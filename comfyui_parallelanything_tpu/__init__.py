@@ -1,0 +1,71 @@
+"""TPU-native parallel-anything: device-chain parallelism for diffusion models on JAX/XLA.
+
+A brand-new framework with the capabilities of ComfyUI-ParallelAnything
+(reference: /root/reference/any_device_parallel.py): build a chain of devices with
+per-device workload percentages, wrap a diffusion model once, and have every sampler
+step execute in parallel across the chain. Where the reference replicates torch
+modules across CUDA devices with threads + PCIe copies, this framework expresses the
+same capabilities as sharded, jit-compiled SPMD programs over a `jax.sharding.Mesh`:
+
+- data parallelism  = batch-axis `NamedSharding` (reference: threaded batch split,
+  any_device_parallel.py:1317-1422)
+- pipeline (batch=1) = contiguous block-range placement over mesh stages
+  (reference: ParallelBlock wrapping, any_device_parallel.py:1152-1198)
+- replication       = a single weight pytree + sharding specs (reference:
+  safe_model_clone, any_device_parallel.py:586-722 — entirely absent here)
+- communication     = XLA ICI collectives (reference: Tensor.to over PCIe)
+
+Beyond parity, long-context sequence/context parallelism (ring attention, Ulysses
+all-to-all) and multi-host meshes are first-class.
+"""
+
+from .version import __version__
+
+from .devices.discovery import (
+    available_devices,
+    get_device,
+    device_platform,
+    default_device,
+)
+from .devices.memory import free_memory_bytes, total_memory_bytes
+
+from .parallel.chain import DeviceLink, DeviceChain
+from .parallel.split import (
+    normalize_weights,
+    largest_remainder_split,
+    weighted_batch_split,
+    blend_memory_weights,
+    block_ranges,
+    batch_size_of,
+    split_tree,
+    split_kwargs,
+    concat_results,
+)
+from .parallel.mesh import build_mesh, mesh_axis_names
+from .parallel.orchestrator import parallelize, ParallelConfig, ParallelModel
+
+__all__ = [
+    "__version__",
+    "available_devices",
+    "get_device",
+    "device_platform",
+    "default_device",
+    "free_memory_bytes",
+    "total_memory_bytes",
+    "DeviceLink",
+    "DeviceChain",
+    "normalize_weights",
+    "largest_remainder_split",
+    "weighted_batch_split",
+    "blend_memory_weights",
+    "block_ranges",
+    "batch_size_of",
+    "split_tree",
+    "split_kwargs",
+    "concat_results",
+    "build_mesh",
+    "mesh_axis_names",
+    "parallelize",
+    "ParallelConfig",
+    "ParallelModel",
+]

@@ -1,0 +1,41 @@
+"""Device memory introspection — the HBM analogue of get_free_vram.
+
+Reference (any_device_parallel.py:724-735): free MB on a CUDA device via
+``total_memory - memory_allocated``, and 0 for any non-CUDA device. Here the probe reads
+``jax.Device.memory_stats()`` (``bytes_limit`` / ``bytes_in_use``), returning 0 for
+devices that expose no stats (host CPU), so CPU-only chains fall back to pure
+user weights exactly like the reference (any_device_parallel.py:738-739).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _stats(device: jax.Device) -> dict | None:
+    try:
+        return device.memory_stats()
+    except Exception:
+        return None
+
+
+def total_memory_bytes(device: jax.Device) -> int:
+    """Device memory capacity in bytes; 0 when the backend exposes no stats."""
+    stats = _stats(device)
+    if not stats:
+        return 0
+    return int(stats.get("bytes_limit", 0))
+
+
+def free_memory_bytes(device: jax.Device) -> int:
+    """Free HBM in bytes (limit - in_use); 0 when unavailable.
+
+    Parity: get_free_vram (any_device_parallel.py:724-735) returns
+    ``total_memory - memory_allocated`` in MB for CUDA and 0 otherwise.
+    """
+    stats = _stats(device)
+    if not stats:
+        return 0
+    limit = int(stats.get("bytes_limit", 0))
+    in_use = int(stats.get("bytes_in_use", 0))
+    return max(0, limit - in_use)

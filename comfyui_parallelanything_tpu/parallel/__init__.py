@@ -1,0 +1,29 @@
+from .chain import DeviceLink, DeviceChain
+from .split import (
+    normalize_weights,
+    largest_remainder_split,
+    weighted_batch_split,
+    blend_memory_weights,
+    block_ranges,
+    batch_size_of,
+    split_tree,
+    split_kwargs,
+    concat_results,
+)
+from .mesh import build_mesh, mesh_axis_names
+
+__all__ = [
+    "DeviceLink",
+    "DeviceChain",
+    "normalize_weights",
+    "largest_remainder_split",
+    "weighted_batch_split",
+    "blend_memory_weights",
+    "block_ranges",
+    "batch_size_of",
+    "split_tree",
+    "split_kwargs",
+    "concat_results",
+    "build_mesh",
+    "mesh_axis_names",
+]

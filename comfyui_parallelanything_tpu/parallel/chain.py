@@ -1,0 +1,136 @@
+"""The DEVICE_CHAIN data type: an ordered list of (device, percentage) links.
+
+Reference semantics (any_device_parallel.py):
+- ParallelDevice.add_device (819-832) copies the incoming chain and appends
+  ``{"device": str, "percentage": float, "weight": pct/100}`` — the ``weight`` key is
+  dead data (setup_parallel renormalizes from ``percentage`` only, 1019-1027), so this
+  implementation does not carry it.
+- ParallelDeviceList.create_list (872-882) builds up to 4 entries at once, dropping
+  entries whose percentage is <= 0 (876-882).
+- setup_parallel normalizes weights as ``pct_i / sum(pct)`` and aborts when the sum is
+  <= 0 (1019-1027).
+
+The chain is immutable; builders return new chains (the reference copies the incoming
+list for the same reason, 821-824).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Iterable, Sequence
+
+import jax
+
+from ..devices.discovery import device_platform, get_device
+from .split import normalize_weights
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceLink:
+    """One link: a device identifier string plus its workload percentage."""
+
+    device: str
+    percentage: float
+
+    def __post_init__(self) -> None:
+        if not isinstance(self.device, str) or not self.device:
+            raise ValueError(f"device must be a non-empty string, got {self.device!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceChain:
+    """An ordered, immutable chain of DeviceLinks — the DEVICE_CHAIN value."""
+
+    links: tuple[DeviceLink, ...] = ()
+
+    # -- builders ----------------------------------------------------------------
+
+    def add(self, device: str, percentage: float) -> "DeviceChain":
+        """Append one link, returning a new chain (parity: add_device, 819-832)."""
+        return DeviceChain(self.links + (DeviceLink(device, float(percentage)),))
+
+    @classmethod
+    def from_pairs(cls, pairs: Iterable[tuple[str, float]]) -> "DeviceChain":
+        """Build a chain from (device, pct) pairs, dropping pct <= 0 entries
+        (parity: ParallelDeviceList.create_list, 872-882)."""
+        links = tuple(
+            DeviceLink(dev, float(pct)) for dev, pct in pairs if float(pct) > 0
+        )
+        return cls(links)
+
+    @classmethod
+    def even(cls, devices: Sequence[str]) -> "DeviceChain":
+        """Convenience: an even split over the given devices."""
+        n = len(devices)
+        if n == 0:
+            return cls()
+        return cls(tuple(DeviceLink(d, 100.0 / n) for d in devices))
+
+    # -- views -------------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.links)
+
+    def __iter__(self):
+        return iter(self.links)
+
+    def __bool__(self) -> bool:
+        return bool(self.links)
+
+    @property
+    def devices(self) -> tuple[str, ...]:
+        return tuple(l.device for l in self.links)
+
+    @property
+    def percentages(self) -> tuple[float, ...]:
+        return tuple(l.percentage for l in self.links)
+
+    @property
+    def platforms(self) -> tuple[str, ...]:
+        return tuple(device_platform(d) for d in self.devices)
+
+    @property
+    def is_homogeneous(self) -> bool:
+        """True when every link lives on the same platform — the case where weighted
+        splits degenerate to even SPMD sharding (SURVEY §7 translation table)."""
+        return len(set(self.platforms)) <= 1
+
+    def normalized_weights(self) -> tuple[float, ...] | None:
+        """``pct_i / sum(pct)``, or None when the sum is <= 0 — the caller must then
+        leave the model untouched (parity: 1019-1027)."""
+        return normalize_weights(self.percentages)
+
+    def jax_devices(self) -> tuple[jax.Device, ...]:
+        """Resolve every link to a live jax.Device. Raises ValueError on any invalid
+        entry (the reference instead skips invalid devices in its replica loop,
+        1037-1042; resolution here happens before mesh construction, where silent
+        skipping would corrupt the sharding layout — callers wanting skip semantics
+        use `validated()`)."""
+        return tuple(get_device(d) for d in self.devices)
+
+    def validated(self) -> "DeviceChain":
+        """Drop links that fail device resolution, mirroring the reference's
+        skip-invalid-device behavior (1037-1042). Weight renormalization happens
+        naturally downstream since weights derive from surviving percentages."""
+        good = []
+        for link in self.links:
+            try:
+                get_device(link.device)
+            except ValueError:
+                continue
+            good.append(link)
+        return DeviceChain(tuple(good))
+
+    def deduplicated(self) -> "DeviceChain":
+        """Merge repeated devices by summing their percentages. The reference allows
+        the same device twice (each gets its own replica + thread); under SPMD a mesh
+        must not contain a device twice, so repeated links fold into one with the
+        combined workload share — same effective split arithmetic."""
+        acc: dict[str, float] = {}
+        order: list[str] = []
+        for link in self.links:
+            if link.device not in acc:
+                order.append(link.device)
+                acc[link.device] = 0.0
+            acc[link.device] += link.percentage
+        return DeviceChain(tuple(DeviceLink(d, acc[d]) for d in order))

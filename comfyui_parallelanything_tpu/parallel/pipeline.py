@@ -1,0 +1,35 @@
+"""Pipeline (batch==1) block-placement mode.
+
+Reference (any_device_parallel.py:1152-1198, 24-87): for batch==1 the model's block
+lists (``double_blocks``/``single_blocks``/``transformer_blocks``/``layers``) are split
+into contiguous ranges proportional to device weights; each block is wrapped so its
+args hop to the owning device, run there, and the last block's output returns to the
+lead device. This is layer *placement* (memory-style pipelining), not microbatched
+throughput pipelining (SURVEY §2e).
+
+TPU-native design: block ranges map to per-stage placements of parameter sub-pytrees;
+activations hop between stages via ``jax.device_put`` over ICI. Fleshed out with the
+staged-model protocol in models/ (see build plan step 5); until a model declares its
+stages this returns None and the router falls back to single-device, which matches the
+reference when no known block list is found (1156-1166).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping, Sequence
+from typing import Any, Callable
+
+import jax
+
+from .split import block_ranges  # noqa: F401  (stage math lives here)
+
+
+def build_pipeline_runner(
+    apply_fn: Callable[..., Any],
+    params: Any,
+    devices: Sequence[jax.Device],
+    weights: Sequence[float],
+    block_lists: Mapping[str, Sequence[str]],
+) -> Callable[..., Any] | None:
+    del apply_fn, params, devices, weights, block_lists
+    return None

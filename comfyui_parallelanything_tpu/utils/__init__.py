@@ -1,0 +1,10 @@
+from .logging import get_logger, log_setup_summary, log_placement, log_degradation
+from .cleanup import aggressive_cleanup
+
+__all__ = [
+    "get_logger",
+    "log_setup_summary",
+    "log_placement",
+    "log_degradation",
+    "aggressive_cleanup",
+]

@@ -1,0 +1,23 @@
+"""Memory cleanup — the JAX analogue of aggressive_cleanup.
+
+Reference (any_device_parallel.py:197-209): ``gc.collect()`` + per-device
+``cuda.synchronize()/empty_cache()`` + host ``soft_empty_cache()``. Under JAX most of
+that surface does not exist: buffers free when their `jax.Array`s die, and there is no
+user-visible allocator cache to flush on TPU. What remains meaningful:
+
+- drop Python garbage so dead `jax.Array` references release device buffers,
+- optionally clear jit compilation caches (only on the OOM path — compiled executables
+  themselves hold device allocations for constants).
+"""
+
+from __future__ import annotations
+
+import gc
+
+import jax
+
+
+def aggressive_cleanup(clear_compile_cache: bool = False) -> None:
+    gc.collect()
+    if clear_compile_cache:
+        jax.clear_caches()

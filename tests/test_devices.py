@@ -1,0 +1,52 @@
+"""Tests for device discovery + memory probes (reference: get_available_devices
+770-786, get_free_vram 724-735)."""
+
+import pytest
+
+from comfyui_parallelanything_tpu.devices.discovery import (
+    available_devices,
+    default_device,
+    device_platform,
+    get_device,
+)
+from comfyui_parallelanything_tpu.devices.memory import (
+    free_memory_bytes,
+    total_memory_bytes,
+)
+
+
+class TestDiscovery:
+    def test_cpu_always_listed_last(self):
+        # Parity: 'cpu' is always in the dropdown (771, 837).
+        devs = available_devices()
+        assert "cpu" in devs
+        assert devs[-1] == "cpu"
+
+    def test_platform_parse(self):
+        assert device_platform("tpu:3") == "tpu"
+        assert device_platform("cpu") == "cpu"
+        assert device_platform("TPU:0") == "tpu"
+
+    def test_get_device_cpu_indices(self, cpu_devices):
+        assert get_device("cpu").id == 0
+        assert get_device("cpu:5").id == 5
+
+    def test_get_device_errors(self):
+        with pytest.raises(ValueError):
+            get_device("cpu:banana")
+        with pytest.raises(ValueError):
+            get_device("quantum:0")
+        with pytest.raises(ValueError):
+            get_device("cpu:9999")
+
+    def test_default_device_exists(self):
+        d = default_device()
+        assert d.platform in ("cpu", "tpu", "gpu")
+
+
+class TestMemory:
+    def test_cpu_reports_zero_or_stats(self, cpu_devices):
+        # Host CPU devices expose no stats → 0, the reference's non-CUDA behavior.
+        v = free_memory_bytes(cpu_devices[0])
+        assert v >= 0
+        assert total_memory_bytes(cpu_devices[0]) >= 0

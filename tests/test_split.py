@@ -1,0 +1,170 @@
+"""Unit tests for the pure split arithmetic (SURVEY §4: the logic the reference never
+tested — weight normalization 1019-1027, split sizes 1317-1322 & 737-766, kwargs
+splitting 1252-1267, result concat 1269-1285)."""
+
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.parallel.split import (
+    batch_size_of,
+    blend_memory_weights,
+    block_ranges,
+    concat_results,
+    largest_remainder_split,
+    normalize_weights,
+    split_kwargs,
+    split_tree,
+    weighted_batch_split,
+)
+
+
+class TestNormalizeWeights:
+    def test_basic(self):
+        assert normalize_weights([50, 50]) == (0.5, 0.5)
+        w = normalize_weights([40, 40, 15, 5])  # README's 4-GPU example split
+        assert w is not None
+        assert abs(sum(w) - 1.0) < 1e-12
+        assert w[0] == pytest.approx(0.4)
+
+    def test_sum_zero_aborts(self):
+        # Reference aborts the whole setup when sum <= 0 (1019-1027).
+        assert normalize_weights([0, 0]) is None
+        assert normalize_weights([]) is None
+        assert normalize_weights([-5, 5]) is None
+
+    def test_unnormalized_percentages(self):
+        w = normalize_weights([1, 3])
+        assert w == (0.25, 0.75)
+
+
+class TestLargestRemainderSplit:
+    def test_sums_exactly(self):
+        for batch in [1, 2, 7, 16, 21, 100]:
+            for weights in [(0.5, 0.5), (0.4, 0.4, 0.15, 0.05), (0.9, 0.05, 0.05)]:
+                sizes = largest_remainder_split(batch, weights)
+                assert sum(sizes) == batch
+                assert all(s >= 0 for s in sizes)
+
+    def test_many_small_weights_no_overflow(self):
+        # The reference's max(1, int(b*w)) overflows here: 8 devices at 12.5% on
+        # batch 4 would produce 8 chunks of 1 = 8 > 4. We must sum to 4 exactly.
+        sizes = largest_remainder_split(4, [1 / 8] * 8)
+        assert sum(sizes) == 4
+        assert sorted(sizes) == [0, 0, 0, 0, 1, 1, 1, 1]
+
+    def test_zero_total(self):
+        assert largest_remainder_split(0, [0.5, 0.5]) == (0, 0)
+
+    def test_even(self):
+        assert largest_remainder_split(16, [0.5, 0.5]) == (8, 8)
+        assert largest_remainder_split(21, [0.5, 0.5]) == (11, 10)  # tie → earlier link
+
+    def test_degenerate_weights_even_split(self):
+        assert largest_remainder_split(8, [0.0, 0.0]) == (4, 4)
+
+    def test_weighted_batch_split_alias(self):
+        assert weighted_batch_split(10, [0.7, 0.3]) == (7, 3)
+
+
+class TestBlendMemoryWeights:
+    def test_blend_formula(self):
+        # Parity: 0.7*user + 0.3*mem_share, renormalized (753-762).
+        w = blend_memory_weights([0.5, 0.5], [100, 300])
+        expected = np.array([0.7 * 0.5 + 0.3 * 0.25, 0.7 * 0.5 + 0.3 * 0.75])
+        expected /= expected.sum()
+        np.testing.assert_allclose(w, expected, rtol=1e-12)
+
+    def test_no_memory_info_falls_back_to_user(self):
+        # CPU-only chain: free bytes all 0 → pure user weights (738-739).
+        assert blend_memory_weights([0.6, 0.4], [0, 0]) == (0.6, 0.4)
+
+    def test_length_mismatch(self):
+        with pytest.raises(ValueError):
+            blend_memory_weights([0.5], [1, 2])
+
+
+class TestBlockRanges:
+    def test_contiguous_cover(self):
+        ranges = block_ranges(19, [0.4, 0.4, 0.2])
+        assert ranges[0][0] == 0
+        assert ranges[-1][1] == 19
+        for (a, b), (c, d) in zip(ranges, ranges[1:]):
+            assert b == c
+        assert sum(b - a for a, b in ranges) == 19
+
+    def test_proportionality(self):
+        ranges = block_ranges(10, [0.5, 0.5])
+        assert ranges == ((0, 5), (5, 10))
+
+    def test_zero_weight_stage_empty(self):
+        ranges = block_ranges(4, [1.0, 0.0])
+        assert ranges == ((0, 4), (4, 4))
+
+
+class TestBatchSizeOf:
+    def test_array(self):
+        assert batch_size_of(np.zeros((5, 3))) == 5
+
+    def test_container(self):
+        # First tensor inside a list/tuple (1213-1218).
+        assert batch_size_of(["meta", np.zeros((7, 2))]) == 7
+
+    def test_scalar_fallback(self):
+        assert batch_size_of(3.0) == 1
+        assert batch_size_of(np.float32(1.0)) == 1
+
+
+class TestSplitTree:
+    def test_array_split(self):
+        chunks = split_tree(np.arange(10).reshape(10, 1), [7, 3])
+        assert chunks[0].shape == (7, 1)
+        assert chunks[1].shape == (3, 1)
+        np.testing.assert_array_equal(np.concatenate(chunks), np.arange(10).reshape(10, 1))
+
+    def test_container_elementwise_and_replication(self):
+        x = [np.zeros((4, 2)), "label"]
+        chunks = split_tree(x, [2, 2])
+        assert chunks[0][0].shape == (2, 2)
+        assert chunks[0][1] == "label" and chunks[1][1] == "label"
+
+    def test_non_matching_array_replicated(self):
+        # An array whose dim0 != sum(sizes) is treated as non-batch and replicated.
+        x = np.zeros((3, 2))
+        chunks = split_tree(x, [2, 2])
+        assert chunks[0].shape == (3, 2) and chunks[1].shape == (3, 2)
+
+
+class TestSplitKwargs:
+    def test_split_iff_dim0_matches_batch(self):
+        # Parity rule (1252-1267): split only arrays with dim0 == batch.
+        kwargs = {
+            "y": np.zeros((8, 4)),       # split
+            "guidance": np.zeros((3,)),  # broadcast (dim0 != batch)
+            "flag": True,                # broadcast (non-array)
+        }
+        out = split_kwargs(kwargs, batch=8, sizes=[5, 3])
+        assert out[0]["y"].shape == (5, 4)
+        assert out[1]["y"].shape == (3, 4)
+        assert out[0]["guidance"].shape == (3,)
+        assert out[1]["flag"] is True
+
+
+class TestConcatResults:
+    def test_arrays(self):
+        out = concat_results([np.ones((2, 3)), np.zeros((1, 3))])
+        assert out.shape == (3, 3)
+
+    def test_tuple_outputs_elementwise(self):
+        # Parity: tuple-of-tensors outputs concat element-wise (1276-1282).
+        a = (np.ones((2, 1)), np.ones((2, 2)))
+        b = (np.zeros((1, 1)), np.zeros((1, 2)))
+        out = concat_results([a, b])
+        assert isinstance(out, tuple)
+        assert out[0].shape == (3, 1) and out[1].shape == (3, 2)
+
+    def test_non_array_passthrough_from_chunk0(self):
+        assert concat_results(["first", "second"]) == "first"
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            concat_results([])
