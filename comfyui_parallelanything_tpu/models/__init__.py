@@ -1,0 +1,11 @@
+from .api import DiffusionModel
+from .unet import UNet2D, UNetConfig, sd15_config, sdxl_config, build_unet
+
+__all__ = [
+    "DiffusionModel",
+    "UNet2D",
+    "UNetConfig",
+    "sd15_config",
+    "sdxl_config",
+    "build_unet",
+]

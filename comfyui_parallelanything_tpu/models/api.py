@@ -1,0 +1,41 @@
+"""The model handle the orchestrator consumes.
+
+The reference duck-types ComfyUI's MODEL wrapper down to a bare ``diffusion_model``
+with ``forward(x, timesteps, context=None, **kwargs)`` (any_device_parallel.py:921-930,
+1287). The functional analogue is this dataclass: a pure ``apply`` + ``params`` pytree
++ metadata the parallel layers need (block lists for pipeline placement, preferred
+dtype). ``parallelize`` accepts it directly (it satisfies the .apply/.params protocol).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+
+
+@dataclasses.dataclass
+class DiffusionModel:
+    """A diffusion network as data: pure apply fn + weights + metadata."""
+
+    apply: Callable[..., Any]
+    params: Any
+    name: str = "model"
+    config: Any = None
+    # Pipeline metadata — the analogue of the reference's block-list discovery over
+    # ['double_blocks', 'single_blocks', 'transformer_blocks', 'layers'] (1156):
+    # maps block-list name -> number of blocks, in execution order.
+    block_lists: dict[str, int] | None = None
+
+    def __call__(self, x, timesteps, context=None, **kwargs):
+        """Jit-compiled forward (cached per shape); kwargs must be arrays here —
+        route python-valued kwargs through ``apply`` directly."""
+        if not hasattr(self, "_jit_apply"):
+            object.__setattr__(self, "_jit_apply", jax.jit(self.apply))
+        return self._jit_apply(self.params, x, timesteps, context, **kwargs)
+
+    def n_params(self) -> int:
+        import jax
+
+        return sum(int(l.size) for l in jax.tree.leaves(self.params))

@@ -1,0 +1,9 @@
+from .basic import timestep_embedding
+from .attention import attention, set_attention_backend, get_attention_backend
+
+__all__ = [
+    "timestep_embedding",
+    "attention",
+    "set_attention_backend",
+    "get_attention_backend",
+]

@@ -1,0 +1,71 @@
+"""Attention with pluggable backends.
+
+The reference toggles flash/xformers OFF for old GPUs (disable_flash_xformers,
+any_device_parallel.py:126-164) — capability-gated attention backends are part of its
+surface. The TPU equivalent is a backend registry:
+
+- ``"xla"``    — plain jnp dot-product attention; XLA fuses it well for moderate
+  sequence lengths and it runs everywhere (the safe fallback, like the reference's
+  post-disable path).
+- ``"pallas"`` — fused flash-attention kernel for TPU (ops/pallas/), used for the long
+  sequences of the FLUX/video configs.
+- ``"auto"``   — pallas on TPU when available and the shape qualifies, else xla.
+
+All functions take (B, S, H, D)-shaped q/k/v ("BSHD") and return (B, S, H, D).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_BACKEND = "auto"
+
+
+def set_attention_backend(name: str) -> None:
+    global _BACKEND
+    if name not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown attention backend {name!r}")
+    _BACKEND = name
+
+
+def get_attention_backend() -> str:
+    return _BACKEND
+
+
+def _xla_attention(q, k, v, scale):
+    # (B, S, H, D) -> einsum over D; stable softmax in f32.
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.cache
+def _pallas_available() -> bool:
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        return False
+    return any(d.platform == "tpu" for d in devs)
+
+
+def attention(q, k, v, scale: float | None = None) -> jnp.ndarray:
+    """Scaled dot-product attention on (B, S, H, D) inputs."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    backend = _BACKEND
+    if backend == "auto":
+        # The pallas kernel wants lane-aligned head dims and TPU hardware.
+        use_pallas = (
+            _pallas_available() and q.shape[-1] % 128 == 0 and q.shape[1] % 128 == 0
+            and k.shape[1] % 128 == 0
+        )
+        backend = "pallas" if use_pallas else "xla"
+    if backend == "pallas":
+        from .pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, scale=scale)
+    return _xla_attention(q, k, v, scale)

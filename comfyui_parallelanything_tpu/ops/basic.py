@@ -1,0 +1,24 @@
+"""Shared primitive ops for the model zoo."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def timestep_embedding(
+    t: jnp.ndarray, dim: int, max_period: float = 10000.0, time_factor: float = 1.0
+) -> jnp.ndarray:
+    """Sinusoidal timestep embedding, (B,) -> (B, dim).
+
+    The classic DDPM/transformer embedding used by every model family in scope (the
+    reference's models compute this inside their torch UNet/DiT; it lives once here).
+    Computed in float32 for stability, cast by callers.
+    """
+    t = time_factor * jnp.asarray(t, jnp.float32)
+    half = dim // 2
+    freqs = jnp.exp(-jnp.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t[:, None] * freqs[None, :]
+    emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    if dim % 2:
+        emb = jnp.concatenate([emb, jnp.zeros_like(emb[:, :1])], axis=-1)
+    return emb

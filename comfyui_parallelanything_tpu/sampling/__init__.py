@@ -1,0 +1,4 @@
+from .schedules import scaled_linear_schedule, ddim_timesteps
+from .ddim import ddim_sample
+
+__all__ = ["scaled_linear_schedule", "ddim_timesteps", "ddim_sample"]

@@ -1,0 +1,62 @@
+"""DDIM sampler (eps-prediction, deterministic η=0) with batched CFG.
+
+Host-side step loop, mirroring how the reference is driven: ComfyUI's KSampler calls
+the (monkey-patched) ``diffusion_model.forward`` once per denoise step
+(any_device_parallel.py:1287 — 'Called by ComfyUI's sampler every denoise step'). The
+``model`` argument here is any forward callable — a bare ``DiffusionModel`` or the
+``ParallelModel`` the orchestrator returns — so every step routes through the parallel
+scheduler exactly like the reference's sampler steps do.
+
+Classifier-free guidance doubles the batch (cond ‖ uncond in one forward), which is
+also what feeds the data-parallel path its batch dimension.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .schedules import ddim_timesteps, scaled_linear_schedule
+
+
+def ddim_sample(
+    model,
+    x_init: jnp.ndarray,
+    context: jnp.ndarray | None = None,
+    *,
+    steps: int = 20,
+    cfg_scale: float = 1.0,
+    uncond_context: jnp.ndarray | None = None,
+    alphas_cumprod: jnp.ndarray | None = None,
+    callback=None,
+    **model_kwargs,
+) -> jnp.ndarray:
+    """Denoise ``x_init`` (noise at t=T) over ``steps`` DDIM steps. Returns x_0."""
+    if alphas_cumprod is None:
+        alphas_cumprod = scaled_linear_schedule()
+    ts = ddim_timesteps(steps, alphas_cumprod.shape[0])
+    batch = x_init.shape[0]
+    use_cfg = cfg_scale != 1.0 and uncond_context is not None
+
+    x = x_init
+    for i, t in enumerate(ts):
+        t_vec = jnp.full((batch,), t, jnp.float32)
+        if use_cfg:
+            x_in = jnp.concatenate([x, x], axis=0)
+            t_in = jnp.concatenate([t_vec, t_vec], axis=0)
+            c_in = jnp.concatenate([context, uncond_context], axis=0)
+            kw = dict(model_kwargs)
+            if "y" in kw and kw["y"] is not None:
+                kw["y"] = jnp.concatenate([kw["y"], kw["y"]], axis=0)
+            eps_both = model(x_in, t_in, c_in, **kw)
+            eps_c, eps_u = jnp.split(eps_both, 2, axis=0)
+            eps = eps_u + cfg_scale * (eps_c - eps_u)
+        else:
+            eps = model(x, t_vec, context, **model_kwargs)
+
+        a_t = alphas_cumprod[t]
+        a_prev = alphas_cumprod[ts[i + 1]] if i + 1 < len(ts) else jnp.float32(1.0)
+        x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
+        x = jnp.sqrt(a_prev) * x0 + jnp.sqrt(1.0 - a_prev) * eps
+        if callback is not None:
+            callback(i, x)
+    return x

@@ -1,0 +1,22 @@
+"""Noise schedules for the SD-family samplers."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def scaled_linear_schedule(
+    n_timesteps: int = 1000, beta_start: float = 0.00085, beta_end: float = 0.012
+) -> jnp.ndarray:
+    """SD's 'scaled_linear' betas → cumulative alphas (ᾱ_t), shape (n_timesteps,)."""
+    betas = (
+        jnp.linspace(beta_start**0.5, beta_end**0.5, n_timesteps, dtype=jnp.float32)
+        ** 2
+    )
+    return jnp.cumprod(1.0 - betas)
+
+
+def ddim_timesteps(n_steps: int, n_train: int = 1000) -> jnp.ndarray:
+    """Evenly spaced sampling timesteps, descending (e.g. 20 of 1000)."""
+    step = n_train // n_steps
+    return jnp.arange(0, n_train, step, dtype=jnp.int32)[::-1]

@@ -8,7 +8,12 @@ v5e-8, minus the ICI.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU platform: the profile exports JAX_PLATFORMS=axon (the tunneled TPU),
+# but the test suite is defined over the virtual 8-device CPU mesh. Dropping the axon
+# pool var also keeps the sitecustomize TPU-tunnel registration out of test runs (a
+# wedged tunnel otherwise blocks jax import even for CPU work).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

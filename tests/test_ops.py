@@ -1,0 +1,75 @@
+"""Tests for shared ops: timestep embedding, attention backends, pallas flash kernel
+(interpreter mode on the CPU platform)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.ops import attention, timestep_embedding
+from comfyui_parallelanything_tpu.ops.attention import _xla_attention
+from comfyui_parallelanything_tpu.ops.pallas.flash_attention import flash_attention
+
+
+class TestTimestepEmbedding:
+    def test_shape_and_range(self):
+        emb = timestep_embedding(jnp.arange(4, dtype=jnp.float32), 128)
+        assert emb.shape == (4, 128)
+        assert np.all(np.abs(np.asarray(emb)) <= 1.0 + 1e-6)
+
+    def test_odd_dim(self):
+        emb = timestep_embedding(jnp.ones((2,)), 65)
+        assert emb.shape == (2, 65)
+
+    def test_t_zero_finite(self):
+        emb = timestep_embedding(jnp.zeros((1,)), 64)
+        assert np.all(np.isfinite(np.asarray(emb)))
+
+
+def _qkv(b=2, sq=64, sk=48, h=4, d=32, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, sq, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, sk, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, sk, h, d)), jnp.float32)
+    return q, k, v
+
+
+class TestAttention:
+    def test_xla_softmax_rows_sum(self):
+        q, k, v = _qkv()
+        out = attention(q, k, v)
+        assert out.shape == q.shape
+
+    def test_self_vs_manual(self):
+        q, k, v = _qkv(b=1, sq=8, sk=8, h=1, d=4)
+        out = np.asarray(attention(q, k, v))[0, :, 0, :]
+        qm, km, vm = (np.asarray(a)[0, :, 0, :] for a in (q, k, v))
+        logits = qm @ km.T / np.sqrt(4)
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, probs @ vm, rtol=1e-5, atol=1e-6)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("sq,sk", [(64, 64), (100, 80), (256, 256), (300, 513)])
+    def test_matches_xla(self, sq, sk):
+        q, k, v = _qkv(b=1, sq=sq, sk=sk, h=2, d=32)
+        got = flash_attention(q, k, v, block_q=128, block_k=128, interpret=True)
+        want = _xla_attention(q, k, v, scale=32**-0.5)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+    def test_cross_attention_shape(self):
+        q, k, v = _qkv(b=2, sq=32, sk=77, h=4, d=16)
+        got = flash_attention(q, k, v, interpret=True)
+        assert got.shape == (2, 32, 4, 16)
+
+    def test_bf16(self):
+        q, k, v = _qkv(b=1, sq=64, sk=64, h=1, d=32)
+        q, k, v = (a.astype(jnp.bfloat16) for a in (q, k, v))
+        got = flash_attention(q, k, v, interpret=True)
+        assert got.dtype == jnp.bfloat16
+        want = _xla_attention(q, k, v, scale=32**-0.5)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=5e-2, atol=5e-2
+        )
