@@ -1,5 +1,12 @@
 from .api import DiffusionModel
 from .unet import UNet2D, UNetConfig, sd15_config, sdxl_config, build_unet
+from .flux import (
+    FluxModel,
+    FluxConfig,
+    flux_dev_config,
+    flux_schnell_config,
+    build_flux,
+)
 
 __all__ = [
     "DiffusionModel",
@@ -8,4 +15,9 @@ __all__ = [
     "sd15_config",
     "sdxl_config",
     "build_unet",
+    "FluxModel",
+    "FluxConfig",
+    "flux_dev_config",
+    "flux_schnell_config",
+    "build_flux",
 ]

@@ -1,4 +1,11 @@
 from .schedules import scaled_linear_schedule, ddim_timesteps
 from .ddim import ddim_sample
+from .flow import flow_euler_sample, flow_timesteps
 
-__all__ = ["scaled_linear_schedule", "ddim_timesteps", "ddim_sample"]
+__all__ = [
+    "scaled_linear_schedule",
+    "ddim_timesteps",
+    "ddim_sample",
+    "flow_euler_sample",
+    "flow_timesteps",
+]
