@@ -43,6 +43,8 @@ from .parallel.split import (
 )
 from .parallel.mesh import build_mesh, mesh_axis_names
 from .parallel.orchestrator import parallelize, ParallelConfig, ParallelModel
+from .parallel.sequence import sequence_parallel_attention
+from .utils.metrics import StepTimer, trace
 
 __all__ = [
     "__version__",
@@ -68,4 +70,7 @@ __all__ = [
     "parallelize",
     "ParallelConfig",
     "ParallelModel",
+    "sequence_parallel_attention",
+    "StepTimer",
+    "trace",
 ]

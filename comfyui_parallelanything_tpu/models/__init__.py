@@ -1,15 +1,19 @@
-from .api import DiffusionModel
+from .api import DiffusionModel, PipelineSegment, PipelineSpec
 from .unet import UNet2D, UNetConfig, sd15_config, sdxl_config, build_unet
 from .flux import (
     FluxModel,
     FluxConfig,
     flux_dev_config,
     flux_schnell_config,
+    z_image_turbo_config,
     build_flux,
 )
+from .wan import WanModel, WanConfig, wan_1_3b_config, wan_14b_config, build_wan
 
 __all__ = [
     "DiffusionModel",
+    "PipelineSegment",
+    "PipelineSpec",
     "UNet2D",
     "UNetConfig",
     "sd15_config",
@@ -19,5 +23,11 @@ __all__ = [
     "FluxConfig",
     "flux_dev_config",
     "flux_schnell_config",
+    "z_image_turbo_config",
     "build_flux",
+    "WanModel",
+    "WanConfig",
+    "wan_1_3b_config",
+    "wan_14b_config",
+    "build_wan",
 ]

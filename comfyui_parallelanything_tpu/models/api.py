@@ -15,6 +15,41 @@ from typing import Any, Callable
 import jax
 
 
+@dataclasses.dataclass(frozen=True)
+class PipelineSegment:
+    """One pipeline-schedulable unit of the forward pass — usually a single block of a
+    block list (the things the reference wraps in ParallelBlock, 1180-1198).
+
+    ``param_keys`` names the top-level entries of the parameter pytree this segment
+    reads, so the pipeline runner can place exactly that sub-pytree on the owning
+    device. ``fn(params, carry) -> carry`` runs the segment; ``carry`` is a flat dict
+    of arrays with a stable structure across every segment of the model, so stage
+    programs compose and activations hop devices as one pytree.
+    """
+
+    param_keys: tuple[str, ...]
+    fn: Callable[[Any, dict], dict]
+    label: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineSpec:
+    """A model's pipeline decomposition: prepare (lead) → segments (staged) → finalize
+    (lead). The functional analogue of the reference's block-list walk + ParallelBlock
+    wrapping (any_device_parallel.py:1152-1198): non-block layers (embeddings, final
+    norm/projection) always run on the lead device (SURVEY §3.4), block segments are
+    assigned contiguous ranges proportional to device weights.
+    """
+
+    prepare_keys: tuple[str, ...]
+    prepare: Callable[..., dict]  # (params, x, t, context, **kwargs) -> carry
+    segments: tuple[PipelineSegment, ...]
+    finalize_keys: tuple[str, ...]
+    # (params, carry, x) -> output; x is the original model input, passed so the
+    # head can recover static output geometry (e.g. un-patchify shape).
+    finalize: Callable[[Any, dict, Any], Any]
+
+
 @dataclasses.dataclass
 class DiffusionModel:
     """A diffusion network as data: pure apply fn + weights + metadata."""
@@ -27,6 +62,10 @@ class DiffusionModel:
     # ['double_blocks', 'single_blocks', 'transformer_blocks', 'layers'] (1156):
     # maps block-list name -> number of blocks, in execution order.
     block_lists: dict[str, int] | None = None
+    # Staged decomposition for the batch==1 pipeline mode; None → model cannot
+    # pipeline and the router falls back to single-device (parity: no known block
+    # list found, 1156-1166).
+    pipeline_spec: PipelineSpec | None = None
 
     def __call__(self, x, timesteps, context=None, **kwargs):
         """Jit-compiled forward (cached per shape); kwargs must be arrays here —

@@ -1,0 +1,273 @@
+"""Torch-checkpoint → JAX parameter conversion, with LoRA baking.
+
+SURVEY §7 hard parts 2 and 5: the one place torch legitimately remains is CPU-side
+checkpoint loading. The reference replicates live torch modules, preserving fp8-stored
+weights and LoRA patches through cloning (any_device_parallel.py:93-124, 688-699,
+971-1004). Here the equivalents are:
+
+- fp8-on-disk weights upcast at load — v5e has no fp8 matmul path, so fp8 tensors
+  become the model's compute dtype on conversion (parity: fp8→fp16 downcast on
+  non-fp8 devices, 688-699);
+- LoRA is baked into the base weights *before* conversion (``bake_lora``) — the
+  analogue of the reference's bake-before-replicate ``patch_model(device_to=...)``
+  call (992-1004): one merged weight set, replicated by sharding, no per-step patch
+  math;
+- name/layout mapping: torch ``Linear.weight`` is (out, in) → flax ``kernel`` is
+  (in, out); torch ``Conv2d.weight`` is (O, I, kH, kW) → flax (kH, kW, I, O); fused
+  qkv (3·H·D, in) → DenseGeneral kernels (in, 3, H, D).
+
+All functions take a flat ``{name: tensor}`` state dict (torch tensors or numpy
+arrays) and return JAX pytrees; no torch import is required unless torch tensors are
+actually passed in.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import get_logger
+from .flux import FluxConfig
+
+_FP8_DTYPE_NAMES = (
+    # Parity: is_float8_dtype's five-name string match (93-98).
+    "float8_e4m3fn",
+    "float8_e4m3fnuz",
+    "float8_e5m2",
+    "float8_e5m2fnuz",
+    "float8_e8m0fnu",
+)
+
+
+def is_float8_dtype(dtype: Any) -> bool:
+    """String-matched fp8 detection, torch- and numpy-dtype agnostic (parity 93-98)."""
+    return any(name in str(dtype) for name in _FP8_DTYPE_NAMES)
+
+
+def to_numpy(t: Any) -> np.ndarray:
+    """Any checkpoint tensor → float32 numpy. fp8/bf16/f16 upcast to f32 here; the
+    model's compute dtype policy re-casts at apply time (bf16 matmuls on TPU)."""
+    if isinstance(t, np.ndarray):
+        return t.astype(np.float32) if t.dtype != np.float32 else t
+    # torch tensor (duck-typed so numpy-only callers never import torch)
+    if hasattr(t, "detach"):
+        t = t.detach()
+        if is_float8_dtype(t.dtype) or str(t.dtype) in ("torch.bfloat16", "torch.float16"):
+            t = t.float()
+        return t.cpu().numpy().astype(np.float32)
+    return np.asarray(t, dtype=np.float32)
+
+
+# --------------------------------------------------------------------------------------
+# Layout transforms (torch → flax)
+# --------------------------------------------------------------------------------------
+
+
+def linear_kernel(w: Any) -> np.ndarray:
+    """(out, in) → (in, out)."""
+    return to_numpy(w).T
+
+
+def conv_kernel(w: Any) -> np.ndarray:
+    """(O, I, kH, kW) → (kH, kW, I, O)."""
+    return to_numpy(w).transpose(2, 3, 1, 0)
+
+
+def qkv_kernel(w: Any, heads: int, head_dim: int) -> np.ndarray:
+    """Fused qkv (3·H·D, in) → DenseGeneral kernel (in, 3, H, D)."""
+    arr = to_numpy(w)
+    in_dim = arr.shape[1]
+    return arr.reshape(3, heads, head_dim, in_dim).transpose(3, 0, 1, 2)
+
+
+def qkv_bias(b: Any, heads: int, head_dim: int) -> np.ndarray:
+    """(3·H·D,) → (3, H, D)."""
+    return to_numpy(b).reshape(3, heads, head_dim)
+
+
+# --------------------------------------------------------------------------------------
+# LoRA baking (bake-before-convert; parity: patch_model at 992-1004)
+# --------------------------------------------------------------------------------------
+
+
+def _lora_pairs(lora_sd: Mapping[str, Any]) -> dict[str, tuple[Any, Any, float | None]]:
+    """Collect (down/A, up/B, alpha) per base key from either naming convention:
+    kohya ``{base}.lora_down.weight`` / ``.lora_up.weight`` / ``.alpha`` or
+    diffusers/PEFT ``{base}.lora_A.weight`` / ``.lora_B.weight``."""
+    pairs: dict[str, dict[str, Any]] = {}
+    for key, tensor in lora_sd.items():
+        for down_tag, up_tag in ((".lora_down.weight", ".lora_up.weight"),
+                                 (".lora_A.weight", ".lora_B.weight")):
+            if key.endswith(down_tag):
+                pairs.setdefault(key[: -len(down_tag)], {})["down"] = tensor
+                break
+            if key.endswith(up_tag):
+                pairs.setdefault(key[: -len(up_tag)], {})["up"] = tensor
+                break
+        else:
+            if key.endswith(".alpha"):
+                pairs.setdefault(key[: -len(".alpha")], {})["alpha"] = tensor
+    out = {}
+    for base, parts in pairs.items():
+        if "down" in parts and "up" in parts:
+            alpha = parts.get("alpha")
+            out[base] = (
+                parts["down"],
+                parts["up"],
+                float(to_numpy(alpha)) if alpha is not None else None,
+            )
+    return out
+
+
+def bake_lora(
+    state_dict: Mapping[str, Any],
+    lora_sd: Mapping[str, Any],
+    strength: float = 1.0,
+) -> dict[str, np.ndarray]:
+    """Merge LoRA deltas into base weights: ``W += strength · (alpha/r) · up @ down``.
+
+    Returns a new float32 state dict; unmatched LoRA keys are logged and skipped
+    (the reference prints-and-continues on patch failures, 1002-1004). Matching is by
+    base-key prefix with '.weight' appended, tolerating the common ``lora_unet_`` /
+    underscore-flattened prefixes by also trying a dot-normalized form.
+    """
+    merged = {k: to_numpy(v) for k, v in state_dict.items()}
+    by_normalized = {k.replace(".", "_"): k for k in merged}
+    unmatched = []
+    for base, (down, up, alpha) in _lora_pairs(lora_sd).items():
+        target = None
+        for cand in (f"{base}.weight", base):
+            if cand in merged:
+                target = cand
+                break
+        if target is None:
+            # kohya convention flattens dots to underscores and prefixes the module
+            # tree root (e.g. lora_unet_double_blocks_0_img_attn_qkv).
+            stripped = base
+            for prefix in ("lora_unet_", "lora_transformer_", "lora_te_", "lora_"):
+                if stripped.startswith(prefix):
+                    stripped = stripped[len(prefix):]
+                    break
+            key = by_normalized.get(f"{stripped}_weight".replace(".", "_"))
+            if key is None:
+                key = by_normalized.get(stripped.replace(".", "_"))
+            target = key
+        if target is None:
+            unmatched.append(base)
+            continue
+        down_a, up_a = to_numpy(down), to_numpy(up)
+        rank = down_a.shape[0]
+        scale = strength * ((alpha / rank) if alpha is not None else 1.0)
+        w = merged[target]
+        if w.ndim == 4:  # conv: (O, I, kH, kW) with 1x1 or kxk lora
+            delta = np.einsum(
+                "or...,ri...->oi...",
+                up_a.reshape(up_a.shape[0], rank, *up_a.shape[2:]),
+                down_a.reshape(rank, down_a.shape[1], *down_a.shape[2:]),
+            )
+            if delta.shape != w.shape:  # 1x1 lora on kxk conv: broadcast at center
+                unmatched.append(base)
+                continue
+            merged[target] = w + scale * delta
+        else:
+            merged[target] = w + scale * (up_a @ down_a)
+    if unmatched:
+        get_logger().warning(
+            "bake_lora: %d LoRA key(s) had no base match and were skipped: %s",
+            len(unmatched),
+            unmatched[:5],
+        )
+    return merged
+
+
+# --------------------------------------------------------------------------------------
+# FLUX checkpoint map (official BFL layout → models/flux.py param tree)
+# --------------------------------------------------------------------------------------
+
+
+def _mlp_embedder(sd: Mapping[str, Any], prefix: str) -> dict:
+    return {
+        "in_layer": {
+            "kernel": linear_kernel(sd[f"{prefix}.in_layer.weight"]),
+            "bias": to_numpy(sd[f"{prefix}.in_layer.bias"]),
+        },
+        "out_layer": {
+            "kernel": linear_kernel(sd[f"{prefix}.out_layer.weight"]),
+            "bias": to_numpy(sd[f"{prefix}.out_layer.bias"]),
+        },
+    }
+
+
+def _dense(sd: Mapping[str, Any], key: str) -> dict:
+    out = {"kernel": linear_kernel(sd[f"{key}.weight"])}
+    if f"{key}.bias" in sd:
+        out["bias"] = to_numpy(sd[f"{key}.bias"])
+    return out
+
+
+def convert_flux_checkpoint(
+    state_dict: Mapping[str, Any],
+    cfg: FluxConfig,
+    lora_sd: Mapping[str, Any] | None = None,
+    lora_strength: float = 1.0,
+) -> dict:
+    """Official FLUX state dict (flux1-dev/schnell layout) → the param pytree of
+    ``models.flux.FluxModel``. LoRA, when given, is baked first (992-1004 parity)."""
+    sd = dict(state_dict)
+    if lora_sd:
+        sd = bake_lora(sd, lora_sd, lora_strength)
+    H, D = cfg.num_heads, cfg.head_dim
+    p: dict[str, Any] = {}
+
+    p["img_in"] = _dense(sd, "img_in")
+    p["txt_in"] = _dense(sd, "txt_in")
+    p["time_in"] = _mlp_embedder(sd, "time_in")
+    p["vector_in"] = _mlp_embedder(sd, "vector_in")
+    if cfg.guidance_embed:
+        p["guidance_in"] = _mlp_embedder(sd, "guidance_in")
+
+    for i in range(cfg.depth):
+        t = f"double_blocks.{i}"
+        blk: dict[str, Any] = {}
+        for stream in ("img", "txt"):
+            blk[f"{stream}_mod"] = {"lin": _dense(sd, f"{t}.{stream}_mod.lin")}
+            blk[f"{stream}_attn_qkv"] = {
+                "kernel": qkv_kernel(sd[f"{t}.{stream}_attn.qkv.weight"], H, D),
+                "bias": qkv_bias(sd[f"{t}.{stream}_attn.qkv.bias"], H, D),
+            }
+            blk[f"{stream}_attn_norm"] = {
+                "query_norm": to_numpy(sd[f"{t}.{stream}_attn.norm.query_norm.scale"]),
+                "key_norm": to_numpy(sd[f"{t}.{stream}_attn.norm.key_norm.scale"]),
+            }
+            blk[f"{stream}_attn_proj"] = _dense(sd, f"{t}.{stream}_attn.proj")
+            blk[f"{stream}_mlp_in"] = _dense(sd, f"{t}.{stream}_mlp.0")
+            blk[f"{stream}_mlp_out"] = _dense(sd, f"{t}.{stream}_mlp.2")
+        p[f"double_blocks_{i}"] = blk
+
+    for i in range(cfg.depth_single_blocks):
+        t = f"single_blocks.{i}"
+        p[f"single_blocks_{i}"] = {
+            "modulation": {"lin": _dense(sd, f"{t}.modulation.lin")},
+            "linear1": _dense(sd, f"{t}.linear1"),
+            "linear2": _dense(sd, f"{t}.linear2"),
+            "norm": {
+                "query_norm": to_numpy(sd[f"{t}.norm.query_norm.scale"]),
+                "key_norm": to_numpy(sd[f"{t}.norm.key_norm.scale"]),
+            },
+        }
+
+    # final_layer.adaLN_modulation.1 emits (shift, scale); our final_mod emits the
+    # same two chunks in the same order.
+    p["final_mod"] = _dense(sd, "final_layer.adaLN_modulation.1")
+    p["final_proj"] = _dense(sd, "final_layer.linear")
+
+    return _tree_to_jnp(p)
+
+
+def _tree_to_jnp(tree: Any) -> Any:
+    if isinstance(tree, dict):
+        return {k: _tree_to_jnp(v) for k, v in tree.items()}
+    return jnp.asarray(tree)

@@ -26,9 +26,9 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import attention
-from ..ops.basic import timestep_embedding
+from ..ops.basic import modulate as _modulate, rms_normalize, timestep_embedding
 from ..ops.rope import apply_rope, axis_rope_freqs
-from .api import DiffusionModel
+from .api import DiffusionModel, PipelineSegment, PipelineSpec
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,6 +60,23 @@ def flux_schnell_config(**overrides) -> FluxConfig:
     return dataclasses.replace(FluxConfig(guidance_embed=False), **overrides)
 
 
+def z_image_turbo_config(**overrides) -> FluxConfig:
+    """Z_Image-class turbo DiT — the reference's headline benchmark model
+    (/root/reference/README.md:46-60: batch=21 @1024², 26.00 s/it on one RTX 3090).
+
+    Z-Image is a ~6B single-stream-heavy MMDiT distilled for few-step sampling (no
+    CFG pass, no guidance embed). Modeled here as the single-stream-dominant point
+    in the MMDiT family: a handful of double blocks feeding a deep single-block
+    stack at FLUX's hidden width but roughly half the total depth.
+    """
+    base = FluxConfig(
+        depth=6,
+        depth_single_blocks=26,
+        guidance_embed=False,
+    )
+    return dataclasses.replace(base, **overrides)
+
+
 class MLPEmbedder(nn.Module):
     cfg: FluxConfig
 
@@ -85,11 +102,6 @@ class Modulation(nn.Module):
         return jnp.split(out[:, None, :], 3 * self.n_sets, axis=-1)
 
 
-def _modulate(x, shift, scale):
-    xf = x.astype(jnp.float32)
-    return (xf * (1.0 + scale) + shift).astype(x.dtype)
-
-
 class QKNorm(nn.Module):
     """Per-head RMSNorm on q and k (f32), FLUX-style."""
 
@@ -97,11 +109,7 @@ class QKNorm(nn.Module):
     def __call__(self, q, k):
         def rms(x, name):
             scale = self.param(name, nn.initializers.ones, (x.shape[-1],))
-            xf = x.astype(jnp.float32)
-            normed = xf * jax.lax.rsqrt(
-                jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6
-            )
-            return (normed * scale).astype(x.dtype)
+            return rms_normalize(x, scale)
 
         return rms(q, "query_norm"), rms(k, "key_norm")
 
@@ -200,12 +208,37 @@ class SingleBlock(nn.Module):
 
 class FluxModel(nn.Module):
     """forward(x latent NHWC, timesteps (B,), context (B,S,ctx_dim),
-    y=(B,vec_dim) pooled vector, guidance=(B,) optional)."""
+    y=(B,vec_dim) pooled vector, guidance=(B,) optional).
+
+    Setup-style (not @nn.compact) so the forward decomposes into staged methods —
+    ``prepare`` / ``double_step`` / ``single_step`` / ``finalize`` — callable
+    individually via ``module.apply(..., method=...)`` with only the parameter
+    sub-pytree each stage owns. That is what makes the batch==1 pipeline placement
+    mode (reference: block-list walk, any_device_parallel.py:1152-1198) expressible
+    as per-device jit programs instead of monkey-patched module wrappers. The carry
+    between stages is a flat dict of arrays: img, txt, vec, rope_cos, rope_sin.
+    """
 
     cfg: FluxConfig
 
-    @nn.compact
-    def __call__(self, x, timesteps, context=None, y=None, guidance=None, **kwargs):
+    def setup(self):
+        cfg = self.cfg
+        self.img_in = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)
+        self.txt_in = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)
+        self.time_in = MLPEmbedder(cfg)
+        if cfg.guidance_embed:
+            self.guidance_in = MLPEmbedder(cfg)
+        self.vector_in = MLPEmbedder(cfg)
+        self.double_blocks = [DoubleBlock(cfg) for _ in range(cfg.depth)]
+        self.single_blocks = [SingleBlock(cfg) for _ in range(cfg.depth_single_blocks)]
+        self.final_mod = nn.Dense(2 * cfg.hidden_size, dtype=jnp.float32)
+        self.final_norm = nn.LayerNorm(use_bias=False, use_scale=False, dtype=cfg.dtype)
+        # in_channels is already the patchified token width (p*p*latent_ch), so the
+        # projection back to patches has exactly in_channels features.
+        self.final_proj = nn.Dense(cfg.in_channels, dtype=jnp.float32)
+
+    def prepare(self, x, timesteps, context=None, y=None, guidance=None, **kwargs):
+        """Embeddings + position tables → the stage carry (runs on the lead device)."""
         cfg = self.cfg
         B, Hh, Ww, C = x.shape
         p = cfg.patch_size
@@ -214,26 +247,24 @@ class FluxModel(nn.Module):
         # 2×2 patchify → (B, hp*wp, in_channels)
         img = x.astype(cfg.dtype).reshape(B, hp, p, wp, p, C)
         img = img.transpose(0, 1, 3, 2, 4, 5).reshape(B, hp * wp, p * p * C)
-        img = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="img_in")(img)
+        img = self.img_in(img)
 
         if context is None:
             raise ValueError("FLUX requires text context tokens")
-        txt = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="txt_in")(
-            context.astype(cfg.dtype)
-        )
+        txt = self.txt_in(context.astype(cfg.dtype))
 
-        vec = MLPEmbedder(cfg, name="time_in")(
+        vec = self.time_in(
             timestep_embedding(timesteps, 256, time_factor=1000.0).astype(cfg.dtype)
         )
         if cfg.guidance_embed:
             if guidance is None:
                 guidance = jnp.full((B,), 4.0, jnp.float32)
-            vec = vec + MLPEmbedder(cfg, name="guidance_in")(
+            vec = vec + self.guidance_in(
                 timestep_embedding(guidance, 256, time_factor=1000.0).astype(cfg.dtype)
             )
         if y is None:
             y = jnp.zeros((B, cfg.vec_in_dim), jnp.float32)
-        vec = vec + MLPEmbedder(cfg, name="vector_in")(y.astype(cfg.dtype))
+        vec = vec + self.vector_in(y.astype(cfg.dtype))
 
         # Position ids: txt tokens at axis-0 index 0, img tokens on the (h, w) grid.
         txt_len = txt.shape[1]
@@ -250,35 +281,98 @@ class FluxModel(nn.Module):
         ).reshape(1, hp * wp, 3)
         img_ids = jnp.broadcast_to(grid, (B, hp * wp, 3))
         ids = jnp.concatenate([txt_ids, img_ids], axis=1)
-        rope = axis_rope_freqs(ids, cfg.axes_dim, cfg.theta)
+        cos, sin = axis_rope_freqs(ids, cfg.axes_dim, cfg.theta)
+        return {"img": img, "txt": txt, "vec": vec, "rope_cos": cos, "rope_sin": sin}
 
-        for i in range(cfg.depth):
-            img, txt = DoubleBlock(cfg, name=f"double_blocks_{i}")(img, txt, vec, rope)
+    def double_step(self, carry, i: int):
+        img, txt = self.double_blocks[i](
+            carry["img"], carry["txt"], carry["vec"], (carry["rope_cos"], carry["rope_sin"])
+        )
+        return {**carry, "img": img, "txt": txt}
 
-        xcat = jnp.concatenate([txt, img], axis=1)
-        for i in range(cfg.depth_single_blocks):
-            xcat = SingleBlock(cfg, name=f"single_blocks_{i}")(xcat, vec, rope)
-        img = xcat[:, txt_len:]
+    def single_step(self, carry, i: int):
+        # Single blocks run on the fused [txt ‖ img] stream; the carry keeps the two
+        # streams separate (uniform structure across every segment) and fuses/splits
+        # at the block boundary — XLA folds the concat/slice into the block program.
+        txt_len = carry["txt"].shape[1]
+        x = jnp.concatenate([carry["txt"], carry["img"]], axis=1)
+        x = self.single_blocks[i](x, carry["vec"], (carry["rope_cos"], carry["rope_sin"]))
+        return {**carry, "txt": x[:, :txt_len], "img": x[:, txt_len:]}
 
-        # Final adaLN + projection back to patches.
+    def finalize(self, carry, out_shape: tuple[int, ...]):
+        """Final adaLN + projection back to NHWC patches (runs on the lead device)."""
+        cfg = self.cfg
+        img, vec = carry["img"], carry["vec"]
+        B, Hh, Ww, C = out_shape
+        p = cfg.patch_size
+        hp, wp = Hh // p, Ww // p
         shift, scale = jnp.split(
-            nn.Dense(2 * cfg.hidden_size, dtype=jnp.float32, name="final_mod")(
-                nn.silu(vec.astype(jnp.float32))
-            )[:, None, :],
-            2,
-            axis=-1,
+            self.final_mod(nn.silu(vec.astype(jnp.float32)))[:, None, :], 2, axis=-1
         )
-        img = _modulate(
-            nn.LayerNorm(use_bias=False, use_scale=False, dtype=cfg.dtype, name="final_norm")(img),
-            shift,
-            scale,
-        )
-        img = nn.Dense(p * p * C, dtype=jnp.float32, name="final_proj")(
-            img.astype(jnp.float32)
-        )
-        # Un-patchify → NHWC latent.
+        img = _modulate(self.final_norm(img), shift, scale)
+        img = self.final_proj(img.astype(jnp.float32))
         img = img.reshape(B, hp, wp, p, p, C).transpose(0, 1, 3, 2, 4, 5)
         return img.reshape(B, Hh, Ww, C)
+
+    def __call__(self, x, timesteps, context=None, y=None, guidance=None, **kwargs):
+        carry = self.prepare(x, timesteps, context, y=y, guidance=guidance)
+        for i in range(self.cfg.depth):
+            carry = self.double_step(carry, i)
+        for i in range(self.cfg.depth_single_blocks):
+            carry = self.single_step(carry, i)
+        return self.finalize(carry, x.shape)
+
+
+def _flux_pipeline_spec(module: FluxModel, cfg: FluxConfig) -> PipelineSpec:
+    """Stage decomposition mirroring the reference's block-list walk order
+    (double_blocks then single_blocks, any_device_parallel.py:1156): embeddings on
+    the lead device, one segment per block, final adaLN/projection on the lead."""
+
+    def prepare(params, x, t, context=None, **kw):
+        return module.apply(
+            {"params": params}, x, t, context, method=FluxModel.prepare, **kw
+        )
+
+    def make_double(i):
+        def fn(params, carry):
+            return module.apply(
+                {"params": params}, carry, i, method=FluxModel.double_step
+            )
+
+        return fn
+
+    def make_single(i):
+        def fn(params, carry):
+            return module.apply(
+                {"params": params}, carry, i, method=FluxModel.single_step
+            )
+
+        return fn
+
+    def finalize(params, carry, x):
+        return module.apply(
+            {"params": params}, carry, x.shape, method=FluxModel.finalize
+        )
+
+    segments = tuple(
+        PipelineSegment((f"double_blocks_{i}",), make_double(i), f"double_blocks[{i}]")
+        for i in range(cfg.depth)
+    ) + tuple(
+        PipelineSegment((f"single_blocks_{i}",), make_single(i), f"single_blocks[{i}]")
+        for i in range(cfg.depth_single_blocks)
+    )
+    prepare_keys = ["img_in", "txt_in", "time_in", "vector_in"]
+    if cfg.guidance_embed:
+        prepare_keys.append("guidance_in")
+    return PipelineSpec(
+        prepare_keys=tuple(prepare_keys),
+        prepare=prepare,
+        segments=segments,
+        # final_norm is scale/bias-free (no params) — only parameterized modules
+        # appear in the param pytree.
+        finalize_keys=("final_mod", "final_proj"),
+        finalize=finalize,
+    )
 
 
 def build_flux(
@@ -303,4 +397,5 @@ def build_flux(
             "double_blocks": cfg.depth,
             "single_blocks": cfg.depth_single_blocks,
         },
+        pipeline_spec=_flux_pipeline_spec(module, cfg),
     )

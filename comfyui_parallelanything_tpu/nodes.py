@@ -1,0 +1,235 @@
+"""The node API layer — ComfyUI-style declarative nodes over the TPU framework.
+
+This re-exposes the reference's entire L4 surface (SURVEY §2a) with the same node
+protocol (``INPUT_TYPES`` / ``RETURN_TYPES`` / ``RETURN_NAMES`` / ``FUNCTION`` /
+``CATEGORY`` / ``DESCRIPTION``) so a ComfyUI-style graph host can register and drive
+the framework exactly as it drives the reference:
+
+- ``ParallelDevice``      — one chain link, chainable (any_device_parallel.py:768-832)
+- ``ParallelDeviceList``  — flat 1-4 device/percentage variant (834-882)
+- ``ParallelAnything``    — the orchestrator node (884-1471)
+- ``NODE_CLASS_MAPPINGS`` / ``NODE_DISPLAY_NAME_MAPPINGS`` (1473-1483)
+
+The DEVICE_CHAIN wire value is the reference's: a plain list of
+``{"device": str, "percentage": float, "weight": float}`` dicts (823-832). The
+``weight`` key is written for wire parity but never read back — the orchestrator
+renormalizes from ``percentage`` only, exactly like setup_parallel (1019-1027, where
+the SURVEY flags ``weight`` as dead data).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .devices.discovery import available_devices
+from .parallel.chain import DeviceChain
+from .parallel.orchestrator import ParallelConfig, parallelize
+
+CATEGORY = "parallel/tpu"
+
+
+def chain_from_wire(entries: list[dict[str, Any]] | None) -> DeviceChain:
+    """DEVICE_CHAIN wire format → DeviceChain (drops pct <= 0, parity 876-882)."""
+    if not entries:
+        return DeviceChain()
+    return DeviceChain.from_pairs(
+        (e["device"], float(e.get("percentage", 0.0))) for e in entries
+    )
+
+
+def chain_to_wire(chain: DeviceChain) -> list[dict[str, Any]]:
+    """DeviceChain → the reference's wire format, including the dead ``weight`` key
+    (pct/100, written at 826/880 and never read)."""
+    return [
+        {"device": l.device, "percentage": l.percentage, "weight": l.percentage / 100.0}
+        for l in chain.links
+    ]
+
+
+class ParallelDevice:
+    """One link in the device chain: pick a device + workload %, chainable via the
+    optional ``previous_devices`` input (parity: 768-832)."""
+
+    DESCRIPTION = (
+        "Add a device to the parallel chain with a workload percentage. "
+        "Chain multiple nodes to build an N-device setup."
+    )
+    RETURN_TYPES = ("DEVICE_CHAIN",)
+    RETURN_NAMES = ("device_chain",)
+    FUNCTION = "add_device"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def get_available_devices(cls) -> list[str]:
+        return available_devices()
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        devices = cls.get_available_devices()
+        return {
+            "required": {
+                "device_id": (
+                    devices,
+                    {"default": devices[0], "tooltip": "Device to add to the chain"},
+                ),
+                "percentage": (
+                    "FLOAT",
+                    {
+                        "default": 50.0,
+                        "min": 1.0,
+                        "max": 100.0,
+                        "step": 1.0,
+                        "tooltip": "Share of the workload for this device",
+                    },
+                ),
+            },
+            "optional": {
+                "previous_devices": (
+                    "DEVICE_CHAIN",
+                    {"tooltip": "Chain from an upstream Parallel Device node"},
+                ),
+            },
+        }
+
+    def add_device(self, device_id: str, percentage: float, previous_devices=None):
+        # Copy-then-append, like the reference (821-832) — upstream lists are never
+        # mutated, so re-running a graph node is side-effect free.
+        chain = list(previous_devices) if previous_devices else []
+        chain.append(
+            {
+                "device": device_id,
+                "percentage": float(percentage),
+                "weight": float(percentage) / 100.0,
+            }
+        )
+        return (chain,)
+
+
+class ParallelDeviceList:
+    """Flat alternative: one node, four device+percentage pairs; entries with
+    percentage <= 0 are dropped (parity: 834-882)."""
+
+    DESCRIPTION = "Configure up to 4 devices in one node; 0% disables a slot."
+    RETURN_TYPES = ("DEVICE_CHAIN",)
+    RETURN_NAMES = ("device_chain",)
+    FUNCTION = "create_list"
+    CATEGORY = CATEGORY
+    N_SLOTS = 4
+
+    @classmethod
+    def get_available_devices(cls) -> list[str]:
+        return available_devices()
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        devices = cls.get_available_devices()
+        required = {}
+        for i in range(1, cls.N_SLOTS + 1):
+            required[f"device_{i}"] = (
+                devices,
+                {"default": devices[0], "tooltip": f"Device for slot {i}"},
+            )
+            required[f"percentage_{i}"] = (
+                "FLOAT",
+                {
+                    "default": 50.0 if i <= 2 else 0.0,
+                    "min": 0.0,
+                    "max": 100.0,
+                    "step": 1.0,
+                    "tooltip": f"Workload share for slot {i}; 0 disables",
+                },
+            )
+        return {"required": required}
+
+    def create_list(self, **kwargs):
+        chain = []
+        for i in range(1, self.N_SLOTS + 1):
+            pct = float(kwargs.get(f"percentage_{i}", 0.0))
+            if pct <= 0:
+                continue
+            dev = kwargs[f"device_{i}"]
+            chain.append({"device": dev, "percentage": pct, "weight": pct / 100.0})
+        return (chain,)
+
+
+class ParallelAnything:
+    """The orchestrator node: takes MODEL + DEVICE_CHAIN, wraps the model so every
+    sampler step runs parallel over the chain, returns the wrapped MODEL
+    (parity: 884-1471)."""
+
+    DESCRIPTION = (
+        "True multi-device parallelism: shards each denoise step across the device "
+        "chain as one SPMD program (data parallel for batches, pipeline block "
+        "placement for batch=1)."
+    )
+    RETURN_TYPES = ("MODEL",)
+    RETURN_NAMES = ("model",)
+    FUNCTION = "setup_parallel"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL", {"tooltip": "Diffusion model to parallelize"}),
+                "parallel_devices": (
+                    "DEVICE_CHAIN",
+                    {"tooltip": "Device chain from Parallel Device node(s)"},
+                ),
+                # Widget defaults match the reference's effective values (SURVEY §5.6:
+                # the auto_vram_balance widget default True wins over the python
+                # signature default False because hosts always pass widget values).
+                "workload_split": (
+                    "BOOLEAN",
+                    {"default": True, "tooltip": "Split batches across devices"},
+                ),
+                "auto_vram_balance": (
+                    "BOOLEAN",
+                    {
+                        "default": True,
+                        "tooltip": "Blend workload split with free device memory",
+                    },
+                ),
+                "purge_cache": (
+                    "BOOLEAN",
+                    {"default": True, "tooltip": "Release caches at teardown"},
+                ),
+                "purge_models": (
+                    "BOOLEAN",
+                    {"default": False, "tooltip": "Also drop compiled programs"},
+                ),
+            },
+        }
+
+    def setup_parallel(
+        self,
+        model,
+        parallel_devices,
+        workload_split: bool = True,
+        auto_vram_balance: bool = True,
+        purge_cache: bool = True,
+        purge_models: bool = False,
+    ):
+        chain = chain_from_wire(parallel_devices)
+        config = ParallelConfig(
+            workload_split=workload_split,
+            auto_memory_balance=auto_vram_balance,
+            purge_cache=purge_cache,
+            purge_models=purge_models,
+        )
+        # parallelize returns the model unchanged on an unusable chain, matching the
+        # reference's abort paths (1019-1027, 1037-1042).
+        return (parallelize(model, chain, config),)
+
+
+NODE_CLASS_MAPPINGS = {
+    "ParallelAnything": ParallelAnything,
+    "ParallelDevice": ParallelDevice,
+    "ParallelDeviceList": ParallelDeviceList,
+}
+
+NODE_DISPLAY_NAME_MAPPINGS = {
+    "ParallelAnything": "Parallel Anything (True Multi-Device TPU)",
+    "ParallelDevice": "Parallel Device Config",
+    "ParallelDeviceList": "Parallel Device List (1-4x)",
+}

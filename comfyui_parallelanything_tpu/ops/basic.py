@@ -2,7 +2,22 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+
+
+def rms_normalize(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """RMSNorm in f32 with a learned scale, returned in x's dtype — the q/k norm used
+    by the MMDiT families (FLUX QKNorm, WAN self/cross q/k norm)."""
+    xf = x.astype(jnp.float32)
+    normed = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (normed * scale).astype(x.dtype)
+
+
+def modulate(x: jnp.ndarray, shift: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """adaLN modulation ``x·(1+scale)+shift`` computed in f32, returned in x's dtype."""
+    xf = x.astype(jnp.float32)
+    return (xf * (1.0 + scale) + shift).astype(x.dtype)
 
 
 def timestep_embedding(

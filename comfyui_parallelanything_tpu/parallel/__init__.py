@@ -11,8 +11,13 @@ from .split import (
     concat_results,
 )
 from .mesh import build_mesh, mesh_axis_names
+from .sequence import sequence_parallel_attention
+from .pipeline import PipelineRunner, build_pipeline_runner
 
 __all__ = [
+    "sequence_parallel_attention",
+    "PipelineRunner",
+    "build_pipeline_runner",
     "DeviceLink",
     "DeviceChain",
     "normalize_weights",

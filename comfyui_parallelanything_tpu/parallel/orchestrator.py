@@ -192,7 +192,7 @@ class ParallelModel:
         config: ParallelConfig,
         groups: list[_PlatformGroup],
         weights: tuple[float, ...],
-        pipeline_runner: Callable[..., Any] | None = None,
+        pipeline_spec: Any = None,
     ):
         self._apply = apply_fn
         self._host_params = params
@@ -200,7 +200,8 @@ class ParallelModel:
         self.config = config
         self._groups = groups
         self.weights = weights
-        self._pipeline_runner = pipeline_runner
+        self._pipeline_spec = pipeline_spec
+        self._pipeline_runner: Any = None  # built lazily on the first batch==1 call
         self._jits: dict[tuple, Callable] = {}
         self._lead_params = None  # lazy single-device placement (fallback path)
         self.active = True
@@ -243,9 +244,14 @@ class ParallelModel:
         batch = batch_size_of(x)
         n = self.n_devices
         try:
-            if batch == 1 and self.config.workload_split and self._pipeline_runner:
-                # Pipeline block-placement mode (reference 1295-1305).
-                return self._pipeline_runner(x, timesteps, context, **kwargs)
+            if batch == 1 and self.config.workload_split and n > 1:
+                # Pipeline block-placement mode (reference 1295-1305); a model that
+                # declares no stages runs single-device (1156-1166) — padded DP on a
+                # 1-sample batch would just compute the same sample on every device.
+                runner = self._get_pipeline_runner()
+                if runner is not None:
+                    return runner(x, timesteps, context, **kwargs)
+                return self.single(x, timesteps, context, **kwargs)
             if not self.config.workload_split or n <= 1:
                 return self.single(x, timesteps, context, **kwargs)
             if batch < n and not self.config.pad_small_batches:
@@ -261,6 +267,21 @@ class ParallelModel:
             )
             self._demote()
             return self.single(x, timesteps, context, **kwargs)
+
+    def _get_pipeline_runner(self):
+        """Build the stage-placement runner on first use — placing per-stage param
+        sub-pytrees costs device memory, so it only happens once a batch==1 call
+        actually arrives (the reference pre-wraps at setup, 1152-1198)."""
+        if self._pipeline_runner is None and self._pipeline_spec is not None:
+            from .pipeline import build_pipeline_runner
+
+            devices = [d for g in self._groups for d in g.devices]
+            self._pipeline_runner = build_pipeline_runner(
+                self._pipeline_spec, self._host_params, devices, list(self.weights)
+            )
+            if self._pipeline_runner is None:
+                self._pipeline_spec = None  # unpipelineable; don't retry every step
+        return self._pipeline_runner
 
     # The reference keeps ``_original_forward`` callable on the lead device
     # (1380-1383); ``single`` is that escape hatch.
@@ -345,6 +366,7 @@ class ParallelModel:
         self.active = False
         for g in self._groups:
             g.params = None
+        self._pipeline_runner = None
         aggressive_cleanup(clear_compile_cache=True)
         self._jits.clear()
 
@@ -366,6 +388,7 @@ class ParallelModel:
         for g in self._groups:
             g.params = None
         self._lead_params = None
+        self._pipeline_runner = None
         self._jits.clear()
         if self.config.purge_cache:
             aggressive_cleanup(clear_compile_cache=self.config.purge_models)
@@ -396,7 +419,6 @@ def parallelize(
     model,
     chain: DeviceChain | Sequence[tuple[str, float]],
     config: ParallelConfig | None = None,
-    pipeline_block_lists: Mapping[str, Sequence[str]] | None = None,
 ) -> ParallelModel | Any:
     """Wrap ``model`` for parallel execution over ``chain``.
 
@@ -478,20 +500,12 @@ def parallelize(
     mode = "spmd" if len(groups) == 1 else "hybrid"
     log_setup_summary(chain.devices, final_weights, mode)
 
-    pm = ParallelModel(
+    return ParallelModel(
         apply_fn=apply_fn,
         params=params,
         chain=chain,
         config=config,
         groups=groups,
         weights=final_weights,
-        pipeline_runner=None,
+        pipeline_spec=getattr(model, "pipeline_spec", None),
     )
-
-    if pipeline_block_lists and config.workload_split:
-        from .pipeline import build_pipeline_runner
-
-        pm._pipeline_runner = build_pipeline_runner(
-            apply_fn, params, devices, final_weights, pipeline_block_lists
-        )
-    return pm

@@ -7,29 +7,163 @@ args hop to the owning device, run there, and the last block's output returns to
 lead device. This is layer *placement* (memory-style pipelining), not microbatched
 throughput pipelining (SURVEY §2e).
 
-TPU-native design: block ranges map to per-stage placements of parameter sub-pytrees;
-activations hop between stages via ``jax.device_put`` over ICI. Fleshed out with the
-staged-model protocol in models/ (see build plan step 5); until a model declares its
-stages this returns None and the router falls back to single-device, which matches the
-reference when no known block list is found (1156-1166).
+TPU-native design: a model declares a ``PipelineSpec`` (models/api.py) — a staged
+decomposition of its forward into prepare → per-block segments → finalize. The runner:
+
+- assigns contiguous segment ranges to devices proportional to weights (the same
+  arithmetic as the reference's 1168-1178, via the largest-remainder fix);
+- places each stage's parameter sub-pytree on its owning device once, at build time
+  (the analogue of ParallelBlock.peers holding each replica's block weights, 1182-1186);
+- jit-compiles ONE program per stage that runs all of that stage's blocks back-to-back
+  (the reference pays a Python-level wrapper call per block, 65-87; here XLA fuses a
+  whole stage);
+- hops the activation carry between stages with ``jax.device_put`` — ICI transfers,
+  dispatched asynchronously, replacing the reference's per-block ``.to(owner_device)``
+  over PCIe (77-78);
+- runs prepare and finalize pinned to the lead device, exactly like the reference's
+  non-block layers (embeddings, final norm/projection) which always run on the lead
+  (SURVEY §3.4).
+
+Devices whose weight rounds to zero blocks hold no stage and are skipped (parity:
+zero-length ranges are valid, split.block_ranges).
 """
 
 from __future__ import annotations
 
-from collections.abc import Mapping, Sequence
+import dataclasses
+from collections.abc import Sequence
 from typing import Any, Callable
 
 import jax
+import numpy as np
 
-from .split import block_ranges  # noqa: F401  (stage math lives here)
+from ..models.api import PipelineSpec
+from ..utils.logging import log_placement
+from .split import block_ranges
+
+
+def _hashable(v) -> bool:
+    try:
+        hash(v)
+    except TypeError:
+        return False
+    return True
+
+
+@dataclasses.dataclass
+class _Stage:
+    device: jax.Device
+    params: Any  # placed sub-pytree for this stage's segments
+    fn: Callable[[Any, dict], dict]  # jitted: runs the stage's segments in order
+    labels: tuple[str, ...]
+
+
+class PipelineRunner:
+    """Callable ``(x, timesteps, context=None, **kwargs) -> output`` executing the
+    staged forward across devices. Built once per (spec, devices, weights)."""
+
+    def __init__(
+        self,
+        spec: PipelineSpec,
+        params: Any,
+        devices: Sequence[jax.Device],
+        weights: Sequence[float],
+    ):
+        self.lead = devices[0]
+        self._spec = spec
+        n = len(spec.segments)
+        ranges = block_ranges(n, weights)
+
+        def subset(keys):
+            missing = [k for k in keys if k not in params]
+            if missing:
+                raise KeyError(
+                    f"pipeline spec references param keys not in the pytree: {missing}"
+                )
+            return {k: params[k] for k in keys}
+
+        self._prepare_params = jax.device_put(subset(spec.prepare_keys), self.lead)
+        self._finalize_params = jax.device_put(subset(spec.finalize_keys), self.lead)
+        # Per-static-kwargs jit cache for prepare (non-array kwargs are compile-time
+        # baked, same contract as the orchestrator's _partition_kwargs).
+        self._prepare_jits: dict[tuple, Any] = {}
+        self._finalize = jax.jit(spec.finalize)
+
+        self.stages: list[_Stage] = []
+        for (s, e), dev in zip(ranges, devices):
+            if s == e:
+                continue  # zero-weight device holds no pipeline stage
+            keys = []
+            for i in range(s, e):
+                for k in spec.segments[i].param_keys:
+                    if k not in keys:
+                        keys.append(k)
+            seg_fns = [spec.segments[i].fn for i in range(s, e)]
+
+            def stage_fn(stage_params, carry, _fns=tuple(seg_fns)):
+                for f in _fns:
+                    carry = f(stage_params, carry)
+                return carry
+
+            self.stages.append(
+                _Stage(
+                    device=dev,
+                    params=jax.device_put(subset(keys), dev),
+                    fn=jax.jit(stage_fn),
+                    labels=tuple(spec.segments[i].label for i in range(s, e)),
+                )
+            )
+            log_placement(
+                str(dev), f"pipeline stage: segments [{s}, {e}) ({e - s} blocks)"
+            )
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.stages)
+
+    def _prepare_for(self, static: dict):
+        """Jitted prepare with non-array kwargs baked in (one compile per distinct
+        static combination — the orchestrator's kwargs contract, orchestrator.py)."""
+        key = tuple(sorted((k, v if _hashable(v) else id(v)) for k, v in static.items()))
+        fn = self._prepare_jits.get(key)
+        if fn is None:
+            prepare = self._spec.prepare
+            bound = dict(static)
+
+            def wrapped(params, x, t, context, traced):
+                return prepare(params, x, t, context, **traced, **bound)
+
+            fn = jax.jit(wrapped)
+            self._prepare_jits[key] = fn
+        return fn
+
+    def __call__(self, x, timesteps, context=None, **kwargs):
+        traced, static = {}, {}
+        for k, v in kwargs.items():
+            (traced if isinstance(v, (jax.Array, np.ndarray)) else static)[k] = v
+        carry = self._prepare_for(static)(
+            self._prepare_params,
+            jax.device_put(x, self.lead),
+            jax.device_put(timesteps, self.lead),
+            jax.device_put(context, self.lead) if context is not None else None,
+            {k: jax.device_put(v, self.lead) for k, v in traced.items()},
+        )
+        for stage in self.stages:
+            carry = jax.device_put(carry, stage.device)  # ICI activation hop
+            carry = stage.fn(stage.params, carry)
+        carry = jax.device_put(carry, self.lead)  # last block → lead (parity 83-85)
+        return self._finalize(self._finalize_params, carry, x)
 
 
 def build_pipeline_runner(
-    apply_fn: Callable[..., Any],
+    spec: PipelineSpec | None,
     params: Any,
     devices: Sequence[jax.Device],
     weights: Sequence[float],
-    block_lists: Mapping[str, Sequence[str]],
-) -> Callable[..., Any] | None:
-    del apply_fn, params, devices, weights, block_lists
-    return None
+) -> PipelineRunner | None:
+    """Build the batch==1 runner; None when the model declares no pipeline spec — the
+    router then falls back to single-device, matching the reference when no known
+    block list is found (1156-1166)."""
+    if spec is None or not spec.segments or len(devices) <= 1:
+        return None
+    return PipelineRunner(spec, params, devices, weights)

@@ -1,5 +1,6 @@
 from .logging import get_logger, log_setup_summary, log_placement, log_degradation
 from .cleanup import aggressive_cleanup
+from .metrics import StepTimer, StepStats, trace
 
 __all__ = [
     "get_logger",
@@ -7,4 +8,7 @@ __all__ = [
     "log_placement",
     "log_degradation",
     "aggressive_cleanup",
+    "StepTimer",
+    "StepStats",
+    "trace",
 ]

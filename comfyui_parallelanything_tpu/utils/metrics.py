@@ -1,0 +1,98 @@
+"""Step-time / throughput metrics and profiler hooks.
+
+The reference's observability is ~40 ``[ParallelAnything]`` print sites and the advice
+to read s/it off the ComfyUI progress bar (SURVEY §5.1, §5.5). The BASELINE metric
+("sec/it at batch=16 1024²; images/sec scaling 1→8 cores") must instead be emitted by
+the framework itself:
+
+- ``StepTimer`` — honest per-step wall timing (`block_until_ready` on the step output
+  before the clock stops, because XLA dispatch is async), accumulating ``StepStats``
+  (images/sec + sec/it) with warmup-step exclusion (first steps include compilation);
+- ``trace`` — context manager around ``jax.profiler.trace`` for Perfetto/XProf dumps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Any
+
+import jax
+
+from .logging import get_logger
+
+
+@dataclasses.dataclass
+class StepStats:
+    steps: int = 0
+    total_s: float = 0.0
+    last_s: float = 0.0
+    images: int = 0
+
+    @property
+    def sec_per_it(self) -> float:
+        return self.total_s / self.steps if self.steps else 0.0
+
+    @property
+    def images_per_sec(self) -> float:
+        return self.images / self.total_s if self.total_s > 0 else 0.0
+
+
+class StepTimer:
+    """Times sampler steps honestly: blocks on the step's output before stopping the
+    clock. Warmup steps (default 1 — the compile step) are recorded separately and
+    excluded from the throughput stats."""
+
+    def __init__(self, warmup_steps: int = 1):
+        self.warmup_steps = warmup_steps
+        self.warmup = StepStats()
+        self.stats = StepStats()
+
+    @contextlib.contextmanager
+    def step(self, batch_size: int = 1):
+        t0 = time.perf_counter()
+        out_box: list[Any] = []
+        yield out_box
+        if out_box:
+            jax.block_until_ready(out_box[0])
+        dt = time.perf_counter() - t0
+        target = (
+            self.warmup
+            if self.warmup.steps < self.warmup_steps
+            else self.stats
+        )
+        target.steps += 1
+        target.total_s += dt
+        target.last_s = dt
+        target.images += batch_size
+
+    def time_step(self, fn, *args, batch_size: int = 1, **kwargs):
+        """Run ``fn`` as one timed step and return its result."""
+        with self.step(batch_size=batch_size) as box:
+            out = fn(*args, **kwargs)
+            box.append(out)
+        return out
+
+    def log_summary(self, label: str = "sampler") -> None:
+        s = self.stats
+        get_logger().info(
+            "%s: %d steps, %.4f s/it, %.2f images/s (warmup %d steps, %.2fs)",
+            label,
+            s.steps,
+            s.sec_per_it,
+            s.images_per_sec,
+            self.warmup.steps,
+            self.warmup.total_s,
+        )
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/parallelanything-trace"):
+    """Profile a region → Perfetto/XProf trace in ``log_dir`` (SURVEY §5.1 plan)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+        get_logger().info("profiler trace written to %s", log_dir)

@@ -1,0 +1,128 @@
+"""Node-protocol layer: the reference's L4 surface re-exposed (SURVEY §2a).
+
+Covers the chain-building semantics the reference leaves untested (SURVEY §4):
+copy-then-append, pct<=0 drops, wire-format keys, and orchestrator routing through
+the node entry point."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from comfyui_parallelanything_tpu import nodes
+from comfyui_parallelanything_tpu.models import build_unet, sd15_config
+from comfyui_parallelanything_tpu.nodes import (
+    NODE_CLASS_MAPPINGS,
+    NODE_DISPLAY_NAME_MAPPINGS,
+    ParallelAnything,
+    ParallelDevice,
+    ParallelDeviceList,
+    chain_from_wire,
+    chain_to_wire,
+)
+from comfyui_parallelanything_tpu.parallel.chain import DeviceChain
+from comfyui_parallelanything_tpu.parallel.orchestrator import ParallelModel
+
+
+class TestNodeProtocol:
+    def test_mappings_complete(self):
+        assert set(NODE_CLASS_MAPPINGS) == {
+            "ParallelAnything",
+            "ParallelDevice",
+            "ParallelDeviceList",
+        }
+        assert set(NODE_DISPLAY_NAME_MAPPINGS) == set(NODE_CLASS_MAPPINGS)
+
+    def test_declarative_contract(self):
+        # Every node carries the full declarative protocol the host introspects
+        # (INPUT_TYPES/RETURN_TYPES/FUNCTION/CATEGORY, reference 788-817, 867-870,
+        # 912-915).
+        for cls in NODE_CLASS_MAPPINGS.values():
+            assert callable(cls.INPUT_TYPES)
+            assert isinstance(cls.RETURN_TYPES, tuple)
+            assert isinstance(cls.FUNCTION, str)
+            assert hasattr(cls, cls.FUNCTION)
+            assert cls.CATEGORY
+
+    def test_device_dropdown_always_has_cpu(self):
+        devs = ParallelDevice.get_available_devices()
+        assert "cpu" in devs
+        inputs = ParallelDevice.INPUT_TYPES()
+        assert inputs["required"]["device_id"][0] == devs
+
+
+class TestParallelDevice:
+    def test_append_and_copy(self):
+        node = ParallelDevice()
+        (chain1,) = node.add_device("cpu", 60.0)
+        (chain2,) = node.add_device("cpu:1", 40.0, previous_devices=chain1)
+        # Upstream list untouched (parity: copy at 821-824).
+        assert len(chain1) == 1 and len(chain2) == 2
+        assert chain2[0]["device"] == "cpu"
+        assert chain2[1] == {"device": "cpu:1", "percentage": 40.0, "weight": 0.4}
+
+
+class TestParallelDeviceList:
+    def test_zero_pct_slots_dropped(self):
+        node = ParallelDeviceList()
+        (chain,) = node.create_list(
+            device_1="cpu", percentage_1=70.0,
+            device_2="cpu:1", percentage_2=30.0,
+            device_3="cpu:2", percentage_3=0.0,
+            device_4="cpu:3", percentage_4=-5.0,
+        )
+        assert [e["device"] for e in chain] == ["cpu", "cpu:1"]
+
+    def test_four_slots_declared(self):
+        req = ParallelDeviceList.INPUT_TYPES()["required"]
+        assert {f"device_{i}" for i in range(1, 5)} <= set(req)
+        assert {f"percentage_{i}" for i in range(1, 5)} <= set(req)
+
+
+class TestWireFormat:
+    def test_roundtrip(self):
+        chain = DeviceChain.from_pairs([("cpu", 70.0), ("cpu:1", 30.0)])
+        wire = chain_to_wire(chain)
+        assert wire[0]["weight"] == 0.7  # dead-data key kept for wire parity
+        back = chain_from_wire(wire)
+        assert back.devices == chain.devices
+        assert back.percentages == chain.percentages
+
+    def test_from_wire_drops_nonpositive(self):
+        back = chain_from_wire(
+            [{"device": "cpu", "percentage": 0.0}, {"device": "cpu:1", "percentage": 5.0}]
+        )
+        assert back.devices == ("cpu:1",)
+
+
+class TestParallelAnythingNode:
+    def test_setup_wraps_model(self):
+        cfg = sd15_config(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(1,), transformer_depth=(0, 1), num_heads=4,
+            context_dim=64, norm_groups=8, dtype=jnp.float32,
+        )
+        model = build_unet(cfg, jax.random.key(0), sample_shape=(1, 16, 16, 4))
+        node = ParallelAnything()
+        dev_node = ParallelDevice()
+        (chain,) = dev_node.add_device("cpu", 50.0)
+        (chain,) = dev_node.add_device("cpu:1", 50.0, previous_devices=chain)
+        (wrapped,) = node.setup_parallel(model, chain)
+        assert isinstance(wrapped, ParallelModel)
+        assert wrapped.n_devices == 2
+
+        x = jax.random.normal(jax.random.key(1), (4, 16, 16, 4), jnp.float32)
+        ctx = jax.random.normal(jax.random.key(2), (4, 12, 64), jnp.float32)
+        out = wrapped(x, jnp.ones((4,)), ctx)
+        assert out.shape == (4, 16, 16, 4)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_unusable_chain_returns_model_unchanged(self):
+        cfg = sd15_config(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(1,), transformer_depth=(0, 1), num_heads=4,
+            context_dim=64, norm_groups=8, dtype=jnp.float32,
+        )
+        model = build_unet(cfg, jax.random.key(0), sample_shape=(1, 16, 16, 4))
+        node = ParallelAnything()
+        (result,) = node.setup_parallel(model, [])
+        assert result is model

@@ -1,0 +1,152 @@
+"""Pipeline (batch==1) block-placement mode: staged execution across devices must
+reproduce the monolithic forward (reference semantics: any_device_parallel.py:1152-1198,
+routing at 1295-1305)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu import DeviceChain, parallelize
+from comfyui_parallelanything_tpu.models.flux import FluxConfig, build_flux
+from comfyui_parallelanything_tpu.parallel.pipeline import build_pipeline_runner
+from comfyui_parallelanything_tpu.parallel.split import block_ranges
+
+
+@pytest.fixture(scope="module")
+def staged_flux():
+    cfg = FluxConfig(
+        in_channels=16,
+        hidden_size=64,
+        num_heads=4,
+        depth=3,
+        depth_single_blocks=5,  # 8 segments total over up to 8 devices
+        context_in_dim=32,
+        vec_in_dim=16,
+        axes_dim=(4, 6, 6),
+        guidance_embed=True,
+        dtype=jnp.float32,
+    )
+    return build_flux(
+        cfg, jax.random.key(7), sample_shape=(1, 8, 8, 4), txt_len=16, name="staged"
+    )
+
+
+def _inputs(batch=1, seed=3):
+    k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
+    x = jax.random.normal(k1, (batch, 8, 8, 4), jnp.float32)
+    ctx = jax.random.normal(k2, (batch, 16, 32), jnp.float32)
+    y = jax.random.normal(k3, (batch, 16), jnp.float32)
+    return x, ctx, y
+
+
+class TestPipelineRunner:
+    def test_staged_equals_monolithic(self, staged_flux, cpu_devices):
+        runner = build_pipeline_runner(
+            staged_flux.pipeline_spec,
+            staged_flux.params,
+            cpu_devices[:4],
+            [0.25, 0.25, 0.25, 0.25],
+        )
+        assert runner is not None and runner.n_stages == 4
+        x, ctx, y = _inputs()
+        t = jnp.array([0.7])
+        got = runner(x, t, ctx, y=y)
+        want = staged_flux(x, t, ctx, y=y)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3
+        )
+
+    def test_uneven_weights_place_proportionally(self, staged_flux, cpu_devices):
+        # 8 segments at 50/25/25 → 4/2/2 blocks per stage.
+        runner = build_pipeline_runner(
+            staged_flux.pipeline_spec,
+            staged_flux.params,
+            cpu_devices[:3],
+            [0.5, 0.25, 0.25],
+        )
+        assert [len(s.labels) for s in runner.stages] == [4, 2, 2]
+        x, ctx, y = _inputs()
+        t = jnp.array([0.3])
+        got = runner(x, t, ctx, y=y)
+        want = staged_flux(x, t, ctx, y=y)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3
+        )
+
+    def test_zero_weight_device_holds_no_stage(self, staged_flux, cpu_devices):
+        runner = build_pipeline_runner(
+            staged_flux.pipeline_spec,
+            staged_flux.params,
+            cpu_devices[:3],
+            [0.5, 0.0, 0.5],
+        )
+        assert runner.n_stages == 2
+
+    def test_single_device_returns_none(self, staged_flux, cpu_devices):
+        assert (
+            build_pipeline_runner(
+                staged_flux.pipeline_spec, staged_flux.params, cpu_devices[:1], [1.0]
+            )
+            is None
+        )
+
+    def test_model_without_spec_returns_none(self, cpu_devices):
+        assert build_pipeline_runner(None, {}, cpu_devices[:2], [0.5, 0.5]) is None
+
+
+class TestRouterIntegration:
+    def test_batch1_routes_through_pipeline(self, staged_flux):
+        chain = DeviceChain.even([f"cpu:{i}" for i in range(4)])
+        pm = parallelize(staged_flux, chain)
+        x, ctx, y = _inputs(batch=1)
+        t = jnp.array([0.5])
+        got = pm(x, t, ctx, y=y)
+        assert pm._pipeline_runner is not None  # lazy build happened
+        want = staged_flux(x, t, ctx, y=y)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3
+        )
+
+    def test_workload_split_off_skips_pipeline(self, staged_flux):
+        from comfyui_parallelanything_tpu import ParallelConfig
+
+        chain = DeviceChain.even([f"cpu:{i}" for i in range(4)])
+        pm = parallelize(
+            staged_flux, chain, ParallelConfig(workload_split=False)
+        )
+        x, ctx, y = _inputs(batch=1)
+        out = pm(x, jnp.array([0.5]), ctx, y=y)
+        assert pm._pipeline_runner is None
+        assert out.shape == x.shape
+
+    def test_block_ranges_cover_all_segments(self):
+        ranges = block_ranges(8, [0.5, 0.25, 0.25])
+        assert ranges[0] == (0, 4) and ranges[-1][1] == 8
+
+    def test_batch1_without_spec_runs_single_device(self):
+        # A bare (apply_fn, params) model has no pipeline spec; batch==1 must route
+        # single-device (reference 1156-1166 / 1307-1315), not padded data-parallel.
+        import jax.numpy as jnp
+        from comfyui_parallelanything_tpu import parallelize
+
+        def f(p, x, t, context=None, **kw):
+            return x * p["s"]
+
+        pm = parallelize(
+            (f, {"s": jnp.float32(2.0)}),
+            DeviceChain.even([f"cpu:{i}" for i in range(4)]),
+        )
+        out = pm(jnp.ones((1, 4)), jnp.zeros((1,)))
+        assert out.shape == (1, 4)
+        assert pm._pipeline_runner is None
+        assert len(out.sharding.device_set) == 1  # not spread over the mesh
+
+    def test_pipeline_handles_static_kwargs(self, staged_flux):
+        # Non-array kwargs must compile-time bake in pipeline mode too (the
+        # orchestrator's kwargs contract).
+        chain = DeviceChain.even([f"cpu:{i}" for i in range(4)])
+        pm = parallelize(staged_flux, chain)
+        x, ctx, y = _inputs(batch=1)
+        out = pm(x, jnp.array([0.5]), ctx, y=y, debug_tag="a-string")
+        assert out.shape == x.shape

@@ -1,0 +1,71 @@
+"""Sequence/context parallelism: ring + Ulysses attention must match single-device
+attention over the full sequence (first-class here; absent in the reference —
+SURVEY §5.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.ops.attention import _xla_attention
+from comfyui_parallelanything_tpu.parallel.mesh import AXIS_SEQ, build_mesh
+from comfyui_parallelanything_tpu.parallel.sequence import sequence_parallel_attention
+
+
+@pytest.fixture(scope="module")
+def seq_mesh(cpu_devices):
+    return build_mesh(cpu_devices[:4], {AXIS_SEQ: 4})
+
+
+def _qkv(B=2, S=32, H=4, D=8, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    shape = (B, S, H, D)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+class TestRingAttention:
+    def test_matches_full_attention(self, seq_mesh):
+        q, k, v = _qkv()
+        scale = q.shape[-1] ** -0.5
+        want = _xla_attention(q, k, v, scale)
+        got = sequence_parallel_attention(q, k, v, seq_mesh, method="ring")
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
+
+    def test_output_sharded_on_seq(self, seq_mesh):
+        q, k, v = _qkv()
+        got = sequence_parallel_attention(q, k, v, seq_mesh, method="ring")
+        assert len(got.sharding.device_set) == 4
+
+    def test_rejects_indivisible_seq(self, seq_mesh):
+        q, k, v = _qkv(S=30)
+        with pytest.raises(ValueError, match="not divisible"):
+            sequence_parallel_attention(q, k, v, seq_mesh, method="ring")
+
+
+class TestUlyssesAttention:
+    def test_matches_full_attention(self, seq_mesh):
+        q, k, v = _qkv()
+        scale = q.shape[-1] ** -0.5
+        want = _xla_attention(q, k, v, scale)
+        got = sequence_parallel_attention(q, k, v, seq_mesh, method="ulysses")
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
+
+    def test_rejects_indivisible_heads(self, seq_mesh):
+        q, k, v = _qkv(H=3, S=32)
+        with pytest.raises(ValueError, match="divisible"):
+            sequence_parallel_attention(q, k, v, seq_mesh, method="ulysses")
+
+
+class TestLongSequence:
+    def test_ring_eight_way(self, cpu_devices):
+        mesh = build_mesh(cpu_devices, {AXIS_SEQ: 8})
+        q, k, v = _qkv(B=1, S=128, H=2, D=8, seed=5)
+        want = _xla_attention(q, k, v, q.shape[-1] ** -0.5)
+        got = sequence_parallel_attention(q, k, v, mesh, method="ring")
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
