@@ -9,6 +9,8 @@ from .flux import (
     build_flux,
 )
 from .wan import WanModel, WanConfig, wan_1_3b_config, wan_14b_config, build_wan
+from .convert import bake_lora, convert_flux_checkpoint
+from .convert_unet import convert_sd_unet_checkpoint, strip_prefix
 
 __all__ = [
     "DiffusionModel",
@@ -30,4 +32,8 @@ __all__ = [
     "wan_1_3b_config",
     "wan_14b_config",
     "build_wan",
+    "bake_lora",
+    "convert_flux_checkpoint",
+    "convert_sd_unet_checkpoint",
+    "strip_prefix",
 ]

@@ -45,9 +45,10 @@ class PipelineSpec:
     prepare: Callable[..., dict]  # (params, x, t, context, **kwargs) -> carry
     segments: tuple[PipelineSegment, ...]
     finalize_keys: tuple[str, ...]
-    # (params, carry, x) -> output; x is the original model input, passed so the
-    # head can recover static output geometry (e.g. un-patchify shape).
-    finalize: Callable[[Any, dict, Any], Any]
+    # (params, carry, out_shape) -> output; out_shape is the original input's shape
+    # tuple (static at trace time), so the head can recover un-patchify geometry
+    # without dragging the input array itself across devices.
+    finalize: Callable[[Any, dict, tuple], Any]
 
 
 @dataclasses.dataclass
@@ -68,11 +69,18 @@ class DiffusionModel:
     pipeline_spec: PipelineSpec | None = None
 
     def __call__(self, x, timesteps, context=None, **kwargs):
-        """Jit-compiled forward (cached per shape); kwargs must be arrays here —
-        route python-valued kwargs through ``apply`` directly."""
-        if not hasattr(self, "_jit_apply"):
-            object.__setattr__(self, "_jit_apply", jax.jit(self.apply))
-        return self._jit_apply(self.params, x, timesteps, context, **kwargs)
+        """Jit-compiled forward (cached per shape and per ambient sequence_parallel
+        context — the ctx is read at trace time inside ops.attention); kwargs must be
+        arrays here — route python-valued kwargs through ``apply`` directly."""
+        from ..ops.attention import sequence_ctx_key
+
+        if not hasattr(self, "_jit_cache"):
+            object.__setattr__(self, "_jit_cache", {})
+        key = sequence_ctx_key()
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fn = self._jit_cache[key] = jax.jit(self.apply)
+        return fn(self.params, x, timesteps, context, **kwargs)
 
     def n_params(self) -> int:
         import jax

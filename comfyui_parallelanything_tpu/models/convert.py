@@ -188,6 +188,21 @@ def bake_lora(
 # --------------------------------------------------------------------------------------
 
 
+def dense_params(sd: Mapping[str, Any], key: str) -> dict:
+    """torch ``{key}.weight``/``.bias`` → flax Dense ``kernel``/``bias``."""
+    out = {"kernel": linear_kernel(sd[f"{key}.weight"])}
+    if f"{key}.bias" in sd:
+        out["bias"] = to_numpy(sd[f"{key}.bias"])
+    return out
+
+
+def tree_to_jnp(tree: Any) -> Any:
+    """Nested dict of numpy arrays → jnp arrays (shared by all converters)."""
+    if isinstance(tree, dict):
+        return {k: tree_to_jnp(v) for k, v in tree.items()}
+    return jnp.asarray(tree)
+
+
 def _mlp_embedder(sd: Mapping[str, Any], prefix: str) -> dict:
     return {
         "in_layer": {
@@ -199,13 +214,6 @@ def _mlp_embedder(sd: Mapping[str, Any], prefix: str) -> dict:
             "bias": to_numpy(sd[f"{prefix}.out_layer.bias"]),
         },
     }
-
-
-def _dense(sd: Mapping[str, Any], key: str) -> dict:
-    out = {"kernel": linear_kernel(sd[f"{key}.weight"])}
-    if f"{key}.bias" in sd:
-        out["bias"] = to_numpy(sd[f"{key}.bias"])
-    return out
 
 
 def convert_flux_checkpoint(
@@ -222,8 +230,8 @@ def convert_flux_checkpoint(
     H, D = cfg.num_heads, cfg.head_dim
     p: dict[str, Any] = {}
 
-    p["img_in"] = _dense(sd, "img_in")
-    p["txt_in"] = _dense(sd, "txt_in")
+    p["img_in"] = dense_params(sd, "img_in")
+    p["txt_in"] = dense_params(sd, "txt_in")
     p["time_in"] = _mlp_embedder(sd, "time_in")
     p["vector_in"] = _mlp_embedder(sd, "vector_in")
     if cfg.guidance_embed:
@@ -233,7 +241,7 @@ def convert_flux_checkpoint(
         t = f"double_blocks.{i}"
         blk: dict[str, Any] = {}
         for stream in ("img", "txt"):
-            blk[f"{stream}_mod"] = {"lin": _dense(sd, f"{t}.{stream}_mod.lin")}
+            blk[f"{stream}_mod"] = {"lin": dense_params(sd, f"{t}.{stream}_mod.lin")}
             blk[f"{stream}_attn_qkv"] = {
                 "kernel": qkv_kernel(sd[f"{t}.{stream}_attn.qkv.weight"], H, D),
                 "bias": qkv_bias(sd[f"{t}.{stream}_attn.qkv.bias"], H, D),
@@ -242,17 +250,17 @@ def convert_flux_checkpoint(
                 "query_norm": to_numpy(sd[f"{t}.{stream}_attn.norm.query_norm.scale"]),
                 "key_norm": to_numpy(sd[f"{t}.{stream}_attn.norm.key_norm.scale"]),
             }
-            blk[f"{stream}_attn_proj"] = _dense(sd, f"{t}.{stream}_attn.proj")
-            blk[f"{stream}_mlp_in"] = _dense(sd, f"{t}.{stream}_mlp.0")
-            blk[f"{stream}_mlp_out"] = _dense(sd, f"{t}.{stream}_mlp.2")
+            blk[f"{stream}_attn_proj"] = dense_params(sd, f"{t}.{stream}_attn.proj")
+            blk[f"{stream}_mlp_in"] = dense_params(sd, f"{t}.{stream}_mlp.0")
+            blk[f"{stream}_mlp_out"] = dense_params(sd, f"{t}.{stream}_mlp.2")
         p[f"double_blocks_{i}"] = blk
 
     for i in range(cfg.depth_single_blocks):
         t = f"single_blocks.{i}"
         p[f"single_blocks_{i}"] = {
-            "modulation": {"lin": _dense(sd, f"{t}.modulation.lin")},
-            "linear1": _dense(sd, f"{t}.linear1"),
-            "linear2": _dense(sd, f"{t}.linear2"),
+            "modulation": {"lin": dense_params(sd, f"{t}.modulation.lin")},
+            "linear1": dense_params(sd, f"{t}.linear1"),
+            "linear2": dense_params(sd, f"{t}.linear2"),
             "norm": {
                 "query_norm": to_numpy(sd[f"{t}.norm.query_norm.scale"]),
                 "key_norm": to_numpy(sd[f"{t}.norm.key_norm.scale"]),
@@ -261,13 +269,7 @@ def convert_flux_checkpoint(
 
     # final_layer.adaLN_modulation.1 emits (shift, scale); our final_mod emits the
     # same two chunks in the same order.
-    p["final_mod"] = _dense(sd, "final_layer.adaLN_modulation.1")
-    p["final_proj"] = _dense(sd, "final_layer.linear")
+    p["final_mod"] = dense_params(sd, "final_layer.adaLN_modulation.1")
+    p["final_proj"] = dense_params(sd, "final_layer.linear")
 
-    return _tree_to_jnp(p)
-
-
-def _tree_to_jnp(tree: Any) -> Any:
-    if isinstance(tree, dict):
-        return {k: _tree_to_jnp(v) for k, v in tree.items()}
-    return jnp.asarray(tree)
+    return tree_to_jnp(p)

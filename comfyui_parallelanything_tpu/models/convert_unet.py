@@ -1,0 +1,233 @@
+"""SD-family (ldm/ComfyUI layout) UNet checkpoint → models/unet.py param tree.
+
+Covers SD1.5 and SDXL diffusion-model state dicts (the ``model.diffusion_model.*``
+subtree of a ComfyUI checkpoint — the bare UNet the reference unwraps at
+any_device_parallel.py:921-930). Same conversion conventions as convert.py (fp8/bf16
+upcast to f32, torch→flax layout transforms); LoRA bakes via ``bake_lora`` before
+calling this.
+
+ldm → here structural map (see models/unet.py for the module definitions):
+
+- ``time_embed.0/.2``            → ``time_embed_0`` / ``time_embed_2``
+- ``label_emb.0.0/.0.2``         → ``label_embed_0`` / ``label_embed_2`` (SDXL)
+- ``input_blocks.0.0``           → ``input_conv``
+- ``input_blocks.N.0`` ResBlock  → ``in_{level}_{i}_res``; ``...N.1`` transformer →
+  ``in_{level}_{i}_attn``; downsample blocks → ``down_{level}``
+- ``middle_block.0/1/2``         → ``mid_res1`` / ``mid_attn`` / ``mid_res2``
+- ``output_blocks.N.0/.1``       → ``out_{level}_{i}_res`` / ``..._attn``; trailing
+  upsample submodule → ``up_{level}``
+- ``out.0/out.2``                → ``out_norm`` / ``out_conv``
+
+ResBlock internals (creation order in UNet2D gives the flax auto-names):
+``in_layers.0``→GroupNorm_0, ``in_layers.2``→Conv_0, ``emb_layers.1``→Dense_0,
+``out_layers.0``→GroupNorm_1, ``out_layers.3``→Conv_1, ``skip_connection``→Conv_2.
+Transformer block: ``attn{1,2}.to_{q,k,v}``→DenseGeneral (C → H×D), ``to_out.0``→
+o-proj (H×D → C), ``norm{1,2,3}``→LayerNorm_{0,1,2}, GEGLU ``ff.net.0.proj``→ff_in
+(x·gelu(gate), same chunk order), ``ff.net.2``→ff_out. ``proj_in``/``proj_out`` are
+1×1 convs in SD1.5 and linears in SDXL — disambiguated by weight rank.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+
+from .convert import conv_kernel, dense_params, to_numpy, tree_to_jnp
+from .unet import UNetConfig, _heads_for
+
+
+def _conv(sd: Mapping[str, Any], key: str) -> dict:
+    out = {"kernel": conv_kernel(sd[f"{key}.weight"])}
+    if f"{key}.bias" in sd:
+        out["bias"] = to_numpy(sd[f"{key}.bias"])
+    return out
+
+
+def _norm(sd: Mapping[str, Any], key: str) -> dict:
+    return {
+        "scale": to_numpy(sd[f"{key}.weight"]),
+        "bias": to_numpy(sd[f"{key}.bias"]),
+    }
+
+
+def _proj_1x1(sd: Mapping[str, Any], key: str) -> dict:
+    """proj_in/proj_out: conv1x1 (SD1.5, rank-4 weight) or linear (SDXL, rank-2).
+    Our module is a 1×1 Conv either way, so linear weights gain the two spatial dims."""
+    w = to_numpy(sd[f"{key}.weight"])
+    if w.ndim == 4:
+        kernel = conv_kernel(w)
+    else:
+        kernel = w.T[None, None, :, :]  # (in, out) → (1, 1, in, out)
+    out = {"kernel": kernel}
+    if f"{key}.bias" in sd:
+        out["bias"] = to_numpy(sd[f"{key}.bias"])
+    return out
+
+
+def _attn_general(w: Any, heads: int, head_dim: int) -> np.ndarray:
+    """to_q/k/v (H·D, C) → DenseGeneral kernel (C, H, D)."""
+    arr = to_numpy(w)
+    return arr.reshape(heads, head_dim, arr.shape[1]).transpose(2, 0, 1)
+
+
+def _attn_out(w: Any, heads: int, head_dim: int) -> np.ndarray:
+    """to_out.0 (C, H·D) → o-proj kernel (H, D, C)."""
+    arr = to_numpy(w)
+    return arr.T.reshape(heads, head_dim, arr.shape[0])
+
+
+def _res_block(sd: Mapping[str, Any], prefix: str, has_skip: bool) -> dict:
+    blk = {
+        "GroupNorm_0": _norm(sd, f"{prefix}.in_layers.0"),
+        "Conv_0": _conv(sd, f"{prefix}.in_layers.2"),
+        "Dense_0": dense_params(sd, f"{prefix}.emb_layers.1"),
+        "GroupNorm_1": _norm(sd, f"{prefix}.out_layers.0"),
+        "Conv_1": _conv(sd, f"{prefix}.out_layers.3"),
+    }
+    if has_skip:
+        blk["Conv_2"] = _conv(sd, f"{prefix}.skip_connection")
+    return blk
+
+
+def _transformer_block(
+    sd: Mapping[str, Any], prefix: str, heads: int, head_dim: int
+) -> dict:
+    def mha(name):
+        out = {
+            f"{name}_q": {
+                "kernel": _attn_general(sd[f"{prefix}.{name}.to_q.weight"], heads, head_dim)
+            },
+            f"{name}_k": {
+                "kernel": _attn_general(sd[f"{prefix}.{name}.to_k.weight"], heads, head_dim)
+            },
+            f"{name}_v": {
+                "kernel": _attn_general(sd[f"{prefix}.{name}.to_v.weight"], heads, head_dim)
+            },
+            f"{name}_o": {
+                "kernel": _attn_out(sd[f"{prefix}.{name}.to_out.0.weight"], heads, head_dim),
+                "bias": to_numpy(sd[f"{prefix}.{name}.to_out.0.bias"]),
+            },
+        }
+        return out
+
+    blk = {
+        "LayerNorm_0": _norm(sd, f"{prefix}.norm1"),
+        "LayerNorm_1": _norm(sd, f"{prefix}.norm2"),
+        "LayerNorm_2": _norm(sd, f"{prefix}.norm3"),
+        "ff_in": dense_params(sd, f"{prefix}.ff.net.0.proj"),
+        "ff_out": dense_params(sd, f"{prefix}.ff.net.2"),
+    }
+    blk.update(mha("attn1"))
+    blk.update(mha("attn2"))
+    return blk
+
+
+def _spatial_transformer(
+    sd: Mapping[str, Any], prefix: str, depth: int, heads: int, head_dim: int
+) -> dict:
+    st = {
+        "GroupNorm_0": _norm(sd, f"{prefix}.norm"),
+        "proj_in": _proj_1x1(sd, f"{prefix}.proj_in"),
+        "proj_out": _proj_1x1(sd, f"{prefix}.proj_out"),
+    }
+    for d in range(depth):
+        st[f"block_{d}"] = _transformer_block(
+            sd, f"{prefix}.transformer_blocks.{d}", heads, head_dim
+        )
+    return st
+
+
+def convert_sd_unet_checkpoint(
+    state_dict: Mapping[str, Any], cfg: UNetConfig
+) -> dict:
+    """ldm-layout UNet state dict → ``models.unet.UNet2D`` param pytree.
+
+    ``state_dict`` keys are relative to the UNet root (strip any
+    ``model.diffusion_model.`` prefix first — see ``strip_prefix``).
+    """
+    sd = state_dict
+    ch = cfg.model_channels
+    p: dict[str, Any] = {}
+
+    p["time_embed_0"] = dense_params(sd, "time_embed.0")
+    p["time_embed_2"] = dense_params(sd, "time_embed.2")
+    if cfg.adm_in_channels is not None:
+        p["label_embed_0"] = dense_params(sd, "label_emb.0.0")
+        p["label_embed_2"] = dense_params(sd, "label_emb.0.2")
+    p["input_conv"] = _conv(sd, "input_blocks.0.0")
+
+    def attn_at(level: int) -> bool:
+        return level in cfg.attention_levels and cfg.transformer_depth[level] > 0
+
+    # -- input (down) path --------------------------------------------------------
+    idx = 1
+    in_ch = ch
+    for level, mult in enumerate(cfg.channel_mult):
+        out_ch = ch * mult
+        heads = _heads_for(cfg, out_ch)
+        for i in range(cfg.num_res_blocks):
+            p[f"in_{level}_{i}_res"] = _res_block(
+                sd, f"input_blocks.{idx}.0", has_skip=(in_ch != out_ch)
+            )
+            if attn_at(level):
+                p[f"in_{level}_{i}_attn"] = _spatial_transformer(
+                    sd, f"input_blocks.{idx}.1",
+                    cfg.transformer_depth[level], heads, out_ch // heads,
+                )
+            in_ch = out_ch
+            idx += 1
+        if level != len(cfg.channel_mult) - 1:
+            p[f"down_{level}"] = {"Conv_0": _conv(sd, f"input_blocks.{idx}.0.op")}
+            idx += 1
+
+    # -- middle -------------------------------------------------------------------
+    mid_ch = ch * cfg.channel_mult[-1]
+    mid_level = len(cfg.channel_mult) - 1
+    heads = _heads_for(cfg, mid_ch)
+    p["mid_res1"] = _res_block(sd, "middle_block.0", has_skip=False)
+    if attn_at(mid_level):
+        p["mid_attn"] = _spatial_transformer(
+            sd, "middle_block.1", cfg.transformer_depth[-1], heads, mid_ch // heads
+        )
+        p["mid_res2"] = _res_block(sd, "middle_block.2", has_skip=False)
+    else:
+        p["mid_res2"] = _res_block(sd, "middle_block.1", has_skip=False)
+
+    # -- output (up) path ---------------------------------------------------------
+    idx = 0
+    for level in reversed(range(len(cfg.channel_mult))):
+        out_ch = ch * cfg.channel_mult[level]
+        heads = _heads_for(cfg, out_ch)
+        for i in range(cfg.num_res_blocks + 1):
+            # Every output res block concatenates a skip, so its input channel count
+            # differs from out_ch → skip_connection always present.
+            p[f"out_{level}_{i}_res"] = _res_block(
+                sd, f"output_blocks.{idx}.0", has_skip=True
+            )
+            sub = 1
+            if attn_at(level):
+                p[f"out_{level}_{i}_attn"] = _spatial_transformer(
+                    sd, f"output_blocks.{idx}.{sub}",
+                    cfg.transformer_depth[level], heads, out_ch // heads,
+                )
+                sub += 1
+            if i == cfg.num_res_blocks and level != 0:
+                p[f"up_{level}"] = {
+                    "Conv_0": _conv(sd, f"output_blocks.{idx}.{sub}.conv")
+                }
+            idx += 1
+
+    p["out_norm"] = _norm(sd, "out.0")
+    p["out_conv"] = _conv(sd, "out.2")
+    return tree_to_jnp(p)
+
+
+def strip_prefix(state_dict: Mapping[str, Any], prefix: str = "model.diffusion_model.") -> dict:
+    """Select + strip a subtree prefix (ComfyUI full checkpoints carry the UNet under
+    ``model.diffusion_model.``)."""
+    out = {k[len(prefix):]: v for k, v in state_dict.items() if k.startswith(prefix)}
+    return out if out else dict(state_dict)
+
+

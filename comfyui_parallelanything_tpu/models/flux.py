@@ -349,9 +349,9 @@ def _flux_pipeline_spec(module: FluxModel, cfg: FluxConfig) -> PipelineSpec:
 
         return fn
 
-    def finalize(params, carry, x):
+    def finalize(params, carry, out_shape):
         return module.apply(
-            {"params": params}, carry, x.shape, method=FluxModel.finalize
+            {"params": params}, carry, out_shape, method=FluxModel.finalize
         )
 
     segments = tuple(

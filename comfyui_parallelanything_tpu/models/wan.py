@@ -237,8 +237,10 @@ def _wan_pipeline_spec(module: WanModel, cfg: WanConfig) -> PipelineSpec:
 
         return fn
 
-    def finalize(params, carry, x):
-        return module.apply({"params": params}, carry, x.shape, method=WanModel.finalize)
+    def finalize(params, carry, out_shape):
+        return module.apply(
+            {"params": params}, carry, out_shape, method=WanModel.finalize
+        )
 
     return PipelineSpec(
         prepare_keys=(

@@ -12,16 +12,52 @@ surface. The TPU equivalent is a backend registry:
 - ``"auto"``   — pallas on TPU when available and the shape qualifies, else xla.
 
 All functions take (B, S, H, D)-shaped q/k/v ("BSHD") and return (B, S, H, D).
+
+Long-context: inside a ``sequence_parallel(mesh, ...)`` context every ``attention``
+call routes through the sequence-parallel program (ring / Ulysses over the ``seq``
+mesh axis, parallel/sequence.py) — so every model family gets context parallelism
+without touching model code (absent in the reference, SURVEY §5.7; first-class here).
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
 
 _BACKEND = "auto"
+
+_SEQ_CTX = threading.local()
+
+
+@contextlib.contextmanager
+def sequence_parallel(mesh, axis: str = "seq", method: str = "ring"):
+    """Route all ``attention`` calls in this context over the mesh's sequence axis.
+
+    Usable around a jitted model forward; the sharded program inlines into the trace.
+    Sequence lengths must divide the axis size (ring) and heads must divide it too
+    for ``method="ulysses"``.
+    """
+    prev = getattr(_SEQ_CTX, "cfg", None)
+    _SEQ_CTX.cfg = (mesh, axis, method)
+    try:
+        yield
+    finally:
+        _SEQ_CTX.cfg = prev
+
+
+def sequence_ctx_key() -> tuple | None:
+    """Hashable identity of the active sequence_parallel context — the ctx is read at
+    trace time, so every jit cache keyed on a model forward must include this (or a
+    program traced under one context would be silently reused under another)."""
+    cfg = getattr(_SEQ_CTX, "cfg", None)
+    if cfg is None:
+        return None
+    mesh, axis, method = cfg
+    return (mesh, axis, method)
 
 
 def set_attention_backend(name: str) -> None:
@@ -52,8 +88,10 @@ def _pallas_available() -> bool:
     return any(d.platform == "tpu" for d in devs)
 
 
-def attention(q, k, v, scale: float | None = None) -> jnp.ndarray:
-    """Scaled dot-product attention on (B, S, H, D) inputs."""
+def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
+    """Backend-dispatched attention WITHOUT sequence-parallel routing — the local
+    compute kernel, also safe to call from inside a shard_map body (where re-entering
+    the seq-parallel path would recurse)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     backend = _BACKEND
@@ -69,3 +107,16 @@ def attention(q, k, v, scale: float | None = None) -> jnp.ndarray:
 
         return flash_attention(q, k, v, scale=scale)
     return _xla_attention(q, k, v, scale)
+
+
+def attention(q, k, v, scale: float | None = None) -> jnp.ndarray:
+    """Scaled dot-product attention on (B, S, H, D) inputs."""
+    seq_cfg = getattr(_SEQ_CTX, "cfg", None)
+    if seq_cfg is not None:
+        if scale is None:
+            scale = q.shape[-1] ** -0.5
+        from ..parallel.sequence import sharded_attention_inline
+
+        mesh, axis, method = seq_cfg
+        return sharded_attention_inline(q, k, v, mesh, axis, method, scale)
+    return attention_local(q, k, v, scale)

@@ -74,8 +74,10 @@ from .split import (
     blend_memory_weights,
     largest_remainder_split,
     normalize_weights,
+    partition_kwargs,
     split_kwargs,
     split_tree,
+    static_kwargs_key,
     concat_results,
 )
 
@@ -134,26 +136,6 @@ class _PlatformGroup:
         self.devices.pop()
         self.device_weights.pop()
         return self.device_strs.pop()
-
-
-def _partition_kwargs(kwargs: Mapping[str, Any]) -> tuple[dict, dict]:
-    """Arrays are traced through jit; everything else is static (compile-time baked)."""
-    traced, static = {}, {}
-    for k, v in kwargs.items():
-        (traced if _is_arraylike(v) else static)[k] = v
-    return traced, static
-
-
-def _static_key(static: Mapping[str, Any]) -> tuple:
-    items = []
-    for k in sorted(static):
-        v = static[k]
-        try:
-            hash(v)
-        except TypeError:
-            v = id(v)
-        items.append((k, v))
-    return tuple(items)
 
 
 def _pad_leaf(a, pad: int):
@@ -223,7 +205,12 @@ class ParallelModel:
     # -- compiled-apply cache ------------------------------------------------------
 
     def _jit_for(self, static: Mapping[str, Any]) -> Callable:
-        key = _static_key(static)
+        # The ambient sequence_parallel context is read at trace time inside
+        # ops.attention, so it must be part of the compile-cache key — otherwise
+        # whichever context was active at first trace would be silently baked in.
+        from ..ops.attention import sequence_ctx_key
+
+        key = (sequence_ctx_key(), static_kwargs_key(static))
         fn = self._jits.get(key)
         if fn is None:
             apply = self._apply
@@ -288,7 +275,7 @@ class ParallelModel:
     def single(self, x, timesteps, context=None, **kwargs):
         if self._lead_params is None:
             self._lead_params = jax.device_put(self._host_params, self.lead_device)
-        traced, static = _partition_kwargs(kwargs)
+        traced, static = partition_kwargs(kwargs)
 
         def put(v):
             return jax.tree.map(
@@ -355,7 +342,7 @@ class ParallelModel:
 
             return jax.tree.map(leaf, v)
 
-        traced, static = _partition_kwargs(kwargs)
+        traced, static = partition_kwargs(kwargs)
         fn = self._jit_for(static)
         out = fn(group.params, place(x), place(timesteps), place(context), place(traced))
         return _slice_padded(out, batch, padded)

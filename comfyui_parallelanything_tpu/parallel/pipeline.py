@@ -35,19 +35,10 @@ from collections.abc import Sequence
 from typing import Any, Callable
 
 import jax
-import numpy as np
 
 from ..models.api import PipelineSpec
 from ..utils.logging import log_placement
-from .split import block_ranges
-
-
-def _hashable(v) -> bool:
-    try:
-        hash(v)
-    except TypeError:
-        return False
-    return True
+from .split import block_ranges, partition_kwargs, static_kwargs_key
 
 
 @dataclasses.dataclass
@@ -85,9 +76,12 @@ class PipelineRunner:
         self._prepare_params = jax.device_put(subset(spec.prepare_keys), self.lead)
         self._finalize_params = jax.device_put(subset(spec.finalize_keys), self.lead)
         # Per-static-kwargs jit cache for prepare (non-array kwargs are compile-time
-        # baked, same contract as the orchestrator's _partition_kwargs).
+        # baked — the orchestrator's kwargs contract, parallel/split.py) and a
+        # per-output-shape cache for finalize (the head needs only static geometry,
+        # not the input array — passing x itself would drag a foreign-device array
+        # into a lead-committed computation).
         self._prepare_jits: dict[tuple, Any] = {}
-        self._finalize = jax.jit(spec.finalize)
+        self._finalize_jits: dict[tuple, Any] = {}
 
         self.stages: list[_Stage] = []
         for (s, e), dev in zip(ranges, devices):
@@ -123,8 +117,8 @@ class PipelineRunner:
 
     def _prepare_for(self, static: dict):
         """Jitted prepare with non-array kwargs baked in (one compile per distinct
-        static combination — the orchestrator's kwargs contract, orchestrator.py)."""
-        key = tuple(sorted((k, v if _hashable(v) else id(v)) for k, v in static.items()))
+        static combination)."""
+        key = static_kwargs_key(static)
         fn = self._prepare_jits.get(key)
         if fn is None:
             prepare = self._spec.prepare
@@ -137,10 +131,21 @@ class PipelineRunner:
             self._prepare_jits[key] = fn
         return fn
 
+    def _finalize_for(self, out_shape: tuple[int, ...]):
+        """Jitted finalize with the static output geometry baked in."""
+        fn = self._finalize_jits.get(out_shape)
+        if fn is None:
+            finalize = self._spec.finalize
+
+            def wrapped(params, carry):
+                return finalize(params, carry, out_shape)
+
+            fn = jax.jit(wrapped)
+            self._finalize_jits[out_shape] = fn
+        return fn
+
     def __call__(self, x, timesteps, context=None, **kwargs):
-        traced, static = {}, {}
-        for k, v in kwargs.items():
-            (traced if isinstance(v, (jax.Array, np.ndarray)) else static)[k] = v
+        traced, static = partition_kwargs(kwargs)
         carry = self._prepare_for(static)(
             self._prepare_params,
             jax.device_put(x, self.lead),
@@ -152,7 +157,7 @@ class PipelineRunner:
             carry = jax.device_put(carry, stage.device)  # ICI activation hop
             carry = stage.fn(stage.params, carry)
         carry = jax.device_put(carry, self.lead)  # last block → lead (parity 83-85)
-        return self._finalize(self._finalize_params, carry, x)
+        return self._finalize_for(tuple(x.shape))(self._finalize_params, carry)
 
 
 def build_pipeline_runner(

@@ -83,8 +83,10 @@ def _ulysses_local(q, k, v, *, axis_name: str, scale: float):
     """Local shard body: re-shard tokens→heads, full-seq attention, shard back.
 
     In: (B, S/n, H, D). all_to_all(split H, concat S) → (B, S, H/n, D).
+    ``attention_local`` (not ``attention``) — the dispatching wrapper would re-enter
+    the sequence-parallel route inside this shard_map body.
     """
-    from ..ops.attention import attention
+    from ..ops.attention import attention_local
 
     def scatter(x):
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
@@ -92,7 +94,7 @@ def _ulysses_local(q, k, v, *, axis_name: str, scale: float):
     def gather(x):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-    out = attention(scatter(q), scatter(k), scatter(v), scale=scale)
+    out = attention_local(scatter(q), scatter(k), scatter(v), scale=scale)
     return gather(out)
 
 
@@ -120,18 +122,7 @@ def sequence_parallel_attention(
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    n_shards = mesh.shape[axis]
-    if q.shape[1] % n_shards:
-        raise ValueError(
-            f"sequence length {q.shape[1]} not divisible by mesh axis "
-            f"{axis!r} of size {n_shards}"
-        )
-    if method == "ulysses" and q.shape[2] % n_shards:
-        raise ValueError(
-            f"ulysses needs num_heads ({q.shape[2]}) divisible by the "
-            f"sequence-shard count ({n_shards})"
-        )
-
+    _validate_shapes(q, k, mesh.shape[axis], method)
     fn = _compiled_attention(mesh, axis, method, float(scale))
     sharding = NamedSharding(mesh, P(None, axis, None, None))
     q, k, v = (lax.with_sharding_constraint(t, sharding) for t in (q, k, v))
@@ -139,10 +130,9 @@ def sequence_parallel_attention(
 
 
 @functools.lru_cache(maxsize=64)
-def _compiled_attention(mesh: Mesh, axis: str, method: str, scale: float):
-    """One jitted shard_map program per (mesh, axis, method, scale) — jit caches are
-    keyed by function object, so rebuilding the closure per call would retrace and
-    recompile on every sampler step."""
+def _sharded_attention_fn(mesh: Mesh, axis: str, method: str, scale: float):
+    """The shard_map-wrapped (un-jitted) attention program — traceable, so it can be
+    inlined inside a larger jitted model forward (the sequence_parallel context)."""
     n_shards = mesh.shape[axis]
     spec = P(None, axis, None, None)  # (B, S, H, D), S sharded
     if method == "ring":
@@ -153,9 +143,42 @@ def _compiled_attention(mesh: Mesh, axis: str, method: str, scale: float):
         body = functools.partial(_ulysses_local, axis_name=axis, scale=scale)
     else:
         raise ValueError(f"unknown sequence-parallel method {method!r}")
-    return jax.jit(
-        shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False,
-        )
+    return shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_attention(mesh: Mesh, axis: str, method: str, scale: float):
+    """One jitted shard_map program per (mesh, axis, method, scale) — jit caches are
+    keyed by function object, so rebuilding the closure per call would retrace and
+    recompile on every sampler step."""
+    return jax.jit(_sharded_attention_fn(mesh, axis, method, scale))
+
+
+def _validate_shapes(q, k, n_shards: int, method: str) -> None:
+    """Clear errors instead of opaque shard_map tracing failures. Both q's and k/v's
+    sequence lengths must shard (cross-attention k/v carries the *text* length — e.g.
+    77 CLIP tokens won't shard 4-way; pad the context to a multiple)."""
+    for name, t in (("q", q), ("k/v", k)):
+        if t.shape[1] % n_shards:
+            raise ValueError(
+                f"sequence-parallel attention: {name} sequence length {t.shape[1]} "
+                f"not divisible by the seq mesh axis ({n_shards}); pad it to a "
+                f"multiple"
+            )
+    if method == "ulysses" and q.shape[2] % n_shards:
+        raise ValueError(
+            f"ulysses needs num_heads ({q.shape[2]}) divisible by the "
+            f"sequence-shard count ({n_shards})"
+        )
+
+
+def sharded_attention_inline(q, k, v, mesh: Mesh, axis: str, method: str, scale: float):
+    """Sequence-parallel attention usable *inside* a traced model forward: constrains
+    q/k/v to the sequence sharding and inlines the shard_map program (no nested
+    dispatch). Used by ops.attention when a ``sequence_parallel`` context is active."""
+    _validate_shapes(q, k, mesh.shape[axis], method)
+    sharding = NamedSharding(mesh, P(None, axis, None, None))
+    q, k, v = (lax.with_sharding_constraint(t, sharding) for t in (q, k, v))
+    return _sharded_attention_fn(mesh, axis, method, float(scale))(q, k, v)

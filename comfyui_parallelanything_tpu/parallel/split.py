@@ -187,6 +187,32 @@ def split_kwargs(
     return out
 
 
+def partition_kwargs(kwargs: Mapping[str, Any]) -> tuple[dict, dict]:
+    """Split kwargs into (traced, static): arrays trace through jit, everything else
+    is compile-time baked — one compiled program per distinct static combination
+    (the reference forwards all kwargs dynamically into torch, 1348-1356, which is
+    meaningless under XLA tracing)."""
+    traced, static = {}, {}
+    for k, v in kwargs.items():
+        (traced if _is_array(v) else static)[k] = v
+    return traced, static
+
+
+def static_kwargs_key(static: Mapping[str, Any]) -> tuple:
+    """Hashable cache key for a static-kwargs dict. Unhashable values key by id() —
+    safe only because every cache entry's compiled closure holds the value strongly,
+    so its id cannot be reused by a different object while the entry lives."""
+    items = []
+    for k in sorted(static):
+        v = static[k]
+        try:
+            hash(v)
+        except TypeError:
+            v = id(v)
+        items.append((k, v))
+    return tuple(items)
+
+
 def concat_results(chunks: Sequence[Any]) -> Any:
     """Concatenate per-device outputs along dim0.
 

@@ -1,0 +1,236 @@
+"""SD UNet checkpoint conversion: synthesize an ldm-layout state dict by inverting
+the converter's transforms from a live model's params, convert back, require exact
+round-trip + forward equivalence (same strategy as test_convert.py for FLUX)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.models.convert_unet import (
+    convert_sd_unet_checkpoint,
+    strip_prefix,
+)
+from comfyui_parallelanything_tpu.models.unet import (
+    UNetConfig,
+    _heads_for,
+    build_unet,
+    sd15_config,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_sd():
+    cfg = sd15_config(
+        model_channels=32,
+        channel_mult=(1, 2),
+        num_res_blocks=1,
+        attention_levels=(1,),
+        transformer_depth=(0, 1),
+        num_heads=4,
+        context_dim=64,
+        norm_groups=8,
+        dtype=jnp.float32,
+    )
+    model = build_unet(cfg, jax.random.key(0), sample_shape=(1, 16, 16, 4))
+    return cfg, model
+
+
+@pytest.fixture(scope="module")
+def tiny_sdxl():
+    # SDXL shape: heads from channels//64? too big for CI — use explicit heads but
+    # keep the adm vector-conditioning path and linear proj_in/out irrelevant here
+    # (our module always uses conv1x1; the converter's linear branch is unit-tested
+    # separately below).
+    cfg = UNetConfig(
+        model_channels=32,
+        channel_mult=(1, 2),
+        attention_levels=(1,),
+        transformer_depth=(0, 2),
+        num_res_blocks=1,
+        num_heads=4,
+        context_dim=64,
+        adm_in_channels=32,
+        norm_groups=8,
+        dtype=jnp.float32,
+    )
+    model = build_unet(cfg, jax.random.key(1), sample_shape=(1, 16, 16, 4))
+    return cfg, model
+
+
+# ---- inverse transforms (test-side; mirror convert_unet.py) -------------------------
+
+
+def _inv_dense(p, key, sd):
+    sd[f"{key}.weight"] = np.asarray(p["kernel"]).T
+    if "bias" in p:
+        sd[f"{key}.bias"] = np.asarray(p["bias"])
+
+
+def _inv_conv(p, key, sd):
+    sd[f"{key}.weight"] = np.asarray(p["kernel"]).transpose(3, 2, 0, 1)
+    if "bias" in p:
+        sd[f"{key}.bias"] = np.asarray(p["bias"])
+
+
+def _inv_norm(p, key, sd):
+    sd[f"{key}.weight"] = np.asarray(p["scale"])
+    sd[f"{key}.bias"] = np.asarray(p["bias"])
+
+
+def _inv_res(p, prefix, sd):
+    _inv_norm(p["GroupNorm_0"], f"{prefix}.in_layers.0", sd)
+    _inv_conv(p["Conv_0"], f"{prefix}.in_layers.2", sd)
+    _inv_dense(p["Dense_0"], f"{prefix}.emb_layers.1", sd)
+    _inv_norm(p["GroupNorm_1"], f"{prefix}.out_layers.0", sd)
+    _inv_conv(p["Conv_1"], f"{prefix}.out_layers.3", sd)
+    if "Conv_2" in p:
+        _inv_conv(p["Conv_2"], f"{prefix}.skip_connection", sd)
+
+
+def _inv_transformer(p, prefix, depth, sd):
+    _inv_norm(p["GroupNorm_0"], f"{prefix}.norm", sd)
+    _inv_conv(p["proj_in"], f"{prefix}.proj_in", sd)
+    _inv_conv(p["proj_out"], f"{prefix}.proj_out", sd)
+    for d in range(depth):
+        blk = p[f"block_{d}"]
+        t = f"{prefix}.transformer_blocks.{d}"
+        _inv_norm(blk["LayerNorm_0"], f"{t}.norm1", sd)
+        _inv_norm(blk["LayerNorm_1"], f"{t}.norm2", sd)
+        _inv_norm(blk["LayerNorm_2"], f"{t}.norm3", sd)
+        _inv_dense(blk["ff_in"], f"{t}.ff.net.0.proj", sd)
+        _inv_dense(blk["ff_out"], f"{t}.ff.net.2", sd)
+        for name in ("attn1", "attn2"):
+            for qkv in ("q", "k", "v"):
+                k = np.asarray(blk[f"{name}_{qkv}"]["kernel"])  # (C, H, D)
+                sd[f"{t}.{name}.to_{qkv}.weight"] = (
+                    k.transpose(1, 2, 0).reshape(-1, k.shape[0])
+                )
+            o = np.asarray(blk[f"{name}_o"]["kernel"])  # (H, D, C)
+            sd[f"{t}.{name}.to_out.0.weight"] = o.reshape(-1, o.shape[-1]).T
+            sd[f"{t}.{name}.to_out.0.bias"] = np.asarray(blk[f"{name}_o"]["bias"])
+
+
+def _ldm_sd(cfg: UNetConfig, params) -> dict:
+    sd: dict = {}
+    _inv_dense(params["time_embed_0"], "time_embed.0", sd)
+    _inv_dense(params["time_embed_2"], "time_embed.2", sd)
+    if cfg.adm_in_channels is not None:
+        _inv_dense(params["label_embed_0"], "label_emb.0.0", sd)
+        _inv_dense(params["label_embed_2"], "label_emb.0.2", sd)
+    _inv_conv(params["input_conv"], "input_blocks.0.0", sd)
+
+    def attn_at(level):
+        return level in cfg.attention_levels and cfg.transformer_depth[level] > 0
+
+    idx = 1
+    for level in range(len(cfg.channel_mult)):
+        for i in range(cfg.num_res_blocks):
+            _inv_res(params[f"in_{level}_{i}_res"], f"input_blocks.{idx}.0", sd)
+            if attn_at(level):
+                _inv_transformer(
+                    params[f"in_{level}_{i}_attn"], f"input_blocks.{idx}.1",
+                    cfg.transformer_depth[level], sd,
+                )
+            idx += 1
+        if level != len(cfg.channel_mult) - 1:
+            _inv_conv(params[f"down_{level}"]["Conv_0"], f"input_blocks.{idx}.0.op", sd)
+            idx += 1
+
+    mid_level = len(cfg.channel_mult) - 1
+    _inv_res(params["mid_res1"], "middle_block.0", sd)
+    if attn_at(mid_level):
+        _inv_transformer(
+            params["mid_attn"], "middle_block.1", cfg.transformer_depth[-1], sd
+        )
+        _inv_res(params["mid_res2"], "middle_block.2", sd)
+    else:
+        _inv_res(params["mid_res2"], "middle_block.1", sd)
+
+    idx = 0
+    for level in reversed(range(len(cfg.channel_mult))):
+        for i in range(cfg.num_res_blocks + 1):
+            _inv_res(params[f"out_{level}_{i}_res"], f"output_blocks.{idx}.0", sd)
+            sub = 1
+            if attn_at(level):
+                _inv_transformer(
+                    params[f"out_{level}_{i}_attn"], f"output_blocks.{idx}.{sub}",
+                    cfg.transformer_depth[level], sd,
+                )
+                sub += 1
+            if i == cfg.num_res_blocks and level != 0:
+                _inv_conv(
+                    params[f"up_{level}"]["Conv_0"],
+                    f"output_blocks.{idx}.{sub}.conv", sd,
+                )
+            idx += 1
+
+    _inv_norm(params["out_norm"], "out.0", sd)
+    _inv_conv(params["out_conv"], "out.2", sd)
+    return sd
+
+
+def _flatten(tree, prefix=()):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _flatten(v, prefix + (k,))
+    else:
+        yield prefix, np.asarray(tree)
+
+
+def _assert_trees_equal(got, want):
+    fg, fw = dict(_flatten(got)), dict(_flatten(want))
+    assert sorted(fg) == sorted(fw), (
+        f"missing: {sorted(set(fw) - set(fg))[:5]} extra: {sorted(set(fg) - set(fw))[:5]}"
+    )
+    for k in fw:
+        np.testing.assert_allclose(fg[k], fw[k], rtol=1e-6, atol=1e-6, err_msg=str(k))
+
+
+class TestSD15RoundTrip:
+    def test_structure_and_values(self, tiny_sd):
+        cfg, model = tiny_sd
+        sd = _ldm_sd(cfg, model.params)
+        got = convert_sd_unet_checkpoint(sd, cfg)
+        _assert_trees_equal(got, model.params)
+
+    def test_forward_equivalence(self, tiny_sd):
+        cfg, model = tiny_sd
+        params = convert_sd_unet_checkpoint(_ldm_sd(cfg, model.params), cfg)
+        x = jax.random.normal(jax.random.key(2), (2, 16, 16, 4), jnp.float32)
+        ctx = jax.random.normal(jax.random.key(3), (2, 12, 64), jnp.float32)
+        t = jnp.array([5.0, 9.0])
+        want = model(x, t, ctx)
+        got = model.apply(params, x, t, ctx)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+class TestSDXLShape:
+    def test_adm_and_depth2_roundtrip(self, tiny_sdxl):
+        cfg, model = tiny_sdxl
+        sd = _ldm_sd(cfg, model.params)
+        got = convert_sd_unet_checkpoint(sd, cfg)
+        _assert_trees_equal(got, model.params)
+
+
+class TestHelpers:
+    def test_strip_prefix(self):
+        sd = {"model.diffusion_model.a.weight": 1, "first_stage_model.b": 2}
+        out = strip_prefix(sd)
+        assert out == {"a.weight": 1}
+
+    def test_strip_prefix_passthrough_when_absent(self):
+        sd = {"a.weight": 1}
+        assert strip_prefix(sd) == sd
+
+    def test_linear_proj_in_gains_spatial_dims(self):
+        # SDXL stores proj_in/out as Linear; converter must emit a 1x1 conv kernel.
+        from comfyui_parallelanything_tpu.models.convert_unet import _proj_1x1
+
+        sd = {"p.weight": np.ones((6, 4), np.float32), "p.bias": np.zeros(6, np.float32)}
+        out = _proj_1x1(sd, "p")
+        assert out["kernel"].shape == (1, 1, 4, 6)
+
+    def test_heads_for_sdxl_convention(self):
+        cfg = UNetConfig(num_heads=-1)
+        assert _heads_for(cfg, 640) == 10
