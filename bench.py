@@ -1,39 +1,65 @@
 """Benchmark entry point — prints ONE JSON line for the driver.
 
-Workload (round 1): SD1.5-class UNet, bf16, batch=16, 512x512 pixels (64x64 latents),
-denoise-step forward with batched CFG folded in — the closest runnable analogue of the
-reference's headline measurement (s/it read off the sampler; /root/reference/README.md:46-60,
-26.00 s/it single-GPU at batch=21 1024^2 on an RTX 3090). The ladder's 1024^2 FLUX
-config takes over as the flagship once the MMDiT lands.
+Workloads follow the BASELINE.md ladder; select with BENCH_CONFIG (default picks by
+platform):
 
-``vs_baseline`` is the reference's published single-GPU sec/it divided by ours —
->1 means faster than the reference's single-GPU row. The workloads are not yet
-identical (SD1.5 @512^2 vs Z_Image @1024^2); the "workload" field says exactly what ran.
+- ``sd15_16``  — SD1.5-class UNet, bf16, batch=16, 1024² pixels (128² latents). The
+  BASELINE headline shape ("sec/it at batch=16 1024²").
+- ``sdxl_8``   — SDXL-class UNet, bf16, batch=8, 1024².
+- ``zimage_21``— Z_Image-class MMDiT, batch=21, 1024² — the reference's own benchmark
+  run (/root/reference/README.md:46-60: 26.00 s/it on one RTX 3090, 12.91 s/it on
+  two GPUs). Large: needs most of a v5e chip's HBM.
+- ``smoke``    — reduced-width SD1.5 topology on CPU (no TPU attached).
+
+``vs_baseline`` divides the reference's published single-GPU 26.00 s/it by our s/it —
+>1 means faster than the reference's single-GPU row. Workloads are not identical
+(different model families per rung); the "workload" field records exactly what ran.
 """
 
 import json
+import os
 import sys
 import time
 
 
-def main() -> None:
+def _build(config_name):
     import jax
     import jax.numpy as jnp
 
-    from comfyui_parallelanything_tpu import DeviceChain, parallelize
-    from comfyui_parallelanything_tpu.models import build_unet, sd15_config
+    from comfyui_parallelanything_tpu.models import (
+        build_flux,
+        build_unet,
+        sd15_config,
+        sdxl_config,
+        z_image_turbo_config,
+    )
 
-    platform = jax.devices()[0].platform
-    n_dev = len(jax.devices())
-
-    if platform == "tpu":
-        batch, latent = 16, 64
+    rng = jax.random.key(0)
+    if config_name == "sd15_16":
+        batch, latent, ctx_len = 16, 128, 77
         cfg = sd15_config(dtype=jnp.bfloat16)
-        workload = f"SD1.5 UNet bf16 batch={batch} 512x512"
-    else:
-        # Off-TPU smoke: same topology, reduced widths, so the bench path stays
-        # executable on the CPU mesh without a TPU attached.
-        batch, latent = 8, 32
+        model = build_unet(cfg, rng, sample_shape=(1, latent, latent, 4))
+        x_ch, ctx_dim = 4, cfg.context_dim
+        kwargs = {}
+        workload = "SD1.5 UNet bf16 batch=16 1024x1024"
+    elif config_name == "sdxl_8":
+        batch, latent, ctx_len = 8, 128, 77
+        cfg = sdxl_config(dtype=jnp.bfloat16)
+        model = build_unet(cfg, rng, sample_shape=(1, latent, latent, 4))
+        x_ch, ctx_dim = 4, cfg.context_dim
+        kwargs = {"y": jnp.zeros((batch, cfg.adm_in_channels), jnp.float32)}
+        workload = "SDXL UNet bf16 batch=8 1024x1024"
+    elif config_name == "zimage_21":
+        batch, latent, ctx_len = 21, 128, 128
+        cfg = z_image_turbo_config(dtype=jnp.bfloat16)
+        model = build_flux(
+            cfg, rng, sample_shape=(1, 16, 16, 16), txt_len=ctx_len
+        )
+        x_ch, ctx_dim = 16, cfg.context_in_dim
+        kwargs = {}
+        workload = "Z_Image-class MMDiT bf16 batch=21 1024x1024 (README repro shape)"
+    elif config_name == "smoke":
+        batch, latent, ctx_len = 8, 32, 24
         cfg = sd15_config(
             model_channels=64,
             channel_mult=(1, 2, 4),
@@ -41,29 +67,44 @@ def main() -> None:
             context_dim=256,
             dtype=jnp.bfloat16,
         )
-        workload = f"SD1.5-topology smoke batch={batch} 256x256"
-    model = build_unet(
-        cfg, jax.random.key(0), sample_shape=(1, latent, latent, 4), name="sd15"
+        model = build_unet(cfg, rng, sample_shape=(1, latent, latent, 4))
+        x_ch, ctx_dim = 4, cfg.context_dim
+        kwargs = {}
+        workload = "SD1.5-topology smoke batch=8 256x256"
+    else:
+        raise ValueError(f"unknown BENCH_CONFIG {config_name!r}")
+    return model, batch, latent, x_ch, ctx_len, ctx_dim, kwargs, workload
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from comfyui_parallelanything_tpu import DeviceChain, parallelize
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    config_name = os.environ.get(
+        "BENCH_CONFIG", "sd15_16" if platform == "tpu" else "smoke"
     )
 
-    chain = DeviceChain.even(
-        [f"{platform}:{d.id}" for d in jax.devices()][: max(1, n_dev)]
-    )
+    model, batch, latent, x_ch, ctx_len, ctx_dim, kwargs, workload = _build(config_name)
+
+    chain = DeviceChain.even([f"{platform}:{d.id}" for d in jax.devices()])
     pm = parallelize(model, chain)
 
-    rng = jax.random.key(1)
-    kx, kc = jax.random.split(rng)
-    x = jax.random.normal(kx, (batch, latent, latent, 4), jnp.float32)
+    kx, kc = jax.random.split(jax.random.key(1))
+    x = jax.random.normal(kx, (batch, latent, latent, x_ch), jnp.float32)
     t = jnp.linspace(999.0, 1.0, batch)
-    ctx = jax.random.normal(kc, (batch, 77, cfg.context_dim), jnp.float32)
+    ctx = jax.random.normal(kc, (batch, ctx_len, ctx_dim), jnp.float32)
 
     # Warmup/compile, then timed denoise-step iterations.
-    out = pm(x, t, ctx)
+    out = pm(x, t, ctx, **kwargs)
     jax.block_until_ready(out)
     iters = 10 if platform == "tpu" else 2  # CPU runs are smoke-only
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = pm(x, t, ctx)
+        out = pm(x, t, ctx, **kwargs)
     jax.block_until_ready(out)
     sec_it = (time.perf_counter() - t0) / iters
 
@@ -71,11 +112,12 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "sec/it SD1.5-UNet denoise step",
+                "metric": f"sec/it denoise step [{config_name}]",
                 "value": round(sec_it, 4),
                 "unit": "s/it",
                 "vs_baseline": round(ref_single_gpu / sec_it, 2),
                 "workload": f"{workload} ({platform} x{n_dev})",
+                "images_per_sec": round(batch / sec_it, 3),
             }
         )
     )

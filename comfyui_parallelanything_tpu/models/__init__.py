@@ -11,6 +11,12 @@ from .flux import (
 from .wan import WanModel, WanConfig, wan_1_3b_config, wan_14b_config, build_wan
 from .convert import bake_lora, convert_flux_checkpoint
 from .convert_unet import convert_sd_unet_checkpoint, strip_prefix
+from .loader import (
+    load_safetensors,
+    load_flux_checkpoint,
+    load_sd_unet_checkpoint,
+    load_wan_checkpoint,
+)
 
 __all__ = [
     "DiffusionModel",
@@ -36,4 +42,8 @@ __all__ = [
     "convert_flux_checkpoint",
     "convert_sd_unet_checkpoint",
     "strip_prefix",
+    "load_safetensors",
+    "load_flux_checkpoint",
+    "load_sd_unet_checkpoint",
+    "load_wan_checkpoint",
 ]

@@ -187,7 +187,9 @@ def convert_sd_unet_checkpoint(
     mid_level = len(cfg.channel_mult) - 1
     heads = _heads_for(cfg, mid_ch)
     p["mid_res1"] = _res_block(sd, "middle_block.0", has_skip=False)
-    if attn_at(mid_level):
+    # Gate must mirror UNet2D exactly (unet.py: transformer_depth[-1], NOT
+    # transformer_depth[mid_level] — the tuples may have different lengths).
+    if mid_level in cfg.attention_levels and cfg.transformer_depth[-1] > 0:
         p["mid_attn"] = _spatial_transformer(
             sd, "middle_block.1", cfg.transformer_depth[-1], heads, mid_ch // heads
         )

@@ -235,9 +235,16 @@ class ParallelModel:
                 # Pipeline block-placement mode (reference 1295-1305); a model that
                 # declares no stages runs single-device (1156-1166) — padded DP on a
                 # 1-sample batch would just compute the same sample on every device.
-                runner = self._get_pipeline_runner()
-                if runner is not None:
-                    return runner(x, timesteps, context, **kwargs)
+                # Under an active sequence_parallel context the pipeline is skipped
+                # entirely: stage programs are pinned to single devices and cannot
+                # host a seq-mesh shard_map — the single-device path (whose jit
+                # cache IS ctx-keyed) lets the requested context parallelism run.
+                from ..ops.attention import sequence_ctx_key
+
+                if sequence_ctx_key() is None:
+                    runner = self._get_pipeline_runner()
+                    if runner is not None:
+                        return runner(x, timesteps, context, **kwargs)
                 return self.single(x, timesteps, context, **kwargs)
             if not self.config.workload_split or n <= 1:
                 return self.single(x, timesteps, context, **kwargs)

@@ -145,6 +145,19 @@ class PipelineRunner:
         return fn
 
     def __call__(self, x, timesteps, context=None, **kwargs):
+        from ..ops.attention import sequence_ctx_key
+
+        if sequence_ctx_key() is not None:
+            # Stage programs are jitted once per runner and pinned to single
+            # devices; a seq-mesh shard_map cannot live inside them. The
+            # orchestrator routes batch==1 to single-device under an active
+            # context — reaching here means the runner was invoked directly.
+            raise ValueError(
+                "pipeline block placement does not compose with an active "
+                "sequence_parallel context; run the model through the "
+                "orchestrator (which falls back to single-device) or exit "
+                "the context"
+            )
         traced, static = partition_kwargs(kwargs)
         carry = self._prepare_for(static)(
             self._prepare_params,

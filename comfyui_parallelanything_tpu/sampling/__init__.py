@@ -1,6 +1,16 @@
 from .schedules import scaled_linear_schedule, ddim_timesteps
 from .ddim import ddim_sample
 from .flow import flow_euler_sample, flow_timesteps
+from .k_samplers import (
+    SAMPLERS,
+    EpsDenoiser,
+    karras_sigmas,
+    sampling_sigmas,
+    sample_euler,
+    sample_euler_ancestral,
+    sample_heun,
+    sample_dpmpp_2m,
+)
 
 __all__ = [
     "scaled_linear_schedule",
@@ -8,4 +18,12 @@ __all__ = [
     "ddim_sample",
     "flow_euler_sample",
     "flow_timesteps",
+    "SAMPLERS",
+    "EpsDenoiser",
+    "karras_sigmas",
+    "sampling_sigmas",
+    "sample_euler",
+    "sample_euler_ancestral",
+    "sample_heun",
+    "sample_dpmpp_2m",
 ]

@@ -1,0 +1,188 @@
+"""k-diffusion-family samplers: Euler, Euler-ancestral, Heun, DPM++ 2M.
+
+The reference is driven by its host's KSampler — every sampler in that menu calls the
+(monkey-patched) ``diffusion_model.forward`` once or twice per step
+(any_device_parallel.py:1287). To stand alone, this framework carries the standard
+sigma-space sampler set itself. Host-side step loops like ddim.py/flow.py: each model
+call routes through the (possibly parallelized) forward, so the DP/pipeline scheduler
+sees exactly the per-step batched calls it is designed for.
+
+Conventions (eps-prediction SD family, k-diffusion/EDM parameterization):
+``sigma_t = sqrt((1-ᾱ_t)/ᾱ_t)``; model input is ``x/sqrt(sigma²+1)`` at the discrete
+timestep nearest in log-sigma; denoised prediction ``x0 = x - sigma·eps``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .schedules import scaled_linear_schedule
+
+
+def model_sigmas(alphas_cumprod: jnp.ndarray) -> jnp.ndarray:
+    """Per-trained-timestep sigma table, ascending with t."""
+    return jnp.sqrt((1.0 - alphas_cumprod) / alphas_cumprod)
+
+
+def sampling_sigmas(n_steps: int, alphas_cumprod: jnp.ndarray | None = None) -> jnp.ndarray:
+    """(n_steps+1,) descending sigmas over the model's range, ending at 0."""
+    if alphas_cumprod is None:
+        alphas_cumprod = scaled_linear_schedule()
+    table = model_sigmas(alphas_cumprod)
+    idx = jnp.linspace(len(table) - 1, 0, n_steps, dtype=jnp.float32)
+    sig = jnp.interp(idx, jnp.arange(len(table), dtype=jnp.float32), table)
+    return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
+
+
+def karras_sigmas(
+    n_steps: int,
+    sigma_min: float = 0.0292,
+    sigma_max: float = 14.6146,
+    rho: float = 7.0,
+) -> jnp.ndarray:
+    """Karras et al. (2022) spacing — denser near sigma_min; (n_steps+1,), ends at 0."""
+    ramp = jnp.linspace(0.0, 1.0, n_steps, dtype=jnp.float32)
+    min_inv, max_inv = sigma_min ** (1 / rho), sigma_max ** (1 / rho)
+    sig = (max_inv + ramp * (min_inv - max_inv)) ** rho
+    return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
+
+
+class EpsDenoiser:
+    """Wraps an eps-prediction forward into ``denoise(x, sigma) -> x0`` with batched
+    CFG (cond ‖ uncond in one call — what feeds the DP path its batch, ddim.py)."""
+
+    def __init__(
+        self,
+        model,
+        context=None,
+        *,
+        cfg_scale: float = 1.0,
+        uncond_context=None,
+        alphas_cumprod: jnp.ndarray | None = None,
+        **model_kwargs,
+    ):
+        if alphas_cumprod is None:
+            alphas_cumprod = scaled_linear_schedule()
+        self.model = model
+        self.context = context
+        self.cfg_scale = cfg_scale
+        self.uncond_context = uncond_context
+        self.kwargs = model_kwargs
+        self.sigma_table = model_sigmas(alphas_cumprod)
+        self.log_sigmas = jnp.log(self.sigma_table)
+
+    def _timestep(self, sigma) -> jnp.ndarray:
+        """Continuous timestep whose table sigma matches (log-space interpolation)."""
+        return jnp.interp(
+            jnp.log(sigma),
+            self.log_sigmas,
+            jnp.arange(len(self.log_sigmas), dtype=jnp.float32),
+        )
+
+    def __call__(self, x: jnp.ndarray, sigma: jnp.ndarray) -> jnp.ndarray:
+        batch = x.shape[0]
+        scale = 1.0 / jnp.sqrt(sigma**2 + 1.0)
+        t_vec = jnp.full((batch,), self._timestep(sigma), jnp.float32)
+        x_in = x * scale
+        use_cfg = self.cfg_scale != 1.0 and self.uncond_context is not None
+        if use_cfg:
+            # Every per-batch kwarg doubles with the batch (dim0 == batch), not
+            # just 'y' — e.g. guidance vectors (same rule as flow.py's CFG path).
+            kw = {
+                k: (
+                    jnp.concatenate([v, v], axis=0)
+                    if hasattr(v, "shape") and v.shape[:1] == (batch,)
+                    else v
+                )
+                for k, v in self.kwargs.items()
+            }
+            eps_both = self.model(
+                jnp.concatenate([x_in, x_in], axis=0),
+                jnp.concatenate([t_vec, t_vec], axis=0),
+                jnp.concatenate([self.context, self.uncond_context], axis=0),
+                **kw,
+            )
+            eps_c, eps_u = jnp.split(eps_both, 2, axis=0)
+            eps = eps_u + self.cfg_scale * (eps_c - eps_u)
+        else:
+            eps = self.model(x_in, t_vec, self.context, **self.kwargs)
+        return x - sigma * eps
+
+
+def sample_euler(denoise, x, sigmas, callback=None):
+    """Deterministic Euler over the sigma schedule."""
+    for i in range(len(sigmas) - 1):
+        x0 = denoise(x, sigmas[i])
+        d = (x - x0) / sigmas[i]
+        x = x + d * (sigmas[i + 1] - sigmas[i])
+        if callback is not None:
+            callback(i, x)
+    return x
+
+
+def sample_euler_ancestral(denoise, x, sigmas, rng, eta: float = 1.0, callback=None):
+    """Euler with ancestral noise injection (stochastic)."""
+    for i in range(len(sigmas) - 1):
+        s, s_next = sigmas[i], sigmas[i + 1]
+        x0 = denoise(x, s)
+        sigma_up = jnp.minimum(
+            s_next,
+            eta * jnp.sqrt(jnp.maximum(s_next**2 * (s**2 - s_next**2) / s**2, 0.0)),
+        )
+        sigma_down = jnp.sqrt(jnp.maximum(s_next**2 - sigma_up**2, 0.0))
+        d = (x - x0) / s
+        x = x + d * (sigma_down - s)
+        if float(s_next) > 0:
+            rng, sub = jax.random.split(rng)
+            x = x + sigma_up * jax.random.normal(sub, x.shape, x.dtype)
+        if callback is not None:
+            callback(i, x)
+    return x
+
+
+def sample_heun(denoise, x, sigmas, callback=None):
+    """Heun's 2nd-order method (two model calls per step except the last)."""
+    for i in range(len(sigmas) - 1):
+        s, s_next = sigmas[i], sigmas[i + 1]
+        x0 = denoise(x, s)
+        d = (x - x0) / s
+        x_pred = x + d * (s_next - s)
+        if float(s_next) == 0.0:
+            x = x_pred
+        else:
+            x0_2 = denoise(x_pred, s_next)
+            d2 = (x_pred - x0_2) / s_next
+            x = x + 0.5 * (d + d2) * (s_next - s)
+        if callback is not None:
+            callback(i, x)
+    return x
+
+
+def sample_dpmpp_2m(denoise, x, sigmas, callback=None):
+    """DPM-Solver++ (2M): multistep 2nd order, one model call per step."""
+    old_x0 = None
+    for i in range(len(sigmas) - 1):
+        s, s_next = sigmas[i], sigmas[i + 1]
+        x0 = denoise(x, s)
+        t, t_next = -jnp.log(s), -jnp.log(jnp.maximum(s_next, 1e-10))
+        h = t_next - t
+        if old_x0 is None or float(s_next) == 0.0:
+            x = (s_next / s) * x - jnp.expm1(-h) * x0
+        else:
+            h_last = t - (-jnp.log(sigmas[i - 1]))
+            r = h_last / h
+            x0_prime = (1 + 1 / (2 * r)) * x0 - (1 / (2 * r)) * old_x0
+            x = (s_next / s) * x - jnp.expm1(-h) * x0_prime
+        old_x0 = x0
+        if callback is not None:
+            callback(i, x)
+    return x
+
+
+SAMPLERS = {
+    "euler": sample_euler,
+    "euler_ancestral": sample_euler_ancestral,
+    "heun": sample_heun,
+    "dpmpp_2m": sample_dpmpp_2m,
+}

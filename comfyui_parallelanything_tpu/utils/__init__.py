@@ -1,6 +1,7 @@
 from .logging import get_logger, log_setup_summary, log_placement, log_degradation
 from .cleanup import aggressive_cleanup
 from .metrics import StepTimer, StepStats, trace
+from .checks import assert_finite, checked
 
 __all__ = [
     "get_logger",
@@ -11,4 +12,6 @@ __all__ = [
     "StepTimer",
     "StepStats",
     "trace",
+    "assert_finite",
+    "checked",
 ]

@@ -1,0 +1,52 @@
+"""In-graph numeric assertions (NaN/Inf) via jax.experimental.checkify.
+
+The reference's answer to silent numeric corruption is defensive try/except and
+print-and-continue (SURVEY §4, §5.2); SPMD has no user-visible threads to race, so
+the TPU-native hazard is NaN/Inf propagating through a jitted program. ``checked``
+wraps a forward so every call verifies its output is finite *inside* the compiled
+program and raises a clear host-side error instead of emitting black images.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+
+def assert_finite(tree: Any, name: str = "output") -> None:
+    """In-graph assertion that every array leaf is finite (trace-time usable)."""
+    for i, leaf in enumerate(jax.tree.leaves(tree)):
+        if isinstance(leaf, jax.Array) or hasattr(leaf, "dtype"):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                checkify.check(
+                    jnp.all(jnp.isfinite(leaf)),
+                    f"{name}[leaf {i}] contains NaN/Inf",
+                )
+
+
+def checked(fn: Callable[..., Any], name: str = "forward") -> Callable[..., Any]:
+    """Wrap ``fn`` so its outputs are finite-checked inside jit; raises ValueError
+    on the host when the check trips.
+
+    Usage::
+
+        model_checked = checked(model.apply, "flux forward")
+        out = model_checked(params, x, t, ctx)   # raises on NaN/Inf output
+    """
+
+    def inner(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        assert_finite(out, name)
+        return out
+
+    checked_fn = checkify.checkify(inner)
+
+    def wrapper(*args, **kwargs):
+        err, out = checked_fn(*args, **kwargs)
+        err.throw()
+        return out
+
+    return wrapper

@@ -1,0 +1,34 @@
+"""Numeric assertion utility (checkify NaN/Inf guard — SURVEY §5.2's TPU plan)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from comfyui_parallelanything_tpu.utils.checks import checked
+
+
+class TestChecked:
+    def test_clean_passthrough(self):
+        fn = checked(lambda x: x * 2.0, "double")
+        out = fn(jnp.ones((3,)))
+        assert jnp.allclose(out, 2.0)
+
+    def test_nan_raises(self):
+        fn = checked(lambda x: x / 0.0 * 0.0, "nanmaker")  # 0/0 → NaN
+        with pytest.raises(Exception, match="NaN/Inf"):
+            fn(jnp.zeros((3,)))
+
+    def test_inf_raises(self):
+        fn = checked(lambda x: 1.0 / x, "infmaker")
+        with pytest.raises(Exception, match="NaN/Inf"):
+            fn(jnp.zeros((3,)))
+
+    def test_pytree_outputs(self):
+        fn = checked(lambda x: {"a": x, "b": (x + 1, x - 1)}, "tree")
+        out = fn(jnp.ones((2,)))
+        assert set(out) == {"a", "b"}
+
+    def test_under_jit(self):
+        fn = checked(jax.jit(lambda x: x * jnp.inf * 0.0), "jitted")
+        with pytest.raises(Exception, match="NaN/Inf"):
+            fn(jnp.ones((2,)))
