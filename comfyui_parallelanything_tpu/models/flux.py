@@ -376,21 +376,32 @@ def _flux_pipeline_spec(module: FluxModel, cfg: FluxConfig) -> PipelineSpec:
 
 
 def build_flux(
-    cfg: FluxConfig, rng, sample_shape=(1, 32, 32, 16), txt_len=128, name="flux"
+    cfg: FluxConfig,
+    rng=None,
+    sample_shape=(1, 32, 32, 16),
+    txt_len=128,
+    name="flux",
+    params=None,
 ) -> DiffusionModel:
+    """Build a FLUX DiffusionModel. ``params`` skips initialization entirely (the
+    checkpoint-load path — initializing billions of params just to overwrite them
+    would double the load cost)."""
     module = FluxModel(cfg)
-    x = jnp.zeros(sample_shape, jnp.float32)
-    t = jnp.zeros((sample_shape[0],), jnp.float32)
-    ctx = jnp.zeros((sample_shape[0], txt_len, cfg.context_in_dim), jnp.float32)
-    y = jnp.zeros((sample_shape[0], cfg.vec_in_dim), jnp.float32)
-    variables = module.init(rng, x, t, ctx, y=y)
+    if params is None:
+        if rng is None:
+            raise ValueError("need rng to initialize (or pass params=)")
+        x = jnp.zeros(sample_shape, jnp.float32)
+        t = jnp.zeros((sample_shape[0],), jnp.float32)
+        ctx = jnp.zeros((sample_shape[0], txt_len, cfg.context_in_dim), jnp.float32)
+        y = jnp.zeros((sample_shape[0], cfg.vec_in_dim), jnp.float32)
+        params = module.init(rng, x, t, ctx, y=y)["params"]
 
     def apply(params, x, timesteps, context=None, **kw):
         return module.apply({"params": params}, x, timesteps, context, **kw)
 
     return DiffusionModel(
         apply=apply,
-        params=variables["params"],
+        params=params,
         name=name,
         config=cfg,
         block_lists={

@@ -30,9 +30,9 @@ from ..utils.logging import get_logger
 from .api import DiffusionModel
 from .convert import bake_lora, convert_flux_checkpoint, to_numpy
 from .convert_unet import convert_sd_unet_checkpoint, strip_prefix
-from .flux import FluxConfig, FluxModel, _flux_pipeline_spec
-from .unet import UNet2D, UNetConfig
-from .wan import WanConfig, WanModel, _wan_pipeline_spec
+from .flux import FluxConfig, build_flux
+from .unet import UNetConfig, build_unet
+from .wan import WanConfig, build_wan
 
 
 def load_safetensors(path: str | os.PathLike) -> dict[str, np.ndarray]:
@@ -77,23 +77,7 @@ def load_flux_checkpoint(
 ) -> DiffusionModel:
     """FLUX checkpoint (path or state dict, official BFL layout) → DiffusionModel."""
     sd = _maybe_bake(_resolve_state_dict(src), lora, lora_strength)
-    params = convert_flux_checkpoint(sd, cfg)
-    module = FluxModel(cfg)
-
-    def apply(params, x, timesteps, context=None, **kw):
-        return module.apply({"params": params}, x, timesteps, context, **kw)
-
-    return DiffusionModel(
-        apply=apply,
-        params=params,
-        name=name,
-        config=cfg,
-        block_lists={
-            "double_blocks": cfg.depth,
-            "single_blocks": cfg.depth_single_blocks,
-        },
-        pipeline_spec=_flux_pipeline_spec(module, cfg),
-    )
+    return build_flux(cfg, name=name, params=convert_flux_checkpoint(sd, cfg))
 
 
 def load_sd_unet_checkpoint(
@@ -107,15 +91,7 @@ def load_sd_unet_checkpoint(
     (``model.diffusion_model.*`` subtree selected automatically) or bare UNet dicts."""
     sd = strip_prefix(_resolve_state_dict(src))
     sd = _maybe_bake(sd, lora, lora_strength)
-    params = convert_sd_unet_checkpoint(sd, cfg)
-    module = UNet2D(cfg)
-
-    def apply(params, x, timesteps, context=None, **kw):
-        return module.apply({"params": params}, x, timesteps, context, **kw)
-
-    return DiffusionModel(
-        apply=apply, params=params, name=name, config=cfg, block_lists=None
-    )
+    return build_unet(cfg, name=name, params=convert_sd_unet_checkpoint(sd, cfg))
 
 
 def load_wan_checkpoint(
@@ -129,7 +105,6 @@ def load_wan_checkpoint(
     param pytree as ``src``."""
     import jax
 
-    module = WanModel(cfg)
     if params_converter is not None:
         params = params_converter(_resolve_state_dict(src), cfg)
     elif isinstance(src, Mapping) and not any("." in k for k in src):
@@ -140,15 +115,4 @@ def load_wan_checkpoint(
         raise ValueError(
             "WAN loading needs params_converter or an already-converted param pytree"
         )
-
-    def apply(params, x, timesteps, context=None, **kw):
-        return module.apply({"params": params}, x, timesteps, context, **kw)
-
-    return DiffusionModel(
-        apply=apply,
-        params=params,
-        name=name,
-        config=cfg,
-        block_lists={"blocks": cfg.depth},
-        pipeline_spec=_wan_pipeline_spec(module, cfg),
-    )
+    return build_wan(cfg, name=name, params=params)

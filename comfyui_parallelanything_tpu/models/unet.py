@@ -220,20 +220,29 @@ class UNet2D(nn.Module):
         return h
 
 
-def build_unet(cfg: UNetConfig, rng, sample_shape=(1, 64, 64, 4), name="sd-unet") -> DiffusionModel:
-    """Initialize a UNet and wrap it as a DiffusionModel handle."""
+def build_unet(
+    cfg: UNetConfig,
+    rng=None,
+    sample_shape=(1, 64, 64, 4),
+    name="sd-unet",
+    params=None,
+) -> DiffusionModel:
+    """Build a UNet DiffusionModel; ``params`` skips initialization (load path)."""
     module = UNet2D(cfg)
-    x = jnp.zeros(sample_shape, jnp.float32)
-    t = jnp.zeros((sample_shape[0],), jnp.float32)
-    ctx = jnp.zeros((sample_shape[0], 77, cfg.context_dim), jnp.float32)
-    kwargs = {}
-    if cfg.adm_in_channels is not None:
-        kwargs["y"] = jnp.zeros((sample_shape[0], cfg.adm_in_channels), jnp.float32)
-    variables = module.init(rng, x, t, ctx, **kwargs)
+    if params is None:
+        if rng is None:
+            raise ValueError("need rng to initialize (or pass params=)")
+        x = jnp.zeros(sample_shape, jnp.float32)
+        t = jnp.zeros((sample_shape[0],), jnp.float32)
+        ctx = jnp.zeros((sample_shape[0], 77, cfg.context_dim), jnp.float32)
+        kwargs = {}
+        if cfg.adm_in_channels is not None:
+            kwargs["y"] = jnp.zeros((sample_shape[0], cfg.adm_in_channels), jnp.float32)
+        params = module.init(rng, x, t, ctx, **kwargs)["params"]
 
     def apply(params, x, timesteps, context=None, **kw):
         return module.apply({"params": params}, x, timesteps, context, **kw)
 
     return DiffusionModel(
-        apply=apply, params=variables["params"], name=name, config=cfg, block_lists=None
+        apply=apply, params=params, name=name, config=cfg, block_lists=None
     )

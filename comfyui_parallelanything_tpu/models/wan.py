@@ -258,20 +258,29 @@ def _wan_pipeline_spec(module: WanModel, cfg: WanConfig) -> PipelineSpec:
 
 
 def build_wan(
-    cfg: WanConfig, rng, sample_shape=(1, 4, 16, 16, 16), txt_len=64, name="wan"
+    cfg: WanConfig,
+    rng=None,
+    sample_shape=(1, 4, 16, 16, 16),
+    txt_len=64,
+    name="wan",
+    params=None,
 ) -> DiffusionModel:
+    """Build a WAN DiffusionModel; ``params`` skips initialization (load path)."""
     module = WanModel(cfg)
-    x = jnp.zeros(sample_shape, jnp.float32)
-    t = jnp.zeros((sample_shape[0],), jnp.float32)
-    ctx = jnp.zeros((sample_shape[0], txt_len, cfg.text_dim), jnp.float32)
-    variables = module.init(rng, x, t, ctx)
+    if params is None:
+        if rng is None:
+            raise ValueError("need rng to initialize (or pass params=)")
+        x = jnp.zeros(sample_shape, jnp.float32)
+        t = jnp.zeros((sample_shape[0],), jnp.float32)
+        ctx = jnp.zeros((sample_shape[0], txt_len, cfg.text_dim), jnp.float32)
+        params = module.init(rng, x, t, ctx)["params"]
 
     def apply(params, x, timesteps, context=None, **kw):
         return module.apply({"params": params}, x, timesteps, context, **kw)
 
     return DiffusionModel(
         apply=apply,
-        params=variables["params"],
+        params=params,
         name=name,
         config=cfg,
         block_lists={"blocks": cfg.depth},

@@ -10,14 +10,33 @@ from .split import (
     split_kwargs,
     concat_results,
 )
-from .mesh import build_mesh, mesh_axis_names
+from .mesh import (
+    build_mesh,
+    mesh_axis_names,
+    fsdp_spec,
+    place_params,
+    place_params_fsdp,
+)
 from .sequence import sequence_parallel_attention
 from .pipeline import PipelineRunner, build_pipeline_runner
+from .multihost import (
+    initialize_distributed,
+    is_multihost,
+    hybrid_mesh,
+    host_local_batch,
+)
 
 __all__ = [
     "sequence_parallel_attention",
     "PipelineRunner",
     "build_pipeline_runner",
+    "fsdp_spec",
+    "place_params",
+    "place_params_fsdp",
+    "initialize_distributed",
+    "is_multihost",
+    "hybrid_mesh",
+    "host_local_batch",
     "DeviceLink",
     "DeviceChain",
     "normalize_weights",

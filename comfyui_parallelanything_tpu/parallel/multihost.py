@@ -1,0 +1,155 @@
+"""Multi-host (DCN) support: process initialization, hybrid meshes, global arrays.
+
+The reference is strictly single-process — its "backend" is threads + PCIe copies in
+one interpreter (SURVEY §2f), and multi-node is out of its reach. TPU-natively,
+multi-host is the same SPMD program over a bigger mesh: ``jax.distributed`` brings up
+the process group over DCN, every process contributes its local chips, and XLA routes
+collectives over ICI within a slice and DCN across slices. These helpers wrap that
+bring-up so the rest of the framework (orchestrator, sequence parallel) is
+host-count-agnostic:
+
+- ``initialize_distributed`` — env-driven ``jax.distributed.initialize`` (no-op when
+  single-process or already initialized);
+- ``hybrid_mesh`` — (dcn_axis, ici_axes) mesh via ``mesh_utils`` so the slow axis
+  (usually ``data``) crosses hosts and fast axes (``seq``/``model``) stay on ICI;
+- ``host_local_batch`` — per-host input shards → one global jax.Array
+  (``jax.make_array_from_process_local_data``), the multi-host analogue of the
+  host-side scatter in the orchestrator's hybrid path.
+"""
+
+from __future__ import annotations
+
+import os
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.logging import get_logger
+from .mesh import AXIS_DATA
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Bring up the JAX process group. Returns True when running multi-process.
+
+    Arguments default to the standard env vars (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``); on TPU pods with all three absent,
+    ``jax.distributed.initialize()`` auto-detects from the TPU metadata. A plain
+    single-process run (no env, no args, no TPU pod) is a no-op.
+    """
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num_str = os.environ.get("JAX_NUM_PROCESSES")
+    num_processes = num_processes if num_processes is not None else (
+        int(num_str) if num_str else None
+    )
+    pid_str = os.environ.get("JAX_PROCESS_ID")
+    process_id = process_id if process_id is not None else (
+        int(pid_str) if pid_str else None
+    )
+    if coordinator_address is None and num_processes is None:
+        return jax.process_count() > 1  # single-process (or already initialized)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # Already initialized — idempotent bring-up. JAX phrases this as
+        # "distributed.initialize should only be called once".
+        msg = str(e).lower()
+        if "once" not in msg and "already" not in msg:
+            raise
+    get_logger().info(
+        "distributed: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+    return jax.process_count() > 1
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def hybrid_mesh(
+    ici_axes: dict[str, int] | None = None,
+    dcn_axis: str = AXIS_DATA,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Mesh whose ``dcn_axis`` spans processes (slow, DCN) and whose ``ici_axes``
+    split each process's local devices (fast, ICI).
+
+    Single-process: degenerates to a mesh over the local devices with the same axis
+    names (dcn axis size 1 — callers need no special-casing). Example on 4 hosts ×
+    8 chips: ``hybrid_mesh({"seq": 8})`` → mesh {"data": 4, "seq": 8} where batch
+    sharding crosses DCN and sequence parallelism stays on ICI.
+    """
+    ici_axes = dict(ici_axes) if ici_axes else {}
+    devices = list(devices) if devices is not None else jax.devices()
+    n_proc = jax.process_count()
+    if len(devices) != n_proc * (len(devices) // n_proc) or (
+        n_proc > 1 and len(devices) != jax.device_count()
+    ):
+        # Multi-process meshes must span the GLOBAL device list (every process
+        # passes the same jax.devices()); a jax.local_devices() subset would
+        # shape the mesh for n_proc× more devices than it holds.
+        raise ValueError(
+            f"devices must be the global device list across all {n_proc} "
+            f"processes (got {len(devices)}, expected {jax.device_count()}); "
+            "pass jax.devices(), not jax.local_devices()"
+        )
+    local = len(devices) // n_proc
+    ici_total = 1
+    for v in ici_axes.values():
+        ici_total *= v
+    if local % ici_total:
+        raise ValueError(
+            f"ici axes {ici_axes} do not divide the {local} per-process devices"
+        )
+    # Remaining local parallelism folds into the dcn axis (data sharding within a
+    # host is still ICI-fast; the axis is simply "everything that isn't an inner
+    # axis"), matching the common data-outer/model-inner recipe.
+    dcn_size = n_proc * (local // ici_total)
+    if is_multihost():
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(local // ici_total, *ici_axes.values()),
+            dcn_mesh_shape=(n_proc, *([1] * len(ici_axes))),
+            devices=devices,
+        ).reshape(dcn_size, *ici_axes.values())
+    else:
+        arr = np.array(devices, dtype=object).reshape(dcn_size, *ici_axes.values())
+    return Mesh(arr, (dcn_axis, *ici_axes.keys()))
+
+
+def host_local_batch(
+    local_array: np.ndarray, mesh: Mesh, axis: str = AXIS_DATA
+) -> jax.Array:
+    """Per-process input shard → one global array sharded on ``axis``.
+
+    Each process passes its own slice of the global batch (dim0); the result is a
+    single jax.Array whose global dim0 is the concatenation across processes —
+    the DCN-scale analogue of the reference's host-side torch.split scatter
+    (1222-1250). Single-process: equivalent to ``device_put`` with the sharding.
+    """
+    sharding = NamedSharding(mesh, P(axis))
+    if not is_multihost():
+        return jax.device_put(np.asarray(local_array), sharding)
+    global_dim0 = local_array.shape[0] * jax.process_count()
+    global_shape = (global_dim0, *local_array.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(local_array), global_shape
+    )

@@ -68,7 +68,7 @@ from ..utils.logging import (
     log_setup_summary,
 )
 from .chain import DeviceChain, DeviceLink
-from .mesh import AXIS_DATA, build_mesh, place_params
+from .mesh import AXIS_DATA, build_mesh, place_params, place_params_fsdp
 from .split import (
     batch_size_of,
     blend_memory_weights,
@@ -100,6 +100,9 @@ class ParallelConfig:
         widget default True wins over the python-signature default False, SURVEY §5.6)
     ``purge_cache`` / ``purge_models`` — cleanup aggressiveness at teardown (901-908)
     ``pad_small_batches``  — see "documented divergences" in the module docstring
+    ``weight_sharding``    — "replicate" (reference parity: full model per device,
+        README.md:167) or "fsdp" (shard each weight over the data axis; required
+        when the model doesn't fit one chip — e.g. FLUX-dev bf16 on v5e)
     """
 
     workload_split: bool = True
@@ -108,6 +111,7 @@ class ParallelConfig:
     purge_models: bool = False
     data_axis: str = AXIS_DATA
     pad_small_batches: bool = True
+    weight_sharding: str = "replicate"
 
 
 @dataclasses.dataclass
@@ -280,6 +284,24 @@ class ParallelModel:
     # The reference keeps ``_original_forward`` callable on the lead device
     # (1380-1383); ``single`` is that escape hatch.
     def single(self, x, timesteps, context=None, **kwargs):
+        # FSDP premise: the full pytree does NOT fit one chip, so the fallback
+        # cannot be a lead-device copy. Run over the group mesh with inputs
+        # replicated instead — params stay 1/N per chip, XLA gathers per-use.
+        g = self._groups[0]
+        if self.config.weight_sharding == "fsdp" and g.params is not None:
+            traced, static = partition_kwargs(kwargs)
+            repl = NamedSharding(g.mesh, P())
+
+            def put_repl(v):
+                return jax.tree.map(
+                    lambda l: jax.device_put(l, repl) if _is_arraylike(l) else l, v
+                )
+
+            fn = self._jit_for(static)
+            return fn(
+                g.params, put_repl(x), put_repl(timesteps), put_repl(context),
+                put_repl(traced),
+            )
         if self._lead_params is None:
             self._lead_params = jax.device_put(self._host_params, self.lead_device)
         traced, static = partition_kwargs(kwargs)
@@ -358,18 +380,29 @@ class ParallelModel:
 
     def _demote(self) -> None:
         self.active = False
+        keep = self.config.weight_sharding == "fsdp"
         for g in self._groups:
-            g.params = None
+            if not keep:
+                # Replicate mode frees the per-device replicas (the lead copy
+                # takes over). FSDP keeps the sharded pytree: it is the ONLY
+                # placement that fits, and single() runs on it with replicated
+                # inputs.
+                g.params = None
         self._pipeline_runner = None
         aggressive_cleanup(clear_compile_cache=True)
         self._jits.clear()
+
+    def _place(self, params, mesh):
+        if self.config.weight_sharding == "fsdp":
+            return place_params_fsdp(params, mesh, self.config.data_axis)
+        return place_params(params, mesh)
 
     def reactivate(self) -> None:
         """Re-place replicas and resume parallel execution after a demotion."""
         for g in self._groups:
             if g.params is None:
                 g.mesh = build_mesh(g.devices, {self.config.data_axis: len(g.devices)})
-                g.params = place_params(self._host_params, g.mesh)
+                g.params = self._place(self._host_params, g.mesh)
         self.active = True
 
     # -- lifecycle (parity: cleanup_parallel_model, 211-282) -----------------------
@@ -462,11 +495,18 @@ def parallelize(
             for g in groups:
                 if g.params is None:
                     g.mesh = build_mesh(g.devices, {config.data_axis: len(g.devices)})
-                    g.params = place_params(params, g.mesh)
-                    log_placement(
-                        f"{g.platform}×{len(g.devices)}",
-                        "replicated parameter pytree",
-                    )
+                    if config.weight_sharding == "fsdp":
+                        g.params = place_params_fsdp(params, g.mesh, config.data_axis)
+                        log_placement(
+                            f"{g.platform}×{len(g.devices)}",
+                            "fsdp-sharded parameter pytree",
+                        )
+                    else:
+                        g.params = place_params(params, g.mesh)
+                        log_placement(
+                            f"{g.platform}×{len(g.devices)}",
+                            "replicated parameter pytree",
+                        )
             break
         except Exception as e:  # noqa: BLE001
             if not _is_resource_exhausted(e):

@@ -1,0 +1,104 @@
+"""FSDP weight sharding: per-leaf largest-axis sharding over the data mesh, numerics
+identical to replicate mode. Beyond-reference capability — a FLUX-dev-class model in
+bf16 cannot hold a full replica per v5e chip (reference README.md:167 'full model per
+device' is physically impossible there)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from comfyui_parallelanything_tpu import DeviceChain, ParallelConfig, parallelize
+from comfyui_parallelanything_tpu.models import build_unet, sd15_config
+from comfyui_parallelanything_tpu.parallel.mesh import (
+    AXIS_DATA,
+    build_mesh,
+    fsdp_spec,
+    place_params_fsdp,
+)
+
+
+class TestFsdpSpec:
+    def test_large_divisible_shards_largest_axis(self):
+        assert fsdp_spec((512, 1024), AXIS_DATA, 8) == P(None, AXIS_DATA)
+        assert fsdp_spec((2048, 256), AXIS_DATA, 8) == P(AXIS_DATA, None)
+
+    def test_small_replicates(self):
+        assert fsdp_spec((64,), AXIS_DATA, 8) == P()
+
+    def test_indivisible_replicates(self):
+        assert fsdp_spec((1000, 999), AXIS_DATA, 8, min_size=1) == P(AXIS_DATA, None)
+        assert fsdp_spec((999, 1001), AXIS_DATA, 8, min_size=1) == P()
+
+    def test_scalar_replicates(self):
+        assert fsdp_spec((), AXIS_DATA, 8) == P()
+
+
+class TestFsdpPlacement:
+    def test_leaves_actually_sharded(self, cpu_devices):
+        mesh = build_mesh(cpu_devices, {AXIS_DATA: 8})
+        params = {
+            "big": jnp.ones((1024, 512)),
+            "small": jnp.ones((16,)),
+        }
+        placed = place_params_fsdp(params, mesh)
+        # big shards over 8 devices; each device holds 1/8 of the rows or cols.
+        shard_shapes = {s.data.shape for s in placed["big"].addressable_shards}
+        assert shard_shapes in ({(128, 512)}, {(1024, 64)})
+        assert len(placed["small"].sharding.device_set) == 8  # replicated
+
+
+class TestFsdpEndToEnd:
+    def test_fsdp_matches_replicate(self, cpu_devices):
+        cfg = sd15_config(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(1,), transformer_depth=(0, 1), num_heads=4,
+            context_dim=64, norm_groups=8, dtype=jnp.float32,
+        )
+        model = build_unet(cfg, jax.random.key(0), sample_shape=(1, 16, 16, 4))
+        chain = DeviceChain.even([f"cpu:{i}" for i in range(8)])
+        pm_rep = parallelize(model, chain)
+        pm_fsdp = parallelize(
+            model, chain, ParallelConfig(weight_sharding="fsdp")
+        )
+        x = jax.random.normal(jax.random.key(1), (8, 16, 16, 4), jnp.float32)
+        ctx = jax.random.normal(jax.random.key(2), (8, 12, 64), jnp.float32)
+        t = jnp.linspace(999.0, 1.0, 8)
+        a = pm_rep(x, t, ctx)
+        b = pm_fsdp(x, t, ctx)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3)
+
+    def test_fsdp_single_fallback_stays_sharded(self, cpu_devices):
+        # batch==1 (no pipeline spec on a bare-fn model) routes through single();
+        # under fsdp the params must NOT be copied whole to the lead device — the
+        # fallback runs on the group mesh with replicated inputs.
+        def f(p, x, t, context=None, **kw):
+            return x @ p["w"]
+
+        params = {"w": jnp.ones((1024, 1024))}
+        chain = DeviceChain.even([f"cpu:{i}" for i in range(8)])
+        pm = parallelize(
+            (f, params), chain, ParallelConfig(weight_sharding="fsdp")
+        )
+        out = pm(jnp.ones((1, 1024)), jnp.zeros((1,)))
+        assert out.shape == (1, 1024)
+        assert pm._lead_params is None  # no full-pytree lead copy happened
+
+    def test_fsdp_params_use_less_per_device_memory(self, cpu_devices):
+        # Structural check: at least the large kernels are sharded, not replicated.
+        cfg = sd15_config(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(1,), transformer_depth=(0, 1), num_heads=4,
+            context_dim=64, norm_groups=8, dtype=jnp.float32,
+        )
+        model = build_unet(cfg, jax.random.key(0), sample_shape=(1, 16, 16, 4))
+        chain = DeviceChain.even([f"cpu:{i}" for i in range(8)])
+        pm = parallelize(model, chain, ParallelConfig(weight_sharding="fsdp"))
+        leaves = jax.tree.leaves(pm._groups[0].params)
+        sharded = [
+            l for l in leaves
+            if l.size >= 2**16 and len(l.addressable_shards) == 8
+            and l.addressable_shards[0].data.size < l.size
+        ]
+        assert sharded, "expected at least one genuinely sharded large parameter"

@@ -1,0 +1,91 @@
+"""Heterogeneous-chain (multi-platform-group) data parallelism — SURVEY §7 hard
+part 1. A real tpu+cpu chain can't exist on the CPU-only CI box, so the platform
+prober is monkeypatched to split the 8 virtual CPU devices into two fake platform
+groups; the weighted host-side scatter / per-group SPMD / gather-concat path then
+runs exactly as it would for tpu+cpu."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu import DeviceChain, parallelize
+from comfyui_parallelanything_tpu.models import build_unet, sd15_config
+from comfyui_parallelanything_tpu.parallel import orchestrator as orch_mod
+
+
+@pytest.fixture()
+def split_platforms(monkeypatch):
+    """cpu:0-1 keep platform 'cpu'; cpu:2-3 report a fake accelerator platform."""
+
+    def fake_platform(device_str: str) -> str:
+        idx = int(device_str.split(":")[1]) if ":" in device_str else 0
+        return "cpu" if idx < 2 else "fake_tpu"
+
+    monkeypatch.setattr(orch_mod, "device_platform", fake_platform)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = sd15_config(
+        model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+        attention_levels=(1,), transformer_depth=(0, 1), num_heads=4,
+        context_dim=64, norm_groups=8, dtype=jnp.float32,
+    )
+    return build_unet(cfg, jax.random.key(0), sample_shape=(1, 16, 16, 4))
+
+
+class TestHybridChain:
+    def test_two_groups_formed(self, split_platforms, tiny_model):
+        chain = DeviceChain.from_pairs(
+            [("cpu:0", 30), ("cpu:1", 30), ("cpu:2", 20), ("cpu:3", 20)]
+        )
+        pm = parallelize(tiny_model, chain)
+        assert len(pm._groups) == 2
+        assert [g.platform for g in pm._groups] == ["cpu", "fake_tpu"]
+        assert pm.n_devices == 4
+
+    def test_hybrid_output_matches_single(self, split_platforms, tiny_model):
+        chain = DeviceChain.from_pairs(
+            [("cpu:0", 40), ("cpu:1", 20), ("cpu:2", 20), ("cpu:3", 20)]
+        )
+        pm = parallelize(tiny_model, chain)
+        x = jax.random.normal(jax.random.key(1), (8, 16, 16, 4), jnp.float32)
+        ctx = jax.random.normal(jax.random.key(2), (8, 12, 64), jnp.float32)
+        t = jnp.linspace(999.0, 1.0, 8)
+        got = pm(x, t, ctx)
+        want = tiny_model(x, t, ctx)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3
+        )
+
+    def test_weighted_split_respected(self, split_platforms, tiny_model):
+        # 75/25 between groups: batch 8 → 6 on group one, 2 on group two.
+        chain = DeviceChain.from_pairs(
+            [("cpu:0", 37.5), ("cpu:1", 37.5), ("cpu:2", 12.5), ("cpu:3", 12.5)]
+        )
+        from comfyui_parallelanything_tpu import ParallelConfig
+
+        pm = parallelize(
+            tiny_model, chain, ParallelConfig(auto_memory_balance=False)
+        )
+        gweights = [g.weight for g in pm._groups]
+        assert gweights[0] == pytest.approx(0.75)
+        assert gweights[1] == pytest.approx(0.25)
+
+    def test_zero_size_group_skipped(self, split_platforms, tiny_model):
+        # Tiny batch with an extreme split: the second group gets 0 items and must
+        # be skipped (the reference's active-device list, 1324-1337).
+        chain = DeviceChain.from_pairs([("cpu:0", 99), ("cpu:2", 1)])
+        from comfyui_parallelanything_tpu import ParallelConfig
+
+        pm = parallelize(
+            tiny_model, chain,
+            ParallelConfig(auto_memory_balance=False, pad_small_batches=True),
+        )
+        x = jax.random.normal(jax.random.key(3), (2, 16, 16, 4), jnp.float32)
+        ctx = jax.random.normal(jax.random.key(4), (2, 12, 64), jnp.float32)
+        out = pm(x, jnp.ones((2,)), ctx)
+        assert out.shape == (2, 16, 16, 4)
+        assert np.all(np.isfinite(np.asarray(out)))

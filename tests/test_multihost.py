@@ -1,0 +1,50 @@
+"""Multi-host helpers — single-process degeneracy (the CI-reachable half; the
+multi-process branch is exercised on real pods via jax.distributed)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.parallel.mesh import AXIS_SEQ
+from comfyui_parallelanything_tpu.parallel.multihost import (
+    host_local_batch,
+    hybrid_mesh,
+    initialize_distributed,
+    is_multihost,
+)
+from comfyui_parallelanything_tpu.parallel.sequence import sequence_parallel_attention
+
+
+class TestSingleProcessDegeneracy:
+    def test_initialize_noop(self):
+        assert initialize_distributed() is False
+        assert not is_multihost()
+
+    def test_hybrid_mesh_all_local(self, cpu_devices):
+        mesh = hybrid_mesh({AXIS_SEQ: 4}, devices=cpu_devices)
+        assert mesh.shape == {"data": 2, "seq": 4}
+
+    def test_hybrid_mesh_pure_data(self, cpu_devices):
+        mesh = hybrid_mesh(devices=cpu_devices)
+        assert mesh.shape == {"data": 8}
+
+    def test_indivisible_raises(self, cpu_devices):
+        with pytest.raises(ValueError, match="do not divide"):
+            hybrid_mesh({AXIS_SEQ: 3}, devices=cpu_devices)
+
+    def test_host_local_batch_places_sharded(self, cpu_devices):
+        mesh = hybrid_mesh(devices=cpu_devices)
+        arr = np.random.default_rng(0).standard_normal((16, 4)).astype(np.float32)
+        out = host_local_batch(arr, mesh)
+        assert out.shape == (16, 4)
+        assert len(out.sharding.device_set) == 8
+        np.testing.assert_allclose(np.asarray(out), arr)
+
+    def test_hybrid_mesh_drives_sequence_parallel(self, cpu_devices):
+        # The (data, seq) hybrid mesh feeds the seq-parallel program directly.
+        mesh = hybrid_mesh({AXIS_SEQ: 4}, devices=cpu_devices)
+        sub = jax.sharding.Mesh(mesh.devices[0:1].reshape(4), (AXIS_SEQ,))
+        q = jax.random.normal(jax.random.key(0), (1, 32, 4, 8), jnp.float32)
+        out = sequence_parallel_attention(q, q, q, sub, method="ring")
+        assert out.shape == q.shape
