@@ -107,12 +107,34 @@ def fsdp_spec(shape: tuple[int, ...], axis: str, n: int, min_size: int = 2**16) 
     return P(*spec)
 
 
-def place_params_fsdp(params, mesh: Mesh, axis: str = AXIS_DATA) -> object:
-    """Place a parameter pytree with per-leaf FSDP sharding over ``axis``."""
+def place_params_sharded(
+    params, mesh: Mesh, axis: str, min_size: int = 2**16
+) -> object:
+    """Place a parameter pytree with per-leaf largest-divisible-axis sharding over
+    ``axis`` (the shared policy behind both FSDP and GSPMD tensor parallelism —
+    the two differ only in WHICH mesh axis carries the shards):
+
+    - over the ``data`` axis (FSDP / ZeRO-3): batch computation needs whole
+      weights, so XLA all-gathers them per use; per-chip weight memory is 1/N;
+    - over the ``model`` axis (TP): the axis is unused by batch sharding, so XLA
+      partitions the matmul contractions themselves (partial products +
+      reduce-scatter/all-reduce) — Megatron-shaped execution without hand-written
+      collectives (absent in the reference: "No model parallelism", README.md:212).
+    """
     n = mesh.shape[axis]
 
     def put(leaf):
-        spec = fsdp_spec(tuple(getattr(leaf, "shape", ())), axis, n)
+        spec = fsdp_spec(tuple(getattr(leaf, "shape", ())), axis, n, min_size)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree.map(put, params)
+
+
+def place_params_fsdp(params, mesh: Mesh, axis: str = AXIS_DATA) -> object:
+    """FSDP placement: ``place_params_sharded`` over the data axis."""
+    return place_params_sharded(params, mesh, axis)
+
+
+def place_params_tp(params, mesh: Mesh, axis: str = AXIS_MODEL) -> object:
+    """Tensor-parallel placement: ``place_params_sharded`` over the model axis."""
+    return place_params_sharded(params, mesh, axis)

@@ -103,6 +103,10 @@ class ParallelConfig:
     ``weight_sharding``    — "replicate" (reference parity: full model per device,
         README.md:167) or "fsdp" (shard each weight over the data axis; required
         when the model doesn't fit one chip — e.g. FLUX-dev bf16 on v5e)
+    ``tensor_parallel``    — size of the ``model`` mesh axis; >1 builds a 2-D
+        (data × model) mesh per group and shards weights over ``model`` so XLA
+        partitions the matmuls themselves (GSPMD TP). Must divide each group's
+        device count; composes with batch sharding, not with fsdp.
     """
 
     workload_split: bool = True
@@ -112,6 +116,7 @@ class ParallelConfig:
     data_axis: str = AXIS_DATA
     pad_small_batches: bool = True
     weight_sharding: str = "replicate"
+    tensor_parallel: int = 1
 
 
 @dataclasses.dataclass
@@ -140,6 +145,41 @@ class _PlatformGroup:
         self.devices.pop()
         self.device_weights.pop()
         return self.device_strs.pop()
+
+
+def _group_mesh(devices, config: "ParallelConfig"):
+    """1-D data mesh, or 2-D (data x model) when tensor_parallel > 1."""
+    n = len(devices)
+    tp = max(1, int(config.tensor_parallel))
+    if tp == 1:
+        return build_mesh(devices, {config.data_axis: n})
+    if config.weight_sharding == "fsdp":
+        raise ValueError("tensor_parallel does not compose with weight_sharding='fsdp'")
+    if n % tp:
+        raise ValueError(
+            f"tensor_parallel={tp} does not divide the group's {n} device(s)"
+        )
+    from .mesh import AXIS_MODEL
+
+    return build_mesh(devices, {config.data_axis: n // tp, AXIS_MODEL: tp})
+
+
+def _place_for(config: "ParallelConfig", params, mesh):
+    """Single placement policy for setup, _place and reactivate: returns
+    (placed_pytree, description)."""
+    if config.weight_sharding == "fsdp":
+        return (
+            place_params_fsdp(params, mesh, config.data_axis),
+            "fsdp-sharded parameter pytree",
+        )
+    if config.tensor_parallel > 1:
+        from .mesh import place_params_tp
+
+        return (
+            place_params_tp(params, mesh),
+            f"tensor-parallel parameter pytree (model axis ×{config.tensor_parallel})",
+        )
+    return place_params(params, mesh), "replicated parameter pytree"
 
 
 def _pad_leaf(a, pad: int):
@@ -229,12 +269,26 @@ class ParallelModel:
 
     # -- execution -----------------------------------------------------------------
 
+    def _data_width(self) -> int:
+        """Total size of the data axis across groups — the unit batch routing
+        compares against (== device count for 1-D meshes; smaller under TP)."""
+        return sum(
+            g.mesh.shape[self.config.data_axis] if g.mesh is not None else len(g.devices)
+            for g in self._groups
+        )
+
     def __call__(self, x, timesteps, context=None, **kwargs):
         if not self.active:
             return self.single(x, timesteps, context, **kwargs)
         batch = batch_size_of(x)
-        n = self.n_devices
+        n = self._data_width()
         try:
+            if self.config.tensor_parallel > 1 and self.config.workload_split:
+                # TP premise: weights only fit sharded — pipeline stage placement
+                # and lead-device fallbacks would re-materialize full weights.
+                # Every batch (incl. batch==1, where the data axis may be 1) runs
+                # the sharded program.
+                return self._data_parallel(batch, x, timesteps, context, kwargs)
             if batch == 1 and self.config.workload_split and n > 1:
                 # Pipeline block-placement mode (reference 1295-1305); a model that
                 # declares no stages runs single-device (1156-1166) — padded DP on a
@@ -284,11 +338,14 @@ class ParallelModel:
     # The reference keeps ``_original_forward`` callable on the lead device
     # (1380-1383); ``single`` is that escape hatch.
     def single(self, x, timesteps, context=None, **kwargs):
-        # FSDP premise: the full pytree does NOT fit one chip, so the fallback
+        # FSDP/TP premise: the full pytree does NOT fit one chip, so the fallback
         # cannot be a lead-device copy. Run over the group mesh with inputs
         # replicated instead — params stay 1/N per chip, XLA gathers per-use.
         g = self._groups[0]
-        if self.config.weight_sharding == "fsdp" and g.params is not None:
+        sharded_weights = (
+            self.config.weight_sharding == "fsdp" or self.config.tensor_parallel > 1
+        )
+        if sharded_weights and g.params is not None:
             traced, static = partition_kwargs(kwargs)
             repl = NamedSharding(g.mesh, P())
 
@@ -352,7 +409,7 @@ class ParallelModel:
         return concat_results(outs)
 
     def _dp_on_group(self, group: _PlatformGroup, batch, x, timesteps, context, kwargs):
-        n = len(group.devices)
+        n = group.mesh.shape[self.config.data_axis]
         padded = batch + ((-batch) % n)
         sharded = NamedSharding(group.mesh, P(self.config.data_axis))
         repl = NamedSharding(group.mesh, P())
@@ -380,11 +437,13 @@ class ParallelModel:
 
     def _demote(self) -> None:
         self.active = False
-        keep = self.config.weight_sharding == "fsdp"
+        keep = (
+            self.config.weight_sharding == "fsdp" or self.config.tensor_parallel > 1
+        )
         for g in self._groups:
             if not keep:
                 # Replicate mode frees the per-device replicas (the lead copy
-                # takes over). FSDP keeps the sharded pytree: it is the ONLY
+                # takes over). FSDP/TP keep the sharded pytree: it is the ONLY
                 # placement that fits, and single() runs on it with replicated
                 # inputs.
                 g.params = None
@@ -393,15 +452,14 @@ class ParallelModel:
         self._jits.clear()
 
     def _place(self, params, mesh):
-        if self.config.weight_sharding == "fsdp":
-            return place_params_fsdp(params, mesh, self.config.data_axis)
-        return place_params(params, mesh)
+        placed, _ = _place_for(self.config, params, mesh)
+        return placed
 
     def reactivate(self) -> None:
         """Re-place replicas and resume parallel execution after a demotion."""
         for g in self._groups:
             if g.params is None:
-                g.mesh = build_mesh(g.devices, {self.config.data_axis: len(g.devices)})
+                g.mesh = _group_mesh(g.devices, self.config)
                 g.params = self._place(self._host_params, g.mesh)
         self.active = True
 
@@ -494,26 +552,21 @@ def parallelize(
         try:
             for g in groups:
                 if g.params is None:
-                    g.mesh = build_mesh(g.devices, {config.data_axis: len(g.devices)})
-                    if config.weight_sharding == "fsdp":
-                        g.params = place_params_fsdp(params, g.mesh, config.data_axis)
-                        log_placement(
-                            f"{g.platform}×{len(g.devices)}",
-                            "fsdp-sharded parameter pytree",
-                        )
-                    else:
-                        g.params = place_params(params, g.mesh)
-                        log_placement(
-                            f"{g.platform}×{len(g.devices)}",
-                            "replicated parameter pytree",
-                        )
+                    g.mesh = _group_mesh(g.devices, config)
+                    g.params, desc = _place_for(config, params, g.mesh)
+                    log_placement(f"{g.platform}×{len(g.devices)}", desc)
             break
         except Exception as e:  # noqa: BLE001
             if not _is_resource_exhausted(e):
                 raise
             g = groups[-1]
-            if len(g.devices) > 1:
-                dropped = g.drop_last_device()
+            tp = max(1, config.tensor_parallel)
+            if len(g.devices) > tp:
+                # Drop enough trailing devices that the survivor count still
+                # divides the tensor_parallel degree (always exactly 1 for tp=1).
+                dropped = [g.drop_last_device()]
+                while len(g.devices) % tp:
+                    dropped.append(g.drop_last_device())
                 log_degradation("setup-oom", f"dropped {dropped}, retrying")
             elif len(groups) > 1:
                 groups.pop()
