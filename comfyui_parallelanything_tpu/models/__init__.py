@@ -17,6 +17,7 @@ from .loader import (
     load_sd_unet_checkpoint,
     load_wan_checkpoint,
 )
+from .checkpoint import save_params, load_params
 
 __all__ = [
     "DiffusionModel",
@@ -46,4 +47,6 @@ __all__ = [
     "load_flux_checkpoint",
     "load_sd_unet_checkpoint",
     "load_wan_checkpoint",
+    "save_params",
+    "load_params",
 ]

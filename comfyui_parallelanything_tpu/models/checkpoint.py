@@ -1,0 +1,37 @@
+"""Native parameter save/restore (orbax) — SURVEY §5.4.
+
+The reference never saves state (state_dict is only an in-memory transfer format
+during cloning, any_device_parallel.py:616/639-665) and leans on its host app for
+model files. This framework hosts models itself (models/loader.py reads the torch
+ecosystem's safetensors), so it also carries a native round-trip format for
+converted params: orbax checkpoints skip the torch→flax conversion on every
+subsequent load and restore directly into any sharding.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+def save_params(path: str | os.PathLike, params: Any) -> None:
+    """Write a parameter pytree to an orbax checkpoint directory."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.fspath(os.path.abspath(path)), params)
+
+
+def load_params(path: str | os.PathLike, like: Any | None = None) -> Any:
+    """Restore a parameter pytree.
+
+    ``like`` (optional) is an abstract/concrete pytree whose structure, dtypes and
+    *shardings* the restore targets — pass e.g. ``jax.eval_shape`` output with
+    `NamedSharding`s to restore directly into a mesh placement without a host copy.
+    """
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is None:
+            return ckptr.restore(os.fspath(os.path.abspath(path)))
+        return ckptr.restore(os.fspath(os.path.abspath(path)), like)

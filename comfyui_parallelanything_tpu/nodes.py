@@ -209,6 +209,7 @@ class ParallelAnything:
         auto_vram_balance: bool = True,
         purge_cache: bool = True,
         purge_models: bool = False,
+        **config_extra,
     ):
         chain = chain_from_wire(parallel_devices)
         config = ParallelConfig(
@@ -216,20 +217,78 @@ class ParallelAnything:
             auto_memory_balance=auto_vram_balance,
             purge_cache=purge_cache,
             purge_models=purge_models,
+            **config_extra,
         )
         # parallelize returns the model unchanged on an unusable chain, matching the
         # reference's abort paths (1019-1027, 1037-1042).
         return (parallelize(model, chain, config),)
 
 
+class ParallelAnythingAdvanced(ParallelAnything):
+    """The orchestrator node with the beyond-reference knobs exposed: weight
+    sharding (FSDP for models bigger than one chip) and tensor parallelism."""
+
+    DESCRIPTION = (
+        ParallelAnything.DESCRIPTION
+        + " Advanced: FSDP weight sharding and tensor parallelism for models "
+        "larger than a single device."
+    )
+    FUNCTION = "setup_parallel_advanced"
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        base = ParallelAnything.INPUT_TYPES()
+        base["required"]["weight_sharding"] = (
+            ["replicate", "fsdp"],
+            {
+                "default": "replicate",
+                "tooltip": "fsdp shards each weight across the chain (model > 1 chip)",
+            },
+        )
+        base["required"]["tensor_parallel"] = (
+            "INT",
+            {
+                "default": 1,
+                "min": 1,
+                "max": 64,
+                "tooltip": "model-axis size; >1 partitions the matmuls (GSPMD TP)",
+            },
+        )
+        return base
+
+    def setup_parallel_advanced(
+        self,
+        model,
+        parallel_devices,
+        workload_split: bool = True,
+        auto_vram_balance: bool = True,
+        purge_cache: bool = True,
+        purge_models: bool = False,
+        weight_sharding: str = "replicate",
+        tensor_parallel: int = 1,
+    ):
+        return self.setup_parallel(
+            model,
+            parallel_devices,
+            workload_split=workload_split,
+            auto_vram_balance=auto_vram_balance,
+            purge_cache=purge_cache,
+            purge_models=purge_models,
+            weight_sharding=weight_sharding,
+            tensor_parallel=tensor_parallel,
+        )
+
+
 NODE_CLASS_MAPPINGS = {
     "ParallelAnything": ParallelAnything,
+    "ParallelAnythingAdvanced": ParallelAnythingAdvanced,
     "ParallelDevice": ParallelDevice,
     "ParallelDeviceList": ParallelDeviceList,
 }
 
 NODE_DISPLAY_NAME_MAPPINGS = {
     "ParallelAnything": "Parallel Anything (True Multi-Device TPU)",
+    "ParallelAnythingAdvanced": "Parallel Anything (Advanced: FSDP/TP)",
     "ParallelDevice": "Parallel Device Config",
     "ParallelDeviceList": "Parallel Device List (1-4x)",
 }

@@ -1,0 +1,38 @@
+"""Native orbax save/restore round-trip (SURVEY §5.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("orbax.checkpoint")
+
+from comfyui_parallelanything_tpu.models.checkpoint import load_params, save_params
+
+
+class TestSaveRestore:
+    def test_roundtrip(self, tmp_path):
+        params = {
+            "layer": {"kernel": jnp.arange(12, dtype=jnp.float32).reshape(3, 4)},
+            "bias": jnp.ones((4,), jnp.float32),
+        }
+        path = tmp_path / "ckpt"
+        save_params(path, params)
+        restored = load_params(path)
+        np.testing.assert_array_equal(
+            np.asarray(restored["layer"]["kernel"]), np.asarray(params["layer"]["kernel"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored["bias"]), np.asarray(params["bias"])
+        )
+
+    def test_restore_into_target_structure(self, tmp_path):
+        params = {"w": jnp.full((8, 8), 3.0)}
+        path = tmp_path / "ckpt2"
+        save_params(path, params)
+        like = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), params
+        )
+        restored = load_params(path, like)
+        assert restored["w"].shape == (8, 8)
+        assert float(restored["w"][0, 0]) == 3.0

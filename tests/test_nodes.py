@@ -27,6 +27,7 @@ class TestNodeProtocol:
     def test_mappings_complete(self):
         assert set(NODE_CLASS_MAPPINGS) == {
             "ParallelAnything",
+            "ParallelAnythingAdvanced",
             "ParallelDevice",
             "ParallelDeviceList",
         }
@@ -115,6 +116,23 @@ class TestParallelAnythingNode:
         out = wrapped(x, jnp.ones((4,)), ctx)
         assert out.shape == (4, 16, 16, 4)
         assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_advanced_node_wires_tp(self):
+        from comfyui_parallelanything_tpu.nodes import ParallelAnythingAdvanced
+
+        cfg = sd15_config(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(1,), transformer_depth=(0, 1), num_heads=4,
+            context_dim=64, norm_groups=8, dtype=jnp.float32,
+        )
+        model = build_unet(cfg, jax.random.key(0), sample_shape=(1, 16, 16, 4))
+        node = ParallelAnythingAdvanced()
+        chain = [
+            {"device": f"cpu:{i}", "percentage": 25.0, "weight": 0.25} for i in range(4)
+        ]
+        (wrapped,) = node.setup_parallel_advanced(model, chain, tensor_parallel=2)
+        assert isinstance(wrapped, ParallelModel)
+        assert wrapped._groups[0].mesh.shape == {"data": 2, "model": 2}
 
     def test_unusable_chain_returns_model_unchanged(self):
         cfg = sd15_config(
