@@ -9,12 +9,23 @@ from .flux import (
     build_flux,
 )
 from .wan import WanModel, WanConfig, wan_1_3b_config, wan_14b_config, build_wan
+from .vae import (
+    VAE,
+    VAEConfig,
+    AutoencoderKL,
+    sd_vae_config,
+    sdxl_vae_config,
+    flux_vae_config,
+    build_vae,
+)
 from .convert import bake_lora, convert_flux_checkpoint
+from .convert_vae import convert_vae_checkpoint, strip_vae_prefix
 from .convert_unet import convert_sd_unet_checkpoint, strip_prefix
 from .loader import (
     load_safetensors,
     load_flux_checkpoint,
     load_sd_unet_checkpoint,
+    load_vae_checkpoint,
     load_wan_checkpoint,
 )
 from .checkpoint import save_params, load_params
@@ -39,13 +50,23 @@ __all__ = [
     "wan_1_3b_config",
     "wan_14b_config",
     "build_wan",
+    "VAE",
+    "VAEConfig",
+    "AutoencoderKL",
+    "sd_vae_config",
+    "sdxl_vae_config",
+    "flux_vae_config",
+    "build_vae",
     "bake_lora",
     "convert_flux_checkpoint",
+    "convert_vae_checkpoint",
+    "strip_vae_prefix",
     "convert_sd_unet_checkpoint",
     "strip_prefix",
     "load_safetensors",
     "load_flux_checkpoint",
     "load_sd_unet_checkpoint",
+    "load_vae_checkpoint",
     "load_wan_checkpoint",
     "save_params",
     "load_params",

@@ -1,0 +1,166 @@
+"""AutoencoderKL checkpoint (ldm/ComfyUI/FLUX layout) → models/vae.py param tree.
+
+Covers the ``first_stage_model.*`` subtree of a ComfyUI full checkpoint, standalone
+``vae.safetensors`` files (same names, no prefix), and FLUX ``ae.safetensors``
+(identical module names, no quant convs, z=16). The reference leaves the VAE to its
+host app entirely (it only ever touches the bare diffusion model,
+any_device_parallel.py:921-930); this converter is part of making the TPU framework
+standalone. Conversion conventions match convert.py: fp8/bf16/fp16 upcast to f32,
+torch OIHW conv weights → flax HWIO, rank-2 attention projections (diffusers-style
+exports) accepted next to the ldm rank-4 1×1 convs.
+
+ldm → here structural map (module names in models/vae.py are explicit, so the flax
+tree mirrors these directly):
+
+- ``{enc,dec}oder.conv_in/conv_out/norm_out``       → same names
+- ``encoder.down.{l}.block.{i}.*``                  → ``encoder/down_{l}_block_{i}``
+- ``encoder.down.{l}.downsample.conv``              → ``encoder/down_{l}_downsample``
+- ``{enc,dec}oder.mid.block_{1,2}``, ``mid.attn_1`` → ``mid_block_{1,2}``, ``mid_attn_1``
+- ``decoder.up.{l}.block.{i}`` / ``up.{l}.upsample``→ ``decoder/up_{l}_block_{i}`` /
+  ``decoder/up_{l}_upsample`` (ldm's ``up`` list is indexed by resolution level,
+  executed high→low, same as models/vae.py's reversed loop)
+- ``quant_conv`` / ``post_quant_conv``              → same names (when cfg.use_quant_conv)
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+
+from .convert import conv_kernel, to_numpy, tree_to_jnp
+from .vae import VAEConfig
+
+
+def _conv(sd: Mapping[str, Any], key: str) -> dict:
+    out = {"kernel": conv_kernel(sd[f"{key}.weight"])}
+    if f"{key}.bias" in sd:
+        out["bias"] = to_numpy(sd[f"{key}.bias"])
+    return out
+
+
+def _attn_proj(sd: Mapping[str, Any], key: str) -> dict:
+    """attn_1 q/k/v/proj_out: 1×1 conv (ldm, rank-4) or linear (diffusers-style
+    rank-2). The module is a 1×1 Conv either way."""
+    w = to_numpy(sd[f"{key}.weight"])
+    if w.ndim == 4:
+        kernel = conv_kernel(w)
+    else:
+        kernel = w.T[None, None]  # (out,in) -> (1,1,in,out)
+    out = {"kernel": kernel}
+    if f"{key}.bias" in sd:
+        out["bias"] = to_numpy(sd[f"{key}.bias"])
+    return out
+
+
+def _norm(sd: Mapping[str, Any], key: str) -> dict:
+    return {"scale": to_numpy(sd[f"{key}.weight"]), "bias": to_numpy(sd[f"{key}.bias"])}
+
+
+def _res_block(sd: Mapping[str, Any], t: str) -> dict:
+    p = {
+        "norm1": _norm(sd, f"{t}.norm1"),
+        "conv1": _conv(sd, f"{t}.conv1"),
+        "norm2": _norm(sd, f"{t}.norm2"),
+        "conv2": _conv(sd, f"{t}.conv2"),
+    }
+    if f"{t}.nin_shortcut.weight" in sd:
+        p["nin_shortcut"] = _conv(sd, f"{t}.nin_shortcut")
+    return p
+
+
+def _attn_block(sd: Mapping[str, Any], t: str) -> dict:
+    return {
+        "norm": _norm(sd, f"{t}.norm"),
+        "q": _attn_proj(sd, f"{t}.q"),
+        "k": _attn_proj(sd, f"{t}.k"),
+        "v": _attn_proj(sd, f"{t}.v"),
+        "proj_out": _attn_proj(sd, f"{t}.proj_out"),
+    }
+
+
+def strip_vae_prefix(state_dict: Mapping[str, Any]) -> dict:
+    """Select the VAE subtree of a combined checkpoint. Recognizes the ComfyUI/ldm
+    ``first_stage_model.`` and diffusers-export ``vae.`` prefixes; a state dict that
+    already starts at ``encoder./decoder.`` passes through unchanged."""
+    for prefix in ("first_stage_model.", "vae."):
+        sub = {
+            k[len(prefix) :]: v for k, v in state_dict.items() if k.startswith(prefix)
+        }
+        if any(k.startswith("decoder.") for k in sub):
+            return sub
+    return dict(state_dict)
+
+
+class _ConsumedRecorder(dict):
+    """Dict view that records which keys the conversion actually read — the complete
+    unconsumed-weights check (a kl-f16-style layout with in-range
+    ``encoder.down.{l}.attn.{i}.*`` keys must fail loudly, not drop weights)."""
+
+    def __init__(self, base: Mapping[str, Any]):
+        super().__init__(base)
+        self.used: set[str] = set()
+
+    def __getitem__(self, key):
+        self.used.add(key)
+        return super().__getitem__(key)
+
+
+def convert_vae_checkpoint(state_dict: Mapping[str, Any], cfg: VAEConfig) -> dict:
+    """ldm-layout AutoencoderKL state dict → the param pytree of
+    ``models.vae.AutoencoderKL`` (pass to ``build_vae(cfg, params=...)``)."""
+    sd = _ConsumedRecorder(strip_vae_prefix(state_dict))
+    n_levels = len(cfg.channel_mult)
+
+    enc: dict[str, Any] = {
+        "conv_in": _conv(sd, "encoder.conv_in"),
+        "mid_block_1": _res_block(sd, "encoder.mid.block_1"),
+        "mid_attn_1": _attn_block(sd, "encoder.mid.attn_1"),
+        "mid_block_2": _res_block(sd, "encoder.mid.block_2"),
+        "norm_out": _norm(sd, "encoder.norm_out"),
+        "conv_out": _conv(sd, "encoder.conv_out"),
+    }
+    for level in range(n_levels):
+        for i in range(cfg.num_res_blocks):
+            enc[f"down_{level}_block_{i}"] = _res_block(
+                sd, f"encoder.down.{level}.block.{i}"
+            )
+        if level != n_levels - 1:
+            enc[f"down_{level}_downsample"] = {
+                "conv": _conv(sd, f"encoder.down.{level}.downsample.conv")
+            }
+
+    dec: dict[str, Any] = {
+        "conv_in": _conv(sd, "decoder.conv_in"),
+        "mid_block_1": _res_block(sd, "decoder.mid.block_1"),
+        "mid_attn_1": _attn_block(sd, "decoder.mid.attn_1"),
+        "mid_block_2": _res_block(sd, "decoder.mid.block_2"),
+        "norm_out": _norm(sd, "decoder.norm_out"),
+        "conv_out": _conv(sd, "decoder.conv_out"),
+    }
+    for level in range(n_levels):
+        for i in range(cfg.num_res_blocks + 1):
+            dec[f"up_{level}_block_{i}"] = _res_block(
+                sd, f"decoder.up.{level}.block.{i}"
+            )
+        if level != 0:
+            dec[f"up_{level}_upsample"] = {
+                "conv": _conv(sd, f"decoder.up.{level}.upsample.conv")
+            }
+
+    p: dict[str, Any] = {"encoder": enc, "decoder": dec}
+    if cfg.use_quant_conv:
+        p["quant_conv"] = _conv(sd, "quant_conv")
+        p["post_quant_conv"] = _conv(sd, "post_quant_conv")
+    # Any VAE-subtree key the walk above never read means the config doesn't match
+    # the checkpoint (wrong channel_mult/num_res_blocks, attn_resolutions variant,
+    # unexpected quant convs) — fail loudly instead of silently dropping weights.
+    # Non-VAE siblings (loss.*, model_ema.*) are fine to ignore.
+    vae_prefixes = ("encoder.", "decoder.", "quant_conv.", "post_quant_conv.")
+    unused = {k for k in sd if k.startswith(vae_prefixes) and k not in sd.used}
+    if unused:
+        raise ValueError(
+            f"{len(unused)} unconverted VAE keys (wrong cfg?): {sorted(unused)[:8]}"
+        )
+    return tree_to_jnp(p)

@@ -94,6 +94,48 @@ def load_sd_unet_checkpoint(
     return build_unet(cfg, name=name, params=convert_sd_unet_checkpoint(sd, cfg))
 
 
+def sniff_vae_config(state_dict: Mapping[str, Any]):
+    """Pick a VAE family config from checkpoint weights: ``flux_vae_config()`` for a
+    16-channel latent, ``sd_vae_config()`` for 4 channels (read off
+    ``decoder.conv_in``, prefixed layouts handled). SD1.5 vs SDXL VAEs are
+    weight-shape identical but need different scaling factors — the 4-channel default
+    warns and SDXL users should pass ``sdxl_vae_config()`` explicitly."""
+    from .convert_vae import strip_vae_prefix
+    from .vae import flux_vae_config, sd_vae_config
+
+    sd = strip_vae_prefix(state_dict)  # single owner of the prefix vocabulary
+    if "decoder.conv_in.weight" not in sd:
+        raise KeyError("decoder.conv_in.weight not found — not an AutoencoderKL dict")
+    conv_in = to_numpy(sd["decoder.conv_in.weight"])
+    z_ch = conv_in.shape[1] if conv_in.ndim == 4 else conv_in.shape[-1]
+    if z_ch == 16:
+        return flux_vae_config()
+    get_logger().warning(
+        "4-channel VAE: defaulting to sd_vae_config() (scaling 0.18215); "
+        "SDXL VAEs are shape-identical but need sdxl_vae_config() "
+        "(scaling 0.13025) — pass cfg= explicitly for SDXL"
+    )
+    return sd_vae_config()
+
+
+def load_vae_checkpoint(
+    src: Any,
+    cfg: "VAEConfig | None" = None,
+):
+    """AutoencoderKL checkpoint → VAE. Accepts a standalone vae/ae.safetensors, a
+    full ComfyUI checkpoint (``first_stage_model.*`` selected automatically), or an
+    in-memory state dict. ``cfg`` defaults via ``sniff_vae_config`` (prefer passing
+    it explicitly for SDXL)."""
+    from .convert_vae import convert_vae_checkpoint
+    from .vae import build_vae
+
+    sd = _resolve_state_dict(src)
+    if cfg is None:
+        cfg = sniff_vae_config(sd)
+    # convert_vae_checkpoint owns the prefix strip — no pre-strip here.
+    return build_vae(cfg, params=convert_vae_checkpoint(sd, cfg))
+
+
 def load_wan_checkpoint(
     src: Any,
     cfg: WanConfig,

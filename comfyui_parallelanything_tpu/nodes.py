@@ -233,7 +233,9 @@ class ParallelAnythingAdvanced(ParallelAnything):
         + " Advanced: FSDP weight sharding and tensor parallelism for models "
         "larger than a single device."
     )
-    FUNCTION = "setup_parallel_advanced"
+    # setup_parallel's **config_extra already routes the extra widgets into
+    # ParallelConfig — no forwarding override needed.
+    FUNCTION = "setup_parallel"
 
     @classmethod
     def INPUT_TYPES(cls):
@@ -255,28 +257,6 @@ class ParallelAnythingAdvanced(ParallelAnything):
             },
         )
         return base
-
-    def setup_parallel_advanced(
-        self,
-        model,
-        parallel_devices,
-        workload_split: bool = True,
-        auto_vram_balance: bool = True,
-        purge_cache: bool = True,
-        purge_models: bool = False,
-        weight_sharding: str = "replicate",
-        tensor_parallel: int = 1,
-    ):
-        return self.setup_parallel(
-            model,
-            parallel_devices,
-            workload_split=workload_split,
-            auto_vram_balance=auto_vram_balance,
-            purge_cache=purge_cache,
-            purge_models=purge_models,
-            weight_sharding=weight_sharding,
-            tensor_parallel=tensor_parallel,
-        )
 
 
 NODE_CLASS_MAPPINGS = {

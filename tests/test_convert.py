@@ -110,15 +110,20 @@ class TestFluxRoundTrip:
             )
 
     def test_converted_params_run_forward(self, tiny):
+        # Both sides run through the SAME jitted program: converted params must be
+        # bitwise substitutes for the originals. (Comparing a jitted forward against
+        # an eager one instead would measure XLA fusion noise amplified through the
+        # random-init blocks — ~2.6e-3 on this tiny config — not converter fidelity.)
         cfg, model = tiny
         sd = _torch_layout_sd(cfg, model.params)
         params = convert_flux_checkpoint(sd, cfg)
         x = jax.random.normal(jax.random.key(1), (1, 8, 8, 4), jnp.float32)
         ctx = jax.random.normal(jax.random.key(2), (1, 8, 16), jnp.float32)
         y = jax.random.normal(jax.random.key(3), (1, 8), jnp.float32)
-        want = model(x, jnp.array([0.5]), ctx, y=y)
-        got = model.apply(params, x, jnp.array([0.5]), ctx, y=y)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+        f = jax.jit(model.apply)
+        want = f(model.params, x, jnp.array([0.5]), ctx, y=y)
+        got = f(params, x, jnp.array([0.5]), ctx, y=y)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
 
 
 def _flatten(tree, prefix=()):

@@ -130,7 +130,9 @@ class TestParallelAnythingNode:
         chain = [
             {"device": f"cpu:{i}", "percentage": 25.0, "weight": 0.25} for i in range(4)
         ]
-        (wrapped,) = node.setup_parallel_advanced(model, chain, tensor_parallel=2)
+        # Invoke through the node protocol (FUNCTION attr), exactly as the host
+        # graph executor does — the advanced widgets flow through **config_extra.
+        (wrapped,) = getattr(node, node.FUNCTION)(model, chain, tensor_parallel=2)
         assert isinstance(wrapped, ParallelModel)
         assert wrapped._groups[0].mesh.shape == {"data": 2, "model": 2}
 
