@@ -136,6 +136,34 @@ def load_vae_checkpoint(
     return build_vae(cfg, params=convert_vae_checkpoint(sd, cfg))
 
 
+def load_clip_text_checkpoint(src: Any, cfg=None, open_clip: bool = False):
+    """CLIP text tower checkpoint → TextEncoder. ``open_clip=True`` selects the
+    OpenCLIP resblocks layout (SDXL's second encoder); default is the HF
+    ``text_model.*`` layout (SD1.5 / SDXL first encoder / FLUX clip_l)."""
+    from .convert_text import (
+        convert_clip_text_checkpoint,
+        convert_open_clip_checkpoint,
+    )
+    from .text_encoders import build_clip_text, clip_l_config, open_clip_g_config
+
+    sd = _resolve_state_dict(src)
+    if cfg is None:
+        cfg = open_clip_g_config() if open_clip else clip_l_config()
+    convert = convert_open_clip_checkpoint if open_clip else convert_clip_text_checkpoint
+    return build_clip_text(cfg, params=convert(sd, cfg))
+
+
+def load_t5_checkpoint(src: Any, cfg=None):
+    """T5 encoder checkpoint (HF layout) → TextEncoder (FLUX/WAN t5xxl)."""
+    from .convert_text import convert_t5_checkpoint
+    from .text_encoders import build_t5_encoder, t5_xxl_config
+
+    sd = _resolve_state_dict(src)
+    if cfg is None:
+        cfg = t5_xxl_config()
+    return build_t5_encoder(cfg, params=convert_t5_checkpoint(sd, cfg))
+
+
 def load_wan_checkpoint(
     src: Any,
     cfg: WanConfig,

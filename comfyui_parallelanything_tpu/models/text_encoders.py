@@ -1,0 +1,315 @@
+"""Text encoders (CLIP-L / OpenCLIP-G / T5) — flax.linen, TPU-first.
+
+The reference receives ready-made conditioning tensors from its host app (its
+forward convention is ``forward(x, timesteps, context, **kwargs)`` with ``context``
+already encoded, any_device_parallel.py:1287); standalone, this framework encodes
+prompts itself. These are fresh implementations of the three encoder families the
+supported checkpoints condition on:
+
+- **CLIP-L** (SD1.5 context; SDXL & FLUX pooled vector): 12-layer pre-LN causal
+  transformer, quick-gelu, 77-token window.
+- **OpenCLIP-G** (SDXL context + pooled): 32-layer, gelu, penultimate-layer output.
+- **T5 encoder** (FLUX/WAN context): RMSNorm, relative-position-bucket attention
+  bias, gated-gelu FFN, bidirectional.
+
+All take int32 token ids — tokenization is in utils/tokenizer.py (BPE/unigram
+tables load from user-supplied files; this image ships none and has no egress).
+Sequence lengths are static per call site (77 / 256 / 512), so every encode is a
+single fixed-shape XLA program; attention masks are additive f32 biases fused into
+the softmax, and matmuls run in the config compute dtype (bf16 on TPU) with f32
+softmax/normalization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# CLIP text towers
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_len: int = 77
+    intermediate_size: int | None = None  # default 4*hidden
+    act: str = "quick_gelu"  # "quick_gelu" (CLIP-L) | "gelu" (OpenCLIP-G)
+    eos_id: int = 49407
+    projection_dim: int | None = None  # text_projection for pooled (OpenCLIP / SDXL)
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def d_ff(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+def clip_l_config(**overrides) -> CLIPTextConfig:
+    """OpenAI CLIP ViT-L/14 text tower (SD1.5 context encoder; SDXL/FLUX 'clip_l')."""
+    return dataclasses.replace(CLIPTextConfig(), **overrides)
+
+
+def open_clip_g_config(**overrides) -> CLIPTextConfig:
+    """OpenCLIP bigG/14 text tower (SDXL's second encoder)."""
+    base = CLIPTextConfig(
+        hidden_size=1280,
+        num_layers=32,
+        num_heads=20,
+        act="gelu",
+        projection_dim=1280,
+    )
+    return dataclasses.replace(base, **overrides)
+
+
+def _act(name: str):
+    if name == "quick_gelu":
+        return lambda x: x * nn.sigmoid(1.702 * x)
+    if name == "gelu":
+        return lambda x: nn.gelu(x, approximate=False)  # HF/OpenCLIP "gelu" is exact erf
+    raise ValueError(f"unknown activation {name!r}")
+
+
+class _CLIPBlock(nn.Module):
+    cfg: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, x, bias):
+        cfg = self.cfg
+        H = cfg.num_heads
+        D = cfg.hidden_size // H
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln1")(x)
+        qkv = {
+            n: nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name=n)(h) for n in "qkv"
+        }
+        B, S, _ = h.shape
+        q, k, v = (qkv[n].reshape(B, S, H, D) for n in "qkv")
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D**-0.5)
+        probs = jax.nn.softmax(logits.astype(jnp.float32) + bias, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+        x = x + nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="out")(
+            attn.reshape(B, S, cfg.hidden_size)
+        )
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="fc1")(h)
+        h = _act(self.cfg.act)(h)
+        return x + nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="fc2")(h)
+
+
+class CLIPTextModel(nn.Module):
+    """Returns (last_hidden, penultimate_hidden, pooled). ``last_hidden`` has the
+    final LayerNorm applied; ``penultimate_hidden`` is the raw layer-(N-1) stream
+    (SDXL consumes exactly that, un-normed). ``pooled`` reads the first-EOS position
+    of the final-LN stream, projected when cfg.projection_dim is set."""
+
+    cfg: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        B, S = tokens.shape
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="tok_emb")(
+            tokens
+        )
+        pos = self.param(
+            "pos_emb", nn.initializers.normal(0.01), (cfg.max_len, cfg.hidden_size)
+        )
+        x = x + pos[None, :S].astype(cfg.dtype)
+        causal = jnp.where(
+            jnp.tril(jnp.ones((S, S), bool)), 0.0, -jnp.inf
+        ).astype(jnp.float32)[None, None]
+        penultimate = None
+        for i in range(cfg.num_layers):
+            if i == cfg.num_layers - 1:
+                penultimate = x
+            x = _CLIPBlock(cfg, name=f"layers_{i}")(x, causal)
+        last = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="final_ln")(x)
+        eos_pos = jnp.argmax((tokens == cfg.eos_id).astype(jnp.int32), axis=-1)
+        pooled = jnp.take_along_axis(last, eos_pos[:, None, None], axis=1)[:, 0]
+        if cfg.projection_dim is not None:
+            pooled = nn.Dense(
+                cfg.projection_dim, use_bias=False, dtype=cfg.dtype, name="text_proj"
+            )(pooled)
+        return last, penultimate, pooled
+
+
+# ---------------------------------------------------------------------------
+# T5 encoder
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 4096
+    num_layers: int = 24
+    num_heads: int = 64
+    d_kv: int = 64
+    d_ff: int = 10240
+    relative_buckets: int = 32
+    relative_max_distance: int = 128
+    dtype: Any = jnp.bfloat16
+
+
+def t5_xxl_config(**overrides) -> T5Config:
+    """google/t5-v1_1-xxl encoder — the FLUX 't5xxl' conditioning tower."""
+    return dataclasses.replace(T5Config(), **overrides)
+
+
+def _t5_relative_buckets(rel_pos, num_buckets: int, max_distance: int):
+    """Bidirectional T5 bucket scheme: sign split, then exact small distances,
+    log-spaced large ones."""
+    num_buckets //= 2
+    ret = jnp.where(rel_pos > 0, num_buckets, 0)
+    n = jnp.abs(rel_pos)
+    max_exact = num_buckets // 2
+    large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return ret + jnp.where(n < max_exact, n, large)
+
+
+class _T5RMSNorm(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+class _T5Block(nn.Module):
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, x, bias):
+        cfg = self.cfg
+        H, D = cfg.num_heads, cfg.d_kv
+        inner = H * D
+        h = _T5RMSNorm(name="ln1")(x)
+        q = nn.Dense(inner, use_bias=False, dtype=cfg.dtype, name="q")(h)
+        k = nn.Dense(inner, use_bias=False, dtype=cfg.dtype, name="k")(h)
+        v = nn.Dense(inner, use_bias=False, dtype=cfg.dtype, name="v")(h)
+        B, S, _ = h.shape
+        q, k, v = (t.reshape(B, S, H, D) for t in (q, k, v))
+        # T5 uses unscaled dot products (the 1/sqrt(d) is folded into init).
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) + bias
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, inner)
+        x = x + nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype, name="o")(attn)
+        h = _T5RMSNorm(name="ln2")(x)
+        wi0 = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype, name="wi_0")(h)
+        wi1 = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype, name="wi_1")(h)
+        h = nn.gelu(wi0, approximate=True) * wi1
+        return x + nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype, name="wo")(h)
+
+
+class T5Encoder(nn.Module):
+    """Bidirectional T5 v1.1 encoder stack; returns the final RMS-normed stream.
+    The relative-position bias table lives on layer 0 and is shared by all layers
+    (T5 convention); ``mask`` (B, S) of 0/1 marks real tokens."""
+
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, tokens, mask=None):
+        cfg = self.cfg
+        B, S = tokens.shape
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="tok_emb")(
+            tokens
+        )
+        pos = jnp.arange(S)
+        buckets = _t5_relative_buckets(
+            pos[None, :] - pos[:, None],
+            cfg.relative_buckets,
+            cfg.relative_max_distance,
+        )
+        bias_table = self.param(
+            "rel_bias",
+            nn.initializers.normal(1.0),
+            (cfg.relative_buckets, cfg.num_heads),
+        )
+        bias = bias_table[buckets].transpose(2, 0, 1)[None].astype(jnp.float32)
+        if mask is not None:
+            bias = bias + jnp.where(mask[:, None, None, :] > 0, 0.0, -jnp.inf)
+        for i in range(cfg.num_layers):
+            x = _T5Block(cfg, name=f"blocks_{i}")(x, bias)
+        return _T5RMSNorm(name="final_ln")(x)
+
+
+# ---------------------------------------------------------------------------
+# Builders (mirror build_flux/build_unet: params= skips init)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TextEncoder:
+    """Encoder as data: jit-cached apply + weights (same shape as DiffusionModel)."""
+
+    module: Any
+    cfg: Any
+    params: Any
+
+    def _jitted(self):
+        if not hasattr(self, "_jit_cache"):
+            fn = jax.jit(
+                lambda p, *a, **kw: self.module.apply({"params": p}, *a, **kw)
+            )
+            object.__setattr__(self, "_jit_cache", fn)
+        return self._jit_cache
+
+    def __call__(self, tokens, **kw):
+        return self._jitted()(self.params, tokens, **kw)
+
+
+def build_clip_text(cfg: CLIPTextConfig, rng=None, params=None) -> TextEncoder:
+    module = CLIPTextModel(cfg)
+    if params is None:
+        if rng is None:
+            raise ValueError("need rng to initialize (or pass params=)")
+        params = module.init(rng, jnp.zeros((1, cfg.max_len), jnp.int32))["params"]
+    return TextEncoder(module=module, cfg=cfg, params=params)
+
+
+def build_t5_encoder(cfg: T5Config, rng=None, params=None, sample_len=64) -> TextEncoder:
+    module = T5Encoder(cfg)
+    if params is None:
+        if rng is None:
+            raise ValueError("need rng to initialize (or pass params=)")
+        params = module.init(rng, jnp.zeros((1, sample_len), jnp.int32))["params"]
+    return TextEncoder(module=module, cfg=cfg, params=params)
+
+
+def sdxl_text_conditioning(
+    l_penultimate, g_penultimate, g_pooled, width: int, height: int,
+    crop_x: int = 0, crop_y: int = 0, target_width: int | None = None,
+    target_height: int | None = None,
+):
+    """Assemble SDXL's (context, y) pair: context = CLIP-L ⊕ OpenCLIP-G penultimate
+    streams (…, 768+1280=2048); y = G pooled (1280) ⊕ six sinusoidal size/crop
+    embeddings (256 each → 2816 = the UNet's adm_in_channels)."""
+    from ..ops.basic import timestep_embedding
+
+    context = jnp.concatenate(
+        [l_penultimate.astype(jnp.float32), g_penultimate.astype(jnp.float32)], axis=-1
+    )
+    B = g_pooled.shape[0]
+    sizes = [
+        height, width, crop_y, crop_x,
+        target_height or height, target_width or width,
+    ]
+    embs = [
+        timestep_embedding(jnp.full((B,), float(s), jnp.float32), 256) for s in sizes
+    ]
+    y = jnp.concatenate([g_pooled.astype(jnp.float32)] + embs, axis=-1)
+    return context, y

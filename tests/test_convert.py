@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tree_utils import flatten_tree
+
 from comfyui_parallelanything_tpu.models.convert import (
     bake_lora,
     convert_flux_checkpoint,
@@ -101,8 +103,8 @@ class TestFluxRoundTrip:
         sd = _torch_layout_sd(cfg, model.params)
         got = convert_flux_checkpoint(sd, cfg)
         assert sorted(_tree_paths(got)) == sorted(_tree_paths(model.params))
-        flat_got = dict(_flatten(got))
-        flat_want = dict(_flatten(model.params))
+        flat_got = dict(flatten_tree(got))
+        flat_want = dict(flatten_tree(model.params))
         for k in flat_want:
             np.testing.assert_allclose(
                 flat_got[k], np.asarray(flat_want[k]), rtol=1e-6, atol=1e-6,
@@ -125,13 +127,6 @@ class TestFluxRoundTrip:
         got = f(params, x, jnp.array([0.5]), ctx, y=y)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
 
-
-def _flatten(tree, prefix=()):
-    if isinstance(tree, dict):
-        for k, v in tree.items():
-            yield from _flatten(v, prefix + (k,))
-    else:
-        yield prefix, np.asarray(tree)
 
 
 class TestLoRABaking:

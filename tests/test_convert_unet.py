@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tree_utils import flatten_tree
+
 from comfyui_parallelanything_tpu.models.convert_unet import (
     convert_sd_unet_checkpoint,
     strip_prefix,
@@ -170,16 +172,9 @@ def _ldm_sd(cfg: UNetConfig, params) -> dict:
     return sd
 
 
-def _flatten(tree, prefix=()):
-    if isinstance(tree, dict):
-        for k, v in tree.items():
-            yield from _flatten(v, prefix + (k,))
-    else:
-        yield prefix, np.asarray(tree)
-
 
 def _assert_trees_equal(got, want):
-    fg, fw = dict(_flatten(got)), dict(_flatten(want))
+    fg, fw = dict(flatten_tree(got)), dict(flatten_tree(want))
     assert sorted(fg) == sorted(fw), (
         f"missing: {sorted(set(fw) - set(fg))[:5]} extra: {sorted(set(fg) - set(fw))[:5]}"
     )

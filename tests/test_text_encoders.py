@@ -1,0 +1,254 @@
+"""Text encoders: golden parity vs the canonical torch implementations.
+
+torch + transformers are CPU-importable here, so CLIP and T5 are checked against
+randomly-initialized `transformers` models directly: export the torch state dict,
+convert with models/convert_text.py, run both, compare activations. This is a much
+stronger check than round-trip inversion — it validates the architecture itself
+(pre-LN order, quick-gelu, T5 bucket scheme, unscaled T5 dot products), not just
+the layout transposes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tree_utils import flatten_tree
+
+from comfyui_parallelanything_tpu.models.convert_text import (
+    convert_clip_text_checkpoint,
+    convert_open_clip_checkpoint,
+    convert_t5_checkpoint,
+)
+from comfyui_parallelanything_tpu.models.text_encoders import (
+    CLIPTextConfig,
+    T5Config,
+    build_clip_text,
+    build_t5_encoder,
+    clip_l_config,
+    open_clip_g_config,
+    sdxl_text_conditioning,
+    t5_xxl_config,
+)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+# eos = vocab-1 like the real tower (49407/49408). Keeping eos_token_id != 2 also
+# steers transformers off its legacy pooling path (argmax of raw ids) onto the
+# first-EOS-position rule this implementation uses.
+TINY_CLIP = CLIPTextConfig(
+    vocab_size=100,
+    hidden_size=64,
+    num_layers=2,
+    num_heads=4,
+    max_len=16,
+    eos_id=99,
+    dtype=jnp.float32,
+)
+
+
+def _hf_clip(cfg: CLIPTextConfig, act: str):
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.d_ff,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        max_position_embeddings=cfg.max_len,
+        hidden_act=act,
+        eos_token_id=cfg.eos_id,
+        bos_token_id=0,
+        pad_token_id=1,
+    )
+    torch.manual_seed(0)
+    return transformers.CLIPTextModel(hf_cfg).eval()
+
+
+class TestCLIPGolden:
+    @pytest.mark.parametrize("act", ["quick_gelu", "gelu"])
+    def test_matches_transformers(self, act):
+        import dataclasses
+
+        cfg = dataclasses.replace(TINY_CLIP, act=act)
+        hf = _hf_clip(cfg, act)
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        params = convert_clip_text_checkpoint(sd, cfg)
+        enc = build_clip_text(cfg, params=params)
+
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(3, cfg.vocab_size - 1, (2, cfg.max_len))
+        tokens[:, -3] = cfg.eos_id  # EOS mid-sequence exercises the pool index
+        with torch.no_grad():
+            out = hf(torch.from_numpy(tokens))
+        last, penultimate, pooled = enc(jnp.asarray(tokens, jnp.int32))
+        np.testing.assert_allclose(
+            np.asarray(last), out.last_hidden_state.numpy(), rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(pooled), out.pooler_output.numpy(), rtol=2e-4, atol=2e-4
+        )
+        assert penultimate.shape == last.shape
+
+    def test_wrapped_prefix_conversion(self):
+        # SD checkpoints wrap the tower under cond_stage_model.transformer.*
+        hf = _hf_clip(TINY_CLIP, "quick_gelu")
+        sd = {
+            f"cond_stage_model.transformer.{k}": v.detach().numpy()
+            for k, v in hf.state_dict().items()
+        }
+        params = convert_clip_text_checkpoint(sd, TINY_CLIP)
+        enc = build_clip_text(TINY_CLIP, params=params)
+        tokens = jnp.full((1, TINY_CLIP.max_len), 5, jnp.int32)
+        last, _, _ = enc(tokens)
+        assert last.shape == (1, TINY_CLIP.max_len, TINY_CLIP.hidden_size)
+
+
+class TestOpenCLIPConversion:
+    def test_fused_qkv_roundtrip(self):
+        """Synthesize an OpenCLIP-layout dict (fused in_proj, raw text_projection
+        matrix) from known per-head weights and check the split lands correctly."""
+        import dataclasses
+
+        cfg = dataclasses.replace(TINY_CLIP, act="gelu", projection_dim=32)
+        enc = build_clip_text(cfg, rng=jax.random.key(0))
+        p = enc.params
+        sd = self._openclip_layout(cfg, p)
+        got = convert_open_clip_checkpoint(sd, cfg)
+        fg, fw = dict(flatten_tree(got)), dict(flatten_tree(p))
+        assert sorted(fg) == sorted(fw)
+        for k in fw:
+            np.testing.assert_array_equal(fg[k], fw[k], err_msg=str(k))
+
+    def test_combined_sdxl_checkpoint_selects_openclip_tower(self):
+        """A single-file SDXL checkpoint holds BOTH towers: the HF CLIP-L under
+        conditioner.embedders.0.transformer.* and OpenCLIP-G under
+        conditioner.embedders.1.model.*. The converter must anchor on the OpenCLIP
+        subtree even though the HF tower also contains token_embedding.weight."""
+        import dataclasses
+
+        cfg = dataclasses.replace(TINY_CLIP, act="gelu", projection_dim=32)
+        enc = build_clip_text(cfg, rng=jax.random.key(2))
+        flat_sd = self._openclip_layout(cfg, enc.params)
+        combined = {
+            # Decoy HF tower key that sorts/iterates first:
+            "conditioner.embedders.0.transformer.text_model.embeddings."
+            "token_embedding.weight": np.zeros((100, 64), np.float32),
+        }
+        combined.update(
+            {f"conditioner.embedders.1.model.{k}": v for k, v in flat_sd.items()}
+        )
+        got = convert_open_clip_checkpoint(combined, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(got["tok_emb"]["embedding"]),
+            np.asarray(enc.params["tok_emb"]["embedding"]),
+        )
+
+    @staticmethod
+    def _openclip_layout(cfg, p):
+        sd = {
+            "token_embedding.weight": np.asarray(p["tok_emb"]["embedding"]),
+            "positional_embedding": np.asarray(p["pos_emb"]),
+            "ln_final.weight": np.asarray(p["final_ln"]["scale"]),
+            "ln_final.bias": np.asarray(p["final_ln"]["bias"]),
+            "text_projection": np.asarray(p["text_proj"]["kernel"]),
+        }
+        for i in range(cfg.num_layers):
+            blk = p[f"layers_{i}"]
+            t = f"transformer.resblocks.{i}"
+            sd[f"{t}.attn.in_proj_weight"] = np.concatenate(
+                [np.asarray(blk[n]["kernel"]).T for n in "qkv"], axis=0
+            )
+            sd[f"{t}.attn.in_proj_bias"] = np.concatenate(
+                [np.asarray(blk[n]["bias"]) for n in "qkv"]
+            )
+            sd[f"{t}.attn.out_proj.weight"] = np.asarray(blk["out"]["kernel"]).T
+            sd[f"{t}.attn.out_proj.bias"] = np.asarray(blk["out"]["bias"])
+            sd[f"{t}.mlp.c_fc.weight"] = np.asarray(blk["fc1"]["kernel"]).T
+            sd[f"{t}.mlp.c_fc.bias"] = np.asarray(blk["fc1"]["bias"])
+            sd[f"{t}.mlp.c_proj.weight"] = np.asarray(blk["fc2"]["kernel"]).T
+            sd[f"{t}.mlp.c_proj.bias"] = np.asarray(blk["fc2"]["bias"])
+            sd[f"{t}.ln_1.weight"] = np.asarray(blk["ln1"]["scale"])
+            sd[f"{t}.ln_1.bias"] = np.asarray(blk["ln1"]["bias"])
+            sd[f"{t}.ln_2.weight"] = np.asarray(blk["ln2"]["scale"])
+            sd[f"{t}.ln_2.bias"] = np.asarray(blk["ln2"]["bias"])
+        return sd
+
+    def test_sdxl_wrapper_prefix(self):
+        cfg = open_clip_g_config(
+            vocab_size=100, hidden_size=64, num_layers=2, num_heads=4,
+            max_len=16, projection_dim=32, dtype=jnp.float32,
+        )
+        enc = build_clip_text(cfg, rng=jax.random.key(1))
+        # Minimal prefixed dict: only check the prefix detection path raises no
+        # KeyError on the anchor, then fails on a genuinely absent layer key.
+        sd = {
+            "conditioner.embedders.1.model.token_embedding.weight": np.zeros(
+                (100, 64), np.float32
+            )
+        }
+        with pytest.raises(KeyError):
+            convert_open_clip_checkpoint(sd, cfg)
+
+
+TINY_T5 = T5Config(
+    vocab_size=100,
+    d_model=64,
+    num_layers=2,
+    num_heads=4,
+    d_kv=16,
+    d_ff=128,
+    dtype=jnp.float32,
+)
+
+
+class TestT5Golden:
+    def test_matches_transformers(self):
+        hf_cfg = transformers.T5Config(
+            vocab_size=TINY_T5.vocab_size,
+            d_model=TINY_T5.d_model,
+            d_kv=TINY_T5.d_kv,
+            d_ff=TINY_T5.d_ff,
+            num_layers=TINY_T5.num_layers,
+            num_heads=TINY_T5.num_heads,
+            relative_attention_num_buckets=TINY_T5.relative_buckets,
+            relative_attention_max_distance=TINY_T5.relative_max_distance,
+            feed_forward_proj="gated-gelu",
+            dropout_rate=0.0,
+        )
+        torch.manual_seed(0)
+        hf = transformers.T5EncoderModel(hf_cfg).eval()
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        params = convert_t5_checkpoint(sd, TINY_T5)
+        enc = build_t5_encoder(TINY_T5, params=params)
+
+        rng = np.random.default_rng(2)
+        tokens = rng.integers(0, TINY_T5.vocab_size, (2, 24))
+        mask = np.ones((2, 24), np.int32)
+        mask[1, 16:] = 0  # padded second row exercises the bias mask
+        with torch.no_grad():
+            want = hf(
+                torch.from_numpy(tokens), attention_mask=torch.from_numpy(mask)
+            ).last_hidden_state.numpy()
+        got = np.asarray(enc(jnp.asarray(tokens, jnp.int32), mask=jnp.asarray(mask)))
+        # Padded positions produce garbage in both frameworks (masked as keys only);
+        # compare real tokens.
+        np.testing.assert_allclose(got[0], want[0], rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(got[1, :16], want[1, :16], rtol=2e-4, atol=2e-4)
+
+    def test_full_size_config_constants(self):
+        cfg = t5_xxl_config()
+        assert (cfg.d_model, cfg.num_layers, cfg.num_heads, cfg.d_ff) == (
+            4096, 24, 64, 10240,
+        )
+
+
+class TestSDXLConditioning:
+    def test_shapes(self):
+        B, S = 2, 16
+        l_pen = jnp.zeros((B, S, 768))
+        g_pen = jnp.zeros((B, S, 1280))
+        g_pool = jnp.zeros((B, 1280))
+        ctx, y = sdxl_text_conditioning(l_pen, g_pen, g_pool, 1024, 1024)
+        assert ctx.shape == (B, S, 2048)
+        assert y.shape == (B, 2816)  # matches sdxl_config().adm_in_channels

@@ -1,0 +1,93 @@
+"""CLIP byte-BPE tokenizer: merge order, framing/padding, byte fallback."""
+
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.utils.tokenizer import (
+    CLIPBPETokenizer,
+    _bytes_to_unicode,
+)
+
+
+def _tiny_tokenizer(**kw):
+    """Hand-built vocab: single chars + a few merges, so expected BPE output is
+    derivable by hand."""
+    alphabet = [
+        "a", "b", "c", "d", "e", "h", "l", "o", "r", "w",
+        "a</w>", "b</w>", "c</w>", "d</w>", "e</w>", "h</w>", "l</w>", "o</w>",
+        "r</w>", "w</w>", "1</w>", "!</w>",
+    ]
+    merges = [
+        ("h", "e"), ("l", "l"), ("he", "ll"), ("hell", "o</w>"),  # hello
+        ("w", "o"), ("r", "l"), ("wo", "rl"), ("worl", "d</w>"),  # world
+    ]
+    vocab = {tok: i for i, tok in enumerate(alphabet)}
+    for a, b in merges:
+        vocab[a + b] = len(vocab)
+    vocab["<|startoftext|>"] = len(vocab)
+    vocab["<|endoftext|>"] = len(vocab)
+    return CLIPBPETokenizer(vocab, merges, max_len=8, **kw)
+
+
+class TestBPE:
+    def test_merges_apply_in_rank_order(self):
+        tok = _tiny_tokenizer()
+        assert tok.encode("hello") == [tok.vocab["hello</w>"]]
+        assert tok.encode("world") == [tok.vocab["world</w>"]]
+        # Unmergeable word falls back to char pieces that exist in the vocab.
+        assert tok.encode("be") == [tok.vocab["b"], tok.vocab["e</w>"]]
+
+    def test_lowercase_and_whitespace_normalization(self):
+        tok = _tiny_tokenizer()
+        assert tok.encode("  HeLLo   WORLD ") == tok.encode("hello world")
+
+    def test_framing_padding_mask(self):
+        tok = _tiny_tokenizer()
+        ids, mask = tok(["hello world"])
+        assert ids.shape == (1, 8)
+        expect = [
+            tok.bos_id, tok.vocab["hello</w>"], tok.vocab["world</w>"], tok.eos_id,
+        ]
+        assert ids[0, :4].tolist() == expect
+        # CLIP-L convention: pad with EOS.
+        assert (ids[0, 4:] == tok.eos_id).all()
+        assert mask[0].tolist() == [1, 1, 1, 1, 0, 0, 0, 0]
+
+    def test_zero_padding_variant(self):
+        # OpenCLIP-G pads with 0 instead of EOS.
+        tok = _tiny_tokenizer(pad_id=0)
+        ids, _ = tok("hello")
+        assert ids[0, 3:].tolist() == [0] * 5
+
+    def test_truncation_keeps_eos(self):
+        tok = _tiny_tokenizer()
+        ids, mask = tok("hello world hello world hello world hello world")
+        assert ids.shape == (1, 8)
+        assert ids[0, 0] == tok.bos_id
+        assert ids[0, -1] == tok.eos_id
+        assert mask[0].sum() == 8
+
+    def test_bytes_to_unicode_reversible(self):
+        m = _bytes_to_unicode()
+        assert len(m) == 256
+        assert len(set(m.values())) == 256
+
+
+class TestJsonTokenizer:
+    def test_loads_hf_tokenizer_json(self, tmp_path):
+        tokenizers = pytest.importorskip("tokenizers")
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        vocab = {"[UNK]": 0, "hello": 1, "world": 2}
+        t = tokenizers.Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
+        t.pre_tokenizer = Whitespace()
+        path = tmp_path / "tokenizer.json"
+        t.save(str(path))
+
+        from comfyui_parallelanything_tpu.utils.tokenizer import load_tokenizer_json
+
+        tok = load_tokenizer_json(path, max_len=6, eos_id=5)
+        ids, mask = tok(["hello world"])
+        assert ids[0].tolist() == [1, 2, 5, 0, 0, 0]  # T5-style appended EOS
+        assert mask[0].tolist() == [1, 1, 1, 0, 0, 0]

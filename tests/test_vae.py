@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tree_utils import flatten_tree
+
 from comfyui_parallelanything_tpu.models.convert_vae import (
     convert_vae_checkpoint,
     strip_vae_prefix,
@@ -103,13 +105,6 @@ def _ldm_layout_sd(cfg: VAEConfig, params) -> dict:
     return sd
 
 
-def _flatten(tree, prefix=()):
-    if isinstance(tree, dict):
-        for k, v in tree.items():
-            yield from _flatten(v, prefix + (k,))
-    else:
-        yield prefix, np.asarray(tree)
-
 
 class TestShapes:
     def test_encode_decode_shapes(self, tiny_vae):
@@ -175,8 +170,8 @@ class TestConverterRoundTrip:
     def test_bitwise_roundtrip(self, tiny_vae):
         sd = _ldm_layout_sd(TINY, tiny_vae.params)
         got = convert_vae_checkpoint(sd, TINY)
-        flat_got = dict(_flatten(got))
-        flat_want = dict(_flatten(tiny_vae.params))
+        flat_got = dict(flatten_tree(got))
+        flat_want = dict(flatten_tree(tiny_vae.params))
         assert sorted(flat_got) == sorted(flat_want)
         for k in flat_want:
             np.testing.assert_array_equal(flat_got[k], flat_want[k], err_msg=str(k))

@@ -1,0 +1,12 @@
+"""Shared test helpers for param-pytree comparisons."""
+
+import numpy as np
+
+
+def flatten_tree(tree, prefix=()):
+    """Nested dict → ((path, np.ndarray), ...) pairs, depth-first."""
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from flatten_tree(v, prefix + (k,))
+    else:
+        yield prefix, np.asarray(tree)
