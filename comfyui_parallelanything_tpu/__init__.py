@@ -44,6 +44,7 @@ from .parallel.split import (
 from .parallel.mesh import build_mesh, mesh_axis_names
 from .parallel.orchestrator import parallelize, ParallelConfig, ParallelModel
 from .parallel.sequence import sequence_parallel_attention
+from .pipelines import StableDiffusionPipeline, FluxPipeline
 from .utils.metrics import StepTimer, trace
 
 __all__ = [
@@ -71,6 +72,8 @@ __all__ = [
     "ParallelConfig",
     "ParallelModel",
     "sequence_parallel_attention",
+    "StableDiffusionPipeline",
+    "FluxPipeline",
     "StepTimer",
     "trace",
 ]

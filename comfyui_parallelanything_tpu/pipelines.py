@@ -1,0 +1,245 @@
+"""End-to-end text→image pipelines: tokenize → encode → (parallel) denoise → decode.
+
+The reference is a node pack inside a host app that owns this outer loop (ComfyUI
+wires CLIPTextEncode → KSampler → VAEDecode around the reference's wrapped MODEL;
+the reference only accelerates the per-step ``diffusion_model.forward``,
+any_device_parallel.py:1287). Standalone, this module IS that outer loop. The
+diffusion model slot accepts either a bare ``DiffusionModel`` or the
+``ParallelModel`` returned by ``parallelize`` — every sampler step then routes
+through the same DP/pipeline scheduler the reference's KSampler steps do.
+
+TPU shape discipline: everything is fixed-shape per (batch, size, steps) combo —
+the step loops re-enter the same compiled forward; only the scalars (t, sigma)
+change. CFG doubles the batch inside one forward (feeding the DP path) instead of
+running two forwards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .sampling.ddim import ddim_sample
+from .sampling.flow import flow_euler_sample
+from .sampling.k_samplers import (
+    EpsDenoiser,
+    karras_sigmas,
+    sample_dpmpp_2m,
+    sample_euler,
+    sample_euler_ancestral,
+    sample_heun,
+    sampling_sigmas,
+)
+
+_K_SAMPLERS: dict[str, Callable] = {
+    "euler": sample_euler,
+    "euler_ancestral": sample_euler_ancestral,
+    "heun": sample_heun,
+    "dpmpp_2m": sample_dpmpp_2m,
+}
+
+
+def _to_images(decoded: jnp.ndarray) -> jnp.ndarray:
+    """VAE output ([-1, 1] convention) → float images in [0, 1], NHWC."""
+    return jnp.clip(decoded * 0.5 + 0.5, 0.0, 1.0)
+
+
+def _match_negatives(prompts: list[str], negative_prompt) -> list[str]:
+    """Broadcast a str negative to the batch; validate list lengths at the API
+    boundary (a mismatch otherwise surfaces as a cross-attention shape error deep
+    inside the model)."""
+    if isinstance(negative_prompt, str):
+        return [negative_prompt] * len(prompts)
+    negatives = list(negative_prompt)
+    if len(negatives) != len(prompts):
+        raise ValueError(
+            f"negative_prompt list has {len(negatives)} entries for "
+            f"{len(prompts)} prompts"
+        )
+    return negatives
+
+
+@dataclasses.dataclass
+class StableDiffusionPipeline:
+    """SD1.5 (clip only) / SDXL (clip + clip_g) text→image.
+
+    ``unet`` may be a DiffusionModel or a ParallelModel (wrap with ``parallelize``
+    first to run each denoise step across the device chain)."""
+
+    unet: Any
+    vae: Any
+    clip: Any  # CLIP-L TextEncoder
+    tokenizer: Any  # prompts -> (ids, mask)
+    clip_g: Any = None  # SDXL second tower (OpenCLIP-G)
+    tokenizer_g: Any = None
+
+    @property
+    def is_sdxl(self) -> bool:
+        return self.clip_g is not None
+
+    def encode_prompt(self, prompts: list[str], height: int, width: int):
+        """Prompts → (context, y) conditioning for the UNet family in use."""
+        ids, _ = self.tokenizer(prompts)
+        last, penultimate, _pooled = self.clip(jnp.asarray(ids, jnp.int32))
+        if not self.is_sdxl:
+            return last, None
+        from .models.text_encoders import sdxl_text_conditioning
+
+        ids_g, _ = (self.tokenizer_g or self.tokenizer)(prompts)
+        _, pen_g, pooled_g = self.clip_g(jnp.asarray(ids_g, jnp.int32))
+        return sdxl_text_conditioning(
+            penultimate, pen_g, pooled_g, width=width, height=height
+        )
+
+    def __call__(
+        self,
+        prompt: str | list[str],
+        negative_prompt: str | list[str] = "",
+        *,
+        steps: int = 30,
+        cfg_scale: float = 7.5,
+        height: int = 512,
+        width: int = 512,
+        rng=None,
+        sampler: str = "dpmpp_2m",
+        karras: bool = True,
+        callback=None,
+    ) -> jnp.ndarray:
+        """Returns float images (B, height, width, 3) in [0, 1]."""
+        prompts = [prompt] if isinstance(prompt, str) else list(prompt)
+        negatives = _match_negatives(prompts, negative_prompt)
+        if rng is None:
+            rng = jax.random.key(0)
+        f = self.vae.spatial_factor
+        if height % f or width % f:
+            raise ValueError(f"height/width must be multiples of {f}")
+
+        context, y = self.encode_prompt(prompts, height, width)
+        use_cfg = cfg_scale != 1.0
+        uncond_context = None
+        uncond_kwargs = None
+        if use_cfg:
+            # The uncond half uses the negative prompt's own pooled y (SDXL) —
+            # ComfyUI/diffusers semantics, carried via uncond_kwargs.
+            uncond_context, uncond_y = self.encode_prompt(negatives, height, width)
+            if uncond_y is not None:
+                uncond_kwargs = {"y": uncond_y}
+
+        B = len(prompts)
+        zc = self.vae.cfg.z_channels
+        noise = jax.random.normal(
+            rng, (B, height // f, width // f, zc), jnp.float32
+        )
+        kwargs = {} if y is None else {"y": y}
+        if sampler == "ddim":
+            latents = ddim_sample(
+                self.unet,
+                noise,
+                context,
+                steps=steps,
+                cfg_scale=cfg_scale if use_cfg else 1.0,
+                uncond_context=uncond_context,
+                uncond_kwargs=uncond_kwargs,
+                callback=callback,
+                **kwargs,
+            )
+        else:
+            step_fn = _K_SAMPLERS.get(sampler)
+            if step_fn is None:
+                raise ValueError(
+                    f"unknown sampler {sampler!r} (have ddim, {', '.join(_K_SAMPLERS)})"
+                )
+            sigmas = karras_sigmas(steps) if karras else sampling_sigmas(steps)
+            denoise = EpsDenoiser(
+                self.unet,
+                context,
+                cfg_scale=cfg_scale if use_cfg else 1.0,
+                uncond_context=uncond_context,
+                uncond_kwargs=uncond_kwargs,
+                **kwargs,
+            )
+            x = noise * sigmas[0]
+            if sampler == "euler_ancestral":
+                latents = step_fn(
+                    denoise, x, sigmas, jax.random.fold_in(rng, 1), callback=callback
+                )
+            else:
+                latents = step_fn(denoise, x, sigmas, callback=callback)
+        return _to_images(self.vae.decode(latents))
+
+
+@dataclasses.dataclass
+class FluxPipeline:
+    """FLUX / Z-Image flow-matching text→image: T5 context + CLIP-L pooled vec."""
+
+    dit: Any  # FLUX-class DiffusionModel or ParallelModel
+    vae: Any  # 16-channel autoencoder
+    clip: Any  # CLIP-L TextEncoder (pooled y)
+    t5: Any  # T5 TextEncoder (context)
+    tokenizer: Any  # CLIP tokenizer
+    t5_tokenizer: Any
+
+    def encode_prompt(self, prompts: list[str]):
+        ids, _ = self.tokenizer(prompts)
+        _, _, pooled = self.clip(jnp.asarray(ids, jnp.int32))
+        t5_ids, t5_mask = self.t5_tokenizer(prompts)
+        context = self.t5(jnp.asarray(t5_ids, jnp.int32), mask=jnp.asarray(t5_mask))
+        return context, pooled
+
+    def __call__(
+        self,
+        prompt: str | list[str],
+        *,
+        steps: int = 20,
+        guidance: float | None = 3.5,
+        shift: float = 1.15,
+        height: int = 1024,
+        width: int = 1024,
+        rng=None,
+        negative_prompt: str | list[str] | None = None,
+        cfg_scale: float = 1.0,
+        callback=None,
+    ) -> jnp.ndarray:
+        """Returns float images (B, height, width, 3) in [0, 1]. ``guidance`` is
+        the dev-family distilled guidance embed (None for schnell); true CFG runs
+        only when ``negative_prompt``+``cfg_scale>1`` are given."""
+        prompts = [prompt] if isinstance(prompt, str) else list(prompt)
+        if rng is None:
+            rng = jax.random.key(0)
+        f = self.vae.spatial_factor
+        patch = getattr(getattr(self.dit, "config", None), "patch_size", 2)
+        unit = f * patch  # VAE factor x DiT patchify
+        if height % unit or width % unit:
+            raise ValueError(f"height/width must be multiples of {unit}")
+        context, pooled = self.encode_prompt(prompts)
+        uncond_context = None
+        uncond_kwargs = None
+        kwargs: dict[str, Any] = {"y": pooled}
+        use_cfg = cfg_scale != 1.0 and negative_prompt is not None
+        if use_cfg:
+            negatives = _match_negatives(prompts, negative_prompt)
+            uncond_context, uncond_pooled = self.encode_prompt(negatives)
+            uncond_kwargs = {"y": uncond_pooled}
+
+        B = len(prompts)
+        zc = self.vae.cfg.z_channels
+        noise = jax.random.normal(
+            rng, (B, height // f, width // f, zc), jnp.float32
+        )
+        latents = flow_euler_sample(
+            self.dit,
+            noise,
+            context,
+            steps=steps,
+            shift=shift,
+            guidance=guidance,
+            cfg_scale=cfg_scale if use_cfg else 1.0,
+            uncond_context=uncond_context,
+            uncond_kwargs=uncond_kwargs,
+            callback=callback,
+            **kwargs,
+        )
+        return _to_images(self.vae.decode(latents))

@@ -1,0 +1,27 @@
+"""Classifier-free-guidance batching shared by every sampler.
+
+CFG runs cond ‖ uncond in ONE forward (doubling dim0 — which is exactly what feeds
+the data-parallel path its batch). Per-batch kwargs (pooled vectors, guidance
+embeds) must double too; when the uncond half has its own value (e.g. SDXL's
+negative-prompt pooled ``y``, matching ComfyUI/diffusers semantics) it rides the
+second half of the concat."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def double_kwargs(
+    kwargs: dict, uncond_kwargs: dict | None, batch: int
+) -> dict:
+    """Concatenate cond ‖ uncond along dim0 for every kwarg whose leading dim is
+    the batch; non-batch kwargs pass through. Missing uncond entries reuse the
+    cond value."""
+    uncond = uncond_kwargs or {}
+    out = {}
+    for k, v in kwargs.items():
+        if hasattr(v, "shape") and v.shape[:1] == (batch,):
+            out[k] = jnp.concatenate([v, uncond.get(k, v)], axis=0)
+        else:
+            out[k] = v
+    return out

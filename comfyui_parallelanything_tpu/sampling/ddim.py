@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from .cfg import double_kwargs
 from .schedules import ddim_timesteps, scaled_linear_schedule
 
 
@@ -26,6 +27,7 @@ def ddim_sample(
     steps: int = 20,
     cfg_scale: float = 1.0,
     uncond_context: jnp.ndarray | None = None,
+    uncond_kwargs: dict | None = None,
     alphas_cumprod: jnp.ndarray | None = None,
     callback=None,
     **model_kwargs,
@@ -44,9 +46,7 @@ def ddim_sample(
             x_in = jnp.concatenate([x, x], axis=0)
             t_in = jnp.concatenate([t_vec, t_vec], axis=0)
             c_in = jnp.concatenate([context, uncond_context], axis=0)
-            kw = dict(model_kwargs)
-            if "y" in kw and kw["y"] is not None:
-                kw["y"] = jnp.concatenate([kw["y"], kw["y"]], axis=0)
+            kw = double_kwargs(model_kwargs, uncond_kwargs, batch)
             eps_both = model(x_in, t_in, c_in, **kw)
             eps_c, eps_u = jnp.split(eps_both, 2, axis=0)
             eps = eps_u + cfg_scale * (eps_c - eps_u)

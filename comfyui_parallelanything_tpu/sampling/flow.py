@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from .cfg import double_kwargs
+
 
 def flow_timesteps(steps: int, shift: float = 1.0) -> jnp.ndarray:
     """(steps+1,) descending t in [1, 0], with the rectified-flow shift applied."""
@@ -30,6 +32,7 @@ def flow_euler_sample(
     guidance: float | None = None,
     cfg_scale: float = 1.0,
     uncond_context: jnp.ndarray | None = None,
+    uncond_kwargs: dict | None = None,
     callback=None,
     **model_kwargs,
 ) -> jnp.ndarray:
@@ -53,10 +56,7 @@ def flow_euler_sample(
             x_in = jnp.concatenate([x, x], axis=0)
             t_in = jnp.concatenate([t_vec, t_vec], axis=0)
             c_in = jnp.concatenate([context, uncond_context], axis=0)
-            kw2 = {
-                k: (jnp.concatenate([v, v], axis=0) if hasattr(v, "shape") and v.shape[:1] == (batch,) else v)
-                for k, v in kw.items()
-            }
+            kw2 = double_kwargs(kw, uncond_kwargs, batch)
             v_both = model(x_in, t_in, c_in, **kw2)
             v_c, v_u = jnp.split(v_both, 2, axis=0)
             v = v_u + cfg_scale * (v_c - v_u)

@@ -17,6 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .cfg import double_kwargs
 from .schedules import scaled_linear_schedule
 
 
@@ -59,6 +60,7 @@ class EpsDenoiser:
         *,
         cfg_scale: float = 1.0,
         uncond_context=None,
+        uncond_kwargs: dict | None = None,
         alphas_cumprod: jnp.ndarray | None = None,
         **model_kwargs,
     ):
@@ -68,6 +70,7 @@ class EpsDenoiser:
         self.context = context
         self.cfg_scale = cfg_scale
         self.uncond_context = uncond_context
+        self.uncond_kwargs = uncond_kwargs
         self.kwargs = model_kwargs
         self.sigma_table = model_sigmas(alphas_cumprod)
         self.log_sigmas = jnp.log(self.sigma_table)
@@ -87,16 +90,9 @@ class EpsDenoiser:
         x_in = x * scale
         use_cfg = self.cfg_scale != 1.0 and self.uncond_context is not None
         if use_cfg:
-            # Every per-batch kwarg doubles with the batch (dim0 == batch), not
-            # just 'y' — e.g. guidance vectors (same rule as flow.py's CFG path).
-            kw = {
-                k: (
-                    jnp.concatenate([v, v], axis=0)
-                    if hasattr(v, "shape") and v.shape[:1] == (batch,)
-                    else v
-                )
-                for k, v in self.kwargs.items()
-            }
+            # Every per-batch kwarg doubles with the batch; uncond variants (e.g.
+            # SDXL's negative pooled y) ride the second half (sampling/cfg.py).
+            kw = double_kwargs(self.kwargs, self.uncond_kwargs, batch)
             eps_both = self.model(
                 jnp.concatenate([x_in, x_in], axis=0),
                 jnp.concatenate([t_vec, t_vec], axis=0),

@@ -71,7 +71,14 @@ class CLIPBPETokenizer:
         self.eos_id = vocab[eos]
         self.pad_id = self.eos_id if pad_id is None else pad_id
         self.byte_map = _bytes_to_unicode()
-        import regex
+        try:
+            import regex
+        except ImportError as e:  # pragma: no cover - present in this image
+            raise ImportError(
+                "CLIPBPETokenizer needs the 'regex' package (unicode categories in "
+                "the CLIP split pattern) — pip install "
+                "comfyui-parallelanything-tpu[text]"
+            ) from e
 
         # CLIP's pattern: contractions, letter runs, digit runs, other symbols.
         self._pat = regex.compile(

@@ -1,0 +1,223 @@
+"""End-to-end pipelines: prompt → image on tiny models over the 8-device mesh.
+
+Exercises the whole standalone stack the reference delegates to its host app —
+tokenize, text-encode, per-step parallel denoise, VAE decode — including shape,
+determinism, CFG batching, and sampler dispatch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import comfyui_parallelanything_tpu as pa
+from comfyui_parallelanything_tpu.models import (
+    CLIPTextConfig,
+    T5Config,
+    VAEConfig,
+    build_clip_text,
+    build_flux,
+    build_t5_encoder,
+    build_unet,
+    build_vae,
+    sd15_config,
+)
+from comfyui_parallelanything_tpu.models.flux import FluxConfig
+from comfyui_parallelanything_tpu.pipelines import FluxPipeline, StableDiffusionPipeline
+
+from test_tokenizer import _tiny_tokenizer
+
+
+@pytest.fixture(scope="module")
+def sd_pipe():
+    tok = _tiny_tokenizer()
+    ccfg = CLIPTextConfig(
+        vocab_size=64, hidden_size=48, num_layers=2, num_heads=4, max_len=8,
+        eos_id=tok.eos_id, dtype=jnp.float32,
+    )
+    ucfg = sd15_config(
+        model_channels=32, channel_mult=(1, 2), transformer_depth=(1, 1),
+        attention_levels=(0, 1), context_dim=48, num_heads=4, norm_groups=8,
+        dtype=jnp.float32,
+    )
+    vcfg = VAEConfig(
+        z_channels=4, base_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+        norm_groups=8, dtype=jnp.float32,
+    )
+    return StableDiffusionPipeline(
+        unet=build_unet(ucfg, jax.random.key(0), sample_shape=(1, 8, 8, 4)),
+        vae=build_vae(vcfg, jax.random.key(1), sample_hw=16),
+        clip=build_clip_text(ccfg, jax.random.key(2)),
+        tokenizer=tok,
+    )
+
+
+class TestStableDiffusionPipeline:
+    def test_prompt_to_image_shape_and_range(self, sd_pipe):
+        img = sd_pipe("hello world", steps=2, cfg_scale=1.0, height=16, width=16)
+        assert img.shape == (1, 16, 16, 3)
+        a = np.asarray(img)
+        assert np.isfinite(a).all() and a.min() >= 0.0 and a.max() <= 1.0
+
+    def test_deterministic_given_rng(self, sd_pipe):
+        kw = dict(steps=2, cfg_scale=1.0, height=16, width=16, rng=jax.random.key(7))
+        np.testing.assert_array_equal(
+            np.asarray(sd_pipe("hello", **kw)), np.asarray(sd_pipe("hello", **kw))
+        )
+
+    def test_cfg_changes_output(self, sd_pipe):
+        kw = dict(steps=2, height=16, width=16, rng=jax.random.key(7))
+        base = np.asarray(sd_pipe("hello", cfg_scale=1.0, **kw))
+        cfg = np.asarray(
+            sd_pipe("hello", negative_prompt="world", cfg_scale=5.0, **kw)
+        )
+        assert not np.allclose(base, cfg)
+
+    @pytest.mark.parametrize("sampler", ["ddim", "euler", "dpmpp_2m", "heun"])
+    def test_sampler_dispatch(self, sd_pipe, sampler):
+        img = sd_pipe(
+            "hello", steps=2, cfg_scale=1.0, height=16, width=16, sampler=sampler
+        )
+        assert img.shape == (1, 16, 16, 3)
+
+    def test_euler_ancestral_uses_rng(self, sd_pipe):
+        img = sd_pipe(
+            "hello", steps=2, cfg_scale=1.0, height=16, width=16,
+            sampler="euler_ancestral",
+        )
+        assert np.isfinite(np.asarray(img)).all()
+
+    def test_unknown_sampler_rejected(self, sd_pipe):
+        with pytest.raises(ValueError, match="unknown sampler"):
+            sd_pipe("hello", sampler="nope", height=16, width=16)
+
+    def test_bad_resolution_rejected(self, sd_pipe):
+        with pytest.raises(ValueError, match="multiples"):
+            sd_pipe("hello", height=15, width=16)
+
+    def test_parallelized_unet_matches_single(self, sd_pipe):
+        """The same pipeline with the UNet wrapped by parallelize must produce the
+        same images — the parallel scheduler is transparency-tested end to end."""
+        chain = pa.DeviceChain.even([f"cpu:{i}" for i in range(4)])
+        punet = pa.parallelize(sd_pipe.unet, chain)
+        ppipe = StableDiffusionPipeline(
+            unet=punet, vae=sd_pipe.vae, clip=sd_pipe.clip, tokenizer=sd_pipe.tokenizer
+        )
+        kw = dict(
+            steps=2, cfg_scale=3.0, negative_prompt="world",
+            height=16, width=16, rng=jax.random.key(3),
+        )
+        want = np.asarray(sd_pipe(["hello", "world"], **kw))
+        got = np.asarray(ppipe(["hello", "world"], **kw))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+class TestCFGKwargDoubling:
+    def test_uncond_variant_rides_second_half(self):
+        from comfyui_parallelanything_tpu.sampling.cfg import double_kwargs
+
+        y = jnp.arange(4.0).reshape(2, 2)
+        uy = -y
+        out = double_kwargs({"y": y, "flag": 3}, {"y": uy}, batch=2)
+        np.testing.assert_array_equal(
+            np.asarray(out["y"]), np.concatenate([np.asarray(y), np.asarray(uy)])
+        )
+        assert out["flag"] == 3
+
+    def test_missing_uncond_duplicates_cond(self):
+        from comfyui_parallelanything_tpu.sampling.cfg import double_kwargs
+
+        y = jnp.ones((2, 3))
+        out = double_kwargs({"y": y}, None, batch=2)
+        assert out["y"].shape == (4, 3)
+
+
+class TestSDXLStylePipeline:
+    def test_negative_pooled_feeds_uncond_half(self, sd_pipe):
+        """SDXL semantics: the uncond half of the CFG batch must be conditioned on
+        the NEGATIVE prompt's pooled vector. Checked via a recording model."""
+        tok = _tiny_tokenizer()
+        ccfg = CLIPTextConfig(
+            vocab_size=64, hidden_size=48, num_layers=2, num_heads=4, max_len=8,
+            eos_id=tok.eos_id, dtype=jnp.float32,
+        )
+        gcfg = CLIPTextConfig(
+            vocab_size=64, hidden_size=48, num_layers=2, num_heads=4, max_len=8,
+            eos_id=tok.eos_id, projection_dim=16, act="gelu", dtype=jnp.float32,
+        )
+        clip_l = build_clip_text(ccfg, jax.random.key(0))
+        clip_g = build_clip_text(gcfg, jax.random.key(1))
+        seen = {}
+
+        def recording_unet(x, t, context, y=None, **kw):
+            seen["y"] = y
+            return jnp.zeros_like(x)
+
+        pipe = StableDiffusionPipeline(
+            unet=recording_unet, vae=sd_pipe.vae, clip=clip_l, tokenizer=tok,
+            clip_g=clip_g,
+        )
+        pipe("hello", negative_prompt="world", steps=1, cfg_scale=5.0,
+             height=16, width=16, sampler="ddim")
+        y = np.asarray(seen["y"])
+        assert y.shape[0] == 2  # cond ‖ uncond
+        # Different prompts → different pooled halves (the old bug duplicated cond).
+        assert not np.allclose(y[0], y[1])
+
+    def test_negative_list_length_validated(self, sd_pipe):
+        with pytest.raises(ValueError, match="negative_prompt"):
+            sd_pipe(["a", "b"], negative_prompt=["n"], cfg_scale=5.0,
+                    height=16, width=16)
+
+
+class TestFluxPipeline:
+    @pytest.fixture(scope="class")
+    def flux_pipe(self):
+        tok = _tiny_tokenizer()
+        ccfg = CLIPTextConfig(
+            vocab_size=64, hidden_size=48, num_layers=2, num_heads=4, max_len=8,
+            eos_id=tok.eos_id, projection_dim=16, dtype=jnp.float32,
+        )
+        t5cfg = T5Config(
+            vocab_size=64, d_model=32, num_layers=2, num_heads=4, d_kv=8, d_ff=64,
+            dtype=jnp.float32,
+        )
+        # in_channels = vae z (16) x patch 2x2 = 64 (patchified token dim).
+        fcfg = FluxConfig(
+            in_channels=64, hidden_size=32, num_heads=2, depth=1,
+            depth_single_blocks=1, context_in_dim=32, vec_in_dim=16,
+            axes_dim=(4, 6, 6), guidance_embed=True, dtype=jnp.float32,
+        )
+        vcfg = VAEConfig(
+            z_channels=16, base_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            norm_groups=8, use_quant_conv=False, dtype=jnp.float32,
+        )
+        return FluxPipeline(
+            dit=build_flux(fcfg, jax.random.key(0), sample_shape=(1, 8, 8, 16), txt_len=8),
+            vae=build_vae(vcfg, jax.random.key(1), sample_hw=16),
+            clip=build_clip_text(ccfg, jax.random.key(2)),
+            t5=build_t5_encoder(t5cfg, jax.random.key(3)),
+            tokenizer=tok,
+            t5_tokenizer=tok,
+        )
+
+    def test_prompt_to_image(self, flux_pipe):
+        img = flux_pipe("hello world", steps=2, guidance=3.5, height=16, width=16)
+        assert img.shape == (1, 16, 16, 3)
+        assert np.isfinite(np.asarray(img)).all()
+
+    def test_schnell_style_no_guidance(self, flux_pipe):
+        img = flux_pipe("hello", steps=1, guidance=None, height=16, width=16)
+        assert img.shape == (1, 16, 16, 3)
+
+    def test_resolution_must_divide_vae_times_patch(self, flux_pipe):
+        # unit = vae factor (2 for the tiny config) x patch 2 = 4
+        with pytest.raises(ValueError, match="multiples"):
+            flux_pipe("hello", steps=1, height=14, width=16)
+
+    def test_true_cfg_with_negative(self, flux_pipe):
+        img = flux_pipe(
+            "hello", negative_prompt="world", cfg_scale=3.0, steps=1,
+            guidance=None, height=16, width=16,
+        )
+        assert img.shape == (1, 16, 16, 3)
+        assert np.isfinite(np.asarray(img)).all()
