@@ -9,6 +9,12 @@ platform):
 - ``zimage_21``— Z_Image-class MMDiT, batch=21, 1024² — the reference's own benchmark
   run (/root/reference/README.md:46-60: 26.00 s/it on one RTX 3090, 12.91 s/it on
   two GPUs). Large: needs most of a v5e chip's HBM.
+- ``flux_16``  — FLUX-class MMDiT, batch=16, 1024² (the BASELINE.json north-star
+  shape). Full flux-dev (12B) needs FSDP over a v5e-8 pod slice; on a single chip
+  this rung runs the dev *topology* at reduced depth so the shape (4096 img tokens
+  of joint attention, bf16, pallas flash path) is what's measured.
+- ``wan_video``— WAN-class video DiT, 16 frames 480p-latent batch=1 (sequence-
+  dominant workload; temporal tokens ≈ video "batch").
 - ``smoke``    — reduced-width SD1.5 topology on CPU (no TPU attached).
 
 ``vs_baseline`` divides the reference's published single-GPU 26.00 s/it by our s/it —
@@ -39,14 +45,14 @@ def _build(config_name):
         batch, latent, ctx_len = 16, 128, 77
         cfg = sd15_config(dtype=jnp.bfloat16)
         model = build_unet(cfg, rng, sample_shape=(1, latent, latent, 4))
-        x_ch, ctx_dim = 4, cfg.context_dim
+        x_shape, ctx_dim = (batch, latent, latent, 4), cfg.context_dim
         kwargs = {}
         workload = "SD1.5 UNet bf16 batch=16 1024x1024"
     elif config_name == "sdxl_8":
         batch, latent, ctx_len = 8, 128, 77
         cfg = sdxl_config(dtype=jnp.bfloat16)
         model = build_unet(cfg, rng, sample_shape=(1, latent, latent, 4))
-        x_ch, ctx_dim = 4, cfg.context_dim
+        x_shape, ctx_dim = (batch, latent, latent, 4), cfg.context_dim
         kwargs = {"y": jnp.zeros((batch, cfg.adm_in_channels), jnp.float32)}
         workload = "SDXL UNet bf16 batch=8 1024x1024"
     elif config_name == "zimage_21":
@@ -55,9 +61,37 @@ def _build(config_name):
         model = build_flux(
             cfg, rng, sample_shape=(1, 16, 16, 16), txt_len=ctx_len
         )
-        x_ch, ctx_dim = 16, cfg.context_in_dim
+        x_shape, ctx_dim = (batch, latent, latent, 16), cfg.context_in_dim
         kwargs = {}
         workload = "Z_Image-class MMDiT bf16 batch=21 1024x1024 (README repro shape)"
+    elif config_name == "flux_16":
+        from comfyui_parallelanything_tpu.models import flux_dev_config
+
+        batch, latent, ctx_len = 16, 128, 512
+        # Dev topology (double+single blocks, guidance embed, 24 heads x 128) at
+        # depth that fits one v5e chip; full 19/38-depth dev runs FSDP multi-chip.
+        cfg = flux_dev_config(depth=4, depth_single_blocks=8, dtype=jnp.bfloat16)
+        model = build_flux(cfg, rng, sample_shape=(1, 32, 32, 16), txt_len=ctx_len)
+        x_shape, ctx_dim = (batch, latent, latent, 16), cfg.context_in_dim
+        kwargs = {
+            "y": jnp.zeros((batch, cfg.vec_in_dim), jnp.float32),
+            "guidance": jnp.full((batch,), 3.5, jnp.float32),
+        }
+        workload = "FLUX-class MMDiT bf16 batch=16 1024x1024 (reduced depth 4/8)"
+    elif config_name == "wan_video":
+        from comfyui_parallelanything_tpu.models import build_wan, wan_1_3b_config
+
+        batch, ctx_len = 1, 128
+        cfg = wan_1_3b_config(depth=8, dtype=jnp.bfloat16)
+        frames, lat_h, lat_w = 16, 30, 52  # ~480p latent video, 16 frames
+        model = build_wan(
+            cfg, rng, sample_shape=(1, frames, lat_h, lat_w, cfg.in_channels),
+            txt_len=ctx_len,
+        )
+        x_shape = (batch, frames, lat_h, lat_w, cfg.in_channels)
+        ctx_dim = cfg.text_dim
+        kwargs = {}
+        workload = f"WAN-class video DiT bf16 {frames}f {lat_h}x{lat_w} latents"
     elif config_name == "smoke":
         batch, latent, ctx_len = 8, 32, 24
         cfg = sd15_config(
@@ -68,12 +102,12 @@ def _build(config_name):
             dtype=jnp.bfloat16,
         )
         model = build_unet(cfg, rng, sample_shape=(1, latent, latent, 4))
-        x_ch, ctx_dim = 4, cfg.context_dim
+        x_shape, ctx_dim = (batch, latent, latent, 4), cfg.context_dim
         kwargs = {}
         workload = "SD1.5-topology smoke batch=8 256x256"
     else:
         raise ValueError(f"unknown BENCH_CONFIG {config_name!r}")
-    return model, batch, latent, x_ch, ctx_len, ctx_dim, kwargs, workload
+    return model, batch, x_shape, ctx_len, ctx_dim, kwargs, workload
 
 
 def main() -> None:
@@ -88,13 +122,13 @@ def main() -> None:
         "BENCH_CONFIG", "sd15_16" if platform == "tpu" else "smoke"
     )
 
-    model, batch, latent, x_ch, ctx_len, ctx_dim, kwargs, workload = _build(config_name)
+    model, batch, x_shape, ctx_len, ctx_dim, kwargs, workload = _build(config_name)
 
     chain = DeviceChain.even([f"{platform}:{d.id}" for d in jax.devices()])
     pm = parallelize(model, chain)
 
     kx, kc = jax.random.split(jax.random.key(1))
-    x = jax.random.normal(kx, (batch, latent, latent, x_ch), jnp.float32)
+    x = jax.random.normal(kx, x_shape, jnp.float32)
     t = jnp.linspace(999.0, 1.0, batch)
     ctx = jax.random.normal(kc, (batch, ctx_len, ctx_dim), jnp.float32)
 

@@ -225,6 +225,12 @@ class AutoencoderKL(nn.Module):
         return self.decode(self.encode(x, rng))
 
 
+def vae_output_to_images(decoded: jnp.ndarray) -> jnp.ndarray:
+    """Decoder output ([-1, 1] convention) → float images in [0, 1], NHWC — the
+    single owner of the output-range convention (pipelines and nodes both use it)."""
+    return jnp.clip(decoded * 0.5 + 0.5, 0.0, 1.0)
+
+
 @dataclasses.dataclass(frozen=True)
 class VAE:
     """The VAE as data: jit-cached encode/decode + weights (mirrors

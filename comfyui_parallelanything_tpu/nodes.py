@@ -259,11 +259,444 @@ class ParallelAnythingAdvanced(ParallelAnything):
         return base
 
 
+# ---------------------------------------------------------------------------
+# Host-layer nodes (beyond the reference's 3 nodes).
+#
+# The reference assumes ComfyUI provides the rest of the graph —
+# CheckpointLoaderSimple → CLIPTextEncode → KSampler → VAEDecode — around its
+# wrapped MODEL (SURVEY §2g lists exactly what it consumes from that host).
+# Standalone, this framework supplies those surrounding nodes itself, with the
+# same wire vocabulary (MODEL / CLIP / CONDITIONING / LATENT / VAE / IMAGE), so a
+# reference user's whole workflow maps node-for-node.
+# ---------------------------------------------------------------------------
+
+_MODEL_FAMILIES = ("sd15", "sdxl", "flux-dev", "flux-schnell", "zimage-turbo")
+
+
+class TPUCheckpointLoader:
+    """Checkpoint file → (MODEL, VAE). The diffusion subtree and (when present in
+    the file) the first_stage_model VAE subtree load together, like the host
+    loader the reference defers to."""
+
+    DESCRIPTION = "Load a diffusion checkpoint (and its bundled VAE) for a family."
+    RETURN_TYPES = ("MODEL", "VAE")
+    RETURN_NAMES = ("model", "vae")
+    FUNCTION = "load"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "ckpt_path": ("STRING", {"default": "", "tooltip": "safetensors path"}),
+                "family": (
+                    list(_MODEL_FAMILIES),
+                    {"default": "sd15", "tooltip": "model family / config preset"},
+                ),
+            },
+            "optional": {
+                "vae_path": (
+                    "STRING",
+                    {"default": "", "tooltip": "separate VAE file (flux ae, fixed vae)"},
+                ),
+                "lora_path": ("STRING", {"default": ""}),
+                "lora_strength": ("FLOAT", {"default": 1.0, "min": -4.0, "max": 4.0}),
+            },
+        }
+
+    def load(
+        self,
+        ckpt_path: str,
+        family: str,
+        vae_path: str = "",
+        lora_path: str = "",
+        lora_strength: float = 1.0,
+    ):
+        from .models import (
+            flux_dev_config,
+            flux_schnell_config,
+            flux_vae_config,
+            load_flux_checkpoint,
+            load_safetensors,
+            load_sd_unet_checkpoint,
+            load_vae_checkpoint,
+            sd15_config,
+            sd_vae_config,
+            sdxl_config,
+            sdxl_vae_config,
+            z_image_turbo_config,
+        )
+
+        lora = lora_path or None
+        sd = load_safetensors(ckpt_path)
+        if family == "sd15":
+            model = load_sd_unet_checkpoint(sd, sd15_config(), lora, lora_strength)
+            vae_cfg = sd_vae_config()
+        elif family == "sdxl":
+            model = load_sd_unet_checkpoint(sd, sdxl_config(), lora, lora_strength)
+            vae_cfg = sdxl_vae_config()
+        else:
+            cfg = {
+                "flux-dev": flux_dev_config,
+                "flux-schnell": flux_schnell_config,
+                "zimage-turbo": z_image_turbo_config,
+            }[family]()
+            model = load_flux_checkpoint(sd, cfg, lora, lora_strength)
+            vae_cfg = flux_vae_config()
+        vae_sd = load_safetensors(vae_path) if vae_path else sd
+        from .models.convert_vae import strip_vae_prefix
+
+        if not any(
+            k.startswith("decoder.") for k in strip_vae_prefix(vae_sd)
+        ):
+            raise ValueError(
+                f"no VAE weights in {'vae_path' if vae_path else 'the checkpoint'} — "
+                "flux/bare-UNet checkpoints don't bundle one; set vae_path to the "
+                "autoencoder file (e.g. ae.safetensors)"
+            )
+        vae = load_vae_checkpoint(vae_sd, cfg=vae_cfg)
+        return model, vae
+
+
+class TPUCLIPLoader:
+    """Tokenizer+encoder files → CLIP wire value (encoder plus its tokenizer)."""
+
+    DESCRIPTION = "Load a CLIP/T5 text encoder and its tokenizer tables."
+    RETURN_TYPES = ("CLIP",)
+    RETURN_NAMES = ("clip",)
+    FUNCTION = "load"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "encoder_path": ("STRING", {"default": ""}),
+                "encoder_type": (
+                    ["clip-l", "open-clip-g", "t5"],
+                    {"default": "clip-l"},
+                ),
+            },
+            "optional": {
+                "vocab_path": ("STRING", {"default": "", "tooltip": "CLIP vocab.json"}),
+                "merges_path": ("STRING", {"default": "", "tooltip": "CLIP merges.txt"}),
+                "tokenizer_json": ("STRING", {"default": "", "tooltip": "tokenizer.json"}),
+                "max_len": ("INT", {"default": 77, "min": 8, "max": 4096}),
+            },
+        }
+
+    def load(
+        self,
+        encoder_path: str,
+        encoder_type: str,
+        vocab_path: str = "",
+        merges_path: str = "",
+        tokenizer_json: str = "",
+        max_len: int = 77,
+    ):
+        from .models import load_clip_text_checkpoint, load_t5_checkpoint
+        from .utils.tokenizer import CLIPBPETokenizer, load_tokenizer_json
+
+        if encoder_type == "t5":
+            if not tokenizer_json:
+                raise ValueError(
+                    "encoder_type='t5' requires tokenizer_json (the T5 tokenizer "
+                    "has no vocab.json/merges.txt form)"
+                )
+            enc = load_t5_checkpoint(encoder_path)
+            tok = load_tokenizer_json(tokenizer_json, max_len=max_len, eos_id=1)
+        else:
+            enc = load_clip_text_checkpoint(
+                encoder_path, open_clip=encoder_type == "open-clip-g"
+            )
+            if tokenizer_json:
+                tok = load_tokenizer_json(tokenizer_json, max_len=max_len)
+            else:
+                tok = CLIPBPETokenizer.from_files(
+                    vocab_path, merges_path, max_len=max_len,
+                    pad_id=0 if encoder_type == "open-clip-g" else None,
+                )
+        return ({"encoder": enc, "tokenizer": tok, "type": encoder_type},)
+
+
+class TPUTextEncode:
+    """(CLIP, text) → CONDITIONING: {'context', 'pooled'} wire dict."""
+
+    DESCRIPTION = "Encode a prompt with a loaded text encoder."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "encode"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip": ("CLIP", {}),
+                "text": ("STRING", {"default": "", "multiline": True}),
+            }
+        }
+
+    def encode(self, clip, text: str):
+        import jax.numpy as jnp
+
+        enc, tok = clip["encoder"], clip["tokenizer"]
+        ids, mask = tok([text])
+        if clip["type"] == "t5":
+            context = enc(jnp.asarray(ids, jnp.int32), mask=jnp.asarray(mask))
+            return ({"context": context, "pooled": None},)
+        last, penultimate, pooled = enc(jnp.asarray(ids, jnp.int32))
+        return (
+            {
+                "context": last,
+                "penultimate": penultimate,
+                "pooled": pooled,
+            },
+        )
+
+
+class TPUConditioningCombine:
+    """Assemble multi-tower conditioning:
+
+    - ``sdxl``: CLIP-L + OpenCLIP-G CONDITIONINGs → 2048-d context ‖ 2816-d
+      pooled/size vector (``sdxl_text_conditioning`` — what the SDXL UNet's
+      cross-attention and label embed expect).
+    - ``flux``: T5 CONDITIONING (context) + CLIP-L CONDITIONING (pooled vec) →
+      the (context, y) pair the MMDiT consumes.
+
+    Without this node the individual towers' outputs are dimensionally wrong for
+    those families — TPUTextEncode alone only serves SD1.5."""
+
+    DESCRIPTION = "Combine text-encoder outputs for SDXL (L+G) or FLUX (T5+CLIP)."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "combine"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning_a": (
+                    "CONDITIONING",
+                    {"tooltip": "CLIP-L (sdxl) / T5 (flux)"},
+                ),
+                "conditioning_b": (
+                    "CONDITIONING",
+                    {"tooltip": "OpenCLIP-G (sdxl) / CLIP-L (flux)"},
+                ),
+                "mode": (["sdxl", "flux"], {"default": "sdxl"}),
+            },
+            "optional": {
+                "width": ("INT", {"default": 1024, "min": 16, "max": 8192}),
+                "height": ("INT", {"default": 1024, "min": 16, "max": 8192}),
+            },
+        }
+
+    def combine(
+        self, conditioning_a, conditioning_b, mode: str,
+        width: int = 1024, height: int = 1024,
+    ):
+        if mode == "flux":
+            if conditioning_b.get("pooled") is None:
+                raise ValueError("flux mode needs a CLIP conditioning (pooled) as b")
+            return (
+                {"context": conditioning_a["context"],
+                 "pooled": conditioning_b["pooled"]},
+            )
+        from .models.text_encoders import sdxl_text_conditioning
+
+        pen_l = conditioning_a.get("penultimate")
+        pen_g = conditioning_b.get("penultimate")
+        pooled_g = conditioning_b.get("pooled")
+        if pen_l is None or pen_g is None or pooled_g is None:
+            raise ValueError(
+                "sdxl mode needs CLIP-L as a and OpenCLIP-G (with text_projection) "
+                "as b, both from TPUTextEncode"
+            )
+        context, y = sdxl_text_conditioning(
+            pen_l, pen_g, pooled_g, width=width, height=height
+        )
+        return ({"context": context, "pooled": y},)
+
+
+class TPUEmptyLatent:
+    """(width, height, batch) → LATENT noise-free zeros, ComfyUI-style."""
+
+    DESCRIPTION = "Allocate an empty latent batch for sampling."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "generate"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "width": ("INT", {"default": 512, "min": 16, "max": 8192, "step": 8}),
+                "height": ("INT", {"default": 512, "min": 16, "max": 8192, "step": 8}),
+                "batch_size": ("INT", {"default": 1, "min": 1, "max": 64}),
+                "channels": ("INT", {"default": 4, "min": 1, "max": 64}),
+            }
+        }
+
+    def generate(self, width: int, height: int, batch_size: int, channels: int = 4):
+        import jax.numpy as jnp
+
+        return (
+            {"samples": jnp.zeros((batch_size, height // 8, width // 8, channels))},
+        )
+
+
+class TPUKSampler:
+    """(MODEL, positive, negative, LATENT) → LATENT — the per-step driver whose
+    forwards route through the parallel scheduler when MODEL came from
+    ParallelAnything (the reference's KSampler relationship, 1287)."""
+
+    DESCRIPTION = "Sample latents with the loaded (optionally parallelized) model."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "sample"
+    CATEGORY = CATEGORY
+
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        from .sampling.runner import SAMPLER_NAMES
+
+        return {
+            "required": {
+                "model": ("MODEL", {}),
+                "positive": ("CONDITIONING", {}),
+                "latent": ("LATENT", {}),
+                "seed": ("INT", {"default": 0, "min": 0, "max": 2**31 - 1}),
+                "steps": ("INT", {"default": 20, "min": 1, "max": 200}),
+                "cfg": ("FLOAT", {"default": 7.5, "min": 1.0, "max": 30.0}),
+                "sampler_name": (list(SAMPLER_NAMES), {"default": "dpmpp_2m"}),
+            },
+            "optional": {
+                "negative": ("CONDITIONING", {}),
+                "guidance": (
+                    "FLOAT",
+                    {"default": 3.5, "min": 0.0, "max": 30.0,
+                     "tooltip": "flux-dev distilled guidance embed; 0 disables "
+                                "(schnell)"},
+                ),
+                "shift": (
+                    "FLOAT",
+                    {"default": 1.15, "min": 0.25, "max": 8.0,
+                     "tooltip": "rectified-flow timestep shift (flow_euler only)"},
+                ),
+            },
+        }
+
+    def sample(
+        self,
+        model,
+        positive,
+        latent,
+        seed: int,
+        steps: int,
+        cfg: float,
+        sampler_name: str,
+        negative=None,
+        guidance: float = 3.5,
+        shift: float = 1.15,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from .sampling.runner import run_sampler
+
+        rng = jax.random.key(seed)
+        shape = latent["samples"].shape
+        batch = shape[0]
+        noise = jax.random.normal(rng, shape, jnp.float32)
+
+        def bcast(arr):
+            # ComfyUI semantics: one encoded prompt conditions the whole latent
+            # batch; tile dim0 up to match (must divide evenly).
+            if arr is not None and arr.shape[0] != batch:
+                if batch % arr.shape[0]:
+                    raise ValueError(
+                        f"conditioning batch {arr.shape[0]} does not divide "
+                        f"latent batch {batch}"
+                    )
+                arr = jnp.repeat(arr, batch // arr.shape[0], axis=0)
+            return arr
+
+        context = bcast(positive["context"])
+        pooled = bcast(positive.get("pooled"))
+        model_cfg = getattr(model, "model_config", None)
+        if model_cfg is None:
+            model_cfg = getattr(model, "config", None)
+        if pooled is None and hasattr(model_cfg, "vec_in_dim"):
+            from .utils.logging import get_logger
+
+            get_logger().warning(
+                "FLUX-family model sampled without a pooled vector (y falls back "
+                "to zeros) — route T5 + CLIP conditioning through "
+                "TPUConditioningCombine(mode='flux')"
+            )
+        uncond_context = bcast(negative["context"]) if negative else None
+        uncond_kwargs = (
+            {"y": bcast(negative["pooled"])}
+            if negative and negative.get("pooled") is not None
+            else None
+        )
+        kwargs = {} if pooled is None else {"y": pooled}
+        out = run_sampler(
+            model, noise, context, sampler=sampler_name, steps=steps,
+            cfg_scale=cfg, uncond_context=uncond_context,
+            uncond_kwargs=uncond_kwargs, rng=rng, shift=shift,
+            guidance=guidance if guidance > 0 else None, **kwargs,
+        )
+        return ({"samples": out},)
+
+
+class TPUVAEDecode:
+    """(VAE, LATENT) → IMAGE floats in [0, 1]; tiled when the latent is large."""
+
+    DESCRIPTION = "Decode latents to images (auto-tiled for large resolutions)."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "decode"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {"vae": ("VAE", {}), "latent": ("LATENT", {})},
+            "optional": {
+                "tile_size": ("INT", {"default": 0, "min": 0, "max": 512,
+                                      "tooltip": "0 = no tiling"}),
+            },
+        }
+
+    def decode(self, vae, latent, tile_size: int = 0):
+        from .models.vae import vae_output_to_images
+
+        z = latent["samples"]
+        decoded = (
+            vae.decode_tiled(z, tile=tile_size, overlap=tile_size // 4)
+            if tile_size
+            else vae.decode(z)
+        )
+        return (vae_output_to_images(decoded),)
+
+
 NODE_CLASS_MAPPINGS = {
     "ParallelAnything": ParallelAnything,
     "ParallelAnythingAdvanced": ParallelAnythingAdvanced,
     "ParallelDevice": ParallelDevice,
     "ParallelDeviceList": ParallelDeviceList,
+    "TPUCheckpointLoader": TPUCheckpointLoader,
+    "TPUCLIPLoader": TPUCLIPLoader,
+    "TPUTextEncode": TPUTextEncode,
+    "TPUConditioningCombine": TPUConditioningCombine,
+    "TPUEmptyLatent": TPUEmptyLatent,
+    "TPUKSampler": TPUKSampler,
+    "TPUVAEDecode": TPUVAEDecode,
 }
 
 NODE_DISPLAY_NAME_MAPPINGS = {
@@ -271,4 +704,11 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "ParallelAnythingAdvanced": "Parallel Anything (Advanced: FSDP/TP)",
     "ParallelDevice": "Parallel Device Config",
     "ParallelDeviceList": "Parallel Device List (1-4x)",
+    "TPUCheckpointLoader": "Load Checkpoint (TPU)",
+    "TPUCLIPLoader": "Load Text Encoder (TPU)",
+    "TPUTextEncode": "Text Encode (TPU)",
+    "TPUConditioningCombine": "Conditioning Combine (TPU, SDXL/FLUX)",
+    "TPUEmptyLatent": "Empty Latent (TPU)",
+    "TPUKSampler": "KSampler (TPU)",
+    "TPUVAEDecode": "VAE Decode (TPU)",
 }

@@ -219,11 +219,15 @@ class ParallelModel:
         groups: list[_PlatformGroup],
         weights: tuple[float, ...],
         pipeline_spec: Any = None,
+        model_config: Any = None,
     ):
         self._apply = apply_fn
         self._host_params = params
         self.chain = chain
         self.config = config
+        # The wrapped model's own config (FluxConfig/UNetConfig/...), distinct from
+        # the ParallelConfig above — pipelines read patch_size etc. through this.
+        self.model_config = model_config
         self._groups = groups
         self.weights = weights
         self._pipeline_spec = pipeline_spec
@@ -595,4 +599,5 @@ def parallelize(
         groups=groups,
         weights=final_weights,
         pipeline_spec=getattr(model, "pipeline_spec", None),
+        model_config=getattr(model, "config", None),
     )

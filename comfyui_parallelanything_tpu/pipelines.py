@@ -17,34 +17,13 @@ running two forwards.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 
-from .sampling.ddim import ddim_sample
-from .sampling.flow import flow_euler_sample
-from .sampling.k_samplers import (
-    EpsDenoiser,
-    karras_sigmas,
-    sample_dpmpp_2m,
-    sample_euler,
-    sample_euler_ancestral,
-    sample_heun,
-    sampling_sigmas,
-)
-
-_K_SAMPLERS: dict[str, Callable] = {
-    "euler": sample_euler,
-    "euler_ancestral": sample_euler_ancestral,
-    "heun": sample_heun,
-    "dpmpp_2m": sample_dpmpp_2m,
-}
-
-
-def _to_images(decoded: jnp.ndarray) -> jnp.ndarray:
-    """VAE output ([-1, 1] convention) → float images in [0, 1], NHWC."""
-    return jnp.clip(decoded * 0.5 + 0.5, 0.0, 1.0)
+from .models.vae import vae_output_to_images as _to_images
+from .sampling.runner import run_sampler
 
 
 def _match_negatives(prompts: list[str], negative_prompt) -> list[str]:
@@ -134,40 +113,22 @@ class StableDiffusionPipeline:
             rng, (B, height // f, width // f, zc), jnp.float32
         )
         kwargs = {} if y is None else {"y": y}
-        if sampler == "ddim":
-            latents = ddim_sample(
-                self.unet,
-                noise,
-                context,
-                steps=steps,
-                cfg_scale=cfg_scale if use_cfg else 1.0,
-                uncond_context=uncond_context,
-                uncond_kwargs=uncond_kwargs,
-                callback=callback,
-                **kwargs,
-            )
-        else:
-            step_fn = _K_SAMPLERS.get(sampler)
-            if step_fn is None:
-                raise ValueError(
-                    f"unknown sampler {sampler!r} (have ddim, {', '.join(_K_SAMPLERS)})"
-                )
-            sigmas = karras_sigmas(steps) if karras else sampling_sigmas(steps)
-            denoise = EpsDenoiser(
-                self.unet,
-                context,
-                cfg_scale=cfg_scale if use_cfg else 1.0,
-                uncond_context=uncond_context,
-                uncond_kwargs=uncond_kwargs,
-                **kwargs,
-            )
-            x = noise * sigmas[0]
-            if sampler == "euler_ancestral":
-                latents = step_fn(
-                    denoise, x, sigmas, jax.random.fold_in(rng, 1), callback=callback
-                )
-            else:
-                latents = step_fn(denoise, x, sigmas, callback=callback)
+        if sampler == "flow_euler":
+            raise ValueError("flow_euler belongs to FluxPipeline, not the SD family")
+        latents = run_sampler(
+            self.unet,
+            noise,
+            context,
+            sampler=sampler,
+            steps=steps,
+            cfg_scale=cfg_scale if use_cfg else 1.0,
+            uncond_context=uncond_context,
+            uncond_kwargs=uncond_kwargs,
+            rng=rng,
+            karras=karras,
+            callback=callback,
+            **kwargs,
+        )
         return _to_images(self.vae.decode(latents))
 
 
@@ -210,7 +171,12 @@ class FluxPipeline:
         if rng is None:
             rng = jax.random.key(0)
         f = self.vae.spatial_factor
-        patch = getattr(getattr(self.dit, "config", None), "patch_size", 2)
+        # ParallelModel keeps the wrapped model's config on .model_config (its own
+        # .config is the ParallelConfig, which has no patch_size).
+        model_cfg = getattr(self.dit, "model_config", None)
+        if model_cfg is None:
+            model_cfg = getattr(self.dit, "config", None)
+        patch = getattr(model_cfg, "patch_size", 2)
         unit = f * patch  # VAE factor x DiT patchify
         if height % unit or width % unit:
             raise ValueError(f"height/width must be multiples of {unit}")
@@ -229,10 +195,11 @@ class FluxPipeline:
         noise = jax.random.normal(
             rng, (B, height // f, width // f, zc), jnp.float32
         )
-        latents = flow_euler_sample(
+        latents = run_sampler(
             self.dit,
             noise,
             context,
+            sampler="flow_euler",
             steps=steps,
             shift=shift,
             guidance=guidance,

@@ -16,8 +16,15 @@ def double_kwargs(
 ) -> dict:
     """Concatenate cond ‖ uncond along dim0 for every kwarg whose leading dim is
     the batch; non-batch kwargs pass through. Missing uncond entries reuse the
-    cond value."""
+    cond value. A key present ONLY in uncond_kwargs is an inconsistency (the cond
+    half would run without it) — rejected loudly rather than silently dropped."""
     uncond = uncond_kwargs or {}
+    extra = set(uncond) - set(kwargs)
+    if extra:
+        raise ValueError(
+            f"uncond_kwargs keys {sorted(extra)} have no cond counterpart — "
+            "cond and uncond conditioning must carry the same kwargs"
+        )
     out = {}
     for k, v in kwargs.items():
         if hasattr(v, "shape") and v.shape[:1] == (batch,):

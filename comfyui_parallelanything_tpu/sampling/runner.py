@@ -1,0 +1,86 @@
+"""Single sampler dispatch shared by pipelines.py and the TPUKSampler node.
+
+One table, one CFG plumbing, one noise-scaling convention — so a sampler added
+here is immediately available to both the Python pipeline API and the node graph
+(and they cannot drift apart)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .ddim import ddim_sample
+from .flow import flow_euler_sample
+from .k_samplers import (
+    EpsDenoiser,
+    karras_sigmas,
+    sample_dpmpp_2m,
+    sample_euler,
+    sample_euler_ancestral,
+    sample_heun,
+    sampling_sigmas,
+)
+
+K_SAMPLERS: dict[str, Callable] = {
+    "euler": sample_euler,
+    "euler_ancestral": sample_euler_ancestral,
+    "heun": sample_heun,
+    "dpmpp_2m": sample_dpmpp_2m,
+}
+
+SAMPLER_NAMES = ("ddim", *K_SAMPLERS, "flow_euler")
+
+
+def run_sampler(
+    model,
+    noise: jnp.ndarray,
+    context,
+    *,
+    sampler: str,
+    steps: int,
+    cfg_scale: float = 1.0,
+    uncond_context=None,
+    uncond_kwargs: dict | None = None,
+    rng=None,
+    karras: bool = True,
+    shift: float = 1.0,
+    guidance: float | None = None,
+    callback=None,
+    **model_kwargs,
+) -> jnp.ndarray:
+    """Drive ``model`` from ``noise`` to a clean latent with the named sampler.
+
+    ``noise`` is unit-variance N(0,1); eps-family samplers scale it to sigma_max
+    internally. ``shift``/``guidance`` apply to ``flow_euler`` only."""
+    use_cfg = cfg_scale != 1.0 and uncond_context is not None
+    eff_cfg = cfg_scale if use_cfg else 1.0
+    if sampler == "flow_euler":
+        return flow_euler_sample(
+            model, noise, context, steps=steps, shift=shift, guidance=guidance,
+            cfg_scale=eff_cfg, uncond_context=uncond_context,
+            uncond_kwargs=uncond_kwargs, callback=callback, **model_kwargs,
+        )
+    if sampler == "ddim":
+        return ddim_sample(
+            model, noise, context, steps=steps, cfg_scale=eff_cfg,
+            uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
+            callback=callback, **model_kwargs,
+        )
+    step_fn = K_SAMPLERS.get(sampler)
+    if step_fn is None:
+        raise ValueError(
+            f"unknown sampler {sampler!r} (have {', '.join(SAMPLER_NAMES)})"
+        )
+    sigmas = karras_sigmas(steps) if karras else sampling_sigmas(steps)
+    denoise = EpsDenoiser(
+        model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,
+        uncond_kwargs=uncond_kwargs, **model_kwargs,
+    )
+    x = noise * sigmas[0]
+    if sampler == "euler_ancestral":
+        if rng is None:
+            rng = jax.random.key(0)
+        return step_fn(denoise, x, sigmas, jax.random.fold_in(rng, 1), callback=callback)
+    return step_fn(denoise, x, sigmas, callback=callback)

@@ -1,0 +1,161 @@
+"""Host-layer nodes: the ComfyUI core-graph equivalents this framework supplies
+standalone (the reference relies on its host for all of these — SURVEY §2g).
+
+The headline test wires the full workflow node-for-node:
+TextEncode ×2 → ParallelAnything(model) → KSampler → VAEDecode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.models import (
+    CLIPTextConfig,
+    VAEConfig,
+    build_clip_text,
+    build_unet,
+    build_vae,
+    sd15_config,
+)
+from comfyui_parallelanything_tpu.nodes import (
+    NODE_CLASS_MAPPINGS,
+    NODE_DISPLAY_NAME_MAPPINGS,
+    ParallelAnything,
+    ParallelDevice,
+    TPUConditioningCombine,
+    TPUEmptyLatent,
+    TPUKSampler,
+    TPUTextEncode,
+    TPUVAEDecode,
+)
+
+from test_tokenizer import _tiny_tokenizer
+
+
+@pytest.fixture(scope="module")
+def graph_parts():
+    tok = _tiny_tokenizer()
+    ccfg = CLIPTextConfig(
+        vocab_size=64, hidden_size=48, num_layers=2, num_heads=4, max_len=8,
+        eos_id=tok.eos_id, dtype=jnp.float32,
+    )
+    ucfg = sd15_config(
+        model_channels=32, channel_mult=(1, 2), transformer_depth=(1, 1),
+        attention_levels=(0, 1), context_dim=48, num_heads=4, norm_groups=8,
+        dtype=jnp.float32,
+    )
+    vcfg = VAEConfig(
+        z_channels=4, base_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+        norm_groups=8, dtype=jnp.float32,
+    )
+    clip_wire = {
+        "encoder": build_clip_text(ccfg, jax.random.key(0)),
+        "tokenizer": tok,
+        "type": "clip-l",
+    }
+    model = build_unet(ucfg, jax.random.key(1), sample_shape=(1, 8, 8, 4))
+    vae = build_vae(vcfg, jax.random.key(2), sample_hw=16)
+    return clip_wire, model, vae
+
+
+class TestConditioningCombine:
+    def test_sdxl_mode_assembles_2048_context_and_2816_pooled(self):
+        a = {"context": jnp.zeros((1, 8, 768)), "penultimate": jnp.zeros((1, 8, 768)),
+             "pooled": jnp.zeros((1, 768))}
+        b = {"context": jnp.zeros((1, 8, 1280)), "penultimate": jnp.zeros((1, 8, 1280)),
+             "pooled": jnp.zeros((1, 1280))}
+        (cond,) = TPUConditioningCombine().combine(a, b, "sdxl", width=1024, height=1024)
+        assert cond["context"].shape == (1, 8, 2048)
+        assert cond["pooled"].shape == (1, 2816)
+
+    def test_flux_mode_merges_t5_context_with_clip_pooled(self):
+        t5 = {"context": jnp.zeros((1, 32, 64)), "pooled": None}
+        clip = {"context": jnp.zeros((1, 8, 48)), "pooled": jnp.zeros((1, 16))}
+        (cond,) = TPUConditioningCombine().combine(t5, clip, "flux")
+        assert cond["context"].shape == (1, 32, 64)
+        assert cond["pooled"].shape == (1, 16)
+
+    def test_missing_towers_rejected(self):
+        t5 = {"context": jnp.zeros((1, 32, 64)), "pooled": None}
+        with pytest.raises(ValueError, match="flux mode"):
+            TPUConditioningCombine().combine(t5, t5, "flux")
+        with pytest.raises(ValueError, match="sdxl mode"):
+            TPUConditioningCombine().combine(t5, t5, "sdxl")
+
+
+class TestRegistration:
+    def test_all_nodes_registered_with_display_names(self):
+        assert set(NODE_CLASS_MAPPINGS) == set(NODE_DISPLAY_NAME_MAPPINGS)
+        for name, cls in NODE_CLASS_MAPPINGS.items():
+            assert hasattr(cls, "INPUT_TYPES") and hasattr(cls, "FUNCTION"), name
+            assert hasattr(cls, "RETURN_TYPES"), name
+            # FUNCTION names a real method (the host calls it via getattr).
+            assert callable(getattr(cls, cls.FUNCTION, None)), name
+
+    def test_host_nodes_present(self):
+        for key in ("TPUCheckpointLoader", "TPUCLIPLoader", "TPUTextEncode",
+                    "TPUEmptyLatent", "TPUKSampler", "TPUVAEDecode"):
+            assert key in NODE_CLASS_MAPPINGS
+
+
+class TestFullNodeGraph:
+    def test_workflow_text_to_image(self, graph_parts):
+        clip_wire, model, vae = graph_parts
+
+        # CLIPTextEncode x2 (positive / negative)
+        (positive,) = TPUTextEncode().encode(clip_wire, "hello world")
+        (negative,) = TPUTextEncode().encode(clip_wire, "world")
+        assert positive["context"].shape == (1, 8, 48)
+
+        # ParallelDevice -> ParallelAnything (the reference's own node path)
+        (chain,) = ParallelDevice().add_device("cpu:0", 50.0)
+        (chain,) = ParallelDevice().add_device("cpu:1", 50.0, chain)
+        (pmodel,) = ParallelAnything().setup_parallel(model, chain)
+
+        # EmptyLatent -> KSampler -> VAEDecode
+        (latent,) = TPUEmptyLatent().generate(width=16, height=16, batch_size=1)
+        assert latent["samples"].shape == (1, 2, 2, 4)
+        (latent,) = TPUEmptyLatent().generate(width=128, height=128, batch_size=2)
+        (sampled,) = TPUKSampler().sample(
+            pmodel, positive, latent, seed=3, steps=2, cfg=4.0,
+            sampler_name="dpmpp_2m", negative=negative,
+        )
+        assert sampled["samples"].shape == latent["samples"].shape
+        (image,) = TPUVAEDecode().decode(vae, sampled)
+        a = np.asarray(image)
+        assert a.shape == (2, 32, 32, 3)
+        assert np.isfinite(a).all() and a.min() >= 0.0 and a.max() <= 1.0
+
+    def test_ksampler_ddim_and_no_negative(self, graph_parts):
+        clip_wire, model, _ = graph_parts
+        (positive,) = TPUTextEncode().encode(clip_wire, "hello")
+        (latent,) = TPUEmptyLatent().generate(width=64, height=64, batch_size=1)
+        (out,) = TPUKSampler().sample(
+            model, positive, latent, seed=0, steps=1, cfg=1.0, sampler_name="ddim",
+        )
+        assert out["samples"].shape == (1, 8, 8, 4)
+
+    def test_vae_decode_tiled_path(self, graph_parts):
+        _, _, vae = graph_parts
+        latent = {"samples": jax.random.normal(jax.random.key(5), (1, 24, 24, 4))}
+        (img,) = TPUVAEDecode().decode(vae, latent, tile_size=16)
+        assert np.asarray(img).shape == (1, 48, 48, 3)
+
+    def test_conditioning_batch_must_divide(self, graph_parts):
+        clip_wire, model, _ = graph_parts
+        (pos,) = TPUTextEncode().encode(clip_wire, "hello")
+        pos = {**pos, "context": jnp.concatenate([pos["context"]] * 2)}
+        (latent,) = TPUEmptyLatent().generate(width=64, height=64, batch_size=3)
+        with pytest.raises(ValueError, match="does not divide"):
+            TPUKSampler().sample(
+                model, pos, latent, seed=0, steps=1, cfg=1.0, sampler_name="euler"
+            )
+
+    def test_seed_determinism(self, graph_parts):
+        clip_wire, model, _ = graph_parts
+        (positive,) = TPUTextEncode().encode(clip_wire, "hello")
+        (latent,) = TPUEmptyLatent().generate(width=64, height=64, batch_size=1)
+        kw = dict(seed=7, steps=1, cfg=1.0, sampler_name="euler")
+        (a,) = TPUKSampler().sample(model, positive, latent, **kw)
+        (b,) = TPUKSampler().sample(model, positive, latent, **kw)
+        np.testing.assert_array_equal(np.asarray(a["samples"]), np.asarray(b["samples"]))

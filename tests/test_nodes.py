@@ -25,12 +25,14 @@ from comfyui_parallelanything_tpu.parallel.orchestrator import ParallelModel
 
 class TestNodeProtocol:
     def test_mappings_complete(self):
-        assert set(NODE_CLASS_MAPPINGS) == {
+        # Reference-parity nodes (SURVEY §2a) must all be present; host-layer
+        # additions (TPU* nodes, covered in test_host_nodes.py) ride alongside.
+        assert {
             "ParallelAnything",
             "ParallelAnythingAdvanced",
             "ParallelDevice",
             "ParallelDeviceList",
-        }
+        } <= set(NODE_CLASS_MAPPINGS)
         assert set(NODE_DISPLAY_NAME_MAPPINGS) == set(NODE_CLASS_MAPPINGS)
 
     def test_declarative_contract(self):
