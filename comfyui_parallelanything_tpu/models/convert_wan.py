@@ -1,0 +1,95 @@
+"""WAN video-DiT checkpoint (official Wan2.x layout) → models/wan.py param tree.
+
+The reference lists WAN2.2 among its tested workloads (/root/reference/README.md:5)
+and replicates the torch module per device; here the official safetensors layout
+converts once into the functional param tree. Layout map (module names on the left
+are the public Wan2.x release's):
+
+- ``patch_embedding``            — Conv3d with kernel == stride == patch_size; its
+  (O, C, pt, ph, pw) weight folds into our patchify Dense by transposing to
+  (pt, ph, pw, C, O) and flattening — exactly the (pt, ph, pw, C) token order
+  WanModel.prepare emits.
+- ``text_embedding.0/.2``        → ``text_in`` / ``text_hidden``
+- ``time_embedding.0/.2``        → ``time_in`` / ``time_hidden``
+- ``time_projection.1``          → ``time_projection``
+- ``blocks.{i}.self_attn.{q,k,v,o}``        → ``blocks_{i}.self_{q,k,v,o}``
+- ``blocks.{i}.self_attn.norm_{q,k}.weight``→ ``blocks_{i}.self_{q,k}_norm.scale``
+- ``blocks.{i}.cross_attn...``              → ``blocks_{i}.cross_*`` (same pattern)
+- ``blocks.{i}.norm3.{weight,bias}``        → ``blocks_{i}.norm3`` (affine pre-norm;
+  norm1/norm2 are affine-free in both implementations — no weights to map)
+- ``blocks.{i}.ffn.0/.2``                   → ``blocks_{i}.ffn_in`` / ``ffn_out``
+- ``blocks.{i}.modulation``                 → ``blocks_{i}.modulation`` (1, 6, D)
+- ``head.head``                             → ``head_proj``
+- ``head.modulation``                       → ``head_modulation`` (1, 2, D)
+
+Ignored on purpose: ``img_emb.*`` (the i2v variant's CLIP-image branch — t2v parity
+scope) and any ema/optimizer sidecars.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+from .convert import linear_kernel, to_numpy, tree_to_jnp
+from .wan import WanConfig
+
+
+def _dense(sd: Mapping[str, Any], key: str, bias: bool = True) -> dict:
+    out = {"kernel": linear_kernel(sd[f"{key}.weight"])}
+    if bias and f"{key}.bias" in sd:
+        out["bias"] = to_numpy(sd[f"{key}.bias"])
+    return out
+
+
+def _rms(sd: Mapping[str, Any], key: str) -> dict:
+    return {"scale": to_numpy(sd[f"{key}.weight"])}
+
+
+def _ln(sd: Mapping[str, Any], key: str) -> dict:
+    return {"scale": to_numpy(sd[f"{key}.weight"]), "bias": to_numpy(sd[f"{key}.bias"])}
+
+
+def convert_wan_checkpoint(state_dict: Mapping[str, Any], cfg: WanConfig) -> dict:
+    """Official WAN state dict → the param pytree of ``models.wan.WanModel``
+    (pass to ``build_wan(cfg, params=...)``)."""
+    sd = dict(state_dict)
+
+    # Conv3d patchify (O, C, pt, ph, pw) → Dense kernel (pt·ph·pw·C, O) in the
+    # (pt, ph, pw, C) flattening order of WanModel.prepare.
+    w = to_numpy(sd["patch_embedding.weight"])
+    pe_kernel = w.transpose(2, 3, 4, 1, 0).reshape(-1, w.shape[0])
+    p: dict[str, Any] = {
+        "patch_embedding": {
+            "kernel": pe_kernel,
+            "bias": to_numpy(sd["patch_embedding.bias"]),
+        },
+        "text_in": _dense(sd, "text_embedding.0"),
+        "text_hidden": _dense(sd, "text_embedding.2"),
+        "time_in": _dense(sd, "time_embedding.0"),
+        "time_hidden": _dense(sd, "time_embedding.2"),
+        "time_projection": _dense(sd, "time_projection.1"),
+        "head_proj": _dense(sd, "head.head"),
+        "head_modulation": {"bias": to_numpy(sd["head.modulation"])},
+    }
+    for i in range(cfg.depth):
+        t = f"blocks.{i}"
+        p[f"blocks_{i}"] = {
+            "self_q": _dense(sd, f"{t}.self_attn.q"),
+            "self_k": _dense(sd, f"{t}.self_attn.k"),
+            "self_v": _dense(sd, f"{t}.self_attn.v"),
+            "self_o": _dense(sd, f"{t}.self_attn.o"),
+            "self_q_norm": _rms(sd, f"{t}.self_attn.norm_q"),
+            "self_k_norm": _rms(sd, f"{t}.self_attn.norm_k"),
+            "cross_q": _dense(sd, f"{t}.cross_attn.q"),
+            "cross_k": _dense(sd, f"{t}.cross_attn.k"),
+            "cross_v": _dense(sd, f"{t}.cross_attn.v"),
+            "cross_o": _dense(sd, f"{t}.cross_attn.o"),
+            "cross_q_norm": _rms(sd, f"{t}.cross_attn.norm_q"),
+            "cross_k_norm": _rms(sd, f"{t}.cross_attn.norm_k"),
+            "norm3": _ln(sd, f"{t}.norm3"),
+            "ffn_in": _dense(sd, f"{t}.ffn.0"),
+            "ffn_out": _dense(sd, f"{t}.ffn.2"),
+            "modulation": to_numpy(sd[f"{t}.modulation"]),
+        }
+    return tree_to_jnp(p)

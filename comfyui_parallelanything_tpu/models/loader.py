@@ -170,9 +170,10 @@ def load_wan_checkpoint(
     params_converter=None,
     name: str = "wan",
 ) -> DiffusionModel:
-    """WAN checkpoint → DiffusionModel. WAN repacks vary; pass ``params_converter``
-    (state_dict, cfg) -> params to supply the layout mapping, or a pre-converted
-    param pytree as ``src``."""
+    """WAN checkpoint → DiffusionModel. The official Wan2.x layout converts via
+    ``convert_wan_checkpoint`` by default; pass ``params_converter`` (state_dict,
+    cfg) -> params for repacked layouts, or a pre-converted param pytree as
+    ``src``."""
     import jax
 
     if params_converter is not None:
@@ -182,7 +183,14 @@ def load_wan_checkpoint(
         # leaf (bf16/fp8 storage dtypes included), same as the file-load path.
         params = jax.tree.map(to_numpy, src)
     else:
-        raise ValueError(
-            "WAN loading needs params_converter or an already-converted param pytree"
-        )
+        from .convert_wan import convert_wan_checkpoint
+
+        try:
+            params = convert_wan_checkpoint(_resolve_state_dict(src), cfg)
+        except KeyError as e:
+            raise ValueError(
+                f"state dict is not the official Wan2.x layout (missing {e}); "
+                "pass params_converter=(state_dict, cfg) -> params for repacked "
+                "layouts, or a pre-converted param pytree"
+            ) from e
     return build_wan(cfg, name=name, params=params)

@@ -69,7 +69,8 @@ def wan_14b_config(**overrides) -> WanConfig:
 
 
 class _RMSNorm(nn.Module):
-    """Per-head RMSNorm in f32 with a learned scale (WAN q/k norm)."""
+    """RMSNorm in f32 with a learned scale over the last dim (WAN q/k norm runs
+    over the full H·D inner dim before the head split)."""
 
     eps: float = 1e-6
 
@@ -77,6 +78,22 @@ class _RMSNorm(nn.Module):
     def __call__(self, x):
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
         return rms_normalize(x, scale, self.eps)
+
+
+class _HeadModulation(nn.Module):
+    """Learned (1, 2, D) bias + time vector → head shift/scale (the public WAN
+    head). A submodule (not a bare ``self.param`` in setup) so its parameter is
+    initialized lazily — pipeline stages that never run the head don't need it in
+    their param subtree."""
+
+    hidden: int
+
+    @nn.compact
+    def __call__(self, vec):
+        mod = self.param(
+            "bias", nn.initializers.normal(0.02), (1, 2, self.hidden)
+        )
+        return mod + vec[:, None, :]
 
 
 class WanBlock(nn.Module):
@@ -99,31 +116,39 @@ class WanBlock(nn.Module):
             e[:, i][:, None, :] for i in range(6)
         )
 
+        B, S, _ = x.shape
+
         # -- self-attention over all space-time tokens ----------------------------
+        # q/k RMSNorm runs over the FULL inner dim (H·D) before the head split —
+        # the public WAN convention (norm_q/norm_k are RMSNorm(dim)); per-head
+        # norm would be numerically different and break checkpoint fidelity.
         h = _modulate(
             nn.LayerNorm(use_bias=False, use_scale=False, dtype=cfg.dtype, name="norm1")(x),
             shift1, scale1,
         )
-        q = nn.DenseGeneral((H, D), dtype=cfg.dtype, name="self_q")(h)
-        k = nn.DenseGeneral((H, D), dtype=cfg.dtype, name="self_k")(h)
-        v = nn.DenseGeneral((H, D), dtype=cfg.dtype, name="self_v")(h)
-        q = _RMSNorm(cfg.qk_norm_eps, name="self_q_norm")(q)
-        k = _RMSNorm(cfg.qk_norm_eps, name="self_k_norm")(k)
+        q = nn.Dense(H * D, dtype=cfg.dtype, name="self_q")(h)
+        k = nn.Dense(H * D, dtype=cfg.dtype, name="self_k")(h)
+        v = nn.Dense(H * D, dtype=cfg.dtype, name="self_v")(h)
+        q = _RMSNorm(cfg.qk_norm_eps, name="self_q_norm")(q).reshape(B, S, H, D)
+        k = _RMSNorm(cfg.qk_norm_eps, name="self_k_norm")(k).reshape(B, S, H, D)
+        v = v.reshape(B, S, H, D)
         cos, sin = rope
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        attn = attention(q, k, v).reshape(x.shape[0], x.shape[1], -1)
+        attn = attention(q, k, v).reshape(B, S, -1)
         attn = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="self_o")(attn)
         x = x + gate1.astype(cfg.dtype) * attn
 
         # -- cross-attention to text (no rope, no gate; affine pre-norm) ----------
+        L = context.shape[1]
         h = nn.LayerNorm(dtype=cfg.dtype, name="norm3")(x)
-        q = nn.DenseGeneral((H, D), dtype=cfg.dtype, name="cross_q")(h)
-        k = nn.DenseGeneral((H, D), dtype=cfg.dtype, name="cross_k")(context)
-        v = nn.DenseGeneral((H, D), dtype=cfg.dtype, name="cross_v")(context)
-        q = _RMSNorm(cfg.qk_norm_eps, name="cross_q_norm")(q)
-        k = _RMSNorm(cfg.qk_norm_eps, name="cross_k_norm")(k)
-        attn = attention(q, k, v).reshape(x.shape[0], x.shape[1], -1)
+        q = nn.Dense(H * D, dtype=cfg.dtype, name="cross_q")(h)
+        k = nn.Dense(H * D, dtype=cfg.dtype, name="cross_k")(context)
+        v = nn.Dense(H * D, dtype=cfg.dtype, name="cross_v")(context)
+        q = _RMSNorm(cfg.qk_norm_eps, name="cross_q_norm")(q).reshape(B, S, H, D)
+        k = _RMSNorm(cfg.qk_norm_eps, name="cross_k_norm")(k).reshape(B, L, H, D)
+        v = v.reshape(B, L, H, D)
+        attn = attention(q, k, v).reshape(B, S, -1)
         x = x + nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="cross_o")(attn)
 
         # -- FFN -------------------------------------------------------------------
@@ -140,7 +165,7 @@ class WanModel(nn.Module):
     """forward(x video latent (B, T, H, W, C), timesteps (B,), context (B, L, text_dim)).
 
     Setup-style for the staged pipeline decomposition (same protocol as FluxModel):
-    carry = {x, context, e, rope_cos, rope_sin}.
+    carry = {x, context, e, vec, rope_cos, rope_sin}.
     """
 
     cfg: WanConfig
@@ -154,7 +179,9 @@ class WanModel(nn.Module):
         self.time_hidden = nn.Dense(cfg.hidden_size, dtype=jnp.float32)
         self.time_projection = nn.Dense(6 * cfg.hidden_size, dtype=jnp.float32)
         self.blocks = [WanBlock(cfg) for _ in range(cfg.depth)]
-        self.head_mod = nn.Dense(2 * cfg.hidden_size, dtype=jnp.float32)
+        # Head modulation is a learned (1, 2, D) bias added to the time vector —
+        # the public WAN head (head.modulation + e), NOT a projection.
+        self.head_modulation = _HeadModulation(cfg.hidden_size)
         self.head_norm = nn.LayerNorm(use_bias=False, use_scale=False, dtype=cfg.dtype)
         pt, ph, pw = cfg.patch_size
         self.head_proj = nn.Dense(pt * ph * pw * cfg.out_channels, dtype=jnp.float32)
@@ -186,6 +213,7 @@ class WanModel(nn.Module):
             )
         )
         e = self.time_projection(nn.silu(vec)).reshape(B, 6, cfg.hidden_size)
+        vec = vec.astype(jnp.float32)  # carried for the head modulation
 
         # 3-axis (t, h, w) position ids for RoPE.
         tt = jnp.arange(tp, dtype=jnp.int32)
@@ -196,7 +224,10 @@ class WanModel(nn.Module):
         ).reshape(1, tp * hp * wp, 3)
         ids = jnp.broadcast_to(grid, (B, tp * hp * wp, 3))
         cos, sin = axis_rope_freqs(ids, self.cfg.axes_dim, cfg.theta)
-        return {"x": tok, "context": ctx, "e": e, "rope_cos": cos, "rope_sin": sin}
+        return {
+            "x": tok, "context": ctx, "e": e, "vec": vec,
+            "rope_cos": cos, "rope_sin": sin,
+        }
 
     def block_step(self, carry, i: int):
         x = self.blocks[i](
@@ -210,10 +241,9 @@ class WanModel(nn.Module):
         B, T, Hh, Ww, _ = out_shape
         pt, ph, pw = cfg.patch_size
         tp, hp, wp = T // pt, Hh // ph, Ww // pw
-        x, e = carry["x"], carry["e"]
-        # Head modulation derives from the e chunks' mean (per-sample vector).
-        vec = e.mean(axis=1)
-        shift, scale = jnp.split(self.head_mod(nn.silu(vec))[:, None, :], 2, axis=-1)
+        x, vec = carry["x"], carry["vec"]
+        mod = self.head_modulation(vec)
+        shift, scale = mod[:, 0][:, None, :], mod[:, 1][:, None, :]
         x = _modulate(self.head_norm(x), shift, scale)
         x = self.head_proj(x.astype(jnp.float32))
         x = x.reshape(B, tp, hp, wp, pt, ph, pw, cfg.out_channels)
@@ -252,7 +282,7 @@ def _wan_pipeline_spec(module: WanModel, cfg: WanConfig) -> PipelineSpec:
             PipelineSegment((f"blocks_{i}",), make_block(i), f"blocks[{i}]")
             for i in range(cfg.depth)
         ),
-        finalize_keys=("head_mod", "head_proj"),
+        finalize_keys=("head_modulation", "head_proj"),
         finalize=finalize,
     )
 

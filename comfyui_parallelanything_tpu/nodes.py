@@ -411,10 +411,15 @@ class TPUCLIPLoader:
             )
             if tokenizer_json:
                 tok = load_tokenizer_json(tokenizer_json, max_len=max_len)
-            else:
+            elif vocab_path and merges_path:
                 tok = CLIPBPETokenizer.from_files(
                     vocab_path, merges_path, max_len=max_len,
                     pad_id=0 if encoder_type == "open-clip-g" else None,
+                )
+            else:
+                raise ValueError(
+                    "CLIP loading needs tokenizer_json OR both vocab_path and "
+                    "merges_path"
                 )
         return ({"encoder": enc, "tokenizer": tok, "type": encoder_type},)
 
@@ -627,9 +632,19 @@ class TPUKSampler:
 
         context = bcast(positive["context"])
         pooled = bcast(positive.get("pooled"))
-        model_cfg = getattr(model, "model_config", None)
-        if model_cfg is None:
-            model_cfg = getattr(model, "config", None)
+        from .parallel.orchestrator import model_config_of
+
+        model_cfg = model_config_of(model)
+        patch = getattr(model_cfg, "patch_size", None)
+        if isinstance(patch, int):
+            # Validate spatial divisibility at the node boundary — a mismatch
+            # otherwise dies deep in patchify with an opaque reshape error.
+            bad = [d for d in shape[1:3] if d % patch]
+            if bad:
+                raise ValueError(
+                    f"latent spatial dims {shape[1:3]} must be multiples of the "
+                    f"model patch size {patch}"
+                )
         if pooled is None and hasattr(model_cfg, "vec_in_dim"):
             from .utils.logging import get_logger
 

@@ -489,6 +489,16 @@ class ParallelModel:
 # --------------------------------------------------------------------------------------
 
 
+def model_config_of(model) -> Any:
+    """The underlying model's own config (FluxConfig/UNetConfig/WanConfig/...),
+    whether ``model`` is bare or a ParallelModel — whose ``.config`` is the
+    ParallelConfig, with the wrapped config kept on ``.model_config``."""
+    cfg = getattr(model, "model_config", None)
+    if cfg is None:
+        cfg = getattr(model, "config", None)
+    return cfg
+
+
 def _unwrap_model(model) -> tuple[Callable[..., Any], Any]:
     """Accept ``(apply_fn, params)`` or any object with ``.apply`` + ``.params`` —
     the duck-typed analogue of the ModelPatcher unwrap (921-930)."""

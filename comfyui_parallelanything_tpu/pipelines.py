@@ -171,12 +171,9 @@ class FluxPipeline:
         if rng is None:
             rng = jax.random.key(0)
         f = self.vae.spatial_factor
-        # ParallelModel keeps the wrapped model's config on .model_config (its own
-        # .config is the ParallelConfig, which has no patch_size).
-        model_cfg = getattr(self.dit, "model_config", None)
-        if model_cfg is None:
-            model_cfg = getattr(self.dit, "config", None)
-        patch = getattr(model_cfg, "patch_size", 2)
+        from .parallel.orchestrator import model_config_of
+
+        patch = getattr(model_config_of(self.dit), "patch_size", 2)
         unit = f * patch  # VAE factor x DiT patchify
         if height % unit or width % unit:
             raise ValueError(f"height/width must be multiples of {unit}")
