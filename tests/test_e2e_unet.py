@@ -63,8 +63,11 @@ class TestEndToEnd:
             pm, x, ctx, steps=3, cfg_scale=3.0, uncond_context=uncond
         )
         assert img_sharded.shape == (8, 16, 16, 4)
+        # Tolerance is relative to the output scale (|values| up to ~35): the
+        # sharded and single-device programs fuse differently, and 3 DDIM steps
+        # compound the per-step drift.
         np.testing.assert_allclose(
-            np.asarray(img_sharded), np.asarray(img_single), rtol=2e-3, atol=2e-3
+            np.asarray(img_sharded), np.asarray(img_single), rtol=2e-3, atol=2e-2
         )
 
     def test_cfg_doubles_feed_the_mesh(self, tiny_unet):
