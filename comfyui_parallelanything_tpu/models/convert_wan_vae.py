@@ -1,0 +1,157 @@
+"""WAN video-VAE checkpoint (official Wan2.x layout) → models/video_vae.py params.
+
+The reference's WAN2.2 workload (/root/reference/README.md:5) decodes through the
+host app's torch VAE; standalone, the official ``Wan2.x_VAE.pth``-style state dict
+converts once into the functional param tree here. Layout map (torch names left):
+
+- ``encoder.conv1`` / ``decoder.conv1``      → ``{en,de}coder/conv_in``
+- ``encoder.head.{0,2}`` / ``decoder.head.{0,2}`` → ``norm_out`` / ``conv_out``
+  (index 1 is the parameterless SiLU)
+- ``conv1`` / ``conv2`` (top level)          → ``quant_conv`` / ``post_quant_conv``
+- ``encoder.downsamples.{seq}`` — a flat Sequential; indices are recomputed here
+  from the config: per level ``num_res_blocks`` ResidualBlocks then (below the
+  last level) one Resample. ResidualBlock subkeys: ``residual.0``/``residual.3``
+  (RMS norms), ``residual.2``/``residual.6`` (causal convs), ``shortcut`` when
+  channels change. Resample subkeys: ``resample.1`` (spatial conv behind the
+  ZeroPad/Upsample at index 0) and ``time_conv`` for the 3d modes.
+- ``decoder.upsamples.{seq}`` — same flattening with ``num_res_blocks + 1``
+  blocks per level.
+- ``encoder.middle.{0,1,2}`` / ``decoder.middle.{0,1,2}`` → ``mid_block_1`` /
+  ``mid_attn_1`` / ``mid_block_2``; the attention block's ``to_qkv``/``proj``
+  are per-frame 1×1 Conv2d, its norm an RMS norm whose optional bias we zero-fill.
+
+Transforms: Conv3d (O,I,T,H,W) → (T,H,W,I,O); Conv2d (O,I,H,W) → (1,H,W,I,O);
+RMS gammas (C,1,1[,1]) → (C,). Semantics note (documented divergence): the torch
+implementation streams 4-frame chunks through per-conv feature caches; this
+framework runs the whole clip as one causal fixed-shape program. Weights map 1:1,
+interior frames match; the torch streaming seam-handling at the very first chunk
+is replaced by explicit causal front-padding.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+
+from .convert import to_numpy, tree_to_jnp
+from .video_vae import VideoVAEConfig
+
+
+def _conv3d(sd: Mapping[str, Any], key: str) -> dict:
+    w = to_numpy(sd[f"{key}.weight"])
+    out = {"kernel": w.transpose(2, 3, 4, 1, 0)}
+    if f"{key}.bias" in sd:
+        out["bias"] = to_numpy(sd[f"{key}.bias"])
+    return {"conv": out}
+
+
+def _conv2d(sd: Mapping[str, Any], key: str) -> dict:
+    w = to_numpy(sd[f"{key}.weight"])
+    out = {"kernel": w.transpose(2, 3, 1, 0)[None]}
+    if f"{key}.bias" in sd:
+        out["bias"] = to_numpy(sd[f"{key}.bias"])
+    return out
+
+
+def _rms(sd: Mapping[str, Any], key: str, want_bias: bool = False) -> dict:
+    gamma = to_numpy(sd[f"{key}.gamma"]).reshape(-1)
+    out = {"scale": gamma}
+    if want_bias:
+        bias = sd.get(f"{key}.bias")
+        out["bias"] = (
+            to_numpy(bias).reshape(-1)
+            if bias is not None
+            else np.zeros_like(gamma)
+        )
+    return out
+
+
+def _res_block(sd: Mapping[str, Any], key: str) -> dict:
+    out = {
+        "norm1": _rms(sd, f"{key}.residual.0"),
+        "conv1": _conv3d(sd, f"{key}.residual.2"),
+        "norm2": _rms(sd, f"{key}.residual.3"),
+        "conv2": _conv3d(sd, f"{key}.residual.6"),
+    }
+    if f"{key}.shortcut.weight" in sd:
+        out["shortcut"] = _conv3d(sd, f"{key}.shortcut")
+    return out
+
+
+def _attn_block(sd: Mapping[str, Any], key: str) -> dict:
+    return {
+        "norm": _rms(sd, f"{key}.norm", want_bias=True),
+        "to_qkv": _conv2d(sd, f"{key}.to_qkv"),
+        "proj": _conv2d(sd, f"{key}.proj"),
+    }
+
+
+def _resample(sd: Mapping[str, Any], key: str, temporal: bool) -> dict:
+    # The spatial conv is a plain nn.Conv child named "conv"; the temporal one a
+    # CausalConv3d wrapper (hence the extra nesting level).
+    out: dict[str, Any] = {"conv": _conv2d(sd, f"{key}.resample.1")}
+    if temporal:
+        out["time_conv"] = _conv3d(sd, f"{key}.time_conv")
+    return out
+
+
+def convert_wan_vae_checkpoint(
+    state_dict: Mapping[str, Any], cfg: VideoVAEConfig
+) -> dict:
+    """Official WAN VAE state dict → the ``VideoAutoencoderKL`` param pytree
+    (pass to ``build_video_vae(cfg, params=...)``)."""
+    sd = dict(state_dict)
+    n = len(cfg.channel_mult)
+
+    enc: dict[str, Any] = {
+        "conv_in": _conv3d(sd, "encoder.conv1"),
+        "mid_block_1": _res_block(sd, "encoder.middle.0"),
+        "mid_attn_1": _attn_block(sd, "encoder.middle.1"),
+        "mid_block_2": _res_block(sd, "encoder.middle.2"),
+        "norm_out": _rms(sd, "encoder.head.0"),
+        "conv_out": _conv3d(sd, "encoder.head.2"),
+    }
+    seq = 0
+    for level in range(n):
+        for i in range(cfg.num_res_blocks):
+            enc[f"down_{level}_block_{i}"] = _res_block(
+                sd, f"encoder.downsamples.{seq}"
+            )
+            seq += 1
+        if level != n - 1:
+            enc[f"down_{level}_downsample"] = _resample(
+                sd, f"encoder.downsamples.{seq}", cfg.temporal_downsample[level]
+            )
+            seq += 1
+
+    dec: dict[str, Any] = {
+        "conv_in": _conv3d(sd, "decoder.conv1"),
+        "mid_block_1": _res_block(sd, "decoder.middle.0"),
+        "mid_attn_1": _attn_block(sd, "decoder.middle.1"),
+        "mid_block_2": _res_block(sd, "decoder.middle.2"),
+        "norm_out": _rms(sd, "decoder.head.0"),
+        "conv_out": _conv3d(sd, "decoder.head.2"),
+    }
+    temporal_up = tuple(reversed(cfg.temporal_downsample))
+    seq = 0
+    for j, level in enumerate(reversed(range(n))):
+        for i in range(cfg.num_res_blocks + 1):
+            dec[f"up_{level}_block_{i}"] = _res_block(
+                sd, f"decoder.upsamples.{seq}"
+            )
+            seq += 1
+        if j != n - 1:
+            dec[f"up_{level}_upsample"] = _resample(
+                sd, f"decoder.upsamples.{seq}", temporal_up[j]
+            )
+            seq += 1
+
+    params = {
+        "encoder": enc,
+        "decoder": dec,
+        "quant_conv": _conv3d(sd, "conv1"),
+        "post_quant_conv": _conv3d(sd, "conv2"),
+    }
+    return tree_to_jnp(params)

@@ -1,0 +1,32 @@
+"""Shared tile-placement and overlap-blend math for the tiled VAE decoders.
+
+Both the image VAE (models/vae.py) and the video VAE (models/video_vae.py) bound
+decoder activation memory by decoding fixed-size overlapping latent tiles and
+linearly blending the overlaps on the host; the per-axis start/mask arithmetic
+lives here once so the two decoders cannot drift."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def tile_starts(size: int, tile: int, stride: int) -> list[int]:
+    """Window starts covering ``size`` with ``tile``-long windows every
+    ``stride``; the last window slides back inside the extent (never pads)."""
+    if size <= tile:
+        return [0]
+    s = list(range(0, size - tile, stride))
+    s.append(size - tile)
+    return s
+
+
+def blend_mask1d(tile: int, overlap: int, factor: int) -> np.ndarray:
+    """Per-pixel blend weight along one axis for a decoded tile of ``tile``
+    latent cells upsampled by ``factor``: a linear ramp over the overlap region
+    at both ends, flat 1.0 in the interior."""
+    if overlap == 0:
+        return np.ones(tile * factor, np.float32)
+    ramp = np.minimum(np.arange(tile * factor) + 1, overlap * factor) / (
+        overlap * factor
+    )
+    return np.minimum(ramp, ramp[::-1]).astype(np.float32)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import attention_local
+from .tiling import blend_mask1d, tile_starts
 
 
 @dataclasses.dataclass(frozen=True)
@@ -277,30 +278,18 @@ class VAE:
         f = self.spatial_factor
         stride = tile - overlap
         decode = functools.partial(self._jitted(AutoencoderKL.decode), self.params)
-
-        def starts(size, t):
-            if size <= t:
-                return [0]
-            s = list(range(0, size - t, stride))
-            s.append(size - t)
-            return s
-
         th, tw = min(tile, H), min(tile, W)
-
-        def mask1d(t):
-            if overlap == 0:
-                return np.ones(t * f, np.float32)
-            ramp = np.minimum(np.arange(t * f) + 1, overlap * f) / (overlap * f)
-            return np.minimum(ramp, ramp[::-1]).astype(np.float32)
-
-        mask = (mask1d(th)[:, None] * mask1d(tw)[None, :])[None, :, :, None]
+        mask = (
+            blend_mask1d(th, overlap, f)[:, None]
+            * blend_mask1d(tw, overlap, f)[None, :]
+        )[None, :, :, None]
         # Accumulate on the host: the whole point of tiling is that full-resolution
         # buffers don't fit comfortably on-device; only one decoded tile lives in
         # HBM at a time, and the blend (memory-bound, not MXU work) runs in numpy.
         out = np.zeros((B, H * f, W * f, self.cfg.in_channels), np.float32)
         weight = np.zeros((1, H * f, W * f, 1), np.float32)
-        for hs in starts(H, th):
-            for ws in starts(W, tw):
+        for hs in tile_starts(H, th, stride):
+            for ws in tile_starts(W, tw, stride):
                 dec = np.asarray(
                     decode(z[:, hs : hs + th, ws : ws + tw, :]), np.float32
                 )

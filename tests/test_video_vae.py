@@ -1,0 +1,233 @@
+"""Video VAE: compression math, causal temporal semantics, tiled decode, and the
+WAN-layout converter round-trip (same strategy as test_convert_wan.py: invert the
+converter's transforms from fresh params, convert back, require bitwise identity,
+then same-program forward substitution)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tree_utils import flatten_tree
+
+from comfyui_parallelanything_tpu.models.convert_wan_vae import (
+    convert_wan_vae_checkpoint,
+)
+from comfyui_parallelanything_tpu.models.video_vae import (
+    VideoAutoencoderKL,
+    VideoVAEConfig,
+    build_video_vae,
+    wan_vae_config,
+)
+
+TINY = VideoVAEConfig(
+    base_channels=8,
+    channel_mult=(1, 2, 2),
+    num_res_blocks=1,
+    temporal_downsample=(False, True),
+    z_channels=4,
+    latent_mean=(0.0,) * 4,
+    latent_std=(1.0,) * 4,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_vae():
+    return build_video_vae(TINY, jax.random.key(0), sample_thw=(3, 8, 8))
+
+
+class TestConfigMath:
+    def test_wan_factors(self):
+        cfg = wan_vae_config()
+        assert cfg.spatial_factor == 8
+        assert cfg.temporal_factor == 4
+        assert cfg.latent_frames(81) == 21  # the WAN clip length convention
+        assert cfg.latent_frames(1) == 1  # single image degenerates cleanly
+
+    def test_frame_count_must_match_schedule(self):
+        with pytest.raises(ValueError):
+            wan_vae_config().latent_frames(80)
+
+
+class TestRoundTrip:
+    def test_shapes(self, tiny_vae):
+        T = 5  # 2k+1 for tf=2 → k+1 = 3 latent frames
+        x = jax.random.normal(jax.random.key(1), (2, T, 16, 16, 3))
+        z = tiny_vae.encode(x)
+        assert z.shape == (2, 3, 4, 4, TINY.z_channels)
+        y = tiny_vae.decode(z)
+        assert y.shape == x.shape
+        assert np.all(np.isfinite(np.asarray(y)))
+
+    def test_single_frame_is_an_image(self, tiny_vae):
+        x = jax.random.normal(jax.random.key(2), (1, 1, 16, 16, 3))
+        z = tiny_vae.encode(x)
+        assert z.shape == (1, 1, 4, 4, TINY.z_channels)
+        assert tiny_vae.decode(z).shape == x.shape
+
+    def test_encode_is_causal(self, tiny_vae):
+        """Perturbing the last pixel frame must leave earlier latent frames
+        untouched — every temporal conv is front-padded only."""
+        x = jax.random.normal(jax.random.key(3), (1, 5, 16, 16, 3))
+        z1 = np.asarray(tiny_vae.encode(x))
+        z2 = np.asarray(tiny_vae.encode(x.at[:, -1].add(10.0)))
+        per_frame = np.abs(z1 - z2).max(axis=(0, 2, 3, 4))
+        assert per_frame[:-1].max() < 1e-5
+        assert per_frame[-1] > 1e-3  # the perturbation does land somewhere
+
+    def test_latent_normalization_applied(self):
+        cfg = VideoVAEConfig(
+            base_channels=8,
+            channel_mult=(1, 2),
+            num_res_blocks=1,
+            temporal_downsample=(False,),
+            z_channels=4,
+            latent_mean=(1.0, 2.0, 3.0, 4.0),
+            latent_std=(2.0,) * 4,
+            dtype=jnp.float32,
+        )
+        vae = build_video_vae(cfg, jax.random.key(0), sample_thw=(1, 8, 8))
+        x = jnp.zeros((1, 1, 8, 8, 3))
+        z = vae.encode(x)
+        raw_mean, _ = jax.jit(
+            lambda p, v: VideoAutoencoderKL(cfg).apply(
+                {"params": p}, v, method="moments"
+            )
+        )(vae.params, x)
+        expect = (np.asarray(raw_mean) - np.array(cfg.latent_mean)) / 2.0
+        np.testing.assert_allclose(np.asarray(z), expect, rtol=1e-5, atol=1e-5)
+
+
+class TestTiledDecode:
+    def test_matches_full_decode(self, tiny_vae):
+        z = jax.random.normal(jax.random.key(4), (1, 3, 20, 20, TINY.z_channels))
+        full = np.asarray(tiny_vae.decode(z), np.float32)
+        tiled = np.asarray(tiny_vae.decode_tiled(z, tile=12, overlap=8), np.float32)
+        assert tiled.shape == full.shape
+        # Conv receptive fields (and the per-frame mid attention) cross tile
+        # edges, so exact equality only holds away from seams; the blended
+        # output must still track the full decode.
+        err = np.abs(tiled - full).mean()
+        assert err < 0.1, err
+
+    def test_small_input_skips_tiling(self, tiny_vae):
+        z = jax.random.normal(jax.random.key(5), (1, 1, 4, 4, TINY.z_channels))
+        np.testing.assert_array_equal(
+            np.asarray(tiny_vae.decode_tiled(z, tile=8)),
+            np.asarray(tiny_vae.decode(z)),
+        )
+
+
+def _inv_conv3d(p, key, sd):
+    sd[f"{key}.weight"] = np.asarray(p["conv"]["kernel"]).transpose(4, 3, 0, 1, 2)
+    sd[f"{key}.bias"] = np.asarray(p["conv"]["bias"])
+
+
+def _inv_conv2d(p, key, sd):
+    sd[f"{key}.weight"] = np.asarray(p["kernel"])[0].transpose(3, 2, 0, 1)
+    sd[f"{key}.bias"] = np.asarray(p["bias"])
+
+
+def _inv_rms(p, key, sd, images=False):
+    shape = (-1, 1, 1) if images else (-1, 1, 1, 1)
+    sd[f"{key}.gamma"] = np.asarray(p["scale"]).reshape(shape)
+    if "bias" in p:
+        sd[f"{key}.bias"] = np.asarray(p["bias"]).reshape(shape)
+
+
+def _inv_res_block(p, key, sd):
+    _inv_rms(p["norm1"], f"{key}.residual.0", sd)
+    _inv_conv3d(p["conv1"], f"{key}.residual.2", sd)
+    _inv_rms(p["norm2"], f"{key}.residual.3", sd)
+    _inv_conv3d(p["conv2"], f"{key}.residual.6", sd)
+    if "shortcut" in p:
+        _inv_conv3d(p["shortcut"], f"{key}.shortcut", sd)
+
+
+def _inv_attn(p, key, sd):
+    _inv_rms(p["norm"], f"{key}.norm", sd, images=True)
+    _inv_conv2d(p["to_qkv"], f"{key}.to_qkv", sd)
+    _inv_conv2d(p["proj"], f"{key}.proj", sd)
+
+
+def _official_layout_sd(cfg: VideoVAEConfig, params) -> dict:
+    sd: dict = {}
+    n = len(cfg.channel_mult)
+    enc, dec = params["encoder"], params["decoder"]
+    _inv_conv3d(enc["conv_in"], "encoder.conv1", sd)
+    _inv_res_block(enc["mid_block_1"], "encoder.middle.0", sd)
+    _inv_attn(enc["mid_attn_1"], "encoder.middle.1", sd)
+    _inv_res_block(enc["mid_block_2"], "encoder.middle.2", sd)
+    _inv_rms(enc["norm_out"], "encoder.head.0", sd)
+    _inv_conv3d(enc["conv_out"], "encoder.head.2", sd)
+    seq = 0
+    for level in range(n):
+        for i in range(cfg.num_res_blocks):
+            _inv_res_block(
+                enc[f"down_{level}_block_{i}"], f"encoder.downsamples.{seq}", sd
+            )
+            seq += 1
+        if level != n - 1:
+            ds = enc[f"down_{level}_downsample"]
+            _inv_conv2d(ds["conv"], f"encoder.downsamples.{seq}.resample.1", sd)
+            if "time_conv" in ds:
+                _inv_conv3d(
+                    ds["time_conv"], f"encoder.downsamples.{seq}.time_conv", sd
+                )
+            seq += 1
+    _inv_conv3d(dec["conv_in"], "decoder.conv1", sd)
+    _inv_res_block(dec["mid_block_1"], "decoder.middle.0", sd)
+    _inv_attn(dec["mid_attn_1"], "decoder.middle.1", sd)
+    _inv_res_block(dec["mid_block_2"], "decoder.middle.2", sd)
+    _inv_rms(dec["norm_out"], "decoder.head.0", sd)
+    _inv_conv3d(dec["conv_out"], "decoder.head.2", sd)
+    seq = 0
+    for j, level in enumerate(reversed(range(n))):
+        for i in range(cfg.num_res_blocks + 1):
+            _inv_res_block(
+                dec[f"up_{level}_block_{i}"], f"decoder.upsamples.{seq}", sd
+            )
+            seq += 1
+        if j != n - 1:
+            us = dec[f"up_{level}_upsample"]
+            _inv_conv2d(us["conv"], f"decoder.upsamples.{seq}.resample.1", sd)
+            if "time_conv" in us:
+                _inv_conv3d(
+                    us["time_conv"], f"decoder.upsamples.{seq}.time_conv", sd
+                )
+            seq += 1
+    _inv_conv3d(params["quant_conv"], "conv1", sd)
+    _inv_conv3d(params["post_quant_conv"], "conv2", sd)
+    return sd
+
+
+class TestConverter:
+    def test_round_trip_bitwise(self, tiny_vae):
+        sd = _official_layout_sd(TINY, tiny_vae.params)
+        converted = convert_wan_vae_checkpoint(sd, TINY)
+        ref = dict(flatten_tree(tiny_vae.params))
+        got = dict(flatten_tree(converted))
+        assert set(ref) == set(got), set(ref) ^ set(got)
+        for k, v in ref.items():
+            np.testing.assert_array_equal(np.asarray(v), np.asarray(got[k]), err_msg=k)
+
+    def test_converted_forward_matches(self, tiny_vae):
+        sd = _official_layout_sd(TINY, tiny_vae.params)
+        vae2 = build_video_vae(TINY, params=convert_wan_vae_checkpoint(sd, TINY))
+        x = jax.random.normal(jax.random.key(6), (1, 3, 16, 16, 3))
+        np.testing.assert_allclose(
+            np.asarray(vae2.encode(x)),
+            np.asarray(tiny_vae.encode(x)),
+            rtol=1e-6,
+            atol=1e-6,
+        )
+
+    def test_missing_attn_norm_bias_zero_filled(self, tiny_vae):
+        """The torch RMS_norm in the attention block has no bias by default —
+        the converter must fill zeros rather than fail."""
+        sd = _official_layout_sd(TINY, tiny_vae.params)
+        sd = {k: v for k, v in sd.items() if not k.endswith("middle.1.norm.bias")}
+        converted = convert_wan_vae_checkpoint(sd, TINY)
+        b = np.asarray(converted["encoder"]["mid_attn_1"]["norm"]["bias"])
+        assert (b == 0).all()
