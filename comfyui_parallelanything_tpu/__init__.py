@@ -44,7 +44,7 @@ from .parallel.split import (
 from .parallel.mesh import build_mesh, mesh_axis_names
 from .parallel.orchestrator import parallelize, ParallelConfig, ParallelModel
 from .parallel.sequence import sequence_parallel_attention
-from .pipelines import StableDiffusionPipeline, FluxPipeline
+from .pipelines import StableDiffusionPipeline, FluxPipeline, WanVideoPipeline
 from .utils.metrics import StepTimer, trace
 
 __all__ = [
@@ -74,6 +74,7 @@ __all__ = [
     "sequence_parallel_attention",
     "StableDiffusionPipeline",
     "FluxPipeline",
+    "WanVideoPipeline",
     "StepTimer",
     "trace",
 ]

@@ -194,3 +194,28 @@ def load_wan_checkpoint(
                 "layouts, or a pre-converted param pytree"
             ) from e
     return build_wan(cfg, name=name, params=params)
+
+
+def load_wan_vae_checkpoint(src: Any, cfg=None):
+    """WAN video-VAE checkpoint (official Wan2.x_VAE layout, optionally wrapped
+    under a ``vae.``/``first_stage_model.`` prefix) → VideoVAE."""
+    from .convert_wan_vae import convert_wan_vae_checkpoint
+    from .video_vae import build_video_vae, wan_vae_config
+
+    sd = _resolve_state_dict(src)
+    for prefix in ("vae.", "first_stage_model.", "model."):
+        stripped = {
+            k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)
+        }
+        if any(k.startswith("encoder.conv1.") for k in stripped):
+            sd = stripped
+            break
+    if cfg is None:
+        cfg = wan_vae_config()
+    try:
+        params = convert_wan_vae_checkpoint(sd, cfg)
+    except KeyError as e:
+        raise ValueError(
+            f"state dict is not the official Wan2.x VAE layout (missing {e})"
+        ) from e
+    return build_video_vae(cfg, params=params)

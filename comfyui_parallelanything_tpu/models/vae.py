@@ -232,6 +232,15 @@ def vae_output_to_images(decoded: jnp.ndarray) -> jnp.ndarray:
     return jnp.clip(decoded * 0.5 + 0.5, 0.0, 1.0)
 
 
+def decode_maybe_tiled(vae, z, tile: int = 0) -> jnp.ndarray:
+    """Decode ``z`` through ``vae`` (image VAE or VideoVAE), tiled when
+    ``tile > 0`` — the single owner of the tile/overlap dispatch policy
+    (overlap = tile/4) used by the pipelines and the VAE-decode node."""
+    if tile:
+        return vae.decode_tiled(z, tile=tile, overlap=tile // 4)
+    return vae.decode(z)
+
+
 @dataclasses.dataclass(frozen=True)
 class VAE:
     """The VAE as data: jit-cached encode/decode + weights (mirrors

@@ -270,7 +270,10 @@ class ParallelAnythingAdvanced(ParallelAnything):
 # reference user's whole workflow maps node-for-node.
 # ---------------------------------------------------------------------------
 
-_MODEL_FAMILIES = ("sd15", "sdxl", "flux-dev", "flux-schnell", "zimage-turbo")
+_MODEL_FAMILIES = (
+    "sd15", "sdxl", "flux-dev", "flux-schnell", "zimage-turbo",
+    "wan-1.3b", "wan-14b",
+)
 
 
 class TPUCheckpointLoader:
@@ -329,6 +332,24 @@ class TPUCheckpointLoader:
 
         lora = lora_path or None
         sd = load_safetensors(ckpt_path)
+        if family.startswith("wan"):
+            # WAN family: video DiT + causal 3D VAE (its own checkpoint file —
+            # WAN releases don't bundle the VAE with the DiT weights).
+            from .models import (
+                load_wan_checkpoint,
+                load_wan_vae_checkpoint,
+                wan_1_3b_config,
+                wan_14b_config,
+            )
+
+            wcfg = (wan_14b_config if family == "wan-14b" else wan_1_3b_config)()
+            model = load_wan_checkpoint(sd, wcfg)
+            if not vae_path:
+                raise ValueError(
+                    "wan checkpoints don't bundle a VAE — set vae_path to the "
+                    "Wan VAE file (e.g. Wan2.1_VAE.pth/.safetensors)"
+                )
+            return model, load_wan_vae_checkpoint(load_safetensors(vae_path))
         if family == "sd15":
             model = load_sd_unet_checkpoint(sd, sd15_config(), lora, lora_strength)
             vae_cfg = sd_vae_config()
@@ -553,6 +574,50 @@ class TPUEmptyLatent:
         )
 
 
+class TPUEmptyVideoLatent:
+    """(width, height, frames, batch) → 5-D video LATENT zeros for the WAN
+    family; frame count follows the causal 4k+1 schedule (81 by convention)."""
+
+    DESCRIPTION = "Allocate an empty video latent batch (WAN-class, 5-D)."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "generate"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "width": ("INT", {"default": 832, "min": 16, "max": 8192, "step": 16}),
+                "height": ("INT", {"default": 480, "min": 16, "max": 8192, "step": 16}),
+                "frames": ("INT", {"default": 81, "min": 1, "max": 1024, "step": 4,
+                                   "tooltip": "pixel frames; must be 1 mod 4 "
+                                              "(causal VAE schedule)"}),
+                "batch_size": ("INT", {"default": 1, "min": 1, "max": 16}),
+                "channels": ("INT", {"default": 16, "min": 1, "max": 64}),
+            }
+        }
+
+    def generate(
+        self, width: int, height: int, frames: int, batch_size: int,
+        channels: int = 16,
+    ):
+        import jax.numpy as jnp
+
+        from .models.video_vae import wan_vae_config
+
+        cfg = wan_vae_config()
+        t_lat = cfg.latent_frames(frames)  # raises on off-schedule counts
+        f = cfg.spatial_factor
+        return (
+            {
+                "samples": jnp.zeros(
+                    (batch_size, t_lat, height // f, width // f, channels)
+                )
+            },
+        )
+
+
 class TPUKSampler:
     """(MODEL, positive, negative, LATENT) → LATENT — the per-step driver whose
     forwards route through the parallel scheduler when MODEL came from
@@ -689,15 +754,9 @@ class TPUVAEDecode:
         }
 
     def decode(self, vae, latent, tile_size: int = 0):
-        from .models.vae import vae_output_to_images
+        from .models.vae import decode_maybe_tiled, vae_output_to_images
 
-        z = latent["samples"]
-        decoded = (
-            vae.decode_tiled(z, tile=tile_size, overlap=tile_size // 4)
-            if tile_size
-            else vae.decode(z)
-        )
-        return (vae_output_to_images(decoded),)
+        return (vae_output_to_images(decode_maybe_tiled(vae, latent["samples"], tile_size)),)
 
 
 NODE_CLASS_MAPPINGS = {
@@ -710,6 +769,7 @@ NODE_CLASS_MAPPINGS = {
     "TPUTextEncode": TPUTextEncode,
     "TPUConditioningCombine": TPUConditioningCombine,
     "TPUEmptyLatent": TPUEmptyLatent,
+    "TPUEmptyVideoLatent": TPUEmptyVideoLatent,
     "TPUKSampler": TPUKSampler,
     "TPUVAEDecode": TPUVAEDecode,
 }
@@ -724,6 +784,7 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPUTextEncode": "Text Encode (TPU)",
     "TPUConditioningCombine": "Conditioning Combine (TPU, SDXL/FLUX)",
     "TPUEmptyLatent": "Empty Latent (TPU)",
+    "TPUEmptyVideoLatent": "Empty Video Latent (TPU, WAN)",
     "TPUKSampler": "KSampler (TPU)",
     "TPUVAEDecode": "VAE Decode (TPU)",
 }

@@ -207,3 +207,89 @@ class FluxPipeline:
             **kwargs,
         )
         return _to_images(self.vae.decode(latents))
+
+
+@dataclasses.dataclass
+class WanVideoPipeline:
+    """WAN text→video: UMT5-class context + flow matching + causal 3D VAE.
+
+    The reference's WAN2.2 workload (/root/reference/README.md:5) runs this loop
+    inside ComfyUI with the wrapped denoiser; standalone, this drives the same
+    per-step parallel routing over a video latent (batch=1 video is exactly the
+    reference's pipeline-mode shape, any_device_parallel.py:1295-1305 — here the
+    temporal token axis keeps the MXU fed instead)."""
+
+    dit: Any  # WAN-class DiffusionModel or ParallelModel
+    vae: Any  # VideoVAE (causal 3D)
+    t5: Any  # UMT5/T5 TextEncoder (context)
+    t5_tokenizer: Any
+
+    def encode_prompt(self, prompts: list[str]):
+        ids, mask = self.t5_tokenizer(prompts)
+        return self.t5(jnp.asarray(ids, jnp.int32), mask=jnp.asarray(mask))
+
+    def __call__(
+        self,
+        prompt: str | list[str],
+        negative_prompt: str | list[str] = "",
+        *,
+        steps: int = 30,
+        cfg_scale: float = 5.0,
+        shift: float = 5.0,
+        height: int = 480,
+        width: int = 832,
+        frames: int = 81,
+        rng=None,
+        decode_tile: int = 0,
+        callback=None,
+    ) -> jnp.ndarray:
+        """Returns float video (B, frames, height, width, 3) in [0, 1]. WAN uses
+        true CFG (cfg_scale>1 with the negative prompt) and a large flow shift;
+        ``frames`` must be ≡ 1 mod the VAE's temporal factor (81 by convention)."""
+        prompts = [prompt] if isinstance(prompt, str) else list(prompt)
+        if rng is None:
+            rng = jax.random.key(0)
+        f = self.vae.spatial_factor
+        from .parallel.orchestrator import model_config_of
+
+        patch = getattr(model_config_of(self.dit), "patch_size", (1, 2, 2))
+        unit_h, unit_w = f * patch[1], f * patch[2]
+        if height % unit_h or width % unit_w:
+            raise ValueError(
+                f"height/width must be multiples of {unit_h}/{unit_w}"
+            )
+        t_lat = self.vae.cfg.latent_frames(frames)  # validates the 4k+1 schedule
+        if t_lat % patch[0]:
+            raise ValueError(
+                f"latent frame count {t_lat} not divisible by temporal patch "
+                f"{patch[0]}"
+            )
+
+        context = self.encode_prompt(prompts)
+        use_cfg = cfg_scale != 1.0
+        uncond_context = None
+        if use_cfg:
+            uncond_context = self.encode_prompt(
+                _match_negatives(prompts, negative_prompt)
+            )
+
+        B = len(prompts)
+        zc = self.vae.cfg.z_channels
+        noise = jax.random.normal(
+            rng, (B, t_lat, height // f, width // f, zc), jnp.float32
+        )
+        latents = run_sampler(
+            self.dit,
+            noise,
+            context,
+            sampler="flow_euler",
+            steps=steps,
+            shift=shift,
+            guidance=None,
+            cfg_scale=cfg_scale if use_cfg else 1.0,
+            uncond_context=uncond_context,
+            callback=callback,
+        )
+        from .models.vae import decode_maybe_tiled
+
+        return _to_images(decode_maybe_tiled(self.vae, latents, decode_tile))

@@ -231,3 +231,34 @@ class TestConverter:
         converted = convert_wan_vae_checkpoint(sd, TINY)
         b = np.asarray(converted["encoder"]["mid_attn_1"]["norm"]["bias"])
         assert (b == 0).all()
+
+
+class TestLoader:
+    def test_load_with_prefix_strip(self, tiny_vae):
+        from comfyui_parallelanything_tpu.models import load_wan_vae_checkpoint
+
+        sd = {
+            f"vae.{k}": v
+            for k, v in _official_layout_sd(TINY, tiny_vae.params).items()
+        }
+        vae2 = load_wan_vae_checkpoint(sd, TINY)
+        z = jax.random.normal(jax.random.key(7), (1, 1, 4, 4, TINY.z_channels))
+        np.testing.assert_allclose(
+            np.asarray(vae2.decode(z)),
+            np.asarray(tiny_vae.decode(z)),
+            rtol=1e-6,
+            atol=1e-6,
+        )
+
+    def test_load_bare_layout(self, tiny_vae):
+        from comfyui_parallelanything_tpu.models import load_wan_vae_checkpoint
+
+        sd = _official_layout_sd(TINY, tiny_vae.params)
+        vae2 = load_wan_vae_checkpoint(sd, TINY)
+        assert vae2.cfg == TINY
+
+    def test_load_rejects_wrong_layout(self):
+        from comfyui_parallelanything_tpu.models import load_wan_vae_checkpoint
+
+        with pytest.raises(ValueError, match="not the official"):
+            load_wan_vae_checkpoint({"decoder.unrelated.weight": np.zeros(3)}, TINY)

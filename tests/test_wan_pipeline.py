@@ -1,0 +1,122 @@
+"""WAN text→video pipeline: prompt → UMT5-class encode → flow-matching denoise
+(routed through the parallel scheduler) → causal 3D VAE decode, on tiny models.
+Also covers the video nodes (TPUEmptyVideoLatent) and the parallelized path over
+the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import comfyui_parallelanything_tpu as pa
+from comfyui_parallelanything_tpu.models import (
+    T5Config,
+    VideoVAEConfig,
+    WanConfig,
+    build_t5_encoder,
+    build_video_vae,
+    build_wan,
+)
+from comfyui_parallelanything_tpu.pipelines import WanVideoPipeline
+
+from test_tokenizer import _tiny_tokenizer
+
+ZC = 4
+
+
+@pytest.fixture(scope="module")
+def wan_pipe():
+    tok = _tiny_tokenizer()
+    tcfg = T5Config(
+        vocab_size=64, d_model=32, d_kv=8, d_ff=64, num_layers=2, num_heads=4,
+        dtype=jnp.float32,
+    )
+    wcfg = WanConfig(
+        in_channels=ZC, out_channels=ZC, hidden_size=48, ffn_dim=96,
+        num_heads=4, depth=2, text_dim=32, freq_dim=16, dtype=jnp.float32,
+    )
+    vcfg = VideoVAEConfig(
+        base_channels=8, channel_mult=(1, 2, 2), num_res_blocks=1,
+        temporal_downsample=(False, True), z_channels=ZC,
+        latent_mean=(0.0,) * ZC, latent_std=(1.0,) * ZC, dtype=jnp.float32,
+    )
+    return WanVideoPipeline(
+        dit=build_wan(
+            wcfg, jax.random.key(0), sample_shape=(1, 2, 4, 4, ZC), txt_len=6
+        ),
+        vae=build_video_vae(vcfg, jax.random.key(1), sample_thw=(3, 8, 8)),
+        t5=build_t5_encoder(tcfg, jax.random.key(2), sample_len=8),
+        t5_tokenizer=tok,
+    )
+
+
+class TestWanVideoPipeline:
+    def test_prompt_to_video_shape_and_range(self, wan_pipe):
+        # tf=2 → frames must be odd; 5 frames → 3 latent frames.
+        video = wan_pipe(
+            "hello", steps=2, cfg_scale=1.0, height=16, width=16, frames=5,
+            shift=3.0,
+        )
+        assert video.shape == (1, 5, 16, 16, 3)
+        a = np.asarray(video)
+        assert np.isfinite(a).all() and a.min() >= 0.0 and a.max() <= 1.0
+
+    def test_cfg_changes_output(self, wan_pipe):
+        kw = dict(steps=2, height=16, width=16, frames=5, rng=jax.random.key(3))
+        base = np.asarray(wan_pipe("hello", cfg_scale=1.0, **kw))
+        cfg = np.asarray(
+            wan_pipe("hello", negative_prompt="world", cfg_scale=5.0, **kw)
+        )
+        assert not np.allclose(base, cfg)
+
+    def test_off_schedule_frames_rejected(self, wan_pipe):
+        with pytest.raises(ValueError, match="1 mod"):
+            wan_pipe("hello", steps=1, frames=4, height=16, width=16)
+
+    def test_bad_resolution_rejected(self, wan_pipe):
+        with pytest.raises(ValueError, match="multiples"):
+            wan_pipe("hello", steps=1, frames=5, height=20, width=16)
+
+    def test_parallelized_video_batch(self, wan_pipe):
+        """Batch=2 video over the 8-device chain routes through the DP/pipeline
+        scheduler exactly like the reference's wrapped forward."""
+        chain = pa.DeviceChain.even([f"cpu:{i}" for i in range(8)])
+        pm = pa.parallelize(wan_pipe.dit, chain)
+        pipe = WanVideoPipeline(
+            dit=pm, vae=wan_pipe.vae, t5=wan_pipe.t5,
+            t5_tokenizer=wan_pipe.t5_tokenizer,
+        )
+        video = pipe(
+            ["hello", "world"], steps=2, cfg_scale=1.0, height=16, width=16,
+            frames=5,
+        )
+        assert video.shape == (2, 5, 16, 16, 3)
+        assert np.isfinite(np.asarray(video)).all()
+
+
+class TestVideoNodes:
+    def test_empty_video_latent_shapes(self):
+        from comfyui_parallelanything_tpu.nodes import TPUEmptyVideoLatent
+
+        (latent,) = TPUEmptyVideoLatent().generate(
+            width=64, height=32, frames=9, batch_size=2, channels=16
+        )
+        # wan schedule: tf=4 → 9 frames → 3 latent frames; f=8 spatial.
+        assert latent["samples"].shape == (2, 3, 4, 8, 16)
+
+    def test_empty_video_latent_rejects_off_schedule(self):
+        from comfyui_parallelanything_tpu.nodes import TPUEmptyVideoLatent
+
+        with pytest.raises(ValueError, match="1 mod"):
+            TPUEmptyVideoLatent().generate(
+                width=64, height=32, frames=8, batch_size=1
+            )
+
+    def test_vae_decode_node_handles_video(self, wan_pipe):
+        from comfyui_parallelanything_tpu.nodes import TPUVAEDecode
+
+        z = jax.random.normal(jax.random.key(5), (1, 3, 4, 4, ZC))
+        (img,) = TPUVAEDecode().decode(wan_pipe.vae, {"samples": z})
+        assert img.shape == (1, 5, 16, 16, 3)
+        a = np.asarray(img)
+        assert a.min() >= 0.0 and a.max() <= 1.0
