@@ -167,18 +167,28 @@ def load_t5_checkpoint(src: Any, cfg=None):
 def load_wan_checkpoint(
     src: Any,
     cfg: WanConfig,
+    lora: Any = None,
+    lora_strength: float = 1.0,
     params_converter=None,
     name: str = "wan",
 ) -> DiffusionModel:
     """WAN checkpoint → DiffusionModel. The official Wan2.x layout converts via
-    ``convert_wan_checkpoint`` by default; pass ``params_converter`` (state_dict,
-    cfg) -> params for repacked layouts, or a pre-converted param pytree as
-    ``src``."""
+    ``convert_wan_checkpoint`` by default (with ``lora`` baked before
+    conversion, like the other families); pass ``params_converter``
+    (state_dict, cfg) -> params for repacked layouts, or a pre-converted param
+    pytree as ``src`` (lora is not supported for pre-converted pytrees)."""
     import jax
 
     if params_converter is not None:
-        params = params_converter(_resolve_state_dict(src), cfg)
+        params = params_converter(
+            _maybe_bake(dict(_resolve_state_dict(src)), lora, lora_strength), cfg
+        )
     elif isinstance(src, Mapping) and not any("." in k for k in src):
+        if lora is not None:
+            raise ValueError(
+                "lora baking needs the flat checkpoint layout; pass the "
+                "state dict / file instead of a pre-converted param pytree"
+            )
         # Pre-converted nested pytree: apply the float32 upcast policy to every
         # leaf (bf16/fp8 storage dtypes included), same as the file-load path.
         params = jax.tree.map(to_numpy, src)
@@ -186,7 +196,10 @@ def load_wan_checkpoint(
         from .convert_wan import convert_wan_checkpoint
 
         try:
-            params = convert_wan_checkpoint(_resolve_state_dict(src), cfg)
+            params = convert_wan_checkpoint(
+                _maybe_bake(dict(_resolve_state_dict(src)), lora, lora_strength),
+                cfg,
+            )
         except KeyError as e:
             raise ValueError(
                 f"state dict is not the official Wan2.x layout (missing {e}); "

@@ -232,6 +232,12 @@ def vae_output_to_images(decoded: jnp.ndarray) -> jnp.ndarray:
     return jnp.clip(decoded * 0.5 + 0.5, 0.0, 1.0)
 
 
+def images_to_vae_input(images: jnp.ndarray) -> jnp.ndarray:
+    """Float images in [0, 1] → the decoder/encoder [-1, 1] convention (inverse
+    of ``vae_output_to_images``)."""
+    return images * 2.0 - 1.0
+
+
 def decode_maybe_tiled(vae, z, tile: int = 0) -> jnp.ndarray:
     """Decode ``z`` through ``vae`` (image VAE or VideoVAE), tiled when
     ``tile > 0`` — the single owner of the tile/overlap dispatch policy

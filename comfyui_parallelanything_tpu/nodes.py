@@ -343,13 +343,14 @@ class TPUCheckpointLoader:
             )
 
             wcfg = (wan_14b_config if family == "wan-14b" else wan_1_3b_config)()
-            model = load_wan_checkpoint(sd, wcfg)
+            model = load_wan_checkpoint(sd, wcfg, lora, lora_strength)
             if not vae_path:
                 raise ValueError(
                     "wan checkpoints don't bundle a VAE — set vae_path to the "
-                    "Wan VAE file (e.g. Wan2.1_VAE.pth/.safetensors)"
+                    "Wan VAE safetensors file (convert the official .pth once "
+                    "with safetensors.torch.save_file)"
                 )
-            return model, load_wan_vae_checkpoint(load_safetensors(vae_path))
+            return model, load_wan_vae_checkpoint(vae_path)
         if family == "sd15":
             model = load_sd_unet_checkpoint(sd, sd15_config(), lora, lora_strength)
             vae_cfg = sd_vae_config()
@@ -574,6 +575,37 @@ class TPUEmptyLatent:
         )
 
 
+class TPUVAEEncode:
+    """(VAE, IMAGE) → LATENT — the img2img entry: encode pixels (floats in
+    [0, 1], as TPUVAEDecode emits) to the latent an init-capable KSampler run
+    starts from (denoise < 1)."""
+
+    DESCRIPTION = "Encode images to latents for img2img / inpaint workflows."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "encode"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {"vae": ("VAE", {}), "image": ("IMAGE", {})},
+            "optional": {
+                "seed": ("INT", {"default": -1, "min": -1, "max": 2**31 - 1,
+                                 "tooltip": "-1 = deterministic posterior mean; "
+                                            ">=0 samples the posterior"}),
+            },
+        }
+
+    def encode(self, vae, image, seed: int = -1):
+        import jax
+
+        from .models.vae import images_to_vae_input
+
+        rng = jax.random.key(seed) if seed >= 0 else None
+        return ({"samples": vae.encode(images_to_vae_input(image), rng)},)
+
+
 class TPUEmptyVideoLatent:
     """(width, height, frames, batch) → 5-D video LATENT zeros for the WAN
     family; frame count follows the causal 4k+1 schedule (81 by convention)."""
@@ -657,6 +689,12 @@ class TPUKSampler:
                     {"default": 1.15, "min": 0.25, "max": 8.0,
                      "tooltip": "rectified-flow timestep shift (flow_euler only)"},
                 ),
+                "denoise": (
+                    "FLOAT",
+                    {"default": 1.0, "min": 0.01, "max": 1.0, "step": 0.01,
+                     "tooltip": "img2img strength: < 1 starts from the input "
+                                "LATENT (wire a VAE Encode) instead of noise"},
+                ),
             },
         }
 
@@ -672,6 +710,7 @@ class TPUKSampler:
         negative=None,
         guidance: float = 3.5,
         shift: float = 1.15,
+        denoise: float = 1.0,
     ):
         import jax
         import jax.numpy as jnp
@@ -729,7 +768,9 @@ class TPUKSampler:
             model, noise, context, sampler=sampler_name, steps=steps,
             cfg_scale=cfg, uncond_context=uncond_context,
             uncond_kwargs=uncond_kwargs, rng=rng, shift=shift,
-            guidance=guidance if guidance > 0 else None, **kwargs,
+            guidance=guidance if guidance > 0 else None,
+            init_latent=latent["samples"] if denoise < 1.0 else None,
+            denoise=denoise, **kwargs,
         )
         return ({"samples": out},)
 
@@ -769,6 +810,7 @@ NODE_CLASS_MAPPINGS = {
     "TPUTextEncode": TPUTextEncode,
     "TPUConditioningCombine": TPUConditioningCombine,
     "TPUEmptyLatent": TPUEmptyLatent,
+    "TPUVAEEncode": TPUVAEEncode,
     "TPUEmptyVideoLatent": TPUEmptyVideoLatent,
     "TPUKSampler": TPUKSampler,
     "TPUVAEDecode": TPUVAEDecode,
@@ -784,6 +826,7 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPUTextEncode": "Text Encode (TPU)",
     "TPUConditioningCombine": "Conditioning Combine (TPU, SDXL/FLUX)",
     "TPUEmptyLatent": "Empty Latent (TPU)",
+    "TPUVAEEncode": "VAE Encode (TPU)",
     "TPUEmptyVideoLatent": "Empty Video Latent (TPU, WAN)",
     "TPUKSampler": "KSampler (TPU)",
     "TPUVAEDecode": "VAE Decode (TPU)",

@@ -41,6 +41,28 @@ def _match_negatives(prompts: list[str], negative_prompt) -> list[str]:
     return negatives
 
 
+def _encode_init_image(vae, init_image, denoise: float, batch: int,
+                       height: int, width: int):
+    """img2img entry shared by the pipelines: encode ``init_image`` (floats in
+    [0, 1]) to the latent ``run_sampler`` starts from when ``denoise < 1``."""
+    if init_image is None:
+        return None
+    if denoise >= 1.0:
+        raise ValueError("init_image given but denoise=1.0 — lower denoise "
+                         "(strength) so the image actually seeds the sampler")
+    from .models.vae import images_to_vae_input
+
+    if init_image.shape[1:3] != (height, width):
+        raise ValueError(
+            f"init_image is {init_image.shape[1:3]}, pipeline is "
+            f"({height}, {width})"
+        )
+    z = vae.encode(images_to_vae_input(init_image))
+    if z.shape[0] == 1 and batch > 1:
+        z = jnp.repeat(z, batch, axis=0)
+    return z
+
+
 @dataclasses.dataclass
 class StableDiffusionPipeline:
     """SD1.5 (clip only) / SDXL (clip + clip_g) text→image.
@@ -86,8 +108,13 @@ class StableDiffusionPipeline:
         sampler: str = "dpmpp_2m",
         karras: bool = True,
         callback=None,
+        init_image: jnp.ndarray | None = None,
+        denoise: float = 1.0,
     ) -> jnp.ndarray:
-        """Returns float images (B, height, width, 3) in [0, 1]."""
+        """Returns float images (B, height, width, 3) in [0, 1]. img2img: pass
+        ``init_image`` (B or 1, height, width, 3 floats in [0, 1]) with
+        ``denoise < 1`` — the sampler starts from the encoded image noised to
+        the truncated schedule's head instead of pure noise."""
         prompts = [prompt] if isinstance(prompt, str) else list(prompt)
         negatives = _match_negatives(prompts, negative_prompt)
         if rng is None:
@@ -115,10 +142,15 @@ class StableDiffusionPipeline:
         kwargs = {} if y is None else {"y": y}
         if sampler == "flow_euler":
             raise ValueError("flow_euler belongs to FluxPipeline, not the SD family")
+        init_latent = _encode_init_image(
+            self.vae, init_image, denoise, B, height, width
+        )
         latents = run_sampler(
             self.unet,
             noise,
             context,
+            init_latent=init_latent,
+            denoise=denoise,
             sampler=sampler,
             steps=steps,
             cfg_scale=cfg_scale if use_cfg else 1.0,
@@ -163,6 +195,8 @@ class FluxPipeline:
         negative_prompt: str | list[str] | None = None,
         cfg_scale: float = 1.0,
         callback=None,
+        init_image: jnp.ndarray | None = None,
+        denoise: float = 1.0,
     ) -> jnp.ndarray:
         """Returns float images (B, height, width, 3) in [0, 1]. ``guidance`` is
         the dev-family distilled guidance embed (None for schnell); true CFG runs
@@ -192,6 +226,9 @@ class FluxPipeline:
         noise = jax.random.normal(
             rng, (B, height // f, width // f, zc), jnp.float32
         )
+        init_latent = _encode_init_image(
+            self.vae, init_image, denoise, B, height, width
+        )
         latents = run_sampler(
             self.dit,
             noise,
@@ -204,6 +241,8 @@ class FluxPipeline:
             uncond_context=uncond_context,
             uncond_kwargs=uncond_kwargs,
             callback=callback,
+            init_latent=init_latent,
+            denoise=denoise,
             **kwargs,
         )
         return _to_images(self.vae.decode(latents))

@@ -30,12 +30,16 @@ def ddim_sample(
     uncond_kwargs: dict | None = None,
     alphas_cumprod: jnp.ndarray | None = None,
     callback=None,
+    ts: jnp.ndarray | None = None,
     **model_kwargs,
 ) -> jnp.ndarray:
-    """Denoise ``x_init`` (noise at t=T) over ``steps`` DDIM steps. Returns x_0."""
+    """Denoise ``x_init`` (noise at t=ts[0]) over the DDIM steps. Returns x_0.
+    ``ts`` overrides the timestep schedule (img2img passes a truncated one and
+    pre-noises ``x_init`` to ts[0] itself)."""
     if alphas_cumprod is None:
         alphas_cumprod = scaled_linear_schedule()
-    ts = ddim_timesteps(steps, alphas_cumprod.shape[0])
+    if ts is None:
+        ts = ddim_timesteps(steps, alphas_cumprod.shape[0])
     batch = x_init.shape[0]
     use_cfg = cfg_scale != 1.0 and uncond_context is not None
 

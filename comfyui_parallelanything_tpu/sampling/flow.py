@@ -34,14 +34,18 @@ def flow_euler_sample(
     uncond_context: jnp.ndarray | None = None,
     uncond_kwargs: dict | None = None,
     callback=None,
+    ts: jnp.ndarray | None = None,
     **model_kwargs,
 ) -> jnp.ndarray:
-    """Euler-integrate the flow from noise (t=1) to sample (t=0).
+    """Euler-integrate the flow from noise (t=ts[0]) to sample (t=0).
 
     ``guidance`` feeds FLUX-dev's distilled guidance embedding; ``cfg_scale`` +
     ``uncond_context`` run true classifier-free guidance (batched, like ddim.py).
-    """
-    ts = flow_timesteps(steps, shift)
+    ``ts`` overrides the schedule (img2img passes a truncated one and mixes
+    ``x_init`` to ts[0] itself)."""
+    if ts is None:
+        ts = flow_timesteps(steps, shift)
+    steps = len(ts) - 1
     batch = x_init.shape[0]
     use_cfg = cfg_scale != 1.0 and uncond_context is not None
 

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from .ddim import ddim_sample
-from .flow import flow_euler_sample
+from .flow import flow_euler_sample, flow_timesteps
 from .k_samplers import (
     EpsDenoiser,
     karras_sigmas,
@@ -48,39 +48,75 @@ def run_sampler(
     shift: float = 1.0,
     guidance: float | None = None,
     callback=None,
+    init_latent: jnp.ndarray | None = None,
+    denoise: float = 1.0,
     **model_kwargs,
 ) -> jnp.ndarray:
     """Drive ``model`` from ``noise`` to a clean latent with the named sampler.
 
     ``noise`` is unit-variance N(0,1); eps-family samplers scale it to sigma_max
-    internally. ``shift``/``guidance`` apply to ``flow_euler`` only."""
+    internally. ``shift``/``guidance`` apply to ``flow_euler`` only.
+
+    img2img: with ``init_latent`` + ``denoise < 1``, the schedule for
+    ``steps/denoise`` total steps is truncated to its last ``steps`` entries and
+    ``init_latent`` is noised to the truncated schedule's start (ComfyUI's
+    KSampler denoise semantics: ``steps`` forwards always run)."""
     use_cfg = cfg_scale != 1.0 and uncond_context is not None
     eff_cfg = cfg_scale if use_cfg else 1.0
+    if not 0.0 < denoise <= 1.0:
+        raise ValueError(f"denoise must be in (0, 1], got {denoise}")
+    img2img = init_latent is not None and denoise < 1.0
+    total = max(steps, int(round(steps / denoise))) if img2img else steps
+
     if sampler == "flow_euler":
+        ts = None
+        x = noise
+        if img2img:
+            # x_t = t·noise + (1-t)·x0 under the v = noise - x0 flow.
+            ts = flow_timesteps(total, shift)[-(steps + 1) :]
+            x = ts[0] * noise + (1.0 - ts[0]) * init_latent
         return flow_euler_sample(
-            model, noise, context, steps=steps, shift=shift, guidance=guidance,
+            model, x, context, steps=steps, shift=shift, guidance=guidance,
             cfg_scale=eff_cfg, uncond_context=uncond_context,
-            uncond_kwargs=uncond_kwargs, callback=callback, **model_kwargs,
+            uncond_kwargs=uncond_kwargs, callback=callback, ts=ts, **model_kwargs,
         )
     if sampler == "ddim":
+        ts = None
+        x = noise
+        if img2img:
+            from .schedules import scaled_linear_schedule
+
+            acp = scaled_linear_schedule()
+            # Exact-strength truncation: `steps` timesteps evenly spaced over
+            # [0, denoise·T) descending (ddim_timesteps' integer stride can't
+            # express this — 1000//n is 0 for n>1000 and quantizes badly above
+            # 500).
+            t_start = max(1, round(denoise * (acp.shape[0] - 1)))
+            ts = jnp.linspace(t_start, 0, steps).round().astype(jnp.int32)
+            a0 = acp[ts[0]]
+            x = jnp.sqrt(a0) * init_latent + jnp.sqrt(1.0 - a0) * noise
         return ddim_sample(
-            model, noise, context, steps=steps, cfg_scale=eff_cfg,
+            model, x, context, steps=steps, cfg_scale=eff_cfg,
             uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
-            callback=callback, **model_kwargs,
+            callback=callback, ts=ts, **model_kwargs,
         )
     step_fn = K_SAMPLERS.get(sampler)
     if step_fn is None:
         raise ValueError(
             f"unknown sampler {sampler!r} (have {', '.join(SAMPLER_NAMES)})"
         )
-    sigmas = karras_sigmas(steps) if karras else sampling_sigmas(steps)
-    denoise = EpsDenoiser(
+    sigmas = karras_sigmas(total) if karras else sampling_sigmas(total)
+    if img2img:
+        sigmas = sigmas[-(steps + 1) :]
+    denoiser = EpsDenoiser(
         model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,
         uncond_kwargs=uncond_kwargs, **model_kwargs,
     )
     x = noise * sigmas[0]
+    if img2img:
+        x = init_latent + x
     if sampler == "euler_ancestral":
         if rng is None:
             rng = jax.random.key(0)
-        return step_fn(denoise, x, sigmas, jax.random.fold_in(rng, 1), callback=callback)
-    return step_fn(denoise, x, sigmas, callback=callback)
+        return step_fn(denoiser, x, sigmas, jax.random.fold_in(rng, 1), callback=callback)
+    return step_fn(denoiser, x, sigmas, callback=callback)
