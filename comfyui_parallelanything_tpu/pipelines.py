@@ -46,6 +46,11 @@ def _encode_init_image(vae, init_image, denoise: float, batch: int,
     """img2img entry shared by the pipelines: encode ``init_image`` (floats in
     [0, 1]) to the latent ``run_sampler`` starts from when ``denoise < 1``."""
     if init_image is None:
+        if denoise < 1.0:
+            raise ValueError(
+                "denoise < 1 without an init_image — partial strength needs an "
+                "image (or latent) to preserve; pass init_image or drop denoise"
+            )
         return None
     if denoise >= 1.0:
         raise ValueError("init_image given but denoise=1.0 — lower denoise "
@@ -262,6 +267,10 @@ class WanVideoPipeline:
     vae: Any  # VideoVAE (causal 3D)
     t5: Any  # UMT5/T5 TextEncoder (context)
     t5_tokenizer: Any
+    # WAN2.2 A14B: a second low-noise expert makes ``dit`` the high-noise one
+    # and every step routes by flow time (models/experts.py).
+    dit_low_noise: Any = None
+    boundary: float | None = None
 
     def encode_prompt(self, prompts: list[str]):
         ids, mask = self.t5_tokenizer(prompts)
@@ -281,17 +290,30 @@ class WanVideoPipeline:
         rng=None,
         decode_tile: int = 0,
         callback=None,
+        init_video: jnp.ndarray | None = None,
+        denoise: float = 1.0,
     ) -> jnp.ndarray:
         """Returns float video (B, frames, height, width, 3) in [0, 1]. WAN uses
         true CFG (cfg_scale>1 with the negative prompt) and a large flow shift;
-        ``frames`` must be ≡ 1 mod the VAE's temporal factor (81 by convention)."""
+        ``frames`` must be ≡ 1 mod the VAE's temporal factor (81 by convention).
+        video2video: pass ``init_video`` (B or 1, frames, height, width, 3 in
+        [0, 1]) with ``denoise < 1`` — same truncated-schedule semantics as the
+        image pipelines."""
         prompts = [prompt] if isinstance(prompt, str) else list(prompt)
         if rng is None:
             rng = jax.random.key(0)
+        denoiser = self.dit
+        if self.dit_low_noise is not None:
+            from .models.experts import WAN22_T2V_BOUNDARY, TimestepExpertSwitch
+
+            denoiser = TimestepExpertSwitch(
+                self.dit, self.dit_low_noise,
+                self.boundary if self.boundary is not None else WAN22_T2V_BOUNDARY,
+            )
         f = self.vae.spatial_factor
         from .parallel.orchestrator import model_config_of
 
-        patch = getattr(model_config_of(self.dit), "patch_size", (1, 2, 2))
+        patch = getattr(model_config_of(denoiser), "patch_size", (1, 2, 2))
         unit_h, unit_w = f * patch[1], f * patch[2]
         if height % unit_h or width % unit_w:
             raise ValueError(
@@ -317,8 +339,31 @@ class WanVideoPipeline:
         noise = jax.random.normal(
             rng, (B, t_lat, height // f, width // f, zc), jnp.float32
         )
+        init_latent = None
+        if init_video is None:
+            if denoise < 1.0:
+                raise ValueError(
+                    "denoise < 1 without an init_video — partial strength needs "
+                    "a clip to preserve; pass init_video or drop denoise"
+                )
+        else:
+            if denoise >= 1.0:
+                raise ValueError(
+                    "init_video given but denoise=1.0 — lower denoise "
+                    "(strength) so the clip actually seeds the sampler"
+                )
+            if init_video.shape[1:4] != (frames, height, width):
+                raise ValueError(
+                    f"init_video is {init_video.shape[1:4]}, pipeline is "
+                    f"({frames}, {height}, {width})"
+                )
+            from .models.vae import images_to_vae_input
+
+            init_latent = self.vae.encode(images_to_vae_input(init_video))
+            if init_latent.shape[0] == 1 and B > 1:
+                init_latent = jnp.repeat(init_latent, B, axis=0)
         latents = run_sampler(
-            self.dit,
+            denoiser,
             noise,
             context,
             sampler="flow_euler",
@@ -328,6 +373,8 @@ class WanVideoPipeline:
             cfg_scale=cfg_scale if use_cfg else 1.0,
             uncond_context=uncond_context,
             callback=callback,
+            init_latent=init_latent,
+            denoise=denoise,
         )
         from .models.vae import decode_maybe_tiled
 

@@ -81,12 +81,17 @@ def run_sampler(
             uncond_kwargs=uncond_kwargs, callback=callback, ts=ts, **model_kwargs,
         )
     if sampler == "ddim":
-        ts = None
-        x = noise
-        if img2img:
+        # A caller-supplied schedule must drive BOTH the truncation/noising here
+        # and the sampler itself, or the init is noised to a different level
+        # than the sampler assumes.
+        acp = model_kwargs.pop("alphas_cumprod", None)
+        if acp is None:
             from .schedules import scaled_linear_schedule
 
             acp = scaled_linear_schedule()
+        ts = None
+        x = noise
+        if img2img:
             # Exact-strength truncation: `steps` timesteps evenly spaced over
             # [0, denoise·T) descending (ddim_timesteps' integer stride can't
             # express this — 1000//n is 0 for n>1000 and quantizes badly above
@@ -98,7 +103,7 @@ def run_sampler(
         return ddim_sample(
             model, x, context, steps=steps, cfg_scale=eff_cfg,
             uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
-            callback=callback, ts=ts, **model_kwargs,
+            callback=callback, ts=ts, alphas_cumprod=acp, **model_kwargs,
         )
     step_fn = K_SAMPLERS.get(sampler)
     if step_fn is None:
