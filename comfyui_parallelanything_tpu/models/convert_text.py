@@ -134,11 +134,15 @@ def convert_t5_checkpoint(state_dict: Mapping[str, Any], cfg: T5Config) -> dict:
     emb_key = "shared.weight" if "shared.weight" in sd else "encoder.embed_tokens.weight"
     p: dict[str, Any] = {
         "tok_emb": {"embedding": to_numpy(sd[emb_key])},
-        "rel_bias": to_numpy(
-            sd["encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"]
-        ),
         "final_ln": {"scale": to_numpy(sd["encoder.final_layer_norm.weight"])},
     }
+    rel = ".layer.0.SelfAttention.relative_attention_bias.weight"
+    if cfg.per_layer_bias:
+        # UMT5: one table per layer.
+        for i in range(cfg.num_layers):
+            p[f"rel_bias_{i}"] = to_numpy(sd[f"encoder.block.{i}{rel}"])
+    else:
+        p["rel_bias"] = to_numpy(sd[f"encoder.block.0{rel}"])
     for i in range(cfg.num_layers):
         t = f"encoder.block.{i}"
         p[f"blocks_{i}"] = {

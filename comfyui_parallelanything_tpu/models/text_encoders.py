@@ -156,12 +156,22 @@ class T5Config:
     d_ff: int = 10240
     relative_buckets: int = 32
     relative_max_distance: int = 128
+    # UMT5 gives every layer its own relative-position bias table; classic T5
+    # shares layer 0's.
+    per_layer_bias: bool = False
     dtype: Any = jnp.bfloat16
 
 
 def t5_xxl_config(**overrides) -> T5Config:
     """google/t5-v1_1-xxl encoder — the FLUX 't5xxl' conditioning tower."""
     return dataclasses.replace(T5Config(), **overrides)
+
+
+def umt5_xxl_config(**overrides) -> T5Config:
+    """google/umt5-xxl encoder — the WAN conditioning tower (multilingual
+    256k-token vocab, per-layer relative bias; otherwise the XXL geometry)."""
+    base = T5Config(vocab_size=256384, per_layer_bias=True)
+    return dataclasses.replace(base, **overrides)
 
 
 def _t5_relative_buckets(rel_pos, num_buckets: int, max_distance: int):
@@ -215,9 +225,10 @@ class _T5Block(nn.Module):
 
 
 class T5Encoder(nn.Module):
-    """Bidirectional T5 v1.1 encoder stack; returns the final RMS-normed stream.
-    The relative-position bias table lives on layer 0 and is shared by all layers
-    (T5 convention); ``mask`` (B, S) of 0/1 marks real tokens."""
+    """Bidirectional T5 v1.1 / UMT5 encoder stack; returns the final RMS-normed
+    stream. The relative-position bias table lives on layer 0 and is shared by
+    all layers (T5 convention) unless ``cfg.per_layer_bias`` (UMT5: one table
+    per layer); ``mask`` (B, S) of 0/1 marks real tokens."""
 
     cfg: T5Config
 
@@ -234,16 +245,22 @@ class T5Encoder(nn.Module):
             cfg.relative_buckets,
             cfg.relative_max_distance,
         )
-        bias_table = self.param(
-            "rel_bias",
-            nn.initializers.normal(1.0),
-            (cfg.relative_buckets, cfg.num_heads),
-        )
-        bias = bias_table[buckets].transpose(2, 0, 1)[None].astype(jnp.float32)
+        mask_bias = 0.0
         if mask is not None:
-            bias = bias + jnp.where(mask[:, None, None, :] > 0, 0.0, -jnp.inf)
+            mask_bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -jnp.inf)
+
+        def layer_bias(name: str):
+            table = self.param(
+                name,
+                nn.initializers.normal(1.0),
+                (cfg.relative_buckets, cfg.num_heads),
+            )
+            return table[buckets].transpose(2, 0, 1)[None].astype(jnp.float32) + mask_bias
+
+        bias = None if cfg.per_layer_bias else layer_bias("rel_bias")
         for i in range(cfg.num_layers):
-            x = _T5Block(cfg, name=f"blocks_{i}")(x, bias)
+            b = layer_bias(f"rel_bias_{i}") if cfg.per_layer_bias else bias
+            x = _T5Block(cfg, name=f"blocks_{i}")(x, b)
         return _T5RMSNorm(name="final_ln")(x)
 
 

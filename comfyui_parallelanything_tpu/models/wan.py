@@ -68,6 +68,13 @@ def wan_14b_config(**overrides) -> WanConfig:
     return dataclasses.replace(base, **overrides)
 
 
+def wan_14b_i2v_config(**overrides) -> WanConfig:
+    """The i2v variant: 36 in-channels = noisy latent 16 + frame mask 4 +
+    encoded-image cond latent 16 (WAN2.2 channel-concat conditioning; no
+    CLIP-vision branch)."""
+    return wan_14b_config(in_channels=36, **overrides)
+
+
 class _RMSNorm(nn.Module):
     """RMSNorm in f32 with a learned scale over the last dim (WAN q/k norm runs
     over the full H·D inner dim before the head split)."""

@@ -395,7 +395,7 @@ class TPUCLIPLoader:
             "required": {
                 "encoder_path": ("STRING", {"default": ""}),
                 "encoder_type": (
-                    ["clip-l", "open-clip-g", "t5"],
+                    ["clip-l", "open-clip-g", "t5", "umt5"],
                     {"default": "clip-l"},
                 ),
             },
@@ -419,13 +419,18 @@ class TPUCLIPLoader:
         from .models import load_clip_text_checkpoint, load_t5_checkpoint
         from .utils.tokenizer import CLIPBPETokenizer, load_tokenizer_json
 
-        if encoder_type == "t5":
+        if encoder_type in ("t5", "umt5"):
             if not tokenizer_json:
                 raise ValueError(
-                    "encoder_type='t5' requires tokenizer_json (the T5 tokenizer "
-                    "has no vocab.json/merges.txt form)"
+                    f"encoder_type={encoder_type!r} requires tokenizer_json (no "
+                    "vocab.json/merges.txt form exists for these tokenizers)"
                 )
-            enc = load_t5_checkpoint(encoder_path)
+            if encoder_type == "umt5":
+                from .models import umt5_xxl_config
+
+                enc = load_t5_checkpoint(encoder_path, umt5_xxl_config())
+            else:
+                enc = load_t5_checkpoint(encoder_path)
             tok = load_tokenizer_json(tokenizer_json, max_len=max_len, eos_id=1)
         else:
             enc = load_clip_text_checkpoint(

@@ -41,28 +41,30 @@ def _match_negatives(prompts: list[str], negative_prompt) -> list[str]:
     return negatives
 
 
-def _encode_init_image(vae, init_image, denoise: float, batch: int,
-                       height: int, width: int):
-    """img2img entry shared by the pipelines: encode ``init_image`` (floats in
-    [0, 1]) to the latent ``run_sampler`` starts from when ``denoise < 1``."""
-    if init_image is None:
+def _encode_init(vae, init, denoise: float, batch: int,
+                 expect: tuple[int, ...], what: str = "init_image"):
+    """Strength-seeded sampling entry shared by ALL pipelines (img2img and
+    video2video): validate the (denoise, init) pairing, check the pixel shape
+    against ``expect`` (the dims after batch), encode, and broadcast a batch-1
+    init to the prompt batch."""
+    if init is None:
         if denoise < 1.0:
             raise ValueError(
-                "denoise < 1 without an init_image — partial strength needs an "
-                "image (or latent) to preserve; pass init_image or drop denoise"
+                f"denoise < 1 without an {what} — partial strength needs "
+                f"something to preserve; pass {what} or drop denoise"
             )
         return None
     if denoise >= 1.0:
-        raise ValueError("init_image given but denoise=1.0 — lower denoise "
-                         "(strength) so the image actually seeds the sampler")
+        raise ValueError(
+            f"{what} given but denoise=1.0 — lower denoise (strength) so it "
+            "actually seeds the sampler"
+        )
     from .models.vae import images_to_vae_input
 
-    if init_image.shape[1:3] != (height, width):
-        raise ValueError(
-            f"init_image is {init_image.shape[1:3]}, pipeline is "
-            f"({height}, {width})"
-        )
-    z = vae.encode(images_to_vae_input(init_image))
+    got = init.shape[1 : 1 + len(expect)]
+    if tuple(got) != tuple(expect):
+        raise ValueError(f"{what} is {got}, pipeline is {tuple(expect)}")
+    z = vae.encode(images_to_vae_input(init))
     if z.shape[0] == 1 and batch > 1:
         z = jnp.repeat(z, batch, axis=0)
     return z
@@ -147,8 +149,8 @@ class StableDiffusionPipeline:
         kwargs = {} if y is None else {"y": y}
         if sampler == "flow_euler":
             raise ValueError("flow_euler belongs to FluxPipeline, not the SD family")
-        init_latent = _encode_init_image(
-            self.vae, init_image, denoise, B, height, width
+        init_latent = _encode_init(
+            self.vae, init_image, denoise, B, (height, width)
         )
         latents = run_sampler(
             self.unet,
@@ -231,8 +233,8 @@ class FluxPipeline:
         noise = jax.random.normal(
             rng, (B, height // f, width // f, zc), jnp.float32
         )
-        init_latent = _encode_init_image(
-            self.vae, init_image, denoise, B, height, width
+        init_latent = _encode_init(
+            self.vae, init_image, denoise, B, (height, width)
         )
         latents = run_sampler(
             self.dit,
@@ -292,23 +294,34 @@ class WanVideoPipeline:
         callback=None,
         init_video: jnp.ndarray | None = None,
         denoise: float = 1.0,
+        image: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         """Returns float video (B, frames, height, width, 3) in [0, 1]. WAN uses
         true CFG (cfg_scale>1 with the negative prompt) and a large flow shift;
         ``frames`` must be ≡ 1 mod the VAE's temporal factor (81 by convention).
         video2video: pass ``init_video`` (B or 1, frames, height, width, 3 in
         [0, 1]) with ``denoise < 1`` — same truncated-schedule semantics as the
-        image pipelines."""
+        image pipelines. image→video: pass ``image`` (B or 1, height, width, 3
+        in [0, 1]) — WAN2.2-style channel-concat conditioning (the i2v DiT's
+        extra in-channels carry a frame mask + the encoded first frame; no
+        CLIP-vision branch, which WAN2.2 dropped)."""
         prompts = [prompt] if isinstance(prompt, str) else list(prompt)
         if rng is None:
             rng = jax.random.key(0)
         denoiser = self.dit
         if self.dit_low_noise is not None:
-            from .models.experts import WAN22_T2V_BOUNDARY, TimestepExpertSwitch
+            from .models.experts import (
+                WAN22_I2V_BOUNDARY,
+                WAN22_T2V_BOUNDARY,
+                TimestepExpertSwitch,
+            )
 
+            default_boundary = (
+                WAN22_I2V_BOUNDARY if image is not None else WAN22_T2V_BOUNDARY
+            )
             denoiser = TimestepExpertSwitch(
                 self.dit, self.dit_low_noise,
-                self.boundary if self.boundary is not None else WAN22_T2V_BOUNDARY,
+                self.boundary if self.boundary is not None else default_boundary,
             )
         f = self.vae.spatial_factor
         from .parallel.orchestrator import model_config_of
@@ -339,29 +352,14 @@ class WanVideoPipeline:
         noise = jax.random.normal(
             rng, (B, t_lat, height // f, width // f, zc), jnp.float32
         )
-        init_latent = None
-        if init_video is None:
-            if denoise < 1.0:
-                raise ValueError(
-                    "denoise < 1 without an init_video — partial strength needs "
-                    "a clip to preserve; pass init_video or drop denoise"
-                )
-        else:
-            if denoise >= 1.0:
-                raise ValueError(
-                    "init_video given but denoise=1.0 — lower denoise "
-                    "(strength) so the clip actually seeds the sampler"
-                )
-            if init_video.shape[1:4] != (frames, height, width):
-                raise ValueError(
-                    f"init_video is {init_video.shape[1:4]}, pipeline is "
-                    f"({frames}, {height}, {width})"
-                )
-            from .models.vae import images_to_vae_input
-
-            init_latent = self.vae.encode(images_to_vae_input(init_video))
-            if init_latent.shape[0] == 1 and B > 1:
-                init_latent = jnp.repeat(init_latent, B, axis=0)
+        init_latent = _encode_init(
+            self.vae, init_video, denoise, B, (frames, height, width),
+            what="init_video",
+        )
+        if image is not None:
+            denoiser = self._i2v_conditioned(
+                denoiser, image, B, frames, height, width, t_lat, zc
+            )
         latents = run_sampler(
             denoiser,
             noise,
@@ -379,3 +377,54 @@ class WanVideoPipeline:
         from .models.vae import decode_maybe_tiled
 
         return _to_images(decode_maybe_tiled(self.vae, latents, decode_tile))
+
+    def _i2v_conditioned(
+        self, denoiser, image, B, frames, height, width, t_lat, zc
+    ):
+        """Wrap ``denoiser`` with WAN i2v channel-concat conditioning: the DiT's
+        extra in-channels carry [frame mask (4ch) ‖ encoded first-frame latent]
+        alongside the noisy latent. The cond tensor is fixed across steps, so
+        one wrapper closure serves every sampler call (and every expert)."""
+        from .models.vae import images_to_vae_input
+        from .parallel.orchestrator import model_config_of
+
+        cfg = model_config_of(denoiser)
+        expect = zc + 4 + zc
+        got_in = getattr(cfg, "in_channels", None)
+        if got_in is not None and got_in != expect:
+            raise ValueError(
+                f"image→video needs an i2v checkpoint with in_channels="
+                f"{expect} (latent {zc} + mask 4 + cond {zc}); this model has "
+                f"{got_in} — load the i2v variant or drop `image`"
+            )
+        if image.shape[1:3] != (height, width):
+            raise ValueError(
+                f"image is {image.shape[1:3]}, pipeline is ({height}, {width})"
+            )
+        if image.shape[0] == 1 and B > 1:
+            image = jnp.repeat(image, B, axis=0)
+        # Conditioning clip: the image as frame 0, zeros after — encoded by the
+        # same causal VAE, so the first latent frame holds the image.
+        clip = jnp.concatenate(
+            [
+                images_to_vae_input(image)[:, None],
+                jnp.zeros((B, frames - 1, height, width, image.shape[-1])),
+            ],
+            axis=1,
+        )
+        cond_latent = self.vae.encode(clip)
+        # 4-channel frame mask (one channel per pixel frame a latent frame
+        # folds): first latent frame = given, rest = generated.
+        h, w = cond_latent.shape[2], cond_latent.shape[3]
+        mask = jnp.zeros((B, t_lat, h, w, 4)).at[:, 0].set(1.0)
+        cond = jnp.concatenate([mask, cond_latent], axis=-1)
+
+        def conditioned(x, t, context=None, **kw):
+            c = cond
+            if x.shape[0] != c.shape[0]:
+                # CFG doubles the batch (cond ‖ uncond in one forward) — the
+                # conditioning rides along for both halves.
+                c = jnp.tile(c, (x.shape[0] // c.shape[0], 1, 1, 1, 1))
+            return denoiser(jnp.concatenate([x, c], axis=-1), t, context, **kw)
+
+        return conditioned

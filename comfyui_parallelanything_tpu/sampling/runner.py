@@ -110,12 +110,27 @@ def run_sampler(
         raise ValueError(
             f"unknown sampler {sampler!r} (have {', '.join(SAMPLER_NAMES)})"
         )
-    sigmas = karras_sigmas(total) if karras else sampling_sigmas(total)
+    # Same coherence rule as the ddim branch: a caller-supplied schedule must
+    # drive the sampling sigmas (and img2img truncation), not just the
+    # denoiser's sigma→timestep table.
+    acp = model_kwargs.pop("alphas_cumprod", None)
+    if karras:
+        if acp is None:
+            sigmas = karras_sigmas(total)
+        else:
+            from .k_samplers import model_sigmas
+
+            table = model_sigmas(acp)
+            sigmas = karras_sigmas(
+                total, sigma_min=float(table[0]), sigma_max=float(table[-1])
+            )
+    else:
+        sigmas = sampling_sigmas(total, acp)
     if img2img:
         sigmas = sigmas[-(steps + 1) :]
     denoiser = EpsDenoiser(
         model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,
-        uncond_kwargs=uncond_kwargs, **model_kwargs,
+        uncond_kwargs=uncond_kwargs, alphas_cumprod=acp, **model_kwargs,
     )
     x = noise * sigmas[0]
     if img2img:

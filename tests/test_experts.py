@@ -184,14 +184,7 @@ class TestVideo2Video:
         assert np.abs(weak - target).mean() < np.abs(full - target).mean()
 
     def test_denoise_without_init_video_rejected(self):
-        from comfyui_parallelanything_tpu.pipelines import WanVideoPipeline
+        from comfyui_parallelanything_tpu.pipelines import _encode_init
 
-        # Validation fires before any model work, so dummy components suffice
-        # for everything the code touches pre-noise... it needs vae + t5, so
-        # reuse the full pipe via the other test's construction is overkill —
-        # go through run_sampler-level check instead in test_img2img; here just
-        # assert the image-pipeline helper raises symmetrically.
-        from comfyui_parallelanything_tpu.pipelines import _encode_init_image
-
-        with pytest.raises(ValueError, match="denoise < 1"):
-            _encode_init_image(None, None, 0.5, 1, 16, 16)
+        with pytest.raises(ValueError, match="init_video"):
+            _encode_init(None, None, 0.5, 1, (5, 16, 16), what="init_video")

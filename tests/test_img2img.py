@@ -188,3 +188,26 @@ class TestWanLora:
         )
         with pytest.raises(ValueError, match="lora"):
             load_wan_checkpoint({"patch_embedding": {}}, cfg, lora={"x": 1})
+
+
+class TestCustomSchedule:
+    def test_custom_alphas_cumprod_drives_sigmas(self):
+        """A caller schedule must change the actual noise levels (and not crash
+        the img2img truncation) for the k-sampler branch, like the ddim one."""
+        import jax.numpy as jnp
+
+        noise = jax.random.normal(jax.random.key(0), (1, 4, 4, 4))
+        short = jnp.linspace(0.999, 0.01, 100)  # 100-entry custom table
+        default = run_sampler(
+            _toy_model(), noise, None, sampler="euler", steps=3, karras=False
+        )
+        custom = run_sampler(
+            _toy_model(), noise, None, sampler="euler", steps=3, karras=False,
+            alphas_cumprod=short,
+        )
+        assert not np.allclose(np.asarray(default), np.asarray(custom))
+        out = run_sampler(
+            _toy_model(), noise, None, sampler="euler", steps=3, karras=False,
+            alphas_cumprod=short, init_latent=jnp.ones_like(noise), denoise=0.5,
+        )
+        assert np.isfinite(np.asarray(out)).all()

@@ -252,3 +252,55 @@ class TestSDXLConditioning:
         ctx, y = sdxl_text_conditioning(l_pen, g_pen, g_pool, 1024, 1024)
         assert ctx.shape == (B, S, 2048)
         assert y.shape == (B, 2816)  # matches sdxl_config().adm_in_channels
+
+
+class TestUMT5Golden:
+    def test_matches_transformers_per_layer_bias(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            TINY_T5, per_layer_bias=True, vocab_size=TINY_T5.vocab_size
+        )
+        hf_cfg = transformers.UMT5Config(
+            vocab_size=cfg.vocab_size,
+            d_model=cfg.d_model,
+            d_kv=cfg.d_kv,
+            d_ff=cfg.d_ff,
+            num_layers=cfg.num_layers,
+            num_heads=cfg.num_heads,
+            relative_attention_num_buckets=cfg.relative_buckets,
+            relative_attention_max_distance=cfg.relative_max_distance,
+            feed_forward_proj="gated-gelu",
+            dropout_rate=0.0,
+        )
+        torch.manual_seed(0)
+        hf = transformers.UMT5EncoderModel(hf_cfg).eval()
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        params = convert_t5_checkpoint(sd, cfg)
+        # Per-layer tables must exist and be distinct from each other.
+        assert "rel_bias_0" in params and "rel_bias_1" in params
+        assert not np.allclose(
+            np.asarray(params["rel_bias_0"]), np.asarray(params["rel_bias_1"])
+        )
+        enc = build_t5_encoder(cfg, params=params)
+
+        rng = np.random.default_rng(3)
+        tokens = rng.integers(0, cfg.vocab_size, (2, 24))
+        mask = np.ones((2, 24), np.int32)
+        mask[1, 16:] = 0
+        with torch.no_grad():
+            want = hf(
+                torch.from_numpy(tokens), attention_mask=torch.from_numpy(mask)
+            ).last_hidden_state.numpy()
+        got = np.asarray(enc(jnp.asarray(tokens, jnp.int32), mask=jnp.asarray(mask)))
+        np.testing.assert_allclose(got[0], want[0], rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(got[1, :16], want[1, :16], rtol=2e-4, atol=2e-4)
+
+    def test_umt5_xxl_config_constants(self):
+        from comfyui_parallelanything_tpu.models import umt5_xxl_config
+
+        cfg = umt5_xxl_config()
+        assert cfg.per_layer_bias and cfg.vocab_size == 256384
+        assert (cfg.d_model, cfg.num_layers, cfg.num_heads, cfg.d_ff) == (
+            4096, 24, 64, 10240,
+        )

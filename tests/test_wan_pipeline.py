@@ -120,3 +120,82 @@ class TestVideoNodes:
         assert img.shape == (1, 5, 16, 16, 3)
         a = np.asarray(img)
         assert a.min() >= 0.0 and a.max() <= 1.0
+
+
+class TestImageToVideo:
+    @pytest.fixture(scope="class")
+    def i2v_pipe(self, wan_pipe):
+        """Same VAE/T5 as the module pipe but an i2v DiT (in = 2*zc + 4)."""
+        wcfg = WanConfig(
+            in_channels=2 * ZC + 4, out_channels=ZC, hidden_size=48, ffn_dim=96,
+            num_heads=4, depth=2, text_dim=32, freq_dim=16, dtype=jnp.float32,
+        )
+        dit = build_wan(
+            wcfg, jax.random.key(4), sample_shape=(1, 2, 4, 4, 2 * ZC + 4),
+            txt_len=6,
+        )
+        return WanVideoPipeline(
+            dit=dit, vae=wan_pipe.vae, t5=wan_pipe.t5,
+            t5_tokenizer=wan_pipe.t5_tokenizer,
+        )
+
+    def test_image_to_video(self, i2v_pipe):
+        img = jnp.full((1, 16, 16, 3), 0.6)
+        video = i2v_pipe(
+            "hello", steps=2, cfg_scale=1.0, height=16, width=16, frames=5,
+            image=img,
+        )
+        assert video.shape == (1, 5, 16, 16, 3)
+        assert np.isfinite(np.asarray(video)).all()
+
+    def test_image_changes_output(self, i2v_pipe):
+        kw = dict(steps=2, cfg_scale=1.0, height=16, width=16, frames=5,
+                  rng=jax.random.key(8))
+        a = np.asarray(i2v_pipe("hello", image=jnp.zeros((1, 16, 16, 3)), **kw))
+        b = np.asarray(i2v_pipe("hello", image=jnp.ones((1, 16, 16, 3)), **kw))
+        assert not np.allclose(a, b)
+
+    def test_t2v_model_rejected_for_i2v(self, wan_pipe):
+        with pytest.raises(ValueError, match="i2v"):
+            wan_pipe(
+                "hello", steps=1, cfg_scale=1.0, height=16, width=16, frames=5,
+                image=jnp.zeros((1, 16, 16, 3)),
+            )
+
+    def test_image_shape_mismatch_rejected(self, i2v_pipe):
+        with pytest.raises(ValueError, match="image is"):
+            i2v_pipe(
+                "hello", steps=1, cfg_scale=1.0, height=16, width=16, frames=5,
+                image=jnp.zeros((1, 8, 8, 3)),
+            )
+
+
+class TestI2VWithCFG:
+    def test_i2v_under_default_cfg(self, wan_pipe):
+        """CFG doubles the forward batch; the i2v cond tensor must ride along
+        for both halves (this is the pipeline's DEFAULT cfg_scale path)."""
+        wcfg = WanConfig(
+            in_channels=2 * ZC + 4, out_channels=ZC, hidden_size=48, ffn_dim=96,
+            num_heads=4, depth=1, text_dim=32, freq_dim=16, dtype=jnp.float32,
+        )
+        pipe = WanVideoPipeline(
+            dit=build_wan(
+                wcfg, jax.random.key(4), sample_shape=(1, 2, 4, 4, 2 * ZC + 4),
+                txt_len=6,
+            ),
+            vae=wan_pipe.vae, t5=wan_pipe.t5,
+            t5_tokenizer=wan_pipe.t5_tokenizer,
+        )
+        video = pipe(
+            "hello", negative_prompt="world", steps=2, cfg_scale=5.0,
+            height=16, width=16, frames=5, image=jnp.full((1, 16, 16, 3), 0.4),
+        )
+        assert video.shape == (1, 5, 16, 16, 3)
+        assert np.isfinite(np.asarray(video)).all()
+
+    def test_denoise_without_init_video_rejected_at_pipeline(self, wan_pipe):
+        with pytest.raises(ValueError, match="init_video"):
+            wan_pipe(
+                "hello", steps=1, cfg_scale=1.0, height=16, width=16, frames=5,
+                denoise=0.5,
+            )
