@@ -611,6 +611,49 @@ class TPUVAEEncode:
         return ({"samples": vae.encode(images_to_vae_input(image), rng)},)
 
 
+class TPUSetLatentNoiseMask:
+    """(LATENT, MASK) → LATENT with a noise mask attached — inpainting: the
+    KSampler denoises only where mask=1 and re-pins mask=0 regions to the input
+    latent at every step (ComfyUI SetLatentNoiseMask semantics)."""
+
+    DESCRIPTION = "Attach an inpainting mask to a latent (1 = regenerate)."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "set_mask"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"latent": ("LATENT", {}), "mask": ("MASK", {})}}
+
+    def set_mask(self, latent, mask):
+        import jax
+        import jax.numpy as jnp
+
+        samples = latent["samples"]
+        m = jnp.asarray(mask, jnp.float32)
+        video = samples.ndim == 5
+        if video and m.ndim == 3:
+            # (B, H, W) spatial mask on a video latent: applies to every frame.
+            m = m[:, None]
+        if m.ndim == samples.ndim - 1:
+            m = m[..., None]
+        if m.ndim != samples.ndim:
+            raise ValueError(
+                f"mask rank {jnp.asarray(mask).ndim} does not fit latent rank "
+                f"{samples.ndim} (expected a (B, H, W)"
+                f"{' or (B, T, H, W)' if video else ''} mask)"
+            )
+        spatial = samples.shape[1:-1]
+        if m.shape[1:-1] != spatial:
+            target = (m.shape[0], *spatial, 1)
+            if video and m.shape[1] == 1:
+                # Broadcast frame axis: resize spatially only, keep T=1.
+                target = (m.shape[0], 1, *spatial[1:], 1)
+            m = jax.image.resize(m, target, method="bilinear")
+        return ({**latent, "noise_mask": m},)
+
+
 class TPUEmptyVideoLatent:
     """(width, height, frames, batch) → 5-D video LATENT zeros for the WAN
     family; frame count follows the causal 4k+1 schedule (81 by convention)."""
@@ -774,8 +817,14 @@ class TPUKSampler:
             cfg_scale=cfg, uncond_context=uncond_context,
             uncond_kwargs=uncond_kwargs, rng=rng, shift=shift,
             guidance=guidance if guidance > 0 else None,
-            init_latent=latent["samples"] if denoise < 1.0 else None,
-            denoise=denoise, **kwargs,
+            init_latent=(
+                latent["samples"]
+                if (denoise < 1.0 or "noise_mask" in latent)
+                else None
+            ),
+            denoise=denoise,
+            latent_mask=latent.get("noise_mask"),
+            **kwargs,
         )
         return ({"samples": out},)
 
@@ -816,6 +865,7 @@ NODE_CLASS_MAPPINGS = {
     "TPUConditioningCombine": TPUConditioningCombine,
     "TPUEmptyLatent": TPUEmptyLatent,
     "TPUVAEEncode": TPUVAEEncode,
+    "TPUSetLatentNoiseMask": TPUSetLatentNoiseMask,
     "TPUEmptyVideoLatent": TPUEmptyVideoLatent,
     "TPUKSampler": TPUKSampler,
     "TPUVAEDecode": TPUVAEDecode,
@@ -832,6 +882,7 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPUConditioningCombine": "Conditioning Combine (TPU, SDXL/FLUX)",
     "TPUEmptyLatent": "Empty Latent (TPU)",
     "TPUVAEEncode": "VAE Encode (TPU)",
+    "TPUSetLatentNoiseMask": "Set Latent Noise Mask (TPU)",
     "TPUEmptyVideoLatent": "Empty Video Latent (TPU, WAN)",
     "TPUKSampler": "KSampler (TPU)",
     "TPUVAEDecode": "VAE Decode (TPU)",

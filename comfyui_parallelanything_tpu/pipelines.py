@@ -42,11 +42,13 @@ def _match_negatives(prompts: list[str], negative_prompt) -> list[str]:
 
 
 def _encode_init(vae, init, denoise: float, batch: int,
-                 expect: tuple[int, ...], what: str = "init_image"):
+                 expect: tuple[int, ...], what: str = "init_image",
+                 allow_full_denoise: bool = False):
     """Strength-seeded sampling entry shared by ALL pipelines (img2img and
     video2video): validate the (denoise, init) pairing, check the pixel shape
     against ``expect`` (the dims after batch), encode, and broadcast a batch-1
-    init to the prompt batch."""
+    init to the prompt batch. ``allow_full_denoise`` lifts the denoise<1
+    requirement (inpainting keeps regions via the mask even at full strength)."""
     if init is None:
         if denoise < 1.0:
             raise ValueError(
@@ -54,7 +56,7 @@ def _encode_init(vae, init, denoise: float, batch: int,
                 f"something to preserve; pass {what} or drop denoise"
             )
         return None
-    if denoise >= 1.0:
+    if denoise >= 1.0 and not allow_full_denoise:
         raise ValueError(
             f"{what} given but denoise=1.0 — lower denoise (strength) so it "
             "actually seeds the sampler"
@@ -117,11 +119,14 @@ class StableDiffusionPipeline:
         callback=None,
         init_image: jnp.ndarray | None = None,
         denoise: float = 1.0,
+        mask: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         """Returns float images (B, height, width, 3) in [0, 1]. img2img: pass
         ``init_image`` (B or 1, height, width, 3 floats in [0, 1]) with
         ``denoise < 1`` — the sampler starts from the encoded image noised to
-        the truncated schedule's head instead of pure noise."""
+        the truncated schedule's head instead of pure noise. Inpainting: add
+        ``mask`` (B or 1, height, width[, 1]; 1 = regenerate, 0 = keep the
+        init_image region) — works at any denoise, including 1.0."""
         prompts = [prompt] if isinstance(prompt, str) else list(prompt)
         negatives = _match_negatives(prompts, negative_prompt)
         if rng is None:
@@ -149,15 +154,29 @@ class StableDiffusionPipeline:
         kwargs = {} if y is None else {"y": y}
         if sampler == "flow_euler":
             raise ValueError("flow_euler belongs to FluxPipeline, not the SD family")
+        if mask is not None and init_image is None:
+            raise ValueError("mask (inpainting) requires init_image")
+        # Inpainting runs at any strength (mask keeps regions even at full
+        # denoise) — one validated encode path either way.
         init_latent = _encode_init(
-            self.vae, init_image, denoise, B, (height, width)
+            self.vae, init_image, denoise, B, (height, width),
+            allow_full_denoise=mask is not None,
         )
+        latent_mask = None
+        if mask is not None:
+            m = jnp.asarray(mask, jnp.float32)
+            if m.ndim == 3:
+                m = m[..., None]
+            latent_mask = jax.image.resize(
+                m, (m.shape[0], height // f, width // f, 1), method="bilinear"
+            )
         latents = run_sampler(
             self.unet,
             noise,
             context,
             init_latent=init_latent,
             denoise=denoise,
+            latent_mask=latent_mask,
             sampler=sampler,
             steps=steps,
             cfg_scale=cfg_scale if use_cfg else 1.0,

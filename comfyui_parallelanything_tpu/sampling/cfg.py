@@ -32,3 +32,16 @@ def double_kwargs(
         else:
             out[k] = v
     return out
+
+
+def apply_callback(callback, i, x):
+    """Invoke a sampler callback; a return that is an array of x's shape
+    REPLACES the working latent (the hook latent-mask inpainting rides on).
+    Any other return — None, a progress-bar bool, a logger's int — is ignored,
+    so observer callbacks keep their fire-and-forget contract."""
+    if callback is None:
+        return x
+    out = callback(i, x)
+    if out is not None and getattr(out, "shape", None) == x.shape:
+        return out
+    return x

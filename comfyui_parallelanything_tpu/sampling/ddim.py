@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .cfg import double_kwargs
+from .cfg import apply_callback, double_kwargs
 from .schedules import ddim_timesteps, scaled_linear_schedule
 
 
@@ -61,6 +61,5 @@ def ddim_sample(
         a_prev = alphas_cumprod[ts[i + 1]] if i + 1 < len(ts) else jnp.float32(1.0)
         x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
         x = jnp.sqrt(a_prev) * x0 + jnp.sqrt(1.0 - a_prev) * eps
-        if callback is not None:
-            callback(i, x)
+        x = apply_callback(callback, i, x)
     return x

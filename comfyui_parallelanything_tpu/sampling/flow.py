@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .cfg import double_kwargs
+from .cfg import apply_callback, double_kwargs
 
 
 def flow_timesteps(steps: int, shift: float = 1.0) -> jnp.ndarray:
@@ -67,6 +67,5 @@ def flow_euler_sample(
         else:
             v = model(x, t_vec, context, **kw)
         x = x + (ts[i + 1] - ts[i]) * v
-        if callback is not None:
-            callback(i, x)
+        x = apply_callback(callback, i, x)
     return x

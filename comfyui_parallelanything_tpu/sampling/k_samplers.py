@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .cfg import double_kwargs
+from .cfg import apply_callback, double_kwargs
 from .schedules import scaled_linear_schedule
 
 
@@ -112,8 +112,7 @@ def sample_euler(denoise, x, sigmas, callback=None):
         x0 = denoise(x, sigmas[i])
         d = (x - x0) / sigmas[i]
         x = x + d * (sigmas[i + 1] - sigmas[i])
-        if callback is not None:
-            callback(i, x)
+        x = apply_callback(callback, i, x)
     return x
 
 
@@ -132,8 +131,7 @@ def sample_euler_ancestral(denoise, x, sigmas, rng, eta: float = 1.0, callback=N
         if float(s_next) > 0:
             rng, sub = jax.random.split(rng)
             x = x + sigma_up * jax.random.normal(sub, x.shape, x.dtype)
-        if callback is not None:
-            callback(i, x)
+        x = apply_callback(callback, i, x)
     return x
 
 
@@ -150,8 +148,7 @@ def sample_heun(denoise, x, sigmas, callback=None):
             x0_2 = denoise(x_pred, s_next)
             d2 = (x_pred - x0_2) / s_next
             x = x + 0.5 * (d + d2) * (s_next - s)
-        if callback is not None:
-            callback(i, x)
+        x = apply_callback(callback, i, x)
     return x
 
 
@@ -171,8 +168,7 @@ def sample_dpmpp_2m(denoise, x, sigmas, callback=None):
             x0_prime = (1 + 1 / (2 * r)) * x0 - (1 / (2 * r)) * old_x0
             x = (s_next / s) * x - jnp.expm1(-h) * x0_prime
         old_x0 = x0
-        if callback is not None:
-            callback(i, x)
+        x = apply_callback(callback, i, x)
     return x
 
 

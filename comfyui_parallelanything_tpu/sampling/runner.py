@@ -50,6 +50,7 @@ def run_sampler(
     callback=None,
     init_latent: jnp.ndarray | None = None,
     denoise: float = 1.0,
+    latent_mask: jnp.ndarray | None = None,
     **model_kwargs,
 ) -> jnp.ndarray:
     """Drive ``model`` from ``noise`` to a clean latent with the named sampler.
@@ -60,25 +61,52 @@ def run_sampler(
     img2img: with ``init_latent`` + ``denoise < 1``, the schedule for
     ``steps/denoise`` total steps is truncated to its last ``steps`` entries and
     ``init_latent`` is noised to the truncated schedule's start (ComfyUI's
-    KSampler denoise semantics: ``steps`` forwards always run)."""
+    KSampler denoise semantics: ``steps`` forwards always run).
+
+    Inpainting: ``latent_mask`` (broadcastable to the latent; 1 = denoise this
+    region, 0 = keep ``init_latent``) re-pins the keep region to the init noised
+    to each step's level after every sampler step — the ComfyUI latent-noise-
+    mask mechanism. Works at any ``denoise`` (requires ``init_latent``)."""
     use_cfg = cfg_scale != 1.0 and uncond_context is not None
     eff_cfg = cfg_scale if use_cfg else 1.0
     if not 0.0 < denoise <= 1.0:
         raise ValueError(f"denoise must be in (0, 1], got {denoise}")
+    if latent_mask is not None and init_latent is None:
+        raise ValueError("latent_mask requires init_latent (the kept content)")
     img2img = init_latent is not None and denoise < 1.0
     total = max(steps, int(round(steps / denoise))) if img2img else steps
 
+    def masked_callback(keep_at):
+        """Blend the keep-region back after each step; the user callback (which
+        may itself replace x) runs on the blended latent."""
+        if latent_mask is None:
+            return callback
+        m = latent_mask
+        user = callback
+
+        def cb(i, x):
+            x = x * m + keep_at(i) * (1.0 - m)
+            if user is not None:
+                out = user(i, x)
+                x = x if out is None else out
+            return x
+
+        return cb
+
     if sampler == "flow_euler":
-        ts = None
+        ts = flow_timesteps(total, shift)
         x = noise
         if img2img:
             # x_t = t·noise + (1-t)·x0 under the v = noise - x0 flow.
-            ts = flow_timesteps(total, shift)[-(steps + 1) :]
+            ts = ts[-(steps + 1) :]
             x = ts[0] * noise + (1.0 - ts[0]) * init_latent
+        cb = masked_callback(
+            lambda i: (1.0 - ts[i + 1]) * init_latent + ts[i + 1] * noise
+        )
         return flow_euler_sample(
             model, x, context, steps=steps, shift=shift, guidance=guidance,
             cfg_scale=eff_cfg, uncond_context=uncond_context,
-            uncond_kwargs=uncond_kwargs, callback=callback, ts=ts, **model_kwargs,
+            uncond_kwargs=uncond_kwargs, callback=cb, ts=ts, **model_kwargs,
         )
     if sampler == "ddim":
         # A caller-supplied schedule must drive BOTH the truncation/noising here
@@ -89,7 +117,8 @@ def run_sampler(
             from .schedules import scaled_linear_schedule
 
             acp = scaled_linear_schedule()
-        ts = None
+        from .schedules import ddim_timesteps
+
         x = noise
         if img2img:
             # Exact-strength truncation: `steps` timesteps evenly spaced over
@@ -100,10 +129,18 @@ def run_sampler(
             ts = jnp.linspace(t_start, 0, steps).round().astype(jnp.int32)
             a0 = acp[ts[0]]
             x = jnp.sqrt(a0) * init_latent + jnp.sqrt(1.0 - a0) * noise
+        else:
+            ts = ddim_timesteps(steps, acp.shape[0])
+
+        def ddim_keep(i):
+            a = acp[ts[i + 1]] if i + 1 < len(ts) else jnp.float32(1.0)
+            return jnp.sqrt(a) * init_latent + jnp.sqrt(1.0 - a) * noise
+
         return ddim_sample(
             model, x, context, steps=steps, cfg_scale=eff_cfg,
             uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
-            callback=callback, ts=ts, alphas_cumprod=acp, **model_kwargs,
+            callback=masked_callback(ddim_keep), ts=ts, alphas_cumprod=acp,
+            **model_kwargs,
         )
     step_fn = K_SAMPLERS.get(sampler)
     if step_fn is None:
@@ -135,8 +172,9 @@ def run_sampler(
     x = noise * sigmas[0]
     if img2img:
         x = init_latent + x
+    cb = masked_callback(lambda i: init_latent + noise * sigmas[i + 1])
     if sampler == "euler_ancestral":
         if rng is None:
             rng = jax.random.key(0)
-        return step_fn(denoiser, x, sigmas, jax.random.fold_in(rng, 1), callback=callback)
-    return step_fn(denoiser, x, sigmas, callback=callback)
+        return step_fn(denoiser, x, sigmas, jax.random.fold_in(rng, 1), callback=cb)
+    return step_fn(denoiser, x, sigmas, callback=cb)
