@@ -58,6 +58,16 @@ def clip_l_config(**overrides) -> CLIPTextConfig:
     return dataclasses.replace(CLIPTextConfig(), **overrides)
 
 
+def open_clip_h_config(**overrides) -> CLIPTextConfig:
+    """OpenCLIP ViT-H/14 text tower (SD2.x context encoder): 1024 wide, 24
+    layers, plain gelu; SD2.x conditions on the penultimate layer."""
+    base = CLIPTextConfig(
+        hidden_size=1024, num_layers=24, num_heads=16, act="gelu",
+        projection_dim=1024,
+    )
+    return dataclasses.replace(base, **overrides)
+
+
 def open_clip_g_config(**overrides) -> CLIPTextConfig:
     """OpenCLIP bigG/14 text tower (SDXL's second encoder)."""
     base = CLIPTextConfig(

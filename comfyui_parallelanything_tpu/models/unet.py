@@ -35,11 +35,23 @@ class UNetConfig:
     context_dim: int = 768
     adm_in_channels: int | None = None  # SDXL pooled-text+size vector conditioning
     norm_groups: int = 32
+    # Sampling parameterization the checkpoint was trained with ("eps" or "v");
+    # carried on the config so samplers/nodes pick it up without a side channel
+    # (ComfyUI keeps this in model_sampling the same way).
+    prediction: str = "eps"
     dtype: Any = jnp.bfloat16  # compute dtype; params stay f32
 
 
 def sd15_config(**overrides) -> UNetConfig:
     return dataclasses.replace(UNetConfig(), **overrides)
+
+
+def sd21_config(**overrides) -> UNetConfig:
+    """SD2.x UNet: OpenCLIP-H context (1024) and fixed 64-dim heads. The 512
+    base checkpoints are eps; the 768-v ones v-prediction — pass
+    ``prediction="v"`` (or use the node family "sd21-v")."""
+    base = UNetConfig(context_dim=1024, num_heads=-1)
+    return dataclasses.replace(base, **overrides)
 
 
 def sdxl_config(**overrides) -> UNetConfig:

@@ -271,8 +271,8 @@ class ParallelAnythingAdvanced(ParallelAnything):
 # ---------------------------------------------------------------------------
 
 _MODEL_FAMILIES = (
-    "sd15", "sdxl", "flux-dev", "flux-schnell", "zimage-turbo",
-    "wan-1.3b", "wan-14b",
+    "sd15", "sd21", "sd21-v", "sdxl", "flux-dev", "flux-schnell",
+    "zimage-turbo", "wan-1.3b", "wan-14b",
 )
 
 
@@ -324,6 +324,7 @@ class TPUCheckpointLoader:
             load_sd_unet_checkpoint,
             load_vae_checkpoint,
             sd15_config,
+            sd21_config,
             sd_vae_config,
             sdxl_config,
             sdxl_vae_config,
@@ -353,6 +354,12 @@ class TPUCheckpointLoader:
             return model, load_wan_vae_checkpoint(vae_path)
         if family == "sd15":
             model = load_sd_unet_checkpoint(sd, sd15_config(), lora, lora_strength)
+            vae_cfg = sd_vae_config()
+        elif family in ("sd21", "sd21-v"):
+            ucfg = sd21_config(
+                prediction="v" if family == "sd21-v" else "eps"
+            )
+            model = load_sd_unet_checkpoint(sd, ucfg, lora, lora_strength)
             vae_cfg = sd_vae_config()
         elif family == "sdxl":
             model = load_sd_unet_checkpoint(sd, sdxl_config(), lora, lora_strength)
@@ -395,7 +402,7 @@ class TPUCLIPLoader:
             "required": {
                 "encoder_path": ("STRING", {"default": ""}),
                 "encoder_type": (
-                    ["clip-l", "open-clip-g", "t5", "umt5"],
+                    ["clip-l", "open-clip-g", "open-clip-h", "t5", "umt5"],
                     {"default": "clip-l"},
                 ),
             },
@@ -433,8 +440,14 @@ class TPUCLIPLoader:
                 enc = load_t5_checkpoint(encoder_path)
             tok = load_tokenizer_json(tokenizer_json, max_len=max_len, eos_id=1)
         else:
+            cfg = None
+            if encoder_type == "open-clip-h":
+                from .models import open_clip_h_config
+
+                cfg = open_clip_h_config()
             enc = load_clip_text_checkpoint(
-                encoder_path, open_clip=encoder_type == "open-clip-g"
+                encoder_path, cfg=cfg,
+                open_clip=encoder_type in ("open-clip-g", "open-clip-h")
             )
             if tokenizer_json:
                 tok = load_tokenizer_json(tokenizer_json, max_len=max_len)
@@ -817,6 +830,7 @@ class TPUKSampler:
             cfg_scale=cfg, uncond_context=uncond_context,
             uncond_kwargs=uncond_kwargs, rng=rng, shift=shift,
             guidance=guidance if guidance > 0 else None,
+            prediction=getattr(model_cfg, "prediction", "eps"),
             init_latent=(
                 latent["samples"]
                 if (denoise < 1.0 or "noise_mask" in latent)

@@ -81,10 +81,13 @@ class StableDiffusionPipeline:
 
     unet: Any
     vae: Any
-    clip: Any  # CLIP-L TextEncoder
+    clip: Any  # CLIP-L (SD1.5) or OpenCLIP-H (SD2.x) TextEncoder
     tokenizer: Any  # prompts -> (ids, mask)
     clip_g: Any = None  # SDXL second tower (OpenCLIP-G)
     tokenizer_g: Any = None
+    # SD2.x conditions on the encoder's penultimate layer ("penultimate");
+    # SD1.5 on the final layer-normed stream ("last").
+    clip_layer: str = "last"
 
     @property
     def is_sdxl(self) -> bool:
@@ -95,7 +98,12 @@ class StableDiffusionPipeline:
         ids, _ = self.tokenizer(prompts)
         last, penultimate, _pooled = self.clip(jnp.asarray(ids, jnp.int32))
         if not self.is_sdxl:
-            return last, None
+            if self.clip_layer not in ("last", "penultimate"):
+                raise ValueError(
+                    f"clip_layer must be 'last' or 'penultimate', got "
+                    f"{self.clip_layer!r}"
+                )
+            return (penultimate if self.clip_layer == "penultimate" else last), None
         from .models.text_encoders import sdxl_text_conditioning
 
         ids_g, _ = (self.tokenizer_g or self.tokenizer)(prompts)
@@ -170,6 +178,8 @@ class StableDiffusionPipeline:
             latent_mask = jax.image.resize(
                 m, (m.shape[0], height // f, width // f, 1), method="bilinear"
             )
+        from .parallel.orchestrator import model_config_of
+
         latents = run_sampler(
             self.unet,
             noise,
@@ -177,6 +187,7 @@ class StableDiffusionPipeline:
             init_latent=init_latent,
             denoise=denoise,
             latent_mask=latent_mask,
+            prediction=getattr(model_config_of(self.unet), "prediction", "eps"),
             sampler=sampler,
             steps=steps,
             cfg_scale=cfg_scale if use_cfg else 1.0,

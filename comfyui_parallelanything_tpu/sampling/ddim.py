@@ -31,11 +31,15 @@ def ddim_sample(
     alphas_cumprod: jnp.ndarray | None = None,
     callback=None,
     ts: jnp.ndarray | None = None,
+    prediction: str = "eps",
     **model_kwargs,
 ) -> jnp.ndarray:
     """Denoise ``x_init`` (noise at t=ts[0]) over the DDIM steps. Returns x_0.
     ``ts`` overrides the timestep schedule (img2img passes a truncated one and
-    pre-noises ``x_init`` to ts[0] itself)."""
+    pre-noises ``x_init`` to ts[0] itself). ``prediction="v"`` treats the model
+    output as SD2.x v-parameterization (x0 = √ᾱ·x − √(1−ᾱ)·v)."""
+    if prediction not in ("eps", "v"):
+        raise ValueError(f"prediction must be 'eps' or 'v', got {prediction!r}")
     if alphas_cumprod is None:
         alphas_cumprod = scaled_linear_schedule()
     if ts is None:
@@ -51,15 +55,20 @@ def ddim_sample(
             t_in = jnp.concatenate([t_vec, t_vec], axis=0)
             c_in = jnp.concatenate([context, uncond_context], axis=0)
             kw = double_kwargs(model_kwargs, uncond_kwargs, batch)
-            eps_both = model(x_in, t_in, c_in, **kw)
-            eps_c, eps_u = jnp.split(eps_both, 2, axis=0)
-            eps = eps_u + cfg_scale * (eps_c - eps_u)
+            out_both = model(x_in, t_in, c_in, **kw)
+            out_c, out_u = jnp.split(out_both, 2, axis=0)
+            out = out_u + cfg_scale * (out_c - out_u)
         else:
-            eps = model(x, t_vec, context, **model_kwargs)
+            out = model(x, t_vec, context, **model_kwargs)
 
         a_t = alphas_cumprod[t]
         a_prev = alphas_cumprod[ts[i + 1]] if i + 1 < len(ts) else jnp.float32(1.0)
-        x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
+        if prediction == "v":
+            x0 = jnp.sqrt(a_t) * x - jnp.sqrt(1.0 - a_t) * out
+            eps = (x - jnp.sqrt(a_t) * x0) / jnp.sqrt(1.0 - a_t)
+        else:
+            eps = out
+            x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
         x = jnp.sqrt(a_prev) * x0 + jnp.sqrt(1.0 - a_prev) * eps
         x = apply_callback(callback, i, x)
     return x

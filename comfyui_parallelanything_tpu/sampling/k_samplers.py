@@ -50,8 +50,10 @@ def karras_sigmas(
 
 
 class EpsDenoiser:
-    """Wraps an eps-prediction forward into ``denoise(x, sigma) -> x0`` with batched
-    CFG (cond ‖ uncond in one call — what feeds the DP path its batch, ddim.py)."""
+    """Wraps a discrete eps- or v-prediction forward into ``denoise(x, sigma) ->
+    x0`` with batched CFG (cond ‖ uncond in one call — what feeds the DP path
+    its batch, ddim.py). ``prediction="v"`` selects the SD2.x-768 v-param
+    (x0 = c_skip·x + c_out·v with c_skip = 1/(σ²+1), c_out = -σ/√(σ²+1))."""
 
     def __init__(
         self,
@@ -62,10 +64,14 @@ class EpsDenoiser:
         uncond_context=None,
         uncond_kwargs: dict | None = None,
         alphas_cumprod: jnp.ndarray | None = None,
+        prediction: str = "eps",
         **model_kwargs,
     ):
         if alphas_cumprod is None:
             alphas_cumprod = scaled_linear_schedule()
+        if prediction not in ("eps", "v"):
+            raise ValueError(f"prediction must be 'eps' or 'v', got {prediction!r}")
+        self.prediction = prediction
         self.model = model
         self.context = context
         self.cfg_scale = cfg_scale
@@ -103,6 +109,8 @@ class EpsDenoiser:
             eps = eps_u + self.cfg_scale * (eps_c - eps_u)
         else:
             eps = self.model(x_in, t_vec, self.context, **self.kwargs)
+        if self.prediction == "v":
+            return x / (sigma**2 + 1.0) - eps * sigma * scale
         return x - sigma * eps
 
 

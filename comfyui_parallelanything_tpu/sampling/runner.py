@@ -51,6 +51,7 @@ def run_sampler(
     init_latent: jnp.ndarray | None = None,
     denoise: float = 1.0,
     latent_mask: jnp.ndarray | None = None,
+    prediction: str = "eps",
     **model_kwargs,
 ) -> jnp.ndarray:
     """Drive ``model`` from ``noise`` to a clean latent with the named sampler.
@@ -73,6 +74,9 @@ def run_sampler(
         raise ValueError(f"denoise must be in (0, 1], got {denoise}")
     if latent_mask is not None and init_latent is None:
         raise ValueError("latent_mask requires init_latent (the kept content)")
+    if prediction != "eps" and sampler == "flow_euler":
+        raise ValueError("flow_euler is velocity-parameterized already; "
+                         "prediction applies to the eps-family samplers")
     img2img = init_latent is not None and denoise < 1.0
     total = max(steps, int(round(steps / denoise))) if img2img else steps
 
@@ -140,7 +144,7 @@ def run_sampler(
             model, x, context, steps=steps, cfg_scale=eff_cfg,
             uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
             callback=masked_callback(ddim_keep), ts=ts, alphas_cumprod=acp,
-            **model_kwargs,
+            prediction=prediction, **model_kwargs,
         )
     step_fn = K_SAMPLERS.get(sampler)
     if step_fn is None:
@@ -167,7 +171,8 @@ def run_sampler(
         sigmas = sigmas[-(steps + 1) :]
     denoiser = EpsDenoiser(
         model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,
-        uncond_kwargs=uncond_kwargs, alphas_cumprod=acp, **model_kwargs,
+        uncond_kwargs=uncond_kwargs, alphas_cumprod=acp, prediction=prediction,
+        **model_kwargs,
     )
     x = noise * sigmas[0]
     if img2img:

@@ -1,0 +1,190 @@
+"""v-parameterization (SD2.x-768): denoiser algebra, ddim equivalence, and the
+config-carried prediction type reaching the samplers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.sampling.k_samplers import (
+    EpsDenoiser,
+    model_sigmas,
+)
+from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+from comfyui_parallelanything_tpu.sampling.schedules import (
+    scaled_linear_schedule,
+)
+
+
+def _zero_model(x, t, context=None, **kw):
+    return jnp.zeros_like(x)
+
+
+class TestVDenoiser:
+    def test_zero_v_output_gives_cskip_x(self):
+        """With v=0, x0 = x/(sigma^2+1) exactly (the c_skip term alone)."""
+        den = EpsDenoiser(_zero_model, None, prediction="v")
+        x = jnp.full((1, 4, 4, 4), 3.0)
+        sigma = jnp.float32(2.0)
+        out = np.asarray(den(x, sigma))
+        np.testing.assert_allclose(out, 3.0 / 5.0, rtol=1e-6)
+
+    def test_zero_eps_output_gives_x(self):
+        den = EpsDenoiser(_zero_model, None, prediction="eps")
+        x = jnp.full((1, 4, 4, 4), 3.0)
+        np.testing.assert_allclose(np.asarray(den(x, jnp.float32(2.0))), 3.0)
+
+    def test_eps_and_v_consistent_on_equivalent_models(self):
+        """An eps model and the v model derived from the same x0-prediction must
+        produce the same denoised output: v = alpha*eps - sigma_t*x0 relation
+        checked through the sigma-space wrapper."""
+        acp = scaled_linear_schedule()
+        table = model_sigmas(acp)
+        x = jax.random.normal(jax.random.key(0), (1, 4, 4, 4))
+        sigma = table[500]
+        alpha_bar = acp[500]
+
+        # Fix a ground-truth x0; build exact eps and v predictions for the
+        # *scaled* input x_in = x/sqrt(sigma^2+1) = sqrt(alpha_bar)-scaled x_t.
+        x0 = jnp.ones_like(x) * 0.3
+
+        def eps_model(x_in, t, context=None, **kw):
+            # x_t(discrete) = x_in; eps = (x_t - sqrt(a)x0)/sqrt(1-a)
+            return (x_in - jnp.sqrt(alpha_bar) * x0) / jnp.sqrt(1 - alpha_bar)
+
+        def v_model(x_in, t, context=None, **kw):
+            eps = (x_in - jnp.sqrt(alpha_bar) * x0) / jnp.sqrt(1 - alpha_bar)
+            return jnp.sqrt(alpha_bar) * eps - jnp.sqrt(1 - alpha_bar) * x0
+
+        out_eps = np.asarray(EpsDenoiser(eps_model, None)(x, sigma))
+        out_v = np.asarray(EpsDenoiser(v_model, None, prediction="v")(x, sigma))
+        np.testing.assert_allclose(out_eps, out_v, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(out_eps, 0.3, rtol=1e-4, atol=1e-5)
+
+
+class TestDdimV:
+    def test_ddim_v_equals_eps_for_equivalent_models(self):
+        from comfyui_parallelanything_tpu.sampling.ddim import ddim_sample
+
+        acp = scaled_linear_schedule()
+        x0 = 0.25
+
+        def eps_model(x, t, context=None, **kw):
+            a = acp[t.astype(jnp.int32)][:, None, None, None]
+            return (x - jnp.sqrt(a) * x0) / jnp.sqrt(1 - a)
+
+        def v_model(x, t, context=None, **kw):
+            a = acp[t.astype(jnp.int32)][:, None, None, None]
+            eps = (x - jnp.sqrt(a) * x0) / jnp.sqrt(1 - a)
+            return jnp.sqrt(a) * eps - jnp.sqrt(1 - a) * x0
+
+        noise = jax.random.normal(jax.random.key(1), (1, 4, 4, 4))
+        out_e = np.asarray(ddim_sample(eps_model, noise, steps=4))
+        out_v = np.asarray(ddim_sample(v_model, noise, steps=4, prediction="v"))
+        np.testing.assert_allclose(out_e, out_v, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(out_e, x0, rtol=1e-3, atol=1e-4)
+
+    def test_bad_prediction_rejected(self):
+        with pytest.raises(ValueError, match="prediction"):
+            EpsDenoiser(_zero_model, None, prediction="x0")
+
+    def test_flow_rejects_prediction(self):
+        with pytest.raises(ValueError, match="flow_euler"):
+            run_sampler(
+                _zero_model, jnp.zeros((1, 4, 4, 4)), None,
+                sampler="flow_euler", steps=2, prediction="v",
+            )
+
+
+class TestConfigCarriesPrediction:
+    def test_sd21_config(self):
+        from comfyui_parallelanything_tpu.models import sd21_config
+
+        assert sd21_config().prediction == "eps"
+        assert sd21_config(prediction="v").prediction == "v"
+        assert sd21_config().context_dim == 1024
+
+    def test_run_sampler_prediction_changes_output(self):
+        def model(x, t, context=None, **kw):
+            return 0.3 * x + 0.1
+
+        noise = jax.random.normal(jax.random.key(2), (1, 4, 4, 4))
+        a = run_sampler(model, noise, None, sampler="euler", steps=3)
+        b = run_sampler(
+            model, noise, None, sampler="euler", steps=3, prediction="v"
+        )
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+class TestSD2TextTower:
+    def test_open_clip_h_config(self):
+        from comfyui_parallelanything_tpu.models import open_clip_h_config
+
+        cfg = open_clip_h_config()
+        assert (cfg.hidden_size, cfg.num_layers, cfg.num_heads) == (1024, 24, 16)
+        assert cfg.act == "gelu" and cfg.projection_dim == 1024
+
+    def test_pipeline_penultimate_conditioning(self):
+        """An SD2-style pipeline (1024-ctx UNet + H tower, penultimate layer)
+        produces an image end-to-end — the full sd21 path."""
+        from comfyui_parallelanything_tpu.models import (
+            CLIPTextConfig, VAEConfig, build_clip_text, build_unet, build_vae,
+            sd21_config,
+        )
+        from comfyui_parallelanything_tpu.pipelines import StableDiffusionPipeline
+        from test_tokenizer import _tiny_tokenizer
+
+        tok = _tiny_tokenizer()
+        ccfg = CLIPTextConfig(
+            vocab_size=64, hidden_size=48, num_layers=2, num_heads=4, max_len=8,
+            act="gelu", eos_id=tok.eos_id, dtype=jnp.float32,
+        )
+        ucfg = sd21_config(
+            model_channels=32, channel_mult=(1, 2), transformer_depth=(1, 1),
+            attention_levels=(0, 1), context_dim=48, num_heads=4, norm_groups=8,
+            prediction="v", dtype=jnp.float32,
+        )
+        vcfg = VAEConfig(
+            z_channels=4, base_channels=32, channel_mult=(1, 2),
+            num_res_blocks=1, norm_groups=8, dtype=jnp.float32,
+        )
+        pipe = StableDiffusionPipeline(
+            unet=build_unet(ucfg, jax.random.key(0), sample_shape=(1, 8, 8, 4)),
+            vae=build_vae(vcfg, jax.random.key(1), sample_hw=16),
+            clip=build_clip_text(ccfg, jax.random.key(2)),
+            tokenizer=tok,
+            clip_layer="penultimate",
+        )
+        img = pipe("hello", steps=2, cfg_scale=1.0, height=16, width=16)
+        assert img.shape == (1, 16, 16, 3)
+        assert np.isfinite(np.asarray(img)).all()
+
+    def test_bad_clip_layer_rejected(self):
+        from comfyui_parallelanything_tpu.models import (
+            CLIPTextConfig, VAEConfig, build_clip_text, build_unet, build_vae,
+            sd15_config,
+        )
+        from comfyui_parallelanything_tpu.pipelines import StableDiffusionPipeline
+        from test_tokenizer import _tiny_tokenizer
+
+        tok = _tiny_tokenizer()
+        pipe = StableDiffusionPipeline(
+            unet=build_unet(
+                sd15_config(model_channels=32, channel_mult=(1, 2),
+                            transformer_depth=(1, 1), attention_levels=(0, 1),
+                            context_dim=48, num_heads=4, norm_groups=8,
+                            dtype=jnp.float32),
+                jax.random.key(0), sample_shape=(1, 8, 8, 4)),
+            vae=build_vae(
+                VAEConfig(z_channels=4, base_channels=32, channel_mult=(1, 2),
+                          num_res_blocks=1, norm_groups=8, dtype=jnp.float32),
+                jax.random.key(1), sample_hw=16),
+            clip=build_clip_text(
+                CLIPTextConfig(vocab_size=64, hidden_size=48, num_layers=2,
+                               num_heads=4, max_len=8, eos_id=tok.eos_id,
+                               dtype=jnp.float32), jax.random.key(2)),
+            tokenizer=tok,
+            clip_layer="antepenultimate",
+        )
+        with pytest.raises(ValueError, match="clip_layer"):
+            pipe("hello", steps=1, cfg_scale=1.0, height=16, width=16)
