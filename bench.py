@@ -114,6 +114,15 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    # Persistent XLA compilation cache: repeat driver runs skip the 20-40s
+    # first-compile (cache dir is repo-local; harmless on first run).
+    try:
+        jax.config.update("jax_compilation_cache_dir", 
+                          os.path.join(os.path.dirname(__file__), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass
+
     from comfyui_parallelanything_tpu import DeviceChain, parallelize
 
     platform = jax.devices()[0].platform

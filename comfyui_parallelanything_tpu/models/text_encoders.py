@@ -46,6 +46,9 @@ class CLIPTextConfig:
     act: str = "quick_gelu"  # "quick_gelu" (CLIP-L) | "gelu" (OpenCLIP-G)
     eos_id: int = 49407
     projection_dim: int | None = None  # text_projection for pooled (OpenCLIP / SDXL)
+    # SD2's FrozenOpenCLIPEmbedder applies ln_final to the penultimate stream;
+    # SDXL consumes it raw. Config-carried so consumers need no side channel.
+    penultimate_ln: bool = False
     dtype: Any = jnp.bfloat16
 
     @property
@@ -63,7 +66,7 @@ def open_clip_h_config(**overrides) -> CLIPTextConfig:
     layers, plain gelu; SD2.x conditions on the penultimate layer."""
     base = CLIPTextConfig(
         hidden_size=1024, num_layers=24, num_heads=16, act="gelu",
-        projection_dim=1024,
+        projection_dim=1024, penultimate_ln=True,
     )
     return dataclasses.replace(base, **overrides)
 
@@ -117,8 +120,9 @@ class _CLIPBlock(nn.Module):
 class CLIPTextModel(nn.Module):
     """Returns (last_hidden, penultimate_hidden, pooled). ``last_hidden`` has the
     final LayerNorm applied; ``penultimate_hidden`` is the raw layer-(N-1) stream
-    (SDXL consumes exactly that, un-normed). ``pooled`` reads the first-EOS position
-    of the final-LN stream, projected when cfg.projection_dim is set."""
+    (SDXL consumes exactly that, un-normed) unless ``cfg.penultimate_ln`` (SD2's
+    OpenCLIP-H convention: ln_final applied). ``pooled`` reads the first-EOS
+    position of the final-LN stream, projected when cfg.projection_dim is set."""
 
     cfg: CLIPTextConfig
 
@@ -141,7 +145,10 @@ class CLIPTextModel(nn.Module):
             if i == cfg.num_layers - 1:
                 penultimate = x
             x = _CLIPBlock(cfg, name=f"layers_{i}")(x, causal)
-        last = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="final_ln")(x)
+        final_ln = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="final_ln")
+        last = final_ln(x)
+        if cfg.penultimate_ln:
+            penultimate = final_ln(penultimate)
         eos_pos = jnp.argmax((tokens == cfg.eos_id).astype(jnp.int32), axis=-1)
         pooled = jnp.take_along_axis(last, eos_pos[:, None, None], axis=1)[:, 0]
         if cfg.projection_dim is not None:

@@ -454,7 +454,11 @@ class TPUCLIPLoader:
             elif vocab_path and merges_path:
                 tok = CLIPBPETokenizer.from_files(
                     vocab_path, merges_path, max_len=max_len,
-                    pad_id=0 if encoder_type == "open-clip-g" else None,
+                    pad_id=(
+                        0
+                        if encoder_type in ("open-clip-g", "open-clip-h")
+                        else None
+                    ),
                 )
             else:
                 raise ValueError(
@@ -491,9 +495,16 @@ class TPUTextEncode:
             context = enc(jnp.asarray(ids, jnp.int32), mask=jnp.asarray(mask))
             return ({"context": context, "pooled": None},)
         last, penultimate, pooled = enc(jnp.asarray(ids, jnp.int32))
+        # SD2 towers (penultimate_ln configs) were trained with penultimate-
+        # layer conditioning — route it as the context automatically.
+        context = (
+            penultimate
+            if getattr(enc.cfg, "penultimate_ln", False)
+            else last
+        )
         return (
             {
-                "context": last,
+                "context": context,
                 "penultimate": penultimate,
                 "pooled": pooled,
             },

@@ -85,7 +85,8 @@ class StableDiffusionPipeline:
     tokenizer: Any  # prompts -> (ids, mask)
     clip_g: Any = None  # SDXL second tower (OpenCLIP-G)
     tokenizer_g: Any = None
-    # SD2.x conditions on the encoder's penultimate layer ("penultimate");
+    # SD2.x conditions on the encoder's penultimate layer ("penultimate" —
+    # with open_clip_h_config the tower already applies SD2's ln_final to it);
     # SD1.5 on the final layer-normed stream ("last").
     clip_layer: str = "last"
 

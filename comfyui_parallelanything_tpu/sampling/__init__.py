@@ -2,6 +2,7 @@ from .schedules import scaled_linear_schedule, ddim_timesteps
 from .ddim import ddim_sample
 from .flow import flow_euler_sample, flow_timesteps
 from .k_samplers import (
+    RNG_SAMPLERS,
     SAMPLERS,
     EpsDenoiser,
     karras_sigmas,
@@ -19,6 +20,7 @@ __all__ = [
     "flow_euler_sample",
     "flow_timesteps",
     "SAMPLERS",
+    "RNG_SAMPLERS",
     "EpsDenoiser",
     "karras_sigmas",
     "sampling_sigmas",

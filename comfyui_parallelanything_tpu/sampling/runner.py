@@ -6,7 +6,6 @@ here is immediately available to both the Python pipeline API and the node graph
 
 from __future__ import annotations
 
-from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -14,21 +13,12 @@ import jax.numpy as jnp
 from .ddim import ddim_sample
 from .flow import flow_euler_sample, flow_timesteps
 from .k_samplers import (
+    RNG_SAMPLERS,
+    SAMPLERS as K_SAMPLERS,
     EpsDenoiser,
     karras_sigmas,
-    sample_dpmpp_2m,
-    sample_euler,
-    sample_euler_ancestral,
-    sample_heun,
     sampling_sigmas,
 )
-
-K_SAMPLERS: dict[str, Callable] = {
-    "euler": sample_euler,
-    "euler_ancestral": sample_euler_ancestral,
-    "heun": sample_heun,
-    "dpmpp_2m": sample_dpmpp_2m,
-}
 
 SAMPLER_NAMES = ("ddim", *K_SAMPLERS, "flow_euler")
 
@@ -178,7 +168,7 @@ def run_sampler(
     if img2img:
         x = init_latent + x
     cb = masked_callback(lambda i: init_latent + noise * sigmas[i + 1])
-    if sampler == "euler_ancestral":
+    if sampler in RNG_SAMPLERS:
         if rng is None:
             rng = jax.random.key(0)
         return step_fn(denoiser, x, sigmas, jax.random.fold_in(rng, 1), callback=cb)

@@ -92,7 +92,13 @@ class TestSamplersRecoverX0:
         np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=0.15, atol=0.15)
 
     def test_registry_complete(self):
-        assert set(SAMPLERS) == {"euler", "euler_ancestral", "heun", "dpmpp_2m"}
+        from comfyui_parallelanything_tpu.sampling import RNG_SAMPLERS
+
+        assert set(SAMPLERS) == {
+            "euler", "euler_ancestral", "heun", "lms", "dpmpp_2m",
+            "dpmpp_2m_sde",
+        }
+        assert RNG_SAMPLERS <= set(SAMPLERS)
 
 
 class TestCFGBatching:
@@ -112,3 +118,67 @@ class TestCFGBatching:
         x = jnp.ones((2, 4, 4, 3))
         den(x, jnp.float32(1.0))
         assert calls == [4]  # cond ‖ uncond fused into one forward
+
+
+class TestNewSamplers:
+    @pytest.mark.parametrize("sampler", ["lms", "dpmpp_2m_sde"])
+    def test_converges_on_perfect_denoiser(self, sampler):
+        """A denoise fn that always returns the target x0 must be recovered."""
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            karras_sigmas,
+            sample_dpmpp_2m_sde,
+            sample_lms,
+        )
+
+        target = 0.3
+
+        sigmas = karras_sigmas(8)
+        noise = jax.random.normal(jax.random.key(0), (1, 4, 4, 4))
+        x = noise * sigmas[0]
+        denoise = lambda x_, s: jnp.full_like(x_, target)
+        if sampler == "lms":
+            out = sample_lms(denoise, x, sigmas)
+        else:
+            out = sample_dpmpp_2m_sde(denoise, x, sigmas, jax.random.key(1), eta=0.0)
+        np.testing.assert_allclose(np.asarray(out), target, rtol=1e-2, atol=2e-2)
+
+    def test_sde_eta_zero_deterministic(self):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            karras_sigmas,
+            sample_dpmpp_2m_sde,
+        )
+
+        sigmas = karras_sigmas(5)
+        x = jax.random.normal(jax.random.key(2), (1, 4, 4, 4)) * sigmas[0]
+        denoise = lambda x_, s: x_ * 0.5
+        a = sample_dpmpp_2m_sde(denoise, x, sigmas, jax.random.key(3), eta=0.0)
+        b = sample_dpmpp_2m_sde(denoise, x, sigmas, jax.random.key(9), eta=0.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_sde_noise_depends_on_rng(self):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            karras_sigmas,
+            sample_dpmpp_2m_sde,
+        )
+
+        sigmas = karras_sigmas(5)
+        x = jax.random.normal(jax.random.key(2), (1, 4, 4, 4)) * sigmas[0]
+        denoise = lambda x_, s: x_ * 0.5
+        a = sample_dpmpp_2m_sde(denoise, x, sigmas, jax.random.key(3))
+        b = sample_dpmpp_2m_sde(denoise, x, sigmas, jax.random.key(9))
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+    def test_run_sampler_dispatch(self):
+        from comfyui_parallelanything_tpu.sampling.runner import (
+            SAMPLER_NAMES,
+            run_sampler,
+        )
+
+        assert "lms" in SAMPLER_NAMES and "dpmpp_2m_sde" in SAMPLER_NAMES
+        noise = jax.random.normal(jax.random.key(0), (1, 4, 4, 4))
+        for s in ("lms", "dpmpp_2m_sde"):
+            out = run_sampler(
+                lambda x, t, c=None, **kw: 0.1 * x, noise, None, sampler=s,
+                steps=3, rng=jax.random.key(1),
+            )
+            assert np.isfinite(np.asarray(out)).all()

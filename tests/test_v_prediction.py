@@ -188,3 +188,50 @@ class TestSD2TextTower:
         )
         with pytest.raises(ValueError, match="clip_layer"):
             pipe("hello", steps=1, cfg_scale=1.0, height=16, width=16)
+
+    def test_penultimate_ln_applied_for_sd2_towers(self):
+        """open_clip_h towers apply ln_final to the penultimate stream (SD2's
+        FrozenOpenCLIPEmbedder convention) — raw for SDXL-style towers."""
+        import dataclasses
+
+        from comfyui_parallelanything_tpu.models import (
+            CLIPTextConfig, build_clip_text,
+        )
+
+        base = CLIPTextConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=4, max_len=8,
+            act="gelu", eos_id=63, dtype=jnp.float32,
+        )
+        tokens = jnp.asarray(np.random.default_rng(0).integers(0, 64, (1, 8)))
+        raw_enc = build_clip_text(base, jax.random.key(0))
+        ln_enc = build_clip_text(
+            dataclasses.replace(base, penultimate_ln=True), params=raw_enc.params
+        )
+        _, pen_raw, _ = raw_enc(tokens)
+        _, pen_ln, _ = ln_enc(tokens)
+        assert not np.allclose(np.asarray(pen_raw), np.asarray(pen_ln))
+        # the normed stream has ~zero mean per position (LayerNorm property)
+        means = np.asarray(pen_ln).mean(axis=-1)
+        assert np.abs(means).max() < 0.2
+
+    def test_text_encode_node_routes_penultimate_for_sd2(self):
+        import dataclasses
+
+        from comfyui_parallelanything_tpu.models import (
+            CLIPTextConfig, build_clip_text,
+        )
+        from comfyui_parallelanything_tpu.nodes import TPUTextEncode
+        from test_tokenizer import _tiny_tokenizer
+
+        tok = _tiny_tokenizer()
+        cfg = CLIPTextConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=4, max_len=8,
+            act="gelu", eos_id=tok.eos_id, penultimate_ln=True, dtype=jnp.float32,
+        )
+        enc = build_clip_text(cfg, jax.random.key(0))
+        (cond,) = TPUTextEncode().encode(
+            {"encoder": enc, "tokenizer": tok, "type": "clip"}, "hello"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cond["context"]), np.asarray(cond["penultimate"])
+        )
