@@ -635,6 +635,58 @@ class TPUVAEEncode:
         return ({"samples": vae.encode(images_to_vae_input(image), rng)},)
 
 
+class TPULatentUpscale:
+    """(LATENT, scale) → LATENT resized in latent space — the hi-res-fix step
+    between a low-res sample and a denoise<1 KSampler pass."""
+
+    DESCRIPTION = "Resize latents (hi-res fix); follow with a denoise<1 KSampler."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "upscale"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "latent": ("LATENT", {}),
+                "scale": ("FLOAT", {"default": 2.0, "min": 0.25, "max": 8.0,
+                                    "step": 0.25}),
+                "method": (["nearest", "bilinear", "lanczos3"],
+                           {"default": "bilinear"}),
+            }
+        }
+
+    def upscale(self, latent, scale: float, method: str = "bilinear"):
+        import jax
+
+        z = latent["samples"]
+        # Spatial dims are the two before channels (works for image 4-D and
+        # video 5-D latents; time is never resized). Snap to even dims — odd
+        # latent sizes break UNet stride-2 skip concats and DiT patchify, the
+        # same boundary validation TPUKSampler applies.
+        h, w = z.shape[-3], z.shape[-2]
+
+        def snap(v: float) -> int:
+            s = round(v)
+            return s + (s % 2)
+
+        th, tw = snap(h * scale), snap(w * scale)
+        if th < 2 or tw < 2:
+            raise ValueError(
+                f"scale {scale} shrinks the {h}x{w} latent to {th}x{tw}"
+            )
+        target = (*z.shape[:-3], th, tw, z.shape[-1])
+        out = {**latent, "samples": jax.image.resize(z, target, method=method)}
+        # A stale noise_mask no longer matches the spatial dims; rescale it too.
+        if "noise_mask" in latent:
+            m = latent["noise_mask"]
+            out["noise_mask"] = jax.image.resize(
+                m, (*m.shape[:-3], target[-3], target[-2], 1), method="bilinear"
+            )
+        return (out,)
+
+
 class TPUSetLatentNoiseMask:
     """(LATENT, MASK) → LATENT with a noise mask attached — inpainting: the
     KSampler denoises only where mask=1 and re-pins mask=0 regions to the input
@@ -891,6 +943,7 @@ NODE_CLASS_MAPPINGS = {
     "TPUEmptyLatent": TPUEmptyLatent,
     "TPUVAEEncode": TPUVAEEncode,
     "TPUSetLatentNoiseMask": TPUSetLatentNoiseMask,
+    "TPULatentUpscale": TPULatentUpscale,
     "TPUEmptyVideoLatent": TPUEmptyVideoLatent,
     "TPUKSampler": TPUKSampler,
     "TPUVAEDecode": TPUVAEDecode,
@@ -908,6 +961,7 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPUEmptyLatent": "Empty Latent (TPU)",
     "TPUVAEEncode": "VAE Encode (TPU)",
     "TPUSetLatentNoiseMask": "Set Latent Noise Mask (TPU)",
+    "TPULatentUpscale": "Latent Upscale (TPU)",
     "TPUEmptyVideoLatent": "Empty Video Latent (TPU, WAN)",
     "TPUKSampler": "KSampler (TPU)",
     "TPUVAEDecode": "VAE Decode (TPU)",

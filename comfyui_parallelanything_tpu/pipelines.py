@@ -72,6 +72,21 @@ def _encode_init(vae, init, denoise: float, batch: int,
     return z
 
 
+def _latent_mask_for(mask, init_image, f: int, height: int, width: int):
+    """Inpainting mask → latent-resolution blend mask (1 = regenerate), shared
+    by the image pipelines so mask semantics cannot drift between them."""
+    if mask is None:
+        return None
+    if init_image is None:
+        raise ValueError("mask (inpainting) requires init_image")
+    m = jnp.asarray(mask, jnp.float32)
+    if m.ndim == 3:
+        m = m[..., None]
+    return jax.image.resize(
+        m, (m.shape[0], height // f, width // f, 1), method="bilinear"
+    )
+
+
 @dataclasses.dataclass
 class StableDiffusionPipeline:
     """SD1.5 (clip only) / SDXL (clip + clip_g) text→image.
@@ -163,22 +178,13 @@ class StableDiffusionPipeline:
         kwargs = {} if y is None else {"y": y}
         if sampler == "flow_euler":
             raise ValueError("flow_euler belongs to FluxPipeline, not the SD family")
-        if mask is not None and init_image is None:
-            raise ValueError("mask (inpainting) requires init_image")
         # Inpainting runs at any strength (mask keeps regions even at full
         # denoise) — one validated encode path either way.
+        latent_mask = _latent_mask_for(mask, init_image, f, height, width)
         init_latent = _encode_init(
             self.vae, init_image, denoise, B, (height, width),
             allow_full_denoise=mask is not None,
         )
-        latent_mask = None
-        if mask is not None:
-            m = jnp.asarray(mask, jnp.float32)
-            if m.ndim == 3:
-                m = m[..., None]
-            latent_mask = jax.image.resize(
-                m, (m.shape[0], height // f, width // f, 1), method="bilinear"
-            )
         from .parallel.orchestrator import model_config_of
 
         latents = run_sampler(
@@ -235,6 +241,7 @@ class FluxPipeline:
         callback=None,
         init_image: jnp.ndarray | None = None,
         denoise: float = 1.0,
+        mask: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         """Returns float images (B, height, width, 3) in [0, 1]. ``guidance`` is
         the dev-family distilled guidance embed (None for schnell); true CFG runs
@@ -264,8 +271,10 @@ class FluxPipeline:
         noise = jax.random.normal(
             rng, (B, height // f, width // f, zc), jnp.float32
         )
+        latent_mask = _latent_mask_for(mask, init_image, f, height, width)
         init_latent = _encode_init(
-            self.vae, init_image, denoise, B, (height, width)
+            self.vae, init_image, denoise, B, (height, width),
+            allow_full_denoise=mask is not None,
         )
         latents = run_sampler(
             self.dit,
@@ -281,6 +290,7 @@ class FluxPipeline:
             callback=callback,
             init_latent=init_latent,
             denoise=denoise,
+            latent_mask=latent_mask,
             **kwargs,
         )
         return _to_images(self.vae.decode(latents))

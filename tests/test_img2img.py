@@ -328,3 +328,88 @@ class TestInpainting:
         )
         ref = run_sampler(_toy_model(), noise, None, sampler="euler", steps=2)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+class TestLatentUpscale:
+    def test_image_latent(self):
+        from comfyui_parallelanything_tpu.nodes import TPULatentUpscale
+
+        lat = {"samples": jnp.ones((2, 8, 8, 4))}
+        (up,) = TPULatentUpscale().upscale(lat, 2.0)
+        assert up["samples"].shape == (2, 16, 16, 4)
+
+    def test_video_latent_keeps_time(self):
+        from comfyui_parallelanything_tpu.nodes import TPULatentUpscale
+
+        lat = {"samples": jnp.ones((1, 3, 8, 8, 16))}
+        (up,) = TPULatentUpscale().upscale(lat, 1.5)
+        assert up["samples"].shape == (1, 3, 12, 12, 16)
+
+    def test_noise_mask_rescaled_with_latent(self):
+        from comfyui_parallelanything_tpu.nodes import (
+            TPULatentUpscale,
+            TPUSetLatentNoiseMask,
+        )
+
+        lat = {"samples": jnp.zeros((1, 8, 8, 4))}
+        (masked,) = TPUSetLatentNoiseMask().set_mask(lat, jnp.ones((1, 16, 16)))
+        (up,) = TPULatentUpscale().upscale(masked, 2.0)
+        assert up["noise_mask"].shape == (1, 16, 16, 1)
+
+
+class TestFluxInpaint:
+    def test_flux_mask_and_img2img(self):
+        from comfyui_parallelanything_tpu.models import (
+            CLIPTextConfig, T5Config, VAEConfig, build_clip_text,
+            build_t5_encoder, build_vae,
+        )
+        from comfyui_parallelanything_tpu.models.flux import FluxConfig, build_flux
+        from comfyui_parallelanything_tpu.pipelines import FluxPipeline
+        from test_tokenizer import _tiny_tokenizer
+
+        tok = _tiny_tokenizer()
+        fcfg = FluxConfig(
+            in_channels=16, hidden_size=32, num_heads=4, depth=1,
+            depth_single_blocks=1, context_in_dim=24, vec_in_dim=16,
+            axes_dim=(4, 2, 2), guidance_embed=False, dtype=jnp.float32,
+        )
+        pipe = FluxPipeline(
+            dit=build_flux(fcfg, jax.random.key(0), sample_shape=(1, 8, 8, 4),
+                           txt_len=8),
+            vae=build_vae(
+                VAEConfig(z_channels=4, base_channels=16, channel_mult=(1, 2),
+                          num_res_blocks=1, norm_groups=8, dtype=jnp.float32),
+                jax.random.key(1), sample_hw=16),
+            clip=build_clip_text(
+                CLIPTextConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                               num_heads=2, max_len=8, eos_id=tok.eos_id,
+                               dtype=jnp.float32), jax.random.key(2)),
+            t5=build_t5_encoder(
+                T5Config(vocab_size=64, d_model=24, d_kv=8, d_ff=48,
+                         num_layers=1, num_heads=2, dtype=jnp.float32),
+                jax.random.key(3), sample_len=8),
+            tokenizer=tok, t5_tokenizer=tok,
+        )
+        init = jnp.full((1, 16, 16, 3), 0.5)
+        m = jnp.zeros((1, 16, 16)).at[:, :8].set(1.0)
+        img = pipe("hello", steps=2, guidance=None, height=16, width=16,
+                   init_image=init, mask=m)
+        assert img.shape == (1, 16, 16, 3)
+        assert np.isfinite(np.asarray(img)).all()
+        # plain img2img too (the path the _encode_init rename touched)
+        img2 = pipe("hello", steps=2, guidance=None, height=16, width=16,
+                    init_image=init, denoise=0.4)
+        assert img2.shape == (1, 16, 16, 3)
+
+    def test_upscale_snaps_to_even(self):
+        from comfyui_parallelanything_tpu.nodes import TPULatentUpscale
+
+        lat = {"samples": jnp.ones((1, 12, 12, 4))}
+        (up,) = TPULatentUpscale().upscale(lat, 1.25)  # 15 -> snapped 16
+        assert up["samples"].shape == (1, 16, 16, 4)
+
+    def test_upscale_rejects_degenerate(self):
+        from comfyui_parallelanything_tpu.nodes import TPULatentUpscale
+
+        with pytest.raises(ValueError, match="shrinks"):
+            TPULatentUpscale().upscale({"samples": jnp.ones((1, 4, 4, 4))}, 0.05)
