@@ -238,6 +238,17 @@ def images_to_vae_input(images: jnp.ndarray) -> jnp.ndarray:
     return images * 2.0 - 1.0
 
 
+def encode_maybe_tiled(vae, x, tile: int = 0) -> jnp.ndarray:
+    """Encode ``x`` through ``vae``, tiled when ``tile > 0`` — the encode-side
+    owner of the tile/overlap dispatch policy: overlap = tile/4 floored to the
+    VAE's spatial-factor alignment (so any factor-aligned tile size works)."""
+    if tile:
+        f = vae.spatial_factor
+        overlap = max(f, tile // 4 // f * f)
+        return vae.encode_tiled(x, tile=tile, overlap=overlap)
+    return vae.encode(x)
+
+
 def decode_maybe_tiled(vae, z, tile: int = 0) -> jnp.ndarray:
     """Decode ``z`` through ``vae`` (image VAE or VideoVAE), tiled when
     ``tile > 0`` — the single owner of the tile/overlap dispatch policy
@@ -278,6 +289,48 @@ class VAE:
     def spatial_factor(self) -> int:
         """Pixels per latent cell along each spatial dim (8 for the kl-f8 family)."""
         return 2 ** (len(self.cfg.channel_mult) - 1)
+
+    def encode_tiled(self, x, tile: int = 512, overlap: int = 128):
+        """Encode in fixed-size overlapping PIXEL tiles (dims in pixels, must be
+        multiples of the spatial factor), blending the latent overlaps — the
+        img2img counterpart of ``decode_tiled`` for resolutions whose encoder
+        activations would blow HBM. Deterministic (posterior mean) only."""
+        B, H, W, _ = x.shape
+        if H <= tile and W <= tile:
+            return self.encode(x)
+        f = self.spatial_factor
+        if tile % f or overlap % f:
+            raise ValueError(f"tile/overlap must be multiples of {f}")
+        if not 0 <= overlap < tile:
+            raise ValueError(f"need 0 <= overlap < tile, got {overlap=} {tile=}")
+        encode = functools.partial(
+            self._jitted(AutoencoderKL.encode), self.params
+        )
+        th, tw = min(tile, H), min(tile, W)
+        mask = (
+            blend_mask1d(th // f, overlap // f, 1)[:, None]
+            * blend_mask1d(tw // f, overlap // f, 1)[None, :]
+        )[None, :, :, None]
+        out = np.zeros((B, H // f, W // f, self.cfg.z_channels), np.float32)
+        weight = np.zeros((1, H // f, W // f, 1), np.float32)
+        # Hold the full-resolution pixels on the HOST (like decode_tiled's
+        # host accumulation): only one tile's pixels + encoder activations
+        # live in HBM at a time.
+        x_host = np.asarray(x, np.float32)
+        # Window starts computed on the latent grid then scaled back up, so
+        # edge tiles (which slide inward) stay f-aligned.
+        hs_list = [s * f for s in tile_starts(H // f, th // f, (tile - overlap) // f)]
+        ws_list = [s * f for s in tile_starts(W // f, tw // f, (tile - overlap) // f)]
+        for hs in hs_list:
+            for ws in ws_list:
+                enc = np.asarray(
+                    encode(x_host[:, hs : hs + th, ws : ws + tw, :], None),
+                    np.float32,
+                )
+                hl, wl = hs // f, ws // f
+                out[:, hl : hl + th // f, wl : wl + tw // f] += enc * mask
+                weight[:, hl : hl + th // f, wl : wl + tw // f] += mask
+        return jnp.asarray(out / weight)
 
     def decode_tiled(self, z, tile: int = 64, overlap: int = 16):
         """Decode in fixed-size overlapping latent tiles, linearly blending the

@@ -623,16 +623,31 @@ class TPUVAEEncode:
                 "seed": ("INT", {"default": -1, "min": -1, "max": 2**31 - 1,
                                  "tooltip": "-1 = deterministic posterior mean; "
                                             ">=0 samples the posterior"}),
+                "tile_size": ("INT", {"default": 0, "min": 0, "max": 4096,
+                                      "step": 32,
+                                      "tooltip": "0 = no tiling (pixels, "
+                                                 "multiple of the VAE factor; "
+                                                 "bounds encoder memory)"}),
             },
         }
 
-    def encode(self, vae, image, seed: int = -1):
+    def encode(self, vae, image, seed: int = -1, tile_size: int = 0):
         import jax
 
         from .models.vae import images_to_vae_input
 
+        from .models.vae import encode_maybe_tiled
+
+        x = images_to_vae_input(image)
+        if tile_size:
+            if seed >= 0:
+                raise ValueError(
+                    "tiled encode is deterministic (posterior mean) — "
+                    "seeded sampling and tile_size are exclusive"
+                )
+            return ({"samples": encode_maybe_tiled(vae, x, tile_size)},)
         rng = jax.random.key(seed) if seed >= 0 else None
-        return ({"samples": vae.encode(images_to_vae_input(image), rng)},)
+        return ({"samples": vae.encode(x, rng)},)
 
 
 class TPULatentUpscale:

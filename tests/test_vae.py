@@ -277,3 +277,32 @@ class TestLoader:
         assert sniff_vae_config(pre).z_channels == 16
         with pytest.raises(KeyError, match="AutoencoderKL"):
             sniff_vae_config({"not_a_vae.weight": np.zeros(1, np.float32)})
+
+
+class TestTiledEncode:
+    def test_matches_full_encode(self, tiny_vae):
+        x = jax.random.uniform(jax.random.key(7), (1, 80, 80, 3)) * 2 - 1
+        full = np.asarray(tiny_vae.encode(x), np.float32)
+        tiled = np.asarray(tiny_vae.encode_tiled(x, tile=48, overlap=16), np.float32)
+        assert tiled.shape == full.shape
+        assert np.mean(np.abs(tiled - full)) < 2e-2
+
+    def test_small_input_short_circuits(self, tiny_vae):
+        x = jax.random.uniform(jax.random.key(8), (1, 16, 16, 3))
+        np.testing.assert_array_equal(
+            np.asarray(tiny_vae.encode_tiled(x, tile=32)),
+            np.asarray(tiny_vae.encode(x)),
+        )
+
+    def test_unaligned_tile_rejected(self, tiny_vae):
+        with pytest.raises(ValueError, match="multiples"):
+            tiny_vae.encode_tiled(jnp.zeros((1, 64, 64, 3)), tile=31, overlap=8)
+
+    def test_encode_maybe_tiled_aligns_overlap(self, tiny_vae):
+        """Any factor-aligned tile size works — the helper floors the derived
+        overlap to the VAE's alignment."""
+        from comfyui_parallelanything_tpu.models.vae import encode_maybe_tiled
+
+        x = jax.random.uniform(jax.random.key(9), (1, 72, 72, 3))
+        out = encode_maybe_tiled(tiny_vae, x, 52)  # 52//4=13 → floored to 12
+        assert out.shape == (1, 36, 36, 4)
