@@ -634,9 +634,7 @@ class TPUVAEEncode:
     def encode(self, vae, image, seed: int = -1, tile_size: int = 0):
         import jax
 
-        from .models.vae import images_to_vae_input
-
-        from .models.vae import encode_maybe_tiled
+        from .models.vae import encode_maybe_tiled, images_to_vae_input
 
         x = images_to_vae_input(image)
         if tile_size:
@@ -834,6 +832,11 @@ class TPUKSampler:
                      "tooltip": "img2img strength: < 1 starts from the input "
                                 "LATENT (wire a VAE Encode) instead of noise"},
                 ),
+                "scheduler": (
+                    ["karras", "normal"],
+                    {"default": "karras",
+                     "tooltip": "sigma spacing for the k-samplers"},
+                ),
             },
         }
 
@@ -850,6 +853,7 @@ class TPUKSampler:
         guidance: float = 3.5,
         shift: float = 1.15,
         denoise: float = 1.0,
+        scheduler: str = "karras",
     ):
         import jax
         import jax.numpy as jnp
@@ -908,6 +912,7 @@ class TPUKSampler:
             cfg_scale=cfg, uncond_context=uncond_context,
             uncond_kwargs=uncond_kwargs, rng=rng, shift=shift,
             guidance=guidance if guidance > 0 else None,
+            karras=scheduler == "karras",
             prediction=getattr(model_cfg, "prediction", "eps"),
             init_latent=(
                 latent["samples"]

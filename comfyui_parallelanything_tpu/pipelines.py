@@ -72,19 +72,31 @@ def _encode_init(vae, init, denoise: float, batch: int,
     return z
 
 
-def _latent_mask_for(mask, init_image, f: int, height: int, width: int):
+def _latent_mask_for(mask, init, f: int, height: int, width: int,
+                     t_lat: int | None = None, what: str = "init_image"):
     """Inpainting mask → latent-resolution blend mask (1 = regenerate), shared
-    by the image pipelines so mask semantics cannot drift between them."""
+    by ALL pipelines so mask semantics cannot drift. Image masks are
+    (B, H, W[, 1]); with ``t_lat`` set (video), masks are (B, T, H, W[, 1]) and
+    the time axis resizes to the pipeline's latent frame count."""
     if mask is None:
         return None
-    if init_image is None:
-        raise ValueError("mask (inpainting) requires init_image")
+    if init is None:
+        raise ValueError(f"mask (inpainting) requires {what}")
     m = jnp.asarray(mask, jnp.float32)
-    if m.ndim == 3:
+    want_rank = 4 if t_lat is None else 5
+    if m.ndim == want_rank - 1:
         m = m[..., None]
-    return jax.image.resize(
-        m, (m.shape[0], height // f, width // f, 1), method="bilinear"
+    if m.ndim != want_rank:
+        raise ValueError(
+            f"mask rank {jnp.asarray(mask).ndim} does not fit a "
+            f"{'video' if t_lat is not None else 'image'} latent"
+        )
+    target = (
+        (m.shape[0], height // f, width // f, 1)
+        if t_lat is None
+        else (m.shape[0], t_lat, height // f, width // f, 1)
     )
+    return jax.image.resize(m, target, method="bilinear")
 
 
 @dataclasses.dataclass
@@ -336,6 +348,7 @@ class WanVideoPipeline:
         init_video: jnp.ndarray | None = None,
         denoise: float = 1.0,
         image: jnp.ndarray | None = None,
+        mask: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         """Returns float video (B, frames, height, width, 3) in [0, 1]. WAN uses
         true CFG (cfg_scale>1 with the negative prompt) and a large flow shift;
@@ -345,7 +358,9 @@ class WanVideoPipeline:
         image pipelines. image→video: pass ``image`` (B or 1, height, width, 3
         in [0, 1]) — WAN2.2-style channel-concat conditioning (the i2v DiT's
         extra in-channels carry a frame mask + the encoded first frame; no
-        CLIP-vision branch, which WAN2.2 dropped)."""
+        CLIP-vision branch, which WAN2.2 dropped). Video inpainting: ``mask``
+        (B or 1, frames, height, width[, 1]; 1 = regenerate) with
+        ``init_video`` re-pins keep regions per step at any denoise."""
         prompts = [prompt] if isinstance(prompt, str) else list(prompt)
         if rng is None:
             rng = jax.random.key(0)
@@ -393,9 +408,12 @@ class WanVideoPipeline:
         noise = jax.random.normal(
             rng, (B, t_lat, height // f, width // f, zc), jnp.float32
         )
+        latent_mask = _latent_mask_for(
+            mask, init_video, f, height, width, t_lat=t_lat, what="init_video"
+        )
         init_latent = _encode_init(
             self.vae, init_video, denoise, B, (frames, height, width),
-            what="init_video",
+            what="init_video", allow_full_denoise=mask is not None,
         )
         if image is not None:
             denoiser = self._i2v_conditioned(
@@ -414,6 +432,7 @@ class WanVideoPipeline:
             callback=callback,
             init_latent=init_latent,
             denoise=denoise,
+            latent_mask=latent_mask,
         )
         from .models.vae import decode_maybe_tiled
 
