@@ -44,7 +44,12 @@ from .parallel.split import (
 from .parallel.mesh import build_mesh, mesh_axis_names
 from .parallel.orchestrator import parallelize, ParallelConfig, ParallelModel
 from .parallel.sequence import sequence_parallel_attention
-from .pipelines import StableDiffusionPipeline, FluxPipeline, WanVideoPipeline
+from .pipelines import (
+    StableDiffusionPipeline,
+    FluxPipeline,
+    Sd3Pipeline,
+    WanVideoPipeline,
+)
 from .utils.metrics import StepTimer, trace
 
 __all__ = [
@@ -75,6 +80,7 @@ __all__ = [
     "StableDiffusionPipeline",
     "FluxPipeline",
     "WanVideoPipeline",
+    "Sd3Pipeline",
     "StepTimer",
     "trace",
 ]

@@ -1,0 +1,120 @@
+"""SD3/SD3.5 MMDiT checkpoint (SAI/ComfyUI single-file layout) → models/mmdit.py.
+
+Layout map (torch names left; optional ``model.diffusion_model.`` prefix is
+stripped):
+
+- ``x_embedder.proj``          — patch conv (dim, C, p, p) → Dense kernel
+  (p·p·C, dim) in the (p_h, p_w, C) flatten order MMDiTModel.prepare emits.
+- ``pos_embed``                — (1, max², dim) table → ``pos_embed/table``.
+- ``t_embedder.mlp.0/.2``      → ``time_in.in/out_layer``; ``y_embedder`` →
+  ``vector_in``; ``context_embedder`` → ``context_in``.
+- ``joint_blocks.{i}.x_block`` → ``blocks_{i}``: ``adaLN_modulation.1`` →
+  ``x_adaln/lin`` (6·dim; SAI chunk order matches), ``attn.qkv`` → fused
+  DenseGeneral (dim → (3, H, 64)), ``attn.ln_q/ln_k`` (3.5 q/k RMS) →
+  ``x_attn_in/ln_q|ln_k``, ``attn.proj`` → ``x_attn_proj``, ``mlp.fc1/fc2`` →
+  ``x_mlp_in/out``. ``context_block`` → the ``ctx_*`` twins; the FINAL block's
+  context side is pre-only (2·dim adaLN, qkv only — no proj/mlp), mirroring
+  JointBlock(pre_only=True).
+- ``final_layer.adaLN_modulation.1`` → ``final_mod``; ``final_layer.linear`` →
+  ``final_proj``.
+
+Not covered: SD3.5-medium's dual-attention x-blocks (``attn2``) — conversion
+raises with a clear message rather than silently dropping weights.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+from .convert import linear_kernel, to_numpy, tree_to_jnp
+from .mmdit import MMDiTConfig
+
+
+def _dense(sd: Mapping[str, Any], key: str) -> dict:
+    out = {"kernel": linear_kernel(sd[f"{key}.weight"])}
+    if f"{key}.bias" in sd:
+        out["bias"] = to_numpy(sd[f"{key}.bias"])
+    return out
+
+
+def _qkv(sd: Mapping[str, Any], key: str, cfg: MMDiTConfig) -> dict:
+    H, D = cfg.num_heads, cfg.head_dim
+    w = to_numpy(sd[f"{key}.weight"])  # (3·dim, dim), rows [q; k; v]
+    kernel = w.T.reshape(cfg.hidden_size, 3, H, D)
+    out = {"kernel": kernel}
+    if f"{key}.bias" in sd:
+        out["bias"] = to_numpy(sd[f"{key}.bias"]).reshape(3, H, D)
+    return out
+
+
+def _attn_in(sd: Mapping[str, Any], key: str, cfg: MMDiTConfig) -> dict:
+    out = {"qkv": _qkv(sd, f"{key}.qkv", cfg)}
+    if cfg.qk_norm:
+        out["ln_q"] = to_numpy(sd[f"{key}.ln_q.weight"])
+        out["ln_k"] = to_numpy(sd[f"{key}.ln_k.weight"])
+    return out
+
+
+def strip_mmdit_prefix(sd: Mapping[str, Any]) -> dict:
+    for prefix in ("model.diffusion_model.", "diffusion_model."):
+        stripped = {
+            k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)
+        }
+        if any(k.startswith("joint_blocks.") for k in stripped):
+            return stripped
+    return dict(sd)
+
+
+def convert_mmdit_checkpoint(
+    state_dict: Mapping[str, Any], cfg: MMDiTConfig
+) -> dict:
+    """SAI/ComfyUI MMDiT state dict → the ``MMDiTModel`` param pytree (pass to
+    ``build_mmdit(cfg, params=...)``)."""
+    sd = strip_mmdit_prefix(state_dict)
+    if any(".attn2." in k for k in sd):
+        raise ValueError(
+            "this checkpoint uses SD3.5-medium dual-attention blocks (attn2), "
+            "which models/mmdit.py does not implement yet"
+        )
+
+    w = to_numpy(sd["x_embedder.proj.weight"])  # (dim, C, p, p)
+    x_in_kernel = w.transpose(2, 3, 1, 0).reshape(-1, w.shape[0])
+    p: dict[str, Any] = {
+        "x_in": {
+            "kernel": x_in_kernel,
+            "bias": to_numpy(sd["x_embedder.proj.bias"]),
+        },
+        "pos_embed": {
+            "table": to_numpy(sd["pos_embed"]).reshape(-1, cfg.hidden_size)
+        },
+        "context_in": _dense(sd, "context_embedder"),
+        "time_in": {
+            "in_layer": _dense(sd, "t_embedder.mlp.0"),
+            "out_layer": _dense(sd, "t_embedder.mlp.2"),
+        },
+        "vector_in": {
+            "in_layer": _dense(sd, "y_embedder.mlp.0"),
+            "out_layer": _dense(sd, "y_embedder.mlp.2"),
+        },
+        "final_mod": _dense(sd, "final_layer.adaLN_modulation.1"),
+        "final_proj": _dense(sd, "final_layer.linear"),
+    }
+    for i in range(cfg.depth):
+        xb = f"joint_blocks.{i}.x_block"
+        cb = f"joint_blocks.{i}.context_block"
+        blk: dict[str, Any] = {
+            "x_adaln": {"lin": _dense(sd, f"{xb}.adaLN_modulation.1")},
+            "x_attn_in": _attn_in(sd, f"{xb}.attn", cfg),
+            "x_attn_proj": _dense(sd, f"{xb}.attn.proj"),
+            "x_mlp_in": _dense(sd, f"{xb}.mlp.fc1"),
+            "x_mlp_out": _dense(sd, f"{xb}.mlp.fc2"),
+            "ctx_adaln": {"lin": _dense(sd, f"{cb}.adaLN_modulation.1")},
+            "ctx_attn_in": _attn_in(sd, f"{cb}.attn", cfg),
+        }
+        if i != cfg.depth - 1:  # pre-only final context block has no out path
+            blk["ctx_attn_proj"] = _dense(sd, f"{cb}.attn.proj")
+            blk["ctx_mlp_in"] = _dense(sd, f"{cb}.mlp.fc1")
+            blk["ctx_mlp_out"] = _dense(sd, f"{cb}.mlp.fc2")
+        p[f"blocks_{i}"] = blk
+    return tree_to_jnp(p)

@@ -232,3 +232,15 @@ def load_wan_vae_checkpoint(src: Any, cfg=None):
             f"state dict is not the official Wan2.x VAE layout (missing {e})"
         ) from e
     return build_video_vae(cfg, params=params)
+
+
+def load_mmdit_checkpoint(src: Any, cfg, lora: Any = None,
+                          lora_strength: float = 1.0, name: str = "mmdit"):
+    """SD3/SD3.5 MMDiT checkpoint (SAI/ComfyUI single-file, optionally under
+    model.diffusion_model.) → DiffusionModel."""
+    from .convert_mmdit import convert_mmdit_checkpoint, strip_mmdit_prefix
+    from .mmdit import build_mmdit
+
+    sd = strip_mmdit_prefix(_resolve_state_dict(src))
+    sd = _maybe_bake(sd, lora, lora_strength)
+    return build_mmdit(cfg, name=name, params=convert_mmdit_checkpoint(sd, cfg))

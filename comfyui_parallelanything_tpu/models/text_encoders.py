@@ -347,3 +347,29 @@ def sdxl_text_conditioning(
     ]
     y = jnp.concatenate([g_pooled.astype(jnp.float32)] + embs, axis=-1)
     return context, y
+
+
+def sd3_text_conditioning(l_penultimate, g_penultimate, l_pooled, g_pooled,
+                          t5_context=None, context_dim: int = 4096):
+    """Assemble SD3's (context, y): the CLIP joint stream (L ⊕ G penultimate,
+    768+1280) zero-padded to ``context_dim`` and concatenated along the SEQUENCE
+    axis with the T5 stream; y = L pooled ⊕ G pooled (2048)."""
+    clip_joint = jnp.concatenate(
+        [l_penultimate.astype(jnp.float32), g_penultimate.astype(jnp.float32)],
+        axis=-1,
+    )
+    pad = context_dim - clip_joint.shape[-1]
+    if pad < 0:
+        raise ValueError(
+            f"CLIP joint width {clip_joint.shape[-1]} exceeds {context_dim}"
+        )
+    clip_joint = jnp.pad(clip_joint, ((0, 0), (0, 0), (0, pad)))
+    context = (
+        jnp.concatenate([clip_joint, t5_context.astype(jnp.float32)], axis=1)
+        if t5_context is not None
+        else clip_joint
+    )
+    y = jnp.concatenate(
+        [l_pooled.astype(jnp.float32), g_pooled.astype(jnp.float32)], axis=-1
+    )
+    return context, y

@@ -58,6 +58,18 @@ def sdxl_vae_config(**overrides) -> VAEConfig:
     return dataclasses.replace(VAEConfig(scaling_factor=0.13025), **overrides)
 
 
+def sd3_vae_config(**overrides) -> VAEConfig:
+    """SD3's 16-channel autoencoder (flux-style module names, no quant convs;
+    scale/shift from the SD3 release)."""
+    base = VAEConfig(
+        z_channels=16,
+        scaling_factor=1.5305,
+        shift_factor=0.0609,
+        use_quant_conv=False,
+    )
+    return dataclasses.replace(base, **overrides)
+
+
 def flux_vae_config(**overrides) -> VAEConfig:
     """FLUX/Z-Image 16-channel autoencoder (scale/shift from the flux repo)."""
     base = VAEConfig(

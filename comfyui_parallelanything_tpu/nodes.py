@@ -271,8 +271,8 @@ class ParallelAnythingAdvanced(ParallelAnything):
 # ---------------------------------------------------------------------------
 
 _MODEL_FAMILIES = (
-    "sd15", "sd21", "sd21-v", "sdxl", "flux-dev", "flux-schnell",
-    "zimage-turbo", "wan-1.3b", "wan-14b",
+    "sd15", "sd21", "sd21-v", "sdxl", "sd3-medium", "sd35-large",
+    "flux-dev", "flux-schnell", "zimage-turbo", "wan-1.3b", "wan-14b",
 )
 
 
@@ -355,6 +355,20 @@ class TPUCheckpointLoader:
         if family == "sd15":
             model = load_sd_unet_checkpoint(sd, sd15_config(), lora, lora_strength)
             vae_cfg = sd_vae_config()
+        elif family in ("sd3-medium", "sd35-large"):
+            from .models import (
+                load_mmdit_checkpoint,
+                sd3_medium_config,
+                sd3_vae_config,
+                sd35_large_config,
+            )
+
+            mcfg = (
+                sd35_large_config() if family == "sd35-large"
+                else sd3_medium_config()
+            )
+            model = load_mmdit_checkpoint(sd, mcfg, lora, lora_strength)
+            vae_cfg = sd3_vae_config()
         elif family in ("sd21", "sd21-v"):
             ucfg = sd21_config(
                 prediction="v" if family == "sd21-v" else "eps"
@@ -519,11 +533,14 @@ class TPUConditioningCombine:
       cross-attention and label embed expect).
     - ``flux``: T5 CONDITIONING (context) + CLIP-L CONDITIONING (pooled vec) →
       the (context, y) pair the MMDiT consumes.
+    - ``sd3``: CLIP-L (a) + OpenCLIP-G (b) [+ T5 (conditioning_c)] → the L⊕G
+      joint stream padded to 4096 into the T5 context ‖ 2048-d pooled
+      (``sd3_text_conditioning``).
 
     Without this node the individual towers' outputs are dimensionally wrong for
-    those families — TPUTextEncode alone only serves SD1.5."""
+    those families — TPUTextEncode alone only serves SD1.5/SD2.x."""
 
-    DESCRIPTION = "Combine text-encoder outputs for SDXL (L+G) or FLUX (T5+CLIP)."
+    DESCRIPTION = "Combine text-encoder outputs for SDXL (L+G), FLUX (T5+CLIP), or SD3 (L+G+T5)."
     RETURN_TYPES = ("CONDITIONING",)
     RETURN_NAMES = ("conditioning",)
     FUNCTION = "combine"
@@ -541,18 +558,39 @@ class TPUConditioningCombine:
                     "CONDITIONING",
                     {"tooltip": "OpenCLIP-G (sdxl) / CLIP-L (flux)"},
                 ),
-                "mode": (["sdxl", "flux"], {"default": "sdxl"}),
+                "mode": (["sdxl", "flux", "sd3"], {"default": "sdxl"}),
             },
             "optional": {
                 "width": ("INT", {"default": 1024, "min": 16, "max": 8192}),
                 "height": ("INT", {"default": 1024, "min": 16, "max": 8192}),
+                "conditioning_c": (
+                    "CONDITIONING",
+                    {"tooltip": "T5 (sd3; optional but recommended)"},
+                ),
             },
         }
 
     def combine(
         self, conditioning_a, conditioning_b, mode: str,
-        width: int = 1024, height: int = 1024,
+        width: int = 1024, height: int = 1024, conditioning_c=None,
     ):
+        if mode == "sd3":
+            from .models.text_encoders import sd3_text_conditioning
+
+            pen_l = conditioning_a.get("penultimate")
+            pooled_l = conditioning_a.get("pooled")
+            pen_g = conditioning_b.get("penultimate")
+            pooled_g = conditioning_b.get("pooled")
+            if pen_l is None or pen_g is None or pooled_l is None or pooled_g is None:
+                raise ValueError(
+                    "sd3 mode needs CLIP-L as a and OpenCLIP-G as b, both "
+                    "from TPUTextEncode (penultimate + pooled)"
+                )
+            t5_ctx = conditioning_c["context"] if conditioning_c else None
+            context, y = sd3_text_conditioning(
+                pen_l, pen_g, pooled_l, pooled_g, t5_ctx
+            )
+            return ({"context": context, "pooled": y},)
         if mode == "flux":
             if conditioning_b.get("pooled") is None:
                 raise ValueError("flux mode needs a CLIP conditioning (pooled) as b")

@@ -488,3 +488,109 @@ class WanVideoPipeline:
             return denoiser(jnp.concatenate([x, c], axis=-1), t, context, **kw)
 
         return conditioned
+
+
+@dataclasses.dataclass
+class Sd3Pipeline:
+    """SD3/SD3.5 flow-matching text→image: CLIP-L + CLIP-G joint stream padded
+    into the T5 context, L⊕G pooled vector, true CFG, large flow shift."""
+
+    dit: Any  # MMDiT-class DiffusionModel or ParallelModel
+    vae: Any  # 16-channel SD3 autoencoder
+    clip: Any  # CLIP-L TextEncoder
+    clip_g: Any  # OpenCLIP-G TextEncoder
+    tokenizer: Any
+    tokenizer_g: Any = None
+    t5: Any = None  # optional (SD3 runs without T5 at reduced quality)
+    t5_tokenizer: Any = None
+
+    def encode_prompt(self, prompts: list[str]):
+        from .models.text_encoders import sd3_text_conditioning
+        from .parallel.orchestrator import model_config_of
+
+        ids, _ = self.tokenizer(prompts)
+        _, pen_l, pooled_l = self.clip(jnp.asarray(ids, jnp.int32))
+        ids_g, _ = (self.tokenizer_g or self.tokenizer)(prompts)
+        _, pen_g, pooled_g = self.clip_g(jnp.asarray(ids_g, jnp.int32))
+        t5_ctx = None
+        if self.t5 is not None:
+            if self.t5_tokenizer is None:
+                raise ValueError(
+                    "t5 encoder set without t5_tokenizer — the CLIP BPE "
+                    "tokenizer's ids are meaningless to the T5 vocab"
+                )
+            t5_ids, t5_mask = self.t5_tokenizer(prompts)
+            t5_ctx = self.t5(
+                jnp.asarray(t5_ids, jnp.int32), mask=jnp.asarray(t5_mask)
+            )
+        ctx_dim = getattr(model_config_of(self.dit), "context_in_dim", 4096)
+        return sd3_text_conditioning(
+            pen_l, pen_g, pooled_l, pooled_g, t5_ctx, context_dim=ctx_dim
+        )
+
+    def __call__(
+        self,
+        prompt: str | list[str],
+        negative_prompt: str | list[str] = "",
+        *,
+        steps: int = 28,
+        cfg_scale: float = 4.5,
+        shift: float = 3.0,
+        height: int = 1024,
+        width: int = 1024,
+        rng=None,
+        callback=None,
+        init_image: jnp.ndarray | None = None,
+        denoise: float = 1.0,
+        mask: jnp.ndarray | None = None,
+    ) -> jnp.ndarray:
+        """Returns float images (B, height, width, 3) in [0, 1]; same
+        img2img/inpaint contract as the other image pipelines."""
+        prompts = [prompt] if isinstance(prompt, str) else list(prompt)
+        if rng is None:
+            rng = jax.random.key(0)
+        f = self.vae.spatial_factor
+        from .parallel.orchestrator import model_config_of
+
+        patch = getattr(model_config_of(self.dit), "patch_size", 2)
+        unit = f * patch
+        if height % unit or width % unit:
+            raise ValueError(f"height/width must be multiples of {unit}")
+
+        context, y = self.encode_prompt(prompts)
+        use_cfg = cfg_scale != 1.0
+        uncond_context = None
+        uncond_kwargs = None
+        if use_cfg:
+            uncond_context, uncond_y = self.encode_prompt(
+                _match_negatives(prompts, negative_prompt)
+            )
+            uncond_kwargs = {"y": uncond_y}
+
+        B = len(prompts)
+        zc = self.vae.cfg.z_channels
+        noise = jax.random.normal(
+            rng, (B, height // f, width // f, zc), jnp.float32
+        )
+        latent_mask = _latent_mask_for(mask, init_image, f, height, width)
+        init_latent = _encode_init(
+            self.vae, init_image, denoise, B, (height, width),
+            allow_full_denoise=mask is not None,
+        )
+        latents = run_sampler(
+            self.dit,
+            noise,
+            context,
+            sampler="flow_euler",
+            steps=steps,
+            shift=shift,
+            cfg_scale=cfg_scale if use_cfg else 1.0,
+            uncond_context=uncond_context,
+            uncond_kwargs=uncond_kwargs,
+            callback=callback,
+            init_latent=init_latent,
+            denoise=denoise,
+            latent_mask=latent_mask,
+            y=y,
+        )
+        return _to_images(self.vae.decode(latents))

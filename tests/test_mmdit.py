@@ -1,0 +1,288 @@
+"""SD3/SD3.5 MMDiT: forward shapes, pos-table cropping, converter round-trip
+(inverse-synthesis, like test_convert_wan.py), pipeline smoke over the mesh,
+and the SD3 conditioning assembly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tree_utils import flatten_tree
+
+import comfyui_parallelanything_tpu as pa
+from comfyui_parallelanything_tpu.models.convert_mmdit import (
+    convert_mmdit_checkpoint,
+)
+from comfyui_parallelanything_tpu.models.mmdit import (
+    MMDiTConfig,
+    build_mmdit,
+    sd3_medium_config,
+    sd35_large_config,
+    sincos_pos_embed,
+)
+
+TINY = MMDiTConfig(
+    in_channels=4, depth=2, context_in_dim=32, pooled_dim=16,
+    pos_embed_max=16, qk_norm=True, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_mmdit():
+    return build_mmdit(TINY, jax.random.key(0), sample_shape=(1, 8, 8, 4), txt_len=6)
+
+
+class TestForward:
+    def test_shapes_and_presets(self, tiny_mmdit):
+        x = jax.random.normal(jax.random.key(1), (2, 8, 8, 4))
+        out = tiny_mmdit(
+            x, jnp.linspace(1.0, 0.1, 2),
+            jax.random.normal(jax.random.key(2), (2, 6, 32)),
+            y=jax.random.normal(jax.random.key(3), (2, 16)),
+        )
+        assert out.shape == (2, 8, 8, 4)
+        assert np.isfinite(np.asarray(out)).all()
+        assert sd3_medium_config().hidden_size == 1536
+        assert sd35_large_config().depth == 38 and sd35_large_config().qk_norm
+
+    def test_pos_table_crop_changes_with_resolution(self, tiny_mmdit):
+        """Different latent sizes read different center crops of the table, so
+        the same token grid position gets consistent embeddings."""
+        c = jax.random.normal(jax.random.key(2), (1, 6, 32))
+        t = jnp.array([0.5])
+        out8 = tiny_mmdit(jnp.zeros((1, 8, 8, 4)), t, c)
+        out16 = tiny_mmdit(jnp.zeros((1, 16, 16, 4)), t, c)
+        assert out8.shape == (1, 8, 8, 4) and out16.shape == (1, 16, 16, 4)
+
+    def test_oversize_grid_rejected(self, tiny_mmdit):
+        with pytest.raises(ValueError, match="pos table"):
+            tiny_mmdit(
+                jnp.zeros((1, 40, 40, 4)), jnp.array([0.5]),
+                jnp.zeros((1, 6, 32)),
+            )
+
+    def test_sincos_table_shape(self):
+        t = sincos_pos_embed(8, 64)
+        assert t.shape == (64, 64)
+        assert np.isfinite(t).all()
+
+    def test_parallelized_over_mesh(self, tiny_mmdit):
+        chain = pa.DeviceChain.even([f"cpu:{i}" for i in range(8)])
+        pm = pa.parallelize(tiny_mmdit, chain)
+        x = jax.random.normal(jax.random.key(4), (8, 8, 8, 4))
+        c = jax.random.normal(jax.random.key(5), (8, 6, 32))
+        out = pm(x, jnp.linspace(1.0, 0.1, 8), c)
+        assert out.shape == (8, 8, 8, 4)
+        # batch==1 → joint blocks placed as pipeline stages
+        x1 = x[:1]
+        out1 = pm(x1, jnp.array([0.5]), c[:1])
+        assert out1.shape == (1, 8, 8, 4)
+        assert pm._pipeline_runner is not None
+
+
+def _inv_dense(p, key, sd):
+    sd[f"{key}.weight"] = np.asarray(p["kernel"]).T
+    if "bias" in p:
+        sd[f"{key}.bias"] = np.asarray(p["bias"])
+
+
+def _inv_qkv(p, key, sd, cfg):
+    k = np.asarray(p["qkv"]["kernel"])  # (dim, 3, H, D)
+    sd[f"{key}.qkv.weight"] = k.reshape(cfg.hidden_size, -1).T
+    sd[f"{key}.qkv.bias"] = np.asarray(p["qkv"]["bias"]).reshape(-1)
+    if "ln_q" in p:
+        sd[f"{key}.ln_q.weight"] = np.asarray(p["ln_q"])
+        sd[f"{key}.ln_k.weight"] = np.asarray(p["ln_k"])
+
+
+def _official_layout_sd(cfg: MMDiTConfig, params) -> dict:
+    sd: dict = {}
+    k = np.asarray(params["x_in"]["kernel"])  # (p*p*C, dim)
+    p_ = cfg.patch_size
+    sd["x_embedder.proj.weight"] = (
+        k.reshape(p_, p_, cfg.in_channels, -1).transpose(3, 2, 0, 1)
+    )
+    sd["x_embedder.proj.bias"] = np.asarray(params["x_in"]["bias"])
+    sd["pos_embed"] = np.asarray(params["pos_embed"]["table"])[None]
+    _inv_dense(params["context_in"], "context_embedder", sd)
+    _inv_dense(params["time_in"]["in_layer"], "t_embedder.mlp.0", sd)
+    _inv_dense(params["time_in"]["out_layer"], "t_embedder.mlp.2", sd)
+    _inv_dense(params["vector_in"]["in_layer"], "y_embedder.mlp.0", sd)
+    _inv_dense(params["vector_in"]["out_layer"], "y_embedder.mlp.2", sd)
+    _inv_dense(params["final_mod"], "final_layer.adaLN_modulation.1", sd)
+    _inv_dense(params["final_proj"], "final_layer.linear", sd)
+    for i in range(cfg.depth):
+        blk = params[f"blocks_{i}"]
+        xb = f"joint_blocks.{i}.x_block"
+        cb = f"joint_blocks.{i}.context_block"
+        _inv_dense(blk["x_adaln"]["lin"], f"{xb}.adaLN_modulation.1", sd)
+        _inv_qkv(blk["x_attn_in"], f"{xb}.attn", sd, cfg)
+        _inv_dense(blk["x_attn_proj"], f"{xb}.attn.proj", sd)
+        _inv_dense(blk["x_mlp_in"], f"{xb}.mlp.fc1", sd)
+        _inv_dense(blk["x_mlp_out"], f"{xb}.mlp.fc2", sd)
+        _inv_dense(blk["ctx_adaln"]["lin"], f"{cb}.adaLN_modulation.1", sd)
+        _inv_qkv(blk["ctx_attn_in"], f"{cb}.attn", sd, cfg)
+        if "ctx_attn_proj" in blk:
+            _inv_dense(blk["ctx_attn_proj"], f"{cb}.attn.proj", sd)
+            _inv_dense(blk["ctx_mlp_in"], f"{cb}.mlp.fc1", sd)
+            _inv_dense(blk["ctx_mlp_out"], f"{cb}.mlp.fc2", sd)
+    return sd
+
+
+class TestConverter:
+    def test_round_trip_bitwise(self, tiny_mmdit):
+        sd = _official_layout_sd(TINY, tiny_mmdit.params)
+        converted = convert_mmdit_checkpoint(sd, TINY)
+        ref = dict(flatten_tree(tiny_mmdit.params))
+        got = dict(flatten_tree(converted))
+        assert set(ref) == set(got), set(ref) ^ set(got)
+        for key, val in ref.items():
+            np.testing.assert_array_equal(
+                np.asarray(val), np.asarray(got[key]), err_msg=str(key)
+            )
+
+    def test_converted_forward_matches(self, tiny_mmdit):
+        sd = {
+            f"model.diffusion_model.{k}": v
+            for k, v in _official_layout_sd(TINY, tiny_mmdit.params).items()
+        }
+        m2 = build_mmdit(TINY, params=convert_mmdit_checkpoint(sd, TINY))
+        x = jax.random.normal(jax.random.key(6), (1, 8, 8, 4))
+        c = jax.random.normal(jax.random.key(7), (1, 6, 32))
+        np.testing.assert_allclose(
+            np.asarray(m2(x, jnp.array([0.7]), c)),
+            np.asarray(tiny_mmdit(x, jnp.array([0.7]), c)),
+            rtol=1e-6, atol=1e-6,
+        )
+
+    def test_dual_attention_rejected(self, tiny_mmdit):
+        sd = _official_layout_sd(TINY, tiny_mmdit.params)
+        sd["joint_blocks.0.x_block.attn2.qkv.weight"] = np.zeros((1, 1))
+        with pytest.raises(ValueError, match="dual-attention"):
+            convert_mmdit_checkpoint(sd, TINY)
+
+
+class TestSd3Conditioning:
+    def test_assembly_shapes(self):
+        from comfyui_parallelanything_tpu.models import sd3_text_conditioning
+
+        pen_l = jnp.ones((2, 7, 8))
+        pen_g = jnp.ones((2, 7, 12))
+        t5 = jnp.ones((2, 5, 32))
+        ctx, y = sd3_text_conditioning(
+            pen_l, pen_g, jnp.ones((2, 8)), jnp.ones((2, 12)), t5,
+            context_dim=32,
+        )
+        assert ctx.shape == (2, 12, 32)  # 7 clip + 5 t5 tokens
+        assert y.shape == (2, 20)
+        # clip rows zero-padded past 8+12=20
+        assert float(jnp.abs(ctx[:, :7, 20:]).max()) == 0.0
+
+    def test_overwide_clip_rejected(self):
+        from comfyui_parallelanything_tpu.models import sd3_text_conditioning
+
+        with pytest.raises(ValueError, match="exceeds"):
+            sd3_text_conditioning(
+                jnp.ones((1, 7, 30)), jnp.ones((1, 7, 30)),
+                jnp.ones((1, 30)), jnp.ones((1, 30)), None, context_dim=32,
+            )
+
+
+class TestSd3Pipeline:
+    def test_prompt_to_image(self, tiny_mmdit):
+        from comfyui_parallelanything_tpu.models import (
+            CLIPTextConfig, VAEConfig, build_clip_text, build_vae,
+        )
+        from test_tokenizer import _tiny_tokenizer
+
+        tok = _tiny_tokenizer()
+        clip_l = build_clip_text(
+            CLIPTextConfig(vocab_size=64, hidden_size=12, num_layers=1,
+                           num_heads=2, max_len=8, eos_id=tok.eos_id,
+                           dtype=jnp.float32),
+            jax.random.key(1),
+        )
+        clip_g = build_clip_text(
+            CLIPTextConfig(vocab_size=64, hidden_size=20, num_layers=1,
+                           num_heads=2, max_len=8, eos_id=tok.eos_id,
+                           act="gelu", dtype=jnp.float32),
+            jax.random.key(2),
+        )
+        # pooled_dim must equal l+g hidden (12+20=32); context 32 matches the
+        # tiny MMDiT; tune a matching DiT.
+        cfg = MMDiTConfig(
+            in_channels=4, depth=2, context_in_dim=32, pooled_dim=32,
+            pos_embed_max=16, qk_norm=True, dtype=jnp.float32,
+        )
+        dit = build_mmdit(cfg, jax.random.key(3), sample_shape=(1, 8, 8, 4),
+                          txt_len=8)
+        vae = build_vae(
+            VAEConfig(z_channels=4, base_channels=16, channel_mult=(1, 2),
+                      num_res_blocks=1, norm_groups=8, dtype=jnp.float32),
+            jax.random.key(4), sample_hw=16,
+        )
+        pipe = pa.Sd3Pipeline(
+            dit=dit, vae=vae, clip=clip_l, clip_g=clip_g, tokenizer=tok,
+        )
+        img = pipe("hello", steps=2, cfg_scale=1.0, height=16, width=16)
+        assert img.shape == (1, 16, 16, 3)
+        assert np.isfinite(np.asarray(img)).all()
+        # true CFG path
+        img2 = pipe(
+            "hello", negative_prompt="world", steps=2, cfg_scale=4.0,
+            height=16, width=16,
+        )
+        assert not np.allclose(np.asarray(img), np.asarray(img2))
+
+
+class TestSd3Nodes:
+    def test_conditioning_combine_sd3(self):
+        from comfyui_parallelanything_tpu.nodes import TPUConditioningCombine
+
+        a = {"penultimate": jnp.ones((1, 7, 8)), "pooled": jnp.ones((1, 8))}
+        b = {"penultimate": jnp.ones((1, 7, 12)), "pooled": jnp.ones((1, 12))}
+        c = {"context": jnp.ones((1, 5, 4096))}
+        (cond,) = TPUConditioningCombine().combine(a, b, "sd3", conditioning_c=c)
+        assert cond["context"].shape == (1, 12, 4096)
+        assert cond["pooled"].shape == (1, 20)
+        # without T5: clip joint only
+        (cond2,) = TPUConditioningCombine().combine(a, b, "sd3")
+        assert cond2["context"].shape == (1, 7, 4096)
+
+    def test_combine_sd3_missing_tower_rejected(self):
+        from comfyui_parallelanything_tpu.nodes import TPUConditioningCombine
+
+        with pytest.raises(ValueError, match="sd3 mode"):
+            TPUConditioningCombine().combine(
+                {"context": jnp.ones((1, 7, 8))},
+                {"penultimate": jnp.ones((1, 7, 12)), "pooled": jnp.ones((1, 12))},
+                "sd3",
+            )
+
+    def test_t5_without_tokenizer_rejected(self, tiny_mmdit):
+        from comfyui_parallelanything_tpu.models import (
+            CLIPTextConfig, VAEConfig, build_clip_text, build_vae,
+        )
+        from test_tokenizer import _tiny_tokenizer
+
+        tok = _tiny_tokenizer()
+        clip = build_clip_text(
+            CLIPTextConfig(vocab_size=64, hidden_size=8, num_layers=1,
+                           num_heads=2, max_len=8, eos_id=tok.eos_id,
+                           dtype=jnp.float32), jax.random.key(0))
+        pipe = pa.Sd3Pipeline(
+            dit=tiny_mmdit, vae=None, clip=clip, clip_g=clip, tokenizer=tok,
+            t5=object(),  # set but no tokenizer
+        )
+        with pytest.raises(ValueError, match="t5_tokenizer"):
+            pipe.encode_prompt(["hello"])
+
+
+class TestSincosOrder:
+    def test_width_axis_first(self):
+        """SAI convention: at (h, w) the table is [emb(w) | emb(h)] — two
+        positions sharing w agree in the first half, sharing h in the second."""
+        t = sincos_pos_embed(4, 8).reshape(4, 4, 8)
+        np.testing.assert_array_equal(t[0, 2, :4], t[3, 2, :4])  # same w
+        np.testing.assert_array_equal(t[2, 0, 4:], t[2, 3, 4:])  # same h
+        assert not np.allclose(t[0, 2, 4:], t[3, 2, 4:])
