@@ -14,6 +14,15 @@ import functools
 
 import jax
 
+# Platform names that mean "a TPU chip". The tunneled TPU registers as the
+# experimental 'axon' PJRT plugin, whose devices report platform 'axon' — treat
+# it as TPU everywhere (device strings, backend dispatch, default device).
+TPU_PLATFORMS = ("tpu", "axon")
+
+
+def is_tpu_device(d: jax.Device) -> bool:
+    return d.platform in TPU_PLATFORMS
+
 
 def _platform_devices(platform: str) -> list[jax.Device]:
     """All jax devices for a platform, or [] when that backend is absent."""
@@ -21,6 +30,15 @@ def _platform_devices(platform: str) -> list[jax.Device]:
         return list(jax.devices(platform))
     except RuntimeError:
         return []
+
+
+def _tpu_class_devices() -> list[jax.Device]:
+    """Devices of the first present TPU-class platform ('tpu', else 'axon')."""
+    for plat in TPU_PLATFORMS:
+        devs = _platform_devices(plat)
+        if devs:
+            return devs
+    return []
 
 
 @functools.cache
@@ -68,7 +86,9 @@ def get_device(device_str: str) -> jax.Device:
             idx = int(device_str.split(":", 1)[1])
         except ValueError as e:
             raise ValueError(f"Malformed device string {device_str!r}") from e
-    devs = _platform_devices(plat)
+    # 'tpu:N' resolves against whichever TPU-class platform is present, so user
+    # chains written as tpu:0 work when the chip registers as 'axon'.
+    devs = _tpu_class_devices() if plat == "tpu" else _platform_devices(plat)
     if not devs:
         raise ValueError(f"No devices available for platform {plat!r} (from {device_str!r})")
     for d in devs:
@@ -83,8 +103,10 @@ def get_device(device_str: str) -> jax.Device:
 def default_device() -> jax.Device:
     """The canonical compute device — analogue of
     comfy.model_management.get_torch_device() (consumed at any_device_parallel.py:952)."""
-    for plat in ("tpu", "gpu"):
-        devs = _platform_devices(plat)
-        if devs:
-            return devs[0]
+    devs = _tpu_class_devices()
+    if devs:
+        return devs[0]
+    devs = _platform_devices("gpu")
+    if devs:
+        return devs[0]
     return jax.devices("cpu")[0]

@@ -375,6 +375,23 @@ def _flux_pipeline_spec(module: FluxModel, cfg: FluxConfig) -> PipelineSpec:
     )
 
 
+def flux_abstract_params(cfg: FluxConfig, sample_shape=(1, 32, 32, 16), txt_len=128):
+    """Shape/dtype pytree of FLUX parameters WITHOUT materializing a single byte
+    (``jax.eval_shape`` over init). The entry point for sharded-from-birth
+    placement of models too big for one chip: feed the result to
+    ``parallel.mesh.materialize_params_sharded`` (or a sharded checkpoint
+    restore) so a flux-dev-class 12B pytree never exists unsharded anywhere."""
+    module = FluxModel(cfg)
+    x = jax.ShapeDtypeStruct(sample_shape, jnp.float32)
+    t = jax.ShapeDtypeStruct((sample_shape[0],), jnp.float32)
+    ctx = jax.ShapeDtypeStruct((sample_shape[0], txt_len, cfg.context_in_dim), jnp.float32)
+    y = jax.ShapeDtypeStruct((sample_shape[0], cfg.vec_in_dim), jnp.float32)
+    return jax.eval_shape(
+        lambda r, x_, t_, c_, y_: module.init(r, x_, t_, c_, y=y_)["params"],
+        jax.random.key(0), x, t, ctx, y,
+    )
+
+
 def build_flux(
     cfg: FluxConfig,
     rng=None,

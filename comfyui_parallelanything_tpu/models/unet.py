@@ -120,7 +120,12 @@ class TransformerBlock(nn.Module):
         h = nn.LayerNorm(dtype=cfg.dtype)(x)
         gate = nn.Dense(self.channels * 8, dtype=cfg.dtype, name="ff_in")(h)
         a, b = jnp.split(gate, 2, axis=-1)
-        x = x + nn.Dense(self.channels, dtype=cfg.dtype, name="ff_out")(a * nn.gelu(b))
+        # GEGLU with EXACT (erf) gelu — the ldm/diffusers convention for SD UNets
+        # (FLUX-family models use tanh-approx; the two differ at ~1e-3, enough to
+        # drift a 50-step sample).
+        x = x + nn.Dense(self.channels, dtype=cfg.dtype, name="ff_out")(
+            a * nn.gelu(b, approximate=False)
+        )
         return x
 
 

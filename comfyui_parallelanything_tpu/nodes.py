@@ -825,6 +825,14 @@ class TPUEmptyVideoLatent:
         )
 
 
+def _scheduler_menu() -> list[str]:
+    """The KSampler scheduler dropdown — sourced from the sampling layer's
+    registry so the menu and make_sigmas dispatch cannot drift."""
+    from .sampling import SCHEDULER_NAMES
+
+    return list(SCHEDULER_NAMES)
+
+
 class TPUKSampler:
     """(MODEL, positive, negative, LATENT) → LATENT — the per-step driver whose
     forwards route through the parallel scheduler when MODEL came from
@@ -871,7 +879,7 @@ class TPUKSampler:
                                 "LATENT (wire a VAE Encode) instead of noise"},
                 ),
                 "scheduler": (
-                    ["karras", "normal"],
+                    _scheduler_menu(),
                     {"default": "karras",
                      "tooltip": "sigma spacing for the k-samplers"},
                 ),
@@ -950,7 +958,7 @@ class TPUKSampler:
             cfg_scale=cfg, uncond_context=uncond_context,
             uncond_kwargs=uncond_kwargs, rng=rng, shift=shift,
             guidance=guidance if guidance > 0 else None,
-            karras=scheduler == "karras",
+            scheduler=scheduler,
             prediction=getattr(model_cfg, "prediction", "eps"),
             init_latent=(
                 latent["samples"]

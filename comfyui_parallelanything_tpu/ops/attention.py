@@ -81,11 +81,13 @@ def _xla_attention(q, k, v, scale):
 
 @functools.cache
 def _pallas_available() -> bool:
+    from ..devices.discovery import is_tpu_device
+
     try:
         devs = jax.devices()
     except RuntimeError:
         return False
-    return any(d.platform == "tpu" for d in devs)
+    return any(is_tpu_device(d) for d in devs)
 
 
 def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:

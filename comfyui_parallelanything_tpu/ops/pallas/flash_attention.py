@@ -5,10 +5,16 @@ video models far more. The reference rides torch's bundled flash/xformers kernel
 merely toggles them off on old GPUs (any_device_parallel.py:126-164); here the fused
 path is a Pallas kernel tuned for the MXU/VMEM hierarchy:
 
-- grid over (batch·heads, query blocks); each program keeps one q block in VMEM
-- online-softmax accumulation over k blocks (f32 running max/sum — no S×S
-  materialization, HBM traffic stays O(S·D))
-- bf16 in, f32 accumulate, caller dtype out
+- grid over (batch·heads, query blocks, key blocks) — K/V stream through VMEM one
+  ``block_k`` tile at a time, so VMEM holds O(block_q + block_k), NOT O(seq_k).
+  This is what lets the same kernel cover WAN-video sequence lengths (tens of
+  thousands of tokens): at 32k keys the old whole-row layout needed ~16 MB of
+  VMEM per program just for K/V; streamed tiles stay ~1-2 MB at any length.
+- online-softmax state (f32 running max/sum/acc) lives in VMEM scratch and is
+  carried across the key-block grid dimension (the innermost, sequential one);
+  the output tile is written once, on the last key block. No S×S
+  materialization — HBM traffic stays O(S·D).
+- bf16 in, f32 accumulate, caller dtype out.
 
 Non-TPU backends run the same kernel in interpreter mode (tests) or should prefer the
 plain XLA path (ops/attention.py handles the dispatch).
@@ -21,44 +27,51 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# m/l scratch rows are stored broadcast across a full 128-wide lane dimension —
+# (block_q, 1) arrays lower poorly on the TPU vector unit.
+_LANES = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int, seq_k: int):
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale: float,
+    block_k: int, seq_k: int,
+):
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
     q = q_ref[...].astype(jnp.float32) * scale
-    block_q, head_dim = q.shape
-    padded_k = k_ref.shape[0]
-    nk = padded_k // block_k
+    k_blk = k_ref[...].astype(jnp.float32)
+    v_blk = v_ref[...].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (block_q, block_k)
+    # Mask out-of-range key columns (host pads seq_k up to a block_k multiple).
+    col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col < seq_k, s, -jnp.inf)
 
-    def body(i, carry):
-        acc, m, l = carry
-        k_blk = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (block_q, block_k)
-        # Mask out-of-range key columns (host pads seq_k up to block_k multiple).
-        col = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(col < seq_k, s, -jnp.inf)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return acc_new, m_new, l_new
-
-    acc, m, l = jax.lax.fori_loop(
-        0,
-        nk,
-        body,
-        (
-            jnp.zeros((block_q, head_dim), jnp.float32),
-            jnp.full((block_q, 1), -jnp.inf, jnp.float32),
-            jnp.zeros((block_q, 1), jnp.float32),
-        ),
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
-    o_ref[...] = (acc / l).astype(o_ref.dtype)
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        o_ref[...] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
 
 
 def _pad_to(x, axis: int, multiple: int):
@@ -88,10 +101,12 @@ def flash_attention(
     ``interpret=None`` auto-selects interpreter mode off-TPU so the same kernel is
     testable on the virtual CPU mesh.
     """
+    from ...devices.discovery import is_tpu_device
+
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+        interpret = not is_tpu_device(jax.devices()[0])
 
     batch, seq_q, heads, head_dim = q.shape
     seq_k = k.shape[1]
@@ -110,14 +125,25 @@ def flash_attention(
 
     out = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, block_k=bk, seq_k=seq_k),
-        grid=(batch * heads, padded_q // bq),
+        # Key blocks are the innermost (sequential) grid dim: scratch carries the
+        # online-softmax state across them, and the output tile (whose index map
+        # ignores j) stays resident in VMEM until its last visit.
+        grid=(batch * heads, padded_q // bq, padded_k // bk),
         in_specs=[
-            pl.BlockSpec((None, bq, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, padded_k, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, padded_k, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, bq, head_dim), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((batch * heads, padded_q, head_dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, head_dim), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(q3, k3, v3)
 

@@ -135,6 +135,54 @@ def place_params_fsdp(params, mesh: Mesh, axis: str = AXIS_DATA) -> object:
     return place_params_sharded(params, mesh, axis)
 
 
+def sharded_shardings(shape_tree, mesh: Mesh, axis: str, min_size: int = 2**16):
+    """Per-leaf ``NamedSharding`` tree for a ShapeDtypeStruct pytree, using the
+    same largest-divisible-axis policy as ``place_params_sharded``."""
+    n = mesh.shape[axis]
+    return jax.tree.map(
+        lambda sd: NamedSharding(mesh, fsdp_spec(tuple(sd.shape), axis, n, min_size)),
+        shape_tree,
+    )
+
+
+def sharded_byte_math(
+    shape_tree, mesh: Mesh, axis: str, itemsize: int = 2, min_size: int = 2**16
+) -> tuple[int, int]:
+    """(per_device_bytes, total_bytes) the FSDP policy would place, computed from
+    abstract shapes alone — the big-model placement proof that needs zero RAM
+    (used by both the driver dryrun and test_fsdp; ``itemsize=2`` = the bf16
+    checkpoint layout the converters produce)."""
+    shardings = sharded_shardings(shape_tree, mesh, axis, min_size)
+    per_device = total = 0
+    for sd, sh in zip(jax.tree.leaves(shape_tree), jax.tree.leaves(shardings)):
+        per_device += int(np.prod(sh.shard_shape(tuple(sd.shape)), dtype=np.int64)) * itemsize
+        total += int(np.prod(tuple(sd.shape), dtype=np.int64)) * itemsize
+    return per_device, total
+
+
+def materialize_params_sharded(
+    shape_tree, mesh: Mesh, axis: str = AXIS_DATA, min_size: int = 2**16
+):
+    """Create a zero-valued parameter pytree *directly in* its FSDP sharding.
+
+    This is the big-model creation path: a FLUX-dev-class pytree (~24 GB bf16)
+    must never exist unsharded — not on the host, not on any single chip. Each
+    leaf is produced by a jitted zeros program whose ``out_shardings`` is the
+    FSDP spec, so every device only ever allocates its 1/N shard. Checkpoint
+    loaders overwrite these buffers shard-by-shard (the reference's analogue is
+    the incremental state-dict copy at any_device_parallel.py:636-665, which
+    still needs a full host copy — this path needs none).
+    """
+    import jax.numpy as jnp
+
+    shardings = sharded_shardings(shape_tree, mesh, axis, min_size)
+
+    def init():
+        return jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype), shape_tree)
+
+    return jax.jit(init, out_shardings=shardings)()
+
+
 def place_params_tp(params, mesh: Mesh, axis: str = AXIS_MODEL) -> object:
     """Tensor-parallel placement: ``place_params_sharded`` over the model axis."""
     return place_params_sharded(params, mesh, axis)

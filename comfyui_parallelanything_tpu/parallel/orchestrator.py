@@ -132,6 +132,9 @@ class _PlatformGroup:
     devices: list[jax.Device]
     device_strs: list[str]
     device_weights: list[float]
+    # Pre-blend user weights, kept so rebalance() can re-blend against *fresh*
+    # memory readings instead of compounding blend-on-blend drift.
+    user_weights: list[float] = dataclasses.field(default_factory=list)
     mesh: Any = None
     params: Any = None  # pytree placed replicated on this group's mesh
 
@@ -144,6 +147,8 @@ class _PlatformGroup:
         self.params = None
         self.devices.pop()
         self.device_weights.pop()
+        if self.user_weights:
+            self.user_weights.pop()
         return self.device_strs.pop()
 
 
@@ -467,6 +472,36 @@ class ParallelModel:
                 g.params = self._place(self._host_params, g.mesh)
         self.active = True
 
+    # -- periodic re-balance (parity: per-step VRAM re-read, 737-766/1317-1322) ----
+
+    def rebalance(self) -> tuple[float, ...]:
+        """Re-read free device memory and re-blend workload weights.
+
+        The reference re-reads VRAM *every step* (any_device_parallel.py:737-766,
+        blended at 1317-1322) — free on CUDA, but on TPU a changed split shape is
+        a recompile, so the deferred analogue runs on demand between sampler runs.
+        Re-blends the *original* user weights (kept per group) against a fresh
+        memory reading — not the already-blended values, which would compound —
+        and resets the lazy pipeline runner so batch==1 stage placement also
+        re-balances on next use. Returns the new normalized weights. No-op on
+        chains where no device reports memory (blend falls back to user weights).
+        """
+        user = [w for g in self._groups for w in g.user_weights]
+        base = normalize_weights(user)
+        if base is None:
+            return self.weights
+        free = [free_memory_bytes(d) for g in self._groups for d in g.devices]
+        new = blend_memory_weights(base, free)
+        i = 0
+        for g in self._groups:
+            for j in range(len(g.device_weights)):
+                g.device_weights[j] = new[i]
+                i += 1
+        self.weights = tuple(new)
+        # Stage ranges are weight-proportional; rebuild lazily on next batch==1.
+        self._pipeline_runner = None
+        return self.weights
+
     # -- lifecycle (parity: cleanup_parallel_model, 211-282) -----------------------
 
     def cleanup(self) -> None:
@@ -524,11 +559,26 @@ def parallelize(
     Returns a ``ParallelModel``; on an unusable chain (empty, or total percentage <= 0)
     returns ``model`` unchanged, exactly like the reference's abort paths
     (1019-1027, 1037-1042).
+
+    Re-entrant: passing an existing ``ParallelModel`` tears down its placements and
+    rebuilds from the retained host params with the new chain/config — the
+    reference's cleanup-then-rebuild on repeated setup_parallel calls (1006-1013,
+    which runs *before* the weight-normalization abort at 1019-1027, so an unusable
+    chain still leaves the previous setup torn down; the returned model keeps
+    executing via its single-device path).
     """
     config = config or ParallelConfig()
     if not isinstance(chain, DeviceChain):
         chain = DeviceChain.from_pairs(chain)
-    apply_fn, params = _unwrap_model(model)
+    if isinstance(model, ParallelModel):
+        apply_fn, params = model._apply, model._host_params
+        pipeline_spec = model._pipeline_spec
+        wrapped_config = model.model_config
+        model.cleanup()
+    else:
+        apply_fn, params = _unwrap_model(model)
+        pipeline_spec = getattr(model, "pipeline_spec", None)
+        wrapped_config = getattr(model, "config", None)
 
     chain = chain.validated().deduplicated()
     weights = chain.normalized_weights()
@@ -538,18 +588,20 @@ def parallelize(
 
     devices = chain.jax_devices()
 
+    user_weights = weights
     if config.auto_memory_balance:
         free = [free_memory_bytes(d) for d in devices]
         weights = blend_memory_weights(weights, free)
 
     # Group consecutive-platform links into homogeneous SPMD sub-programs.
     groups: list[_PlatformGroup] = []
-    for dev_str, dev, w in zip(chain.devices, devices, weights):
+    for dev_str, dev, w, uw in zip(chain.devices, devices, weights, user_weights):
         plat = device_platform(dev_str)
         if groups and groups[-1].platform == plat:
             groups[-1].devices.append(dev)
             groups[-1].device_strs.append(dev_str)
             groups[-1].device_weights.append(w)
+            groups[-1].user_weights.append(uw)
         else:
             groups.append(
                 _PlatformGroup(
@@ -557,6 +609,7 @@ def parallelize(
                     devices=[dev],
                     device_strs=[dev_str],
                     device_weights=[w],
+                    user_weights=[uw],
                 )
             )
 
@@ -608,6 +661,6 @@ def parallelize(
         config=config,
         groups=groups,
         weights=final_weights,
-        pipeline_spec=getattr(model, "pipeline_spec", None),
-        model_config=getattr(model, "config", None),
+        pipeline_spec=pipeline_spec,
+        model_config=wrapped_config,
     )

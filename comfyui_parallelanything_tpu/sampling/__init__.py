@@ -4,9 +4,15 @@ from .flow import flow_euler_sample, flow_timesteps
 from .k_samplers import (
     RNG_SAMPLERS,
     SAMPLERS,
+    SCHEDULER_NAMES,
     EpsDenoiser,
+    beta_sigmas,
+    exponential_sigmas,
     karras_sigmas,
+    make_sigmas,
     sampling_sigmas,
+    sgm_uniform_sigmas,
+    simple_sigmas,
     sample_euler,
     sample_euler_ancestral,
     sample_heun,
@@ -24,6 +30,12 @@ __all__ = [
     "EpsDenoiser",
     "karras_sigmas",
     "sampling_sigmas",
+    "exponential_sigmas",
+    "sgm_uniform_sigmas",
+    "simple_sigmas",
+    "beta_sigmas",
+    "make_sigmas",
+    "SCHEDULER_NAMES",
     "sample_euler",
     "sample_euler_ancestral",
     "sample_heun",

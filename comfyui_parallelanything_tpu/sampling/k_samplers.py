@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .cfg import apply_callback, double_kwargs
 from .schedules import scaled_linear_schedule
@@ -47,6 +48,103 @@ def karras_sigmas(
     min_inv, max_inv = sigma_min ** (1 / rho), sigma_max ** (1 / rho)
     sig = (max_inv + ramp * (min_inv - max_inv)) ** rho
     return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
+
+
+def exponential_sigmas(
+    n_steps: int, sigma_min: float = 0.0292, sigma_max: float = 14.6146
+) -> jnp.ndarray:
+    """Log-uniform spacing (k-diffusion ``get_sigmas_exponential``); ends at 0."""
+    sig = jnp.exp(
+        jnp.linspace(
+            jnp.log(jnp.float32(sigma_max)), jnp.log(jnp.float32(sigma_min)), n_steps
+        )
+    )
+    return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
+
+
+def _sigma_table(alphas_cumprod: jnp.ndarray | None) -> jnp.ndarray:
+    if alphas_cumprod is None:
+        alphas_cumprod = scaled_linear_schedule()
+    return model_sigmas(alphas_cumprod)
+
+
+def sgm_uniform_sigmas(
+    n_steps: int, alphas_cumprod: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """SGM/EDM "trailing" uniform-timestep spacing (ComfyUI ``sgm_uniform``):
+    n+1 uniform timesteps, last dropped, so the final nonzero sigma sits one
+    uniform stride above 0 instead of at sigma_min."""
+    table = _sigma_table(alphas_cumprod)
+    idx = jnp.linspace(len(table) - 1, 0, n_steps + 1, dtype=jnp.float32)[:-1]
+    sig = jnp.interp(idx, jnp.arange(len(table), dtype=jnp.float32), table)
+    return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
+
+
+def simple_sigmas(
+    n_steps: int, alphas_cumprod: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """ComfyUI ``simple``: raw table entries at equal index strides (no interp)."""
+    table = _sigma_table(alphas_cumprod)
+    stride = len(table) / n_steps
+    idx = [len(table) - 1 - int(i * stride) for i in range(n_steps)]
+    sig = table[jnp.asarray(idx, jnp.int32)]
+    return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
+
+
+def _beta_ppf(q: np.ndarray, a: float, b: float, grid_points: int = 65537) -> np.ndarray:
+    """Beta quantile function by numeric CDF inversion (jax betainc + interp) —
+    keeps the beta scheduler dependency-free (scipy is not a package dep)."""
+    from jax.scipy.special import betainc
+
+    grid = np.linspace(0.0, 1.0, grid_points, dtype=np.float64)
+    cdf = np.asarray(betainc(a, b, jnp.asarray(grid)), np.float64)
+    return np.interp(q, cdf, grid)
+
+
+def beta_sigmas(
+    n_steps: int,
+    alphas_cumprod: jnp.ndarray | None = None,
+    alpha: float = 0.6,
+    beta: float = 0.6,
+) -> jnp.ndarray:
+    """ComfyUI ``beta`` (arXiv:2407.12173): timesteps at Beta(0.6, 0.6) quantiles —
+    denser at both schedule ends. Duplicate timesteps (quantiles collide after
+    rounding at high step counts) are skipped like the reference implementation,
+    so the result may be shorter than ``n_steps + 1`` — a repeated sigma would
+    divide-by-zero the multistep samplers (lms, dpm++ sde)."""
+    table = _sigma_table(alphas_cumprod)
+    ts = 1.0 - np.linspace(0.0, 1.0, n_steps, dtype=np.float64)
+    idx = np.rint(_beta_ppf(ts, alpha, beta) * (len(table) - 1)).astype(np.int64)
+    keep = np.concatenate([[True], np.diff(idx) != 0])
+    sig = table[jnp.asarray(idx[keep], jnp.int32)]
+    return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
+
+
+SCHEDULER_NAMES = ("karras", "normal", "exponential", "sgm_uniform", "simple", "beta")
+
+
+def make_sigmas(
+    scheduler: str, n_steps: int, alphas_cumprod: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """The KSampler scheduler menu: named spacing → (n_steps+1,) descending sigmas
+    ending at 0, ranged over the model's sigma table when one is supplied."""
+    if scheduler in ("karras", "exponential"):
+        fn = karras_sigmas if scheduler == "karras" else exponential_sigmas
+        if alphas_cumprod is None:
+            return fn(n_steps)
+        table = _sigma_table(alphas_cumprod)
+        return fn(n_steps, sigma_min=float(table[0]), sigma_max=float(table[-1]))
+    if scheduler == "normal":
+        return sampling_sigmas(n_steps, alphas_cumprod)
+    if scheduler == "sgm_uniform":
+        return sgm_uniform_sigmas(n_steps, alphas_cumprod)
+    if scheduler == "simple":
+        return simple_sigmas(n_steps, alphas_cumprod)
+    if scheduler == "beta":
+        return beta_sigmas(n_steps, alphas_cumprod)
+    raise ValueError(
+        f"unknown scheduler {scheduler!r} (have {', '.join(SCHEDULER_NAMES)})"
+    )
 
 
 class EpsDenoiser:

@@ -16,8 +16,7 @@ from .k_samplers import (
     RNG_SAMPLERS,
     SAMPLERS as K_SAMPLERS,
     EpsDenoiser,
-    karras_sigmas,
-    sampling_sigmas,
+    make_sigmas,
 )
 
 SAMPLER_NAMES = ("ddim", *K_SAMPLERS, "flow_euler")
@@ -35,6 +34,7 @@ def run_sampler(
     uncond_kwargs: dict | None = None,
     rng=None,
     karras: bool = True,
+    scheduler: str | None = None,
     shift: float = 1.0,
     guidance: float | None = None,
     callback=None,
@@ -143,20 +143,12 @@ def run_sampler(
         )
     # Same coherence rule as the ddim branch: a caller-supplied schedule must
     # drive the sampling sigmas (and img2img truncation), not just the
-    # denoiser's sigma→timestep table.
+    # denoiser's sigma→timestep table. ``scheduler`` names the full KSampler
+    # menu (make_sigmas); the older ``karras`` boolean remains as a fallback
+    # when no name is given.
     acp = model_kwargs.pop("alphas_cumprod", None)
-    if karras:
-        if acp is None:
-            sigmas = karras_sigmas(total)
-        else:
-            from .k_samplers import model_sigmas
-
-            table = model_sigmas(acp)
-            sigmas = karras_sigmas(
-                total, sigma_min=float(table[0]), sigma_max=float(table[-1])
-            )
-    else:
-        sigmas = sampling_sigmas(total, acp)
+    sched_name = scheduler if scheduler is not None else ("karras" if karras else "normal")
+    sigmas = make_sigmas(sched_name, total, acp)
     if img2img:
         sigmas = sigmas[-(steps + 1) :]
     denoiser = EpsDenoiser(

@@ -85,6 +85,82 @@ class TestFsdpEndToEnd:
         assert out.shape == (1, 1024)
         assert pm._lead_params is None  # no full-pytree lead copy happened
 
+    def test_full_size_flux_dev_fsdp_byte_math(self, cpu_devices):
+        # The stated reason FSDP exists: flux-dev bf16 (~24 GB) cannot replicate
+        # on a 16 GB v5e chip (parallel/mesh.py fsdp_spec docstring). Prove the
+        # placement math on the REAL 19/38-depth 12B-param config — abstract
+        # shapes (eval_shape, zero bytes materialized) + the exact per-device
+        # shard bytes the FSDP policy produces.
+        from comfyui_parallelanything_tpu.models import (
+            flux_abstract_params,
+            flux_dev_config,
+        )
+        from comfyui_parallelanything_tpu.parallel.mesh import sharded_byte_math
+
+        cfg = flux_dev_config(dtype=jnp.bfloat16)
+        assert (cfg.depth, cfg.depth_single_blocks) == (19, 38)
+        shapes = flux_abstract_params(cfg, sample_shape=(1, 4, 4, 16), txt_len=4)
+        n_params = sum(s.size for s in jax.tree.leaves(shapes))
+        assert n_params > 10e9  # genuinely the 12B-class pytree
+        # Exact per-device bytes from shard shapes (bf16 checkpoint layout: 2
+        # bytes/param — the load path the converters produce).
+        per_device, total = sharded_byte_math(
+            shapes, build_mesh(cpu_devices, {AXIS_DATA: 8}), AXIS_DATA
+        )
+        assert total > 20 * 2**30  # the full replica genuinely overflows a v5e
+        # Sharded 8-way it fits with room to spare; replication slack (small
+        # norms/biases live whole on every chip) stays under 5%.
+        assert per_device < total / 8 * 1.05
+        assert per_device < 4 * 2**30
+
+    def test_full_width_flux_fsdp_places_and_steps(self, cpu_devices):
+        # The mechanics proof at full layer width: materialize a full-WIDTH
+        # (hidden 3072, 24 heads) flux pytree directly into its FSDP sharding —
+        # the unsharded pytree never exists — verify real buffer bytes are 1/8
+        # per device, and run one denoise step through the orchestrator. (The
+        # full 57-block 12B forward is not runnable on the virtual mesh: eight
+        # host threads each all-gathering full weights needs >8x the pytree in
+        # one host's RAM; on a real v5e-8 each chip holds 1/8 + one block's
+        # gather. Depth is the only reduction here — every tensor shape that
+        # matters to sharding is full-size.)
+        from comfyui_parallelanything_tpu.models import (
+            build_flux,
+            flux_abstract_params,
+            flux_dev_config,
+        )
+        from comfyui_parallelanything_tpu.parallel.mesh import (
+            materialize_params_sharded,
+        )
+
+        cfg = flux_dev_config(depth=1, depth_single_blocks=2, dtype=jnp.bfloat16)
+        shapes = flux_abstract_params(cfg, sample_shape=(1, 4, 4, 16), txt_len=4)
+        shapes = jax.tree.map(
+            lambda sd: jax.ShapeDtypeStruct(sd.shape, jnp.bfloat16), shapes
+        )
+        mesh = build_mesh(cpu_devices, {AXIS_DATA: 8})
+        params = materialize_params_sharded(shapes, mesh, AXIS_DATA)
+        total = sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(params))
+        per_dev = {}
+        for leaf in jax.tree.leaves(params):
+            for sh in leaf.addressable_shards:
+                per_dev[sh.device.id] = per_dev.get(sh.device.id, 0) + sh.data.nbytes
+        assert len(per_dev) == 8
+        for b in per_dev.values():
+            assert b < total / 8 * 1.05
+        model = build_flux(cfg, params=params, sample_shape=(1, 4, 4, 16), txt_len=4)
+        pm = parallelize(
+            model,
+            DeviceChain.even([f"cpu:{i}" for i in range(8)]),
+            ParallelConfig(weight_sharding="fsdp"),
+        )
+        x = jnp.ones((8, 4, 4, 16), jnp.float32)
+        t = jnp.linspace(1.0, 0.1, 8)
+        ctx = jnp.ones((8, 4, cfg.context_in_dim), jnp.float32)
+        y = jnp.ones((8, cfg.vec_in_dim), jnp.float32)
+        out = pm(x, t, ctx, y=y, guidance=jnp.full((8,), 3.5, jnp.float32))
+        assert out.shape == (8, 4, 4, 16)
+        assert np.isfinite(np.asarray(out, np.float32)).all()
+
     def test_fsdp_params_use_less_per_device_memory(self, cpu_devices):
         # Structural check: at least the large kernels are sharded, not replicated.
         cfg = sd15_config(

@@ -1,0 +1,176 @@
+"""SD3/SD3.5 MMDiT joint-block golden parity vs a minimal torch reference.
+
+The torch reference follows the public SAI MMDiT design: per-stream adaLN (SAI
+6-chunk order: shift/scale/gate for attn, then for mlp), fused qkv with optional
+per-head-dim q/k RMSNorm (SD3.5), joint attention over [context ‖ x], per-stream
+proj + tanh-GELU MLP, and a pre-only final context block (qkv in, no out path).
+Exported in the official ``joint_blocks.{i}.{x,context}_block`` key layout, mapped
+with ``convert_mmdit.py``'s helpers, compared activation-for-activation against
+``models/mmdit.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.models.convert_mmdit import _attn_in, _dense
+from comfyui_parallelanything_tpu.models.mmdit import JointBlock, MMDiTConfig
+
+from test_golden_flux import t_attention
+
+torch = pytest.importorskip("torch")
+tnn = torch.nn
+F = torch.nn.functional
+
+CFG = MMDiTConfig(
+    in_channels=4,
+    patch_size=2,
+    depth=2,            # hidden 128, heads 2, head_dim 64
+    context_in_dim=32,
+    pooled_dim=24,
+    pos_embed_max=8,
+    qk_norm=True,       # exercise the SD3.5 per-head q/k RMS path
+    dtype=jnp.float32,
+)
+H_ = CFG.hidden_size
+
+
+class TRMS(tnn.Module):
+    def __init__(self, dim):
+        super().__init__()
+        self.weight = tnn.Parameter(torch.randn(dim))
+
+    def forward(self, x):
+        x32 = x.float()
+        n = x32 * torch.rsqrt(x32.pow(2).mean(-1, keepdim=True) + 1e-6)
+        return n * self.weight
+
+
+class TAttn(tnn.Module):
+    """Keys: .qkv / .ln_q.weight / .ln_k.weight / .proj."""
+
+    def __init__(self, h, head_dim, pre_only=False):
+        super().__init__()
+        self.qkv = tnn.Linear(h, 3 * h)
+        self.ln_q = TRMS(head_dim)
+        self.ln_k = TRMS(head_dim)
+        if not pre_only:
+            self.proj = tnn.Linear(h, h)
+
+
+class TMlp(tnn.Module):
+    def __init__(self, h, mlp_dim):
+        super().__init__()
+        self.fc1 = tnn.Linear(h, mlp_dim)
+        self.fc2 = tnn.Linear(mlp_dim, h)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate="tanh"))
+
+
+class TStreamBlock(tnn.Module):
+    def __init__(self, h, heads, mlp_dim, pre_only=False):
+        super().__init__()
+        self.heads = heads
+        self.pre_only = pre_only
+        n_mods = 2 if pre_only else 6
+        self.adaLN_modulation = tnn.Sequential(tnn.SiLU(), tnn.Linear(h, n_mods * h))
+        self.attn = TAttn(h, h // heads, pre_only)
+        if not pre_only:
+            self.mlp = TMlp(h, mlp_dim)
+
+
+def _ln(x, h):
+    return F.layer_norm(x, (h,), eps=1e-6)
+
+
+def _mods(blk, vec, n):
+    return blk.adaLN_modulation(vec.float())[:, None, :].chunk(n, dim=-1)
+
+
+def _qkv_heads(blk, x, heads, shift, scale):
+    b, s, h = x.shape
+    d = h // heads
+    hn = _ln(x, h).float() * (1 + scale) + shift
+    qkv = blk.attn.qkv(hn).reshape(b, s, 3, heads, d)
+    q = blk.attn.ln_q(qkv[:, :, 0])
+    k = blk.attn.ln_k(qkv[:, :, 1])
+    return hn, q, k, qkv[:, :, 2]
+
+
+def t_joint_block(xb, cb, x, ctx, vec, heads, pre_only):
+    h = x.shape[-1]
+    xs1, xc1, xg1, xs2, xc2, xg2 = _mods(xb, vec, 6)
+    _, xq, xk, xv = _qkv_heads(xb, x, heads, xs1, xc1)
+    if pre_only:
+        cs1, cc1 = _mods(cb, vec, 2)
+    else:
+        cs1, cc1, cg1, cs2, cc2, cg2 = _mods(cb, vec, 6)
+    _, cq, ck, cv = _qkv_heads(cb, ctx, heads, cs1, cc1)
+
+    ctx_len = ctx.shape[1]
+    q = torch.cat([cq, xq], dim=1)
+    k = torch.cat([ck, xk], dim=1)
+    v = torch.cat([cv, xv], dim=1)
+    attn = t_attention(q, k, v).reshape(q.shape[0], q.shape[1], -1)
+    ctx_a, x_a = attn[:, :ctx_len], attn[:, ctx_len:]
+
+    x = x + xg1 * xb.attn.proj(x_a)
+    x = x + xg2 * xb.mlp(_ln(x, h).float() * (1 + xc2) + xs2)
+    if pre_only:
+        return x, ctx
+    ctx = ctx + cg1 * cb.attn.proj(ctx_a)
+    ctx = ctx + cg2 * cb.mlp(_ln(ctx, h).float() * (1 + cc2) + cs2)
+    return x, ctx
+
+
+def _block_params(sd, i, pre_only):
+    xb = f"joint_blocks.{i}.x_block"
+    cb = f"joint_blocks.{i}.context_block"
+    blk = {
+        "x_adaln": {"lin": _dense(sd, f"{xb}.adaLN_modulation.1")},
+        "x_attn_in": _attn_in(sd, f"{xb}.attn", CFG),
+        "x_attn_proj": _dense(sd, f"{xb}.attn.proj"),
+        "x_mlp_in": _dense(sd, f"{xb}.mlp.fc1"),
+        "x_mlp_out": _dense(sd, f"{xb}.mlp.fc2"),
+        "ctx_adaln": {"lin": _dense(sd, f"{cb}.adaLN_modulation.1")},
+        "ctx_attn_in": _attn_in(sd, f"{cb}.attn", CFG),
+    }
+    if not pre_only:
+        blk["ctx_attn_proj"] = _dense(sd, f"{cb}.attn.proj")
+        blk["ctx_mlp_in"] = _dense(sd, f"{cb}.mlp.fc1")
+        blk["ctx_mlp_out"] = _dense(sd, f"{cb}.mlp.fc2")
+    return blk
+
+
+@pytest.mark.parametrize("pre_only", [False, True])
+def test_joint_block_golden_parity(pre_only):
+    torch.manual_seed(4)
+    mlp_dim = int(H_ * CFG.mlp_ratio)
+    xb = TStreamBlock(H_, CFG.num_heads, mlp_dim, pre_only=False).eval()
+    cb = TStreamBlock(H_, CFG.num_heads, mlp_dim, pre_only=pre_only).eval()
+    sd = {f"joint_blocks.0.x_block.{k}": v.detach() for k, v in xb.state_dict().items()}
+    sd.update(
+        {f"joint_blocks.0.context_block.{k}": v.detach()
+         for k, v in cb.state_dict().items()}
+    )
+    params = _block_params(sd, 0, pre_only)
+
+    rng = np.random.default_rng(21)
+    B, S, L = 2, 12, 6
+    x = rng.normal(size=(B, S, H_)).astype(np.float32)
+    ctx = rng.normal(size=(B, L, H_)).astype(np.float32)
+    vec = rng.normal(size=(B, H_)).astype(np.float32)
+
+    with torch.no_grad():
+        w_x, w_ctx = t_joint_block(
+            xb, cb, torch.from_numpy(x), torch.from_numpy(ctx),
+            torch.from_numpy(vec), CFG.num_heads, pre_only,
+        )
+    got_x, got_ctx = JointBlock(CFG, pre_only=pre_only).apply(
+        {"params": jax.tree.map(jnp.asarray, params)},
+        jnp.asarray(x), jnp.asarray(ctx), jnp.asarray(vec),
+    )
+    np.testing.assert_allclose(np.asarray(got_x), w_x.numpy(), rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(got_ctx), w_ctx.numpy(), rtol=5e-4, atol=5e-4)

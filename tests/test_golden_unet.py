@@ -1,0 +1,192 @@
+"""SD UNet golden parity vs minimal torch reference blocks (ldm layout).
+
+The res-block and spatial-transformer torch references below follow the public
+ldm/openaimodel design the single-file SD checkpoints serialize: ResBlock as
+in_layers(GN→SiLU→Conv) + emb_layers(SiLU→Linear) + out_layers(GN→SiLU→Conv) with a
+1×1 skip, and SpatialTransformer as GN→1×1 proj_in→BasicTransformerBlock stack
+(pre-LN attn1/attn2/GEGLU-ff)→1×1 proj_out with residual. Converted with the
+internal helpers of ``convert_unet.py`` and compared activation-for-activation
+against ``models/unet.py`` — the architecture-level check that round-trip
+inversion (test_convert_unet.py) cannot provide.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.models.convert_unet import (
+    _res_block,
+    _spatial_transformer,
+)
+from comfyui_parallelanything_tpu.models.unet import (
+    ResBlock,
+    SpatialTransformer,
+    UNetConfig,
+    sd15_config,
+)
+
+torch = pytest.importorskip("torch")
+tnn = torch.nn
+F = torch.nn.functional
+
+CFG = sd15_config(
+    model_channels=32,
+    channel_mult=(1, 2),
+    num_res_blocks=1,
+    attention_levels=(1,),
+    transformer_depth=(0, 2),
+    num_heads=4,
+    context_dim=48,
+    norm_groups=8,
+    dtype=jnp.float32,
+)
+
+
+class TResBlock(tnn.Module):
+    """ldm openaimodel ResBlock (keys: in_layers/emb_layers/out_layers/skip)."""
+
+    def __init__(self, ch, emb_dim, out_ch, groups):
+        super().__init__()
+        self.in_layers = tnn.Sequential(
+            tnn.GroupNorm(groups, ch), tnn.SiLU(), tnn.Conv2d(ch, out_ch, 3, padding=1)
+        )
+        self.emb_layers = tnn.Sequential(tnn.SiLU(), tnn.Linear(emb_dim, out_ch))
+        self.out_layers = tnn.Sequential(
+            tnn.GroupNorm(groups, out_ch), tnn.SiLU(), tnn.Identity(),
+            tnn.Conv2d(out_ch, out_ch, 3, padding=1),
+        )
+        self.skip_connection = (
+            tnn.Conv2d(ch, out_ch, 1) if ch != out_ch else tnn.Identity()
+        )
+
+    def forward(self, x, emb):
+        h = self.in_layers(x)
+        h = h + self.emb_layers(emb)[:, :, None, None]
+        h = self.out_layers(h)
+        return self.skip_connection(x) + h
+
+
+class TCrossAttention(tnn.Module):
+    def __init__(self, q_dim, kv_dim, heads, head_dim):
+        super().__init__()
+        inner = heads * head_dim
+        self.heads, self.head_dim = heads, head_dim
+        self.to_q = tnn.Linear(q_dim, inner, bias=False)
+        self.to_k = tnn.Linear(kv_dim, inner, bias=False)
+        self.to_v = tnn.Linear(kv_dim, inner, bias=False)
+        self.to_out = tnn.Sequential(tnn.Linear(inner, q_dim))
+
+    def forward(self, x, context=None):
+        ctx = x if context is None else context
+        b, s, _ = x.shape
+        sk = ctx.shape[1]
+
+        def heads_view(t, sl):
+            return t.reshape(b, sl, self.heads, self.head_dim)
+
+        q = heads_view(self.to_q(x), s)
+        k = heads_view(self.to_k(ctx), sk)
+        v = heads_view(self.to_v(ctx), sk)
+        logits = torch.einsum("bqhd,bkhd->bhqk", q, k).float() / np.sqrt(self.head_dim)
+        probs = torch.softmax(logits, dim=-1)
+        o = torch.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, -1)
+        return self.to_out(o)
+
+
+class TGEGLU(tnn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.proj = tnn.Linear(ch, ch * 8)
+
+    def forward(self, x):
+        a, gate = self.proj(x).chunk(2, dim=-1)
+        return a * F.gelu(gate)  # exact erf gelu — the ldm convention
+
+
+class TBasicTransformerBlock(tnn.Module):
+    def __init__(self, ch, ctx_dim, heads, head_dim):
+        super().__init__()
+        self.attn1 = TCrossAttention(ch, ch, heads, head_dim)
+        self.attn2 = TCrossAttention(ch, ctx_dim, heads, head_dim)
+        self.ff = tnn.Sequential()
+        self.ff.net = tnn.Sequential(TGEGLU(ch), tnn.Identity(), tnn.Linear(ch * 4, ch))
+        self.norm1 = tnn.LayerNorm(ch)
+        self.norm2 = tnn.LayerNorm(ch)
+        self.norm3 = tnn.LayerNorm(ch)
+
+    def forward(self, x, context):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), context)
+        x = x + self.ff.net(self.norm3(x))
+        return x
+
+
+class TSpatialTransformer(tnn.Module):
+    def __init__(self, ch, ctx_dim, depth, heads, head_dim, groups):
+        super().__init__()
+        self.norm = tnn.GroupNorm(groups, ch, eps=1e-6)
+        self.proj_in = tnn.Conv2d(ch, ch, 1)
+        self.transformer_blocks = tnn.ModuleList(
+            [TBasicTransformerBlock(ch, ctx_dim, heads, head_dim) for _ in range(depth)]
+        )
+        self.proj_out = tnn.Conv2d(ch, ch, 1)
+
+    def forward(self, x, context):
+        b, c, hh, ww = x.shape
+        h = self.proj_in(self.norm(x))
+        h = h.reshape(b, c, hh * ww).permute(0, 2, 1)
+        for blk in self.transformer_blocks:
+            h = blk(h, context)
+        h = h.permute(0, 2, 1).reshape(b, c, hh, ww)
+        return x + self.proj_out(h)
+
+
+def _nchw(x_nhwc):
+    return torch.from_numpy(np.ascontiguousarray(x_nhwc.transpose(0, 3, 1, 2)))
+
+
+def test_res_block_golden_parity():
+    torch.manual_seed(0)
+    ch, out_ch, emb_dim = 32, 64, 128
+    tblk = TResBlock(ch, emb_dim, out_ch, groups=CFG.norm_groups).eval()
+    sd = {f"res.{k}": v.detach() for k, v in tblk.state_dict().items()}
+    params = _res_block(sd, "res", has_skip=True)
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2, 8, 8, ch)).astype(np.float32)
+    emb = rng.normal(size=(2, emb_dim)).astype(np.float32)
+    with torch.no_grad():
+        want = tblk(_nchw(x), torch.from_numpy(emb)).numpy().transpose(0, 2, 3, 1)
+    got = np.asarray(
+        ResBlock(CFG, out_ch).apply(
+            {"params": jax.tree.map(jnp.asarray, params)}, jnp.asarray(x), jnp.asarray(emb)
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
+
+
+def test_spatial_transformer_golden_parity():
+    torch.manual_seed(1)
+    ch, heads = 32, 4
+    depth, head_dim = 2, ch // 4
+    tst = TSpatialTransformer(
+        ch, CFG.context_dim, depth, heads, head_dim, groups=CFG.norm_groups
+    ).eval()
+    sd = {f"st.{k}": v.detach() for k, v in tst.state_dict().items()}
+    params = _spatial_transformer(sd, "st", depth, heads, head_dim)
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(2, 8, 8, ch)).astype(np.float32)
+    ctx = rng.normal(size=(2, 7, CFG.context_dim)).astype(np.float32)
+    with torch.no_grad():
+        want = (
+            tst(_nchw(x), torch.from_numpy(ctx)).numpy().transpose(0, 2, 3, 1)
+        )
+    got = np.asarray(
+        SpatialTransformer(CFG, ch, depth).apply(
+            {"params": jax.tree.map(jnp.asarray, params)},
+            jnp.asarray(x), jnp.asarray(ctx),
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)

@@ -1,0 +1,161 @@
+"""WAN block golden parity vs a minimal torch reference (official WAN 2.1 design).
+
+The torch block below follows the public Wan2.1 DiT block: 6-chunk adaLN modulation
+(shared time vector + learned per-block bias), self-attention with full-inner-dim
+q/k RMSNorm and 3-axis RoPE, affine-pre-norm cross-attention to text (ungated), and
+a tanh-GELU FFN. Exported in the official ``blocks.{i}.*`` key layout, mapped with
+``convert_wan.py``'s helpers, and compared activation-for-activation against
+``models/wan.py`` — the architecture-level check round-trip inversion
+(test_convert_wan.py) cannot provide.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.models.convert_wan import _dense, _ln, _rms
+from comfyui_parallelanything_tpu.models.wan import WanBlock, WanConfig
+
+from test_golden_flux import t_apply_rope, t_attention, t_rope_freqs
+
+torch = pytest.importorskip("torch")
+tnn = torch.nn
+F = torch.nn.functional
+
+CFG = WanConfig(
+    hidden_size=64,
+    ffn_dim=128,
+    num_heads=4,   # head_dim 16
+    depth=1,
+    dtype=jnp.float32,
+)
+
+
+class TWanRMSNorm(tnn.Module):
+    def __init__(self, dim, eps=1e-6):
+        super().__init__()
+        self.eps = eps
+        self.weight = tnn.Parameter(torch.randn(dim))
+
+    def forward(self, x):
+        x32 = x.float()
+        n = x32 * torch.rsqrt(x32.pow(2).mean(-1, keepdim=True) + self.eps)
+        return n * self.weight
+
+
+class TWanAttention(tnn.Module):
+    """Key container: .q/.k/.v/.o/.norm_q/.norm_k (official WAN attention keys)."""
+
+    def __init__(self, dim):
+        super().__init__()
+        self.q = tnn.Linear(dim, dim)
+        self.k = tnn.Linear(dim, dim)
+        self.v = tnn.Linear(dim, dim)
+        self.o = tnn.Linear(dim, dim)
+        self.norm_q = TWanRMSNorm(dim)
+        self.norm_k = TWanRMSNorm(dim)
+
+
+class TWanBlock(tnn.Module):
+    def __init__(self, dim, ffn_dim, heads):
+        super().__init__()
+        self.heads = heads
+        self.dim = dim
+        self.self_attn = TWanAttention(dim)
+        self.cross_attn = TWanAttention(dim)
+        self.norm3 = tnn.LayerNorm(dim, eps=1e-6)
+        self.ffn = tnn.Sequential(
+            tnn.Linear(dim, ffn_dim), tnn.GELU(approximate="tanh"),
+            tnn.Linear(ffn_dim, dim),
+        )
+        self.modulation = tnn.Parameter(torch.randn(1, 6, dim))
+
+    def forward(self, x, context, e, cos, sin):
+        H = self.heads
+        D = self.dim // H
+        B, S, _ = x.shape
+        L = context.shape[1]
+        e = (e + self.modulation).float()
+        shift1, scale1, gate1, shift2, scale2, gate2 = (
+            e[:, i][:, None, :] for i in range(6)
+        )
+
+        def ln_plain(t):
+            return F.layer_norm(t, (self.dim,), eps=1e-6)
+
+        # self-attention, q/k RMSNorm over the full inner dim, then heads + rope
+        h = ln_plain(x) * (1 + scale1) + shift1
+        q = self.self_attn.norm_q(self.self_attn.q(h)).reshape(B, S, H, D)
+        k = self.self_attn.norm_k(self.self_attn.k(h)).reshape(B, S, H, D)
+        v = self.self_attn.v(h).reshape(B, S, H, D)
+        q, k = t_apply_rope(q, cos, sin), t_apply_rope(k, cos, sin)
+        attn = t_attention(q, k, v).reshape(B, S, -1)
+        x = x + gate1 * self.self_attn.o(attn)
+
+        # cross-attention to text: affine pre-norm, no rope, no gate
+        h = self.norm3(x)
+        q = self.cross_attn.norm_q(self.cross_attn.q(h)).reshape(B, S, H, D)
+        k = self.cross_attn.norm_k(self.cross_attn.k(context)).reshape(B, L, H, D)
+        v = self.cross_attn.v(context).reshape(B, L, H, D)
+        attn = t_attention(q, k, v).reshape(B, S, -1)
+        x = x + self.cross_attn.o(attn)
+
+        # FFN, modulated + gated
+        h = ln_plain(x) * (1 + scale2) + shift2
+        return x + gate2 * self.ffn(h)
+
+
+def _wan_block_params(sd, t):
+    """The per-block mapping of convert_wan_checkpoint (same helpers, same keys)."""
+    return {
+        "self_q": _dense(sd, f"{t}.self_attn.q"),
+        "self_k": _dense(sd, f"{t}.self_attn.k"),
+        "self_v": _dense(sd, f"{t}.self_attn.v"),
+        "self_o": _dense(sd, f"{t}.self_attn.o"),
+        "self_q_norm": _rms(sd, f"{t}.self_attn.norm_q"),
+        "self_k_norm": _rms(sd, f"{t}.self_attn.norm_k"),
+        "cross_q": _dense(sd, f"{t}.cross_attn.q"),
+        "cross_k": _dense(sd, f"{t}.cross_attn.k"),
+        "cross_v": _dense(sd, f"{t}.cross_attn.v"),
+        "cross_o": _dense(sd, f"{t}.cross_attn.o"),
+        "cross_q_norm": _rms(sd, f"{t}.cross_attn.norm_q"),
+        "cross_k_norm": _rms(sd, f"{t}.cross_attn.norm_k"),
+        "norm3": _ln(sd, f"{t}.norm3"),
+        "ffn_in": _dense(sd, f"{t}.ffn.0"),
+        "ffn_out": _dense(sd, f"{t}.ffn.2"),
+        "modulation": sd[f"{t}.modulation"].numpy(),
+    }
+
+
+def test_wan_block_golden_parity():
+    torch.manual_seed(2)
+    tblk = TWanBlock(CFG.hidden_size, CFG.ffn_dim, CFG.num_heads).eval()
+    sd = {f"blocks.0.{k}": v.detach() for k, v in tblk.state_dict().items()}
+    params = _wan_block_params(sd, "blocks.0")
+
+    rng = np.random.default_rng(9)
+    B, S, L = 2, 24, 7
+    x = rng.normal(size=(B, S, CFG.hidden_size)).astype(np.float32)
+    ctx = rng.normal(size=(B, L, CFG.hidden_size)).astype(np.float32)
+    e = rng.normal(size=(B, 6, CFG.hidden_size)).astype(np.float32)
+    ids = rng.integers(0, 4, size=(B, S, 3))
+    axes = (4, 6, 6)  # sums to head_dim 16
+
+    t_cos, t_sin = t_rope_freqs(torch.from_numpy(ids), axes, 10000.0)
+    with torch.no_grad():
+        want = tblk(
+            torch.from_numpy(x), torch.from_numpy(ctx), torch.from_numpy(e),
+            t_cos, t_sin,
+        ).numpy()
+
+    from comfyui_parallelanything_tpu.ops.rope import axis_rope_freqs
+
+    cos, sin = axis_rope_freqs(jnp.asarray(ids), axes, 10000.0)
+    got = np.asarray(
+        WanBlock(CFG).apply(
+            {"params": jax.tree.map(jnp.asarray, params)},
+            jnp.asarray(x), jnp.asarray(ctx), jnp.asarray(e), (cos, sin),
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)

@@ -8,8 +8,10 @@ import pytest
 
 from comfyui_parallelanything_tpu.sampling import (
     SAMPLERS,
+    SCHEDULER_NAMES,
     EpsDenoiser,
     karras_sigmas,
+    make_sigmas,
     sampling_sigmas,
     sample_dpmpp_2m,
     sample_euler,
@@ -38,6 +40,52 @@ class TestSchedules:
     def test_model_sigmas_monotonic(self):
         table = np.asarray(model_sigmas(scaled_linear_schedule()))
         assert np.all(np.diff(table) > 0)
+
+    @pytest.mark.parametrize("name", SCHEDULER_NAMES)
+    def test_every_scheduler_descends_to_zero(self, name):
+        # Shared contract of the whole KSampler menu: (n+1,) sigmas, strictly
+        # descending over the nonzero part, terminated by exactly 0, starting
+        # at the model's sigma_max.
+        acp = scaled_linear_schedule()
+        sig = np.asarray(make_sigmas(name, 12, acp))
+        table = np.asarray(model_sigmas(acp))
+        assert len(sig) == 13
+        assert sig[-1] == 0.0
+        assert np.all(np.diff(sig[:-1]) < 0), f"{name}: {sig}"
+        assert sig[0] == pytest.approx(float(table[-1]), rel=1e-4)
+
+    def test_sgm_uniform_is_trailing(self):
+        # The sgm spacing drops the final uniform point: its last nonzero sigma
+        # sits a full stride above sigma_min, unlike "normal".
+        acp = scaled_linear_schedule()
+        normal = np.asarray(make_sigmas("normal", 10, acp))
+        sgm = np.asarray(make_sigmas("sgm_uniform", 10, acp))
+        assert sgm[-2] > normal[-2] * 5
+
+    def test_beta_denser_at_ends(self):
+        # Beta(0.6, 0.6) quantiles cluster TIMESTEPS at both schedule ends (the
+        # sigma table's nonlinearity hides this in sigma space, so recover the
+        # timestep of each emitted sigma from the table and compare strides).
+        acp = scaled_linear_schedule()
+        table = np.asarray(model_sigmas(acp))
+        sig = np.asarray(make_sigmas("beta", 20, acp))[:-1]
+        ts = np.array([int(np.abs(table - s).argmin()) for s in sig])
+        strides = -np.diff(ts)
+        assert strides[0] < strides[len(strides) // 2]
+        assert strides[-1] < strides[len(strides) // 2]
+
+    def test_beta_high_step_count_has_no_duplicates(self):
+        # At >=150 steps the rounded Beta quantiles collide at the schedule ends;
+        # the reference skips repeated timesteps — a repeated sigma would
+        # divide-by-zero the multistep samplers (lms, dpm++ 2m sde).
+        acp = scaled_linear_schedule()
+        for n in (150, 250):
+            sig = np.asarray(make_sigmas("beta", n, acp))
+            assert np.all(np.diff(sig[:-1]) < 0), f"duplicate sigmas at {n} steps"
+
+    def test_unknown_scheduler_raises(self):
+        with pytest.raises(ValueError, match="unknown scheduler"):
+            make_sigmas("cosine", 10)
 
 
 def _linear_eps_model(true_x0):

@@ -64,6 +64,17 @@ class TestFlashAttention:
         got = flash_attention(q, k, v, interpret=True)
         assert got.shape == (2, 32, 4, 16)
 
+    def test_streamed_kv_block_invariance(self):
+        # The k-block grid dimension streams K/V through VMEM; the result must be
+        # independent of how the key sequence is tiled (VMEM stays O(block_k) even
+        # at video lengths — the whole point of the streamed layout).
+        q, k, v = _qkv(b=1, sq=128, sk=1000, h=1, d=32)
+        fine = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+        coarse = flash_attention(q, k, v, block_q=128, block_k=512, interpret=True)
+        want = _xla_attention(q, k, v, scale=32**-0.5)
+        np.testing.assert_allclose(np.asarray(fine), np.asarray(want), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(coarse), np.asarray(want), rtol=2e-4, atol=2e-4)
+
     def test_bf16(self):
         q, k, v = _qkv(b=1, sq=64, sk=64, h=1, d=32)
         q, k, v = (a.astype(jnp.bfloat16) for a in (q, k, v))

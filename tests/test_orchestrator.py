@@ -166,6 +166,71 @@ class TestSetupSemantics:
         with pytest.raises(TypeError):
             parallelize(42, even_chain(2))
 
+    def test_rebalance_shifts_weights_after_memory_change(self, toy, monkeypatch):
+        # Parity (deferred): the reference re-reads free VRAM every step and blends
+        # 0.7*user + 0.3*mem (737-766, 1317-1322); here rebalance() does the same
+        # on demand between sampler runs.
+        from comfyui_parallelanything_tpu.parallel import orchestrator as orch
+
+        apply_fn, params = toy
+        pm = parallelize((apply_fn, params), even_chain(4))
+        assert pm.weights == (0.25, 0.25, 0.25, 0.25)
+        # Synthetic memory pressure: devices 2/3 report half the free bytes.
+        fake = {0: 8 << 30, 1: 8 << 30, 2: 4 << 30, 3: 4 << 30}
+        monkeypatch.setattr(orch, "free_memory_bytes", lambda d: fake[d.id])
+        new = pm.rebalance()
+        np.testing.assert_allclose(sum(new), 1.0, rtol=1e-6)
+        np.testing.assert_allclose(new[0], 0.7 * 0.25 + 0.3 * (8 / 24), rtol=1e-6)
+        np.testing.assert_allclose(new[2], 0.7 * 0.25 + 0.3 * (4 / 24), rtol=1e-6)
+        assert pm._pipeline_runner is None  # stage placement re-balances lazily
+        # Blend is against the ORIGINAL user weights — a second rebalance with the
+        # same readings is a fixed point, not a compounding drift.
+        again = pm.rebalance()
+        np.testing.assert_allclose(again, new, rtol=1e-6)
+        # Execution stays correct after the shift.
+        x, t, c = _inputs(8)
+        got = pm(x, t, c)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(apply_fn(params, x, t, c)), rtol=1e-5, atol=1e-6
+        )
+
+    def test_reentrant_rewrap(self, toy):
+        # Parity: setup_parallel on an already-parallel model tears down the old
+        # setup and rebuilds with the new chain (any_device_parallel.py:1006-1013).
+        apply_fn, params = toy
+        pm = parallelize((apply_fn, params), even_chain(8))
+        x, t, c = _inputs(16)
+        pm(x, t, c)
+        old_groups = pm._groups
+        pm2 = parallelize(pm, even_chain(4))
+        # Old wrapper was torn down...
+        assert not pm.active
+        assert all(g.params is None for g in old_groups)
+        # ...and the new one routes over the new chain with correct results.
+        assert isinstance(pm2, ParallelModel)
+        assert pm2.devices == ("cpu:0", "cpu:1", "cpu:2", "cpu:3")
+        assert pm2.active
+        got = pm2(x, t, c)
+        want = apply_fn(params, x, t, c)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+        assert len(got.sharding.device_set) == 4
+
+    def test_reentrant_rewrap_unusable_chain_returns_torn_down_model(self, toy):
+        # Reference ordering: the re-entrancy teardown (1006-1013) runs before the
+        # weight-normalization abort (1019-1027) — an unusable new chain still
+        # leaves the previous setup torn down, and the model keeps working via the
+        # single-device path.
+        apply_fn, params = toy
+        pm = parallelize((apply_fn, params), even_chain(8))
+        out = parallelize(pm, [("cpu:0", 0.0)])
+        assert out is pm
+        assert not pm.active
+        x, t, c = _inputs(4)
+        got = pm(x, t, c)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(apply_fn(params, x, t, c)), rtol=1e-5, atol=1e-6
+        )
+
     def test_cleanup(self, toy):
         apply_fn, params = toy
         pm = parallelize((apply_fn, params), even_chain(4))
