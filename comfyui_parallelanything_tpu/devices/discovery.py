@@ -55,14 +55,20 @@ def available_devices() -> list[str]:
         plat = dev.platform
         if plat == "cpu":
             continue
+        # Canonical spelling: TPU-class devices (incl. the tunneled 'axon'
+        # plugin) are always listed as tpu:N, so saved chains stay portable and
+        # dedup/grouping sees one platform per chip.
+        if plat in TPU_PLATFORMS:
+            plat = "tpu"
         seen_platforms.add(plat)
         out.append(f"{plat}:{dev.id}")
     # Non-default accelerator backends (e.g. tpu present but cpu is default platform).
-    for plat in ("tpu", "gpu"):
-        if plat in seen_platforms:
-            continue
-        for dev in _platform_devices(plat):
-            out.append(f"{plat}:{dev.id}")
+    if "tpu" not in seen_platforms:
+        for dev in _tpu_class_devices():
+            out.append(f"tpu:{dev.id}")
+    if "gpu" not in seen_platforms:
+        for dev in _platform_devices("gpu"):
+            out.append(f"gpu:{dev.id}")
     out.append("cpu")
     return out
 

@@ -17,9 +17,11 @@ stripped):
   JointBlock(pre_only=True).
 - ``final_layer.adaLN_modulation.1`` → ``final_mod``; ``final_layer.linear`` →
   ``final_proj``.
-
-Not covered: SD3.5-medium's dual-attention x-blocks (``attn2``) — conversion
-raises with a clear message rather than silently dropping weights.
+- SD3.5-medium (mmdit-x) dual attention: ``joint_blocks.{i}.x_block.attn2`` →
+  ``x_attn_in2`` (qkv + ln_q/ln_k) and ``attn2.proj`` → ``x_attn2_proj``. The
+  set of dual-attention layers and the presence of q/k RMS norms are inferred
+  from the state dict and MUST match the config — a mismatch raises rather than
+  silently dropping weights (``load_mmdit_checkpoint`` auto-aligns both).
 """
 
 from __future__ import annotations
@@ -72,10 +74,32 @@ def convert_mmdit_checkpoint(
     """SAI/ComfyUI MMDiT state dict → the ``MMDiTModel`` param pytree (pass to
     ``build_mmdit(cfg, params=...)``)."""
     sd = strip_mmdit_prefix(state_dict)
-    if any(".attn2." in k for k in sd):
+    # SD3.5-medium (mmdit-x) dual attention: which blocks carry attn2 is a fact
+    # of the checkpoint — infer it and demand the config agree, so a silently
+    # wrong config cannot drop weights.
+    attn2_layers = tuple(sorted(
+        int(k.split(".")[1])
+        for k in sd
+        if k.startswith("joint_blocks.") and k.endswith(".x_block.attn2.qkv.weight")
+    ))
+    if attn2_layers != tuple(cfg.x_block_self_attn_layers):
         raise ValueError(
-            "this checkpoint uses SD3.5-medium dual-attention blocks (attn2), "
-            "which models/mmdit.py does not implement yet"
+            f"checkpoint has dual-attention (attn2) blocks at layers "
+            f"{list(attn2_layers)} but cfg.x_block_self_attn_layers is "
+            f"{list(cfg.x_block_self_attn_layers)} — build the config with "
+            "x_block_self_attn_layers matching the checkpoint "
+            "(sd35_medium_config for the published SD3.5-medium)"
+        )
+    # Same strictness for q/k RMS norm: a qk_norm=False config would silently
+    # drop every ln_q/ln_k weight an SD3.5 checkpoint carries.
+    has_qk_norm = any(
+        k.startswith("joint_blocks.") and k.endswith(".attn.ln_q.weight") for k in sd
+    )
+    if has_qk_norm != cfg.qk_norm:
+        raise ValueError(
+            f"checkpoint {'has' if has_qk_norm else 'lacks'} q/k RMS-norm weights "
+            f"(attn.ln_q/ln_k) but cfg.qk_norm is {cfg.qk_norm} — use the SD3.5 "
+            "configs for SD3.5 checkpoints"
         )
 
     w = to_numpy(sd["x_embedder.proj.weight"])  # (dim, C, p, p)
@@ -112,6 +136,9 @@ def convert_mmdit_checkpoint(
             "ctx_adaln": {"lin": _dense(sd, f"{cb}.adaLN_modulation.1")},
             "ctx_attn_in": _attn_in(sd, f"{cb}.attn", cfg),
         }
+        if i in attn2_layers:
+            blk["x_attn_in2"] = _attn_in(sd, f"{xb}.attn2", cfg)
+            blk["x_attn2_proj"] = _dense(sd, f"{xb}.attn2.proj")
         if i != cfg.depth - 1:  # pre-only final context block has no out path
             blk["ctx_attn_proj"] = _dense(sd, f"{cb}.attn.proj")
             blk["ctx_mlp_in"] = _dense(sd, f"{cb}.mlp.fc1")

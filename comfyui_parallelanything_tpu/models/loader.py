@@ -243,4 +243,31 @@ def load_mmdit_checkpoint(src: Any, cfg, lora: Any = None,
 
     sd = strip_mmdit_prefix(_resolve_state_dict(src))
     sd = _maybe_bake(sd, lora, lora_strength)
+    # Dual-attention layout (SD3.5-medium mmdit-x) and q/k RMS norm presence are
+    # facts of the checkpoint — align the config to what the state dict actually
+    # contains so a caller passing a generic config still loads correctly (the
+    # converter itself stays strict on both).
+    attn2_layers = tuple(sorted(
+        int(k.split(".")[1])
+        for k in sd
+        if k.startswith("joint_blocks.") and k.endswith(".x_block.attn2.qkv.weight")
+    ))
+    has_qk_norm = any(
+        k.startswith("joint_blocks.") and k.endswith(".attn.ln_q.weight") for k in sd
+    )
+    if (
+        attn2_layers != tuple(cfg.x_block_self_attn_layers)
+        or has_qk_norm != cfg.qk_norm
+    ):
+        import dataclasses
+
+        from ..utils.logging import get_logger
+
+        get_logger().info(
+            "aligning MMDiT config to checkpoint: dual-attention layers %s, "
+            "qk_norm=%s", list(attn2_layers), has_qk_norm,
+        )
+        cfg = dataclasses.replace(
+            cfg, x_block_self_attn_layers=attn2_layers, qk_norm=has_qk_norm
+        )
     return build_mmdit(cfg, name=name, params=convert_mmdit_checkpoint(sd, cfg))

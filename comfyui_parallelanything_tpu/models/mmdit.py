@@ -39,6 +39,10 @@ class MMDiTConfig:
     pos_embed_max: int = 192       # checkpoint pos table is (max², hidden), cropped
     mlp_ratio: float = 4.0
     qk_norm: bool = False          # SD3.5 adds per-head q/k RMS norm
+    # SD3.5-medium (mmdit-x): block indices with a SECOND self-attention over the
+    # x stream only (dual attention). The converter infers this from which
+    # joint_blocks.{i}.x_block.attn2 keys exist in the checkpoint.
+    x_block_self_attn_layers: tuple[int, ...] = ()
     dtype: Any = jnp.bfloat16
 
     @property
@@ -62,6 +66,19 @@ def sd3_medium_config(**overrides) -> MMDiTConfig:
 def sd35_large_config(**overrides) -> MMDiTConfig:
     """SD3.5-large (8B): depth 38, q/k RMS norm."""
     base = MMDiTConfig(depth=38, qk_norm=True)
+    return dataclasses.replace(base, **overrides)
+
+
+def sd35_medium_config(**overrides) -> MMDiTConfig:
+    """SD3.5-medium (2.5B, mmdit-x): depth 24, q/k RMS norm, dual attention in
+    the first 13 blocks (the published checkpoint's x_block_self_attn_layers —
+    convert_mmdit_checkpoint re-infers the exact set from the state dict)."""
+    base = MMDiTConfig(
+        depth=24,
+        qk_norm=True,
+        pos_embed_max=384,
+        x_block_self_attn_layers=tuple(range(13)),
+    )
     return dataclasses.replace(base, **overrides)
 
 
@@ -147,14 +164,24 @@ class JointBlock(nn.Module):
 
     cfg: MMDiTConfig
     pre_only: bool = False
+    dual_attn: bool = False
 
     @nn.compact
     def __call__(self, x, ctx, vec):
         cfg = self.cfg
         mlp_dim = int(cfg.hidden_size * cfg.mlp_ratio)
 
-        x_mods = _AdaLN(cfg, 6, name="x_adaln")(vec)
-        (xs1, xc1, xg1, xs2, xc2, xg2) = x_mods
+        if self.dual_attn:
+            # mmdit-x (SD3.5-medium): 9-chunk x-side adaLN — the extra triple
+            # modulates a SECOND self-attention over the x stream alone, fed from
+            # the same pre-norm output (SAI chunk order: attn, mlp, attn2).
+            (xs1, xc1, xg1, xs2, xc2, xg2, x2s, x2c, x2g) = _AdaLN(
+                cfg, 9, name="x_adaln"
+            )(vec)
+            _, q2, k2, v2 = _StreamAttnIn(cfg, name="x_attn_in2")(x, x2s, x2c)
+        else:
+            x_mods = _AdaLN(cfg, 6, name="x_adaln")(vec)
+            (xs1, xc1, xg1, xs2, xc2, xg2) = x_mods
         _, xq, xk, xv = _StreamAttnIn(cfg, name="x_attn_in")(x, xs1, xc1)
 
         if self.pre_only:
@@ -175,6 +202,12 @@ class JointBlock(nn.Module):
         x = x + xg1.astype(cfg.dtype) * nn.Dense(
             cfg.hidden_size, dtype=cfg.dtype, name="x_attn_proj"
         )(x_attn)
+        if self.dual_attn:
+            attn2 = attention(q2, k2, v2)
+            attn2 = attn2.reshape(attn2.shape[0], attn2.shape[1], -1)
+            x = x + x2g.astype(cfg.dtype) * nn.Dense(
+                cfg.hidden_size, dtype=cfg.dtype, name="x_attn2_proj"
+            )(attn2)
         xm = nn.LayerNorm(
             use_bias=False, use_scale=False, epsilon=1e-6, dtype=cfg.dtype,
             name="x_norm2",
@@ -234,7 +267,11 @@ class MMDiTModel(nn.Module):
         self.time_in = _VecEmbedder(cfg)
         self.vector_in = _VecEmbedder(cfg)
         self.blocks = [
-            JointBlock(cfg, pre_only=(i == cfg.depth - 1))
+            JointBlock(
+                cfg,
+                pre_only=(i == cfg.depth - 1),
+                dual_attn=(i in cfg.x_block_self_attn_layers),
+            )
             for i in range(cfg.depth)
         ]
         self.final_mod = nn.Dense(2 * cfg.hidden_size, dtype=jnp.float32)

@@ -271,7 +271,7 @@ class ParallelAnythingAdvanced(ParallelAnything):
 # ---------------------------------------------------------------------------
 
 _MODEL_FAMILIES = (
-    "sd15", "sd21", "sd21-v", "sdxl", "sd3-medium", "sd35-large",
+    "sd15", "sd21", "sd21-v", "sdxl", "sd3-medium", "sd35-medium", "sd35-large",
     "flux-dev", "flux-schnell", "zimage-turbo", "wan-1.3b", "wan-14b",
 )
 
@@ -355,18 +355,20 @@ class TPUCheckpointLoader:
         if family == "sd15":
             model = load_sd_unet_checkpoint(sd, sd15_config(), lora, lora_strength)
             vae_cfg = sd_vae_config()
-        elif family in ("sd3-medium", "sd35-large"):
+        elif family in ("sd3-medium", "sd35-medium", "sd35-large"):
             from .models import (
                 load_mmdit_checkpoint,
                 sd3_medium_config,
                 sd3_vae_config,
                 sd35_large_config,
+                sd35_medium_config,
             )
 
-            mcfg = (
-                sd35_large_config() if family == "sd35-large"
-                else sd3_medium_config()
-            )
+            mcfg = {
+                "sd35-large": sd35_large_config,
+                "sd35-medium": sd35_medium_config,
+                "sd3-medium": sd3_medium_config,
+            }[family]()
             model = load_mmdit_checkpoint(sd, mcfg, lora, lora_strength)
             vae_cfg = sd3_vae_config()
         elif family in ("sd21", "sd21-v"):

@@ -152,6 +152,7 @@ class StableDiffusionPipeline:
         rng=None,
         sampler: str = "dpmpp_2m",
         karras: bool = True,
+        scheduler: str | None = None,
         callback=None,
         init_image: jnp.ndarray | None = None,
         denoise: float = 1.0,
@@ -214,6 +215,7 @@ class StableDiffusionPipeline:
             uncond_kwargs=uncond_kwargs,
             rng=rng,
             karras=karras,
+            scheduler=scheduler,
             callback=callback,
             **kwargs,
         )

@@ -113,7 +113,9 @@ def beta_sigmas(
     so the result may be shorter than ``n_steps + 1`` — a repeated sigma would
     divide-by-zero the multistep samplers (lms, dpm++ sde)."""
     table = _sigma_table(alphas_cumprod)
-    ts = 1.0 - np.linspace(0.0, 1.0, n_steps, dtype=np.float64)
+    # endpoint=False matches the reference scheduler: quantiles stop one stride
+    # above q=0, so the last nonzero sigma sits above sigma_min.
+    ts = 1.0 - np.linspace(0.0, 1.0, n_steps, endpoint=False, dtype=np.float64)
     idx = np.rint(_beta_ppf(ts, alpha, beta) * (len(table) - 1)).astype(np.int64)
     keep = np.concatenate([[True], np.diff(idx) != 0])
     sig = table[jnp.asarray(idx[keep], jnp.int32)]

@@ -70,13 +70,16 @@ class TMlp(tnn.Module):
 
 
 class TStreamBlock(tnn.Module):
-    def __init__(self, h, heads, mlp_dim, pre_only=False):
+    def __init__(self, h, heads, mlp_dim, pre_only=False, dual=False):
         super().__init__()
         self.heads = heads
         self.pre_only = pre_only
-        n_mods = 2 if pre_only else 6
+        self.dual = dual
+        n_mods = 2 if pre_only else (9 if dual else 6)
         self.adaLN_modulation = tnn.Sequential(tnn.SiLU(), tnn.Linear(h, n_mods * h))
         self.attn = TAttn(h, h // heads, pre_only)
+        if dual:
+            self.attn2 = TAttn(h, h // heads)
         if not pre_only:
             self.mlp = TMlp(h, mlp_dim)
 
@@ -101,7 +104,19 @@ def _qkv_heads(blk, x, heads, shift, scale):
 
 def t_joint_block(xb, cb, x, ctx, vec, heads, pre_only):
     h = x.shape[-1]
-    xs1, xc1, xg1, xs2, xc2, xg2 = _mods(xb, vec, 6)
+    if xb.dual:
+        # SAI mmdit-x 9-chunk order: attn triple, mlp triple, attn2 triple; both
+        # attention inputs modulate the SAME pre-norm output.
+        xs1, xc1, xg1, xs2, xc2, xg2, x2s, x2c, x2g = _mods(xb, vec, 9)
+        b, s, _ = x.shape
+        d = h // heads
+        h2 = _ln(x, h).float() * (1 + x2c) + x2s
+        qkv2 = xb.attn2.qkv(h2).reshape(b, s, 3, heads, d)
+        q2 = xb.attn2.ln_q(qkv2[:, :, 0])
+        k2 = xb.attn2.ln_k(qkv2[:, :, 1])
+        v2 = qkv2[:, :, 2]
+    else:
+        xs1, xc1, xg1, xs2, xc2, xg2 = _mods(xb, vec, 6)
     _, xq, xk, xv = _qkv_heads(xb, x, heads, xs1, xc1)
     if pre_only:
         cs1, cc1 = _mods(cb, vec, 2)
@@ -117,6 +132,9 @@ def t_joint_block(xb, cb, x, ctx, vec, heads, pre_only):
     ctx_a, x_a = attn[:, :ctx_len], attn[:, ctx_len:]
 
     x = x + xg1 * xb.attn.proj(x_a)
+    if xb.dual:
+        a2 = t_attention(q2, k2, v2).reshape(q2.shape[0], q2.shape[1], -1)
+        x = x + x2g * xb.attn2.proj(a2)
     x = x + xg2 * xb.mlp(_ln(x, h).float() * (1 + xc2) + xs2)
     if pre_only:
         return x, ctx
@@ -125,7 +143,7 @@ def t_joint_block(xb, cb, x, ctx, vec, heads, pre_only):
     return x, ctx
 
 
-def _block_params(sd, i, pre_only):
+def _block_params(sd, i, pre_only, dual=False):
     xb = f"joint_blocks.{i}.x_block"
     cb = f"joint_blocks.{i}.context_block"
     blk = {
@@ -137,6 +155,9 @@ def _block_params(sd, i, pre_only):
         "ctx_adaln": {"lin": _dense(sd, f"{cb}.adaLN_modulation.1")},
         "ctx_attn_in": _attn_in(sd, f"{cb}.attn", CFG),
     }
+    if dual:
+        blk["x_attn_in2"] = _attn_in(sd, f"{xb}.attn2", CFG)
+        blk["x_attn2_proj"] = _dense(sd, f"{xb}.attn2.proj")
     if not pre_only:
         blk["ctx_attn_proj"] = _dense(sd, f"{cb}.attn.proj")
         blk["ctx_mlp_in"] = _dense(sd, f"{cb}.mlp.fc1")
@@ -174,3 +195,49 @@ def test_joint_block_golden_parity(pre_only):
     )
     np.testing.assert_allclose(np.asarray(got_x), w_x.numpy(), rtol=5e-4, atol=5e-4)
     np.testing.assert_allclose(np.asarray(got_ctx), w_ctx.numpy(), rtol=5e-4, atol=5e-4)
+
+
+def test_dual_attention_block_golden_parity():
+    # SD3.5-medium mmdit-x: second self-attention over the x stream, 9-chunk
+    # x-side adaLN, fed from the same pre-norm output.
+    torch.manual_seed(6)
+    mlp_dim = int(H_ * CFG.mlp_ratio)
+    xb = TStreamBlock(H_, CFG.num_heads, mlp_dim, dual=True).eval()
+    cb = TStreamBlock(H_, CFG.num_heads, mlp_dim).eval()
+    sd = {f"joint_blocks.0.x_block.{k}": v.detach() for k, v in xb.state_dict().items()}
+    sd.update(
+        {f"joint_blocks.0.context_block.{k}": v.detach()
+         for k, v in cb.state_dict().items()}
+    )
+    params = _block_params(sd, 0, pre_only=False, dual=True)
+
+    rng = np.random.default_rng(23)
+    B, S, L = 2, 12, 6
+    x = rng.normal(size=(B, S, H_)).astype(np.float32)
+    ctx = rng.normal(size=(B, L, H_)).astype(np.float32)
+    vec = rng.normal(size=(B, H_)).astype(np.float32)
+
+    with torch.no_grad():
+        w_x, w_ctx = t_joint_block(
+            xb, cb, torch.from_numpy(x), torch.from_numpy(ctx),
+            torch.from_numpy(vec), CFG.num_heads, pre_only=False,
+        )
+    got_x, got_ctx = JointBlock(CFG, pre_only=False, dual_attn=True).apply(
+        {"params": jax.tree.map(jnp.asarray, params)},
+        jnp.asarray(x), jnp.asarray(ctx), jnp.asarray(vec),
+    )
+    np.testing.assert_allclose(np.asarray(got_x), w_x.numpy(), rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(got_ctx), w_ctx.numpy(), rtol=5e-4, atol=5e-4)
+
+
+def test_converter_infers_dual_attention_layers():
+    # The converter must refuse a config that disagrees with the checkpoint's
+    # actual attn2 layout (silently dropping weights is the failure this guards).
+    from comfyui_parallelanything_tpu.models.convert_mmdit import (
+        convert_mmdit_checkpoint,
+    )
+
+    torch.manual_seed(8)
+    sd = {"joint_blocks.0.x_block.attn2.qkv.weight": torch.randn(3 * H_, H_)}
+    with pytest.raises(ValueError, match="x_block_self_attn_layers"):
+        convert_mmdit_checkpoint(sd, CFG)  # CFG declares no dual layers

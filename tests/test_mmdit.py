@@ -120,6 +120,9 @@ def _official_layout_sd(cfg: MMDiTConfig, params) -> dict:
         _inv_dense(blk["x_attn_proj"], f"{xb}.attn.proj", sd)
         _inv_dense(blk["x_mlp_in"], f"{xb}.mlp.fc1", sd)
         _inv_dense(blk["x_mlp_out"], f"{xb}.mlp.fc2", sd)
+        if "x_attn_in2" in blk:  # SD3.5-medium dual attention
+            _inv_qkv(blk["x_attn_in2"], f"{xb}.attn2", sd, cfg)
+            _inv_dense(blk["x_attn2_proj"], f"{xb}.attn2.proj", sd)
         _inv_dense(blk["ctx_adaln"]["lin"], f"{cb}.adaLN_modulation.1", sd)
         _inv_qkv(blk["ctx_attn_in"], f"{cb}.attn", sd, cfg)
         if "ctx_attn_proj" in blk:
@@ -155,11 +158,38 @@ class TestConverter:
             rtol=1e-6, atol=1e-6,
         )
 
-    def test_dual_attention_rejected(self, tiny_mmdit):
+    def test_dual_attention_config_mismatch_rejected(self, tiny_mmdit):
         sd = _official_layout_sd(TINY, tiny_mmdit.params)
         sd["joint_blocks.0.x_block.attn2.qkv.weight"] = np.zeros((1, 1))
-        with pytest.raises(ValueError, match="dual-attention"):
+        with pytest.raises(ValueError, match="x_block_self_attn_layers"):
             convert_mmdit_checkpoint(sd, TINY)
+
+    def test_dual_attention_round_trip_and_loader_alignment(self):
+        # SD3.5-medium (mmdit-x): dual-attention layers survive synthesis →
+        # conversion bitwise, and the loader aligns a generic config to the
+        # checkpoint's actual attn2 layout.
+        import dataclasses
+
+        from comfyui_parallelanything_tpu.models.loader import load_mmdit_checkpoint
+
+        cfg = dataclasses.replace(TINY, x_block_self_attn_layers=(0,))
+        model = build_mmdit(cfg, jax.random.key(3), sample_shape=(1, 8, 8, 4),
+                            txt_len=6)
+        sd = _official_layout_sd(cfg, model.params)
+        converted = convert_mmdit_checkpoint(sd, cfg)
+        ref = dict(flatten_tree(model.params))
+        got = dict(flatten_tree(converted))
+        assert set(ref) == set(got), set(ref) ^ set(got)
+        assert any("x_attn_in2" in k for k in got)
+        # Loader with the NON-dual generic config still loads it correctly.
+        m2 = load_mmdit_checkpoint(sd, TINY)
+        x = jax.random.normal(jax.random.key(6), (1, 8, 8, 4))
+        c = jax.random.normal(jax.random.key(7), (1, 6, 32))
+        np.testing.assert_allclose(
+            np.asarray(m2(x, jnp.array([0.7]), c)),
+            np.asarray(model(x, jnp.array([0.7]), c)),
+            rtol=1e-6, atol=1e-6,
+        )
 
 
 class TestSd3Conditioning:

@@ -64,6 +64,15 @@ class TestStableDiffusionPipeline:
             np.asarray(sd_pipe("hello", **kw)), np.asarray(sd_pipe("hello", **kw))
         )
 
+    def test_scheduler_menu_reaches_pipeline(self, sd_pipe):
+        # The Python pipeline API exposes the same scheduler menu as the node
+        # graph (shared run_sampler dispatch — they must not drift apart).
+        kw = dict(steps=2, cfg_scale=1.0, height=16, width=16, rng=jax.random.key(7))
+        base = np.asarray(sd_pipe("hello", scheduler="karras", **kw))
+        sgm = np.asarray(sd_pipe("hello", scheduler="sgm_uniform", **kw))
+        assert np.isfinite(sgm).all()
+        assert not np.allclose(base, sgm)  # different sigma spacing, different image
+
     def test_cfg_changes_output(self, sd_pipe):
         kw = dict(steps=2, height=16, width=16, rng=jax.random.key(7))
         base = np.asarray(sd_pipe("hello", cfg_scale=1.0, **kw))
