@@ -122,7 +122,37 @@ def beta_sigmas(
     return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
 
 
-SCHEDULER_NAMES = ("karras", "normal", "exponential", "sgm_uniform", "simple", "beta")
+def ddim_uniform_sigmas(
+    n_steps: int, alphas_cumprod: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """ComfyUI ``ddim_uniform``: the DDIM stride — table entries at indices
+    ``1, 1+T//n, 1+2·T//n, … (< T)`` (integer stride, so the realized step count
+    can differ slightly from ``n_steps``), descending."""
+    table = _sigma_table(alphas_cumprod)
+    T = len(table)
+    stride = max(1, T // n_steps)
+    idx = list(range(1, T, stride))
+    sig = table[jnp.asarray(list(reversed(idx)), jnp.int32)]
+    return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
+
+
+def kl_optimal_sigmas(
+    n_steps: int, alphas_cumprod: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """"Align Your Steps" KL-optimal spacing (arXiv:2404.14507):
+    σᵢ = tan((1−i/(n−1))·atan(σ_max) + (i/(n−1))·atan(σ_min)) — inclusive
+    interpolation, so the last nonzero sigma is exactly σ_min."""
+    table = _sigma_table(alphas_cumprod)
+    sigma_min, sigma_max = jnp.float32(table[0]), jnp.float32(table[-1])
+    frac = jnp.linspace(0.0, 1.0, n_steps, dtype=jnp.float32)
+    sig = jnp.tan((1.0 - frac) * jnp.arctan(sigma_max) + frac * jnp.arctan(sigma_min))
+    return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
+
+
+SCHEDULER_NAMES = (
+    "karras", "normal", "exponential", "sgm_uniform", "simple", "ddim_uniform",
+    "beta", "kl_optimal",
+)
 
 
 def make_sigmas(
@@ -142,8 +172,12 @@ def make_sigmas(
         return sgm_uniform_sigmas(n_steps, alphas_cumprod)
     if scheduler == "simple":
         return simple_sigmas(n_steps, alphas_cumprod)
+    if scheduler == "ddim_uniform":
+        return ddim_uniform_sigmas(n_steps, alphas_cumprod)
     if scheduler == "beta":
         return beta_sigmas(n_steps, alphas_cumprod)
+    if scheduler == "kl_optimal":
+        return kl_optimal_sigmas(n_steps, alphas_cumprod)
     raise ValueError(
         f"unknown scheduler {scheduler!r} (have {', '.join(SCHEDULER_NAMES)})"
     )

@@ -150,6 +150,9 @@ def run_sampler(
     sched_name = scheduler if scheduler is not None else ("karras" if karras else "normal")
     sigmas = make_sigmas(sched_name, total, acp)
     if img2img:
+        # ddim_uniform's integer stride can realize a count slightly off the
+        # request; the host KSampler truncates the realized schedule the same
+        # way, so the tiny denoise-strength skew is reference-faithful.
         sigmas = sigmas[-(steps + 1) :]
     denoiser = EpsDenoiser(
         model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,

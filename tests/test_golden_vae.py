@@ -1,0 +1,235 @@
+"""VAE golden parity vs a minimal torch AutoencoderKL (ldm layout).
+
+Full-model activation comparison against a from-scratch torch implementation of
+the public kl-f8 autoencoder design: GroupNorm(eps=1e-6)+SiLU resnet blocks,
+single-head 1×1-conv spatial attention in the mid block, asymmetric (0,1)×(0,1)
+stride-2 downsampling, nearest-×2 upsampling, and quant/post-quant 1×1 convs.
+Exported in the official ``encoder.down.{l}.block.{i}`` / ``decoder.up...`` key
+layout and converted with ``convert_vae.py`` — the architecture-level check that
+round-trip inversion cannot provide (wrong pad side or norm order would survive a
+round trip; it cannot survive this).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.models.convert_vae import convert_vae_checkpoint
+from comfyui_parallelanything_tpu.models.vae import AutoencoderKL, VAEConfig
+
+torch = pytest.importorskip("torch")
+tnn = torch.nn
+F = torch.nn.functional
+
+CFG = VAEConfig(
+    in_channels=3,
+    z_channels=4,
+    base_channels=32,
+    channel_mult=(1, 2),
+    num_res_blocks=1,
+    norm_groups=8,
+    scaling_factor=0.18215,
+    use_quant_conv=True,
+    dtype=jnp.float32,
+)
+
+
+def _gn(groups, ch):
+    return tnn.GroupNorm(groups, ch, eps=1e-6)
+
+
+class TResnetBlock(tnn.Module):
+    def __init__(self, in_ch, out_ch, groups):
+        super().__init__()
+        self.norm1 = _gn(groups, in_ch)
+        self.conv1 = tnn.Conv2d(in_ch, out_ch, 3, padding=1)
+        self.norm2 = _gn(groups, out_ch)
+        self.conv2 = tnn.Conv2d(out_ch, out_ch, 3, padding=1)
+        if in_ch != out_ch:
+            self.nin_shortcut = tnn.Conv2d(in_ch, out_ch, 1)
+
+    def forward(self, x):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = self.conv2(F.silu(self.norm2(h)))
+        if hasattr(self, "nin_shortcut"):
+            x = self.nin_shortcut(x)
+        return x + h
+
+
+class TAttnBlock(tnn.Module):
+    def __init__(self, ch, groups):
+        super().__init__()
+        self.norm = _gn(groups, ch)
+        self.q = tnn.Conv2d(ch, ch, 1)
+        self.k = tnn.Conv2d(ch, ch, 1)
+        self.v = tnn.Conv2d(ch, ch, 1)
+        self.proj_out = tnn.Conv2d(ch, ch, 1)
+
+    def forward(self, x):
+        h = self.norm(x)
+        q, k, v = self.q(h), self.k(h), self.v(h)
+        b, c, hh, ww = q.shape
+        q = q.reshape(b, c, hh * ww).permute(0, 2, 1)
+        k = k.reshape(b, c, hh * ww)
+        w = torch.softmax(torch.bmm(q, k) / np.sqrt(c), dim=-1)  # (b, hw_q, hw_k)
+        v = v.reshape(b, c, hh * ww)
+        h = torch.bmm(v, w.permute(0, 2, 1)).reshape(b, c, hh, ww)
+        return x + self.proj_out(h)
+
+
+class TDownsample(tnn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = tnn.Conv2d(ch, ch, 3, stride=2, padding=0)
+
+    def forward(self, x):
+        return self.conv(F.pad(x, (0, 1, 0, 1)))
+
+
+class TUpsample(tnn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = tnn.Conv2d(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2.0, mode="nearest"))
+
+
+class _Level(tnn.Module):
+    pass
+
+
+class _Mid(tnn.Module):
+    pass
+
+
+class TEncoder(tnn.Module):
+    def __init__(self, cfg: VAEConfig):
+        super().__init__()
+        g = cfg.norm_groups
+        chans = [cfg.base_channels * m for m in cfg.channel_mult]
+        self.conv_in = tnn.Conv2d(cfg.in_channels, cfg.base_channels, 3, padding=1)
+        self.down = tnn.ModuleList()
+        ch = cfg.base_channels
+        for level, out_ch in enumerate(chans):
+            lvl = _Level()
+            lvl.block = tnn.ModuleList()
+            for _ in range(cfg.num_res_blocks):
+                lvl.block.append(TResnetBlock(ch, out_ch, g))
+                ch = out_ch
+            if level != len(chans) - 1:
+                lvl.downsample = TDownsample(ch)
+            self.down.append(lvl)
+        self.mid = _Mid()
+        self.mid.block_1 = TResnetBlock(ch, ch, g)
+        self.mid.attn_1 = TAttnBlock(ch, g)
+        self.mid.block_2 = TResnetBlock(ch, ch, g)
+        self.norm_out = _gn(g, ch)
+        self.conv_out = tnn.Conv2d(ch, 2 * cfg.z_channels, 3, padding=1)
+
+    def forward(self, x):
+        h = self.conv_in(x)
+        for level, lvl in enumerate(self.down):
+            for blk in lvl.block:
+                h = blk(h)
+            if hasattr(lvl, "downsample"):
+                h = lvl.downsample(h)
+        h = self.mid.block_2(self.mid.attn_1(self.mid.block_1(h)))
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+
+class TDecoder(tnn.Module):
+    def __init__(self, cfg: VAEConfig):
+        super().__init__()
+        g = cfg.norm_groups
+        chans = [cfg.base_channels * m for m in cfg.channel_mult]
+        ch = chans[-1]
+        self.conv_in = tnn.Conv2d(cfg.z_channels, ch, 3, padding=1)
+        self.mid = _Mid()
+        self.mid.block_1 = TResnetBlock(ch, ch, g)
+        self.mid.attn_1 = TAttnBlock(ch, g)
+        self.mid.block_2 = TResnetBlock(ch, ch, g)
+        # ldm registers up levels in ascending index order but RUNS them reversed.
+        self.up = tnn.ModuleList()
+        up_levels = []
+        for level in reversed(range(len(chans))):
+            out_ch = chans[level]
+            lvl = _Level()
+            lvl.block = tnn.ModuleList()
+            for _ in range(cfg.num_res_blocks + 1):
+                lvl.block.append(TResnetBlock(ch, out_ch, g))
+                ch = out_ch
+            if level != 0:
+                lvl.upsample = TUpsample(ch)
+            up_levels.insert(0, lvl)
+        for lvl in up_levels:
+            self.up.append(lvl)
+        self.norm_out = _gn(g, chans[0])
+        self.conv_out = tnn.Conv2d(chans[0], cfg.in_channels, 3, padding=1)
+
+    def forward(self, z):
+        h = self.conv_in(z)
+        h = self.mid.block_2(self.mid.attn_1(self.mid.block_1(h)))
+        for level in reversed(range(len(self.up))):
+            lvl = self.up[level]
+            for blk in lvl.block:
+                h = blk(h)
+            if hasattr(lvl, "upsample"):
+                h = lvl.upsample(h)
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+
+class TAutoencoderKL(tnn.Module):
+    def __init__(self, cfg: VAEConfig):
+        super().__init__()
+        self.encoder = TEncoder(cfg)
+        self.decoder = TDecoder(cfg)
+        self.quant_conv = tnn.Conv2d(2 * cfg.z_channels, 2 * cfg.z_channels, 1)
+        self.post_quant_conv = tnn.Conv2d(cfg.z_channels, cfg.z_channels, 1)
+
+
+@pytest.fixture(scope="module")
+def pair():
+    torch.manual_seed(5)
+    tvae = TAutoencoderKL(CFG).eval()
+    sd = {k: v.detach() for k, v in tvae.state_dict().items()}
+    params = convert_vae_checkpoint(sd, CFG)
+    return tvae, params
+
+
+def test_encoder_moments_golden_parity(pair):
+    tvae, params = pair
+    rng = np.random.default_rng(31)
+    x = rng.uniform(-1, 1, size=(2, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        h = tvae.quant_conv(
+            tvae.encoder(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+        ).numpy().transpose(0, 2, 3, 1)
+    want_mean, want_logvar = np.split(h, 2, axis=-1)
+    mean, logvar = AutoencoderKL(CFG).apply(
+        {"params": params}, jnp.asarray(x), method=AutoencoderKL.moments
+    )
+    np.testing.assert_allclose(np.asarray(mean), want_mean, rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(
+        np.asarray(logvar), np.clip(want_logvar, -30, 20), rtol=5e-4, atol=5e-4
+    )
+
+
+def test_decoder_golden_parity(pair):
+    tvae, params = pair
+    rng = np.random.default_rng(33)
+    z_raw = rng.normal(size=(2, 4, 4, CFG.z_channels)).astype(np.float32)
+    with torch.no_grad():
+        want = tvae.decoder(
+            tvae.post_quant_conv(torch.from_numpy(z_raw.transpose(0, 3, 1, 2)))
+        ).numpy().transpose(0, 2, 3, 1)
+    # decode() applies the scaling factor first; feed it the scaled latent so the
+    # raw z entering post_quant_conv matches the torch path.
+    z_scaled = (z_raw - CFG.shift_factor) * CFG.scaling_factor
+    got = np.asarray(
+        AutoencoderKL(CFG).apply(
+            {"params": params}, jnp.asarray(z_scaled), method=AutoencoderKL.decode
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
