@@ -130,7 +130,12 @@ def ddim_uniform_sigmas(
     can differ slightly from ``n_steps``), descending."""
     table = _sigma_table(alphas_cumprod)
     T = len(table)
-    stride = max(1, T // n_steps)
+    stride = T // n_steps
+    if stride <= 1:
+        # Stride 1 would enumerate (nearly) the whole table regardless of the
+        # request; the reference falls back to uniform trailing spacing here so
+        # the realized count honors n_steps.
+        return sgm_uniform_sigmas(n_steps, alphas_cumprod)
     idx = list(range(1, T, stride))
     sig = table[jnp.asarray(list(reversed(idx)), jnp.int32)]
     return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])

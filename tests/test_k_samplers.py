@@ -93,6 +93,17 @@ class TestSchedules:
             sig = np.asarray(make_sigmas("beta", n, acp))
             assert np.all(np.diff(sig[:-1]) < 0), f"duplicate sigmas at {n} steps"
 
+    def test_ddim_uniform_high_step_count_honors_request(self):
+        # stride<=1 falls back to uniform trailing spacing — the realized count
+        # must track the request, not balloon to the table length. (In the
+        # integer-stride regime the reference-faithful overshoot remains, e.g.
+        # 400 requested -> stride 2 -> 500 realized.)
+        acp = scaled_linear_schedule()
+        for n in (600, 999):
+            sig = np.asarray(make_sigmas("ddim_uniform", n, acp))
+            assert len(sig) == n + 1, (n, len(sig))
+        assert len(np.asarray(make_sigmas("ddim_uniform", 400, acp))) == 501
+
     def test_unknown_scheduler_raises(self):
         with pytest.raises(ValueError, match="unknown scheduler"):
             make_sigmas("cosine", 10)
