@@ -160,18 +160,57 @@ def _build(config_name):
     return _RUNGS[config_name](jnp, jax.random.key(0))
 
 
+def _cost_flops(lowered):
+    """FLOPs from a Lowered's XLA HLO cost analysis, or None if unavailable."""
+    cost = lowered.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    flops = (cost or {}).get("flops")
+    return float(flops) if flops and flops > 0 else None
+
+
 def _flops_per_step(model, x, t, ctx, kwargs):
     """Analytic model FLOPs for one denoise step via XLA HLO cost analysis of the
     lowered (uncompiled) forward. Returns None when the backend can't estimate."""
     import jax
 
     try:
-        lowered = jax.jit(model.apply).lower(model.params, x, t, ctx, **kwargs)
-        cost = lowered.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else None
-        flops = (cost or {}).get("flops")
-        return float(flops) if flops and flops > 0 else None
+        return _cost_flops(
+            jax.jit(model.apply).lower(model.params, x, t, ctx, **kwargs)
+        )
+    except Exception:
+        return None
+
+
+def _full_flux_flops(batch, latent, ctx_len):
+    """Analytic FLOPs/step of the FULL 19/38-depth flux-dev at this rung's
+    shapes, from abstract (never-materialized) params — the analytic bridge from
+    the reduced-depth flux_16 measurement to the full model the BASELINE
+    north-star is defined on."""
+    import jax
+    import jax.numpy as jnp
+
+    from comfyui_parallelanything_tpu.models import flux_abstract_params, flux_dev_config
+    from comfyui_parallelanything_tpu.models.flux import FluxModel
+
+    try:
+        cfg = flux_dev_config(dtype=jnp.bfloat16)
+        module = FluxModel(cfg)
+        sds = flux_abstract_params(cfg, sample_shape=(1, 32, 32, 16), txt_len=ctx_len)
+        args = (
+            jax.ShapeDtypeStruct((batch, latent, latent, 16), jnp.float32),
+            jax.ShapeDtypeStruct((batch,), jnp.float32),
+            jax.ShapeDtypeStruct((batch, ctx_len, cfg.context_in_dim), jnp.float32),
+            jax.ShapeDtypeStruct((batch, cfg.vec_in_dim), jnp.float32),
+            jax.ShapeDtypeStruct((batch,), jnp.float32),
+        )
+        return _cost_flops(
+            jax.jit(
+                lambda p, x, t, c, y, g: module.apply(
+                    {"params": p}, x, t, c, y=y, guidance=g
+                )
+            ).lower(sds, *args)
+        )
     except Exception:
         return None
 
@@ -242,22 +281,26 @@ def run_inner() -> None:
         round(_REF_SINGLE_GPU_S_IT / sec_it, 2) if config_name == "zimage_21" else None
     )
 
-    print(
-        json.dumps(
-            {
-                "metric": f"sec/it denoise step [{config_name}]",
-                "value": round(sec_it, 4),
-                "unit": "s/it",
-                "vs_baseline": vs_baseline,
-                "platform": platform,
-                "n_devices": n_dev,
-                "mfu": mfu,
-                "model_flops_per_step": flops,
-                "workload": f"{workload} ({platform} x{n_dev})",
-                "images_per_sec": round(batch / sec_it, 3),
-            }
-        )
-    )
+    record = {
+        "metric": f"sec/it denoise step [{config_name}]",
+        "value": round(sec_it, 4),
+        "unit": "s/it",
+        "vs_baseline": vs_baseline,
+        "platform": platform,
+        "n_devices": n_dev,
+        "mfu": mfu,
+        "model_flops_per_step": flops,
+        "workload": f"{workload} ({platform} x{n_dev})",
+        "images_per_sec": round(batch / sec_it, 3),
+    }
+    if config_name == "flux_16" and flops:
+        # Analytic bridge to the full 19/38-depth model (compute-bound regime:
+        # time scales with matmul FLOPs at fixed shapes/arithmetic class).
+        full = _full_flux_flops(batch, x_shape[1], ctx_len)
+        if full:
+            record["full_model_flops_per_step"] = full
+            record["extrapolated_full_depth_s_it"] = round(sec_it * full / flops, 4)
+    print(json.dumps(record))
 
 
 def _cpu_env():

@@ -160,12 +160,32 @@ class TestSamplersRecoverX0:
         # Stochastic: looser tolerance, but must land near the oracle x0.
         np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=0.15, atol=0.15)
 
+    def test_dpmpp_3m_sde_converges_near_x0(self, problem):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            sample_dpmpp_3m_sde,
+        )
+
+        x0, x_init, sigmas, denoise = problem
+        out = sample_dpmpp_3m_sde(denoise, x_init, sigmas, jax.random.key(3))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=0.15, atol=0.15)
+
+    def test_dpmpp_3m_sde_eta_zero_deterministic_and_tight(self, problem):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            sample_dpmpp_3m_sde,
+        )
+
+        x0, x_init, sigmas, denoise = problem
+        a = sample_dpmpp_3m_sde(denoise, x_init, sigmas, jax.random.key(3), eta=0.0)
+        b = sample_dpmpp_3m_sde(denoise, x_init, sigmas, jax.random.key(9), eta=0.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(x0), rtol=1e-2, atol=1e-2)
+
     def test_registry_complete(self):
         from comfyui_parallelanything_tpu.sampling import RNG_SAMPLERS
 
         assert set(SAMPLERS) == {
             "euler", "euler_ancestral", "heun", "lms", "dpmpp_2m",
-            "dpmpp_2m_sde",
+            "dpmpp_2m_sde", "dpmpp_3m_sde",
         }
         assert RNG_SAMPLERS <= set(SAMPLERS)
 
