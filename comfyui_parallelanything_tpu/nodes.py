@@ -304,6 +304,12 @@ class TPUCheckpointLoader:
                 ),
                 "lora_path": ("STRING", {"default": ""}),
                 "lora_strength": ("FLOAT", {"default": 1.0, "min": -4.0, "max": 4.0}),
+                "quantize": (
+                    ["none", "int8"],
+                    {"default": "none",
+                     "tooltip": "int8 halves weight HBM (per-channel symmetric; "
+                                "e.g. flux-dev fits one v5e chip replicated)"},
+                ),
             },
         }
 
@@ -314,6 +320,7 @@ class TPUCheckpointLoader:
         vae_path: str = "",
         lora_path: str = "",
         lora_strength: float = 1.0,
+        quantize: str = "none",
     ):
         from .models import (
             flux_dev_config,
@@ -332,6 +339,29 @@ class TPUCheckpointLoader:
         )
 
         lora = lora_path or None
+
+        import contextlib
+
+        import jax
+
+        # int8 load path: conversion materializes the FULL-precision pytree —
+        # on the accelerator that would OOM before quantization can help (the
+        # whole point is that flux-dev-class f32 does NOT fit a v5e). Pin the
+        # load to host CPU RAM, quantize there, and let placement (parallelize)
+        # move only the int8 payload to the chips.
+        load_ctx = (
+            jax.default_device(jax.devices("cpu")[0])
+            if quantize == "int8"
+            else contextlib.nullcontext()
+        )
+
+        def maybe_quant(m):
+            if quantize == "int8":
+                from .models import quantize_model
+
+                return quantize_model(m)
+            return m
+
         sd = load_safetensors(ckpt_path)
         if family.startswith("wan"):
             # WAN family: video DiT + causal 3D VAE (its own checkpoint file —
@@ -344,7 +374,9 @@ class TPUCheckpointLoader:
             )
 
             wcfg = (wan_14b_config if family == "wan-14b" else wan_1_3b_config)()
-            model = load_wan_checkpoint(sd, wcfg, lora, lora_strength)
+            with load_ctx:
+                model = load_wan_checkpoint(sd, wcfg, lora, lora_strength)
+                model = maybe_quant(model)
             if not vae_path:
                 raise ValueError(
                     "wan checkpoints don't bundle a VAE — set vae_path to the "
@@ -352,42 +384,44 @@ class TPUCheckpointLoader:
                     "with safetensors.torch.save_file)"
                 )
             return model, load_wan_vae_checkpoint(vae_path)
-        if family == "sd15":
-            model = load_sd_unet_checkpoint(sd, sd15_config(), lora, lora_strength)
-            vae_cfg = sd_vae_config()
-        elif family in ("sd3-medium", "sd35-medium", "sd35-large"):
-            from .models import (
-                load_mmdit_checkpoint,
-                sd3_medium_config,
-                sd3_vae_config,
-                sd35_large_config,
-                sd35_medium_config,
-            )
+        with load_ctx:
+            if family == "sd15":
+                model = load_sd_unet_checkpoint(sd, sd15_config(), lora, lora_strength)
+                vae_cfg = sd_vae_config()
+            elif family in ("sd3-medium", "sd35-medium", "sd35-large"):
+                from .models import (
+                    load_mmdit_checkpoint,
+                    sd3_medium_config,
+                    sd3_vae_config,
+                    sd35_large_config,
+                    sd35_medium_config,
+                )
 
-            mcfg = {
-                "sd35-large": sd35_large_config,
-                "sd35-medium": sd35_medium_config,
-                "sd3-medium": sd3_medium_config,
-            }[family]()
-            model = load_mmdit_checkpoint(sd, mcfg, lora, lora_strength)
-            vae_cfg = sd3_vae_config()
-        elif family in ("sd21", "sd21-v"):
-            ucfg = sd21_config(
-                prediction="v" if family == "sd21-v" else "eps"
-            )
-            model = load_sd_unet_checkpoint(sd, ucfg, lora, lora_strength)
-            vae_cfg = sd_vae_config()
-        elif family == "sdxl":
-            model = load_sd_unet_checkpoint(sd, sdxl_config(), lora, lora_strength)
-            vae_cfg = sdxl_vae_config()
-        else:
-            cfg = {
-                "flux-dev": flux_dev_config,
-                "flux-schnell": flux_schnell_config,
-                "zimage-turbo": z_image_turbo_config,
-            }[family]()
-            model = load_flux_checkpoint(sd, cfg, lora, lora_strength)
-            vae_cfg = flux_vae_config()
+                mcfg = {
+                    "sd35-large": sd35_large_config,
+                    "sd35-medium": sd35_medium_config,
+                    "sd3-medium": sd3_medium_config,
+                }[family]()
+                model = load_mmdit_checkpoint(sd, mcfg, lora, lora_strength)
+                vae_cfg = sd3_vae_config()
+            elif family in ("sd21", "sd21-v"):
+                ucfg = sd21_config(
+                    prediction="v" if family == "sd21-v" else "eps"
+                )
+                model = load_sd_unet_checkpoint(sd, ucfg, lora, lora_strength)
+                vae_cfg = sd_vae_config()
+            elif family == "sdxl":
+                model = load_sd_unet_checkpoint(sd, sdxl_config(), lora, lora_strength)
+                vae_cfg = sdxl_vae_config()
+            else:
+                cfg = {
+                    "flux-dev": flux_dev_config,
+                    "flux-schnell": flux_schnell_config,
+                    "zimage-turbo": z_image_turbo_config,
+                }[family]()
+                model = load_flux_checkpoint(sd, cfg, lora, lora_strength)
+                vae_cfg = flux_vae_config()
+            model = maybe_quant(model)
         vae_sd = load_safetensors(vae_path) if vae_path else sd
         from .models.convert_vae import strip_vae_prefix
 

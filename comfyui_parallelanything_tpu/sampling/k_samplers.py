@@ -365,8 +365,11 @@ def sample_dpmpp_3m_sde(denoise, x, sigmas, rng, eta: float = 1.0, callback=None
         s, s_next = sigmas[i], sigmas[i + 1]
         x0 = denoise(x, s)
         if float(s_next) == 0.0:
-            x = x0
-            h = None
+            # Final (or interior-zero) step: no history update — a None h must
+            # never enter the multistep state (k-diffusion updates history only
+            # on non-zero steps).
+            x = apply_callback(callback, i, x0)
+            continue
         else:
             t, t_next = -jnp.log(s), -jnp.log(s_next)
             h = t_next - t

@@ -1,0 +1,71 @@
+"""Capture the full BENCH ladder on the real TPU and record it.
+
+Run whenever the axon tunnel is up (it comes and goes — probe first):
+
+    python scripts/measure_tpu.py [rung ...]
+
+For each rung (default: the TPU ladder in ascending cost) this runs
+``bench.py`` in a subprocess with ``BENCH_CONFIG`` set, inheriting the tunnel
+env. bench.py itself probes availability and falls back honestly, so a tunnel
+flap mid-ladder yields a ``platform: "cpu"`` line which is recorded but NOT
+written into the measured table. Results append to ``BASELINE_measured.json``
+(one JSON object per run, keyed by rung + timestamp) and the human-readable
+Measured table in ``BASELINE.md`` is left for a manual/agent pass — raw
+evidence first, prose second.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Ascending cost so a mid-ladder tunnel flap still banks the cheap rungs.
+LADDER = ("smoke", "sd15_16", "sdxl_8", "zimage_21", "flux_16", "wan_video")
+
+
+def run_rung(rung: str, timeout: int = 2400) -> dict | None:
+    sys.path.insert(0, _REPO)
+    from bench import _last_json_line  # the guarded metric-line scan, one impl
+
+    env = dict(os.environ)
+    env["BENCH_CONFIG"] = rung
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench.py")],
+            env=env, cwd=_REPO, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"rung": rung, "error": f"timed out after {timeout}s"}
+    line = _last_json_line(proc.stdout)
+    if line is not None:
+        rec = json.loads(line)
+        rec["rung"] = rung
+        return rec
+    return {"rung": rung, "error": proc.stderr.strip()[-300:]}
+
+
+def main() -> None:
+    rungs = sys.argv[1:] or list(LADDER)
+    out_path = os.path.join(_REPO, "BASELINE_measured.json")
+    results = []
+    for rung in rungs:
+        rec = run_rung(rung)
+        rec["ts"] = time.time()
+        results.append(rec)
+        print(json.dumps(rec))
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        if rec.get("platform") not in ("tpu", "axon") and "error" not in rec:
+            print(f"# {rung}: fell back to {rec.get('platform')} — tunnel down? "
+                  "continuing (later rungs may recover)", file=sys.stderr)
+    tpu_rungs = [r for r in results if r.get("platform") in ("tpu", "axon")]
+    print(f"# captured {len(tpu_rungs)}/{len(rungs)} rungs on TPU", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
