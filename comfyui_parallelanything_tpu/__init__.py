@@ -50,6 +50,7 @@ from .pipelines import (
     Sd3Pipeline,
     WanVideoPipeline,
 )
+from .host import run_workflow, WorkflowError
 from .utils.metrics import StepTimer, trace
 
 __all__ = [
@@ -81,6 +82,8 @@ __all__ = [
     "FluxPipeline",
     "WanVideoPipeline",
     "Sd3Pipeline",
+    "run_workflow",
+    "WorkflowError",
     "StepTimer",
     "trace",
 ]

@@ -1,0 +1,144 @@
+"""Standalone workflow-graph executor — the host layer the reference borrows.
+
+The reference node pack runs inside ComfyUI, which supplies graph storage,
+topological execution, and link resolution (SURVEY §1 L5: "external host").
+This module makes the framework its own host: it executes ComfyUI API-format
+workflow JSON directly against ``nodes.NODE_CLASS_MAPPINGS``, so a user of the
+reference can bring their exported workflow file and run it here unchanged
+(given the node names in this pack).
+
+Format (the ComfyUI ``/prompt`` API shape):
+
+    {
+      "1": {"class_type": "ParallelDevice",
+            "inputs": {"device_id": "tpu:0", "percentage": 50.0}},
+      "2": {"class_type": "ParallelDevice",
+            "inputs": {"device_id": "tpu:1", "percentage": 50.0,
+                        "previous_devices": ["1", 0]}},
+      ...
+    }
+
+A two-element list ``[node_id, output_index]`` is a link; everything else is a
+literal widget value. Node classes follow the declarative protocol
+(``INPUT_TYPES`` / ``RETURN_TYPES`` / ``FUNCTION``) — the same protocol the
+reference registers into ComfyUI (any_device_parallel.py:1473-1483).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+
+class WorkflowError(ValueError):
+    """A malformed or unexecutable workflow graph (unknown node/class, bad
+    link, cycle) — raised with the offending node id in the message."""
+
+
+def _is_link(v: Any) -> bool:
+    return (
+        isinstance(v, list)
+        and len(v) == 2
+        and isinstance(v[0], (str, int))
+        and isinstance(v[1], int)
+    )
+
+
+def run_workflow(
+    workflow: Any,
+    class_mappings: dict[str, type] | None = None,
+    outputs: dict[str, tuple] | None = None,
+) -> dict[str, tuple]:
+    """Execute a ComfyUI API-format workflow; returns ``{node_id: outputs}``.
+
+    ``workflow`` is the dict itself or a path to a JSON file. ``class_mappings``
+    extends/overrides ``nodes.NODE_CLASS_MAPPINGS`` (e.g. to register custom
+    nodes like the hosts the reference targets allow). ``outputs`` pre-seeds
+    node results (a cache from a previous run — re-running a graph only
+    executes nodes not already present, the host-side analogue of ComfyUI's
+    execution cache).
+    """
+    from .nodes import NODE_CLASS_MAPPINGS
+
+    classes: dict[str, type] = dict(NODE_CLASS_MAPPINGS)
+    classes.update(class_mappings or {})
+
+    if isinstance(workflow, (str, os.PathLike)):
+        with open(workflow) as f:
+            workflow = json.load(f)
+    if not isinstance(workflow, dict):
+        raise WorkflowError(f"workflow must be a dict, got {type(workflow).__name__}")
+    graph = {str(k): v for k, v in workflow.items()}
+
+    results: dict[str, tuple] = dict(outputs or {})
+    visiting: list[str] = []  # stack, for a readable cycle message
+
+    def exec_node(nid: str) -> tuple:
+        if nid in results:
+            return results[nid]
+        if nid in visiting:
+            raise WorkflowError(
+                f"cycle in workflow: {' -> '.join(visiting)} -> {nid}"
+            )
+        spec = graph.get(nid)
+        if spec is None:
+            raise WorkflowError(f"link references unknown node id {nid!r}")
+        if not isinstance(spec, dict):
+            raise WorkflowError(
+                f"node {nid}: spec must be a dict with class_type/inputs, "
+                f"got {type(spec).__name__}"
+            )
+        cls = classes.get(spec.get("class_type"))
+        if cls is None:
+            raise WorkflowError(
+                f"node {nid}: unknown class_type {spec.get('class_type')!r} "
+                f"(registered: {sorted(classes)})"
+            )
+        visiting.append(nid)
+        try:
+            kwargs: dict[str, Any] = {}
+            for name, v in (spec.get("inputs") or {}).items():
+                if _is_link(v):
+                    upstream = exec_node(str(v[0]))
+                    idx = int(v[1])
+                    if idx < 0 or idx >= len(upstream):
+                        raise WorkflowError(
+                            f"node {nid}: input {name!r} wants output {idx} of "
+                            f"node {v[0]}, which has {len(upstream)} output(s) "
+                            "(indices must be non-negative)"
+                        )
+                    kwargs[name] = upstream[idx]
+                else:
+                    kwargs[name] = v
+            fn = getattr(cls(), cls.FUNCTION)
+            out = fn(**kwargs)
+        finally:
+            visiting.pop()
+        if not isinstance(out, tuple):
+            out = (out,)
+        results[nid] = out
+        return out
+
+    for nid in graph:
+        exec_node(nid)
+    return results
+
+
+def main(argv: list[str] | None = None) -> None:
+    """``python -m comfyui_parallelanything_tpu.host workflow.json`` — run a
+    workflow file and print each node's output types."""
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m comfyui_parallelanything_tpu.host <workflow.json>",
+              file=sys.stderr)
+        raise SystemExit(2)
+    results = run_workflow(argv[0])
+    for nid, out in results.items():
+        print(f"{nid}: {tuple(type(o).__name__ for o in out)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -919,6 +919,12 @@ class TPUKSampler:
                     {"default": "karras",
                      "tooltip": "sigma spacing for the k-samplers"},
                 ),
+                "cfg_rescale": (
+                    "FLOAT",
+                    {"default": 0.0, "min": 0.0, "max": 1.0, "step": 0.05,
+                     "tooltip": "CFG rescale phi (Lin et al.): tames high-cfg "
+                                "over-saturation, esp. v-prediction models"},
+                ),
             },
         }
 
@@ -936,6 +942,7 @@ class TPUKSampler:
         shift: float = 1.15,
         denoise: float = 1.0,
         scheduler: str = "karras",
+        cfg_rescale: float = 0.0,
     ):
         import jax
         import jax.numpy as jnp
@@ -995,6 +1002,7 @@ class TPUKSampler:
             uncond_kwargs=uncond_kwargs, rng=rng, shift=shift,
             guidance=guidance if guidance > 0 else None,
             scheduler=scheduler,
+            cfg_rescale=cfg_rescale,
             prediction=getattr(model_cfg, "prediction", "eps"),
             init_latent=(
                 latent["samples"]

@@ -34,6 +34,20 @@ def double_kwargs(
     return out
 
 
+def rescale_guidance(guided: jnp.ndarray, cond: jnp.ndarray, phi: float) -> jnp.ndarray:
+    """CFG rescale (Lin et al. 2023 §3.4; diffusers ``guidance_rescale``): match
+    the guided prediction's per-sample std to the cond prediction's, blended by
+    ``phi`` (0 = off). Tames high-cfg over-saturation, especially on
+    v-prediction models."""
+    if phi <= 0.0:
+        return guided
+    dims = tuple(range(1, guided.ndim))
+    std_c = jnp.std(cond, axis=dims, keepdims=True)
+    std_g = jnp.std(guided, axis=dims, keepdims=True)
+    rescaled = guided * (std_c / jnp.maximum(std_g, 1e-8))
+    return phi * rescaled + (1.0 - phi) * guided
+
+
 def apply_callback(callback, i, x):
     """Invoke a sampler callback; a return that is an array of x's shape
     REPLACES the working latent (the hook latent-mask inpainting rides on).

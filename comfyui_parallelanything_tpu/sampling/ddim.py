@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .cfg import apply_callback, double_kwargs
+from .cfg import apply_callback, double_kwargs, rescale_guidance
 from .schedules import ddim_timesteps, scaled_linear_schedule
 
 
@@ -32,6 +32,7 @@ def ddim_sample(
     callback=None,
     ts: jnp.ndarray | None = None,
     prediction: str = "eps",
+    cfg_rescale: float = 0.0,
     **model_kwargs,
 ) -> jnp.ndarray:
     """Denoise ``x_init`` (noise at t=ts[0]) over the DDIM steps. Returns x_0.
@@ -58,6 +59,7 @@ def ddim_sample(
             out_both = model(x_in, t_in, c_in, **kw)
             out_c, out_u = jnp.split(out_both, 2, axis=0)
             out = out_u + cfg_scale * (out_c - out_u)
+            out = rescale_guidance(out, out_c, cfg_rescale)
         else:
             out = model(x, t_vec, context, **model_kwargs)
 

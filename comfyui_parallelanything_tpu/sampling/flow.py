@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .cfg import apply_callback, double_kwargs
+from .cfg import apply_callback, double_kwargs, rescale_guidance
 
 
 def flow_timesteps(steps: int, shift: float = 1.0) -> jnp.ndarray:
@@ -35,6 +35,7 @@ def flow_euler_sample(
     uncond_kwargs: dict | None = None,
     callback=None,
     ts: jnp.ndarray | None = None,
+    cfg_rescale: float = 0.0,
     **model_kwargs,
 ) -> jnp.ndarray:
     """Euler-integrate the flow from noise (t=ts[0]) to sample (t=0).
@@ -64,6 +65,7 @@ def flow_euler_sample(
             v_both = model(x_in, t_in, c_in, **kw2)
             v_c, v_u = jnp.split(v_both, 2, axis=0)
             v = v_u + cfg_scale * (v_c - v_u)
+            v = rescale_guidance(v, v_c, cfg_rescale)
         else:
             v = model(x, t_vec, context, **kw)
         x = x + (ts[i + 1] - ts[i]) * v

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .cfg import apply_callback, double_kwargs
+from .cfg import apply_callback, double_kwargs, rescale_guidance
 from .schedules import scaled_linear_schedule
 
 
@@ -205,6 +205,7 @@ class EpsDenoiser:
         uncond_kwargs: dict | None = None,
         alphas_cumprod: jnp.ndarray | None = None,
         prediction: str = "eps",
+        cfg_rescale: float = 0.0,
         **model_kwargs,
     ):
         if alphas_cumprod is None:
@@ -215,6 +216,7 @@ class EpsDenoiser:
         self.model = model
         self.context = context
         self.cfg_scale = cfg_scale
+        self.cfg_rescale = cfg_rescale
         self.uncond_context = uncond_context
         self.uncond_kwargs = uncond_kwargs
         self.kwargs = model_kwargs
@@ -247,6 +249,7 @@ class EpsDenoiser:
             )
             eps_c, eps_u = jnp.split(eps_both, 2, axis=0)
             eps = eps_u + self.cfg_scale * (eps_c - eps_u)
+            eps = rescale_guidance(eps, eps_c, self.cfg_rescale)
         else:
             eps = self.model(x_in, t_vec, self.context, **self.kwargs)
         if self.prediction == "v":

@@ -42,6 +42,7 @@ def run_sampler(
     denoise: float = 1.0,
     latent_mask: jnp.ndarray | None = None,
     prediction: str = "eps",
+    cfg_rescale: float = 0.0,
     **model_kwargs,
 ) -> jnp.ndarray:
     """Drive ``model`` from ``noise`` to a clean latent with the named sampler.
@@ -100,7 +101,8 @@ def run_sampler(
         return flow_euler_sample(
             model, x, context, steps=steps, shift=shift, guidance=guidance,
             cfg_scale=eff_cfg, uncond_context=uncond_context,
-            uncond_kwargs=uncond_kwargs, callback=cb, ts=ts, **model_kwargs,
+            uncond_kwargs=uncond_kwargs, callback=cb, ts=ts,
+            cfg_rescale=cfg_rescale, **model_kwargs,
         )
     if sampler == "ddim":
         # A caller-supplied schedule must drive BOTH the truncation/noising here
@@ -134,7 +136,7 @@ def run_sampler(
             model, x, context, steps=steps, cfg_scale=eff_cfg,
             uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
             callback=masked_callback(ddim_keep), ts=ts, alphas_cumprod=acp,
-            prediction=prediction, **model_kwargs,
+            prediction=prediction, cfg_rescale=cfg_rescale, **model_kwargs,
         )
     step_fn = K_SAMPLERS.get(sampler)
     if step_fn is None:
@@ -157,7 +159,7 @@ def run_sampler(
     denoiser = EpsDenoiser(
         model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,
         uncond_kwargs=uncond_kwargs, alphas_cumprod=acp, prediction=prediction,
-        **model_kwargs,
+        cfg_rescale=cfg_rescale, **model_kwargs,
     )
     x = noise * sigmas[0]
     if img2img:

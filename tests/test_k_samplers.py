@@ -190,6 +190,52 @@ class TestSamplersRecoverX0:
         assert RNG_SAMPLERS <= set(SAMPLERS)
 
 
+class TestCFGRescale:
+    def test_rescale_matches_cond_std(self):
+        from comfyui_parallelanything_tpu.sampling.cfg import rescale_guidance
+
+        rng = np.random.default_rng(17)
+        cond = jnp.asarray(rng.normal(size=(2, 8, 8, 4)), jnp.float32)
+        guided = cond * 3.0 + 1.0  # inflated std (what high cfg does)
+        full = rescale_guidance(guided, cond, 1.0)
+        # phi=1: per-sample std matches the cond prediction exactly.
+        np.testing.assert_allclose(
+            np.asarray(full).std(axis=(1, 2, 3)),
+            np.asarray(cond).std(axis=(1, 2, 3)), rtol=1e-5,
+        )
+        # phi=0: identity. phi=0.5: halfway.
+        np.testing.assert_array_equal(
+            np.asarray(rescale_guidance(guided, cond, 0.0)), np.asarray(guided)
+        )
+        half = rescale_guidance(guided, cond, 0.5)
+        np.testing.assert_allclose(
+            np.asarray(half), 0.5 * np.asarray(full) + 0.5 * np.asarray(guided),
+            rtol=1e-6,
+        )
+
+    def test_run_sampler_accepts_cfg_rescale(self):
+        # e2e: rescale changes the output when CFG is active.
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        noise = jax.random.normal(jax.random.key(1), (2, 8, 8, 4))
+        ctx = jax.random.normal(jax.random.key(2), (2, 4, 8))
+        un = jax.random.normal(jax.random.key(3), (2, 4, 8))
+
+        def model2(x, t, context=None, **kw):
+            # PER-SAMPLE context scale (CFG doubles the batch, so a global mean
+            # would give cond and uncond halves the identical value) so the two
+            # halves differ in STD — a constant offset would leave the rescale
+            # factor at exactly 1.
+            s = 0.1 + 0.05 * context.mean(axis=(1, 2))[:, None, None, None]
+            return x * s
+
+        base = run_sampler(model2, noise, ctx, sampler="euler", steps=3,
+                           cfg_scale=5.0, uncond_context=un)
+        resc = run_sampler(model2, noise, ctx, sampler="euler", steps=3,
+                           cfg_scale=5.0, uncond_context=un, cfg_rescale=0.7)
+        assert not np.allclose(np.asarray(base), np.asarray(resc))
+
+
 class TestCFGBatching:
     def test_cfg_doubles_batch_through_model(self):
         calls = []
