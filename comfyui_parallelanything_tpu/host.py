@@ -45,6 +45,33 @@ def _is_link(v: Any) -> bool:
     )
 
 
+_WIDGET_PRIMITIVES = {"INT", "FLOAT", "STRING", "BOOLEAN"}
+
+
+def _wire_inputs(cls: type) -> tuple[set[str], set[str]]:
+    """(wire_input_names, declared_input_names) from a node's INPUT_TYPES.
+
+    Disambiguates link-vs-literal for two-element list values: a declared widget
+    (primitive type or dropdown options) takes literals; a declared wire type
+    (e.g. "MODEL") takes links. Undeclared names fall back to the link shape
+    heuristic."""
+    wires: set[str] = set()
+    declared: set[str] = set()
+    try:
+        spec = cls.INPUT_TYPES()
+    except Exception:
+        return wires, declared
+    for group in spec.values():
+        if not isinstance(group, dict):
+            continue
+        for name, decl in group.items():
+            declared.add(name)
+            typ = decl[0] if isinstance(decl, (tuple, list)) and decl else decl
+            if isinstance(typ, str) and typ not in _WIDGET_PRIMITIVES:
+                wires.add(name)
+    return wires, declared
+
+
 def run_workflow(
     workflow: Any,
     class_mappings: dict[str, type] | None = None,
@@ -97,9 +124,12 @@ def run_workflow(
             )
         visiting.append(nid)
         try:
+            wires, declared = _wire_inputs(cls)
             kwargs: dict[str, Any] = {}
             for name, v in (spec.get("inputs") or {}).items():
-                if _is_link(v):
+                # A 2-list is a link only for wire-typed (or undeclared) inputs;
+                # declared widgets keep list literals as values.
+                if _is_link(v) and (name in wires or name not in declared):
                     upstream = exec_node(str(v[0]))
                     idx = int(v[1])
                     if idx < 0 or idx >= len(upstream):
@@ -112,7 +142,14 @@ def run_workflow(
                 else:
                     kwargs[name] = v
             fn = getattr(cls(), cls.FUNCTION)
-            out = fn(**kwargs)
+            try:
+                out = fn(**kwargs)
+            except WorkflowError:
+                raise
+            except Exception as e:
+                raise WorkflowError(
+                    f"node {nid} ({spec.get('class_type')}): {type(e).__name__}: {e}"
+                ) from e
         finally:
             visiting.pop()
         if not isinstance(out, tuple):

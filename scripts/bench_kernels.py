@@ -1,0 +1,94 @@
+"""Flash-attention kernel benchmark: pallas (streamed K/V) vs plain XLA.
+
+Run on a live TPU (the tunnel comes and goes — probe first):
+
+    python scripts/bench_kernels.py
+
+Shapes cover the rungs that matter: FLUX joint attention at 1024² (4.6k tokens,
+24 heads × 128) and WAN-video lengths (16k/32k tokens) where the streamed-K/V
+layout is what keeps VMEM bounded. Each row reports ms/call (median of 5 after
+warmup) and the speedup of the pallas path over XLA. Appends JSON lines to
+KERNEL_BENCH.json; BASELINE.md's kernel section reads from there.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# (label, batch, seq, heads, head_dim)
+SHAPES = [
+    ("flux_1024_joint", 1, 4608, 24, 128),
+    ("flux_b4", 4, 4608, 24, 128),
+    ("wan_480p_16f", 1, 16384, 12, 128),
+    ("wan_long_32k", 1, 32768, 12, 128),
+]
+
+
+def _time_fn(fn, *args, iters=5):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile + warmup
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from comfyui_parallelanything_tpu.devices.discovery import is_tpu_device
+    from comfyui_parallelanything_tpu.ops.attention import _xla_attention
+    from comfyui_parallelanything_tpu.ops.pallas.flash_attention import (
+        flash_attention,
+    )
+
+    dev = jax.devices()[0]
+    on_tpu = is_tpu_device(dev)
+    if not on_tpu:
+        print("# WARNING: no TPU — interpret-mode pallas numbers are meaningless; "
+              "running tiny-shape smoke only", file=sys.stderr)
+
+    out_path = os.path.join(_REPO, "KERNEL_BENCH.json")
+    shapes = SHAPES if on_tpu else [("cpu_smoke", 1, 256, 2, 64)]
+    for label, b, s, h, d in shapes:
+        k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(k1, (b, s, h, d), jnp.bfloat16)
+        k = jax.random.normal(k2, (b, s, h, d), jnp.bfloat16)
+        v = jax.random.normal(k3, (b, s, h, d), jnp.bfloat16)
+        rec = {"shape": label, "b": b, "seq": s, "heads": h, "head_dim": d,
+               "platform": dev.platform, "device_kind": dev.device_kind,
+               "ts": time.time()}
+        try:
+            rec["pallas_ms"] = round(
+                _time_fn(lambda a, b_, c: flash_attention(a, b_, c), q, k, v) * 1e3, 3
+            )
+        except Exception as e:  # noqa: BLE001 — record, keep measuring
+            rec["pallas_error"] = str(e)[:200]
+        try:
+            rec["xla_ms"] = round(
+                _time_fn(lambda a, b_, c: _xla_attention(a, b_, c, d**-0.5),
+                         q, k, v) * 1e3, 3
+            )
+        except Exception as e:  # noqa: BLE001 — S×S logits OOM at video lengths
+            rec["xla_error"] = str(e)[:200]
+        if "pallas_ms" in rec and "xla_ms" in rec:
+            rec["pallas_speedup"] = round(rec["xla_ms"] / rec["pallas_ms"], 2)
+        print(json.dumps(rec))
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,35 @@ class TestExecutor:
         with pytest.raises(WorkflowError, match="3 .* 1 output"):
             run_workflow(wf)
 
+    def test_widget_list_literal_not_mistaken_for_link(self):
+        # A declared widget whose literal value is a 2-list must NOT resolve as
+        # a link (ComfyUI decides link-vs-literal from INPUT_TYPES; so do we).
+        seen = {}
+
+        class Sizer:
+            RETURN_TYPES = ("X",)
+            FUNCTION = "go"
+
+            @classmethod
+            def INPUT_TYPES(cls):
+                return {"required": {"size": ("INT", {}),
+                                     "pair": ("FLOAT", {})}}
+
+            def go(self, size, pair):
+                seen["pair"] = pair
+                return (size,)
+
+        wf = {"7": {"class_type": "Sizer", "inputs": {"size": 3, "pair": [64, 0]}}}
+        out = run_workflow(wf, {"Sizer": Sizer})
+        assert out["7"] == (3,)
+        assert seen["pair"] == [64, 0]  # stayed a literal
+
+    def test_node_error_carries_node_id(self):
+        wf = {"9": {"class_type": "ParallelDevice",
+                    "inputs": {"percentage": 50.0}}}  # missing device_id
+        with pytest.raises(WorkflowError, match="node 9"):
+            run_workflow(wf)
+
     def test_output_cache_skips_execution(self):
         ran = []
 

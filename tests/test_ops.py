@@ -64,6 +64,46 @@ class TestFlashAttention:
         got = flash_attention(q, k, v, interpret=True)
         assert got.shape == (2, 32, 4, 16)
 
+    def test_long_sequence_many_k_blocks(self):
+        # Video-length regime (scaled for interpreter mode): the k-block grid
+        # dim walks 16 tiles; online-softmax state must stay exact across all
+        # of them. On real TPU this shape runs with VMEM at O(block), not O(S).
+        q, k, v = _qkv(b=1, sq=256, sk=4096, h=1, d=32)
+        got = flash_attention(q, k, v, block_q=256, block_k=256, interpret=True)
+        want = _xla_attention(q, k, v, scale=32**-0.5)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+    def test_flash_under_sequence_parallel_ulysses(self, cpu_devices):
+        # The composition the WAN long-context path uses on TPU: Ulysses
+        # all_to_all head scatter inside shard_map, flash kernel as the local
+        # attention. Forcing the pallas backend (interpret on CPU) proves the
+        # kernel traces and runs inside the shard_map body.
+        from comfyui_parallelanything_tpu.ops.attention import (
+            get_attention_backend,
+            set_attention_backend,
+        )
+        from comfyui_parallelanything_tpu.parallel.mesh import AXIS_SEQ, build_mesh
+        from comfyui_parallelanything_tpu.parallel.sequence import (
+            sequence_parallel_attention,
+        )
+
+        mesh = build_mesh(cpu_devices[:4], {AXIS_SEQ: 4})
+        rng = np.random.default_rng(19)
+        q = jnp.asarray(rng.normal(size=(1, 64, 4, 32)), jnp.float32)
+        kv = jnp.asarray(rng.normal(size=(1, 64, 4, 32)), jnp.float32)
+        want = _xla_attention(q, kv, kv, scale=32**-0.5)
+        prev = get_attention_backend()
+        set_attention_backend("pallas")
+        try:
+            got = sequence_parallel_attention(q, kv, kv, mesh, method="ulysses")
+        finally:
+            set_attention_backend(prev)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
     def test_streamed_kv_block_invariance(self):
         # The k-block grid dimension streams K/V through VMEM; the result must be
         # independent of how the key sequence is tiled (VMEM stays O(block_k) even
