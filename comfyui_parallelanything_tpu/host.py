@@ -19,9 +19,15 @@ Format (the ComfyUI ``/prompt`` API shape):
     }
 
 A two-element list ``[node_id, output_index]`` is a link; everything else is a
-literal widget value. Node classes follow the declarative protocol
-(``INPUT_TYPES`` / ``RETURN_TYPES`` / ``FUNCTION``) — the same protocol the
-reference registers into ComfyUI (any_device_parallel.py:1473-1483).
+literal widget value. ComfyUI's executor treats any link-shaped value as a link
+regardless of the declared input type (exported workflows routinely wire
+widget inputs, e.g. a seed from a seed-control node via convert-widget-to-
+input), so declared primitive widgets (INT/FLOAT/STRING/BOOLEAN) also resolve
+link-shaped values — but only when the referenced id names a node in the
+graph, which keeps genuine list literals safe. Node classes follow the
+declarative protocol (``INPUT_TYPES`` / ``RETURN_TYPES`` / ``FUNCTION``) — the
+same protocol the reference registers into ComfyUI
+(any_device_parallel.py:1473-1483).
 """
 
 from __future__ import annotations
@@ -99,15 +105,8 @@ def run_workflow(
     graph = {str(k): v for k, v in workflow.items()}
 
     results: dict[str, tuple] = dict(outputs or {})
-    visiting: list[str] = []  # stack, for a readable cycle message
 
-    def exec_node(nid: str) -> tuple:
-        if nid in results:
-            return results[nid]
-        if nid in visiting:
-            raise WorkflowError(
-                f"cycle in workflow: {' -> '.join(visiting)} -> {nid}"
-            )
+    def node_class(nid: str) -> tuple[dict, type]:
         spec = graph.get(nid)
         if spec is None:
             raise WorkflowError(f"link references unknown node id {nid!r}")
@@ -122,20 +121,63 @@ def run_workflow(
                 f"node {nid}: unknown class_type {spec.get('class_type')!r} "
                 f"(registered: {sorted(classes)})"
             )
-        visiting.append(nid)
-        try:
-            wires, declared = _wire_inputs(cls)
+        return spec, cls
+
+    def link_inputs(spec: dict, cls: type) -> dict[str, tuple[str, int]]:
+        """Which inputs take their value from another node's output.
+
+        ComfyUI semantics: any link-shaped value is a link, even into declared
+        primitive widgets — gated on the referenced id naming a graph node so
+        a genuine 2-list literal into a widget stays a literal."""
+        wires, declared = _wire_inputs(cls)
+        links: dict[str, tuple[str, int]] = {}
+        for name, v in (spec.get("inputs") or {}).items():
+            if _is_link(v) and (
+                name in wires or name not in declared or str(v[0]) in graph
+            ):
+                links[name] = (str(v[0]), int(v[1]))
+        return links
+
+    def exec_node(root: str) -> tuple:
+        # Iterative post-order DFS (exported graphs can be thousands of nodes
+        # deep — Python recursion would hit the interpreter limit and surface
+        # as RecursionError instead of a WorkflowError).
+        # Each frame is [nid, resolved]; resolved is None until the node is
+        # expanded, then the cached (spec, cls, links) so execution doesn't
+        # re-derive them (INPUT_TYPES would otherwise run twice per node).
+        stack: list[list] = [[root, None]]
+        path: list[str] = []  # gray nodes in order, for a readable cycle message
+        on_path: set[str] = set()
+        while stack:
+            nid, resolved = stack[-1]
+            if resolved is None:
+                if nid in results:
+                    stack.pop()
+                    continue
+                if nid in on_path:
+                    raise WorkflowError(
+                        f"cycle in workflow: {' -> '.join(path)} -> {nid}"
+                    )
+                spec, cls = node_class(nid)
+                links = link_inputs(spec, cls)
+                stack[-1][1] = (spec, cls, links)
+                path.append(nid)
+                on_path.add(nid)
+                deps = dict.fromkeys(dep for dep, _ in links.values())
+                for dep in reversed(list(deps)):
+                    if dep not in results:
+                        stack.append([dep, None])
+                continue
+            spec, cls, links = resolved
             kwargs: dict[str, Any] = {}
             for name, v in (spec.get("inputs") or {}).items():
-                # A 2-list is a link only for wire-typed (or undeclared) inputs;
-                # declared widgets keep list literals as values.
-                if _is_link(v) and (name in wires or name not in declared):
-                    upstream = exec_node(str(v[0]))
-                    idx = int(v[1])
+                if name in links:
+                    dep, idx = links[name]
+                    upstream = results[dep]
                     if idx < 0 or idx >= len(upstream):
                         raise WorkflowError(
                             f"node {nid}: input {name!r} wants output {idx} of "
-                            f"node {v[0]}, which has {len(upstream)} output(s) "
+                            f"node {dep}, which has {len(upstream)} output(s) "
                             "(indices must be non-negative)"
                         )
                     kwargs[name] = upstream[idx]
@@ -150,12 +192,13 @@ def run_workflow(
                 raise WorkflowError(
                     f"node {nid} ({spec.get('class_type')}): {type(e).__name__}: {e}"
                 ) from e
-        finally:
-            visiting.pop()
-        if not isinstance(out, tuple):
-            out = (out,)
-        results[nid] = out
-        return out
+            if not isinstance(out, tuple):
+                out = (out,)
+            results[nid] = out
+            on_path.discard(nid)
+            path.pop()
+            stack.pop()
+        return results[root]
 
     for nid in graph:
         exec_node(nid)

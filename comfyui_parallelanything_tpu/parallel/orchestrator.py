@@ -484,8 +484,14 @@ class ParallelModel:
         memory reading — not the already-blended values, which would compound —
         and resets the lazy pipeline runner so batch==1 stage placement also
         re-balances on next use. Returns the new normalized weights. No-op on
-        chains where no device reports memory (blend falls back to user weights).
+        chains where no device reports memory (blend falls back to user weights),
+        and when ``auto_memory_balance`` is off — the reference gates the
+        per-step VRAM re-blend on ``auto_balance_ref`` the same way
+        (any_device_parallel.py:1317-1322), so explicit user weights are never
+        silently overridden by memory stats.
         """
+        if not self.config.auto_memory_balance:
+            return self.weights
         user = [w for g in self._groups for w in g.user_weights]
         base = normalize_weights(user)
         if base is None:

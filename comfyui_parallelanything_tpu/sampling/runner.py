@@ -53,7 +53,9 @@ def run_sampler(
     img2img: with ``init_latent`` + ``denoise < 1``, the schedule for
     ``steps/denoise`` total steps is truncated to its last ``steps`` entries and
     ``init_latent`` is noised to the truncated schedule's start (ComfyUI's
-    KSampler denoise semantics: ``steps`` forwards always run).
+    KSampler denoise semantics: ``steps`` forwards always run — except when a
+    scheduler realizes fewer than ``steps`` sigmas, where the truncation is
+    rescaled to the realized length to preserve the requested strength).
 
     Inpainting: ``latent_mask`` (broadcastable to the latent; 1 = denoise this
     region, 0 = keep ``init_latent``) re-pins the keep region to the init noised
@@ -152,10 +154,22 @@ def run_sampler(
     sched_name = scheduler if scheduler is not None else ("karras" if karras else "normal")
     sigmas = make_sigmas(sched_name, total, acp)
     if img2img:
-        # ddim_uniform's integer stride can realize a count slightly off the
-        # request; the host KSampler truncates the realized schedule the same
-        # way, so the tiny denoise-strength skew is reference-faithful.
-        sigmas = sigmas[-(steps + 1) :]
+        # The realized schedule can be shorter than requested (ddim_uniform's
+        # integer stride; beta's duplicate-timestep dedup in make_sigmas).
+        # While the fixed ComfyUI slice still truncates (realized > steps) use
+        # it verbatim — ``steps`` forwards run, reference-faithful even when
+        # the realized count is slightly off the request. Only when the fixed
+        # slice would degenerate (realized <= steps keeps the WHOLE schedule,
+        # i.e. effective denoise 1.0 regardless of the request — beta at high
+        # step counts) rescale the truncation to the realized length so the
+        # requested strength survives; documented divergence from the host
+        # KSampler, which has no guard for this case.
+        realized = len(sigmas) - 1
+        if realized > steps:
+            sigmas = sigmas[-(steps + 1) :]
+        else:
+            keep = min(realized, max(1, round(steps * realized / total)))
+            sigmas = sigmas[-(keep + 1) :]
     denoiser = EpsDenoiser(
         model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,
         uncond_kwargs=uncond_kwargs, alphas_cumprod=acp, prediction=prediction,

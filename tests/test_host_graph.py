@@ -117,6 +117,57 @@ class TestExecutor:
         assert out["7"] == (3,)
         assert seen["pair"] == [64, 0]  # stayed a literal
 
+    def test_linked_primitive_widget_resolves(self):
+        # ComfyUI's convert-widget-to-input: a declared INT widget wired from
+        # another node's output arrives as [node_id, idx] and MUST resolve as a
+        # link (ComfyUI's executor treats any link-shaped value as a link
+        # regardless of INPUT_TYPES).
+        class SeedSource:
+            RETURN_TYPES = ("INT",)
+            FUNCTION = "go"
+
+            def go(self):
+                return (1234,)
+
+        class Consumer:
+            RETURN_TYPES = ("X",)
+            FUNCTION = "go"
+
+            @classmethod
+            def INPUT_TYPES(cls):
+                return {"required": {"seed": ("INT", {})}}
+
+            def go(self, seed):
+                return (seed,)
+
+        wf = {
+            "a": {"class_type": "SeedSource", "inputs": {}},
+            "b": {"class_type": "Consumer", "inputs": {"seed": ["a", 0]}},
+        }
+        out = run_workflow(wf, {"SeedSource": SeedSource, "Consumer": Consumer})
+        assert out["b"] == (1234,)
+
+    def test_deep_chain_no_recursion_limit(self):
+        # Link resolution is iterative: a linear chain far beyond Python's
+        # recursion limit executes (no RecursionError escaping as a crash).
+        class Inc:
+            RETURN_TYPES = ("X",)
+            FUNCTION = "go"
+
+            @classmethod
+            def INPUT_TYPES(cls):
+                return {"required": {"x": ("X", {})}}
+
+            def go(self, x):
+                return (x + 1,)
+
+        n = 3000
+        wf = {"0": {"class_type": "Inc", "inputs": {"x": -1}}}
+        for i in range(1, n):
+            wf[str(i)] = {"class_type": "Inc", "inputs": {"x": [str(i - 1), 0]}}
+        out = run_workflow(wf, {"Inc": Inc})
+        assert out[str(n - 1)] == (n - 1,)
+
     def test_node_error_carries_node_id(self):
         wf = {"9": {"class_type": "ParallelDevice",
                     "inputs": {"percentage": 50.0}}}  # missing device_id

@@ -49,6 +49,27 @@ class TestRunSamplerDenoise:
         d_full = float(jnp.abs(full - init).mean())
         assert d_weak < d_full, (sampler, d_weak, d_full)
 
+    def test_beta_short_schedule_honors_denoise(self):
+        # beta's duplicate-timestep dedup can realize fewer sigmas than the
+        # steps/denoise request; the img2img truncation must scale to the
+        # realized length. The old fixed sigmas[-(steps+1):] slice kept the
+        # whole schedule whenever len(sigmas) <= steps, running every denoise
+        # strength at an effective 1.0 (identical outputs below).
+        T = 8  # tiny sigma table forces realized < steps+1 after dedup
+        acp = jnp.cumprod(1.0 - jnp.linspace(1e-2, 0.3, T))
+        init = jnp.full((1, 8, 8, 4), 2.0)
+        noise = jax.random.normal(jax.random.key(2), (1, 8, 8, 4))
+        out = {
+            d: run_sampler(
+                _toy_model(), noise, None, sampler="euler", scheduler="beta",
+                steps=10, init_latent=init, denoise=d, alphas_cumprod=acp,
+            )
+            for d in (0.3, 0.95)
+        }
+        d_weak = float(jnp.abs(out[0.3] - init).mean())
+        d_strong = float(jnp.abs(out[0.95] - init).mean())
+        assert d_weak < d_strong, (d_weak, d_strong)
+
     def test_denoise_out_of_range_rejected(self):
         noise = jnp.zeros((1, 4, 4, 4))
         with pytest.raises(ValueError, match="denoise"):

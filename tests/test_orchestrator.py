@@ -194,6 +194,26 @@ class TestSetupSemantics:
             np.asarray(got), np.asarray(apply_fn(params, x, t, c)), rtol=1e-5, atol=1e-6
         )
 
+    def test_rebalance_noop_when_auto_balance_off(self, toy, monkeypatch):
+        # Parity: the reference gates the per-step VRAM re-blend on
+        # auto_balance_ref (any_device_parallel.py:1317-1322) — with it off,
+        # explicit user weights must survive rebalance() untouched.
+        from comfyui_parallelanything_tpu.parallel import orchestrator as orch
+
+        apply_fn, params = toy
+        chain = DeviceChain.from_pairs(
+            [("cpu:0", 60.0), ("cpu:1", 25.0), ("cpu:2", 10.0), ("cpu:3", 5.0)]
+        )
+        pm = parallelize(
+            (apply_fn, params), chain, ParallelConfig(auto_memory_balance=False)
+        )
+        before = pm.weights
+        np.testing.assert_allclose(before, (0.60, 0.25, 0.10, 0.05), rtol=1e-6)
+        fake = {0: 8 << 30, 1: 1 << 30, 2: 1 << 30, 3: 1 << 30}
+        monkeypatch.setattr(orch, "free_memory_bytes", lambda d: fake[d.id])
+        assert pm.rebalance() == before
+        assert pm.weights == before
+
     def test_reentrant_rewrap(self, toy):
         # Parity: setup_parallel on an already-parallel model tears down the old
         # setup and rebuilds with the new chain (any_device_parallel.py:1006-1013).
