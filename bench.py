@@ -232,9 +232,9 @@ def run_inner() -> None:
     # Persistent XLA compilation cache: repeat driver runs skip the 20-40s
     # first-compile (cache dir is repo-local; harmless on first run).
     try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(_REPO, ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        from comfyui_parallelanything_tpu.utils import enable_compilation_cache
+
+        enable_compilation_cache(os.path.join(_REPO, ".jax_cache"))
     except Exception:
         pass
 

@@ -71,6 +71,8 @@ from .chain import DeviceChain, DeviceLink
 from .mesh import AXIS_DATA, build_mesh, place_params, place_params_fsdp
 from .split import (
     batch_size_of,
+    pad_leaf as _pad_leaf,
+    slice_padded as _slice_padded,
     blend_memory_weights,
     largest_remainder_split,
     normalize_weights,
@@ -185,27 +187,6 @@ def _place_for(config: "ParallelConfig", params, mesh):
             f"tensor-parallel parameter pytree (model axis ×{config.tensor_parallel})",
         )
     return place_params(params, mesh), "replicated parameter pytree"
-
-
-def _pad_leaf(a, pad: int):
-    """Pad dim0 by repeating the last element (sliced off after the SPMD call)."""
-    if pad == 0:
-        return a
-    return jnp.concatenate([a, jnp.repeat(a[-1:], pad, axis=0)], axis=0)
-
-
-def _slice_padded(out, batch: int, padded: int):
-    """Un-pad: slice dim0 back to ``batch`` on every array leaf that carries the
-    padded batch dimension (dicts/tuples/lists handled by tree mapping)."""
-    if padded == batch:
-        return out
-
-    def fix(leaf):
-        if _is_arraylike(leaf) and leaf.ndim > 0 and leaf.shape[0] == padded:
-            return leaf[:batch]
-        return leaf
-
-    return jax.tree.map(fix, out)
 
 
 class ParallelModel:
@@ -368,8 +349,6 @@ class ParallelModel:
                 g.params, put_repl(x), put_repl(timesteps), put_repl(context),
                 put_repl(traced),
             )
-        if self._lead_params is None:
-            self._lead_params = jax.device_put(self._host_params, self.lead_device)
         traced, static = partition_kwargs(kwargs)
 
         def put(v):
@@ -379,7 +358,14 @@ class ParallelModel:
             )
 
         fn = self._jit_for(static)
-        return fn(self._lead_params, put(x), put(timesteps), put(context), put(traced))
+        return fn(self._lead(), put(x), put(timesteps), put(context), put(traced))
+
+    def _lead(self):
+        """Lazy full-pytree copy on the lead device — the shared placement for
+        the eager single() fallback and traceable()'s single-device spec."""
+        if self._lead_params is None:
+            self._lead_params = jax.device_put(self._host_params, self.lead_device)
+        return self._lead_params
 
     def _data_parallel(self, batch, x, timesteps, context, kwargs):
         if len(self._groups) == 1:
@@ -441,6 +427,41 @@ class ParallelModel:
         fn = self._jit_for(static)
         out = fn(group.params, place(x), place(timesteps), place(context), place(traced))
         return _slice_padded(out, batch, padded)
+
+    # -- whole-loop compilation handle (sampling/compiled.py) ----------------------
+
+    def traceable(self):
+        """A ``TraceSpec`` letting a sampler compile its ENTIRE denoise loop as
+        one XLA program over this chain, or None when that cannot be a single
+        program (heterogeneous multi-group chains need host-side scatter; an
+        ambient sequence_parallel context pins shard_map meshes this path does
+        not carry). Trades away per-step elasticity (step-OOM demotion,
+        1435-1448) for zero per-step dispatch — the opt-in documented on
+        ``run_sampler(compile_loop=True)``."""
+        from ..ops.attention import sequence_ctx_key
+        from ..sampling.compiled import TraceSpec
+
+        if sequence_ctx_key() is not None:
+            return None
+        if len(self._groups) != 1:
+            return None
+        g = self._groups[0]
+        sharded = (
+            self.config.weight_sharding == "fsdp" or self.config.tensor_parallel > 1
+        )
+        if g.params is not None:
+            if self.active and self.config.workload_split and self._data_width() > 1:
+                return TraceSpec(
+                    apply=self._apply, params=g.params, mesh=g.mesh,
+                    data_axis=self.config.data_axis,
+                )
+            if sharded:
+                # Sharded weights are the ONLY placement that fits — run the
+                # loop over the group mesh with replicated inputs (the single()
+                # premise), whether active or step-OOM-demoted; a lead-device
+                # copy would re-materialize the full pytree on one chip.
+                return TraceSpec(apply=self._apply, params=g.params)
+        return TraceSpec(apply=self._apply, params=self._lead())
 
     # -- degradation (parity 1435-1448, divergence documented above) ---------------
 

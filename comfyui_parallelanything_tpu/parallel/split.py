@@ -134,6 +134,35 @@ def _is_array(x: Any) -> bool:
     return isinstance(x, (jax.Array, np.ndarray))
 
 
+def is_arraylike(v: Any) -> bool:
+    """Duck-typed array check (tracers included) — broader than ``_is_array``,
+    which the split-math above keeps strict so jit statics never split."""
+    return hasattr(v, "shape") and hasattr(v, "dtype")
+
+
+def pad_leaf(a, pad: int):
+    """Pad dim0 by repeating the last element (sliced off after the SPMD call)."""
+    if pad == 0:
+        return a
+    import jax.numpy as jnp
+
+    return jnp.concatenate([a, jnp.repeat(a[-1:], pad, axis=0)], axis=0)
+
+
+def slice_padded(out, batch: int, padded: int):
+    """Un-pad: slice dim0 back to ``batch`` on every array leaf that carries the
+    padded batch dimension (dicts/tuples/lists handled by tree mapping)."""
+    if padded == batch:
+        return out
+
+    def fix(leaf):
+        if is_arraylike(leaf) and leaf.ndim > 0 and leaf.shape[0] == padded:
+            return leaf[:batch]
+        return leaf
+
+    return jax.tree.map(fix, out)
+
+
 def batch_size_of(x: Any) -> int:
     """Batch size of a forward input: dim0 of an array, else dim0 of the first array
     inside a list/tuple, else 1 (parity: get_batch_size, 1210-1220)."""

@@ -157,6 +157,7 @@ class StableDiffusionPipeline:
         init_image: jnp.ndarray | None = None,
         denoise: float = 1.0,
         mask: jnp.ndarray | None = None,
+        compile_loop: bool = False,
     ) -> jnp.ndarray:
         """Returns float images (B, height, width, 3) in [0, 1]. img2img: pass
         ``init_image`` (B or 1, height, width, 3 floats in [0, 1]) with
@@ -217,6 +218,7 @@ class StableDiffusionPipeline:
             karras=karras,
             scheduler=scheduler,
             callback=callback,
+            compile_loop=compile_loop,
             **kwargs,
         )
         return _to_images(self.vae.decode(latents))
@@ -256,6 +258,7 @@ class FluxPipeline:
         init_image: jnp.ndarray | None = None,
         denoise: float = 1.0,
         mask: jnp.ndarray | None = None,
+        compile_loop: bool = False,
     ) -> jnp.ndarray:
         """Returns float images (B, height, width, 3) in [0, 1]. ``guidance`` is
         the dev-family distilled guidance embed (None for schnell); true CFG runs
@@ -302,6 +305,7 @@ class FluxPipeline:
             uncond_context=uncond_context,
             uncond_kwargs=uncond_kwargs,
             callback=callback,
+            compile_loop=compile_loop,
             init_latent=init_latent,
             denoise=denoise,
             latent_mask=latent_mask,
@@ -351,6 +355,7 @@ class WanVideoPipeline:
         denoise: float = 1.0,
         image: jnp.ndarray | None = None,
         mask: jnp.ndarray | None = None,
+        compile_loop: bool = False,
     ) -> jnp.ndarray:
         """Returns float video (B, frames, height, width, 3) in [0, 1]. WAN uses
         true CFG (cfg_scale>1 with the negative prompt) and a large flow shift;
@@ -432,6 +437,7 @@ class WanVideoPipeline:
             cfg_scale=cfg_scale if use_cfg else 1.0,
             uncond_context=uncond_context,
             callback=callback,
+            compile_loop=compile_loop,
             init_latent=init_latent,
             denoise=denoise,
             latent_mask=latent_mask,
@@ -545,6 +551,7 @@ class Sd3Pipeline:
         init_image: jnp.ndarray | None = None,
         denoise: float = 1.0,
         mask: jnp.ndarray | None = None,
+        compile_loop: bool = False,
     ) -> jnp.ndarray:
         """Returns float images (B, height, width, 3) in [0, 1]; same
         img2img/inpaint contract as the other image pipelines."""
@@ -590,6 +597,7 @@ class Sd3Pipeline:
             uncond_context=uncond_context,
             uncond_kwargs=uncond_kwargs,
             callback=callback,
+            compile_loop=compile_loop,
             init_latent=init_latent,
             denoise=denoise,
             latent_mask=latent_mask,

@@ -1,0 +1,651 @@
+"""Whole-loop compiled sampling: the entire denoise loop as ONE jitted program.
+
+The reference's hot path re-enters the (monkey-patched) ``forward`` from Python
+every denoise step (any_device_parallel.py:1287) — cheap on CUDA, but on TPU
+each re-entry pays dispatch latency and re-allocates the latent in HBM. This
+module compiles the *whole sampler loop* — schedule walk, CFG doubling, model
+forward, latent update, optional inpaint-mask blend — into a single XLA program
+via ``lax.scan``, with the input latent **donated** so every intermediate x_t
+lives in the scan carry and the per-step host round-trip disappears.
+
+Opt-in via ``run_sampler(..., compile_loop=True)``. The compiled path covers
+the single-program cases (bare models; single-platform-group ParallelModel
+chains, replicated or FSDP). It intentionally does NOT cover:
+
+- heterogeneous chains (host-side scatter between per-platform programs cannot
+  live inside one XLA program) — falls back to the eager loops;
+- user callbacks (arbitrary Python per step) — falls back; the latent-mask
+  inpainting hook IS supported, traced into the loop;
+- step-level OOM demotion (parity 1435-1448): one program means one
+  allocation decision at compile time. Elasticity stays with the eager path.
+
+Each scan sampler mirrors its eager twin in ``k_samplers.py``/``ddim.py``/
+``flow.py`` op-for-op (Python schedule branches become ``jnp.where`` on the
+step index); ``tests/test_compiled.py`` pins eager/compiled equivalence for
+the full sampler menu.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import weakref
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.split import (
+    is_arraylike as _is_arraylike,
+    pad_leaf as _pad_leaf,
+    slice_padded as _slice_padded,
+)
+from .cfg import double_kwargs, rescale_guidance
+from .k_samplers import RNG_SAMPLERS, EpsDenoiser, lms_coefficient_matrix
+
+__all__ = [
+    "TraceSpec",
+    "trace_spec_of",
+    "compiled_k_sample",
+    "compiled_ddim_sample",
+    "compiled_flow_sample",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSpec:
+    """A model reduced to what one XLA program needs: a pure apply + params
+    (already placed/sharded), and the mesh to pin the batch axis to (None for
+    single-device models)."""
+
+    apply: Callable[..., Any]  # (params, x, t, context, **kwargs)
+    params: Any
+    mesh: Any = None
+    data_axis: str | None = None
+
+
+_plain_callable_specs: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def trace_spec_of(model) -> TraceSpec | None:
+    """A TraceSpec for ``model``, or None when it cannot run as one program.
+
+    ParallelModel exposes ``.traceable()`` (None for hybrid chains / active
+    sequence-parallel contexts); DiffusionModel / ``(apply, params)`` are pure
+    by construction; a bare callable is *assumed* pure — the documented
+    contract of ``compile_loop=True``."""
+    traceable = getattr(model, "traceable", None)
+    if callable(traceable):
+        return traceable()
+    apply = getattr(model, "apply", None)
+    params = getattr(model, "params", None)
+    if callable(apply) and params is not None:
+        return TraceSpec(apply=apply, params=params)
+    if isinstance(model, tuple) and len(model) == 2 and callable(model[0]):
+        return TraceSpec(apply=model[0], params=model[1])
+    if callable(model):
+        spec = _plain_callable_specs.get(model)
+        if spec is None:
+
+            def apply_plain(params, x, t, context=None, *, _m=model, **kwargs):
+                return _m(x, t, context, **kwargs)
+
+            spec = TraceSpec(apply=apply_plain, params=())
+            _plain_callable_specs[model] = spec
+        return spec
+    return None
+
+
+# ---------------------------------------------------------------------------
+# placement: pad the batch to the data-axis width and shard (the compiled-path
+# analogue of _dp_on_group's place(); orchestrator.py applies it per step, here
+# it happens once at loop entry)
+# ---------------------------------------------------------------------------
+
+
+def _place_batch(tree, batch: int, padded: int, mesh, data_axis):
+    """Pad+shard batch-dim leaves, replicate other array leaves (mesh case);
+    pad only on single-device (mesh None)."""
+    if mesh is None:
+        if padded == batch:
+            return tree
+        return jax.tree.map(
+            lambda l: _pad_leaf(l, padded - batch)
+            if _is_arraylike(l) and l.ndim > 0 and l.shape[0] == batch
+            else l,
+            tree,
+        )
+    sharded = NamedSharding(mesh, P(data_axis))
+    repl = NamedSharding(mesh, P())
+
+    def leaf(l):
+        if not _is_arraylike(l):
+            return l
+        if l.ndim > 0 and l.shape[0] == batch:
+            return jax.device_put(_pad_leaf(l, padded - batch), sharded)
+        return jax.device_put(l, repl)
+
+    return jax.tree.map(leaf, tree)
+
+
+def _constrain(x, mesh, data_axis):
+    """Re-pin the carry's batch sharding each step so XLA's propagation can't
+    drift it onto a replicated layout mid-loop."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(data_axis)))
+
+
+def step_keys(rng, n: int) -> jnp.ndarray:
+    """Per-step keys via the same iterative ``rng, sub = split(rng)`` chain the
+    eager stochastic samplers consume, so compiled noise == eager noise."""
+    keys = []
+    for _ in range(n):
+        rng, sub = jax.random.split(rng)
+        keys.append(sub)
+    return jnp.stack(keys)
+
+
+def _mask_blend(x, mask, keep):
+    return x * mask + keep * (1.0 - mask)
+
+
+# ---------------------------------------------------------------------------
+# k-family scan loops (sigma-space). Each mirrors its eager twin; `denoise`
+# is an EpsDenoiser built inside the jitted program.
+# ---------------------------------------------------------------------------
+
+
+def _scan_euler(denoise, x, sigmas, keys, post, constrain):
+    def body(x, per):
+        i, s, s_next = per
+        x0 = denoise(x, s)
+        d = (x - x0) / s
+        x = x + d * (s_next - s)
+        return constrain(post(i, x)), None
+
+    n = len(sigmas) - 1
+    x, _ = jax.lax.scan(body, x, (jnp.arange(n), sigmas[:-1], sigmas[1:]))
+    return x
+
+
+def _scan_euler_ancestral(denoise, x, sigmas, keys, post, constrain, eta=1.0):
+    def body(x, per):
+        i, s, s_next, key = per
+        x0 = denoise(x, s)
+        sigma_up = jnp.minimum(
+            s_next,
+            eta * jnp.sqrt(jnp.maximum(s_next**2 * (s**2 - s_next**2) / s**2, 0.0)),
+        )
+        sigma_down = jnp.sqrt(jnp.maximum(s_next**2 - sigma_up**2, 0.0))
+        d = (x - x0) / s
+        x = x + d * (sigma_down - s)
+        noise = jax.random.normal(key, x.shape, x.dtype)
+        x = x + jnp.where(s_next > 0, sigma_up, 0.0) * noise
+        return constrain(post(i, x)), None
+
+    n = len(sigmas) - 1
+    x, _ = jax.lax.scan(body, x, (jnp.arange(n), sigmas[:-1], sigmas[1:], keys))
+    return x
+
+
+def _scan_heun(denoise, x, sigmas, keys, post, constrain):
+    # Interior steps have s_next > 0; the final step (s_next == 0) is Euler,
+    # which collapses to x = denoise(x, s) — run it as an epilogue so the scan
+    # body keeps the uniform two-call shape without dividing by zero.
+    def body(x, per):
+        i, s, s_next = per
+        x0 = denoise(x, s)
+        d = (x - x0) / s
+        x_pred = x + d * (s_next - s)
+        x0_2 = denoise(x_pred, s_next)
+        d2 = (x_pred - x0_2) / s_next
+        x = x + 0.5 * (d + d2) * (s_next - s)
+        return constrain(post(i, x)), None
+
+    n = len(sigmas) - 1
+    x, _ = jax.lax.scan(body, x, (jnp.arange(n - 1), sigmas[:-2], sigmas[1:-1]))
+    x = denoise(x, sigmas[n - 1])
+    return constrain(post(n - 1, x))
+
+
+def _scan_dpmpp_2m(denoise, x, sigmas, keys, post, constrain):
+    s_prev = jnp.concatenate([sigmas[:1], sigmas[:-2]])  # dummy at i==0
+
+    def body(carry, per):
+        x, old_x0 = carry
+        i, s, s_next, sp = per
+        x0 = denoise(x, s)
+        t, t_next = -jnp.log(s), -jnp.log(jnp.maximum(s_next, 1e-10))
+        h = t_next - t
+        simple = (s_next / s) * x - jnp.expm1(-h) * x0
+        h_last = t - (-jnp.log(sp))
+        r = jnp.where(i == 0, 1.0, h_last / h)
+        x0_prime = (1 + 1 / (2 * r)) * x0 - (1 / (2 * r)) * old_x0
+        multi = (s_next / s) * x - jnp.expm1(-h) * x0_prime
+        x = jnp.where((i == 0) | (s_next == 0.0), simple, multi)
+        x = constrain(post(i, x))
+        return (x, x0), None
+
+    n = len(sigmas) - 1
+    (x, _), _ = jax.lax.scan(
+        body, (x, jnp.zeros_like(x)), (jnp.arange(n), sigmas[:-1], sigmas[1:], s_prev)
+    )
+    return x
+
+
+def _scan_dpmpp_2m_sde(denoise, x, sigmas, keys, post, constrain, eta=1.0):
+    def body(carry, per):
+        x, old_x0, h_last, have = carry
+        i, s, s_next, key = per
+        x0 = denoise(x, s)
+        last = s_next == 0.0
+        t, t_next = -jnp.log(s), -jnp.log(jnp.maximum(s_next, 1e-10))
+        h = t_next - t
+        eta_h = eta * h
+        x_new = (s_next / s) * jnp.exp(-eta_h) * x + (-jnp.expm1(-h - eta_h)) * x0
+        r_safe = jnp.where(have > 0, h_last / h, 1.0)
+        x_new = x_new + have * (
+            0.5 * (-jnp.expm1(-h - eta_h)) * (1 / r_safe) * (x0 - old_x0)
+        )
+        if eta > 0:
+            x_new = x_new + s_next * jnp.sqrt(
+                jnp.maximum(-jnp.expm1(-2 * eta_h), 0.0)
+            ) * jax.random.normal(key, x.shape, x.dtype)
+        x = jnp.where(last, x0, x_new)
+        x = constrain(post(i, x))
+        # History updates only on non-final steps (k-diffusion keeps h_last
+        # untouched when s_next == 0); old_x0 updates unconditionally, matching
+        # the eager loop's assignment outside the else-branch.
+        return (x, x0, jnp.where(last, h_last, h), jnp.where(last, have, 1.0)), None
+
+    n = len(sigmas) - 1
+    (x, _, _, _), _ = jax.lax.scan(
+        body,
+        (x, jnp.zeros_like(x), jnp.float32(1.0), jnp.float32(0.0)),
+        (jnp.arange(n), sigmas[:-1], sigmas[1:], keys),
+    )
+    return x
+
+
+def _scan_dpmpp_3m_sde(denoise, x, sigmas, keys, post, constrain, eta=1.0):
+    def body(carry, per):
+        x, x0_1, x0_2, h_1, h_2, count = carry
+        i, s, s_next, key = per
+        x0 = denoise(x, s)
+        last = s_next == 0.0
+        t, t_next = -jnp.log(s), -jnp.log(jnp.maximum(s_next, 1e-10))
+        h = t_next - t
+        h_eta = h * (eta + 1.0)
+        base = jnp.exp(-h_eta) * x + (-jnp.expm1(-h_eta)) * x0
+        phi_2 = jnp.expm1(-h_eta) / h_eta + 1.0
+        # 2nd-order correction (one history entry)
+        r_2 = h_1 / h
+        d_2 = (x0 - x0_1) / r_2
+        second = base + phi_2 * d_2
+        # 3rd-order correction (two history entries)
+        r0, r1 = h_1 / h, h_2 / h
+        d1_0 = (x0 - x0_1) / r0
+        d1_1 = (x0_1 - x0_2) / r1
+        d1 = d1_0 + (d1_0 - d1_1) * r0 / (r0 + r1)
+        d2 = (d1_0 - d1_1) / (r0 + r1)
+        phi_3 = phi_2 / h_eta - 0.5
+        third = base + phi_2 * d1 - phi_3 * d2
+        x_new = jnp.where(count >= 2, third, jnp.where(count == 1, second, base))
+        if eta > 0:
+            x_new = x_new + s_next * jnp.sqrt(
+                jnp.maximum(-jnp.expm1(-2.0 * eta * h), 0.0)
+            ) * jax.random.normal(key, x.shape, x.dtype)
+        x = jnp.where(last, x0, x_new)
+        x = constrain(post(i, x))
+        # No history update on a zero step (eager `continue`).
+        carry = (
+            x,
+            jnp.where(last, x0_1, x0),
+            jnp.where(last, x0_2, x0_1),
+            jnp.where(last, h_1, h),
+            jnp.where(last, h_2, h_1),
+            jnp.where(last, count, count + 1),
+        )
+        return carry, None
+
+    n = len(sigmas) - 1
+    z = jnp.zeros_like(x)
+    (x, *_), _ = jax.lax.scan(
+        body,
+        (x, z, z, jnp.float32(1.0), jnp.float32(1.0), jnp.int32(0)),
+        (jnp.arange(n), sigmas[:-1], sigmas[1:], keys),
+    )
+    return x
+
+
+def _scan_lms(denoise, x, sigmas, keys, post, constrain, coeffs=None):
+    # Coefficients depend only on the (concrete) schedule — precomputed on the
+    # host by the entry point (sigmas is a tracer here), zero-padded per row to
+    # the running order, so the scan body is a fixed-shape history contraction.
+    order = coeffs.shape[1]
+
+    def body(carry, per):
+        x, hist = carry
+        i, s = per
+        x0 = denoise(x, s)
+        d = (x - x0) / s
+        hist = jnp.roll(hist, 1, axis=0).at[0].set(d)  # hist[j] = d_{i-j}
+        x = x + jnp.tensordot(coeffs[i], hist, axes=([0], [0]))
+        x = constrain(post(i, x))
+        return (x, hist), None
+
+    n = len(sigmas) - 1
+    hist0 = jnp.zeros((order,) + x.shape, x.dtype)
+    (x, _), _ = jax.lax.scan(body, (x, hist0), (jnp.arange(n), sigmas[:-1]))
+    return x
+
+
+SCAN_SAMPLERS = {
+    "euler": _scan_euler,
+    "euler_ancestral": _scan_euler_ancestral,
+    "heun": _scan_heun,
+    "lms": _scan_lms,
+    "dpmpp_2m": _scan_dpmpp_2m,
+    "dpmpp_2m_sde": _scan_dpmpp_2m_sde,
+    "dpmpp_3m_sde": _scan_dpmpp_3m_sde,
+}
+
+
+# ---------------------------------------------------------------------------
+# the jitted loop programs. Unhashable static kwargs follow the orchestrator's
+# pattern (orchestrator.py _jit_for): bake them into a closure and cache the
+# jitted closure by static_kwargs_key, so repeated run_sampler calls with the
+# same shapes/config hit the compile cache instead of re-tracing.
+# ---------------------------------------------------------------------------
+
+_loop_jits: dict[tuple, Callable] = {}
+# Bounded FIFO: entries hold the spec's apply fn (strongly) and a compiled
+# executable — a long-lived host cycling through many models must not grow
+# without limit. aggressive_cleanup(clear_compile_cache=True) (the teardown /
+# purge_cache path) empties it entirely via clear_compiled_loops().
+_LOOP_CACHE_MAX = 32
+
+
+def clear_compiled_loops() -> None:
+    """Drop every cached loop program (called from aggressive_cleanup on the
+    purge/teardown path, so ParallelModel.cleanup() reaches this cache too)."""
+    _loop_jits.clear()
+
+
+def _donate_for(spec: TraceSpec) -> bool:
+    """Donate the input latent only off-CPU — the CPU backend doesn't implement
+    donation and would warn on every call."""
+    if spec.mesh is not None:
+        return spec.mesh.devices.flat[0].platform != "cpu"
+    leaves = jax.tree.leaves(spec.params)
+    if leaves and hasattr(leaves[0], "devices"):
+        return next(iter(leaves[0].devices())).platform != "cpu"
+    return jax.default_backend() != "cpu"
+
+
+def _get_loop_jit(kind: str, spec: TraceSpec, static: dict, meta: tuple, build):
+    """Cache key mirrors the repo's jit-cache discipline: the ambient
+    sequence_parallel context is read at trace time inside ops.attention, so it
+    must key the cache (ops/attention.py contract; orchestrator._jit_for does
+    the same). ``build`` must close over (apply, mesh, data_axis) only — NOT
+    the params pytree — so params always arrive as the first call argument
+    (a bare callable's apply may still close over its own weights, which is why
+    the cache is bounded and clearable above)."""
+    from ..ops.attention import sequence_ctx_key
+    from ..parallel.split import static_kwargs_key
+
+    key = (kind, spec.apply, static_kwargs_key(static), meta, spec.mesh,
+           spec.data_axis, sequence_ctx_key())
+    fn = _loop_jits.get(key)
+    if fn is None:
+        while len(_loop_jits) >= _LOOP_CACHE_MAX:
+            _loop_jits.pop(next(iter(_loop_jits)))
+        impl = build(dict(static))
+        donate = (1,) if _donate_for(spec) else ()
+        fn = _loop_jits[key] = jax.jit(impl, donate_argnums=donate)
+    return fn
+
+
+def _donation_safe(x, *others):
+    """A donated buffer must not alias another argument: ddim/flow at
+    denoise=1.0 pass the same array as both the latent and the mask-noise
+    reference. Copy the latent when aliased."""
+    if any(o is x for o in others):
+        return jnp.copy(x)
+    return x
+
+
+def _model_fn(apply, params, static_kwargs):
+    def fn(x, t, context=None, **kwargs):
+        return apply(params, x, t, context, **kwargs, **static_kwargs)
+
+    return fn
+
+
+def _post_from(mask, keep_at):
+    if mask is None:
+        return lambda i, x: x
+    return lambda i, x: _mask_blend(x, mask, keep_at(i))
+
+
+# ---------------------------------------------------------------------------
+# entry points (called by sampling.runner when compile_loop=True)
+# ---------------------------------------------------------------------------
+
+
+def _prep(spec: TraceSpec, batch: int, trees: list):
+    """Pad the batch to the data-axis width and place every input tree; returns
+    (placed_trees, padded)."""
+    if spec.mesh is not None:
+        n = spec.mesh.shape[spec.data_axis]
+    else:
+        n = 1
+    padded = batch + ((-batch) % n)
+    return [
+        _place_batch(t, batch, padded, spec.mesh, spec.data_axis) for t in trees
+    ], padded
+
+
+def compiled_k_sample(
+    spec: TraceSpec, sampler: str, x, sigmas, context, *,
+    cfg_scale, uncond_context, uncond_kwargs, acp, prediction, cfg_rescale,
+    rng=None, mask=None, mask_init=None, mask_noise=None, model_kwargs=None,
+):
+    from ..parallel.split import partition_kwargs
+
+    batch = x.shape[0]
+    traced, static = partition_kwargs(model_kwargs or {})
+    # Static (non-array) uncond kwargs are ignored: double_kwargs only swaps
+    # batch-dim arrays into the uncond half, same as the eager denoiser.
+    u_traced, _ = partition_kwargs(uncond_kwargs or {})
+    keys = (
+        step_keys(jax.random.fold_in(rng, 1), len(sigmas) - 1)
+        if sampler in RNG_SAMPLERS
+        else None
+    )
+    # LMS integrates its Adams-Bashforth coefficients from the concrete
+    # schedule — done here (sigmas is a tracer inside the loop program).
+    aux = (
+        jnp.asarray(lms_coefficient_matrix(np.asarray(sigmas)), x.dtype)
+        if sampler == "lms"
+        else None
+    )
+    x = _donation_safe(x, mask_noise, mask_init)
+    placed, padded = _prep(
+        spec, batch,
+        [x, context, uncond_context, traced, u_traced, mask, mask_init, mask_noise],
+    )
+    x, context, uncond_context, traced, u_traced, mask, mask_init, mask_noise = placed
+    meta = (sampler, float(cfg_scale), float(cfg_rescale), prediction)
+    apply_fn, mesh, axis = spec.apply, spec.mesh, spec.data_axis
+
+    def build(bound_static):
+        def impl(params, x, sigmas, keys, aux, context, uncond_context, kwargs,
+                 u_kwargs, acp, mask, mask_init, mask_noise):
+            denoise = EpsDenoiser(
+                _model_fn(apply_fn, params, bound_static), context,
+                cfg_scale=meta[1], uncond_context=uncond_context,
+                uncond_kwargs=u_kwargs, alphas_cumprod=acp,
+                prediction=meta[3], cfg_rescale=meta[2], **kwargs,
+            )
+            post = _post_from(mask, lambda i: mask_init + mask_noise * sigmas[i + 1])
+            constrain = lambda v: _constrain(v, mesh, axis)  # noqa: E731
+            sampler_fn = SCAN_SAMPLERS[meta[0]]
+            if meta[0] == "lms":
+                return sampler_fn(denoise, x, sigmas, keys, post, constrain,
+                                  coeffs=aux)
+            return sampler_fn(denoise, x, sigmas, keys, post, constrain)
+
+        return impl
+
+    fn = _get_loop_jit("k", spec, static, meta, build)
+    out = fn(
+        spec.params, x, sigmas, keys, aux, context, uncond_context, traced,
+        u_traced or None, acp, mask, mask_init, mask_noise,
+    )
+    return _slice_padded(out, batch, padded)
+
+
+def compiled_ddim_sample(
+    spec: TraceSpec, x, ts, acp, context, *,
+    cfg_scale, uncond_context, uncond_kwargs, prediction, cfg_rescale,
+    mask=None, mask_init=None, mask_noise=None, model_kwargs=None,
+):
+    from ..parallel.split import partition_kwargs
+
+    batch_orig = x.shape[0]
+    traced, static = partition_kwargs(model_kwargs or {})
+    u_traced, _ = partition_kwargs(uncond_kwargs or {})
+    a_t = acp[ts]
+    a_prev = jnp.concatenate([acp[ts[1:]], jnp.ones((1,), acp.dtype)])
+    x = _donation_safe(x, mask_noise, mask_init)
+    placed, padded = _prep(
+        spec, batch_orig,
+        [x, context, uncond_context, traced, u_traced, mask, mask_init, mask_noise],
+    )
+    x, context, uncond_context, traced, u_traced, mask, mask_init, mask_noise = placed
+    meta = (float(cfg_scale), float(cfg_rescale), prediction)
+    apply_fn, mesh, axis = spec.apply, spec.mesh, spec.data_axis
+
+    def build(bound_static):
+        def impl(params, x, ts, a_t, a_prev, context, uncond_context, kwargs,
+                 u_kwargs, mask, mask_init, mask_noise):
+            model = _model_fn(apply_fn, params, bound_static)
+            cfg_scale_, cfg_rescale_, prediction_ = meta
+            batch = x.shape[0]
+            use_cfg = cfg_scale_ != 1.0 and uncond_context is not None
+            post = _post_from(
+                mask,
+                lambda i: jnp.sqrt(a_prev[i]) * mask_init
+                + jnp.sqrt(1.0 - a_prev[i]) * mask_noise,
+            )
+
+            def body(x, per):
+                i, t, at, aprev = per
+                t_vec = jnp.full((batch,), t, jnp.float32)
+                if use_cfg:
+                    kw = double_kwargs(kwargs, u_kwargs, batch)
+                    out_both = model(
+                        jnp.concatenate([x, x], axis=0),
+                        jnp.concatenate([t_vec, t_vec], axis=0),
+                        jnp.concatenate([context, uncond_context], axis=0),
+                        **kw,
+                    )
+                    out_c, out_u = jnp.split(out_both, 2, axis=0)
+                    out = out_u + cfg_scale_ * (out_c - out_u)
+                    out = rescale_guidance(out, out_c, cfg_rescale_)
+                else:
+                    out = model(x, t_vec, context, **kwargs)
+                if prediction_ == "v":
+                    x0 = jnp.sqrt(at) * x - jnp.sqrt(1.0 - at) * out
+                    eps = (x - jnp.sqrt(at) * x0) / jnp.sqrt(1.0 - at)
+                else:
+                    eps = out
+                    x0 = (x - jnp.sqrt(1.0 - at) * eps) / jnp.sqrt(at)
+                x = jnp.sqrt(aprev) * x0 + jnp.sqrt(1.0 - aprev) * eps
+                return _constrain(post(i, x), mesh, axis), None
+
+            n = len(ts)
+            x, _ = jax.lax.scan(body, x, (jnp.arange(n), ts, a_t, a_prev))
+            return x
+
+        return impl
+
+    fn = _get_loop_jit("ddim", spec, static, meta, build)
+    out = fn(
+        spec.params, x, ts, a_t, a_prev, context, uncond_context, traced,
+        u_traced or None, mask, mask_init, mask_noise,
+    )
+    return _slice_padded(out, batch_orig, padded)
+
+
+def compiled_flow_sample(
+    spec: TraceSpec, x, ts, context, *,
+    cfg_scale, uncond_context, uncond_kwargs, guidance, cfg_rescale,
+    mask=None, mask_init=None, mask_noise=None, model_kwargs=None,
+):
+    from ..parallel.split import partition_kwargs
+
+    batch_orig = x.shape[0]
+    traced, static = partition_kwargs(model_kwargs or {})
+    u_traced, _ = partition_kwargs(uncond_kwargs or {})
+    x = _donation_safe(x, mask_noise, mask_init)
+    placed, padded = _prep(
+        spec, batch_orig,
+        [x, context, uncond_context, traced, u_traced, mask, mask_init, mask_noise],
+    )
+    x, context, uncond_context, traced, u_traced, mask, mask_init, mask_noise = placed
+    meta = (
+        float(cfg_scale), float(cfg_rescale),
+        None if guidance is None else float(guidance),
+    )
+    apply_fn, mesh, axis = spec.apply, spec.mesh, spec.data_axis
+
+    def build(bound_static):
+        def impl(params, x, ts, context, uncond_context, kwargs, u_kwargs,
+                 mask, mask_init, mask_noise):
+            model = _model_fn(apply_fn, params, bound_static)
+            cfg_scale_, cfg_rescale_, guidance_ = meta
+            batch = x.shape[0]
+            use_cfg = cfg_scale_ != 1.0 and uncond_context is not None
+            kw = dict(kwargs)
+            if guidance_ is not None:
+                kw["guidance"] = jnp.full((batch,), guidance_, jnp.float32)
+            post = _post_from(
+                mask,
+                lambda i: (1.0 - ts[i + 1]) * mask_init + ts[i + 1] * mask_noise,
+            )
+
+            def body(x, per):
+                i, t, t_next = per
+                t_vec = jnp.full((batch,), t, jnp.float32)
+                if use_cfg:
+                    kw2 = double_kwargs(kw, u_kwargs, batch)
+                    v_both = model(
+                        jnp.concatenate([x, x], axis=0),
+                        jnp.concatenate([t_vec, t_vec], axis=0),
+                        jnp.concatenate([context, uncond_context], axis=0),
+                        **kw2,
+                    )
+                    v_c, v_u = jnp.split(v_both, 2, axis=0)
+                    v = v_u + cfg_scale_ * (v_c - v_u)
+                    v = rescale_guidance(v, v_c, cfg_rescale_)
+                else:
+                    v = model(x, t_vec, context, **kw)
+                x = x + (t_next - t) * v
+                return _constrain(post(i, x), mesh, axis), None
+
+            n = len(ts) - 1
+            x, _ = jax.lax.scan(body, x, (jnp.arange(n), ts[:-1], ts[1:]))
+            return x
+
+        return impl
+
+    fn = _get_loop_jit("flow", spec, static, meta, build)
+    out = fn(
+        spec.params, x, ts, context, uncond_context, traced, u_traced or None,
+        mask, mask_init, mask_noise,
+    )
+    return _slice_padded(out, batch_orig, padded)

@@ -403,11 +403,12 @@ def sample_dpmpp_3m_sde(denoise, x, sigmas, rng, eta: float = 1.0, callback=None
     return x
 
 
-def sample_lms(denoise, x, sigmas, order: int = 4, callback=None):
-    """Linear multistep (Katherine Crowson's LMS): Adams-Bashforth over the
-    sigma schedule with numerically integrated coefficients."""
-    import numpy as np
-
+def lms_coefficient_matrix(sigmas, order: int = 4):
+    """Adams-Bashforth coefficients for LMS over a concrete sigma schedule:
+    ``C[i, j]`` weights the j-steps-back derivative at step i (zero-padded past
+    the running order ``min(i+1, order)``). Shared by the eager loop below and
+    the whole-loop compiled sampler (compiled.py), which needs them as one
+    host-precomputed array — they depend only on the schedule, not the latent."""
     sig = np.asarray(sigmas, np.float64)
 
     def lms_coeff(order_, i, j):
@@ -427,6 +428,19 @@ def sample_lms(denoise, x, sigmas, order: int = 4, callback=None):
         tau = 0.5 * (b - a) * nodes + 0.5 * (b + a)
         return float(0.5 * (b - a) * np.sum(weights * np.vectorize(poly)(tau)))
 
+    n = len(sig) - 1
+    C = np.zeros((n, order), np.float64)
+    for i in range(n):
+        cur = min(i + 1, order)
+        for j in range(cur):
+            C[i, j] = lms_coeff(cur, i, j)
+    return C
+
+
+def sample_lms(denoise, x, sigmas, order: int = 4, callback=None):
+    """Linear multistep (Katherine Crowson's LMS): Adams-Bashforth over the
+    sigma schedule with numerically integrated coefficients."""
+    C = lms_coefficient_matrix(sigmas, order)
     ds = []
     for i in range(len(sigmas) - 1):
         x0 = denoise(x, sigmas[i])
@@ -435,8 +449,7 @@ def sample_lms(denoise, x, sigmas, order: int = 4, callback=None):
         if len(ds) > order:
             ds.pop(0)
         cur = min(i + 1, order)
-        coeffs = [lms_coeff(cur, i, j) for j in range(cur)]
-        x = x + sum(c * d_ for c, d_ in zip(coeffs, reversed(ds)))
+        x = x + sum(C[i, j] * d_ for j, d_ in zip(range(cur), reversed(ds)))
         x = apply_callback(callback, i, x)
     return x
 

@@ -22,6 +22,26 @@ from .k_samplers import (
 SAMPLER_NAMES = ("ddim", *K_SAMPLERS, "flow_euler")
 
 
+def _compiled_spec(model, callback):
+    """TraceSpec for the whole-loop compiled path, or None with a logged reason
+    (the caller falls back to the eager per-step loops)."""
+    from ..utils import get_logger
+    from .compiled import trace_spec_of
+
+    if callback is not None:
+        get_logger().info(
+            "compile_loop: user callback cannot trace into the loop; eager path"
+        )
+        return None
+    spec = trace_spec_of(model)
+    if spec is None:
+        get_logger().info(
+            "compile_loop: model is not single-program traceable (hybrid chain "
+            "or active sequence-parallel context); eager path"
+        )
+    return spec
+
+
 def run_sampler(
     model,
     noise: jnp.ndarray,
@@ -43,6 +63,7 @@ def run_sampler(
     latent_mask: jnp.ndarray | None = None,
     prediction: str = "eps",
     cfg_rescale: float = 0.0,
+    compile_loop: bool = False,
     **model_kwargs,
 ) -> jnp.ndarray:
     """Drive ``model`` from ``noise`` to a clean latent with the named sampler.
@@ -60,7 +81,14 @@ def run_sampler(
     Inpainting: ``latent_mask`` (broadcastable to the latent; 1 = denoise this
     region, 0 = keep ``init_latent``) re-pins the keep region to the init noised
     to each step's level after every sampler step — the ComfyUI latent-noise-
-    mask mechanism. Works at any ``denoise`` (requires ``init_latent``)."""
+    mask mechanism. Works at any ``denoise`` (requires ``init_latent``).
+
+    ``compile_loop=True`` compiles the ENTIRE denoise loop into one XLA program
+    (sampling/compiled.py): zero per-step dispatch, latent donated, inpaint mask
+    traced in. Opt-in because it covers single-program models only (bare models
+    and single-platform-group parallel chains) and trades away per-step OOM
+    demotion; hybrid chains or a user ``callback`` silently fall back to the
+    eager loops (logged)."""
     use_cfg = cfg_scale != 1.0 and uncond_context is not None
     eff_cfg = cfg_scale if use_cfg else 1.0
     if not 0.0 < denoise <= 1.0:
@@ -72,6 +100,13 @@ def run_sampler(
                          "prediction applies to the eps-family samplers")
     img2img = init_latent is not None and denoise < 1.0
     total = max(steps, int(round(steps / denoise))) if img2img else steps
+    # Shared by every compiled-loop dispatch below: the traced inpaint-mask
+    # blend needs the init/noise references only when a mask is present.
+    compiled_mask_kw = dict(
+        mask=latent_mask,
+        mask_init=init_latent if latent_mask is not None else None,
+        mask_noise=noise if latent_mask is not None else None,
+    )
 
     def masked_callback(keep_at):
         """Blend the keep-region back after each step; the user callback (which
@@ -97,6 +132,21 @@ def run_sampler(
             # x_t = t·noise + (1-t)·x0 under the v = noise - x0 flow.
             ts = ts[-(steps + 1) :]
             x = ts[0] * noise + (1.0 - ts[0]) * init_latent
+        if compile_loop:
+            spec = _compiled_spec(model, callback)
+            if spec is not None:
+                from .compiled import compiled_flow_sample
+
+                if x is noise:
+                    # The loop donates its latent; never donate the CALLER's
+                    # noise array (plain txt2img passes it through unchanged).
+                    x = jnp.copy(x)
+                return compiled_flow_sample(
+                    spec, x, ts, context, cfg_scale=eff_cfg,
+                    uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
+                    guidance=guidance, cfg_rescale=cfg_rescale,
+                    **compiled_mask_kw, model_kwargs=model_kwargs,
+                )
         cb = masked_callback(
             lambda i: (1.0 - ts[i + 1]) * init_latent + ts[i + 1] * noise
         )
@@ -129,6 +179,22 @@ def run_sampler(
             x = jnp.sqrt(a0) * init_latent + jnp.sqrt(1.0 - a0) * noise
         else:
             ts = ddim_timesteps(steps, acp.shape[0])
+
+        if compile_loop:
+            spec = _compiled_spec(model, callback)
+            if spec is not None:
+                from .compiled import compiled_ddim_sample
+
+                if x is noise:
+                    # See the flow branch: the donated latent must not be the
+                    # caller's noise array.
+                    x = jnp.copy(x)
+                return compiled_ddim_sample(
+                    spec, x, ts, acp, context, cfg_scale=eff_cfg,
+                    uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
+                    prediction=prediction, cfg_rescale=cfg_rescale,
+                    **compiled_mask_kw, model_kwargs=model_kwargs,
+                )
 
         def ddim_keep(i):
             a = acp[ts[i + 1]] if i + 1 < len(ts) else jnp.float32(1.0)
@@ -170,17 +236,28 @@ def run_sampler(
         else:
             keep = min(realized, max(1, round(steps * realized / total)))
             sigmas = sigmas[-(keep + 1) :]
+    x = noise * sigmas[0]
+    if img2img:
+        x = init_latent + x
+    if sampler in RNG_SAMPLERS and rng is None:
+        rng = jax.random.key(0)
+    if compile_loop:
+        spec = _compiled_spec(model, callback)
+        if spec is not None:
+            from .compiled import compiled_k_sample
+
+            return compiled_k_sample(
+                spec, sampler, x, sigmas, context, cfg_scale=eff_cfg,
+                uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
+                acp=acp, prediction=prediction, cfg_rescale=cfg_rescale, rng=rng,
+                **compiled_mask_kw, model_kwargs=model_kwargs,
+            )
     denoiser = EpsDenoiser(
         model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,
         uncond_kwargs=uncond_kwargs, alphas_cumprod=acp, prediction=prediction,
         cfg_rescale=cfg_rescale, **model_kwargs,
     )
-    x = noise * sigmas[0]
-    if img2img:
-        x = init_latent + x
     cb = masked_callback(lambda i: init_latent + noise * sigmas[i + 1])
     if sampler in RNG_SAMPLERS:
-        if rng is None:
-            rng = jax.random.key(0)
         return step_fn(denoiser, x, sigmas, jax.random.fold_in(rng, 1), callback=cb)
     return step_fn(denoiser, x, sigmas, callback=cb)

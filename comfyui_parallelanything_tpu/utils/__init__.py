@@ -1,9 +1,11 @@
 from .logging import get_logger, log_setup_summary, log_placement, log_degradation
 from .cleanup import aggressive_cleanup
+from .compile_cache import enable_compilation_cache
 from .metrics import StepTimer, StepStats, trace
 from .checks import assert_finite, checked
 
 __all__ = [
+    "enable_compilation_cache",
     "get_logger",
     "log_setup_summary",
     "log_placement",

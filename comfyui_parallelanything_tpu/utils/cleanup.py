@@ -20,4 +20,10 @@ import jax
 def aggressive_cleanup(clear_compile_cache: bool = False) -> None:
     gc.collect()
     if clear_compile_cache:
+        try:
+            from ..sampling.compiled import clear_compiled_loops
+
+            clear_compiled_loops()
+        except Exception:
+            pass
         jax.clear_caches()

@@ -1,0 +1,33 @@
+"""Persistent XLA compilation cache.
+
+The reference pays zero compile cost (CUDA eager kernels); on TPU every traced
+program costs a 20-40 s XLA compile on first use. Enabling JAX's persistent
+cache amortizes that across *processes* — a bench retried over a flaky tunnel,
+or a workflow host restarted between runs, re-loads compiled executables from
+disk instead of re-paying the compile (VERDICT r2 item 2c).
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.expanduser("~/.cache/comfyui_parallelanything_tpu/xla")
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (defaults to
+    ``$PA_TPU_COMPILE_CACHE`` or ``~/.cache/comfyui_parallelanything_tpu/xla``)
+    and lower the write thresholds so even fast-compiling programs persist.
+    Idempotent; returns the directory in use."""
+    import jax
+
+    cache_dir = (
+        cache_dir
+        or os.environ.get("PA_TPU_COMPILE_CACHE")
+        or _DEFAULT_DIR
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir

@@ -48,6 +48,10 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from comfyui_parallelanything_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+
     from comfyui_parallelanything_tpu.devices.discovery import is_tpu_device
     from comfyui_parallelanything_tpu.ops.attention import _xla_attention
     from comfyui_parallelanything_tpu.ops.pallas.flash_attention import (
