@@ -22,6 +22,9 @@ platform):
   shape). Full flux-dev (12B) needs FSDP over a v5e-8 pod slice; on a single chip
   this rung runs the dev *topology* at reduced depth so the shape (4096 img tokens
   of joint attention, bf16, pallas flash path) is what's measured.
+- ``flux_16_int8`` — FULL 19/38 flux-dev topology with int8-stored weights
+  (fits one v5e chip): the measured replacement for flux_16's analytic
+  full-depth extrapolation.
 - ``wan_video``— WAN-class video DiT, 16 frames 480p-latent batch=1 (sequence-
   dominant workload; temporal tokens ≈ video "batch").
 - ``smoke``    — reduced-width SD1.5 topology on CPU (no TPU attached).
@@ -107,6 +110,76 @@ def _rung_flux_16(jnp, rng):
             kwargs, "FLUX-class MMDiT bf16 batch=16 1024x1024 (reduced depth 4/8)")
 
 
+def _synth_int8_params(sds, min_size: int = 2**16):
+    """Materialize a quantized parameter pytree directly from abstract shapes,
+    on host CPU: large >=2-D leaves become ``QuantTensor(int8 zeros, const
+    scale)`` (the same min-size/channel-axis rule as quantize_params), small
+    leaves bf16 zeros. Matmul timing is value-independent, so zeros measure the
+    same compute as real weights — and a 12B high-precision pytree is never
+    materialized anywhere."""
+    import numpy as _np
+
+    import jax
+    import jax.numpy as jnp
+
+    from comfyui_parallelanything_tpu.models.quantize import QuantTensor
+
+    cpu = jax.devices("cpu")[0]
+
+    def synth(leaf):
+        shape = tuple(leaf.shape)
+        size = int(_np.prod(shape)) if shape else 1
+        with jax.default_device(cpu):
+            if len(shape) >= 2 and size >= min_size:
+                scale_shape = tuple(1 for _ in shape[:-1]) + (shape[-1],)
+                return QuantTensor(
+                    q=jnp.zeros(shape, jnp.int8),
+                    scale=jnp.full(scale_shape, 1e-2, jnp.float32),
+                )
+            return jnp.zeros(shape, jnp.bfloat16)
+
+    return jax.tree.map(synth, sds)
+
+
+def _rung_flux_16_int8(jnp, rng):
+    """FULL 19/38 flux-dev topology, int8-stored weights — the measured
+    replacement for flux_16's analytic depth bridge (VERDICT r2 item 3): a
+    ~12 GB int8 replica fits a 16 GB v5e chip, so full-depth s/it is a real
+    measurement, not a FLOP-ratio extrapolation. Weights are synthesized
+    directly as int8 (zeros; matmul timing is value-independent) from abstract
+    shapes — a 12B f32/bf16 pytree is never materialized anywhere. Dequantize
+    happens inside jit: int8 HBM reads, on-chip widening (models/quantize.py).
+    """
+    from comfyui_parallelanything_tpu.models import (
+        flux_abstract_params,
+        flux_dev_config,
+    )
+    from comfyui_parallelanything_tpu.models.api import DiffusionModel
+    from comfyui_parallelanything_tpu.models.flux import FluxModel
+    from comfyui_parallelanything_tpu.models.quantize import dequantize_params
+
+    batch, latent, ctx_len = 16, 128, 512
+    cfg = flux_dev_config(dtype=jnp.bfloat16)
+    sds = flux_abstract_params(cfg, sample_shape=(1, 32, 32, 16), txt_len=ctx_len)
+    params = _synth_int8_params(sds)
+    module = FluxModel(cfg)
+
+    def apply(p, x, t, context=None, **kw):
+        return module.apply(
+            {"params": dequantize_params(p, jnp.bfloat16)}, x, t, context, **kw
+        )
+
+    model = DiffusionModel(apply=apply, params=params, name="flux-dev-int8",
+                           config=cfg)
+    kwargs = {
+        "y": jnp.zeros((batch, cfg.vec_in_dim), jnp.float32),
+        "guidance": jnp.full((batch,), 3.5, jnp.float32),
+    }
+    return (model, batch, (batch, latent, latent, 16), ctx_len, cfg.context_in_dim,
+            kwargs, "FLUX-dev MMDiT FULL depth 19/38, int8 weights, batch=16 "
+                    "1024x1024 (measured full depth, single chip)")
+
+
 def _rung_wan_video(jnp, rng):
     from comfyui_parallelanything_tpu.models import build_wan, wan_1_3b_config
 
@@ -145,6 +218,7 @@ _RUNGS = {
     "sdxl_8": _rung_sdxl_8,
     "zimage_21": _rung_zimage_21,
     "flux_16": _rung_flux_16,
+    "flux_16_int8": _rung_flux_16_int8,
     "wan_video": _rung_wan_video,
     "smoke": _rung_smoke,
 }

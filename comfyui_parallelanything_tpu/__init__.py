@@ -50,7 +50,8 @@ from .pipelines import (
     Sd3Pipeline,
     WanVideoPipeline,
 )
-from .host import run_workflow, WorkflowError
+from .models.generic import derive_pipeline_spec, wrap_flax_module
+from .host import run_workflow, WorkflowCache, WorkflowError
 from .utils.metrics import StepTimer, trace
 
 __all__ = [
@@ -82,7 +83,10 @@ __all__ = [
     "FluxPipeline",
     "WanVideoPipeline",
     "Sd3Pipeline",
+    "derive_pipeline_spec",
+    "wrap_flax_module",
     "run_workflow",
+    "WorkflowCache",
     "WorkflowError",
     "StepTimer",
     "trace",

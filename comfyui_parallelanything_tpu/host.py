@@ -42,6 +42,59 @@ class WorkflowError(ValueError):
     link, cycle) — raised with the offending node id in the message."""
 
 
+class WorkflowCache:
+    """Cross-run output cache with ComfyUI-style invalidation.
+
+    A plain ``outputs`` dict reuses entries unconditionally; this cache instead
+    keys each node's outputs to a signature of (class_type, literal inputs,
+    upstream signatures), so editing a node — or anything upstream of it —
+    re-executes exactly the stale subgraph. When a stale or dropped entry is
+    evicted, any output value exposing ``cleanup()`` (a ParallelModel) is torn
+    down: the host-side analogue of the reference's ``weakref.finalize``
+    teardown firing when ComfyUI replaces a MODEL output
+    (any_device_parallel.py:1459, 211-282) — without it, the cache would hold
+    every superseded model's device placements alive indefinitely.
+    """
+
+    def __init__(self) -> None:
+        self.results: dict[str, tuple] = {}
+        self.signatures: dict[str, str] = {}
+
+    def evict(self, nid: str) -> None:
+        """Drop one node's cached outputs, tearing down teardownable values
+        (unless a still-cached node shares the same object — the standard
+        ComfyUI MODEL pass-through)."""
+        self.evict_stale({nid})
+
+    def evict_stale(self, stale) -> None:
+        """Drop every cached entry in ``stale``. A value is torn down only when
+        NO surviving entry still holds the same object: pass-through nodes
+        (e.g. a sampler returning the MODEL it received) share identity with
+        their upstream, and tearing down via the stale downstream entry would
+        gut the still-valid upstream cache."""
+        stale = set(stale)
+        keep_ids = {
+            id(v)
+            for nid, out in self.results.items()
+            if nid not in stale
+            for v in out
+        }
+        torn: set[int] = set()
+        for nid in stale:
+            out = self.results.pop(nid, None)
+            self.signatures.pop(nid, None)
+            for value in out or ():
+                if id(value) in keep_ids or id(value) in torn:
+                    continue
+                torn.add(id(value))
+                cleanup = getattr(value, "cleanup", None)
+                if callable(cleanup):
+                    try:
+                        cleanup()
+                    except Exception:
+                        pass
+
+
 def _is_link(v: Any) -> bool:
     return (
         isinstance(v, list)
@@ -81,16 +134,18 @@ def _wire_inputs(cls: type) -> tuple[set[str], set[str]]:
 def run_workflow(
     workflow: Any,
     class_mappings: dict[str, type] | None = None,
-    outputs: dict[str, tuple] | None = None,
+    outputs: "dict[str, tuple] | WorkflowCache | None" = None,
 ) -> dict[str, tuple]:
     """Execute a ComfyUI API-format workflow; returns ``{node_id: outputs}``.
 
     ``workflow`` is the dict itself or a path to a JSON file. ``class_mappings``
     extends/overrides ``nodes.NODE_CLASS_MAPPINGS`` (e.g. to register custom
     nodes like the hosts the reference targets allow). ``outputs`` pre-seeds
-    node results (a cache from a previous run — re-running a graph only
-    executes nodes not already present, the host-side analogue of ComfyUI's
-    execution cache).
+    node results: a plain dict reuses entries unconditionally (re-running a
+    graph only executes nodes not already present); a ``WorkflowCache`` adds
+    ComfyUI-style invalidation — stale/dropped entries are evicted (tearing
+    down teardownable values like parallel models) and only the changed
+    subgraph re-executes. Cache mode requires an acyclic graph.
     """
     from .nodes import NODE_CLASS_MAPPINGS
 
@@ -104,7 +159,10 @@ def run_workflow(
         raise WorkflowError(f"workflow must be a dict, got {type(workflow).__name__}")
     graph = {str(k): v for k, v in workflow.items()}
 
-    results: dict[str, tuple] = dict(outputs or {})
+    cache = outputs if isinstance(outputs, WorkflowCache) else None
+    results: dict[str, tuple] = (
+        cache.results if cache is not None else dict(outputs or {})
+    )
 
     def node_class(nid: str) -> tuple[dict, type]:
         spec = graph.get(nid)
@@ -138,20 +196,22 @@ def run_workflow(
                 links[name] = (str(v[0]), int(v[1]))
         return links
 
-    def exec_node(root: str) -> tuple:
-        # Iterative post-order DFS (exported graphs can be thousands of nodes
-        # deep — Python recursion would hit the interpreter limit and surface
-        # as RecursionError instead of a WorkflowError).
-        # Each frame is [nid, resolved]; resolved is None until the node is
-        # expanded, then the cached (spec, cls, links) so execution doesn't
-        # re-derive them (INPUT_TYPES would otherwise run twice per node).
+    def postorder(root: str, is_done, visit) -> None:
+        """Iterative post-order DFS over link dependencies — exported graphs
+        can be thousands of nodes deep, so Python recursion would hit the
+        interpreter limit and surface as RecursionError instead of a
+        WorkflowError. ``is_done(nid)`` short-circuits already-computed nodes;
+        ``visit(nid, spec, cls, links)`` runs once per node after its deps.
+        Each frame caches (spec, cls, links) at expansion so INPUT_TYPES isn't
+        re-derived at visit time. Shared by execution and the cache-mode
+        signature pass — one traversal, one cycle-detection contract."""
         stack: list[list] = [[root, None]]
         path: list[str] = []  # gray nodes in order, for a readable cycle message
         on_path: set[str] = set()
         while stack:
             nid, resolved = stack[-1]
             if resolved is None:
-                if nid in results:
+                if is_done(nid):
                     stack.pop()
                     continue
                 if nid in on_path:
@@ -165,43 +225,81 @@ def run_workflow(
                 on_path.add(nid)
                 deps = dict.fromkeys(dep for dep, _ in links.values())
                 for dep in reversed(list(deps)):
-                    if dep not in results:
+                    if not is_done(dep):
                         stack.append([dep, None])
                 continue
             spec, cls, links = resolved
-            kwargs: dict[str, Any] = {}
-            for name, v in (spec.get("inputs") or {}).items():
-                if name in links:
-                    dep, idx = links[name]
-                    upstream = results[dep]
-                    if idx < 0 or idx >= len(upstream):
-                        raise WorkflowError(
-                            f"node {nid}: input {name!r} wants output {idx} of "
-                            f"node {dep}, which has {len(upstream)} output(s) "
-                            "(indices must be non-negative)"
-                        )
-                    kwargs[name] = upstream[idx]
-                else:
-                    kwargs[name] = v
-            fn = getattr(cls(), cls.FUNCTION)
-            try:
-                out = fn(**kwargs)
-            except WorkflowError:
-                raise
-            except Exception as e:
-                raise WorkflowError(
-                    f"node {nid} ({spec.get('class_type')}): {type(e).__name__}: {e}"
-                ) from e
-            if not isinstance(out, tuple):
-                out = (out,)
-            results[nid] = out
+            visit(nid, spec, cls, links)
             on_path.discard(nid)
             path.pop()
             stack.pop()
-        return results[root]
+
+    def compute_signatures() -> dict[str, str]:
+        """Per-node content signature over (class_type, literal inputs,
+        upstream signatures), over the whole graph regardless of cache state,
+        so staleness of cached entries is detectable. Raises on cycles (cache
+        mode's documented contract)."""
+        import hashlib
+
+        sigs: dict[str, str] = {}
+
+        def visit(nid, spec, cls, links):
+            canon: dict[str, Any] = {}
+            for name, v in (spec.get("inputs") or {}).items():
+                if name in links:
+                    dep, idx = links[name]
+                    canon[name] = ["__link__", sigs[dep], idx]
+                else:
+                    canon[name] = v
+            blob = json.dumps(
+                [spec.get("class_type"), canon], sort_keys=True, default=repr
+            )
+            sigs[nid] = hashlib.sha1(blob.encode()).hexdigest()
+
+        for root in graph:
+            postorder(root, sigs.__contains__, visit)
+        return sigs
+
+    if cache is not None:
+        sigs = compute_signatures()
+        cache.evict_stale(
+            nid
+            for nid in cache.results
+            if nid not in graph or cache.signatures.get(nid) != sigs[nid]
+        )
+
+    def exec_visit(nid, spec, cls, links):
+        kwargs: dict[str, Any] = {}
+        for name, v in (spec.get("inputs") or {}).items():
+            if name in links:
+                dep, idx = links[name]
+                upstream = results[dep]
+                if idx < 0 or idx >= len(upstream):
+                    raise WorkflowError(
+                        f"node {nid}: input {name!r} wants output {idx} of "
+                        f"node {dep}, which has {len(upstream)} output(s) "
+                        "(indices must be non-negative)"
+                    )
+                kwargs[name] = upstream[idx]
+            else:
+                kwargs[name] = v
+        fn = getattr(cls(), cls.FUNCTION)
+        try:
+            out = fn(**kwargs)
+        except WorkflowError:
+            raise
+        except Exception as e:
+            raise WorkflowError(
+                f"node {nid} ({spec.get('class_type')}): {type(e).__name__}: {e}"
+            ) from e
+        if not isinstance(out, tuple):
+            out = (out,)
+        results[nid] = out
 
     for nid in graph:
-        exec_node(nid)
+        postorder(nid, results.__contains__, exec_visit)
+    if cache is not None:
+        cache.signatures.update(sigs)
     return results
 
 

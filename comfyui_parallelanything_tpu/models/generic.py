@@ -1,0 +1,131 @@
+"""Wrap-anything genericity: pipeline specs for models the framework has never
+seen.
+
+The reference wraps *any* torch module and auto-discovers its pipeline block
+lists by name — ``['double_blocks', 'single_blocks', 'transformer_blocks',
+'layers']`` (any_device_parallel.py:1156) — falling back to plain data
+parallelism when none is found (1156-1166). The in-repo model zoo declares
+hand-written ``PipelineSpec``s; this module closes the gap for third-party
+models:
+
+- ``derive_pipeline_spec(module, params)`` — auto-derive a spec from any flax
+  module following the reference's naming convention: block submodule lists
+  under one of the four names (setup-style, so params carry ``{name}_{i}``
+  keys), plus ``prepare(x, t, context=None, **kw) -> carry`` and
+  ``finalize(carry, out_shape)`` methods (the reference's non-block layers,
+  which always run on the lead device, SURVEY §3.4).
+- ``wrap_flax_module(module, params)`` — one call from a bare flax module to a
+  ``DiffusionModel`` the orchestrator accepts, spec auto-derived when possible.
+- ``parallelize(..., pipeline_spec=...)`` — the explicit hint for ``(apply,
+  params)`` tuples that cannot carry attributes (orchestrator.py).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from .api import DiffusionModel, PipelineSegment, PipelineSpec
+
+# The reference's discovery list, in its walk order (1156).
+BLOCK_LIST_NAMES = ("double_blocks", "single_blocks", "transformer_blocks", "layers")
+
+
+def _block_groups(params) -> list[tuple[str, int]]:
+    """(list_name, count) for every reference-named block list present as
+    contiguous ``{name}_{i}`` keys in the top-level param pytree."""
+    if not isinstance(params, dict):
+        return []
+    groups = []
+    for name in BLOCK_LIST_NAMES:
+        pat = re.compile(rf"^{re.escape(name)}_(\d+)$")
+        idx = sorted(int(m.group(1)) for k in params if (m := pat.match(str(k))))
+        if idx and idx == list(range(len(idx))):
+            groups.append((name, len(idx)))
+    return groups
+
+
+def _call_block(m, carry, list_name: str, i: int):
+    return getattr(m, list_name)[i](carry)
+
+
+def derive_pipeline_spec(module, params) -> PipelineSpec | None:
+    """Auto-derive a batch==1 pipeline decomposition, or None when the module
+    doesn't follow the convention (the model still data-parallelizes — the
+    reference's own fallback when no known block list is found, 1156-1166).
+
+    Convention: ``module`` is a flax module whose forward is
+    ``prepare → blocks (carry → carry, each) → finalize``, with the block lists
+    defined in ``setup`` under a reference name so their params appear as
+    ``{name}_{i}`` top-level keys."""
+    if not (
+        callable(getattr(module, "apply", None))
+        and callable(getattr(type(module), "prepare", None))
+        and callable(getattr(type(module), "finalize", None))
+    ):
+        return None
+    if isinstance(params, dict) and set(params) == {"params"}:
+        params = params["params"]
+    groups = _block_groups(params)
+    if not groups:
+        return None
+
+    mcls = type(module)
+
+    def prepare(p, x, t, context=None, **kw):
+        return module.apply({"params": p}, x, t, context, method=mcls.prepare, **kw)
+
+    def make_seg(name: str, i: int):
+        def fn(p, carry):
+            return module.apply({"params": p}, carry, name, i, method=_call_block)
+
+        return fn
+
+    def finalize(p, carry, out_shape):
+        return module.apply({"params": p}, carry, out_shape, method=mcls.finalize)
+
+    segments = tuple(
+        PipelineSegment((f"{name}_{i}",), make_seg(name, i), f"{name}[{i}]")
+        for name, count in groups
+        for i in range(count)
+    )
+    block_keys = {f"{name}_{i}" for name, count in groups for i in range(count)}
+    # prepare/finalize both run on the lead device; the non-block remainder of
+    # the pytree serves both (same device — placement dedups to one copy).
+    rest = tuple(k for k in params if k not in block_keys)
+    return PipelineSpec(
+        prepare_keys=rest,
+        prepare=prepare,
+        segments=segments,
+        finalize_keys=rest,
+        finalize=finalize,
+    )
+
+
+def wrap_flax_module(
+    module,
+    params,
+    name: str = "model",
+    config: Any = None,
+) -> DiffusionModel:
+    """One call from a third-party flax module + params to an orchestrator-ready
+    ``DiffusionModel``: the diffusion-forward convention
+    ``__call__(x, timesteps, context=None, **kwargs)`` (the signature the
+    reference's injected forward assumes, any_device_parallel.py:1287) becomes
+    the pure apply; the batch==1 pipeline spec is auto-derived when the module
+    follows the block-list convention, else None (data parallel only)."""
+    if isinstance(params, dict) and set(params) == {"params"}:
+        params = params["params"]
+
+    def apply_fn(p, x, t, context=None, **kw):
+        return module.apply({"params": p}, x, t, context, **kw)
+
+    spec = derive_pipeline_spec(module, params)
+    return DiffusionModel(
+        apply=apply_fn,
+        params=params,
+        name=name,
+        config=config,
+        block_lists=dict(_block_groups(params)) or None,
+        pipeline_spec=spec,
+    )

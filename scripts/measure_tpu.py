@@ -25,7 +25,10 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Ascending cost so a mid-ladder tunnel flap still banks the cheap rungs.
-LADDER = ("smoke", "sd15_16", "sdxl_8", "zimage_21", "flux_16", "wan_video")
+LADDER = (
+    "smoke", "sd15_16", "sdxl_8", "zimage_21", "flux_16", "flux_16_int8",
+    "wan_video",
+)
 
 
 def run_rung(rung: str, timeout: int = 2400) -> dict | None:

@@ -197,6 +197,234 @@ class TestExecutor:
         assert len(out["2"][0]) == 2
 
 
+class TestWorkflowCache:
+    class _Model:
+        """Teardownable output (the shape ParallelModel exposes)."""
+
+        def __init__(self):
+            self.active = True
+
+        def cleanup(self):
+            self.active = False
+
+    def _classes(self, built):
+        outer = self
+
+        class Build:
+            RETURN_TYPES = ("MODEL",)
+            FUNCTION = "go"
+
+            @classmethod
+            def INPUT_TYPES(cls):
+                return {"required": {"tag": ("STRING", {})}}
+
+            def go(self, tag):
+                m = outer._Model()
+                built.append((tag, m))
+                return (m,)
+
+        class Use:
+            RETURN_TYPES = ("X",)
+            FUNCTION = "go"
+
+            @classmethod
+            def INPUT_TYPES(cls):
+                return {"required": {"model": ("MODEL", {})}}
+
+            def go(self, model):
+                return (model,)
+
+        return {"Build": Build, "Use": Use}
+
+    def _wf(self, tag):
+        return {
+            "m": {"class_type": "Build", "inputs": {"tag": tag}},
+            "u": {"class_type": "Use", "inputs": {"model": ["m", 0]}},
+        }
+
+    def test_unchanged_graph_reuses_cache(self):
+        from comfyui_parallelanything_tpu.host import WorkflowCache
+
+        built = []
+        classes = self._classes(built)
+        cache = WorkflowCache()
+        run_workflow(self._wf("a"), classes, outputs=cache)
+        run_workflow(self._wf("a"), classes, outputs=cache)
+        assert len(built) == 1  # second run fully cached
+        assert built[0][1].active
+
+    def test_changed_input_evicts_and_tears_down(self):
+        # Editing the model node re-executes it AND tears down the superseded
+        # model — the host-side analogue of the reference's finalizer firing
+        # when ComfyUI replaces a MODEL (any_device_parallel.py:1459).
+        from comfyui_parallelanything_tpu.host import WorkflowCache
+
+        built = []
+        classes = self._classes(built)
+        cache = WorkflowCache()
+        run_workflow(self._wf("a"), classes, outputs=cache)
+        out2 = run_workflow(self._wf("b"), classes, outputs=cache)
+        assert [t for t, _ in built] == ["a", "b"]
+        assert not built[0][1].active  # old model torn down on eviction
+        assert built[1][1].active
+        assert out2["u"][0] is built[1][1]  # downstream re-ran on the new model
+
+    def test_dropped_node_evicts(self):
+        from comfyui_parallelanything_tpu.host import WorkflowCache
+
+        built = []
+        classes = self._classes(built)
+        cache = WorkflowCache()
+        run_workflow(self._wf("a"), classes, outputs=cache)
+        run_workflow({"other": {"class_type": "Build", "inputs": {"tag": "z"}}},
+                     classes, outputs=cache)
+        assert not built[0][1].active  # entry for removed node torn down
+        assert "m" not in cache.results and "u" not in cache.results
+
+    def test_passthrough_eviction_spares_shared_model(self):
+        # A downstream node that RETURNS the model it received (the standard
+        # ComfyUI MODEL pass-through) shares the object with its upstream
+        # cache entry. Editing only the downstream node's literal must evict
+        # and re-run it WITHOUT tearing down the still-cached upstream model.
+        from comfyui_parallelanything_tpu.host import WorkflowCache
+
+        built = []
+        classes = self._classes(built)
+        outer = self
+
+        class Tag:
+            RETURN_TYPES = ("MODEL",)
+            FUNCTION = "go"
+
+            @classmethod
+            def INPUT_TYPES(cls):
+                return {"required": {"model": ("MODEL", {}),
+                                     "note": ("STRING", {})}}
+
+            def go(self, model, note):
+                return (model,)  # pass-through
+
+        classes["Tag"] = Tag
+
+        def wf(note):
+            return {
+                "m": {"class_type": "Build", "inputs": {"tag": "a"}},
+                "t": {"class_type": "Tag",
+                      "inputs": {"model": ["m", 0], "note": note}},
+            }
+
+        cache = WorkflowCache()
+        run_workflow(wf("one"), classes, outputs=cache)
+        model = built[0][1]
+        run_workflow(wf("two"), classes, outputs=cache)
+        assert len(built) == 1          # upstream Build stayed cached
+        assert model.active             # shared model NOT torn down
+        assert cache.results["t"][0] is model
+        del outer
+
+    def test_downstream_only_change_keeps_upstream_cache(self):
+        from comfyui_parallelanything_tpu.host import WorkflowCache
+
+        built = []
+        classes = self._classes(built)
+        cache = WorkflowCache()
+        wf = self._wf("a")
+        run_workflow(wf, classes, outputs=cache)
+        wf2 = self._wf("a")
+        wf2["u2"] = {"class_type": "Use", "inputs": {"model": ["m", 0]}}
+        run_workflow(wf2, classes, outputs=cache)
+        assert len(built) == 1  # upstream model untouched
+        assert built[0][1].active
+
+
+class TestShippedExampleWorkflow:
+    def test_example_sd15_txt2img_executes(self, cpu_devices, tmp_path, monkeypatch):
+        """The committed examples/workflow_sd15_txt2img.json must stay runnable:
+        execute it through host.py against a synthetic tiny checkpoint (inverse-
+        synthesis layout, the tests' standard pattern), with only the things a
+        user would edit rewritten — file paths, device ids, sizes/steps. Every
+        node class in the shipped artifact executes for real."""
+        import jax.numpy as jnp
+        from safetensors.numpy import save_file
+
+        import comfyui_parallelanything_tpu.models as models_pkg
+        import comfyui_parallelanything_tpu.models.text_encoders as te_mod
+        from comfyui_parallelanything_tpu.models import build_unet, build_vae
+        from tests.test_convert_unet import _ldm_sd
+        from tests.test_text_encoders import TINY_CLIP, _hf_clip
+        from tests.test_vae import TINY as TINY_VAE, _ldm_layout_sd
+
+        real_sd15 = models_pkg.sd15_config
+
+        def tiny_sd15():
+            return real_sd15(
+                model_channels=32, channel_mult=(1, 2), transformer_depth=(1, 1),
+                attention_levels=(0, 1), context_dim=TINY_CLIP.hidden_size,
+                num_heads=4, norm_groups=8, dtype=jnp.float32,
+            )
+
+        monkeypatch.setattr(models_pkg, "sd15_config", tiny_sd15)
+        monkeypatch.setattr(models_pkg, "sd_vae_config", lambda: TINY_VAE)
+        monkeypatch.setattr(te_mod, "clip_l_config", lambda: TINY_CLIP)
+
+        # Synthetic full checkpoint: diffusion + bundled VAE subtrees, in the
+        # torch/ldm key layout the converters consume.
+        ucfg = tiny_sd15()
+        unet = build_unet(ucfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+        vae = build_vae(TINY_VAE, jax.random.key(1), sample_hw=16)
+        sd = {
+            f"model.diffusion_model.{k}": np.ascontiguousarray(v)
+            for k, v in _ldm_sd(ucfg, unet.params).items()
+        }
+        sd.update(
+            {
+                f"first_stage_model.{k}": np.ascontiguousarray(v)
+                for k, v in _ldm_layout_sd(TINY_VAE, vae.params).items()
+            }
+        )
+        ckpt = tmp_path / "ckpt.safetensors"
+        save_file(sd, str(ckpt))
+
+        # Synthetic CLIP encoder (HF text_model layout) + tokenizer.json.
+        hf = _hf_clip(TINY_CLIP, "quick_gelu")
+        clip_sd = {
+            k: np.ascontiguousarray(v.detach().numpy())
+            for k, v in hf.state_dict().items()
+        }
+        enc_path = tmp_path / "clip.safetensors"
+        save_file(clip_sd, str(enc_path))
+
+        tokenizers = pytest.importorskip("tokenizers")
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        vocab = {"[UNK]": 0, "a": 5, "watercolor": 6, "lighthouse": 7, "at": 8,
+                 "dawn": 9, "blurry": 10, "low": 11, "quality": 12}
+        t = tokenizers.Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
+        t.pre_tokenizer = Whitespace()
+        tok_path = tmp_path / "tokenizer.json"
+        t.save(str(tok_path))
+
+        wf = json.load(open("examples/workflow_sd15_txt2img.json"))
+        wf["checkpoint"]["inputs"]["ckpt_path"] = str(ckpt)
+        wf["clip"]["inputs"]["encoder_path"] = str(enc_path)
+        wf["clip"]["inputs"]["tokenizer_json"] = str(tok_path)
+        wf["clip"]["inputs"]["max_len"] = TINY_CLIP.max_len
+        wf["dev0"]["inputs"]["device_id"] = "cpu:0"
+        wf["dev1"]["inputs"]["device_id"] = "cpu:1"
+        wf["latent"]["inputs"].update(width=32, height=32, batch_size=4)
+        wf["sampler"]["inputs"]["steps"] = 2
+
+        out = run_workflow(wf)
+        images = out["decode"][0]
+        # TPUEmptyLatent assumes the SD factor-8 latent grid; the tiny VAE
+        # upsamples by its own (smaller) factor — assert consistently.
+        hw = 32 // 8 * vae.spatial_factor
+        assert images.shape == (4, hw, hw, 3)
+        assert np.isfinite(np.asarray(images)).all()
+        assert out["parallel"][0].devices == ("cpu:0", "cpu:1")
+
+
 class TestEndToEndGraph:
     def test_full_sampling_workflow(self, cpu_devices):
         # The reference's whole value proposition as one JSON file: build a

@@ -251,6 +251,49 @@ class TestSetupSemantics:
             np.asarray(got), np.asarray(apply_fn(params, x, t, c)), rtol=1e-5, atol=1e-6
         )
 
+    def test_gc_teardown_honors_purge_flags(self, toy, monkeypatch):
+        # Parity: weakref.finalize(model, cleanup_parallel_model, ...) at
+        # any_device_parallel.py:1459 — dropping every reference to the wrapped
+        # MODEL must still honor purge_cache/purge_models.
+        import gc
+
+        from comfyui_parallelanything_tpu.parallel import orchestrator as orch
+
+        purges = []
+        monkeypatch.setattr(
+            orch, "aggressive_cleanup",
+            lambda clear_compile_cache=False: purges.append(clear_compile_cache),
+        )
+        apply_fn, params = toy
+        pm = parallelize(
+            (apply_fn, params), even_chain(2),
+            ParallelConfig(purge_cache=True, purge_models=True),
+        )
+        fin = pm._finalizer
+        del pm
+        gc.collect()
+        assert not fin.alive
+        assert True in purges  # purge_models=True → compile caches cleared
+
+        # purge_cache=False → GC teardown does NOT purge.
+        purges.clear()
+        pm2 = parallelize(
+            (apply_fn, params), even_chain(2), ParallelConfig(purge_cache=False)
+        )
+        del pm2
+        gc.collect()
+        assert purges == []
+
+    def test_explicit_cleanup_detaches_finalizer(self, toy):
+        import gc
+
+        apply_fn, params = toy
+        pm = parallelize((apply_fn, params), even_chain(2))
+        pm.cleanup()
+        assert not pm._finalizer.alive  # detached: no double-teardown at GC
+        del pm
+        gc.collect()
+
     def test_cleanup(self, toy):
         apply_fn, params = toy
         pm = parallelize((apply_fn, params), even_chain(4))
@@ -373,6 +416,77 @@ class TestReviewRegressions:
 
 
 class TestHybridMultiGroup:
+    def test_auto_reactivation_after_n_steps(self, toy):
+        # VERDICT r2 item 6: reactivate_after=N resumes parallel execution
+        # after N single-device steps instead of serializing the rest of a run.
+        apply_fn, params = toy
+        pm = parallelize(
+            (apply_fn, params), even_chain(4), ParallelConfig(reactivate_after=3)
+        )
+        pm._demote()
+        assert not pm.active
+        x, t, c = _inputs(8)
+        expect = np.asarray(apply_fn(params, x, t, c))
+        for i in range(3):
+            got = pm(x, t, c)  # N=3 single-device steps run demoted
+            assert not pm.active
+        got = pm(x, t, c)  # next call reactivates, runs parallel again
+        assert pm.active
+        assert pm._groups[0].params is not None
+        np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-5, atol=1e-6)
+
+    def test_cleaned_up_model_never_auto_reactivates(self, toy):
+        # cleanup() is terminal: neither the step counter nor rebalance() may
+        # resurrect placements the user explicitly tore down.
+        apply_fn, params = toy
+        pm = parallelize(
+            (apply_fn, params), even_chain(4), ParallelConfig(reactivate_after=1)
+        )
+        pm.cleanup()
+        x, t, c = _inputs(8)
+        for _ in range(3):
+            pm(x, t, c)
+        assert not pm.active
+        pm.rebalance()
+        assert not pm.active
+
+    def test_cleanup_on_demoted_model_purges(self, toy, monkeypatch):
+        # A demoted model still holds a lead copy / compile caches — cleanup()
+        # must run the purge even though active is already False.
+        from comfyui_parallelanything_tpu.parallel import orchestrator as orch
+
+        purges = []
+        monkeypatch.setattr(
+            orch, "aggressive_cleanup",
+            lambda clear_compile_cache=False: purges.append(clear_compile_cache),
+        )
+        apply_fn, params = toy
+        pm = parallelize((apply_fn, params), even_chain(2))
+        pm._demote()
+        x, t, c = _inputs(4)
+        pm(x, t, c)  # builds the lead-device fallback placement
+        assert pm._lead_params is not None
+        purges.clear()
+        pm.cleanup()
+        assert pm._lead_params is None
+        assert purges  # purge_cache honored despite prior demotion
+
+    def test_demotion_permanent_by_default(self, toy):
+        apply_fn, params = toy
+        pm = parallelize((apply_fn, params), even_chain(4))
+        pm._demote()
+        x, t, c = _inputs(8)
+        for _ in range(5):
+            pm(x, t, c)
+        assert not pm.active  # reference-documented default: manual reactivate
+
+    def test_rebalance_reactivates_demoted_chain(self, toy):
+        apply_fn, params = toy
+        pm = parallelize((apply_fn, params), even_chain(4))
+        pm._demote()
+        pm.rebalance()
+        assert pm.active
+
     def test_two_group_weighted_dispatch(self, toy):
         """Exercise the heterogeneous two-program path by hand-building two platform
         groups out of CPU devices (70/30 weighted host scatter + async concat)."""

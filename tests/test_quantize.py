@@ -97,6 +97,25 @@ class TestQuantizedModel:
         scale = np.abs(full).mean() + 1e-6
         assert np.abs(quant - full).mean() / scale < 0.05
 
+    def test_int8_sampler_run_close_to_bf16(self, flux_model):
+        # VERDICT r2 item 3: bound int8-vs-full-precision error END-TO-END
+        # through a sampler run, not just one forward — quantization noise
+        # compounds across steps, and this is the regime the flux_16_int8
+        # bench rung runs in.
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        qm = quantize_model(flux_model, min_size=2**10, dtype=jnp.float32)
+        noise = jax.random.normal(jax.random.key(3), (2, 8, 8, 4))
+        ctx = jax.random.normal(jax.random.key(4), (2, 8, TINY.context_in_dim))
+        y = jax.random.normal(jax.random.key(5), (2, TINY.vec_in_dim))
+        kw = dict(sampler="flow_euler", steps=6, shift=1.0, y=y)
+        full = np.asarray(run_sampler(flux_model, noise, ctx, **kw))
+        quant = np.asarray(run_sampler(qm, noise, ctx, **kw))
+        assert np.isfinite(quant).all()
+        scale = np.abs(full).mean() + 1e-6
+        rel = np.abs(quant - full).mean() / scale
+        assert rel < 0.10, rel  # compounded over 6 steps, still small
+
     def test_parallelized_dp(self, flux_model, cpu_devices):
         qm = quantize_model(flux_model, min_size=2**10, dtype=jnp.float32)
         pm = parallelize(qm, DeviceChain.even([f"cpu:{i}" for i in range(8)]))
@@ -156,6 +175,43 @@ class TestQuantizedModel:
         assert pm._pipeline_runner is not None and pm._pipeline_runner.n_stages >= 2
         single = np.asarray(qm.apply(qm.params, x, t, ctx, y=y))
         np.testing.assert_allclose(np.asarray(out), single, rtol=2e-3, atol=2e-3)
+
+    def test_bench_synth_int8_rung_logic(self):
+        # The flux_16_int8 bench rung synthesizes int8 params straight from
+        # abstract shapes (no high-precision pytree ever exists); validate the
+        # same code path at tiny scale: structure matches quantize_params'
+        # rule, and the dequantize-in-jit forward runs.
+        import bench
+        from comfyui_parallelanything_tpu.models import flux_abstract_params
+        from comfyui_parallelanything_tpu.models.flux import FluxModel
+
+        sds = flux_abstract_params(TINY, sample_shape=(1, 8, 8, 4), txt_len=8)
+        params = bench._synth_int8_params(sds, min_size=2**10)
+        leaves = jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantTensor)
+        )
+        qts = [l for l in leaves if isinstance(l, QuantTensor)]
+        assert qts and all(l.q.dtype == jnp.int8 for l in qts)
+        ref = quantize_params(
+            jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), sds),
+            min_size=2**10,
+        )
+        assert jax.tree.structure(
+            params, is_leaf=lambda x: isinstance(x, QuantTensor)
+        ) == jax.tree.structure(ref, is_leaf=lambda x: isinstance(x, QuantTensor))
+
+        module = FluxModel(TINY)
+        out = jax.jit(
+            lambda p, x, t, c, y: module.apply(
+                {"params": dequantize_params(p, jnp.float32)}, x, t, c, y=y
+            )
+        )(
+            params,
+            jnp.ones((1, 8, 8, 4)), jnp.ones((1,)),
+            jnp.ones((1, 8, TINY.context_in_dim)), jnp.ones((1, TINY.vec_in_dim)),
+        )
+        assert out.shape == (1, 8, 8, 4)
+        assert np.isfinite(np.asarray(out)).all()
 
     def test_dequantize_params_inverse_shape(self, flux_model):
         q = quantize_params(flux_model.params, min_size=2**10)
