@@ -98,16 +98,24 @@ def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
         scale = q.shape[-1] ** -0.5
     backend = _BACKEND
     if backend == "auto":
-        # The pallas kernel wants lane-aligned head dims and TPU hardware.
+        from .pallas.tuning import pallas_wins
+
+        # The pallas kernel wants lane-aligned head dims and TPU hardware;
+        # within that, the measured tuning table (ops/pallas/tuning.py) decides
+        # whether the fused kernel actually beats XLA at this length.
         use_pallas = (
             _pallas_available() and q.shape[-1] % 128 == 0 and q.shape[1] % 128 == 0
-            and k.shape[1] % 128 == 0
+            and k.shape[1] % 128 == 0 and pallas_wins(q.shape[1])
         )
         backend = "pallas" if use_pallas else "xla"
     if backend == "pallas":
         from .pallas.flash_attention import flash_attention
+        from .pallas.tuning import best_blocks
 
-        return flash_attention(q, k, v, scale=scale)
+        block_q, block_k = best_blocks(q.shape[1])
+        return flash_attention(
+            q, k, v, scale=scale, block_q=block_q, block_k=block_k
+        )
     return _xla_attention(q, k, v, scale)
 
 

@@ -1,0 +1,103 @@
+"""Data-driven flash-kernel tuning (VERDICT r2 item 7).
+
+The pallas kernel's block sizes (256/256) started as guesses; real numbers come
+from ``scripts/bench_kernels.py``, which sweeps ``block_q``/``block_k`` over
+{128, 256, 512} at the shapes that matter (FLUX 4.6k joint attention, WAN
+16k/32k video) and — with ``--apply`` — writes the winners here as
+``tuning.json``. The ``auto`` attention backend (ops/attention.py) then:
+
+- picks the measured-best blocks for the nearest benchmarked sequence length,
+- falls back to XLA for sequence ranges where the measurement says the fused
+  kernel LOSES (the reference's capability-gated backend disable, inverted:
+  data-gated instead of SM-version-gated, any_device_parallel.py:126-164).
+
+Without a measured file everything behaves exactly as the defaults did.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+
+_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tuning.json")
+
+_DEFAULT = {
+    "source": "default",       # "measured" once bench_kernels --apply ran
+    "device_kind": None,
+    "block_q": 256,
+    "block_k": 256,
+    # [{"seq": int, "block_q": int, "block_k": int,
+    #   "pallas_ms": float, "xla_ms": float|None}, ...]
+    "entries": [],
+}
+
+
+@functools.lru_cache(maxsize=1)
+def kernel_tuning() -> dict:
+    """The active tuning table (defaults merged under any measured file).
+
+    A measured table is generation-specific: block winners and win/lose ranges
+    from a v5e do not transfer to a v6e. When the file records a
+    ``device_kind`` that doesn't match the current first accelerator, fall back
+    to defaults rather than silently applying foreign measurements."""
+    try:
+        with open(_PATH) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            raise ValueError("tuning.json must hold an object")
+        measured_kind = data.get("device_kind")
+        if measured_kind:
+            try:
+                import jax
+
+                current = jax.devices()[0].device_kind
+            except Exception:
+                current = None
+            if current is not None and current != measured_kind:
+                return dict(_DEFAULT)
+        return {**_DEFAULT, **data}
+    except Exception:
+        return dict(_DEFAULT)
+
+
+def _nearest(entries: list, seq: int):
+    return min(entries, key=lambda e: abs(int(e.get("seq", 0)) - seq))
+
+
+def best_blocks(seq: int) -> tuple[int, int]:
+    """(block_q, block_k) for a sequence length: the measured winner at the
+    nearest benchmarked length, else the defaults."""
+    t = kernel_tuning()
+    entries = [e for e in t["entries"] if e.get("block_q") and e.get("block_k")]
+    if not entries:
+        return int(t["block_q"]), int(t["block_k"])
+    e = _nearest(entries, seq)
+    return int(e["block_q"]), int(e["block_k"])
+
+
+def pallas_wins(seq: int) -> bool:
+    """Whether the fused kernel beat XLA at the nearest measured length. With
+    no measurement, True — the default guess for lane-aligned shapes (XLA's
+    S×S logits materialization loses at the long lengths this path serves).
+    An entry whose XLA measurement FAILED (``xla_ms`` None — S×S logits OOM at
+    video lengths) counts as a pallas win: that is a length where the fused
+    kernel is mandatory, not absent data."""
+    t = kernel_tuning()
+    entries = [e for e in t["entries"] if e.get("pallas_ms") is not None]
+    if not entries:
+        return True
+    e = _nearest(entries, seq)
+    if e.get("xla_ms") is None:
+        return True
+    return float(e["pallas_ms"]) <= float(e["xla_ms"])
+
+
+def write_tuning(data: dict) -> str:
+    """Persist a measured tuning table (bench_kernels --apply) and reload."""
+    merged = {**_DEFAULT, **data, "source": "measured"}
+    with open(_PATH, "w") as f:
+        json.dump(merged, f, indent=2, sort_keys=True)
+        f.write("\n")
+    kernel_tuning.cache_clear()
+    return _PATH

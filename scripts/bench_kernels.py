@@ -1,14 +1,21 @@
-"""Flash-attention kernel benchmark: pallas (streamed K/V) vs plain XLA.
+"""Flash-attention kernel benchmark: pallas (streamed K/V) vs plain XLA,
+with a block-size sweep (VERDICT r2 item 7).
 
 Run on a live TPU (the tunnel comes and goes — probe first):
 
-    python scripts/bench_kernels.py
+    python scripts/bench_kernels.py            # measure, append KERNEL_BENCH.json
+    python scripts/bench_kernels.py --apply    # ALSO write the winners into
+                                               # ops/pallas/tuning.json so the
+                                               # auto backend uses measured
+                                               # blocks + xla-fallback ranges
+    KERNEL_SWEEP=0 python scripts/bench_kernels.py   # default blocks only
 
 Shapes cover the rungs that matter: FLUX joint attention at 1024² (4.6k tokens,
 24 heads × 128) and WAN-video lengths (16k/32k tokens) where the streamed-K/V
-layout is what keeps VMEM bounded. Each row reports ms/call (median of 5 after
-warmup) and the speedup of the pallas path over XLA. Appends JSON lines to
-KERNEL_BENCH.json; BASELINE.md's kernel section reads from there.
+layout is what keeps VMEM bounded. The sweep tries block_q × block_k over
+{128, 256, 512}² per shape; each cell is the median of 5 timed calls after a
+compile+warmup call. Appends JSON lines to KERNEL_BENCH.json; BASELINE.md's
+kernel section reads from there.
 """
 
 from __future__ import annotations
@@ -66,6 +73,9 @@ def main() -> None:
 
     out_path = os.path.join(_REPO, "KERNEL_BENCH.json")
     shapes = SHAPES if on_tpu else [("cpu_smoke", 1, 256, 2, 64)]
+    sweep = on_tpu and os.environ.get("KERNEL_SWEEP", "1") != "0"
+    blocks = (128, 256, 512)
+    entries = []
     for label, b, s, h, d in shapes:
         k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
         q = jax.random.normal(k1, (b, s, h, d), jnp.bfloat16)
@@ -74,12 +84,27 @@ def main() -> None:
         rec = {"shape": label, "b": b, "seq": s, "heads": h, "head_dim": d,
                "platform": dev.platform, "device_kind": dev.device_kind,
                "ts": time.time()}
-        try:
-            rec["pallas_ms"] = round(
-                _time_fn(lambda a, b_, c: flash_attention(a, b_, c), q, k, v) * 1e3, 3
-            )
-        except Exception as e:  # noqa: BLE001 — record, keep measuring
-            rec["pallas_error"] = str(e)[:200]
+        combos = (
+            [(bq, bk) for bq in blocks for bk in blocks] if sweep else [(256, 256)]
+        )
+        best = None  # (ms, bq, bk)
+        for bq, bk in combos:
+            try:
+                ms = _time_fn(
+                    lambda a, b_, c, _bq=bq, _bk=bk: flash_attention(
+                        a, b_, c, block_q=_bq, block_k=_bk
+                    ),
+                    q, k, v,
+                ) * 1e3
+            except Exception as e:  # noqa: BLE001 — record, keep sweeping
+                rec[f"pallas_{bq}x{bk}_error"] = str(e)[:120]
+                continue
+            rec[f"pallas_{bq}x{bk}_ms"] = round(ms, 3)
+            if best is None or ms < best[0]:
+                best = (ms, bq, bk)
+        if best is not None:
+            rec["pallas_ms"] = round(best[0], 3)
+            rec["block_q"], rec["block_k"] = best[1], best[2]
         try:
             rec["xla_ms"] = round(
                 _time_fn(lambda a, b_, c: _xla_attention(a, b_, c, d**-0.5),
@@ -92,6 +117,29 @@ def main() -> None:
         print(json.dumps(rec))
         with open(out_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
+        if on_tpu and "pallas_ms" in rec:
+            entries.append({
+                "seq": s,
+                "block_q": rec.get("block_q", 256),
+                "block_k": rec.get("block_k", 256),
+                "pallas_ms": rec["pallas_ms"],
+                "xla_ms": rec.get("xla_ms"),
+            })
+
+    if "--apply" in sys.argv:
+        if not (on_tpu and entries):
+            print("# --apply skipped: no TPU measurements", file=sys.stderr)
+            return
+        from comfyui_parallelanything_tpu.ops.pallas.tuning import write_tuning
+
+        # Per-shape winners live in `entries` (best_blocks picks the nearest);
+        # the table-level block fields stay the neutral 256/256 default — a
+        # cross-shape "fastest absolute ms" would just crown the cheapest shape.
+        path = write_tuning({
+            "device_kind": dev.device_kind,
+            "entries": entries,
+        })
+        print(f"# tuning table written: {path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -49,6 +49,131 @@ class TestAttention:
         np.testing.assert_allclose(out, probs @ vm, rtol=1e-5, atol=1e-6)
 
 
+class TestKernelTuning:
+    """Data-driven block sizes / backend choice (ops/pallas/tuning.py): the
+    mechanism bench_kernels.py --apply feeds on real hardware."""
+
+    def _table(self, entries):
+        return {"source": "measured", "block_q": 256, "block_k": 256,
+                "entries": entries}
+
+    def test_defaults_without_file(self, monkeypatch):
+        from comfyui_parallelanything_tpu.ops.pallas import tuning
+
+        monkeypatch.setattr(tuning, "_PATH", "/nonexistent/tuning.json")
+        tuning.kernel_tuning.cache_clear()
+        try:
+            assert tuning.best_blocks(4608) == (256, 256)
+            assert tuning.pallas_wins(4608) is True  # default guess
+        finally:
+            tuning.kernel_tuning.cache_clear()
+
+    def test_measured_entries_drive_choice(self, monkeypatch):
+        from comfyui_parallelanything_tpu.ops.pallas import tuning
+
+        table = self._table([
+            {"seq": 4608, "block_q": 512, "block_k": 256,
+             "pallas_ms": 1.0, "xla_ms": 2.0},
+            {"seq": 512, "block_q": 128, "block_k": 128,
+             "pallas_ms": 3.0, "xla_ms": 1.0},  # kernel LOSES at short seq
+        ])
+        monkeypatch.setattr(tuning, "kernel_tuning", lambda: {**tuning._DEFAULT, **table})
+        assert tuning.best_blocks(4000) == (512, 256)  # nearest: 4608
+        assert tuning.best_blocks(600) == (128, 128)
+        assert tuning.pallas_wins(4608) is True
+        assert tuning.pallas_wins(384) is False  # nearest entry says xla
+
+    def test_xla_oom_entry_counts_as_pallas_win(self, monkeypatch):
+        # An entry whose XLA measurement failed (S×S logits OOM at video
+        # lengths) marks a length where the fused kernel is MANDATORY.
+        from comfyui_parallelanything_tpu.ops.pallas import tuning
+
+        table = self._table([
+            {"seq": 4608, "block_q": 256, "block_k": 256,
+             "pallas_ms": 2.0, "xla_ms": 1.5},        # xla narrowly wins
+            {"seq": 32768, "block_q": 256, "block_k": 512,
+             "pallas_ms": 40.0, "xla_ms": None},      # xla OOMed
+        ])
+        monkeypatch.setattr(
+            tuning, "kernel_tuning", lambda: {**tuning._DEFAULT, **table}
+        )
+        assert tuning.pallas_wins(32768) is True   # never route 32k to xla
+        assert tuning.pallas_wins(4608) is False
+
+    def test_foreign_device_table_ignored(self, monkeypatch, tmp_path):
+        # A v5e-measured table must not apply on a different TPU generation.
+        import json as _json
+
+        from comfyui_parallelanything_tpu.ops.pallas import tuning
+
+        p = tmp_path / "tuning.json"
+        p.write_text(_json.dumps({
+            "device_kind": "TPU v99", "block_q": 512, "block_k": 512,
+            "entries": [{"seq": 128, "block_q": 512, "block_k": 512,
+                         "pallas_ms": 9.0, "xla_ms": 1.0}],
+        }))
+        monkeypatch.setattr(tuning, "_PATH", str(p))
+        tuning.kernel_tuning.cache_clear()
+        try:
+            assert tuning.kernel_tuning()["source"] == "default"
+            assert tuning.best_blocks(128) == (256, 256)
+        finally:
+            tuning.kernel_tuning.cache_clear()
+
+    def test_write_and_reload_roundtrip(self, monkeypatch, tmp_path):
+        from comfyui_parallelanything_tpu.ops.pallas import tuning
+
+        monkeypatch.setattr(tuning, "_PATH", str(tmp_path / "tuning.json"))
+        tuning.kernel_tuning.cache_clear()
+        try:
+            import jax
+
+            kind = jax.devices()[0].device_kind  # must match to be applied
+            tuning.write_tuning({
+                "device_kind": kind,
+                "block_q": 512, "block_k": 128,
+                "entries": [{"seq": 16384, "block_q": 512, "block_k": 128,
+                             "pallas_ms": 5.0, "xla_ms": 50.0}],
+            })
+            t = tuning.kernel_tuning()
+            assert t["source"] == "measured" and t["device_kind"] == kind
+            assert tuning.best_blocks(20000) == (512, 128)
+        finally:
+            tuning.kernel_tuning.cache_clear()
+
+    def test_auto_backend_respects_measured_loss(self, monkeypatch):
+        # Auto mode must fall back to XLA for lengths where measurement says
+        # the fused kernel loses — even on TPU with aligned shapes.
+        import importlib
+
+        # ops/__init__ rebinds the name `attention` to the function, shadowing
+        # the submodule on attribute access — resolve the module explicitly.
+        att = importlib.import_module("comfyui_parallelanything_tpu.ops.attention")
+        from comfyui_parallelanything_tpu.ops.pallas import tuning
+
+        calls = []
+        monkeypatch.setattr(att, "_pallas_available", lambda: True)
+        monkeypatch.setattr(
+            tuning, "kernel_tuning",
+            lambda: {**tuning._DEFAULT, "entries": [
+                {"seq": 128, "block_q": 128, "block_k": 128,
+                 "pallas_ms": 9.0, "xla_ms": 1.0},
+            ]},
+        )
+        fa = importlib.import_module(
+            "comfyui_parallelanything_tpu.ops.pallas.flash_attention"
+        )
+        real = fa.flash_attention
+        monkeypatch.setattr(
+            fa, "flash_attention",
+            lambda *a, **kw: calls.append(kw) or real(*a, interpret=True, **kw),
+        )
+        q = jnp.ones((1, 128, 2, 128), jnp.float32)
+        out = att.attention_local(q, q, q)
+        assert out.shape == q.shape
+        assert calls == []  # measured loss -> xla path, kernel never invoked
+
+
 class TestFlashAttention:
     @pytest.mark.parametrize("sq,sk", [(64, 64), (100, 80), (256, 256), (300, 513)])
     def test_matches_xla(self, sq, sk):
