@@ -925,6 +925,14 @@ class TPUKSampler:
                      "tooltip": "CFG rescale phi (Lin et al.): tames high-cfg "
                                 "over-saturation, esp. v-prediction models"},
                 ),
+                "compile_loop": (
+                    "BOOLEAN",
+                    {"default": False,
+                     "tooltip": "compile the WHOLE denoise loop into one XLA "
+                                "program (zero per-step dispatch; single-"
+                                "program chains only — hybrid chains fall "
+                                "back to the eager loop)"},
+                ),
             },
         }
 
@@ -943,6 +951,7 @@ class TPUKSampler:
         denoise: float = 1.0,
         scheduler: str = "karras",
         cfg_rescale: float = 0.0,
+        compile_loop: bool = False,
     ):
         import jax
         import jax.numpy as jnp
@@ -1003,6 +1012,7 @@ class TPUKSampler:
             guidance=guidance if guidance > 0 else None,
             scheduler=scheduler,
             cfg_rescale=cfg_rescale,
+            compile_loop=compile_loop,
             prediction=getattr(model_cfg, "prediction", "eps"),
             init_latent=(
                 latent["samples"]

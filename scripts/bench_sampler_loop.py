@@ -1,0 +1,77 @@
+"""Eager vs whole-loop-compiled sampler benchmark (VERDICT r2 item 2 evidence).
+
+Quantifies what ``run_sampler(compile_loop=True)`` buys on real hardware: the
+eager path re-enters the jitted forward from Python every denoise step (the
+reference's hot-loop shape, any_device_parallel.py:1287), paying per-step
+dispatch and a fresh latent allocation; the compiled path runs the whole loop
+as one lax.scan XLA program with the latent donated.
+
+    python scripts/bench_sampler_loop.py          # default: sd15-class, 20 steps
+    BENCH_STEPS=30 python scripts/bench_sampler_loop.py
+
+Appends JSON lines to SAMPLER_LOOP_BENCH.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from comfyui_parallelanything_tpu.models import build_unet, sd15_config
+    from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+    from comfyui_parallelanything_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    if on_tpu:
+        batch, latent, ctx_len = 8, 64, 77   # 512² SD1.5-class
+        cfg = sd15_config(dtype=jnp.bfloat16)
+    else:
+        batch, latent, ctx_len = 4, 16, 24   # CPU smoke
+        cfg = sd15_config(
+            model_channels=64, channel_mult=(1, 2), transformer_depth=(1, 1),
+            attention_levels=(0, 1), context_dim=64, num_heads=4, norm_groups=16,
+            dtype=jnp.float32,
+        )
+    model = build_unet(cfg, jax.random.key(0), sample_shape=(1, latent, latent, 4))
+    noise = jax.random.normal(jax.random.key(1), (batch, latent, latent, 4))
+    ctx = jax.random.normal(jax.random.key(2), (batch, ctx_len, cfg.context_dim))
+
+    rec = {
+        "workload": f"sd15-class b={batch} {latent * 8}px {steps} steps dpmpp_2m",
+        "platform": dev.platform, "device_kind": dev.device_kind,
+        "steps": steps, "ts": time.time(),
+    }
+    for key, flag in (("eager_s", False), ("compiled_s", True)):
+        out = run_sampler(model, noise, ctx, sampler="dpmpp_2m", steps=steps,
+                          compile_loop=flag)
+        jax.block_until_ready(out)  # compile + warmup
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = run_sampler(model, noise, ctx, sampler="dpmpp_2m", steps=steps,
+                              compile_loop=flag)
+            jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+        rec[key] = round(statistics.median(times), 4)
+    rec["compiled_speedup"] = round(rec["eager_s"] / rec["compiled_s"], 3)
+    print(json.dumps(rec))
+    with open(os.path.join(_REPO, "SAMPLER_LOOP_BENCH.json"), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -119,6 +119,29 @@ class TestParallelAnythingNode:
         assert out.shape == (4, 16, 16, 4)
         assert np.all(np.isfinite(np.asarray(out)))
 
+    def test_ksampler_compile_loop_widget(self):
+        # The node-level opt-in for whole-loop compilation must produce the
+        # same latent as the eager path.
+        from comfyui_parallelanything_tpu.nodes import TPUEmptyLatent, TPUKSampler
+
+        cfg = sd15_config(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(1,), transformer_depth=(0, 1), num_heads=4,
+            context_dim=64, norm_groups=8, dtype=jnp.float32,
+        )
+        model = build_unet(cfg, jax.random.key(0), sample_shape=(1, 16, 16, 4))
+        (latent,) = TPUEmptyLatent().generate(width=64, height=64, batch_size=2)
+        cond = {"context": jax.random.normal(jax.random.key(3), (1, 6, 64))}
+        node = TPUKSampler()
+        outs = {}
+        for flag in (False, True):
+            (out,) = node.sample(
+                model, cond, latent, seed=5, steps=2, cfg=1.0,
+                sampler_name="euler", scheduler="karras", compile_loop=flag,
+            )
+            outs[flag] = np.asarray(out["samples"])
+        np.testing.assert_allclose(outs[False], outs[True], rtol=2e-4, atol=2e-5)
+
     def test_advanced_node_wires_tp(self):
         from comfyui_parallelanything_tpu.nodes import ParallelAnythingAdvanced
 
