@@ -130,6 +130,11 @@ class TestParallelAnythingNode:
             context_dim=64, norm_groups=8, dtype=jnp.float32,
         )
         model = build_unet(cfg, jax.random.key(0), sample_shape=(1, 16, 16, 4))
+        # Guard against a vacuous eager-vs-eager comparison: the model must
+        # actually be single-program traceable for the compiled path to run.
+        from comfyui_parallelanything_tpu.sampling.compiled import trace_spec_of
+
+        assert trace_spec_of(model) is not None
         (latent,) = TPUEmptyLatent().generate(width=64, height=64, batch_size=2)
         cond = {"context": jax.random.normal(jax.random.key(3), (1, 6, 64))}
         node = TPUKSampler()
