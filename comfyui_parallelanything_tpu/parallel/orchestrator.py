@@ -121,6 +121,26 @@ def _is_arraylike(v) -> bool:
     return isinstance(v, (jax.Array, np.ndarray))
 
 
+def _split_inputs(batch, sizes, x, timesteps, context, kwargs):
+    """Per-chunk (x, timesteps, context, kwargs) under the shared
+    split-or-broadcast contract: a value splits on dim0 iff it carries the
+    batch, else it broadcasts to every chunk (parity 1252-1267). One
+    implementation for the hybrid scatter and microbatched pipeline paths."""
+    xs = split_tree(x, sizes)
+    ts = (
+        split_tree(timesteps, sizes)
+        if batch_size_of(timesteps) == batch
+        else [timesteps] * len(sizes)
+    )
+    cs = (
+        split_tree(context, sizes)
+        if context is not None and batch_size_of(context) == batch
+        else [context] * len(sizes)
+    )
+    kws = split_kwargs(kwargs, batch, sizes)
+    return list(zip(xs, ts, cs, kws))
+
+
 @dataclasses.dataclass(frozen=True)
 class ParallelConfig:
     """The orchestrator's knobs — exactly the reference's widget surface (SURVEY §5.6).
@@ -156,6 +176,14 @@ class ParallelConfig:
     # stop permanently serializing a long run). On a failed attempt the counter
     # restarts, giving exponential-free periodic retry.
     reactivate_after: int | None = None
+    # >1 enables GPipe-style THROUGHPUT pipelining for batch>1 (beyond the
+    # reference, whose pipeline mode is batch==1 layer placement only, SURVEY
+    # §2e): the batch splits into this many microbatches streamed through the
+    # per-device stage programs without host blocking — XLA's per-device
+    # execution queues overlap microbatch j's later stages with j+1's earlier
+    # ones. Useful when weights are stage-placed because a full replica does
+    # not fit (the FSDP alternative without per-step all-gather traffic).
+    pipeline_microbatches: int = 0
 
 
 @dataclasses.dataclass
@@ -254,7 +282,7 @@ class ParallelModel:
         self._groups = groups
         self.weights = weights
         self._pipeline_spec = pipeline_spec
-        self._pipeline_runner: Any = None  # built lazily on the first batch==1 call
+        self._pipeline_runner: Any = None  # built lazily on first pipeline-path use
         self._jits: dict[tuple, Callable] = {}
         self._lead_params = None  # lazy single-device placement (fallback path)
         self.active = True
@@ -319,6 +347,8 @@ class ParallelModel:
         )
 
     def __call__(self, x, timesteps, context=None, **kwargs):
+        from ..ops.attention import sequence_ctx_key
+
         if not self.active:
             ra = self.config.reactivate_after
             if (
@@ -356,6 +386,19 @@ class ParallelModel:
                 # Every batch (incl. batch==1, where the data axis may be 1) runs
                 # the sharded program.
                 return self._data_parallel(batch, x, timesteps, context, kwargs)
+            mb = self.config.pipeline_microbatches
+            if mb > 1 and self.config.workload_split and batch >= mb and n > 1:
+                # Opt-in GPipe-style throughput pipelining (see ParallelConfig):
+                # microbatches stream through the stage chain; async dispatch
+                # overlaps them across stage devices. Falls through to normal
+                # routing when the model declares no pipeline spec or a
+                # sequence_parallel context pins the attention mesh.
+                if sequence_ctx_key() is None:
+                    runner = self._get_pipeline_runner()
+                    if runner is not None:
+                        return self._pipeline_microbatch(
+                            runner, mb, batch, x, timesteps, context, kwargs
+                        )
             if batch == 1 and self.config.workload_split and n > 1:
                 # Pipeline block-placement mode (reference 1295-1305); a model that
                 # declares no stages runs single-device (1156-1166) — padded DP on a
@@ -364,8 +407,6 @@ class ParallelModel:
                 # entirely: stage programs are pinned to single devices and cannot
                 # host a seq-mesh shard_map — the single-device path (whose jit
                 # cache IS ctx-keyed) lets the requested context parallelism run.
-                from ..ops.attention import sequence_ctx_key
-
                 if sequence_ctx_key() is None:
                     runner = self._get_pipeline_runner()
                     if runner is not None:
@@ -387,10 +428,26 @@ class ParallelModel:
             self._demote()
             return self.single(x, timesteps, context, **kwargs)
 
+    def _pipeline_microbatch(self, runner, mb, batch, x, timesteps, context, kwargs):
+        """GPipe-style throughput pipelining over the stage chain.
+
+        Every microbatch is dispatched through the per-device stage programs
+        WITHOUT host blocking: each stage is an async program pinned to its own
+        device, so XLA's per-device execution queues run microbatch j's later
+        stages concurrently with j+1's earlier ones — the host only blocks on
+        the final concat's consumers. The reference has no analogue (its
+        pipeline mode is batch==1 only; SURVEY §2e calls it layer placement,
+        not throughput pipelining)."""
+        sizes = [s for s in largest_remainder_split(batch, [1.0 / mb] * mb) if s > 0]
+        chunks = _split_inputs(batch, sizes, x, timesteps, context, kwargs)
+        outs = [runner(xi, ti, ci, **ki) for xi, ti, ci, ki in chunks]
+        return concat_results(outs)
+
     def _get_pipeline_runner(self):
         """Build the stage-placement runner on first use — placing per-stage param
-        sub-pytrees costs device memory, so it only happens once a batch==1 call
-        actually arrives (the reference pre-wraps at setup, 1152-1198)."""
+        sub-pytrees costs device memory, so it only happens once a pipeline-path
+        call (batch==1, or batch>1 with pipeline_microbatches) actually arrives
+        (the reference pre-wraps at setup, 1152-1198)."""
         if self._pipeline_runner is None and self._pipeline_spec is not None:
             from .pipeline import build_pipeline_runner
 
@@ -452,20 +509,9 @@ class ParallelModel:
         gweights = normalize_weights([g.weight for g in self._groups])
         assert gweights is not None
         sizes = largest_remainder_split(batch, gweights)
-        xs = split_tree(x, sizes)
-        ts = (
-            split_tree(timesteps, sizes)
-            if batch_size_of(timesteps) == batch
-            else [timesteps] * len(sizes)
-        )
-        cs = (
-            split_tree(context, sizes)
-            if context is not None and batch_size_of(context) == batch
-            else [context] * len(sizes)
-        )
-        kws = split_kwargs(kwargs, batch, sizes)
+        chunks = _split_inputs(batch, sizes, x, timesteps, context, kwargs)
         outs = []
-        for g, size, xg, tg, cg, kg in zip(self._groups, sizes, xs, ts, cs, kws):
+        for g, size, (xg, tg, cg, kg) in zip(self._groups, sizes, chunks):
             if size == 0:
                 continue  # inactive group this batch (active-device list, 1324-1337)
             outs.append(self._dp_on_group(g, size, xg, tg, cg, kg))
