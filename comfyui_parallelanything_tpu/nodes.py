@@ -212,6 +212,10 @@ class ParallelAnything:
         **config_extra,
     ):
         chain = chain_from_wire(parallel_devices)
+        if not config_extra.get("reactivate_after"):
+            # Widget convention: 0 = off. ParallelConfig uses None for off —
+            # a literal 0 would mean "reactivate on the very next step".
+            config_extra.pop("reactivate_after", None)
         config = ParallelConfig(
             workload_split=workload_split,
             auto_memory_balance=auto_vram_balance,
@@ -254,6 +258,30 @@ class ParallelAnythingAdvanced(ParallelAnything):
                 "min": 1,
                 "max": 64,
                 "tooltip": "model-axis size; >1 partitions the matmuls (GSPMD TP)",
+            },
+        )
+        base["optional"] = dict(base.get("optional") or {})
+        base["optional"]["pipeline_microbatches"] = (
+            "INT",
+            {
+                "default": 0,
+                "min": 0,
+                "max": 64,
+                "tooltip": "GPipe-style throughput pipelining for batch>1: "
+                           "split the batch into this many microbatches "
+                           "streamed through the stage chain (0 or 1 = off; "
+                           "needs >=2 to pipeline)",
+            },
+        )
+        base["optional"]["reactivate_after"] = (
+            "INT",
+            {
+                "default": 0,
+                "min": 0,
+                "max": 10000,
+                "tooltip": "auto-resume the parallel path this many single-"
+                           "device steps after a step-OOM demotion (0 = "
+                           "permanent demotion until manual reactivate)",
             },
         )
         return base

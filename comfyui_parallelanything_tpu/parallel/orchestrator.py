@@ -121,6 +121,19 @@ def _is_arraylike(v) -> bool:
     return isinstance(v, (jax.Array, np.ndarray))
 
 
+def _pad_tree(tree, batch, padded):
+    """Repeat-pad every batch-dim array leaf of a tree from ``batch`` to
+    ``padded`` rows (non-arrays and non-batch leaves pass through)."""
+    if padded == batch:
+        return tree
+    return jax.tree.map(
+        lambda l: _pad_leaf(l, padded - batch)
+        if _is_arraylike(l) and l.ndim > 0 and l.shape[0] == batch
+        else l,
+        tree,
+    )
+
+
 def _split_inputs(batch, sizes, x, timesteps, context, kwargs):
     """Per-chunk (x, timesteps, context, kwargs) under the shared
     split-or-broadcast contract: a value splits on dim0 iff it carries the
@@ -438,10 +451,19 @@ class ParallelModel:
         the final concat's consumers. The reference has no analogue (its
         pipeline mode is batch==1 only; SURVEY §2e calls it layer placement,
         not throughput pipelining)."""
-        sizes = [s for s in largest_remainder_split(batch, [1.0 / mb] * mb) if s > 0]
-        chunks = _split_inputs(batch, sizes, x, timesteps, context, kwargs)
+        # Uniform chunk shapes: pad the batch up to mb * ceil(batch/mb) so
+        # every microbatch compiles ONE set of stage/prepare/finalize programs
+        # (uneven largest-remainder sizes would double every XLA compile).
+        per = -(-batch // mb)
+        padded = per * mb
+        if padded != batch:
+            x, timesteps, context, kwargs = (
+                _pad_tree(v, batch, padded)
+                for v in (x, timesteps, context, kwargs)
+            )
+        chunks = _split_inputs(padded, [per] * mb, x, timesteps, context, kwargs)
         outs = [runner(xi, ti, ci, **ki) for xi, ti, ci, ki in chunks]
-        return concat_results(outs)
+        return _slice_padded(concat_results(outs), batch, padded)
 
     def _get_pipeline_runner(self):
         """Build the stage-placement runner on first use — placing per-stage param
@@ -613,12 +635,23 @@ class ParallelModel:
     def reactivate(self) -> None:
         """Re-place replicas and resume parallel execution after a demotion.
         Called manually, from rebalance(), or automatically after
-        ``config.reactivate_after`` single-device steps."""
+        ``config.reactivate_after`` single-device steps. All-or-nothing: a
+        placement failure on a later group rolls back the groups placed in
+        THIS attempt, so a failed retry never leaves extra replicas pinned
+        through the (memory-pressured) demoted period."""
         self._steps_demoted = 0
-        for g in self._groups:
-            if g.params is None:
-                g.mesh = _group_mesh(g.devices, self.config)
-                g.params = self._place(self._host_params, g.mesh)
+        placed_now: list = []
+        try:
+            for g in self._groups:
+                if g.params is None:
+                    g.mesh = _group_mesh(g.devices, self.config)
+                    g.params = self._place(self._host_params, g.mesh)
+                    placed_now.append(g)
+        except Exception:
+            for g in placed_now:
+                g.params = None
+                g.mesh = None
+            raise
         self.active = True
         self._demoted = False
 

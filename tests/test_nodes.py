@@ -166,6 +166,33 @@ class TestParallelAnythingNode:
         assert isinstance(wrapped, ParallelModel)
         assert wrapped._groups[0].mesh.shape == {"data": 2, "model": 2}
 
+    def test_advanced_node_microbatch_and_reactivate_widgets(self):
+        from comfyui_parallelanything_tpu.nodes import ParallelAnythingAdvanced
+
+        cfg = sd15_config(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(1,), transformer_depth=(0, 1), num_heads=4,
+            context_dim=64, norm_groups=8, dtype=jnp.float32,
+        )
+        model = build_unet(cfg, jax.random.key(0), sample_shape=(1, 16, 16, 4))
+        node = ParallelAnythingAdvanced()
+        spec = node.INPUT_TYPES()
+        assert "pipeline_microbatches" in spec["optional"]
+        assert "reactivate_after" in spec["optional"]
+        chain = [
+            {"device": f"cpu:{i}", "percentage": 50.0, "weight": 0.5}
+            for i in range(2)
+        ]
+        (wrapped,) = getattr(node, node.FUNCTION)(
+            model, chain, pipeline_microbatches=2, reactivate_after=0
+        )
+        assert wrapped.config.pipeline_microbatches == 2
+        assert wrapped.config.reactivate_after is None  # 0 widget -> off
+        (wrapped2,) = getattr(node, node.FUNCTION)(
+            model, chain, reactivate_after=5
+        )
+        assert wrapped2.config.reactivate_after == 5
+
     def test_unusable_chain_returns_model_unchanged(self):
         cfg = sd15_config(
             model_channels=32, channel_mult=(1, 2), num_res_blocks=1,

@@ -435,6 +435,35 @@ class TestHybridMultiGroup:
         assert pm._groups[0].params is not None
         np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-5, atol=1e-6)
 
+    def test_reactivate_rolls_back_partial_placement(self, toy, monkeypatch):
+        # A placement failure on a later group must free the groups placed in
+        # the same attempt — a failed retry can't pin extra replicas through
+        # the memory-pressured demoted period.
+        import copy
+
+        apply_fn, params = toy
+        pm = parallelize((apply_fn, params), even_chain(4))
+        # Fake a second platform group so reactivate places two groups.
+        g2 = copy.copy(pm._groups[0])
+        g2.devices = list(pm._groups[0].devices)
+        pm._groups.append(g2)
+        pm._demote()
+        assert all(g.params is None for g in pm._groups)
+        calls = []
+
+        def fake_place(p, mesh):
+            calls.append(1)
+            if len(calls) == 2:
+                raise RuntimeError("RESOURCE_EXHAUSTED: fake")
+            return p
+
+        monkeypatch.setattr(pm, "_place", fake_place)
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            pm.reactivate()
+        assert not pm.active
+        assert all(g.params is None for g in pm._groups)  # rolled back
+        pm._groups.pop()
+
     def test_cleaned_up_model_never_auto_reactivates(self, toy):
         # cleanup() is terminal: neither the step counter nor rebalance() may
         # resurrect placements the user explicitly tore down.

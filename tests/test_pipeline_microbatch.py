@@ -69,6 +69,35 @@ class TestMicrobatchedPipeline:
             np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
         )
 
+    def test_uneven_batch_pads_to_uniform_chunks(self, model, cpu_devices):
+        # Uneven largest-remainder sizes would compile every stage program
+        # twice; the router pads to mb * ceil(batch/mb) so all chunks share
+        # ONE shape, then slices the concat back.
+        pm = parallelize(
+            model,
+            DeviceChain.even([f"cpu:{i}" for i in range(4)]),
+            ParallelConfig(pipeline_microbatches=3),
+        )
+        x, t, ctx, y = _inputs(7, seed=5)
+        pm(x, t, ctx, y=y)  # build the runner
+        orig = pm._pipeline_runner
+        seen = []
+
+        class Spy:
+            n_stages = orig.n_stages
+
+            def __call__(self, xi, ti, ci=None, **kw):
+                seen.append(xi.shape[0])
+                return orig(xi, ti, ci, **kw)
+
+        pm._pipeline_runner = Spy()
+        got = pm(x, t, ctx, y=y)
+        assert seen == [3, 3, 3]  # uniform chunk shapes (7 -> 9 padded)
+        want = model.apply(model.params, x, t, ctx, y=y)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
+
     def test_no_spec_falls_through_to_dp(self, cpu_devices):
         def f(p, x, t, context=None, **kw):
             return x * p["a"]
