@@ -342,6 +342,44 @@ def _scan_lms(denoise, x, sigmas, keys, post, constrain, coeffs=None):
     return x
 
 
+def _scan_lcm(denoise, x, sigmas, keys, post, constrain):
+    def body(x, per):
+        i, s, s_next, key = per
+        x0 = denoise(x, s)
+        noise = jax.random.normal(key, x.shape, x.dtype)
+        x = x0 + jnp.where(s_next > 0, s_next, 0.0) * noise
+        return constrain(post(i, x)), None
+
+    n = len(sigmas) - 1
+    x, _ = jax.lax.scan(body, x, (jnp.arange(n), sigmas[:-1], sigmas[1:], keys))
+    return x
+
+
+def _scan_ddpm(denoise, x, sigmas, keys, post, constrain):
+    def body(x, per):
+        i, s, s_next, key = per
+        x0 = denoise(x, s)
+        eps = (x - x0) / s
+        acp = 1.0 / (s**2 + 1.0)
+        acp_prev = 1.0 / (s_next**2 + 1.0)
+        alpha = acp / acp_prev
+        x_a = x / jnp.sqrt(1.0 + s**2)
+        mu = jnp.sqrt(1.0 / alpha) * (
+            x_a - (1.0 - alpha) * eps / jnp.sqrt(1.0 - acp)
+        )
+        var = (1.0 - alpha) * (1.0 - acp_prev) / jnp.maximum(1.0 - acp, 1e-12)
+        noisy = (
+            mu + jnp.sqrt(jnp.maximum(var, 0.0))
+            * jax.random.normal(key, x.shape, x.dtype)
+        ) * jnp.sqrt(1.0 + s_next**2)
+        x = jnp.where(s_next > 0, noisy, mu)
+        return constrain(post(i, x)), None
+
+    n = len(sigmas) - 1
+    x, _ = jax.lax.scan(body, x, (jnp.arange(n), sigmas[:-1], sigmas[1:], keys))
+    return x
+
+
 SCAN_SAMPLERS = {
     "euler": _scan_euler,
     "euler_ancestral": _scan_euler_ancestral,
@@ -350,6 +388,8 @@ SCAN_SAMPLERS = {
     "dpmpp_2m": _scan_dpmpp_2m,
     "dpmpp_2m_sde": _scan_dpmpp_2m_sde,
     "dpmpp_3m_sde": _scan_dpmpp_3m_sde,
+    "lcm": _scan_lcm,
+    "ddpm": _scan_ddpm,
 }
 
 

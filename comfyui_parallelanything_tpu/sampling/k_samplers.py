@@ -454,6 +454,48 @@ def sample_lms(denoise, x, sigmas, order: int = 4, callback=None):
     return x
 
 
+def sample_lcm(denoise, x, sigmas, rng, callback=None):
+    """Latent Consistency Model sampling (the host KSampler's ``lcm`` entry):
+    each step takes the model's x0 prediction directly and re-noises it to the
+    next sigma with FRESH noise — one jump per step, no ODE integration."""
+    for i in range(len(sigmas) - 1):
+        x0 = denoise(x, sigmas[i])
+        x = x0
+        if float(sigmas[i + 1]) > 0:
+            rng, sub = jax.random.split(rng)
+            x = x + sigmas[i + 1] * jax.random.normal(sub, x.shape, x.dtype)
+        x = apply_callback(callback, i, x)
+    return x
+
+
+def sample_ddpm(denoise, x, sigmas, rng, callback=None):
+    """Ancestral DDPM in sigma space (k-diffusion's ``sample_ddpm`` /
+    generic_step_sampler with the DDPM posterior step): the model's eps
+    estimate drives the exact DDPM posterior mean in ᾱ-space, with posterior
+    variance noise on every non-final step. x rides in k-diffusion's sigma
+    scaling (x = √(1+σ²)·x_ᾱ) between steps."""
+    for i in range(len(sigmas) - 1):
+        s, s_next = sigmas[i], sigmas[i + 1]
+        x0 = denoise(x, s)
+        eps = (x - x0) / s
+        acp = 1.0 / (s**2 + 1.0)          # ᾱ_t from sigma
+        acp_prev = 1.0 / (s_next**2 + 1.0)
+        alpha = acp / acp_prev
+        x_a = x / jnp.sqrt(1.0 + s**2)     # ᾱ-space sample
+        mu = jnp.sqrt(1.0 / alpha) * (
+            x_a - (1.0 - alpha) * eps / jnp.sqrt(1.0 - acp)
+        )
+        if float(s_next) > 0:
+            rng, sub = jax.random.split(rng)
+            var = (1.0 - alpha) * (1.0 - acp_prev) / (1.0 - acp)
+            mu = mu + jnp.sqrt(var) * jax.random.normal(sub, x.shape, x.dtype)
+            x = mu * jnp.sqrt(1.0 + s_next**2)  # back to sigma scaling
+        else:
+            x = mu
+        x = apply_callback(callback, i, x)
+    return x
+
+
 # One registry for the sigma-space samplers; stochastic ones (extra rng arg)
 # are listed in RNG_SAMPLERS so dispatchers know the signature.
 SAMPLERS = {
@@ -464,5 +506,9 @@ SAMPLERS = {
     "dpmpp_2m": sample_dpmpp_2m,
     "dpmpp_2m_sde": sample_dpmpp_2m_sde,
     "dpmpp_3m_sde": sample_dpmpp_3m_sde,
+    "lcm": sample_lcm,
+    "ddpm": sample_ddpm,
 }
-RNG_SAMPLERS = frozenset({"euler_ancestral", "dpmpp_2m_sde", "dpmpp_3m_sde"})
+RNG_SAMPLERS = frozenset(
+    {"euler_ancestral", "dpmpp_2m_sde", "dpmpp_3m_sde", "lcm", "ddpm"}
+)

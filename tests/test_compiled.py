@@ -38,7 +38,7 @@ def _ctx(seed=3, batch=SHAPE[0]):
 
 ALL_SAMPLERS = [
     "euler", "euler_ancestral", "heun", "lms", "dpmpp_2m", "dpmpp_2m_sde",
-    "dpmpp_3m_sde", "ddim", "flow_euler",
+    "dpmpp_3m_sde", "lcm", "ddpm", "ddim", "flow_euler",
 ]
 
 

@@ -180,12 +180,28 @@ class TestSamplersRecoverX0:
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         np.testing.assert_allclose(np.asarray(a), np.asarray(x0), rtol=1e-2, atol=1e-2)
 
+    def test_lcm_recovers_x0_exactly(self, problem):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import sample_lcm
+
+        x0, x_init, sigmas, denoise = problem
+        out = sample_lcm(denoise, x_init, sigmas, jax.random.key(4))
+        # The final LCM step returns the model x0 prediction directly — with an
+        # oracle denoiser that is exact regardless of the noisy trajectory.
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=1e-5, atol=1e-5)
+
+    def test_ddpm_converges_near_x0(self, problem):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import sample_ddpm
+
+        x0, x_init, sigmas, denoise = problem
+        out = sample_ddpm(denoise, x_init, sigmas, jax.random.key(5))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=0.15, atol=0.15)
+
     def test_registry_complete(self):
         from comfyui_parallelanything_tpu.sampling import RNG_SAMPLERS
 
         assert set(SAMPLERS) == {
             "euler", "euler_ancestral", "heun", "lms", "dpmpp_2m",
-            "dpmpp_2m_sde", "dpmpp_3m_sde",
+            "dpmpp_2m_sde", "dpmpp_3m_sde", "lcm", "ddpm",
         }
         assert RNG_SAMPLERS <= set(SAMPLERS)
 
