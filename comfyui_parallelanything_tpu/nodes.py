@@ -1079,6 +1079,100 @@ class TPUVAEDecode:
         return (vae_output_to_images(decode_maybe_tiled(vae, latent["samples"], tile_size)),)
 
 
+class TPUSaveImage:
+    """IMAGE → PNG files on disk — the terminal node every exported ComfyUI
+    txt2img workflow ends with (the reference relies on the host's SaveImage;
+    standalone, the framework supplies its own). Returns the written paths."""
+
+    DESCRIPTION = "Save a batch of images as numbered PNGs."
+    RETURN_TYPES = ("PATHS",)
+    RETURN_NAMES = ("paths",)
+    FUNCTION = "save"
+    CATEGORY = CATEGORY
+    OUTPUT_NODE = True
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "images": ("IMAGE", {}),
+                "filename_prefix": ("STRING", {"default": "tpu"}),
+            },
+            "optional": {
+                "output_dir": ("STRING", {"default": "output"}),
+            },
+        }
+
+    def save(self, images, filename_prefix: str = "tpu", output_dir: str = "output"):
+        import os
+
+        import numpy as np
+        from PIL import Image
+
+        os.makedirs(output_dir, exist_ok=True)
+        arr = np.asarray(images)
+        if arr.ndim == 3:
+            arr = arr[None]
+        arr = (np.clip(arr, 0.0, 1.0) * 255.0 + 0.5).astype(np.uint8)
+        # Counter continues past the HIGHEST existing index (not the file
+        # count) so re-runs never overwrite, even with gaps or stray files
+        # matching the prefix — host SaveImage semantics.
+        import re as _re
+
+        pat = _re.compile(_re.escape(filename_prefix) + r"_(\d+)\.png$")
+        taken = [
+            int(m.group(1))
+            for f in os.listdir(output_dir)
+            if (m := pat.match(f))
+        ]
+        start = max(taken) + 1 if taken else 0
+        paths = []
+        for i, img in enumerate(arr):
+            path = os.path.join(
+                output_dir, f"{filename_prefix}_{start + i:05d}.png"
+            )
+            Image.fromarray(img).save(path)
+            paths.append(path)
+        return (tuple(paths),)
+
+
+class TPULoadImage:
+    """Image file → (IMAGE floats in [0,1], MASK from alpha) — the img2img /
+    inpaint entry node of exported workflows (host LoadImage semantics: mask is
+    1 where the alpha channel is transparent; zeros when no alpha)."""
+
+    DESCRIPTION = "Load an image file as IMAGE (+ alpha-derived MASK)."
+    RETURN_TYPES = ("IMAGE", "MASK")
+    RETURN_NAMES = ("image", "mask")
+    FUNCTION = "load"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"image_path": ("STRING", {"default": ""})}}
+
+    def load(self, image_path: str):
+        import jax.numpy as jnp
+        import numpy as np
+        from PIL import Image, ImageOps
+
+        img = Image.open(image_path)
+        # Camera JPEGs carry orientation in EXIF; the host LoadImage applies it
+        # before handing pixels downstream — match that.
+        img = ImageOps.exif_transpose(img)
+        # Convert FIRST: palette-mode PNGs carry transparency without an 'A'
+        # band, and RGBA conversion materializes it into the alpha channel.
+        rgba = np.asarray(img.convert("RGBA"), np.float32) / 255.0
+        image = jnp.asarray(rgba[None, :, :, :3])
+        alpha = rgba[None, :, :, 3]
+        mask = (
+            jnp.asarray(1.0 - alpha)
+            if float(alpha.min()) < 1.0
+            else jnp.zeros(image.shape[:3], jnp.float32)
+        )
+        return (image, mask)
+
+
 NODE_CLASS_MAPPINGS = {
     "ParallelAnything": ParallelAnything,
     "ParallelAnythingAdvanced": ParallelAnythingAdvanced,
@@ -1095,6 +1189,8 @@ NODE_CLASS_MAPPINGS = {
     "TPUEmptyVideoLatent": TPUEmptyVideoLatent,
     "TPUKSampler": TPUKSampler,
     "TPUVAEDecode": TPUVAEDecode,
+    "TPUSaveImage": TPUSaveImage,
+    "TPULoadImage": TPULoadImage,
 }
 
 NODE_DISPLAY_NAME_MAPPINGS = {
@@ -1105,6 +1201,8 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPUCheckpointLoader": "Load Checkpoint (TPU)",
     "TPUCLIPLoader": "Load Text Encoder (TPU)",
     "TPUTextEncode": "Text Encode (TPU)",
+    "TPUSaveImage": "Save Image (TPU)",
+    "TPULoadImage": "Load Image (TPU)",
     "TPUConditioningCombine": "Conditioning Combine (TPU, SDXL/FLUX)",
     "TPUEmptyLatent": "Empty Latent (TPU)",
     "TPUVAEEncode": "VAE Encode (TPU)",

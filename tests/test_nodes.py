@@ -193,6 +193,59 @@ class TestParallelAnythingNode:
         )
         assert wrapped2.config.reactivate_after == 5
 
+    def test_save_load_image_roundtrip(self, tmp_path):
+        # The terminal/entry nodes of exported workflows: save a batch as
+        # numbered PNGs, load one back within 8-bit quantization error.
+        from comfyui_parallelanything_tpu.nodes import TPULoadImage, TPUSaveImage
+
+        imgs = jnp.asarray(
+            np.random.default_rng(0).uniform(0, 1, size=(2, 16, 16, 3)),
+            jnp.float32,
+        )
+        (paths,) = TPUSaveImage().save(
+            imgs, filename_prefix="t", output_dir=str(tmp_path)
+        )
+        assert len(paths) == 2 and all(p.endswith(".png") for p in paths)
+        # Re-run continues numbering instead of overwriting.
+        (paths2,) = TPUSaveImage().save(
+            imgs, filename_prefix="t", output_dir=str(tmp_path)
+        )
+        assert set(paths).isdisjoint(paths2)
+        image, mask = TPULoadImage().load(paths[0])
+        assert image.shape == (1, 16, 16, 3)
+        np.testing.assert_allclose(
+            np.asarray(image[0]), np.asarray(imgs[0]), atol=1.0 / 255.0 + 1e-6
+        )
+        assert mask.shape == (1, 16, 16) and float(mask.max()) == 0.0
+
+    def test_save_image_counter_survives_gaps(self, tmp_path):
+        # Deleting an early file must not shift numbering onto survivors.
+        import os
+
+        from comfyui_parallelanything_tpu.nodes import TPUSaveImage
+
+        img = jnp.ones((1, 4, 4, 3), jnp.float32)
+        (p1,) = TPUSaveImage().save(img, "t", str(tmp_path))[0]
+        ((p2,),) = TPUSaveImage().save(img, "t", str(tmp_path))
+        os.remove(p1)  # leave a gap at index 0
+        ((p3,),) = TPUSaveImage().save(img, "t", str(tmp_path))
+        assert p3 != p2 and os.path.exists(p2)  # survivor untouched
+
+    def test_load_image_alpha_becomes_mask(self, tmp_path):
+        from PIL import Image
+
+        from comfyui_parallelanything_tpu.nodes import TPULoadImage
+
+        rgba = np.zeros((8, 8, 4), np.uint8)
+        rgba[..., :3] = 128
+        rgba[..., 3] = 255
+        rgba[:4, :, 3] = 0  # top half transparent -> mask 1
+        p = tmp_path / "a.png"
+        Image.fromarray(rgba, "RGBA").save(p)
+        image, mask = TPULoadImage().load(str(p))
+        assert float(mask[0, :4].min()) == 1.0
+        assert float(mask[0, 4:].max()) == 0.0
+
     def test_unusable_chain_returns_model_unchanged(self):
         cfg = sd15_config(
             model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
