@@ -561,25 +561,47 @@ class TPUTextEncode:
             "required": {
                 "clip": ("CLIP", {}),
                 "text": ("STRING", {"default": "", "multiline": True}),
-            }
+            },
+            "optional": {
+                "clip_skip": (
+                    "INT",
+                    {"default": 0, "min": 0, "max": 2,
+                     "tooltip": "host CLIPSetLastLayer semantics: 0 = model "
+                                "default (SD2 towers auto-use penultimate), "
+                                "1 = final layer, 2 = penultimate"},
+                ),
+            },
         }
 
-    def encode(self, clip, text: str):
+    def encode(self, clip, text: str, clip_skip: int = 0):
         import jax.numpy as jnp
 
+        if clip_skip in (-1, -2):
+            # Host CLIPSetLastLayer convention (stop_at_clip_layer).
+            clip_skip = -clip_skip
+        if clip_skip not in (0, 1, 2):
+            raise ValueError(
+                f"clip_skip must be 0 (model default), 1/-1 (final layer) or "
+                f"2/-2 (penultimate); got {clip_skip}"
+            )
         enc, tok = clip["encoder"], clip["tokenizer"]
         ids, mask = tok([text])
         if clip["type"] == "t5":
             context = enc(jnp.asarray(ids, jnp.int32), mask=jnp.asarray(mask))
             return ({"context": context, "pooled": None},)
         last, penultimate, pooled = enc(jnp.asarray(ids, jnp.int32))
-        # SD2 towers (penultimate_ln configs) were trained with penultimate-
-        # layer conditioning — route it as the context automatically.
-        context = (
-            penultimate
-            if getattr(enc.cfg, "penultimate_ln", False)
-            else last
-        )
+        if clip_skip == 1:
+            context = last
+        elif clip_skip == 2:
+            context = penultimate
+        else:
+            # Model default: SD2 towers (penultimate_ln configs) were trained
+            # with penultimate-layer conditioning — route it automatically.
+            context = (
+                penultimate
+                if getattr(enc.cfg, "penultimate_ln", False)
+                else last
+            )
         return (
             {
                 "context": context,
@@ -1109,28 +1131,30 @@ class TPUSaveImage:
         import numpy as np
         from PIL import Image
 
-        os.makedirs(output_dir, exist_ok=True)
+        # Host SaveImage semantics: the prefix may carry a subfolder
+        # ("run1/img") — create it and count within it.
+        subdir, name = os.path.split(filename_prefix)
+        target_dir = os.path.join(output_dir, subdir) if subdir else output_dir
+        os.makedirs(target_dir, exist_ok=True)
         arr = np.asarray(images)
         if arr.ndim == 3:
             arr = arr[None]
         arr = (np.clip(arr, 0.0, 1.0) * 255.0 + 0.5).astype(np.uint8)
         # Counter continues past the HIGHEST existing index (not the file
         # count) so re-runs never overwrite, even with gaps or stray files
-        # matching the prefix — host SaveImage semantics.
+        # matching the prefix.
         import re as _re
 
-        pat = _re.compile(_re.escape(filename_prefix) + r"_(\d+)\.png$")
+        pat = _re.compile(_re.escape(name) + r"_(\d+)\.png$")
         taken = [
             int(m.group(1))
-            for f in os.listdir(output_dir)
+            for f in os.listdir(target_dir)
             if (m := pat.match(f))
         ]
         start = max(taken) + 1 if taken else 0
         paths = []
         for i, img in enumerate(arr):
-            path = os.path.join(
-                output_dir, f"{filename_prefix}_{start + i:05d}.png"
-            )
+            path = os.path.join(target_dir, f"{name}_{start + i:05d}.png")
             Image.fromarray(img).save(path)
             paths.append(path)
         return (tuple(paths),)

@@ -126,6 +126,23 @@ class TestFullNodeGraph:
         assert a.shape == (2, 32, 32, 3)
         assert np.isfinite(a).all() and a.min() >= 0.0 and a.max() <= 1.0
 
+    def test_clip_skip_selects_layer(self, graph_parts):
+        # Host CLIPSetLastLayer semantics: 1 = final layer, 2 = penultimate,
+        # 0 = model default.
+        clip_wire, _, _ = graph_parts
+        (default,) = TPUTextEncode().encode(clip_wire, "hello")
+        (final,) = TPUTextEncode().encode(clip_wire, "hello", clip_skip=1)
+        (pen,) = TPUTextEncode().encode(clip_wire, "hello", clip_skip=2)
+        np.testing.assert_array_equal(
+            np.asarray(final["context"]), np.asarray(default["context"])
+        )  # CLIP-L default == final layer
+        np.testing.assert_array_equal(
+            np.asarray(pen["context"]), np.asarray(default["penultimate"])
+        )
+        assert not np.allclose(
+            np.asarray(final["context"]), np.asarray(pen["context"])
+        )
+
     def test_ksampler_ddim_and_no_negative(self, graph_parts):
         clip_wire, model, _ = graph_parts
         (positive,) = TPUTextEncode().encode(clip_wire, "hello")

@@ -231,6 +231,18 @@ class TestParallelAnythingNode:
         ((p3,),) = TPUSaveImage().save(img, "t", str(tmp_path))
         assert p3 != p2 and os.path.exists(p2)  # survivor untouched
 
+    def test_save_image_subfolder_prefix(self, tmp_path):
+        # Host SaveImage semantics: the prefix may carry a subfolder.
+        import os
+
+        from comfyui_parallelanything_tpu.nodes import TPUSaveImage
+
+        img = jnp.ones((1, 4, 4, 3), jnp.float32)
+        ((p1,),) = TPUSaveImage().save(img, "run1/img", str(tmp_path))
+        ((p2,),) = TPUSaveImage().save(img, "run1/img", str(tmp_path))
+        assert os.path.dirname(p1) == str(tmp_path / "run1")
+        assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+
     def test_load_image_alpha_becomes_mask(self, tmp_path):
         from PIL import Image
 
