@@ -1132,9 +1132,17 @@ class TPUSaveImage:
         from PIL import Image
 
         # Host SaveImage semantics: the prefix may carry a subfolder
-        # ("run1/img") — create it and count within it.
+        # ("run1/img") — create it and count within it. Absolute or
+        # parent-escaping prefixes are rejected: a workflow JSON must not be
+        # able to write outside the configured output directory.
         subdir, name = os.path.split(filename_prefix)
         target_dir = os.path.join(output_dir, subdir) if subdir else output_dir
+        root = os.path.realpath(output_dir)
+        if os.path.commonpath([root, os.path.realpath(target_dir)]) != root:
+            raise ValueError(
+                f"filename_prefix {filename_prefix!r} resolves outside "
+                f"output_dir {output_dir!r}"
+            )
         os.makedirs(target_dir, exist_ok=True)
         arr = np.asarray(images)
         if arr.ndim == 3:

@@ -414,6 +414,7 @@ class TestShippedExampleWorkflow:
         wf["dev1"]["inputs"]["device_id"] = "cpu:1"
         wf["latent"]["inputs"].update(width=32, height=32, batch_size=4)
         wf["sampler"]["inputs"]["steps"] = 2
+        wf["save"]["inputs"]["output_dir"] = str(tmp_path / "out")
 
         out = run_workflow(wf)
         images = out["decode"][0]
@@ -423,6 +424,10 @@ class TestShippedExampleWorkflow:
         assert images.shape == (4, hw, hw, 3)
         assert np.isfinite(np.asarray(images)).all()
         assert out["parallel"][0].devices == ("cpu:0", "cpu:1")
+        import os
+
+        paths = out["save"][0]
+        assert len(paths) == 4 and all(os.path.exists(p) for p in paths)
 
 
 class TestEndToEndGraph:

@@ -7,6 +7,7 @@ the node entry point."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from comfyui_parallelanything_tpu import nodes
 from comfyui_parallelanything_tpu.models import build_unet, sd15_config
@@ -242,6 +243,14 @@ class TestParallelAnythingNode:
         ((p2,),) = TPUSaveImage().save(img, "run1/img", str(tmp_path))
         assert os.path.dirname(p1) == str(tmp_path / "run1")
         assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+
+    def test_save_image_rejects_escaping_prefix(self, tmp_path):
+        from comfyui_parallelanything_tpu.nodes import TPUSaveImage
+
+        img = jnp.ones((1, 4, 4, 3), jnp.float32)
+        for bad in ("../esc/img", "/tmp/abs/img"):
+            with pytest.raises(ValueError, match="outside"):
+                TPUSaveImage().save(img, bad, str(tmp_path))
 
     def test_load_image_alpha_becomes_mask(self, tmp_path):
         from PIL import Image
