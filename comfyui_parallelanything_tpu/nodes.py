@@ -1122,10 +1122,19 @@ class TPUSaveImage:
             },
             "optional": {
                 "output_dir": ("STRING", {"default": "output"}),
+                "metadata": (
+                    "STRING",
+                    {"default": "", "multiline": True,
+                     "tooltip": "embedded as the PNG 'parameters' text chunk "
+                                "(the A1111-style key most galleries/readers "
+                                "parse; ComfyUI's own chunks are "
+                                "'prompt'/'workflow')"},
+                ),
             },
         }
 
-    def save(self, images, filename_prefix: str = "tpu", output_dir: str = "output"):
+    def save(self, images, filename_prefix: str = "tpu", output_dir: str = "output",
+             metadata: str = ""):
         import os
 
         import numpy as np
@@ -1160,10 +1169,16 @@ class TPUSaveImage:
             if (m := pat.match(f))
         ]
         start = max(taken) + 1 if taken else 0
+        pnginfo = None
+        if metadata:
+            from PIL.PngImagePlugin import PngInfo
+
+            pnginfo = PngInfo()
+            pnginfo.add_text("parameters", metadata)
         paths = []
         for i, img in enumerate(arr):
             path = os.path.join(target_dir, f"{name}_{start + i:05d}.png")
-            Image.fromarray(img).save(path)
+            Image.fromarray(img).save(path, pnginfo=pnginfo)
             paths.append(path)
         return (tuple(paths),)
 
@@ -1205,6 +1220,44 @@ class TPULoadImage:
         return (image, mask)
 
 
+class TPUImageScale:
+    """IMAGE → resized IMAGE (bilinear/nearest/lanczos) — the image-space half
+    of the hi-res-fix surface (TPULatentUpscale covers latent space)."""
+
+    DESCRIPTION = "Resize images to an exact width/height."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "scale"
+    CATEGORY = CATEGORY
+
+    METHODS = ("bilinear", "nearest", "lanczos3")
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE", {}),
+                "width": ("INT", {"default": 1024, "min": 8, "max": 16384}),
+                "height": ("INT", {"default": 1024, "min": 8, "max": 16384}),
+                "method": (list(cls.METHODS), {"default": "bilinear"}),
+            }
+        }
+
+    def scale(self, image, width: int, height: int, method: str = "bilinear"):
+        import jax
+        import jax.numpy as jnp
+
+        if method not in self.METHODS:
+            raise ValueError(f"method must be one of {self.METHODS}, got {method!r}")
+        img = jnp.asarray(image)
+        if img.ndim == 3:
+            img = img[None]
+        out = jax.image.resize(
+            img, (img.shape[0], height, width, img.shape[-1]), method=method
+        )
+        return (jnp.clip(out, 0.0, 1.0),)
+
+
 NODE_CLASS_MAPPINGS = {
     "ParallelAnything": ParallelAnything,
     "ParallelAnythingAdvanced": ParallelAnythingAdvanced,
@@ -1223,6 +1276,7 @@ NODE_CLASS_MAPPINGS = {
     "TPUVAEDecode": TPUVAEDecode,
     "TPUSaveImage": TPUSaveImage,
     "TPULoadImage": TPULoadImage,
+    "TPUImageScale": TPUImageScale,
 }
 
 NODE_DISPLAY_NAME_MAPPINGS = {
@@ -1235,6 +1289,7 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPUTextEncode": "Text Encode (TPU)",
     "TPUSaveImage": "Save Image (TPU)",
     "TPULoadImage": "Load Image (TPU)",
+    "TPUImageScale": "Image Scale (TPU)",
     "TPUConditioningCombine": "Conditioning Combine (TPU, SDXL/FLUX)",
     "TPUEmptyLatent": "Empty Latent (TPU)",
     "TPUVAEEncode": "VAE Encode (TPU)",

@@ -244,6 +244,30 @@ class TestParallelAnythingNode:
         assert os.path.dirname(p1) == str(tmp_path / "run1")
         assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
 
+    def test_save_image_embeds_metadata(self, tmp_path):
+        from PIL import Image
+
+        from comfyui_parallelanything_tpu.nodes import TPUSaveImage
+
+        img = jnp.ones((1, 4, 4, 3), jnp.float32)
+        ((p,),) = TPUSaveImage().save(
+            img, "m", str(tmp_path), metadata="prompt: a lighthouse"
+        )
+        assert Image.open(p).text["parameters"] == "prompt: a lighthouse"
+
+    def test_image_scale(self):
+        from comfyui_parallelanything_tpu.nodes import TPUImageScale
+
+        img = jnp.linspace(0, 1, 2 * 8 * 8 * 3).reshape(2, 8, 8, 3)
+        (out,) = TPUImageScale().scale(img, width=16, height=12)
+        assert out.shape == (2, 12, 16, 3)
+        assert float(out.min()) >= 0.0 and float(out.max()) <= 1.0
+        # Nearest on an integer upscale preserves exact values.
+        (nn,) = TPUImageScale().scale(img, width=16, height=16, method="nearest")
+        np.testing.assert_array_equal(np.asarray(nn[:, ::2, ::2]), np.asarray(img))
+        with pytest.raises(ValueError, match="method"):
+            TPUImageScale().scale(img, width=8, height=8, method="cubic")
+
     def test_save_image_rejects_escaping_prefix(self, tmp_path):
         from comfyui_parallelanything_tpu.nodes import TPUSaveImage
 
