@@ -772,6 +772,12 @@ class TPUVAEEncode:
         return ({"samples": vae.encode(x, rng)},)
 
 
+# Resize methods shared by the two hi-res-fix siblings (latent- and
+# image-space); both validate against it so a workflow typo gets a clear
+# error instead of a jax internal one.
+RESIZE_METHODS = ("nearest", "bilinear", "lanczos3")
+
+
 class TPULatentUpscale:
     """(LATENT, scale) → LATENT resized in latent space — the hi-res-fix step
     between a low-res sample and a denoise<1 KSampler pass."""
@@ -789,13 +795,17 @@ class TPULatentUpscale:
                 "latent": ("LATENT", {}),
                 "scale": ("FLOAT", {"default": 2.0, "min": 0.25, "max": 8.0,
                                     "step": 0.25}),
-                "method": (["nearest", "bilinear", "lanczos3"],
-                           {"default": "bilinear"}),
+                "method": (list(RESIZE_METHODS), {"default": "bilinear"}),
             }
         }
 
     def upscale(self, latent, scale: float, method: str = "bilinear"):
         import jax
+
+        if method not in RESIZE_METHODS:
+            raise ValueError(
+                f"method must be one of {RESIZE_METHODS}, got {method!r}"
+            )
 
         z = latent["samples"]
         # Spatial dims are the two before channels (works for image 4-D and
@@ -1230,16 +1240,19 @@ class TPUImageScale:
     FUNCTION = "scale"
     CATEGORY = CATEGORY
 
-    METHODS = ("bilinear", "nearest", "lanczos3")
-
     @classmethod
     def INPUT_TYPES(cls):
         return {
             "required": {
                 "image": ("IMAGE", {}),
-                "width": ("INT", {"default": 1024, "min": 8, "max": 16384}),
-                "height": ("INT", {"default": 1024, "min": 8, "max": 16384}),
-                "method": (list(cls.METHODS), {"default": "bilinear"}),
+                # step 8: diffusion consumers need factor-of-8-aligned pixel
+                # dims (TPUEmptyLatent uses the same step; TPUKSampler's
+                # boundary validation rejects misaligned latents).
+                "width": ("INT", {"default": 1024, "min": 8, "max": 16384,
+                                  "step": 8}),
+                "height": ("INT", {"default": 1024, "min": 8, "max": 16384,
+                                   "step": 8}),
+                "method": (list(RESIZE_METHODS), {"default": "bilinear"}),
             }
         }
 
@@ -1247,8 +1260,10 @@ class TPUImageScale:
         import jax
         import jax.numpy as jnp
 
-        if method not in self.METHODS:
-            raise ValueError(f"method must be one of {self.METHODS}, got {method!r}")
+        if method not in RESIZE_METHODS:
+            raise ValueError(
+                f"method must be one of {RESIZE_METHODS}, got {method!r}"
+            )
         img = jnp.asarray(image)
         if img.ndim == 3:
             img = img[None]

@@ -338,12 +338,16 @@ class TestWorkflowCache:
 
 
 class TestShippedExampleWorkflow:
-    def test_example_sd15_txt2img_executes(self, cpu_devices, tmp_path, monkeypatch):
-        """The committed examples/workflow_sd15_txt2img.json must stay runnable:
-        execute it through host.py against a synthetic tiny checkpoint (inverse-
-        synthesis layout, the tests' standard pattern), with only the things a
-        user would edit rewritten — file paths, device ids, sizes/steps. Every
-        node class in the shipped artifact executes for real."""
+    """The committed examples/*.json must stay runnable: execute them through
+    host.py against a synthetic tiny checkpoint (inverse-synthesis layout, the
+    tests' standard pattern), with only the things a user would edit rewritten
+    — file paths, device ids, sizes/steps. Every node class in the shipped
+    artifacts executes for real."""
+
+    def _synthetic_env(self, tmp_path, monkeypatch):
+        """Tiny sd15 checkpoint + CLIP encoder + tokenizer on disk, with the
+        family preset factories monkeypatched to the matching tiny configs.
+        Returns (paths dict, vae spatial factor)."""
         import jax.numpy as jnp
         from safetensors.numpy import save_file
 
@@ -404,30 +408,69 @@ class TestShippedExampleWorkflow:
         t.pre_tokenizer = Whitespace()
         tok_path = tmp_path / "tokenizer.json"
         t.save(str(tok_path))
+        paths = {
+            "ckpt": str(ckpt), "clip": str(enc_path), "tok": str(tok_path),
+            "max_len": TINY_CLIP.max_len,
+        }
+        return paths, vae.spatial_factor
 
-        wf = json.load(open("examples/workflow_sd15_txt2img.json"))
-        wf["checkpoint"]["inputs"]["ckpt_path"] = str(ckpt)
-        wf["clip"]["inputs"]["encoder_path"] = str(enc_path)
-        wf["clip"]["inputs"]["tokenizer_json"] = str(tok_path)
-        wf["clip"]["inputs"]["max_len"] = TINY_CLIP.max_len
+    def _rewrite_common(self, wf, paths):
+        wf["checkpoint"]["inputs"]["ckpt_path"] = paths["ckpt"]
+        wf["clip"]["inputs"]["encoder_path"] = paths["clip"]
+        wf["clip"]["inputs"]["tokenizer_json"] = paths["tok"]
+        wf["clip"]["inputs"]["max_len"] = paths["max_len"]
         wf["dev0"]["inputs"]["device_id"] = "cpu:0"
         wf["dev1"]["inputs"]["device_id"] = "cpu:1"
-        wf["latent"]["inputs"].update(width=32, height=32, batch_size=4)
         wf["sampler"]["inputs"]["steps"] = 2
+        return wf
+
+    def test_example_sd15_txt2img_executes(self, cpu_devices, tmp_path, monkeypatch):
+        import os
+
+        paths, factor = self._synthetic_env(tmp_path, monkeypatch)
+        wf = self._rewrite_common(
+            json.load(open("examples/workflow_sd15_txt2img.json")), paths
+        )
+        wf["latent"]["inputs"].update(width=32, height=32, batch_size=4)
         wf["save"]["inputs"]["output_dir"] = str(tmp_path / "out")
 
         out = run_workflow(wf)
         images = out["decode"][0]
         # TPUEmptyLatent assumes the SD factor-8 latent grid; the tiny VAE
         # upsamples by its own (smaller) factor — assert consistently.
-        hw = 32 // 8 * vae.spatial_factor
+        hw = 32 // 8 * factor
         assert images.shape == (4, hw, hw, 3)
         assert np.isfinite(np.asarray(images)).all()
         assert out["parallel"][0].devices == ("cpu:0", "cpu:1")
+        saved = out["save"][0]
+        assert len(saved) == 4 and all(os.path.exists(p) for p in saved)
+
+    def test_example_sd15_img2img_executes(self, cpu_devices, tmp_path, monkeypatch):
         import os
 
-        paths = out["save"][0]
-        assert len(paths) == 4 and all(os.path.exists(p) for p in paths)
+        from PIL import Image
+
+        paths, factor = self._synthetic_env(tmp_path, monkeypatch)
+        src = tmp_path / "input.png"
+        Image.fromarray(
+            (np.random.default_rng(0).uniform(0, 1, (16, 16, 3)) * 255).astype(
+                np.uint8
+            )
+        ).save(src)
+        wf = self._rewrite_common(
+            json.load(open("examples/workflow_sd15_img2img.json")), paths
+        )
+        wf["source"]["inputs"]["image_path"] = str(src)
+        wf["save"]["inputs"]["output_dir"] = str(tmp_path / "out")
+
+        out = run_workflow(wf)
+        images = out["decode"][0]
+        lat = 16 // factor  # encode downsamples by the tiny VAE's factor
+        assert out["sampler"][0]["samples"].shape[1:3] == (lat, lat)
+        assert images.shape == (1, lat * factor, lat * factor, 3)
+        assert np.isfinite(np.asarray(images)).all()
+        saved = out["save"][0]
+        assert len(saved) == 1 and os.path.exists(saved[0])
 
 
 class TestEndToEndGraph:
