@@ -176,6 +176,23 @@ class TestQuantizedModel:
         single = np.asarray(qm.apply(qm.params, x, t, ctx, y=y))
         np.testing.assert_allclose(np.asarray(out), single, rtol=2e-3, atol=2e-3)
 
+    def test_compile_loop_on_quantized_model(self, flux_model):
+        # The whole-loop compiled sampler must trace straight through a
+        # QuantTensor pytree (dequantize-in-jit) and match the eager loop —
+        # the exact combination the flux_16_int8 bench rung runs.
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        qm = quantize_model(flux_model, min_size=2**10, dtype=jnp.float32)
+        noise = jax.random.normal(jax.random.key(6), (2, 8, 8, 4))
+        ctx = jax.random.normal(jax.random.key(7), (2, 8, TINY.context_in_dim))
+        y = jax.random.normal(jax.random.key(8), (2, TINY.vec_in_dim))
+        kw = dict(sampler="euler", steps=3, y=y)
+        eager = run_sampler(qm, noise, ctx, **kw)
+        compiled = run_sampler(qm, noise, ctx, compile_loop=True, **kw)
+        np.testing.assert_allclose(
+            np.asarray(eager), np.asarray(compiled), rtol=2e-4, atol=2e-5
+        )
+
     def test_bench_synth_int8_rung_logic(self):
         # The flux_16_int8 bench rung synthesizes int8 params straight from
         # abstract shapes (no high-precision pytree ever exists); validate the
