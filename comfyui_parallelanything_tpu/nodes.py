@@ -1166,6 +1166,10 @@ class TPUSaveImage:
         arr = np.asarray(images)
         if arr.ndim == 3:
             arr = arr[None]
+        elif arr.ndim == 5:
+            # Video floats (B, F, H, W, 3) — the WAN decode shape: write every
+            # frame of every clip as its own numbered PNG, in order.
+            arr = arr.reshape((-1,) + arr.shape[2:])
         arr = (np.clip(arr, 0.0, 1.0) * 255.0 + 0.5).astype(np.uint8)
         # Counter continues past the HIGHEST existing index (not the file
         # count) so re-runs never overwrite, even with gaps or stray files

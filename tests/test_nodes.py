@@ -244,6 +244,18 @@ class TestParallelAnythingNode:
         assert os.path.dirname(p1) == str(tmp_path / "run1")
         assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
 
+    def test_save_video_frames(self, tmp_path):
+        # WAN decode emits (B, F, H, W, 3) video floats — every frame saves as
+        # its own numbered PNG, in clip/frame order.
+        from comfyui_parallelanything_tpu.nodes import TPUSaveImage
+
+        vid = jnp.ones((1, 3, 8, 8, 3)) * 0.5
+        (paths,) = TPUSaveImage().save(vid, "v", str(tmp_path))
+        assert len(paths) == 3
+        import os
+
+        assert all(os.path.exists(p) for p in paths)
+
     def test_save_image_embeds_metadata(self, tmp_path):
         from PIL import Image
 
