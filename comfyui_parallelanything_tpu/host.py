@@ -107,28 +107,35 @@ def _is_link(v: Any) -> bool:
 _WIDGET_PRIMITIVES = {"INT", "FLOAT", "STRING", "BOOLEAN"}
 
 
-def _wire_inputs(cls: type) -> tuple[set[str], set[str]]:
-    """(wire_input_names, declared_input_names) from a node's INPUT_TYPES.
+def _wire_inputs(cls: type) -> tuple[set[str], set[str], dict[str, str]]:
+    """(wire_input_names, declared_input_names, hidden_inputs) from a node's
+    INPUT_TYPES, in ONE call (INPUT_TYPES may scan the filesystem for dropdown
+    options — it must not be re-derived per execution).
 
     Disambiguates link-vs-literal for two-element list values: a declared widget
     (primitive type or dropdown options) takes literals; a declared wire type
     (e.g. "MODEL") takes links. Undeclared names fall back to the link shape
-    heuristic."""
+    heuristic. ``hidden`` entries (ComfyUI executor semantics) are values the
+    HOST injects — PROMPT (the workflow dict), UNIQUE_ID (the node id)."""
     wires: set[str] = set()
     declared: set[str] = set()
+    hidden: dict[str, str] = {}
     try:
         spec = cls.INPUT_TYPES()
     except Exception:
-        return wires, declared
-    for group in spec.values():
+        return wires, declared, hidden
+    for key, group in spec.items():
         if not isinstance(group, dict):
+            continue
+        if key == "hidden":
+            hidden = {k: v for k, v in group.items() if isinstance(v, str)}
             continue
         for name, decl in group.items():
             declared.add(name)
             typ = decl[0] if isinstance(decl, (tuple, list)) and decl else decl
             if isinstance(typ, str) and typ not in _WIDGET_PRIMITIVES:
                 wires.add(name)
-    return wires, declared
+    return wires, declared, hidden
 
 
 def run_workflow(
@@ -181,20 +188,21 @@ def run_workflow(
             )
         return spec, cls
 
-    def link_inputs(spec: dict, cls: type) -> dict[str, tuple[str, int]]:
-        """Which inputs take their value from another node's output.
+    def link_inputs(spec: dict, cls: type) -> tuple[dict[str, tuple[str, int]], dict[str, str]]:
+        """(links, hidden): which inputs take their value from another node's
+        output, plus the host-injected hidden group.
 
         ComfyUI semantics: any link-shaped value is a link, even into declared
         primitive widgets — gated on the referenced id naming a graph node so
         a genuine 2-list literal into a widget stays a literal."""
-        wires, declared = _wire_inputs(cls)
+        wires, declared, hidden = _wire_inputs(cls)
         links: dict[str, tuple[str, int]] = {}
         for name, v in (spec.get("inputs") or {}).items():
             if _is_link(v) and (
                 name in wires or name not in declared or str(v[0]) in graph
             ):
                 links[name] = (str(v[0]), int(v[1]))
-        return links
+        return links, hidden
 
     def postorder(root: str, is_done, visit) -> None:
         """Iterative post-order DFS over link dependencies — exported graphs
@@ -219,8 +227,8 @@ def run_workflow(
                         f"cycle in workflow: {' -> '.join(path)} -> {nid}"
                     )
                 spec, cls = node_class(nid)
-                links = link_inputs(spec, cls)
-                stack[-1][1] = (spec, cls, links)
+                links, hidden = link_inputs(spec, cls)
+                stack[-1][1] = (spec, cls, links, hidden)
                 path.append(nid)
                 on_path.add(nid)
                 deps = dict.fromkeys(dep for dep, _ in links.values())
@@ -228,8 +236,8 @@ def run_workflow(
                     if not is_done(dep):
                         stack.append([dep, None])
                 continue
-            spec, cls, links = resolved
-            visit(nid, spec, cls, links)
+            spec, cls, links, hidden = resolved
+            visit(nid, spec, cls, links, hidden)
             on_path.discard(nid)
             path.pop()
             stack.pop()
@@ -243,7 +251,7 @@ def run_workflow(
 
         sigs: dict[str, str] = {}
 
-        def visit(nid, spec, cls, links):
+        def visit(nid, spec, cls, links, hidden):
             canon: dict[str, Any] = {}
             for name, v in (spec.get("inputs") or {}).items():
                 if name in links:
@@ -268,7 +276,7 @@ def run_workflow(
             if nid not in graph or cache.signatures.get(nid) != sigs[nid]
         )
 
-    def exec_visit(nid, spec, cls, links):
+    def exec_visit(nid, spec, cls, links, hidden):
         kwargs: dict[str, Any] = {}
         for name, v in (spec.get("inputs") or {}).items():
             if name in links:
@@ -283,6 +291,16 @@ def run_workflow(
                 kwargs[name] = upstream[idx]
             else:
                 kwargs[name] = v
+        # Host-injected hidden values are applied LAST: ComfyUI's executor
+        # lets hidden win over same-named graph inputs (a user typing a text
+        # value into "prompt" must not corrupt the embedded workflow).
+        for name, typ in hidden.items():
+            if typ == "PROMPT":
+                kwargs[name] = graph
+            elif typ == "UNIQUE_ID":
+                kwargs[name] = nid
+            else:
+                kwargs[name] = None
         fn = getattr(cls(), cls.FUNCTION)
         try:
             out = fn(**kwargs)

@@ -1137,14 +1137,17 @@ class TPUSaveImage:
                     {"default": "", "multiline": True,
                      "tooltip": "embedded as the PNG 'parameters' text chunk "
                                 "(the A1111-style key most galleries/readers "
-                                "parse; ComfyUI's own chunks are "
-                                "'prompt'/'workflow')"},
+                                "parse)"},
                 ),
             },
+            # Host-injected (ComfyUI executor semantics): the whole workflow
+            # dict, embedded as the 'prompt' PNG chunk so a saved image can be
+            # dragged back into a graph editor to restore its workflow.
+            "hidden": {"prompt": "PROMPT"},
         }
 
     def save(self, images, filename_prefix: str = "tpu", output_dir: str = "output",
-             metadata: str = ""):
+             metadata: str = "", prompt=None):
         import os
 
         import numpy as np
@@ -1184,11 +1187,19 @@ class TPUSaveImage:
         ]
         start = max(taken) + 1 if taken else 0
         pnginfo = None
-        if metadata:
+        if metadata or prompt is not None:
+            import json as _json
+
             from PIL.PngImagePlugin import PngInfo
 
             pnginfo = PngInfo()
-            pnginfo.add_text("parameters", metadata)
+            if metadata:
+                pnginfo.add_text("parameters", metadata)
+            if prompt is not None:
+                try:
+                    pnginfo.add_text("prompt", _json.dumps(prompt, default=repr))
+                except Exception:
+                    pass  # unserializable custom-node state: skip, still save
         paths = []
         for i, img in enumerate(arr):
             path = os.path.join(target_dir, f"{name}_{start + i:05d}.png")

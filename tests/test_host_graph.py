@@ -197,6 +197,58 @@ class TestExecutor:
         assert len(out["2"][0]) == 2
 
 
+class TestHiddenInputs:
+    def test_prompt_and_unique_id_injected(self):
+        # ComfyUI executor semantics: "hidden" INPUT_TYPES entries are filled
+        # by the HOST — PROMPT gets the whole workflow dict, UNIQUE_ID the
+        # executing node's id.
+        seen = {}
+
+        class Probe:
+            RETURN_TYPES = ("X",)
+            FUNCTION = "go"
+
+            @classmethod
+            def INPUT_TYPES(cls):
+                return {"required": {},
+                        "hidden": {"prompt": "PROMPT", "uid": "UNIQUE_ID"}}
+
+            def go(self, prompt=None, uid=None):
+                seen.update(prompt=prompt, uid=uid)
+                return (1,)
+
+        wf = {"p9": {"class_type": "Probe", "inputs": {}}}
+        run_workflow(wf, {"Probe": Probe})
+        assert seen["uid"] == "p9"
+        assert seen["prompt"]["p9"]["class_type"] == "Probe"
+
+    def test_save_image_embeds_workflow_prompt(self, tmp_path):
+        # A saved PNG carries the workflow under the 'prompt' chunk (the host
+        # convention for drag-back-into-graph restoration).
+        import json as _json
+
+        from PIL import Image
+
+        class Gen:
+            RETURN_TYPES = ("IMAGE",)
+            FUNCTION = "go"
+
+            def go(self):
+                return (jnp.ones((1, 4, 4, 3)) * 0.25,)
+
+        wf = {
+            "g": {"class_type": "Gen", "inputs": {}},
+            "s": {"class_type": "TPUSaveImage",
+                  "inputs": {"images": ["g", 0], "filename_prefix": "w",
+                             "output_dir": str(tmp_path)}},
+        }
+        out = run_workflow(wf, {"Gen": Gen})
+        (path,) = out["s"][0]
+        embedded = _json.loads(Image.open(path).text["prompt"])
+        assert embedded["s"]["class_type"] == "TPUSaveImage"
+        assert embedded["g"]["class_type"] == "Gen"
+
+
 class TestWorkflowCache:
     class _Model:
         """Teardownable output (the shape ParallelModel exposes)."""
