@@ -355,6 +355,11 @@ def run_inner() -> None:
         round(_REF_SINGLE_GPU_S_IT / sec_it, 2) if config_name == "zimage_21" else None
     )
 
+    from comfyui_parallelanything_tpu.ops.attention import (
+        get_attention_backend,
+        resolved_backends,
+    )
+
     record = {
         "metric": f"sec/it denoise step [{config_name}]",
         "value": round(sec_it, 4),
@@ -366,6 +371,12 @@ def run_inner() -> None:
         "model_flops_per_step": flops,
         "workload": f"{workload} ({platform} x{n_dev})",
         "images_per_sec": round(batch / sec_it, 3),
+        # Which attention path(s) actually served the run, resolved at trace
+        # time ("pallas", "xla", or "pallas+xla" when different shapes picked
+        # differently) — so the evidence never hides an XLA fallback behind an
+        # "auto" setting. Falls back to the configured setting if the model
+        # has no attention at all.
+        "attention_backend": "+".join(resolved_backends()) or get_attention_backend(),
     }
     if config_name == "flux_16" and flops:
         # Analytic bridge to the full 19/38-depth model (compute-bound regime:

@@ -23,12 +23,26 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
 import threading
 
 import jax
 import jax.numpy as jnp
 
-_BACKEND = "auto"
+
+def _initial_backend() -> str:
+    """Startup backend from ``PA_TPU_ATTENTION_BACKEND`` (auto/xla/pallas).
+
+    The env override exists so a *driving process* (watchdog, bench harness, a
+    hosted workflow run) can force the safe XLA path for every child it spawns
+    when the fused kernel fails a hardware probe — without touching code. An
+    invalid value falls back to "auto" rather than erroring at import time.
+    """
+    name = os.environ.get("PA_TPU_ATTENTION_BACKEND", "auto")
+    return name if name in ("auto", "xla", "pallas") else "auto"
+
+
+_BACKEND = _initial_backend()
 
 _SEQ_CTX = threading.local()
 
@@ -58,6 +72,17 @@ def sequence_ctx_key() -> tuple | None:
         return None
     mesh, axis, method = cfg
     return (mesh, axis, method)
+
+
+_RESOLVED: set[str] = set()
+
+
+def resolved_backends() -> tuple[str, ...]:
+    """Backends that have actually served ``attention_local`` calls in this
+    process, resolved at trace time — "auto" never appears here. Evidence
+    labeling for benchmarks (a bench line must say which kernel produced the
+    number), not a control surface."""
+    return tuple(sorted(_RESOLVED))
 
 
 def set_attention_backend(name: str) -> None:
@@ -108,6 +133,7 @@ def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
             and k.shape[1] % 128 == 0 and pallas_wins(q.shape[1])
         )
         backend = "pallas" if use_pallas else "xla"
+    _RESOLVED.add(backend)
     if backend == "pallas":
         from .pallas.flash_attention import flash_attention
         from .pallas.tuning import best_blocks

@@ -31,8 +31,11 @@ LADDER = (
 )
 
 
-def run_rung(rung: str, timeout: int = 2400) -> dict | None:
+if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
+
+
+def run_rung(rung: str, timeout: int = 2400) -> dict:
     from bench import _last_json_line  # the guarded metric-line scan, one impl
 
     env = dict(os.environ)
@@ -52,17 +55,23 @@ def run_rung(rung: str, timeout: int = 2400) -> dict | None:
     return {"rung": rung, "error": proc.stderr.strip()[-300:]}
 
 
+def record_result(rec: dict) -> dict:
+    """Stamp and append one rung result to ``BASELINE_measured.json`` — the one
+    writer for the evidence file (measure_tpu CLI and tpu_watchdog both go
+    through here so the record format cannot drift)."""
+    rec["ts"] = time.time()
+    with open(os.path.join(_REPO, "BASELINE_measured.json"), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
 def main() -> None:
     rungs = sys.argv[1:] or list(LADDER)
-    out_path = os.path.join(_REPO, "BASELINE_measured.json")
     results = []
     for rung in rungs:
-        rec = run_rung(rung)
-        rec["ts"] = time.time()
+        rec = record_result(run_rung(rung))
         results.append(rec)
         print(json.dumps(rec))
-        with open(out_path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
         if rec.get("platform") not in ("tpu", "axon") and "error" not in rec:
             print(f"# {rung}: fell back to {rec.get('platform')} — tunnel down? "
                   "continuing (later rungs may recover)", file=sys.stderr)

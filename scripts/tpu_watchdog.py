@@ -1,0 +1,265 @@
+"""Persistent TPU-evidence watchdog for the flaky axon tunnel.
+
+The tunnel comes and goes in windows of a few minutes (observed: up at
+03:45, wedged by 03:52 the same morning). A plain ascending-ladder run
+(`measure_tpu.py`) can burn a whole window on cheap rungs or CPU
+fallbacks, so this watchdog instead:
+
+1. probes the tunnel in a bounded subprocess every ``--interval`` seconds
+   (a wedged tunnel hangs ``import jax``, so the probe must be a child);
+2. the moment the probe passes, banks the MISSING evidence artifacts in
+   value order — the README-repro headline first:
+       zimage_21 > sd15_16 > sdxl_8 > flux_16_int8 > flux_16 > wan_video
+       > kernel sweep (bench_kernels --apply) > sampler loop
+3. re-probes between artifacts so a mid-window wedge stops the ladder
+   instead of cascading CPU fallbacks;
+4. exits when everything is banked.
+
+"Banked" means: a ``platform: tpu|axon`` line for the rung in
+``BASELINE_measured.json``; a measured tuning table written by the kernel
+sweep's ``--apply``; a TPU line in ``SAMPLER_LOOP_BENCH.json``.
+
+Flap-vs-failure policy: a rung/script that fails while a follow-up probe
+says the tunnel is STILL UP earns a strike. Strikes deprioritize (other
+evidence goes first) and eventually cap at ``_MAX_FAILS``; the cap needs
+three strikes because a wedge-then-recover race can hand out one unfairly.
+Run it nohup'd for a whole session:
+
+    nohup python scripts/tpu_watchdog.py > /tmp/tpu_watchdog.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TPU = ("tpu", "axon")
+
+# Highest-value first: the README-repro rung carries the vs_baseline headline
+# (reference 26.00 s/it, /root/reference/README.md:54-56).
+RUNGS = ("zimage_21", "sd15_16", "sdxl_8", "flux_16_int8", "flux_16", "wan_video")
+
+# Rungs whose attention shapes cannot survive the plain-XLA path on one chip:
+# _xla_attention materializes f32 (B, H, S, S) logits — flux-class joint
+# attention at batch 16-21 / 24 heads / ~4.2-4.6k tokens is 33-36 GB against
+# 16 GB of v5e HBM (cf. ops/pallas/tuning.py on XLA OOMs at long lengths).
+# When the pallas kernel is hardware-broken (PA_TPU_ATTENTION_BACKEND=xla
+# forced), attempting these would burn three windows each on certain OOMs.
+_XLA_UNSAFE = {"zimage_21", "flux_16_int8", "flux_16"}
+
+
+def _attemptable(rung: str) -> bool:
+    if (os.environ.get("PA_TPU_ATTENTION_BACKEND") == "xla"
+            and rung in _XLA_UNSAFE):
+        return False
+    return _FAILS.get(rung, 0) < _MAX_FAILS
+
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+_FAILS: dict[str, int] = {}
+# Three strikes: a genuine crash repeats every attempt, while the
+# wedge-recovers-before-the-follow-up-probe race must coincide with the same
+# key three separate times to cap it unfairly.
+_MAX_FAILS = 3
+_PALLAS_FAILS = 0
+_PALLAS_PROBED = False
+
+
+def probe(timeout: int = 90) -> bool:
+    code = (
+        "import jax, sys; d = jax.devices(); "
+        f"sys.exit(0 if d and d[0].platform in {_TPU!r} else 3)"
+    )
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", code], env=dict(os.environ),
+            capture_output=True, timeout=timeout,
+        ).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def probe_pallas_hardware(timeout: int = 300) -> None:
+    """Run the fused flash kernel on the real chip before any rung relies on
+    it. The kernel is interpreter-mode tested only (no hardware all round), and
+    the untuned `auto` backend defaults to pallas for lane-aligned shapes — a
+    compile/runtime failure there would burn EVERY tunnel window on the same
+    crash. After two failures on a live tunnel, force the safe XLA path for all
+    child runs via ``PA_TPU_ATTENTION_BACKEND`` (ops/attention.py reads it at
+    import); two, not one, because a wedge-then-recover race can fake one."""
+    global _PALLAS_PROBED, _PALLAS_FAILS
+    if _PALLAS_PROBED or os.environ.get("PA_TPU_ATTENTION_BACKEND"):
+        return
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "from comfyui_parallelanything_tpu.ops.pallas.flash_attention "
+        "import flash_attention\n"
+        # Guard against the interpreter-mode false positive: a mid-probe flap
+        # can land this child on CPU, where interpret=None would auto-select
+        # interpreter mode and 'pass' without touching hardware.
+        f"assert jax.devices()[0].platform in {_TPU!r}, 'not on TPU'\n"
+        "q = jnp.ones((1, 256, 2, 128), jnp.bfloat16)\n"
+        "out = flash_attention(q, q, q, scale=0.09, block_q=128, block_k=128,\n"
+        "                      interpret=False)\n"
+        "jax.block_until_ready(out)\n"
+        "assert out.shape == q.shape\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=dict(os.environ), cwd=_REPO,
+            capture_output=True, text=True, timeout=timeout,
+        )
+        ok = proc.returncode == 0
+        tail = proc.stderr.strip()[-300:]
+    except subprocess.TimeoutExpired:
+        ok, tail = False, f"pallas probe timed out after {timeout}s"
+    if ok:
+        _log("pallas hardware probe OK — fused kernel live on this chip")
+        _PALLAS_PROBED = True
+    elif probe():
+        _PALLAS_FAILS += 1
+        if _PALLAS_FAILS >= 2:
+            os.environ["PA_TPU_ATTENTION_BACKEND"] = "xla"
+            _log(f"pallas hardware probe FAILED {_PALLAS_FAILS}x on a live "
+                 f"tunnel — forcing xla attention for all child runs: {tail}")
+            _PALLAS_PROBED = True
+        else:
+            _log(f"pallas hardware probe failed on a live tunnel "
+                 f"(1/2 before xla fallback): {tail}")
+    else:
+        # Tunnel flapped mid-probe: not a kernel verdict. Re-probe next window
+        # rather than mislabeling a healthy kernel as broken for the session.
+        _log(f"pallas probe inconclusive (tunnel flapped): {tail}")
+
+
+def _tpu_records(filename: str):
+    """Parsed TPU-measured records from a repo JSON-Lines artifact (all three
+    evidence files append one JSON object per line)."""
+    path = os.path.join(_REPO, filename)
+    if not os.path.exists(path):
+        return
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("platform") in _TPU:
+                yield rec
+
+
+def banked_rungs() -> set[str]:
+    return {r.get("rung") for r in _tpu_records("BASELINE_measured.json")}
+
+
+def kernels_banked() -> bool:
+    """The sweep is banked only when ``--apply`` wrote a measured tuning table
+    (its last act): per-shape KERNEL_BENCH.json lines land incrementally, so a
+    mid-sweep wedge must read as incomplete, not banked."""
+    path = os.path.join(
+        _REPO, "comfyui_parallelanything_tpu", "ops", "pallas", "tuning.json"
+    )
+    try:
+        with open(path) as f:
+            return json.load(f).get("source") == "measured"
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def sampler_banked() -> bool:
+    return any(_tpu_records("SAMPLER_LOOP_BENCH.json"))
+
+
+def _log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def _strike(key: str, what: str) -> None:
+    """Count a failure observed while a follow-up probe says the tunnel is
+    still up — likely the item's own crash, not a flap (see module policy)."""
+    if probe():
+        _FAILS[key] = _FAILS.get(key, 0) + 1
+        _log(f"{what} failed on a live tunnel ({_FAILS[key]}/{_MAX_FAILS})")
+
+
+def bank_one() -> bool:
+    """Run the single highest-value missing artifact. True if anything ran.
+
+    Ordering: fewest strikes first, then declared value order — one unlucky
+    flap deprioritizes a rung below clean ones but never blocks the ladder."""
+    from measure_tpu import record_result, run_rung  # noqa: E402
+
+    done = banked_rungs()
+    candidates = [r for r in RUNGS if r not in done and _attemptable(r)]
+    for rung in sorted(candidates, key=lambda r: (_FAILS.get(r, 0),
+                                                  RUNGS.index(r))):
+        _log(f"running rung {rung}")
+        rec = record_result(run_rung(rung))
+        ok = rec.get("platform") in _TPU
+        if not ok:
+            _strike(rung, f"rung {rung}")
+        _log(f"rung {rung}: platform={rec.get('platform')} "
+             f"value={rec.get('value')} banked={ok}")
+        return True
+    for label, banked, argv in (
+        ("kernels", kernels_banked, ("bench_kernels.py", "--apply")),
+        ("sampler", sampler_banked, ("bench_sampler_loop.py",)),
+    ):
+        if banked() or _FAILS.get(label, 0) >= _MAX_FAILS:
+            continue
+        _log(f"running {label} bench ({argv[0]})")
+        _run_script(*argv)
+        ok = banked()
+        if not ok:
+            _strike(label, f"{label} bench")
+        _log(f"{label} bench done, banked={ok}")
+        return True
+    return False
+
+
+def _run_script(name: str, *args: str, timeout: int = 3600) -> None:
+    """A hung child (wedged tunnel) must not take the persistent watchdog down
+    with it — swallow the timeout; the banked checks decide what happens next."""
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(_REPO, "scripts", name), *args],
+            cwd=_REPO, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        _log(f"{name} timed out after {timeout}s (wedged tunnel?)")
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--interval", type=int, default=120,
+                    help="seconds between tunnel probes while down")
+    interval = ap.parse_args().interval
+
+    def capped(key: str) -> bool:
+        return _FAILS.get(key, 0) >= _MAX_FAILS
+
+    while True:
+        done = banked_rungs()
+        missing = [r for r in RUNGS if r not in done and _attemptable(r)]
+        if (not missing and (kernels_banked() or capped("kernels"))
+                and (sampler_banked() or capped("sampler"))):
+            _log("all attemptable TPU evidence banked — exiting")
+            return
+        if probe():
+            _log(f"tunnel UP (missing: {missing or 'kernels/sampler'})")
+            probe_pallas_hardware()
+            if not bank_one():
+                time.sleep(interval)  # nothing attemptable right now
+        else:
+            _log("tunnel down")
+            time.sleep(interval)
+
+
+if __name__ == "__main__":
+    main()

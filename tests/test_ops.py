@@ -49,6 +49,49 @@ class TestAttention:
         np.testing.assert_allclose(out, probs @ vm, rtol=1e-5, atol=1e-6)
 
 
+class TestBackendEnvOverride:
+    """PA_TPU_ATTENTION_BACKEND seeds the startup backend (ops/attention.py
+    _initial_backend) so a driving process can force the safe XLA path for
+    every child it spawns after a failed hardware probe (scripts/tpu_watchdog)."""
+
+    def test_env_forces_xla(self, monkeypatch):
+        import importlib
+
+        mod = importlib.import_module("comfyui_parallelanything_tpu.ops.attention")
+
+        monkeypatch.setenv("PA_TPU_ATTENTION_BACKEND", "xla")
+        assert mod._initial_backend() == "xla"
+
+    def test_invalid_env_falls_back_to_auto(self, monkeypatch):
+        import importlib
+
+        mod = importlib.import_module("comfyui_parallelanything_tpu.ops.attention")
+
+        monkeypatch.setenv("PA_TPU_ATTENTION_BACKEND", "cuda")
+        assert mod._initial_backend() == "auto"
+
+    def test_unset_env_is_auto(self, monkeypatch):
+        import importlib
+
+        mod = importlib.import_module("comfyui_parallelanything_tpu.ops.attention")
+
+        monkeypatch.delenv("PA_TPU_ATTENTION_BACKEND", raising=False)
+        assert mod._initial_backend() == "auto"
+
+    def test_resolved_backends_records_actual_path(self):
+        # Evidence labeling: after a call, resolved_backends() names the path
+        # that actually served it ("auto" never appears) — bench.py stamps
+        # this into every measured record.
+        import importlib
+
+        mod = importlib.import_module("comfyui_parallelanything_tpu.ops.attention")
+
+        q, k, v = _qkv(b=1, sq=8, sk=8, h=1, d=4)
+        mod.attention_local(q, k, v)  # CPU + unaligned shapes -> xla
+        assert "xla" in mod.resolved_backends()
+        assert "auto" not in mod.resolved_backends()
+
+
 class TestKernelTuning:
     """Data-driven block sizes / backend choice (ops/pallas/tuning.py): the
     mechanism bench_kernels.py --apply feeds on real hardware."""
