@@ -4,12 +4,18 @@ The reference toggles flash/xformers OFF for old GPUs (disable_flash_xformers,
 any_device_parallel.py:126-164) — capability-gated attention backends are part of its
 surface. The TPU equivalent is a backend registry:
 
-- ``"xla"``    — plain jnp dot-product attention; XLA fuses it well for moderate
+- ``"xla"``    — jnp dot-product attention; XLA fuses it well for moderate
   sequence lengths and it runs everywhere (the safe fallback, like the reference's
-  post-disable path).
+  post-disable path). Shapes whose S×S logits would exceed ``_CHUNK_THRESHOLD``
+  are automatically served by the chunked path below.
+- ``"xla_chunked"`` — memory-bounded attention in plain XLA ops (lax.scan over
+  query blocks; the S×S logits tensor never materializes). The only path that
+  fits SD-class 1024² workloads on one chip: 40/64-dim UNet heads can never
+  take the pallas kernel, and materializing logits there needs >100 GB.
 - ``"pallas"`` — fused flash-attention kernel for TPU (ops/pallas/), used for the long
   sequences of the FLUX/video configs.
-- ``"auto"``   — pallas on TPU when available and the shape qualifies, else xla.
+- ``"auto"``   — pallas on TPU when available and the shape qualifies, else the
+  xla family (plain or chunked by size).
 
 All functions take (B, S, H, D)-shaped q/k/v ("BSHD") and return (B, S, H, D).
 
@@ -31,7 +37,8 @@ import jax.numpy as jnp
 
 
 def _initial_backend() -> str:
-    """Startup backend from ``PA_TPU_ATTENTION_BACKEND`` (auto/xla/pallas).
+    """Startup backend from ``PA_TPU_ATTENTION_BACKEND``
+    (auto/xla/xla_chunked/pallas).
 
     The env override exists so a *driving process* (watchdog, bench harness, a
     hosted workflow run) can force the safe XLA path for every child it spawns
@@ -39,7 +46,7 @@ def _initial_backend() -> str:
     invalid value falls back to "auto" rather than erroring at import time.
     """
     name = os.environ.get("PA_TPU_ATTENTION_BACKEND", "auto")
-    return name if name in ("auto", "xla", "pallas") else "auto"
+    return name if name in ("auto", "xla", "xla_chunked", "pallas") else "auto"
 
 
 _BACKEND = _initial_backend()
@@ -87,7 +94,7 @@ def resolved_backends() -> tuple[str, ...]:
 
 def set_attention_backend(name: str) -> None:
     global _BACKEND
-    if name not in ("auto", "xla", "pallas"):
+    if name not in ("auto", "xla", "xla_chunked", "pallas"):
         raise ValueError(f"unknown attention backend {name!r}")
     _BACKEND = name
 
@@ -102,6 +109,42 @@ def _xla_attention(q, k, v, scale):
     logits = logits.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# Above this many f32 logits elements (B*H*S_q*S_k; 2**27 ≈ 512 MB) the
+# materializing XLA path is routed to the chunked one. SD-class UNets at 1024²
+# (16k tokens, batch 16) would need 137 GB of logits — far past any HBM — and
+# their 40/64-dim heads can never take the lane-aligned pallas kernel, so
+# chunking is the only way those workloads fit a chip at all.
+_CHUNK_THRESHOLD = 2**27
+
+
+def _xla_chunked_attention(q, k, v, scale):
+    """Memory-bounded attention without a fused kernel: a ``lax.scan`` over
+    query blocks, each computing an ordinary softmax against the full K/V — the
+    (B, H, S_q, S_k) logits tensor never materializes, only
+    (B, H, block_q, S_k) slices do. The flash kernel's memory story with plain
+    XLA ops: works for any head dim and any platform, trading one fused pass
+    for nq sequential block passes (each still an MXU-shaped matmul pair)."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    per_row = B * H * Sk
+    block_q = max(16, min(Sq, _CHUNK_THRESHOLD // max(per_row, 1)) // 16 * 16)
+    if block_q >= Sq:
+        return _xla_attention(q, k, v, scale)
+    nq = -(-Sq // block_q)
+    pad = nq * block_q - Sq
+    qp = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # (nq, B, block_q, H, D): scan over leading block axis; padded query rows
+    # attend normally and are sliced away after.
+    qb = qp.reshape(B, nq, block_q, H, D).transpose(1, 0, 2, 3, 4)
+
+    def body(_, qblk):
+        return None, _xla_attention(qblk, k, v, scale)
+
+    _, out = jax.lax.scan(body, None, qb)
+    out = out.transpose(1, 0, 2, 3, 4).reshape(B, nq * block_q, H, D)
+    return out[:, :Sq]
 
 
 @functools.cache
@@ -122,6 +165,7 @@ def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
     if scale is None:
         scale = q.shape[-1] ** -0.5
     backend = _BACKEND
+    logit_elems = q.shape[0] * q.shape[2] * q.shape[1] * k.shape[1]
     if backend == "auto":
         from .pallas.tuning import pallas_wins
 
@@ -133,6 +177,11 @@ def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
             and k.shape[1] % 128 == 0 and pallas_wins(q.shape[1])
         )
         backend = "pallas" if use_pallas else "xla"
+    if backend == "xla" and logit_elems > _CHUNK_THRESHOLD:
+        # "xla" means the XLA family: shapes whose S×S logits would blow HBM
+        # (pallas-ineligible 40/64-dim UNet heads at 1024², or a forced
+        # non-pallas run) go through the chunked path instead of OOMing.
+        backend = "xla_chunked"
     _RESOLVED.add(backend)
     if backend == "pallas":
         from .pallas.flash_attention import flash_attention
@@ -142,6 +191,8 @@ def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
         return flash_attention(
             q, k, v, scale=scale, block_q=block_q, block_k=block_k
         )
+    if backend == "xla_chunked":
+        return _xla_chunked_attention(q, k, v, scale)
     return _xla_attention(q, k, v, scale)
 
 

@@ -43,20 +43,12 @@ _TPU = ("tpu", "axon")
 # (reference 26.00 s/it, /root/reference/README.md:54-56).
 RUNGS = ("zimage_21", "sd15_16", "sdxl_8", "flux_16_int8", "flux_16", "wan_video")
 
-# Rungs whose attention shapes cannot survive the plain-XLA path on one chip:
-# _xla_attention materializes f32 (B, H, S, S) logits — flux-class joint
-# attention at batch 16-21 / 24 heads / ~4.2-4.6k tokens is 33-36 GB against
-# 16 GB of v5e HBM (cf. ops/pallas/tuning.py on XLA OOMs at long lengths).
-# When the pallas kernel is hardware-broken (PA_TPU_ATTENTION_BACKEND=xla
-# forced), attempting these would burn three windows each on certain OOMs.
-_XLA_UNSAFE = {"zimage_21", "flux_16_int8", "flux_16"}
-
-
 def _attemptable(rung: str) -> bool:
-    if (os.environ.get("PA_TPU_ATTENTION_BACKEND") == "xla"
-            and rung in _XLA_UNSAFE):
-        return False
+    # Every rung survives a forced non-pallas run: the "xla" backend family
+    # auto-routes HBM-sized logits through the chunked path (ops/attention.py
+    # _xla_chunked_attention), so no shape is xla-unsafe anymore.
     return _FAILS.get(rung, 0) < _MAX_FAILS
+
 
 sys.path.insert(0, os.path.join(_REPO, "scripts"))
 
@@ -200,7 +192,9 @@ def bank_one() -> bool:
         _log(f"running rung {rung}")
         rec = record_result(run_rung(rung))
         ok = rec.get("platform") in _TPU
-        if not ok:
+        if ok:
+            _run_script("render_measured.py", timeout=120)
+        else:
             _strike(rung, f"rung {rung}")
         _log(f"rung {rung}: platform={rec.get('platform')} "
              f"value={rec.get('value')} banked={ok}")
@@ -214,7 +208,9 @@ def bank_one() -> bool:
         _log(f"running {label} bench ({argv[0]})")
         _run_script(*argv)
         ok = banked()
-        if not ok:
+        if ok:
+            _run_script("render_measured.py", timeout=120)
+        else:
             _strike(label, f"{label} bench")
         _log(f"{label} bench done, banked={ok}")
         return True
