@@ -42,7 +42,12 @@ from ..parallel.split import (
     slice_padded as _slice_padded,
 )
 from .cfg import double_kwargs, rescale_guidance
-from .k_samplers import RNG_SAMPLERS, EpsDenoiser, lms_coefficient_matrix
+from .k_samplers import (
+    RNG_SAMPLERS,
+    EpsDenoiser,
+    ancestral_steps as _ancestral,
+    lms_coefficient_matrix,
+)
 
 __all__ = [
     "TraceSpec",
@@ -174,15 +179,112 @@ def _scan_euler_ancestral(denoise, x, sigmas, keys, post, constrain, eta=1.0):
     def body(x, per):
         i, s, s_next, key = per
         x0 = denoise(x, s)
-        sigma_up = jnp.minimum(
-            s_next,
-            eta * jnp.sqrt(jnp.maximum(s_next**2 * (s**2 - s_next**2) / s**2, 0.0)),
-        )
-        sigma_down = jnp.sqrt(jnp.maximum(s_next**2 - sigma_up**2, 0.0))
+        sigma_down, sigma_up = _ancestral(s, s_next, eta)
         d = (x - x0) / s
         x = x + d * (sigma_down - s)
         noise = jax.random.normal(key, x.shape, x.dtype)
         x = x + jnp.where(s_next > 0, sigma_up, 0.0) * noise
+        return constrain(post(i, x)), None
+
+    n = len(sigmas) - 1
+    x, _ = jax.lax.scan(body, x, (jnp.arange(n), sigmas[:-1], sigmas[1:], keys))
+    return x
+
+
+def _scan_dpm_2(denoise, x, sigmas, keys, post, constrain):
+    # Interior steps have s_next > 0; the final step (s_next == 0) is plain
+    # Euler — epilogue, same shape discipline as _scan_heun.
+    def body(x, per):
+        i, s, s_next = per
+        x0 = denoise(x, s)
+        d = (x - x0) / s
+        sigma_mid = jnp.exp(0.5 * (jnp.log(s) + jnp.log(s_next)))
+        x_2 = x + d * (sigma_mid - s)
+        x0_2 = denoise(x_2, sigma_mid)
+        d_2 = (x_2 - x0_2) / sigma_mid
+        x = x + d_2 * (s_next - s)
+        return constrain(post(i, x)), None
+
+    n = len(sigmas) - 1
+    x, _ = jax.lax.scan(body, x, (jnp.arange(n - 1), sigmas[:-2], sigmas[1:-1]))
+    x0 = denoise(x, sigmas[n - 1])
+    d = (x - x0) / sigmas[n - 1]
+    x = x + d * (sigmas[n] - sigmas[n - 1])
+    return constrain(post(n - 1, x))
+
+
+def _scan_dpm_2_ancestral(denoise, x, sigmas, keys, post, constrain, eta=1.0):
+    def body(x, per):
+        i, s, s_next, key = per
+        x0 = denoise(x, s)
+        sd, su = _ancestral(s, s_next, eta)
+        d = (x - x0) / s
+        euler = x + d * (sd - s)
+        sd_safe = jnp.maximum(sd, 1e-10)
+        sigma_mid = jnp.exp(0.5 * (jnp.log(s) + jnp.log(sd_safe)))
+        x_2 = x + d * (sigma_mid - s)
+        x0_2 = denoise(x_2, sigma_mid)
+        d_2 = (x_2 - x0_2) / sigma_mid
+        mid = x + d_2 * (sd - s)
+        x = jnp.where(sd > 0, mid, euler)
+        noise = jax.random.normal(key, x.shape, x.dtype)
+        x = x + jnp.where(s_next > 0, su, 0.0) * noise
+        return constrain(post(i, x)), None
+
+    n = len(sigmas) - 1
+    x, _ = jax.lax.scan(body, x, (jnp.arange(n), sigmas[:-1], sigmas[1:], keys))
+    return x
+
+
+def _scan_dpmpp_2s_ancestral(denoise, x, sigmas, keys, post, constrain, eta=1.0):
+    def body(x, per):
+        i, s, s_next, key = per
+        x0 = denoise(x, s)
+        sd, su = _ancestral(s, s_next, eta)
+        d = (x - x0) / s
+        euler = x + d * (sd - s)
+        sd_safe = jnp.maximum(sd, 1e-10)
+        t, t_next = -jnp.log(s), -jnp.log(sd_safe)
+        h = t_next - t
+        sigma_mid = jnp.exp(-(t + 0.5 * h))
+        x_2 = (sigma_mid / s) * x - jnp.expm1(-0.5 * h) * x0
+        x0_2 = denoise(x_2, sigma_mid)
+        second = (sd / s) * x - jnp.expm1(-h) * x0_2
+        x = jnp.where(sd > 0, second, euler)
+        noise = jax.random.normal(key, x.shape, x.dtype)
+        x = x + jnp.where(s_next > 0, su, 0.0) * noise
+        return constrain(post(i, x)), None
+
+    n = len(sigmas) - 1
+    x, _ = jax.lax.scan(body, x, (jnp.arange(n), sigmas[:-1], sigmas[1:], keys))
+    return x
+
+
+def _scan_dpmpp_sde(denoise, x, sigmas, keys, post, constrain, eta=1.0):
+    r = 0.5
+
+    def body(x, per):
+        i, s, s_next, key = per
+        k_mid, k_end = jax.random.split(key)
+        x0 = denoise(x, s)
+        d = (x - x0) / s
+        euler = x + d * (s_next - s)
+        s_next_safe = jnp.maximum(s_next, 1e-10)
+        t, t_next = -jnp.log(s), -jnp.log(s_next_safe)
+        h = t_next - t
+        sigma_mid = jnp.exp(-(t + r * h))
+        fac = 1.0 / (2.0 * r)
+        sd1, su1 = _ancestral(s, sigma_mid, eta)
+        t_down1 = -jnp.log(jnp.maximum(sd1, 1e-10))
+        x_2 = (sd1 / s) * x - jnp.expm1(t - t_down1) * x0
+        x_2 = x_2 + su1 * jax.random.normal(k_mid, x.shape, x.dtype)
+        x0_2 = denoise(x_2, sigma_mid)
+        sd2, su2 = _ancestral(s, s_next, eta)
+        t_down2 = -jnp.log(jnp.maximum(sd2, 1e-10))
+        x0_blend = (1.0 - fac) * x0 + fac * x0_2
+        full = (sd2 / s) * x - jnp.expm1(t - t_down2) * x0_blend
+        full = full + su2 * jax.random.normal(k_end, x.shape, x.dtype)
+        x = jnp.where(s_next > 0, full, euler)
         return constrain(post(i, x)), None
 
     n = len(sigmas) - 1
@@ -384,7 +486,11 @@ SCAN_SAMPLERS = {
     "euler": _scan_euler,
     "euler_ancestral": _scan_euler_ancestral,
     "heun": _scan_heun,
+    "dpm_2": _scan_dpm_2,
+    "dpm_2_ancestral": _scan_dpm_2_ancestral,
     "lms": _scan_lms,
+    "dpmpp_2s_ancestral": _scan_dpmpp_2s_ancestral,
+    "dpmpp_sde": _scan_dpmpp_sde,
     "dpmpp_2m": _scan_dpmpp_2m,
     "dpmpp_2m_sde": _scan_dpmpp_2m_sde,
     "dpmpp_3m_sde": _scan_dpmpp_3m_sde,

@@ -267,16 +267,24 @@ def sample_euler(denoise, x, sigmas, callback=None):
     return x
 
 
+def ancestral_steps(s, s_next, eta: float = 1.0):
+    """(sigma_down, sigma_up) for an ancestral step from ``s`` to ``s_next``
+    (k-diffusion's get_ancestral_step): deterministic integration runs to
+    sigma_down, then sigma_up of fresh noise restores the s_next level."""
+    sigma_up = jnp.minimum(
+        s_next,
+        eta * jnp.sqrt(jnp.maximum(s_next**2 * (s**2 - s_next**2) / s**2, 0.0)),
+    )
+    sigma_down = jnp.sqrt(jnp.maximum(s_next**2 - sigma_up**2, 0.0))
+    return sigma_down, sigma_up
+
+
 def sample_euler_ancestral(denoise, x, sigmas, rng, eta: float = 1.0, callback=None):
     """Euler with ancestral noise injection (stochastic)."""
     for i in range(len(sigmas) - 1):
         s, s_next = sigmas[i], sigmas[i + 1]
         x0 = denoise(x, s)
-        sigma_up = jnp.minimum(
-            s_next,
-            eta * jnp.sqrt(jnp.maximum(s_next**2 * (s**2 - s_next**2) / s**2, 0.0)),
-        )
-        sigma_down = jnp.sqrt(jnp.maximum(s_next**2 - sigma_up**2, 0.0))
+        sigma_down, sigma_up = ancestral_steps(s, s_next, eta)
         d = (x - x0) / s
         x = x + d * (sigma_down - s)
         if float(s_next) > 0:
@@ -299,6 +307,110 @@ def sample_heun(denoise, x, sigmas, callback=None):
             x0_2 = denoise(x_pred, s_next)
             d2 = (x_pred - x0_2) / s_next
             x = x + 0.5 * (d + d2) * (s_next - s)
+        x = apply_callback(callback, i, x)
+    return x
+
+
+def sample_dpm_2(denoise, x, sigmas, callback=None):
+    """DPM2 (k-diffusion ``sample_dpm_2``): explicit midpoint method — the
+    second model call sits at the geometric mean of the step's sigmas."""
+    for i in range(len(sigmas) - 1):
+        s, s_next = sigmas[i], sigmas[i + 1]
+        x0 = denoise(x, s)
+        d = (x - x0) / s
+        if float(s_next) == 0.0:
+            x = x + d * (s_next - s)
+        else:
+            sigma_mid = jnp.exp(0.5 * (jnp.log(s) + jnp.log(s_next)))
+            x_2 = x + d * (sigma_mid - s)
+            x0_2 = denoise(x_2, sigma_mid)
+            d_2 = (x_2 - x0_2) / sigma_mid
+            x = x + d_2 * (s_next - s)
+        x = apply_callback(callback, i, x)
+    return x
+
+
+def sample_dpm_2_ancestral(denoise, x, sigmas, rng, eta: float = 1.0, callback=None):
+    """DPM2 ancestral (k-diffusion ``sample_dpm_2_ancestral``): the midpoint
+    step runs to sigma_down, then sigma_up of fresh noise is injected."""
+    for i in range(len(sigmas) - 1):
+        s, s_next = sigmas[i], sigmas[i + 1]
+        x0 = denoise(x, s)
+        sigma_down, sigma_up = ancestral_steps(s, s_next, eta)
+        d = (x - x0) / s
+        if float(sigma_down) == 0.0:
+            x = x + d * (sigma_down - s)
+        else:
+            sigma_mid = jnp.exp(0.5 * (jnp.log(s) + jnp.log(sigma_down)))
+            x_2 = x + d * (sigma_mid - s)
+            x0_2 = denoise(x_2, sigma_mid)
+            d_2 = (x_2 - x0_2) / sigma_mid
+            x = x + d_2 * (sigma_down - s)
+        if float(s_next) > 0:
+            rng, sub = jax.random.split(rng)
+            x = x + sigma_up * jax.random.normal(sub, x.shape, x.dtype)
+        x = apply_callback(callback, i, x)
+    return x
+
+
+def sample_dpmpp_2s_ancestral(denoise, x, sigmas, rng, eta: float = 1.0,
+                              callback=None):
+    """DPM-Solver++ (2S) ancestral (k-diffusion ``sample_dpmpp_2s_ancestral``):
+    single-step 2nd order in exponential-integrator form (midpoint at
+    r = 1/2 in log-sigma time), ancestral noise on every non-final step."""
+    for i in range(len(sigmas) - 1):
+        s, s_next = sigmas[i], sigmas[i + 1]
+        x0 = denoise(x, s)
+        sigma_down, sigma_up = ancestral_steps(s, s_next, eta)
+        if float(sigma_down) == 0.0:
+            d = (x - x0) / s
+            x = x + d * (sigma_down - s)
+        else:
+            t, t_next = -jnp.log(s), -jnp.log(sigma_down)
+            h = t_next - t
+            sigma_mid = jnp.exp(-(t + 0.5 * h))
+            x_2 = (sigma_mid / s) * x - jnp.expm1(-0.5 * h) * x0
+            x0_2 = denoise(x_2, sigma_mid)
+            x = (sigma_down / s) * x - jnp.expm1(-h) * x0_2
+        if float(s_next) > 0:
+            rng, sub = jax.random.split(rng)
+            x = x + sigma_up * jax.random.normal(sub, x.shape, x.dtype)
+        x = apply_callback(callback, i, x)
+    return x
+
+
+def sample_dpmpp_sde(denoise, x, sigmas, rng, eta: float = 1.0, callback=None):
+    """DPM-Solver++ SDE (k-diffusion ``sample_dpmpp_sde``, r = 1/2): 2nd-order
+    single-step with ancestral-style noise injected BOTH at the midpoint model
+    call and at the step end — two model calls and two noise draws per step.
+    Per-step rng chain: ``rng, sub = split(rng)`` then ``k_mid, k_end =
+    split(sub)`` (the compiled twin consumes the same chain via step_keys)."""
+    r = 0.5
+    for i in range(len(sigmas) - 1):
+        s, s_next = sigmas[i], sigmas[i + 1]
+        x0 = denoise(x, s)
+        if float(s_next) == 0.0:
+            d = (x - x0) / s
+            x = x + d * (s_next - s)
+        else:
+            rng, sub = jax.random.split(rng)
+            k_mid, k_end = jax.random.split(sub)
+            t, t_next = -jnp.log(s), -jnp.log(s_next)
+            h = t_next - t
+            sigma_mid = jnp.exp(-(t + r * h))
+            fac = 1.0 / (2.0 * r)
+            # Step 1: to the midpoint's sigma_down, + its sigma_up of noise.
+            sd1, su1 = ancestral_steps(s, sigma_mid, eta)
+            t_down1 = -jnp.log(jnp.maximum(sd1, 1e-10))
+            x_2 = (sd1 / s) * x - jnp.expm1(t - t_down1) * x0
+            x_2 = x_2 + su1 * jax.random.normal(k_mid, x.shape, x.dtype)
+            x0_2 = denoise(x_2, sigma_mid)
+            # Step 2: full step from the blended denoised estimate.
+            sd2, su2 = ancestral_steps(s, s_next, eta)
+            t_down2 = -jnp.log(jnp.maximum(sd2, 1e-10))
+            x0_blend = (1.0 - fac) * x0 + fac * x0_2
+            x = (sd2 / s) * x - jnp.expm1(t - t_down2) * x0_blend
+            x = x + su2 * jax.random.normal(k_end, x.shape, x.dtype)
         x = apply_callback(callback, i, x)
     return x
 
@@ -502,7 +614,11 @@ SAMPLERS = {
     "euler": sample_euler,
     "euler_ancestral": sample_euler_ancestral,
     "heun": sample_heun,
+    "dpm_2": sample_dpm_2,
+    "dpm_2_ancestral": sample_dpm_2_ancestral,
     "lms": sample_lms,
+    "dpmpp_2s_ancestral": sample_dpmpp_2s_ancestral,
+    "dpmpp_sde": sample_dpmpp_sde,
     "dpmpp_2m": sample_dpmpp_2m,
     "dpmpp_2m_sde": sample_dpmpp_2m_sde,
     "dpmpp_3m_sde": sample_dpmpp_3m_sde,
@@ -510,5 +626,6 @@ SAMPLERS = {
     "ddpm": sample_ddpm,
 }
 RNG_SAMPLERS = frozenset(
-    {"euler_ancestral", "dpmpp_2m_sde", "dpmpp_3m_sde", "lcm", "ddpm"}
+    {"euler_ancestral", "dpm_2_ancestral", "dpmpp_2s_ancestral", "dpmpp_sde",
+     "dpmpp_2m_sde", "dpmpp_3m_sde", "lcm", "ddpm"}
 )

@@ -196,11 +196,59 @@ class TestSamplersRecoverX0:
         out = sample_ddpm(denoise, x_init, sigmas, jax.random.key(5))
         np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=0.15, atol=0.15)
 
+    def test_dpm_2_recovers_x0(self, problem):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import sample_dpm_2
+
+        x0, x_init, sigmas, denoise = problem
+        out = sample_dpm_2(denoise, x_init, sigmas)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=1e-2, atol=1e-2)
+
+    def test_dpm_2_ancestral_converges_near_x0(self, problem):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            sample_dpm_2_ancestral,
+        )
+
+        x0, x_init, sigmas, denoise = problem
+        out = sample_dpm_2_ancestral(denoise, x_init, sigmas, jax.random.key(6))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=0.15, atol=0.15)
+
+    def test_dpmpp_2s_ancestral_converges_near_x0(self, problem):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            sample_dpmpp_2s_ancestral,
+        )
+
+        x0, x_init, sigmas, denoise = problem
+        out = sample_dpmpp_2s_ancestral(denoise, x_init, sigmas, jax.random.key(7))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=0.15, atol=0.15)
+
+    def test_dpmpp_2s_ancestral_eta_zero_deterministic_and_tight(self, problem):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            sample_dpmpp_2s_ancestral,
+        )
+
+        x0, x_init, sigmas, denoise = problem
+        a = sample_dpmpp_2s_ancestral(denoise, x_init, sigmas, jax.random.key(7),
+                                      eta=0.0)
+        b = sample_dpmpp_2s_ancestral(denoise, x_init, sigmas, jax.random.key(11),
+                                      eta=0.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(x0), rtol=1e-2, atol=1e-2)
+
+    def test_dpmpp_sde_converges_near_x0(self, problem):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            sample_dpmpp_sde,
+        )
+
+        x0, x_init, sigmas, denoise = problem
+        out = sample_dpmpp_sde(denoise, x_init, sigmas, jax.random.key(8))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=0.15, atol=0.15)
+
     def test_registry_complete(self):
         from comfyui_parallelanything_tpu.sampling import RNG_SAMPLERS
 
         assert set(SAMPLERS) == {
-            "euler", "euler_ancestral", "heun", "lms", "dpmpp_2m",
+            "euler", "euler_ancestral", "heun", "dpm_2", "dpm_2_ancestral",
+            "lms", "dpmpp_2s_ancestral", "dpmpp_sde", "dpmpp_2m",
             "dpmpp_2m_sde", "dpmpp_3m_sde", "lcm", "ddpm",
         }
         assert RNG_SAMPLERS <= set(SAMPLERS)
