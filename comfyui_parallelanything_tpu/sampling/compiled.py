@@ -47,6 +47,7 @@ from .k_samplers import (
     EpsDenoiser,
     ancestral_steps as _ancestral,
     lms_coefficient_matrix,
+    unipc_coeff_table,
 )
 
 __all__ = [
@@ -214,19 +215,26 @@ def _scan_dpm_2(denoise, x, sigmas, keys, post, constrain):
 
 
 def _scan_dpm_2_ancestral(denoise, x, sigmas, keys, post, constrain, eta=1.0):
+    # The second-order branch sits under lax.cond, not jnp.where: the final
+    # step (sigma_down == 0, Euler) must not execute — or pay for — the
+    # midpoint model call its eager twin skips.
     def body(x, per):
         i, s, s_next, key = per
         x0 = denoise(x, s)
         sd, su = _ancestral(s, s_next, eta)
         d = (x - x0) / s
-        euler = x + d * (sd - s)
-        sd_safe = jnp.maximum(sd, 1e-10)
-        sigma_mid = jnp.exp(0.5 * (jnp.log(s) + jnp.log(sd_safe)))
-        x_2 = x + d * (sigma_mid - s)
-        x0_2 = denoise(x_2, sigma_mid)
-        d_2 = (x_2 - x0_2) / sigma_mid
-        mid = x + d_2 * (sd - s)
-        x = jnp.where(sd > 0, mid, euler)
+
+        def euler_branch(x):
+            return x + d * (sd - s)
+
+        def midpoint_branch(x):
+            sigma_mid = jnp.exp(0.5 * (jnp.log(s) + jnp.log(sd)))
+            x_2 = x + d * (sigma_mid - s)
+            x0_2 = denoise(x_2, sigma_mid)
+            d_2 = (x_2 - x0_2) / sigma_mid
+            return x + d_2 * (sd - s)
+
+        x = jax.lax.cond(sd > 0, midpoint_branch, euler_branch, x)
         noise = jax.random.normal(key, x.shape, x.dtype)
         x = x + jnp.where(s_next > 0, su, 0.0) * noise
         return constrain(post(i, x)), None
@@ -241,16 +249,20 @@ def _scan_dpmpp_2s_ancestral(denoise, x, sigmas, keys, post, constrain, eta=1.0)
         i, s, s_next, key = per
         x0 = denoise(x, s)
         sd, su = _ancestral(s, s_next, eta)
-        d = (x - x0) / s
-        euler = x + d * (sd - s)
-        sd_safe = jnp.maximum(sd, 1e-10)
-        t, t_next = -jnp.log(s), -jnp.log(sd_safe)
-        h = t_next - t
-        sigma_mid = jnp.exp(-(t + 0.5 * h))
-        x_2 = (sigma_mid / s) * x - jnp.expm1(-0.5 * h) * x0
-        x0_2 = denoise(x_2, sigma_mid)
-        second = (sd / s) * x - jnp.expm1(-h) * x0_2
-        x = jnp.where(sd > 0, second, euler)
+
+        def euler_branch(x):
+            d = (x - x0) / s
+            return x + d * (sd - s)
+
+        def second_branch(x):
+            t, t_next = -jnp.log(s), -jnp.log(sd)
+            h = t_next - t
+            sigma_mid = jnp.exp(-(t + 0.5 * h))
+            x_2 = (sigma_mid / s) * x - jnp.expm1(-0.5 * h) * x0
+            x0_2 = denoise(x_2, sigma_mid)
+            return (sd / s) * x - jnp.expm1(-h) * x0_2
+
+        x = jax.lax.cond(sd > 0, second_branch, euler_branch, x)
         noise = jax.random.normal(key, x.shape, x.dtype)
         x = x + jnp.where(s_next > 0, su, 0.0) * noise
         return constrain(post(i, x)), None
@@ -267,24 +279,28 @@ def _scan_dpmpp_sde(denoise, x, sigmas, keys, post, constrain, eta=1.0):
         i, s, s_next, key = per
         k_mid, k_end = jax.random.split(key)
         x0 = denoise(x, s)
-        d = (x - x0) / s
-        euler = x + d * (s_next - s)
-        s_next_safe = jnp.maximum(s_next, 1e-10)
-        t, t_next = -jnp.log(s), -jnp.log(s_next_safe)
-        h = t_next - t
-        sigma_mid = jnp.exp(-(t + r * h))
-        fac = 1.0 / (2.0 * r)
-        sd1, su1 = _ancestral(s, sigma_mid, eta)
-        t_down1 = -jnp.log(jnp.maximum(sd1, 1e-10))
-        x_2 = (sd1 / s) * x - jnp.expm1(t - t_down1) * x0
-        x_2 = x_2 + su1 * jax.random.normal(k_mid, x.shape, x.dtype)
-        x0_2 = denoise(x_2, sigma_mid)
-        sd2, su2 = _ancestral(s, s_next, eta)
-        t_down2 = -jnp.log(jnp.maximum(sd2, 1e-10))
-        x0_blend = (1.0 - fac) * x0 + fac * x0_2
-        full = (sd2 / s) * x - jnp.expm1(t - t_down2) * x0_blend
-        full = full + su2 * jax.random.normal(k_end, x.shape, x.dtype)
-        x = jnp.where(s_next > 0, full, euler)
+
+        def euler_branch(x):
+            d = (x - x0) / s
+            return x + d * (s_next - s)
+
+        def full_branch(x):
+            t, t_next = -jnp.log(s), -jnp.log(s_next)
+            h = t_next - t
+            sigma_mid = jnp.exp(-(t + r * h))
+            fac = 1.0 / (2.0 * r)
+            sd1, su1 = _ancestral(s, sigma_mid, eta)
+            t_down1 = -jnp.log(jnp.maximum(sd1, 1e-10))
+            x_2 = (sd1 / s) * x - jnp.expm1(t - t_down1) * x0
+            x_2 = x_2 + su1 * jax.random.normal(k_mid, x.shape, x.dtype)
+            x0_2 = denoise(x_2, sigma_mid)
+            sd2, su2 = _ancestral(s, s_next, eta)
+            t_down2 = -jnp.log(jnp.maximum(sd2, 1e-10))
+            x0_blend = (1.0 - fac) * x0 + fac * x0_2
+            out = (sd2 / s) * x - jnp.expm1(t - t_down2) * x0_blend
+            return out + su2 * jax.random.normal(k_end, x.shape, x.dtype)
+
+        x = jax.lax.cond(s_next > 0, full_branch, euler_branch, x)
         return constrain(post(i, x)), None
 
     n = len(sigmas) - 1
@@ -444,6 +460,44 @@ def _scan_lms(denoise, x, sigmas, keys, post, constrain, coeffs=None):
     return x
 
 
+def _scan_unipc(denoise, x, sigmas, keys, post, constrain, coeffs=None):
+    # Variant-agnostic: the host-precomputed table (unipc_coeff_table) bakes
+    # B_h/rho differences between bh1 and bh2 into the per-step rows. History
+    # carry holds the last three model evaluations (zeros early — the
+    # zero-padded rki/rho columns cancel them, mirroring the eager ramp-up).
+    def body(carry, per):
+        x, h1, h2, h3 = carry
+        i, s, s_next, c = per
+        hphi1, Bh, rp0, rp1, rc0, rc1, rct, rki0, rki1 = (c[k] for k in range(9))
+        m0 = h1
+        D1_1 = (h2 - m0) * rki0
+        D1_2 = (h3 - m0) * rki1
+        base = (s_next / s) * x - hphi1 * m0
+
+        def step_branch(x):
+            x_pred = base - Bh * (rp0 * D1_1 + rp1 * D1_2)
+            m_t = denoise(x_pred, s_next)
+            return (
+                base - Bh * (rc0 * D1_1 + rc1 * D1_2 + rct * (m_t - m0)),
+                m_t,
+            )
+
+        def terminal_branch(x):
+            return m0, m0  # history entry is never consumed after a terminal step
+
+        x, m_t = jax.lax.cond(s_next > 0, step_branch, terminal_branch, x)
+        x = constrain(post(i, x))
+        return (x, m_t, h1, h2), None
+
+    n = len(sigmas) - 1
+    m_init = denoise(x, sigmas[0])
+    z = jnp.zeros_like(x)
+    (x, *_), _ = jax.lax.scan(
+        body, (x, m_init, z, z), (jnp.arange(n), sigmas[:-1], sigmas[1:], coeffs)
+    )
+    return x
+
+
 def _scan_lcm(denoise, x, sigmas, keys, post, constrain):
     def body(x, per):
         i, s, s_next, key = per
@@ -496,7 +550,13 @@ SCAN_SAMPLERS = {
     "dpmpp_3m_sde": _scan_dpmpp_3m_sde,
     "lcm": _scan_lcm,
     "ddpm": _scan_ddpm,
+    "uni_pc": _scan_unipc,
+    "uni_pc_bh2": _scan_unipc,
 }
+
+# Samplers whose scan body consumes a host-precomputed schedule-derived table
+# (built in compiled_k_sample; sigmas is a tracer inside the loop program).
+_AUX_SAMPLERS = ("lms", "uni_pc", "uni_pc_bh2")
 
 
 # ---------------------------------------------------------------------------
@@ -611,13 +671,20 @@ def compiled_k_sample(
         if sampler in RNG_SAMPLERS
         else None
     )
-    # LMS integrates its Adams-Bashforth coefficients from the concrete
-    # schedule — done here (sigmas is a tracer inside the loop program).
-    aux = (
-        jnp.asarray(lms_coefficient_matrix(np.asarray(sigmas)), x.dtype)
-        if sampler == "lms"
-        else None
-    )
+    # Schedule-derived coefficient tables are integrated here from the
+    # concrete sigmas (they are tracers inside the loop program).
+    if sampler == "lms":
+        aux = jnp.asarray(lms_coefficient_matrix(np.asarray(sigmas)), x.dtype)
+    elif sampler in ("uni_pc", "uni_pc_bh2"):
+        aux = jnp.asarray(
+            unipc_coeff_table(
+                np.asarray(sigmas),
+                variant="bh2" if sampler.endswith("bh2") else "bh1",
+            ),
+            x.dtype,
+        )
+    else:
+        aux = None
     x = _donation_safe(x, mask_noise, mask_init)
     placed, padded = _prep(
         spec, batch,
@@ -639,7 +706,7 @@ def compiled_k_sample(
             post = _post_from(mask, lambda i: mask_init + mask_noise * sigmas[i + 1])
             constrain = lambda v: _constrain(v, mesh, axis)  # noqa: E731
             sampler_fn = SCAN_SAMPLERS[meta[0]]
-            if meta[0] == "lms":
+            if meta[0] in _AUX_SAMPLERS:
                 return sampler_fn(denoise, x, sigmas, keys, post, constrain,
                                   coeffs=aux)
             return sampler_fn(denoise, x, sigmas, keys, post, constrain)

@@ -608,6 +608,103 @@ def sample_ddpm(denoise, x, sigmas, rng, callback=None):
     return x
 
 
+def unipc_coeff_table(sigmas, order: int = 3, variant: str = "bh1"):
+    """Host-precomputed per-step UniPC quantities (float64) — the analogue of
+    ``lms_coefficient_matrix``: they depend only on the concrete schedule, so
+    the eager loop and the whole-loop compiled twin consume the same table.
+
+    UniPC (Zhao et al. 2023) in k-diffusion sigma space: with λ = -log σ the
+    VP-space α factors cancel and the exponential-integrator base step is
+    exactly the dpmpp one, ``(σ_next/σ)·x - expm1(-h)·m0``. Row i holds
+    ``[h_phi_1, B_h, rp0, rp1, rc0, rc1, rc_t, rki0, rki1]`` for the step
+    σ_i→σ_{i+1} at running order p = min(order, i+1, n-i) (warm-up ramp and
+    the official lower_order_final ramp-down): predictor weights ``rp*`` for
+    the older-history differences, corrector weights ``rc*`` plus the fresh
+    ``rc_t·(m_t − m0)`` term, and ``rki*`` the 1/r_k factors that form those
+    differences. Unused slots are zero, so consumers need no order branches.
+    ``B_h`` encodes the variant (bh1: hh; bh2: expm1(hh)) — the runtime update
+    is variant-agnostic."""
+    sig = np.asarray(sigmas, np.float64)
+    lam = -np.log(np.maximum(sig, 1e-10))
+    n = len(sig) - 1
+    table = np.zeros((n, 9))
+    for i in range(n):
+        p = max(1, min(order, i + 1, n - i))
+        h = lam[i + 1] - lam[i]
+        hh = -h
+        h_phi_1 = np.expm1(hh)
+        B_h = hh if variant == "bh1" else np.expm1(hh)
+        rks, rkinv = [], []
+        for j in range(1, p):
+            rk = (lam[i - j] - lam[i]) / h
+            rks.append(rk)
+            rkinv.append(1.0 / rk)
+        rks.append(1.0)  # the D1_t column
+        R = np.array([[rk**k for rk in rks] for k in range(p)])
+        b = np.zeros(p)
+        fact = 1.0
+        h_phi_k = h_phi_1 / hh - 1.0
+        for k in range(1, p + 1):
+            b[k - 1] = h_phi_k * fact / B_h
+            fact *= k + 1
+            h_phi_k = h_phi_k / hh - 1.0 / fact
+        # Order 2 predictor is hardcoded to 0.5 in the official UniPC (and the
+        # host KSampler's port of it) — "for order 2, we use a simplified
+        # version" — not the 1×1 solve, which differs by O(h).
+        if p == 1:
+            rhos_p = np.zeros(0)
+        elif p == 2:
+            rhos_p = np.array([0.5])
+        else:
+            rhos_p = np.linalg.solve(R[:-1, :-1], b[:-1])
+        rhos_c = np.linalg.solve(R, b) if p > 1 else np.array([0.5])
+        row = table[i]
+        row[0], row[1] = h_phi_1, B_h
+        row[2 : 2 + len(rhos_p)] = rhos_p
+        row[4 : 4 + len(rhos_c) - 1] = rhos_c[:-1]
+        row[6] = rhos_c[-1]
+        row[7 : 7 + len(rkinv)] = rkinv
+    return table
+
+
+def _sample_unipc(denoise, x, sigmas, callback=None, variant="bh1", order=3):
+    """UniPC multistep predictor-corrector (data-prediction form). One model
+    call per step: the corrector reuses the evaluation at the predictor's
+    point, which then becomes the next step's history entry — the official
+    multistep flow. Final (σ→0) step returns m0 directly."""
+    C = unipc_coeff_table(sigmas, order, variant)
+    n = len(sigmas) - 1
+    hist = [denoise(x, sigmas[0])]
+    for i in range(n):
+        s, s_next = sigmas[i], sigmas[i + 1]
+        m0 = hist[-1]
+        if float(s_next) == 0.0:
+            x = apply_callback(callback, i, m0)
+            continue
+        hphi1, Bh, rp0, rp1, rc0, rc1, rct, rki0, rki1 = (float(v) for v in C[i])
+        D1_1 = (hist[-2] - m0) * rki0 if len(hist) >= 2 else 0.0
+        D1_2 = (hist[-3] - m0) * rki1 if len(hist) >= 3 else 0.0
+        base = (s_next / s) * x - hphi1 * m0
+        x_pred = base - Bh * (rp0 * D1_1 + rp1 * D1_2)
+        m_t = denoise(x_pred, s_next)
+        x = base - Bh * (rc0 * D1_1 + rc1 * D1_2 + rct * (m_t - m0))
+        hist.append(m_t)
+        if len(hist) > order:
+            hist.pop(0)
+        x = apply_callback(callback, i, x)
+    return x
+
+
+def sample_uni_pc(denoise, x, sigmas, callback=None):
+    """UniPC, bh1 variant (the host KSampler's ``uni_pc`` entry)."""
+    return _sample_unipc(denoise, x, sigmas, callback, variant="bh1")
+
+
+def sample_uni_pc_bh2(denoise, x, sigmas, callback=None):
+    """UniPC, bh2 variant (the host KSampler's ``uni_pc_bh2`` entry)."""
+    return _sample_unipc(denoise, x, sigmas, callback, variant="bh2")
+
+
 # One registry for the sigma-space samplers; stochastic ones (extra rng arg)
 # are listed in RNG_SAMPLERS so dispatchers know the signature.
 SAMPLERS = {
@@ -624,6 +721,8 @@ SAMPLERS = {
     "dpmpp_3m_sde": sample_dpmpp_3m_sde,
     "lcm": sample_lcm,
     "ddpm": sample_ddpm,
+    "uni_pc": sample_uni_pc,
+    "uni_pc_bh2": sample_uni_pc_bh2,
 }
 RNG_SAMPLERS = frozenset(
     {"euler_ancestral", "dpm_2_ancestral", "dpmpp_2s_ancestral", "dpmpp_sde",

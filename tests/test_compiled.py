@@ -39,7 +39,8 @@ def _ctx(seed=3, batch=SHAPE[0]):
 ALL_SAMPLERS = [
     "euler", "euler_ancestral", "heun", "dpm_2", "dpm_2_ancestral", "lms",
     "dpmpp_2s_ancestral", "dpmpp_sde", "dpmpp_2m", "dpmpp_2m_sde",
-    "dpmpp_3m_sde", "lcm", "ddpm", "ddim", "flow_euler",
+    "dpmpp_3m_sde", "lcm", "ddpm", "uni_pc", "uni_pc_bh2", "ddim",
+    "flow_euler",
 ]
 
 

@@ -243,13 +243,48 @@ class TestSamplersRecoverX0:
         out = sample_dpmpp_sde(denoise, x_init, sigmas, jax.random.key(8))
         np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=0.15, atol=0.15)
 
+    @pytest.mark.parametrize("name", ["uni_pc", "uni_pc_bh2"])
+    def test_unipc_recovers_x0(self, problem, name):
+        x0, x_init, sigmas, denoise = problem
+        out = SAMPLERS[name](denoise, x_init, sigmas)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=1e-2, atol=1e-2)
+
+    def test_unipc_variants_differ_midway(self, problem):
+        # bh1 and bh2 share the base step but weight the corrections
+        # differently — a truncated (non-terminal) run must show it.
+        x0, x_init, sigmas, denoise = problem
+        a = SAMPLERS["uni_pc"](denoise, x_init, sigmas[:6])
+        b = SAMPLERS["uni_pc_bh2"](denoise, x_init, sigmas[:6])
+        assert float(jnp.abs(a - b).max()) > 0
+
+    def test_unipc_coeff_table_shape_and_order_ramp(self):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            unipc_coeff_table,
+        )
+
+        sigmas = sampling_sigmas(8)
+        C = unipc_coeff_table(sigmas, order=3)
+        assert C.shape == (8, 9)
+        # Step 0 runs order 1: no predictor/older-corrector weights, rc_t=0.5.
+        assert C[0, 2] == 0 and C[0, 4] == 0 and C[0, 6] == 0.5
+        # Step 1 runs order 2: the official UniPC hardcodes the order-2
+        # predictor weight to exactly 0.5 (not the 1×1 solve).
+        assert C[1, 2] == 0.5 and C[1, 3] == 0
+        # The final step also ramps down to order 1 (lower_order_final); the
+        # penultimate runs order 2 with the same hardcoded predictor weight.
+        assert C[-1, 2] == 0 and C[-1, 7] == 0
+        assert C[-2, 2] == 0.5
+        # An interior step at full order has predictor + history weights.
+        assert C[4, 2] != 0 and C[4, 3] != 0 and C[4, 7] != 0
+
     def test_registry_complete(self):
         from comfyui_parallelanything_tpu.sampling import RNG_SAMPLERS
 
         assert set(SAMPLERS) == {
             "euler", "euler_ancestral", "heun", "dpm_2", "dpm_2_ancestral",
             "lms", "dpmpp_2s_ancestral", "dpmpp_sde", "dpmpp_2m",
-            "dpmpp_2m_sde", "dpmpp_3m_sde", "lcm", "ddpm",
+            "dpmpp_2m_sde", "dpmpp_3m_sde", "lcm", "ddpm", "uni_pc",
+            "uni_pc_bh2",
         }
         assert RNG_SAMPLERS <= set(SAMPLERS)
 
