@@ -46,6 +46,10 @@ class FluxConfig:
     guidance_embed: bool = True
     patch_size: int = 2
     dtype: Any = jnp.bfloat16
+    # Rectified-flow velocity parameterization: the KSampler node reads this to
+    # route flux-family models through flow-time k-sampling (sampling/runner.py)
+    # instead of the eps sigma table.
+    prediction: str = "flow"
 
     @property
     def head_dim(self) -> int:

@@ -44,6 +44,9 @@ class MMDiTConfig:
     # joint_blocks.{i}.x_block.attn2 keys exist in the checkpoint.
     x_block_self_attn_layers: tuple[int, ...] = ()
     dtype: Any = jnp.bfloat16
+    # SD3-family MMDiTs are rectified-flow models (see models/flux.py): the
+    # KSampler node reads this to route them through flow-time k-sampling.
+    prediction: str = "flow"
 
     @property
     def hidden_size(self) -> int:

@@ -45,6 +45,9 @@ class WanConfig:
     qk_norm_eps: float = 1e-6
     theta: float = 10000.0
     dtype: Any = jnp.bfloat16
+    # Rectified-flow velocity parameterization (see models/flux.py): routes the
+    # KSampler node's k-sampler menu through flow-time sampling for WAN.
+    prediction: str = "flow"
 
     @property
     def head_dim(self) -> int:

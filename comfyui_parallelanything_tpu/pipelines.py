@@ -247,6 +247,7 @@ class FluxPipeline:
         prompt: str | list[str],
         *,
         steps: int = 20,
+        sampler: str = "flow_euler",
         guidance: float | None = 3.5,
         shift: float = 1.15,
         height: int = 1024,
@@ -297,7 +298,8 @@ class FluxPipeline:
             self.dit,
             noise,
             context,
-            sampler="flow_euler",
+            sampler=sampler,
+            prediction="flow",
             steps=steps,
             shift=shift,
             guidance=guidance,
@@ -343,6 +345,7 @@ class WanVideoPipeline:
         negative_prompt: str | list[str] = "",
         *,
         steps: int = 30,
+        sampler: str = "flow_euler",
         cfg_scale: float = 5.0,
         shift: float = 5.0,
         height: int = 480,
@@ -430,7 +433,8 @@ class WanVideoPipeline:
             denoiser,
             noise,
             context,
-            sampler="flow_euler",
+            sampler=sampler,
+            prediction="flow",
             steps=steps,
             shift=shift,
             guidance=None,
@@ -542,6 +546,7 @@ class Sd3Pipeline:
         negative_prompt: str | list[str] = "",
         *,
         steps: int = 28,
+        sampler: str = "flow_euler",
         cfg_scale: float = 4.5,
         shift: float = 3.0,
         height: int = 1024,
@@ -590,7 +595,8 @@ class Sd3Pipeline:
             self.dit,
             noise,
             context,
-            sampler="flow_euler",
+            sampler=sampler,
+            prediction="flow",
             steps=steps,
             shift=shift,
             cfg_scale=cfg_scale if use_cfg else 1.0,

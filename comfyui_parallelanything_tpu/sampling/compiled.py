@@ -308,6 +308,102 @@ def _scan_dpmpp_sde(denoise, x, sigmas, keys, post, constrain, eta=1.0):
     return x
 
 
+def _scan_euler_ancestral_rf(denoise, x, sigmas, keys, post, constrain, eta=1.0):
+    # Mirrors sample_euler_ancestral_rf (rectified-flow renoise form).
+    def body(x, per):
+        i, s, s_next, key = per
+        x0 = denoise(x, s)
+
+        def final(x):
+            return x0
+
+        def step(x):
+            downstep = 1.0 + (s_next / s - 1.0) * eta
+            sd = s_next * downstep
+            alpha_ip1 = 1.0 - s_next
+            alpha_down = 1.0 - sd
+            renoise = jnp.sqrt(jnp.maximum(
+                s_next**2 - sd**2 * alpha_ip1**2 / alpha_down**2, 0.0
+            ))
+            xx = (sd / s) * x + (1.0 - sd / s) * x0
+            return (alpha_ip1 / alpha_down) * xx + renoise * jax.random.normal(
+                key, x.shape, x.dtype
+            )
+
+        x = jax.lax.cond(s_next > 0, step, final, x)
+        return constrain(post(i, x)), None
+
+    n = len(sigmas) - 1
+    x, _ = jax.lax.scan(body, x, (jnp.arange(n), sigmas[:-1], sigmas[1:], keys))
+    return x
+
+
+def _scan_dpmpp_2s_ancestral_rf(denoise, x, sigmas, keys, post, constrain,
+                                eta=1.0):
+    # Mirrors sample_dpmpp_2s_ancestral_rf (flow log-SNR midpoint + RF renoise).
+    def body(x, per):
+        i, s, s_next, key = per
+        x0 = denoise(x, s)
+        downstep = 1.0 + (s_next / s - 1.0) * eta
+        sd = s_next * downstep
+        a1 = 1.0 - s_next
+        ad = 1.0 - sd
+        renoise = jnp.sqrt(jnp.maximum(s_next**2 - sd**2 * a1**2 / ad**2, 0.0))
+
+        def euler_branch(x):
+            d = (x - x0) / s
+            return x + d * (sd - s)
+
+        def second_branch(x):
+            # λ diverges at σ=1: clamp the formula's input and pin the result
+            # to the host's fixed 0.9999 midpoint there (the clamped value
+            # only feeds the discarded where-branch).
+            s_c = jnp.minimum(s, 0.999999)
+            t_i = jnp.log((1.0 - s_c) / s_c)
+            t_down = jnp.log((1.0 - sd) / sd)
+            sigma_mid = jnp.where(
+                s >= 1.0,
+                jnp.float32(0.9999),
+                1.0 / (jnp.exp(t_i + 0.5 * (t_down - t_i)) + 1.0),
+            )
+            u = (sigma_mid / s) * x + (1.0 - sigma_mid / s) * x0
+            x0_2 = denoise(u, sigma_mid)
+            return (sd / s) * x + (1.0 - sd / s) * x0_2
+
+        x = jax.lax.cond(s_next > 0, second_branch, euler_branch, x)
+        noise = jax.random.normal(key, x.shape, x.dtype)
+        x = jnp.where(s_next > 0, (a1 / ad) * x + renoise * noise, x)
+        return constrain(post(i, x)), None
+
+    n = len(sigmas) - 1
+    x, _ = jax.lax.scan(body, x, (jnp.arange(n), sigmas[:-1], sigmas[1:], keys))
+    return x
+
+
+def _scan_lcm_rf(denoise, x, sigmas, keys, post, constrain):
+    # Mirrors sample_lcm_rf: flow-interpolant renoise t·n + (1−t)·x0.
+    def body(x, per):
+        i, s, s_next, key = per
+        x0 = denoise(x, s)
+        noise = jax.random.normal(key, x.shape, x.dtype)
+        renoised = s_next * noise + (1.0 - s_next) * x0
+        x = jnp.where(s_next > 0, renoised, x0)
+        return constrain(post(i, x)), None
+
+    n = len(sigmas) - 1
+    x, _ = jax.lax.scan(body, x, (jnp.arange(n), sigmas[:-1], sigmas[1:], keys))
+    return x
+
+
+# prediction="flow" scan-twin swaps (host CONST-dispatch parity; mirrors
+# k_samplers.FLOW_VARIANTS — runner rejects FLOW_REJECT before reaching here).
+SCAN_FLOW_VARIANTS = {
+    "euler_ancestral": _scan_euler_ancestral_rf,
+    "dpmpp_2s_ancestral": _scan_dpmpp_2s_ancestral_rf,
+    "lcm": _scan_lcm_rf,
+}
+
+
 def _scan_heun(denoise, x, sigmas, keys, post, constrain):
     # Interior steps have s_next > 0; the final step (s_next == 0) is Euler,
     # which collapses to x = denoise(x, s) — run it as an epilogue so the scan
@@ -703,9 +799,22 @@ def compiled_k_sample(
                 uncond_kwargs=u_kwargs, alphas_cumprod=acp,
                 prediction=meta[3], cfg_rescale=meta[2], **kwargs,
             )
-            post = _post_from(mask, lambda i: mask_init + mask_noise * sigmas[i + 1])
+            if meta[3] == "flow":
+                # Flow forward process: keep-region re-pinned to
+                # (1−t)·init + t·noise at each step's flow time.
+                post = _post_from(
+                    mask,
+                    lambda i: (1.0 - sigmas[i + 1]) * mask_init
+                    + sigmas[i + 1] * mask_noise,
+                )
+            else:
+                post = _post_from(
+                    mask, lambda i: mask_init + mask_noise * sigmas[i + 1]
+                )
             constrain = lambda v: _constrain(v, mesh, axis)  # noqa: E731
             sampler_fn = SCAN_SAMPLERS[meta[0]]
+            if meta[3] == "flow":
+                sampler_fn = SCAN_FLOW_VARIANTS.get(meta[0], sampler_fn)
             if meta[0] in _AUX_SAMPLERS:
                 return sampler_fn(denoise, x, sigmas, keys, post, constrain,
                                   coeffs=aux)

@@ -14,12 +14,20 @@ import jax.numpy as jnp
 from .cfg import apply_callback, double_kwargs, rescale_guidance
 
 
+def apply_flow_shift(t: jnp.ndarray, shift: float) -> jnp.ndarray:
+    """The rectified-flow resolution shift warp t ↦ s·t/(1+(s−1)·t) — the one
+    implementation shared by the flow_euler ladder and the CONST sigma table
+    the scheduler menu ranges over (k_samplers.flow_sigma_table)."""
+    if shift == 1.0:
+        return t
+    return shift * t / (1.0 + (shift - 1.0) * t)
+
+
 def flow_timesteps(steps: int, shift: float = 1.0) -> jnp.ndarray:
     """(steps+1,) descending t in [1, 0], with the rectified-flow shift applied."""
-    t = jnp.linspace(1.0, 0.0, steps + 1, dtype=jnp.float32)
-    if shift != 1.0:
-        t = shift * t / (1.0 + (shift - 1.0) * t)
-    return t
+    return apply_flow_shift(
+        jnp.linspace(1.0, 0.0, steps + 1, dtype=jnp.float32), shift
+    )
 
 
 def flow_euler_sample(

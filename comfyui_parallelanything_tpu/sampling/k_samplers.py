@@ -27,11 +27,13 @@ def model_sigmas(alphas_cumprod: jnp.ndarray) -> jnp.ndarray:
     return jnp.sqrt((1.0 - alphas_cumprod) / alphas_cumprod)
 
 
-def sampling_sigmas(n_steps: int, alphas_cumprod: jnp.ndarray | None = None) -> jnp.ndarray:
+def sampling_sigmas(
+    n_steps: int,
+    alphas_cumprod: jnp.ndarray | None = None,
+    sigma_table: jnp.ndarray | None = None,
+) -> jnp.ndarray:
     """(n_steps+1,) descending sigmas over the model's range, ending at 0."""
-    if alphas_cumprod is None:
-        alphas_cumprod = scaled_linear_schedule()
-    table = model_sigmas(alphas_cumprod)
+    table = _sigma_table(alphas_cumprod, sigma_table)
     idx = jnp.linspace(len(table) - 1, 0, n_steps, dtype=jnp.float32)
     sig = jnp.interp(idx, jnp.arange(len(table), dtype=jnp.float32), table)
     return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
@@ -62,29 +64,49 @@ def exponential_sigmas(
     return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
 
 
-def _sigma_table(alphas_cumprod: jnp.ndarray | None) -> jnp.ndarray:
+def _sigma_table(
+    alphas_cumprod: jnp.ndarray | None, sigma_table: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    if sigma_table is not None:
+        return sigma_table
     if alphas_cumprod is None:
         alphas_cumprod = scaled_linear_schedule()
     return model_sigmas(alphas_cumprod)
 
 
+def flow_sigma_table(shift: float = 1.0, n: int = 1000) -> jnp.ndarray:
+    """The CONST (rectified-flow) model sigma table: sigma(t) = t with the
+    resolution shift applied, ascending over n trained timesteps — the host's
+    ModelSamplingDiscreteFlow table, which its scheduler menu samples for flow
+    models. sigma_max = 1, sigma_min = shifted(1/n) (~1e-3)."""
+    from .flow import apply_flow_shift
+
+    return apply_flow_shift(
+        jnp.linspace(1.0 / n, 1.0, n, dtype=jnp.float32), shift
+    )
+
+
 def sgm_uniform_sigmas(
-    n_steps: int, alphas_cumprod: jnp.ndarray | None = None
+    n_steps: int,
+    alphas_cumprod: jnp.ndarray | None = None,
+    sigma_table: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """SGM/EDM "trailing" uniform-timestep spacing (ComfyUI ``sgm_uniform``):
     n+1 uniform timesteps, last dropped, so the final nonzero sigma sits one
     uniform stride above 0 instead of at sigma_min."""
-    table = _sigma_table(alphas_cumprod)
+    table = _sigma_table(alphas_cumprod, sigma_table)
     idx = jnp.linspace(len(table) - 1, 0, n_steps + 1, dtype=jnp.float32)[:-1]
     sig = jnp.interp(idx, jnp.arange(len(table), dtype=jnp.float32), table)
     return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
 
 
 def simple_sigmas(
-    n_steps: int, alphas_cumprod: jnp.ndarray | None = None
+    n_steps: int,
+    alphas_cumprod: jnp.ndarray | None = None,
+    sigma_table: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """ComfyUI ``simple``: raw table entries at equal index strides (no interp)."""
-    table = _sigma_table(alphas_cumprod)
+    table = _sigma_table(alphas_cumprod, sigma_table)
     stride = len(table) / n_steps
     idx = [len(table) - 1 - int(i * stride) for i in range(n_steps)]
     sig = table[jnp.asarray(idx, jnp.int32)]
@@ -106,13 +128,14 @@ def beta_sigmas(
     alphas_cumprod: jnp.ndarray | None = None,
     alpha: float = 0.6,
     beta: float = 0.6,
+    sigma_table: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """ComfyUI ``beta`` (arXiv:2407.12173): timesteps at Beta(0.6, 0.6) quantiles —
     denser at both schedule ends. Duplicate timesteps (quantiles collide after
     rounding at high step counts) are skipped like the reference implementation,
     so the result may be shorter than ``n_steps + 1`` — a repeated sigma would
     divide-by-zero the multistep samplers (lms, dpm++ sde)."""
-    table = _sigma_table(alphas_cumprod)
+    table = _sigma_table(alphas_cumprod, sigma_table)
     # endpoint=False matches the reference scheduler: quantiles stop one stride
     # above q=0, so the last nonzero sigma sits above sigma_min.
     ts = 1.0 - np.linspace(0.0, 1.0, n_steps, endpoint=False, dtype=np.float64)
@@ -123,12 +146,14 @@ def beta_sigmas(
 
 
 def ddim_uniform_sigmas(
-    n_steps: int, alphas_cumprod: jnp.ndarray | None = None
+    n_steps: int,
+    alphas_cumprod: jnp.ndarray | None = None,
+    sigma_table: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """ComfyUI ``ddim_uniform``: the DDIM stride — table entries at indices
     ``1, 1+T//n, 1+2·T//n, … (< T)`` (integer stride, so the realized step count
     can differ slightly from ``n_steps``), descending."""
-    table = _sigma_table(alphas_cumprod)
+    table = _sigma_table(alphas_cumprod, sigma_table)
     T = len(table)
     stride = T // n_steps
     if stride <= 1:
@@ -136,19 +161,21 @@ def ddim_uniform_sigmas(
         # request. Uniform trailing spacing is the exact limit of the stride
         # scheme as stride→1, and it honors the requested count — so the
         # degenerate regime hands off to sgm_uniform.
-        return sgm_uniform_sigmas(n_steps, alphas_cumprod)
+        return sgm_uniform_sigmas(n_steps, alphas_cumprod, sigma_table)
     idx = list(range(1, T, stride))
     sig = table[jnp.asarray(list(reversed(idx)), jnp.int32)]
     return jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)])
 
 
 def kl_optimal_sigmas(
-    n_steps: int, alphas_cumprod: jnp.ndarray | None = None
+    n_steps: int,
+    alphas_cumprod: jnp.ndarray | None = None,
+    sigma_table: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """"Align Your Steps" KL-optimal spacing (arXiv:2404.14507):
     σᵢ = tan((1−i/(n−1))·atan(σ_max) + (i/(n−1))·atan(σ_min)) — inclusive
     interpolation, so the last nonzero sigma is exactly σ_min."""
-    table = _sigma_table(alphas_cumprod)
+    table = _sigma_table(alphas_cumprod, sigma_table)
     sigma_min, sigma_max = jnp.float32(table[0]), jnp.float32(table[-1])
     frac = jnp.linspace(0.0, 1.0, n_steps, dtype=jnp.float32)
     sig = jnp.tan((1.0 - frac) * jnp.arctan(sigma_max) + frac * jnp.arctan(sigma_min))
@@ -162,38 +189,57 @@ SCHEDULER_NAMES = (
 
 
 def make_sigmas(
-    scheduler: str, n_steps: int, alphas_cumprod: jnp.ndarray | None = None
+    scheduler: str,
+    n_steps: int,
+    alphas_cumprod: jnp.ndarray | None = None,
+    sigma_table: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """The KSampler scheduler menu: named spacing → (n_steps+1,) descending sigmas
-    ending at 0, ranged over the model's sigma table when one is supplied."""
+    ending at 0, ranged over the model's sigma table when one is supplied.
+    ``sigma_table`` overrides the eps alpha-bar derivation — flow models pass
+    ``flow_sigma_table(shift)`` so every scheduler ranges over flow time,
+    exactly as the host menu does for CONST model sampling."""
     if scheduler in ("karras", "exponential"):
         fn = karras_sigmas if scheduler == "karras" else exponential_sigmas
-        if alphas_cumprod is None:
+        if alphas_cumprod is None and sigma_table is None:
             return fn(n_steps)
-        table = _sigma_table(alphas_cumprod)
+        table = _sigma_table(alphas_cumprod, sigma_table)
         return fn(n_steps, sigma_min=float(table[0]), sigma_max=float(table[-1]))
     if scheduler == "normal":
-        return sampling_sigmas(n_steps, alphas_cumprod)
+        return sampling_sigmas(n_steps, alphas_cumprod, sigma_table)
     if scheduler == "sgm_uniform":
-        return sgm_uniform_sigmas(n_steps, alphas_cumprod)
+        return sgm_uniform_sigmas(n_steps, alphas_cumprod, sigma_table)
     if scheduler == "simple":
-        return simple_sigmas(n_steps, alphas_cumprod)
+        return simple_sigmas(n_steps, alphas_cumprod, sigma_table)
     if scheduler == "ddim_uniform":
-        return ddim_uniform_sigmas(n_steps, alphas_cumprod)
+        return ddim_uniform_sigmas(n_steps, alphas_cumprod, sigma_table)
     if scheduler == "beta":
-        return beta_sigmas(n_steps, alphas_cumprod)
+        return beta_sigmas(n_steps, alphas_cumprod, sigma_table=sigma_table)
     if scheduler == "kl_optimal":
-        return kl_optimal_sigmas(n_steps, alphas_cumprod)
+        return kl_optimal_sigmas(n_steps, alphas_cumprod, sigma_table)
     raise ValueError(
         f"unknown scheduler {scheduler!r} (have {', '.join(SCHEDULER_NAMES)})"
     )
 
 
 class EpsDenoiser:
-    """Wraps a discrete eps- or v-prediction forward into ``denoise(x, sigma) ->
-    x0`` with batched CFG (cond ‖ uncond in one call — what feeds the DP path
-    its batch, ddim.py). ``prediction="v"`` selects the SD2.x-768 v-param
-    (x0 = c_skip·x + c_out·v with c_skip = 1/(σ²+1), c_out = -σ/√(σ²+1))."""
+    """Wraps a model forward into ``denoise(x, sigma) -> x0`` with batched CFG
+    (cond ‖ uncond in one call — what feeds the DP path its batch, ddim.py).
+
+    ``prediction`` selects the parameterization:
+
+    - ``"eps"`` — noise prediction (SD1.5/SDXL family); x0 = x − σ·eps with
+      the 1/√(σ²+1) input scaling and log-interp σ→timestep table.
+    - ``"v"``   — SD2.x-768 v-param (x0 = c_skip·x + c_out·v with
+      c_skip = 1/(σ²+1), c_out = −σ/√(σ²+1)).
+    - ``"flow"`` — rectified-flow velocity (FLUX/WAN family). Here σ IS the
+      flow time t ∈ (0, 1]: the model takes x unscaled and t directly, and
+      x0 = x − σ·v. This is exact, not an approximation: under the flow
+      forward x_t = (1−t)·x0 + t·n, the k-diffusion ODE d = (x − x0)/σ equals
+      the velocity n − x0, so the whole sigma-space sampler family integrates
+      the same probability-flow ODE ``flow_euler`` does — any k-sampler works
+      on a flow model given a flow-time schedule (the host KSampler's CONST
+      model-sampling wrapper, reproduced TPU-side)."""
 
     def __init__(
         self,
@@ -210,8 +256,10 @@ class EpsDenoiser:
     ):
         if alphas_cumprod is None:
             alphas_cumprod = scaled_linear_schedule()
-        if prediction not in ("eps", "v"):
-            raise ValueError(f"prediction must be 'eps' or 'v', got {prediction!r}")
+        if prediction not in ("eps", "v", "flow"):
+            raise ValueError(
+                f"prediction must be 'eps', 'v' or 'flow', got {prediction!r}"
+            )
         self.prediction = prediction
         self.model = model
         self.context = context
@@ -233,9 +281,15 @@ class EpsDenoiser:
 
     def __call__(self, x: jnp.ndarray, sigma: jnp.ndarray) -> jnp.ndarray:
         batch = x.shape[0]
-        scale = 1.0 / jnp.sqrt(sigma**2 + 1.0)
-        t_vec = jnp.full((batch,), self._timestep(sigma), jnp.float32)
-        x_in = x * scale
+        if self.prediction == "flow":
+            # Flow time is the sigma: the model takes x raw and t = σ directly.
+            scale = 1.0
+            t_vec = jnp.full((batch,), sigma, jnp.float32)
+            x_in = x
+        else:
+            scale = 1.0 / jnp.sqrt(sigma**2 + 1.0)
+            t_vec = jnp.full((batch,), self._timestep(sigma), jnp.float32)
+            x_in = x * scale
         use_cfg = self.cfg_scale != 1.0 and self.uncond_context is not None
         if use_cfg:
             # Every per-batch kwarg doubles with the batch; uncond variants (e.g.
@@ -254,6 +308,7 @@ class EpsDenoiser:
             eps = self.model(x_in, t_vec, self.context, **self.kwargs)
         if self.prediction == "v":
             return x / (sigma**2 + 1.0) - eps * sigma * scale
+        # eps: x0 = x − σ·eps. flow: x0 = x − σ·v — the same expression.
         return x - sigma * eps
 
 
@@ -290,6 +345,91 @@ def sample_euler_ancestral(denoise, x, sigmas, rng, eta: float = 1.0, callback=N
         if float(s_next) > 0:
             rng, sub = jax.random.split(rng)
             x = x + sigma_up * jax.random.normal(sub, x.shape, x.dtype)
+        x = apply_callback(callback, i, x)
+    return x
+
+
+def sample_euler_ancestral_rf(denoise, x, sigmas, rng, eta: float = 1.0,
+                              callback=None):
+    """Euler ancestral for rectified-flow schedules (the host's
+    ``sample_euler_ancestral_RF``): under x_t = (1−t)·x0 + t·n the VE renoise
+    ``x += σ_up·n`` would leave the (1−t)·x0 component unscaled, so the RF form
+    rescales by the interpolant's alpha ratio and injects the variance that
+    exactly restores the t_next marginal."""
+    for i in range(len(sigmas) - 1):
+        s, s_next = sigmas[i], sigmas[i + 1]
+        x0 = denoise(x, s)
+        if float(s_next) == 0.0:
+            x = x0
+        else:
+            downstep = 1.0 + (s_next / s - 1.0) * eta
+            sd = s_next * downstep
+            alpha_ip1 = 1.0 - s_next
+            alpha_down = 1.0 - sd
+            renoise = jnp.sqrt(jnp.maximum(
+                s_next**2 - sd**2 * alpha_ip1**2 / alpha_down**2, 0.0
+            ))
+            ratio = sd / s
+            x = ratio * x + (1.0 - ratio) * x0
+            rng, sub = jax.random.split(rng)
+            x = (alpha_ip1 / alpha_down) * x + renoise * jax.random.normal(
+                sub, x.shape, x.dtype
+            )
+        x = apply_callback(callback, i, x)
+    return x
+
+
+def sample_dpmpp_2s_ancestral_rf(denoise, x, sigmas, rng, eta: float = 1.0,
+                                 callback=None):
+    """DPM-Solver++(2S) ancestral for rectified-flow schedules (the host's
+    ``sample_dpmpp_2s_ancestral_RF``): the exponential-integrator time is the
+    flow log-SNR λ = log((1−σ)/σ), the midpoint sits at λ + h/2 (pinned to
+    σ = 0.9999 when σ = 1, where λ diverges), and the renoise rescales by the
+    interpolant's alpha ratio like the RF Euler-ancestral form."""
+    for i in range(len(sigmas) - 1):
+        s, s_next = sigmas[i], sigmas[i + 1]
+        x0 = denoise(x, s)
+        downstep = 1.0 + (s_next / s - 1.0) * eta
+        sd = s_next * downstep
+        alpha_ip1 = 1.0 - s_next
+        alpha_down = 1.0 - sd
+        renoise = jnp.sqrt(jnp.maximum(
+            s_next**2 - sd**2 * alpha_ip1**2 / alpha_down**2, 0.0
+        ))
+        if float(s_next) == 0.0:
+            d = (x - x0) / s
+            x = x + d * (sd - s)
+        else:
+            if float(s) >= 1.0:
+                sigma_mid = jnp.float32(0.9999)
+            else:
+                t_i = jnp.log((1.0 - s) / s)
+                t_down = jnp.log((1.0 - sd) / sd)
+                h = t_down - t_i
+                sigma_mid = 1.0 / (jnp.exp(t_i + 0.5 * h) + 1.0)
+            u = (sigma_mid / s) * x + (1.0 - sigma_mid / s) * x0
+            x0_2 = denoise(u, sigma_mid)
+            x = (sd / s) * x + (1.0 - sd / s) * x0_2
+        if float(s_next) > 0:
+            rng, sub = jax.random.split(rng)
+            x = (alpha_ip1 / alpha_down) * x + renoise * jax.random.normal(
+                sub, x.shape, x.dtype
+            )
+        x = apply_callback(callback, i, x)
+    return x
+
+
+def sample_lcm_rf(denoise, x, sigmas, rng, callback=None):
+    """LCM on rectified-flow schedules: re-noising uses the flow interpolant
+    ``x = t·n + (1−t)·x0`` (the host's CONST ``noise_scaling``) instead of the
+    VE ``x0 + σ·n``."""
+    for i in range(len(sigmas) - 1):
+        x0 = denoise(x, sigmas[i])
+        x = x0
+        if float(sigmas[i + 1]) > 0:
+            rng, sub = jax.random.split(rng)
+            t = sigmas[i + 1]
+            x = t * jax.random.normal(sub, x.shape, x.dtype) + (1.0 - t) * x0
         x = apply_callback(callback, i, x)
     return x
 
@@ -728,3 +868,17 @@ RNG_SAMPLERS = frozenset(
     {"euler_ancestral", "dpm_2_ancestral", "dpmpp_2s_ancestral", "dpmpp_sde",
      "dpmpp_2m_sde", "dpmpp_3m_sde", "lcm", "ddpm"}
 )
+
+# prediction="flow" renoising policy (host CONST-dispatch parity):
+# - FLOW_VARIANTS: samplers the host swaps for an RF-specific form — we do too.
+# - FLOW_REJECT: ddpm's alpha-bar posterior is an eps-schedule construction
+#   with no flow meaning; reject loudly rather than produce garbage.
+# - Everything else runs its generic form on the flow schedule (deterministic
+#   samplers are exact there; the remaining SDE family keeps the generic
+#   lambda-space noise the host also uses for them).
+FLOW_VARIANTS = {
+    "euler_ancestral": sample_euler_ancestral_rf,
+    "dpmpp_2s_ancestral": sample_dpmpp_2s_ancestral_rf,
+    "lcm": sample_lcm_rf,
+}
+FLOW_REJECT = frozenset({"ddpm"})

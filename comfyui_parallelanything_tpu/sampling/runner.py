@@ -13,9 +13,12 @@ import jax.numpy as jnp
 from .ddim import ddim_sample
 from .flow import flow_euler_sample, flow_timesteps
 from .k_samplers import (
+    FLOW_REJECT,
+    FLOW_VARIANTS,
     RNG_SAMPLERS,
     SAMPLERS as K_SAMPLERS,
     EpsDenoiser,
+    flow_sigma_table,
     make_sigmas,
 )
 
@@ -69,7 +72,10 @@ def run_sampler(
     """Drive ``model`` from ``noise`` to a clean latent with the named sampler.
 
     ``noise`` is unit-variance N(0,1); eps-family samplers scale it to sigma_max
-    internally. ``shift``/``guidance`` apply to ``flow_euler`` only.
+    internally. ``shift``/``guidance`` apply to the flow paths — ``flow_euler``
+    AND any k-sampler running with ``prediction="flow"`` (shift warps the flow
+    sigma table the scheduler menu ranges over; guidance feeds the FLUX-dev
+    distilled-guidance kwarg).
 
     img2img: with ``init_latent`` + ``denoise < 1``, the schedule for
     ``steps/denoise`` total steps is truncated to its last ``steps`` entries and
@@ -95,9 +101,12 @@ def run_sampler(
         raise ValueError(f"denoise must be in (0, 1], got {denoise}")
     if latent_mask is not None and init_latent is None:
         raise ValueError("latent_mask requires init_latent (the kept content)")
-    if prediction != "eps" and sampler == "flow_euler":
+    if prediction == "v" and sampler == "flow_euler":
         raise ValueError("flow_euler is velocity-parameterized already; "
-                         "prediction applies to the eps-family samplers")
+                         "prediction='v' applies to the eps-family samplers")
+    if prediction == "flow" and sampler == "ddim":
+        raise ValueError("ddim runs in alpha-bar space and has no flow form; "
+                         "use flow_euler or any k-sampler for flow models")
     img2img = init_latent is not None and denoise < 1.0
     total = max(steps, int(round(steps / denoise))) if img2img else steps
     # Shared by every compiled-loop dispatch below: the traced inpaint-mask
@@ -211,14 +220,46 @@ def run_sampler(
         raise ValueError(
             f"unknown sampler {sampler!r} (have {', '.join(SAMPLER_NAMES)})"
         )
-    # Same coherence rule as the ddim branch: a caller-supplied schedule must
-    # drive the sampling sigmas (and img2img truncation), not just the
-    # denoiser's sigma→timestep table. ``scheduler`` names the full KSampler
-    # menu (make_sigmas); the older ``karras`` boolean remains as a fallback
-    # when no name is given.
+    is_flow = prediction == "flow"
     acp = model_kwargs.pop("alphas_cumprod", None)
-    sched_name = scheduler if scheduler is not None else ("karras" if karras else "normal")
-    sigmas = make_sigmas(sched_name, total, acp)
+    if is_flow:
+        if acp is not None:
+            # The coherence rule (one schedule drives sigmas, truncation, AND
+            # the denoiser) makes silently ignoring this worse than rejecting:
+            # flow schedules come from flow_sigma_table(shift), not alpha-bars.
+            raise ValueError(
+                "alphas_cumprod is an eps-schedule input with no flow meaning; "
+                "flow schedules derive from the shift-warped flow sigma table"
+            )
+        if sampler in FLOW_REJECT:
+            raise ValueError(
+                f"{sampler} is an eps-schedule construction (alpha-bar "
+                "posterior) with no rectified-flow form; pick any other "
+                "k-sampler for flow models"
+            )
+        # Flow models sample over flow time (σ ≡ t): the scheduler menu
+        # ranges over the CONST sigma table exactly like the host's
+        # calculate_sigmas — "normal" is the shifted ladder; karras/beta/…
+        # re-space it. FLUX-dev's distilled guidance rides a model kwarg as
+        # in the flow_euler branch.
+        sched_name = scheduler if scheduler is not None else "normal"
+        sigmas = make_sigmas(
+            sched_name, total, sigma_table=flow_sigma_table(shift)
+        )
+        if guidance is not None:
+            model_kwargs["guidance"] = jnp.full(
+                (noise.shape[0],), guidance, jnp.float32
+            )
+    else:
+        # Same coherence rule as the ddim branch: a caller-supplied schedule
+        # must drive the sampling sigmas (and img2img truncation), not just
+        # the denoiser's sigma→timestep table. ``scheduler`` names the full
+        # KSampler menu (make_sigmas); the older ``karras`` boolean remains
+        # as a fallback when no name is given.
+        sched_name = (
+            scheduler if scheduler is not None else ("karras" if karras else "normal")
+        )
+        sigmas = make_sigmas(sched_name, total, acp)
     if img2img:
         # The realized schedule can be shorter than requested (ddim_uniform's
         # integer stride; beta's duplicate-timestep dedup in make_sigmas).
@@ -236,9 +277,15 @@ def run_sampler(
         else:
             keep = min(realized, max(1, round(steps * realized / total)))
             sigmas = sigmas[-(keep + 1) :]
-    x = noise * sigmas[0]
-    if img2img:
-        x = init_latent + x
+    if is_flow:
+        # Flow forward process: x_t = t·noise + (1−t)·x0.
+        x = sigmas[0] * noise
+        if img2img:
+            x = x + (1.0 - sigmas[0]) * init_latent
+    else:
+        x = noise * sigmas[0]
+        if img2img:
+            x = init_latent + x
     if sampler in RNG_SAMPLERS and rng is None:
         rng = jax.random.key(0)
     if compile_loop:
@@ -257,7 +304,14 @@ def run_sampler(
         uncond_kwargs=uncond_kwargs, alphas_cumprod=acp, prediction=prediction,
         cfg_rescale=cfg_rescale, **model_kwargs,
     )
-    cb = masked_callback(lambda i: init_latent + noise * sigmas[i + 1])
+    if is_flow:
+        # Host CONST-dispatch parity: samplers with an RF renoise form swap in.
+        step_fn = FLOW_VARIANTS.get(sampler, step_fn)
+        cb = masked_callback(
+            lambda i: (1.0 - sigmas[i + 1]) * init_latent + sigmas[i + 1] * noise
+        )
+    else:
+        cb = masked_callback(lambda i: init_latent + noise * sigmas[i + 1])
     if sampler in RNG_SAMPLERS:
         return step_fn(denoiser, x, sigmas, jax.random.fold_in(rng, 1), callback=cb)
     return step_fn(denoiser, x, sigmas, callback=cb)

@@ -82,6 +82,20 @@ class TestEagerCompiledEquivalence:
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.parametrize("sampler", ["euler", "dpmpp_2m", "uni_pc",
+                                         "euler_ancestral",
+                                         "dpmpp_2s_ancestral", "lcm"])
+    def test_flow_prediction(self, sampler):
+        # Flow-time k-sampling (FLUX/SD3/WAN routing): the compiled loop must
+        # match eager on the flow schedule, including the flow mask blend.
+        mask = jnp.zeros((1, 8, 8, 1)).at[:, :4].set(1.0)
+        kw = dict(prediction="flow", shift=1.2,
+                  init_latent=jnp.full(SHAPE, 0.5), latent_mask=mask)
+        a = _run(sampler, False, **kw)
+        b = _run(sampler, True, **kw)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
     def test_v_prediction_and_scheduler(self):
         kw = dict(prediction="v", scheduler="sgm_uniform")
         a = _run("dpmpp_2m", False, **kw)

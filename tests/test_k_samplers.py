@@ -277,6 +277,28 @@ class TestSamplersRecoverX0:
         # An interior step at full order has predictor + history weights.
         assert C[4, 2] != 0 and C[4, 3] != 0 and C[4, 7] != 0
 
+    def test_flow_oracle_recovers_x0_across_k_samplers(self):
+        # prediction="flow": the k-diffusion ODE d = (x − x0)/σ IS the flow
+        # velocity, so with an oracle velocity model every deterministic
+        # sampler must recover x0 on a flow-time schedule.
+        from comfyui_parallelanything_tpu.sampling.flow import flow_timesteps
+
+        x0 = jax.random.normal(jax.random.key(0), (2, 4, 4, 3), jnp.float32)
+
+        def vmodel(x, t_vec, context=None, **kw):
+            return (x - x0) / t_vec[0]  # exact velocity under x_t=(1−t)x0+tn
+
+        denoise = EpsDenoiser(vmodel, prediction="flow")
+        sigmas = flow_timesteps(10, shift=1.15)
+        noise = jax.random.normal(jax.random.key(1), x0.shape)
+        x_init = sigmas[0] * noise + (1.0 - sigmas[0]) * x0
+        for name in ("euler", "heun", "dpm_2", "dpmpp_2m", "uni_pc", "lms"):
+            out = SAMPLERS[name](denoise, x_init, sigmas)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(x0), rtol=1e-2, atol=1e-2,
+                err_msg=name,
+            )
+
     def test_registry_complete(self):
         from comfyui_parallelanything_tpu.sampling import RNG_SAMPLERS
 
@@ -416,3 +438,196 @@ class TestNewSamplers:
                 steps=3, rng=jax.random.key(1),
             )
             assert np.isfinite(np.asarray(out)).all()
+
+
+class TestFlowPredictionRouting:
+    """prediction="flow" routes the k-sampler menu onto flow-time schedules —
+    the host KSampler's CONST model-sampling wrapper for FLUX/SD3/WAN."""
+
+    def _vmodel(self):
+        def vmodel(x, t, context=None, **kw):
+            return 0.2 * x + 0.1 * jnp.sin(t)[:, None, None, None]
+
+        return vmodel
+
+    def test_euler_flow_equals_flow_euler(self):
+        # k-euler with flow prediction integrates the SAME ODE flow_euler
+        # does: d = (x − x0)/σ = v. On an identical schedule the outputs must
+        # agree to fp tolerance. (run_sampler's k-branch uses the host's
+        # "normal" CONST ladder, which ends at σ_min≈1e-3 rather than
+        # flow_euler's raw linspace — so the ladder is pinned explicitly.)
+        from comfyui_parallelanything_tpu.sampling.flow import flow_euler_sample
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            flow_sigma_table,
+            make_sigmas,
+            sample_euler,
+        )
+
+        sigmas = make_sigmas("normal", 7, sigma_table=flow_sigma_table(1.3))
+        noise = jax.random.normal(jax.random.key(0), (2, 4, 4, 4))
+        x_init = sigmas[0] * noise
+        a = flow_euler_sample(self._vmodel(), x_init, None, ts=sigmas)
+        b = sample_euler(
+            EpsDenoiser(self._vmodel(), prediction="flow"), x_init, sigmas
+        )
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_flow_guidance_kwarg_reaches_model(self):
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        seen = []
+
+        def vmodel(x, t, context=None, guidance=None, **kw):
+            seen.append(guidance)
+            return 0.1 * x
+
+        noise = jax.random.normal(jax.random.key(0), (2, 4, 4, 4))
+        run_sampler(vmodel, noise, None, sampler="dpmpp_2m", steps=3,
+                    prediction="flow", guidance=2.5)
+        assert seen and all(
+            g is not None and g.shape == (2,) and float(g[0]) == 2.5
+            for g in seen
+        )
+
+    def test_flow_img2img_mixes_toward_init(self):
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        noise = jax.random.normal(jax.random.key(0), (1, 4, 4, 4))
+        init = jnp.full((1, 4, 4, 4), 3.0)
+        out = run_sampler(self._vmodel(), noise, None, sampler="euler",
+                          steps=4, prediction="flow", init_latent=init,
+                          denoise=0.4)
+        # Low strength keeps the result near the init, not the noise.
+        assert float(jnp.abs(out - init).mean()) < float(jnp.abs(out - noise).mean())
+
+    def test_ddim_rejects_flow(self):
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        noise = jax.random.normal(jax.random.key(0), (1, 4, 4, 4))
+        with pytest.raises(ValueError, match="alpha-bar"):
+            run_sampler(self._vmodel(), noise, None, sampler="ddim", steps=3,
+                        prediction="flow")
+
+    def test_ddpm_rejects_flow(self):
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        noise = jax.random.normal(jax.random.key(0), (1, 4, 4, 4))
+        with pytest.raises(ValueError, match="rectified-flow"):
+            run_sampler(self._vmodel(), noise, None, sampler="ddpm", steps=3,
+                        prediction="flow", rng=jax.random.key(1))
+
+    def test_flow_scheduler_menu_honored(self):
+        # The host applies its scheduler menu to CONST (flow) models; karras
+        # and normal must produce different flow-time ladders and outputs.
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            flow_sigma_table,
+            make_sigmas,
+        )
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        table = flow_sigma_table(shift=1.2)
+        normal = make_sigmas("normal", 8, sigma_table=table)
+        karras = make_sigmas("karras", 8, sigma_table=table)
+        for sig in (normal, karras):
+            s = np.asarray(sig)
+            assert (np.diff(s) < 0).all() and s[-1] == 0.0
+            assert s[0] <= 1.0 + 1e-6  # flow time never exceeds 1
+        assert not np.allclose(np.asarray(normal), np.asarray(karras))
+
+        noise = jax.random.normal(jax.random.key(0), (1, 4, 4, 4))
+        a = run_sampler(self._vmodel(), noise, None, sampler="euler", steps=6,
+                        prediction="flow", scheduler="normal")
+        b = run_sampler(self._vmodel(), noise, None, sampler="euler", steps=6,
+                        prediction="flow", scheduler="karras")
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+    def test_euler_ancestral_flow_uses_rf_renoise(self):
+        # Oracle flow model: the RF ancestral form must converge near x0,
+        # and its output must differ from the VE renoise math.
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            flow_sigma_table,
+            make_sigmas,
+            sample_euler_ancestral,
+            sample_euler_ancestral_rf,
+        )
+
+        x0 = jax.random.normal(jax.random.key(0), (2, 4, 4, 3), jnp.float32)
+
+        def vmodel(x, t_vec, context=None, **kw):
+            return (x - x0) / t_vec[0]
+
+        denoise = EpsDenoiser(vmodel, prediction="flow")
+        sigmas = make_sigmas("normal", 10, sigma_table=flow_sigma_table())
+        noise = jax.random.normal(jax.random.key(1), x0.shape)
+        x_init = sigmas[0] * noise + (1.0 - sigmas[0]) * x0
+        rf = sample_euler_ancestral_rf(denoise, x_init, sigmas, jax.random.key(2))
+        np.testing.assert_allclose(np.asarray(rf), np.asarray(x0),
+                                   rtol=0.15, atol=0.15)
+        # With the oracle denoiser the terminal step returns x0 exactly for
+        # BOTH forms — the renoise difference shows on a truncated (non-
+        # terminal) trajectory.
+        rf_mid = sample_euler_ancestral_rf(
+            denoise, x_init, sigmas[:5], jax.random.key(2)
+        )
+        ve_mid = sample_euler_ancestral(
+            denoise, x_init, sigmas[:5], jax.random.key(2)
+        )
+        assert not np.allclose(np.asarray(rf_mid), np.asarray(ve_mid))
+
+    def test_dpmpp_2s_ancestral_flow_uses_rf_form(self):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            flow_sigma_table,
+            make_sigmas,
+            sample_dpmpp_2s_ancestral,
+            sample_dpmpp_2s_ancestral_rf,
+        )
+
+        x0 = jax.random.normal(jax.random.key(0), (2, 4, 4, 3), jnp.float32)
+
+        def vmodel(x, t_vec, context=None, **kw):
+            return (x - x0) / t_vec[0]
+
+        denoise = EpsDenoiser(vmodel, prediction="flow")
+        sigmas = make_sigmas("normal", 10, sigma_table=flow_sigma_table())
+        noise = jax.random.normal(jax.random.key(1), x0.shape)
+        x_init = sigmas[0] * noise + (1.0 - sigmas[0]) * x0
+        rf = sample_dpmpp_2s_ancestral_rf(denoise, x_init, sigmas,
+                                          jax.random.key(2))
+        np.testing.assert_allclose(np.asarray(rf), np.asarray(x0),
+                                   rtol=0.15, atol=0.15)
+        # Renoise forms differ on a truncated (non-terminal) trajectory.
+        rf_mid = sample_dpmpp_2s_ancestral_rf(denoise, x_init, sigmas[:5],
+                                              jax.random.key(2))
+        ve_mid = sample_dpmpp_2s_ancestral(denoise, x_init, sigmas[:5],
+                                           jax.random.key(2))
+        assert not np.allclose(np.asarray(rf_mid), np.asarray(ve_mid))
+
+    def test_lcm_flow_recovers_x0_exactly(self):
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            flow_sigma_table,
+            make_sigmas,
+            sample_lcm_rf,
+        )
+
+        x0 = jax.random.normal(jax.random.key(0), (2, 4, 4, 3), jnp.float32)
+
+        def vmodel(x, t_vec, context=None, **kw):
+            return (x - x0) / t_vec[0]
+
+        denoise = EpsDenoiser(vmodel, prediction="flow")
+        sigmas = make_sigmas("normal", 8, sigma_table=flow_sigma_table())
+        noise = jax.random.normal(jax.random.key(1), x0.shape)
+        x_init = sigmas[0] * noise + (1.0 - sigmas[0]) * x0
+        out = sample_lcm_rf(denoise, x_init, sigmas, jax.random.key(2))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x0),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_flux_config_declares_flow(self):
+        from comfyui_parallelanything_tpu.models import (
+            flux_dev_config,
+            wan_1_3b_config,
+        )
+
+        assert flux_dev_config().prediction == "flow"
+        assert wan_1_3b_config().prediction == "flow"
