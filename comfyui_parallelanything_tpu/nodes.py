@@ -929,6 +929,60 @@ def _scheduler_menu() -> list[str]:
     return list(SCHEDULER_NAMES)
 
 
+def _prepare_sampling_inputs(model, positive, negative, latent):
+    """Shared sampler-node boundary (TPUKSampler + TPUSamplerCustomAdvanced):
+    conditioning batch broadcast (ComfyUI semantics: one encoded prompt
+    conditions the whole latent batch, tiled when it divides evenly),
+    patch-size divisibility validation (a mismatch otherwise dies deep in
+    patchify with an opaque reshape error), the missing-pooled FLUX warning,
+    and uncond kwargs assembly.
+
+    Returns ``(model_cfg, context, pooled, uncond_context, uncond_kwargs)``."""
+    import jax.numpy as jnp
+
+    from .parallel.orchestrator import model_config_of
+
+    shape = latent["samples"].shape
+    batch = shape[0]
+
+    def bcast(arr):
+        if arr is not None and arr.shape[0] != batch:
+            if batch % arr.shape[0]:
+                raise ValueError(
+                    f"conditioning batch {arr.shape[0]} does not divide "
+                    f"latent batch {batch}"
+                )
+            arr = jnp.repeat(arr, batch // arr.shape[0], axis=0)
+        return arr
+
+    context = bcast(positive["context"])
+    pooled = bcast(positive.get("pooled"))
+    model_cfg = model_config_of(model)
+    patch = getattr(model_cfg, "patch_size", None)
+    if isinstance(patch, int):
+        bad = [d for d in shape[1:3] if d % patch]
+        if bad:
+            raise ValueError(
+                f"latent spatial dims {shape[1:3]} must be multiples of the "
+                f"model patch size {patch}"
+            )
+    if pooled is None and hasattr(model_cfg, "vec_in_dim"):
+        from .utils.logging import get_logger
+
+        get_logger().warning(
+            "FLUX-family model sampled without a pooled vector (y falls back "
+            "to zeros) — route T5 + CLIP conditioning through "
+            "TPUConditioningCombine(mode='flux')"
+        )
+    uncond_context = bcast(negative["context"]) if negative else None
+    uncond_kwargs = (
+        {"y": bcast(negative["pooled"])}
+        if negative and negative.get("pooled") is not None
+        else None
+    )
+    return model_cfg, context, pooled, uncond_context, uncond_kwargs
+
+
 class TPUKSampler:
     """(MODEL, positive, negative, LATENT) → LATENT — the per-step driver whose
     forwards route through the parallel scheduler when MODEL came from
@@ -1020,49 +1074,9 @@ class TPUKSampler:
 
         rng = jax.random.key(seed)
         shape = latent["samples"].shape
-        batch = shape[0]
         noise = jax.random.normal(rng, shape, jnp.float32)
-
-        def bcast(arr):
-            # ComfyUI semantics: one encoded prompt conditions the whole latent
-            # batch; tile dim0 up to match (must divide evenly).
-            if arr is not None and arr.shape[0] != batch:
-                if batch % arr.shape[0]:
-                    raise ValueError(
-                        f"conditioning batch {arr.shape[0]} does not divide "
-                        f"latent batch {batch}"
-                    )
-                arr = jnp.repeat(arr, batch // arr.shape[0], axis=0)
-            return arr
-
-        context = bcast(positive["context"])
-        pooled = bcast(positive.get("pooled"))
-        from .parallel.orchestrator import model_config_of
-
-        model_cfg = model_config_of(model)
-        patch = getattr(model_cfg, "patch_size", None)
-        if isinstance(patch, int):
-            # Validate spatial divisibility at the node boundary — a mismatch
-            # otherwise dies deep in patchify with an opaque reshape error.
-            bad = [d for d in shape[1:3] if d % patch]
-            if bad:
-                raise ValueError(
-                    f"latent spatial dims {shape[1:3]} must be multiples of the "
-                    f"model patch size {patch}"
-                )
-        if pooled is None and hasattr(model_cfg, "vec_in_dim"):
-            from .utils.logging import get_logger
-
-            get_logger().warning(
-                "FLUX-family model sampled without a pooled vector (y falls back "
-                "to zeros) — route T5 + CLIP conditioning through "
-                "TPUConditioningCombine(mode='flux')"
-            )
-        uncond_context = bcast(negative["context"]) if negative else None
-        uncond_kwargs = (
-            {"y": bcast(negative["pooled"])}
-            if negative and negative.get("pooled") is not None
-            else None
+        model_cfg, context, pooled, uncond_context, uncond_kwargs = (
+            _prepare_sampling_inputs(model, positive, negative, latent)
         )
         kwargs = {} if pooled is None else {"y": pooled}
         out = run_sampler(
@@ -1288,6 +1302,236 @@ class TPUImageScale:
         return (jnp.clip(out, 0.0, 1.0),)
 
 
+class TPURandomNoise:
+    """seed → NOISE — the host's custom-sampling noise source (RandomNoise).
+    The wire carries the seed; SamplerCustomAdvanced generates noise shaped
+    like the latent it receives, exactly as the host's NOISE object does."""
+
+    DESCRIPTION = "Noise source for the custom-sampling graph."
+    RETURN_TYPES = ("NOISE",)
+    RETURN_NAMES = ("noise",)
+    FUNCTION = "get_noise"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "noise_seed": ("INT", {"default": 0, "min": 0, "max": 2**31 - 1}),
+        }}
+
+    def get_noise(self, noise_seed: int):
+        return ({"seed": int(noise_seed)},)
+
+
+class TPUKSamplerSelect:
+    """sampler_name → SAMPLER — the host's KSamplerSelect."""
+
+    DESCRIPTION = "Pick the sampler for the custom-sampling graph."
+    RETURN_TYPES = ("SAMPLER",)
+    RETURN_NAMES = ("sampler",)
+    FUNCTION = "get_sampler"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        from .sampling.runner import SAMPLER_NAMES
+
+        return {"required": {
+            "sampler_name": (list(SAMPLER_NAMES), {"default": "euler"}),
+        }}
+
+    def get_sampler(self, sampler_name: str):
+        return ({"sampler": sampler_name},)
+
+
+class TPUBasicScheduler:
+    """(MODEL, scheduler, steps, denoise) → SIGMAS — the host's BasicScheduler:
+    the named spacing over the MODEL's sigma space (flow models range over the
+    shift-warped CONST table; eps/v over the alpha-bar table), with the host's
+    denoise semantics (steps/denoise total, last steps+1 kept)."""
+
+    DESCRIPTION = "Compute the sigma schedule for the custom-sampling graph."
+    RETURN_TYPES = ("SIGMAS",)
+    RETURN_NAMES = ("sigmas",)
+    FUNCTION = "get_sigmas"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL", {}),
+                "scheduler": (_scheduler_menu(), {"default": "normal"}),
+                "steps": ("INT", {"default": 20, "min": 1, "max": 200}),
+                "denoise": ("FLOAT", {"default": 1.0, "min": 0.01, "max": 1.0,
+                                      "step": 0.01}),
+            },
+            "optional": {
+                "shift": ("FLOAT", {
+                    "default": 1.15, "min": 0.25, "max": 8.0,
+                    "tooltip": "rectified-flow timestep shift (flow models; "
+                               "the host sets this via ModelSamplingFlux)"}),
+            },
+        }
+
+    def get_sigmas(self, model, scheduler: str, steps: int, denoise: float,
+                   shift: float = 1.15):
+        from .parallel.orchestrator import model_config_of
+        from .sampling.k_samplers import flow_sigma_table, make_sigmas
+
+        total = max(steps, int(round(steps / denoise))) if denoise < 1.0 else steps
+        if getattr(model_config_of(model), "prediction", "eps") == "flow":
+            sigmas = make_sigmas(scheduler, total,
+                                 sigma_table=flow_sigma_table(shift))
+        else:
+            sigmas = make_sigmas(scheduler, total)
+        if denoise < 1.0:
+            # Same degenerate-schedule guard as run_sampler's truncation: a
+            # scheduler that realizes fewer sigmas than requested (beta dedup)
+            # would otherwise keep the WHOLE ladder and silently run at full
+            # strength.
+            realized = len(sigmas) - 1
+            if realized > steps:
+                sigmas = sigmas[-(steps + 1):]
+            else:
+                keep = min(realized, max(1, round(steps * realized / total)))
+                sigmas = sigmas[-(keep + 1):]
+        return (sigmas,)
+
+
+class TPUFluxGuidance:
+    """(CONDITIONING, guidance) → CONDITIONING — the host's FluxGuidance: tags
+    the conditioning with the FLUX-dev distilled-guidance value the sampler
+    feeds to the model's guidance embed."""
+
+    DESCRIPTION = "Attach flux distilled guidance to a conditioning."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "append"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "conditioning": ("CONDITIONING", {}),
+            "guidance": ("FLOAT", {"default": 3.5, "min": 0.0, "max": 100.0}),
+        }}
+
+    def append(self, conditioning, guidance: float):
+        return ({**conditioning, "guidance": float(guidance)},)
+
+
+class TPUBasicGuider:
+    """(MODEL, CONDITIONING) → GUIDER — the host's BasicGuider: unguided
+    (cfg=1) sampling driver for distilled models (FLUX)."""
+
+    DESCRIPTION = "Guider without CFG (distilled models)."
+    RETURN_TYPES = ("GUIDER",)
+    RETURN_NAMES = ("guider",)
+    FUNCTION = "get_guider"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "model": ("MODEL", {}),
+            "conditioning": ("CONDITIONING", {}),
+        }}
+
+    def get_guider(self, model, conditioning):
+        return ({"model": model, "positive": conditioning, "negative": None,
+                 "cfg": 1.0},)
+
+
+class TPUCFGGuider:
+    """(MODEL, positive, negative, cfg) → GUIDER — the host's CFGGuider."""
+
+    DESCRIPTION = "Classifier-free-guidance guider."
+    RETURN_TYPES = ("GUIDER",)
+    RETURN_NAMES = ("guider",)
+    FUNCTION = "get_guider"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "model": ("MODEL", {}),
+            "positive": ("CONDITIONING", {}),
+            "negative": ("CONDITIONING", {}),
+            "cfg": ("FLOAT", {"default": 7.5, "min": 1.0, "max": 30.0}),
+        }}
+
+    def get_guider(self, model, positive, negative, cfg: float):
+        return ({"model": model, "positive": positive, "negative": negative,
+                 "cfg": float(cfg)},)
+
+
+class TPUSamplerCustomAdvanced:
+    """(NOISE, GUIDER, SAMPLER, SIGMAS, LATENT) → (LATENT, LATENT) — the
+    host's SamplerCustomAdvanced: the custom-sampling execution node that
+    exported FLUX workflows drive instead of the one-box KSampler. The wired
+    LATENT is always the noising base (host noise_scaling: a zero EmptyLatent
+    degenerates to pure noise; a VAE-encoded one + truncated SIGMAS is
+    img2img). The second output mirrors the host's ``denoised_output``; on a
+    terminal (σ→0) schedule the two coincide exactly, and this node returns
+    the same array for both (divergence only for partial sigma ranges)."""
+
+    DESCRIPTION = "Custom-sampling driver (noise + guider + sampler + sigmas)."
+    RETURN_TYPES = ("LATENT", "LATENT")
+    RETURN_NAMES = ("output", "denoised_output")
+    FUNCTION = "sample"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "noise": ("NOISE", {}),
+                "guider": ("GUIDER", {}),
+                "sampler": ("SAMPLER", {}),
+                "sigmas": ("SIGMAS", {}),
+                "latent_image": ("LATENT", {}),
+            },
+            "optional": {
+                "compile_loop": ("BOOLEAN", {"default": False}),
+            },
+        }
+
+    def sample(self, noise, guider, sampler, sigmas, latent_image,
+               compile_loop: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from .sampling.runner import run_sampler
+
+        model = guider["model"]
+        positive, negative = guider["positive"], guider.get("negative")
+        cfg = guider.get("cfg", 1.0)
+        shape = latent_image["samples"].shape
+        rng = jax.random.key(noise["seed"])
+        noise_arr = jax.random.normal(rng, shape, jnp.float32)
+        model_cfg, context, pooled, uncond_context, uncond_kwargs = (
+            _prepare_sampling_inputs(model, positive, negative, latent_image)
+        )
+        out = run_sampler(
+            model, noise_arr, context,
+            sampler=sampler["sampler"],
+            steps=max(1, len(sigmas) - 1),
+            sigmas=sigmas,
+            cfg_scale=cfg,
+            uncond_context=uncond_context,
+            uncond_kwargs=uncond_kwargs,
+            rng=rng,
+            guidance=positive.get("guidance"),
+            prediction=getattr(model_cfg, "prediction", "eps"),
+            init_latent=latent_image["samples"],
+            latent_mask=latent_image.get("noise_mask"),
+            compile_loop=compile_loop,
+            **({} if pooled is None else {"y": pooled}),
+        )
+        return ({"samples": out}, {"samples": out})
+
+
 NODE_CLASS_MAPPINGS = {
     "ParallelAnything": ParallelAnything,
     "ParallelAnythingAdvanced": ParallelAnythingAdvanced,
@@ -1307,6 +1551,13 @@ NODE_CLASS_MAPPINGS = {
     "TPUSaveImage": TPUSaveImage,
     "TPULoadImage": TPULoadImage,
     "TPUImageScale": TPUImageScale,
+    "TPURandomNoise": TPURandomNoise,
+    "TPUKSamplerSelect": TPUKSamplerSelect,
+    "TPUBasicScheduler": TPUBasicScheduler,
+    "TPUFluxGuidance": TPUFluxGuidance,
+    "TPUBasicGuider": TPUBasicGuider,
+    "TPUCFGGuider": TPUCFGGuider,
+    "TPUSamplerCustomAdvanced": TPUSamplerCustomAdvanced,
 }
 
 NODE_DISPLAY_NAME_MAPPINGS = {
@@ -1328,4 +1579,11 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPUEmptyVideoLatent": "Empty Video Latent (TPU, WAN)",
     "TPUKSampler": "KSampler (TPU)",
     "TPUVAEDecode": "VAE Decode (TPU)",
+    "TPURandomNoise": "Random Noise (TPU)",
+    "TPUKSamplerSelect": "KSampler Select (TPU)",
+    "TPUBasicScheduler": "Basic Scheduler (TPU)",
+    "TPUFluxGuidance": "Flux Guidance (TPU)",
+    "TPUBasicGuider": "Basic Guider (TPU)",
+    "TPUCFGGuider": "CFG Guider (TPU)",
+    "TPUSamplerCustomAdvanced": "Sampler Custom Advanced (TPU)",
 }

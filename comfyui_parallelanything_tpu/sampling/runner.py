@@ -67,6 +67,7 @@ def run_sampler(
     prediction: str = "eps",
     cfg_rescale: float = 0.0,
     compile_loop: bool = False,
+    sigmas: jnp.ndarray | None = None,
     **model_kwargs,
 ) -> jnp.ndarray:
     """Drive ``model`` from ``noise`` to a clean latent with the named sampler.
@@ -94,7 +95,16 @@ def run_sampler(
     traced in. Opt-in because it covers single-program models only (bare models
     and single-platform-group parallel chains) and trades away per-step OOM
     demotion; hybrid chains or a user ``callback`` silently fall back to the
-    eager loops (logged)."""
+    eager loops (logged).
+
+    ``sigmas`` supplies an explicit descending schedule (the host's
+    SamplerCustom/BasicScheduler split): schedule construction, ``scheduler``/
+    ``steps``-based truncation, and the ``denoise`` math are all skipped, and
+    noising follows the host's ``noise_scaling`` with ``init_latent`` as the
+    base (``init + σ₀·noise`` eps; ``σ₀·noise + (1−σ₀)·init`` flow) — a
+    truncated sigma ladder therefore gives img2img exactly as the host's
+    custom-sampling graphs do. flow_euler treats it as its ``ts`` ladder; ddim
+    (timestep-indexed, not sigma-driven) rejects it."""
     use_cfg = cfg_scale != 1.0 and uncond_context is not None
     eff_cfg = cfg_scale if use_cfg else 1.0
     if not 0.0 < denoise <= 1.0:
@@ -107,6 +117,9 @@ def run_sampler(
     if prediction == "flow" and sampler == "ddim":
         raise ValueError("ddim runs in alpha-bar space and has no flow form; "
                          "use flow_euler or any k-sampler for flow models")
+    if sigmas is not None and sampler == "ddim":
+        raise ValueError("ddim is timestep-indexed, not sigma-driven; explicit "
+                         "sigmas apply to flow_euler and the k-samplers")
     img2img = init_latent is not None and denoise < 1.0
     total = max(steps, int(round(steps / denoise))) if img2img else steps
     # Shared by every compiled-loop dispatch below: the traced inpaint-mask
@@ -135,12 +148,18 @@ def run_sampler(
         return cb
 
     if sampler == "flow_euler":
-        ts = flow_timesteps(total, shift)
-        x = noise
-        if img2img:
-            # x_t = t·noise + (1-t)·x0 under the v = noise - x0 flow.
-            ts = ts[-(steps + 1) :]
-            x = ts[0] * noise + (1.0 - ts[0]) * init_latent
+        if sigmas is not None:
+            ts = jnp.asarray(sigmas, jnp.float32)
+            x = ts[0] * noise
+            if init_latent is not None:
+                x = x + (1.0 - ts[0]) * init_latent
+        else:
+            ts = flow_timesteps(total, shift)
+            x = noise
+            if img2img:
+                # x_t = t·noise + (1-t)·x0 under the v = noise - x0 flow.
+                ts = ts[-(steps + 1) :]
+                x = ts[0] * noise + (1.0 - ts[0]) * init_latent
         if compile_loop:
             spec = _compiled_spec(model, callback)
             if spec is not None:
@@ -222,6 +241,7 @@ def run_sampler(
         )
     is_flow = prediction == "flow"
     acp = model_kwargs.pop("alphas_cumprod", None)
+    explicit_sigmas = sigmas is not None
     if is_flow:
         if acp is not None:
             # The coherence rule (one schedule drives sigmas, truncation, AND
@@ -242,15 +262,16 @@ def run_sampler(
         # calculate_sigmas — "normal" is the shifted ladder; karras/beta/…
         # re-space it. FLUX-dev's distilled guidance rides a model kwarg as
         # in the flow_euler branch.
-        sched_name = scheduler if scheduler is not None else "normal"
-        sigmas = make_sigmas(
-            sched_name, total, sigma_table=flow_sigma_table(shift)
-        )
+        if not explicit_sigmas:
+            sched_name = scheduler if scheduler is not None else "normal"
+            sigmas = make_sigmas(
+                sched_name, total, sigma_table=flow_sigma_table(shift)
+            )
         if guidance is not None:
             model_kwargs["guidance"] = jnp.full(
                 (noise.shape[0],), guidance, jnp.float32
             )
-    else:
+    elif not explicit_sigmas:
         # Same coherence rule as the ddim branch: a caller-supplied schedule
         # must drive the sampling sigmas (and img2img truncation), not just
         # the denoiser's sigma→timestep table. ``scheduler`` names the full
@@ -260,7 +281,11 @@ def run_sampler(
             scheduler if scheduler is not None else ("karras" if karras else "normal")
         )
         sigmas = make_sigmas(sched_name, total, acp)
-    if img2img:
+    if explicit_sigmas:
+        # A supplied ladder IS the schedule: no construction, no denoise-based
+        # truncation (the host's BasicScheduler already applied it).
+        sigmas = jnp.asarray(sigmas, jnp.float32)
+    if img2img and not explicit_sigmas:
         # The realized schedule can be shorter than requested (ddim_uniform's
         # integer stride; beta's duplicate-timestep dedup in make_sigmas).
         # While the fixed ComfyUI slice still truncates (realized > steps) use
@@ -277,14 +302,19 @@ def run_sampler(
         else:
             keep = min(realized, max(1, round(steps * realized / total)))
             sigmas = sigmas[-(keep + 1) :]
+    # Noising: host noise_scaling semantics. With an explicit ladder any
+    # supplied init is the base (the custom-sampling graphs' behavior — a
+    # zero EmptyLatent base degenerates to pure noise); otherwise only
+    # img2img mixes the init.
+    mix_init = img2img or (explicit_sigmas and init_latent is not None)
     if is_flow:
         # Flow forward process: x_t = t·noise + (1−t)·x0.
         x = sigmas[0] * noise
-        if img2img:
+        if mix_init:
             x = x + (1.0 - sigmas[0]) * init_latent
     else:
         x = noise * sigmas[0]
-        if img2img:
+        if mix_init:
             x = init_latent + x
     if sampler in RNG_SAMPLERS and rng is None:
         rng = jax.random.key(0)

@@ -566,3 +566,33 @@ class TestEndToEndGraph:
         # The MODEL that sampled is the parallel wrapper over the 4-dev chain.
         pm = out["par"][0]
         assert pm.devices == ("cpu:0", "cpu:1", "cpu:2", "cpu:3")
+
+
+class TestCustomSamplingWorkflow:
+    """A custom-sampling graph in API-format JSON — the node wiring exported
+    FLUX workflows use (RandomNoise + KSamplerSelect + BasicScheduler +
+    BasicGuider + SamplerCustomAdvanced) — executes through the host."""
+
+    def test_custom_sampling_json_graph(self):
+        wf = {
+            "m": {"class_type": "ToyModel", "inputs": {}},
+            "c": {"class_type": "ToyConditioning", "inputs": {"seed": 4}},
+            "n": {"class_type": "TPURandomNoise", "inputs": {"noise_seed": 11}},
+            "s": {"class_type": "TPUKSamplerSelect",
+                  "inputs": {"sampler_name": "euler"}},
+            "sig": {"class_type": "TPUBasicScheduler",
+                    "inputs": {"model": ["m", 0], "scheduler": "normal",
+                               "steps": 3, "denoise": 1.0}},
+            "g": {"class_type": "TPUBasicGuider",
+                  "inputs": {"model": ["m", 0], "conditioning": ["c", 0]}},
+            "lat": {"class_type": "TPUEmptyLatent",
+                    "inputs": {"width": 64, "height": 64, "batch_size": 1}},
+            "out": {"class_type": "TPUSamplerCustomAdvanced",
+                    "inputs": {"noise": ["n", 0], "guider": ["g", 0],
+                               "sampler": ["s", 0], "sigmas": ["sig", 0],
+                               "latent_image": ["lat", 0]}},
+        }
+        out = run_workflow(wf, CUSTOM)
+        latent = out["out"][0]["samples"]
+        assert latent.shape == (1, 8, 8, 4)
+        assert np.isfinite(np.asarray(latent)).all()

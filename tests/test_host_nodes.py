@@ -176,3 +176,99 @@ class TestFullNodeGraph:
         (a,) = TPUKSampler().sample(model, positive, latent, **kw)
         (b,) = TPUKSampler().sample(model, positive, latent, **kw)
         np.testing.assert_array_equal(np.asarray(a["samples"]), np.asarray(b["samples"]))
+
+
+class TestCustomSamplingGraph:
+    """The host's custom-sampling node family (RandomNoise / KSamplerSelect /
+    BasicScheduler / guiders / SamplerCustomAdvanced) — the graph exported
+    FLUX workflows use instead of the one-box KSampler."""
+
+    def test_wire_objects(self, graph_parts):
+        from comfyui_parallelanything_tpu.nodes import (
+            TPUBasicGuider,
+            TPUBasicScheduler,
+            TPUCFGGuider,
+            TPUFluxGuidance,
+            TPUKSamplerSelect,
+            TPURandomNoise,
+        )
+
+        clip_wire, model, _ = graph_parts
+        (noise,) = TPURandomNoise().get_noise(42)
+        assert noise == {"seed": 42}
+        (samp,) = TPUKSamplerSelect().get_sampler("euler")
+        assert samp == {"sampler": "euler"}
+        (sig,) = TPUBasicScheduler().get_sigmas(model, "normal", 6, 1.0)
+        s = np.asarray(sig)
+        assert len(s) == 7 and (np.diff(s) < 0).all() and s[-1] == 0.0
+        # denoise < 1 truncates to the last steps+1 of a longer ladder.
+        (sig_d,) = TPUBasicScheduler().get_sigmas(model, "normal", 6, 0.5)
+        assert len(np.asarray(sig_d)) == 7
+        assert float(np.asarray(sig_d)[0]) < float(s[0])
+
+        (cond,) = TPUTextEncode().encode(clip_wire, "hello")
+        (tagged,) = TPUFluxGuidance().append(cond, 4.0)
+        assert tagged["guidance"] == 4.0 and "context" in tagged
+        (g1,) = TPUBasicGuider().get_guider(model, cond)
+        assert g1["cfg"] == 1.0 and g1["negative"] is None
+        (g2,) = TPUCFGGuider().get_guider(model, cond, cond, 6.0)
+        assert g2["cfg"] == 6.0 and g2["negative"] is not None
+
+    def test_full_custom_graph_matches_ksampler(self, graph_parts):
+        # SamplerCustomAdvanced with BasicScheduler sigmas must reproduce the
+        # one-box KSampler run with the same seed/scheduler/steps.
+        from comfyui_parallelanything_tpu.nodes import (
+            TPUBasicScheduler,
+            TPUCFGGuider,
+            TPUKSamplerSelect,
+            TPURandomNoise,
+            TPUSamplerCustomAdvanced,
+        )
+
+        clip_wire, model, _ = graph_parts
+        (pos,) = TPUTextEncode().encode(clip_wire, "hello world")
+        (neg,) = TPUTextEncode().encode(clip_wire, "world")
+        (latent,) = TPUEmptyLatent().generate(width=64, height=64, batch_size=2)
+
+        (noise,) = TPURandomNoise().get_noise(9)
+        (samp,) = TPUKSamplerSelect().get_sampler("dpmpp_2m")
+        (sig,) = TPUBasicScheduler().get_sigmas(model, "karras", 3, 1.0)
+        (guider,) = TPUCFGGuider().get_guider(model, pos, neg, 4.0)
+        out, den = TPUSamplerCustomAdvanced().sample(noise, guider, samp, sig, latent)
+        np.testing.assert_array_equal(
+            np.asarray(out["samples"]), np.asarray(den["samples"])
+        )
+        (ref,) = TPUKSampler().sample(
+            model, pos, latent, seed=9, steps=3, cfg=4.0,
+            sampler_name="dpmpp_2m", negative=neg, scheduler="karras",
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["samples"]), np.asarray(ref["samples"]),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_img2img_via_truncated_sigmas(self, graph_parts):
+        # A non-zero latent + truncated ladder is img2img by construction
+        # (host noise_scaling semantics) — output should stay nearer the init
+        # than a full-strength run does.
+        from comfyui_parallelanything_tpu.nodes import (
+            TPUBasicGuider,
+            TPUBasicScheduler,
+            TPUKSamplerSelect,
+            TPURandomNoise,
+            TPUSamplerCustomAdvanced,
+        )
+
+        clip_wire, model, _ = graph_parts
+        (pos,) = TPUTextEncode().encode(clip_wire, "hello")
+        init = {"samples": jnp.full((1, 8, 8, 4), 2.0)}
+        (noise,) = TPURandomNoise().get_noise(1)
+        (samp,) = TPUKSamplerSelect().get_sampler("euler")
+        (guider,) = TPUBasicGuider().get_guider(model, pos)
+        (sig_full,) = TPUBasicScheduler().get_sigmas(model, "normal", 4, 1.0)
+        (sig_trunc,) = TPUBasicScheduler().get_sigmas(model, "normal", 4, 0.3)
+        full, _ = TPUSamplerCustomAdvanced().sample(noise, guider, samp, sig_full, init)
+        weak, _ = TPUSamplerCustomAdvanced().sample(noise, guider, samp, sig_trunc, init)
+        d_full = float(jnp.abs(full["samples"] - init["samples"]).mean())
+        d_weak = float(jnp.abs(weak["samples"] - init["samples"]).mean())
+        assert d_weak < d_full
