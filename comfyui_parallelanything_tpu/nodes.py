@@ -1466,6 +1466,74 @@ class TPUCFGGuider:
                  "cfg": float(cfg)},)
 
 
+class TPUDisableNoise:
+    """→ NOISE that generates zeros — the host's DisableNoise: stage 2+ of a
+    split-sigma graph continues from an already-noised latent, so the wired
+    LATENT must pass through unchanged (zeros noise + noise_scaling keeps the
+    init as the base)."""
+
+    DESCRIPTION = "Zero-noise source for split-sigma continuation stages."
+    RETURN_TYPES = ("NOISE",)
+    RETURN_NAMES = ("noise",)
+    FUNCTION = "get_noise"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {}}
+
+    def get_noise(self):
+        return ({"seed": None},)
+
+
+class TPUSplitSigmas:
+    """(SIGMAS, step) → (SIGMAS, SIGMAS) — the host's SplitSigmas: the ladder
+    cut at ``step`` with the boundary sigma shared, so running the high half
+    then the low half (with DisableNoise) reproduces the unsplit run."""
+
+    DESCRIPTION = "Split a sigma ladder for multi-stage sampling."
+    RETURN_TYPES = ("SIGMAS", "SIGMAS")
+    RETURN_NAMES = ("high_sigmas", "low_sigmas")
+    FUNCTION = "split"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "sigmas": ("SIGMAS", {}),
+            "step": ("INT", {"default": 0, "min": 0, "max": 10000}),
+        }}
+
+    def split(self, sigmas, step: int):
+        return (sigmas[: step + 1], sigmas[step:])
+
+
+class TPUFlipSigmas:
+    """SIGMAS → SIGMAS reversed — the host's FlipSigmas (unsampling graphs);
+    a leading zero is bumped to a tiny value so samplers never divide by a
+    zero starting sigma."""
+
+    DESCRIPTION = "Reverse a sigma ladder (unsampling)."
+    RETURN_TYPES = ("SIGMAS",)
+    RETURN_NAMES = ("sigmas",)
+    FUNCTION = "flip"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"sigmas": ("SIGMAS", {})}}
+
+    def flip(self, sigmas):
+        import jax.numpy as jnp
+
+        flipped = jnp.flip(sigmas, axis=0)
+        # Host-faithful: ONLY an exact-zero start is bumped (a small nonzero
+        # start from a truncated ladder is preserved).
+        return (flipped.at[0].set(
+            jnp.where(flipped[0] == 0.0, 1e-4, flipped[0])
+        ),)
+
+
 class TPUSamplerCustomAdvanced:
     """(NOISE, GUIDER, SAMPLER, SIGMAS, LATENT) → (LATENT, LATENT) — the
     host's SamplerCustomAdvanced: the custom-sampling execution node that
@@ -1508,11 +1576,18 @@ class TPUSamplerCustomAdvanced:
         positive, negative = guider["positive"], guider.get("negative")
         cfg = guider.get("cfg", 1.0)
         shape = latent_image["samples"].shape
-        rng = jax.random.key(noise["seed"])
-        noise_arr = jax.random.normal(rng, shape, jnp.float32)
+        seed = noise["seed"]
+        rng = jax.random.key(0 if seed is None else seed)
+        # DisableNoise (seed None) wires zeros: noise_scaling then keeps the
+        # latent as the base — the split-sigma continuation contract.
+        noise_arr = (
+            jnp.zeros(shape, jnp.float32) if seed is None
+            else jax.random.normal(rng, shape, jnp.float32)
+        )
         model_cfg, context, pooled, uncond_context, uncond_kwargs = (
             _prepare_sampling_inputs(model, positive, negative, latent_image)
         )
+        prediction = getattr(model_cfg, "prediction", "eps")
         out = run_sampler(
             model, noise_arr, context,
             sampler=sampler["sampler"],
@@ -1523,12 +1598,28 @@ class TPUSamplerCustomAdvanced:
             uncond_kwargs=uncond_kwargs,
             rng=rng,
             guidance=positive.get("guidance"),
-            prediction=getattr(model_cfg, "prediction", "eps"),
+            prediction=prediction,
             init_latent=latent_image["samples"],
             latent_mask=latent_image.get("noise_mask"),
             compile_loop=compile_loop,
             **({} if pooled is None else {"y": pooled}),
         )
+        # Host inverse_noise_scaling: a PARTIAL flow run (split sigmas, final
+        # σ > 0) stores its output un-interpolated, so the next stage's
+        # (1−σ)·latent noise_scaling restores the in-flight state exactly;
+        # terminal runs (σ→0) are untouched. eps inverse scaling is identity.
+        s_last = float(sigmas[-1])
+        if prediction == "flow" and s_last > 0:
+            if s_last >= 1.0:
+                # σ_last = 1 means pure noise: 1/(1−σ) is infinite. The host
+                # divides anyway and silently emits inf (its unsampling graphs
+                # hit this); reject loudly instead — documented divergence.
+                raise ValueError(
+                    "flow sigma ladder ends at 1.0 (pure noise): the partial-"
+                    "run inverse noise scaling 1/(1-sigma) is undefined there. "
+                    "Split or flip the ladder so the final sigma is below 1."
+                )
+            out = out / (1.0 - s_last)
         return ({"samples": out}, {"samples": out})
 
 
@@ -1558,6 +1649,9 @@ NODE_CLASS_MAPPINGS = {
     "TPUBasicGuider": TPUBasicGuider,
     "TPUCFGGuider": TPUCFGGuider,
     "TPUSamplerCustomAdvanced": TPUSamplerCustomAdvanced,
+    "TPUDisableNoise": TPUDisableNoise,
+    "TPUSplitSigmas": TPUSplitSigmas,
+    "TPUFlipSigmas": TPUFlipSigmas,
 }
 
 NODE_DISPLAY_NAME_MAPPINGS = {
@@ -1586,4 +1680,7 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPUBasicGuider": "Basic Guider (TPU)",
     "TPUCFGGuider": "CFG Guider (TPU)",
     "TPUSamplerCustomAdvanced": "Sampler Custom Advanced (TPU)",
+    "TPUDisableNoise": "Disable Noise (TPU)",
+    "TPUSplitSigmas": "Split Sigmas (TPU)",
+    "TPUFlipSigmas": "Flip Sigmas (TPU)",
 }

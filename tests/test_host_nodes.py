@@ -272,3 +272,107 @@ class TestCustomSamplingGraph:
         d_full = float(jnp.abs(full["samples"] - init["samples"]).mean())
         d_weak = float(jnp.abs(weak["samples"] - init["samples"]).mean())
         assert d_weak < d_full
+
+
+class TestSplitSigmaStages:
+    """SplitSigmas + DisableNoise: two-stage sampling must reproduce the
+    unsplit run EXACTLY for deterministic samplers — eps via identity
+    noise_scaling continuation, flow via the host's inverse_noise_scaling
+    round-trip on the partial output."""
+
+    def _stages(self, model, pos, latent, sigmas, split_at):
+        from comfyui_parallelanything_tpu.nodes import (
+            TPUBasicGuider,
+            TPUDisableNoise,
+            TPUKSamplerSelect,
+            TPURandomNoise,
+            TPUSamplerCustomAdvanced,
+            TPUSplitSigmas,
+        )
+
+        (guider,) = TPUBasicGuider().get_guider(model, pos)
+        (samp,) = TPUKSamplerSelect().get_sampler("euler")
+        (noise,) = TPURandomNoise().get_noise(5)
+        (no_noise,) = TPUDisableNoise().get_noise()
+        full, _ = TPUSamplerCustomAdvanced().sample(noise, guider, samp,
+                                                    sigmas, latent)
+        high, low = TPUSplitSigmas().split(sigmas, split_at)
+        mid, _ = TPUSamplerCustomAdvanced().sample(noise, guider, samp,
+                                                   high, latent)
+        out, _ = TPUSamplerCustomAdvanced().sample(no_noise, guider, samp,
+                                                   low, mid)
+        return full, out
+
+    def test_eps_two_stage_equals_full(self, graph_parts):
+        from comfyui_parallelanything_tpu.nodes import TPUBasicScheduler
+
+        clip_wire, model, _ = graph_parts
+        (pos,) = TPUTextEncode().encode(clip_wire, "hello")
+        (latent,) = TPUEmptyLatent().generate(width=64, height=64, batch_size=1)
+        (sig,) = TPUBasicScheduler().get_sigmas(model, "normal", 4, 1.0)
+        full, out = self._stages(model, pos, latent, sig, 2)
+        np.testing.assert_allclose(
+            np.asarray(full["samples"]), np.asarray(out["samples"]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_flow_two_stage_equals_full(self):
+        from comfyui_parallelanything_tpu.models import build_flux, flux_dev_config
+        from comfyui_parallelanything_tpu.nodes import TPUBasicScheduler
+
+        cfg = flux_dev_config(depth=1, depth_single_blocks=1, hidden_size=128,
+                              num_heads=1, context_in_dim=32, vec_in_dim=16,
+                              dtype=jnp.float32)
+        model = build_flux(cfg, jax.random.key(0), sample_shape=(1, 8, 8, 16),
+                           txt_len=6)
+        pos = {"context": jax.random.normal(jax.random.key(3), (1, 6, 32)),
+               "pooled": jnp.zeros((1, 16))}
+        latent = {"samples": jnp.zeros((1, 8, 8, 16))}
+        (sig,) = TPUBasicScheduler().get_sigmas(model, "normal", 4, 1.0)
+        full, out = self._stages(model, pos, latent, sig, 2)
+        np.testing.assert_allclose(
+            np.asarray(full["samples"]), np.asarray(out["samples"]),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_flip_sigmas(self):
+        from comfyui_parallelanything_tpu.nodes import TPUFlipSigmas
+
+        sig = jnp.asarray([1.0, 0.5, 0.2, 0.0])
+        (flipped,) = TPUFlipSigmas().flip(sig)
+        f = np.asarray(flipped)
+        assert f[0] == pytest.approx(1e-4)  # zero start bumped
+        np.testing.assert_allclose(f[1:], [0.2, 0.5, 1.0])
+
+    def test_flip_preserves_small_nonzero_start(self):
+        from comfyui_parallelanything_tpu.nodes import TPUFlipSigmas
+
+        sig = jnp.asarray([1.0, 0.5, 5e-5])
+        (flipped,) = TPUFlipSigmas().flip(sig)
+        assert np.asarray(flipped)[0] == pytest.approx(5e-5)
+
+    def test_flow_partial_run_to_sigma_one_rejected(self):
+        # A flow ladder ending AT 1.0 (pure noise) has no inverse noise
+        # scaling; the node rejects instead of emitting inf like the host.
+        from comfyui_parallelanything_tpu.models import build_flux, flux_dev_config
+        from comfyui_parallelanything_tpu.nodes import (
+            TPUBasicGuider,
+            TPUKSamplerSelect,
+            TPURandomNoise,
+            TPUSamplerCustomAdvanced,
+        )
+
+        cfg = flux_dev_config(depth=1, depth_single_blocks=1, hidden_size=128,
+                              num_heads=1, context_in_dim=32, vec_in_dim=16,
+                              dtype=jnp.float32)
+        model = build_flux(cfg, jax.random.key(0), sample_shape=(1, 8, 8, 16),
+                           txt_len=6)
+        pos = {"context": jax.random.normal(jax.random.key(3), (1, 6, 32)),
+               "pooled": jnp.zeros((1, 16))}
+        latent = {"samples": jnp.zeros((1, 8, 8, 16))}
+        (guider,) = TPUBasicGuider().get_guider(model, pos)
+        (samp,) = TPUKSamplerSelect().get_sampler("euler")
+        (noise,) = TPURandomNoise().get_noise(1)
+        bad = jnp.asarray([1.0, 1.0])  # degenerate: ends at pure noise
+        with pytest.raises(ValueError, match="pure noise"):
+            TPUSamplerCustomAdvanced().sample(noise, guider, samp, bad, latent)
