@@ -497,6 +497,30 @@ class TestShippedExampleWorkflow:
         saved = out["save"][0]
         assert len(saved) == 4 and all(os.path.exists(p) for p in saved)
 
+    def test_example_custom_sampling_executes(self, cpu_devices, tmp_path,
+                                              monkeypatch):
+        import os
+
+        paths, factor = self._synthetic_env(tmp_path, monkeypatch)
+        wf = json.load(open("examples/workflow_custom_sampling.json"))
+        wf["checkpoint"]["inputs"]["ckpt_path"] = paths["ckpt"]
+        wf["clip"]["inputs"]["encoder_path"] = paths["clip"]
+        wf["clip"]["inputs"]["tokenizer_json"] = paths["tok"]
+        wf["clip"]["inputs"]["max_len"] = paths["max_len"]
+        wf["dev0"]["inputs"]["device_id"] = "cpu:0"
+        wf["dev1"]["inputs"]["device_id"] = "cpu:1"
+        wf["sigmas"]["inputs"]["steps"] = 2
+        wf["latent"]["inputs"].update(width=32, height=32, batch_size=4)
+        wf["save"]["inputs"]["output_dir"] = str(tmp_path / "out")
+
+        out = run_workflow(wf)
+        images = out["decode"][0]
+        hw = 32 // 8 * factor
+        assert images.shape == (4, hw, hw, 3)
+        assert np.isfinite(np.asarray(images)).all()
+        saved = out["save"][0]
+        assert len(saved) == 4 and all(os.path.exists(p) for p in saved)
+
     def test_example_sd15_img2img_executes(self, cpu_devices, tmp_path, monkeypatch):
         import os
 
