@@ -40,7 +40,6 @@ import json
 import os
 import subprocess
 import sys
-import time
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
@@ -62,12 +61,39 @@ _PEAK_BF16 = [
 _REF_SINGLE_GPU_S_IT = 26.00  # /root/reference/README.md:54-56 (Z_Image batch=21)
 
 
+def _bf16_build(build_fn, cfg, **build_kw):
+    """Build a model with bf16-STORED weights synthesized host-side from
+    abstract shapes — no f32 pytree is ever materialized on any device.
+
+    Two bugs this kills at once: (a) flax ``init`` stores params at the default
+    ``param_dtype`` f32, so the "bf16" rung labels were silently benching f32
+    weight storage (2x the HBM reads on every matmul — the usual TPU
+    bottleneck); (b) the z-image proxy is 5.77B params = 21.5 GiB at f32, an
+    init-time OOM on a 16 GiB v5e chip, while its bf16 inference layout
+    (10.8 GiB) fits. Weights are zeros: matmul/attention timing is
+    value-independent, the same argument as ``_synth_int8_params``."""
+    import jax
+    import jax.numpy as jnp
+
+    sds = jax.eval_shape(
+        lambda key: build_fn(cfg, rng=key, **build_kw).params, jax.random.key(0)
+    )
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        params = jax.tree.map(
+            lambda l: jnp.zeros(l.shape, jnp.bfloat16)
+            if l.dtype == jnp.float32 else jnp.zeros(l.shape, l.dtype),
+            sds,
+        )
+    return build_fn(cfg, params=params, **build_kw)
+
+
 def _rung_sd15_16(jnp, rng):
     from comfyui_parallelanything_tpu.models import build_unet, sd15_config
 
     batch, latent, ctx_len = 16, 128, 77
     cfg = sd15_config(dtype=jnp.bfloat16)
-    model = build_unet(cfg, rng, sample_shape=(1, latent, latent, 4))
+    model = _bf16_build(build_unet, cfg, sample_shape=(1, latent, latent, 4))
     return (model, batch, (batch, latent, latent, 4), ctx_len, cfg.context_dim,
             {}, "SD1.5 UNet bf16 batch=16 1024x1024")
 
@@ -77,7 +103,7 @@ def _rung_sdxl_8(jnp, rng):
 
     batch, latent, ctx_len = 8, 128, 77
     cfg = sdxl_config(dtype=jnp.bfloat16)
-    model = build_unet(cfg, rng, sample_shape=(1, latent, latent, 4))
+    model = _bf16_build(build_unet, cfg, sample_shape=(1, latent, latent, 4))
     kwargs = {"y": jnp.zeros((batch, cfg.adm_in_channels), jnp.float32)}
     return (model, batch, (batch, latent, latent, 4), ctx_len, cfg.context_dim,
             kwargs, "SDXL UNet bf16 batch=8 1024x1024")
@@ -88,10 +114,15 @@ def _rung_zimage_21(jnp, rng):
 
     batch, latent, ctx_len = 21, 128, 128
     cfg = z_image_turbo_config(dtype=jnp.bfloat16)
-    model = build_flux(cfg, rng, sample_shape=(1, 16, 16, 16), txt_len=ctx_len)
+    model = _bf16_build(
+        build_flux, cfg, sample_shape=(1, 16, 16, 16), txt_len=ctx_len
+    )
+    # 3 sequential microbatches of 7: 10.8 GiB bf16 weights + full-batch-21
+    # activations OOM'd a 16 GiB v5e (evidence: zimage_21 fallback_stderr in
+    # BASELINE_measured.json); 21 images per iteration either way.
     return (model, batch, (batch, latent, latent, 16), ctx_len, cfg.context_in_dim,
-            {}, "Z_Image-scale MMDiT bf16 batch=21 1024x1024 "
-                "(flux-class proxy; README repro shape)")
+            {}, "Z_Image-scale MMDiT bf16 batch=21 (3x7 microbatch) 1024x1024 "
+                "(flux-class proxy; README repro shape)", 3)
 
 
 def _rung_flux_16(jnp, rng):
@@ -101,7 +132,9 @@ def _rung_flux_16(jnp, rng):
     # Dev topology (double+single blocks, guidance embed, 24 heads x 128) at
     # depth that fits one v5e chip; full 19/38-depth dev runs FSDP multi-chip.
     cfg = flux_dev_config(depth=4, depth_single_blocks=8, dtype=jnp.bfloat16)
-    model = build_flux(cfg, rng, sample_shape=(1, 32, 32, 16), txt_len=ctx_len)
+    model = _bf16_build(
+        build_flux, cfg, sample_shape=(1, 32, 32, 16), txt_len=ctx_len
+    )
     kwargs = {
         "y": jnp.zeros((batch, cfg.vec_in_dim), jnp.float32),
         "guidance": jnp.full((batch,), 3.5, jnp.float32),
@@ -175,9 +208,14 @@ def _rung_flux_16_int8(jnp, rng):
         "y": jnp.zeros((batch, cfg.vec_in_dim), jnp.float32),
         "guidance": jnp.full((batch,), 3.5, jnp.float32),
     }
+    # 4 sequential microbatches of 4: ~12 GiB int8 weights + dequant temps +
+    # full-batch-16 activations OOM'd the 16 GiB chip (evidence: flux_16_int8
+    # fallback_stderr in BASELINE_measured.json); 16 images per iteration
+    # either way, and 4x4608 token-rows per matmul still fills the MXU.
     return (model, batch, (batch, latent, latent, 16), ctx_len, cfg.context_in_dim,
             kwargs, "FLUX-dev MMDiT FULL depth 19/38, int8 weights, batch=16 "
-                    "1024x1024 (measured full depth, single chip)")
+                    "(4x4 microbatch) 1024x1024 (measured full depth, single chip)",
+            4)
 
 
 def _rung_wan_video(jnp, rng):
@@ -186,8 +224,8 @@ def _rung_wan_video(jnp, rng):
     batch, ctx_len = 1, 128
     cfg = wan_1_3b_config(depth=8, dtype=jnp.bfloat16)
     frames, lat_h, lat_w = 16, 30, 52  # ~480p latent video, 16 frames
-    model = build_wan(
-        cfg, rng, sample_shape=(1, frames, lat_h, lat_w, cfg.in_channels),
+    model = _bf16_build(
+        build_wan, cfg, sample_shape=(1, frames, lat_h, lat_w, cfg.in_channels),
         txt_len=ctx_len,
     )
     return (model, batch, (batch, frames, lat_h, lat_w, cfg.in_channels), ctx_len,
@@ -245,13 +283,26 @@ def _cost_flops(lowered):
 
 def _flops_per_step(model, x, t, ctx, kwargs):
     """Analytic model FLOPs for one denoise step via XLA HLO cost analysis of the
-    lowered (uncompiled) forward. Returns None when the backend can't estimate."""
+    lowered (uncompiled) forward. Always lowers for CPU: the axon tunnel's PJRT
+    client doesn't implement cost analysis (observed: sd15_16 banked with
+    model_flops_per_step null → mfu null) and dot/conv FLOP counts are
+    backend-independent anyway, so one CPU lowering serves every platform.
+    Abstract args only — ShapeDtypeStructs are uncommitted, so default_device
+    controls the lowering target and no TPU buffer is touched."""
     import jax
 
     try:
-        return _cost_flops(
-            jax.jit(model.apply).lower(model.params, x, t, ctx, **kwargs)
+        abstract = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
+            (model.params, x, t, ctx, kwargs),
         )
+        with jax.default_device(jax.devices("cpu")[0]):
+            return _cost_flops(
+                jax.jit(model.apply).lower(
+                    abstract[0], abstract[1], abstract[2], abstract[3],
+                    **abstract[4],
+                )
+            )
     except Exception:
         return None
 
@@ -299,6 +350,34 @@ def _peak_bf16(device_kind):
     return None
 
 
+def _make_step(pm, batch, n_chunks, t, ctx, kwargs):
+    """One denoise-step callable mapping latents -> latents (the shape
+    ``chained_time`` chains). ``n_chunks > 1`` runs the batch as that many
+    sequential microbatches and concatenates — identical images-per-iteration,
+    activation peak divided by ``n_chunks`` (how a 16 GiB chip runs a batch
+    sized for the reference's 24 GiB GPU). ``batch`` must divide evenly."""
+    import jax.numpy as jnp
+
+    if n_chunks == 1:
+        return lambda v: pm(v, t, ctx, **kwargs)
+    if batch % n_chunks:
+        raise ValueError(f"batch {batch} not divisible by n_chunks {n_chunks}")
+
+    def _slice_batch(a, sl):
+        return a[sl] if hasattr(a, "shape") and a.shape[:1] == (batch,) else a
+
+    def step(v):
+        size = batch // n_chunks
+        outs = []
+        for i in range(n_chunks):
+            sl = slice(i * size, (i + 1) * size)
+            kw = {k: _slice_batch(a, sl) for k, a in kwargs.items()}
+            outs.append(pm(v[sl], t[sl], ctx[sl], **kw))
+        return jnp.concatenate(outs, axis=0)
+
+    return step
+
+
 def run_inner() -> None:
     import jax
     import jax.numpy as jnp
@@ -321,7 +400,16 @@ def run_inner() -> None:
         "BENCH_CONFIG", "sd15_16" if is_tpu else "smoke"
     )
 
-    model, batch, x_shape, ctx_len, ctx_dim, kwargs, workload = _build(config_name)
+    built = _build(config_name)
+    model, batch, x_shape, ctx_len, ctx_dim, kwargs, workload = built[:7]
+    # Optional 8th element: sequential microbatch count. The big single-chip
+    # rungs OOM at full batch (bf16 weights 10.8-12 GiB + the fused
+    # single-block projection's (B, 4224, 21504) activation on a 16 GiB v5e);
+    # splitting the batch into N sequential chunks divides the activation peak
+    # by N while keeping the workload identical — the same B images per
+    # iteration, exactly how a 16 GiB chip should run a batch sized for the
+    # reference's 24 GiB RTX 3090.
+    n_chunks = built[7] if len(built) > 7 else 1
 
     chain = DeviceChain.even([f"{platform}:{d.id}" for d in jax.devices()])
     pm = parallelize(model, chain)
@@ -331,15 +419,16 @@ def run_inner() -> None:
     t = jnp.linspace(999.0, 1.0, batch)
     ctx = jax.random.normal(kc, (batch, ctx_len, ctx_dim), jnp.float32)
 
-    # Warmup/compile, then timed denoise-step iterations.
-    out = pm(x, t, ctx, **kwargs)
-    jax.block_until_ready(out)
+    step = _make_step(pm, batch, n_chunks, t, ctx, kwargs)
+
+    # Warmup/compile + timed denoise-step iterations, tunnel-proof: the axon
+    # plugin's block_until_ready returned in 2.8 ms for a 43-TFLOP step (~80x
+    # the chip's peak), so chained_time chains each iteration's output into
+    # the next input and closes with a host readback (utils/metrics.py).
+    from comfyui_parallelanything_tpu.utils.metrics import chained_time
+
     iters = 10 if is_tpu else 2  # CPU runs are smoke-only
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = pm(x, t, ctx, **kwargs)
-    jax.block_until_ready(out)
-    sec_it = (time.perf_counter() - t0) / iters
+    sec_it, _ = chained_time(step, x, iters)
 
     # MFU: analytic step FLOPs / time / aggregate peak. TPU only (CPU peak is
     # not meaningful for MXU utilization).
@@ -370,6 +459,7 @@ def run_inner() -> None:
         "mfu": mfu,
         "model_flops_per_step": flops,
         "workload": f"{workload} ({platform} x{n_dev})",
+        "microbatch_chunks": n_chunks,
         "images_per_sec": round(batch / sec_it, 3),
         # Which attention path(s) actually served the run, resolved at trace
         # time ("pallas", "xla", or "pallas+xla" when different shapes picked

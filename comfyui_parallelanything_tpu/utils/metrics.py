@@ -87,6 +87,40 @@ class StepTimer:
         )
 
 
+def force_ready(v) -> float:
+    """Force execution of ``v``'s whole dependency chain via a 4-byte
+    device->host readback of a reduced scalar. Unlike ``block_until_ready``
+    (which the experimental axon tunnel plugin has returned from without
+    waiting — observed "timings" ~80x above chip peak), possessing the bytes
+    on the host proves the computation actually finished."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    return float(np.asarray(jnp.sum(v.astype(jnp.float32))))
+
+
+def chained_time(step, x0, iters: int):
+    """Tunnel-proof mean seconds per ``step`` call.
+
+    ``step`` must map an array to a like-shaped array (denoise models and
+    attention both do). Each iteration feeds its output back as the next
+    input, making the timed region one serial dependency chain — no runtime
+    can skip, dedupe, or overlap it — and it closes with a ``force_ready``
+    readback. Two warmup calls run first so both the original and the
+    chained dtype signatures are compiled outside the timed region.
+
+    Returns ``(sec_per_iter, last_output)``."""
+    out = step(x0)
+    out = step(out)
+    force_ready(out)
+    run = out
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run = step(run)
+    force_ready(run)
+    return (time.perf_counter() - t0) / iters, run
+
+
 @contextlib.contextmanager
 def trace(log_dir: str = "/tmp/parallelanything-trace"):
     """Profile a region → Perfetto/XProf trace in ``log_dir`` (SURVEY §5.1 plan)."""

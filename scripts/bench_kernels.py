@@ -13,16 +13,15 @@ Run on a live TPU (the tunnel comes and goes — probe first):
 Shapes cover the rungs that matter: FLUX joint attention at 1024² (4.6k tokens,
 24 heads × 128) and WAN-video lengths (16k/32k tokens) where the streamed-K/V
 layout is what keeps VMEM bounded. The sweep tries block_q × block_k over
-{128, 256, 512}² per shape; each cell is the median of 5 timed calls after a
-compile+warmup call. Appends JSON lines to KERNEL_BENCH.json; BASELINE.md's
-kernel section reads from there.
+{128, 256, 512}² per shape; each cell is the mean of 5 chained timed calls
+after compile+warmup (see ``_time_fn`` for why chained). Appends JSON lines to
+KERNEL_BENCH.json; BASELINE.md's kernel section reads from there.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import statistics
 import sys
 import time
 
@@ -39,16 +38,14 @@ SHAPES = [
 
 
 def _time_fn(fn, *args, iters=5):
-    import jax
+    """Tunnel-proof mean time per call (attention maps q-shaped to q-shaped,
+    so the output chains back as the first argument; see
+    utils/metrics.chained_time for why per-call block_until_ready is
+    untrustworthy through the axon tunnel)."""
+    from comfyui_parallelanything_tpu.utils.metrics import chained_time
 
-    out = fn(*args)
-    jax.block_until_ready(out)  # compile + warmup
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        times.append(time.perf_counter() - t0)
-    return statistics.median(times)
+    sec, _ = chained_time(lambda a: fn(a, *args[1:]), args[0], iters)
+    return sec
 
 
 def main() -> None:

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import json
 import os
-import statistics
 import sys
 import time
 
@@ -55,18 +54,23 @@ def main() -> None:
         "platform": dev.platform, "device_kind": dev.device_kind,
         "steps": steps, "ts": time.time(),
     }
+    # Tunnel-proof timing: each run feeds its output back as the next run's
+    # noise (see utils/metrics.chained_time for why per-call
+    # block_until_ready is untrustworthy through the axon tunnel). Values may
+    # blow up over chained runs with random weights; TPU arithmetic is
+    # value-independent, so timing is unaffected.
+    from comfyui_parallelanything_tpu.utils.metrics import chained_time
+
+    iters = 3
     for key, flag in (("eager_s", False), ("compiled_s", True)):
-        out = run_sampler(model, noise, ctx, sampler="dpmpp_2m", steps=steps,
-                          compile_loop=flag)
-        jax.block_until_ready(out)  # compile + warmup
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            out = run_sampler(model, noise, ctx, sampler="dpmpp_2m", steps=steps,
-                              compile_loop=flag)
-            jax.block_until_ready(out)
-            times.append(time.perf_counter() - t0)
-        rec[key] = round(statistics.median(times), 4)
+        sec, _ = chained_time(
+            lambda v, _flag=flag: run_sampler(
+                model, v, ctx, sampler="dpmpp_2m", steps=steps,
+                compile_loop=_flag,
+            ).astype(noise.dtype),
+            noise, iters,
+        )
+        rec[key] = round(sec, 4)
     rec["compiled_speedup"] = round(rec["eager_s"] / rec["compiled_s"], 3)
     print(json.dumps(rec))
     with open(os.path.join(_REPO, "SAMPLER_LOOP_BENCH.json"), "a") as f:

@@ -36,7 +36,9 @@ if _REPO not in sys.path:
 
 
 def run_rung(rung: str, timeout: int = 2400) -> dict:
-    from bench import _last_json_line  # the guarded metric-line scan, one impl
+    # The guarded metric-line scan and the platform tuple both live in
+    # bench.py — one implementation, no drift.
+    from bench import _TPU_PLATFORMS, _last_json_line
 
     env = dict(os.environ)
     env["BENCH_CONFIG"] = rung
@@ -51,6 +53,17 @@ def run_rung(rung: str, timeout: int = 2400) -> dict:
     if line is not None:
         rec = json.loads(line)
         rec["rung"] = rung
+        if rec.get("platform") not in _TPU_PLATFORMS and rung != "smoke":
+            # A CPU-fallback line on a TPU-sized rung means the TPU child died
+            # (smoke is CPU by definition) — keep its traceback
+            # (bench.py forwards the inner stderr tail) or the whole window's
+            # diagnosis is lost the moment the fallback line parses. Head+tail
+            # slice: XLA OOMs put the exception line BEFORE a multi-kB
+            # per-buffer dump, so a tail alone keeps only dump noise.
+            err = proc.stderr.strip()
+            rec["fallback_stderr"] = (
+                err if len(err) <= 2400 else err[:1600] + "\n...[snip]...\n" + err[-800:]
+            )
         return rec
     return {"rung": rung, "error": proc.stderr.strip()[-300:]}
 
@@ -66,16 +79,18 @@ def record_result(rec: dict) -> dict:
 
 
 def main() -> None:
+    from bench import _TPU_PLATFORMS
+
     rungs = sys.argv[1:] or list(LADDER)
     results = []
     for rung in rungs:
         rec = record_result(run_rung(rung))
         results.append(rec)
         print(json.dumps(rec))
-        if rec.get("platform") not in ("tpu", "axon") and "error" not in rec:
+        if rec.get("platform") not in _TPU_PLATFORMS and "error" not in rec:
             print(f"# {rung}: fell back to {rec.get('platform')} — tunnel down? "
                   "continuing (later rungs may recover)", file=sys.stderr)
-    tpu_rungs = [r for r in results if r.get("platform") in ("tpu", "axon")]
+    tpu_rungs = [r for r in results if r.get("platform") in _TPU_PLATFORMS]
     print(f"# captured {len(tpu_rungs)}/{len(rungs)} rungs on TPU", file=sys.stderr)
 
 

@@ -41,7 +41,7 @@ def _fmt_ts(ts: float | None) -> str:
 
 def render() -> str:
     recs = [r for r in _lines("BASELINE_measured.json")
-            if r.get("platform") in _TPU]
+            if r.get("platform") in _TPU and not r.get("invalid")]
     # Latest record per rung wins (earlier attempts may predate fixes).
     by_rung: dict[str, dict] = {}
     for r in recs:
@@ -70,22 +70,25 @@ def render() -> str:
 
     # Latest-wins dedup, same as the rung table: the watchdog retries wedged
     # benches, and the artifacts are append-only.
-    kern = list({r.get("seq"): r for r in _lines("KERNEL_BENCH.json")
-                 if r.get("platform") in _TPU}.values())
+    # Keyed on the shape LABEL, not seq — flux_1024_joint and flux_b4 share
+    # seq=4608 and must both render.
+    kern = list({r.get("shape"): r for r in _lines("KERNEL_BENCH.json")
+                 if r.get("platform") in _TPU and not r.get("invalid")}.values())
     if kern:
         out.append("")
         out.append("**Pallas flash kernel vs XLA (measured)** — winners applied "
                    "to `ops/pallas/tuning.json` by `bench_kernels.py --apply`:")
         out.append("")
-        out.append("| seq | best block_q×block_k | pallas ms | xla ms |")
-        out.append("|---|---|---|---|")
+        out.append("| shape | batch | seq | best block_q×block_k | pallas ms | xla ms |")
+        out.append("|---|---|---|---|---|---|")
         for r in kern:
             xla = r.get("xla_ms")
-            out.append(f"| {r.get('seq')} | {r.get('block_q')}×{r.get('block_k')} "
+            out.append(f"| {r.get('shape')} | {r.get('b')} | {r.get('seq')} "
+                       f"| {r.get('block_q')}×{r.get('block_k')} "
                        f"| {r.get('pallas_ms')} | {xla if xla is not None else 'OOM'} |")
 
     samp = list({r.get("workload"): r for r in _lines("SAMPLER_LOOP_BENCH.json")
-                 if r.get("platform") in _TPU}.values())
+                 if r.get("platform") in _TPU and not r.get("invalid")}.values())
     if samp:
         out.append("")
         out.append("**Whole-loop compiled sampler vs eager (measured)**:")

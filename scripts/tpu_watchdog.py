@@ -140,7 +140,7 @@ def _tpu_records(filename: str):
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if rec.get("platform") in _TPU:
+            if rec.get("platform") in _TPU and not rec.get("invalid"):
                 yield rec
 
 
