@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -48,28 +49,18 @@ def _time_fn(fn, *args, iters=5):
     return sec
 
 
-def main() -> None:
+def _run_shapes(shapes, on_tpu, dev):
+    """Measure the given shapes inline, appending one JSON line each to
+    KERNEL_BENCH.json. Returns the per-shape tuning entries."""
     import jax
     import jax.numpy as jnp
 
-    from comfyui_parallelanything_tpu.utils import enable_compilation_cache
-
-    enable_compilation_cache()
-
-    from comfyui_parallelanything_tpu.devices.discovery import is_tpu_device
     from comfyui_parallelanything_tpu.ops.attention import _xla_attention
     from comfyui_parallelanything_tpu.ops.pallas.flash_attention import (
         flash_attention,
     )
 
-    dev = jax.devices()[0]
-    on_tpu = is_tpu_device(dev)
-    if not on_tpu:
-        print("# WARNING: no TPU — interpret-mode pallas numbers are meaningless; "
-              "running tiny-shape smoke only", file=sys.stderr)
-
     out_path = os.path.join(_REPO, "KERNEL_BENCH.json")
-    shapes = SHAPES if on_tpu else [("cpu_smoke", 1, 256, 2, 64)]
     sweep = on_tpu and os.environ.get("KERNEL_SWEEP", "1") != "0"
     blocks = (128, 256, 512)
     entries = []
@@ -122,9 +113,74 @@ def main() -> None:
                 "pallas_ms": rec["pallas_ms"],
                 "xla_ms": rec.get("xla_ms"),
             })
+    return entries
+
+
+def _entries_from_file() -> list[dict]:
+    """Latest TPU-measured tuning entry per shape label from KERNEL_BENCH.json
+    (the children append there; a wedged shape simply has no line)."""
+    by_label: dict[str, dict] = {}
+    path = os.path.join(_REPO, "KERNEL_BENCH.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            for raw in f:
+                try:
+                    r = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                if (r.get("platform") in ("tpu", "axon") and "pallas_ms" in r
+                        and not r.get("invalid")):
+                    by_label[r.get("shape")] = r
+    return [
+        {"seq": r["seq"], "block_q": r.get("block_q", 256),
+         "block_k": r.get("block_k", 256), "pallas_ms": r["pallas_ms"],
+         "xla_ms": r.get("xla_ms")}
+        for r in by_label.values()
+    ]
+
+
+def main() -> None:
+    import jax
+
+    from comfyui_parallelanything_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    from comfyui_parallelanything_tpu.devices.discovery import is_tpu_device
+
+    dev = jax.devices()[0]
+    on_tpu = is_tpu_device(dev)
+
+    if "--shape" in sys.argv:
+        label = sys.argv[sys.argv.index("--shape") + 1]
+        shapes = [sh for sh in SHAPES if sh[0] == label]
+        if not shapes:
+            raise SystemExit(f"unknown shape {label!r}")
+        _run_shapes(shapes, on_tpu, dev)
+        return
+
+    if not on_tpu:
+        print("# WARNING: no TPU — interpret-mode pallas numbers are meaningless; "
+              "running tiny-shape smoke only", file=sys.stderr)
+        _run_shapes([("cpu_smoke", 1, 256, 2, 64)], on_tpu, dev)
+        return
+
+    # Parent mode: one bounded subprocess per shape, so a wedged pallas cell
+    # (round-3 lesson: flux_16 hung 30 min inside one pallas forward through
+    # the tunnel) costs one shape's timeout, not the whole sweep.
+    for label, *_ in SHAPES:
+        try:
+            subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--shape", label],
+                cwd=_REPO, timeout=1800,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"# shape {label} timed out (wedged tunnel?) — skipping",
+                  file=sys.stderr)
 
     if "--apply" in sys.argv:
-        if not (on_tpu and entries):
+        entries = _entries_from_file()
+        if not entries:
             print("# --apply skipped: no TPU measurements", file=sys.stderr)
             return
         from comfyui_parallelanything_tpu.ops.pallas.tuning import write_tuning

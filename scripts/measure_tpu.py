@@ -35,7 +35,10 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 
-def run_rung(rung: str, timeout: int = 2400) -> dict:
+def run_rung(rung: str, timeout: int = 3200) -> dict:
+    # timeout covers bench.py's own worst case: ≤240s TPU probe + 1800s inner
+    # child + 900s CPU fallback; anything tighter kills the honest fallback
+    # line mid-write and records a bare error instead.
     # The guarded metric-line scan and the platform tuple both live in
     # bench.py — one implementation, no drift.
     from bench import _TPU_PLATFORMS, _last_json_line

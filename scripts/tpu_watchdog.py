@@ -75,42 +75,60 @@ def probe(timeout: int = 90) -> bool:
         return False
 
 
-def probe_pallas_hardware(timeout: int = 300) -> None:
-    """Run the fused flash kernel on the real chip before any rung relies on
-    it. The kernel is interpreter-mode tested only (no hardware all round), and
-    the untuned `auto` backend defaults to pallas for lane-aligned shapes — a
-    compile/runtime failure there would burn EVERY tunnel window on the same
-    crash. After two failures on a live tunnel, force the safe XLA path for all
-    child runs via ``PA_TPU_ATTENTION_BACKEND`` (ops/attention.py reads it at
-    import); two, not one, because a wedge-then-recover race can fake one."""
+# (batch, seq, heads) per probe stage — the shapes the remaining rungs
+# actually run through the auto backend (head_dim 128 throughout): a 256-token
+# smoke, FLUX 1024² joint attention, WAN-video length. Round-3 lesson: the
+# 256-token probe passed while the flux_16 rung then hung 30 minutes inside
+# its first pallas forward at 4608 tokens — a probe that doesn't cover the
+# rung shapes defends nothing.
+_PALLAS_PROBE_SHAPES = ((1, 256, 2), (1, 4608, 24), (1, 16384, 12))
+
+
+def probe_pallas_hardware(timeout: int = 600) -> None:
+    """Run the fused flash kernel on the real chip AT THE RUNG SHAPES before
+    any rung relies on it (the untuned `auto` backend picks pallas for
+    lane-aligned shapes — a wedge there burns a whole 1800s bench timeout per
+    attempt). Each shape runs in its own bounded subprocess, cheapest first,
+    stopping at the first failure. After two failures on a live tunnel, force
+    the safe XLA path for all child runs via ``PA_TPU_ATTENTION_BACKEND``
+    (ops/attention.py reads it at import); two, not one, because a
+    wedge-then-recover race can fake one."""
     global _PALLAS_PROBED, _PALLAS_FAILS
     if _PALLAS_PROBED or os.environ.get("PA_TPU_ATTENTION_BACKEND"):
         return
-    code = (
-        "import jax, jax.numpy as jnp\n"
-        "from comfyui_parallelanything_tpu.ops.pallas.flash_attention "
-        "import flash_attention\n"
-        # Guard against the interpreter-mode false positive: a mid-probe flap
-        # can land this child on CPU, where interpret=None would auto-select
-        # interpreter mode and 'pass' without touching hardware.
-        f"assert jax.devices()[0].platform in {_TPU!r}, 'not on TPU'\n"
-        "q = jnp.ones((1, 256, 2, 128), jnp.bfloat16)\n"
-        "out = flash_attention(q, q, q, scale=0.09, block_q=128, block_k=128,\n"
-        "                      interpret=False)\n"
-        "jax.block_until_ready(out)\n"
-        "assert out.shape == q.shape\n"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code], env=dict(os.environ), cwd=_REPO,
-            capture_output=True, text=True, timeout=timeout,
+    ok, tail = True, ""
+    for b, s, h in _PALLAS_PROBE_SHAPES:
+        code = (
+            "import jax, jax.numpy as jnp\n"
+            "from comfyui_parallelanything_tpu.ops.pallas.flash_attention "
+            "import flash_attention\n"
+            "from comfyui_parallelanything_tpu.utils.metrics import force_ready\n"
+            # Guard against the interpreter-mode false positive: a mid-probe
+            # flap can land this child on CPU, where interpret=None would
+            # auto-select interpreter mode and 'pass' without touching
+            # hardware. force_ready, not block_until_ready: the tunnel's
+            # block has returned without waiting (bench.py round-3 evidence).
+            f"assert jax.devices()[0].platform in {_TPU!r}, 'not on TPU'\n"
+            f"q = jnp.ones(({b}, {s}, {h}, 128), jnp.bfloat16)\n"
+            "out = flash_attention(q, q, q, scale=0.09, block_q=256,\n"
+            "                      block_k=256, interpret=False)\n"
+            "force_ready(out)\n"
+            "assert out.shape == q.shape\n"
         )
-        ok = proc.returncode == 0
-        tail = proc.stderr.strip()[-300:]
-    except subprocess.TimeoutExpired:
-        ok, tail = False, f"pallas probe timed out after {timeout}s"
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], env=dict(os.environ), cwd=_REPO,
+                capture_output=True, text=True, timeout=timeout,
+            )
+            ok = proc.returncode == 0
+            tail = f"seq={s}: {proc.stderr.strip()[-300:]}"
+        except subprocess.TimeoutExpired:
+            ok, tail = False, f"pallas probe seq={s} timed out after {timeout}s"
+        if not ok:
+            break
+        _log(f"pallas probe OK at seq={s}")
     if ok:
-        _log("pallas hardware probe OK — fused kernel live on this chip")
+        _log("pallas hardware probe OK at all rung shapes")
         _PALLAS_PROBED = True
     elif probe():
         _PALLAS_FAILS += 1
