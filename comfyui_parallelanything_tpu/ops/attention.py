@@ -169,12 +169,16 @@ def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
     if backend == "auto":
         from .pallas.tuning import pallas_wins
 
-        # The pallas kernel wants lane-aligned head dims and TPU hardware;
-        # within that, the measured tuning table (ops/pallas/tuning.py) decides
-        # whether the fused kernel actually beats XLA at this length.
+        # The kernel itself pads any head dim to 128 lanes (exact; see
+        # flash_attention), so eligibility is just TPU + block-divisible
+        # sequence; the measured tuning table (ops/pallas/tuning.py) decides
+        # whether the fused kernel actually beats the XLA family at this
+        # (length, head-dim class) — non-aligned dims pay a padded FLOP tax
+        # and default to XLA until a measurement proves the kernel wins.
         use_pallas = (
-            _pallas_available() and q.shape[-1] % 128 == 0 and q.shape[1] % 128 == 0
-            and k.shape[1] % 128 == 0 and pallas_wins(q.shape[1])
+            _pallas_available() and q.shape[1] % 128 == 0
+            and k.shape[1] % 128 == 0
+            and pallas_wins(q.shape[1], q.shape[-1])
         )
         backend = "pallas" if use_pallas else "xla"
     if backend == "xla" and logit_elems > _CHUNK_THRESHOLD:
@@ -187,7 +191,7 @@ def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
         from .pallas.flash_attention import flash_attention
         from .pallas.tuning import best_blocks
 
-        block_q, block_k = best_blocks(q.shape[1])
+        block_q, block_k = best_blocks(q.shape[1], q.shape[-1])
         return flash_attention(
             q, k, v, scale=scale, block_q=block_q, block_k=block_k
         )

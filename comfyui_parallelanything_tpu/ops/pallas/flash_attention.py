@@ -108,6 +108,21 @@ def flash_attention(
     if interpret is None:
         interpret = not is_tpu_device(jax.devices()[0])
 
+    # Lane alignment: the MXU wants the head dim in 128-lane multiples. For
+    # the 40/64-dim UNet-family heads, zero-pad D — exact, not approximate:
+    # padded K columns add zero to every q·k logit, and padded V columns
+    # produce zeros that are sliced away below. (Scale was already fixed from
+    # the ORIGINAL head dim above.) Whether the padded FLOP tax beats chunked
+    # XLA at a given shape is a tuning-table question (ops/pallas/tuning.py);
+    # this function just makes any head dim runnable.
+    orig_head_dim = q.shape[-1]
+    lane_pad = (-orig_head_dim) % 128
+    if lane_pad:
+        pad_spec = ((0, 0), (0, 0), (0, 0), (0, lane_pad))
+        q = jnp.pad(q, pad_spec)
+        k = jnp.pad(k, pad_spec)
+        v = jnp.pad(v, pad_spec)
+
     batch, seq_q, heads, head_dim = q.shape
     seq_k = k.shape[1]
 
@@ -148,4 +163,7 @@ def flash_attention(
     )(q3, k3, v3)
 
     out = out[:, :seq_q, :]
-    return out.reshape(batch, heads, seq_q, head_dim).transpose(0, 2, 1, 3)
+    out = out.reshape(batch, heads, seq_q, head_dim).transpose(0, 2, 1, 3)
+    if lane_pad:
+        out = out[..., :orig_head_dim]
+    return out

@@ -27,8 +27,10 @@ _DEFAULT = {
     "device_kind": None,
     "block_q": 256,
     "block_k": 256,
-    # [{"seq": int, "block_q": int, "block_k": int,
+    # [{"seq": int, "head_dim": int|None, "block_q": int, "block_k": int,
     #   "pallas_ms": float, "xla_ms": float|None}, ...]
+    # head_dim tags a measurement to its dim class (non-128-aligned dims run
+    # the kernel zero-padded and must win their own measurements).
     "entries": [],
 }
 
@@ -65,29 +67,63 @@ def _nearest(entries: list, seq: int):
     return min(entries, key=lambda e: abs(int(e.get("seq", 0)) - seq))
 
 
-def best_blocks(seq: int) -> tuple[int, int]:
+def best_blocks(seq: int, head_dim: int | None = None) -> tuple[int, int]:
     """(block_q, block_k) for a sequence length: the measured winner at the
-    nearest benchmarked length, else the defaults."""
+    nearest benchmarked length (preferring measurements of the same head-dim
+    class), else the defaults."""
     t = kernel_tuning()
     entries = [e for e in t["entries"] if e.get("block_q") and e.get("block_k")]
+    if head_dim is not None:
+        same_dim = [e for e in entries if e.get("head_dim") == head_dim]
+        if same_dim:
+            entries = same_dim
+        elif head_dim % 128 == 0:
+            # Aligned dims must not inherit blocks tuned under the padded-FLOP
+            # regime of a different dim class (mirrors pallas_wins).
+            entries = [
+                e for e in entries
+                if e.get("head_dim") is None or e["head_dim"] % 128 == 0
+            ]
     if not entries:
         return int(t["block_q"]), int(t["block_k"])
     e = _nearest(entries, seq)
     return int(e["block_q"]), int(e["block_k"])
 
 
-def pallas_wins(seq: int) -> bool:
+def pallas_wins(seq: int, head_dim: int | None = None) -> bool:
     """Whether the fused kernel beat XLA at the nearest measured length. With
-    no measurement, True — the default guess for lane-aligned shapes (XLA's
-    S×S logits materialization loses at the long lengths this path serves).
-    An entry whose XLA measurement FAILED (``xla_ms`` None — S×S logits OOM at
-    video lengths) counts as a pallas win: that is a length where the fused
-    kernel is mandatory, not absent data."""
+    no measurement, True for lane-aligned head dims — the default guess (XLA's
+    S×S logits materialization loses at the long lengths this path serves) —
+    but False for non-aligned dims (40/64 UNet heads): those run the kernel
+    zero-PADDED to 128 lanes, a 2-3.2× FLOP tax that must *prove* it beats the
+    chunked-XLA path before auto picks it. Entries measured at a specific
+    ``head_dim`` (bench_kernels records it) gate their own dim class; an entry
+    whose XLA measurement FAILED (``xla_ms`` None — S×S logits OOM) counts as
+    a pallas win: that is a length where the fused kernel is mandatory, not
+    absent data."""
     t = kernel_tuning()
     entries = [e for e in t["entries"] if e.get("pallas_ms") is not None]
+    padded_dim = head_dim is not None and head_dim % 128 != 0
+    if head_dim is not None:
+        same_dim = [e for e in entries if e.get("head_dim") == head_dim]
+        if same_dim:
+            entries = same_dim
+        elif padded_dim:
+            return False
+        else:
+            # Aligned dim: generic (dim-less or aligned-dim) entries apply.
+            entries = [
+                e for e in entries
+                if e.get("head_dim") is None or e["head_dim"] % 128 == 0
+            ]
     if not entries:
         return True
     e = _nearest(entries, seq)
+    if padded_dim and not (seq / 2 <= int(e.get("seq", 0)) <= seq * 2):
+        # A padded-dim win extrapolates at most 2x in sequence length: the
+        # padded FLOP tax that wins at 16k against chunked XLA was never
+        # measured against the cheap plain-XLA competitor at short lengths.
+        return False
     if e.get("xla_ms") is None:
         return True
     return float(e["pallas_ms"]) <= float(e["xla_ms"])

@@ -35,6 +35,12 @@ SHAPES = [
     ("flux_b4", 4, 4608, 24, 128),
     ("wan_480p_16f", 1, 16384, 12, 128),
     ("wan_long_32k", 1, 32768, 12, 128),
+    # UNet-family heads: the kernel runs these zero-padded to 128 lanes
+    # (flash_attention pads internally). A measured win here lets the auto
+    # backend route SD-class 1024² attention (the sd15_16 rung's 8.6%-MFU
+    # bottleneck) through the fused kernel; a loss keeps chunked XLA.
+    ("sd15_1024_d40", 16, 16384, 8, 40),
+    ("sdxl_1024_d64", 8, 4096, 10, 64),
 ]
 
 
@@ -55,10 +61,22 @@ def _run_shapes(shapes, on_tpu, dev):
     import jax
     import jax.numpy as jnp
 
-    from comfyui_parallelanything_tpu.ops.attention import _xla_attention
+    from comfyui_parallelanything_tpu.ops.attention import (
+        _CHUNK_THRESHOLD,
+        _xla_attention,
+        _xla_chunked_attention,
+    )
     from comfyui_parallelanything_tpu.ops.pallas.flash_attention import (
         flash_attention,
     )
+
+    def xla_family(a, b_, c, scale):
+        # The real competitor the auto backend would pick: chunked when the
+        # S×S logits would blow HBM, plain otherwise (ops/attention.py).
+        elems = a.shape[0] * a.shape[2] * a.shape[1] * b_.shape[1]
+        if elems > _CHUNK_THRESHOLD:
+            return _xla_chunked_attention(a, b_, c, scale)
+        return _xla_attention(a, b_, c, scale)
 
     out_path = os.path.join(_REPO, "KERNEL_BENCH.json")
     sweep = on_tpu and os.environ.get("KERNEL_SWEEP", "1") != "0"
@@ -95,7 +113,7 @@ def _run_shapes(shapes, on_tpu, dev):
             rec["block_q"], rec["block_k"] = best[1], best[2]
         try:
             rec["xla_ms"] = round(
-                _time_fn(lambda a, b_, c: _xla_attention(a, b_, c, d**-0.5),
+                _time_fn(lambda a, b_, c: xla_family(a, b_, c, d**-0.5),
                          q, k, v) * 1e3, 3
             )
         except Exception as e:  # noqa: BLE001 — S×S logits OOM at video lengths
@@ -108,6 +126,7 @@ def _run_shapes(shapes, on_tpu, dev):
         if on_tpu and "pallas_ms" in rec:
             entries.append({
                 "seq": s,
+                "head_dim": d,
                 "block_q": rec.get("block_q", 256),
                 "block_k": rec.get("block_k", 256),
                 "pallas_ms": rec["pallas_ms"],
@@ -132,7 +151,8 @@ def _entries_from_file() -> list[dict]:
                         and not r.get("invalid")):
                     by_label[r.get("shape")] = r
     return [
-        {"seq": r["seq"], "block_q": r.get("block_q", 256),
+        {"seq": r["seq"], "head_dim": r.get("head_dim"),
+         "block_q": r.get("block_q", 256),
          "block_k": r.get("block_k", 256), "pallas_ms": r["pallas_ms"],
          "xla_ms": r.get("xla_ms")}
         for r in by_label.values()

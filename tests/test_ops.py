@@ -243,6 +243,59 @@ class TestKernelTuning:
         finally:
             tuning.kernel_tuning.cache_clear()
 
+    def test_padded_head_dim_gate(self, monkeypatch):
+        # Non-128-aligned head dims (40/64 UNet heads) run the kernel
+        # zero-padded — a FLOP tax that must PROVE itself: without a measured
+        # entry for that dim class auto says no; with a measured win it says
+        # yes; aligned dims keep the default-True guess.
+        from comfyui_parallelanything_tpu.ops.pallas import tuning
+
+        monkeypatch.setattr(
+            tuning, "kernel_tuning", lambda: {**tuning._DEFAULT, "entries": []}
+        )
+        assert tuning.pallas_wins(16384, 128) is True   # aligned: default guess
+        assert tuning.pallas_wins(16384, 40) is False   # padded: needs proof
+
+        table = self._table([
+            {"seq": 16384, "head_dim": 40, "block_q": 512, "block_k": 256,
+             "pallas_ms": 100.0, "xla_ms": 180.0},      # padded kernel wins
+            {"seq": 4096, "head_dim": 64, "block_q": 256, "block_k": 256,
+             "pallas_ms": 9.0, "xla_ms": 4.0},          # padded kernel loses
+            {"seq": 4608, "block_q": 256, "block_k": 256,
+             "pallas_ms": 1.0, "xla_ms": 2.0},          # aligned (no dim tag)
+        ])
+        monkeypatch.setattr(
+            tuning, "kernel_tuning", lambda: {**tuning._DEFAULT, **table}
+        )
+        assert tuning.pallas_wins(16384, 40) is True
+        assert tuning.pallas_wins(4096, 64) is False
+        # Aligned queries must not be judged by padded-dim entries.
+        assert tuning.pallas_wins(4608, 128) is True
+        # Same-dim measurements drive block choice for that class.
+        assert tuning.best_blocks(16384, 40) == (512, 256)
+        assert tuning.best_blocks(4608, 128) == (256, 256)
+        # A padded-dim win extrapolates at most 2x in seq: the 16k dim-40 win
+        # must NOT route a 256-token dim-40 attention (never measured against
+        # the cheap plain-XLA competitor there) through the padded kernel.
+        assert tuning.pallas_wins(256, 40) is False
+        assert tuning.pallas_wins(8192, 40) is True  # within 2x of 16384
+
+    def test_aligned_blocks_ignore_padded_dim_entries(self, monkeypatch):
+        # A partial sweep can leave ONLY padded-dim entries (per-shape
+        # subprocess timeouts); aligned dims must then fall back to defaults,
+        # not adopt blocks tuned under the padded-FLOP regime.
+        from comfyui_parallelanything_tpu.ops.pallas import tuning
+
+        table = self._table([
+            {"seq": 16384, "head_dim": 40, "block_q": 512, "block_k": 512,
+             "pallas_ms": 100.0, "xla_ms": 180.0},
+        ])
+        monkeypatch.setattr(
+            tuning, "kernel_tuning", lambda: {**tuning._DEFAULT, **table}
+        )
+        assert tuning.best_blocks(4608, 128) == (256, 256)  # defaults
+        assert tuning.pallas_wins(4608, 128) is True        # default guess
+
     def test_auto_backend_respects_measured_loss(self, monkeypatch):
         # Auto mode must fall back to XLA for lengths where measurement says
         # the fused kernel loses — even on TPU with aligned shapes.
@@ -290,6 +343,19 @@ class TestFlashAttention:
         q, k, v = _qkv(b=2, sq=32, sk=77, h=4, d=16)
         got = flash_attention(q, k, v, interpret=True)
         assert got.shape == (2, 32, 4, 16)
+
+    def test_lane_padding_exact_at_unet_head_dim(self):
+        # 40-dim SD1.5 heads run the kernel zero-padded to 128 lanes; padding
+        # is EXACT (padded K dims add zero to every logit, padded V columns
+        # emit discarded zeros), so the result must match plain attention at
+        # the original dim — the property that makes padded routing safe.
+        q, k, v = _qkv(b=2, sq=128, sk=128, h=2, d=40)
+        got = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+        assert got.shape == (2, 128, 2, 40)
+        want = _xla_attention(q, k, v, scale=40**-0.5)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
 
     def test_long_sequence_many_k_blocks(self):
         # Video-length regime (scaled for interpreter mode): the k-block grid
