@@ -184,6 +184,36 @@ def sampler_banked() -> bool:
     return any(_tpu_records("SAMPLER_LOOP_BENCH.json"))
 
 
+# Rungs whose banked number may improve once the kernel sweep's measured
+# tuning table lands: their attention runs chunked XLA until a measured
+# padded-kernel win flips the auto backend (ops/pallas/tuning.py pallas_wins
+# head-dim gating). After --apply they get ONE re-run; latest record wins the
+# rendered table.
+_RETUNE_RUNGS = ("sd15_16", "sdxl_8")
+
+
+def stale_after_tuning() -> list[str]:
+    """Rungs banked BEFORE the measured tuning table was written."""
+    if not kernels_banked():
+        return []
+    path = os.path.join(
+        _REPO, "comfyui_parallelanything_tpu", "ops", "pallas", "tuning.json"
+    )
+    try:
+        table_ts = os.path.getmtime(path)
+    except OSError:
+        return []
+    stale = []
+    for rung in _RETUNE_RUNGS:
+        key = f"retune:{rung}"
+        recs = [r for r in _tpu_records("BASELINE_measured.json")
+                if r.get("rung") == rung]
+        if (recs and max(float(r.get("ts", 0)) for r in recs) < table_ts
+                and _FAILS.get(key, 0) < _MAX_FAILS):
+            stale.append(rung)
+    return stale
+
+
 def _log(msg: str) -> None:
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
@@ -232,6 +262,17 @@ def bank_one() -> bool:
             _strike(label, f"{label} bench")
         _log(f"{label} bench done, banked={ok}")
         return True
+    for rung in stale_after_tuning():
+        _log(f"re-running rung {rung} under the measured tuning table")
+        rec = record_result(run_rung(rung))
+        ok = rec.get("platform") in _TPU
+        if ok:
+            _run_script("render_measured.py", timeout=120)
+        else:
+            _strike(f"retune:{rung}", f"retune {rung}")
+        _log(f"retune {rung}: platform={rec.get('platform')} "
+             f"value={rec.get('value')} banked={ok}")
+        return True
     return False
 
 
@@ -262,7 +303,8 @@ def main() -> None:
         done = banked_rungs()
         missing = [r for r in RUNGS if r not in done and _attemptable(r)]
         if (not missing and (kernels_banked() or capped("kernels"))
-                and (sampler_banked() or capped("sampler"))):
+                and (sampler_banked() or capped("sampler"))
+                and not stale_after_tuning()):
             _log("all attemptable TPU evidence banked — exiting")
             return
         if probe():
