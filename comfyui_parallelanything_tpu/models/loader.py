@@ -60,6 +60,41 @@ def _resolve_state_dict(src: Any) -> dict[str, Any]:
     raise TypeError(f"expected a path or state dict, got {type(src).__name__}")
 
 
+def peek_safetensors(path: str | os.PathLike) -> dict[str, Any]:
+    """Key → shape-only stub for every tensor in a .safetensors file, WITHOUT
+    reading tensor data (header metadata only). Enough for
+    ``sniff_model_family``; a multi-GB checkpoint costs one header read."""
+    import types
+
+    from safetensors import safe_open
+
+    with safe_open(os.fspath(path), framework="numpy") as f:
+        return {
+            k: types.SimpleNamespace(shape=tuple(f.get_slice(k).get_shape()))
+            for k in f.keys()
+        }
+
+
+def load_safetensors_subset(
+    path: str | os.PathLike, *prefixes: str
+) -> dict[str, np.ndarray]:
+    """Read only the keys under the given prefixes (e.g. the bundled
+    ``cond_stage_model.`` text tower) — the rest of the file is never
+    materialized."""
+    from safetensors import safe_open
+
+    out: dict[str, np.ndarray] = {}
+    with safe_open(os.fspath(path), framework="numpy") as f:
+        for key in f.keys():
+            if any(key.startswith(p) for p in prefixes):
+                t = f.get_tensor(key)
+                out[key] = (
+                    np.asarray(t, dtype=np.float32)
+                    if t.dtype != np.float32 else t
+                )
+    return out
+
+
 def _maybe_bake(sd: dict, lora: Any, strength: float) -> dict:
     if lora is None:
         return sd
@@ -92,6 +127,59 @@ def load_sd_unet_checkpoint(
     sd = strip_prefix(_resolve_state_dict(src))
     sd = _maybe_bake(sd, lora, lora_strength)
     return build_unet(cfg, name=name, params=convert_sd_unet_checkpoint(sd, cfg))
+
+
+def sniff_model_family(state_dict: Mapping[str, Any]) -> str:
+    """Model family id (nodes._MODEL_FAMILIES vocabulary) from checkpoint key
+    signatures — the stock ``CheckpointLoaderSimple`` has no family widget, so
+    the compat shim (nodes_compat.py) sniffs it off the file the way the host
+    loader the reference defers to does. Keys may be bare or under the full
+    checkpoint's ``model.diffusion_model.`` prefix."""
+    pfx = "model.diffusion_model."
+    names = {k[len(pfx):] if k.startswith(pfx) else k: k for k in state_dict}
+
+    def has(prefix: str) -> bool:
+        return any(n.startswith(prefix) for n in names)
+
+    def dim(name: str, axis: int) -> int | None:
+        key = names.get(name)
+        if key is None:
+            return None
+        shape = getattr(state_dict[key], "shape", None)
+        return None if shape is None else int(shape[axis])
+
+    if has("double_blocks."):
+        if has("guidance_in."):
+            return "flux-dev"
+        depth = 1 + max(
+            int(n.split(".")[1]) for n in names if n.startswith("double_blocks.")
+        )
+        # No guidance embed: schnell runs the full 19-double-block stack; the
+        # z-image proxy (flux.py z_image_turbo_config, depth 6/26) is the
+        # shallow single-stream-dominant point of the family.
+        return "flux-schnell" if depth >= 12 else "zimage-turbo"
+    if has("joint_blocks."):
+        if any(".x_block.attn2." in n for n in names):
+            return "sd35-medium"  # dual-attention mmdit-x
+        depth = 1 + max(
+            int(n.split(".")[1]) for n in names if n.startswith("joint_blocks.")
+        )
+        return "sd35-large" if depth >= 38 else "sd3-medium"
+    if has("blocks.0.self_attn.") or has("blocks.0.cross_attn."):
+        width = dim("blocks.0.self_attn.q.weight", 0)
+        return "wan-14b" if width is not None and width >= 5120 else "wan-1.3b"
+    if has("input_blocks."):
+        if has("label_emb."):
+            return "sdxl"
+        ctx = dim("input_blocks.1.1.transformer_blocks.0.attn2.to_k.weight", 1)
+        # 768 = CLIP-L (SD1.x); 1024 = OpenCLIP-H (SD2.x). eps-vs-v prediction
+        # is not recorded in weights, so SD2.x defaults to the eps preset —
+        # pass family explicitly (TPUCheckpointLoader) for v-prediction models.
+        return "sd21" if ctx == 1024 else "sd15"
+    raise ValueError(
+        "cannot sniff model family: no known diffusion-model key signature "
+        "(double_blocks/joint_blocks/self_attn/input_blocks) in checkpoint"
+    )
 
 
 def sniff_vae_config(state_dict: Mapping[str, Any]):

@@ -576,6 +576,10 @@ class TPUTextEncode:
     def encode(self, clip, text: str, clip_skip: int = 0):
         import jax.numpy as jnp
 
+        if clip_skip == 0:
+            # CLIPSetLastLayer shim tags the wire (nodes_compat.py); an
+            # explicit widget value wins over the tag.
+            clip_skip = int(clip.get("clip_skip", 0))
         if clip_skip in (-1, -2):
             # Host CLIPSetLastLayer convention (stop_at_clip_layer).
             clip_skip = -clip_skip
@@ -584,7 +588,39 @@ class TPUTextEncode:
                 f"clip_skip must be 0 (model default), 1/-1 (final layer) or "
                 f"2/-2 (penultimate); got {clip_skip}"
             )
+        ctype = clip.get("type")
+        if ctype == "sdxl-dual":
+            # Bundled SDXL towers (CheckpointLoaderSimple shim): encode both,
+            # assemble the (2048-d context, 2816-d pooled) pair exactly like
+            # TPUConditioningCombine(mode='sdxl') with stock 1024² size tags.
+            from .models.text_encoders import sdxl_text_conditioning
+
+            (cl,) = self.encode(clip["l"], text, clip_skip)
+            (cg,) = self.encode(clip["g"], text, clip_skip)
+            # Default (0) = penultimate, SDXL's training-time convention; an
+            # explicit clip_skip selects per-tower streams via each tower's
+            # own skip-resolved "context" (1 = final layer, 2 = penultimate).
+            str_l = cl["penultimate"] if clip_skip == 0 else cl["context"]
+            str_g = cg["penultimate"] if clip_skip == 0 else cg["context"]
+            context, y = sdxl_text_conditioning(
+                str_l, str_g, cg["pooled"], width=1024, height=1024,
+            )
+            return ({"context": context, "penultimate": None, "pooled": y},)
+        if ctype == "flux-dual":
+            # Stock DualCLIPLoader(type=flux): T5 context + CLIP-L pooled —
+            # TPUConditioningCombine(mode='flux') semantics in one encode.
+            (ct5,) = self.encode(clip["t5"], text, clip_skip)
+            (cl,) = self.encode(clip["l"], text, clip_skip)
+            return (
+                {"context": ct5["context"], "penultimate": None,
+                 "pooled": cl["pooled"]},
+            )
         enc, tok = clip["encoder"], clip["tokenizer"]
+        if enc is None or tok is None:
+            raise ValueError(
+                clip.get("tokenizer_error")
+                or "CLIP wire has no encoder/tokenizer"
+            )
         ids, mask = tok([text])
         if clip["type"] == "t5":
             context = enc(jnp.asarray(ids, jnp.int32), mask=jnp.asarray(mask))
@@ -1684,3 +1720,10 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPUSplitSigmas": "Split Sigmas (TPU)",
     "TPUFlipSigmas": "Flip Sigmas (TPU)",
 }
+
+# Stock-ComfyUI class-name shims (CheckpointLoaderSimple, CLIPTextEncode,
+# KSampler, …) so exported API-format workflows resolve unchanged — see
+# nodes_compat.py. setdefault-merged: native names always win.
+from . import nodes_compat as _compat  # noqa: E402  (needs the classes above)
+
+_compat.register(NODE_CLASS_MAPPINGS, NODE_DISPLAY_NAME_MAPPINGS)

@@ -1,0 +1,480 @@
+"""Stock-ComfyUI node-name compatibility shims.
+
+Workflows exported from a stock ComfyUI install reference the builtin node
+class names — ``CheckpointLoaderSimple``, ``CLIPTextEncode``, ``KSampler``,
+``VAEDecode``, … — not this package's ``TPU*`` names. The reference node pack
+runs *inside* ComfyUI and gets those builtins for free
+(any_device_parallel.py:1473-1483 registers only its own nodes); this package
+hosts the graph itself (host.py), so builtin-name coverage is part of the
+parity surface: with these shims an exported API-format workflow runs
+unchanged.
+
+Each shim is a thin adapter over the corresponding ``TPU*`` node: it renames
+stock input keys (``latent_image``→``latent``, ``samples``→``latent``,
+``pixels``→``image``), resolves bare file names against the ComfyUI directory
+layout (``$PA_MODELS_DIR/checkpoints`` etc.), and sniffs what stock nodes
+leave implicit (the model family, via ``models.loader.sniff_model_family``).
+Custom-sampling nodes (RandomNoise, BasicScheduler, SamplerCustomAdvanced, …)
+were already built with stock-matching input names and alias directly.
+
+File resolution env vars (the stand-ins for ComfyUI's folder_paths):
+
+- ``PA_MODELS_DIR``  (default ``models``): ``checkpoints/``, ``clip/``,
+  ``vae/``, ``loras/`` subdirs are searched, then the dir itself, then the
+  bare name as a path.
+- ``PA_INPUT_DIR``   (default ``input``): ``LoadImage`` names.
+- ``PA_TOKENIZER_JSON`` / ``PA_CLIP_VOCAB`` + ``PA_CLIP_MERGES``: tokenizer
+  tables for CLIP towers extracted from bundled checkpoints (checkpoints
+  carry encoder weights but never tokenizer data).
+- ``PA_T5_TOKENIZER_JSON``: tokenizer for the T5/UMT5 tower
+  (``DualCLIPLoader``).
+"""
+
+from __future__ import annotations
+
+import os
+
+CATEGORY = "TPU-ParallelAnything/compat"
+
+
+def _models_dir() -> str:
+    return os.environ.get("PA_MODELS_DIR", "models")
+
+
+def resolve_model_file(name: str, *subdirs: str) -> str:
+    """A stock widget's bare file name → an existing path, searched through
+    the ComfyUI folder layout; falls back to the name itself (absolute paths
+    and cwd-relative paths keep working)."""
+    root = _models_dir()
+    for sub in subdirs:
+        cand = os.path.join(root, sub, name)
+        if os.path.exists(cand):
+            return cand
+    cand = os.path.join(root, name)
+    if os.path.exists(cand):
+        return cand
+    return name
+
+
+def _clip_tokenizer(max_len: int = 77, pad_id: int | None = None):
+    """CLIP BPE tokenizer from env-configured tables, or None (checkpoints
+    bundle encoder weights but never tokenizer data — the error surfaces at
+    encode time with instructions, not at load time)."""
+    tok_json = os.environ.get("PA_TOKENIZER_JSON", "")
+    vocab = os.environ.get("PA_CLIP_VOCAB", "")
+    merges = os.environ.get("PA_CLIP_MERGES", "")
+    from .utils.tokenizer import CLIPBPETokenizer, load_tokenizer_json
+
+    if tok_json:
+        return load_tokenizer_json(tok_json, max_len=max_len)
+    if vocab and merges:
+        return CLIPBPETokenizer.from_files(
+            vocab, merges, max_len=max_len, pad_id=pad_id
+        )
+    return None
+
+
+_TOKENIZER_HELP = (
+    "checkpoints bundle text-encoder weights but never tokenizer tables; set "
+    "PA_TOKENIZER_JSON (a tokenizer.json) or PA_CLIP_VOCAB + PA_CLIP_MERGES "
+    "(vocab.json + merges.txt), or wire a TPUCLIPLoader node instead"
+)
+
+
+class CheckpointLoaderSimple:
+    """Stock loader: (ckpt_name) → (MODEL, CLIP, VAE). Family is sniffed off
+    the checkpoint keys (stock has no family widget); CLIP comes from the
+    bundled ``cond_stage_model``/``conditioner`` towers for the SD families
+    (SDXL gets the dual L+G wire TPUTextEncode combines)."""
+
+    DESCRIPTION = "Stock-name checkpoint loader (family sniffed, bundled CLIP)."
+    RETURN_TYPES = ("MODEL", "CLIP", "VAE")
+    RETURN_NAMES = ("model", "clip", "vae")
+    FUNCTION = "load"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"ckpt_name": ("STRING", {"default": ""})}}
+
+    def load(self, ckpt_name: str):
+        from .models.loader import peek_safetensors, sniff_model_family
+        from .nodes import TPUCheckpointLoader
+
+        path = resolve_model_file(ckpt_name, "checkpoints")
+        # Family sniffing needs only key names + two shapes: peek the header
+        # instead of materializing a multi-GB file twice (the full read
+        # happens once, inside TPUCheckpointLoader).
+        family = sniff_model_family(peek_safetensors(path))
+        model, vae = TPUCheckpointLoader().load(ckpt_path=path, family=family)
+        return model, self._bundled_clip(path, family), vae
+
+    def _bundled_clip(self, path, family: str):
+        from .models import load_clip_text_checkpoint
+        from .models.loader import load_safetensors_subset
+
+        def error_wire(msg: str):
+            return {"encoder": None, "tokenizer": None, "type": "error",
+                    "tokenizer_error": msg}
+
+        try:
+            if family in ("sd15", "sd21", "sd21-v"):
+                open_clip = family.startswith("sd21")
+                cfg = None
+                if open_clip:
+                    from .models import open_clip_h_config
+
+                    cfg = open_clip_h_config()
+                tower = load_safetensors_subset(path, "cond_stage_model.")
+                if not tower:
+                    return error_wire(
+                        "checkpoint has no bundled cond_stage_model tower; "
+                        "wire a TPUCLIPLoader node instead"
+                    )
+                enc = load_clip_text_checkpoint(
+                    tower, cfg=cfg, open_clip=open_clip
+                )
+                tok = _clip_tokenizer(
+                    max_len=enc.cfg.max_len, pad_id=0 if open_clip else None
+                )
+                return {
+                    "encoder": enc, "tokenizer": tok, "type": "clip",
+                    "tokenizer_error": None if tok else _TOKENIZER_HELP,
+                }
+            if family == "sdxl":
+                from .models import open_clip_g_config
+
+                # conditioner.embedders.0 = CLIP-L (HF layout),
+                # conditioner.embedders.1 = OpenCLIP-G (resblocks layout).
+                towers = load_safetensors_subset(
+                    path, "conditioner.embedders.0.", "conditioner.embedders.1."
+                )
+                sub_l = {k: v for k, v in towers.items()
+                         if k.startswith("conditioner.embedders.0.")}
+                sub_g = {k: v for k, v in towers.items()
+                         if k.startswith("conditioner.embedders.1.")}
+                if not sub_l or not sub_g:
+                    return error_wire(
+                        "sdxl checkpoint has no bundled conditioner towers; "
+                        "wire TPUCLIPLoader nodes instead"
+                    )
+                enc_l = load_clip_text_checkpoint(sub_l)
+                enc_g = load_clip_text_checkpoint(
+                    sub_g, cfg=open_clip_g_config(), open_clip=True
+                )
+                tok_l = _clip_tokenizer(max_len=enc_l.cfg.max_len)
+                tok_g = _clip_tokenizer(max_len=enc_g.cfg.max_len, pad_id=0)
+                err = None if (tok_l and tok_g) else _TOKENIZER_HELP
+                return {
+                    "type": "sdxl-dual",
+                    "l": {"encoder": enc_l, "tokenizer": tok_l, "type": "clip",
+                          "tokenizer_error": err},
+                    "g": {"encoder": enc_g, "tokenizer": tok_g, "type": "clip",
+                          "tokenizer_error": err},
+                    "tokenizer_error": err,
+                }
+            return error_wire(
+                f"{family} checkpoints do not bundle text encoders; wire "
+                "TPUCLIPLoader (or the DualCLIPLoader shim) instead"
+            )
+        except Exception as e:  # noqa: BLE001 — degrade to an encode-time error
+            return error_wire(f"bundled text-encoder extraction failed: {e}")
+
+
+class DualCLIPLoader:
+    """Stock dual loader (FLUX/SD3 workflows): two encoder files → one CLIP
+    wire. ``type=flux`` pairs T5-XXL (context) with CLIP-L (pooled)."""
+
+    DESCRIPTION = "Stock-name dual text-encoder loader (flux/sdxl/sd3 pairs)."
+    RETURN_TYPES = ("CLIP",)
+    RETURN_NAMES = ("clip",)
+    FUNCTION = "load"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip_name1": ("STRING", {"default": ""}),
+                "clip_name2": ("STRING", {"default": ""}),
+                "type": (["flux", "sdxl", "sd3"], {"default": "flux"}),
+            }
+        }
+
+    def load(self, clip_name1: str, clip_name2: str, type: str = "flux"):
+        from .nodes import TPUCLIPLoader
+
+        loader = TPUCLIPLoader()
+
+        def clip_wire(name: str, encoder_type: str):
+            path = resolve_model_file(name, "clip", "text_encoders")
+            kw = {}
+            if encoder_type in ("t5", "umt5"):
+                tok_json = os.environ.get("PA_T5_TOKENIZER_JSON", "")
+                if not tok_json:
+                    raise ValueError(
+                        "DualCLIPLoader t5 tower needs PA_T5_TOKENIZER_JSON "
+                        "(no vocab/merges form exists for T5 tokenizers)"
+                    )
+                kw["tokenizer_json"] = tok_json
+            else:
+                tok_json = os.environ.get("PA_TOKENIZER_JSON", "")
+                if tok_json:
+                    kw["tokenizer_json"] = tok_json
+                else:
+                    kw["vocab_path"] = os.environ.get("PA_CLIP_VOCAB", "")
+                    kw["merges_path"] = os.environ.get("PA_CLIP_MERGES", "")
+            (wire,) = loader.load(path, encoder_type, **kw)
+            return wire
+
+        if type == "flux":
+            # Stock convention: name1 = t5xxl, name2 = clip_l. A "t5" in
+            # either file name corrects swapped wiring; with no match in
+            # either, trust the positional convention (a rename like
+            # flan_xxl.safetensors must not flip a correctly-ordered graph).
+            n1 = os.path.basename(clip_name1).lower()
+            n2 = os.path.basename(clip_name2).lower()
+            swapped = "t5" not in n1 and "t5" in n2
+            t5_name = clip_name2 if swapped else clip_name1
+            l_name = clip_name1 if swapped else clip_name2
+            return (
+                {
+                    "type": "flux-dual",
+                    "t5": clip_wire(t5_name, "t5"),
+                    "l": clip_wire(l_name, "clip-l"),
+                    "tokenizer_error": None,
+                },
+            )
+        if type == "sdxl":
+            return (
+                {
+                    "type": "sdxl-dual",
+                    "l": clip_wire(clip_name1, "clip-l"),
+                    "g": clip_wire(clip_name2, "open-clip-g"),
+                    "tokenizer_error": None,
+                },
+            )
+        raise ValueError(
+            "DualCLIPLoader type=sd3 needs three towers — wire TPUCLIPLoader "
+            "nodes + TPUConditioningCombine(mode='sd3') instead"
+        )
+
+
+class CLIPSetLastLayer:
+    """Stock clip-skip node: tags the CLIP wire; TPUTextEncode honors the tag
+    when its own clip_skip widget is 0 (host stop_at_clip_layer semantics:
+    -1 = final layer, -2 = penultimate)."""
+
+    DESCRIPTION = "Stock-name clip-skip (tags the CLIP wire)."
+    RETURN_TYPES = ("CLIP",)
+    RETURN_NAMES = ("clip",)
+    FUNCTION = "set_last_layer"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip": ("CLIP", {}),
+                "stop_at_clip_layer": ("INT", {"default": -1, "min": -24, "max": -1}),
+            }
+        }
+
+    def set_last_layer(self, clip, stop_at_clip_layer: int):
+        if stop_at_clip_layer not in (-1, -2):
+            raise ValueError(
+                "only stop_at_clip_layer -1 (final) or -2 (penultimate) is "
+                f"supported, got {stop_at_clip_layer}"
+            )
+        return ({**clip, "clip_skip": -stop_at_clip_layer},)
+
+
+def _renamed(tpu_cls, rename: dict[str, str], *, name: str):
+    """Adapter class factory: stock input keys → TPU node keys."""
+
+    class Shim:
+        DESCRIPTION = f"Stock-name alias of {tpu_cls.__name__}."
+        RETURN_TYPES = tpu_cls.RETURN_TYPES
+        RETURN_NAMES = getattr(tpu_cls, "RETURN_NAMES", None)
+        FUNCTION = "run"
+        CATEGORY = CATEGORY
+
+        @classmethod
+        def INPUT_TYPES(cls):
+            spec = tpu_cls.INPUT_TYPES()
+            back = {v: k for k, v in rename.items()}
+            return {
+                section: {back.get(k, k): v for k, v in entries.items()}
+                for section, entries in spec.items()
+            }
+
+        def run(self, **kwargs):
+            mapped = {rename.get(k, k): v for k, v in kwargs.items()}
+            inner = tpu_cls()
+            return getattr(inner, tpu_cls.FUNCTION)(**mapped)
+
+    Shim.__name__ = Shim.__qualname__ = name
+    return Shim
+
+
+class LoadImage:
+    """Stock image loader: names resolve against ``$PA_INPUT_DIR``."""
+
+    DESCRIPTION = "Stock-name alias of TPULoadImage (input-dir resolution)."
+    FUNCTION = "run"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"image": ("STRING", {"default": ""})}}
+
+    def run(self, image: str):
+        from .nodes import TPULoadImage
+
+        base = os.environ.get("PA_INPUT_DIR", "input")
+        cand = os.path.join(base, image)
+        return TPULoadImage().load(cand if os.path.exists(cand) else image)
+
+    # RETURN_TYPES mirror the TPU node (set below to avoid import cycles).
+
+
+class LatentUpscale:
+    """Stock latent upscale takes absolute target pixel dims; the TPU node
+    takes a scale factor — computed here from the wired latent at runtime.
+    ``crop`` is accepted and ignored (center-crop after resize is a stock
+    nicety, not a parity requirement — documented divergence)."""
+
+    DESCRIPTION = "Stock-name latent upscale (absolute dims → scale factor)."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "upscale"
+    CATEGORY = CATEGORY
+
+    _METHODS = {
+        "nearest-exact": "nearest", "nearest": "nearest",
+        "bilinear": "bilinear", "area": "bilinear",
+        "bicubic": "bicubic", "bislerp": "bicubic",
+    }
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples": ("LATENT", {}),
+                "upscale_method": (list(cls._METHODS), {"default": "bilinear"}),
+                "width": ("INT", {"default": 1024, "min": 16, "max": 16384}),
+                "height": ("INT", {"default": 1024, "min": 16, "max": 16384}),
+            },
+            "optional": {"crop": ("STRING", {"default": "disabled"})},
+        }
+
+    def upscale(self, samples, upscale_method: str, width: int, height: int,
+                crop: str = "disabled"):
+        from .nodes import TPULatentUpscale
+
+        z = samples["samples"]
+        h = z.shape[-3]
+        # Stock dims are pixel-space; latents are 8x smaller. Non-uniform
+        # aspect changes collapse to the height ratio (scale-factor node).
+        scale = max(height // 8, 2) / h
+        method = self._METHODS.get(upscale_method, "bilinear")
+        return TPULatentUpscale().upscale(samples, scale, method)
+
+
+class _EmptyLatent16ch:
+    """Stock EmptySD3LatentImage: 16-channel latents (SD3/FLUX), no channel
+    widget."""
+
+    DESCRIPTION = "Stock-name 16-channel empty latent (SD3/FLUX)."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "generate"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "width": ("INT", {"default": 1024, "min": 16, "max": 16384}),
+                "height": ("INT", {"default": 1024, "min": 16, "max": 16384}),
+                "batch_size": ("INT", {"default": 1, "min": 1, "max": 4096}),
+            }
+        }
+
+    def generate(self, width: int, height: int, batch_size: int = 1):
+        from .nodes import TPUEmptyLatent
+
+        return TPUEmptyLatent().generate(
+            width=width, height=height, batch_size=batch_size, channels=16
+        )
+
+
+def stock_node_mappings() -> dict[str, type]:
+    """All stock-name shims, keyed by the stock class name (merged into
+    ``nodes.NODE_CLASS_MAPPINGS`` so exported workflows resolve directly)."""
+    from . import nodes as n
+
+    LoadImage.RETURN_TYPES = n.TPULoadImage.RETURN_TYPES
+    LoadImage.RETURN_NAMES = getattr(n.TPULoadImage, "RETURN_NAMES", None)
+
+    mappings = {
+        "CheckpointLoaderSimple": CheckpointLoaderSimple,
+        "DualCLIPLoader": DualCLIPLoader,
+        "CLIPSetLastLayer": CLIPSetLastLayer,
+        "LoadImage": LoadImage,
+        "LatentUpscale": LatentUpscale,
+        # Pure renames.
+        "CLIPTextEncode": _renamed(n.TPUTextEncode, {}, name="CLIPTextEncode"),
+        "EmptyLatentImage": _renamed(
+            n.TPUEmptyLatent, {}, name="EmptyLatentImage"
+        ),
+        "EmptySD3LatentImage": _EmptyLatent16ch,
+        "KSampler": _renamed(
+            n.TPUKSampler, {"latent_image": "latent"}, name="KSampler"
+        ),
+        "VAEDecode": _renamed(
+            n.TPUVAEDecode, {"samples": "latent"}, name="VAEDecode"
+        ),
+        "VAEEncode": _renamed(
+            n.TPUVAEEncode, {"pixels": "image"}, name="VAEEncode"
+        ),
+        "SaveImage": _renamed(n.TPUSaveImage, {}, name="SaveImage"),
+        "LatentUpscaleBy": _renamed(
+            n.TPULatentUpscale, {"samples": "latent", "scale_by": "scale",
+                                 "upscale_method": "method"},
+            name="LatentUpscaleBy",
+        ),
+        "SetLatentNoiseMask": _renamed(
+            n.TPUSetLatentNoiseMask, {"samples": "latent"},
+            name="SetLatentNoiseMask",
+        ),
+        # Custom-sampling family: built stock-shaped from the start.
+        "RandomNoise": _renamed(n.TPURandomNoise, {}, name="RandomNoise"),
+        "DisableNoise": _renamed(n.TPUDisableNoise, {}, name="DisableNoise"),
+        "KSamplerSelect": _renamed(
+            n.TPUKSamplerSelect, {}, name="KSamplerSelect"
+        ),
+        "BasicScheduler": _renamed(
+            n.TPUBasicScheduler, {}, name="BasicScheduler"
+        ),
+        "BasicGuider": _renamed(n.TPUBasicGuider, {}, name="BasicGuider"),
+        "CFGGuider": _renamed(n.TPUCFGGuider, {}, name="CFGGuider"),
+        "FluxGuidance": _renamed(n.TPUFluxGuidance, {}, name="FluxGuidance"),
+        "SamplerCustomAdvanced": _renamed(
+            n.TPUSamplerCustomAdvanced, {}, name="SamplerCustomAdvanced"
+        ),
+        "SplitSigmas": _renamed(n.TPUSplitSigmas, {}, name="SplitSigmas"),
+        "FlipSigmas": _renamed(n.TPUFlipSigmas, {}, name="FlipSigmas"),
+    }
+    return mappings
+
+
+def register(
+    node_class_mappings: dict[str, type],
+    display_name_mappings: dict[str, str] | None = None,
+) -> None:
+    """Merge the shims into a registry without overriding native names."""
+    for name, cls in stock_node_mappings().items():
+        node_class_mappings.setdefault(name, cls)
+        if display_name_mappings is not None:
+            display_name_mappings.setdefault(name, f"{name} (stock compat)")

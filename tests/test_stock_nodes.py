@@ -1,0 +1,240 @@
+"""Stock-ComfyUI node-name shims (nodes_compat.py): a workflow exported from
+a stock ComfyUI install — builtin class names, builtin input keys — runs
+against this host unchanged.
+
+The reference pack lives inside ComfyUI and gets the builtins for free
+(any_device_parallel.py:1473-1483 registers only its own nodes); here the
+builtin names are part of the host-parity surface. Family sniffing
+(models/loader.sniff_model_family) replaces the stock loader's implicit
+config detection.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.host import run_workflow
+from comfyui_parallelanything_tpu.models.loader import sniff_model_family
+
+
+class TestSniffModelFamily:
+    def _flux_keys(self, dev=True, depth=19):
+        sd = {f"double_blocks.{i}.img_attn.qkv.weight": np.zeros((1, 1))
+              for i in range(depth)}
+        sd["single_blocks.0.linear1.weight"] = np.zeros((1, 1))
+        if dev:
+            sd["guidance_in.in_layer.weight"] = np.zeros((1, 1))
+        return sd
+
+    def test_flux_dev_vs_schnell_vs_zimage(self):
+        assert sniff_model_family(self._flux_keys(dev=True)) == "flux-dev"
+        assert sniff_model_family(self._flux_keys(dev=False)) == "flux-schnell"
+        # Z-image proxy: flux layout, no guidance embed, shallow double stack
+        # (flux.py z_image_turbo_config depth 6/26).
+        assert sniff_model_family(
+            self._flux_keys(dev=False, depth=6)
+        ) == "zimage-turbo"
+
+    def test_prefixed_full_checkpoint_keys(self):
+        sd = {f"model.diffusion_model.{k}": v
+              for k, v in self._flux_keys().items()}
+        sd["first_stage_model.decoder.conv_in.weight"] = np.zeros((1, 1))
+        assert sniff_model_family(sd) == "flux-dev"
+
+    def test_mmdit_variants(self):
+        base = {f"joint_blocks.{i}.x_block.attn.qkv.weight": np.zeros((1, 1))
+                for i in range(24)}
+        assert sniff_model_family(base) == "sd3-medium"
+        large = {f"joint_blocks.{i}.x_block.attn.qkv.weight": np.zeros((1, 1))
+                 for i in range(38)}
+        assert sniff_model_family(large) == "sd35-large"
+        dual = dict(base)
+        dual["joint_blocks.0.x_block.attn2.qkv.weight"] = np.zeros((1, 1))
+        assert sniff_model_family(dual) == "sd35-medium"
+
+    def test_wan_width(self):
+        sd = {"blocks.0.self_attn.q.weight": np.zeros((1536, 1536))}
+        assert sniff_model_family(sd) == "wan-1.3b"
+        sd = {"blocks.0.self_attn.q.weight": np.zeros((5120, 5120))}
+        assert sniff_model_family(sd) == "wan-14b"
+
+    def test_unet_families(self):
+        sdxl = {"input_blocks.0.0.weight": np.zeros((1, 1)),
+                "label_emb.0.0.weight": np.zeros((1, 1))}
+        assert sniff_model_family(sdxl) == "sdxl"
+        sd15 = {
+            "input_blocks.0.0.weight": np.zeros((1, 1)),
+            "input_blocks.1.1.transformer_blocks.0.attn2.to_k.weight":
+                np.zeros((320, 768)),
+        }
+        assert sniff_model_family(sd15) == "sd15"
+        sd21 = {
+            "input_blocks.0.0.weight": np.zeros((1, 1)),
+            "input_blocks.1.1.transformer_blocks.0.attn2.to_k.weight":
+                np.zeros((320, 1024)),
+        }
+        assert sniff_model_family(sd21) == "sd21"
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError, match="cannot sniff"):
+            sniff_model_family({"some.random.weight": np.zeros((1,))})
+
+    def test_sniffs_synthetic_sd15_checkpoint(self, tmp_path, monkeypatch):
+        # The same synthetic checkpoint the e2e test loads must sniff sd15.
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        from comfyui_parallelanything_tpu.models import load_safetensors
+
+        assert sniff_model_family(load_safetensors(paths["ckpt"])) == "sd15"
+
+
+def _synthetic_stock_env(tmp_path, monkeypatch):
+    """Tiny sd15 checkpoint WITH bundled cond_stage_model CLIP (the stock
+    loader extracts text encoders from the file), plus tokenizer tables wired
+    through the PA_* env vars the shims read. Mirrors
+    test_host_graph._synthetic_env, extended with the bundled tower."""
+    import jax
+    import jax.numpy as jnp
+    from safetensors.numpy import save_file
+
+    import comfyui_parallelanything_tpu.models as models_pkg
+    import comfyui_parallelanything_tpu.models.text_encoders as te_mod
+    from comfyui_parallelanything_tpu.models import build_unet, build_vae
+    from tests.test_convert_unet import _ldm_sd
+    from tests.test_text_encoders import TINY_CLIP, _hf_clip
+    from tests.test_vae import TINY as TINY_VAE, _ldm_layout_sd
+
+    real_sd15 = models_pkg.sd15_config
+
+    def tiny_sd15():
+        return real_sd15(
+            model_channels=32, channel_mult=(1, 2), transformer_depth=(1, 1),
+            attention_levels=(0, 1), context_dim=TINY_CLIP.hidden_size,
+            num_heads=4, norm_groups=8, dtype=jnp.float32,
+        )
+
+    monkeypatch.setattr(models_pkg, "sd15_config", tiny_sd15)
+    monkeypatch.setattr(models_pkg, "sd_vae_config", lambda: TINY_VAE)
+    monkeypatch.setattr(te_mod, "clip_l_config", lambda: TINY_CLIP)
+
+    ucfg = tiny_sd15()
+    unet = build_unet(ucfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+    vae = build_vae(TINY_VAE, jax.random.key(1), sample_hw=16)
+    hf = _hf_clip(TINY_CLIP, "quick_gelu")
+    sd = {
+        f"model.diffusion_model.{k}": np.ascontiguousarray(v)
+        for k, v in _ldm_sd(ucfg, unet.params).items()
+    }
+    sd.update({
+        f"first_stage_model.{k}": np.ascontiguousarray(v)
+        for k, v in _ldm_layout_sd(TINY_VAE, vae.params).items()
+    })
+    # Bundled text tower, SD1.x layout: cond_stage_model.transformer.<HF keys>.
+    sd.update({
+        f"cond_stage_model.transformer.{k}":
+            np.ascontiguousarray(v.detach().numpy())
+        for k, v in hf.state_dict().items()
+    })
+    ckpt = tmp_path / "ckpt.safetensors"
+    save_file(sd, str(ckpt))
+
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {"[UNK]": 0, "a": 5, "watercolor": 6, "lighthouse": 7, "at": 8,
+             "dawn": 9, "blurry": 10, "low": 11, "quality": 12}
+    t = tokenizers.Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
+    t.pre_tokenizer = Whitespace()
+    tok_path = tmp_path / "tokenizer.json"
+    t.save(str(tok_path))
+
+    monkeypatch.setenv("PA_TOKENIZER_JSON", str(tok_path))
+    return {"ckpt": str(ckpt), "tok": str(tok_path)}
+
+
+class TestStockWorkflow:
+    def _stock_workflow(self, ckpt):
+        """API-format graph exactly as a stock ComfyUI export writes it:
+        builtin class names, builtin input keys, [node, output] links."""
+        return {
+            "4": {"class_type": "CheckpointLoaderSimple",
+                  "inputs": {"ckpt_name": ckpt}},
+            "5": {"class_type": "EmptyLatentImage",
+                  "inputs": {"width": 32, "height": 32, "batch_size": 2}},
+            "6": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "a watercolor lighthouse at dawn",
+                             "clip": ["4", 1]}},
+            "7": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "blurry low quality",
+                             "clip": ["4", 1]}},
+            "3": {"class_type": "KSampler",
+                  "inputs": {"seed": 7, "steps": 2, "cfg": 7.0,
+                             "sampler_name": "euler", "scheduler": "normal",
+                             "denoise": 1.0, "model": ["4", 0],
+                             "positive": ["6", 0], "negative": ["7", 0],
+                             "latent_image": ["5", 0]}},
+            "8": {"class_type": "VAEDecode",
+                  "inputs": {"samples": ["3", 0], "vae": ["4", 2]}},
+            "9": {"class_type": "SaveImage",
+                  "inputs": {"images": ["8", 0],
+                             "filename_prefix": "ComfyUI"}},
+        }
+
+    def test_exported_stock_workflow_runs_unchanged(self, tmp_path, monkeypatch):
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        monkeypatch.setenv("PA_OUTPUT_DIR", str(tmp_path / "out"))
+        wf = self._stock_workflow(paths["ckpt"])
+        # SaveImage's stock form has no output_dir widget; point the TPU
+        # node's default there via its own optional input (exported graphs
+        # carry only filename_prefix — add output_dir like a host config).
+        wf["9"]["inputs"]["output_dir"] = str(tmp_path / "out")
+
+        out = run_workflow(wf)
+        images = out["8"][0]
+        assert images.shape[0] == 2 and images.shape[-1] == 3
+        assert np.isfinite(np.asarray(images)).all()
+        saved = out["9"][0]
+        assert len(saved) == 2 and all(os.path.exists(p) for p in saved)
+
+    def test_models_dir_resolution(self, tmp_path, monkeypatch):
+        # ComfyUI folder layout: a bare name resolves via
+        # $PA_MODELS_DIR/checkpoints/<name>.
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        models = tmp_path / "models" / "checkpoints"
+        models.mkdir(parents=True)
+        os.rename(paths["ckpt"], models / "tiny.safetensors")
+        monkeypatch.setenv("PA_MODELS_DIR", str(tmp_path / "models"))
+
+        wf = self._stock_workflow("tiny.safetensors")
+        del wf["9"]  # no image save needed for the resolution check
+        out = run_workflow(wf)
+        assert out["8"][0].shape[0] == 2
+
+    def test_clip_set_last_layer_tags_wire(self, tmp_path, monkeypatch):
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        wf = self._stock_workflow(paths["ckpt"])
+        del wf["9"]
+        wf["10"] = {"class_type": "CLIPSetLastLayer",
+                    "inputs": {"clip": ["4", 1], "stop_at_clip_layer": -2}}
+        wf["6"]["inputs"]["clip"] = ["10", 0]
+        out = run_workflow(wf)
+        assert np.isfinite(np.asarray(out["8"][0])).all()
+
+    def test_missing_tokenizer_fails_with_instructions(self, tmp_path,
+                                                       monkeypatch):
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        monkeypatch.delenv("PA_TOKENIZER_JSON")
+        wf = self._stock_workflow(paths["ckpt"])
+        with pytest.raises(Exception, match="PA_TOKENIZER_JSON"):
+            run_workflow(wf)
+
+    def test_latent_upscale_absolute_dims(self, tmp_path, monkeypatch):
+        from comfyui_parallelanything_tpu.nodes import NODE_CLASS_MAPPINGS
+
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        node = NODE_CLASS_MAPPINGS["LatentUpscale"]()
+        (out,) = node.upscale(lat, "bilinear", width=128, height=128)
+        # 128 px -> 16 latent; from 8 -> scale 2.
+        assert out["samples"].shape == (1, 16, 16, 4)
