@@ -835,7 +835,11 @@ class TPULatentUpscale:
             }
         }
 
-    def upscale(self, latent, scale: float, method: str = "bilinear"):
+    def upscale(self, latent, scale: float, method: str = "bilinear",
+                scale_w: float | None = None):
+        """``scale_w`` (optional, defaults to ``scale``) resizes width by its
+        own factor — aspect-changing upscales, e.g. the stock LatentUpscale
+        node's absolute width/height targets (nodes_compat.py)."""
         import jax
 
         if method not in RESIZE_METHODS:
@@ -854,7 +858,8 @@ class TPULatentUpscale:
             s = round(v)
             return s + (s % 2)
 
-        th, tw = snap(h * scale), snap(w * scale)
+        th = snap(h * scale)
+        tw = snap(w * (scale if scale_w is None else scale_w))
         if th < 2 or tw < 2:
             raise ValueError(
                 f"scale {scale} shrinks the {h}x{w} latent to {th}x{tw}"
@@ -1181,7 +1186,12 @@ class TPUSaveImage:
                 "filename_prefix": ("STRING", {"default": "tpu"}),
             },
             "optional": {
-                "output_dir": ("STRING", {"default": "output"}),
+                "output_dir": (
+                    "STRING",
+                    {"default": "",
+                     "tooltip": "empty = $PA_OUTPUT_DIR, else ./output — the "
+                                "same root the API server serves /view from"},
+                ),
                 "metadata": (
                     "STRING",
                     {"default": "", "multiline": True,
@@ -1196,12 +1206,18 @@ class TPUSaveImage:
             "hidden": {"prompt": "PROMPT"},
         }
 
-    def save(self, images, filename_prefix: str = "tpu", output_dir: str = "output",
+    def save(self, images, filename_prefix: str = "tpu", output_dir: str = "",
              metadata: str = "", prompt=None):
         import os
 
         import numpy as np
         from PIL import Image
+
+        # Empty widget = the host-configured output root (PA_OUTPUT_DIR, the
+        # same root server.py serves /view from), else the stock "output" —
+        # exported stock workflows carry only filename_prefix, and their
+        # images must land where the API server can find them.
+        output_dir = output_dir or os.environ.get("PA_OUTPUT_DIR", "output")
 
         # Host SaveImage semantics: the prefix may carry a subfolder
         # ("run1/img") — create it and count within it. Absolute or

@@ -340,9 +340,10 @@ class LoadImage:
 
 class LatentUpscale:
     """Stock latent upscale takes absolute target pixel dims; the TPU node
-    takes a scale factor — computed here from the wired latent at runtime.
-    ``crop`` is accepted and ignored (center-crop after resize is a stock
-    nicety, not a parity requirement — documented divergence)."""
+    takes scale factors — computed here from the wired latent at runtime,
+    height and width independently. ``crop`` is accepted and ignored
+    (center-crop after resize is a stock nicety, not a parity requirement —
+    documented divergence)."""
 
     DESCRIPTION = "Stock-name latent upscale (absolute dims → scale factor)."
     RETURN_TYPES = ("LATENT",)
@@ -373,12 +374,16 @@ class LatentUpscale:
         from .nodes import TPULatentUpscale
 
         z = samples["samples"]
-        h = z.shape[-3]
-        # Stock dims are pixel-space; latents are 8x smaller. Non-uniform
-        # aspect changes collapse to the height ratio (scale-factor node).
-        scale = max(height // 8, 2) / h
+        h, w = z.shape[-3], z.shape[-2]
+        # Stock dims are pixel-space; latents are 8x smaller. Height and
+        # width scale independently (aspect-changing upscales resize exactly
+        # to the stock target).
+        scale_h = max(height // 8, 2) / h
+        scale_w = max(width // 8, 2) / w
         method = self._METHODS.get(upscale_method, "bilinear")
-        return TPULatentUpscale().upscale(samples, scale, method)
+        return TPULatentUpscale().upscale(
+            samples, scale_h, method, scale_w=scale_w
+        )
 
 
 class _EmptyLatent16ch:

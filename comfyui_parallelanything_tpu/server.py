@@ -1,0 +1,299 @@
+"""Minimal ComfyUI-compatible HTTP API over the workflow host.
+
+The reference pack's graphs are driven through ComfyUI's HTTP server (the
+frontend and every scripting client POST API-format JSON to ``/prompt``).
+This module is that surface for the standalone host: stdlib-only
+(``http.server``), one worker thread executing prompts serially (one
+accelerator — serial is the correct schedule), and a persistent
+``host.WorkflowCache`` shared across prompts so a model loaded by one prompt
+stays resident for the next (the reference's keep-loaded behavior, which its
+``cleanup_parallel_model``/finalizer pair defends, any_device_parallel.py
+211-282).
+
+Endpoints (the ComfyUI client-protocol subset that makes scripts work):
+
+- ``POST /prompt``            ``{"prompt": {...graph...}}`` → ``{"prompt_id"}``
+- ``GET  /history``           all completed prompts
+- ``GET  /history/{id}``      one prompt's status + outputs
+- ``GET  /view?filename=``    serve a saved image (``subfolder=`` honored)
+- ``GET  /queue``             running + pending prompt ids
+- ``POST /interrupt``         drop all *pending* prompts (a compiled step
+                              cannot be preempted mid-dispatch)
+- ``GET  /object_info[/cls]`` node-registry introspection (INPUT_TYPES etc.)
+- ``GET  /system_stats``      devices from devices.discovery
+
+Run:  ``python -m comfyui_parallelanything_tpu.server [--port 8188]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from .host import WorkflowCache, run_workflow
+
+
+def _jsonable(v):
+    """INPUT_TYPES trees hold tuples/dicts/strings and the odd non-JSON leaf
+    (a type, a float('inf') bound) — degrade those to strings."""
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (str, int, bool)) or v is None:
+        return v
+    if isinstance(v, float):
+        return v if v == v and abs(v) != float("inf") else str(v)
+    return str(v)
+
+
+class PromptQueue:
+    """Serial prompt executor with ComfyUI-shaped bookkeeping."""
+
+    def __init__(self, class_mappings=None, output_dir: str | None = None):
+        self.class_mappings = class_mappings
+        self.output_dir = output_dir or os.environ.get("PA_OUTPUT_DIR", "output")
+        self.cache = WorkflowCache()
+        self.pending: "queue.Queue[tuple[str, dict] | None]" = queue.Queue()
+        self.pending_ids: list[str] = []
+        self.running: str | None = None
+        self.history: dict[str, dict] = {}
+        self.counter = 0
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def submit(self, prompt: dict) -> tuple[str, int]:
+        pid = uuid.uuid4().hex
+        # Bookkeeping AND enqueue under one lock: interrupt() drains under the
+        # same lock, so a submit racing an interrupt either lands wholly
+        # before (and is dropped with a history entry) or wholly after (and
+        # survives) — never half-registered.
+        with self._lock:
+            self.counter += 1
+            number = self.counter
+            self.pending_ids.append(pid)
+            self.pending.put((pid, prompt))
+        return pid, number
+
+    def interrupt(self) -> int:
+        """Drop every pending prompt (the running one finishes — a compiled
+        step cannot be preempted). Anything the worker popped before this
+        drain counts as running."""
+        dropped = 0
+        with self._lock:
+            while True:
+                try:
+                    item = self.pending.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None:  # preserve the shutdown sentinel
+                    self.pending.put(None)
+                    break
+                pid = item[0]
+                dropped += 1
+                self.pending_ids.remove(pid)
+                self.history[pid] = {
+                    "status": {"status_str": "interrupted", "completed": False},
+                    "outputs": {},
+                }
+        return dropped
+
+    def shutdown(self) -> None:
+        self.pending.put(None)
+        self._worker.join(timeout=30)
+
+    def _run(self) -> None:
+        while True:
+            item = self.pending.get()
+            if item is None:
+                return
+            pid, prompt = item
+            with self._lock:
+                if pid not in self.pending_ids:
+                    continue  # interrupted while queued
+                self.running = pid
+            t0 = time.time()
+            try:
+                results = run_workflow(
+                    prompt, class_mappings=self.class_mappings,
+                    outputs=self.cache,
+                )
+                entry = {
+                    "status": {"status_str": "success", "completed": True,
+                               "exec_s": round(time.time() - t0, 3)},
+                    "outputs": self._image_outputs(prompt, results),
+                }
+            except Exception as e:  # noqa: BLE001 — failures land in history
+                entry = {
+                    "status": {"status_str": "error", "completed": False,
+                               "message": f"{type(e).__name__}: {e}"},
+                    "outputs": {},
+                }
+            with self._lock:
+                self.history[pid] = entry
+                self.pending_ids.remove(pid)
+                self.running = None
+
+    def _image_outputs(self, prompt: dict, results: dict) -> dict:
+        """ComfyUI history shape: per save-node ``{"images": [{filename,
+        subfolder, type}]}`` — detected as outputs whose first element is a
+        list of existing file paths (what the SaveImage family returns)."""
+        out: dict[str, dict] = {}
+        for nid in prompt:
+            vals = results.get(str(nid))
+            if not vals or not isinstance(vals[0], (list, tuple)):
+                continue
+            paths = [p for p in vals[0]
+                     if isinstance(p, str) and os.path.exists(p)]
+            if not paths:
+                continue
+            images = []
+            for p in paths:
+                rel = os.path.relpath(p, self.output_dir)
+                sub, fname = os.path.split(rel)
+                if sub.startswith(".."):
+                    sub, fname = "", p  # saved outside output_dir: absolute
+                images.append(
+                    {"filename": fname, "subfolder": sub, "type": "output"}
+                )
+            out[str(nid)] = {"images": images}
+        return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    q: PromptQueue  # injected by make_server
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _send(self, code: int, payload, content_type="application/json"):
+        body = (json.dumps(payload).encode()
+                if content_type == "application/json" else payload)
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        if url.path == "/queue":
+            with self.q._lock:
+                running = [self.q.running] if self.q.running else []
+                pend = [p for p in self.q.pending_ids if p != self.q.running]
+            return self._send(
+                200, {"queue_running": running, "queue_pending": pend}
+            )
+        if parts and parts[0] == "history":
+            if len(parts) == 2:
+                entry = self.q.history.get(parts[1])
+                return self._send(200, {parts[1]: entry} if entry else {})
+            return self._send(200, self.q.history)
+        if url.path == "/view":
+            qs = parse_qs(url.query)
+            fname = qs.get("filename", [""])[0]
+            sub = qs.get("subfolder", [""])[0]
+            path = os.path.normpath(os.path.join(self.q.output_dir, sub, fname))
+            base = os.path.abspath(self.q.output_dir)
+            if not os.path.abspath(path).startswith(base + os.sep):
+                return self._send(403, {"error": "path escapes output dir"})
+            if not os.path.exists(path):
+                return self._send(404, {"error": "not found"})
+            with open(path, "rb") as f:
+                return self._send(200, f.read(), content_type="image/png")
+        if parts and parts[0] == "object_info":
+            from .nodes import NODE_CLASS_MAPPINGS, NODE_DISPLAY_NAME_MAPPINGS
+
+            classes = dict(NODE_CLASS_MAPPINGS)
+            classes.update(self.q.class_mappings or {})
+            names = [parts[1]] if len(parts) == 2 else list(classes)
+            info = {}
+            for name in names:
+                cls = classes.get(name)
+                if cls is None:
+                    continue
+                info[name] = {
+                    "input": _jsonable(cls.INPUT_TYPES()),
+                    "output": _jsonable(list(cls.RETURN_TYPES)),
+                    "output_name": _jsonable(
+                        list(getattr(cls, "RETURN_NAMES", None)
+                             or cls.RETURN_TYPES)
+                    ),
+                    "name": name,
+                    "display_name": NODE_DISPLAY_NAME_MAPPINGS.get(name, name),
+                    "description": getattr(cls, "DESCRIPTION", ""),
+                    "category": getattr(cls, "CATEGORY", ""),
+                }
+            if len(parts) == 2 and not info:
+                return self._send(404, {"error": f"unknown node {parts[1]!r}"})
+            return self._send(200, info)
+        if url.path == "/system_stats":
+            from .devices.discovery import available_devices
+
+            return self._send(200, {"devices": available_devices()})
+        return self._send(404, {"error": f"no route {url.path}"})
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        url = urlparse(self.path)
+        if url.path == "/interrupt":
+            return self._send(200, {"dropped": self.q.interrupt()})
+        if url.path == "/prompt":
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                prompt = payload.get("prompt")
+                if not isinstance(prompt, dict) or not prompt:
+                    return self._send(
+                        400, {"error": "body must carry a non-empty "
+                                       '{"prompt": {...}} graph'}
+                    )
+            except (ValueError, json.JSONDecodeError) as e:
+                return self._send(400, {"error": f"bad JSON: {e}"})
+            pid, number = self.q.submit(prompt)
+            return self._send(200, {"prompt_id": pid, "number": number})
+        return self._send(404, {"error": f"no route {url.path}"})
+
+
+def make_server(
+    host: str = "127.0.0.1",
+    port: int = 8188,
+    class_mappings=None,
+    output_dir: str | None = None,
+) -> tuple[ThreadingHTTPServer, PromptQueue]:
+    """Build (but don't start) the HTTP server + its prompt queue. Port 0
+    picks an ephemeral port (tests); ``server.server_address`` has the real
+    one."""
+    q = PromptQueue(class_mappings=class_mappings, output_dir=output_dir)
+    handler = type("Handler", (_Handler,), {"q": q})
+    srv = ThreadingHTTPServer((host, port), handler)
+    return srv, q
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8188)
+    ap.add_argument("--output-dir", default=None)
+    args = ap.parse_args()
+    srv, q = make_server(args.host, args.port, output_dir=args.output_dir)
+    print(f"ParallelAnything workflow server on http://{args.host}:{args.port}")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        q.shutdown()
+
+
+if __name__ == "__main__":
+    main()

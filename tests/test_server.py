@@ -1,0 +1,142 @@
+"""ComfyUI-compatible HTTP API (server.py): POST /prompt → history → /view,
+over the real workflow host with a persistent cross-prompt cache."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.server import make_server
+from tests.test_stock_nodes import _synthetic_stock_env
+
+
+@pytest.fixture
+def server(tmp_path, monkeypatch):
+    out_dir = tmp_path / "out"
+    srv, q = make_server(port=0, output_dir=str(out_dir))
+    thread = __import__("threading").Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield base, q, str(out_dir)
+    srv.shutdown()
+    q.shutdown()
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        ct = r.headers.get("Content-Type", "")
+        body = r.read()
+    return json.loads(body) if "json" in ct else body
+
+
+def _post(base, path, payload=None):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload or {}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _wait_history(base, pid, timeout=300):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        hist = _get(base, f"/history/{pid}")
+        if pid in hist:
+            return hist[pid]
+        time.sleep(0.5)
+    raise TimeoutError(f"prompt {pid} never completed")
+
+
+def _stock_graph(ckpt, out_dir):
+    return {
+        "4": {"class_type": "CheckpointLoaderSimple",
+              "inputs": {"ckpt_name": ckpt}},
+        "5": {"class_type": "EmptyLatentImage",
+              "inputs": {"width": 32, "height": 32, "batch_size": 1}},
+        "6": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "a watercolor lighthouse", "clip": ["4", 1]}},
+        "3": {"class_type": "KSampler",
+              "inputs": {"seed": 3, "steps": 2, "cfg": 1.0,
+                         "sampler_name": "euler", "scheduler": "normal",
+                         "denoise": 1.0, "model": ["4", 0],
+                         "positive": ["6", 0], "latent_image": ["5", 0]}},
+        "8": {"class_type": "VAEDecode",
+              "inputs": {"samples": ["3", 0], "vae": ["4", 2]}},
+        "9": {"class_type": "SaveImage",
+              "inputs": {"images": ["8", 0], "filename_prefix": "api",
+                         "output_dir": out_dir}},
+    }
+
+
+class TestServer:
+    def test_prompt_history_view_roundtrip(self, server, tmp_path, monkeypatch):
+        base, q, out_dir = server
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        wf = _stock_graph(paths["ckpt"], out_dir)
+
+        resp = _post(base, "/prompt", {"prompt": wf})
+        assert "prompt_id" in resp
+        entry = _wait_history(base, resp["prompt_id"])
+        assert entry["status"]["status_str"] == "success", entry["status"]
+        images = entry["outputs"]["9"]["images"]
+        assert len(images) == 1
+        png = _get(
+            base,
+            f"/view?filename={images[0]['filename']}"
+            f"&subfolder={images[0]['subfolder']}",
+        )
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+        # Second prompt reuses the cache: the checkpoint node must not
+        # re-execute (same signature), only the edited subgraph.
+        wf2 = json.loads(json.dumps(wf))
+        wf2["3"]["inputs"]["seed"] = 4
+        sig_keys = set(q.cache.results)
+        resp2 = _post(base, "/prompt", {"prompt": wf2})
+        entry2 = _wait_history(base, resp2["prompt_id"])
+        assert entry2["status"]["status_str"] == "success"
+        assert set(q.cache.results) >= sig_keys  # loader entry survived
+
+    def test_error_lands_in_history(self, server):
+        base, _, _ = server
+        resp = _post(base, "/prompt", {"prompt": {
+            "1": {"class_type": "NoSuchNode", "inputs": {}}
+        }})
+        entry = _wait_history(base, resp["prompt_id"])
+        assert entry["status"]["status_str"] == "error"
+        assert "NoSuchNode" in entry["status"]["message"]
+
+    def test_bad_request_rejected(self, server):
+        base, _, _ = server
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(base, "/prompt", {"not_prompt": 1})
+        assert err.value.code == 400
+
+    def test_object_info_exposes_registry(self, server):
+        base, _, _ = server
+        info = _get(base, "/object_info/KSampler")
+        assert info["KSampler"]["display_name"]
+        assert "seed" in json.dumps(info["KSampler"]["input"])
+        everything = _get(base, "/object_info")
+        assert {"CheckpointLoaderSimple", "TPUKSampler",
+                "ParallelAnything"} <= set(everything)
+
+    def test_view_path_escape_rejected(self, server):
+        base, _, _ = server
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(base, "/view?filename=../../etc/passwd")
+        assert err.value.code == 403
+
+    def test_queue_and_interrupt(self, server):
+        base, q, _ = server
+        state = _get(base, "/queue")
+        assert state == {"queue_running": [], "queue_pending": []}
+        assert _post(base, "/interrupt")["dropped"] == 0
+
+    def test_system_stats_lists_devices(self, server):
+        base, _, _ = server
+        stats = _get(base, "/system_stats")
+        assert isinstance(stats["devices"], list) and stats["devices"]

@@ -238,3 +238,18 @@ class TestStockWorkflow:
         (out,) = node.upscale(lat, "bilinear", width=128, height=128)
         # 128 px -> 16 latent; from 8 -> scale 2.
         assert out["samples"].shape == (1, 16, 16, 4)
+        # Width-only change must NOT no-op: axes scale independently.
+        (wide,) = node.upscale(lat, "bilinear", width=192, height=64)
+        assert wide["samples"].shape == (1, 8, 24, 4)
+
+    def test_save_image_defaults_to_pa_output_dir(self, tmp_path, monkeypatch):
+        # Stock exports carry only filename_prefix; images must land in the
+        # host-configured root (the one the API server serves /view from).
+        from comfyui_parallelanything_tpu.nodes import NODE_CLASS_MAPPINGS
+
+        monkeypatch.setenv("PA_OUTPUT_DIR", str(tmp_path / "served"))
+        node = NODE_CLASS_MAPPINGS["SaveImage"]()
+        (paths,) = node.run(
+            images=np.zeros((1, 8, 8, 3), np.float32), filename_prefix="x"
+        )
+        assert all(p.startswith(str(tmp_path / "served")) for p in paths)
