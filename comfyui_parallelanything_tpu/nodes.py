@@ -349,7 +349,11 @@ class TPUCheckpointLoader:
         lora_path: str = "",
         lora_strength: float = 1.0,
         quantize: str = "none",
+        load_vae: bool = True,
     ):
+        # load_vae=False skips the VAE conversion and returns (MODEL, None) —
+        # for re-load paths that only need the diffusion model (the
+        # LoraLoader shim re-bakes and discards everything else).
         from .models import (
             flux_dev_config,
             flux_schnell_config,
@@ -405,6 +409,8 @@ class TPUCheckpointLoader:
             with load_ctx:
                 model = load_wan_checkpoint(sd, wcfg, lora, lora_strength)
                 model = maybe_quant(model)
+            if not load_vae:
+                return model, None
             if not vae_path:
                 raise ValueError(
                     "wan checkpoints don't bundle a VAE — set vae_path to the "
@@ -450,6 +456,8 @@ class TPUCheckpointLoader:
                 model = load_flux_checkpoint(sd, cfg, lora, lora_strength)
                 vae_cfg = flux_vae_config()
             model = maybe_quant(model)
+        if not load_vae:
+            return model, None
         vae_sd = load_safetensors(vae_path) if vae_path else sd
         from .models.convert_vae import strip_vae_prefix
 

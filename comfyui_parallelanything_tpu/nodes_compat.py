@@ -107,6 +107,10 @@ class CheckpointLoaderSimple:
         # happens once, inside TPUCheckpointLoader).
         family = sniff_model_family(peek_safetensors(path))
         model, vae = TPUCheckpointLoader().load(ckpt_path=path, family=family)
+        # Source tag: the LoraLoader shim re-bakes from the original file
+        # (LoRA applies to the checkpoint layout pre-conversion). Same
+        # object.__setattr__ route the frozen dataclass uses for _jit_cache.
+        object.__setattr__(model, "source", {"path": path, "family": family})
         return model, self._bundled_clip(path, family), vae
 
     def _bundled_clip(self, path, family: str):
@@ -258,6 +262,72 @@ class DualCLIPLoader:
             "DualCLIPLoader type=sd3 needs three towers — wire TPUCLIPLoader "
             "nodes + TPUConditioningCombine(mode='sd3') instead"
         )
+
+
+class LoraLoader:
+    """Stock LoRA node: (MODEL, CLIP, lora_name, strengths) → patched
+    (MODEL, CLIP). LoRA bakes into the checkpoint layout BEFORE conversion
+    (models/convert.bake_lora — the reference's patches-then-load order,
+    any_device_parallel.py:971-1004), so this shim re-loads the tagged source
+    checkpoint with the LoRA applied. One LoRA per model (chain a second via
+    TPUCheckpointLoader's lora_path or bake offline); ``strength_clip`` is
+    accepted and ignored — text-encoder LoRA is a documented divergence."""
+
+    DESCRIPTION = "Stock-name LoRA loader (re-bakes from the source checkpoint)."
+    RETURN_TYPES = ("MODEL", "CLIP")
+    RETURN_NAMES = ("model", "clip")
+    FUNCTION = "load_lora"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL", {}),
+                "clip": ("CLIP", {}),
+                "lora_name": ("STRING", {"default": ""}),
+                "strength_model": (
+                    "FLOAT", {"default": 1.0, "min": -4.0, "max": 4.0}
+                ),
+                "strength_clip": (
+                    "FLOAT", {"default": 1.0, "min": -4.0, "max": 4.0}
+                ),
+            }
+        }
+
+    def load_lora(self, model, clip, lora_name: str,
+                  strength_model: float = 1.0, strength_clip: float = 1.0):
+        from .nodes import TPUCheckpointLoader
+
+        source = getattr(model, "source", None)
+        if source is None:
+            raise ValueError(
+                "LoraLoader needs a MODEL from CheckpointLoaderSimple (the "
+                "source-checkpoint tag); for TPUCheckpointLoader models pass "
+                "lora_path on the loader itself"
+            )
+        if source.get("lora"):
+            raise ValueError(
+                "stacking a second LoraLoader is not supported — bake "
+                "multiple LoRAs offline or use TPUCheckpointLoader lora_path"
+            )
+        lora = resolve_model_file(lora_name, "loras")
+        # An empty/missing name must not silently return an unpatched model
+        # (TPUCheckpointLoader treats lora_path="" as no-LoRA).
+        if not lora_name or not os.path.isfile(lora):
+            raise ValueError(
+                f"LoRA file not found: {lora_name!r} (searched "
+                f"$PA_MODELS_DIR/loras and the name as a path)"
+            )
+        patched, _ = TPUCheckpointLoader().load(
+            ckpt_path=source["path"], family=source["family"],
+            lora_path=lora, lora_strength=strength_model,
+            load_vae=False,  # re-bake only needs the diffusion model
+        )
+        object.__setattr__(
+            patched, "source", {**source, "lora": lora}
+        )
+        return patched, clip
 
 
 class CLIPSetLastLayer:
@@ -425,6 +495,7 @@ def stock_node_mappings() -> dict[str, type]:
     mappings = {
         "CheckpointLoaderSimple": CheckpointLoaderSimple,
         "DualCLIPLoader": DualCLIPLoader,
+        "LoraLoader": LoraLoader,
         "CLIPSetLastLayer": CLIPSetLastLayer,
         "LoadImage": LoadImage,
         "LatentUpscale": LatentUpscale,

@@ -242,6 +242,65 @@ class TestStockWorkflow:
         (wide,) = node.upscale(lat, "bilinear", width=192, height=64)
         assert wide["samples"].shape == (1, 8, 24, 4)
 
+    def test_lora_loader_rebakes_from_source(self, tmp_path, monkeypatch):
+        from safetensors.numpy import save_file
+
+        from comfyui_parallelanything_tpu.models import load_safetensors
+        from comfyui_parallelanything_tpu.nodes import NODE_CLASS_MAPPINGS
+
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        model, clip, vae = (
+            NODE_CLASS_MAPPINGS["CheckpointLoaderSimple"]().load(paths["ckpt"])
+        )
+
+        # Rank-2 kohya LoRA against a real attention projection of the tiny
+        # checkpoint (bake_lora matches the stripped ldm key).
+        sd = load_safetensors(paths["ckpt"])
+        target = next(
+            k for k in sd
+            if k.endswith("attn1.to_q.weight") and "input_blocks" in k
+        ).removeprefix("model.diffusion_model.")
+        out_d, in_d = sd[f"model.diffusion_model.{target}"].shape
+        rng = np.random.default_rng(5)
+        lora_path = tmp_path / "style.safetensors"
+        save_file({
+            f"{target.removesuffix('.weight')}.lora_down.weight":
+                rng.standard_normal((2, in_d)).astype(np.float32),
+            f"{target.removesuffix('.weight')}.lora_up.weight":
+                rng.standard_normal((out_d, 2)).astype(np.float32),
+        }, str(lora_path))
+
+        node = NODE_CLASS_MAPPINGS["LoraLoader"]()
+        patched, clip_out = node.load_lora(model, clip, str(lora_path), 1.0, 1.0)
+        assert clip_out is clip
+        import jax
+
+        base = np.concatenate(
+            [np.ravel(v) for v in jax.tree.leaves(model.params)]
+        )
+        new = np.concatenate(
+            [np.ravel(v) for v in jax.tree.leaves(patched.params)]
+        )
+        assert base.shape == new.shape and not np.allclose(base, new)
+
+        # Zero strength bakes nothing.
+        zero, _ = node.load_lora(model, clip, str(lora_path), 0.0, 1.0)
+        znew = np.concatenate(
+            [np.ravel(v) for v in jax.tree.leaves(zero.params)]
+        )
+        np.testing.assert_allclose(znew, base, rtol=1e-6, atol=1e-6)
+
+        # Stacking, untagged models, and missing files fail with instructions
+        # (an absent LoRA must never silently return an unpatched model).
+        with pytest.raises(ValueError, match="stacking"):
+            node.load_lora(patched, clip, str(lora_path), 1.0, 1.0)
+        with pytest.raises(ValueError, match="CheckpointLoaderSimple"):
+            node.load_lora(object(), clip, str(lora_path), 1.0, 1.0)
+        with pytest.raises(ValueError, match="not found"):
+            node.load_lora(model, clip, "", 1.0, 1.0)
+        with pytest.raises(ValueError, match="not found"):
+            node.load_lora(model, clip, "ghost.safetensors", 1.0, 1.0)
+
     def test_save_image_defaults_to_pa_output_dir(self, tmp_path, monkeypatch):
         # Stock exports carry only filename_prefix; images must land in the
         # host-configured root (the one the API server serves /view from).
