@@ -21,15 +21,24 @@ Endpoints (the ComfyUI client-protocol subset that makes scripts work):
                               cannot be preempted mid-dispatch)
 - ``GET  /object_info[/cls]`` node-registry introspection (INPUT_TYPES etc.)
 - ``GET  /system_stats``      devices from devices.discovery
+- ``GET  /ws``                WebSocket progress events (RFC 6455, stdlib):
+                              ``status`` on queue changes,
+                              ``execution_start`` when a prompt begins, and
+                              the canonical completion signal API clients
+                              wait for — ``executing`` with ``node: null``
+                              and the ``prompt_id``.
 
 Run:  ``python -m comfyui_parallelanything_tpu.server [--port 8188]``
 """
 
 from __future__ import annotations
 
+import base64
+import hashlib
 import json
 import os
 import queue
+import struct
 import threading
 import time
 import uuid
@@ -37,6 +46,56 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from .host import WorkflowCache, run_workflow
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"  # RFC 6455 §1.3
+
+
+def _ws_frame(payload: bytes, opcode: int = 0x1) -> bytes:
+    """One server→client frame (FIN set, unmasked — RFC 6455 §5.2)."""
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        head += bytes([n])
+    elif n < 1 << 16:
+        head += bytes([126]) + struct.pack(">H", n)
+    else:
+        head += bytes([127]) + struct.pack(">Q", n)
+    return head + payload
+
+
+def _ws_read_frame(rfile) -> tuple[int, bytes] | None:
+    """(opcode, payload) of one client frame, or None on EOF — including an
+    abrupt disconnect mid-header (a truncated read must not raise out of the
+    handler as struct.error). Client frames are masked (RFC 6455 §5.3)."""
+
+    def need(k: int) -> bytes | None:
+        data = rfile.read(k)
+        return data if len(data) == k else None
+
+    hdr = need(2)
+    if hdr is None:
+        return None
+    opcode = hdr[0] & 0x0F
+    masked, n = hdr[1] & 0x80, hdr[1] & 0x7F
+    if n == 126:
+        ext = need(2)
+        if ext is None:
+            return None
+        n = struct.unpack(">H", ext)[0]
+    elif n == 127:
+        ext = need(8)
+        if ext is None:
+            return None
+        n = struct.unpack(">Q", ext)[0]
+    mask = need(4) if masked else b"\x00" * 4
+    if mask is None:
+        return None
+    data = need(n)
+    if data is None:
+        return None
+    if masked:
+        data = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+    return opcode, data
 
 
 def _jsonable(v):
@@ -53,6 +112,48 @@ def _jsonable(v):
     return str(v)
 
 
+class _WsListener:
+    """One /ws client: a dedicated writer thread drains a bounded frame
+    queue. All writes (events AND pongs) go through the single writer, so
+    frames can never interleave mid-stream; ``send`` never blocks, and a
+    stalled client simply fills its queue and is evicted — the socket close
+    then unblocks any in-flight ``sendall``."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.frames: "queue.Queue[bytes | None]" = queue.Queue(maxsize=64)
+        self._writer = threading.Thread(target=self._write_loop, daemon=True)
+        self._writer.start()
+
+    def _write_loop(self) -> None:
+        while True:
+            frame = self.frames.get()
+            if frame is None:
+                return
+            try:
+                self.sock.sendall(frame)
+            except OSError:
+                return
+
+    def send(self, frame: bytes) -> bool:
+        """False → the queue is full (stalled client): caller should evict."""
+        try:
+            self.frames.put_nowait(frame)
+            return True
+        except queue.Full:
+            return False
+
+    def close(self) -> None:
+        try:
+            self.frames.put_nowait(None)
+        except queue.Full:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
 class PromptQueue:
     """Serial prompt executor with ComfyUI-shaped bookkeeping."""
 
@@ -66,8 +167,40 @@ class PromptQueue:
         self.history: dict[str, dict] = {}
         self.counter = 0
         self._lock = threading.Lock()
+        self._listeners: dict = {}  # socket → _WsListener
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
+
+    def add_listener(self, sock) -> "_WsListener":
+        listener = _WsListener(sock)
+        with self._lock:
+            self._listeners[sock] = listener
+        return listener
+
+    def remove_listener(self, sock) -> None:
+        with self._lock:
+            listener = self._listeners.pop(sock, None)
+        if listener is not None:
+            listener.close()
+
+    def _emit(self, event: dict) -> None:
+        """Queue one JSON event to every /ws client — never blocks the
+        caller (the worker thread must not wedge on a stalled client); a
+        client whose bounded queue fills is evicted."""
+        frame = _ws_frame(json.dumps(event).encode())
+        with self._lock:
+            listeners = list(self._listeners.items())
+        for sock, listener in listeners:
+            if not listener.send(frame):
+                self.remove_listener(sock)
+
+    def _emit_status(self) -> None:
+        with self._lock:
+            remaining = len(self.pending_ids)
+        self._emit({
+            "type": "status",
+            "data": {"status": {"exec_info": {"queue_remaining": remaining}}},
+        })
 
     def submit(self, prompt: dict) -> tuple[str, int]:
         pid = uuid.uuid4().hex
@@ -80,6 +213,7 @@ class PromptQueue:
             number = self.counter
             self.pending_ids.append(pid)
             self.pending.put((pid, prompt))
+        self._emit_status()
         return pid, number
 
     def interrupt(self) -> int:
@@ -103,6 +237,8 @@ class PromptQueue:
                     "status": {"status_str": "interrupted", "completed": False},
                     "outputs": {},
                 }
+        if dropped:
+            self._emit_status()  # ws clients must see the queue shrink
         return dropped
 
     def shutdown(self) -> None:
@@ -119,6 +255,7 @@ class PromptQueue:
                 if pid not in self.pending_ids:
                     continue  # interrupted while queued
                 self.running = pid
+            self._emit({"type": "execution_start", "data": {"prompt_id": pid}})
             t0 = time.time()
             try:
                 results = run_workflow(
@@ -140,6 +277,11 @@ class PromptQueue:
                 self.history[pid] = entry
                 self.pending_ids.remove(pid)
                 self.running = None
+            # The canonical completion signal ComfyUI API clients block on.
+            self._emit({
+                "type": "executing", "data": {"node": None, "prompt_id": pid},
+            })
+            self._emit_status()
 
     def _image_outputs(self, prompt: dict, results: dict) -> dict:
         """ComfyUI history shape: per save-node ``{"images": [{filename,
@@ -169,6 +311,10 @@ class PromptQueue:
 
 class _Handler(BaseHTTPRequestHandler):
     q: PromptQueue  # injected by make_server
+    # RFC 6455 §4 handshakes require an HTTP/1.1 status line — browsers and
+    # strict WS clients reject 'HTTP/1.0 101'. (Every response sets
+    # Content-Length, which HTTP/1.1 keep-alive needs.)
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
@@ -185,6 +331,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — http.server API
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
+        if url.path == "/ws":
+            return self._serve_websocket()
         if url.path == "/queue":
             with self.q._lock:
                 running = [self.q.running] if self.q.running else []
@@ -240,6 +388,41 @@ class _Handler(BaseHTTPRequestHandler):
 
             return self._send(200, {"devices": available_devices()})
         return self._send(404, {"error": f"no route {url.path}"})
+
+    def _serve_websocket(self):
+        """RFC 6455 upgrade + event push. The thread parks reading client
+        frames (ping → pong, close → exit) while PromptQueue._emit writes
+        events to the raw socket from the worker thread."""
+        key = self.headers.get("Sec-WebSocket-Key")
+        if self.headers.get("Upgrade", "").lower() != "websocket" or not key:
+            return self._send(400, {"error": "expected a WebSocket upgrade"})
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_GUID).encode()).digest()
+        ).decode()
+        sock = self.connection
+        # Register BEFORE the 101 goes out: a client that POSTs /prompt the
+        # instant its handshake completes must not race past an unregistered
+        # listener and miss the prompt's events (TCP buffers anything queued
+        # before the client starts reading).
+        listener = self.q.add_listener(sock)
+        self.send_response(101, "Switching Protocols")
+        self.send_header("Upgrade", "websocket")
+        self.send_header("Connection", "Upgrade")
+        self.send_header("Sec-WebSocket-Accept", accept)
+        self.end_headers()
+        self.wfile.flush()
+        self.close_connection = True
+        try:
+            while True:
+                frame = _ws_read_frame(self.rfile)
+                if frame is None or frame[0] == 0x8:  # EOF / close
+                    return
+                if frame[0] == 0x9:  # ping → pong, via the single writer
+                    listener.send(_ws_frame(frame[1], opcode=0xA))
+        except OSError:
+            return
+        finally:
+            self.q.remove_listener(sock)
 
     def do_POST(self):  # noqa: N802 — http.server API
         url = urlparse(self.path)

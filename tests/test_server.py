@@ -140,3 +140,52 @@ class TestServer:
         base, _, _ = server
         stats = _get(base, "/system_stats")
         assert isinstance(stats["devices"], list) and stats["devices"]
+
+    def test_websocket_completion_events(self, server):
+        # The ComfyUI API-client pattern: open /ws, POST /prompt, block on
+        # the 'executing' event with node=None and the prompt_id — no
+        # history polling.
+        import base64 as b64
+        import socket
+        import struct
+
+        base, _, _ = server
+        port = int(base.rsplit(":", 1)[1])
+        sock = socket.create_connection(("127.0.0.1", port), timeout=60)
+        key = b64.b64encode(b"0123456789abcdef").decode()
+        sock.sendall(
+            (f"GET /ws HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+             f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n"
+             "\r\n").encode()
+        )
+        f = sock.makefile("rb")
+        status = f.readline()
+        assert b"101" in status
+        while f.readline() not in (b"\r\n", b""):  # drain handshake headers
+            pass
+
+        def read_event():
+            hdr = f.read(2)
+            n = hdr[1] & 0x7F
+            if n == 126:
+                n = struct.unpack(">H", f.read(2))[0]
+            return json.loads(f.read(n))
+
+        # An intentionally failing prompt still completes with events.
+        resp = _post(base, "/prompt", {"prompt": {
+            "1": {"class_type": "NoSuchNode", "inputs": {}}
+        }})
+        pid = resp["prompt_id"]
+        seen = []
+        for _ in range(6):
+            evt = read_event()
+            seen.append(evt["type"])
+            if (evt["type"] == "executing"
+                    and evt["data"]["node"] is None
+                    and evt["data"]["prompt_id"] == pid):
+                break
+        else:
+            raise AssertionError(f"no completion event; saw {seen}")
+        assert "status" in seen  # queue-change event arrived too
+        sock.close()

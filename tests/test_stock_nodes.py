@@ -230,6 +230,43 @@ class TestStockWorkflow:
         with pytest.raises(Exception, match="PA_TOKENIZER_JSON"):
             run_workflow(wf)
 
+    def test_stock_custom_sampling_graph_executes(self, tmp_path, monkeypatch):
+        # The custom-sampling path exactly as a stock FLUX-style export wires
+        # it: RandomNoise + KSamplerSelect + BasicScheduler + CFGGuider +
+        # SamplerCustomAdvanced under their stock names and stock input keys.
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        wf = {
+            "ckpt": {"class_type": "CheckpointLoaderSimple",
+                     "inputs": {"ckpt_name": paths["ckpt"]}},
+            "pos": {"class_type": "CLIPTextEncode",
+                    "inputs": {"text": "a watercolor lighthouse",
+                               "clip": ["ckpt", 1]}},
+            "neg": {"class_type": "CLIPTextEncode",
+                    "inputs": {"text": "blurry", "clip": ["ckpt", 1]}},
+            "latent": {"class_type": "EmptyLatentImage",
+                       "inputs": {"width": 32, "height": 32, "batch_size": 1}},
+            "noise": {"class_type": "RandomNoise",
+                      "inputs": {"noise_seed": 11}},
+            "sel": {"class_type": "KSamplerSelect",
+                    "inputs": {"sampler_name": "euler"}},
+            "sig": {"class_type": "BasicScheduler",
+                    "inputs": {"model": ["ckpt", 0], "scheduler": "normal",
+                               "steps": 2, "denoise": 1.0}},
+            "guide": {"class_type": "CFGGuider",
+                      "inputs": {"model": ["ckpt", 0], "positive": ["pos", 0],
+                                 "negative": ["neg", 0], "cfg": 3.0}},
+            "run": {"class_type": "SamplerCustomAdvanced",
+                    "inputs": {"noise": ["noise", 0], "guider": ["guide", 0],
+                               "sampler": ["sel", 0], "sigmas": ["sig", 0],
+                               "latent_image": ["latent", 0]}},
+            "dec": {"class_type": "VAEDecode",
+                    "inputs": {"samples": ["run", 0], "vae": ["ckpt", 2]}},
+        }
+        out = run_workflow(wf)
+        images = out["dec"][0]
+        assert images.shape[0] == 1 and images.shape[-1] == 3
+        assert np.isfinite(np.asarray(images)).all()
+
     def test_latent_upscale_absolute_dims(self, tmp_path, monkeypatch):
         from comfyui_parallelanything_tpu.nodes import NODE_CLASS_MAPPINGS
 
