@@ -14,8 +14,14 @@ surface. The TPU equivalent is a backend registry:
   take the pallas kernel, and materializing logits there needs >100 GB.
 - ``"pallas"`` — fused flash-attention kernel for TPU (ops/pallas/), used for the long
   sequences of the FLUX/video configs.
-- ``"auto"``   — pallas on TPU when available and the shape qualifies, else the
-  xla family (plain or chunked by size).
+- ``"pallas_jax"`` — jax's own battle-tested TPU flash kernel
+  (jax.experimental.pallas.ops.tpu.flash_attention) as an alternative fused
+  candidate: round-3's only hardware data point for the in-repo kernel was a
+  30-minute hang at 4.6k tokens, so the kernel sweep measures BOTH fused
+  implementations and the tuning table routes ``auto`` to whichever one
+  actually won (128-aligned head dims only — no padding logic upstream).
+- ``"auto"``   — the measured-best fused kernel on TPU when available and the
+  shape qualifies, else the xla family (plain or chunked by size).
 
 All functions take (B, S, H, D)-shaped q/k/v ("BSHD") and return (B, S, H, D).
 
@@ -46,8 +52,10 @@ def _initial_backend() -> str:
     invalid value falls back to "auto" rather than erroring at import time.
     """
     name = os.environ.get("PA_TPU_ATTENTION_BACKEND", "auto")
-    return name if name in ("auto", "xla", "xla_chunked", "pallas") else "auto"
+    return name if name in _BACKEND_NAMES else "auto"
 
+
+_BACKEND_NAMES = ("auto", "xla", "xla_chunked", "pallas", "pallas_jax")
 
 _BACKEND = _initial_backend()
 
@@ -94,7 +102,7 @@ def resolved_backends() -> tuple[str, ...]:
 
 def set_attention_backend(name: str) -> None:
     global _BACKEND
-    if name not in ("auto", "xla", "xla_chunked", "pallas"):
+    if name not in _BACKEND_NAMES:
         raise ValueError(f"unknown attention backend {name!r}")
     _BACKEND = name
 
@@ -147,6 +155,21 @@ def _xla_chunked_attention(q, k, v, scale):
     return out[:, :Sq]
 
 
+def _pallas_jax_attention(q, k, v, scale):
+    """jax's upstream fused TPU flash kernel, adapted from this module's BSHD
+    layout to its BHSD one. TPU-only (no interpret path is wired); head dim
+    must be 128-aligned (the upstream kernel has no lane-padding logic). Block
+    sizes are left to the upstream defaults — its own heuristics are part of
+    what makes it the battle-tested candidate."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as jax_flash,
+    )
+
+    qt, kt, vt = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+    out = jax_flash(qt, kt, vt, sm_scale=float(scale))
+    return out.transpose(0, 2, 1, 3)
+
+
 @functools.cache
 def _pallas_available() -> bool:
     from ..devices.discovery import is_tpu_device
@@ -180,7 +203,19 @@ def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
             and k.shape[1] % 128 == 0
             and pallas_wins(q.shape[1], q.shape[-1])
         )
-        backend = "pallas" if use_pallas else "xla"
+        if use_pallas:
+            from .pallas.tuning import fused_backend
+
+            # Which fused implementation won the measurement at this shape
+            # class (in-repo streamed-KV kernel vs jax's upstream one).
+            backend = fused_backend(q.shape[1], q.shape[-1])
+        else:
+            backend = "xla"
+    if backend == "pallas_jax" and q.shape[-1] % 128 != 0:
+        # The upstream kernel has no lane padding; a FORCED pallas_jax (the
+        # watchdog's probe-failure fallback) on a 40/64-dim head takes the
+        # safe XLA family rather than the unprobed in-repo padded path.
+        backend = "xla"
     if backend == "xla" and logit_elems > _CHUNK_THRESHOLD:
         # "xla" means the XLA family: shapes whose S×S logits would blow HBM
         # (pallas-ineligible 40/64-dim UNet heads at 1024², or a forced
@@ -195,6 +230,8 @@ def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
         return flash_attention(
             q, k, v, scale=scale, block_q=block_q, block_k=block_k
         )
+    if backend == "pallas_jax":
+        return _pallas_jax_attention(q, k, v, scale)
     if backend == "xla_chunked":
         return _xla_chunked_attention(q, k, v, scale)
     return _xla_attention(q, k, v, scale)

@@ -20,7 +20,11 @@ import functools
 import json
 import os
 
-_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tuning.json")
+# PA_TUNING_PATH override exists for the watchdog dry-run (tests write a
+# throwaway measured table without touching the packaged one).
+_PATH = os.environ.get("PA_TUNING_PATH") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "tuning.json"
+)
 
 _DEFAULT = {
     "source": "default",       # "measured" once bench_kernels --apply ran
@@ -77,7 +81,14 @@ def best_blocks(seq: int, head_dim: int | None = None) -> tuple[int, int]:
         same_dim = [e for e in entries if e.get("head_dim") == head_dim]
         if same_dim:
             entries = same_dim
-        elif head_dim % 128 == 0:
+        elif head_dim % 128 != 0:
+            # Padded dim with no same-dim measurement: return the defaults
+            # rather than inheriting blocks tuned for a different dim class —
+            # mirrors pallas_wins' filtering, which matters when a forced
+            # (non-auto) pallas backend runs a padded shape the sweep never
+            # measured.
+            entries = []
+        else:
             # Aligned dims must not inherit blocks tuned under the padded-FLOP
             # regime of a different dim class (mirrors pallas_wins).
             entries = [
@@ -88,6 +99,38 @@ def best_blocks(seq: int, head_dim: int | None = None) -> tuple[int, int]:
         return int(t["block_q"]), int(t["block_k"])
     e = _nearest(entries, seq)
     return int(e["block_q"]), int(e["block_k"])
+
+
+def _fused_ms(e: dict):
+    """Best measured fused-kernel time for an entry: min over the in-repo
+    kernel (``pallas_ms``) and jax's upstream one (``pallas_jax_ms``)."""
+    times = [e.get("pallas_ms"), e.get("pallas_jax_ms")]
+    times = [t for t in times if t is not None]
+    return min(times) if times else None
+
+
+def fused_backend(seq: int, head_dim: int | None = None) -> str:
+    """Which fused implementation serves this shape class: "pallas_jax" when
+    jax's upstream kernel measured faster at the nearest benchmarked length
+    (and the dim is lane-aligned — upstream has no padding logic), else the
+    in-repo "pallas"."""
+    if head_dim is not None and head_dim % 128 != 0:
+        return "pallas"
+    t = kernel_tuning()
+    entries = [e for e in t["entries"] if _fused_ms(e) is not None]
+    if head_dim is not None:
+        same_dim = [e for e in entries if e.get("head_dim") == head_dim]
+        entries = same_dim or [
+            e for e in entries
+            if e.get("head_dim") is None or e.get("head_dim", 0) % 128 == 0
+        ]
+    if not entries:
+        return "pallas"
+    e = _nearest(entries, seq)
+    pj, pm = e.get("pallas_jax_ms"), e.get("pallas_ms")
+    if pj is not None and (pm is None or pj < pm):
+        return "pallas_jax"
+    return "pallas"
 
 
 def pallas_wins(seq: int, head_dim: int | None = None) -> bool:
@@ -102,7 +145,7 @@ def pallas_wins(seq: int, head_dim: int | None = None) -> bool:
     a pallas win: that is a length where the fused kernel is mandatory, not
     absent data."""
     t = kernel_tuning()
-    entries = [e for e in t["entries"] if e.get("pallas_ms") is not None]
+    entries = [e for e in t["entries"] if _fused_ms(e) is not None]
     padded_dim = head_dim is not None and head_dim % 128 != 0
     if head_dim is not None:
         same_dim = [e for e in entries if e.get("head_dim") == head_dim]
@@ -126,7 +169,7 @@ def pallas_wins(seq: int, head_dim: int | None = None) -> bool:
         return False
     if e.get("xla_ms") is None:
         return True
-    return float(e["pallas_ms"]) <= float(e["xla_ms"])
+    return float(_fused_ms(e)) <= float(e["xla_ms"])
 
 
 def write_tuning(data: dict) -> str:

@@ -70,15 +70,49 @@ def batch_sharded(mesh: Mesh, axis: str = AXIS_DATA, ndim: int | None = None) ->
     return NamedSharding(mesh, P(axis))
 
 
+# Cap on transfer bytes in flight during big-pytree placement. A whole-pytree
+# jax.device_put dispatches every leaf's transfer at once; on a 16 GiB chip a
+# ~12 GiB model leaves no headroom for the staging the concurrent transfers
+# need (round-3 evidence: flux_16_int8 OOM'd while *placing* the int8 pytree,
+# BASELINE_measured.json fallback_stderr). Draining the queue every N bytes is
+# the reference's incremental key-by-key state-dict copy trick
+# (any_device_parallel.py:639-665) applied to device_put.
+_MAX_INFLIGHT_BYTES = 1 << 30
+
+
+def streamed_tree_put(tree, sharding_for_leaf, max_inflight_bytes=_MAX_INFLIGHT_BYTES):
+    """Place a pytree leaf-by-leaf with bounded in-flight transfer bytes.
+
+    ``sharding_for_leaf`` maps each leaf to its target ``Sharding`` (or device).
+    Transfers still overlap (XLA dispatch is async) but the queue is drained
+    with ``block_until_ready`` whenever the un-acknowledged bytes exceed the
+    cap, so placement-time device peak stays ~total + cap instead of
+    total + all-concurrent staging.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    placed, inflight, inflight_bytes = [], [], 0
+    for leaf in leaves:
+        out = jax.device_put(leaf, sharding_for_leaf(leaf))
+        placed.append(out)
+        nbytes = getattr(out, "nbytes", 0)
+        if nbytes:
+            inflight.append(out)
+            inflight_bytes += nbytes
+        if inflight_bytes >= max_inflight_bytes:
+            jax.block_until_ready(inflight)
+            inflight, inflight_bytes = [], 0
+    return jax.tree.unflatten(treedef, placed)
+
+
 def place_params(params, mesh: Mesh) -> object:
-    """Replicate a parameter pytree onto the mesh in one transfer per leaf.
+    """Replicate a parameter pytree onto the mesh, streamed leaf-by-leaf.
 
     This is the entire replacement for the reference's replica build loop + incremental
     state-dict copy (1056-1128, 636-665): XLA broadcasts each buffer over ICI, there is
     no 2× host peak, and the pytree remains a single logical value.
     """
     sharding = replicated(mesh)
-    return jax.device_put(params, sharding)
+    return streamed_tree_put(params, lambda _: sharding)
 
 
 def fsdp_spec(shape: tuple[int, ...], axis: str, n: int, min_size: int = 2**16) -> P:
@@ -123,11 +157,11 @@ def place_params_sharded(
     """
     n = mesh.shape[axis]
 
-    def put(leaf):
+    def sharding_for(leaf):
         spec = fsdp_spec(tuple(getattr(leaf, "shape", ())), axis, n, min_size)
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return NamedSharding(mesh, spec)
 
-    return jax.tree.map(put, params)
+    return streamed_tree_put(params, sharding_for)
 
 
 def place_params_fsdp(params, mesh: Mesh, axis: str = AXIS_DATA) -> object:

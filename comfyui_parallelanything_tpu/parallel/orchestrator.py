@@ -520,7 +520,11 @@ class ParallelModel:
         """Lazy full-pytree copy on the lead device — the shared placement for
         the eager single() fallback and traceable()'s single-device spec."""
         if self._lead_params is None:
-            self._lead_params = jax.device_put(self._host_params, self.lead_device)
+            from .mesh import streamed_tree_put
+
+            self._lead_params = streamed_tree_put(
+                self._host_params, lambda _: self.lead_device
+            )
         return self._lead_params
 
     def _data_parallel(self, batch, x, timesteps, context, kwargs):

@@ -31,10 +31,14 @@ def main() -> None:
     from comfyui_parallelanything_tpu.sampling.runner import run_sampler
     from comfyui_parallelanything_tpu.utils import enable_compilation_cache
 
+    from bench import _TPU_PLATFORMS, evidence_dir
+
     enable_compilation_cache()
     dev = jax.devices()[0]
-    on_tpu = dev.platform in ("tpu", "axon")
+    on_tpu = dev.platform in _TPU_PLATFORMS
     steps = int(os.environ.get("BENCH_STEPS", "20"))
+    if os.environ.get("PA_BENCH_TINY") == "1":
+        on_tpu = False  # dry-run: record flows as TPU, workload stays smoke-size
     if on_tpu:
         batch, latent, ctx_len = 8, 64, 77   # 512² SD1.5-class
         cfg = sd15_config(dtype=jnp.bfloat16)
@@ -73,7 +77,7 @@ def main() -> None:
         rec[key] = round(sec, 4)
     rec["compiled_speedup"] = round(rec["eager_s"] / rec["compiled_s"], 3)
     print(json.dumps(rec))
-    with open(os.path.join(_REPO, "SAMPLER_LOOP_BENCH.json"), "a") as f:
+    with open(os.path.join(evidence_dir(), "SAMPLER_LOOP_BENCH.json"), "a") as f:
         f.write(json.dumps(rec) + "\n")
 
 

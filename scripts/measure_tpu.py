@@ -26,8 +26,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Ascending cost so a mid-ladder tunnel flap still banks the cheap rungs.
 LADDER = (
-    "smoke", "sd15_16", "sdxl_8", "zimage_21", "flux_16", "flux_16_int8",
-    "wan_video",
+    "smoke", "sd15_16", "sdxl_8", "hybrid_sd15", "zimage_21", "flux_16",
+    "flux_16_int8", "wan_video",
 )
 
 
@@ -35,7 +35,7 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 
-def run_rung(rung: str, timeout: int = 3200) -> dict:
+def run_rung(rung: str, timeout: int = 3200, extra_env: dict | None = None) -> dict:
     # timeout covers bench.py's own worst case: ≤240s TPU probe + 1800s inner
     # child + 900s CPU fallback; anything tighter kills the honest fallback
     # line mid-write and records a bare error instead.
@@ -45,6 +45,8 @@ def run_rung(rung: str, timeout: int = 3200) -> dict:
 
     env = dict(os.environ)
     env["BENCH_CONFIG"] = rung
+    if extra_env:
+        env.update(extra_env)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -75,8 +77,10 @@ def record_result(rec: dict) -> dict:
     """Stamp and append one rung result to ``BASELINE_measured.json`` — the one
     writer for the evidence file (measure_tpu CLI and tpu_watchdog both go
     through here so the record format cannot drift)."""
+    from bench import evidence_dir
+
     rec["ts"] = time.time()
-    with open(os.path.join(_REPO, "BASELINE_measured.json"), "a") as f:
+    with open(os.path.join(evidence_dir(), "BASELINE_measured.json"), "a") as f:
         f.write(json.dumps(rec) + "\n")
     return rec
 

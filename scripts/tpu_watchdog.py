@@ -37,11 +37,19 @@ import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_TPU = ("tpu", "axon")
+sys.path.insert(0, _REPO)
+# bench.py owns the platform tuple and evidence-dir override (PA_FAKE_TPU_PLATFORM
+# / PA_EVIDENCE_DIR enable the mocked end-to-end dry-run the round-3 window
+# showed this pipeline needs before it runs unattended on hardware).
+from bench import _TPU_PLATFORMS as _TPU, evidence_dir  # noqa: E402
 
 # Highest-value first: the README-repro rung carries the vs_baseline headline
-# (reference 26.00 s/it, /root/reference/README.md:54-56).
-RUNGS = ("zimage_21", "sd15_16", "sdxl_8", "flux_16_int8", "flux_16", "wan_video")
+# (reference 26.00 s/it, /root/reference/README.md:54-56). hybrid_sd15 (the
+# tpu:0+cpu two-platform chain, SURVEY §7 hard part 1 on real hardware) sits
+# after the headline trio: cheap enough for a modest window, less valuable
+# than the README repro.
+RUNGS = ("zimage_21", "sd15_16", "sdxl_8", "hybrid_sd15", "flux_16_int8",
+         "flux_16", "wan_video")
 
 def _attemptable(rung: str) -> bool:
     # Every rung survives a forced non-pallas run: the "xla" backend family
@@ -59,6 +67,47 @@ _FAILS: dict[str, int] = {}
 _MAX_FAILS = 3
 _PALLAS_FAILS = 0
 _PALLAS_PROBED = False
+
+# OOM-recovery ladders (VERDICT r3 next-1): when a rung's failure record shows
+# resource exhaustion, the next attempt in the SAME window runs one step deeper
+# on the sequential-microbatch ladder (bench.py BENCH_MICROBATCH) instead of
+# burning a strike on a failure we know how to fix. First entry = the rung's
+# own built-in default (no env override).
+_MB_LADDERS: dict[str, tuple[int, ...]] = {
+    "zimage_21": (3, 7, 21),
+    "flux_16_int8": (4, 8, 16),
+    "flux_16": (1, 2, 4, 8),
+    "sd15_16": (1, 2, 4),
+    "sdxl_8": (1, 2, 4),
+}
+_MB_IDX: dict[str, int] = {}
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM", "Resource exhausted")
+
+
+def _looks_oom(rec: dict) -> bool:
+    text = f"{rec.get('fallback_stderr', '')} {rec.get('error', '')}"
+    return any(m in text for m in _OOM_MARKERS)
+
+
+def _rung_env(rung: str) -> dict:
+    idx = _MB_IDX.get(rung, 0)
+    if idx == 0 or rung not in _MB_LADDERS:
+        return {}
+    return {"BENCH_MICROBATCH": str(_MB_LADDERS[rung][idx])}
+
+
+def _deepen(rung: str) -> bool:
+    """Advance the rung's microbatch ladder; True if there was a deeper step."""
+    ladder = _MB_LADDERS.get(rung, ())
+    idx = _MB_IDX.get(rung, 0)
+    if idx + 1 < len(ladder):
+        _MB_IDX[rung] = idx + 1
+        _log(f"{rung}: OOM — deepening microbatch to "
+             f"{ladder[idx + 1]} for the next attempt")
+        return True
+    return False
 
 
 def probe(timeout: int = 90) -> bool:
@@ -133,9 +182,15 @@ def probe_pallas_hardware(timeout: int = 600) -> None:
     elif probe():
         _PALLAS_FAILS += 1
         if _PALLAS_FAILS >= 2:
-            os.environ["PA_TPU_ATTENTION_BACKEND"] = "xla"
+            # Escalation ladder: before giving up on fused attention entirely,
+            # probe jax's upstream kernel at the same shapes — round 3 showed
+            # the in-repo kernel can wedge where a second implementation may
+            # not, and a fused path is worth ~2-5x at FLUX/video lengths.
+            fallback = "pallas_jax" if _probe_pallas_jax(timeout) else "xla"
+            os.environ["PA_TPU_ATTENTION_BACKEND"] = fallback
             _log(f"pallas hardware probe FAILED {_PALLAS_FAILS}x on a live "
-                 f"tunnel — forcing xla attention for all child runs: {tail}")
+                 f"tunnel — forcing {fallback} attention for all child runs: "
+                 f"{tail}")
             _PALLAS_PROBED = True
         else:
             _log(f"pallas hardware probe failed on a live tunnel "
@@ -146,10 +201,42 @@ def probe_pallas_hardware(timeout: int = 600) -> None:
         _log(f"pallas probe inconclusive (tunnel flapped): {tail}")
 
 
+def _probe_pallas_jax(timeout: int = 600) -> bool:
+    """Bounded-subprocess probe of jax's upstream fused kernel at the rung
+    shapes (the pallas_jax fallback candidate). True only if every shape runs
+    on a real TPU."""
+    for b, s, h in _PALLAS_PROBE_SHAPES:
+        code = (
+            "import jax, jax.numpy as jnp\n"
+            "from comfyui_parallelanything_tpu.ops.attention "
+            "import _pallas_jax_attention\n"
+            "from comfyui_parallelanything_tpu.utils.metrics import force_ready\n"
+            f"assert jax.devices()[0].platform in {_TPU!r}, 'not on TPU'\n"
+            f"q = jnp.ones(({b}, {s}, {h}, 128), jnp.bfloat16)\n"
+            "out = _pallas_jax_attention(q, q, q, 0.09)\n"
+            "force_ready(out)\n"
+            "assert out.shape == q.shape\n"
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], env=dict(os.environ), cwd=_REPO,
+                capture_output=True, text=True, timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            _log(f"pallas_jax probe seq={s} timed out")
+            return False
+        if proc.returncode != 0:
+            _log(f"pallas_jax probe seq={s} failed: "
+                 f"{proc.stderr.strip()[-200:]}")
+            return False
+        _log(f"pallas_jax probe OK at seq={s}")
+    return True
+
+
 def _tpu_records(filename: str):
     """Parsed TPU-measured records from a repo JSON-Lines artifact (all three
     evidence files append one JSON object per line)."""
-    path = os.path.join(_REPO, filename)
+    path = os.path.join(evidence_dir(), filename)
     if not os.path.exists(path):
         return
     with open(path) as f:
@@ -166,13 +253,21 @@ def banked_rungs() -> set[str]:
     return {r.get("rung") for r in _tpu_records("BASELINE_measured.json")}
 
 
+def _tuning_path() -> str:
+    # Mirrors ops/pallas/tuning.py's _PATH resolution. Duplicated ON PURPOSE:
+    # importing that module pulls in the package __init__ chain (jax), and a
+    # wedged axon tunnel hangs `import jax` — the watchdog process must stay
+    # jax-free (see probe()'s subprocess design).
+    return os.environ.get("PA_TUNING_PATH") or os.path.join(
+        _REPO, "comfyui_parallelanything_tpu", "ops", "pallas", "tuning.json"
+    )
+
+
 def kernels_banked() -> bool:
     """The sweep is banked only when ``--apply`` wrote a measured tuning table
     (its last act): per-shape KERNEL_BENCH.json lines land incrementally, so a
     mid-sweep wedge must read as incomplete, not banked."""
-    path = os.path.join(
-        _REPO, "comfyui_parallelanything_tpu", "ops", "pallas", "tuning.json"
-    )
+    path = _tuning_path()
     try:
         with open(path) as f:
             return json.load(f).get("source") == "measured"
@@ -196,11 +291,8 @@ def stale_after_tuning() -> list[str]:
     """Rungs banked BEFORE the measured tuning table was written."""
     if not kernels_banked():
         return []
-    path = os.path.join(
-        _REPO, "comfyui_parallelanything_tpu", "ops", "pallas", "tuning.json"
-    )
     try:
-        table_ts = os.path.getmtime(path)
+        table_ts = os.path.getmtime(_tuning_path())
     except OSError:
         return []
     stale = []
@@ -238,10 +330,12 @@ def bank_one() -> bool:
     for rung in sorted(candidates, key=lambda r: (_FAILS.get(r, 0),
                                                   RUNGS.index(r))):
         _log(f"running rung {rung}")
-        rec = record_result(run_rung(rung))
+        rec = record_result(run_rung(rung, extra_env=_rung_env(rung)))
         ok = rec.get("platform") in _TPU
         if ok:
             _run_script("render_measured.py", timeout=120)
+        elif _looks_oom(rec) and _deepen(rung):
+            pass  # actionable failure with a known fix — no strike
         else:
             _strike(rung, f"rung {rung}")
         _log(f"rung {rung}: platform={rec.get('platform')} "
@@ -264,7 +358,7 @@ def bank_one() -> bool:
         return True
     for rung in stale_after_tuning():
         _log(f"re-running rung {rung} under the measured tuning table")
-        rec = record_result(run_rung(rung))
+        rec = record_result(run_rung(rung, extra_env=_rung_env(rung)))
         ok = rec.get("platform") in _TPU
         if ok:
             _run_script("render_measured.py", timeout=120)

@@ -48,6 +48,30 @@ class TestFsdpPlacement:
         assert shard_shapes in ({(128, 512)}, {(1024, 64)})
         assert len(placed["small"].sharding.device_set) == 8  # replicated
 
+    def test_streamed_put_matches_direct_device_put(self, cpu_devices):
+        # streamed_tree_put (the int8-placement OOM fix, VERDICT r3 next-1)
+        # must be value- and sharding-identical to a whole-pytree device_put;
+        # a tiny in-flight cap forces several drain cycles through the loop.
+        import numpy as np
+
+        from comfyui_parallelanything_tpu.parallel.mesh import (
+            replicated,
+            streamed_tree_put,
+        )
+
+        mesh = build_mesh(cpu_devices, {AXIS_DATA: 8})
+        params = {f"w{i}": jnp.full((64, 64), float(i)) for i in range(6)}
+        sharding = replicated(mesh)
+        streamed = streamed_tree_put(
+            params, lambda _: sharding, max_inflight_bytes=1
+        )
+        direct = jax.device_put(params, sharding)
+        for k in params:
+            assert streamed[k].sharding == direct[k].sharding
+            np.testing.assert_array_equal(
+                np.asarray(streamed[k]), np.asarray(direct[k])
+            )
+
 
 class TestFsdpEndToEnd:
     def test_fsdp_matches_replicate(self, cpu_devices):

@@ -150,6 +150,28 @@ class TestChunkedAttention:
         finally:
             att.set_attention_backend("auto")
 
+    def test_forced_pallas_jax_padded_dim_takes_xla_family(self, monkeypatch):
+        # The watchdog's probe-failure fallback forces pallas_jax globally;
+        # 40/64-dim heads (upstream kernel has no lane padding) must route to
+        # the safe XLA family — including the chunked path for big logits —
+        # not to the unprobed in-repo padded kernel.
+        att = self._mod()
+        att.set_attention_backend("pallas_jax")
+        try:
+            monkeypatch.setattr(att, "_RESOLVED", set())
+            q, k, v = _qkv(b=1, sq=16, sk=16, h=1, d=4)  # 4 % 128 != 0
+            out = att.attention_local(q, k, v)
+            ref = att._xla_attention(q, k, v, scale=4 ** -0.5)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-2, atol=2e-2)
+            assert att.resolved_backends() == ("xla",)
+            monkeypatch.setattr(att, "_CHUNK_THRESHOLD", 64)
+            monkeypatch.setattr(att, "_RESOLVED", set())
+            att.attention_local(*_qkv(b=1, sq=32, sk=32, h=2, d=8))
+            assert att.resolved_backends() == ("xla_chunked",)
+        finally:
+            att.set_attention_backend("auto")
+
 
 class TestKernelTuning:
     """Data-driven block sizes / backend choice (ops/pallas/tuning.py): the
@@ -279,6 +301,48 @@ class TestKernelTuning:
         # the cheap plain-XLA competitor there) through the padded kernel.
         assert tuning.pallas_wins(256, 40) is False
         assert tuning.pallas_wins(8192, 40) is True  # within 2x of 16384
+
+    def test_padded_dim_blocks_never_inherit_aligned_winners(self, monkeypatch):
+        # ADVICE r3: best_blocks for a padded dim with NO same-dim entry must
+        # return the defaults, mirroring pallas_wins' filtering — under a
+        # forced pallas backend the kernel would otherwise run blocks tuned
+        # for the wrong dim class.
+        from comfyui_parallelanything_tpu.ops.pallas import tuning
+
+        table = self._table([
+            {"seq": 4608, "head_dim": 128, "block_q": 512, "block_k": 512,
+             "pallas_ms": 1.0, "xla_ms": 2.0},
+        ])
+        monkeypatch.setattr(
+            tuning, "kernel_tuning", lambda: {**tuning._DEFAULT, **table}
+        )
+        assert tuning.best_blocks(4608, head_dim=40) == (256, 256)
+        assert tuning.best_blocks(4608, head_dim=128) == (512, 512)
+
+    def test_fused_backend_picks_measured_winner(self, monkeypatch):
+        # Two fused candidates (in-repo kernel vs jax's upstream one): auto
+        # routes to whichever measured faster; padded dims always take the
+        # in-repo kernel (upstream has no lane padding); a shape where ONLY
+        # the upstream kernel produced a number (round-3's hang scenario)
+        # still counts as a fused win over XLA.
+        from comfyui_parallelanything_tpu.ops.pallas import tuning
+
+        table = self._table([
+            {"seq": 4608, "head_dim": 128, "block_q": 256, "block_k": 256,
+             "pallas_ms": None, "pallas_jax_ms": 3.0, "xla_ms": 9.0},
+            {"seq": 16384, "head_dim": 128, "block_q": 256, "block_k": 256,
+             "pallas_ms": 2.0, "pallas_jax_ms": 4.0, "xla_ms": 9.0},
+        ])
+        monkeypatch.setattr(
+            tuning, "kernel_tuning", lambda: {**tuning._DEFAULT, **table}
+        )
+        assert tuning.fused_backend(4608, 128) == "pallas_jax"
+        assert tuning.fused_backend(16384, 128) == "pallas"
+        assert tuning.fused_backend(4608, 40) == "pallas"  # padded dim
+        assert tuning.pallas_wins(4608, 128) is True  # jax-kernel-only entry
+        # No measurements at all: default to the in-repo kernel.
+        monkeypatch.setattr(tuning, "kernel_tuning", lambda: dict(tuning._DEFAULT))
+        assert tuning.fused_backend(4608, 128) == "pallas"
 
     def test_aligned_blocks_ignore_padded_dim_entries(self, monkeypatch):
         # A partial sweep can leave ONLY padded-dim entries (per-shape

@@ -1,0 +1,181 @@
+"""End-to-end dry-run of the TPU-evidence watchdog against a mocked TPU.
+
+Round-3 post-mortem (VERDICT r3): the one live tunnel window was lost to three
+infrastructure bugs because the watchdog → measure → kernel-sweep → retune
+pipeline had never executed end to end anywhere. This test runs the REAL
+``scripts/tpu_watchdog.py`` process — real subprocess tree, real bench.py
+children, real artifact writes — with the platform check faked to CPU
+(``PA_FAKE_TPU_PLATFORM=cpu``), every artifact redirected to a temp dir
+(``PA_EVIDENCE_DIR`` / ``PA_TUNING_PATH``), and every rung shrunk to the smoke
+workload (``PA_BENCH_TINY=1``).
+
+What must hold by exit:
+- the watchdog terminates on its own ("all attemptable TPU evidence banked");
+- all six ladder rungs banked, the README-repro headline (zimage_21) FIRST;
+- the kernel sweep ran and ``--apply`` wrote a measured tuning table;
+- the sampler-loop bench banked;
+- rungs banked before the tuning table landed were re-run once after it
+  (the retune flow);
+- BASELINE.md's measured section was re-rendered — in the temp dir;
+- the repo's real evidence files were never touched (the fake-platform guard).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _dryrun_env(evidence: str) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PA_TPU_ATTENTION_BACKEND", None)
+    # One host device: the dry-run tests pipeline control flow, not sharding
+    # (the 8-device mesh path has its own suite), and single-device children
+    # compile noticeably faster.
+    env["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    ).strip()
+    env["PA_FAKE_TPU_PLATFORM"] = "cpu"
+    env["PA_EVIDENCE_DIR"] = evidence
+    env["PA_TUNING_PATH"] = os.path.join(evidence, "tuning.json")
+    env["PA_BENCH_TINY"] = "1"
+    env["KERNEL_SWEEP"] = "0"
+    env["BENCH_STEPS"] = "3"
+    existing = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = _REPO + (os.pathsep + existing if existing else "")
+    return env
+
+
+def _records(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_watchdog_banks_everything_end_to_end(tmp_path):
+    evidence = str(tmp_path / "evidence")
+    os.makedirs(evidence)
+    # No BASELINE.md seeded here on purpose: render_measured.py must seed its
+    # evidence-dir copy from the repo's file on first run.
+
+    real_measured = os.path.join(_REPO, "BASELINE_measured.json")
+    real_before = open(real_measured).read() if os.path.exists(real_measured) else None
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "tpu_watchdog.py"),
+         "--interval", "1"],
+        env=_dryrun_env(evidence), cwd=_REPO,
+        capture_output=True, text=True, timeout=1500,
+    )
+    log = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"watchdog died:\n{log[-4000:]}"
+    assert "all attemptable TPU evidence banked" in log, log[-4000:]
+
+    # --- rung evidence: all six banked, headline first, honestly labeled ---
+    recs = _records(os.path.join(evidence, "BASELINE_measured.json"))
+    banked = [r for r in recs if r.get("platform") == "cpu"
+              and not r.get("invalid")]
+    rung_order = [r["rung"] for r in banked]
+    assert rung_order[0] == "zimage_21", (
+        f"headline rung must bank first, got order {rung_order}")
+    assert set(rung_order) >= {"zimage_21", "sd15_16", "sdxl_8", "hybrid_sd15",
+                               "flux_16", "flux_16_int8", "wan_video"}, rung_order
+    assert all(r.get("dryrun") for r in banked), "fake-platform records must " \
+        "carry the dryrun marker"
+    # The microbatch path ran (tiny rungs declare 2 sequential chunks).
+    assert any(r.get("microbatch_chunks") == 2 for r in banked)
+
+    # --- kernel sweep: KERNEL_BENCH lines + measured tuning table ---
+    kern = _records(os.path.join(evidence, "KERNEL_BENCH.json"))
+    assert {r.get("shape") for r in kern} >= {"tiny_128d", "tiny_40d"}
+    with open(os.path.join(evidence, "tuning.json")) as f:
+        table = json.load(f)
+    assert table["source"] == "measured"
+    assert table["entries"], "apply must persist per-shape entries"
+    dims = {e.get("head_dim") for e in table["entries"]}
+    assert {128, 40} <= dims, f"both dim classes must be measured, got {dims}"
+
+    # --- retune: rungs banked before the table got ONE re-run after it ---
+    table_ts = os.path.getmtime(os.path.join(evidence, "tuning.json"))
+    for rung in ("sd15_16", "sdxl_8"):
+        times = [r["ts"] for r in banked if r["rung"] == rung]
+        assert len(times) == 2, f"{rung}: expected bank + retune, got {times}"
+        assert min(times) < table_ts < max(times), (
+            f"{rung}: retune must postdate the tuning table")
+
+    # --- sampler-loop bench banked ---
+    samp = _records(os.path.join(evidence, "SAMPLER_LOOP_BENCH.json"))
+    assert samp and samp[0]["compiled_s"] > 0
+
+    # --- human-readable render landed in the evidence dir ---
+    md = open(os.path.join(evidence, "BASELINE.md")).read()
+    body = md.split("<!-- measured:begin -->")[1].split("<!-- measured:end -->")[0]
+    assert "zimage_21" in body and "tiny_128d" in body
+
+    # --- the repo's real evidence was never touched ---
+    real_after = open(real_measured).read() if os.path.exists(real_measured) else None
+    assert real_after == real_before
+    assert not os.path.exists(os.path.join(_REPO, "evidence"))
+
+
+def test_oom_deepens_microbatch_ladder_without_striking():
+    """The OOM-recovery ladder: a resource-exhausted failure advances the
+    rung's BENCH_MICROBATCH depth for the next same-window attempt instead of
+    burning a strike (VERDICT r3 next-1 fallback)."""
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    import tpu_watchdog as wd
+
+    wd._MB_IDX.clear()
+    try:
+        assert wd._rung_env("zimage_21") == {}
+        assert wd._looks_oom({"fallback_stderr": "xx RESOURCE_EXHAUSTED yy"})
+        assert wd._looks_oom({"error": "Out of memory allocating 1g"})
+        assert not wd._looks_oom({"fallback_stderr": "segmentation fault"})
+        assert wd._deepen("zimage_21")
+        assert wd._rung_env("zimage_21") == {"BENCH_MICROBATCH": "7"}
+        assert wd._deepen("zimage_21")
+        assert wd._rung_env("zimage_21") == {"BENCH_MICROBATCH": "21"}
+        assert not wd._deepen("zimage_21")  # ladder exhausted -> strikes resume
+        assert wd._rung_env("wan_video") == {}  # no ladder for this rung
+    finally:
+        wd._MB_IDX.clear()
+
+
+def test_bench_microbatch_override_rounds_to_divisor(tmp_path):
+    """BENCH_MICROBATCH=5 on a batch-8 tiny rung must round up to the next
+    divisor (8), never crash on indivisibility."""
+    env = _dryrun_env(str(tmp_path))
+    env["BENCH_CONFIG"] = "sd15_16"
+    env["BENCH_MICROBATCH"] = "5"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"), "--inner"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["microbatch_chunks"] == 8  # next divisor of batch 8 above 5
+
+
+def test_fake_platform_refuses_real_evidence_dir():
+    """The PA_FAKE_TPU_PLATFORM guard: without PA_EVIDENCE_DIR, bench.py must
+    refuse to run at all rather than risk a faked record in the real files."""
+    env = dict(os.environ)
+    env["PA_FAKE_TPU_PLATFORM"] = "cpu"
+    env.pop("PA_EVIDENCE_DIR", None)
+    existing = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = _REPO + (os.pathsep + existing if existing else "")
+    proc = subprocess.run(
+        [sys.executable, "-c", "import bench"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode != 0
+    assert "PA_EVIDENCE_DIR" in proc.stderr
