@@ -37,6 +37,9 @@ import os
 from typing import Any
 
 
+from .utils.progress import Interrupted, check_interrupt
+
+
 class WorkflowError(ValueError):
     """A malformed or unexecutable workflow graph (unknown node/class, bad
     link, cycle) — raised with the offending node id in the message."""
@@ -142,6 +145,7 @@ def run_workflow(
     workflow: Any,
     class_mappings: dict[str, type] | None = None,
     outputs: "dict[str, tuple] | WorkflowCache | None" = None,
+    on_node=None,
 ) -> dict[str, tuple]:
     """Execute a ComfyUI API-format workflow; returns ``{node_id: outputs}``.
 
@@ -153,6 +157,12 @@ def run_workflow(
     ComfyUI-style invalidation — stale/dropped entries are evicted (tearing
     down teardownable values like parallel models) and only the changed
     subgraph re-executes. Cache mode requires an acyclic graph.
+
+    ``on_node(nid)`` fires immediately before each node actually executes
+    (cached nodes are skipped, matching ComfyUI's ``executing`` event, which
+    the server layer forwards to /ws clients). A ``utils.progress.Interrupted``
+    raised inside a node (the cooperative sampler interrupt) propagates
+    unwrapped so callers can distinguish "interrupted" from "failed".
     """
     from .nodes import NODE_CLASS_MAPPINGS
 
@@ -301,10 +311,16 @@ def run_workflow(
                 kwargs[name] = nid
             else:
                 kwargs[name] = None
+        # Cooperative interrupt at node granularity (ComfyUI checks between
+        # nodes too, not only between sampler steps): a Cancel landing inside
+        # a non-sampler node stops the graph before the NEXT node runs.
+        check_interrupt(f"before node {nid}")
+        if on_node is not None:
+            on_node(nid)
         fn = getattr(cls(), cls.FUNCTION)
         try:
             out = fn(**kwargs)
-        except WorkflowError:
+        except (WorkflowError, Interrupted):
             raise
         except Exception as e:
             raise WorkflowError(

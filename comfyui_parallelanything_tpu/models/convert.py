@@ -147,13 +147,23 @@ def bake_lora(
             # kohya convention flattens dots to underscores and prefixes the module
             # tree root (e.g. lora_unet_double_blocks_0_img_attn_qkv).
             stripped = base
-            for prefix in ("lora_unet_", "lora_transformer_", "lora_te_", "lora_"):
+            for prefix in ("lora_unet_", "lora_transformer_", "lora_te1_",
+                           "lora_te2_", "lora_te_", "lora_"):
                 if stripped.startswith(prefix):
                     stripped = stripped[len(prefix):]
                     break
             key = by_normalized.get(f"{stripped}_weight".replace(".", "_"))
             if key is None:
                 key = by_normalized.get(stripped.replace(".", "_"))
+            if key is None:
+                # Prefixed sub-dicts (a text tower extracted as
+                # ``cond_stage_model.transformer.text_model...``): the LoRA
+                # base names only the module-tree suffix, so fall back to a
+                # unique suffix match. Ambiguity (two towers in one dict)
+                # skips — callers bake per tower with pre-filtered LoRA keys.
+                want = "_" + f"{stripped}_weight".replace(".", "_")
+                hits = [v for k, v in by_normalized.items() if k.endswith(want)]
+                key = hits[0] if len(hits) == 1 else None
             target = key
         if target is None:
             unmatched.append(base)

@@ -96,11 +96,20 @@ def load_safetensors_subset(
 
 
 def _maybe_bake(sd: dict, lora: Any, strength: float) -> dict:
+    """Bake one LoRA — or a STACK: ``lora`` may be a list of ``(lora, strength)``
+    pairs, applied in order (the stock LoraLoader chain; each shim link appends
+    to the list and the whole stack re-bakes from the source checkpoint)."""
     if lora is None:
         return sd
-    lora_sd = _resolve_state_dict(lora)
-    get_logger().info("baking LoRA (%d tensors, strength %.2f)", len(lora_sd), strength)
-    return bake_lora(sd, lora_sd, strength)
+    stack = lora if isinstance(lora, (list, tuple)) else [(lora, strength)]
+    for item in stack:
+        src_i, s_i = item if isinstance(item, (list, tuple)) else (item, strength)
+        lora_sd = _resolve_state_dict(src_i)
+        get_logger().info(
+            "baking LoRA (%d tensors, strength %.2f)", len(lora_sd), s_i
+        )
+        sd = bake_lora(sd, lora_sd, s_i)
+    return sd
 
 
 def load_flux_checkpoint(
@@ -175,7 +184,19 @@ def sniff_model_family(state_dict: Mapping[str, Any]) -> str:
         # 768 = CLIP-L (SD1.x); 1024 = OpenCLIP-H (SD2.x). eps-vs-v prediction
         # is not recorded in weights, so SD2.x defaults to the eps preset —
         # pass family explicitly (TPUCheckpointLoader) for v-prediction models.
-        return "sd21" if ctx == 1024 else "sd15"
+        if ctx == 1024:
+            # The most common SD2.1 checkpoint (768-v) is v-prediction; with
+            # the eps preset it silently produces garbage images. Make the
+            # default diagnosable at load time instead of debuggable at
+            # render time.
+            get_logger().warning(
+                "SD2.x checkpoint sniffed as 'sd21' (eps-prediction). If this "
+                "is a v-prediction model (e.g. the common 768-v checkpoint), "
+                "pass family='sd21-v' via TPUCheckpointLoader or images will "
+                "be garbage."
+            )
+            return "sd21"
+        return "sd15"
     raise ValueError(
         "cannot sniff model family: no known diffusion-model key signature "
         "(double_blocks/joint_blocks/self_attn/input_blocks) in checkpoint"

@@ -27,6 +27,20 @@ from .parallel.orchestrator import ParallelConfig, parallelize
 
 CATEGORY = "parallel/tpu"
 
+# Stock ComfyUI seed widgets are 64-bit: the UI's "randomize" fills the full
+# [0, 2**64) range. jax.random.key takes a SIGNED int64, so a seed >= 2**63
+# coming through the stock shims (nodes_compat) would raise OverflowError in
+# roughly half of randomly-seeded exported workflows.
+SEED_MAX = 2**64 - 1
+
+
+def seed_key(seed: int):
+    """``jax.random.key`` for any ComfyUI seed, folding the stock 64-bit range
+    deterministically into jax's signed-int64 domain."""
+    import jax
+
+    return jax.random.key(int(seed) % 2**63)
+
 
 def chain_from_wire(entries: list[dict[str, Any]] | None) -> DeviceChain:
     """DEVICE_CHAIN wire format → DeviceChain (drops pct <= 0, parity 876-882)."""
@@ -812,7 +826,7 @@ class TPUVAEEncode:
                     "seeded sampling and tile_size are exclusive"
                 )
             return ({"samples": encode_maybe_tiled(vae, x, tile_size)},)
-        rng = jax.random.key(seed) if seed >= 0 else None
+        rng = seed_key(seed) if seed >= 0 else None
         return ({"samples": vae.encode(x, rng)},)
 
 
@@ -986,7 +1000,10 @@ def _prepare_sampling_inputs(model, positive, negative, latent):
     patchify with an opaque reshape error), the missing-pooled FLUX warning,
     and uncond kwargs assembly.
 
-    Returns ``(model_cfg, context, pooled, uncond_context, uncond_kwargs)``."""
+    Returns ``(model_cfg, context, pooled, uncond_context, uncond_kwargs,
+    cond_extra)`` where ``cond_extra`` is the multi-cond kwargs dict for
+    ``run_sampler`` (``extra_conds`` / ``cond_area`` / ``cond_strength`` —
+    the stock ConditioningCombine/SetArea wire)."""
     import jax.numpy as jnp
 
     from .parallel.orchestrator import model_config_of
@@ -1029,7 +1046,28 @@ def _prepare_sampling_inputs(model, positive, negative, latent):
         if negative and negative.get("pooled") is not None
         else None
     )
-    return model_cfg, context, pooled, uncond_context, uncond_kwargs
+    # Multi-cond wire (stock ConditioningCombine/SetArea shims): extra conds
+    # ride the positive dict's "extras" tuple; a SetArea on the primary rides
+    # "area"/"strength". Negative-side extras have no uncond slot — warn and
+    # sample with the primary negative only (documented divergence).
+    extras = [
+        {**e, "context": bcast(e["context"]),
+         "pooled": bcast(e.get("pooled"))}
+        for e in positive.get("extras", ())
+    ]
+    if negative and (negative.get("extras") or negative.get("area") is not None):
+        from .utils.logging import get_logger
+
+        get_logger().warning(
+            "combined/area NEGATIVE conditioning is not supported — sampling "
+            "with the primary negative prompt, full-frame"
+        )
+    cond_extra = {
+        "extra_conds": extras,
+        "cond_area": positive.get("area"),
+        "cond_strength": float(positive.get("strength", 1.0)),
+    }
+    return model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra
 
 
 class TPUKSampler:
@@ -1053,7 +1091,7 @@ class TPUKSampler:
                 "model": ("MODEL", {}),
                 "positive": ("CONDITIONING", {}),
                 "latent": ("LATENT", {}),
-                "seed": ("INT", {"default": 0, "min": 0, "max": 2**31 - 1}),
+                "seed": ("INT", {"default": 0, "min": 0, "max": SEED_MAX}),
                 "steps": ("INT", {"default": 20, "min": 1, "max": 200}),
                 "cfg": ("FLOAT", {"default": 7.5, "min": 1.0, "max": 30.0}),
                 "sampler_name": (list(SAMPLER_NAMES), {"default": "dpmpp_2m"}),
@@ -1121,17 +1159,17 @@ class TPUKSampler:
 
         from .sampling.runner import run_sampler
 
-        rng = jax.random.key(seed)
+        rng = seed_key(seed)
         shape = latent["samples"].shape
         noise = jax.random.normal(rng, shape, jnp.float32)
-        model_cfg, context, pooled, uncond_context, uncond_kwargs = (
+        model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra = (
             _prepare_sampling_inputs(model, positive, negative, latent)
         )
         kwargs = {} if pooled is None else {"y": pooled}
         out = run_sampler(
             model, noise, context, sampler=sampler_name, steps=steps,
             cfg_scale=cfg, uncond_context=uncond_context,
-            uncond_kwargs=uncond_kwargs, rng=rng, shift=shift,
+            uncond_kwargs=uncond_kwargs, rng=rng, shift=shift, **cond_extra,
             guidance=guidance if guidance > 0 else None,
             scheduler=scheduler,
             cfg_rescale=cfg_rescale,
@@ -1376,7 +1414,7 @@ class TPURandomNoise:
     @classmethod
     def INPUT_TYPES(cls):
         return {"required": {
-            "noise_seed": ("INT", {"default": 0, "min": 0, "max": 2**31 - 1}),
+            "noise_seed": ("INT", {"default": 0, "min": 0, "max": SEED_MAX}),
         }}
 
     def get_noise(self, noise_seed: int):
@@ -1637,20 +1675,21 @@ class TPUSamplerCustomAdvanced:
         cfg = guider.get("cfg", 1.0)
         shape = latent_image["samples"].shape
         seed = noise["seed"]
-        rng = jax.random.key(0 if seed is None else seed)
+        rng = seed_key(0 if seed is None else seed)
         # DisableNoise (seed None) wires zeros: noise_scaling then keeps the
         # latent as the base — the split-sigma continuation contract.
         noise_arr = (
             jnp.zeros(shape, jnp.float32) if seed is None
             else jax.random.normal(rng, shape, jnp.float32)
         )
-        model_cfg, context, pooled, uncond_context, uncond_kwargs = (
+        model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra = (
             _prepare_sampling_inputs(model, positive, negative, latent_image)
         )
         prediction = getattr(model_cfg, "prediction", "eps")
         out = run_sampler(
             model, noise_arr, context,
             sampler=sampler["sampler"],
+            **cond_extra,
             steps=max(1, len(sigmas) - 1),
             sigmas=sigmas,
             cfg_scale=cfg,

@@ -111,9 +111,31 @@ class CheckpointLoaderSimple:
         # (LoRA applies to the checkpoint layout pre-conversion). Same
         # object.__setattr__ route the frozen dataclass uses for _jit_cache.
         object.__setattr__(model, "source", {"path": path, "family": family})
-        return model, self._bundled_clip(path, family), vae
+        # source_ckpt marks this CLIP wire as rebuildable-from-checkpoint: the
+        # LoraLoader shim's strength_clip rebuild must never clobber a wire
+        # that came from DualCLIPLoader/TPUCLIPLoader instead.
+        clip = {**self._bundled_clip(path, family), "source_ckpt": path}
+        return model, clip, vae
 
-    def _bundled_clip(self, path, family: str):
+    @staticmethod
+    def _te_filtered(loras, *prefixes: str):
+        """Per-tower text-encoder LoRA sub-stacks: keep only keys under the
+        given kohya tower prefixes (te1 = CLIP-L, te2 = OpenCLIP-G) so a
+        dual-tower LoRA can never bake its G deltas into the L tower via the
+        suffix-match fallback."""
+        from .models.loader import load_safetensors
+
+        out = []
+        for src, strength in loras or ():
+            if strength == 0.0:
+                continue
+            sd = src if isinstance(src, dict) else load_safetensors(src)
+            sub = {k: v for k, v in sd.items() if k.startswith(prefixes)}
+            if sub:
+                out.append((sub, strength))
+        return out
+
+    def _bundled_clip(self, path, family: str, te_loras=None):
         from .models import load_clip_text_checkpoint
         from .models.loader import load_safetensors_subset
 
@@ -135,6 +157,13 @@ class CheckpointLoaderSimple:
                         "checkpoint has no bundled cond_stage_model tower; "
                         "wire a TPUCLIPLoader node instead"
                     )
+                if te_loras:
+                    from .models.convert import bake_lora
+
+                    for sub, s in self._te_filtered(
+                        te_loras, "lora_te_", "lora_te1_"
+                    ):
+                        tower = bake_lora(tower, sub, s)
                 enc = load_clip_text_checkpoint(
                     tower, cfg=cfg, open_clip=open_clip
                 )
@@ -162,6 +191,16 @@ class CheckpointLoaderSimple:
                         "sdxl checkpoint has no bundled conditioner towers; "
                         "wire TPUCLIPLoader nodes instead"
                     )
+                if te_loras:
+                    from .models.convert import bake_lora
+
+                    # kohya dual-tower convention: te1 = CLIP-L, te2 = G.
+                    for sub, s in self._te_filtered(
+                        te_loras, "lora_te1_", "lora_te_"
+                    ):
+                        sub_l = bake_lora(sub_l, sub, s)
+                    for sub, s in self._te_filtered(te_loras, "lora_te2_"):
+                        sub_g = bake_lora(sub_g, sub, s)
                 enc_l = load_clip_text_checkpoint(sub_l)
                 enc_g = load_clip_text_checkpoint(
                     sub_g, cfg=open_clip_g_config(), open_clip=True
@@ -269,9 +308,12 @@ class LoraLoader:
     (MODEL, CLIP). LoRA bakes into the checkpoint layout BEFORE conversion
     (models/convert.bake_lora — the reference's patches-then-load order,
     any_device_parallel.py:971-1004), so this shim re-loads the tagged source
-    checkpoint with the LoRA applied. One LoRA per model (chain a second via
-    TPUCheckpointLoader's lora_path or bake offline); ``strength_clip`` is
-    accepted and ignored — text-encoder LoRA is a documented divergence."""
+    checkpoint with the LoRA applied. Chained LoraLoaders STACK: each link
+    appends to the accumulated ``(path, strength)`` list carried on the source
+    tag and the whole stack re-bakes in chain order. ``strength_clip`` bakes
+    the LoRA's text-encoder deltas (kohya ``lora_te*`` keys) into the bundled
+    CLIP towers the same way — the returned CLIP wire is rebuilt from the
+    source checkpoint when the LoRA carries te keys and strength_clip ≠ 0."""
 
     DESCRIPTION = "Stock-name LoRA loader (re-bakes from the source checkpoint)."
     RETURN_TYPES = ("MODEL", "CLIP")
@@ -306,11 +348,6 @@ class LoraLoader:
                 "source-checkpoint tag); for TPUCheckpointLoader models pass "
                 "lora_path on the loader itself"
             )
-        if source.get("lora"):
-            raise ValueError(
-                "stacking a second LoraLoader is not supported — bake "
-                "multiple LoRAs offline or use TPUCheckpointLoader lora_path"
-            )
         lora = resolve_model_file(lora_name, "loras")
         # An empty/missing name must not silently return an unpatched model
         # (TPUCheckpointLoader treats lora_path="" as no-LoRA).
@@ -319,15 +356,64 @@ class LoraLoader:
                 f"LoRA file not found: {lora_name!r} (searched "
                 f"$PA_MODELS_DIR/loras and the name as a path)"
             )
+        model_stack = list(source.get("loras", ())) + [(lora, strength_model)]
         patched, _ = TPUCheckpointLoader().load(
             ckpt_path=source["path"], family=source["family"],
-            lora_path=lora, lora_strength=strength_model,
+            lora_path=model_stack,
             load_vae=False,  # re-bake only needs the diffusion model
         )
+        clip_stack = list(source.get("te_loras", ())) + [(lora, strength_clip)]
         object.__setattr__(
-            patched, "source", {**source, "lora": lora}
+            patched, "source",
+            {**source, "loras": model_stack, "te_loras": clip_stack},
         )
+        clip = self._maybe_rebake_clip(clip, source, clip_stack)
         return patched, clip
+
+    @staticmethod
+    def _maybe_rebake_clip(clip, source: dict, clip_stack: list):
+        """Rebuild the CLIP wire with text-encoder LoRA deltas baked — only
+        when there is anything to bake (te keys present at nonzero clip
+        strength, checked from safetensors HEADERS before any tensor data is
+        read) and only for wires that actually came from this checkpoint's
+        bundled towers (``source_ckpt`` tag): an externally-loaded CLIP
+        (DualCLIPLoader) must never be clobbered by a rebuild."""
+        from .models.loader import load_safetensors, peek_safetensors
+        from .utils.logging import get_logger
+
+        te_prefixes = ("lora_te_", "lora_te1_", "lora_te2_")
+        active = [
+            (p, s) for p, s in clip_stack
+            if s != 0.0 and any(
+                k.startswith(te_prefixes) for k in peek_safetensors(p)
+            )
+        ]
+        if not active:
+            return clip
+        if not isinstance(clip, dict) or clip.get("source_ckpt") != source["path"]:
+            get_logger().warning(
+                "LoraLoader strength_clip: the CLIP wire did not come from "
+                "this checkpoint's bundled towers (DualCLIPLoader/TPUCLIPLoader"
+                ") — text-encoder LoRA deltas are NOT baked; bake them into "
+                "the encoder files offline if needed"
+            )
+            return clip
+        # Each active file loads ONCE per link; _bundled_clip's per-tower
+        # passes reuse the in-memory dicts (the source tag keeps paths, not
+        # multi-MB state dicts).
+        loaded = [(load_safetensors(p), s) for p, s in active]
+        rebuilt = CheckpointLoaderSimple()._bundled_clip(
+            source["path"], source["family"], te_loras=loaded
+        )
+        # Preserve wire state the chain added upstream (CLIPSetLastLayer's
+        # clip_skip tag, source_ckpt itself, etc.): stock patches the incoming
+        # clip object, so everything but the freshly-baked encoder fields must
+        # survive.
+        extra_state = {
+            k: v for k, v in clip.items()
+            if k not in rebuilt and k not in ("encoder", "tokenizer")
+        }
+        return {**rebuilt, **extra_state}
 
 
 class CLIPSetLastLayer:
@@ -484,6 +570,279 @@ class _EmptyLatent16ch:
         )
 
 
+class ConditioningCombine:
+    """Stock combine: BOTH conditionings apply during sampling. The second
+    cond (and any extras it accumulated) rides the first's ``extras`` tuple;
+    the sampler blends per-cond predictions area-weight-normalized
+    (sampling/k_samplers.EpsDenoiser._combine_conds — ComfyUI's
+    calc_cond_batch rule, minus its crop-run optimization)."""
+
+    DESCRIPTION = "Stock-name conditioning combine (both prompts apply)."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "combine"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning_1": ("CONDITIONING", {}),
+                "conditioning_2": ("CONDITIONING", {}),
+            }
+        }
+
+    def combine(self, conditioning_1, conditioning_2):
+        second = {k: v for k, v in conditioning_2.items() if k != "extras"}
+        extras = (
+            tuple(conditioning_1.get("extras", ()))
+            + (second,)
+            + tuple(conditioning_2.get("extras", ()))
+        )
+        return ({**conditioning_1, "extras": extras},)
+
+
+class ConditioningSetArea:
+    """Stock area conditioning: scope a prompt to a latent-space box. Widgets
+    are pixels (step 8, like stock); the wire stores latent units (//8)."""
+
+    DESCRIPTION = "Stock-name area conditioning (regional prompting)."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "append"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING", {}),
+                "width": ("INT", {"default": 64, "min": 8, "max": 16384,
+                                  "step": 8}),
+                "height": ("INT", {"default": 64, "min": 8, "max": 16384,
+                                   "step": 8}),
+                "x": ("INT", {"default": 0, "min": 0, "max": 16384, "step": 8}),
+                "y": ("INT", {"default": 0, "min": 0, "max": 16384, "step": 8}),
+                "strength": ("FLOAT", {"default": 1.0, "min": 0.0, "max": 10.0}),
+            }
+        }
+
+    def append(self, conditioning, width: int, height: int, x: int, y: int,
+               strength: float = 1.0):
+        # Stock conditioning_set_values maps over EVERY list entry — primary
+        # and combined extras alike get the box.
+        box = {
+            "area": (height // 8, width // 8, y // 8, x // 8),
+            "strength": float(strength),
+        }
+        out = {**conditioning, **box}
+        if conditioning.get("extras"):
+            out["extras"] = tuple(
+                {**e, **box} for e in conditioning["extras"]
+            )
+        return (out,)
+
+
+class ConditioningAverage:
+    """Stock average: lerp ``from`` into ``to`` at (1 − strength). Token-wise
+    over the overlap; ``to``'s trailing tokens survive unblended and a shorter
+    ``from`` is zero-padded — the stock node's exact rule."""
+
+    DESCRIPTION = "Stock-name conditioning average (prompt blending)."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "addWeighted"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning_to": ("CONDITIONING", {}),
+                "conditioning_from": ("CONDITIONING", {}),
+                "conditioning_to_strength": (
+                    "FLOAT", {"default": 1.0, "min": 0.0, "max": 1.0}
+                ),
+            }
+        }
+
+    def addWeighted(self, conditioning_to, conditioning_from,  # noqa: N802 — stock method name
+                    conditioning_to_strength: float):
+        import jax.numpy as jnp
+
+        s = float(conditioning_to_strength)
+        from_ctx = jnp.asarray(conditioning_from["context"])
+        p_from = conditioning_from.get("pooled")
+
+        def blend_one(cond: dict) -> dict:
+            to_ctx = jnp.asarray(cond["context"])
+            n = to_ctx.shape[1]
+            f = from_ctx
+            if f.shape[1] < n:
+                pad = [(0, 0)] * f.ndim
+                pad[1] = (0, n - f.shape[1])
+                f = jnp.pad(f, pad)
+            out = {**cond, "context": to_ctx * s + f[:, :n] * (1.0 - s)}
+            p_to = cond.get("pooled")
+            if p_to is not None and p_from is not None:
+                out["pooled"] = (jnp.asarray(p_to) * s
+                                 + jnp.asarray(p_from) * (1.0 - s))
+            return out
+
+        # Stock blends EVERY entry of the to-list — here the primary cond and
+        # each combined extra alike.
+        out = blend_one(conditioning_to)
+        if conditioning_to.get("extras"):
+            out["extras"] = tuple(
+                blend_one(e) for e in conditioning_to["extras"]
+            )
+        return (out,)
+
+
+# Stock upscale_method menu → jax.image.resize method. "area" has no jax
+# equivalent; bilinear is the closest downscale behavior (documented
+# divergence — stock uses adaptive average pooling there).
+_STOCK_RESIZE = {
+    "nearest-exact": "nearest",
+    "bilinear": "bilinear",
+    "area": "bilinear",
+    "bicubic": "cubic",
+    "lanczos": "lanczos3",
+}
+
+
+def _stock_resize(image, width: int, height: int, upscale_method: str,
+                  crop: str = "disabled"):
+    """The stock ImageScale core: optional center-crop to the target aspect
+    ratio, then resize. Returns a (B, H, W, C) float image in [0, 1]."""
+    import jax
+    import jax.numpy as jnp
+
+    method = _STOCK_RESIZE.get(upscale_method)
+    if method is None:
+        raise ValueError(
+            f"upscale_method must be one of {sorted(_STOCK_RESIZE)}, "
+            f"got {upscale_method!r}"
+        )
+    img = jnp.asarray(image)
+    if img.ndim == 3:
+        img = img[None]
+    if crop == "center":
+        b, h, w, c = img.shape
+        aspect = width / height
+        if w / h > aspect:  # too wide: crop columns
+            new_w = max(1, round(h * aspect))
+            x0 = (w - new_w) // 2
+            img = img[:, :, x0:x0 + new_w, :]
+        elif w / h < aspect:  # too tall: crop rows
+            new_h = max(1, round(w / aspect))
+            y0 = (h - new_h) // 2
+            img = img[:, y0:y0 + new_h, :, :]
+    elif crop != "disabled":
+        raise ValueError(f"crop must be 'disabled' or 'center', got {crop!r}")
+    out = jax.image.resize(
+        img, (img.shape[0], height, width, img.shape[-1]), method=method
+    )
+    return jnp.clip(out, 0.0, 1.0)
+
+
+class ImageScale:
+    """Stock image resize: exact width/height with the stock method menu and
+    center-crop option (TPUImageScale is the native sibling with the jax
+    method names)."""
+
+    DESCRIPTION = "Stock-name image resize (method menu + center crop)."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "upscale"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE", {}),
+                "upscale_method": (sorted(_STOCK_RESIZE), {"default": "bilinear"}),
+                "width": ("INT", {"default": 512, "min": 0, "max": 16384}),
+                "height": ("INT", {"default": 512, "min": 0, "max": 16384}),
+                "crop": (["disabled", "center"], {"default": "disabled"}),
+            }
+        }
+
+    def upscale(self, image, upscale_method: str, width: int, height: int,
+                crop: str = "disabled"):
+        # Stock 0-sentinel: a zero dim derives from the other one keeping the
+        # source aspect ratio (both zero is meaningless).
+        if width == 0 and height == 0:
+            raise ValueError("ImageScale: width and height cannot both be 0")
+        if width == 0 or height == 0:
+            import jax.numpy as jnp
+
+            img = jnp.asarray(image)
+            src_h, src_w = (img.shape[0:2] if img.ndim == 3
+                            else img.shape[1:3])
+            if width == 0:
+                width = max(1, round(height * src_w / src_h))
+            else:
+                height = max(1, round(width * src_h / src_w))
+        return (_stock_resize(image, width, height, upscale_method, crop),)
+
+
+class ImageScaleBy:
+    """Stock relative image resize: scale_by factor, no crop."""
+
+    DESCRIPTION = "Stock-name relative image resize."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "upscale"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE", {}),
+                "upscale_method": (sorted(_STOCK_RESIZE), {"default": "bilinear"}),
+                "scale_by": ("FLOAT", {"default": 1.0, "min": 0.01, "max": 8.0,
+                                       "step": 0.01}),
+            }
+        }
+
+    def upscale(self, image, upscale_method: str, scale_by: float):
+        import jax.numpy as jnp
+
+        img = jnp.asarray(image)
+        if img.ndim == 3:
+            img = img[None]
+        h = max(1, round(img.shape[1] * scale_by))
+        w = max(1, round(img.shape[2] * scale_by))
+        return (_stock_resize(img, w, h, upscale_method),)
+
+
+class PreviewImage:
+    """Stock preview node: saves under ``<output_dir>/temp`` (the host's
+    temp-image convention) via TPUSaveImage — headless, a preview IS a file
+    the client fetches through /view."""
+
+    DESCRIPTION = "Stock-name image preview (saves to the temp subfolder)."
+    RETURN_TYPES = ("STRING",)
+    RETURN_NAMES = ("paths",)
+    FUNCTION = "preview"
+    CATEGORY = CATEGORY
+    OUTPUT_NODE = True
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"images": ("IMAGE", {})}}
+
+    def preview(self, images):
+        from .nodes import TPUSaveImage
+
+        # temp/ subfolder under the served output root: /view can fetch it
+        # (subfolder=temp) and the history's relpath logic tags it correctly.
+        return TPUSaveImage().save(images, filename_prefix="temp/preview")
+
+
 def stock_node_mappings() -> dict[str, type]:
     """All stock-name shims, keyed by the stock class name (merged into
     ``nodes.NODE_CLASS_MAPPINGS`` so exported workflows resolve directly)."""
@@ -515,6 +874,12 @@ def stock_node_mappings() -> dict[str, type]:
             n.TPUVAEEncode, {"pixels": "image"}, name="VAEEncode"
         ),
         "SaveImage": _renamed(n.TPUSaveImage, {}, name="SaveImage"),
+        "ImageScale": ImageScale,
+        "ImageScaleBy": ImageScaleBy,
+        "PreviewImage": PreviewImage,
+        "ConditioningCombine": ConditioningCombine,
+        "ConditioningSetArea": ConditioningSetArea,
+        "ConditioningAverage": ConditioningAverage,
         "LatentUpscaleBy": _renamed(
             n.TPULatentUpscale, {"samples": "latent", "scale_by": "scale",
                                  "upscale_method": "method"},

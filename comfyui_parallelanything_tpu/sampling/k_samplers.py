@@ -252,6 +252,9 @@ class EpsDenoiser:
         alphas_cumprod: jnp.ndarray | None = None,
         prediction: str = "eps",
         cfg_rescale: float = 0.0,
+        extra_conds: tuple | list | None = None,
+        cond_area: tuple | None = None,
+        cond_strength: float = 1.0,
         **model_kwargs,
     ):
         if alphas_cumprod is None:
@@ -267,9 +270,57 @@ class EpsDenoiser:
         self.cfg_rescale = cfg_rescale
         self.uncond_context = uncond_context
         self.uncond_kwargs = uncond_kwargs
+        # Multi-cond (stock ConditioningCombine/SetArea): extra positive conds,
+        # each {"context", "pooled"?, "strength"?, "area"? (h, w, y, x) in
+        # latent units}. Predictions are area-weight-normalized per pixel —
+        # ComfyUI's calc_cond_batch combination rule, minus its crop-run
+        # optimization (each cond here sees the full latent; documented
+        # divergence). ``cond_area``/``cond_strength`` scope the PRIMARY cond
+        # the same way when SetArea was applied to it directly.
+        self.extra_conds = tuple(extra_conds or ())
+        self.cond_area = cond_area
+        self.cond_strength = cond_strength
         self.kwargs = model_kwargs
         self.sigma_table = model_sigmas(alphas_cumprod)
         self.log_sigmas = jnp.log(self.sigma_table)
+
+    def _area_mask(self, area, strength: float, shape):
+        """Per-pixel weight for one cond: ``strength`` everywhere (area None),
+        or strength inside the (h, w, y, x) latent-unit box. Non-2D latents
+        (video) use the full frame — stock area conditioning is 2D."""
+        if area is None or len(shape) != 4:
+            return jnp.float32(strength)
+        h, w, y, x0 = (int(v) for v in area)
+        m = jnp.zeros((1, shape[1], shape[2], 1), jnp.float32)
+        m = m.at[:, y:y + h, x0:x0 + w, :].set(1.0)
+        return m * jnp.float32(strength)
+
+    def _combine_conds(self, eps_c, x_in, t_vec, batch):
+        """Area-weight-normalized blend of the primary cond's prediction with
+        every extra cond's (one model call each — token lengths differ, so
+        they cannot batch into one call without padding)."""
+        m0 = self._area_mask(self.cond_area, self.cond_strength, x_in.shape)
+        num = m0 * eps_c
+        den = m0 * jnp.ones_like(eps_c[..., :1])
+        for e in self.extra_conds:
+            ctx = e["context"]
+            if ctx.shape[0] != batch:
+                ctx = jnp.repeat(ctx, batch // ctx.shape[0], axis=0)
+            kw = dict(self.kwargs)
+            pooled = e.get("pooled")
+            if pooled is not None:
+                if pooled.shape[0] != batch:
+                    pooled = jnp.repeat(pooled, batch // pooled.shape[0], axis=0)
+                kw["y"] = pooled
+            eps_e = self.model(x_in, t_vec, ctx, **kw)
+            m = self._area_mask(
+                e.get("area"), float(e.get("strength", 1.0)), x_in.shape
+            )
+            num = num + m * eps_e
+            den = den + m * jnp.ones_like(eps_e[..., :1])
+        # Uncovered pixels (every cond area-scoped away from them) fall back
+        # to the primary prediction rather than dividing by zero.
+        return jnp.where(den > 0, num / jnp.maximum(den, 1e-8), eps_c)
 
     def _timestep(self, sigma) -> jnp.ndarray:
         """Continuous timestep whose table sigma matches (log-space interpolation)."""
@@ -302,10 +353,14 @@ class EpsDenoiser:
                 **kw,
             )
             eps_c, eps_u = jnp.split(eps_both, 2, axis=0)
+            if self.extra_conds or self.cond_area is not None:
+                eps_c = self._combine_conds(eps_c, x_in, t_vec, batch)
             eps = eps_u + self.cfg_scale * (eps_c - eps_u)
             eps = rescale_guidance(eps, eps_c, self.cfg_rescale)
         else:
             eps = self.model(x_in, t_vec, self.context, **self.kwargs)
+            if self.extra_conds or self.cond_area is not None:
+                eps = self._combine_conds(eps, x_in, t_vec, batch)
         if self.prediction == "v":
             return x / (sigma**2 + 1.0) - eps * sigma * scale
         # eps: x0 = x − σ·eps. flow: x0 = x − σ·v — the same expression.

@@ -68,6 +68,9 @@ def run_sampler(
     cfg_rescale: float = 0.0,
     compile_loop: bool = False,
     sigmas: jnp.ndarray | None = None,
+    extra_conds=None,
+    cond_area=None,
+    cond_strength: float = 1.0,
     **model_kwargs,
 ) -> jnp.ndarray:
     """Drive ``model`` from ``noise`` to a clean latent with the named sampler.
@@ -107,6 +110,24 @@ def run_sampler(
     (timestep-indexed, not sigma-driven) rejects it."""
     use_cfg = cfg_scale != 1.0 and uncond_context is not None
     eff_cfg = cfg_scale if use_cfg else 1.0
+    multi_cond = bool(extra_conds) or cond_area is not None
+    if multi_cond and sampler in ("ddim", "flow_euler"):
+        # Multi-cond lives in EpsDenoiser (the k-sampler family — every stock
+        # KSampler menu name). ddim/flow_euler are TPU-native extras with
+        # their own model-call sites; combined/area conditioning there is out
+        # of scope, and silence would mean silently dropping a prompt.
+        raise ValueError(
+            "combined/area conditioning (ConditioningCombine/SetArea) is "
+            "supported on the k-sampler family only, not "
+            f"{sampler!r} — pick any stock sampler name"
+        )
+    if multi_cond and compile_loop:
+        from ..utils import get_logger
+
+        get_logger().info(
+            "compile_loop: multi-cond (Combine/SetArea) runs the eager path"
+        )
+        compile_loop = False
     if not 0.0 < denoise <= 1.0:
         raise ValueError(f"denoise must be in (0, 1], got {denoise}")
     if latent_mask is not None and init_latent is None:
@@ -147,6 +168,21 @@ def run_sampler(
 
         return cb
 
+    def with_progress(cb, n_steps):
+        """Per-step progress + cooperative interrupt on the eager loops (the
+        ComfyUI protocol's ``progress`` event source; utils/progress.py). The
+        compiled path is one XLA program — no step boundaries to report or
+        stop at, which run_sampler's docstring lists among its trade-offs."""
+        from ..utils.progress import report_progress
+
+        def cb2(i, x):
+            report_progress(i + 1, n_steps)  # raises Interrupted if requested
+            if cb is not None:
+                return cb(i, x)
+            return None
+
+        return cb2
+
     if sampler == "flow_euler":
         if sigmas is not None:
             ts = jnp.asarray(sigmas, jnp.float32)
@@ -175,9 +211,9 @@ def run_sampler(
                     guidance=guidance, cfg_rescale=cfg_rescale,
                     **compiled_mask_kw, model_kwargs=model_kwargs,
                 )
-        cb = masked_callback(
+        cb = with_progress(masked_callback(
             lambda i: (1.0 - ts[i + 1]) * init_latent + ts[i + 1] * noise
-        )
+        ), len(ts) - 1)
         return flow_euler_sample(
             model, x, context, steps=steps, shift=shift, guidance=guidance,
             cfg_scale=eff_cfg, uncond_context=uncond_context,
@@ -231,7 +267,8 @@ def run_sampler(
         return ddim_sample(
             model, x, context, steps=steps, cfg_scale=eff_cfg,
             uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
-            callback=masked_callback(ddim_keep), ts=ts, alphas_cumprod=acp,
+            callback=with_progress(masked_callback(ddim_keep), len(ts)),
+            ts=ts, alphas_cumprod=acp,
             prediction=prediction, cfg_rescale=cfg_rescale, **model_kwargs,
         )
     step_fn = K_SAMPLERS.get(sampler)
@@ -332,7 +369,8 @@ def run_sampler(
     denoiser = EpsDenoiser(
         model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,
         uncond_kwargs=uncond_kwargs, alphas_cumprod=acp, prediction=prediction,
-        cfg_rescale=cfg_rescale, **model_kwargs,
+        cfg_rescale=cfg_rescale, extra_conds=extra_conds, cond_area=cond_area,
+        cond_strength=cond_strength, **model_kwargs,
     )
     if is_flow:
         # Host CONST-dispatch parity: samplers with an RF renoise form swap in.
@@ -342,6 +380,7 @@ def run_sampler(
         )
     else:
         cb = masked_callback(lambda i: init_latent + noise * sigmas[i + 1])
+    cb = with_progress(cb, len(sigmas) - 1)
     if sampler in RNG_SAMPLERS:
         return step_fn(denoiser, x, sigmas, jax.random.fold_in(rng, 1), callback=cb)
     return step_fn(denoiser, x, sigmas, callback=cb)

@@ -17,14 +17,19 @@ Endpoints (the ComfyUI client-protocol subset that makes scripts work):
 - ``GET  /history/{id}``      one prompt's status + outputs
 - ``GET  /view?filename=``    serve a saved image (``subfolder=`` honored)
 - ``GET  /queue``             running + pending prompt ids
-- ``POST /interrupt``         drop all *pending* prompts (a compiled step
-                              cannot be preempted mid-dispatch)
+- ``POST /interrupt``         drop all *pending* prompts and stop the
+                              *running* one at its next sampler-step boundary
+                              (cooperative flag, utils/progress.py; a single
+                              compiled step cannot be preempted mid-dispatch)
 - ``GET  /object_info[/cls]`` node-registry introspection (INPUT_TYPES etc.)
 - ``GET  /system_stats``      devices from devices.discovery
 - ``GET  /ws``                WebSocket progress events (RFC 6455, stdlib):
                               ``status`` on queue changes,
-                              ``execution_start`` when a prompt begins, and
-                              the canonical completion signal API clients
+                              ``execution_start`` when a prompt begins,
+                              ``executing`` per node as it runs, ``progress``
+                              per sampler step (what frontends render progress
+                              bars from), ``execution_interrupted`` on Cancel,
+                              and the canonical completion signal API clients
                               wait for — ``executing`` with ``node: null``
                               and the ``prompt_id``.
 
@@ -46,6 +51,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from .host import WorkflowCache, run_workflow
+from .utils.progress import (
+    Interrupted,
+    clear_interrupt,
+    request_interrupt,
+    set_progress_hook,
+)
 
 _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"  # RFC 6455 §1.3
 
@@ -217,9 +228,11 @@ class PromptQueue:
         return pid, number
 
     def interrupt(self) -> int:
-        """Drop every pending prompt (the running one finishes — a compiled
-        step cannot be preempted). Anything the worker popped before this
-        drain counts as running."""
+        """Drop every pending prompt AND ask the running one to stop at its
+        next sampler-step boundary (utils/progress.py cooperative flag — the
+        ComfyUI Cancel semantics; a single compiled step still cannot be
+        preempted mid-dispatch). Anything the worker popped before this drain
+        counts as running."""
         dropped = 0
         with self._lock:
             while True:
@@ -237,6 +250,22 @@ class PromptQueue:
                     "status": {"status_str": "interrupted", "completed": False},
                     "outputs": {},
                 }
+            # An id still pending but not running is an in-flight pop (the
+            # worker took it off the queue but hasn't published running yet):
+            # removing it here makes the worker's pending_ids check drop it —
+            # the Cancel wins the race instead of losing it.
+            for pid in [p for p in self.pending_ids if p != self.running]:
+                dropped += 1
+                self.pending_ids.remove(pid)
+                self.history[pid] = {
+                    "status": {"status_str": "interrupted", "completed": False},
+                    "outputs": {},
+                }
+            if self.running is not None:
+                # Set under the SAME lock the worker clears it under when
+                # publishing running: a Cancel can never land in the window
+                # between running=pid and the flag reset.
+                request_interrupt()
         if dropped:
             self._emit_status()  # ws clients must see the queue shrink
         return dropped
@@ -255,24 +284,60 @@ class PromptQueue:
                 if pid not in self.pending_ids:
                     continue  # interrupted while queued
                 self.running = pid
+                # Reset any stale Cancel under the same lock interrupt() uses:
+                # once running is published, a new interrupt targets THIS
+                # prompt and must survive.
+                clear_interrupt()
             self._emit({"type": "execution_start", "data": {"prompt_id": pid}})
             t0 = time.time()
+            # Per-node `executing` + per-step `progress` events — the pair a
+            # stock ComfyUI frontend renders its progress bars from. The node
+            # id rides a cell so the progress hook can tag its events with
+            # whichever node is currently executing.
+            current: dict = {"node": None}
+
+            def on_node(nid, _pid=pid, _cur=current):
+                _cur["node"] = nid
+                self._emit({
+                    "type": "executing",
+                    "data": {"node": nid, "prompt_id": _pid},
+                })
+
+            def hook(value, max_value, _pid=pid, _cur=current):
+                self._emit({
+                    "type": "progress",
+                    "data": {"value": value, "max": max_value,
+                             "prompt_id": _pid, "node": _cur["node"]},
+                })
+
+            prev_hook = set_progress_hook(hook)
             try:
                 results = run_workflow(
                     prompt, class_mappings=self.class_mappings,
-                    outputs=self.cache,
+                    outputs=self.cache, on_node=on_node,
                 )
                 entry = {
                     "status": {"status_str": "success", "completed": True,
                                "exec_s": round(time.time() - t0, 3)},
                     "outputs": self._image_outputs(prompt, results),
                 }
+            except Interrupted:
+                entry = {
+                    "status": {"status_str": "interrupted", "completed": False},
+                    "outputs": {},
+                }
+                self._emit({
+                    "type": "execution_interrupted",
+                    "data": {"prompt_id": pid, "node_id": current["node"]},
+                })
             except Exception as e:  # noqa: BLE001 — failures land in history
                 entry = {
                     "status": {"status_str": "error", "completed": False,
                                "message": f"{type(e).__name__}: {e}"},
                     "outputs": {},
                 }
+            finally:
+                set_progress_hook(prev_hook)
             with self._lock:
                 self.history[pid] = entry
                 self.pending_ids.remove(pid)
@@ -301,11 +366,15 @@ class PromptQueue:
                 rel = os.path.relpath(p, self.output_dir)
                 sub, fname = os.path.split(rel)
                 if sub.startswith(".."):
-                    sub, fname = "", p  # saved outside output_dir: absolute
+                    # Saved outside output_dir: /view's escape check would 403
+                    # exactly this path, so advertising it would hand clients
+                    # an unfetchable record — omit it from the history.
+                    continue
                 images.append(
                     {"filename": fname, "subfolder": sub, "type": "output"}
                 )
-            out[str(nid)] = {"images": images}
+            if images:
+                out[str(nid)] = {"images": images}
         return out
 
 
@@ -341,10 +410,16 @@ class _Handler(BaseHTTPRequestHandler):
                 200, {"queue_running": running, "queue_pending": pend}
             )
         if parts and parts[0] == "history":
+            # Snapshot under the queue lock: the worker thread inserts entries
+            # under it, and json.dumps over a dict mutated mid-iteration raises
+            # RuntimeError and aborts the connection. (Entries are written once
+            # at insert, so a shallow copy is a consistent view.)
+            with self.q._lock:
+                snap = dict(self.q.history)
             if len(parts) == 2:
-                entry = self.q.history.get(parts[1])
+                entry = snap.get(parts[1])
                 return self._send(200, {parts[1]: entry} if entry else {})
-            return self._send(200, self.q.history)
+            return self._send(200, snap)
         if url.path == "/view":
             qs = parse_qs(url.query)
             fname = qs.get("filename", [""])[0]

@@ -1,0 +1,72 @@
+"""Cooperative per-step progress + interrupt plumbing.
+
+Inside ComfyUI, the reference gets progress bars and the Cancel button for
+free: the host's sampler loop reports each denoise step and polls
+``comfy.model_management`` for an interrupt between steps. Standalone, this
+module is that machinery: the eager sampler loops call ``report_progress``
+once per step (sampling/runner.py), the graph host reports node boundaries
+(host.run_workflow ``on_node``), and the HTTP server translates both into the
+``progress`` / ``executing`` WebSocket events a stock ComfyUI client renders —
+and sets the interrupt flag from ``POST /interrupt`` so the *running* prompt
+stops between steps, not just the pending ones.
+
+The hook is a process-wide single slot (one accelerator, one serial prompt
+worker — the server's execution model); ``set_progress_hook`` returns the
+previous hook so scoped installs nest correctly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+_hook: Optional[Callable[[int, int], None]] = None
+_interrupt = threading.Event()
+
+
+class Interrupted(RuntimeError):
+    """Raised between sampler steps after ``request_interrupt()`` — the
+    cooperative analogue of ComfyUI's InterruptProcessingException."""
+
+
+def set_progress_hook(fn: Optional[Callable[[int, int], None]]):
+    """Install ``fn(value, max_value)`` as the step hook; returns the previous
+    hook (restore it when the scope ends)."""
+    global _hook
+    prev, _hook = _hook, fn
+    return prev
+
+
+def request_interrupt() -> None:
+    """Ask the running sampler loop to stop at the next step boundary."""
+    _interrupt.set()
+
+
+def clear_interrupt() -> None:
+    """Reset the flag — call before starting a prompt so a stale interrupt
+    aimed at a previous (possibly already-finished) prompt can't kill it."""
+    _interrupt.clear()
+
+
+def interrupt_requested() -> bool:
+    return _interrupt.is_set()
+
+
+def check_interrupt(where: str = "between nodes") -> None:
+    """Honor a pending interrupt (the flag is consumed so the next prompt
+    starts clean). Called at every cooperative boundary: sampler steps
+    (``report_progress``) and graph-node starts (``host.run_workflow``) — the
+    latter so a Cancel landing inside a non-sampler node (VAE decode, a slow
+    checkpoint load) still stops the prompt, matching ComfyUI's per-node
+    interrupt check."""
+    if _interrupt.is_set():
+        _interrupt.clear()
+        raise Interrupted(f"interrupted {where}")
+
+
+def report_progress(value: int, max_value: int) -> None:
+    """One sampler step completed: notify the hook, then honor a pending
+    interrupt."""
+    if _hook is not None:
+        _hook(value, max_value)
+    check_interrupt(f"at step {value}/{max_value}")

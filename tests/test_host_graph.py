@@ -69,6 +69,25 @@ class TestExecutor:
         with pytest.raises(WorkflowError, match="unknown class_type"):
             run_workflow({"1": {"class_type": "NoSuchNode", "inputs": {}}})
 
+    def test_pending_interrupt_stops_before_next_node(self):
+        # A Cancel landing inside a non-sampler node must stop the graph at
+        # the next NODE boundary, not only at sampler-step boundaries
+        # (ComfyUI's per-node interrupt check).
+        from comfyui_parallelanything_tpu.utils.progress import (
+            Interrupted,
+            clear_interrupt,
+            request_interrupt,
+        )
+
+        request_interrupt()
+        try:
+            with pytest.raises(Interrupted, match="before node"):
+                run_workflow(_chain_workflow())
+        finally:
+            clear_interrupt()
+        # The flag was consumed: the next run proceeds normally.
+        assert run_workflow(_chain_workflow())["2"][0]
+
     def test_unknown_link_target_raises(self):
         wf = {"1": {"class_type": "ParallelDevice",
                     "inputs": {"device_id": "cpu:0", "percentage": 50.0,

@@ -631,3 +631,72 @@ class TestFlowPredictionRouting:
 
         assert flux_dev_config().prediction == "flow"
         assert wan_1_3b_config().prediction == "flow"
+
+
+class TestMultiCond:
+    """Stock ConditioningCombine/SetArea semantics: per-cond predictions blend
+    area-weight-normalized (EpsDenoiser._combine_conds)."""
+
+    @staticmethod
+    def _mean_model(x, t_vec, context=None, **kw):
+        # Prediction = per-row mean of the context: trivially shows which
+        # cond(s) drove each pixel, and respects CFG's batched cond‖uncond.
+        m = jnp.mean(context, axis=tuple(range(1, context.ndim)))
+        return jnp.ones_like(x) * m.reshape((-1,) + (1,) * (x.ndim - 1))
+
+    def test_area_cond_blends_inside_box_only(self):
+        x = jnp.zeros((1, 8, 8, 4), jnp.float32)
+        ctx0 = jnp.zeros((1, 3, 5), jnp.float32)
+        ctx1 = jnp.ones((1, 7, 5), jnp.float32)  # different token length: own call
+        d = EpsDenoiser(
+            self._mean_model, ctx0,
+            extra_conds=[{"context": ctx1, "area": (4, 4, 0, 0),
+                          "strength": 1.0}],
+        )
+        x0 = d(x, jnp.float32(1.0))
+        eps = -(np.asarray(x0))  # x0 = x − σ·eps with x = 0, σ = 1
+        # Inside the box both conds contribute: (1·0 + 1·1)/2.
+        np.testing.assert_allclose(eps[0, 0, 0, 0], 0.5, atol=1e-6)
+        np.testing.assert_allclose(eps[0, 3, 3, 0], 0.5, atol=1e-6)
+        # Outside only the primary does.
+        np.testing.assert_allclose(eps[0, 7, 7, 0], 0.0, atol=1e-6)
+        np.testing.assert_allclose(eps[0, 0, 6, 0], 0.0, atol=1e-6)
+
+    def test_full_frame_combine_averages(self):
+        x = jnp.zeros((1, 4, 4, 2), jnp.float32)
+        d = EpsDenoiser(
+            self._mean_model, jnp.zeros((1, 3, 5)),
+            extra_conds=[{"context": jnp.ones((1, 3, 5))}],
+        )
+        eps = -np.asarray(d(x, jnp.float32(1.0)))
+        np.testing.assert_allclose(eps, 0.5, atol=1e-6)
+
+    def test_strengths_weight_the_blend(self):
+        x = jnp.zeros((1, 4, 4, 2), jnp.float32)
+        d = EpsDenoiser(
+            self._mean_model, jnp.zeros((1, 3, 5)),
+            extra_conds=[{"context": jnp.ones((1, 3, 5)), "strength": 3.0}],
+        )
+        eps = -np.asarray(d(x, jnp.float32(1.0)))
+        np.testing.assert_allclose(eps, 0.75, atol=1e-6)  # (0·1 + 1·3)/(1+3)
+
+    def test_cfg_applies_extras_to_cond_half_only(self):
+        x = jnp.zeros((1, 4, 4, 2), jnp.float32)
+        d = EpsDenoiser(
+            self._mean_model, jnp.zeros((1, 3, 5)),
+            cfg_scale=2.0, uncond_context=jnp.full((1, 3, 5), -1.0),
+            extra_conds=[{"context": jnp.ones((1, 3, 5))}],
+        )
+        eps = -np.asarray(d(x, jnp.float32(1.0)))
+        # cond = (0+1)/2 = 0.5 blended; uncond = −1; cfg: −1 + 2·(0.5 − (−1)).
+        np.testing.assert_allclose(eps, 2.0, atol=1e-5)
+
+    def test_multi_cond_rejected_on_ddim_and_flow_euler(self):
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        with pytest.raises(ValueError, match="k-sampler"):
+            run_sampler(
+                lambda x, t, c=None, **k: x, jnp.zeros((1, 4, 4, 4)),
+                jnp.zeros((1, 3, 5)), sampler="ddim", steps=2,
+                extra_conds=[{"context": jnp.ones((1, 3, 5))}],
+            )

@@ -47,6 +47,20 @@ class TestNodeProtocol:
             assert hasattr(cls, cls.FUNCTION)
             assert cls.CATEGORY
 
+    def test_seed_key_accepts_full_stock_64bit_range(self):
+        # Stock seed widgets randomize over [0, 2**64); jax.random.key takes
+        # signed int64 (ADVICE r3). seed_key must fold, deterministically.
+        import jax
+
+        from comfyui_parallelanything_tpu.nodes import SEED_MAX, seed_key
+
+        assert SEED_MAX == 2**64 - 1
+        for s in (0, 7, 2**63 - 1, 2**63, SEED_MAX):
+            seed_key(s)  # must not raise
+        same = jax.random.key_data(seed_key(2**63 + 5))
+        folded = jax.random.key_data(jax.random.key(5))
+        assert (same == folded).all()
+
     def test_device_dropdown_always_has_cpu(self):
         devs = ParallelDevice.get_available_devices()
         assert "cpu" in devs

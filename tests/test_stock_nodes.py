@@ -169,8 +169,11 @@ class TestStockWorkflow:
             "7": {"class_type": "CLIPTextEncode",
                   "inputs": {"text": "blurry low quality",
                              "clip": ["4", 1]}},
+            # seed beyond 2**63: stock seed widgets are 64-bit and the UI's
+            # randomize fills [0, 2**64) — half of exported workflows carry a
+            # seed jax.random.key would reject (ADVICE r3, folded by seed_key).
             "3": {"class_type": "KSampler",
-                  "inputs": {"seed": 7, "steps": 2, "cfg": 7.0,
+                  "inputs": {"seed": 2**63 + 7, "steps": 2, "cfg": 7.0,
                              "sampler_name": "euler", "scheduler": "normal",
                              "denoise": 1.0, "model": ["4", 0],
                              "positive": ["6", 0], "negative": ["7", 0],
@@ -197,6 +200,66 @@ class TestStockWorkflow:
         assert np.isfinite(np.asarray(images)).all()
         saved = out["9"][0]
         assert len(saved) == 2 and all(os.path.exists(p) for p in saved)
+
+    def test_stock_conditioning_and_image_shims_run(self, tmp_path,
+                                                    monkeypatch):
+        # VERDICT r3 missing #4: regional prompting (SetArea → Combine),
+        # prompt blending (Average), stock image resize, and PreviewImage —
+        # one exported-style graph exercising all of them.
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        monkeypatch.setenv("PA_OUTPUT_DIR", str(tmp_path / "out"))
+        wf = self._stock_workflow(paths["ckpt"])
+        wf["9"]["inputs"]["output_dir"] = str(tmp_path / "out")
+        wf.update({
+            "10": {"class_type": "CLIPTextEncode",
+                   "inputs": {"text": "blurry low quality",
+                              "clip": ["4", 1]}},
+            # Regional prompt: the second prompt scoped to the top-left 16px
+            # (2 latent cells of the 32px graph), combined into the first.
+            "11": {"class_type": "ConditioningSetArea",
+                   "inputs": {"conditioning": ["10", 0], "width": 16,
+                              "height": 16, "x": 0, "y": 0, "strength": 0.8}},
+            "12": {"class_type": "ConditioningCombine",
+                   "inputs": {"conditioning_1": ["6", 0],
+                              "conditioning_2": ["11", 0]}},
+            # Blend the two raw prompts too (exercises Average's lerp).
+            "13": {"class_type": "ConditioningAverage",
+                   "inputs": {"conditioning_to": ["12", 0],
+                              "conditioning_from": ["10", 0],
+                              "conditioning_to_strength": 0.7}},
+            "14": {"class_type": "ImageScale",
+                   "inputs": {"image": ["8", 0], "upscale_method": "bicubic",
+                              "width": 48, "height": 40, "crop": "center"}},
+            "15": {"class_type": "ImageScaleBy",
+                   "inputs": {"image": ["8", 0],
+                              "upscale_method": "lanczos", "scale_by": 0.5}},
+            "16": {"class_type": "PreviewImage",
+                   "inputs": {"images": ["14", 0]}},
+        })
+        wf["3"]["inputs"]["positive"] = ["13", 0]
+
+        out = run_workflow(wf)
+        assert np.isfinite(np.asarray(out["8"][0])).all()
+        assert out["14"][0].shape[1:3] == (40, 48)
+        h, w = np.asarray(out["8"][0]).shape[1:3]
+        assert out["15"][0].shape[1:3] == (
+            max(1, round(h * 0.5)), max(1, round(w * 0.5)))
+        # Stock 0-sentinel: a zero dim keeps the source aspect ratio.
+        from comfyui_parallelanything_tpu.nodes_compat import ImageScale
+
+        (kept,) = ImageScale().upscale(
+            np.zeros((1, 10, 20, 3), np.float32), "bilinear",
+            width=40, height=0,
+        )
+        assert kept.shape[1:3] == (20, 40)
+        with pytest.raises(ValueError, match="both be 0"):
+            ImageScale().upscale(
+                np.zeros((1, 10, 20, 3), np.float32), "bilinear",
+                width=0, height=0,
+            )
+        previews = out["16"][0]
+        assert previews and all(os.path.exists(p) for p in previews)
+        assert all(os.sep + "temp" + os.sep in p for p in previews)
 
     def test_models_dir_resolution(self, tmp_path, monkeypatch):
         # ComfyUI folder layout: a bare name resolves via
@@ -327,16 +390,89 @@ class TestStockWorkflow:
         )
         np.testing.assert_allclose(znew, base, rtol=1e-6, atol=1e-6)
 
-        # Stacking, untagged models, and missing files fail with instructions
+        # Stacking: chained LoraLoaders compose — two strength-1 bakes of the
+        # same LoRA equal one strength-2 bake (deltas are linear in strength).
+        stacked, _ = node.load_lora(patched, clip, str(lora_path), 1.0, 1.0)
+        snew = np.concatenate(
+            [np.ravel(v) for v in jax.tree.leaves(stacked.params)]
+        )
+        assert not np.allclose(snew, new)
+        twice, _ = node.load_lora(model, clip, str(lora_path), 2.0, 1.0)
+        tnew = np.concatenate(
+            [np.ravel(v) for v in jax.tree.leaves(twice.params)]
+        )
+        np.testing.assert_allclose(snew, tnew, rtol=1e-4, atol=1e-5)
+
+        # Untagged models and missing files fail with instructions
         # (an absent LoRA must never silently return an unpatched model).
-        with pytest.raises(ValueError, match="stacking"):
-            node.load_lora(patched, clip, str(lora_path), 1.0, 1.0)
         with pytest.raises(ValueError, match="CheckpointLoaderSimple"):
             node.load_lora(object(), clip, str(lora_path), 1.0, 1.0)
         with pytest.raises(ValueError, match="not found"):
             node.load_lora(model, clip, "", 1.0, 1.0)
         with pytest.raises(ValueError, match="not found"):
             node.load_lora(model, clip, "ghost.safetensors", 1.0, 1.0)
+
+    def test_lora_loader_strength_clip_bakes_text_tower(self, tmp_path,
+                                                        monkeypatch):
+        # A LoRA with kohya lora_te_* keys must rebuild the CLIP wire with the
+        # deltas baked into the bundled tower (ADVICE/VERDICT r3: the
+        # strength_clip divergence closed).
+        from safetensors.numpy import save_file
+
+        from comfyui_parallelanything_tpu.models import load_safetensors
+        from comfyui_parallelanything_tpu.nodes import NODE_CLASS_MAPPINGS
+
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        model, clip, _ = (
+            NODE_CLASS_MAPPINGS["CheckpointLoaderSimple"]().load(paths["ckpt"])
+        )
+        sd = load_safetensors(paths["ckpt"])
+        target = next(
+            k for k in sd
+            if k.startswith("cond_stage_model.") and
+            k.endswith("self_attn.q_proj.weight")
+        )
+        out_d, in_d = sd[target].shape
+        base_name = (
+            target.removeprefix("cond_stage_model.transformer.")
+            .removesuffix(".weight").replace(".", "_")
+        )
+        rng = np.random.default_rng(9)
+        lora_path = tmp_path / "te.safetensors"
+        save_file({
+            f"lora_te_{base_name}.lora_down.weight":
+                rng.standard_normal((2, in_d)).astype(np.float32),
+            f"lora_te_{base_name}.lora_up.weight":
+                rng.standard_normal((out_d, 2)).astype(np.float32),
+        }, str(lora_path))
+
+        node = NODE_CLASS_MAPPINGS["LoraLoader"]()
+        import jax
+
+        def flat(wire):
+            return np.concatenate([
+                np.ravel(np.asarray(v, np.float32))
+                for v in jax.tree.leaves(wire["encoder"].params)
+            ])
+
+        _, clip_out = node.load_lora(model, clip, str(lora_path), 1.0, 1.0)
+        assert clip_out is not clip
+        assert not np.allclose(flat(clip_out), flat(clip))
+        # strength_clip=0 leaves the wire untouched (identity, no rebuild).
+        _, clip_zero = node.load_lora(model, clip, str(lora_path), 1.0, 0.0)
+        assert clip_zero is clip
+        # Upstream wire state (CLIPSetLastLayer's tag) survives the rebuild.
+        _, clip_keep = node.load_lora(
+            model, {**clip, "clip_skip": 2}, str(lora_path), 1.0, 1.0
+        )
+        assert clip_keep["clip_skip"] == 2
+        assert not np.allclose(flat(clip_keep), flat(clip))
+        # A CLIP wire NOT from this checkpoint's bundled towers (no
+        # source_ckpt tag — e.g. DualCLIPLoader) is never clobbered by the
+        # rebuild; te deltas are skipped with a warning instead.
+        external = {k: v for k, v in clip.items() if k != "source_ckpt"}
+        _, clip_ext = node.load_lora(model, external, str(lora_path), 1.0, 1.0)
+        assert clip_ext is external
 
     def test_save_image_defaults_to_pa_output_dir(self, tmp_path, monkeypatch):
         # Stock exports carry only filename_prefix; images must land in the
