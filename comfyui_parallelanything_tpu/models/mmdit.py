@@ -8,9 +8,12 @@ sincos position table cropped to the sample grid (no RoPE), pooled CLIP(L+G)
 vector + timestep modulation, optional per-head q/k RMS norm (the 3.5 models).
 
 Same staged decomposition as models/flux.py (prepare / block_step / finalize)
-so the batch==1 pipeline placement mode works identically. SD3.5-medium's
-dual-attention x-blocks are not implemented (documented gap; medium-3.5 only —
-sd3-medium and sd3.5-large convert and run).
+so the batch==1 pipeline placement mode works identically. All three public
+variants convert and run: sd3-medium, sd3.5-large, and sd3.5-medium — the
+mmdit-x dual-attention x-blocks the medium model adds are implemented via
+``x_block_self_attn_layers`` below (the converter infers the indices from the
+checkpoint's ``joint_blocks.{i}.x_block.attn2`` keys; loader preset
+``sd35-medium``).
 """
 
 from __future__ import annotations
