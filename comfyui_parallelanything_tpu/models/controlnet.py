@@ -1,0 +1,244 @@
+"""ControlNet for the SD-family UNets — flax.linen, NHWC, TPU-first.
+
+The reference wraps whatever MODEL its host hands it — a ControlNet-patched
+model included (the host computes control residuals and the UNet consumes
+them; the reference's duck-typed unwrap at any_device_parallel.py:921-930 is
+agnostic to it). Standalone, this module is that capability: the ControlNet
+trunk (a copy of the UNet encoder + middle with zero-conv taps and a hint
+encoder) producing per-skip residuals that ``UNet2D`` consumes via its
+``control`` kwarg.
+
+TPU-first composition: ``apply_control`` merges base UNet + ControlNet into
+ONE DiffusionModel whose apply computes the residuals and the denoise step in
+a single jit program — XLA fuses/schedules both trunks; nothing crosses the
+host boundary per step, and the merged pytree places/shards through
+``parallelize`` like any other model (DP/FSDP work unchanged).
+
+Structure mirrors the public ControlNet layout (lucidrains/lllyasviel lineage,
+as shipped in ldm-format ``.safetensors``): ``input_hint_block`` (8 convs,
+8× spatial reduction from pixels to latents), the UNet ``input_blocks`` +
+``middle_block`` trunk, one zero conv per skip (``zero_convs``) and a middle
+zero conv (``middle_block_out``). Conversion: convert_unet.py
+``convert_controlnet_checkpoint``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.basic import timestep_embedding
+from .api import DiffusionModel
+from .unet import Downsample, ResBlock, SpatialTransformer, UNetConfig
+
+# input_hint_block conv ladder: (out_channels, stride) per conv, pixels → 8×
+# reduced latent grid, final zero conv to model_channels appended dynamically.
+_HINT_LADDER = ((16, 1), (16, 1), (32, 2), (32, 1), (96, 2), (96, 1), (256, 2))
+
+
+class ControlNet2D(nn.Module):
+    """forward(x NHWC latents, hint NHWC pixels (8× the latent grid),
+    timesteps (B,), context, y) → {"input": (residual, ...), "middle": (r,)}.
+
+    Residual list order matches UNet2D's ``skips`` list (consumed in reverse
+    by the up path). Zero convs initialize to zero, so an untrained ControlNet
+    is an exact no-op on the base model."""
+
+    cfg: UNetConfig
+    hint_channels: int = 3
+
+    @nn.compact
+    def __call__(self, x, hint, timesteps, context=None, y=None):
+        cfg = self.cfg
+        ch = cfg.model_channels
+        t_emb = timestep_embedding(timesteps, ch).astype(cfg.dtype)
+        emb = nn.Dense(ch * 4, dtype=cfg.dtype, name="time_embed_0")(t_emb)
+        emb = nn.Dense(ch * 4, dtype=cfg.dtype, name="time_embed_2")(nn.silu(emb))
+        if cfg.adm_in_channels is not None:
+            if y is None:
+                raise ValueError("this config requires vector conditioning `y`")
+            y_emb = nn.Dense(ch * 4, dtype=cfg.dtype, name="label_embed_0")(
+                y.astype(cfg.dtype)
+            )
+            emb = emb + nn.Dense(ch * 4, dtype=cfg.dtype, name="label_embed_2")(
+                nn.silu(y_emb)
+            )
+
+        x = x.astype(cfg.dtype)
+        if context is not None:
+            context = context.astype(cfg.dtype)
+
+        if hint.shape[1:3] != (x.shape[1] * 8, x.shape[2] * 8):
+            raise ValueError(
+                f"hint image {hint.shape[1:3]} must be 8x the latent grid "
+                f"{x.shape[1:3]} (pixels vs latents)"
+            )
+        g = hint.astype(cfg.dtype)
+        for i, (out_ch, stride) in enumerate(_HINT_LADDER):
+            g = nn.Conv(out_ch, (3, 3), strides=(stride, stride), padding=1,
+                        dtype=cfg.dtype, name=f"hint_{i}")(g)
+            g = nn.silu(g)
+        g = nn.Conv(ch, (3, 3), padding=1, dtype=cfg.dtype,
+                    kernel_init=nn.initializers.zeros,
+                    name=f"hint_{len(_HINT_LADDER)}")(g)
+
+        def zero_conv(h, idx):
+            return nn.Conv(
+                h.shape[-1], (1, 1), dtype=cfg.dtype,
+                kernel_init=nn.initializers.zeros, name=f"zero_conv_{idx}"
+            )(h)
+
+        h = nn.Conv(ch, (3, 3), padding=1, dtype=cfg.dtype, name="input_conv")(x)
+        h = h + g
+        outs = [zero_conv(h, 0)]
+        zi = 1
+        # Encoder trunk: identical structure (and module names) to UNet2D's
+        # input path, so the checkpoint converter shares its mapping.
+        for level, mult in enumerate(cfg.channel_mult):
+            out_ch = ch * mult
+            for i in range(cfg.num_res_blocks):
+                h = ResBlock(cfg, out_ch, name=f"in_{level}_{i}_res")(h, emb)
+                if level in cfg.attention_levels and cfg.transformer_depth[level] > 0:
+                    h = SpatialTransformer(
+                        cfg, out_ch, cfg.transformer_depth[level],
+                        name=f"in_{level}_{i}_attn",
+                    )(h, context)
+                outs.append(zero_conv(h, zi))
+                zi += 1
+            if level != len(cfg.channel_mult) - 1:
+                h = Downsample(cfg, out_ch, name=f"down_{level}")(h)
+                outs.append(zero_conv(h, zi))
+                zi += 1
+        mid_ch = ch * cfg.channel_mult[-1]
+        mid_depth = (
+            cfg.transformer_depth[-1]
+            if len(cfg.channel_mult) - 1 in cfg.attention_levels else 0
+        )
+        h = ResBlock(cfg, mid_ch, name="mid_res1")(h, emb)
+        if mid_depth > 0:
+            h = SpatialTransformer(cfg, mid_ch, mid_depth, name="mid_attn")(h, context)
+        h = ResBlock(cfg, mid_ch, name="mid_res2")(h, emb)
+        mid = nn.Conv(mid_ch, (1, 1), dtype=cfg.dtype,
+                      kernel_init=nn.initializers.zeros, name="mid_out")(h)
+        return {"input": tuple(outs), "middle": (mid,)}
+
+
+def build_controlnet(
+    cfg: UNetConfig,
+    rng=None,
+    sample_shape=(1, 64, 64, 4),
+    hint_channels: int = 3,
+    name="controlnet",
+    params=None,
+) -> DiffusionModel:
+    """Build a ControlNet as a DiffusionModel handle (apply + params); the
+    apply signature is ``(params, x, timesteps, context=None, hint=..., y=...)``
+    — hint is keyword-only past the shared prefix so generic model plumbing
+    still sees the (x, t, context) convention."""
+    module = ControlNet2D(cfg, hint_channels=hint_channels)
+    if params is None:
+        if rng is None:
+            raise ValueError("need rng to initialize (or pass params=)")
+        x = jnp.zeros(sample_shape, jnp.float32)
+        hint = jnp.zeros(
+            (sample_shape[0], sample_shape[1] * 8, sample_shape[2] * 8,
+             hint_channels), jnp.float32,
+        )
+        t = jnp.zeros((sample_shape[0],), jnp.float32)
+        ctx = jnp.zeros((sample_shape[0], 77, cfg.context_dim), jnp.float32)
+        kwargs = {}
+        if cfg.adm_in_channels is not None:
+            kwargs["y"] = jnp.zeros(
+                (sample_shape[0], cfg.adm_in_channels), jnp.float32
+            )
+        params = module.init(rng, x, hint, t, ctx, **kwargs)["params"]
+
+    def apply(params, x, timesteps, context=None, *, hint, y=None):
+        kw = {} if y is None else {"y": y}
+        return module.apply({"params": params}, x, hint, timesteps, context, **kw)
+
+    return DiffusionModel(apply=apply, params=params, name=name, config=cfg)
+
+
+def apply_control(
+    base: DiffusionModel,
+    control_net: DiffusionModel,
+    hint,
+    strength: float = 1.0,
+    start_percent: float = 0.0,
+    end_percent: float = 1.0,
+) -> DiffusionModel:
+    """Compose base UNet + ControlNet into one DiffusionModel.
+
+    The merged params pytree carries both networks AND the hint image, so the
+    composition places/shards through ``parallelize`` like a single model and
+    the whole denoise step (control trunk + base trunk) is one jit program.
+
+    ``start_percent``/``end_percent`` gate the residuals by sampling progress
+    (the stock ControlNetApplyAdvanced knobs), approximated as linear in the
+    timestep: progress = 1 − t/999 for the eps/v UNet families this serves.
+    Documented divergence: stock maps percents through model_sampling's sigma
+    table; at the default (0, 1) the gate is exactly a no-op either way.
+    """
+    strength = float(strength)
+    start_p, end_p = float(start_percent), float(end_percent)
+    merged = {
+        "base": base.params,
+        "ctrl": control_net.params,
+        "hint": jnp.asarray(hint, jnp.float32),
+    }
+    base_apply, ctrl_apply = base.apply, control_net.apply
+
+    def apply(p, x, timesteps, context=None, control=None, **kw):
+        hint_img = p["hint"]
+        if hint_img.ndim == 3:
+            hint_img = hint_img[None]
+        if hint_img.shape[0] != x.shape[0]:
+            if hint_img.shape[0] != 1:
+                # A per-sample hint batch cannot survive data-parallel
+                # splitting (the hint rides the REPLICATED params pytree while
+                # x shards) — only a single shared hint broadcasts safely.
+                raise ValueError(
+                    f"hint batch {hint_img.shape[0]} != latent batch "
+                    f"{x.shape[0]}: pass ONE hint image (it broadcasts to the "
+                    "batch); per-sample hints are not supported"
+                )
+            hint_img = jnp.repeat(hint_img, x.shape[0], axis=0)
+        want_hw = (x.shape[1] * 8, x.shape[2] * 8)
+        if hint_img.shape[1:3] != want_hw:
+            # Stock auto-resizes the hint to the generation size
+            # (common_upscale); shapes are static under jit so this traces.
+            hint_img = jax.image.resize(
+                hint_img,
+                (hint_img.shape[0], *want_hw, hint_img.shape[-1]),
+                method="bilinear",
+            )
+        ctrl = ctrl_apply(
+            p["ctrl"], x, timesteps, context, hint=hint_img, y=kw.get("y"),
+        )
+        gate = jnp.float32(strength)
+        if (start_p, end_p) != (0.0, 1.0):
+            progress = 1.0 - timesteps.astype(jnp.float32) / 999.0
+            on = (progress >= start_p) & (progress <= end_p)
+            gate = gate * on.astype(jnp.float32)[:, None, None, None]
+        ctrl = jax.tree.map(lambda a: a * gate, ctrl)
+        if control is not None:
+            # Stacked ControlNets (a chain of apply_control compositions):
+            # residuals from the outer net(s) arrive via the ``control``
+            # kwarg and SUM with this net's — the host's multi-controlnet
+            # accumulation. Structures match because every net shares the
+            # base UNet's skip layout.
+            ctrl = jax.tree.map(lambda a, b: a + b, ctrl, control)
+        return base_apply(p["base"], x, timesteps, context, control=ctrl, **kw)
+
+    return DiffusionModel(
+        apply=apply,
+        params=merged,
+        name=f"{base.name}+control",
+        config=base.config,
+    )
+
+
+# Re-exported config alias: ControlNets share the UNet config surface.
+ControlNetConfig = UNetConfig

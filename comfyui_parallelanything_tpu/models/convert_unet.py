@@ -139,15 +139,10 @@ def _spatial_transformer(
     return st
 
 
-def convert_sd_unet_checkpoint(
-    state_dict: Mapping[str, Any], cfg: UNetConfig
-) -> dict:
-    """ldm-layout UNet state dict → ``models.unet.UNet2D`` param pytree.
-
-    ``state_dict`` keys are relative to the UNet root (strip any
-    ``model.diffusion_model.`` prefix first — see ``strip_prefix``).
-    """
-    sd = state_dict
+def _encoder_params(sd: Mapping[str, Any], cfg: UNetConfig) -> dict:
+    """The shared trunk conversion — time/label embeds, input path, middle —
+    used by both the full UNet and the ControlNet (whose encoder is a copy of
+    the UNet's with identical ldm naming)."""
     ch = cfg.model_channels
     p: dict[str, Any] = {}
 
@@ -196,6 +191,23 @@ def convert_sd_unet_checkpoint(
         p["mid_res2"] = _res_block(sd, "middle_block.2", has_skip=False)
     else:
         p["mid_res2"] = _res_block(sd, "middle_block.1", has_skip=False)
+    return p
+
+
+def convert_sd_unet_checkpoint(
+    state_dict: Mapping[str, Any], cfg: UNetConfig
+) -> dict:
+    """ldm-layout UNet state dict → ``models.unet.UNet2D`` param pytree.
+
+    ``state_dict`` keys are relative to the UNet root (strip any
+    ``model.diffusion_model.`` prefix first — see ``strip_prefix``).
+    """
+    sd = state_dict
+    ch = cfg.model_channels
+    p = _encoder_params(sd, cfg)
+
+    def attn_at(level: int) -> bool:
+        return level in cfg.attention_levels and cfg.transformer_depth[level] > 0
 
     # -- output (up) path ---------------------------------------------------------
     idx = 0
@@ -233,3 +245,34 @@ def strip_prefix(state_dict: Mapping[str, Any], prefix: str = "model.diffusion_m
     return out if out else dict(state_dict)
 
 
+
+
+def convert_controlnet_checkpoint(
+    state_dict: Mapping[str, Any], cfg: UNetConfig
+) -> dict:
+    """ldm-layout ControlNet state dict → ``models.controlnet.ControlNet2D``
+    param pytree.
+
+    Beyond the shared encoder trunk (``_encoder_params``), the ControlNet adds:
+
+    - ``input_hint_block.{0,2,...,14}`` → ``hint_{0..7}`` (8 convs, pixels →
+      8×-reduced latent grid; the last one is a zero conv to model_channels)
+    - ``zero_convs.{k}.0``              → ``zero_conv_{k}`` (one 1×1 per skip)
+    - ``middle_block_out.0``            → ``mid_out``
+
+    Keys are relative to the ControlNet root (public single-file controlnets
+    ship bare; diffusers-reexports carry a ``control_model.`` prefix — strip
+    it with ``strip_prefix(sd, "control_model.")`` first).
+    """
+    sd = state_dict
+    p = _encoder_params(sd, cfg)
+    for i in range(8):
+        p[f"hint_{i}"] = _conv(sd, f"input_hint_block.{2 * i}")
+    n_zero = 1 + sum(
+        cfg.num_res_blocks + (1 if level != len(cfg.channel_mult) - 1 else 0)
+        for level in range(len(cfg.channel_mult))
+    )
+    for k in range(n_zero):
+        p[f"zero_conv_{k}"] = _conv(sd, f"zero_convs.{k}.0")
+    p["mid_out"] = _conv(sd, "middle_block_out.0")
+    return tree_to_jnp(p)

@@ -138,6 +138,44 @@ def load_sd_unet_checkpoint(
     return build_unet(cfg, name=name, params=convert_sd_unet_checkpoint(sd, cfg))
 
 
+def load_controlnet_checkpoint(
+    src: Any,
+    cfg: "UNetConfig | None" = None,
+    name: str = "controlnet",
+) -> DiffusionModel:
+    """ControlNet checkpoint (ldm single-file layout; bare keys or the
+    ``control_model.`` prefix some exports carry) → a ControlNet
+    DiffusionModel for ``apply_control``. With ``cfg=None`` the base-UNet
+    family is sniffed off the cross-attention context width (768 → sd15,
+    1024 → sd21, 2048/label_emb → sdxl)."""
+    from .controlnet import build_controlnet
+    from .convert_unet import convert_controlnet_checkpoint
+
+    sd = dict(_resolve_state_dict(src))
+    if any(k.startswith("control_model.") for k in sd):
+        sd = strip_prefix(sd, "control_model.")
+    if cfg is None:
+        # Package-level attrs (not .unet directly): the node layer resolves
+        # configs through the package namespace everywhere else, and tests
+        # shrink models by monkeypatching exactly these names.
+        from . import sd15_config, sd21_config, sdxl_config
+
+        key = next(
+            (k for k in sd if k.endswith("attn2.to_k.weight")
+             and k.startswith("input_blocks.")), None,
+        )
+        ctx = int(to_numpy(sd[key]).shape[1]) if key else 768
+        if any(k.startswith("label_emb.") for k in sd) or ctx == 2048:
+            cfg = sdxl_config()
+        elif ctx == 1024:
+            cfg = sd21_config()
+        else:
+            cfg = sd15_config()
+    return build_controlnet(
+        cfg, name=name, params=convert_controlnet_checkpoint(sd, cfg)
+    )
+
+
 def sniff_model_family(state_dict: Mapping[str, Any]) -> str:
     """Model family id (nodes._MODEL_FAMILIES vocabulary) from checkpoint key
     signatures — the stock ``CheckpointLoaderSimple`` has no family widget, so

@@ -169,12 +169,21 @@ class Upsample(nn.Module):
 
 
 class UNet2D(nn.Module):
-    """forward(x NHWC, timesteps (B,), context (B,S,D), y=(B,adm) for SDXL)."""
+    """forward(x NHWC, timesteps (B,), context (B,S,D), y=(B,adm) for SDXL).
+
+    ``control`` injects ControlNet residuals (models/controlnet.py): a dict
+    with ``"input"`` (one NHWC residual per skip entry, added as each skip is
+    consumed — the host UNet's hs.pop() + control pop convention) and
+    ``"middle"`` (added to the middle-block output). Composed models build the
+    dict inside the same jit program (``apply_control``), so it never crosses
+    the kwargs-partitioning boundary as a python value.
+    """
 
     cfg: UNetConfig
 
     @nn.compact
-    def __call__(self, x, timesteps, context=None, y=None, **kwargs):
+    def __call__(self, x, timesteps, context=None, y=None, control=None,
+                 **kwargs):
         cfg = self.cfg
         ch = cfg.model_channels
         t_emb = timestep_embedding(timesteps, ch).astype(cfg.dtype)
@@ -216,11 +225,26 @@ class UNet2D(nn.Module):
         if mid_depth > 0:
             h = SpatialTransformer(cfg, mid_ch, mid_depth, name="mid_attn")(h, context)
         h = ResBlock(cfg, mid_ch, name="mid_res2")(h, emb)
+        ctrl_in: list = []
+        if control is not None:
+            mid_residuals = control.get("middle") or ()
+            if mid_residuals:
+                h = h + mid_residuals[0].astype(h.dtype)
+            ctrl_in = list(control.get("input") or ())
+            if ctrl_in and len(ctrl_in) != len(skips):
+                raise ValueError(
+                    f"control['input'] has {len(ctrl_in)} residuals for "
+                    f"{len(skips)} skip connections — ControlNet/UNet config "
+                    "mismatch"
+                )
         # -- output (up) blocks ----------------------------------------------------
         for level in reversed(range(len(cfg.channel_mult))):
             out_ch = ch * cfg.channel_mult[level]
             for i in range(cfg.num_res_blocks + 1):
-                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                skip = skips.pop()
+                if ctrl_in:
+                    skip = skip + ctrl_in.pop().astype(skip.dtype)
+                h = jnp.concatenate([h, skip], axis=-1)
                 h = ResBlock(cfg, out_ch, name=f"out_{level}_{i}_res")(h, emb)
                 if level in cfg.attention_levels and cfg.transformer_depth[level] > 0:
                     h = SpatialTransformer(

@@ -992,6 +992,64 @@ def _scheduler_menu() -> list[str]:
     return list(SCHEDULER_NAMES)
 
 
+def _model_with_control(model, specs):
+    """Compose ControlNet residual injection into the MODEL for this sampler
+    run (the ``control`` tag Apply nodes leave on the positive conditioning —
+    a TUPLE, so chained Apply nodes stack and their residuals sum, the host's
+    multi-controlnet accumulation). The composition is a single merged
+    DiffusionModel — every control trunk + the base trunk in one jit program —
+    and a parallelized MODEL re-parallelizes the composition over its own
+    chain/config, so DP/FSDP placement covers all the networks. Control
+    therefore conditions every model call (cond AND uncond) — the host's
+    ControlNetApplyAdvanced semantics; for the plain positive-only
+    ControlNetApply this is a documented divergence (stock scopes it to cond).
+
+    Returns ``(model, teardown)``: when the composition re-parallelized, the
+    caller must call ``teardown()`` after the run — the ORIGINAL placement
+    stays resident (it is the cached workflow output later prompts reuse), so
+    the composed placement is a transient whose device memory must be
+    released."""
+    if not specs:
+        return model, None
+    from .models.api import DiffusionModel
+    from .models.controlnet import apply_control
+    from .parallel.orchestrator import ParallelModel, parallelize
+
+    specs = specs if isinstance(specs, (list, tuple)) else (specs,)
+
+    def compose(base):
+        for spec in specs:
+            base = apply_control(
+                base, spec["model"], spec["hint"],
+                strength=float(spec.get("strength", 1.0)),
+                start_percent=float(spec.get("start_percent", 0.0)),
+                end_percent=float(spec.get("end_percent", 1.0)),
+            )
+        return base
+
+    if isinstance(model, ParallelModel):
+        if model._pipeline_spec is not None:
+            from .utils.logging import get_logger
+
+            get_logger().info(
+                "ControlNet composition: batch==1 pipeline placement is "
+                "unavailable for the composed model (no staged decomposition "
+                "of the control trunk) — DP/single-device routing only"
+            )
+        base = DiffusionModel(
+            apply=model._apply, params=model._host_params,
+            config=model.model_config,
+        )
+        composed_pm = parallelize(compose(base), model.chain, config=model.config)
+        return composed_pm, getattr(composed_pm, "cleanup", None)
+    if not (hasattr(model, "apply") and hasattr(model, "params")):
+        raise ValueError(
+            "ControlNet needs a MODEL with (apply, params) — wire the loader "
+            "output (optionally through ParallelAnything) into the sampler"
+        )
+    return compose(model), None
+
+
 def _prepare_sampling_inputs(model, positive, negative, latent):
     """Shared sampler-node boundary (TPUKSampler + TPUSamplerCustomAdvanced):
     conditioning batch broadcast (ComfyUI semantics: one encoded prompt
@@ -1061,6 +1119,15 @@ def _prepare_sampling_inputs(model, positive, negative, latent):
         get_logger().warning(
             "combined/area NEGATIVE conditioning is not supported — sampling "
             "with the primary negative prompt, full-frame"
+        )
+    if negative and negative.get("control"):
+        from .utils.logging import get_logger
+
+        get_logger().warning(
+            "a ControlNet tag on the NEGATIVE conditioning is ignored — "
+            "control composes into the MODEL from the positive tag and "
+            "conditions cond AND uncond calls alike (ControlNetApplyAdvanced "
+            "semantics)"
         )
     cond_extra = {
         "extra_conds": extras,
@@ -1165,25 +1232,34 @@ class TPUKSampler:
         model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra = (
             _prepare_sampling_inputs(model, positive, negative, latent)
         )
-        kwargs = {} if pooled is None else {"y": pooled}
-        out = run_sampler(
-            model, noise, context, sampler=sampler_name, steps=steps,
-            cfg_scale=cfg, uncond_context=uncond_context,
-            uncond_kwargs=uncond_kwargs, rng=rng, shift=shift, **cond_extra,
-            guidance=guidance if guidance > 0 else None,
-            scheduler=scheduler,
-            cfg_rescale=cfg_rescale,
-            compile_loop=compile_loop,
-            prediction=getattr(model_cfg, "prediction", "eps"),
-            init_latent=(
-                latent["samples"]
-                if (denoise < 1.0 or "noise_mask" in latent)
-                else None
-            ),
-            denoise=denoise,
-            latent_mask=latent.get("noise_mask"),
-            **kwargs,
+        model, ctrl_teardown = _model_with_control(
+            model, positive.get("control")
         )
+        kwargs = {} if pooled is None else {"y": pooled}
+        try:
+            out = run_sampler(
+                model, noise, context, sampler=sampler_name, steps=steps,
+                cfg_scale=cfg, uncond_context=uncond_context,
+                uncond_kwargs=uncond_kwargs, rng=rng, shift=shift, **cond_extra,
+                guidance=guidance if guidance > 0 else None,
+                scheduler=scheduler,
+                cfg_rescale=cfg_rescale,
+                compile_loop=compile_loop,
+                prediction=getattr(model_cfg, "prediction", "eps"),
+                init_latent=(
+                    latent["samples"]
+                    if (denoise < 1.0 or "noise_mask" in latent)
+                    else None
+                ),
+                denoise=denoise,
+                latent_mask=latent.get("noise_mask"),
+                **kwargs,
+            )
+            # Read back before teardown frees the composed placement.
+            out = jax.block_until_ready(out)
+        finally:
+            if ctrl_teardown is not None:
+                ctrl_teardown()
         return ({"samples": out},)
 
 
@@ -1685,24 +1761,33 @@ class TPUSamplerCustomAdvanced:
         model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra = (
             _prepare_sampling_inputs(model, positive, negative, latent_image)
         )
-        prediction = getattr(model_cfg, "prediction", "eps")
-        out = run_sampler(
-            model, noise_arr, context,
-            sampler=sampler["sampler"],
-            **cond_extra,
-            steps=max(1, len(sigmas) - 1),
-            sigmas=sigmas,
-            cfg_scale=cfg,
-            uncond_context=uncond_context,
-            uncond_kwargs=uncond_kwargs,
-            rng=rng,
-            guidance=positive.get("guidance"),
-            prediction=prediction,
-            init_latent=latent_image["samples"],
-            latent_mask=latent_image.get("noise_mask"),
-            compile_loop=compile_loop,
-            **({} if pooled is None else {"y": pooled}),
+        model, ctrl_teardown = _model_with_control(
+            model, positive.get("control")
         )
+        prediction = getattr(model_cfg, "prediction", "eps")
+        try:
+            out = run_sampler(
+                model, noise_arr, context,
+                sampler=sampler["sampler"],
+                **cond_extra,
+                steps=max(1, len(sigmas) - 1),
+                sigmas=sigmas,
+                cfg_scale=cfg,
+                uncond_context=uncond_context,
+                uncond_kwargs=uncond_kwargs,
+                rng=rng,
+                guidance=positive.get("guidance"),
+                prediction=prediction,
+                init_latent=latent_image["samples"],
+                latent_mask=latent_image.get("noise_mask"),
+                compile_loop=compile_loop,
+                **({} if pooled is None else {"y": pooled}),
+            )
+            # Read back before a control teardown frees the composed placement.
+            out = jax.block_until_ready(out)
+        finally:
+            if ctrl_teardown is not None:
+                ctrl_teardown()
         # Host inverse_noise_scaling: a PARTIAL flow run (split sigmas, final
         # σ > 0) stores its output un-interpolated, so the next stage's
         # (1−σ)·latent noise_scaling restores the in-flight state exactly;
@@ -1720,6 +1805,85 @@ class TPUSamplerCustomAdvanced:
                 )
             out = out / (1.0 - s_last)
         return ({"samples": out}, {"samples": out})
+
+
+class TPUControlNetLoader:
+    """ControlNet checkpoint file → CONTROL_NET wire. The base-UNet family is
+    sniffed off the checkpoint (context width / label_emb) unless the caller
+    passes one of the UNet families explicitly."""
+
+    DESCRIPTION = "Load an SD-family ControlNet (family sniffed)."
+    RETURN_TYPES = ("CONTROL_NET",)
+    RETURN_NAMES = ("control_net",)
+    FUNCTION = "load"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "ckpt_path": ("STRING", {"default": "",
+                                         "tooltip": "safetensors path"}),
+            }
+        }
+
+    def load(self, ckpt_path: str):
+        from .models import load_controlnet_checkpoint
+
+        return ({"model": load_controlnet_checkpoint(ckpt_path)},)
+
+
+class TPUControlNetApply:
+    """Tag a conditioning with ControlNet guidance: the sampler nodes compose
+    the control trunk into the MODEL for the run (one jit program; see
+    models/controlnet.apply_control), so the residuals condition every model
+    call — cond and uncond alike, the host's behavior. ``image`` is the hint
+    in pixels (8x the latent grid); ``start_percent``/``end_percent`` gate by
+    sampling progress."""
+
+    DESCRIPTION = "Apply a ControlNet hint image to conditioning."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "apply"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING", {}),
+                "control_net": ("CONTROL_NET", {}),
+                "image": ("IMAGE", {}),
+                "strength": ("FLOAT", {"default": 1.0, "min": 0.0,
+                                       "max": 10.0, "step": 0.01}),
+            },
+            "optional": {
+                "start_percent": ("FLOAT", {"default": 0.0, "min": 0.0,
+                                            "max": 1.0, "step": 0.001}),
+                "end_percent": ("FLOAT", {"default": 1.0, "min": 0.0,
+                                          "max": 1.0, "step": 0.001}),
+            },
+        }
+
+    def apply(self, conditioning, control_net, image, strength: float = 1.0,
+              start_percent: float = 0.0, end_percent: float = 1.0):
+        import jax.numpy as jnp
+
+        img = jnp.asarray(image)
+        if img.ndim == 3:
+            img = img[None]
+        spec = {
+            "model": control_net["model"],
+            "hint": img,
+            "strength": float(strength),
+            "start_percent": float(start_percent),
+            "end_percent": float(end_percent),
+        }
+        # Chained Apply nodes STACK (residuals sum, the host's
+        # multi-controlnet accumulation) — a tuple on the wire.
+        prior = conditioning.get("control") or ()
+        prior = prior if isinstance(prior, (list, tuple)) else (prior,)
+        return ({**conditioning, "control": tuple(prior) + (spec,)},)
 
 
 NODE_CLASS_MAPPINGS = {
@@ -1751,6 +1915,8 @@ NODE_CLASS_MAPPINGS = {
     "TPUDisableNoise": TPUDisableNoise,
     "TPUSplitSigmas": TPUSplitSigmas,
     "TPUFlipSigmas": TPUFlipSigmas,
+    "TPUControlNetLoader": TPUControlNetLoader,
+    "TPUControlNetApply": TPUControlNetApply,
 }
 
 NODE_DISPLAY_NAME_MAPPINGS = {
@@ -1782,6 +1948,8 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPUDisableNoise": "Disable Noise (TPU)",
     "TPUSplitSigmas": "Split Sigmas (TPU)",
     "TPUFlipSigmas": "Flip Sigmas (TPU)",
+    "TPUControlNetLoader": "Load ControlNet (TPU)",
+    "TPUControlNetApply": "Apply ControlNet (TPU)",
 }
 
 # Stock-ComfyUI class-name shims (CheckpointLoaderSimple, CLIPTextEncode,

@@ -570,6 +570,105 @@ class _EmptyLatent16ch:
         )
 
 
+class ControlNetLoader:
+    """Stock loader: control_net_name resolves via $PA_MODELS_DIR/controlnet."""
+
+    DESCRIPTION = "Stock-name ControlNet loader (folder-layout resolution)."
+    RETURN_TYPES = ("CONTROL_NET",)
+    RETURN_NAMES = ("control_net",)
+    FUNCTION = "load_controlnet"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"control_net_name": ("STRING", {"default": ""})}}
+
+    def load_controlnet(self, control_net_name: str):
+        from .nodes import TPUControlNetLoader
+
+        path = resolve_model_file(control_net_name, "controlnet")
+        if not control_net_name or not os.path.isfile(path):
+            raise ValueError(
+                f"ControlNet file not found: {control_net_name!r} (searched "
+                "$PA_MODELS_DIR/controlnet and the name as a path)"
+            )
+        return TPUControlNetLoader().load(ckpt_path=path)
+
+
+class ControlNetApply:
+    """Stock apply: (conditioning, control_net, image, strength). The control
+    trunk composes into the MODEL at sampling (one jit program), conditioning
+    cond AND uncond calls — the host's semantics."""
+
+    DESCRIPTION = "Stock-name ControlNet apply."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "apply_controlnet"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING", {}),
+                "control_net": ("CONTROL_NET", {}),
+                "image": ("IMAGE", {}),
+                "strength": ("FLOAT", {"default": 1.0, "min": 0.0,
+                                       "max": 10.0, "step": 0.01}),
+            }
+        }
+
+    def apply_controlnet(self, conditioning, control_net, image,
+                         strength: float = 1.0):
+        from .nodes import TPUControlNetApply
+
+        return TPUControlNetApply().apply(
+            conditioning, control_net, image, strength
+        )
+
+
+class ControlNetApplyAdvanced:
+    """Stock advanced apply: (positive, negative, control_net, image,
+    strength, start_percent, end_percent) → (positive, negative). The control
+    tag rides the positive; because the sampler composes control into the
+    MODEL itself, the negative's calls are conditioned identically (stock
+    applies the same control to both — same net effect, one tag)."""
+
+    DESCRIPTION = "Stock-name ControlNet apply (strength window)."
+    RETURN_TYPES = ("CONDITIONING", "CONDITIONING")
+    RETURN_NAMES = ("positive", "negative")
+    FUNCTION = "apply_controlnet"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "positive": ("CONDITIONING", {}),
+                "negative": ("CONDITIONING", {}),
+                "control_net": ("CONTROL_NET", {}),
+                "image": ("IMAGE", {}),
+                "strength": ("FLOAT", {"default": 1.0, "min": 0.0,
+                                       "max": 10.0, "step": 0.01}),
+                "start_percent": ("FLOAT", {"default": 0.0, "min": 0.0,
+                                            "max": 1.0, "step": 0.001}),
+                "end_percent": ("FLOAT", {"default": 1.0, "min": 0.0,
+                                          "max": 1.0, "step": 0.001}),
+            }
+        }
+
+    def apply_controlnet(self, positive, negative, control_net, image,
+                         strength: float = 1.0, start_percent: float = 0.0,
+                         end_percent: float = 1.0):
+        from .nodes import TPUControlNetApply
+
+        (tagged,) = TPUControlNetApply().apply(
+            positive, control_net, image, strength,
+            start_percent=start_percent, end_percent=end_percent,
+        )
+        return tagged, negative
+
+
 class ConditioningCombine:
     """Stock combine: BOTH conditionings apply during sampling. The second
     cond (and any extras it accumulated) rides the first's ``extras`` tuple;
@@ -880,6 +979,9 @@ def stock_node_mappings() -> dict[str, type]:
         "ConditioningCombine": ConditioningCombine,
         "ConditioningSetArea": ConditioningSetArea,
         "ConditioningAverage": ConditioningAverage,
+        "ControlNetLoader": ControlNetLoader,
+        "ControlNetApply": ControlNetApply,
+        "ControlNetApplyAdvanced": ControlNetApplyAdvanced,
         "LatentUpscaleBy": _renamed(
             n.TPULatentUpscale, {"samples": "latent", "scale_by": "scale",
                                  "upscale_method": "method"},
