@@ -146,6 +146,7 @@ def run_workflow(
     class_mappings: dict[str, type] | None = None,
     outputs: "dict[str, tuple] | WorkflowCache | None" = None,
     on_node=None,
+    on_cached=None,
 ) -> dict[str, tuple]:
     """Execute a ComfyUI API-format workflow; returns ``{node_id: outputs}``.
 
@@ -160,9 +161,12 @@ def run_workflow(
 
     ``on_node(nid)`` fires immediately before each node actually executes
     (cached nodes are skipped, matching ComfyUI's ``executing`` event, which
-    the server layer forwards to /ws clients). A ``utils.progress.Interrupted``
-    raised inside a node (the cooperative sampler interrupt) propagates
-    unwrapped so callers can distinguish "interrupted" from "failed".
+    the server layer forwards to /ws clients). ``on_cached(nids)`` fires once
+    before execution with the sorted graph nodes served from pre-seeded
+    outputs/cache (ComfyUI's ``execution_cached`` event). A
+    ``utils.progress.Interrupted`` raised inside a node (the cooperative
+    sampler interrupt) propagates unwrapped so callers can distinguish
+    "interrupted" from "failed".
     """
     from .nodes import NODE_CLASS_MAPPINGS
 
@@ -285,6 +289,10 @@ def run_workflow(
             for nid in cache.results
             if nid not in graph or cache.signatures.get(nid) != sigs[nid]
         )
+    if on_cached is not None:
+        cached = sorted(nid for nid in graph if nid in results)
+        if cached:
+            on_cached(cached)
 
     def exec_visit(nid, spec, cls, links, hidden):
         kwargs: dict[str, Any] = {}

@@ -992,30 +992,56 @@ def _scheduler_menu() -> list[str]:
     return list(SCHEDULER_NAMES)
 
 
-def _model_with_control(model, specs):
-    """Compose ControlNet residual injection into the MODEL for this sampler
-    run (the ``control`` tag Apply nodes leave on the positive conditioning —
-    a TUPLE, so chained Apply nodes stack and their residuals sum, the host's
-    multi-controlnet accumulation). The composition is a single merged
-    DiffusionModel — every control trunk + the base trunk in one jit program —
-    and a parallelized MODEL re-parallelizes the composition over its own
-    chain/config, so DP/FSDP placement covers all the networks. Control
-    therefore conditions every model call (cond AND uncond) — the host's
-    ControlNetApplyAdvanced semantics; for the plain positive-only
-    ControlNetApply this is a documented divergence (stock scopes it to cond).
+def _collect_control(positive) -> tuple:
+    """Every control spec reachable from the positive conditioning: the
+    top-level ``control`` tuple plus tags riding combined ``extras`` entries
+    (ConditioningCombine moves the second cond — control tag included — into
+    extras; dropping those silently would make control order-dependent)."""
+    def tags(cond):
+        c = cond.get("control") or ()
+        return tuple(c) if isinstance(c, (list, tuple)) else (c,)
 
-    Returns ``(model, teardown)``: when the composition re-parallelized, the
-    caller must call ``teardown()`` after the run — the ORIGINAL placement
-    stays resident (it is the cached workflow output later prompts reuse), so
-    the composed placement is a transient whose device memory must be
-    released."""
+    specs = tags(positive)
+    for e in positive.get("extras", ()):
+        specs += tags(e)
+    return specs
+
+
+def _model_with_control(model, specs):
+    """Compose ControlNet residual injection into the MODEL (the ``control``
+    tags Apply nodes leave on the positive conditioning — chained Apply nodes
+    stack and their residuals sum, the host's multi-controlnet accumulation).
+    The composition is a single merged DiffusionModel — every control trunk +
+    the base trunk in one jit program — and a parallelized MODEL
+    re-parallelizes the composition over its own chain/config, so DP/FSDP
+    placement covers all the networks. Control therefore conditions every
+    model call (cond AND uncond) — the host's ControlNetApplyAdvanced
+    semantics; for the plain positive-only ControlNetApply this is a
+    documented divergence (stock scopes it to cond).
+
+    The composition is CACHED on the base model keyed by the spec identities
+    (strong refs held, so ids stay valid) and stays resident across prompts —
+    re-running with the same ControlNet setup reuses the placed params and
+    compiled programs instead of paying placement + XLA compile per prompt.
+    A different setup replaces the cache entry (the old composition's
+    placement is cleaned up); memory note: for a parallelized MODEL the base
+    placement (the cached workflow output) and the composed placement coexist
+    while control is in use — a placement OOM degrades through the normal
+    drop-device path."""
     if not specs:
-        return model, None
+        return model
     from .models.api import DiffusionModel
     from .models.controlnet import apply_control
     from .parallel.orchestrator import ParallelModel, parallelize
 
-    specs = specs if isinstance(specs, (list, tuple)) else (specs,)
+    key = tuple(
+        (id(s["model"]), id(s["hint"]), float(s.get("strength", 1.0)),
+         float(s.get("start_percent", 0.0)), float(s.get("end_percent", 1.0)))
+        for s in specs
+    )
+    cached = getattr(model, "_control_composed", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
 
     def compose(base):
         for spec in specs:
@@ -1040,14 +1066,24 @@ def _model_with_control(model, specs):
             apply=model._apply, params=model._host_params,
             config=model.model_config,
         )
-        composed_pm = parallelize(compose(base), model.chain, config=model.config)
-        return composed_pm, getattr(composed_pm, "cleanup", None)
-    if not (hasattr(model, "apply") and hasattr(model, "params")):
-        raise ValueError(
-            "ControlNet needs a MODEL with (apply, params) — wire the loader "
-            "output (optionally through ParallelAnything) into the sampler"
-        )
-    return compose(model), None
+        composed = parallelize(compose(base), model.chain, config=model.config)
+    else:
+        if not (hasattr(model, "apply") and hasattr(model, "params")):
+            raise ValueError(
+                "ControlNet needs a MODEL with (apply, params) — wire the "
+                "loader output (optionally through ParallelAnything) into "
+                "the sampler"
+            )
+        composed = compose(model)
+    if cached is not None and hasattr(cached[1], "cleanup"):
+        cached[1].cleanup()  # a replaced composition frees its placement
+    # specs kept in the entry: the id()-based key stays valid only while the
+    # tagged objects are alive.
+    try:
+        object.__setattr__(model, "_control_composed", (key, composed, specs))
+    except (AttributeError, TypeError):
+        pass  # uncacheable model object: composition still works, uncached
+    return composed
 
 
 def _prepare_sampling_inputs(model, positive, negative, latent):
@@ -1232,34 +1268,26 @@ class TPUKSampler:
         model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra = (
             _prepare_sampling_inputs(model, positive, negative, latent)
         )
-        model, ctrl_teardown = _model_with_control(
-            model, positive.get("control")
-        )
+        model = _model_with_control(model, _collect_control(positive))
         kwargs = {} if pooled is None else {"y": pooled}
-        try:
-            out = run_sampler(
-                model, noise, context, sampler=sampler_name, steps=steps,
-                cfg_scale=cfg, uncond_context=uncond_context,
-                uncond_kwargs=uncond_kwargs, rng=rng, shift=shift, **cond_extra,
-                guidance=guidance if guidance > 0 else None,
-                scheduler=scheduler,
-                cfg_rescale=cfg_rescale,
-                compile_loop=compile_loop,
-                prediction=getattr(model_cfg, "prediction", "eps"),
-                init_latent=(
-                    latent["samples"]
-                    if (denoise < 1.0 or "noise_mask" in latent)
-                    else None
-                ),
-                denoise=denoise,
-                latent_mask=latent.get("noise_mask"),
-                **kwargs,
-            )
-            # Read back before teardown frees the composed placement.
-            out = jax.block_until_ready(out)
-        finally:
-            if ctrl_teardown is not None:
-                ctrl_teardown()
+        out = run_sampler(
+            model, noise, context, sampler=sampler_name, steps=steps,
+            cfg_scale=cfg, uncond_context=uncond_context,
+            uncond_kwargs=uncond_kwargs, rng=rng, shift=shift, **cond_extra,
+            guidance=guidance if guidance > 0 else None,
+            scheduler=scheduler,
+            cfg_rescale=cfg_rescale,
+            compile_loop=compile_loop,
+            prediction=getattr(model_cfg, "prediction", "eps"),
+            init_latent=(
+                latent["samples"]
+                if (denoise < 1.0 or "noise_mask" in latent)
+                else None
+            ),
+            denoise=denoise,
+            latent_mask=latent.get("noise_mask"),
+            **kwargs,
+        )
         return ({"samples": out},)
 
 
@@ -1761,33 +1789,25 @@ class TPUSamplerCustomAdvanced:
         model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra = (
             _prepare_sampling_inputs(model, positive, negative, latent_image)
         )
-        model, ctrl_teardown = _model_with_control(
-            model, positive.get("control")
-        )
+        model = _model_with_control(model, _collect_control(positive))
         prediction = getattr(model_cfg, "prediction", "eps")
-        try:
-            out = run_sampler(
-                model, noise_arr, context,
-                sampler=sampler["sampler"],
-                **cond_extra,
-                steps=max(1, len(sigmas) - 1),
-                sigmas=sigmas,
-                cfg_scale=cfg,
-                uncond_context=uncond_context,
-                uncond_kwargs=uncond_kwargs,
-                rng=rng,
-                guidance=positive.get("guidance"),
-                prediction=prediction,
-                init_latent=latent_image["samples"],
-                latent_mask=latent_image.get("noise_mask"),
-                compile_loop=compile_loop,
-                **({} if pooled is None else {"y": pooled}),
-            )
-            # Read back before a control teardown frees the composed placement.
-            out = jax.block_until_ready(out)
-        finally:
-            if ctrl_teardown is not None:
-                ctrl_teardown()
+        out = run_sampler(
+            model, noise_arr, context,
+            sampler=sampler["sampler"],
+            **cond_extra,
+            steps=max(1, len(sigmas) - 1),
+            sigmas=sigmas,
+            cfg_scale=cfg,
+            uncond_context=uncond_context,
+            uncond_kwargs=uncond_kwargs,
+            rng=rng,
+            guidance=positive.get("guidance"),
+            prediction=prediction,
+            init_latent=latent_image["samples"],
+            latent_mask=latent_image.get("noise_mask"),
+            compile_loop=compile_loop,
+            **({} if pooled is None else {"y": pooled}),
+        )
         # Host inverse_noise_scaling: a PARTIAL flow run (split sigmas, final
         # σ > 0) stores its output un-interpolated, so the next stage's
         # (1−σ)·latent noise_scaling restores the in-flight state exactly;

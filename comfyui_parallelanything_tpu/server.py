@@ -26,12 +26,15 @@ Endpoints (the ComfyUI client-protocol subset that makes scripts work):
 - ``GET  /ws``                WebSocket progress events (RFC 6455, stdlib):
                               ``status`` on queue changes,
                               ``execution_start`` when a prompt begins,
-                              ``executing`` per node as it runs, ``progress``
-                              per sampler step (what frontends render progress
-                              bars from), ``execution_interrupted`` on Cancel,
-                              and the canonical completion signal API clients
-                              wait for — ``executing`` with ``node: null``
-                              and the ``prompt_id``.
+                              ``execution_cached`` with the cache-served node
+                              ids, ``executing`` per node as it runs,
+                              ``progress`` per sampler step (what frontends
+                              render progress bars from), ``executed`` per
+                              output node with its images,
+                              ``execution_interrupted`` on Cancel, and the
+                              canonical completion signal API clients wait
+                              for — ``executing`` with ``node: null`` and the
+                              ``prompt_id``.
 
 Run:  ``python -m comfyui_parallelanything_tpu.server [--port 8188]``
 """
@@ -310,17 +313,31 @@ class PromptQueue:
                              "prompt_id": _pid, "node": _cur["node"]},
                 })
 
+            def on_cached(nids, _pid=pid):
+                self._emit({
+                    "type": "execution_cached",
+                    "data": {"nodes": list(nids), "prompt_id": _pid},
+                })
+
             prev_hook = set_progress_hook(hook)
             try:
                 results = run_workflow(
                     prompt, class_mappings=self.class_mappings,
-                    outputs=self.cache, on_node=on_node,
+                    outputs=self.cache, on_node=on_node, on_cached=on_cached,
                 )
                 entry = {
                     "status": {"status_str": "success", "completed": True,
                                "exec_s": round(time.time() - t0, 3)},
                     "outputs": self._image_outputs(prompt, results),
                 }
+                # Per-output-node `executed` events (what API clients collect
+                # result images from without polling /history).
+                for nid, out in entry["outputs"].items():
+                    self._emit({
+                        "type": "executed",
+                        "data": {"node": nid, "output": out,
+                                 "prompt_id": pid},
+                    })
             except Interrupted:
                 entry = {
                     "status": {"status_str": "interrupted", "completed": False},

@@ -270,6 +270,37 @@ class TestControlParallel:
                                    rtol=2e-4, atol=2e-4)
 
 
+class TestControlPlumbing:
+    def test_collect_control_reaches_combined_extras(self):
+        # A ControlNetApply tag on the SECOND input of ConditioningCombine
+        # rides the extras tuple — it must still compose (order-independent).
+        from comfyui_parallelanything_tpu.nodes import _collect_control
+
+        spec_a, spec_b = {"model": "A"}, {"model": "B"}
+        positive = {
+            "context": None,
+            "control": (spec_a,),
+            "extras": ({"context": None, "control": (spec_b,)},
+                       {"context": None}),
+        }
+        assert _collect_control(positive) == (spec_a, spec_b)
+        assert _collect_control({"context": None}) == ()
+
+    def test_composition_cached_across_calls(self, tiny_pair):
+        # Same specs → the SAME composed model object (placement + compiled
+        # programs reused across prompts); changed strength → a fresh one.
+        from comfyui_parallelanything_tpu.nodes import _model_with_control
+
+        cfg, base, cn = tiny_pair
+        hint = jnp.zeros((1, 64, 64, 3))
+        spec = {"model": cn, "hint": hint, "strength": 1.0}
+        m1 = _model_with_control(base, (spec,))
+        m2 = _model_with_control(base, (spec,))
+        assert m1 is m2
+        m3 = _model_with_control(base, ({**spec, "strength": 0.5},))
+        assert m3 is not m1
+
+
 class TestControlWorkflow:
     def test_stock_controlnet_workflow_runs(self, tmp_path, monkeypatch):
         # Exported-style graph: ControlNetLoader → ControlNetApplyAdvanced

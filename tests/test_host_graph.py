@@ -540,6 +540,47 @@ class TestShippedExampleWorkflow:
         saved = out["save"][0]
         assert len(saved) == 4 and all(os.path.exists(p) for p in saved)
 
+    def test_example_sd15_controlnet_executes(self, cpu_devices, tmp_path,
+                                              monkeypatch):
+        import os
+
+        from PIL import Image
+        from safetensors.numpy import save_file
+
+        import comfyui_parallelanything_tpu.models as models_pkg
+        from comfyui_parallelanything_tpu.models import build_controlnet
+        from tests.test_controlnet import _ldm_controlnet_sd, _randomized_cn
+
+        paths, factor = self._synthetic_env(tmp_path, monkeypatch)
+        # Tiny ControlNet checkpoint for the (monkeypatched) tiny sd15 config.
+        cfg = models_pkg.sd15_config()
+        cn = build_controlnet(cfg, jax.random.key(5), sample_shape=(1, 4, 4, 4))
+        cn_sd = _ldm_controlnet_sd(cfg, _randomized_cn(cn, cfg).params)
+        cn_path = tmp_path / "cn.safetensors"
+        save_file({k: np.ascontiguousarray(v) for k, v in cn_sd.items()},
+                  str(cn_path))
+        hint_path = tmp_path / "hint.png"
+        Image.fromarray(
+            (np.random.default_rng(3).uniform(0, 1, (32, 32, 3)) * 255)
+            .astype(np.uint8)
+        ).save(hint_path)
+
+        wf = self._rewrite_common(
+            json.load(open("examples/workflow_sd15_controlnet.json")), paths
+        )
+        wf["latent"]["inputs"].update(width=32, height=32, batch_size=2)
+        wf["hint"]["inputs"]["image_path"] = str(hint_path)
+        wf["controlnet"]["inputs"]["ckpt_path"] = str(cn_path)
+        wf["save"]["inputs"]["output_dir"] = str(tmp_path / "out")
+
+        out = run_workflow(wf)
+        images = out["decode"][0]
+        hw = 32 // 8 * factor
+        assert images.shape == (2, hw, hw, 3)
+        assert np.isfinite(np.asarray(images)).all()
+        saved = out["save"][0]
+        assert len(saved) == 2 and all(os.path.exists(p) for p in saved)
+
     def test_example_sd15_img2img_executes(self, cpu_devices, tmp_path, monkeypatch):
         import os
 

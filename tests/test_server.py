@@ -201,6 +201,29 @@ class TestServer:
         assert [p["value"] for p in progress] == [1, 2]  # steps=2
         assert all(p["max"] == 2 and p["prompt_id"] == pid for p in progress)
         assert all(p["node"] == "3" for p in progress)  # tagged to the KSampler
+        executed = [e["data"] for e in events if e["type"] == "executed"]
+        assert [d["node"] for d in executed] == ["9"]  # the SaveImage node
+        assert executed[0]["output"]["images"][0]["filename"]
+
+        # Second prompt with one edit: unchanged upstream nodes are announced
+        # as cache-served via execution_cached.
+        sock, read_event = self._ws_connect(base)
+        wf2 = json.loads(json.dumps(wf))
+        wf2["3"]["inputs"]["seed"] = 99
+        pid2 = _post(base, "/prompt", {"prompt": wf2})["prompt_id"]
+        cached = None
+        for _ in range(200):
+            evt = read_event()
+            if evt["type"] == "execution_cached":
+                cached = evt["data"]
+            if (evt["type"] == "executing"
+                    and evt["data"].get("node") is None
+                    and evt["data"].get("prompt_id") == pid2):
+                break
+        sock.close()
+        assert cached is not None and cached["prompt_id"] == pid2
+        # The loader/encoders survive the seed edit; the sampler chain reruns.
+        assert "4" in cached["nodes"] and "3" not in cached["nodes"]
 
     def test_interrupt_stops_running_prompt(self, server, tmp_path,
                                             monkeypatch):
