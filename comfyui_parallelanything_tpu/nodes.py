@@ -1906,6 +1906,60 @@ class TPUControlNetApply:
         return ({**conditioning, "control": tuple(prior) + (spec,)},)
 
 
+class TPUUpscaleModelLoader:
+    """ESRGAN-family upscaler checkpoint → UPSCALE_MODEL wire (nf/nb/gc/scale
+    sniffed; both public key layouts accepted — models/upscale.py)."""
+
+    DESCRIPTION = "Load an ESRGAN-family (RRDBNet) image upscaler."
+    RETURN_TYPES = ("UPSCALE_MODEL",)
+    RETURN_NAMES = ("upscale_model",)
+    FUNCTION = "load"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "ckpt_path": ("STRING", {"default": "",
+                                         "tooltip": "safetensors path"}),
+            }
+        }
+
+    def load(self, ckpt_path: str):
+        from .models import load_upscale_checkpoint
+
+        return (load_upscale_checkpoint(ckpt_path),)
+
+
+class TPUImageUpscaleWithModel:
+    """(UPSCALE_MODEL, IMAGE) → model-upscaled IMAGE; large images process as
+    overlapping tiles blended linearly (bounded activation memory)."""
+
+    DESCRIPTION = "Upscale images with an ESRGAN-family model (tiled)."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "upscale"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "upscale_model": ("UPSCALE_MODEL", {}),
+                "image": ("IMAGE", {}),
+            },
+            "optional": {
+                "tile": ("INT", {"default": 512, "min": 64, "max": 4096,
+                                 "tooltip": "tile size for large images"}),
+            },
+        }
+
+    def upscale(self, upscale_model, image, tile: int = 512):
+        from .models import upscale_image
+
+        return (upscale_image(upscale_model, image, tile=tile),)
+
+
 NODE_CLASS_MAPPINGS = {
     "ParallelAnything": ParallelAnything,
     "ParallelAnythingAdvanced": ParallelAnythingAdvanced,
@@ -1937,6 +1991,8 @@ NODE_CLASS_MAPPINGS = {
     "TPUFlipSigmas": TPUFlipSigmas,
     "TPUControlNetLoader": TPUControlNetLoader,
     "TPUControlNetApply": TPUControlNetApply,
+    "TPUUpscaleModelLoader": TPUUpscaleModelLoader,
+    "TPUImageUpscaleWithModel": TPUImageUpscaleWithModel,
 }
 
 NODE_DISPLAY_NAME_MAPPINGS = {
@@ -1970,6 +2026,8 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPUFlipSigmas": "Flip Sigmas (TPU)",
     "TPUControlNetLoader": "Load ControlNet (TPU)",
     "TPUControlNetApply": "Apply ControlNet (TPU)",
+    "TPUUpscaleModelLoader": "Load Upscale Model (TPU)",
+    "TPUImageUpscaleWithModel": "Upscale Image With Model (TPU)",
 }
 
 # Stock-ComfyUI class-name shims (CheckpointLoaderSimple, CLIPTextEncode,

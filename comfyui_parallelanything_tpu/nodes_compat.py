@@ -570,6 +570,31 @@ class _EmptyLatent16ch:
         )
 
 
+class UpscaleModelLoader:
+    """Stock loader: model_name resolves via $PA_MODELS_DIR/upscale_models."""
+
+    DESCRIPTION = "Stock-name upscale-model loader (folder-layout resolution)."
+    RETURN_TYPES = ("UPSCALE_MODEL",)
+    RETURN_NAMES = ("upscale_model",)
+    FUNCTION = "load_model"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"model_name": ("STRING", {"default": ""})}}
+
+    def load_model(self, model_name: str):
+        from .nodes import TPUUpscaleModelLoader
+
+        path = resolve_model_file(model_name, "upscale_models")
+        if not model_name or not os.path.isfile(path):
+            raise ValueError(
+                f"upscale model not found: {model_name!r} (searched "
+                "$PA_MODELS_DIR/upscale_models and the name as a path)"
+            )
+        return TPUUpscaleModelLoader().load(ckpt_path=path)
+
+
 class ControlNetLoader:
     """Stock loader: control_net_name resolves via $PA_MODELS_DIR/controlnet."""
 
@@ -982,6 +1007,10 @@ def stock_node_mappings() -> dict[str, type]:
         "ControlNetLoader": ControlNetLoader,
         "ControlNetApply": ControlNetApply,
         "ControlNetApplyAdvanced": ControlNetApplyAdvanced,
+        "UpscaleModelLoader": UpscaleModelLoader,
+        "ImageUpscaleWithModel": _renamed(
+            n.TPUImageUpscaleWithModel, {}, name="ImageUpscaleWithModel"
+        ),
         "LatentUpscaleBy": _renamed(
             n.TPULatentUpscale, {"samples": "latent", "scale_by": "scale",
                                  "upscale_method": "method"},

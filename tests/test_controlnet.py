@@ -248,6 +248,38 @@ class TestControlNetConversion:
                                    rtol=1e-5, atol=1e-5)
 
 
+class TestSDXLControlNet:
+    def test_adm_round_trip_and_forward(self):
+        # SDXL-style controlnets carry the label_emb vector-conditioning path
+        # (the sniffing loader keys off it); round-trip + forward equivalence
+        # with y wired through.
+        from comfyui_parallelanything_tpu.models.unet import UNetConfig
+
+        cfg = UNetConfig(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(1,), transformer_depth=(0, 1), num_heads=4,
+            context_dim=64, adm_in_channels=32, norm_groups=8,
+            dtype=jnp.float32,
+        )
+        base = build_unet(cfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+        cn = build_controlnet(cfg, jax.random.key(1), sample_shape=(1, 8, 8, 4))
+        cn = _randomized_cn(cn, cfg)
+        sd = _ldm_controlnet_sd(cfg, cn.params)
+        got = convert_controlnet_checkpoint(sd, cfg)
+        fg, fw = dict(flatten_tree(got)), dict(flatten_tree(cn.params))
+        assert sorted(fg) == sorted(fw)
+        hint = jax.random.uniform(jax.random.key(2), (1, 64, 64, 3))
+        x = jax.random.normal(jax.random.key(3), (1, 8, 8, 4))
+        t = jnp.array([300.0])
+        ctx = jax.random.normal(jax.random.key(4), (1, 5, 64))
+        y = jax.random.normal(jax.random.key(5), (1, 32))
+        composed = apply_control(base, cn, hint, 1.0)
+        out = composed(x, t, ctx, y=y)
+        ref = base(x, t, ctx, y=y)
+        assert out.shape == ref.shape
+        assert not np.allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
 class TestControlParallel:
     def test_composed_model_parallelizes(self, tiny_pair, cpu_devices):
         # The merged pytree (base + control + hint) places through parallelize

@@ -1,0 +1,198 @@
+"""ESRGAN-family upscaler (models/upscale.py): config sniffing, both public
+checkpoint layouts round-tripped by inverse synthesis, tiled-vs-whole
+equivalence, and the stock UpscaleModelLoader/ImageUpscaleWithModel shims in
+a workflow."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from comfyui_parallelanything_tpu.models import (
+    UpscaleConfig,
+    build_upscaler,
+    load_upscale_checkpoint,
+    upscale_image,
+)
+from comfyui_parallelanything_tpu.models.upscale import (
+    _normalize_esrgan_keys,
+    convert_upscale_checkpoint,
+    sniff_upscale_config,
+)
+
+TINY = UpscaleConfig(nf=8, nb=2, gc=4, scale=4, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tiny_upscaler():
+    return build_upscaler(TINY, jax.random.key(0))
+
+
+def _modern_sd(cfg: UpscaleConfig, params) -> dict:
+    """Inverse-synthesize the modern RRDBNet layout from our params."""
+    sd: dict = {}
+
+    def put(key, p):
+        sd[f"{key}.weight"] = np.asarray(p["kernel"]).transpose(3, 2, 0, 1)
+        if "bias" in p:
+            sd[f"{key}.bias"] = np.asarray(p["bias"])
+
+    for k in ("conv_first", "conv_body", "conv_up1", "conv_up2",
+              "conv_hr", "conv_last"):
+        put(k, params[k])
+    for i in range(cfg.nb):
+        for k in range(1, 4):
+            for j in range(1, 6):
+                put(f"body.{i}.rdb{k}.conv{j}",
+                    params[f"body_{i}"][f"rdb{k}"][f"conv{j}"])
+    return sd
+
+
+def _legacy_sd(cfg: UpscaleConfig, params) -> dict:
+    """The old ESRGAN sequential naming for the same weights."""
+    modern = _modern_sd(cfg, params)
+    import re
+
+    out = {}
+    head = {"conv_first": "model.0", "conv_up1": "model.3",
+            "conv_up2": "model.6", "conv_hr": "model.8",
+            "conv_last": "model.10"}
+    for k, v in modern.items():
+        m = re.match(r"body\.(\d+)\.rdb(\d)\.conv(\d)\.(weight|bias)", k)
+        if m:
+            i, r, c, wb = m.groups()
+            out[f"model.1.sub.{i}.RDB{r}.conv{c}.0.{wb}"] = v
+            continue
+        if k.startswith("conv_body."):
+            out[f"model.1.sub.{cfg.nb}.{k.split('.', 1)[1]}"] = v
+            continue
+        stem, wb = k.rsplit(".", 1)
+        out[f"{head[stem]}.{wb}"] = v
+    return out
+
+
+class TestConversion:
+    def test_modern_layout_round_trip(self, tiny_upscaler):
+        sd = _modern_sd(TINY, tiny_upscaler.params)
+        cfg = sniff_upscale_config(sd)
+        assert (cfg.nf, cfg.nb, cfg.gc, cfg.scale) == (8, 2, 4, 4)
+        params, _ = convert_upscale_checkpoint(sd)
+        x = jax.random.uniform(jax.random.key(1), (1, 12, 10, 3))
+        np.testing.assert_allclose(
+            np.asarray(build_upscaler(cfg, params=params)(x)),
+            np.asarray(tiny_upscaler(x)), rtol=1e-6, atol=1e-6,
+        )
+
+    def test_legacy_layout_converts_identically(self, tiny_upscaler):
+        legacy = _legacy_sd(TINY, tiny_upscaler.params)
+        norm = _normalize_esrgan_keys(legacy)
+        assert sorted(norm) == sorted(_modern_sd(TINY, tiny_upscaler.params))
+        params, cfg = convert_upscale_checkpoint(legacy)
+        x = jax.random.uniform(jax.random.key(1), (1, 12, 10, 3))
+        np.testing.assert_allclose(
+            np.asarray(build_upscaler(cfg, params=params)(x)),
+            np.asarray(tiny_upscaler(x)), rtol=1e-6, atol=1e-6,
+        )
+
+    def test_pixel_unshuffle_matches_torch_channel_order(self):
+        # RealESRGAN x2/x1 conv_first weights were trained against
+        # torch.pixel_unshuffle's C-major depth order — pin ours to it.
+        torch = pytest.importorskip("torch")
+
+        from comfyui_parallelanything_tpu.models.upscale import _pixel_unshuffle
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 8, 6, 3)).astype(np.float32)
+        ours = np.asarray(_pixel_unshuffle(jnp.asarray(x), 2))
+        want = (
+            torch.nn.functional.pixel_unshuffle(
+                torch.from_numpy(x).permute(0, 3, 1, 2), 2
+            ).permute(0, 2, 3, 1).numpy()
+        )
+        np.testing.assert_allclose(ours, want, rtol=0, atol=0)
+
+    def test_legacy_non_x4_layout_rejected_clearly(self, tiny_upscaler):
+        legacy = _legacy_sd(TINY, tiny_upscaler.params)
+        # Simulate an x2 legacy head (different sequential indices).
+        legacy["model.4.weight"] = legacy.pop("model.10.weight")
+        legacy["model.4.bias"] = legacy.pop("model.10.bias")
+        with pytest.raises(ValueError, match="x4 sequential layout"):
+            convert_upscale_checkpoint(legacy)
+
+    def test_scale2_pixel_unshuffle_shapes(self):
+        cfg = UpscaleConfig(nf=8, nb=1, gc=4, scale=2, in_channels=3,
+                            dtype=jnp.float32)
+        model = build_upscaler(cfg, jax.random.key(2))
+        out = model(jnp.zeros((1, 16, 12, 3)))
+        assert out.shape == (1, 32, 24, 3)
+        # Sniffing reads the shuffle factor off conv_first's input width (12).
+        sd = {  # minimal keys the sniffer touches
+            "conv_first.weight": np.zeros((8, 12, 3, 3), np.float32),
+            "conv_last.weight": np.zeros((3, 8, 3, 3), np.float32),
+            "body.0.rdb1.conv1.weight": np.zeros((4, 8, 3, 3), np.float32),
+        }
+        got = sniff_upscale_config(sd)
+        assert got.scale == 2 and got.in_channels == 3
+
+
+class TestUpscaleImage:
+    def test_output_scale_and_range(self, tiny_upscaler):
+        x = jax.random.uniform(jax.random.key(3), (2, 12, 10, 3))
+        out = upscale_image(tiny_upscaler, x)
+        assert out.shape == (2, 48, 40, 3)
+        arr = np.asarray(out)
+        assert arr.min() >= 0.0 and arr.max() <= 1.0
+
+    def test_tiled_approximates_whole(self, tiny_upscaler):
+        # Tiling is the host's approximation too: tile borders see the conv
+        # zero-padding instead of real context, so seams differ slightly —
+        # the blend must keep the output CLOSE in aggregate and the weight
+        # normalization must leave no holes or hot spots.
+        x = jax.random.uniform(jax.random.key(4), (1, 40, 36, 3))
+        whole = np.asarray(upscale_image(tiny_upscaler, x, tile=512))
+        tiled = np.asarray(upscale_image(tiny_upscaler, x, tile=32, overlap=8))
+        assert tiled.shape == whole.shape
+        assert np.isfinite(tiled).all()
+        assert np.mean(np.abs(tiled - whole)) < 0.02
+        # Interior far from any seam is exact (receptive field inside tile).
+        np.testing.assert_allclose(tiled[:, 64:80, 60:76], whole[:, 64:80, 60:76],
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestStockShims:
+    def test_stock_upscale_workflow_runs(self, tmp_path, monkeypatch):
+        from safetensors.numpy import save_file
+
+        from comfyui_parallelanything_tpu.host import run_workflow
+
+        up = build_upscaler(TINY, jax.random.key(0))
+        up_dir = tmp_path / "models" / "upscale_models"
+        up_dir.mkdir(parents=True)
+        save_file(
+            {k: np.ascontiguousarray(v)
+             for k, v in _modern_sd(TINY, up.params).items()},
+            str(up_dir / "tiny_x4.safetensors"),
+        )
+        monkeypatch.setenv("PA_MODELS_DIR", str(tmp_path / "models"))
+
+        from PIL import Image
+
+        in_dir = tmp_path / "input"
+        in_dir.mkdir()
+        Image.fromarray(
+            (np.random.default_rng(0).uniform(size=(12, 12, 3)) * 255)
+            .astype(np.uint8)
+        ).save(in_dir / "src.png")
+        monkeypatch.setenv("PA_INPUT_DIR", str(in_dir))
+
+        out = run_workflow({
+            "1": {"class_type": "LoadImage", "inputs": {"image": "src.png"}},
+            "2": {"class_type": "UpscaleModelLoader",
+                  "inputs": {"model_name": "tiny_x4.safetensors"}},
+            "3": {"class_type": "ImageUpscaleWithModel",
+                  "inputs": {"upscale_model": ["2", 0], "image": ["1", 0]}},
+        })
+        img = np.asarray(out["3"][0])
+        assert img.shape[1:3] == (48, 48)
+        assert np.isfinite(img).all()
