@@ -216,12 +216,26 @@ def sniff_model_family(state_dict: Mapping[str, Any]) -> str:
         width = dim("blocks.0.self_attn.q.weight", 0)
         return "wan-14b" if width is not None and width >= 5120 else "wan-1.3b"
     if has("input_blocks."):
+        # 9 input channels (latent 4 + mask 1 + masked-image latent 4) mark
+        # the dedicated inpainting variants of the SD families.
+        in_ch = dim("input_blocks.0.0.weight", 1)
+        inpaint = "-inpaint" if in_ch == 9 else ""
         if has("label_emb."):
-            return "sdxl"
+            return "sdxl" + inpaint
         ctx = dim("input_blocks.1.1.transformer_blocks.0.attn2.to_k.weight", 1)
         # 768 = CLIP-L (SD1.x); 1024 = OpenCLIP-H (SD2.x). eps-vs-v prediction
         # is not recorded in weights, so SD2.x defaults to the eps preset —
         # pass family explicitly (TPUCheckpointLoader) for v-prediction models.
+        if ctx == 768 and inpaint:
+            return "sd15-inpaint"
+        if ctx == 1024 and inpaint:
+            return "sd21-inpaint"
+        if inpaint:
+            raise ValueError(
+                "9-channel (inpainting) checkpoint with an unrecognized "
+                f"context width {ctx} — supported inpaint families: "
+                "sd15-inpaint, sd21-inpaint, sdxl-inpaint"
+            )
         if ctx == 1024:
             # The most common SD2.1 checkpoint (768-v) is v-prediction; with
             # the eps preset it silently produces garbage images. Make the

@@ -261,6 +261,48 @@ class UNet2D(nn.Module):
         return h
 
 
+def apply_inpaint_conditioning(base: "DiffusionModel", mask, masked_latent):
+    """Compose the 9-channel inpaint-model input convention into a
+    DiffusionModel: every denoise step's input becomes
+    ``concat([x, mask, masked_image_latent], channel)`` — the sd-inpainting
+    checkpoint contract (4 + 1 + 4 channels). Like ``apply_control``, the
+    conditioning channels ride the merged params pytree so the composition
+    places/shards through ``parallelize`` and the whole step stays one jit
+    program. ``mask`` is 1 where content is REGENERATED (latent resolution,
+    (1|B, H, W, 1)); ``masked_latent`` is the VAE encode of the
+    mask-blanked pixels."""
+    merged = {
+        "base": base.params,
+        "mask": jnp.asarray(mask, jnp.float32),
+        "masked": jnp.asarray(masked_latent, jnp.float32),
+    }
+    base_apply = base.apply
+
+    def _bcast(a, batch):
+        if a.ndim == 3:
+            a = a[None]
+        if a.shape[0] != batch:
+            if a.shape[0] != 1:
+                raise ValueError(
+                    f"inpaint conditioning batch {a.shape[0]} != latent "
+                    f"batch {batch}: pass ONE mask/masked-image (it "
+                    "broadcasts); per-sample conditioning is not supported"
+                )
+            a = jnp.repeat(a, batch, axis=0)
+        return a
+
+    def apply(p, x, timesteps, context=None, **kw):
+        m = _bcast(p["mask"], x.shape[0])
+        ml = _bcast(p["masked"], x.shape[0])
+        x_in = jnp.concatenate([x, m.astype(x.dtype), ml.astype(x.dtype)], -1)
+        return base_apply(p["base"], x_in, timesteps, context, **kw)
+
+    return DiffusionModel(
+        apply=apply, params=merged, name=f"{base.name}+inpaint",
+        config=base.config,
+    )
+
+
 def build_unet(
     cfg: UNetConfig,
     rng=None,

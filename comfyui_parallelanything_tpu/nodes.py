@@ -313,7 +313,9 @@ class ParallelAnythingAdvanced(ParallelAnything):
 # ---------------------------------------------------------------------------
 
 _MODEL_FAMILIES = (
-    "sd15", "sd21", "sd21-v", "sdxl", "sd3-medium", "sd35-medium", "sd35-large",
+    "sd15", "sd15-inpaint", "sd21", "sd21-v", "sd21-inpaint", "sdxl",
+    "sdxl-inpaint",
+    "sd3-medium", "sd35-medium", "sd35-large",
     "flux-dev", "flux-schnell", "zimage-turbo", "wan-1.3b", "wan-14b",
 )
 
@@ -433,8 +435,11 @@ class TPUCheckpointLoader:
                 )
             return model, load_wan_vae_checkpoint(vae_path)
         with load_ctx:
-            if family == "sd15":
-                model = load_sd_unet_checkpoint(sd, sd15_config(), lora, lora_strength)
+            if family in ("sd15", "sd15-inpaint"):
+                ucfg = sd15_config(
+                    in_channels=9 if family == "sd15-inpaint" else 4
+                )
+                model = load_sd_unet_checkpoint(sd, ucfg, lora, lora_strength)
                 vae_cfg = sd_vae_config()
             elif family in ("sd3-medium", "sd35-medium", "sd35-large"):
                 from .models import (
@@ -452,14 +457,18 @@ class TPUCheckpointLoader:
                 }[family]()
                 model = load_mmdit_checkpoint(sd, mcfg, lora, lora_strength)
                 vae_cfg = sd3_vae_config()
-            elif family in ("sd21", "sd21-v"):
+            elif family in ("sd21", "sd21-v", "sd21-inpaint"):
                 ucfg = sd21_config(
-                    prediction="v" if family == "sd21-v" else "eps"
+                    prediction="v" if family == "sd21-v" else "eps",
+                    in_channels=9 if family == "sd21-inpaint" else 4,
                 )
                 model = load_sd_unet_checkpoint(sd, ucfg, lora, lora_strength)
                 vae_cfg = sd_vae_config()
-            elif family == "sdxl":
-                model = load_sd_unet_checkpoint(sd, sdxl_config(), lora, lora_strength)
+            elif family in ("sdxl", "sdxl-inpaint"):
+                xcfg = sdxl_config(
+                    in_channels=9 if family == "sdxl-inpaint" else 4
+                )
+                model = load_sd_unet_checkpoint(sd, xcfg, lora, lora_strength)
                 vae_cfg = sdxl_vae_config()
             else:
                 cfg = {
@@ -1007,7 +1016,7 @@ def _collect_control(positive) -> tuple:
     return specs
 
 
-def _model_with_control(model, specs):
+def _model_with_control(model, specs, inpaint=None):
     """Compose ControlNet residual injection into the MODEL (the ``control``
     tags Apply nodes leave on the positive conditioning — chained Apply nodes
     stack and their residuals sum, the host's multi-controlnet accumulation).
@@ -1028,22 +1037,30 @@ def _model_with_control(model, specs):
     placement (the cached workflow output) and the composed placement coexist
     while control is in use — a placement OOM degrades through the normal
     drop-device path."""
-    if not specs:
+    if not specs and not inpaint:
         return model
     from .models.api import DiffusionModel
     from .models.controlnet import apply_control
+    from .models.unet import apply_inpaint_conditioning
     from .parallel.orchestrator import ParallelModel, parallelize
 
     key = tuple(
         (id(s["model"]), id(s["hint"]), float(s.get("strength", 1.0)),
          float(s.get("start_percent", 0.0)), float(s.get("end_percent", 1.0)))
         for s in specs
-    )
+    ) + ((id(inpaint["mask"]), id(inpaint["masked_latent"]))
+         if inpaint else ())
     cached = getattr(model, "_control_composed", None)
     if cached is not None and cached[0] == key:
         return cached[1]
 
     def compose(base):
+        if inpaint:
+            # Innermost: the 9-channel input convention wraps the raw model;
+            # control residuals then apply to the wrapped step.
+            base = apply_inpaint_conditioning(
+                base, inpaint["mask"], inpaint["masked_latent"]
+            )
         for spec in specs:
             base = apply_control(
                 base, spec["model"], spec["hint"],
@@ -1077,10 +1094,12 @@ def _model_with_control(model, specs):
         composed = compose(model)
     if cached is not None and hasattr(cached[1], "cleanup"):
         cached[1].cleanup()  # a replaced composition frees its placement
-    # specs kept in the entry: the id()-based key stays valid only while the
-    # tagged objects are alive.
+    # specs/inpaint kept in the entry: the id()-based key stays valid only
+    # while the tagged objects are alive.
     try:
-        object.__setattr__(model, "_control_composed", (key, composed, specs))
+        object.__setattr__(
+            model, "_control_composed", (key, composed, specs, inpaint)
+        )
     except (AttributeError, TypeError):
         pass  # uncacheable model object: composition still works, uncached
     return composed
@@ -1268,7 +1287,9 @@ class TPUKSampler:
         model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra = (
             _prepare_sampling_inputs(model, positive, negative, latent)
         )
-        model = _model_with_control(model, _collect_control(positive))
+        model = _model_with_control(
+            model, _collect_control(positive), inpaint=positive.get("inpaint")
+        )
         kwargs = {} if pooled is None else {"y": pooled}
         out = run_sampler(
             model, noise, context, sampler=sampler_name, steps=steps,
@@ -1789,7 +1810,9 @@ class TPUSamplerCustomAdvanced:
         model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra = (
             _prepare_sampling_inputs(model, positive, negative, latent_image)
         )
-        model = _model_with_control(model, _collect_control(positive))
+        model = _model_with_control(
+            model, _collect_control(positive), inpaint=positive.get("inpaint")
+        )
         prediction = getattr(model_cfg, "prediction", "eps")
         out = run_sampler(
             model, noise_arr, context,
@@ -1906,6 +1929,75 @@ class TPUControlNetApply:
         return ({**conditioning, "control": tuple(prior) + (spec,)},)
 
 
+class TPUInpaintModelConditioning:
+    """(positive, negative, VAE, pixels, mask) → the wire trio that drives a
+    DEDICATED inpainting checkpoint (family sd15-inpaint/sdxl-inpaint, 9 input
+    channels): conditioning tagged with the latent-space mask + masked-image
+    latent (the sampler composes them into the model input via
+    ``apply_inpaint_conditioning``), plus the encoded source latent. ``mask``
+    is 1 where content regenerates, pixel resolution; masked pixels neutralize
+    to 0.5 gray before encoding (the checkpoint's training convention).
+    ``noise_mask=True`` additionally pins the keep region each step (the
+    latent-noise-mask mechanism — matching host behavior)."""
+
+    DESCRIPTION = "Conditioning + latents for dedicated inpainting checkpoints."
+    RETURN_TYPES = ("CONDITIONING", "CONDITIONING", "LATENT")
+    RETURN_NAMES = ("positive", "negative", "latent")
+    FUNCTION = "encode"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "positive": ("CONDITIONING", {}),
+                "negative": ("CONDITIONING", {}),
+                "vae": ("VAE", {}),
+                "pixels": ("IMAGE", {}),
+                "mask": ("MASK", {}),
+            },
+            "optional": {
+                "noise_mask": ("BOOLEAN", {"default": True}),
+            },
+        }
+
+    def encode(self, positive, negative, vae, pixels, mask,
+               noise_mask: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        from .models.vae import images_to_vae_input
+
+        px = images_to_vae_input(pixels)
+        m = jnp.asarray(mask, jnp.float32)
+        if m.ndim == 2:
+            m = m[None]
+        if m.ndim == 3:
+            m = m[..., None]  # (B, H, W, 1)
+        if m.shape[1:3] != px.shape[1:3]:
+            m = jax.image.resize(
+                m, (m.shape[0], *px.shape[1:3], 1), method="nearest"
+            )
+        # Neutralize the regenerate region to 0.5 gray pre-encode (the
+        # inpainting checkpoints' training convention). px is already in the
+        # VAE's [-1, 1] input space, where 0.5-gray is 0.0.
+        masked_px = px * (1.0 - m)
+        masked_latent = vae.encode(masked_px, None)
+        latent = vae.encode(px, None)
+        lat_mask = jax.image.resize(
+            m, (m.shape[0], *latent.shape[1:3], 1), method="nearest"
+        )
+        tag = {"mask": lat_mask, "masked_latent": masked_latent}
+        out_latent = {"samples": latent}
+        if noise_mask:
+            out_latent["noise_mask"] = lat_mask
+        return (
+            {**positive, "inpaint": tag},
+            {**negative, "inpaint": tag},
+            out_latent,
+        )
+
+
 class TPUUpscaleModelLoader:
     """ESRGAN-family upscaler checkpoint → UPSCALE_MODEL wire (nf/nb/gc/scale
     sniffed; both public key layouts accepted — models/upscale.py)."""
@@ -1993,6 +2085,7 @@ NODE_CLASS_MAPPINGS = {
     "TPUControlNetApply": TPUControlNetApply,
     "TPUUpscaleModelLoader": TPUUpscaleModelLoader,
     "TPUImageUpscaleWithModel": TPUImageUpscaleWithModel,
+    "TPUInpaintModelConditioning": TPUInpaintModelConditioning,
 }
 
 NODE_DISPLAY_NAME_MAPPINGS = {
@@ -2028,6 +2121,7 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPUControlNetApply": "Apply ControlNet (TPU)",
     "TPUUpscaleModelLoader": "Load Upscale Model (TPU)",
     "TPUImageUpscaleWithModel": "Upscale Image With Model (TPU)",
+    "TPUInpaintModelConditioning": "Inpaint Model Conditioning (TPU)",
 }
 
 # Stock-ComfyUI class-name shims (CheckpointLoaderSimple, CLIPTextEncode,

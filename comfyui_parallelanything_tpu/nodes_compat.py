@@ -1011,6 +1011,10 @@ def stock_node_mappings() -> dict[str, type]:
         "ImageUpscaleWithModel": _renamed(
             n.TPUImageUpscaleWithModel, {}, name="ImageUpscaleWithModel"
         ),
+        # Stock-shaped from the start (same widget names).
+        "InpaintModelConditioning": _renamed(
+            n.TPUInpaintModelConditioning, {}, name="InpaintModelConditioning"
+        ),
         "LatentUpscaleBy": _renamed(
             n.TPULatentUpscale, {"samples": "latent", "scale_by": "scale",
                                  "upscale_method": "method"},

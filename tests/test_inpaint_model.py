@@ -1,0 +1,136 @@
+"""Dedicated inpainting checkpoints (9-channel UNets): the input-concat
+composition, family sniffing, and the InpaintModelConditioning node driving a
+sampler run end to end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.models import (
+    apply_inpaint_conditioning,
+    build_unet,
+    build_vae,
+    sd15_config,
+)
+
+
+def _tiny9():
+    cfg = sd15_config(
+        in_channels=9, model_channels=32, channel_mult=(1, 2),
+        transformer_depth=(1, 1), attention_levels=(0, 1), context_dim=64,
+        num_heads=4, norm_groups=8, dtype=jnp.float32,
+    )
+    return cfg, build_unet(cfg, jax.random.key(0), sample_shape=(1, 8, 8, 9))
+
+
+class TestInpaintComposition:
+    def test_wrap_concats_channels_exactly(self):
+        cfg, model = _tiny9()
+        mask = jnp.zeros((1, 8, 8, 1)).at[:, 2:6, 2:6, :].set(1.0)
+        masked = jax.random.normal(jax.random.key(1), (1, 8, 8, 4))
+        wrapped = apply_inpaint_conditioning(model, mask, masked)
+        x = jax.random.normal(jax.random.key(2), (2, 8, 8, 4))
+        t = jnp.array([500.0, 100.0])
+        ctx = jax.random.normal(jax.random.key(3), (2, 5, 64))
+        got = wrapped(x, t, ctx)
+        manual = jnp.concatenate([
+            x,
+            jnp.repeat(mask, 2, axis=0),
+            jnp.repeat(masked, 2, axis=0),
+        ], axis=-1)
+        want = model(manual, t, ctx)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+        assert got.shape == (2, 8, 8, 4)  # out_channels unaffected
+
+    def test_per_sample_conditioning_rejected(self):
+        cfg, model = _tiny9()
+        wrapped = apply_inpaint_conditioning(
+            model, jnp.zeros((3, 8, 8, 1)), jnp.zeros((3, 8, 8, 4))
+        )
+        with pytest.raises(ValueError, match="ONE mask"):
+            wrapped.apply(wrapped.params, jnp.zeros((2, 8, 8, 4)),
+                          jnp.zeros((2,)), jnp.zeros((2, 5, 64)))
+
+
+class TestSniffing:
+    def test_nine_channel_checkpoints_sniff_inpaint(self):
+        from comfyui_parallelanything_tpu.models.loader import (
+            sniff_model_family,
+        )
+
+        def fake(in_ch, ctx, label=False):
+            sd = {
+                "input_blocks.0.0.weight": np.zeros((32, in_ch, 3, 3)),
+                "input_blocks.1.1.transformer_blocks.0.attn2.to_k.weight":
+                    np.zeros((32, ctx)),
+            }
+            if label:
+                sd["label_emb.0.0.weight"] = np.zeros((32, 16))
+            return sd
+
+        assert sniff_model_family(fake(4, 768)) == "sd15"
+        assert sniff_model_family(fake(9, 768)) == "sd15-inpaint"
+        assert sniff_model_family(fake(9, 1024)) == "sd21-inpaint"
+        assert sniff_model_family(fake(9, 2048, label=True)) == "sdxl-inpaint"
+        assert sniff_model_family(fake(4, 2048, label=True)) == "sdxl"
+        # A 9-channel dict of an unknown family must fail loudly, not load a
+        # 4-channel config into an opaque conversion shape error.
+        with pytest.raises(ValueError, match="inpaint"):
+            sniff_model_family(fake(9, 4096))
+
+
+class TestInpaintSampling:
+    def test_conditioning_node_drives_a_sampler_run(self):
+        from comfyui_parallelanything_tpu.nodes import (
+            TPUInpaintModelConditioning,
+            TPUKSampler,
+        )
+        from tests.test_vae import TINY as TINY_VAE
+
+        cfg, model = _tiny9()
+        vae = build_vae(TINY_VAE, jax.random.key(1), sample_hw=16)
+        f = vae.spatial_factor
+        hw = 8 * f  # pixel size whose latent grid is 8x8
+        pixels = jax.random.uniform(jax.random.key(2), (1, hw, hw, 3))
+        mask = jnp.zeros((hw, hw)).at[: hw // 2, :].set(1.0)
+
+        pos, neg, latent = TPUInpaintModelConditioning().encode(
+            {"context": jnp.zeros((1, 5, 64))},
+            {"context": jnp.zeros((1, 5, 64))},
+            vae, pixels, mask,
+        )
+        assert pos["inpaint"]["mask"].shape == (1, 8, 8, 1)
+        assert pos["inpaint"]["masked_latent"].shape == latent["samples"].shape
+        assert "noise_mask" in latent
+        # The mask landed at latent resolution with the right polarity.
+        assert float(pos["inpaint"]["mask"][0, 0, 0, 0]) == 1.0
+        assert float(pos["inpaint"]["mask"][0, -1, 0, 0]) == 0.0
+        # Masked pixels neutralize to 0.5-gray = 0.0 in the VAE's [-1, 1]
+        # input space (the checkpoints' training convention).
+        from comfyui_parallelanything_tpu.models.vae import (
+            images_to_vae_input,
+        )
+
+        px = images_to_vae_input(pixels)
+        m4 = jnp.asarray(mask)[None, ..., None]
+        want_ml = vae.encode(px * (1.0 - m4), None)
+        np.testing.assert_allclose(
+            np.asarray(pos["inpaint"]["masked_latent"]),
+            np.asarray(want_ml), rtol=1e-5, atol=1e-5,
+        )
+
+        (out,) = TPUKSampler().sample(
+            model=model, positive=pos, negative=None, latent=latent,
+            seed=3, steps=2, cfg=1.0, sampler_name="euler",
+        )
+        assert out["samples"].shape == latent["samples"].shape
+        assert np.isfinite(np.asarray(out["samples"])).all()
+
+    def test_stock_shim_registered(self):
+        from comfyui_parallelanything_tpu.nodes_compat import (
+            stock_node_mappings,
+        )
+
+        assert "InpaintModelConditioning" in stock_node_mappings()
