@@ -436,8 +436,10 @@ class TPUCheckpointLoader:
             return model, load_wan_vae_checkpoint(vae_path)
         with load_ctx:
             if family in ("sd15", "sd15-inpaint"):
+                # Kwargs only for the inpaint variant: tests monkeypatch the
+                # preset factories with zero-arg tiny versions.
                 ucfg = sd15_config(
-                    in_channels=9 if family == "sd15-inpaint" else 4
+                    **({"in_channels": 9} if family == "sd15-inpaint" else {})
                 )
                 model = load_sd_unet_checkpoint(sd, ucfg, lora, lora_strength)
                 vae_cfg = sd_vae_config()
@@ -460,13 +462,13 @@ class TPUCheckpointLoader:
             elif family in ("sd21", "sd21-v", "sd21-inpaint"):
                 ucfg = sd21_config(
                     prediction="v" if family == "sd21-v" else "eps",
-                    in_channels=9 if family == "sd21-inpaint" else 4,
+                    **({"in_channels": 9} if family == "sd21-inpaint" else {}),
                 )
                 model = load_sd_unet_checkpoint(sd, ucfg, lora, lora_strength)
                 vae_cfg = sd_vae_config()
             elif family in ("sdxl", "sdxl-inpaint"):
                 xcfg = sdxl_config(
-                    in_channels=9 if family == "sdxl-inpaint" else 4
+                    **({"in_channels": 9} if family == "sdxl-inpaint" else {})
                 )
                 model = load_sd_unet_checkpoint(sd, xcfg, lora, lora_strength)
                 vae_cfg = sdxl_vae_config()

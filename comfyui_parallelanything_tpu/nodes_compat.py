@@ -694,6 +694,97 @@ class ControlNetApplyAdvanced:
         return tagged, negative
 
 
+class ConditioningZeroOut:
+    """Stock zero-out: the FLUX-workflow "negative" — a conditioning whose
+    embeddings are all zeros (guidance-distilled models take it instead of a
+    real negative prompt)."""
+
+    DESCRIPTION = "Stock-name conditioning zero-out (FLUX negative)."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "zero_out"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"conditioning": ("CONDITIONING", {})}}
+
+    def zero_out(self, conditioning):
+        import jax.numpy as jnp
+
+        out = dict(conditioning)
+        for k in ("context", "penultimate", "pooled"):
+            if out.get(k) is not None:
+                out[k] = jnp.zeros_like(out[k])
+        if out.get("extras"):
+            out["extras"] = tuple(
+                {**e, **{k: jnp.zeros_like(e[k])
+                         for k in ("context", "pooled")
+                         if e.get(k) is not None}}
+                for e in out["extras"]
+            )
+        return (out,)
+
+
+class CLIPTextEncodeSDXL:
+    """Stock SDXL encode: both prompts (text_g/text_l) through the dual
+    bundled towers with the full size/crop/target conditioning vector —
+    TPUTextEncode's sdxl-dual path generalized to the stock widget surface."""
+
+    DESCRIPTION = "Stock-name SDXL dual-prompt text encode."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "encode"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip": ("CLIP", {}),
+                "width": ("INT", {"default": 1024, "min": 0, "max": 16384}),
+                "height": ("INT", {"default": 1024, "min": 0, "max": 16384}),
+                "crop_w": ("INT", {"default": 0, "min": 0, "max": 16384}),
+                "crop_h": ("INT", {"default": 0, "min": 0, "max": 16384}),
+                "target_width": ("INT", {"default": 1024, "min": 0,
+                                         "max": 16384}),
+                "target_height": ("INT", {"default": 1024, "min": 0,
+                                          "max": 16384}),
+                "text_g": ("STRING", {"default": "", "multiline": True}),
+                "text_l": ("STRING", {"default": "", "multiline": True}),
+            }
+        }
+
+    def encode(self, clip, width: int, height: int, crop_w: int, crop_h: int,
+               target_width: int, target_height: int,
+               text_g: str, text_l: str):
+        from .models.text_encoders import sdxl_text_conditioning
+        from .nodes import TPUTextEncode
+
+        if clip.get("type") != "sdxl-dual":
+            raise ValueError(
+                "CLIPTextEncodeSDXL needs the dual L+G CLIP wire "
+                "(CheckpointLoaderSimple on an SDXL checkpoint, or "
+                "DualCLIPLoader type=sdxl)"
+            )
+        enc = TPUTextEncode()
+        # Honor a CLIPSetLastLayer tag on the dual wire exactly like
+        # TPUTextEncode's own sdxl-dual branch: default (0) = penultimate
+        # (SDXL's training convention); an explicit skip selects each tower's
+        # skip-resolved stream.
+        clip_skip = int(clip.get("clip_skip", 0))
+        (cl,) = enc.encode(clip["l"], text_l, clip_skip)
+        (cg,) = enc.encode(clip["g"], text_g, clip_skip)
+        str_l = cl["penultimate"] if clip_skip == 0 else cl["context"]
+        str_g = cg["penultimate"] if clip_skip == 0 else cg["context"]
+        context, y = sdxl_text_conditioning(
+            str_l, str_g, cg["pooled"],
+            width=width, height=height, crop_x=crop_w, crop_y=crop_h,
+            target_width=target_width, target_height=target_height,
+        )
+        return ({"context": context, "penultimate": None, "pooled": y},)
+
+
 class ConditioningCombine:
     """Stock combine: BOTH conditionings apply during sampling. The second
     cond (and any extras it accumulated) rides the first's ``extras`` tuple;
@@ -1004,6 +1095,8 @@ def stock_node_mappings() -> dict[str, type]:
         "ConditioningCombine": ConditioningCombine,
         "ConditioningSetArea": ConditioningSetArea,
         "ConditioningAverage": ConditioningAverage,
+        "ConditioningZeroOut": ConditioningZeroOut,
+        "CLIPTextEncodeSDXL": CLIPTextEncodeSDXL,
         "ControlNetLoader": ControlNetLoader,
         "ControlNetApply": ControlNetApply,
         "ControlNetApplyAdvanced": ControlNetApplyAdvanced,

@@ -359,6 +359,14 @@ class PromptQueue:
                 self.history[pid] = entry
                 self.pending_ids.remove(pid)
                 self.running = None
+                # Consume any leftover Cancel UNDER the same lock interrupt()
+                # sets it under, with running already retired: an interrupt
+                # that landed after the prompt's last cooperative checkpoint
+                # can neither survive this clear nor be re-set afterwards
+                # (interrupt() only sets the flag while running is non-None),
+                # so a stale flag can never leak into the next bare
+                # run_workflow anywhere in the process.
+                clear_interrupt()
             # The canonical completion signal ComfyUI API clients block on.
             self._emit({
                 "type": "executing", "data": {"node": None, "prompt_id": pid},

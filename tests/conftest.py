@@ -35,3 +35,15 @@ def cpu_devices():
     devs = jax.devices("cpu")
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(autouse=True)
+def _no_stale_interrupt():
+    """The cooperative sampler interrupt (utils/progress.py) is process-wide
+    state: a Cancel that races past its prompt's last checkpoint would poison
+    whichever test runs the next workflow (observed as order-dependent
+    Interrupted failures in the full suite). Every test ends flag-clean."""
+    yield
+    from comfyui_parallelanything_tpu.utils.progress import clear_interrupt
+
+    clear_interrupt()

@@ -261,6 +261,42 @@ class TestStockWorkflow:
         assert previews and all(os.path.exists(p) for p in previews)
         assert all(os.sep + "temp" + os.sep in p for p in previews)
 
+    def test_conditioning_zero_out_and_sdxl_encode(self, tmp_path,
+                                                   monkeypatch):
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.nodes import NODE_CLASS_MAPPINGS
+
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        _, clip, _ = (
+            NODE_CLASS_MAPPINGS["CheckpointLoaderSimple"]().load(paths["ckpt"])
+        )
+        enc = NODE_CLASS_MAPPINGS["CLIPTextEncode"]()
+        (cond,) = enc.run(clip=clip, text="a watercolor lighthouse")
+
+        # ZeroOut: every embedding zeroed, extras included.
+        zo = NODE_CLASS_MAPPINGS["ConditioningZeroOut"]()
+        (z,) = zo.zero_out({**cond, "extras": (dict(cond),)})
+        assert float(jnp.abs(z["context"]).max()) == 0.0
+        assert float(jnp.abs(z["extras"][0]["context"]).max()) == 0.0
+        assert z["context"].shape == cond["context"].shape
+
+        # CLIPTextEncodeSDXL over a dual wire (same tiny tower as both L and
+        # G — the shim's plumbing and the 2816-style size vector are what's
+        # under test, not tower asymmetry).
+        dual = {"type": "sdxl-dual", "l": clip, "g": clip}
+        xl = NODE_CLASS_MAPPINGS["CLIPTextEncodeSDXL"]()
+        (c,) = xl.encode(
+            dual, width=512, height=768, crop_w=0, crop_h=0,
+            target_width=1024, target_height=1024,
+            text_g="a watercolor lighthouse", text_l="at dawn",
+        )
+        hidden = cond["penultimate"].shape[-1]
+        assert c["context"].shape[-1] == 2 * hidden
+        assert c["pooled"].shape[-1] == cond["pooled"].shape[-1] + 6 * 256
+        with pytest.raises(ValueError, match="dual"):
+            xl.encode(clip, 512, 512, 0, 0, 512, 512, "a", "b")
+
     def test_models_dir_resolution(self, tmp_path, monkeypatch):
         # ComfyUI folder layout: a bare name resolves via
         # $PA_MODELS_DIR/checkpoints/<name>.
