@@ -250,6 +250,23 @@ def images_to_vae_input(images: jnp.ndarray) -> jnp.ndarray:
     return images * 2.0 - 1.0
 
 
+def normalize_mask(mask, hw: tuple, method: str = "nearest") -> jnp.ndarray:
+    """A MASK wire value in any of its shapes ((H, W) / (B, H, W) /
+    (B, H, W, 1)) → float (B, H, W, 1) at the ``hw`` spatial size — the one
+    mask-conditioning convention shared by the inpaint nodes (each resizes the
+    SAME normalized mask to pixel and latent resolutions)."""
+    import jax
+
+    m = jnp.asarray(mask, jnp.float32)
+    if m.ndim == 2:
+        m = m[None]
+    if m.ndim == 3:
+        m = m[..., None]
+    if m.shape[1:3] != tuple(hw):
+        m = jax.image.resize(m, (m.shape[0], *hw, 1), method=method)
+    return m
+
+
 def encode_maybe_tiled(vae, x, tile: int = 0) -> jnp.ndarray:
     """Encode ``x`` through ``vae``, tiled when ``tile > 0`` — the encode-side
     owner of the tile/overlap dispatch policy: overlap = tile/4 floored to the

@@ -1968,18 +1968,10 @@ class TPUInpaintModelConditioning:
         import jax
         import jax.numpy as jnp
 
-        from .models.vae import images_to_vae_input
+        from .models.vae import images_to_vae_input, normalize_mask
 
         px = images_to_vae_input(pixels)
-        m = jnp.asarray(mask, jnp.float32)
-        if m.ndim == 2:
-            m = m[None]
-        if m.ndim == 3:
-            m = m[..., None]  # (B, H, W, 1)
-        if m.shape[1:3] != px.shape[1:3]:
-            m = jax.image.resize(
-                m, (m.shape[0], *px.shape[1:3], 1), method="nearest"
-            )
+        m = normalize_mask(mask, px.shape[1:3])
         # Neutralize the regenerate region to 0.5 gray pre-encode (the
         # inpainting checkpoints' training convention). px is already in the
         # VAE's [-1, 1] input space, where 0.5-gray is 0.0.

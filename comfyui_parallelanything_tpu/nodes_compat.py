@@ -694,6 +694,127 @@ class ControlNetApplyAdvanced:
         return tagged, negative
 
 
+class VAEEncodeForInpaint:
+    """Stock soft-inpaint encode for REGULAR (4-channel) checkpoints: blanks
+    the masked pixels before encoding (so the masked content cannot leak into
+    the latent), grows the mask by ``grow_mask_by`` pixels (stock default 6 —
+    seam room for the VAE's receptive field), and returns the latent with a
+    ``noise_mask`` for the sampler's latent-noise-mask mechanism. Dedicated
+    9-channel checkpoints use InpaintModelConditioning instead."""
+
+    DESCRIPTION = "Stock-name inpaint encode (masked latent + noise_mask)."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "encode"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "vae": ("VAE", {}),
+                "pixels": ("IMAGE", {}),
+                "mask": ("MASK", {}),
+                "grow_mask_by": ("INT", {"default": 6, "min": 0, "max": 64}),
+            }
+        }
+
+    def encode(self, vae, pixels, mask, grow_mask_by: int = 6):
+        import jax
+        import jax.numpy as jnp
+
+        from .models.vae import images_to_vae_input, normalize_mask
+
+        px = images_to_vae_input(pixels)
+        m = jnp.round(
+            jnp.clip(normalize_mask(mask, px.shape[1:3]), 0.0, 1.0)
+        )
+        # Blank with the ORIGINAL rounded mask (0.0 == 0.5-gray in the VAE's
+        # [-1, 1] input space — stock keeps the real-pixel context around the
+        # seam); the GROWN mask serves only as the noise_mask.
+        latent = vae.encode(px * (1.0 - m), None)
+        grown = m
+        if grow_mask_by > 1:
+            # Stock's grow: a k×k max window (~(k-1)/2 px per side).
+            k = int(grow_mask_by)
+            grown = jax.lax.reduce_window(
+                m, -jnp.inf, jax.lax.max,
+                (1, k, k, 1), (1, 1, 1, 1), "SAME",
+            )
+        lat_mask = jax.image.resize(
+            grown, (grown.shape[0], *latent.shape[1:3], 1), method="nearest"
+        )
+        return ({"samples": latent, "noise_mask": lat_mask},)
+
+
+class ImagePadForOutpaint:
+    """Stock outpaint prep: pad the image by left/top/right/bottom pixels
+    (edge-replicated — gives the sampler a color hint) and return the matching
+    regenerate mask, feathered ``feathering`` pixels into the original so the
+    seam blends."""
+
+    DESCRIPTION = "Stock-name outpaint padding (padded image + feathered mask)."
+    RETURN_TYPES = ("IMAGE", "MASK")
+    RETURN_NAMES = ("image", "mask")
+    FUNCTION = "expand_image"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE", {}),
+                "left": ("INT", {"default": 0, "min": 0, "max": 16384,
+                                 "step": 8}),
+                "top": ("INT", {"default": 0, "min": 0, "max": 16384,
+                                "step": 8}),
+                "right": ("INT", {"default": 0, "min": 0, "max": 16384,
+                                  "step": 8}),
+                "bottom": ("INT", {"default": 0, "min": 0, "max": 16384,
+                                   "step": 8}),
+                "feathering": ("INT", {"default": 40, "min": 0, "max": 16384,
+                                       "step": 1}),
+            }
+        }
+
+    def expand_image(self, image, left: int, top: int, right: int,
+                     bottom: int, feathering: int = 40):
+        import jax.numpy as jnp
+
+        img = jnp.asarray(image)
+        if img.ndim == 3:
+            img = img[None]
+        B, H, W, C = img.shape
+        padded = jnp.pad(
+            img, ((0, 0), (top, bottom), (left, right), (0, 0)), mode="edge"
+        )
+        # Mask: 1 in the new border, feathered down to 0 inside the original.
+        rows = jnp.arange(H, dtype=jnp.float32)
+        cols = jnp.arange(W, dtype=jnp.float32)
+        # Distance to the nearest PADDED edge of the original region; sides
+        # without padding don't feather (jnp.inf distance).
+        d = jnp.full((H, W), jnp.inf, jnp.float32)
+        if top:
+            d = jnp.minimum(d, rows[:, None])
+        if bottom:
+            d = jnp.minimum(d, (H - 1 - rows)[:, None])
+        if left:
+            d = jnp.minimum(d, cols[None, :])
+        if right:
+            d = jnp.minimum(d, (W - 1 - cols)[None, :])
+        # Stock semantics: QUADRATIC ramp, and no feathering at all when the
+        # requested feather would cover most of the image.
+        if feathering > 0 and feathering * 2 < H and feathering * 2 < W:
+            v = jnp.clip(1.0 - d / float(feathering), 0.0, 1.0)
+            inner = v * v
+        else:
+            inner = jnp.zeros((H, W), jnp.float32)
+        mask = jnp.pad(
+            inner, ((top, bottom), (left, right)), constant_values=1.0
+        )
+        return padded, jnp.broadcast_to(mask[None], (B, *mask.shape))
+
+
 class ConditioningZeroOut:
     """Stock zero-out: the FLUX-workflow "negative" — a conditioning whose
     embeddings are all zeros (guidance-distilled models take it instead of a
@@ -1097,6 +1218,8 @@ def stock_node_mappings() -> dict[str, type]:
         "ConditioningAverage": ConditioningAverage,
         "ConditioningZeroOut": ConditioningZeroOut,
         "CLIPTextEncodeSDXL": CLIPTextEncodeSDXL,
+        "VAEEncodeForInpaint": VAEEncodeForInpaint,
+        "ImagePadForOutpaint": ImagePadForOutpaint,
         "ControlNetLoader": ControlNetLoader,
         "ControlNetApply": ControlNetApply,
         "ControlNetApplyAdvanced": ControlNetApplyAdvanced,

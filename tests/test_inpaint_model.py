@@ -134,3 +134,53 @@ class TestInpaintSampling:
         )
 
         assert "InpaintModelConditioning" in stock_node_mappings()
+
+
+class TestSoftInpaintNodes:
+    def test_vae_encode_for_inpaint(self):
+        from comfyui_parallelanything_tpu.nodes_compat import (
+            VAEEncodeForInpaint,
+        )
+        from tests.test_vae import TINY as TINY_VAE
+
+        vae = build_vae(TINY_VAE, jax.random.key(1), sample_hw=16)
+        f = vae.spatial_factor
+        hw = 8 * f
+        pixels = jax.random.uniform(jax.random.key(2), (1, hw, hw, 3))
+        mask = jnp.zeros((hw, hw)).at[:2, :2].set(1.0)
+
+        (lat,) = VAEEncodeForInpaint().encode(vae, pixels, mask,
+                                              grow_mask_by=2)
+        assert lat["samples"].shape[1:3] == (8, 8)
+        nm = np.asarray(lat["noise_mask"])
+        assert nm.shape == (1, 8, 8, 1)
+        # grow_mask_by dilated the 2px corner beyond its original extent.
+        assert nm.sum() > 0 and float(nm[0, 0, 0, 0]) == 1.0
+        assert float(nm[0, -1, -1, 0]) == 0.0
+        # No growth: strictly smaller or equal mask.
+        (lat0,) = VAEEncodeForInpaint().encode(vae, pixels, mask,
+                                               grow_mask_by=0)
+        assert np.asarray(lat0["noise_mask"]).sum() <= nm.sum()
+
+    def test_image_pad_for_outpaint(self):
+        from comfyui_parallelanything_tpu.nodes_compat import (
+            ImagePadForOutpaint,
+        )
+
+        img = jax.random.uniform(jax.random.key(3), (1, 16, 12, 3))
+        padded, mask = ImagePadForOutpaint().expand_image(
+            img, left=8, top=0, right=0, bottom=4, feathering=4
+        )
+        assert padded.shape == (1, 20, 20, 3)
+        assert mask.shape == (1, 20, 20)
+        m = np.asarray(mask)
+        assert m[0, :, :8].min() == 1.0      # new left border fully masked
+        assert m[0, -4:, :].min() == 1.0     # new bottom border fully masked
+        assert m[0, 0, -1] == 0.0            # untouched corner (no top/right pad)
+        # Feather ramps inside the original region next to the padded edge.
+        assert 0.0 < m[0, 8, 10] < 1.0
+        # Edge-replication: padded left column equals the original's first col.
+        np.testing.assert_allclose(
+            np.asarray(padded[0, 0, :8, :]),
+            np.broadcast_to(np.asarray(img[0, 0, 0, :]), (8, 3)),
+        )
