@@ -1339,6 +1339,34 @@ class TPUVAEDecode:
         return (vae_output_to_images(decode_maybe_tiled(vae, latent["samples"], tile_size)),)
 
 
+def resolve_save_target(filename_prefix: str, output_dir: str = "",
+                        suffix: str = "png") -> tuple:
+    """Shared host-SaveImage path semantics for every save-family node:
+    empty ``output_dir`` = the served PA_OUTPUT_DIR root; the prefix may carry
+    a subfolder ("run1/img", created + counted within); absolute or
+    parent-escaping prefixes are rejected; the numbered counter continues past
+    the HIGHEST existing ``{name}_{N}.{suffix}`` index so re-runs never
+    overwrite. Returns ``(target_dir, name, start_index)``."""
+    import os
+    import re as _re
+
+    output_dir = output_dir or os.environ.get("PA_OUTPUT_DIR", "output")
+    subdir, name = os.path.split(filename_prefix)
+    target_dir = os.path.join(output_dir, subdir) if subdir else output_dir
+    root = os.path.realpath(output_dir)
+    if os.path.commonpath([root, os.path.realpath(target_dir)]) != root:
+        raise ValueError(
+            f"filename_prefix {filename_prefix!r} resolves outside "
+            f"output_dir {output_dir!r}"
+        )
+    os.makedirs(target_dir, exist_ok=True)
+    pat = _re.compile(_re.escape(name) + r"_(\d+)\." + _re.escape(suffix) + "$")
+    taken = [
+        int(m.group(1)) for f in os.listdir(target_dir) if (m := pat.match(f))
+    ]
+    return target_dir, name, (max(taken) + 1 if taken else 0)
+
+
 class TPUSaveImage:
     """IMAGE → PNG files on disk — the terminal node every exported ComfyUI
     txt2img workflow ends with (the reference relies on the host's SaveImage;
@@ -1386,25 +1414,12 @@ class TPUSaveImage:
         import numpy as np
         from PIL import Image
 
-        # Empty widget = the host-configured output root (PA_OUTPUT_DIR, the
-        # same root server.py serves /view from), else the stock "output" —
-        # exported stock workflows carry only filename_prefix, and their
-        # images must land where the API server can find them.
-        output_dir = output_dir or os.environ.get("PA_OUTPUT_DIR", "output")
-
-        # Host SaveImage semantics: the prefix may carry a subfolder
-        # ("run1/img") — create it and count within it. Absolute or
-        # parent-escaping prefixes are rejected: a workflow JSON must not be
-        # able to write outside the configured output directory.
-        subdir, name = os.path.split(filename_prefix)
-        target_dir = os.path.join(output_dir, subdir) if subdir else output_dir
-        root = os.path.realpath(output_dir)
-        if os.path.commonpath([root, os.path.realpath(target_dir)]) != root:
-            raise ValueError(
-                f"filename_prefix {filename_prefix!r} resolves outside "
-                f"output_dir {output_dir!r}"
-            )
-        os.makedirs(target_dir, exist_ok=True)
+        # Shared host-SaveImage path semantics (resolve_save_target):
+        # PA_OUTPUT_DIR default, subfolder prefixes, escape rejection, and a
+        # past-highest-index counter.
+        target_dir, name, start = resolve_save_target(
+            filename_prefix, output_dir, "png"
+        )
         arr = np.asarray(images)
         if arr.ndim == 3:
             arr = arr[None]
@@ -1413,18 +1428,6 @@ class TPUSaveImage:
             # frame of every clip as its own numbered PNG, in order.
             arr = arr.reshape((-1,) + arr.shape[2:])
         arr = (np.clip(arr, 0.0, 1.0) * 255.0 + 0.5).astype(np.uint8)
-        # Counter continues past the HIGHEST existing index (not the file
-        # count) so re-runs never overwrite, even with gaps or stray files
-        # matching the prefix.
-        import re as _re
-
-        pat = _re.compile(_re.escape(name) + r"_(\d+)\.png$")
-        taken = [
-            int(m.group(1))
-            for f in os.listdir(target_dir)
-            if (m := pat.match(f))
-        ]
-        start = max(taken) + 1 if taken else 0
         pnginfo = None
         if metadata or prompt is not None:
             import json as _json

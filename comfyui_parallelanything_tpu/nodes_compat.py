@@ -694,6 +694,202 @@ class ControlNetApplyAdvanced:
         return tagged, negative
 
 
+def _repeat_to_batch(a, batch: int):
+    """Stock repeat_to_batch_size: cycle (tile) then truncate, so any source
+    batch composites onto any destination batch (larger, smaller, or
+    non-divisor alike)."""
+    import jax.numpy as jnp
+
+    if a.shape[0] == batch:
+        return a
+    reps = -(-batch // a.shape[0])
+    return jnp.tile(a, (reps,) + (1,) * (a.ndim - 1))[:batch]
+
+
+class ImageCompositeMasked:
+    """Stock masked paste: source composites over destination at (x, y),
+    optionally through a mask (1 = take source) — the standard inpaint
+    post-step that pastes the regenerated region back into the original."""
+
+    DESCRIPTION = "Stock-name masked image composite."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "composite"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "destination": ("IMAGE", {}),
+                "source": ("IMAGE", {}),
+                "x": ("INT", {"default": 0, "min": 0, "max": 16384}),
+                "y": ("INT", {"default": 0, "min": 0, "max": 16384}),
+                "resize_source": ("BOOLEAN", {"default": False}),
+            },
+            "optional": {"mask": ("MASK", {})},
+        }
+
+    def composite(self, destination, source, x: int, y: int,
+                  resize_source: bool = False, mask=None):
+        import jax
+        import jax.numpy as jnp
+
+        dst = jnp.asarray(destination)
+        src = jnp.asarray(source)
+        if dst.ndim == 3:
+            dst = dst[None]
+        if src.ndim == 3:
+            src = src[None]
+        B, H, W, C = dst.shape
+        if resize_source:
+            src = jax.image.resize(
+                src, (src.shape[0], H, W, C), method="bilinear"
+            )
+        src = _repeat_to_batch(src, B)
+        # Mask normalizes to the FULL source size first, THEN crops with the
+        # paste window (stock composite order — squishing the whole mask down
+        # to the clipped size would blend edge values instead of cropping).
+        if mask is None:
+            m_full = jnp.ones((1, *src.shape[1:3], 1), jnp.float32)
+        else:
+            from .models.vae import normalize_mask
+
+            m_full = normalize_mask(mask, src.shape[1:3])
+        # Clip the paste window to the destination bounds.
+        h = min(src.shape[1], H - y)
+        w = min(src.shape[2], W - x)
+        if h <= 0 or w <= 0:
+            return (dst,)
+        src = src[:, :h, :w, :]
+        m = m_full[:, :h, :w, :]
+        region = dst[:, y:y + h, x:x + w, :]
+        blended = src * m + region * (1.0 - m)
+        return (dst.at[:, y:y + h, x:x + w, :].set(blended),)
+
+
+class LatentComposite:
+    """Stock latent paste: samples_from over samples_to at (x, y) — widget
+    coordinates are PIXELS, divided by 8 to latent cells like stock."""
+
+    DESCRIPTION = "Stock-name latent composite."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "composite"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples_to": ("LATENT", {}),
+                "samples_from": ("LATENT", {}),
+                "x": ("INT", {"default": 0, "min": 0, "max": 16384, "step": 8}),
+                "y": ("INT", {"default": 0, "min": 0, "max": 16384, "step": 8}),
+                "feather": ("INT", {"default": 0, "min": 0, "max": 16384,
+                                    "step": 8}),
+            }
+        }
+
+    def composite(self, samples_to, samples_from, x: int, y: int,
+                  feather: int = 0):
+        import jax.numpy as jnp
+
+        dst = jnp.asarray(samples_to["samples"])
+        src = jnp.asarray(samples_from["samples"])
+        xl, yl, fl = x // 8, y // 8, feather // 8
+        B, H, W, C = dst.shape
+        h = min(src.shape[1], H - yl)
+        w = min(src.shape[2], W - xl)
+        if h <= 0 or w <= 0:
+            return ({**samples_to},)
+        src = src[:, :h, :w, :]
+        src = _repeat_to_batch(src, B)
+        m = jnp.ones((h, w), jnp.float32)
+        if fl > 0:
+            # Feather ONLY the pasted edges that fall strictly inside the
+            # destination — edges flush with the canvas border stay hard
+            # (stock gates each ramp the same way).
+            ones_h = jnp.ones((h,), jnp.float32)
+            ramp_h = jnp.minimum(
+                jnp.arange(1, h + 1, dtype=jnp.float32) / fl, 1.0
+            )
+            top_r = ramp_h if yl > 0 else ones_h
+            bot_r = ramp_h[::-1] if yl + h < H else ones_h
+            m = m * jnp.minimum(top_r, bot_r)[:, None]
+            ones_w = jnp.ones((w,), jnp.float32)
+            ramp_w = jnp.minimum(
+                jnp.arange(1, w + 1, dtype=jnp.float32) / fl, 1.0
+            )
+            left_r = ramp_w if xl > 0 else ones_w
+            right_r = ramp_w[::-1] if xl + w < W else ones_w
+            m = m * jnp.minimum(left_r, right_r)[None, :]
+        m = m[None, :, :, None]
+        region = dst[:, yl:yl + h, xl:xl + w, :]
+        return ({
+            **samples_to,
+            "samples": dst.at[:, yl:yl + h, xl:xl + w, :].set(
+                src * m + region * (1.0 - m)
+            ),
+        },)
+
+
+class SaveAnimatedWEBP:
+    """Stock video save: a (B|F, H, W, 3) image sequence (e.g. WAN decode
+    frames) → one animated WEBP under the served output root."""
+
+    DESCRIPTION = "Stock-name animated WEBP save."
+    RETURN_TYPES = ("STRING",)
+    RETURN_NAMES = ("paths",)
+    FUNCTION = "save_images"
+    CATEGORY = CATEGORY
+    OUTPUT_NODE = True
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "images": ("IMAGE", {}),
+                "filename_prefix": ("STRING", {"default": "ComfyUI"}),
+                "fps": ("FLOAT", {"default": 6.0, "min": 0.01, "max": 1000.0}),
+                "lossless": ("BOOLEAN", {"default": True}),
+                "quality": ("INT", {"default": 80, "min": 0, "max": 100}),
+            }
+        }
+
+    def save_images(self, images, filename_prefix: str = "ComfyUI",
+                    fps: float = 6.0, lossless: bool = True,
+                    quality: int = 80):
+        import numpy as np
+        from PIL import Image
+
+        arr = np.asarray(images)
+        if arr.ndim == 3:
+            arr = arr[None]
+        if arr.ndim == 5:  # (B, F, H, W, 3) video batch → flatten clips
+            arr = arr.reshape((-1,) + arr.shape[2:])
+        frames = [
+            Image.fromarray(
+                (np.clip(f, 0.0, 1.0) * 255.0 + 0.5).astype(np.uint8)
+            )
+            for f in arr
+        ]
+        # Shared save-path semantics with TPUSaveImage (subfolder prefixes,
+        # escape rejection, past-highest-index counter).
+        from .nodes import resolve_save_target
+
+        target_dir, name, start = resolve_save_target(
+            filename_prefix or "ComfyUI", suffix="webp"
+        )
+        path = os.path.join(target_dir, f"{name}_{start:05d}.webp")
+        frames[0].save(
+            path, save_all=True, append_images=frames[1:],
+            duration=max(1, int(round(1000.0 / fps))), loop=0,
+            lossless=lossless, quality=quality,
+        )
+        return ((path,),)
+
+
 class VAEEncodeForInpaint:
     """Stock soft-inpaint encode for REGULAR (4-channel) checkpoints: blanks
     the masked pixels before encoding (so the masked content cannot leak into
@@ -1220,6 +1416,9 @@ def stock_node_mappings() -> dict[str, type]:
         "CLIPTextEncodeSDXL": CLIPTextEncodeSDXL,
         "VAEEncodeForInpaint": VAEEncodeForInpaint,
         "ImagePadForOutpaint": ImagePadForOutpaint,
+        "ImageCompositeMasked": ImageCompositeMasked,
+        "LatentComposite": LatentComposite,
+        "SaveAnimatedWEBP": SaveAnimatedWEBP,
         "ControlNetLoader": ControlNetLoader,
         "ControlNetApply": ControlNetApply,
         "ControlNetApplyAdvanced": ControlNetApplyAdvanced,

@@ -2,6 +2,8 @@
 composition, family sniffing, and the InpaintModelConditioning node driving a
 sampler run end to end."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -184,3 +186,67 @@ class TestSoftInpaintNodes:
             np.asarray(padded[0, 0, :8, :]),
             np.broadcast_to(np.asarray(img[0, 0, 0, :]), (8, 3)),
         )
+
+
+class TestCompositeAndVideoSave:
+    def test_image_composite_masked(self):
+        from comfyui_parallelanything_tpu.nodes_compat import (
+            ImageCompositeMasked,
+        )
+
+        dst = jnp.zeros((1, 8, 8, 3))
+        src = jnp.ones((1, 4, 4, 3))
+        (out,) = ImageCompositeMasked().composite(dst, src, x=2, y=2)
+        o = np.asarray(out)
+        assert o[0, 2, 2, 0] == 1.0 and o[0, 5, 5, 0] == 1.0
+        assert o[0, 0, 0, 0] == 0.0 and o[0, 6, 6, 0] == 0.0
+        # Half mask: blended region takes source only where mask=1.
+        mask = jnp.zeros((4, 4)).at[:2, :].set(1.0)
+        (out2,) = ImageCompositeMasked().composite(dst, src, 2, 2, mask=mask)
+        o2 = np.asarray(out2)
+        assert o2[0, 2, 2, 0] == 1.0 and o2[0, 5, 2, 0] == 0.0
+        # Paste window clips at the destination edge instead of erroring,
+        # and a masked edge-paste CROPS the mask (not squish-resizes it).
+        (out3,) = ImageCompositeMasked().composite(dst, src, x=6, y=6)
+        assert np.asarray(out3)[0, 7, 7, 0] == 1.0
+        row_mask = jnp.zeros((4, 4)).at[:1, :].set(1.0)  # only source row 0
+        (out3m,) = ImageCompositeMasked().composite(
+            dst, src, 6, 6, mask=row_mask
+        )
+        o3 = np.asarray(out3m)
+        # Cropping keeps source rows 0-1: row 0 masked on, row 1 off. A
+        # squish-resize would blend the 1s into both rows instead.
+        assert o3[0, 6, 6, 0] == 1.0 and o3[0, 7, 7, 0] == 0.0
+        # Non-divisor batches cycle like stock repeat_to_batch_size.
+        (out4,) = ImageCompositeMasked().composite(
+            jnp.zeros((3, 8, 8, 3)), jnp.ones((2, 4, 4, 3)), 0, 0
+        )
+        assert np.asarray(out4).shape[0] == 3
+
+    def test_latent_composite(self):
+        from comfyui_parallelanything_tpu.nodes_compat import LatentComposite
+
+        to = {"samples": jnp.zeros((1, 8, 8, 4))}
+        frm = {"samples": jnp.ones((1, 4, 4, 4))}
+        (out,) = LatentComposite().composite(to, frm, x=16, y=16)  # /8 → 2,2
+        o = np.asarray(out["samples"])
+        assert o[0, 2, 2, 0] == 1.0 and o[0, 0, 0, 0] == 0.0
+
+    def test_save_animated_webp(self, tmp_path, monkeypatch):
+        from PIL import Image
+
+        from comfyui_parallelanything_tpu.nodes_compat import SaveAnimatedWEBP
+
+        monkeypatch.setenv("PA_OUTPUT_DIR", str(tmp_path))
+        frames = np.random.default_rng(0).uniform(size=(4, 16, 16, 3))
+        (paths,) = SaveAnimatedWEBP().save_images(
+            frames, filename_prefix="clip", fps=8.0
+        )
+        assert len(paths) == 1 and paths[0].endswith(".webp")
+        im = Image.open(paths[0])
+        assert getattr(im, "n_frames", 1) == 4
+        # Numbered continuation, no overwrite; subfolder prefixes honored.
+        (paths2,) = SaveAnimatedWEBP().save_images(frames, "clip")
+        assert paths2[0] != paths[0]
+        (paths3,) = SaveAnimatedWEBP().save_images(frames, "run1/clip")
+        assert os.sep + "run1" + os.sep in paths3[0]
