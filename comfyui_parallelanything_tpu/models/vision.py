@@ -1,0 +1,277 @@
+"""CLIP vision towers (ViT) — flax.linen, NHWC, TPU-first.
+
+The host's CLIPVisionLoader/CLIPVisionEncode family: the image half of CLIP,
+consumed by unCLIP checkpoints, IPAdapter-style image prompting, and
+image-conditioned video models. Standalone implementation of the HF
+``CLIPVisionModel`` architecture (patch-conv embed + CLS token + learned
+positions, pre-LN transformer — the same block as the text towers,
+``text_encoders._CLIPBlock`` — post-LN pooled CLS, optional visual
+projection), converted from the HF-layout safetensors the public clip-vision
+checkpoints ship (``vision_model.*`` keys).
+
+Outputs follow the host's CLIP_VISION_OUTPUT shape: projected
+``image_embeds``, final-LN ``last_hidden``, and the raw ``penultimate``
+hidden states (what IPAdapter-plus style consumers read).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from .text_encoders import CLIPTextConfig, _CLIPBlock
+
+# OpenAI CLIP preprocessing constants (the host's clip_preprocess).
+CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPVisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int | None = None  # default 4*hidden
+    act: str = "quick_gelu"               # ViT-L; ViT-H/bigG use "gelu"
+    projection_dim: int | None = 768
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def block_cfg(self) -> CLIPTextConfig:
+        """The shared-transformer-block view of this config (the text and
+        vision towers use the identical pre-LN block)."""
+        return CLIPTextConfig(
+            hidden_size=self.hidden_size, num_heads=self.num_heads,
+            intermediate_size=self.intermediate_size, act=self.act,
+            dtype=self.dtype,
+        )
+
+
+def clip_vit_l_14_config(**overrides) -> CLIPVisionConfig:
+    """OpenAI CLIP ViT-L/14 vision tower (SD unCLIP-small / IPAdapter sd15)."""
+    return dataclasses.replace(CLIPVisionConfig(), **overrides)
+
+
+def clip_vit_h_14_config(**overrides) -> CLIPVisionConfig:
+    """OpenCLIP ViT-H/14 vision tower (the common IPAdapter image encoder)."""
+    base = CLIPVisionConfig(
+        hidden_size=1280, num_layers=32, num_heads=16, act="gelu",
+        projection_dim=1024,
+    )
+    return dataclasses.replace(base, **overrides)
+
+
+def clip_vit_bigg_14_config(**overrides) -> CLIPVisionConfig:
+    """OpenCLIP bigG/14 vision tower (SDXL-family image conditioning)."""
+    base = CLIPVisionConfig(
+        hidden_size=1664, num_layers=48, num_heads=16,
+        intermediate_size=8192, act="gelu", projection_dim=1280,
+    )
+    return dataclasses.replace(base, **overrides)
+
+
+class CLIPVisionModel(nn.Module):
+    """forward(images NHWC, already clip-preprocessed to
+    (B, image_size, image_size, 3)) → (image_embeds, last_hidden,
+    penultimate)."""
+
+    cfg: CLIPVisionConfig
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.cfg
+        x = images.astype(cfg.dtype)
+        p = cfg.patch_size
+        # HF patch_embedding: Conv(3→hidden, k=p, s=p, bias=False).
+        x = nn.Conv(
+            cfg.hidden_size, (p, p), strides=(p, p), use_bias=False,
+            dtype=cfg.dtype, name="patch_embed",
+        )(x)
+        B = x.shape[0]
+        x = x.reshape(B, -1, cfg.hidden_size)
+        cls = self.param(
+            "class_embedding", nn.initializers.normal(0.02), (cfg.hidden_size,)
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(cfg.dtype), (B, 1, cfg.hidden_size)), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_emb", nn.initializers.normal(0.02),
+            (cfg.num_patches + 1, cfg.hidden_size),
+        )
+        x = x + pos[None].astype(cfg.dtype)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="pre_ln")(x)
+        bias = jnp.zeros((1, 1, 1, 1), jnp.float32)  # no mask for vision
+        block_cfg = cfg.block_cfg()
+        penultimate = None
+        for i in range(cfg.num_layers):
+            if i == cfg.num_layers - 1:
+                penultimate = x
+            x = _CLIPBlock(block_cfg, name=f"layers_{i}")(x, bias)
+        post_ln = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="post_ln")
+        pooled = post_ln(x[:, 0])
+        if cfg.projection_dim is not None:
+            pooled = nn.Dense(
+                cfg.projection_dim, use_bias=False, dtype=cfg.dtype,
+                name="visual_proj",
+            )(pooled)
+        # HF convention: last_hidden_state is the RAW encoder output —
+        # post_layernorm applies only to the pooled CLS token.
+        return pooled, x, penultimate
+
+
+@dataclasses.dataclass
+class VisionEncoder:
+    """A vision tower as data (the TextEncoder pattern)."""
+
+    apply: Any
+    params: Any
+    cfg: CLIPVisionConfig
+    name: str = "clip-vision"
+
+    def __call__(self, images):
+        import jax
+
+        if not hasattr(self, "_jit"):
+            object.__setattr__(self, "_jit", jax.jit(self.apply))
+        return self._jit(self.params, images)
+
+
+def build_clip_vision(cfg: CLIPVisionConfig, rng=None, params=None,
+                      name="clip-vision") -> VisionEncoder:
+    module = CLIPVisionModel(cfg)
+    if params is None:
+        if rng is None:
+            raise ValueError("need rng to initialize (or pass params=)")
+        params = module.init(
+            rng, jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+        )["params"]
+
+    def apply(p, images):
+        return module.apply({"params": p}, images)
+
+    return VisionEncoder(apply=apply, params=params, cfg=cfg, name=name)
+
+
+def clip_preprocess(images, size: int = 224, crop: bool = True):
+    """The host's clip_preprocess: [0,1] NHWC images → ``size``-square,
+    CLIP-normalized input. ``crop=True`` resizes the short side bicubically
+    then center-crops (the OpenAI/HF image processor); ``crop=False``
+    squashes straight to the square (the stock node's crop="none")."""
+    import jax
+    import jax.numpy as jnp
+
+    img = jnp.asarray(images)
+    if img.ndim == 3:
+        img = img[None]
+    B, H, W, C = img.shape
+    if crop:
+        scale = size / min(H, W)
+        nh, nw = max(size, round(H * scale)), max(size, round(W * scale))
+        img = jax.image.resize(img, (B, nh, nw, C), method="cubic")
+        y0, x0 = (nh - size) // 2, (nw - size) // 2
+        img = img[:, y0:y0 + size, x0:x0 + size, :]
+    else:
+        img = jax.image.resize(img, (B, size, size, C), method="cubic")
+    mean = jnp.asarray(CLIP_MEAN, jnp.float32)
+    std = jnp.asarray(CLIP_STD, jnp.float32)
+    return (jnp.clip(img, 0.0, 1.0) - mean) / std
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint conversion (HF CLIPVisionModel layout)
+# ---------------------------------------------------------------------------
+
+
+def sniff_vision_config(sd) -> CLIPVisionConfig:
+    """Infer the tower from an HF-layout state dict: width/patch from the
+    patch conv, depth from the layer indices, act by the known families."""
+    import re
+
+    pe = np.asarray(sd["vision_model.embeddings.patch_embedding.weight"])
+    hidden, _, patch, _ = pe.shape
+    pos = np.asarray(sd["vision_model.embeddings.position_embedding.weight"])
+    image_size = int(round((pos.shape[0] - 1) ** 0.5)) * patch
+    layers = 1 + max(
+        int(m.group(1)) for k in sd
+        if (m := re.match(r"vision_model\.encoder\.layers\.(\d+)\.", k))
+    )
+    fc1 = np.asarray(sd["vision_model.encoder.layers.0.mlp.fc1.weight"])
+    proj = None
+    if "visual_projection.weight" in sd:
+        proj = int(np.asarray(sd["visual_projection.weight"]).shape[0])
+    # Head counts by family: OpenAI ViT-B/L keep 64-wide heads (12/16), but
+    # OpenCLIP ViT-H (1280) and bigG (1664) both use 16 heads (head widths
+    # 80/104) — see clip_vit_h_14_config/clip_vit_bigg_14_config above.
+    heads = {768: 12, 1024: 16, 1280: 16, 1664: 16}.get(
+        hidden, max(1, hidden // 64)
+    )
+    # quick_gelu is the OpenAI ViT-L convention, exact gelu everything larger.
+    return CLIPVisionConfig(
+        image_size=image_size, patch_size=patch, hidden_size=hidden,
+        num_layers=layers, num_heads=heads,
+        intermediate_size=int(fc1.shape[0]),
+        act="quick_gelu" if hidden <= 1024 else "gelu",
+        projection_dim=proj,
+    )
+
+
+def convert_clip_vision_checkpoint(sd, cfg: CLIPVisionConfig | None = None):
+    """HF ``vision_model.*`` state dict → ``CLIPVisionModel`` params (+cfg)."""
+    from .convert import conv_kernel, dense_params, to_numpy, tree_to_jnp
+
+    if cfg is None:
+        cfg = sniff_vision_config(sd)
+    pre = "vision_model."
+    p: dict = {
+        "class_embedding": to_numpy(sd[f"{pre}embeddings.class_embedding"]).reshape(-1),
+        "patch_embed": {
+            "kernel": conv_kernel(sd[f"{pre}embeddings.patch_embedding.weight"])
+        },
+        "pos_emb": to_numpy(sd[f"{pre}embeddings.position_embedding.weight"]),
+        "pre_ln": {
+            "scale": to_numpy(sd[f"{pre}pre_layrnorm.weight"]),  # HF's typo'd name
+            "bias": to_numpy(sd[f"{pre}pre_layrnorm.bias"]),
+        },
+        "post_ln": {
+            "scale": to_numpy(sd[f"{pre}post_layernorm.weight"]),
+            "bias": to_numpy(sd[f"{pre}post_layernorm.bias"]),
+        },
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{pre}encoder.layers.{i}."
+        p[f"layers_{i}"] = {
+            "ln1": {"scale": to_numpy(sd[f"{lp}layer_norm1.weight"]),
+                    "bias": to_numpy(sd[f"{lp}layer_norm1.bias"])},
+            "ln2": {"scale": to_numpy(sd[f"{lp}layer_norm2.weight"]),
+                    "bias": to_numpy(sd[f"{lp}layer_norm2.bias"])},
+            "q": dense_params(sd, f"{lp}self_attn.q_proj"),
+            "k": dense_params(sd, f"{lp}self_attn.k_proj"),
+            "v": dense_params(sd, f"{lp}self_attn.v_proj"),
+            "out": dense_params(sd, f"{lp}self_attn.out_proj"),
+            "fc1": dense_params(sd, f"{lp}mlp.fc1"),
+            "fc2": dense_params(sd, f"{lp}mlp.fc2"),
+        }
+    if cfg.projection_dim is not None and "visual_projection.weight" in sd:
+        p["visual_proj"] = {
+            "kernel": to_numpy(sd["visual_projection.weight"]).T
+        }
+    return tree_to_jnp(p), cfg
+
+
+def load_clip_vision_checkpoint(src, cfg: CLIPVisionConfig | None = None,
+                                name: str = "clip-vision") -> VisionEncoder:
+    from .loader import _resolve_state_dict
+
+    params, cfg = convert_clip_vision_checkpoint(_resolve_state_dict(src), cfg)
+    return build_clip_vision(cfg, params=params, name=name)

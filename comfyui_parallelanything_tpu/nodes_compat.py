@@ -595,6 +595,73 @@ class UpscaleModelLoader:
         return TPUUpscaleModelLoader().load(ckpt_path=path)
 
 
+class CLIPVisionLoader:
+    """Stock loader: clip_name resolves via $PA_MODELS_DIR/clip_vision; the
+    tower (ViT-L/H/bigG) is sniffed off the HF-layout checkpoint
+    (models/vision.py)."""
+
+    DESCRIPTION = "Stock-name CLIP vision loader (tower sniffed)."
+    RETURN_TYPES = ("CLIP_VISION",)
+    RETURN_NAMES = ("clip_vision",)
+    FUNCTION = "load_clip"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"clip_name": ("STRING", {"default": ""})}}
+
+    def load_clip(self, clip_name: str):
+        from .models.vision import load_clip_vision_checkpoint
+
+        path = resolve_model_file(clip_name, "clip_vision")
+        if not clip_name or not os.path.isfile(path):
+            raise ValueError(
+                f"CLIP vision model not found: {clip_name!r} (searched "
+                "$PA_MODELS_DIR/clip_vision and the name as a path)"
+            )
+        return ({"model": load_clip_vision_checkpoint(path)},)
+
+
+class CLIPVisionEncode:
+    """Stock encode: IMAGE → CLIP_VISION_OUTPUT (projected image_embeds, RAW
+    last_hidden — post_layernorm applies only to the pooled CLS, the HF
+    convention — and the raw penultimate hidden states). Preprocessing is the
+    host's clip_preprocess (bicubic short-side resize + center crop + CLIP
+    normalization); ``crop`` "none" squashes to the square instead."""
+
+    DESCRIPTION = "Stock-name CLIP vision encode."
+    RETURN_TYPES = ("CLIP_VISION_OUTPUT",)
+    RETURN_NAMES = ("clip_vision_output",)
+    FUNCTION = "encode"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip_vision": ("CLIP_VISION", {}),
+                "image": ("IMAGE", {}),
+            },
+            "optional": {
+                "crop": (["center", "none"], {"default": "center"}),
+            },
+        }
+
+    def encode(self, clip_vision, image, crop: str = "center"):
+        from .models.vision import clip_preprocess
+
+        model = clip_vision["model"]
+        px = clip_preprocess(
+            image, size=model.cfg.image_size, crop=(crop != "none")
+        )
+        embeds, last, penultimate = model(px)
+        return ({
+            "image_embeds": embeds,
+            "last_hidden": last,
+            "penultimate": penultimate,
+        },)
+
+
 class ControlNetLoader:
     """Stock loader: control_net_name resolves via $PA_MODELS_DIR/controlnet."""
 
@@ -1422,6 +1489,8 @@ def stock_node_mappings() -> dict[str, type]:
         "ControlNetLoader": ControlNetLoader,
         "ControlNetApply": ControlNetApply,
         "ControlNetApplyAdvanced": ControlNetApplyAdvanced,
+        "CLIPVisionLoader": CLIPVisionLoader,
+        "CLIPVisionEncode": CLIPVisionEncode,
         "UpscaleModelLoader": UpscaleModelLoader,
         "ImageUpscaleWithModel": _renamed(
             n.TPUImageUpscaleWithModel, {}, name="ImageUpscaleWithModel"

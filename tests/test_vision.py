@@ -1,0 +1,171 @@
+"""CLIP vision tower: golden equivalence against transformers'
+CLIPVisionModelWithProjection, config sniffing, preprocessing, and the
+CLIPVisionLoader/CLIPVisionEncode node surface."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from comfyui_parallelanything_tpu.models.vision import (
+    CLIPVisionConfig,
+    build_clip_vision,
+    clip_preprocess,
+    convert_clip_vision_checkpoint,
+    load_clip_vision_checkpoint,
+    sniff_vision_config,
+)
+
+TINY = CLIPVisionConfig(
+    image_size=28, patch_size=7, hidden_size=32, num_layers=2, num_heads=4,
+    intermediate_size=64, act="quick_gelu", projection_dim=16,
+    dtype=jnp.float32,
+)
+
+
+def _hf_vision(cfg: CLIPVisionConfig, act: str):
+    hf_cfg = transformers.CLIPVisionConfig(
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        image_size=cfg.image_size,
+        patch_size=cfg.patch_size,
+        hidden_act=act,
+        projection_dim=cfg.projection_dim,
+    )
+    torch.manual_seed(0)
+    return transformers.CLIPVisionModelWithProjection(hf_cfg).eval()
+
+
+class TestGolden:
+    @pytest.mark.parametrize("act", ["quick_gelu", "gelu"])
+    def test_matches_transformers(self, act):
+        cfg = dataclasses.replace(TINY, act=act)
+        hf = _hf_vision(cfg, act)
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        params, _ = convert_clip_vision_checkpoint(sd, cfg)
+        model = build_clip_vision(cfg, params=params)
+
+        rng = np.random.default_rng(1)
+        img = rng.standard_normal(
+            (2, cfg.image_size, cfg.image_size, 3)
+        ).astype(np.float32)
+        embeds, last, penultimate = model(jnp.asarray(img))
+
+        with torch.no_grad():
+            out = hf(
+                pixel_values=torch.from_numpy(img).permute(0, 3, 1, 2),
+                output_hidden_states=True,
+            )
+        np.testing.assert_allclose(
+            np.asarray(embeds), out.image_embeds.numpy(), rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(last), out.last_hidden_state.numpy(),
+            rtol=2e-4, atol=2e-4,
+        )
+        # transformers hidden_states[-2] is the raw penultimate stream.
+        np.testing.assert_allclose(
+            np.asarray(penultimate), out.hidden_states[-2].numpy(),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def test_sniff_round_trip(self):
+        hf = _hf_vision(TINY, "quick_gelu")
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        cfg = sniff_vision_config(sd)
+        assert (cfg.image_size, cfg.patch_size, cfg.hidden_size,
+                cfg.num_layers, cfg.intermediate_size,
+                cfg.projection_dim) == (28, 7, 32, 2, 64, 16)
+        # num_heads for the tiny fixture comes from the fallback rule —
+        # loaders for real towers use the family table; pass cfg explicitly.
+        params, _ = convert_clip_vision_checkpoint(sd, TINY)
+        assert "visual_proj" in params
+
+    def test_sniff_head_table_for_public_towers(self):
+        # ViT-H (1280) and bigG (1664) use 16 heads (widths 80/104), NOT the
+        # 64-wide-head rule; ViT-B/L stay on it.
+        from comfyui_parallelanything_tpu.models.vision import (
+            sniff_vision_config,
+        )
+
+        def fake(hidden, layers):
+            grid = 16 * 16
+            return {
+                "vision_model.embeddings.patch_embedding.weight":
+                    np.zeros((hidden, 3, 14, 14), np.float32),
+                "vision_model.embeddings.position_embedding.weight":
+                    np.zeros((grid + 1, hidden), np.float32),
+                "vision_model.encoder.layers.0.mlp.fc1.weight":
+                    np.zeros((hidden * 4, hidden), np.float32),
+                f"vision_model.encoder.layers.{layers - 1}.mlp.fc1.weight":
+                    np.zeros((hidden * 4, hidden), np.float32),
+            }
+
+        assert sniff_vision_config(fake(1024, 24)).num_heads == 16
+        assert sniff_vision_config(fake(1280, 32)).num_heads == 16
+        assert sniff_vision_config(fake(1664, 48)).num_heads == 16
+        assert sniff_vision_config(fake(768, 12)).num_heads == 12
+
+
+class TestPreprocess:
+    def test_resize_crop_and_normalize(self):
+        img = jnp.ones((1, 50, 100, 3)) * 0.5
+        out = clip_preprocess(img, size=28)
+        assert out.shape == (1, 28, 28, 3)
+        from comfyui_parallelanything_tpu.models.vision import (
+            CLIP_MEAN,
+            CLIP_STD,
+        )
+
+        want = (0.5 - np.asarray(CLIP_MEAN)) / np.asarray(CLIP_STD)
+        np.testing.assert_allclose(
+            np.asarray(out)[0, 14, 14], want, rtol=1e-5, atol=1e-5
+        )
+
+
+class TestNodes:
+    def test_loader_and_encode(self, tmp_path, monkeypatch):
+        from safetensors.numpy import save_file
+
+        from comfyui_parallelanything_tpu.nodes_compat import (
+            stock_node_mappings,
+        )
+
+        hf = _hf_vision(TINY, "quick_gelu")
+        sd = {k: np.ascontiguousarray(v.detach().numpy())
+              for k, v in hf.state_dict().items()}
+        cv_dir = tmp_path / "models" / "clip_vision"
+        cv_dir.mkdir(parents=True)
+        save_file(sd, str(cv_dir / "tiny_vision.safetensors"))
+        monkeypatch.setenv("PA_MODELS_DIR", str(tmp_path / "models"))
+
+        maps = stock_node_mappings()
+        # The sniffed head count differs for the tiny fixture; load through
+        # the node (which sniffs) and patch heads via the sniffer.
+        import comfyui_parallelanything_tpu.models.vision as vision_mod
+
+        real_sniff = vision_mod.sniff_vision_config
+        monkeypatch.setattr(
+            vision_mod, "sniff_vision_config",
+            lambda s: dataclasses.replace(real_sniff(s), num_heads=4,
+                                          dtype=jnp.float32),
+        )
+        (wire,) = maps["CLIPVisionLoader"]().load_clip(
+            clip_name="tiny_vision.safetensors"
+        )
+        img = jnp.asarray(
+            np.random.default_rng(0).uniform(size=(1, 40, 40, 3)),
+            jnp.float32,
+        )
+        (out,) = maps["CLIPVisionEncode"]().encode(wire, img)
+        assert out["image_embeds"].shape == (1, 16)
+        assert out["penultimate"].shape[0] == 1
+        assert np.isfinite(np.asarray(out["image_embeds"])).all()
