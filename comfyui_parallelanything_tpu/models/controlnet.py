@@ -219,9 +219,11 @@ def apply_control(
         )
         gate = jnp.float32(strength)
         if (start_p, end_p) != (0.0, 1.0):
-            progress = 1.0 - timesteps.astype(jnp.float32) / 999.0
-            on = (progress >= start_p) & (progress <= end_p)
-            gate = gate * on.astype(jnp.float32)[:, None, None, None]
+            from ..ops.basic import progress_window_gate
+
+            gate = gate * progress_window_gate(
+                timesteps, start_p, end_p, x.ndim
+            )
         ctrl = jax.tree.map(lambda a: a * gate, ctrl)
         if control is not None:
             # Stacked ControlNets (a chain of apply_control compositions):

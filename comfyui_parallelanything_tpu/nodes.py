@@ -1177,6 +1177,22 @@ def _prepare_sampling_inputs(model, positive, negative, latent):
             "combined/area NEGATIVE conditioning is not supported — sampling "
             "with the primary negative prompt, full-frame"
         )
+    if positive.get("timestep_range") is not None:
+        from .utils.logging import get_logger
+
+        get_logger().warning(
+            "ConditioningSetTimestepRange on the PRIMARY positive cond is "
+            "ignored (a step with no active conditioning has no fallback) — "
+            "route ranged prompts through ConditioningCombine so they ride "
+            "the extras, where the window gates them"
+        )
+    if negative and negative.get("timestep_range") is not None:
+        from .utils.logging import get_logger
+
+        get_logger().warning(
+            "ConditioningSetTimestepRange on the NEGATIVE conditioning is "
+            "not supported — the negative prompt applies across the whole run"
+        )
     if negative and negative.get("control"):
         from .utils.logging import get_logger
 

@@ -1078,6 +1078,43 @@ class ImagePadForOutpaint:
         return padded, jnp.broadcast_to(mask[None], (B, *mask.shape))
 
 
+class ConditioningSetTimestepRange:
+    """Stock timestep-range gate: scope a conditioning to a sampling-progress
+    window (start/end in [0, 1], 0 = first step). Effective on conds riding a
+    Combine's ``extras`` (the stock multi-stage pattern: two prompts covering
+    different ranges); on a lone PRIMARY cond the gate is ignored with a
+    warning at sampling time (a step with no active cond has no stock
+    fallback either)."""
+
+    DESCRIPTION = "Stock-name conditioning timestep window."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "set_range"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING", {}),
+                "start": ("FLOAT", {"default": 0.0, "min": 0.0, "max": 1.0,
+                                    "step": 0.001}),
+                "end": ("FLOAT", {"default": 1.0, "min": 0.0, "max": 1.0,
+                                  "step": 0.001}),
+            }
+        }
+
+    def set_range(self, conditioning, start: float, end: float):
+        rng_ = (float(start), float(end))
+        out = {**conditioning, "timestep_range": rng_}
+        if conditioning.get("extras"):
+            out["extras"] = tuple(
+                {**e, "timestep_range": rng_}
+                for e in conditioning["extras"]
+            )
+        return (out,)
+
+
 class ConditioningZeroOut:
     """Stock zero-out: the FLUX-workflow "negative" — a conditioning whose
     embeddings are all zeros (guidance-distilled models take it instead of a
@@ -1480,6 +1517,7 @@ def stock_node_mappings() -> dict[str, type]:
         "ConditioningSetArea": ConditioningSetArea,
         "ConditioningAverage": ConditioningAverage,
         "ConditioningZeroOut": ConditioningZeroOut,
+        "ConditioningSetTimestepRange": ConditioningSetTimestepRange,
         "CLIPTextEncodeSDXL": CLIPTextEncodeSDXL,
         "VAEEncodeForInpaint": VAEEncodeForInpaint,
         "ImagePadForOutpaint": ImagePadForOutpaint,

@@ -37,3 +37,21 @@ def timestep_embedding(
     if dim % 2:
         emb = jnp.concatenate([emb, jnp.zeros_like(emb[:, :1])], axis=-1)
     return emb
+
+
+def progress_window_gate(
+    t_vec: jnp.ndarray, start: float, end: float, ndim: int,
+    flow_time: bool = False,
+) -> jnp.ndarray:
+    """Per-batch sampling-progress window gate in {0, 1}, shaped (B, 1, ...)
+    to broadcast over a rank-``ndim`` batch tensor (rank-safe for video's 5D
+    latents). Progress runs 0 → 1 over the denoise: flow time IS the noise
+    level (progress = 1 − t); the eps/v families carry table timesteps
+    (progress = 1 − t/999 — the stock percent-window linear-in-t
+    approximation). Shared by ControlNet's start/end percents
+    (models/controlnet.apply_control) and ConditioningSetTimestepRange
+    (sampling/k_samplers.EpsDenoiser) so the two gates cannot drift."""
+    t = t_vec.astype(jnp.float32)
+    progress = 1.0 - (t if flow_time else t / 999.0)
+    on = (progress >= float(start)) & (progress <= float(end))
+    return on.astype(jnp.float32).reshape((-1,) + (1,) * (ndim - 1))

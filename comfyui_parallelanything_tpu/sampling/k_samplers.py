@@ -298,7 +298,10 @@ class EpsDenoiser:
     def _combine_conds(self, eps_c, x_in, t_vec, batch):
         """Area-weight-normalized blend of the primary cond's prediction with
         every extra cond's (one model call each — token lengths differ, so
-        they cannot batch into one call without padding)."""
+        they cannot batch into one call without padding). An extra carrying
+        ``timestep_range`` (start, end) contributes only while sampling
+        progress is inside the window (the stock ConditioningSetTimestepRange
+        + Combine multi-stage pattern)."""
         m0 = self._area_mask(self.cond_area, self.cond_strength, x_in.shape)
         num = m0 * eps_c
         den = m0 * jnp.ones_like(eps_c[..., :1])
@@ -316,6 +319,14 @@ class EpsDenoiser:
             m = self._area_mask(
                 e.get("area"), float(e.get("strength", 1.0)), x_in.shape
             )
+            rng_ = e.get("timestep_range")
+            if rng_ is not None:
+                from ..ops.basic import progress_window_gate
+
+                m = m * progress_window_gate(
+                    t_vec, rng_[0], rng_[1], x_in.ndim,
+                    flow_time=(self.prediction == "flow"),
+                )
             num = num + m * eps_e
             den = den + m * jnp.ones_like(eps_e[..., :1])
         # Uncovered pixels (every cond area-scoped away from them) fall back

@@ -700,3 +700,22 @@ class TestMultiCond:
                 jnp.zeros((1, 3, 5)), sampler="ddim", steps=2,
                 extra_conds=[{"context": jnp.ones((1, 3, 5))}],
             )
+
+    def test_timestep_range_gates_extras(self):
+        # Stock SetTimestepRange + Combine: the extra prompt contributes only
+        # inside its progress window. eps family: progress = 1 - t/999.
+        x = jnp.zeros((1, 4, 4, 2), jnp.float32)
+        d = EpsDenoiser(
+            self._mean_model, jnp.zeros((1, 3, 5)),
+            extra_conds=[{"context": jnp.ones((1, 3, 5)),
+                          "timestep_range": (0.0, 0.5)}],
+        )
+        # x0 = x - sigma*eps with x = 0, so eps = -x0/sigma.
+        # Early sampling: sigma high -> t near table top -> progress ~0: ON.
+        s_hi = float(d.sigma_table[-1])
+        eps_early = -np.asarray(d(x, d.sigma_table[-1])) / s_hi
+        np.testing.assert_allclose(eps_early, 0.5, atol=1e-5)
+        # Late sampling: sigma low -> progress ~1: OFF (primary only).
+        s_lo = float(d.sigma_table[0])
+        eps_late = -np.asarray(d(x, d.sigma_table[0])) / s_lo
+        np.testing.assert_allclose(eps_late, 0.0, atol=1e-5)
