@@ -214,8 +214,24 @@ def sniff_upscale_config(sd: Mapping[str, Any]) -> UpscaleConfig:
         int(m.group(1)) for k in sd if (m := re.match(r"body\.(\d+)\.", k))
     )
     out_ch = int(np.asarray(sd["conv_last.weight"]).shape[0])
-    base_in = 3 if in_w % 3 == 0 else 1
-    scale = {1: 4, 4: 2, 16: 1}[in_w // base_in]
+    # conv_first's input width encodes in_channels × pixel-unshuffle²:
+    # x4 models see raw pixels (factor 1), x2 unshuffle by 2 (factor 4),
+    # x1 by 4 (factor 16). Only the known 1/3-channel pairs are accepted;
+    # widths outside the table raise instead of guessing a divisor. Widths
+    # that COLLIDE with a table entry (a 4-channel x4 sniffs as 1-channel
+    # x2 at width 4; 4-channel x2 as 1-channel x1 at width 16) cannot be
+    # told apart from the state dict — such variants need an explicit
+    # UpscaleConfig.
+    known = {1: (1, 4), 3: (3, 4), 4: (1, 2), 12: (3, 2), 16: (1, 1),
+             48: (3, 1)}
+    if in_w not in known:
+        raise ValueError(
+            f"unrecognized RRDBNet conv_first input width {in_w}: expected "
+            "in_channels 1 or 3 with pixel-unshuffle factor 1/4/16 "
+            f"(widths {sorted(known)}); pass an explicit UpscaleConfig for "
+            "nonstandard variants"
+        )
+    base_in, scale = known[in_w]
     return UpscaleConfig(nf=nf, nb=nb, gc=gc, scale=scale,
                          in_channels=base_in, out_channels=out_ch)
 

@@ -126,6 +126,12 @@ def _xla_attention(q, k, v, scale):
 # chunking is the only way those workloads fit a chip at all.
 _CHUNK_THRESHOLD = 2**27
 
+# Block size of jax's upstream TPU flash kernel
+# (pallas.ops.tpu.flash_attention.BlockSizes.get_default — 128 on every axis in
+# the pinned jaxlib). The upstream kernel asserts seq_len % block == 0 and has
+# no padding, so routing to "pallas_jax" must gate on this.
+_UPSTREAM_BLOCK = 128
+
 
 def _xla_chunked_attention(q, k, v, scale):
     """Memory-bounded attention without a fused kernel: a ``lax.scan`` over
@@ -211,10 +217,17 @@ def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
             backend = fused_backend(q.shape[1], q.shape[-1])
         else:
             backend = "xla"
-    if backend == "pallas_jax" and q.shape[-1] % 128 != 0:
-        # The upstream kernel has no lane padding; a FORCED pallas_jax (the
-        # watchdog's probe-failure fallback) on a 40/64-dim head takes the
-        # safe XLA family rather than the unprobed in-repo padded path.
+    if backend == "pallas_jax" and (
+        q.shape[-1] % 128 != 0
+        or q.shape[1] % _UPSTREAM_BLOCK != 0
+        or k.shape[1] % _UPSTREAM_BLOCK != 0
+    ):
+        # The upstream kernel has no lane padding and asserts seq_len %
+        # block == 0 (BlockSizes.get_default blocks are _UPSTREAM_BLOCK; no
+        # internal padding). A FORCED pallas_jax (the watchdog's
+        # probe-failure fallback) on a 40/64-dim head or a non-block-aligned
+        # sequence takes the safe XLA family rather than crashing at trace
+        # time on a shape the sweep never measured.
         backend = "xla"
     if backend == "xla" and logit_elems > _CHUNK_THRESHOLD:
         # "xla" means the XLA family: shapes whose S×S logits would blow HBM

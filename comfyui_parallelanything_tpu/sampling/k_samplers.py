@@ -22,6 +22,23 @@ from .cfg import apply_callback, double_kwargs, rescale_guidance
 from .schedules import scaled_linear_schedule
 
 
+def broadcast_cond_batch(arr, batch: int):
+    """ComfyUI conditioning-batch semantics: one encoded prompt (or any even
+    divisor) tiles to the latent batch; a non-divisor batch is a user error
+    surfaced here rather than as a downstream XLA shape mismatch. Shared by
+    the node boundary (nodes._prepare_sampling_inputs) and the denoiser's
+    extra-cond path so direct ``run_sampler(extra_conds=...)`` callers get the
+    same contract."""
+    if arr is not None and arr.shape[0] != batch:
+        if batch % arr.shape[0]:
+            raise ValueError(
+                f"conditioning batch {arr.shape[0]} does not divide "
+                f"latent batch {batch}"
+            )
+        arr = jnp.repeat(arr, batch // arr.shape[0], axis=0)
+    return arr
+
+
 def model_sigmas(alphas_cumprod: jnp.ndarray) -> jnp.ndarray:
     """Per-trained-timestep sigma table, ascending with t."""
     return jnp.sqrt((1.0 - alphas_cumprod) / alphas_cumprod)
@@ -306,15 +323,11 @@ class EpsDenoiser:
         num = m0 * eps_c
         den = m0 * jnp.ones_like(eps_c[..., :1])
         for e in self.extra_conds:
-            ctx = e["context"]
-            if ctx.shape[0] != batch:
-                ctx = jnp.repeat(ctx, batch // ctx.shape[0], axis=0)
+            ctx = broadcast_cond_batch(e["context"], batch)
             kw = dict(self.kwargs)
             pooled = e.get("pooled")
             if pooled is not None:
-                if pooled.shape[0] != batch:
-                    pooled = jnp.repeat(pooled, batch // pooled.shape[0], axis=0)
-                kw["y"] = pooled
+                kw["y"] = broadcast_cond_batch(pooled, batch)
             eps_e = self.model(x_in, t_vec, ctx, **kw)
             m = self._area_mask(
                 e.get("area"), float(e.get("strength", 1.0)), x_in.shape

@@ -701,6 +701,18 @@ class TestMultiCond:
                 extra_conds=[{"context": jnp.ones((1, 3, 5))}],
             )
 
+    def test_non_divisor_extra_cond_batch_raises(self):
+        # Direct run_sampler/EpsDenoiser API callers (no node-layer
+        # pre-validation) get the same clear error the node layer raises, not
+        # a silent 1x repeat followed by an XLA shape mismatch.
+        x = jnp.zeros((3, 4, 4, 2), jnp.float32)
+        d = EpsDenoiser(
+            self._mean_model, jnp.zeros((3, 3, 5)),
+            extra_conds=[{"context": jnp.ones((2, 3, 5))}],
+        )
+        with pytest.raises(ValueError, match="does not divide"):
+            d(x, jnp.float32(1.0))
+
     def test_timestep_range_gates_extras(self):
         # Stock SetTimestepRange + Combine: the extra prompt contributes only
         # inside its progress window. eps family: progress = 1 - t/999.

@@ -172,6 +172,30 @@ class TestChunkedAttention:
         finally:
             att.set_attention_backend("auto")
 
+    def test_forced_pallas_jax_unaligned_seq_takes_xla_family(self,
+                                                              monkeypatch):
+        # Upstream jax flash kernel asserts seq % block == 0 (no padding); a
+        # forced pallas_jax on a 128-lane head but non-block-aligned sequence
+        # (e.g. an unswept WAN-class latent length) must fall back to the XLA
+        # family instead of crashing at trace time.
+        att = self._mod()
+        att.set_attention_backend("pallas_jax")
+        try:
+            monkeypatch.setattr(att, "_RESOLVED", set())
+            q, k, v = _qkv(b=1, sq=40, sk=40, h=1, d=128)  # 40 % 128 != 0
+            out = att.attention_local(q, k, v)
+            ref = att._xla_attention(q, k, v, scale=128 ** -0.5)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-2, atol=2e-2)
+            assert att.resolved_backends() == ("xla",)
+            # Mixed alignment (aligned q, unaligned kv) is equally unsafe.
+            monkeypatch.setattr(att, "_RESOLVED", set())
+            q2, k2, v2 = _qkv(b=1, sq=128, sk=72, h=1, d=128)
+            att.attention_local(q2, k2, v2)
+            assert att.resolved_backends() == ("xla",)
+        finally:
+            att.set_attention_backend("auto")
+
 
 class TestKernelTuning:
     """Data-driven block sizes / backend choice (ops/pallas/tuning.py): the

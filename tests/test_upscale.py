@@ -135,6 +135,18 @@ class TestConversion:
         got = sniff_upscale_config(sd)
         assert got.scale == 2 and got.in_channels == 3
 
+    def test_sniff_rejects_unrecognized_input_width(self):
+        # A 4-channel x4 variant (conv_first in width 8 after unshuffle-2)
+        # must raise descriptively, not sniff as in_channels=1 with a wrong
+        # shuffle factor and build a silently wrong topology.
+        sd = {
+            "conv_first.weight": np.zeros((8, 8, 3, 3), np.float32),
+            "conv_last.weight": np.zeros((3, 8, 3, 3), np.float32),
+            "body.0.rdb1.conv1.weight": np.zeros((4, 8, 3, 3), np.float32),
+        }
+        with pytest.raises(ValueError, match="conv_first input width 8"):
+            sniff_upscale_config(sd)
+
 
 class TestUpscaleImage:
     def test_output_scale_and_range(self, tiny_upscaler):
