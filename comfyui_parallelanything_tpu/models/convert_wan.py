@@ -22,8 +22,16 @@ are the public Wan2.x release's):
 - ``head.head``                             → ``head_proj``
 - ``head.modulation``                       → ``head_modulation`` (1, 2, D)
 
-Ignored on purpose: ``img_emb.*`` (the i2v variant's CLIP-image branch — t2v parity
-scope) and any ema/optimizer sidecars.
+The WAN2.1-style i2v CLIP-image branch converts when the config carries
+``img_dim`` (wan_14b_i2v_clip_config):
+
+- ``img_emb.proj.0/.1/.3/.4`` → ``img_ln_in`` / ``img_in`` / ``img_hidden`` /
+  ``img_ln_out`` (the MLPProj LN→Dense→GELU→Dense→LN stack)
+- ``blocks.{i}.cross_attn.{k,v}_img``   → ``blocks_{i}.cross_{k,v}_img``
+- ``blocks.{i}.cross_attn.norm_k_img.weight`` → ``blocks_{i}.cross_k_img_norm``
+
+Without ``img_dim`` those keys are ignored (a t2v config loading an i2v file);
+ema/optimizer sidecars are always ignored.
 """
 
 from __future__ import annotations
@@ -72,6 +80,11 @@ def convert_wan_checkpoint(state_dict: Mapping[str, Any], cfg: WanConfig) -> dic
         "head_proj": _dense(sd, "head.head"),
         "head_modulation": {"bias": to_numpy(sd["head.modulation"])},
     }
+    if cfg.img_dim is not None:
+        p["img_ln_in"] = _ln(sd, "img_emb.proj.0")
+        p["img_in"] = _dense(sd, "img_emb.proj.1")
+        p["img_hidden"] = _dense(sd, "img_emb.proj.3")
+        p["img_ln_out"] = _ln(sd, "img_emb.proj.4")
     for i in range(cfg.depth):
         t = f"blocks.{i}"
         p[f"blocks_{i}"] = {
@@ -92,4 +105,10 @@ def convert_wan_checkpoint(state_dict: Mapping[str, Any], cfg: WanConfig) -> dic
             "ffn_out": _dense(sd, f"{t}.ffn.2"),
             "modulation": to_numpy(sd[f"{t}.modulation"]),
         }
+        if cfg.img_dim is not None:
+            p[f"blocks_{i}"].update(
+                cross_k_img=_dense(sd, f"{t}.cross_attn.k_img"),
+                cross_v_img=_dense(sd, f"{t}.cross_attn.v_img"),
+                cross_k_img_norm=_rms(sd, f"{t}.cross_attn.norm_k_img"),
+            )
     return tree_to_jnp(p)

@@ -221,6 +221,13 @@ def sniff_model_family(state_dict: Mapping[str, Any]) -> str:
         in_ch = dim("input_blocks.0.0.weight", 1)
         inpaint = "-inpaint" if in_ch == 9 else ""
         if has("label_emb."):
+            # SD2.1-unCLIP also carries an adm label_emb, but keeps the SD2
+            # block layout (a transformer at input_blocks.1 with OpenCLIP-H
+            # 1024-wide context; SDXL's first attention sits deeper and its
+            # context is 2048).
+            ctx = dim("input_blocks.1.1.transformer_blocks.0.attn2.to_k.weight", 1)
+            if ctx == 1024:
+                return "sd21-unclip"
             return "sdxl" + inpaint
         ctx = dim("input_blocks.1.1.transformer_blocks.0.attn2.to_k.weight", 1)
         # 768 = CLIP-L (SD1.x); 1024 = OpenCLIP-H (SD2.x). eps-vs-v prediction

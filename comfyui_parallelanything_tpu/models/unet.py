@@ -303,6 +303,74 @@ def apply_inpaint_conditioning(base: "DiffusionModel", mask, masked_latent):
     )
 
 
+def unclip_adm(tags, adm_in_channels: int, rng=None,
+               merge_augmentation: float = 0.05) -> jnp.ndarray:
+    """SD2.x-unCLIP adm vector from ``unCLIPConditioning`` tags: each tag's
+    CLIP image embeds are noise-augmented by its ``noise_augmentation`` level
+    (DDPM q_sample over the squared-cosine alpha-bar table — the host's
+    CLIPEmbeddingNoiseAugmentation, whose SD21UnclipL/H noise_aug_config sets
+    ``beta_schedule: squaredcos_cap_v2``; identity data stats), concatenated
+    with the sinusoidal embedding of that level, weighted by ``strength``, and
+    summed; multiple tags re-augment the summed embeds at
+    ``merge_augmentation`` (the host's noise_augment_merge). Returns
+    (1, adm_in_channels) float32 — broadcast to the latent batch by the
+    caller. The uncond half of CFG gets zeros (host SD21UNCLIP.encode_adm
+    semantics for untagged conditioning). Host-surface parity: the reference
+    registers only its own nodes and assumes the host provides unCLIP
+    conditioning (any_device_parallel.py:1473-1483)."""
+    import jax
+
+    from ..ops.basic import timestep_embedding
+
+    if rng is None:
+        rng = jax.random.key(0)
+    n = 1000
+    # squaredcos_cap_v2: beta_t = 1 - bar((t+1)/T)/bar(t/T), capped at 0.999,
+    # with bar(s) = cos²(((s + 0.008)/1.008)·π/2).
+    import numpy as _np
+
+    _t = _np.arange(n, dtype=_np.float64)
+
+    def _bar(s):
+        return _np.cos((s + 0.008) / 1.008 * _np.pi / 2.0) ** 2
+
+    betas = _np.clip(1.0 - _bar((_t + 1) / n) / _bar(_t / n), 0.0, 0.999)
+    acp = jnp.asarray(_np.cumprod(1.0 - betas), jnp.float32)
+
+    def augment(emb, aug: float, key):
+        level = int(round((n - 1) * max(0.0, min(1.0, aug))))
+        noise = jax.random.normal(key, emb.shape, jnp.float32)
+        noised = (
+            jnp.sqrt(acp[level]) * emb + jnp.sqrt(1.0 - acp[level]) * noise
+        )
+        lvl = jnp.full((emb.shape[0],), float(level), jnp.float32)
+        return noised, timestep_embedding(lvl, adm_in_channels - emb.shape[-1])
+
+    outs = []
+    for i, tag in enumerate(tags):
+        emb = jnp.asarray(tag["embeds"], jnp.float32)
+        if emb.ndim == 1:
+            emb = emb[None]
+        emb = emb[:1]  # one adm vector; stock iterates embeds row-wise
+        noised, lvl_emb = augment(
+            emb, float(tag.get("noise_augmentation", 0.0)),
+            jax.random.fold_in(rng, i),
+        )
+        outs.append(
+            jnp.concatenate([noised, lvl_emb], axis=-1)
+            * float(tag.get("strength", 1.0))
+        )
+    y = sum(outs)
+    if len(outs) > 1:
+        emb_dim = jnp.asarray(tags[0]["embeds"]).shape[-1]
+        noised, lvl_emb = augment(
+            y[:, :emb_dim], merge_augmentation,
+            jax.random.fold_in(rng, len(outs)),
+        )
+        y = jnp.concatenate([noised, lvl_emb], axis=-1)
+    return y
+
+
 def build_unet(
     cfg: UNetConfig,
     rng=None,

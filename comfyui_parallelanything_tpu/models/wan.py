@@ -48,6 +48,11 @@ class WanConfig:
     # Rectified-flow velocity parameterization (see models/flux.py): routes the
     # KSampler node's k-sampler menu through flow-time sampling for WAN.
     prediction: str = "flow"
+    # CLIP-vision context width (WAN2.1-style i2v checkpoints: the img_emb
+    # MLP projects CLIP ViT-H penultimate states (B, 257, 1280) into extra
+    # cross-attention context). None = no image branch (t2v, and WAN2.2 i2v
+    # which dropped it in favor of pure channel-concat conditioning).
+    img_dim: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -76,6 +81,15 @@ def wan_14b_i2v_config(**overrides) -> WanConfig:
     encoded-image cond latent 16 (WAN2.2 channel-concat conditioning; no
     CLIP-vision branch)."""
     return wan_14b_config(in_channels=36, **overrides)
+
+
+def wan_14b_i2v_clip_config(**overrides) -> WanConfig:
+    """The WAN2.1-style i2v variant: channel-concat conditioning (36
+    in-channels, as above) PLUS the CLIP-vision branch — ``img_emb.*``
+    projects ViT-H penultimate states into 257 extra cross-attention context
+    tokens served by per-block ``k_img``/``v_img`` heads. The reference's
+    tested WAN set (/root/reference/README.md:5) includes these checkpoints."""
+    return wan_14b_config(in_channels=36, img_dim=1280, **overrides)
 
 
 class _RMSNorm(nn.Module):
@@ -112,9 +126,13 @@ class WanBlock(nn.Module):
     cfg: WanConfig
 
     @nn.compact
-    def __call__(self, x, context, e, rope):
+    def __call__(self, x, context, e, rope, context_img=None):
         """x: (B, S, D) space-time tokens; context: (B, L, D) projected text;
-        e: (B, 6, D) f32 modulation chunks; rope: (cos, sin)."""
+        e: (B, 6, D) f32 modulation chunks; rope: (cos, sin); context_img:
+        optional (B, Li, D) projected CLIP-vision tokens (WAN2.1-style i2v) —
+        attended by dedicated k_img/v_img heads and summed with the text
+        cross-attention before the output projection (the public i2v
+        cross-attn: one extra attention over image context, same queries)."""
         cfg = self.cfg
         H, D = cfg.num_heads, cfg.head_dim
         # Learned per-block modulation bias added to the shared time modulation.
@@ -158,7 +176,16 @@ class WanBlock(nn.Module):
         q = _RMSNorm(cfg.qk_norm_eps, name="cross_q_norm")(q).reshape(B, S, H, D)
         k = _RMSNorm(cfg.qk_norm_eps, name="cross_k_norm")(k).reshape(B, L, H, D)
         v = v.reshape(B, L, H, D)
-        attn = attention(q, k, v).reshape(B, S, -1)
+        attn = attention(q, k, v)
+        if context_img is not None:
+            Li = context_img.shape[1]
+            k_i = nn.Dense(H * D, dtype=cfg.dtype, name="cross_k_img")(context_img)
+            v_i = nn.Dense(H * D, dtype=cfg.dtype, name="cross_v_img")(context_img)
+            k_i = _RMSNorm(cfg.qk_norm_eps, name="cross_k_img_norm")(k_i)
+            attn = attn + attention(
+                q, k_i.reshape(B, Li, H, D), v_i.reshape(B, Li, H, D)
+            )
+        attn = attn.reshape(B, S, -1)
         x = x + nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="cross_o")(attn)
 
         # -- FFN -------------------------------------------------------------------
@@ -189,6 +216,14 @@ class WanModel(nn.Module):
         self.time_hidden = nn.Dense(cfg.hidden_size, dtype=jnp.float32)
         self.time_projection = nn.Dense(6 * cfg.hidden_size, dtype=jnp.float32)
         self.blocks = [WanBlock(cfg) for _ in range(cfg.depth)]
+        if cfg.img_dim is not None:
+            # The public i2v img_emb MLPProj: LN(img_dim) → Dense → GELU →
+            # Dense → LN(hidden), projecting CLIP-vision penultimate states
+            # into extra cross-attention context tokens.
+            self.img_ln_in = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)
+            self.img_in = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)
+            self.img_hidden = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)
+            self.img_ln_out = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)
         # Head modulation is a learned (1, 2, D) bias added to the time vector —
         # the public WAN head (head.modulation + e), NOT a projection.
         self.head_modulation = _HeadModulation(cfg.hidden_size)
@@ -196,7 +231,7 @@ class WanModel(nn.Module):
         pt, ph, pw = cfg.patch_size
         self.head_proj = nn.Dense(pt * ph * pw * cfg.out_channels, dtype=jnp.float32)
 
-    def prepare(self, x, timesteps, context=None, **kwargs):
+    def prepare(self, x, timesteps, context=None, clip_fea=None, **kwargs):
         cfg = self.cfg
         B, T, Hh, Ww, C = x.shape
         pt, ph, pw = cfg.patch_size
@@ -234,15 +269,27 @@ class WanModel(nn.Module):
         ).reshape(1, tp * hp * wp, 3)
         ids = jnp.broadcast_to(grid, (B, tp * hp * wp, 3))
         cos, sin = axis_rope_freqs(ids, self.cfg.axes_dim, cfg.theta)
-        return {
+        carry = {
             "x": tok, "context": ctx, "e": e, "vec": vec,
             "rope_cos": cos, "rope_sin": sin,
         }
+        if clip_fea is not None:
+            if cfg.img_dim is None:
+                raise ValueError(
+                    "clip_fea passed but this WAN config has no CLIP-vision "
+                    "branch (img_dim=None) — load a WAN2.1-style i2v "
+                    "checkpoint (wan_14b_i2v_clip_config)"
+                )
+            ci = self.img_ln_in(clip_fea.astype(jnp.float32))
+            ci = self.img_hidden(nn.gelu(self.img_in(ci.astype(cfg.dtype))))
+            carry["context_img"] = self.img_ln_out(ci).astype(cfg.dtype)
+        return carry
 
     def block_step(self, carry, i: int):
         x = self.blocks[i](
             carry["x"], carry["context"], carry["e"],
             (carry["rope_cos"], carry["rope_sin"]),
+            context_img=carry.get("context_img"),
         )
         return {**carry, "x": x}
 
@@ -260,16 +307,19 @@ class WanModel(nn.Module):
         x = x.transpose(0, 1, 4, 2, 5, 3, 6, 7)
         return x.reshape(B, T, Hh, Ww, cfg.out_channels)
 
-    def __call__(self, x, timesteps, context=None, **kwargs):
-        carry = self.prepare(x, timesteps, context)
+    def __call__(self, x, timesteps, context=None, clip_fea=None, **kwargs):
+        carry = self.prepare(x, timesteps, context, clip_fea=clip_fea)
         for i in range(self.cfg.depth):
             carry = self.block_step(carry, i)
         return self.finalize(carry, x.shape)
 
 
 def _wan_pipeline_spec(module: WanModel, cfg: WanConfig) -> PipelineSpec:
-    def prepare(params, x, t, context=None, **kw):
-        return module.apply({"params": params}, x, t, context, method=WanModel.prepare)
+    def prepare(params, x, t, context=None, clip_fea=None, **kw):
+        return module.apply(
+            {"params": params}, x, t, context, clip_fea=clip_fea,
+            method=WanModel.prepare,
+        )
 
     def make_block(i):
         def fn(params, carry):
@@ -282,11 +332,14 @@ def _wan_pipeline_spec(module: WanModel, cfg: WanConfig) -> PipelineSpec:
             {"params": params}, carry, out_shape, method=WanModel.finalize
         )
 
+    prepare_keys = (
+        "patch_embedding", "text_in", "text_hidden",
+        "time_in", "time_hidden", "time_projection",
+    )
+    if cfg.img_dim is not None:
+        prepare_keys += ("img_ln_in", "img_in", "img_hidden", "img_ln_out")
     return PipelineSpec(
-        prepare_keys=(
-            "patch_embedding", "text_in", "text_hidden",
-            "time_in", "time_hidden", "time_projection",
-        ),
+        prepare_keys=prepare_keys,
         prepare=prepare,
         segments=tuple(
             PipelineSegment((f"blocks_{i}",), make_block(i), f"blocks[{i}]")
@@ -294,6 +347,105 @@ def _wan_pipeline_spec(module: WanModel, cfg: WanConfig) -> PipelineSpec:
         ),
         finalize_keys=("head_modulation", "head_proj"),
         finalize=finalize,
+    )
+
+
+def apply_i2v_conditioning(base: DiffusionModel, cond=None, clip_fea=None):
+    """Compose WAN i2v conditioning into a DiffusionModel: every denoise
+    step's input becomes ``concat([x, cond], channel)`` (``cond`` = 4-channel
+    frame mask ‖ encoded start-frames latent, the WAN i2v channel-concat
+    contract) and, when ``clip_fea`` is given (WAN2.1-style checkpoints with
+    the img_emb branch), the CLIP-vision penultimate states ride the call as
+    the ``clip_fea`` kwarg. Like ``apply_inpaint_conditioning``
+    (models/unet.py), the conditioning tensors live in the merged params
+    pytree so the composition places/shards through ``parallelize`` and the
+    whole step stays one jit program. CFG's doubled batch (cond ‖ uncond in
+    one forward) tiles both tensors. The reference's WAN i2v workloads get
+    this conditioning from the host model it wraps
+    (any_device_parallel.py:921-930 unwraps it; /root/reference/README.md:5
+    lists WAN2.2 in the tested set).
+
+    Config-aware (host WAN21.concat_cond semantics): on a t2v model
+    (in_channels == out_channels) the channel-concat tag is IGNORED with a
+    warning (stock models without extra channels never call concat_cond); on
+    an i2v model with no start-image cond, the missing channels zero-fill
+    (stock zero-fills concat_latent_image, so a WanImageToVideo wired with
+    only clip_vision_output still samples); a cond of the wrong width raises
+    at compose time instead of dying in patchify."""
+    cfg = base.config
+    expected = None
+    in_ch = getattr(cfg, "in_channels", None)
+    out_ch = getattr(cfg, "out_channels", None)
+    if in_ch is not None and out_ch is not None:
+        expected = in_ch - out_ch  # extra channels the checkpoint consumes
+        if expected <= 0:
+            if cond is not None or clip_fea is not None:
+                from ..utils.logging import get_logger
+
+                get_logger().warning(
+                    "i2v conditioning on a t2v checkpoint (in_channels == "
+                    f"{in_ch}, no concat slots) — ignored, sampling proceeds "
+                    "unconditioned (stock concat_cond semantics)"
+                )
+            return base
+        if cond is not None and cond.shape[-1] != expected:
+            raise ValueError(
+                f"i2v cond carries {cond.shape[-1]} channels but the "
+                f"checkpoint concatenates {expected} "
+                f"(in {in_ch} − latent {out_ch}) — the WanImageToVideo VAE "
+                "does not match this model"
+            )
+    if clip_fea is not None and getattr(cfg, "img_dim", None) is None:
+        # A WAN2.1-template graph (clip_vision_output wired) reused on a
+        # checkpoint without the img_emb branch (WAN2.2 i2v, t2v): stock's
+        # model simply ignores clip_fea when it has no img_emb — degrade the
+        # same way instead of raising mid-sampling in WanModel.prepare.
+        from ..utils.logging import get_logger
+
+        get_logger().warning(
+            "clip_vision_output on a WAN checkpoint without the CLIP-vision "
+            "branch (no img_emb weights; WAN2.2-style) — image embeds "
+            "ignored, channel-concat conditioning still applies"
+        )
+        clip_fea = None
+    merged: dict = {"base": base.params}
+    if cond is not None:
+        merged["cond"] = jnp.asarray(cond)
+    if clip_fea is not None:
+        merged["clip_fea"] = jnp.asarray(clip_fea)
+    base_apply = base.apply
+    fill_ch = expected if cond is None else None
+
+    def _tile_to(a, batch, ndim):
+        if a.shape[0] != batch:
+            if batch % a.shape[0]:
+                raise ValueError(
+                    f"i2v conditioning batch {a.shape[0]} does not divide "
+                    f"model batch {batch}"
+                )
+            a = jnp.tile(
+                a, (batch // a.shape[0],) + (1,) * (ndim - 1)
+            )
+        return a
+
+    def apply(p, x, timesteps, context=None, **kw):
+        x_in = x
+        if "cond" in p:
+            c = _tile_to(p["cond"], x.shape[0], x.ndim)
+            x_in = jnp.concatenate([x, c.astype(x.dtype)], axis=-1)
+        elif fill_ch:
+            # No start-image cond on an i2v checkpoint: zero-fill the concat
+            # slots (zeros frame mask = nothing given, zeros cond latent).
+            x_in = jnp.concatenate(
+                [x, jnp.zeros(x.shape[:-1] + (fill_ch,), x.dtype)], axis=-1
+            )
+        if "clip_fea" in p:
+            kw = {**kw, "clip_fea": _tile_to(p["clip_fea"], x.shape[0], 3)}
+        return base_apply(p["base"], x_in, timesteps, context, **kw)
+
+    return DiffusionModel(
+        apply=apply, params=merged, name=f"{base.name}+i2v",
+        config=base.config,
     )
 
 
@@ -313,7 +465,14 @@ def build_wan(
         x = jnp.zeros(sample_shape, jnp.float32)
         t = jnp.zeros((sample_shape[0],), jnp.float32)
         ctx = jnp.zeros((sample_shape[0], txt_len, cfg.text_dim), jnp.float32)
-        params = module.init(rng, x, t, ctx)["params"]
+        kwargs = {}
+        if cfg.img_dim is not None:
+            # 257 = CLIP ViT penultimate tokens (CLS + 16² patches); init must
+            # trace the image branch so its params exist in the pytree.
+            kwargs["clip_fea"] = jnp.zeros(
+                (sample_shape[0], 257, cfg.img_dim), jnp.float32
+            )
+        params = module.init(rng, x, t, ctx, **kwargs)["params"]
 
     def apply(params, x, timesteps, context=None, **kw):
         return module.apply({"params": params}, x, timesteps, context, **kw)

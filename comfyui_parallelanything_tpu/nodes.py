@@ -313,8 +313,8 @@ class ParallelAnythingAdvanced(ParallelAnything):
 # ---------------------------------------------------------------------------
 
 _MODEL_FAMILIES = (
-    "sd15", "sd15-inpaint", "sd21", "sd21-v", "sd21-inpaint", "sdxl",
-    "sdxl-inpaint",
+    "sd15", "sd15-inpaint", "sd21", "sd21-v", "sd21-inpaint", "sd21-unclip",
+    "sdxl", "sdxl-inpaint",
     "sd3-medium", "sd35-medium", "sd35-large",
     "flux-dev", "flux-schnell", "zimage-turbo", "wan-1.3b", "wan-14b",
 )
@@ -422,6 +422,23 @@ class TPUCheckpointLoader:
             )
 
             wcfg = (wan_14b_config if family == "wan-14b" else wan_1_3b_config)()
+            # Variant sniffing within the family: i2v checkpoints carry extra
+            # in-channels (36 = latent + frame mask + cond latent) and the
+            # WAN2.1-style ones add the CLIP-vision branch (img_emb.* — its
+            # proj.1 Linear's input width is the CLIP hidden size).
+            import dataclasses as _dc
+
+            pe = sd.get("patch_embedding.weight")
+            img_w = sd.get("img_emb.proj.1.weight")
+            wcfg = _dc.replace(
+                wcfg,
+                in_channels=(
+                    int(pe.shape[1]) if pe is not None else wcfg.in_channels
+                ),
+                img_dim=(
+                    int(img_w.shape[1]) if img_w is not None else None
+                ),
+            )
             with load_ctx:
                 model = load_wan_checkpoint(sd, wcfg, lora, lora_strength)
                 model = maybe_quant(model)
@@ -459,11 +476,30 @@ class TPUCheckpointLoader:
                 }[family]()
                 model = load_mmdit_checkpoint(sd, mcfg, lora, lora_strength)
                 vae_cfg = sd3_vae_config()
-            elif family in ("sd21", "sd21-v", "sd21-inpaint"):
+            elif family in ("sd21", "sd21-v", "sd21-inpaint", "sd21-unclip"):
                 ucfg = sd21_config(
                     prediction="v" if family == "sd21-v" else "eps",
                     **({"in_channels": 9} if family == "sd21-inpaint" else {}),
                 )
+                if family == "sd21-unclip":
+                    # The unCLIP variants derive from the 768-v model
+                    # (v-prediction) and add an adm head whose width the
+                    # checkpoint's label_emb records (1536 = ViT-L embeds +
+                    # level embedding, 2048 = ViT-H).
+                    import dataclasses as _dc
+
+                    le = sd.get("label_emb.0.0.weight")
+                    if le is None:
+                        le = sd.get("model.diffusion_model.label_emb.0.0.weight")
+                    if le is None:
+                        raise ValueError(
+                            "sd21-unclip checkpoint has no label_emb — "
+                            "not an unCLIP variant"
+                        )
+                    ucfg = _dc.replace(
+                        ucfg, prediction="v",
+                        adm_in_channels=int(le.shape[1]),
+                    )
                 model = load_sd_unet_checkpoint(sd, ucfg, lora, lora_strength)
                 vae_cfg = sd_vae_config()
             elif family in ("sdxl", "sdxl-inpaint"):
@@ -655,7 +691,7 @@ class TPUTextEncode:
                 or "CLIP wire has no encoder/tokenizer"
             )
         ids, mask = tok([text])
-        if clip["type"] == "t5":
+        if clip["type"] in ("t5", "umt5"):
             context = enc(jnp.asarray(ids, jnp.int32), mask=jnp.asarray(mask))
             return ({"context": context, "pooled": None},)
         last, penultimate, pooled = enc(jnp.asarray(ids, jnp.int32))
@@ -1018,7 +1054,7 @@ def _collect_control(positive) -> tuple:
     return specs
 
 
-def _model_with_control(model, specs, inpaint=None):
+def _model_with_control(model, specs, inpaint=None, i2v=None):
     """Compose ControlNet residual injection into the MODEL (the ``control``
     tags Apply nodes leave on the positive conditioning — chained Apply nodes
     stack and their residuals sum, the host's multi-controlnet accumulation).
@@ -1039,11 +1075,12 @@ def _model_with_control(model, specs, inpaint=None):
     placement (the cached workflow output) and the composed placement coexist
     while control is in use — a placement OOM degrades through the normal
     drop-device path."""
-    if not specs and not inpaint:
+    if not specs and not inpaint and not i2v:
         return model
     from .models.api import DiffusionModel
     from .models.controlnet import apply_control
     from .models.unet import apply_inpaint_conditioning
+    from .models.wan import apply_i2v_conditioning
     from .parallel.orchestrator import ParallelModel, parallelize
 
     key = tuple(
@@ -1051,12 +1088,20 @@ def _model_with_control(model, specs, inpaint=None):
          float(s.get("start_percent", 0.0)), float(s.get("end_percent", 1.0)))
         for s in specs
     ) + ((id(inpaint["mask"]), id(inpaint["masked_latent"]))
-         if inpaint else ())
+         if inpaint else ()) + (
+        (id(i2v.get("cond")), id(i2v.get("clip_fea"))) if i2v else ()
+    )
     cached = getattr(model, "_control_composed", None)
     if cached is not None and cached[0] == key:
         return cached[1]
 
     def compose(base):
+        if i2v:
+            # Innermost: the WAN i2v channel-concat (+ optional CLIP branch)
+            # wraps the raw model; control residuals apply to the wrapped step.
+            base = apply_i2v_conditioning(
+                base, i2v.get("cond"), i2v.get("clip_fea")
+            )
         if inpaint:
             # Innermost: the 9-channel input convention wraps the raw model;
             # control residuals then apply to the wrapped step.
@@ -1096,18 +1141,18 @@ def _model_with_control(model, specs, inpaint=None):
         composed = compose(model)
     if cached is not None and hasattr(cached[1], "cleanup"):
         cached[1].cleanup()  # a replaced composition frees its placement
-    # specs/inpaint kept in the entry: the id()-based key stays valid only
+    # specs/inpaint/i2v kept in the entry: the id()-based key stays valid only
     # while the tagged objects are alive.
     try:
         object.__setattr__(
-            model, "_control_composed", (key, composed, specs, inpaint)
+            model, "_control_composed", (key, composed, specs, inpaint, i2v)
         )
     except (AttributeError, TypeError):
         pass  # uncacheable model object: composition still works, uncached
     return composed
 
 
-def _prepare_sampling_inputs(model, positive, negative, latent):
+def _prepare_sampling_inputs(model, positive, negative, latent, rng=None):
     """Shared sampler-node boundary (TPUKSampler + TPUSamplerCustomAdvanced):
     conditioning batch broadcast (ComfyUI semantics: one encoded prompt
     conditions the whole latent batch, tiled when it divides evenly),
@@ -1119,22 +1164,14 @@ def _prepare_sampling_inputs(model, positive, negative, latent):
     cond_extra)`` where ``cond_extra`` is the multi-cond kwargs dict for
     ``run_sampler`` (``extra_conds`` / ``cond_area`` / ``cond_strength`` —
     the stock ConditioningCombine/SetArea wire)."""
-    import jax.numpy as jnp
-
     from .parallel.orchestrator import model_config_of
+    from .sampling.k_samplers import broadcast_cond_batch
 
     shape = latent["samples"].shape
     batch = shape[0]
 
     def bcast(arr):
-        if arr is not None and arr.shape[0] != batch:
-            if batch % arr.shape[0]:
-                raise ValueError(
-                    f"conditioning batch {arr.shape[0]} does not divide "
-                    f"latent batch {batch}"
-                )
-            arr = jnp.repeat(arr, batch // arr.shape[0], axis=0)
-        return arr
+        return broadcast_cond_batch(arr, batch)
 
     context = bcast(positive["context"])
     pooled = bcast(positive.get("pooled"))
@@ -1161,6 +1198,58 @@ def _prepare_sampling_inputs(model, positive, negative, latent):
         if negative and negative.get("pooled") is not None
         else None
     )
+    adm = getattr(model_cfg, "adm_in_channels", None)
+    if positive.get("unclip") and adm:
+        # SD2.x-unCLIP: the adm vector comes from the unCLIPConditioning tags
+        # (noise-augmented CLIP image embeds ‖ level embedding); an untagged
+        # negative samples against zeros — host SD21UNCLIP.encode_adm.
+        import jax.numpy as jnp
+
+        from .models.unet import unclip_adm
+
+        pooled = bcast(unclip_adm(positive["unclip"], adm, rng=rng))
+        uncond_kwargs = {
+            "y": (
+                bcast(unclip_adm(negative["unclip"], adm, rng=rng))
+                if negative and negative.get("unclip")
+                else jnp.zeros_like(pooled)
+            )
+        }
+    elif adm:
+        # adm models sampled without an adm-shaped pooled: stock zero-fills
+        # (SD21UNCLIP.encode_adm for untagged conditioning; SDXL encode_adm
+        # defaults a missing pooled_output to zeros) rather than erroring.
+        # On sd21-unclip the TEXT tower's 1024-wide pooled is dropped — it
+        # never feeds the 1536/2048 label_emb; a wrong-width pooled on other
+        # adm families (bare SDXL CLIPTextEncode wiring) raises with the fix.
+        import jax.numpy as jnp
+
+        def adm_or_none(vec, what):
+            if vec is not None and vec.shape[-1] != adm:
+                if getattr(model_cfg, "context_dim", None) == 1024:
+                    return None
+                raise ValueError(
+                    f"{what} pooled vector is {vec.shape[-1]}-wide but this "
+                    f"model's adm head expects {adm} — route the prompt "
+                    "through CLIPTextEncodeSDXL / "
+                    "TPUConditioningCombine(mode='sdxl')"
+                )
+            return vec
+
+        pooled = adm_or_none(pooled, "positive")
+        if pooled is None:
+            pooled = jnp.zeros((batch, adm), jnp.float32)
+        # The NEGATIVE side needs the same treatment: uncond_kwargs was
+        # assigned from negative["pooled"] above, and a 1024-wide text pooled
+        # there would reach label_emb on the uncond half of CFG.
+        uncond_y = adm_or_none(
+            uncond_kwargs.get("y") if uncond_kwargs else None, "negative"
+        )
+        if negative:
+            uncond_kwargs = {
+                "y": uncond_y if uncond_y is not None
+                else jnp.zeros((batch, adm), jnp.float32)
+            }
     # Multi-cond wire (stock ConditioningCombine/SetArea shims): extra conds
     # ride the positive dict's "extras" tuple; a SetArea on the primary rides
     # "area"/"strength". Negative-side extras have no uncond slot — warn and
@@ -1303,10 +1392,12 @@ class TPUKSampler:
         shape = latent["samples"].shape
         noise = jax.random.normal(rng, shape, jnp.float32)
         model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra = (
-            _prepare_sampling_inputs(model, positive, negative, latent)
+            _prepare_sampling_inputs(model, positive, negative, latent,
+                                     rng=rng)
         )
         model = _model_with_control(
-            model, _collect_control(positive), inpaint=positive.get("inpaint")
+            model, _collect_control(positive), inpaint=positive.get("inpaint"),
+            i2v=positive.get("i2v"),
         )
         kwargs = {} if pooled is None else {"y": pooled}
         out = run_sampler(
@@ -1325,6 +1416,110 @@ class TPUKSampler:
             ),
             denoise=denoise,
             latent_mask=latent.get("noise_mask"),
+            **kwargs,
+        )
+        return ({"samples": out},)
+
+
+class TPUKSamplerAdvanced:
+    """The host's KSamplerAdvanced: a KSampler whose denoise run covers an
+    explicit step window [start_at_step, end_at_step) of the full ``steps``
+    schedule — the stock SDXL base→refiner template's driver (base renders
+    steps 0..N with leftover noise, the refiner continues N..end from the
+    base's latent with ``add_noise`` disabled).
+
+    Semantics matched to stock: ``add_noise="disable"`` drives the run with a
+    zero noise tensor (the latent arrives already-noised from the previous
+    stage); ``return_with_leftover_noise="enable"`` stops the ladder at
+    sigma[end_at_step] without denoising to zero (the leftover the next stage
+    consumes); with it disabled and ``end_at_step < steps`` the final sigma is
+    forced to 0 (stock's force_full_denoise). Host-provided builtin the
+    reference's workflows drive steps through
+    (any_device_parallel.py:1287 assumes the host sampler calls forward)."""
+
+    DESCRIPTION = "Sample a step window of the schedule (base→refiner driver)."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "sample"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        from .sampling.runner import SAMPLER_NAMES
+
+        return {
+            "required": {
+                "model": ("MODEL", {}),
+                "add_noise": (["enable", "disable"], {"default": "enable"}),
+                "noise_seed": ("INT", {"default": 0, "min": 0, "max": SEED_MAX}),
+                "steps": ("INT", {"default": 20, "min": 1, "max": 200}),
+                "cfg": ("FLOAT", {"default": 8.0, "min": 1.0, "max": 30.0}),
+                "sampler_name": (list(SAMPLER_NAMES), {"default": "euler"}),
+                "scheduler": (_scheduler_menu(), {"default": "normal"}),
+                "positive": ("CONDITIONING", {}),
+                "negative": ("CONDITIONING", {}),
+                "latent_image": ("LATENT", {}),
+                "start_at_step": ("INT", {"default": 0, "min": 0, "max": 10000}),
+                "end_at_step": ("INT", {"default": 10000, "min": 0,
+                                        "max": 10000}),
+                "return_with_leftover_noise": (["enable", "disable"],
+                                               {"default": "disable"}),
+            },
+            "optional": {
+                "shift": ("FLOAT", {"default": 1.15, "min": 0.25, "max": 8.0}),
+                "compile_loop": ("BOOLEAN", {"default": False}),
+            },
+        }
+
+    def sample(self, model, add_noise: str, noise_seed: int, steps: int,
+               cfg: float, sampler_name: str, scheduler: str, positive,
+               negative, latent_image, start_at_step: int, end_at_step: int,
+               return_with_leftover_noise: str, shift: float = 1.15,
+               compile_loop: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from .sampling.runner import run_sampler
+
+        latent = latent_image
+        (sigmas,) = TPUBasicScheduler().get_sigmas(
+            model, scheduler, steps, denoise=1.0, shift=shift
+        )
+        realized = len(sigmas) - 1  # dedup schedulers may realize fewer
+        start = min(start_at_step, realized)
+        end = min(end_at_step, realized)
+        if end <= start:
+            return (dict(latent),)  # empty window: stock returns the latent
+        sigmas = sigmas[start:end + 1]
+        if return_with_leftover_noise != "enable" and end < realized:
+            sigmas = sigmas.at[-1].set(0.0)  # stock force_full_denoise
+
+        shape = latent["samples"].shape
+        rng = seed_key(noise_seed)
+        noise = (
+            jax.random.normal(rng, shape, jnp.float32)
+            if add_noise == "enable"
+            else jnp.zeros(shape, jnp.float32)
+        )
+        model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra = (
+            _prepare_sampling_inputs(model, positive, negative, latent,
+                                     rng=rng)
+        )
+        model = _model_with_control(
+            model, _collect_control(positive), inpaint=positive.get("inpaint"),
+            i2v=positive.get("i2v"),
+        )
+        kwargs = {} if pooled is None else {"y": pooled}
+        out = run_sampler(
+            model, noise, context, sampler=sampler_name,
+            steps=max(1, len(sigmas) - 1), sigmas=sigmas,
+            cfg_scale=cfg, uncond_context=uncond_context,
+            uncond_kwargs=uncond_kwargs, rng=rng, shift=shift, **cond_extra,
+            guidance=positive.get("guidance"),
+            prediction=getattr(model_cfg, "prediction", "eps"),
+            init_latent=latent["samples"],
+            latent_mask=latent.get("noise_mask"),
+            compile_loop=compile_loop,
             **kwargs,
         )
         return ({"samples": out},)
@@ -1829,10 +2024,12 @@ class TPUSamplerCustomAdvanced:
             else jax.random.normal(rng, shape, jnp.float32)
         )
         model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra = (
-            _prepare_sampling_inputs(model, positive, negative, latent_image)
+            _prepare_sampling_inputs(model, positive, negative, latent_image,
+                                     rng=rng)
         )
         model = _model_with_control(
-            model, _collect_control(positive), inpaint=positive.get("inpaint")
+            model, _collect_control(positive), inpaint=positive.get("inpaint"),
+            i2v=positive.get("i2v"),
         )
         prediction = getattr(model_cfg, "prediction", "eps")
         out = run_sampler(
@@ -2080,6 +2277,7 @@ NODE_CLASS_MAPPINGS = {
     "TPULatentUpscale": TPULatentUpscale,
     "TPUEmptyVideoLatent": TPUEmptyVideoLatent,
     "TPUKSampler": TPUKSampler,
+    "TPUKSamplerAdvanced": TPUKSamplerAdvanced,
     "TPUVAEDecode": TPUVAEDecode,
     "TPUSaveImage": TPUSaveImage,
     "TPULoadImage": TPULoadImage,
@@ -2119,6 +2317,7 @@ NODE_DISPLAY_NAME_MAPPINGS = {
     "TPULatentUpscale": "Latent Upscale (TPU)",
     "TPUEmptyVideoLatent": "Empty Video Latent (TPU, WAN)",
     "TPUKSampler": "KSampler (TPU)",
+    "TPUKSamplerAdvanced": "KSampler Advanced (TPU)",
     "TPUVAEDecode": "VAE Decode (TPU)",
     "TPURandomNoise": "Random Noise (TPU)",
     "TPUKSamplerSelect": "KSampler Select (TPU)",

@@ -144,7 +144,7 @@ class CheckpointLoaderSimple:
                     "tokenizer_error": msg}
 
         try:
-            if family in ("sd15", "sd21", "sd21-v"):
+            if family in ("sd15", "sd21", "sd21-v", "sd21-unclip"):
                 open_clip = family.startswith("sd21")
                 cfg = None
                 if open_clip:
@@ -303,6 +303,212 @@ class DualCLIPLoader:
         )
 
 
+class CLIPLoader:
+    """Stock single-tower text-encoder loader: (clip_name, type) → CLIP.
+    The ``type`` menu names the model family the tower serves; the tower
+    architecture resolves from it (plus a t5-in-filename sniff for the
+    families whose templates ship either tower). Tokenizer tables come from
+    the PA_* env vars like the DualCLIPLoader shim. Host-provided builtin
+    (any_device_parallel.py:1473-1483)."""
+
+    DESCRIPTION = "Stock-name single text-encoder loader."
+    RETURN_TYPES = ("CLIP",)
+    RETURN_NAMES = ("clip",)
+    FUNCTION = "load"
+    CATEGORY = CATEGORY
+
+    # Stock type menu → tower architecture. Families needing two towers
+    # (flux/sdxl dual) still load their single named file here — stock wires
+    # two CLIPLoaders or one DualCLIPLoader interchangeably.
+    _TYPE_TOWER = {
+        "stable_diffusion": "clip-l",
+        "sdxl": "clip-l",
+        "sd3": "clip-l",
+        "flux": "clip-l",
+        "stable_cascade": "clip-l",
+        "wan": "umt5",
+        "ltxv": "t5",
+        "pixart": "t5",
+        "cosmos": "t5",
+        "lumina2": "t5",
+        "hunyuan_video": "clip-l",
+    }
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip_name": ("STRING", {"default": ""}),
+                "type": (sorted(cls._TYPE_TOWER),
+                         {"default": "stable_diffusion"}),
+            },
+            "optional": {
+                "device": (["default", "cpu"], {"default": "default"}),
+            },
+        }
+
+    def load(self, clip_name: str, type: str = "stable_diffusion",
+             device: str = "default"):
+        from .nodes import TPUCLIPLoader
+
+        tower = self._TYPE_TOWER.get(type)
+        if tower is None:
+            raise ValueError(
+                f"CLIPLoader type {type!r} is not supported — one of "
+                f"{sorted(self._TYPE_TOWER)}"
+            )
+        name = os.path.basename(clip_name).lower()
+        if "umt5" in name:
+            tower = "umt5"
+        elif "t5" in name:
+            tower = "t5" if tower not in ("umt5",) else tower
+        path = resolve_model_file(clip_name, "clip", "text_encoders")
+        kw = {}
+        if tower in ("t5", "umt5"):
+            tok_json = os.environ.get("PA_T5_TOKENIZER_JSON", "")
+            if not tok_json:
+                raise ValueError(
+                    f"CLIPLoader type={type!r} loads a T5-family tower and "
+                    "needs PA_T5_TOKENIZER_JSON (no vocab/merges form exists)"
+                )
+            kw["tokenizer_json"] = tok_json
+            # Stock T5 token budgets: WAN tokenizes umt5 at 512, the other
+            # t5-served families at 256 — the CLIP default of 77 would
+            # silently truncate typical video prompts.
+            kw["max_len"] = 512 if type == "wan" else 256
+        else:
+            tok_json = os.environ.get("PA_TOKENIZER_JSON", "")
+            if tok_json:
+                kw["tokenizer_json"] = tok_json
+            else:
+                kw["vocab_path"] = os.environ.get("PA_CLIP_VOCAB", "")
+                kw["merges_path"] = os.environ.get("PA_CLIP_MERGES", "")
+        (wire,) = TPUCLIPLoader().load(path, tower, **kw)
+        return (wire,)
+
+
+class VAELoader:
+    """Stock external-VAE loader: (vae_name) → VAE. Resolves through
+    $PA_MODELS_DIR/vae; the file's key layout picks the family — WAN's causal
+    3D video VAE (``encoder.downsamples``/``decoder.upsamples`` flat
+    Sequentials) vs the AutoencoderKL image families (sniffed by
+    sniff_vae_config: latent width, SDXL scaling). Host-provided builtin
+    (any_device_parallel.py:1473-1483)."""
+
+    DESCRIPTION = "Stock-name external VAE loader (image + WAN video layouts)."
+    RETURN_TYPES = ("VAE",)
+    RETURN_NAMES = ("vae",)
+    FUNCTION = "load"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"vae_name": ("STRING", {"default": ""})}}
+
+    def load(self, vae_name: str):
+        from .models.loader import (
+            load_vae_checkpoint,
+            load_wan_vae_checkpoint,
+            peek_safetensors,
+        )
+
+        path = resolve_model_file(vae_name, "vae")
+        if not os.path.isfile(path):
+            raise ValueError(
+                f"VAE file not found: {vae_name!r} (searched "
+                "$PA_MODELS_DIR/vae and the name as a path)"
+            )
+        keys = peek_safetensors(path)
+        if any("decoder.upsamples." in k for k in keys):
+            return (load_wan_vae_checkpoint(path),)
+        return (load_vae_checkpoint(path),)
+
+
+class UNETLoader:
+    """Stock diffusion-model-only loader (FLUX/WAN templates): (unet_name,
+    weight_dtype) → MODEL. Family is sniffed off the keys like
+    CheckpointLoaderSimple; ``weight_dtype`` is accepted for workflow
+    compatibility but ignored — the load path's dtype policy (bf16 compute,
+    fp8 upcast-on-load, mirroring the reference's fp8 handling at
+    any_device_parallel.py:93-124) already covers every menu entry."""
+
+    DESCRIPTION = "Stock-name bare diffusion-model loader (family sniffed)."
+    RETURN_TYPES = ("MODEL",)
+    RETURN_NAMES = ("model",)
+    FUNCTION = "load_unet"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "unet_name": ("STRING", {"default": ""}),
+                "weight_dtype": (
+                    ["default", "fp8_e4m3fn", "fp8_e4m3fn_fast", "fp8_e5m2"],
+                    {"default": "default"},
+                ),
+            }
+        }
+
+    def load_unet(self, unet_name: str, weight_dtype: str = "default"):
+        from .models.loader import peek_safetensors, sniff_model_family
+        from .nodes import TPUCheckpointLoader
+
+        path = resolve_model_file(
+            unet_name, "diffusion_models", "unet", "checkpoints"
+        )
+        family = sniff_model_family(peek_safetensors(path))
+        model, _ = TPUCheckpointLoader().load(
+            ckpt_path=path, family=family, load_vae=False
+        )
+        # Same source tag CheckpointLoaderSimple leaves: the LoraLoader shims
+        # re-bake from the original file.
+        object.__setattr__(model, "source", {"path": path, "family": family})
+        return (model,)
+
+
+class unCLIPConditioning:  # noqa: N801 — stock node name
+    """Stock unCLIP node: tags the conditioning with the CLIP image embeds +
+    noise-augmentation level; the sampler assembles the model's adm vector
+    from the tags (models/unet.unclip_adm — host SD21UNCLIP.encode_adm
+    semantics: q_sample augmentation, level embedding, strength weighting,
+    multi-tag merge). Chained nodes stack tags. Host-provided builtin
+    (any_device_parallel.py:1473-1483)."""
+
+    DESCRIPTION = "Stock-name unCLIP image conditioning (SD2.x-unCLIP)."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "apply_adm"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING", {}),
+                "clip_vision_output": ("CLIP_VISION_OUTPUT", {}),
+                "strength": ("FLOAT", {"default": 1.0, "min": -10.0,
+                                       "max": 10.0, "step": 0.01}),
+                "noise_augmentation": ("FLOAT", {"default": 0.0, "min": 0.0,
+                                                 "max": 1.0, "step": 0.01}),
+            }
+        }
+
+    def apply_adm(self, conditioning, clip_vision_output, strength: float,
+                  noise_augmentation: float):
+        tag = {
+            "embeds": clip_vision_output["image_embeds"],
+            "strength": float(strength),
+            "noise_augmentation": float(noise_augmentation),
+        }
+        return (
+            {
+                **conditioning,
+                "unclip": tuple(conditioning.get("unclip", ())) + (tag,),
+            },
+        )
+
+
 class LoraLoader:
     """Stock LoRA node: (MODEL, CLIP, lora_name, strengths) → patched
     (MODEL, CLIP). LoRA bakes into the checkpoint layout BEFORE conversion
@@ -414,6 +620,39 @@ class LoraLoader:
             if k not in rebuilt and k not in ("encoder", "tokenizer")
         }
         return {**rebuilt, **extra_state}
+
+
+class LoraLoaderModelOnly:
+    """Stock model-only LoRA link (the stock FLUX LoRA templates): same
+    re-bake-from-source semantics as LoraLoader (the reference's
+    bake-before-replicate order, any_device_parallel.py:971-1004) with no
+    CLIP wire — ``strength_clip`` is fixed at 0 so the text towers are
+    untouched."""
+
+    DESCRIPTION = "Stock-name model-only LoRA loader."
+    RETURN_TYPES = ("MODEL",)
+    RETURN_NAMES = ("model",)
+    FUNCTION = "load_lora_model_only"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL", {}),
+                "lora_name": ("STRING", {"default": ""}),
+                "strength_model": (
+                    "FLOAT", {"default": 1.0, "min": -4.0, "max": 4.0}
+                ),
+            }
+        }
+
+    def load_lora_model_only(self, model, lora_name: str,
+                             strength_model: float = 1.0):
+        patched, _ = LoraLoader().load_lora(
+            model, None, lora_name, strength_model, strength_clip=0.0
+        )
+        return (patched,)
 
 
 class CLIPSetLastLayer:
@@ -662,6 +901,103 @@ class CLIPVisionEncode:
         },)
 
 
+class WanImageToVideo:
+    """Stock WAN i2v entry node: allocates the empty video latent and tags
+    BOTH conditionings with the i2v conditioning the sampler composes into
+    the model (nodes._model_with_control → models.wan.apply_i2v_conditioning):
+    a 4-channel latent frame mask ‖ the VAE-encoded start frames
+    (channel-concat, the WAN2.2 contract) plus, when ``clip_vision_output``
+    is wired, the CLIP-vision penultimate states for WAN2.1-style
+    checkpoints' img_emb branch. The stock node's
+    concat_latent_image/concat_mask/clip_vision_output conditioning keys
+    collapse into the single ``i2v`` tag here. Host-provided builtin
+    (any_device_parallel.py:1473-1483 registers only the pack's own nodes)."""
+
+    DESCRIPTION = "Stock-name WAN image→video conditioning + empty latent."
+    RETURN_TYPES = ("CONDITIONING", "CONDITIONING", "LATENT")
+    RETURN_NAMES = ("positive", "negative", "latent")
+    FUNCTION = "encode"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "positive": ("CONDITIONING", {}),
+                "negative": ("CONDITIONING", {}),
+                "vae": ("VAE", {}),
+                "width": ("INT", {"default": 832, "min": 16, "max": 8192,
+                                  "step": 16}),
+                "height": ("INT", {"default": 480, "min": 16, "max": 8192,
+                                   "step": 16}),
+                "length": ("INT", {"default": 81, "min": 1, "max": 1024,
+                                   "step": 4}),
+                "batch_size": ("INT", {"default": 1, "min": 1, "max": 16}),
+            },
+            "optional": {
+                "clip_vision_output": ("CLIP_VISION_OUTPUT", {}),
+                "start_image": ("IMAGE", {}),
+            },
+        }
+
+    def encode(self, positive, negative, vae, width: int, height: int,
+               length: int, batch_size: int, start_image=None,
+               clip_vision_output=None):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .models.vae import images_to_vae_input
+
+        t_lat = vae.cfg.latent_frames(length)  # validates the 4k+1 schedule
+        f = vae.spatial_factor
+        zc = vae.cfg.z_channels
+        latent = {
+            "samples": jnp.zeros(
+                (batch_size, t_lat, height // f, width // f, zc)
+            )
+        }
+        tag: dict = {}
+        if start_image is not None:
+            img = jnp.asarray(start_image)
+            if img.ndim == 3:
+                img = img[None]
+            F = min(img.shape[0], length)
+            img = img[:F]
+            if img.shape[1:3] != (height, width):
+                img = jax.image.resize(
+                    img, (F, height, width, img.shape[-1]), method="bilinear"
+                )
+            clip = jnp.concatenate(
+                [
+                    images_to_vae_input(img)[None],  # frames of ONE clip
+                    jnp.zeros((1, length - F, height, width, img.shape[-1])),
+                ],
+                axis=1,
+            )
+            cond_latent = vae.encode(clip)
+            h, w = cond_latent.shape[2], cond_latent.shape[3]
+            # Frame mask: channel c of latent frame j marks the pixel frame it
+            # folds — frame 0 fills all 4 channels of latent frame 0 (the
+            # causal VAE's lone first frame, repeated like stock's msk
+            # repeat), latent frame j≥1 channel c folds pixel 4(j-1)+1+c.
+            mask = np.zeros((1, t_lat, h, w, 4), np.float32)
+            for j in range(t_lat):
+                for c in range(4):
+                    pix = 0 if j == 0 else 4 * (j - 1) + 1 + c
+                    if pix < F:
+                        mask[:, j, :, :, c] = 1.0
+            tag["cond"] = jnp.concatenate(
+                [jnp.asarray(mask), cond_latent], axis=-1
+            )
+        if clip_vision_output is not None:
+            tag["clip_fea"] = clip_vision_output["penultimate"]
+        if tag:
+            positive = {**positive, "i2v": tag}
+            negative = {**negative, "i2v": tag}
+        return positive, negative, latent
+
+
 class ControlNetLoader:
     """Stock loader: control_net_name resolves via $PA_MODELS_DIR/controlnet."""
 
@@ -822,7 +1158,11 @@ class ImageCompositeMasked:
         else:
             from .models.vae import normalize_mask
 
-            m_full = normalize_mask(mask, src.shape[1:3])
+            # Cycle the mask batch to the destination batch like stock's
+            # repeat_to_batch_size treatment of source/mask — a mask batch
+            # matching neither 1 nor B must not surface as an XLA broadcast
+            # error.
+            m_full = _repeat_to_batch(normalize_mask(mask, src.shape[1:3]), B)
         # Clip the paste window to the destination bounds.
         h = min(src.shape[1], H - y)
         w = min(src.shape[2], W - x)
@@ -1490,7 +1830,12 @@ def stock_node_mappings() -> dict[str, type]:
     mappings = {
         "CheckpointLoaderSimple": CheckpointLoaderSimple,
         "DualCLIPLoader": DualCLIPLoader,
+        "CLIPLoader": CLIPLoader,
+        "VAELoader": VAELoader,
+        "UNETLoader": UNETLoader,
+        "unCLIPConditioning": unCLIPConditioning,
         "LoraLoader": LoraLoader,
+        "LoraLoaderModelOnly": LoraLoaderModelOnly,
         "CLIPSetLastLayer": CLIPSetLastLayer,
         "LoadImage": LoadImage,
         "LatentUpscale": LatentUpscale,
@@ -1502,6 +1847,9 @@ def stock_node_mappings() -> dict[str, type]:
         "EmptySD3LatentImage": _EmptyLatent16ch,
         "KSampler": _renamed(
             n.TPUKSampler, {"latent_image": "latent"}, name="KSampler"
+        ),
+        "KSamplerAdvanced": _renamed(
+            n.TPUKSamplerAdvanced, {}, name="KSamplerAdvanced"
         ),
         "VAEDecode": _renamed(
             n.TPUVAEDecode, {"samples": "latent"}, name="VAEDecode"
@@ -1529,6 +1877,7 @@ def stock_node_mappings() -> dict[str, type]:
         "ControlNetApplyAdvanced": ControlNetApplyAdvanced,
         "CLIPVisionLoader": CLIPVisionLoader,
         "CLIPVisionEncode": CLIPVisionEncode,
+        "WanImageToVideo": WanImageToVideo,
         "UpscaleModelLoader": UpscaleModelLoader,
         "ImageUpscaleWithModel": _renamed(
             n.TPUImageUpscaleWithModel, {}, name="ImageUpscaleWithModel"

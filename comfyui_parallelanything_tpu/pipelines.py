@@ -358,6 +358,7 @@ class WanVideoPipeline:
         denoise: float = 1.0,
         image: jnp.ndarray | None = None,
         mask: jnp.ndarray | None = None,
+        clip_vision_output: Any | None = None,
         compile_loop: bool = False,
     ) -> jnp.ndarray:
         """Returns float video (B, frames, height, width, 3) in [0, 1]. WAN uses
@@ -367,8 +368,11 @@ class WanVideoPipeline:
         [0, 1]) with ``denoise < 1`` — same truncated-schedule semantics as the
         image pipelines. image→video: pass ``image`` (B or 1, height, width, 3
         in [0, 1]) — WAN2.2-style channel-concat conditioning (the i2v DiT's
-        extra in-channels carry a frame mask + the encoded first frame; no
-        CLIP-vision branch, which WAN2.2 dropped). Video inpainting: ``mask``
+        extra in-channels carry a frame mask + the encoded first frame).
+        WAN2.1-style i2v checkpoints (config ``img_dim`` set) additionally
+        take ``clip_vision_output`` (a CLIP_VISION_OUTPUT dict or a raw
+        (B|1, 257, img_dim) penultimate-states array) routed through the
+        model's img_emb branch. Video inpainting: ``mask``
         (B or 1, frames, height, width[, 1]; 1 = regenerate) with
         ``init_video`` re-pins keep regions per step at any denoise."""
         prompts = [prompt] if isinstance(prompt, str) else list(prompt)
@@ -427,7 +431,13 @@ class WanVideoPipeline:
         )
         if image is not None:
             denoiser = self._i2v_conditioned(
-                denoiser, image, B, frames, height, width, t_lat, zc
+                denoiser, image, B, frames, height, width, t_lat, zc,
+                clip_vision_output=clip_vision_output,
+            )
+        elif clip_vision_output is not None:
+            raise ValueError(
+                "clip_vision_output without `image` — the CLIP branch rides "
+                "the i2v conditioning; pass the start image too"
             )
         latents = run_sampler(
             denoiser,
@@ -451,7 +461,8 @@ class WanVideoPipeline:
         return _to_images(decode_maybe_tiled(self.vae, latents, decode_tile))
 
     def _i2v_conditioned(
-        self, denoiser, image, B, frames, height, width, t_lat, zc
+        self, denoiser, image, B, frames, height, width, t_lat, zc,
+        clip_vision_output=None,
     ):
         """Wrap ``denoiser`` with WAN i2v channel-concat conditioning: the DiT's
         extra in-channels carry [frame mask (4ch) ‖ encoded first-frame latent]
@@ -491,12 +502,35 @@ class WanVideoPipeline:
         mask = jnp.zeros((B, t_lat, h, w, 4)).at[:, 0].set(1.0)
         cond = jnp.concatenate([mask, cond_latent], axis=-1)
 
+        clip_fea = None
+        if clip_vision_output is not None:
+            if getattr(cfg, "img_dim", None) is None:
+                raise ValueError(
+                    "clip_vision_output needs a WAN2.1-style i2v checkpoint "
+                    "with the img_emb branch (config img_dim) — this model "
+                    "has none (WAN2.2 i2v conditions by channel-concat only); "
+                    "drop clip_vision_output"
+                )
+            clip_fea = (
+                clip_vision_output["penultimate"]
+                if isinstance(clip_vision_output, dict)
+                else jnp.asarray(clip_vision_output)
+            )
+            if clip_fea.shape[0] == 1 and B > 1:
+                clip_fea = jnp.repeat(clip_fea, B, axis=0)
+
         def conditioned(x, t, context=None, **kw):
             c = cond
+            fea = clip_fea
             if x.shape[0] != c.shape[0]:
                 # CFG doubles the batch (cond ‖ uncond in one forward) — the
                 # conditioning rides along for both halves.
-                c = jnp.tile(c, (x.shape[0] // c.shape[0], 1, 1, 1, 1))
+                reps = x.shape[0] // c.shape[0]
+                c = jnp.tile(c, (reps, 1, 1, 1, 1))
+                if fea is not None:
+                    fea = jnp.tile(fea, (reps, 1, 1))
+            if fea is not None:
+                kw = {**kw, "clip_fea": fea}
             return denoiser(jnp.concatenate([x, c], axis=-1), t, context, **kw)
 
         return conditioned

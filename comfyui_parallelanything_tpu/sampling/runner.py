@@ -176,7 +176,9 @@ def run_sampler(
         from ..utils.progress import report_progress
 
         def cb2(i, x):
-            report_progress(i + 1, n_steps)  # raises Interrupted if requested
+            # Raises Interrupted if requested; x feeds the WS latent-preview
+            # hook (utils/progress.set_preview_hook) when one is installed.
+            report_progress(i + 1, n_steps, latent=x)
             if cb is not None:
                 return cb(i, x)
             return None

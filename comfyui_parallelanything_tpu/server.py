@@ -34,7 +34,10 @@ Endpoints (the ComfyUI client-protocol subset that makes scripts work):
                               ``execution_interrupted`` on Cancel, and the
                               canonical completion signal API clients wait
                               for — ``executing`` with ``node: null`` and the
-                              ``prompt_id``.
+                              ``prompt_id``. Opt-in (``extra_data.preview``
+                              on POST /prompt): per-step latent previews as
+                              stock binary frames (>II event-type 1 + format
+                              2 (PNG) + PNG bytes; utils/latent_preview.py).
 
 Run:  ``python -m comfyui_parallelanything_tpu.server [--port 8188]``
 """
@@ -58,6 +61,7 @@ from .utils.progress import (
     Interrupted,
     clear_interrupt,
     request_interrupt,
+    set_preview_hook,
     set_progress_hook,
 )
 
@@ -208,6 +212,16 @@ class PromptQueue:
             if not listener.send(frame):
                 self.remove_listener(sock)
 
+    def _emit_binary(self, payload: bytes) -> None:
+        """Queue one binary event (the stock preview-frame channel: a 4-byte
+        big-endian event type + event payload, sent as a binary WS frame)."""
+        frame = _ws_frame(payload, opcode=0x2)
+        with self._lock:
+            listeners = list(self._listeners.items())
+        for sock, listener in listeners:
+            if not listener.send(frame):
+                self.remove_listener(sock)
+
     def _emit_status(self) -> None:
         with self._lock:
             remaining = len(self.pending_ids)
@@ -216,7 +230,7 @@ class PromptQueue:
             "data": {"status": {"exec_info": {"queue_remaining": remaining}}},
         })
 
-    def submit(self, prompt: dict) -> tuple[str, int]:
+    def submit(self, prompt: dict, preview: bool = False) -> tuple[str, int]:
         pid = uuid.uuid4().hex
         # Bookkeeping AND enqueue under one lock: interrupt() drains under the
         # same lock, so a submit racing an interrupt either lands wholly
@@ -226,7 +240,7 @@ class PromptQueue:
             self.counter += 1
             number = self.counter
             self.pending_ids.append(pid)
-            self.pending.put((pid, prompt))
+            self.pending.put((pid, prompt, bool(preview)))
         self._emit_status()
         return pid, number
 
@@ -282,7 +296,7 @@ class PromptQueue:
             item = self.pending.get()
             if item is None:
                 return
-            pid, prompt = item
+            pid, prompt, preview = item
             with self._lock:
                 if pid not in self.pending_ids:
                     continue  # interrupted while queued
@@ -319,7 +333,23 @@ class PromptQueue:
                     "data": {"nodes": list(nids), "prompt_id": _pid},
                 })
 
+            def preview_hook(latent):
+                # Stock preview frame: >II event-type 1 (PREVIEW_IMAGE) +
+                # image format 2 (PNG), then the PNG bytes. Never let a
+                # preview failure (odd latent rank, PIL hiccup) kill the
+                # prompt — previews are best-effort by contract.
+                import struct
+
+                try:
+                    from .utils.latent_preview import preview_png
+
+                    png = preview_png(latent)
+                except Exception:  # noqa: BLE001 — preview is best-effort
+                    return
+                self._emit_binary(struct.pack(">II", 1, 2) + png)
+
             prev_hook = set_progress_hook(hook)
+            prev_preview = set_preview_hook(preview_hook if preview else None)
             try:
                 results = run_workflow(
                     prompt, class_mappings=self.class_mappings,
@@ -355,6 +385,7 @@ class PromptQueue:
                 }
             finally:
                 set_progress_hook(prev_hook)
+                set_preview_hook(prev_preview)
             with self._lock:
                 self.history[pid] = entry
                 self.pending_ids.remove(pid)
@@ -540,7 +571,11 @@ class _Handler(BaseHTTPRequestHandler):
                     )
             except (ValueError, json.JSONDecodeError) as e:
                 return self._send(400, {"error": f"bad JSON: {e}"})
-            pid, number = self.q.submit(prompt)
+            preview = bool(
+                (payload.get("extra_data") or {}).get("preview")
+                or payload.get("preview")
+            )
+            pid, number = self.q.submit(prompt, preview=preview)
             return self._send(200, {"prompt_id": pid, "number": number})
         return self._send(404, {"error": f"no route {url.path}"})
 

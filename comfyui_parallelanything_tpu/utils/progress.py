@@ -21,6 +21,7 @@ import threading
 from typing import Callable, Optional
 
 _hook: Optional[Callable[[int, int], None]] = None
+_preview_hook: Optional[Callable[[object], None]] = None
 _interrupt = threading.Event()
 
 
@@ -34,6 +35,17 @@ def set_progress_hook(fn: Optional[Callable[[int, int], None]]):
     hook (restore it when the scope ends)."""
     global _hook
     prev, _hook = _hook, fn
+    return prev
+
+
+def set_preview_hook(fn: Optional[Callable[[object], None]]):
+    """Install ``fn(latent)`` to receive the CURRENT latent once per eager
+    sampler step (the WS latent-preview source; None latent steps — e.g.
+    samplers that only report counters — are skipped). Returns the previous
+    hook. Like the progress hook this is a process-wide single slot; the
+    compiled whole-loop path has no step boundaries and emits no previews."""
+    global _preview_hook
+    prev, _preview_hook = _preview_hook, fn
     return prev
 
 
@@ -64,9 +76,12 @@ def check_interrupt(where: str = "between nodes") -> None:
         raise Interrupted(f"interrupted {where}")
 
 
-def report_progress(value: int, max_value: int) -> None:
-    """One sampler step completed: notify the hook, then honor a pending
+def report_progress(value: int, max_value: int, latent=None) -> None:
+    """One sampler step completed: notify the hook (and the preview hook with
+    the current latent, when both are present), then honor a pending
     interrupt."""
     if _hook is not None:
         _hook(value, max_value)
+    if _preview_hook is not None and latent is not None:
+        _preview_hook(latent)
     check_interrupt(f"at step {value}/{max_value}")

@@ -609,6 +609,87 @@ class TestShippedExampleWorkflow:
         assert len(saved) == 1 and os.path.exists(saved[0])
 
 
+    def test_example_inpaint_outpaint_executes(self, cpu_devices, tmp_path,
+                                               monkeypatch):
+        import os
+
+        from PIL import Image
+
+        paths, factor = self._synthetic_env(tmp_path, monkeypatch)
+        src = tmp_path / "input.png"
+        Image.fromarray(
+            (np.random.default_rng(0).uniform(0, 1, (16, 16, 3)) * 255).astype(
+                np.uint8
+            )
+        ).save(src)
+        wf = self._rewrite_common(
+            json.load(open("examples/workflow_sd15_inpaint_outpaint.json")),
+            paths,
+        )
+        wf["source"]["inputs"]["image_path"] = str(src)
+        # Tiny-scale the outpaint extension to the synthetic world.
+        wf["outpaint_pad"]["inputs"].update(left=8, right=8, feathering=4)
+        wf["save"]["inputs"]["output_dir"] = str(tmp_path / "out")
+
+        out = run_workflow(wf)
+        images = out["paste_back"][0]
+        # 16px source + 8px pad each side; decode returns the padded frame.
+        assert images.shape == (1, 16, 32, 3)
+        assert np.isfinite(np.asarray(images)).all()
+        # The source interior survives the paste-back (mask is 0 there away
+        # from the feather band).
+        src_px = np.asarray(Image.open(src), np.float32)[None] / 255.0
+        np.testing.assert_allclose(
+            np.asarray(images[:, 4:12, 14:18, :]),
+            src_px[:, 4:12, 6:10, :], atol=0.35,
+        )
+        saved = out["save"][0]
+        assert len(saved) == 1 and os.path.exists(saved[0])
+
+    def test_example_hiresfix_executes(self, cpu_devices, tmp_path,
+                                       monkeypatch):
+        import os
+
+        import jax
+        from safetensors.numpy import save_file
+
+        from comfyui_parallelanything_tpu.models.upscale import (
+            UpscaleConfig,
+            build_upscaler,
+        )
+        from tests.test_upscale import _modern_sd
+
+        import jax.numpy as jnp
+
+        paths, factor = self._synthetic_env(tmp_path, monkeypatch)
+        ucfg = UpscaleConfig(nf=8, nb=1, gc=4, scale=4, dtype=jnp.float32)
+        up = build_upscaler(ucfg, jax.random.key(7))
+        up_path = tmp_path / "esrgan_tiny.safetensors"
+        save_file(
+            {k: np.ascontiguousarray(v)
+             for k, v in _modern_sd(ucfg, up.params).items()},
+            str(up_path),
+        )
+        wf = self._rewrite_common(
+            json.load(open("examples/workflow_sd15_hiresfix.json")), paths
+        )
+        wf["latent"]["inputs"].update(width=32, height=32, batch_size=1)
+        wf["hires_pass"]["inputs"]["steps"] = 2
+        wf["esrgan"]["inputs"]["ckpt_path"] = str(up_path)
+        wf["final_upscale"]["inputs"]["tile"] = 0
+        wf["save"]["inputs"]["output_dir"] = str(tmp_path / "out")
+
+        out = run_workflow(wf)
+        hw = 32 // 8 * factor  # base latent grid through the tiny VAE
+        base = out["decode"][0]
+        assert base.shape == (1, 2 * hw, 2 * hw, 3)  # latent-upscaled 2x
+        final = out["final_upscale"][0]
+        assert final.shape == (1, 8 * hw, 8 * hw, 3)  # ESRGAN x4 on top
+        assert np.isfinite(np.asarray(final)).all()
+        saved = out["save"][0]
+        assert len(saved) == 1 and os.path.exists(saved[0])
+
+
 class TestEndToEndGraph:
     def test_full_sampling_workflow(self, cpu_devices):
         # The reference's whole value proposition as one JSON file: build a

@@ -222,6 +222,16 @@ class TestCompositeAndVideoSave:
             jnp.zeros((3, 8, 8, 3)), jnp.ones((2, 4, 4, 3)), 0, 0
         )
         assert np.asarray(out4).shape[0] == 3
+        # A batched mask matching neither 1 nor the destination batch cycles
+        # too (stock repeat_to_batch_size), instead of an XLA broadcast error.
+        mask2 = jnp.stack([jnp.ones((4, 4)), jnp.zeros((4, 4))])
+        (out5,) = ImageCompositeMasked().composite(
+            jnp.zeros((3, 8, 8, 3)), jnp.ones((3, 4, 4, 3)), 0, 0, mask=mask2
+        )
+        o5 = np.asarray(out5)
+        # Cycled mask: batch 0 on, batch 1 off, batch 2 on (cycle restart).
+        assert o5[0, 0, 0, 0] == 1.0 and o5[1, 0, 0, 0] == 0.0
+        assert o5[2, 0, 0, 0] == 1.0
 
     def test_latent_composite(self):
         from comfyui_parallelanything_tpu.nodes_compat import LatentComposite

@@ -141,8 +141,10 @@ class TestServer:
         stats = _get(base, "/system_stats")
         assert isinstance(stats["devices"], list) and stats["devices"]
 
-    def _ws_connect(self, base):
-        """Open /ws; returns (sock, read_event) — RFC 6455 client handshake."""
+    def _ws_connect(self, base, raw=False):
+        """Open /ws; returns (sock, read_event) — RFC 6455 client handshake.
+        ``raw=True`` returns frames as (opcode, payload bytes) instead of
+        parsed JSON (binary preview frames are not JSON)."""
         import base64 as b64
         import socket
         import struct
@@ -161,14 +163,21 @@ class TestServer:
         while f.readline() not in (b"\r\n", b""):
             pass
 
-        def read_event():
+        def read_frame():
             hdr = f.read(2)
             n = hdr[1] & 0x7F
             if n == 126:
                 n = struct.unpack(">H", f.read(2))[0]
-            return json.loads(f.read(n))
+            elif n == 127:
+                n = struct.unpack(">Q", f.read(8))[0]
+            return hdr[0] & 0x0F, f.read(n)
 
-        return sock, read_event
+        def read_event():
+            opcode, payload = read_frame()
+            assert opcode == 0x1, f"expected text frame, got opcode {opcode}"
+            return json.loads(payload)
+
+        return sock, (read_frame if raw else read_event)
 
     def test_websocket_node_and_progress_events(self, server, tmp_path,
                                                 monkeypatch):
@@ -306,3 +315,82 @@ class TestServer:
             raise AssertionError(f"no completion event; saw {seen}")
         assert "status" in seen  # queue-change event arrived too
         sock.close()
+
+
+class TestLatentPreviews:
+    def test_opt_in_preview_frames_arrive_mid_sampling(self, server, tmp_path,
+                                                       monkeypatch):
+        """extra_data.preview=true → per-step binary WS frames in the stock
+        layout (>II event-type 1 PREVIEW_IMAGE + format 2 PNG + PNG bytes),
+        decodable and latent-grid-sized; without the flag, zero binary frames
+        (previews are opt-in — VERDICT r4 next-7)."""
+        import io
+        import struct
+
+        from PIL import Image
+
+        base, _, out_dir = server
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        wf = _stock_graph(paths["ckpt"], out_dir)
+
+        sock, read_frame = TestServer()._ws_connect(base, raw=True)
+        pid = _post(
+            base, "/prompt", {"prompt": wf, "extra_data": {"preview": True}}
+        )["prompt_id"]
+        previews, done = [], False
+        for _ in range(300):
+            opcode, payload = read_frame()
+            if opcode == 0x2:
+                previews.append(payload)
+                continue
+            evt = json.loads(payload)
+            if (evt["type"] == "executing"
+                    and evt["data"].get("node") is None
+                    and evt["data"].get("prompt_id") == pid):
+                done = True
+                break
+        sock.close()
+        assert done and len(previews) == 2  # one per sampler step
+        etype, fmt = struct.unpack(">II", previews[0][:8])
+        assert (etype, fmt) == (1, 2)  # PREVIEW_IMAGE, PNG
+        img = Image.open(io.BytesIO(previews[0][8:]))
+        # 32px request / 8 (EmptyLatentImage grid) = 4px latent, upscaled by
+        # an integer factor; mode RGB.
+        assert img.mode == "RGB"
+        assert img.size[0] == img.size[1] and img.size[0] % 4 == 0
+
+        # Default run: no binary frames.
+        sock, read_frame = TestServer()._ws_connect(base, raw=True)
+        pid2 = _post(base, "/prompt", {"prompt": {
+            **json.loads(json.dumps(wf)),
+            "3": {**wf["3"], "inputs": {**wf["3"]["inputs"], "seed": 5}},
+        }})["prompt_id"]
+        binaries = 0
+        for _ in range(300):
+            opcode, payload = read_frame()
+            if opcode == 0x2:
+                binaries += 1
+                continue
+            evt = json.loads(payload)
+            if (evt["type"] == "executing"
+                    and evt["data"].get("node") is None
+                    and evt["data"].get("prompt_id") == pid2):
+                break
+        sock.close()
+        assert binaries == 0
+
+    def test_latent_to_rgb_shapes(self):
+        import numpy as np
+
+        from comfyui_parallelanything_tpu.utils.latent_preview import (
+            latent_to_rgb,
+            preview_png,
+        )
+
+        for shape in [(2, 8, 6, 4), (1, 8, 6, 16), (1, 8, 6, 5),
+                      (1, 3, 8, 6, 4)]:
+            rgb = latent_to_rgb(np.random.default_rng(0).normal(size=shape))
+            assert rgb.shape == (8, 6, 3)
+            assert rgb.min() >= 0.0 and rgb.max() <= 1.0
+        png = preview_png(np.zeros((1, 4, 4, 4), np.float32))
+        assert png[:4] == b"\x89PNG"

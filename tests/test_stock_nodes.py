@@ -521,3 +521,689 @@ class TestStockWorkflow:
             images=np.zeros((1, 8, 8, 3), np.float32), filename_prefix="x"
         )
         assert all(p.startswith(str(tmp_path / "served")) for p in paths)
+
+
+class TestKSamplerAdvanced:
+    """Stock KSamplerAdvanced semantics: step-window runs, leftover noise,
+    add_noise-disabled continuation (the SDXL base→refiner template driver)."""
+
+    def _toy(self):
+        # Deterministic eps-style toy model (no params): enough for exact
+        # split-vs-full trajectory equality under euler.
+        return lambda x, t, context=None, **kw: x * 0.05
+
+    def _conds(self):
+        import jax.numpy as jnp
+
+        return ({"context": jnp.zeros((1, 3, 5))},
+                {"context": jnp.zeros((1, 3, 5))})
+
+    def test_split_run_matches_full_window(self):
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.nodes import TPUKSamplerAdvanced
+
+        pos, neg = self._conds()
+        lat = {"samples": jnp.zeros((1, 8, 8, 4))}
+        node = TPUKSamplerAdvanced()
+        kw = dict(noise_seed=3, steps=4, cfg=1.0, sampler_name="euler",
+                  scheduler="normal", positive=pos, negative=neg)
+        (full,) = node.sample(
+            self._toy(), add_noise="enable", latent_image=lat,
+            start_at_step=0, end_at_step=10000,
+            return_with_leftover_noise="disable", **kw,
+        )
+        (base,) = node.sample(
+            self._toy(), add_noise="enable", latent_image=lat,
+            start_at_step=0, end_at_step=2,
+            return_with_leftover_noise="enable", **kw,
+        )
+        (cont,) = node.sample(
+            self._toy(), add_noise="disable", latent_image=base,
+            start_at_step=2, end_at_step=10000,
+            return_with_leftover_noise="disable", **kw,
+        )
+        np.testing.assert_allclose(
+            np.asarray(cont["samples"]), np.asarray(full["samples"]),
+            rtol=1e-5, atol=1e-6,
+        )
+        # The base half still carries noise (sigma[2] > 0): it must differ
+        # from the fully-denoised run.
+        assert not np.allclose(
+            np.asarray(base["samples"]), np.asarray(full["samples"])
+        )
+
+    def test_force_full_denoise_on_short_window(self):
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.nodes import TPUKSamplerAdvanced
+
+        pos, neg = self._conds()
+        lat = {"samples": jnp.zeros((1, 8, 8, 4))}
+        node = TPUKSamplerAdvanced()
+        kw = dict(noise_seed=3, steps=4, cfg=1.0, sampler_name="euler",
+                  scheduler="normal", positive=pos, negative=neg,
+                  add_noise="enable", latent_image=lat, start_at_step=0,
+                  end_at_step=2)
+        (leftover,) = node.sample(
+            self._toy(), return_with_leftover_noise="enable", **kw
+        )
+        (forced,) = node.sample(
+            self._toy(), return_with_leftover_noise="disable", **kw
+        )
+        assert not np.allclose(
+            np.asarray(leftover["samples"]), np.asarray(forced["samples"])
+        )
+
+    def test_empty_window_returns_latent(self):
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.nodes import TPUKSamplerAdvanced
+
+        pos, neg = self._conds()
+        lat = {"samples": jnp.ones((1, 8, 8, 4))}
+        (out,) = TPUKSamplerAdvanced().sample(
+            self._toy(), add_noise="enable", noise_seed=0, steps=4, cfg=1.0,
+            sampler_name="euler", scheduler="normal", positive=pos,
+            negative=neg, latent_image=lat, start_at_step=3, end_at_step=3,
+            return_with_leftover_noise="disable",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["samples"]), np.asarray(lat["samples"])
+        )
+
+    def test_base_refiner_template_runs_unchanged(self, tmp_path, monkeypatch):
+        """The stock SDXL base→refiner API export shape — two checkpoint
+        loaders, four text encodes, chained KSamplerAdvanced — runs as-is
+        (the tiny sd15 synthetic checkpoint stands in for both stages; the
+        node surface and window semantics are family-independent)."""
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        monkeypatch.setenv("PA_OUTPUT_DIR", str(tmp_path / "out"))
+        wf = {
+            "4": {"class_type": "CheckpointLoaderSimple",
+                  "inputs": {"ckpt_name": paths["ckpt"]}},
+            "12": {"class_type": "CheckpointLoaderSimple",
+                   "inputs": {"ckpt_name": paths["ckpt"]}},
+            "5": {"class_type": "EmptyLatentImage",
+                  "inputs": {"width": 32, "height": 32, "batch_size": 1}},
+            "6": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "a watercolor lighthouse", "clip": ["4", 1]}},
+            "7": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "blurry", "clip": ["4", 1]}},
+            "15": {"class_type": "CLIPTextEncode",
+                   "inputs": {"text": "a watercolor lighthouse",
+                              "clip": ["12", 1]}},
+            "16": {"class_type": "CLIPTextEncode",
+                   "inputs": {"text": "blurry", "clip": ["12", 1]}},
+            "10": {"class_type": "KSamplerAdvanced",
+                   "inputs": {"add_noise": "enable", "noise_seed": 721897,
+                              "steps": 4, "cfg": 2.0,
+                              "sampler_name": "euler", "scheduler": "normal",
+                              "start_at_step": 0, "end_at_step": 2,
+                              "return_with_leftover_noise": "enable",
+                              "model": ["4", 0], "positive": ["6", 0],
+                              "negative": ["7", 0], "latent_image": ["5", 0]}},
+            "11": {"class_type": "KSamplerAdvanced",
+                   "inputs": {"add_noise": "disable", "noise_seed": 0,
+                              "steps": 4, "cfg": 2.0,
+                              "sampler_name": "euler", "scheduler": "normal",
+                              "start_at_step": 2, "end_at_step": 10000,
+                              "return_with_leftover_noise": "disable",
+                              "model": ["12", 0], "positive": ["15", 0],
+                              "negative": ["16", 0],
+                              "latent_image": ["10", 0]}},
+            "8": {"class_type": "VAEDecode",
+                  "inputs": {"samples": ["11", 0], "vae": ["12", 2]}},
+            "9": {"class_type": "SaveImage",
+                  "inputs": {"images": ["8", 0], "filename_prefix": "refined",
+                             "output_dir": str(tmp_path / "out")}},
+        }
+        out = run_workflow(wf)
+        assert np.isfinite(np.asarray(out["8"][0])).all()
+        assert all(os.path.exists(p) for p in out["9"][0])
+
+
+class TestNewStockLoaders:
+    def test_unet_loader_bare_diffusion_file(self, tmp_path, monkeypatch):
+        from safetensors.numpy import save_file
+
+        from comfyui_parallelanything_tpu.models import load_safetensors
+        from comfyui_parallelanything_tpu.nodes_compat import UNETLoader
+
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        sd = load_safetensors(paths["ckpt"])
+        bare = {
+            k.removeprefix("model.diffusion_model."): np.ascontiguousarray(v)
+            for k, v in sd.items()
+            if k.startswith("model.diffusion_model.")
+        }
+        unet_path = tmp_path / "unet_only.safetensors"
+        save_file(bare, str(unet_path))
+        (model,) = UNETLoader().load_unet(str(unet_path))
+        assert model.source["family"] == "sd15"
+        assert hasattr(model, "apply") and hasattr(model, "params")
+
+    def test_lora_loader_model_only(self, tmp_path, monkeypatch):
+        import jax
+        from safetensors.numpy import save_file
+
+        from comfyui_parallelanything_tpu.models import load_safetensors
+        from comfyui_parallelanything_tpu.nodes import NODE_CLASS_MAPPINGS
+
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        model, clip, _ = (
+            NODE_CLASS_MAPPINGS["CheckpointLoaderSimple"]().load(paths["ckpt"])
+        )
+        sd = load_safetensors(paths["ckpt"])
+        target = next(
+            k for k in sd
+            if k.endswith("attn1.to_q.weight") and "input_blocks" in k
+        ).removeprefix("model.diffusion_model.")
+        out_d, in_d = sd[f"model.diffusion_model.{target}"].shape
+        rng = np.random.default_rng(6)
+        lora_path = tmp_path / "style.safetensors"
+        save_file({
+            f"{target.removesuffix('.weight')}.lora_down.weight":
+                rng.standard_normal((2, in_d)).astype(np.float32),
+            f"{target.removesuffix('.weight')}.lora_up.weight":
+                rng.standard_normal((out_d, 2)).astype(np.float32),
+        }, str(lora_path))
+        node = NODE_CLASS_MAPPINGS["LoraLoaderModelOnly"]()
+        (patched,) = node.load_lora_model_only(model, str(lora_path), 1.0)
+
+        def flat(m):
+            return np.concatenate(
+                [np.ravel(v) for v in jax.tree.leaves(m.params)]
+            )
+
+        assert not np.allclose(flat(patched), flat(model))
+
+    def test_vae_loader_image_layout(self, tmp_path, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+        from safetensors.numpy import save_file
+
+        from comfyui_parallelanything_tpu.nodes_compat import VAELoader
+        from tests.test_vae import TINY as TINY_VAE, _ldm_layout_sd
+        from comfyui_parallelanything_tpu.models import build_vae
+
+        vae = build_vae(TINY_VAE, jax.random.key(1), sample_hw=16)
+        vae_path = tmp_path / "ext_vae.safetensors"
+        save_file(
+            {k: np.ascontiguousarray(v)
+             for k, v in _ldm_layout_sd(TINY_VAE, vae.params).items()},
+            str(vae_path),
+        )
+        # The tiny config must be what sniffing resolves: pin it.
+        import comfyui_parallelanything_tpu.models as models_pkg
+
+        monkeypatch.setattr(models_pkg, "sd_vae_config", lambda: TINY_VAE)
+        import comfyui_parallelanything_tpu.models.loader as loader_mod
+
+        monkeypatch.setattr(
+            loader_mod, "sniff_vae_config", lambda sd: TINY_VAE
+        )
+        (loaded,) = VAELoader().load(str(vae_path))
+        z = loaded.encode(jnp.zeros((1, 16, 16, 3)), None)
+        assert z.shape[-1] == TINY_VAE.z_channels
+
+    def test_vae_loader_routes_wan_video_layout(self, tmp_path, monkeypatch):
+        from safetensors.numpy import save_file
+
+        from comfyui_parallelanything_tpu.nodes_compat import VAELoader
+        import comfyui_parallelanything_tpu.models.loader as loader_mod
+
+        path = tmp_path / "wan_vae.safetensors"
+        save_file(
+            {"decoder.upsamples.0.residual.0.gamma":
+                 np.zeros((4, 1, 1, 1), np.float32)},
+            str(path),
+        )
+        seen = {}
+
+        def fake_load(p, cfg=None):
+            seen["path"] = p
+            return "video-vae"
+
+        monkeypatch.setattr(loader_mod, "load_wan_vae_checkpoint", fake_load)
+        (out,) = VAELoader().load(str(path))
+        assert out == "video-vae" and seen["path"] == str(path)
+
+    def test_vae_loader_missing_file(self):
+        from comfyui_parallelanything_tpu.nodes_compat import VAELoader
+
+        with pytest.raises(ValueError, match="not found"):
+            VAELoader().load("ghost_vae.safetensors")
+
+    def test_clip_loader_single_tower(self, tmp_path, monkeypatch):
+        from safetensors.numpy import save_file
+
+        from comfyui_parallelanything_tpu.nodes_compat import CLIPLoader
+        import comfyui_parallelanything_tpu.models.text_encoders as te_mod
+        from tests.test_text_encoders import TINY_CLIP, _hf_clip
+
+        _synthetic_stock_env(tmp_path, monkeypatch)  # tokenizer env
+        monkeypatch.setattr(te_mod, "clip_l_config", lambda: TINY_CLIP)
+        hf = _hf_clip(TINY_CLIP, "quick_gelu")
+        enc_path = tmp_path / "clip_l.safetensors"
+        save_file(
+            {k: np.ascontiguousarray(v.detach().numpy())
+             for k, v in hf.state_dict().items()},
+            str(enc_path),
+        )
+        (wire,) = CLIPLoader().load(str(enc_path), type="stable_diffusion")
+        assert wire["encoder"] is not None and wire["tokenizer"] is not None
+
+    def test_clip_loader_wan_needs_t5_tokenizer(self, monkeypatch):
+        from comfyui_parallelanything_tpu.nodes_compat import CLIPLoader
+
+        monkeypatch.delenv("PA_T5_TOKENIZER_JSON", raising=False)
+        with pytest.raises(ValueError, match="PA_T5_TOKENIZER_JSON"):
+            CLIPLoader().load("umt5_xxl.safetensors", type="wan")
+
+
+class TestUnclip:
+    def test_sniff_sd21_unclip(self):
+        sd = {
+            "input_blocks.0.0.weight": np.zeros((1, 4)),
+            "label_emb.0.0.weight": np.zeros((1024, 2048)),
+            "input_blocks.1.1.transformer_blocks.0.attn2.to_k.weight":
+                np.zeros((320, 1024)),
+        }
+        assert sniff_model_family(sd) == "sd21-unclip"
+        # SDXL keeps sniffing sdxl (no transformer at input_blocks.1).
+        sdxl = {"input_blocks.0.0.weight": np.zeros((1, 4)),
+                "label_emb.0.0.weight": np.zeros((1, 2816))}
+        assert sniff_model_family(sdxl) == "sdxl"
+
+    def test_unclip_adm_vector(self):
+        from comfyui_parallelanything_tpu.models.unet import unclip_adm
+
+        tags = [{"embeds": np.ones((1, 24), np.float32), "strength": 1.0,
+                 "noise_augmentation": 0.0}]
+        y = unclip_adm(tags, 32)
+        assert y.shape == (1, 32)
+        # Zero augmentation at level 0 still q_samples with sqrt(acp[0])~1:
+        # the embed half stays close to the input, the level half is the
+        # sinusoidal embedding of 0.
+        assert np.allclose(np.asarray(y[:, :24]), 1.0, atol=0.05)
+        # Strength scales the whole vector.
+        y2 = unclip_adm([{**tags[0], "strength": 2.0}], 32)
+        np.testing.assert_allclose(
+            np.asarray(y2), 2 * np.asarray(y), rtol=1e-5
+        )
+        # Multiple tags merge (re-augmented sum) without shape drift.
+        y3 = unclip_adm(tags + [{**tags[0], "noise_augmentation": 0.5}], 32)
+        assert y3.shape == (1, 32) and np.isfinite(np.asarray(y3)).all()
+
+    def test_unclip_conditioning_node_tags_and_samples(self):
+        import jax
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.models import build_unet, sd15_config
+        from comfyui_parallelanything_tpu.nodes import TPUKSampler
+        from comfyui_parallelanything_tpu.nodes_compat import unCLIPConditioning
+
+        cfg = sd15_config(
+            model_channels=32, channel_mult=(1, 2), transformer_depth=(1, 1),
+            attention_levels=(0, 1), context_dim=16, num_heads=4,
+            norm_groups=8, adm_in_channels=32, prediction="v",
+            dtype=jnp.float32,
+        )
+        model = build_unet(cfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+        cvo = {"image_embeds": jnp.ones((1, 24)), "last_hidden": None,
+               "penultimate": None}
+        pos = {"context": jnp.zeros((1, 3, 16))}
+        (tagged,) = unCLIPConditioning().apply_adm(pos, cvo, 1.0, 0.2)
+        assert len(tagged["unclip"]) == 1
+        # Chaining stacks.
+        (tagged2,) = unCLIPConditioning().apply_adm(tagged, cvo, 0.5, 0.0)
+        assert len(tagged2["unclip"]) == 2
+        neg = {"context": jnp.zeros((1, 3, 16))}
+        (out,) = TPUKSampler().sample(
+            model, tagged, {"samples": jnp.zeros((2, 8, 8, 4))}, seed=1,
+            steps=2, cfg=3.0, sampler_name="euler", scheduler="normal",
+            negative=neg,
+        )
+        assert out["samples"].shape == (2, 8, 8, 4)
+        assert np.isfinite(np.asarray(out["samples"])).all()
+
+
+def _synthetic_wan_env(tmp_path, monkeypatch):
+    """Tiny WAN i2v world for the stock template: bare DiT file (official
+    Wan2.x layout incl. the img_emb CLIP branch), official-layout video VAE,
+    UMT5 encoder + tokenizer.json, HF-layout CLIP-vision tower, start image —
+    all wired through the same env vars / preset monkeypatches the shims read."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    from PIL import Image
+    from safetensors.numpy import save_file
+
+    import comfyui_parallelanything_tpu.models as models_pkg
+    import comfyui_parallelanything_tpu.models.video_vae as vv_mod
+    from comfyui_parallelanything_tpu.models.wan import WanConfig, build_wan
+    from tests.test_convert_wan import _official_layout_sd
+    from tests.test_golden_video_vae import CFG as VCFG, TWanVAE
+    from tests.test_text_encoders import TINY_T5
+    from tests.test_vision import TINY as TINY_VIS, _hf_vision
+
+    import torch
+
+    # -- WAN i2v DiT (official layout, CLIP branch) -------------------------
+    zc = VCFG.z_channels
+    wcfg = WanConfig(
+        in_channels=2 * zc + 4, out_channels=zc, hidden_size=48, ffn_dim=96,
+        num_heads=4, depth=2, text_dim=TINY_T5.d_model, freq_dim=16,
+        img_dim=TINY_VIS.hidden_size, dtype=jnp.float32,
+    )
+    dit = build_wan(
+        wcfg, jax.random.key(0), sample_shape=(1, 2, 4, 4, 2 * zc + 4),
+        txt_len=6,
+    )
+    dit_path = tmp_path / "wan_i2v_tiny.safetensors"
+    save_file(
+        {k: np.ascontiguousarray(v)
+         for k, v in _official_layout_sd(wcfg, dit.params).items()},
+        str(dit_path),
+    )
+    # The loader's family preset; in_channels/img_dim re-sniff off the file.
+    base_cfg = dataclasses.replace(wcfg, in_channels=zc, img_dim=None)
+    monkeypatch.setattr(models_pkg, "wan_1_3b_config", lambda: base_cfg)
+
+    # -- video VAE (official torch layout) ----------------------------------
+    torch.manual_seed(11)
+    tvae = TWanVAE(VCFG).eval()
+    vae_path = tmp_path / "wan_vae_tiny.safetensors"
+    save_file(
+        {k: np.ascontiguousarray(v.detach().numpy())
+         for k, v in tvae.state_dict().items()},
+        str(vae_path),
+    )
+    monkeypatch.setattr(vv_mod, "wan_vae_config", lambda: VCFG)
+
+    # -- UMT5 text encoder + tokenizer --------------------------------------
+    import transformers
+
+    t5_cfg = dataclasses.replace(TINY_T5, per_layer_bias=True)
+    hf_cfg = transformers.UMT5Config(
+        vocab_size=t5_cfg.vocab_size, d_model=t5_cfg.d_model,
+        d_kv=t5_cfg.d_kv, d_ff=t5_cfg.d_ff, num_layers=t5_cfg.num_layers,
+        num_heads=t5_cfg.num_heads,
+        relative_attention_num_buckets=t5_cfg.relative_buckets,
+        relative_attention_max_distance=t5_cfg.relative_max_distance,
+        feed_forward_proj="gated-gelu", dropout_rate=0.0,
+    )
+    torch.manual_seed(1)
+    hf_t5 = transformers.UMT5EncoderModel(hf_cfg).eval()
+    umt5_path = tmp_path / "umt5_tiny.safetensors"
+    save_file(
+        {k: np.ascontiguousarray(v.detach().numpy())
+         for k, v in hf_t5.state_dict().items()},
+        str(umt5_path),
+    )
+    monkeypatch.setattr(models_pkg, "umt5_xxl_config", lambda: t5_cfg)
+
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {"[UNK]": 0, "</s>": 1, "a": 5, "cat": 6, "walking": 7,
+             "blurry": 8}
+    t = tokenizers.Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
+    t.pre_tokenizer = Whitespace()
+    tok_path = tmp_path / "t5_tokenizer.json"
+    t.save(str(tok_path))
+    monkeypatch.setenv("PA_T5_TOKENIZER_JSON", str(tok_path))
+
+    # -- CLIP vision tower (HF layout) --------------------------------------
+    vis_path = tmp_path / "clip_vision_tiny.safetensors"
+    hf_vis = _hf_vision(TINY_VIS, "quick_gelu")
+    save_file(
+        {k: np.ascontiguousarray(v.detach().numpy())
+         for k, v in hf_vis.state_dict().items()},
+        str(vis_path),
+    )
+
+    # -- start image ---------------------------------------------------------
+    img_path = tmp_path / "start.png"
+    Image.fromarray(
+        (np.full((16, 16, 3), 0.5) * 255).astype(np.uint8)
+    ).save(str(img_path))
+    monkeypatch.setenv("PA_INPUT_DIR", str(tmp_path))
+
+    return {
+        "dit": str(dit_path), "vae": str(vae_path), "umt5": str(umt5_path),
+        "vision": str(vis_path), "image": "start.png",
+    }
+
+
+class TestStockWanI2VWorkflow:
+    def test_wan_i2v_template_runs_unchanged(self, tmp_path, monkeypatch):
+        """The stock WAN image-to-video API export shape — UNETLoader +
+        CLIPLoader(wan) + VAELoader + CLIPVisionLoader/Encode +
+        WanImageToVideo + KSampler + VAEDecode + SaveAnimatedWEBP — runs
+        as-is on the tiny synthetic WAN i2v world."""
+        paths = _synthetic_wan_env(tmp_path, monkeypatch)
+        monkeypatch.setenv("PA_OUTPUT_DIR", str(tmp_path / "out"))
+        wf = {
+            "37": {"class_type": "UNETLoader",
+                   "inputs": {"unet_name": paths["dit"],
+                              "weight_dtype": "default"}},
+            "38": {"class_type": "CLIPLoader",
+                   "inputs": {"clip_name": paths["umt5"], "type": "wan"}},
+            "39": {"class_type": "VAELoader",
+                   "inputs": {"vae_name": paths["vae"]}},
+            "49": {"class_type": "CLIPVisionLoader",
+                   "inputs": {"clip_name": paths["vision"]}},
+            "52": {"class_type": "LoadImage",
+                   "inputs": {"image": paths["image"]}},
+            "51": {"class_type": "CLIPVisionEncode",
+                   "inputs": {"clip_vision": ["49", 0], "image": ["52", 0],
+                              "crop": "none"}},
+            "6": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "a cat walking", "clip": ["38", 0]}},
+            "7": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "blurry", "clip": ["38", 0]}},
+            "50": {"class_type": "WanImageToVideo",
+                   "inputs": {"positive": ["6", 0], "negative": ["7", 0],
+                              "vae": ["39", 0], "width": 16, "height": 16,
+                              "length": 5, "batch_size": 1,
+                              "clip_vision_output": ["51", 0],
+                              "start_image": ["52", 0]}},
+            "3": {"class_type": "KSampler",
+                  "inputs": {"seed": 7, "steps": 2, "cfg": 1.0,
+                             "sampler_name": "euler", "scheduler": "normal",
+                             "denoise": 1.0, "model": ["37", 0],
+                             "positive": ["50", 0], "negative": ["50", 1],
+                             "latent_image": ["50", 2]}},
+            "8": {"class_type": "VAEDecode",
+                  "inputs": {"samples": ["3", 0], "vae": ["39", 0]}},
+            "28": {"class_type": "SaveAnimatedWEBP",
+                   "inputs": {"images": ["8", 0], "fps": 8.0,
+                              "filename_prefix": "wan_i2v"}},
+        }
+        out = run_workflow(wf)
+        video = np.asarray(out["8"][0])
+        assert video.shape == (1, 5, 16, 16, 3) or video.shape == (5, 16, 16, 3)
+        assert np.isfinite(video).all()
+        assert all(os.path.exists(p) for p in out["28"][0])
+
+
+class TestUnclipReviewFixes:
+    def _adm_model(self):
+        import jax
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.models import build_unet, sd15_config
+
+        cfg = sd15_config(
+            model_channels=32, channel_mult=(1, 2), transformer_depth=(1, 1),
+            attention_levels=(0, 1), context_dim=16, num_heads=4,
+            norm_groups=8, adm_in_channels=32, prediction="v",
+            dtype=jnp.float32,
+        )
+        return build_unet(cfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+
+    def test_untagged_adm_model_samples_with_zero_adm(self):
+        # A plain txt2img graph on an adm checkpoint (no unCLIPConditioning,
+        # no pooled) must sample against a zeros adm vector like stock, not
+        # crash on a missing/mis-sized y.
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.nodes import TPUKSampler
+
+        model = self._adm_model()
+        (out,) = TPUKSampler().sample(
+            model, {"context": jnp.zeros((1, 3, 16))},
+            {"samples": jnp.zeros((1, 8, 8, 4))}, seed=0, steps=2, cfg=3.0,
+            sampler_name="euler", scheduler="normal",
+            negative={"context": jnp.zeros((1, 3, 16))},
+        )
+        assert np.isfinite(np.asarray(out["samples"])).all()
+
+    def test_wrong_width_text_pooled_dropped_for_unclip_context(self):
+        # context_dim 1024 marks the sd21-unclip family: the text tower's
+        # pooled never feeds the adm head (stock drops it); tiny config here
+        # has context 16, so emulate by patching the gate's width read.
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.nodes import TPUKSampler
+
+        model = self._adm_model()
+        # Non-1024 context + wrong-width pooled → diagnosable error.
+        with pytest.raises(ValueError, match="adm head expects"):
+            TPUKSampler().sample(
+                model,
+                {"context": jnp.zeros((1, 3, 16)),
+                 "pooled": jnp.zeros((1, 24))},
+                {"samples": jnp.zeros((1, 8, 8, 4))}, seed=0, steps=1,
+                cfg=1.0, sampler_name="euler", scheduler="normal",
+            )
+
+    def test_unclip_adm_uses_cosine_alpha_bar(self):
+        # squaredcos_cap_v2, not the linear table: at level 500 the cosine
+        # alpha-bar keeps ~0.49 of the signal (linear keeps ~0.08).
+        from comfyui_parallelanything_tpu.models.unet import unclip_adm
+
+        tags = [{"embeds": np.ones((1, 24), np.float32),
+                 "noise_augmentation": 0.5}]
+        y = np.asarray(unclip_adm(tags, 32))
+        signal = float(np.mean(y[:, :24]))
+        # sqrt(acp_cos[500]) ~ 0.70 of the unit embed; linear would be ~0.28.
+        assert 0.5 < signal < 0.9, signal
+
+
+class TestCLIPLoaderTokenBudget:
+    def test_wan_t5_max_len_512(self, tmp_path, monkeypatch):
+        import dataclasses
+
+        import torch
+        import transformers
+        from safetensors.numpy import save_file
+
+        import comfyui_parallelanything_tpu.models as models_pkg
+        from comfyui_parallelanything_tpu.nodes_compat import CLIPLoader
+        from tests.test_text_encoders import TINY_T5
+
+        t5_cfg = dataclasses.replace(TINY_T5, per_layer_bias=True)
+        hf_cfg = transformers.UMT5Config(
+            vocab_size=t5_cfg.vocab_size, d_model=t5_cfg.d_model,
+            d_kv=t5_cfg.d_kv, d_ff=t5_cfg.d_ff, num_layers=t5_cfg.num_layers,
+            num_heads=t5_cfg.num_heads,
+            relative_attention_num_buckets=t5_cfg.relative_buckets,
+            relative_attention_max_distance=t5_cfg.relative_max_distance,
+            feed_forward_proj="gated-gelu", dropout_rate=0.0,
+        )
+        torch.manual_seed(0)
+        hf = transformers.UMT5EncoderModel(hf_cfg).eval()
+        path = tmp_path / "umt5_tiny.safetensors"
+        save_file({k: np.ascontiguousarray(v.detach().numpy())
+                   for k, v in hf.state_dict().items()}, str(path))
+        monkeypatch.setattr(models_pkg, "umt5_xxl_config", lambda: t5_cfg)
+
+        tokenizers = pytest.importorskip("tokenizers")
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        t = tokenizers.Tokenizer(
+            WordLevel({"[UNK]": 0, "</s>": 1, "a": 5}, unk_token="[UNK]")
+        )
+        t.pre_tokenizer = Whitespace()
+        tok = tmp_path / "t5_tok.json"
+        t.save(str(tok))
+        monkeypatch.setenv("PA_T5_TOKENIZER_JSON", str(tok))
+        (wire,) = CLIPLoader().load(str(path), type="wan")
+        # WAN prompts tokenize at 512, not the CLIP default 77 (stock umt5
+        # budget) — a long prompt must not silently truncate.
+        assert wire["tokenizer"].max_len == 512
+
+
+class TestUnclipNegativeSide:
+    def test_wrong_width_negative_pooled_zeroed_for_unclip(self, monkeypatch):
+        """The uncond half of CFG must get the same treatment as the cond
+        half: a 1024-wide text pooled on the negative conditioning of an
+        sd21-unclip-class model (context 1024) is dropped to zeros, not fed
+        into label_emb."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.models import build_unet, sd15_config
+        from comfyui_parallelanything_tpu.nodes import TPUKSampler
+
+        # context_dim 1024 marks the unclip family for the width gate; keep
+        # every other dim tiny.
+        cfg = sd15_config(
+            model_channels=32, channel_mult=(1, 2), transformer_depth=(1, 1),
+            attention_levels=(0, 1), context_dim=1024, num_heads=4,
+            norm_groups=8, adm_in_channels=32, prediction="v",
+            dtype=jnp.float32,
+        )
+        model = build_unet(cfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+        (out,) = TPUKSampler().sample(
+            model,
+            {"context": jnp.zeros((1, 3, 1024))},
+            {"samples": jnp.zeros((1, 8, 8, 4))}, seed=0, steps=2, cfg=3.0,
+            sampler_name="euler", scheduler="normal",
+            negative={"context": jnp.zeros((1, 3, 1024)),
+                      "pooled": jnp.zeros((1, 1024))},  # text-tower width
+        )
+        assert np.isfinite(np.asarray(out["samples"])).all()
+
+
+class TestI2VClipFeaOnClipless:
+    def test_clip_fea_dropped_with_warning_on_wan22_checkpoint(self, caplog):
+        """WAN2.1 template (clip_vision_output wired) reused on a WAN2.2-style
+        i2v checkpoint (36 channels, no img_emb): stock ignores clip_fea —
+        the composition drops it with a warning instead of raising
+        mid-sampling."""
+        import jax
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.models import build_wan
+        from comfyui_parallelanything_tpu.models.wan import (
+            WanConfig,
+            apply_i2v_conditioning,
+        )
+
+        wcfg = WanConfig(
+            in_channels=12, out_channels=4, hidden_size=48, ffn_dim=96,
+            num_heads=4, depth=1, text_dim=32, freq_dim=16,
+            dtype=jnp.float32,  # no img_dim: WAN2.2-style
+        )
+        dit = build_wan(
+            wcfg, jax.random.key(0), sample_shape=(1, 2, 4, 4, 12), txt_len=6
+        )
+        cond = jnp.zeros((1, 2, 4, 4, 8))
+        composed = apply_i2v_conditioning(
+            dit, cond, clip_fea=jnp.ones((1, 5, 24))
+        )
+        out = composed.apply(
+            composed.params, jnp.zeros((1, 2, 4, 4, 4)), jnp.array([0.5]),
+            jnp.zeros((1, 6, 32)),
+        )
+        assert out.shape == (1, 2, 4, 4, 4)
+        assert np.isfinite(np.asarray(out)).all()

@@ -246,3 +246,136 @@ class TestVideoInpaint:
                 "hello", steps=1, cfg_scale=1.0, height=16, width=16, frames=5,
                 mask=jnp.ones((1, 5, 16, 16)),
             )
+
+
+class TestI2VClipVision:
+    """WAN2.1-style i2v (img_dim set): clip_vision_output rides the img_emb
+    branch through the pipeline and the WanImageToVideo stock node."""
+
+    @pytest.fixture(scope="class")
+    def i2v_clip_pipe(self, wan_pipe):
+        wcfg = WanConfig(
+            in_channels=2 * ZC + 4, out_channels=ZC, hidden_size=48,
+            ffn_dim=96, num_heads=4, depth=2, text_dim=32, freq_dim=16,
+            img_dim=24, dtype=jnp.float32,
+        )
+        dit = build_wan(
+            wcfg, jax.random.key(6), sample_shape=(1, 2, 4, 4, 2 * ZC + 4),
+            txt_len=6,
+        )
+        return WanVideoPipeline(
+            dit=dit, vae=wan_pipe.vae, t5=wan_pipe.t5,
+            t5_tokenizer=wan_pipe.t5_tokenizer,
+        )
+
+    def _cvo(self, b=1):
+        return {
+            "penultimate": jax.random.normal(
+                jax.random.key(11), (b, 5, 24), jnp.float32
+            )
+        }
+
+    def test_clip_vision_output_changes_video(self, i2v_clip_pipe):
+        kw = dict(steps=2, cfg_scale=1.0, height=16, width=16, frames=5,
+                  rng=jax.random.key(12), image=jnp.full((1, 16, 16, 3), 0.5))
+        a = np.asarray(i2v_clip_pipe("hello", **kw))
+        b = np.asarray(
+            i2v_clip_pipe("hello", clip_vision_output=self._cvo(), **kw)
+        )
+        assert a.shape == b.shape == (1, 5, 16, 16, 3)
+        assert not np.allclose(a, b)
+        assert np.isfinite(b).all()
+
+    def test_clip_vision_under_cfg(self, i2v_clip_pipe):
+        video = i2v_clip_pipe(
+            "hello", negative_prompt="world", steps=2, cfg_scale=5.0,
+            height=16, width=16, frames=5,
+            image=jnp.full((1, 16, 16, 3), 0.4),
+            clip_vision_output=self._cvo(),
+        )
+        assert np.isfinite(np.asarray(video)).all()
+
+    def test_clip_vision_on_clipless_model_rejected(self, i2v_pipe_factory):
+        pipe = i2v_pipe_factory
+        with pytest.raises(ValueError, match="img_emb"):
+            pipe(
+                "hello", steps=1, cfg_scale=1.0, height=16, width=16,
+                frames=5, image=jnp.zeros((1, 16, 16, 3)),
+                clip_vision_output=self._cvo(),
+            )
+
+    def test_clip_vision_without_image_rejected(self, i2v_clip_pipe):
+        with pytest.raises(ValueError, match="start image"):
+            i2v_clip_pipe(
+                "hello", steps=1, cfg_scale=1.0, height=16, width=16,
+                frames=5, clip_vision_output=self._cvo(),
+            )
+
+    @pytest.fixture(scope="class")
+    def i2v_pipe_factory(self, wan_pipe):
+        wcfg = WanConfig(
+            in_channels=2 * ZC + 4, out_channels=ZC, hidden_size=48,
+            ffn_dim=96, num_heads=4, depth=1, text_dim=32, freq_dim=16,
+            dtype=jnp.float32,
+        )
+        dit = build_wan(
+            wcfg, jax.random.key(7), sample_shape=(1, 2, 4, 4, 2 * ZC + 4),
+            txt_len=6,
+        )
+        return WanVideoPipeline(
+            dit=dit, vae=wan_pipe.vae, t5=wan_pipe.t5,
+            t5_tokenizer=wan_pipe.t5_tokenizer,
+        )
+
+
+class TestWanImageToVideoNode:
+    def test_node_builds_latent_and_tags(self, wan_pipe):
+        from comfyui_parallelanything_tpu.nodes_compat import WanImageToVideo
+
+        pos = {"context": jnp.zeros((1, 6, 32))}
+        neg = {"context": jnp.zeros((1, 6, 32))}
+        cvo = {"penultimate": jnp.ones((1, 5, 24))}
+        p2, n2, lat = WanImageToVideo().encode(
+            pos, neg, wan_pipe.vae, width=16, height=16, length=5,
+            batch_size=2, start_image=jnp.full((1, 16, 16, 3), 0.5),
+            clip_vision_output=cvo,
+        )
+        # tf=2 in the tiny VAE: 5 frames -> 3 latent frames; f=4 spatial.
+        f = wan_pipe.vae.spatial_factor
+        assert lat["samples"].shape == (2, 3, 16 // f, 16 // f, ZC)
+        assert "i2v" in p2 and "i2v" in n2
+        cond = p2["i2v"]["cond"]
+        assert cond.shape == (1, 3, 16 // f, 16 // f, 4 + ZC)
+        m = np.asarray(cond[..., :4])
+        # Only the first latent frame is given (F=1): all 4 fold channels on.
+        assert m[:, 0].min() == 1.0 and m[:, 1:].max() == 0.0
+        assert p2["i2v"]["clip_fea"] is cvo["penultimate"]
+
+    def test_node_samples_through_ksampler(self, wan_pipe):
+        """The i2v tag composes into the model inside TPUKSampler: a full
+        node-path denoise run on a clip-branch i2v DiT."""
+        from comfyui_parallelanything_tpu.nodes import TPUKSampler
+        from comfyui_parallelanything_tpu.nodes_compat import WanImageToVideo
+
+        wcfg = WanConfig(
+            in_channels=2 * ZC + 4, out_channels=ZC, hidden_size=48,
+            ffn_dim=96, num_heads=4, depth=1, text_dim=32, freq_dim=16,
+            img_dim=24, dtype=jnp.float32,
+        )
+        dit = build_wan(
+            wcfg, jax.random.key(8), sample_shape=(1, 2, 4, 4, 2 * ZC + 4),
+            txt_len=6,
+        )
+        pos = {"context": jnp.zeros((1, 6, 32))}
+        neg = {"context": jnp.zeros((1, 6, 32))}
+        p2, n2, lat = WanImageToVideo().encode(
+            pos, neg, wan_pipe.vae, width=16, height=16, length=5,
+            batch_size=1, start_image=jnp.full((1, 16, 16, 3), 0.5),
+            clip_vision_output={"penultimate": jnp.ones((1, 5, 24))},
+        )
+        (out,) = TPUKSampler().sample(
+            dit, p2, lat, seed=0, steps=2, cfg=1.0,
+            sampler_name="euler", scheduler="normal", negative=n2,
+        )
+        assert out["samples"].shape == lat["samples"].shape
+        assert np.isfinite(np.asarray(out["samples"])).all()
