@@ -1,0 +1,228 @@
+"""Per-op-class MFU budget for a bench rung (VERDICT r4 next-2).
+
+The round-3 hardware table shows sd15_16 at 8.6% MFU while sdxl_8 hits 40% on
+the same chip — a 4.7× gap that needs a *budget* (where do the 91% of cycles
+go?) before a live window can fix it. This script produces that budget WITHOUT
+hardware: it traces the rung's denoise-step jaxpr, walks every equation
+(recursing into pjit/closed-call subjaxprs), and buckets exact FLOPs and
+memory traffic by op class:
+
+- ``conv``       — conv_general_dilated (the UNet trunk)
+- ``matmul``     — dot_general (attention projections, transformer MLPs,
+                   attention score/value products)
+- ``attention``  — the dot_generals of attention score/value products
+                   (contraction or output dim is a sequence length from this
+                   trace) — split out because lane-padding waste lives here
+- ``elementwise`` — everything else, costed by bytes touched (norms,
+                   activations, softmax, residual adds)
+
+Roofline projection per class (v5e-1: 197 bf16 TFLOP/s, 819 GB/s HBM):
+``t_class = max(flops / peak_flops, bytes / hbm_bw)``. The MXU-waste model
+additionally reports matmul time at the PADDED contraction width (lane
+granularity 128): a 40-wide head dim costs the MXU the same as 128 — the
+padded/unpadded ratio is the ceiling a lane-respecting kernel can claw back.
+
+Output: a table on stdout + ``MFU_BUDGET.json`` next to the other evidence
+artifacts. Run for any rung: ``BENCH_CONFIG=sd15_16 python scripts/mfu_budget.py``.
+CPU-safe (pure tracing; nothing executes).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+PEAK_FLOPS = 197e12  # v5e bf16
+HBM_BW = 819e9       # v5e HBM bytes/s
+LANE = 128           # MXU lane granularity
+
+
+def _nbytes(aval) -> int:
+    return math.prod(aval.shape) * aval.dtype.itemsize if aval.shape else aval.dtype.itemsize
+
+
+def _dot_flops(eqn):
+    """Exact dot_general FLOPs (2·M·N·K over batch dims) + the lane-padded
+    variant (contraction and output dims rounded up to LANE)."""
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    k = math.prod(lhs.shape[d] for d in lc)
+    b = math.prod(lhs.shape[d] for d in lb)
+    m = math.prod(
+        lhs.shape[d] for d in range(len(lhs.shape)) if d not in (*lc, *lb)
+    )
+    n = math.prod(
+        rhs.shape[d] for d in range(len(rhs.shape)) if d not in (*rc, *rb)
+    )
+    pad = lambda v: -(-v // LANE) * LANE  # noqa: E731
+    return 2 * b * m * n * k, 2 * b * pad(m) * pad(n) * pad(k), (m, n, k, b)
+
+
+def _conv_flops(eqn):
+    out = eqn.outvars[0].aval
+    rhs = eqn.invars[1].aval  # kernel (spatial..., in/feature, out) per dnums
+    # 2 · out_elements · (kernel elements per output) — feature_group_count
+    # divides the per-output kernel work.
+    groups = eqn.params.get("feature_group_count", 1)
+    kernel_per_out = math.prod(rhs.shape[:-1]) // max(groups, 1)
+    flops = 2 * math.prod(out.shape) * kernel_per_out
+    return flops, flops  # convs lower through MXU-shaped patches; no extra pad model
+
+
+def _subjaxprs(eqn):
+    """Inner jaxprs of one equation (pjit/scan/cond/custom-call params)."""
+    from jax.extend import core as jex_core
+
+    closed = getattr(jex_core, "ClosedJaxpr", None)
+    bare = getattr(jex_core, "Jaxpr", None)
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vals:
+            if closed is not None and isinstance(x, closed):
+                yield x.jaxpr
+            elif bare is not None and isinstance(x, bare):
+                yield x
+
+
+def walk(jaxpr, acc, seq_lens):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        for sub in _subjaxprs(eqn):  # recurse into pjit/scan/cond
+            walk(sub, acc, seq_lens)
+        if name == "dot_general":
+            f, fpad, (m, n, k, b) = _dot_flops(eqn)
+            cls = "matmul"
+            # Attention score/value products: QK^T contracts the head dim
+            # (k ≤ 256) against a full sequence (m or n ∈ seq_lens — the
+            # chunked path keeps full length only on the K side); PV
+            # contracts the sequence itself (k ∈ seq_lens). This is where
+            # 40/80/160-wide-head lane padding concentrates.
+            if (k in seq_lens) or (
+                (m in seq_lens or n in seq_lens) and k <= 256
+            ):
+                cls = "attention"
+            acc[cls]["flops"] += f
+            acc[cls]["flops_padded"] += fpad
+            acc[cls]["bytes"] += sum(_nbytes(v.aval) for v in eqn.invars)
+            acc[cls]["bytes"] += sum(_nbytes(v.aval) for v in eqn.outvars)
+            acc[cls]["count"] += 1
+        elif name == "conv_general_dilated":
+            f, fpad = _conv_flops(eqn)
+            acc["conv"]["flops"] += f
+            acc["conv"]["flops_padded"] += fpad
+            acc["conv"]["bytes"] += sum(_nbytes(v.aval) for v in eqn.invars)
+            acc["conv"]["bytes"] += sum(_nbytes(v.aval) for v in eqn.outvars)
+            acc["conv"]["count"] += 1
+        elif not eqn.primitive.multiple_results or name in ("scan", "while"):
+            byts = sum(_nbytes(v.aval) for v in eqn.invars if hasattr(v, "aval"))
+            byts += sum(_nbytes(v.aval) for v in eqn.outvars)
+            acc["elementwise"]["flops"] += math.prod(
+                eqn.outvars[0].aval.shape
+            ) if eqn.outvars and eqn.outvars[0].aval.shape else 0
+            acc["elementwise"]["bytes"] += byts
+            acc["elementwise"]["count"] += 1
+            acc.setdefault("_by_prim", {}).setdefault(name, [0, 0])
+            acc["_by_prim"][name][0] += 1
+            acc["_by_prim"][name][1] += byts
+
+
+def main():
+    global jax
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+
+    rung = os.environ.get("BENCH_CONFIG", "sd15_16")
+    model, batch, lat_shape, ctx_len, ctx_dim, kwargs, workload, *mb = (
+        bench._RUNGS[rung](jnp, jax.random.key(0))
+    )
+    x = jnp.zeros(lat_shape, jnp.bfloat16)
+    t = jnp.zeros((batch,), jnp.float32)
+    ctx = jnp.zeros((batch, ctx_len, ctx_dim), jnp.bfloat16)
+
+    jaxpr = jax.make_jaxpr(
+        lambda p, x, t, c: model.apply(p, x, t, c, **kwargs)
+    )(model.params, x, t, ctx)
+
+    # Sequence lengths that can appear as attention S×S outputs: every
+    # spatial-token count at the UNet/DiT resolutions in this trace.
+    side = lat_shape[1]
+    seq_lens = {ctx_len}
+    for s in range(8):
+        if side >> s:
+            seq_lens.add((side >> s) * (lat_shape[2] >> s))
+
+    acc = {
+        c: {"flops": 0, "flops_padded": 0, "bytes": 0, "count": 0}
+        for c in ("conv", "matmul", "attention", "elementwise")
+    }
+    walk(jaxpr.jaxpr, acc, seq_lens)
+    by_prim = acc.pop("_by_prim", {})
+
+    total_flops = sum(c["flops"] for c in acc.values())
+    rows, total_ms = [], 0.0
+    for cls, c in acc.items():
+        t_flops = c["flops"] / PEAK_FLOPS
+        t_pad = c["flops_padded"] / PEAK_FLOPS
+        t_mem = c["bytes"] / HBM_BW
+        t_cls = max(t_pad, t_mem)
+        total_ms += t_cls * 1e3
+        rows.append({
+            "class": cls, "count": c["count"], "gflops": c["flops"] / 1e9,
+            "gflops_padded": c["flops_padded"] / 1e9,
+            "gbytes": c["bytes"] / 1e9,
+            "ms_compute": t_flops * 1e3, "ms_padded": t_pad * 1e3,
+            "ms_memory": t_mem * 1e3, "ms_roofline": t_cls * 1e3,
+            "bound": "memory" if t_mem > t_pad else "compute",
+        })
+    out = {
+        "rung": rung, "workload": workload, "batch": batch,
+        "total_model_gflops": total_flops / 1e9,
+        "ideal_s_it": total_flops / PEAK_FLOPS,
+        "roofline_s_it": total_ms / 1e3,
+        "roofline_mfu": (total_flops / PEAK_FLOPS) / (total_ms / 1e3)
+        if total_ms else None,
+        "classes": rows,
+        "peak_flops": PEAK_FLOPS, "hbm_bw": HBM_BW,
+    }
+    path = os.path.join(bench.evidence_dir(), "MFU_BUDGET.json")
+    existing = []
+    if os.path.exists(path):
+        existing = json.load(open(path))
+        if not isinstance(existing, list):
+            existing = [existing]
+    existing = [e for e in existing if e.get("rung") != rung] + [out]
+    json.dump(existing, open(path, "w"), indent=1)
+
+    hdr = (f"{'class':18} {'n':>5} {'GFLOP':>10} {'GFLOP(pad)':>11} "
+           f"{'GB':>8} {'ms@peak':>8} {'ms(pad)':>8} {'ms(mem)':>8} "
+           f"{'roofline':>9} bound")
+    print(hdr)
+    for r in rows:
+        print(f"{r['class']:18} {r['count']:>5} {r['gflops']:>10.1f} "
+              f"{r['gflops_padded']:>11.1f} {r['gbytes']:>8.2f} "
+              f"{r['ms_compute']:>8.2f} {r['ms_padded']:>8.2f} "
+              f"{r['ms_memory']:>8.2f} {r['ms_roofline']:>9.2f} {r['bound']}")
+    top = sorted(by_prim.items(), key=lambda kv: -kv[1][1])[:8]
+    out["elementwise_top"] = [
+        {"prim": k, "count": v[0], "gbytes": v[1] / 1e9} for k, v in top
+    ]
+    print("\nelementwise top contributors (UNFUSED bytes — XLA fuses most;"
+          " ranking, not prediction):")
+    for k, v in top:
+        print(f"  {k:28} n={v[0]:>5}  {v[1]/1e9:>8.2f} GB")
+    print(f"\nrung={rung}  model={total_flops/1e12:.2f} TFLOP/step  "
+          f"ideal={out['ideal_s_it']*1e3:.1f} ms/it  "
+          f"unfused-roofline={total_ms:.1f} ms/it  "
+          f"unfused-roofline-MFU={out['roofline_mfu']:.1%}")
+    print(f"budget written to {path}")
+
+
+if __name__ == "__main__":
+    main()
