@@ -247,6 +247,117 @@ def strip_prefix(state_dict: Mapping[str, Any], prefix: str = "model.diffusion_m
 
 
 
+# diffusers ResnetBlock2D → ldm ResBlock param-name map (suffix rewrite).
+_DIFFUSERS_RES = {
+    "norm1": "in_layers.0",
+    "conv1": "in_layers.2",
+    "time_emb_proj": "emb_layers.1",
+    "norm2": "out_layers.0",
+    "conv2": "out_layers.3",
+    "conv_shortcut": "skip_connection",
+}
+
+
+def diffusers_controlnet_to_ldm(state_dict: Mapping[str, Any]) -> dict:
+    """diffusers ``ControlNetModel`` key layout → ldm/cldm key layout.
+
+    Most public SDXL ControlNets (and many SD1.5 re-releases) ship in the
+    diffusers layout (``down_blocks.*``, ``controlnet_cond_embedding.*``,
+    ``controlnet_down_blocks.*``); the host the reference rides on detects and
+    remaps it inside its controlnet loader (the reference itself wraps
+    whatever MODEL results — its unwrap at any_device_parallel.py:921-930 is
+    layout-agnostic), so exported workflows load such files through the plain
+    ``ControlNetLoader``. This is that remap, as a pure key rewrite —
+    the tensors themselves then flow through ``convert_controlnet_checkpoint``
+    unchanged (transformer/resnet internals share names between the layouts
+    modulo the container renames below).
+
+    Structure is derived from the key set itself (res-blocks per level from
+    the max ``resnets.{r}`` index), so the remap needs no config:
+
+    - ``time_embedding.linear_{1,2}``    → ``time_embed.{0,2}``
+    - ``add_embedding.linear_{1,2}``     → ``label_emb.0.{0,2}`` (SDXL)
+    - ``conv_in``                        → ``input_blocks.0.0``
+    - ``controlnet_cond_embedding.conv_in/blocks.{0..5}/conv_out``
+                                         → ``input_hint_block.{0,2..12,14}``
+    - ``down_blocks.b.resnets.r``        → ``input_blocks.{1+b*(R+1)+r}.0``
+      (ResnetBlock2D param names per ``_DIFFUSERS_RES``)
+    - ``down_blocks.b.attentions.r``     → ``input_blocks.{1+b*(R+1)+r}.1``
+    - ``down_blocks.b.downsamplers.0.conv`` → ``input_blocks.{(b+1)*(R+1)}.0.op``
+    - ``mid_block.resnets.0/attentions.0/resnets.1`` → ``middle_block.0/1/2``
+    - ``controlnet_down_blocks.k``       → ``zero_convs.k.0``
+    - ``controlnet_mid_block``           → ``middle_block_out.0``
+    """
+    sd = dict(state_dict)
+    res_idx = [
+        (int(parts[1]), int(parts[3]))
+        for parts in (k.split(".") for k in sd)
+        if parts[0] == "down_blocks" and parts[2] == "resnets"
+    ]
+    if not res_idx:
+        raise ValueError(
+            "not a diffusers ControlNet state dict (no down_blocks.*.resnets)"
+        )
+    n_res = max(r for _, r in res_idx) + 1
+
+    def _res_suffix(suffix: str) -> str:
+        name, rest = suffix.split(".", 1)
+        return f"{_DIFFUSERS_RES[name]}.{rest}"
+
+    out: dict[str, Any] = {}
+    for k, v in sd.items():
+        parts = k.split(".")
+        if parts[0] in ("time_embedding", "add_embedding"):
+            if parts[1] not in ("linear_1", "linear_2"):
+                # e.g. time_embedding.cond_proj (LCM-derived nets): aliasing
+                # it onto linear_2's slot would silently corrupt weights.
+                raise KeyError(f"unrecognized diffusers controlnet key: {k}")
+            slot = 0 if parts[1] == "linear_1" else 2
+            root = "time_embed" if parts[0] == "time_embedding" else "label_emb.0"
+            nk = f"{root}.{slot}.{parts[-1]}"
+        elif parts[0] == "conv_in":
+            nk = f"input_blocks.0.0.{parts[-1]}"
+        elif parts[0] == "controlnet_cond_embedding":
+            if parts[1] == "conv_in":
+                hint = 0
+            elif parts[1] == "conv_out":
+                hint = 14
+            else:
+                hint = 2 * int(parts[2]) + 2
+            nk = f"input_hint_block.{hint}.{parts[-1]}"
+        elif parts[0] == "down_blocks":
+            b = int(parts[1])
+            if parts[2] == "resnets":
+                idx = 1 + b * (n_res + 1) + int(parts[3])
+                nk = f"input_blocks.{idx}.0." + _res_suffix(
+                    ".".join(parts[4:])
+                )
+            elif parts[2] == "attentions":
+                idx = 1 + b * (n_res + 1) + int(parts[3])
+                nk = f"input_blocks.{idx}.1." + ".".join(parts[4:])
+            elif parts[2] == "downsamplers":
+                idx = (b + 1) * (n_res + 1)
+                nk = f"input_blocks.{idx}.0.op.{parts[-1]}"
+            else:
+                raise KeyError(f"unrecognized diffusers controlnet key: {k}")
+        elif parts[0] == "mid_block":
+            if parts[1] == "resnets":
+                pos = 0 if parts[2] == "0" else 2
+                nk = f"middle_block.{pos}." + _res_suffix(".".join(parts[3:]))
+            elif parts[1] == "attentions":
+                nk = "middle_block.1." + ".".join(parts[3:])
+            else:
+                raise KeyError(f"unrecognized diffusers controlnet key: {k}")
+        elif parts[0] == "controlnet_down_blocks":
+            nk = f"zero_convs.{parts[1]}.0.{parts[-1]}"
+        elif parts[0] == "controlnet_mid_block":
+            nk = f"middle_block_out.0.{parts[-1]}"
+        else:
+            raise KeyError(f"unrecognized diffusers controlnet key: {k}")
+        out[nk] = v
+    return out
+
+
 def convert_controlnet_checkpoint(
     state_dict: Mapping[str, Any], cfg: UNetConfig
 ) -> dict:

@@ -143,17 +143,26 @@ def load_controlnet_checkpoint(
     cfg: "UNetConfig | None" = None,
     name: str = "controlnet",
 ) -> DiffusionModel:
-    """ControlNet checkpoint (ldm single-file layout; bare keys or the
-    ``control_model.`` prefix some exports carry) → a ControlNet
+    """ControlNet checkpoint (ldm single-file layout — bare keys or the
+    ``control_model.`` prefix some exports carry — or the diffusers
+    ``ControlNetModel`` layout most public SDXL controlnets ship in, detected
+    by its ``controlnet_cond_embedding.*`` keys and remapped) → a ControlNet
     DiffusionModel for ``apply_control``. With ``cfg=None`` the base-UNet
     family is sniffed off the cross-attention context width (768 → sd15,
-    1024 → sd21, 2048/label_emb → sdxl)."""
+    1024 → sd21, 2048/label_emb → sdxl). Loading either layout is host
+    behavior the reference assumes (its unwrap, any_device_parallel.py:921-930,
+    is agnostic to how the control model got into the MODEL it wraps)."""
     from .controlnet import build_controlnet
-    from .convert_unet import convert_controlnet_checkpoint
+    from .convert_unet import (
+        convert_controlnet_checkpoint,
+        diffusers_controlnet_to_ldm,
+    )
 
     sd = dict(_resolve_state_dict(src))
     if any(k.startswith("control_model.") for k in sd):
         sd = strip_prefix(sd, "control_model.")
+    if any(k.startswith("controlnet_cond_embedding.") for k in sd):
+        sd = diffusers_controlnet_to_ldm(sd)
     if cfg is None:
         # Package-level attrs (not .unet directly): the node layer resolves
         # configs through the package namespace everywhere else, and tests

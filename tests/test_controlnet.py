@@ -280,6 +280,235 @@ class TestSDXLControlNet:
         assert not np.allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
 
+def _diffusers_from_ldm(cfg, sd):
+    """Rename an ldm-layout ControlNet dict into the diffusers
+    ``ControlNetModel`` layout (hand-written inverse of
+    ``diffusers_controlnet_to_ldm`` so the test checks the remap against an
+    independently-derived mapping, not against itself)."""
+    inv_res = {"in_layers.0": "norm1", "in_layers.2": "conv1",
+               "emb_layers.1": "time_emb_proj", "out_layers.0": "norm2",
+               "out_layers.3": "conv2", "skip_connection": "conv_shortcut"}
+    n_res = cfg.num_res_blocks
+    mid_attn = (len(cfg.channel_mult) - 1 in cfg.attention_levels
+                and cfg.transformer_depth[-1] > 0)
+    out = {}
+    for k, v in sd.items():
+        parts = k.split(".")
+        if parts[0] == "time_embed":
+            nk = f"time_embedding.linear_{1 if parts[1] == '0' else 2}.{parts[-1]}"
+        elif parts[0] == "label_emb":
+            nk = f"add_embedding.linear_{1 if parts[2] == '0' else 2}.{parts[-1]}"
+        elif parts[0] == "input_hint_block":
+            i = int(parts[1]) // 2
+            sub = ("conv_in" if i == 0 else
+                   "conv_out" if i == 7 else f"blocks.{i - 1}")
+            nk = f"controlnet_cond_embedding.{sub}.{parts[-1]}"
+        elif parts[0] == "input_blocks":
+            idx = int(parts[1])
+            if idx == 0:
+                nk = f"conv_in.{parts[-1]}"
+            else:
+                b, r = (idx - 1) // (n_res + 1), (idx - 1) % (n_res + 1)
+                if parts[2] == "0" and parts[3] == "op":
+                    nk = f"down_blocks.{b}.downsamplers.0.conv.{parts[-1]}"
+                elif parts[2] == "0":
+                    nk = (f"down_blocks.{b}.resnets.{r}."
+                          f"{inv_res['.'.join(parts[3:-1])]}.{parts[-1]}")
+                else:
+                    nk = (f"down_blocks.{b}.attentions.{r}."
+                          + ".".join(parts[3:]))
+        elif parts[0] == "middle_block":
+            pos = int(parts[1])
+            if mid_attn and pos == 1:
+                nk = "mid_block.attentions.0." + ".".join(parts[2:])
+            elif parts[2] == "op":  # never happens in mid; keep explicit
+                raise AssertionError(k)
+            else:
+                r = 0 if pos == 0 else 1
+                nk = (f"mid_block.resnets.{r}."
+                      f"{inv_res['.'.join(parts[2:-1])]}.{parts[-1]}")
+        elif parts[0] == "zero_convs":
+            nk = f"controlnet_down_blocks.{parts[1]}.{parts[-1]}"
+        elif parts[0] == "middle_block_out":
+            nk = f"controlnet_mid_block.{parts[-1]}"
+        else:
+            raise AssertionError(f"unmapped ldm key {k}")
+        out[nk] = v
+    return out
+
+
+class TestDiffusersControlNet:
+    """Diffusers ``ControlNetModel`` single-file layout — how most public SDXL
+    controlnets ship. Stock ComfyUI remaps it inside its loader; here
+    ``diffusers_controlnet_to_ldm`` + the ldm converter must land on the same
+    params as the ldm path."""
+
+    def test_remap_matches_ldm_path(self, tiny_pair):
+        cfg, _, cn = tiny_pair
+        cn2 = _randomized_cn(cn, cfg)
+        ldm = _ldm_controlnet_sd(cfg, cn2.params)
+        from comfyui_parallelanything_tpu.models.convert_unet import (
+            diffusers_controlnet_to_ldm,
+        )
+
+        remapped = diffusers_controlnet_to_ldm(_diffusers_from_ldm(cfg, ldm))
+        assert sorted(remapped) == sorted(ldm)
+        got = convert_controlnet_checkpoint(remapped, cfg)
+        fg, fw = dict(flatten_tree(got)), dict(flatten_tree(cn2.params))
+        assert sorted(fg) == sorted(fw)
+        for k in fw:
+            np.testing.assert_array_equal(fg[k], fw[k], err_msg=str(k))
+
+    @staticmethod
+    def _tiny_adm_cfg(monkeypatch):
+        """Tiny label_emb-carrying config, patched in as the sniffed-SDXL
+        target (the loader resolves ``sdxl_config`` through the models
+        package namespace)."""
+        import comfyui_parallelanything_tpu.models as models_pkg
+        from comfyui_parallelanything_tpu.models.unet import UNetConfig
+
+        cfg = UNetConfig(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(1,), transformer_depth=(0, 1), num_heads=4,
+            context_dim=64, adm_in_channels=32, norm_groups=8,
+            dtype=jnp.float32,
+        )
+        monkeypatch.setattr(models_pkg, "sdxl_config", lambda: cfg)
+        return cfg
+
+    def test_unrecognized_embedding_sublayer_raises(self, tiny_pair):
+        # time_embedding.cond_proj (LCM-derived nets) must raise, not alias
+        # onto linear_2's slot and silently corrupt the time embed.
+        cfg, _, cn = tiny_pair
+        from comfyui_parallelanything_tpu.models.convert_unet import (
+            diffusers_controlnet_to_ldm,
+        )
+
+        sd = _diffusers_from_ldm(cfg, _ldm_controlnet_sd(cfg, cn.params))
+        sd["time_embedding.cond_proj.weight"] = np.zeros((4, 4), np.float32)
+        with pytest.raises(KeyError, match="unrecognized"):
+            diffusers_controlnet_to_ldm(sd)
+
+    def test_sdxl_diffusers_file_sniffs_and_runs(self, tmp_path, monkeypatch):
+        # An SDXL-style (label_emb/add_embedding-carrying) diffusers-layout
+        # file loads through the sniffing loader with no cfg, producing a
+        # ControlNet whose composition with an adm base model samples.
+        from safetensors.numpy import save_file
+
+        cfg = self._tiny_adm_cfg(monkeypatch)
+        base = build_unet(cfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+        cn = _randomized_cn(
+            build_controlnet(cfg, jax.random.key(1), sample_shape=(1, 8, 8, 4)),
+            cfg,
+        )
+        sd = _diffusers_from_ldm(cfg, _ldm_controlnet_sd(cfg, cn.params))
+        assert any(k.startswith("add_embedding.") for k in sd)
+        path = tmp_path / "sdxl_cn_diffusers.safetensors"
+        save_file({k: np.ascontiguousarray(v) for k, v in sd.items()},
+                  str(path))
+
+        loaded = load_controlnet_checkpoint(str(path))  # cfg sniffed
+        assert loaded.config.adm_in_channels == 32
+        hint = jax.random.uniform(jax.random.key(2), (1, 64, 64, 3))
+        x = jax.random.normal(jax.random.key(3), (1, 8, 8, 4))
+        t = jnp.array([300.0])
+        ctx = jax.random.normal(jax.random.key(4), (1, 5, 64))
+        y = jax.random.normal(jax.random.key(5), (1, 32))
+        want = apply_control(base, cn, hint, 1.0)(x, t, ctx, y=y)
+        got = apply_control(base, loaded, hint, 1.0)(x, t, ctx, y=y)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_sdxl_ldm_file_sniffs(self, tmp_path, monkeypatch):
+        # Same sniff through the ldm layout (label_emb.* keys), control_model.
+        # prefix included — the other common SDXL controlnet export shape.
+        from safetensors.numpy import save_file
+
+        cfg = self._tiny_adm_cfg(monkeypatch)
+        cn = build_controlnet(cfg, jax.random.key(1), sample_shape=(1, 8, 8, 4))
+        sd = _ldm_controlnet_sd(cfg, cn.params)
+        path = tmp_path / "sdxl_cn.safetensors"
+        save_file({f"control_model.{k}": np.ascontiguousarray(v)
+                   for k, v in sd.items()}, str(path))
+        loaded = load_controlnet_checkpoint(str(path))
+        assert loaded.config.adm_in_channels == 32
+
+
+class TestSDXLComposedGraph:
+    def test_sdxl_controlnet_graph_samples(self, tmp_path, monkeypatch):
+        """A stock-export SDXL graph — single-file checkpoint (dual towers
+        bundled), diffusers-layout SDXL ControlNet, ControlNetApplyAdvanced —
+        samples end to end: adm vector (pooled + size embeds) flows through
+        BOTH trunks of the composed jit program."""
+        from PIL import Image
+
+        import comfyui_parallelanything_tpu.models as models_pkg
+        from comfyui_parallelanything_tpu.host import run_workflow
+        from safetensors.numpy import save_file
+        from tests.test_stock_nodes import _synthetic_sdxl_env
+
+        env = _synthetic_sdxl_env(tmp_path, monkeypatch)
+        monkeypatch.setenv("PA_OUTPUT_DIR", str(tmp_path / "out"))
+
+        cfg = models_pkg.sdxl_config()  # the env's tiny factory
+        cn = _randomized_cn(
+            build_controlnet(cfg, jax.random.key(9), sample_shape=(1, 4, 4, 4)),
+            cfg,
+        )
+        cn_dir = tmp_path / "models" / "controlnet"
+        cn_dir.mkdir(parents=True)
+        sd = _diffusers_from_ldm(cfg, _ldm_controlnet_sd(cfg, cn.params))
+        save_file({k: np.ascontiguousarray(v) for k, v in sd.items()},
+                  str(cn_dir / "tiny_xl_cn.safetensors"))
+        monkeypatch.setenv("PA_MODELS_DIR", str(tmp_path / "models"))
+
+        in_dir = tmp_path / "input"
+        in_dir.mkdir()
+        Image.fromarray(
+            (np.random.default_rng(1).uniform(size=(32, 32, 3)) * 255)
+            .astype(np.uint8)
+        ).save(in_dir / "hint.png")
+        monkeypatch.setenv("PA_INPUT_DIR", str(in_dir))
+
+        wf = {
+            "4": {"class_type": "CheckpointLoaderSimple",
+                  "inputs": {"ckpt_name": env["ckpt"]}},
+            "5": {"class_type": "EmptyLatentImage",
+                  "inputs": {"width": 32, "height": 32, "batch_size": 1}},
+            "6": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "a watercolor lighthouse",
+                             "clip": ["4", 1]}},
+            "7": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "blurry", "clip": ["4", 1]}},
+            "10": {"class_type": "LoadImage", "inputs": {"image": "hint.png"}},
+            "11": {"class_type": "ControlNetLoader",
+                   "inputs": {"control_net_name": "tiny_xl_cn.safetensors"}},
+            "12": {"class_type": "ControlNetApplyAdvanced",
+                   "inputs": {"positive": ["6", 0], "negative": ["7", 0],
+                              "control_net": ["11", 0], "image": ["10", 0],
+                              "strength": 0.9, "start_percent": 0.0,
+                              "end_percent": 1.0}},
+            "3": {"class_type": "KSampler",
+                  "inputs": {"seed": 11, "steps": 2, "cfg": 5.0,
+                             "sampler_name": "euler", "scheduler": "normal",
+                             "denoise": 1.0, "model": ["4", 0],
+                             "positive": ["12", 0], "negative": ["12", 1],
+                             "latent_image": ["5", 0]}},
+            "8": {"class_type": "VAEDecode",
+                  "inputs": {"samples": ["3", 0], "vae": ["4", 2]}},
+        }
+        out = run_workflow(wf)
+        images = np.asarray(out["8"][0])
+        assert images.shape[0] == 1 and np.isfinite(images).all()
+        # The control steered the sample.
+        wf_plain = {k: v for k, v in wf.items() if k not in ("10", "11", "12")}
+        wf_plain["3"] = {**wf["3"], "inputs": {**wf["3"]["inputs"],
+                                               "positive": ["6", 0],
+                                               "negative": ["7", 0]}}
+        plain = np.asarray(run_workflow(wf_plain)["8"][0])
+        assert not np.allclose(images, plain, atol=1e-4)
+
+
 class TestControlParallel:
     def test_composed_model_parallelizes(self, tiny_pair, cpu_devices):
         # The merged pytree (base + control + hint) places through parallelize

@@ -139,6 +139,11 @@ def _synthetic_stock_env(tmp_path, monkeypatch):
     ckpt = tmp_path / "ckpt.safetensors"
     save_file(sd, str(ckpt))
 
+    tok_path = _word_level_tokenizer(tmp_path, monkeypatch)
+    return {"ckpt": str(ckpt), "tok": tok_path}
+
+
+def _word_level_tokenizer(tmp_path, monkeypatch) -> str:
     tokenizers = pytest.importorskip("tokenizers")
     from tokenizers.models import WordLevel
     from tokenizers.pre_tokenizers import Whitespace
@@ -151,7 +156,84 @@ def _synthetic_stock_env(tmp_path, monkeypatch):
     t.save(str(tok_path))
 
     monkeypatch.setenv("PA_TOKENIZER_JSON", str(tok_path))
-    return {"ckpt": str(ckpt), "tok": str(tok_path)}
+    return str(tok_path)
+
+
+def _synthetic_sdxl_env(tmp_path, monkeypatch):
+    """Tiny single-file SDXL checkpoint with BOTH bundled conditioner towers
+    (HF CLIP-L under conditioner.embedders.0, OpenCLIP-G under
+    conditioner.embedders.1) plus the VAE — the stock SDXL export layout,
+    sniffed as family=sdxl by CheckpointLoaderSimple. The tiny widths are
+    coupled the way the real family's are: context = L ⊕ G hidden,
+    adm = G pooled + 6×256 size embeddings."""
+    import jax
+    import jax.numpy as jnp
+    from safetensors.numpy import save_file
+
+    import comfyui_parallelanything_tpu.models as models_pkg
+    from comfyui_parallelanything_tpu.models import build_unet, build_vae
+    from comfyui_parallelanything_tpu.models.text_encoders import (
+        build_clip_text,
+        open_clip_g_config,
+    )
+    from tests.test_convert_unet import _ldm_sd
+    from tests.test_text_encoders import (
+        TINY_CLIP,
+        TestOpenCLIPConversion,
+        _hf_clip,
+    )
+    from tests.test_vae import TINY as TINY_VAE, _ldm_layout_sd
+
+    g_cfg = open_clip_g_config(
+        vocab_size=100, hidden_size=64, num_layers=2, num_heads=4,
+        max_len=16, projection_dim=64, dtype=jnp.float32,
+    )
+    real_xl = models_pkg.sdxl_config
+
+    def tiny_xl():
+        return real_xl(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(1,), transformer_depth=(0, 1), num_heads=4,
+            context_dim=TINY_CLIP.hidden_size + g_cfg.hidden_size,
+            adm_in_channels=g_cfg.projection_dim + 6 * 256,
+            norm_groups=8, dtype=jnp.float32,
+        )
+
+    import comfyui_parallelanything_tpu.models.text_encoders as te_mod
+
+    monkeypatch.setattr(models_pkg, "sdxl_config", tiny_xl)
+    monkeypatch.setattr(models_pkg, "sdxl_vae_config", lambda: TINY_VAE)
+    monkeypatch.setattr(models_pkg, "open_clip_g_config", lambda: g_cfg)
+    monkeypatch.setattr(te_mod, "clip_l_config", lambda: TINY_CLIP)
+
+    ucfg = tiny_xl()
+    unet = build_unet(ucfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+    vae = build_vae(TINY_VAE, jax.random.key(1), sample_hw=16)
+    hf = _hf_clip(TINY_CLIP, "quick_gelu")
+    g_enc = build_clip_text(g_cfg, rng=jax.random.key(2))
+    sd = {
+        f"model.diffusion_model.{k}": np.ascontiguousarray(v)
+        for k, v in _ldm_sd(ucfg, unet.params).items()
+    }
+    sd.update({
+        f"first_stage_model.{k}": np.ascontiguousarray(v)
+        for k, v in _ldm_layout_sd(TINY_VAE, vae.params).items()
+    })
+    sd.update({
+        f"conditioner.embedders.0.transformer.{k}":
+            np.ascontiguousarray(v.detach().numpy())
+        for k, v in hf.state_dict().items()
+    })
+    sd.update({
+        f"conditioner.embedders.1.model.{k}": np.ascontiguousarray(v)
+        for k, v in TestOpenCLIPConversion._openclip_layout(
+            g_cfg, g_enc.params
+        ).items()
+    })
+    ckpt = tmp_path / "sdxl_ckpt.safetensors"
+    save_file(sd, str(ckpt))
+    tok_path = _word_level_tokenizer(tmp_path, monkeypatch)
+    return {"ckpt": str(ckpt), "tok": tok_path}
 
 
 class TestStockWorkflow:
