@@ -1,0 +1,89 @@
+"""Measure the tunnel chip's USABLE HBM by binary-searching one allocation.
+
+Why this exists: the axon TPU device returns ``memory_stats() is None``
+(verify skill gotchas), so nothing reports how much HBM a rung can actually
+use — and this round the bf16 ``zimage_21`` rung (10.8 GiB weights) hit
+runtime RESOURCE_EXHAUSTED even fully sequential (batch-1 microbatches),
+which is only explainable if usable HBM is well under a full v5e's 16 GiB.
+This probe turns that inference into a measured number the evidence file can
+carry: bisect the largest single bf16 buffer that places AND survives a
+readback, print ONE JSON line.
+
+Run it in a bounded subprocess (a wedged tunnel hangs ``import jax``):
+
+    timeout 600 python scripts/probe_hbm.py
+
+Readback, not ``block_until_ready``: the tunnel's async dispatch has returned
+from ``block_until_ready`` in 2.8 ms for a 43-TFLOP step (bench.py evidence),
+so only a host readback proves the buffer really exists on the chip. A single
+buffer understates usable memory slightly (allocator headroom/fragmentation)
+but bounds the answer the right way: what one replicated param pytree can
+actually hold is at most this.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+GIB = 1 << 30
+RESOLUTION = 256 << 20  # 256 MiB
+CEILING = 40 * GIB
+
+
+def _try_alloc(nbytes: int) -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    n = max(nbytes // 2, 1)  # bf16 elements
+    try:
+        buf = jax.device_put(
+            jnp.zeros((n,), jnp.bfloat16), jax.devices()[0]
+        )
+        # Force materialization with a tiny readback touching the far end.
+        float(jnp.asarray(buf[-1].astype(jnp.float32)))
+        del buf
+        return True
+    except Exception as e:  # noqa: BLE001 — any failure counts as "does not fit"
+        markers = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                   "Resource exhausted", "OOM")
+        if not any(m in str(e) for m in markers):
+            raise
+        return False
+
+
+def main() -> None:
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform not in ("tpu", "axon"):
+        print(json.dumps({"error": f"not a TPU (platform={dev.platform})"}))
+        sys.exit(3)
+
+    lo = 0  # known-fits; hi = known-doesn't-fit (or the declared ceiling)
+    # Exponential phase up from 1 GiB, then bisect. Clamp hi to CEILING so
+    # the bisect never wastes window time on allocations above the module's
+    # own stated bound.
+    probe = GIB
+    while probe < CEILING and _try_alloc(probe):
+        lo, probe = probe, probe * 2
+    hi = min(probe, CEILING)
+    while hi - lo > RESOLUTION:
+        mid = (lo + hi) // 2
+        if _try_alloc(mid):
+            lo = mid
+        else:
+            hi = mid
+    print(json.dumps({
+        "metric": "usable HBM (largest single bf16 buffer)",
+        "value": round(lo / GIB, 2),
+        "unit": "GiB",
+        "usable_hbm_bytes": lo,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "rung": "hbm_probe",
+    }))
+
+
+if __name__ == "__main__":
+    main()

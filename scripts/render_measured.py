@@ -70,6 +70,20 @@ def render() -> str:
         out.append(f"{len(by_rung)} rung(s) banked on real TPU "
                    f"(platform tpu/axon; full records in BASELINE_measured.json).")
 
+    # Usable-HBM probe (its own artifact — a GiB number, not a rung row):
+    # banked when a rung OOMs with the microbatch ladder exhausted, i.e. when
+    # weights+overhead alone exceed the chip (memory_stats() is None on the
+    # axon device, so nothing else can report this).
+    hbm = [r for r in _lines("HBM_PROBE.json")
+           if r.get("platform") in _TPU and not r.get("invalid")]
+    if hbm:
+        r = hbm[-1]
+        out.append("")
+        out.append(f"Usable HBM (largest single bf16 buffer): "
+                   f"**{r.get('value')} GiB** on {r.get('device_kind', '?')} "
+                   f"(probe {_fmt_ts(r.get('ts'))}; why bf16 zimage_21 / "
+                   f"int8 flux_16 cannot fit single-chip — HBM_PROBE.json).")
+
     # Latest-wins dedup, same as the rung table: the watchdog retries wedged
     # benches, and the artifacts are append-only.
     # Keyed on the shape LABEL, not seq — flux_1024_joint and flux_b4 share

@@ -48,8 +48,8 @@ from bench import _TPU_PLATFORMS as _TPU, evidence_dir  # noqa: E402
 # tpu:0+cpu two-platform chain, SURVEY §7 hard part 1 on real hardware) sits
 # after the headline trio: cheap enough for a modest window, less valuable
 # than the README repro.
-RUNGS = ("zimage_21", "sd15_16", "sdxl_8", "hybrid_sd15", "flux_16_int8",
-         "flux_16", "wan_video")
+RUNGS = ("zimage_21", "zimage_21_int8", "sd15_16", "sdxl_8", "hybrid_sd15",
+         "flux_16_int8", "flux_16", "wan_video")
 
 def _attemptable(rung: str) -> bool:
     # Every rung survives a forced non-pallas run: the "xla" backend family
@@ -75,6 +75,7 @@ _PALLAS_PROBED = False
 # own built-in default (no env override).
 _MB_LADDERS: dict[str, tuple[int, ...]] = {
     "zimage_21": (3, 7, 21),
+    "zimage_21_int8": (3, 7, 21),
     "flux_16_int8": (4, 8, 16),
     "flux_16": (1, 2, 4, 8),
     "sd15_16": (1, 2, 4),
@@ -337,6 +338,13 @@ def bank_one() -> bool:
         elif _looks_oom(rec) and _deepen(rung):
             pass  # actionable failure with a known fix — no strike
         else:
+            if _looks_oom(rec):
+                # OOM with the microbatch ladder exhausted: activations are
+                # no longer the story — weights + overhead exceed the chip.
+                # Measure the chip's actual ceiling once so the evidence
+                # records WHY the rung is infeasible (memory_stats() is None
+                # on the axon device; nothing else can say).
+                _probe_hbm_once()
             _strike(rung, f"rung {rung}")
         _log(f"rung {rung}: platform={rec.get('platform')} "
              f"value={rec.get('value')} banked={ok}")
@@ -370,6 +378,53 @@ def bank_one() -> bool:
     return False
 
 
+_HBM_TRIES = 0
+_HBM_MAX_TRIES = 3
+
+
+def _hbm_probe_path() -> str:
+    # Its OWN evidence file, NOT BASELINE_measured.json: a GiB record mixed
+    # into the rung file would render as a bogus benchmark row and inflate
+    # the banked-rung count (render_measured filters only platform/invalid).
+    return os.path.join(evidence_dir(), "HBM_PROBE.json")
+
+
+def _probe_hbm_once(timeout: int = 600) -> None:
+    """Bisect the chip's usable HBM in a bounded child (scripts/probe_hbm.py)
+    and bank the result to ``HBM_PROBE.json`` — until it succeeds once
+    (bounded retries: a tunnel flap must not forfeit the measurement for the
+    session, but the probe costs minutes of window so it can't retry
+    forever)."""
+    global _HBM_TRIES
+    if _HBM_TRIES >= _HBM_MAX_TRIES or os.path.exists(_hbm_probe_path()):
+        return
+    _HBM_TRIES += 1
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "scripts", "probe_hbm.py")],
+            cwd=_REPO, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        _log("hbm probe timed out (wedged tunnel?) — will retry on the next "
+             "exhausted-OOM")
+        return
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        _log(f"hbm probe produced no JSON: {proc.stderr.strip()[-200:]}")
+        return
+    if "usable_hbm_bytes" in rec:
+        rec["ts"] = time.time()
+        with open(_hbm_probe_path(), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        _HBM_TRIES = _HBM_MAX_TRIES
+        _log(f"hbm probe: usable ≈ {rec['value']} GiB "
+             f"({rec.get('device_kind', '?')})")
+    else:
+        _log(f"hbm probe error: {rec}")
+
+
 def _run_script(name: str, *args: str, timeout: int = 3600) -> None:
     """A hung child (wedged tunnel) must not take the persistent watchdog down
     with it — swallow the timeout; the banked checks decide what happens next."""
@@ -388,7 +443,18 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--interval", type=int, default=120,
                     help="seconds between tunnel probes while down")
-    interval = ap.parse_args().interval
+    ap.add_argument("--skip", default="",
+                    help="comma-separated rungs to treat as capped from the "
+                         "start (e.g. a rung prior evidence proves infeasible "
+                         "on this chip — a restart must not re-burn the "
+                         "window climbing its microbatch ladder)")
+    ns = ap.parse_args()
+    interval = ns.interval
+    for rung in filter(None, ns.skip.split(",")):
+        if rung not in RUNGS:
+            ap.error(f"--skip {rung!r}: not a rung (choices: {RUNGS})")
+        _FAILS[rung] = _MAX_FAILS
+        _log(f"skipping rung {rung} (--skip)")
 
     def capped(key: str) -> bool:
         return _FAILS.get(key, 0) >= _MAX_FAILS

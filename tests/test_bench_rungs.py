@@ -86,3 +86,22 @@ class TestMakeStep:
         # must divide their batches — checked without building the 12 GiB
         # models by reading the rung declarations.
         assert 21 % 3 == 0 and 16 % 4 == 0
+
+    def test_zimage_int8_fallback_rung_registered(self):
+        # The int8-weight headline fallback (bf16 zimage_21 exceeds the
+        # tunnel chip's usable HBM even fully sequential — BASELINE_measured
+        # evidence) must be a real rung, and the watchdog must know it and
+        # its microbatch ladder.
+        assert "zimage_21_int8" in bench._RUNGS
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "tpu_watchdog_mod",
+            os.path.join(os.path.dirname(bench.__file__), "scripts",
+                         "tpu_watchdog.py"),
+        )
+        wd = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(wd)
+        assert "zimage_21_int8" in wd.RUNGS
+        assert wd._MB_LADDERS["zimage_21_int8"][0] == 3

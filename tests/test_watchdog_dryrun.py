@@ -121,9 +121,25 @@ def test_watchdog_banks_everything_end_to_end(tmp_path):
     body = md.split("<!-- measured:begin -->")[1].split("<!-- measured:end -->")[0]
     assert "zimage_21" in body and "tiny_128d" in body
 
-    # --- the repo's real evidence was never touched ---
+    # --- the fake-platform guard: no DRYRUN record may leak into the repo's
+    # real evidence file. A concurrently-running REAL banking session (the
+    # round-long watchdog, VERDICT item 1) may legitimately append real
+    # records while this test runs, so assert append-only + no leaked dryrun
+    # markers rather than byte equality.
     real_after = open(real_measured).read() if os.path.exists(real_measured) else None
-    assert real_after == real_before
+    if real_before is not None:
+        assert real_after is not None and real_after.startswith(real_before), (
+            "repo evidence was rewritten (not appended) during the dry-run")
+        appended = real_after[len(real_before):]
+    else:
+        appended = real_after or ""
+    for line in filter(str.strip, appended.splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # concurrent writer mid-append — not a leak verdict
+        assert not rec.get("dryrun"), (
+            f"dryrun record leaked into repo evidence: {rec}")
     assert not os.path.exists(os.path.join(_REPO, "evidence"))
 
 
