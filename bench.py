@@ -581,6 +581,7 @@ def run_inner() -> None:
     )
 
     from comfyui_parallelanything_tpu.ops.attention import (
+        chunk_config,
         get_attention_backend,
         resolved_backends,
     )
@@ -603,6 +604,9 @@ def run_inner() -> None:
         # "auto" setting. Falls back to the configured setting if the model
         # has no attention at all.
         "attention_backend": "+".join(resolved_backends()) or get_attention_backend(),
+        # Which chunked-attention configuration served the run (the sd15_16
+        # MFU-budget sweep dimension): threshold elems + softmax dtype.
+        "attn_chunk": chunk_config(),
     }
     if _FAKE_TPU or _TINY:
         record["dryrun"] = True

@@ -111,10 +111,14 @@ def get_attention_backend() -> str:
     return _BACKEND
 
 
-def _xla_attention(q, k, v, scale):
-    # (B, S, H, D) -> einsum over D; stable softmax in f32.
+def _xla_attention(q, k, v, scale, logits_dtype=jnp.float32):
+    # (B, S, H, D) -> einsum over D; stable softmax (jax.nn.softmax subtracts
+    # the row max) in ``logits_dtype`` — f32 everywhere EXCEPT the chunked
+    # scan under the measured chunk tuning (see _xla_chunked_attention): the
+    # sweep only measures that path, so the bf16 knob must not leak into
+    # other models' plain-XLA softmax.
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    logits = logits.astype(jnp.float32)
+    logits = logits.astype(logits_dtype)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -125,6 +129,61 @@ def _xla_attention(q, k, v, scale):
 # their 40/64-dim heads can never take the lane-aligned pallas kernel, so
 # chunking is the only way those workloads fit a chip at all.
 _CHUNK_THRESHOLD = 2**27
+
+# Measured chunk tuning (the sd15_16 MFU-budget fixes, BASELINE.md): the
+# watchdog's chunk sweep benches {threshold × softmax-dtype} combos on
+# hardware and persists the winner here; env vars override per-process for
+# the sweep itself. Read at trace time — bench children are fresh processes.
+_CHUNK_TUNING_PATH = os.environ.get("PA_ATTN_CHUNK_TUNING") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "attn_chunk.json"
+)
+
+
+@functools.cache
+def _chunk_tuning() -> dict:
+    import json
+
+    try:
+        with open(_CHUNK_TUNING_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _chunk_threshold() -> int:
+    env = os.environ.get("PA_ATTN_CHUNK_ELEMS")
+    if env:
+        return int(env)
+    return int(_chunk_tuning().get("chunk_elems", _CHUNK_THRESHOLD))
+
+
+def _softmax_dtype():
+    env = os.environ.get("PA_ATTN_BF16_SOFTMAX")
+    if env is not None:
+        return jnp.bfloat16 if env == "1" else jnp.float32
+    return jnp.bfloat16 if _chunk_tuning().get("bf16_softmax") else jnp.float32
+
+
+def chunk_config() -> dict:
+    """The chunk settings serving this process (evidence labeling: a bench
+    record must say which configuration produced the number). ``sources``
+    attributes each value separately — one env var being set must not
+    mislabel the other value's provenance."""
+    def src(env_name: str, table_key: str) -> str:
+        if os.environ.get(env_name) is not None:
+            return "env"
+        if table_key in _chunk_tuning():
+            return _chunk_tuning().get("source", "measured")
+        return "default"
+
+    return {
+        "chunk_elems": _chunk_threshold(),
+        "bf16_softmax": _softmax_dtype() == jnp.bfloat16,
+        "sources": {
+            "chunk_elems": src("PA_ATTN_CHUNK_ELEMS", "chunk_elems"),
+            "bf16_softmax": src("PA_ATTN_BF16_SOFTMAX", "bf16_softmax"),
+        },
+    }
 
 # Block size of jax's upstream TPU flash kernel
 # (pallas.ops.tpu.flash_attention.BlockSizes.get_default — 128 on every axis in
@@ -143,7 +202,7 @@ def _xla_chunked_attention(q, k, v, scale):
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     per_row = B * H * Sk
-    block_q = max(16, min(Sq, _CHUNK_THRESHOLD // max(per_row, 1)) // 16 * 16)
+    block_q = max(16, min(Sq, _chunk_threshold() // max(per_row, 1)) // 16 * 16)
     if block_q >= Sq:
         return _xla_attention(q, k, v, scale)
     nq = -(-Sq // block_q)
@@ -152,9 +211,13 @@ def _xla_chunked_attention(q, k, v, scale):
     # (nq, B, block_q, H, D): scan over leading block axis; padded query rows
     # attend normally and are sliced away after.
     qb = qp.reshape(B, nq, block_q, H, D).transpose(1, 0, 2, 3, 4)
+    # The measured softmax dtype applies to THIS path only — it's what the
+    # chunk sweep benches (the scan's per-block logits round-trips are the
+    # sd15_16 MFU budget's dominant traffic); plain-XLA softmax stays f32.
+    logits_dtype = _softmax_dtype()
 
     def body(_, qblk):
-        return None, _xla_attention(qblk, k, v, scale)
+        return None, _xla_attention(qblk, k, v, scale, logits_dtype=logits_dtype)
 
     _, out = jax.lax.scan(body, None, qb)
     out = out.transpose(1, 0, 2, 3, 4).reshape(B, nq * block_q, H, D)
@@ -229,7 +292,7 @@ def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
         # sequence takes the safe XLA family rather than crashing at trace
         # time on a shape the sweep never measured.
         backend = "xla"
-    if backend == "xla" and logit_elems > _CHUNK_THRESHOLD:
+    if backend == "xla" and logit_elems > _chunk_threshold():
         # "xla" means the XLA family: shapes whose S×S logits would blow HBM
         # (pallas-ineligible 40/64-dim UNet heads at 1024², or a forced
         # non-pallas run) go through the chunked path instead of OOMing.

@@ -70,7 +70,7 @@ def _run_shapes(shapes, on_tpu, dev):
     import jax.numpy as jnp
 
     from comfyui_parallelanything_tpu.ops.attention import (
-        _CHUNK_THRESHOLD,
+        _chunk_threshold,
         _xla_attention,
         _xla_chunked_attention,
     )
@@ -80,9 +80,11 @@ def _run_shapes(shapes, on_tpu, dev):
 
     def xla_family(a, b_, c, scale):
         # The real competitor the auto backend would pick: chunked when the
-        # S×S logits would blow HBM, plain otherwise (ops/attention.py).
+        # S×S logits would blow HBM, plain otherwise — routed on the LIVE
+        # threshold (env + persisted chunk tuning), same as attention_local,
+        # so pallas_wins decisions compare against production routing.
         elems = a.shape[0] * a.shape[2] * a.shape[1] * b_.shape[1]
-        if elems > _CHUNK_THRESHOLD:
+        if elems > _chunk_threshold():
             return _xla_chunked_attention(a, b_, c, scale)
         return _xla_attention(a, b_, c, scale)
 

@@ -287,6 +287,125 @@ def sampler_banked() -> bool:
 # rendered table.
 _RETUNE_RUNGS = ("sd15_16", "sdxl_8")
 
+# Chunked-attention sweep (the sd15_16 MFU-budget fixes, BASELINE.md): bench
+# the staged {chunk threshold × softmax dtype} combos on the rung the budget
+# says is scan-bound, persist the winner to ops/attn_chunk.json so future
+# default-env runs (incl. the driver's end-of-round bench) ship it. Sweep
+# order mirrors the budget's expectations: bigger blocks first, then bf16
+# logits on top.
+_CHUNK_SWEEP_RUNG = "sd15_16"
+_CHUNK_COMBOS: tuple[dict, ...] = (
+    {},
+    {"PA_ATTN_CHUNK_ELEMS": str(2**29)},
+    {"PA_ATTN_CHUNK_ELEMS": str(2**29), "PA_ATTN_BF16_SOFTMAX": "1"},
+    {"PA_ATTN_CHUNK_ELEMS": str(2**30), "PA_ATTN_BF16_SOFTMAX": "1"},
+)
+
+
+def _chunk_tuning_path() -> str:
+    return os.environ.get("PA_ATTN_CHUNK_TUNING") or os.path.join(
+        _REPO, "comfyui_parallelanything_tpu", "ops", "attn_chunk.json"
+    )
+
+
+def chunk_sweep_banked() -> bool:
+    try:
+        with open(_chunk_tuning_path()) as f:
+            return json.load(f).get("source") == "measured"
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def _combo_key(combo: dict) -> str:
+    return json.dumps(combo, sort_keys=True)
+
+
+def _chunk_sweep_state() -> tuple[dict[str, dict], dict[str, int]]:
+    """(best TPU record per combo, failure count per combo) from
+    CHUNK_SWEEP.json — the sweep's own artifact, so losing/partial combo
+    measurements never pollute the rung table (latest-wins rendering reads
+    BASELINE_measured.json only) and a flap-interrupted sweep resumes where
+    it left off instead of re-burning measured combos."""
+    path = os.path.join(evidence_dir(), "CHUNK_SWEEP.json")
+    done: dict[str, dict] = {}
+    fails: dict[str, int] = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                key = _combo_key(rec.get("attn_env", {}))
+                if rec.get("platform") in _TPU and not rec.get("invalid"):
+                    done[key] = rec
+                else:
+                    fails[key] = fails.get(key, 0) + 1
+    return done, fails
+
+
+def _run_chunk_sweep() -> None:
+    """Measure the staged chunk combos on the sweep rung (resumably), persist
+    the winner, then ALWAYS re-run the rung under the persisted table with
+    default env — the confirmation run is the only record that lands in
+    BASELINE_measured.json, so the rendered number is the shipping
+    configuration's, never a losing combo's."""
+    from measure_tpu import record_result, run_rung  # noqa: E402
+
+    mb = _rung_env(_CHUNK_SWEEP_RUNG)
+    sweep_path = os.path.join(evidence_dir(), "CHUNK_SWEEP.json")
+    if not chunk_sweep_banked():
+        done, fails = _chunk_sweep_state()
+        for combo in _CHUNK_COMBOS:
+            key = _combo_key(combo)
+            if key in done or fails.get(key, 0) >= 2:
+                continue  # measured, or twice-failed (likely OOM) — move on
+            rec = run_rung(_CHUNK_SWEEP_RUNG, extra_env={**mb, **combo})
+            rec["attn_env"] = combo
+            rec["ts"] = time.time()
+            with open(sweep_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            if rec.get("platform") in _TPU:
+                _log(f"chunk sweep {combo or 'default'}: {rec['value']} s/it")
+            else:
+                _log(f"chunk sweep {combo or 'default'} failed "
+                     f"({rec.get('platform')})")
+                if not probe():
+                    _log("chunk sweep paused (tunnel down); resumes at the "
+                         "unmeasured combos next window")
+                    return
+        done, fails = _chunk_sweep_state()
+        resolved = sum(
+            1 for c in _CHUNK_COMBOS
+            if _combo_key(c) in done or fails.get(_combo_key(c), 0) >= 2
+        )
+        if not done or resolved < len(_CHUNK_COMBOS):
+            return
+        best_key, best_rec = min(
+            done.items(), key=lambda kv: float(kv[1]["value"])
+        )
+        best = json.loads(best_key)
+        table = {
+            "source": "measured",
+            "chunk_elems": int(best.get("PA_ATTN_CHUNK_ELEMS", 2**27)),
+            "bf16_softmax": best.get("PA_ATTN_BF16_SOFTMAX") == "1",
+            "rung": _CHUNK_SWEEP_RUNG,
+            "best_s_it": float(best_rec["value"]),
+            "ts": time.time(),
+        }
+        with open(_chunk_tuning_path(), "w") as f:
+            json.dump(table, f, indent=1)
+        _log(f"chunk sweep winner {best or 'default'} "
+             f"({best_rec['value']} s/it) — persisted to "
+             f"{os.path.basename(_chunk_tuning_path())}")
+    # Shipping-config confirmation under the persisted table (also the resume
+    # point when a previous window banked the table but lost this run).
+    rec = record_result(run_rung(_CHUNK_SWEEP_RUNG, extra_env=mb))
+    if rec.get("platform") in _TPU:
+        _run_script("render_measured.py", timeout=120)
+    else:
+        _log("chunk sweep confirmation run failed; retries next window")
+
 
 def stale_after_tuning() -> list[str]:
     """Rungs banked BEFORE the measured tuning table was written."""
@@ -375,7 +494,47 @@ def bank_one() -> bool:
         _log(f"retune {rung}: platform={rec.get('platform')} "
              f"value={rec.get('value')} banked={ok}")
         return True
+    if _chunk_sweep_due():
+        _log("running chunked-attention sweep (sd15_16 MFU-budget fixes)")
+        _run_chunk_sweep()
+        ok = chunk_sweep_banked() and _chunk_confirmed()
+        if not ok:
+            _strike("chunk_sweep", "chunk sweep")
+        _log(f"chunk sweep done, banked={ok}")
+        return True
     return False
+
+
+def _chunk_confirmed() -> bool:
+    """A default-env sweep-rung record postdating the persisted table — the
+    shipping configuration's number is what the rendered table shows."""
+    try:
+        table_ts = os.path.getmtime(_chunk_tuning_path())
+    except OSError:
+        return False
+    return any(
+        float(r.get("ts", 0)) > table_ts
+        for r in _tpu_records("BASELINE_measured.json")
+        if r.get("rung") == _CHUNK_SWEEP_RUNG
+    )
+
+
+def _chunk_sweep_due() -> bool:
+    """The sweep is worth a window only after the retune flow settles AND the
+    chunked path still serves the sweep rung (a kernel-sweep win for 40-dim
+    heads would route attention off the scan entirely). A banked table with
+    no confirmation run yet keeps the sweep due — the confirmation is the
+    resume point."""
+    if _FAILS.get("chunk_sweep", 0) >= _MAX_FAILS:
+        return False
+    if chunk_sweep_banked():
+        return not _chunk_confirmed()
+    recs = [r for r in _tpu_records("BASELINE_measured.json")
+            if r.get("rung") == _CHUNK_SWEEP_RUNG]
+    if not recs:
+        return False
+    latest = max(recs, key=lambda r: float(r.get("ts", 0)))
+    return "xla_chunked" in str(latest.get("attention_backend", ""))
 
 
 _HBM_TRIES = 0
@@ -464,7 +623,8 @@ def main() -> None:
         missing = [r for r in RUNGS if r not in done and _attemptable(r)]
         if (not missing and (kernels_banked() or capped("kernels"))
                 and (sampler_banked() or capped("sampler"))
-                and not stale_after_tuning()):
+                and not stale_after_tuning()
+                and not _chunk_sweep_due()):
             _log("all attemptable TPU evidence banked — exiting")
             return
         if probe():

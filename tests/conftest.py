@@ -14,6 +14,16 @@ import os
 # wedged tunnel otherwise blocks jax import even for CPU work).
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Hermetic chunked-attention defaults: once the watchdog's chunk sweep banks a
+# measured ops/attn_chunk.json, default-env processes serve it — but the test
+# suite asserts against the built-in defaults. Point the tuning path at a
+# nonexistent file (tests that exercise the table monkeypatch the module's
+# _CHUNK_TUNING_PATH directly).
+os.environ.setdefault("PA_ATTN_CHUNK_TUNING", os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "nonexistent-attn-chunk.json"
+))
+os.environ.pop("PA_ATTN_CHUNK_ELEMS", None)
+os.environ.pop("PA_ATTN_BF16_SOFTMAX", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

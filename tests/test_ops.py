@@ -138,6 +138,58 @@ class TestChunkedAttention:
         att.attention_local(q, k, v)  # 1*2*32*32 = 2048 > 64 -> chunked
         assert att.resolved_backends() == ("xla_chunked",)
 
+    def test_bf16_softmax_env_matches_f32_at_bf16_tolerance(self, monkeypatch):
+        # The sd15_16 MFU-budget lever: bf16 logits+softmax halves the chunked
+        # path's HBM traffic; numerics must stay within bf16 tolerances.
+        att = self._mod()
+        q, k, v = _qkv(b=2, sq=96, sk=64, h=2, d=16, seed=7)
+        monkeypatch.setattr(att, "_CHUNK_THRESHOLD", 2 * 2 * 64 * 16)
+        ref = att._xla_chunked_attention(q, k, v, scale=16 ** -0.5)
+        monkeypatch.setenv("PA_ATTN_BF16_SOFTMAX", "1")
+        out = att._xla_chunked_attention(q, k, v, scale=16 ** -0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_chunk_elems_env_overrides_threshold(self, monkeypatch):
+        att = self._mod()
+        monkeypatch.setattr(att, "_RESOLVED", set())
+        monkeypatch.setenv("PA_ATTN_CHUNK_ELEMS", "64")
+        q, k, v = _qkv(b=1, sq=32, sk=32, h=2, d=8)
+        att.attention_local(q, k, v)  # 2048 > 64 -> chunked
+        assert att.resolved_backends() == ("xla_chunked",)
+        assert att.chunk_config() == {
+            "chunk_elems": 64, "bf16_softmax": False,
+            # Per-field provenance: only the threshold came from the env.
+            "sources": {"chunk_elems": "env", "bf16_softmax": "default"},
+        }
+
+    def test_persisted_chunk_tuning_honored(self, tmp_path, monkeypatch):
+        # The watchdog's chunk sweep persists the measured winner; a fresh
+        # process (no env) must serve it.
+        import json as _json
+
+        att = self._mod()
+        path = tmp_path / "attn_chunk.json"
+        path.write_text(_json.dumps(
+            {"source": "measured", "chunk_elems": 128, "bf16_softmax": True}
+        ))
+        monkeypatch.setattr(att, "_CHUNK_TUNING_PATH", str(path))
+        att._chunk_tuning.cache_clear()
+        try:
+            assert att._chunk_threshold() == 128
+            assert att._softmax_dtype() == jnp.bfloat16
+            cfg = att.chunk_config()
+            assert cfg["sources"] == {"chunk_elems": "measured",
+                                      "bf16_softmax": "measured"}
+            assert cfg["chunk_elems"] == 128
+            # Env still wins over the persisted table (the sweep itself).
+            monkeypatch.setenv("PA_ATTN_CHUNK_ELEMS", "256")
+            monkeypatch.setenv("PA_ATTN_BF16_SOFTMAX", "0")
+            assert att._chunk_threshold() == 256
+            assert att._softmax_dtype() == jnp.float32
+        finally:
+            att._chunk_tuning.cache_clear()
+
     def test_explicit_backend_name(self, monkeypatch):
         att = self._mod()
         att.set_attention_backend("xla_chunked")

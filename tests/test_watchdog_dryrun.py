@@ -166,6 +166,74 @@ def test_oom_deepens_microbatch_ladder_without_striking():
         wd._MB_IDX.clear()
 
 
+def test_chunk_sweep_gating(tmp_path, monkeypatch):
+    """The chunked-attention sweep runs only when the sweep rung's latest TPU
+    record still uses the chunked path, and reads as banked once a measured
+    table is persisted."""
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    import tpu_watchdog as wd
+
+    evidence = tmp_path / "evidence"
+    evidence.mkdir()
+    tuning = tmp_path / "attn_chunk.json"
+    monkeypatch.setenv("PA_EVIDENCE_DIR", str(evidence))
+    monkeypatch.setenv("PA_ATTN_CHUNK_TUNING", str(tuning))
+    wd._FAILS.pop("chunk_sweep", None)
+
+    assert not wd._chunk_sweep_due()  # no records at all
+    measured = evidence / "BASELINE_measured.json"
+    with open(measured, "w") as f:
+        f.write(json.dumps({"rung": "sd15_16", "platform": "tpu",
+                            "attention_backend": "xla+xla_chunked",
+                            "ts": 1.0}) + "\n")
+    assert wd._chunk_sweep_due()
+    # A later record served by the fused kernel ends the sweep's relevance.
+    with open(measured, "a") as f:
+        f.write(json.dumps({"rung": "sd15_16", "platform": "tpu",
+                            "attention_backend": "pallas",
+                            "ts": 2.0}) + "\n")
+    assert not wd._chunk_sweep_due()
+    with open(measured, "a") as f:
+        f.write(json.dumps({"rung": "sd15_16", "platform": "tpu",
+                            "attention_backend": "xla+xla_chunked",
+                            "ts": 3.0}) + "\n")
+    assert wd._chunk_sweep_due()
+    tuning.write_text(json.dumps({"source": "measured", "chunk_elems": 2**29,
+                                  "bf16_softmax": True}))
+    assert wd.chunk_sweep_banked()
+    # Banked but unconfirmed (no default-env record postdates the table):
+    # the sweep stays due — the confirmation run is the resume point.
+    assert wd._chunk_sweep_due() and not wd._chunk_confirmed()
+    table_ts = os.path.getmtime(tuning)
+    with open(measured, "a") as f:
+        f.write(json.dumps({"rung": "sd15_16", "platform": "tpu",
+                            "attention_backend": "xla+xla_chunked",
+                            "ts": table_ts + 60}) + "\n")
+    assert wd._chunk_confirmed()
+    assert not wd._chunk_sweep_due()
+
+
+def test_chunk_sweep_state_resumes(tmp_path, monkeypatch):
+    """CHUNK_SWEEP.json parsing: measured combos are skipped on resume,
+    twice-failed combos read as capped, partial lines are tolerated."""
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    import tpu_watchdog as wd
+
+    evidence = tmp_path / "evidence"
+    evidence.mkdir()
+    monkeypatch.setenv("PA_EVIDENCE_DIR", str(evidence))
+    combo = {"PA_ATTN_CHUNK_ELEMS": str(2**29)}
+    with open(evidence / "CHUNK_SWEEP.json", "w") as f:
+        f.write(json.dumps({"attn_env": {}, "platform": "tpu",
+                            "value": 2.5}) + "\n")
+        f.write(json.dumps({"attn_env": combo, "platform": "cpu"}) + "\n")
+        f.write(json.dumps({"attn_env": combo, "platform": "cpu"}) + "\n")
+        f.write('{"truncated...\n')
+    done, fails = wd._chunk_sweep_state()
+    assert wd._combo_key({}) in done
+    assert fails[wd._combo_key(combo)] == 2
+
+
 def test_bench_microbatch_override_rounds_to_divisor(tmp_path):
     """BENCH_MICROBATCH=5 on a batch-8 tiny rung must round up to the next
     divisor (8), never crash on indivisibility."""
