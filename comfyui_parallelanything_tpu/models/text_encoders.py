@@ -349,6 +349,27 @@ def sdxl_text_conditioning(
     return context, y
 
 
+def sdxl_refiner_text_conditioning(g_penultimate, g_pooled, width: int,
+                                   height: int, ascore: float,
+                                   crop_x: int = 0, crop_y: int = 0):
+    """Assemble the SDXL-REFINER (context, y) pair: context = the OpenCLIP-G
+    penultimate stream alone (1280-wide — the refiner has no CLIP-L tower);
+    y = G pooled (1280) ⊕ five sinusoidal embeddings (256 each) in the
+    refiner embedder's order — height, width, crop_y, crop_x, aesthetic
+    score — totalling 2560 = the refiner UNet's adm_in_channels."""
+    from ..ops.basic import timestep_embedding
+
+    context = g_penultimate.astype(jnp.float32)
+    B = g_pooled.shape[0]
+    vals = [height, width, crop_y, crop_x, ascore]
+    embs = [
+        timestep_embedding(jnp.full((B,), float(v), jnp.float32), 256)
+        for v in vals
+    ]
+    y = jnp.concatenate([g_pooled.astype(jnp.float32)] + embs, axis=-1)
+    return context, y
+
+
 def sd3_text_conditioning(l_penultimate, g_penultimate, l_pooled, g_pooled,
                           t5_context=None, context_dim: int = 4096):
     """Assemble SD3's (context, y): the CLIP joint stream (L ⊕ G penultimate,

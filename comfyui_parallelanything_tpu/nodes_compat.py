@@ -1819,6 +1819,466 @@ class PreviewImage:
         return TPUSaveImage().save(images, filename_prefix="temp/preview")
 
 
+class CLIPTextEncodeSDXLRefiner:
+    """Stock refiner encode: ONE prompt through the OpenCLIP-G tower with the
+    refiner's (size, crop, aesthetic-score) conditioning vector. Accepts the
+    sdxl-dual wire (uses its G tower — the stock base→refiner template wires
+    the base checkpoint's CLIP here too) or a single G-tower CLIP wire."""
+
+    DESCRIPTION = "Stock-name SDXL-refiner text encode (aesthetic score adm)."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "encode"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip": ("CLIP", {}),
+                "ascore": ("FLOAT", {"default": 6.0, "min": 0.0,
+                                     "max": 1000.0}),
+                "width": ("INT", {"default": 1024, "min": 0, "max": 16384}),
+                "height": ("INT", {"default": 1024, "min": 0, "max": 16384}),
+                "text": ("STRING", {"default": "", "multiline": True}),
+            }
+        }
+
+    def encode(self, clip, ascore: float, width: int, height: int, text: str):
+        from .models.text_encoders import sdxl_refiner_text_conditioning
+        from .nodes import TPUTextEncode
+
+        g_wire = clip["g"] if clip.get("type") == "sdxl-dual" else clip
+        if g_wire.get("encoder") is None:
+            raise ValueError(
+                "CLIPTextEncodeSDXLRefiner needs a G-tower CLIP wire (the "
+                "sdxl-dual wire from an SDXL checkpoint, or TPUCLIPLoader "
+                "type=open-clip-g)"
+            )
+        clip_skip = int(clip.get("clip_skip", g_wire.get("clip_skip", 0)))
+        (cg,) = TPUTextEncode().encode(g_wire, text, clip_skip)
+        stream = cg["penultimate"] if clip_skip == 0 else cg["context"]
+        context, y = sdxl_refiner_text_conditioning(
+            stream, cg["pooled"], width=width, height=height,
+            ascore=float(ascore),
+        )
+        return ({"context": context, "penultimate": None, "pooled": y},)
+
+
+class ConditioningConcat:
+    """Stock concat: ``conditioning_from``'s tokens append onto
+    ``conditioning_to``'s along the sequence axis (ONE longer prompt — unlike
+    Combine, which keeps both prompts separate and blends predictions).
+    conditioning_to's other fields (pooled, control tags, …) win."""
+
+    DESCRIPTION = "Stock-name conditioning token concat."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "concat"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning_to": ("CONDITIONING", {}),
+                "conditioning_from": ("CONDITIONING", {}),
+            }
+        }
+
+    def concat(self, conditioning_to, conditioning_from):
+        import jax.numpy as jnp
+
+        to_ctx = conditioning_to.get("context")
+        from_ctx = conditioning_from.get("context")
+        if to_ctx is None or from_ctx is None:
+            raise ValueError("ConditioningConcat needs text conditionings "
+                             "with a context stream on both inputs")
+        if to_ctx.shape[-1] != from_ctx.shape[-1]:
+            raise ValueError(
+                f"cannot concat conditionings of different widths "
+                f"({to_ctx.shape[-1]} vs {from_ctx.shape[-1]} — e.g. an SDXL "
+                "dual-tower cond with a plain CLIP-L one)"
+            )
+        if from_ctx.shape[0] != to_ctx.shape[0]:
+            from_ctx = _repeat_to_batch(from_ctx, to_ctx.shape[0])
+        return ({**conditioning_to,
+                 "context": jnp.concatenate([to_ctx, from_ctx], axis=1)},)
+
+
+class ImageInvert:
+    DESCRIPTION = "Stock-name image invert (1 - pixels)."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "invert"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"image": ("IMAGE", {})}}
+
+    def invert(self, image):
+        import jax.numpy as jnp
+
+        return (1.0 - jnp.asarray(image),)
+
+
+class ImageBatch:
+    """Stock batch join: the second image resizes (bilinear) to the first's
+    spatial size when they differ, then both concatenate along batch."""
+
+    DESCRIPTION = "Stock-name image batch concat."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "batch"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"image1": ("IMAGE", {}),
+                             "image2": ("IMAGE", {})}}
+
+    def batch(self, image1, image2):
+        import jax
+        import jax.numpy as jnp
+
+        a = jnp.asarray(image1)
+        b = jnp.asarray(image2)
+        if a.ndim == 3:
+            a = a[None]
+        if b.ndim == 3:
+            b = b[None]
+        if b.shape[1:3] != a.shape[1:3]:
+            b = jax.image.resize(
+                b, (b.shape[0], *a.shape[1:3], b.shape[-1]), method="bilinear"
+            )
+        return (jnp.concatenate([a, b], axis=0),)
+
+
+class RepeatLatentBatch:
+    DESCRIPTION = "Stock-name latent batch repeat."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "repeat"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"samples": ("LATENT", {}),
+                             "amount": ("INT", {"default": 1, "min": 1,
+                                                "max": 64})}}
+
+    def repeat(self, samples, amount: int):
+        import jax.numpy as jnp
+
+        lat = jnp.asarray(samples["samples"])
+        out = dict(samples)
+        out["samples"] = jnp.tile(
+            lat, (int(amount),) + (1,) * (lat.ndim - 1)
+        )
+        if samples.get("noise_mask") is not None:
+            # Cycle the mask up to the SAMPLES batch first (stock
+            # repeat_to_batch_size), then tile — so masks stay paired with
+            # their samples instead of landing at a batch that matches
+            # neither the latents nor 1.
+            m = _repeat_to_batch(
+                jnp.asarray(samples["noise_mask"]), lat.shape[0]
+            )
+            out["noise_mask"] = jnp.tile(
+                m, (int(amount),) + (1,) * (m.ndim - 1)
+            )
+        return (out,)
+
+
+class LatentFromBatch:
+    DESCRIPTION = "Stock-name latent batch slice."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "frombatch"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"samples": ("LATENT", {}),
+                             "batch_index": ("INT", {"default": 0, "min": 0,
+                                                     "max": 4095}),
+                             "length": ("INT", {"default": 1, "min": 1,
+                                                "max": 4096})}}
+
+    def frombatch(self, samples, batch_index: int, length: int):
+        import jax.numpy as jnp
+
+        lat = jnp.asarray(samples["samples"])
+        i = min(int(batch_index), lat.shape[0] - 1)
+        n = min(int(length), lat.shape[0] - i)
+        out = dict(samples)
+        out["samples"] = lat[i:i + n]
+        if samples.get("noise_mask") is not None:
+            m = jnp.asarray(samples["noise_mask"])
+            if m.shape[0] > 1:
+                # Cycle up to the samples batch BEFORE slicing (stock rule) —
+                # a mask batch smaller than the latent batch would otherwise
+                # slice short or empty.
+                out["noise_mask"] = _repeat_to_batch(m, lat.shape[0])[i:i + n]
+        return (out,)
+
+
+class SolidMask:
+    DESCRIPTION = "Stock-name constant mask."
+    RETURN_TYPES = ("MASK",)
+    RETURN_NAMES = ("mask",)
+    FUNCTION = "solid"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "value": ("FLOAT", {"default": 1.0, "min": 0.0, "max": 1.0}),
+            "width": ("INT", {"default": 512, "min": 1, "max": 16384}),
+            "height": ("INT", {"default": 512, "min": 1, "max": 16384}),
+        }}
+
+    def solid(self, value: float, width: int, height: int):
+        import jax.numpy as jnp
+
+        return (jnp.full((1, int(height), int(width)), float(value),
+                         jnp.float32),)
+
+
+class InvertMask:
+    DESCRIPTION = "Stock-name mask invert."
+    RETURN_TYPES = ("MASK",)
+    RETURN_NAMES = ("mask",)
+    FUNCTION = "invert"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"mask": ("MASK", {})}}
+
+    def invert(self, mask):
+        import jax.numpy as jnp
+
+        return (1.0 - jnp.asarray(mask, jnp.float32),)
+
+
+class ImageToMask:
+    DESCRIPTION = "Stock-name channel extract (image → mask)."
+    RETURN_TYPES = ("MASK",)
+    RETURN_NAMES = ("mask",)
+    FUNCTION = "image_to_mask"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"image": ("IMAGE", {}),
+                             "channel": (["red", "green", "blue", "alpha"],
+                                         {"default": "red"})}}
+
+    def image_to_mask(self, image, channel: str = "red"):
+        import jax.numpy as jnp
+
+        img = jnp.asarray(image)
+        if img.ndim == 3:
+            img = img[None]
+        idx = {"red": 0, "green": 1, "blue": 2, "alpha": 3}[channel]
+        if idx >= img.shape[-1]:
+            # Stock indexes an existing channel; a 3-channel image has no
+            # alpha — fully-opaque is the faithful reading.
+            return (jnp.ones(img.shape[:3], jnp.float32),)
+        return (img[..., idx].astype(jnp.float32),)
+
+
+class MaskToImage:
+    DESCRIPTION = "Stock-name mask → grayscale image."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "mask_to_image"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"mask": ("MASK", {})}}
+
+    def mask_to_image(self, mask):
+        import jax.numpy as jnp
+
+        m = jnp.asarray(mask, jnp.float32)
+        if m.ndim == 2:
+            m = m[None]
+        if m.ndim == 4:
+            m = m[..., 0]
+        return (jnp.repeat(m[..., None], 3, axis=-1),)
+
+
+class GrowMask:
+    """Stock grow/shrink: |expand| iterations of a 3×3 max (grow) or min
+    (shrink) window; ``tapered_corners`` excludes the diagonal neighbors
+    (the stock plus-shaped kernel), rounding grown corners."""
+
+    DESCRIPTION = "Stock-name mask dilate/erode."
+    RETURN_TYPES = ("MASK",)
+    RETURN_NAMES = ("mask",)
+    FUNCTION = "expand_mask"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "mask": ("MASK", {}),
+            "expand": ("INT", {"default": 0, "min": -16384, "max": 16384}),
+            "tapered_corners": ("BOOLEAN", {"default": True}),
+        }}
+
+    def expand_mask(self, mask, expand: int, tapered_corners: bool = True):
+        import jax.numpy as jnp
+
+        m = jnp.asarray(mask, jnp.float32)
+        if m.ndim == 2:
+            m = m[None]
+        grow = expand > 0
+        n = min(abs(int(expand)), max(m.shape[1], m.shape[2]))
+        for _ in range(n):
+            # One 3×3 max/min step; the plus kernel = max over the 4-neighbor
+            # shifts + center (diagonals excluded when tapered).
+            shifts = [m]
+            padded = jnp.pad(
+                m, ((0, 0), (1, 1), (1, 1)),
+                constant_values=0.0 if grow else 1.0,
+            )
+            offs = [(-1, 0), (1, 0), (0, -1), (0, 1)]
+            if not tapered_corners:
+                offs += [(-1, -1), (-1, 1), (1, -1), (1, 1)]
+            for dy, dx in offs:
+                shifts.append(
+                    padded[:, 1 + dy:1 + dy + m.shape[1],
+                           1 + dx:1 + dx + m.shape[2]]
+                )
+            m = (jnp.max(jnp.stack(shifts), axis=0) if grow
+                 else jnp.min(jnp.stack(shifts), axis=0))
+        return (m,)
+
+
+class FeatherMask:
+    """Stock feather: linear ramp to 0 over the given pixel depth from each
+    selected edge."""
+
+    DESCRIPTION = "Stock-name mask edge feather."
+    RETURN_TYPES = ("MASK",)
+    RETURN_NAMES = ("mask",)
+    FUNCTION = "feather"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "mask": ("MASK", {}),
+            "left": ("INT", {"default": 0, "min": 0, "max": 16384}),
+            "top": ("INT", {"default": 0, "min": 0, "max": 16384}),
+            "right": ("INT", {"default": 0, "min": 0, "max": 16384}),
+            "bottom": ("INT", {"default": 0, "min": 0, "max": 16384}),
+        }}
+
+    def feather(self, mask, left: int, top: int, right: int, bottom: int):
+        import jax.numpy as jnp
+
+        m = jnp.asarray(mask, jnp.float32)
+        if m.ndim == 2:
+            m = m[None]
+        _, H, W = m.shape
+        rows = jnp.arange(H, dtype=jnp.float32)
+        cols = jnp.arange(W, dtype=jnp.float32)
+        scale = jnp.ones((H, W), jnp.float32)
+        if top:
+            scale = scale * jnp.clip((rows[:, None] + 1) / top, 0, 1)
+        if bottom:
+            scale = scale * jnp.clip((H - rows[:, None]) / bottom, 0, 1)
+        if left:
+            scale = scale * jnp.clip((cols[None, :] + 1) / left, 0, 1)
+        if right:
+            scale = scale * jnp.clip((W - cols[None, :]) / right, 0, 1)
+        return (m * scale[None],)
+
+
+class MaskComposite:
+    """Stock mask composite: ``source`` pastes onto ``destination`` at
+    (x, y) under the selected op (multiply/add/subtract/and/or/xor)."""
+
+    DESCRIPTION = "Stock-name mask composite."
+    RETURN_TYPES = ("MASK",)
+    RETURN_NAMES = ("mask",)
+    FUNCTION = "combine"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "destination": ("MASK", {}),
+            "source": ("MASK", {}),
+            "x": ("INT", {"default": 0, "min": 0, "max": 16384}),
+            "y": ("INT", {"default": 0, "min": 0, "max": 16384}),
+            "operation": (["multiply", "add", "subtract", "and", "or", "xor"],
+                          {"default": "multiply"}),
+        }}
+
+    def combine(self, destination, source, x: int, y: int,
+                operation: str = "multiply"):
+        import jax.numpy as jnp
+
+        dst = jnp.asarray(destination, jnp.float32)
+        src = jnp.asarray(source, jnp.float32)
+        if dst.ndim == 2:
+            dst = dst[None]
+        if src.ndim == 2:
+            src = src[None]
+        _, H, W = dst.shape
+        h = min(src.shape[1], H - min(int(y), H))
+        w = min(src.shape[2], W - min(int(x), W))
+        if h <= 0 or w <= 0:
+            return (dst,)
+        src = _repeat_to_batch(src, dst.shape[0])[:, :h, :w]
+        win = dst[:, y:y + h, x:x + w]
+        ops = {
+            "multiply": win * src,
+            "add": win + src,
+            "subtract": win - src,
+            "and": jnp.round(win) * jnp.round(src),
+            "or": jnp.clip(jnp.round(win) + jnp.round(src), 0, 1),
+            "xor": jnp.abs(jnp.round(win) - jnp.round(src)),
+        }
+        out = jnp.clip(ops[operation], 0.0, 1.0)
+        return (dst.at[:, y:y + h, x:x + w].set(out),)
+
+
+class LoadImageMask:
+    """Stock mask load: one channel of an input-directory image as a MASK
+    (alpha inverts, matching stock's 1-alpha regenerate convention)."""
+
+    DESCRIPTION = "Stock-name image-channel mask loader."
+    RETURN_TYPES = ("MASK",)
+    RETURN_NAMES = ("mask",)
+    FUNCTION = "load_image"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"image": ("STRING", {"default": ""}),
+                             "channel": (["alpha", "red", "green", "blue"],
+                                         {"default": "alpha"})}}
+
+    def load_image(self, image: str, channel: str = "alpha"):
+        import jax.numpy as jnp
+        import numpy as np
+
+        px, alpha = LoadImage().run(image)
+        if channel == "alpha":
+            # LoadImage's MASK output is already stock's 1-alpha.
+            return (jnp.asarray(alpha),)
+        arr = np.asarray(px)
+        idx = {"red": 0, "green": 1, "blue": 2}[channel]
+        return (jnp.asarray(arr[..., idx], jnp.float32),)
+
+
 def stock_node_mappings() -> dict[str, type]:
     """All stock-name shims, keyed by the stock class name (merged into
     ``nodes.NODE_CLASS_MAPPINGS`` so exported workflows resolve directly)."""
@@ -1866,7 +2326,21 @@ def stock_node_mappings() -> dict[str, type]:
         "ConditioningAverage": ConditioningAverage,
         "ConditioningZeroOut": ConditioningZeroOut,
         "ConditioningSetTimestepRange": ConditioningSetTimestepRange,
+        "ConditioningConcat": ConditioningConcat,
         "CLIPTextEncodeSDXL": CLIPTextEncodeSDXL,
+        "CLIPTextEncodeSDXLRefiner": CLIPTextEncodeSDXLRefiner,
+        "ImageInvert": ImageInvert,
+        "ImageBatch": ImageBatch,
+        "RepeatLatentBatch": RepeatLatentBatch,
+        "LatentFromBatch": LatentFromBatch,
+        "SolidMask": SolidMask,
+        "InvertMask": InvertMask,
+        "ImageToMask": ImageToMask,
+        "MaskToImage": MaskToImage,
+        "GrowMask": GrowMask,
+        "FeatherMask": FeatherMask,
+        "MaskComposite": MaskComposite,
+        "LoadImageMask": LoadImageMask,
         "VAEEncodeForInpaint": VAEEncodeForInpaint,
         "ImagePadForOutpaint": ImagePadForOutpaint,
         "ImageCompositeMasked": ImageCompositeMasked,

@@ -385,14 +385,20 @@ def _run_chunk_sweep() -> None:
             done.items(), key=lambda kv: float(kv[1]["value"])
         )
         best = json.loads(best_key)
+        # Keys the winning combo didn't set are OMITTED: attention.py then
+        # serves its own built-in default for them (the watchdog must stay
+        # jax-free, so it cannot import the canonical constant — omission is
+        # how the two stay in sync when the default wins).
         table = {
             "source": "measured",
-            "chunk_elems": int(best.get("PA_ATTN_CHUNK_ELEMS", 2**27)),
-            "bf16_softmax": best.get("PA_ATTN_BF16_SOFTMAX") == "1",
             "rung": _CHUNK_SWEEP_RUNG,
             "best_s_it": float(best_rec["value"]),
             "ts": time.time(),
         }
+        if "PA_ATTN_CHUNK_ELEMS" in best:
+            table["chunk_elems"] = int(best["PA_ATTN_CHUNK_ELEMS"])
+        if "PA_ATTN_BF16_SOFTMAX" in best:
+            table["bf16_softmax"] = best["PA_ATTN_BF16_SOFTMAX"] == "1"
         with open(_chunk_tuning_path(), "w") as f:
             json.dump(table, f, indent=1)
         _log(f"chunk sweep winner {best or 'default'} "

@@ -19,9 +19,9 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 # suite asserts against the built-in defaults. Point the tuning path at a
 # nonexistent file (tests that exercise the table monkeypatch the module's
 # _CHUNK_TUNING_PATH directly).
-os.environ.setdefault("PA_ATTN_CHUNK_TUNING", os.path.join(
+os.environ["PA_ATTN_CHUNK_TUNING"] = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "nonexistent-attn-chunk.json"
-))
+)
 os.environ.pop("PA_ATTN_CHUNK_ELEMS", None)
 os.environ.pop("PA_ATTN_BF16_SOFTMAX", None)
 _flags = os.environ.get("XLA_FLAGS", "")

@@ -1289,3 +1289,165 @@ class TestI2VClipFeaOnClipless:
         )
         assert out.shape == (1, 2, 4, 4, 4)
         assert np.isfinite(np.asarray(out)).all()
+
+
+class TestMaskAndUtilityShims:
+    """The round-5 utility family: mask ops, batch utils, conditioning
+    concat, the refiner text encode — the stock builtins inpaint/refiner
+    template exports lean on beyond the core loop."""
+
+    def _nodes(self):
+        from comfyui_parallelanything_tpu.nodes_compat import (
+            stock_node_mappings,
+        )
+
+        return stock_node_mappings()
+
+    def test_conditioning_concat_token_axis(self):
+        import jax.numpy as jnp
+
+        n = self._nodes()
+        to = {"context": jnp.ones((2, 3, 8)), "pooled": jnp.ones((2, 8))}
+        frm = {"context": jnp.zeros((1, 5, 8))}
+        (out,) = n["ConditioningConcat"]().concat(to, frm)
+        assert out["context"].shape == (2, 8, 8)
+        assert out["pooled"].shape == (2, 8)  # to's fields win
+        with pytest.raises(ValueError, match="widths"):
+            n["ConditioningConcat"]().concat(
+                to, {"context": jnp.zeros((1, 5, 4))}
+            )
+
+    def test_refiner_encode_over_dual_wire(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.nodes import NODE_CLASS_MAPPINGS
+
+        env = _synthetic_sdxl_env(tmp_path, monkeypatch)
+        _, clip, _ = (
+            NODE_CLASS_MAPPINGS["CheckpointLoaderSimple"]().load(env["ckpt"])
+        )
+        n = self._nodes()
+        (c,) = n["CLIPTextEncodeSDXLRefiner"]().encode(
+            clip, ascore=6.0, width=1024, height=1024,
+            text="a watercolor lighthouse",
+        )
+        g_hidden = clip["g"]["encoder"].cfg.hidden_size
+        g_pool = clip["g"]["encoder"].cfg.projection_dim
+        assert c["context"].shape[-1] == g_hidden  # G stream alone
+        assert c["pooled"].shape[-1] == g_pool + 5 * 256
+        with pytest.raises(ValueError, match="G-tower"):
+            n["CLIPTextEncodeSDXLRefiner"]().encode(
+                {"encoder": None}, 6.0, 1024, 1024, "x"
+            )
+
+    def test_mask_family_roundtrip(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        n = self._nodes()
+        (m,) = n["SolidMask"]().solid(0.25, width=8, height=4)
+        assert m.shape == (1, 4, 8) and float(m[0, 0, 0]) == 0.25
+        (inv,) = n["InvertMask"]().invert(m)
+        assert float(inv[0, 0, 0]) == 0.75
+        (img,) = n["MaskToImage"]().mask_to_image(m)
+        assert img.shape == (1, 4, 8, 3)
+        (back,) = n["ImageToMask"]().image_to_mask(img, "green")
+        np.testing.assert_allclose(np.asarray(back), np.asarray(m))
+        # 3-channel image has no alpha: fully-opaque mask.
+        (ones,) = n["ImageToMask"]().image_to_mask(img, "alpha")
+        assert float(ones.min()) == 1.0
+
+    def test_grow_mask_dilates_and_erodes(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        n = self._nodes()
+        m = jnp.zeros((1, 7, 7)).at[0, 3, 3].set(1.0)
+        (grown,) = n["GrowMask"]().expand_mask(m, 1, tapered_corners=True)
+        assert float(grown.sum()) == 5.0  # plus-shaped kernel
+        (grown_sq,) = n["GrowMask"]().expand_mask(m, 1, tapered_corners=False)
+        assert float(grown_sq.sum()) == 9.0  # full 3x3
+        (shrunk,) = n["GrowMask"]().expand_mask(grown_sq, -1,
+                                                tapered_corners=False)
+        np.testing.assert_allclose(np.asarray(shrunk), np.asarray(m))
+        (same,) = n["GrowMask"]().expand_mask(m, 0)
+        np.testing.assert_allclose(np.asarray(same), np.asarray(m))
+
+    def test_feather_and_composite(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        n = self._nodes()
+        (m,) = n["SolidMask"]().solid(1.0, width=8, height=8)
+        (f,) = n["FeatherMask"]().feather(m, left=4, top=0, right=0, bottom=0)
+        got = np.asarray(f)[0, 4, :4]
+        np.testing.assert_allclose(got, [0.25, 0.5, 0.75, 1.0], atol=1e-6)
+
+        dst = jnp.zeros((1, 6, 6)).at[:, :, :].set(0.5)
+        src = jnp.ones((1, 2, 2))
+        (add,) = n["MaskComposite"]().combine(dst, src, x=4, y=4,
+                                              operation="add")
+        assert float(add[0, 5, 5]) == 1.0 and float(add[0, 0, 0]) == 0.5
+        (sub,) = n["MaskComposite"]().combine(dst, src, x=0, y=0,
+                                              operation="subtract")
+        assert float(sub[0, 0, 0]) == 0.0
+        (xor,) = n["MaskComposite"]().combine(dst, src, x=0, y=0,
+                                              operation="xor")
+        # round(0.5) banker's-rounds to 0; xor(0, 1) = 1.
+        assert float(xor[0, 0, 0]) == 1.0
+        assert float(xor[0, 5, 5]) == 0.5  # outside the paste window: untouched
+
+    def test_image_batch_and_latent_batch_utils(self):
+        import jax.numpy as jnp
+
+        n = self._nodes()
+        a = jnp.zeros((2, 8, 8, 3))
+        b = jnp.ones((1, 4, 4, 3))
+        (batched,) = n["ImageBatch"]().batch(a, b)
+        assert batched.shape == (3, 8, 8, 3)
+
+        lat = {"samples": jnp.arange(4.0).reshape(4, 1, 1, 1),
+               "noise_mask": jnp.ones((4, 2, 2, 1))}
+        (rep,) = n["RepeatLatentBatch"]().repeat(lat, 2)
+        assert rep["samples"].shape[0] == 8
+        assert rep["noise_mask"].shape[0] == 8
+        (sl,) = n["LatentFromBatch"]().frombatch(lat, batch_index=1, length=2)
+        assert sl["samples"].shape[0] == 2
+        assert float(sl["samples"][0, 0, 0, 0]) == 1.0
+        assert sl["noise_mask"].shape[0] == 2
+
+        # A mask batch smaller than the samples batch cycles up (stock
+        # repeat_to_batch_size) before tiling/slicing — never lands empty or
+        # at a batch matching neither the latents nor 1.
+        short = {"samples": jnp.zeros((4, 1, 1, 1)),
+                 "noise_mask": jnp.ones((2, 2, 2, 1))}
+        (rep2,) = n["RepeatLatentBatch"]().repeat(short, 3)
+        assert rep2["samples"].shape[0] == 12
+        assert rep2["noise_mask"].shape[0] == 12
+        (sl2,) = n["LatentFromBatch"]().frombatch(short, batch_index=2,
+                                                  length=2)
+        assert sl2["noise_mask"].shape[0] == 2
+
+    def test_load_image_mask_channels(self, tmp_path, monkeypatch):
+        import numpy as np
+        from PIL import Image
+
+        n = self._nodes()
+        in_dir = tmp_path / "input"
+        in_dir.mkdir()
+        rgba = np.zeros((4, 4, 4), np.uint8)
+        rgba[..., 0] = 255  # red
+        rgba[..., 3] = 0    # fully transparent
+        Image.fromarray(rgba, "RGBA").save(in_dir / "m.png")
+        monkeypatch.setenv("PA_INPUT_DIR", str(in_dir))
+        (alpha,) = n["LoadImageMask"]().load_image("m.png", "alpha")
+        assert float(alpha.min()) == 1.0  # stock 1-alpha: transparent -> 1
+        (red,) = n["LoadImageMask"]().load_image("m.png", "red")
+        assert float(red.max()) == 1.0 and red.shape == (1, 4, 4)
+
+    def test_image_invert(self):
+        import jax.numpy as jnp
+
+        n = self._nodes()
+        (inv,) = n["ImageInvert"]().invert(jnp.full((1, 2, 2, 3), 0.25))
+        assert float(inv[0, 0, 0, 0]) == 0.75
