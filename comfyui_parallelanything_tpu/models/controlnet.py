@@ -30,7 +30,13 @@ import jax.numpy as jnp
 
 from ..ops.basic import timestep_embedding
 from .api import DiffusionModel
-from .unet import Downsample, ResBlock, SpatialTransformer, UNetConfig
+from .unet import (
+    Downsample,
+    ResBlock,
+    SpatialTransformer,
+    UNetConfig,
+    middle_depth,
+)
 
 # input_hint_block conv ladder: (out_channels, stride) per conv, pixels → 8×
 # reduced latent grid, final zero conv to model_channels appended dynamically.
@@ -111,10 +117,7 @@ class ControlNet2D(nn.Module):
                 outs.append(zero_conv(h, zi))
                 zi += 1
         mid_ch = ch * cfg.channel_mult[-1]
-        mid_depth = (
-            cfg.transformer_depth[-1]
-            if len(cfg.channel_mult) - 1 in cfg.attention_levels else 0
-        )
+        mid_depth = middle_depth(cfg)
         h = ResBlock(cfg, mid_ch, name="mid_res1")(h, emb)
         if mid_depth > 0:
             h = SpatialTransformer(cfg, mid_ch, mid_depth, name="mid_attn")(h, context)

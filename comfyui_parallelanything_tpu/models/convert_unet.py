@@ -35,7 +35,7 @@ from typing import Any
 import numpy as np
 
 from .convert import conv_kernel, dense_params, to_numpy, tree_to_jnp
-from .unet import UNetConfig, _heads_for
+from .unet import UNetConfig, _heads_for, middle_depth
 
 
 def _conv(sd: Mapping[str, Any], key: str) -> dict:
@@ -179,14 +179,14 @@ def _encoder_params(sd: Mapping[str, Any], cfg: UNetConfig) -> dict:
 
     # -- middle -------------------------------------------------------------------
     mid_ch = ch * cfg.channel_mult[-1]
-    mid_level = len(cfg.channel_mult) - 1
     heads = _heads_for(cfg, mid_ch)
     p["mid_res1"] = _res_block(sd, "middle_block.0", has_skip=False)
-    # Gate must mirror UNet2D exactly (unet.py: transformer_depth[-1], NOT
-    # transformer_depth[mid_level] — the tuples may have different lengths).
-    if mid_level in cfg.attention_levels and cfg.transformer_depth[-1] > 0:
+    # Gate must mirror UNet2D exactly — the shared middle_depth() derivation
+    # (incl. the refiner's transformer_depth_middle override).
+    mid_depth = middle_depth(cfg)
+    if mid_depth > 0:
         p["mid_attn"] = _spatial_transformer(
-            sd, "middle_block.1", cfg.transformer_depth[-1], heads, mid_ch // heads
+            sd, "middle_block.1", mid_depth, heads, mid_ch // heads
         )
         p["mid_res2"] = _res_block(sd, "middle_block.2", has_skip=False)
     else:

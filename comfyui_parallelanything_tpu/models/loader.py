@@ -233,10 +233,18 @@ def sniff_model_family(state_dict: Mapping[str, Any]) -> str:
             # SD2.1-unCLIP also carries an adm label_emb, but keeps the SD2
             # block layout (a transformer at input_blocks.1 with OpenCLIP-H
             # 1024-wide context; SDXL's first attention sits deeper and its
-            # context is 2048).
+            # context is 2048; the SDXL REFINER's sits deeper still and is
+            # OpenCLIP-G-only, 1280-wide).
             ctx = dim("input_blocks.1.1.transformer_blocks.0.attn2.to_k.weight", 1)
             if ctx == 1024:
                 return "sd21-unclip"
+            first_attn = next(
+                (n for n in sorted(names)
+                 if n.endswith("transformer_blocks.0.attn2.to_k.weight")
+                 and n.startswith("input_blocks.")), None,
+            )
+            if first_attn is not None and dim(first_attn, 1) == 1280:
+                return "sdxl-refiner"
             return "sdxl" + inpaint
         ctx = dim("input_blocks.1.1.transformer_blocks.0.attn2.to_k.weight", 1)
         # 768 = CLIP-L (SD1.x); 1024 = OpenCLIP-H (SD2.x). eps-vs-v prediction

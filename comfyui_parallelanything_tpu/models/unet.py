@@ -34,6 +34,11 @@ class UNetConfig:
     num_heads: int = 8
     context_dim: int = 768
     adm_in_channels: int | None = None  # SDXL pooled-text+size vector conditioning
+    # Middle-block transformer depth override. None = derive from the deepest
+    # encoder level (the SD1.5/SD2/SDXL-base pattern). The SDXL REFINER needs
+    # it: no attention at its deepest encoder level but a depth-4 middle
+    # transformer — underivable from the per-level tuple.
+    transformer_depth_middle: int | None = None
     norm_groups: int = 32
     # Sampling parameterization the checkpoint was trained with ("eps" or "v");
     # carried on the config so samplers/nodes pick it up without a side channel
@@ -65,6 +70,34 @@ def sdxl_config(**overrides) -> UNetConfig:
         adm_in_channels=2816,
     )
     return dataclasses.replace(base, **overrides)
+
+
+def sdxl_refiner_config(**overrides) -> UNetConfig:
+    """SDXL-refiner UNet (sd_xl_refiner.yaml): 384 base channels, attention
+    only at the middle two levels (depth 4) PLUS a depth-4 middle transformer,
+    OpenCLIP-G-only context (1280), aesthetic-score adm (2560)."""
+    base = UNetConfig(
+        model_channels=384,
+        channel_mult=(1, 2, 4, 4),
+        attention_levels=(1, 2),
+        transformer_depth=(0, 4, 4, 0),
+        transformer_depth_middle=4,
+        num_heads=-1,
+        context_dim=1280,
+        adm_in_channels=2560,
+    )
+    return dataclasses.replace(base, **overrides)
+
+
+def middle_depth(cfg: UNetConfig) -> int:
+    """Middle-block transformer depth — the ONE derivation shared by UNet2D,
+    the checkpoint converter, and the ControlNet trunk (they must agree or
+    conversion misindexes middle_block.{1,2})."""
+    if cfg.transformer_depth_middle is not None:
+        return cfg.transformer_depth_middle
+    if len(cfg.channel_mult) - 1 in cfg.attention_levels:
+        return cfg.transformer_depth[-1]
+    return 0
 
 
 def _heads_for(cfg: UNetConfig, channels: int) -> int:
@@ -220,7 +253,7 @@ class UNet2D(nn.Module):
                 skips.append(h)
         # -- middle ----------------------------------------------------------------
         mid_ch = ch * cfg.channel_mult[-1]
-        mid_depth = cfg.transformer_depth[-1] if len(cfg.channel_mult) - 1 in cfg.attention_levels else 0
+        mid_depth = middle_depth(cfg)
         h = ResBlock(cfg, mid_ch, name="mid_res1")(h, emb)
         if mid_depth > 0:
             h = SpatialTransformer(cfg, mid_ch, mid_depth, name="mid_attn")(h, context)

@@ -314,7 +314,7 @@ class ParallelAnythingAdvanced(ParallelAnything):
 
 _MODEL_FAMILIES = (
     "sd15", "sd15-inpaint", "sd21", "sd21-v", "sd21-inpaint", "sd21-unclip",
-    "sdxl", "sdxl-inpaint",
+    "sdxl", "sdxl-inpaint", "sdxl-refiner",
     "sd3-medium", "sd35-medium", "sd35-large",
     "flux-dev", "flux-schnell", "zimage-turbo", "wan-1.3b", "wan-14b",
 )
@@ -382,6 +382,7 @@ class TPUCheckpointLoader:
             sd21_config,
             sd_vae_config,
             sdxl_config,
+            sdxl_refiner_config,
             sdxl_vae_config,
             z_image_turbo_config,
         )
@@ -502,10 +503,13 @@ class TPUCheckpointLoader:
                     )
                 model = load_sd_unet_checkpoint(sd, ucfg, lora, lora_strength)
                 vae_cfg = sd_vae_config()
-            elif family in ("sdxl", "sdxl-inpaint"):
-                xcfg = sdxl_config(
-                    **({"in_channels": 9} if family == "sdxl-inpaint" else {})
-                )
+            elif family in ("sdxl", "sdxl-inpaint", "sdxl-refiner"):
+                if family == "sdxl-refiner":
+                    xcfg = sdxl_refiner_config()
+                else:
+                    xcfg = sdxl_config(
+                        **({"in_channels": 9} if family == "sdxl-inpaint" else {})
+                    )
                 model = load_sd_unet_checkpoint(sd, xcfg, lora, lora_strength)
                 vae_cfg = sdxl_vae_config()
             else:

@@ -174,6 +174,33 @@ class CheckpointLoaderSimple:
                     "encoder": enc, "tokenizer": tok, "type": "clip",
                     "tokenizer_error": None if tok else _TOKENIZER_HELP,
                 }
+            if family == "sdxl-refiner":
+                from .models import open_clip_g_config
+
+                # The refiner bundles ONE tower: OpenCLIP-G under
+                # conditioner.embedders.0.model.* (no CLIP-L). A plain
+                # G-tower CLIP wire — CLIPTextEncodeSDXLRefiner consumes it
+                # directly.
+                tower = load_safetensors_subset(path, "conditioner.embedders.0.")
+                if not tower:
+                    return error_wire(
+                        "sdxl-refiner checkpoint has no bundled conditioner "
+                        "tower; wire TPUCLIPLoader type=open-clip-g instead"
+                    )
+                if te_loras:
+                    from .models.convert import bake_lora
+
+                    for sub, s in self._te_filtered(te_loras, "lora_te2_",
+                                                    "lora_te_"):
+                        tower = bake_lora(tower, sub, s)
+                enc_g = load_clip_text_checkpoint(
+                    tower, cfg=open_clip_g_config(), open_clip=True
+                )
+                tok_g = _clip_tokenizer(max_len=enc_g.cfg.max_len, pad_id=0)
+                return {
+                    "encoder": enc_g, "tokenizer": tok_g, "type": "clip",
+                    "tokenizer_error": None if tok_g else _TOKENIZER_HELP,
+                }
             if family == "sdxl":
                 from .models import open_clip_g_config
 
@@ -2279,6 +2306,93 @@ class LoadImageMask:
         return (jnp.asarray(arr[..., idx], jnp.float32),)
 
 
+class VAEDecodeTiled:
+    """Stock tiled decode: bounded activation memory at any resolution.
+    ``tile_size`` is in PIXELS like stock (converted to latent cells by the
+    VAE's spatial factor); the tile/overlap policy itself lives with its
+    single owner, ``models/vae.decode_maybe_tiled``. Stock's newer
+    ``overlap``/``temporal_size``/``temporal_overlap`` widgets are accepted
+    so current exports run unchanged — overlap is owner-derived and the
+    temporal knobs don't apply to spatial tiling here."""
+
+    DESCRIPTION = "Stock-name tiled VAE decode."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "decode"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples": ("LATENT", {}),
+                "vae": ("VAE", {}),
+                "tile_size": ("INT", {"default": 512, "min": 64, "max": 4096,
+                                      "step": 32}),
+            },
+            "optional": {
+                "overlap": ("INT", {"default": 64, "min": 0, "max": 4096}),
+                "temporal_size": ("INT", {"default": 64, "min": 8,
+                                          "max": 4096}),
+                "temporal_overlap": ("INT", {"default": 8, "min": 4,
+                                             "max": 4096}),
+            },
+        }
+
+    def decode(self, samples, vae, tile_size: int = 512, overlap: int = 64,
+               temporal_size: int = 64, temporal_overlap: int = 8):
+        from .models.vae import decode_maybe_tiled, vae_output_to_images
+
+        factor = getattr(vae, "spatial_factor", 8)
+        tile = max(8, int(tile_size) // factor)
+        return (vae_output_to_images(
+            decode_maybe_tiled(vae, samples["samples"], tile)
+        ),)
+
+
+class VAEEncodeTiled:
+    """Stock tiled encode — the img2img counterpart of VAEDecodeTiled for
+    resolutions whose encoder activations exceed HBM. Tile/overlap policy via
+    its owner ``models/vae.encode_maybe_tiled`` (pixel-unit tile, overlap
+    floored to the VAE's spatial-factor alignment)."""
+
+    DESCRIPTION = "Stock-name tiled VAE encode."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "encode"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "pixels": ("IMAGE", {}),
+                "vae": ("VAE", {}),
+                "tile_size": ("INT", {"default": 512, "min": 64, "max": 4096,
+                                      "step": 64}),
+            },
+            "optional": {
+                "overlap": ("INT", {"default": 64, "min": 0, "max": 4096}),
+                "temporal_size": ("INT", {"default": 64, "min": 8,
+                                          "max": 4096}),
+                "temporal_overlap": ("INT", {"default": 8, "min": 4,
+                                             "max": 4096}),
+            },
+        }
+
+    def encode(self, pixels, vae, tile_size: int = 512, overlap: int = 64,
+               temporal_size: int = 64, temporal_overlap: int = 8):
+        import jax.numpy as jnp
+
+        from .models.vae import encode_maybe_tiled, images_to_vae_input
+
+        img = jnp.asarray(pixels)
+        if img.ndim == 3:
+            img = img[None]
+        z = encode_maybe_tiled(vae, images_to_vae_input(img), int(tile_size))
+        return ({"samples": z},)
+
+
 def stock_node_mappings() -> dict[str, type]:
     """All stock-name shims, keyed by the stock class name (merged into
     ``nodes.NODE_CLASS_MAPPINGS`` so exported workflows resolve directly)."""
@@ -2342,6 +2456,8 @@ def stock_node_mappings() -> dict[str, type]:
         "MaskComposite": MaskComposite,
         "LoadImageMask": LoadImageMask,
         "VAEEncodeForInpaint": VAEEncodeForInpaint,
+        "VAEDecodeTiled": VAEDecodeTiled,
+        "VAEEncodeTiled": VAEEncodeTiled,
         "ImagePadForOutpaint": ImagePadForOutpaint,
         "ImageCompositeMasked": ImageCompositeMasked,
         "LatentComposite": LatentComposite,

@@ -139,11 +139,12 @@ def _ldm_sd(cfg: UNetConfig, params) -> dict:
             _inv_conv(params[f"down_{level}"]["Conv_0"], f"input_blocks.{idx}.0.op", sd)
             idx += 1
 
-    mid_level = len(cfg.channel_mult) - 1
+    from comfyui_parallelanything_tpu.models.unet import middle_depth
+
     _inv_res(params["mid_res1"], "middle_block.0", sd)
-    if attn_at(mid_level):
+    if middle_depth(cfg) > 0:
         _inv_transformer(
-            params["mid_attn"], "middle_block.1", cfg.transformer_depth[-1], sd
+            params["mid_attn"], "middle_block.1", middle_depth(cfg), sd
         )
         _inv_res(params["mid_res2"], "middle_block.2", sd)
     else:
@@ -206,6 +207,51 @@ class TestSDXLShape:
         sd = _ldm_sd(cfg, model.params)
         got = convert_sd_unet_checkpoint(sd, cfg)
         _assert_trees_equal(got, model.params)
+
+
+class TestRefinerShape:
+    def test_middle_override_roundtrip_and_forward(self):
+        # The refiner's signature topology: NO attention at the deepest
+        # encoder level but a transformer in the middle block
+        # (transformer_depth_middle) — underivable from the per-level tuple.
+        from comfyui_parallelanything_tpu.models import (
+            build_unet,
+            sdxl_refiner_config,
+        )
+        from comfyui_parallelanything_tpu.models.unet import middle_depth
+
+        cfg = sdxl_refiner_config(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(0,), transformer_depth=(1, 0),
+            transformer_depth_middle=2, num_heads=4, context_dim=64,
+            adm_in_channels=32, norm_groups=8, dtype=jnp.float32,
+        )
+        assert middle_depth(cfg) == 2  # deepest level has none; middle does
+        model = build_unet(cfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+        assert "mid_attn" in model.params
+        sd = _ldm_sd(cfg, model.params)
+        assert "middle_block.1.transformer_blocks.1.attn1.to_q.weight" in sd
+        got = convert_sd_unet_checkpoint(sd, cfg)
+        _assert_trees_equal(got, model.params)
+        x = jax.random.normal(jax.random.key(2), (1, 8, 8, 4), jnp.float32)
+        ctx = jax.random.normal(jax.random.key(3), (1, 5, 64), jnp.float32)
+        t = jnp.array([7.0])
+        y = jax.random.normal(jax.random.key(4), (1, 32), jnp.float32)
+        want = model(x, t, ctx, y=y)
+        got_out = model.apply(got, x, t, ctx, y=y)
+        np.testing.assert_allclose(np.asarray(got_out), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_full_size_refiner_config(self):
+        from comfyui_parallelanything_tpu.models import sdxl_refiner_config
+        from comfyui_parallelanything_tpu.models.unet import middle_depth
+
+        cfg = sdxl_refiner_config()
+        assert cfg.model_channels == 384
+        assert cfg.context_dim == 1280
+        assert cfg.adm_in_channels == 2560
+        assert cfg.transformer_depth == (0, 4, 4, 0)
+        assert middle_depth(cfg) == 4
 
 
 class TestHelpers:

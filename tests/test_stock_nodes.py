@@ -236,6 +236,70 @@ def _synthetic_sdxl_env(tmp_path, monkeypatch):
     return {"ckpt": str(ckpt), "tok": tok_path}
 
 
+def _synthetic_refiner_env(tmp_path, monkeypatch):
+    """Tiny SDXL-REFINER single-file checkpoint: refiner-shaped UNet (no
+    deepest-level attention, depth-carrying middle transformer, G-only
+    1280-wide context so the family SNIFFS as sdxl-refiner), the bundled
+    OpenCLIP-G tower under conditioner.embedders.0.model.*, and the VAE."""
+    import jax
+    import jax.numpy as jnp
+    from safetensors.numpy import save_file
+
+    import comfyui_parallelanything_tpu.models as models_pkg
+    from comfyui_parallelanything_tpu.models import build_unet, build_vae
+    from comfyui_parallelanything_tpu.models.text_encoders import (
+        build_clip_text,
+        open_clip_g_config,
+    )
+    from tests.test_convert_unet import _ldm_sd
+    from tests.test_text_encoders import TestOpenCLIPConversion
+    from tests.test_vae import TINY as TINY_VAE, _ldm_layout_sd
+
+    g_cfg = open_clip_g_config(
+        vocab_size=100, hidden_size=1280, num_layers=1, num_heads=8,
+        max_len=16, intermediate_size=128, projection_dim=64,
+        dtype=jnp.float32,
+    )
+    real_ref = models_pkg.sdxl_refiner_config
+
+    def tiny_refiner():
+        return real_ref(
+            model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(1,), transformer_depth=(0, 1),
+            transformer_depth_middle=1, num_heads=4,
+            context_dim=g_cfg.hidden_size,
+            adm_in_channels=g_cfg.projection_dim + 5 * 256,
+            norm_groups=8, dtype=jnp.float32,
+        )
+
+    monkeypatch.setattr(models_pkg, "sdxl_refiner_config", tiny_refiner)
+    monkeypatch.setattr(models_pkg, "sdxl_vae_config", lambda: TINY_VAE)
+    monkeypatch.setattr(models_pkg, "open_clip_g_config", lambda: g_cfg)
+
+    ucfg = tiny_refiner()
+    unet = build_unet(ucfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+    vae = build_vae(TINY_VAE, jax.random.key(1), sample_hw=16)
+    g_enc = build_clip_text(g_cfg, rng=jax.random.key(2))
+    sd = {
+        f"model.diffusion_model.{k}": np.ascontiguousarray(v)
+        for k, v in _ldm_sd(ucfg, unet.params).items()
+    }
+    sd.update({
+        f"first_stage_model.{k}": np.ascontiguousarray(v)
+        for k, v in _ldm_layout_sd(TINY_VAE, vae.params).items()
+    })
+    sd.update({
+        f"conditioner.embedders.0.model.{k}": np.ascontiguousarray(v)
+        for k, v in TestOpenCLIPConversion._openclip_layout(
+            g_cfg, g_enc.params
+        ).items()
+    })
+    ckpt = tmp_path / "refiner_ckpt.safetensors"
+    save_file(sd, str(ckpt))
+    tok_path = _word_level_tokenizer(tmp_path, monkeypatch)
+    return {"ckpt": str(ckpt), "tok": tok_path}
+
+
 class TestStockWorkflow:
     def _stock_workflow(self, ckpt):
         """API-format graph exactly as a stock ComfyUI export writes it:
@@ -1444,6 +1508,88 @@ class TestMaskAndUtilityShims:
         assert float(alpha.min()) == 1.0  # stock 1-alpha: transparent -> 1
         (red,) = n["LoadImageMask"]().load_image("m.png", "red")
         assert float(red.max()) == 1.0 and red.shape == (1, 4, 4)
+
+    def test_refiner_checkpoint_sniffs_and_samples(self, tmp_path,
+                                                   monkeypatch):
+        """The real refiner story: a refiner-shaped single-file checkpoint
+        sniffs as sdxl-refiner (G-only 1280 context, label_emb, no shallow
+        attention), loads its bundled G tower as a plain CLIP wire, and a
+        stock refiner graph (CLIPTextEncodeSDXLRefiner ×2 → KSampler)
+        denoises."""
+        from comfyui_parallelanything_tpu.host import run_workflow
+        from comfyui_parallelanything_tpu.models import (
+            load_safetensors,
+            sniff_model_family,
+        )
+
+        env = _synthetic_refiner_env(tmp_path, monkeypatch)
+        assert sniff_model_family(load_safetensors(env["ckpt"])) == \
+            "sdxl-refiner"
+        monkeypatch.setenv("PA_OUTPUT_DIR", str(tmp_path / "out"))
+        wf = {
+            "4": {"class_type": "CheckpointLoaderSimple",
+                  "inputs": {"ckpt_name": env["ckpt"]}},
+            "5": {"class_type": "EmptyLatentImage",
+                  "inputs": {"width": 32, "height": 32, "batch_size": 1}},
+            "6": {"class_type": "CLIPTextEncodeSDXLRefiner",
+                  "inputs": {"ascore": 6.0, "width": 1024, "height": 1024,
+                             "text": "a watercolor lighthouse",
+                             "clip": ["4", 1]}},
+            "7": {"class_type": "CLIPTextEncodeSDXLRefiner",
+                  "inputs": {"ascore": 2.5, "width": 1024, "height": 1024,
+                             "text": "blurry", "clip": ["4", 1]}},
+            "3": {"class_type": "KSampler",
+                  "inputs": {"seed": 3, "steps": 2, "cfg": 4.0,
+                             "sampler_name": "euler", "scheduler": "normal",
+                             "denoise": 0.3, "model": ["4", 0],
+                             "positive": ["6", 0], "negative": ["7", 0],
+                             "latent_image": ["5", 0]}},
+            "8": {"class_type": "VAEDecode",
+                  "inputs": {"samples": ["3", 0], "vae": ["4", 2]}},
+        }
+        out = run_workflow(wf)
+        images = np.asarray(out["8"][0])
+        assert images.shape[0] == 1 and np.isfinite(images).all()
+
+    def test_tiled_vae_nodes_match_untiled(self, tmp_path, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from comfyui_parallelanything_tpu.models import build_vae
+        from tests.test_vae import TINY as TINY_VAE
+
+        n = self._nodes()
+        vae = build_vae(TINY_VAE, jax.random.key(0), sample_hw=16)
+        lat = jax.random.normal(
+            jax.random.key(1), (1, 16, 16, TINY_VAE.z_channels)
+        )
+        # 2024+ stock exports carry overlap/temporal widgets — must be
+        # accepted (host.py passes every workflow input as a kwarg).
+        (tiled,) = n["VAEDecodeTiled"]().decode(
+            {"samples": lat}, vae, tile_size=64, overlap=32,
+            temporal_size=64, temporal_overlap=8,
+        )
+        from comfyui_parallelanything_tpu.models.vae import (
+            vae_output_to_images,
+        )
+
+        plain = vae_output_to_images(vae.decode(lat))
+        assert tiled.shape == plain.shape
+        np.testing.assert_allclose(np.asarray(tiled), np.asarray(plain),
+                                   atol=0.08)  # ramp-blend seams, bf16 dots
+        px = jnp.clip(plain, 0.0, 1.0)
+        (enc,) = n["VAEEncodeTiled"]().encode(px, vae, tile_size=64,
+                                              overlap=32)
+        # Factor-unaligned tile sizes floor gracefully through the owner
+        # (encode_maybe_tiled), not a ValueError.
+        (enc2,) = n["VAEEncodeTiled"]().encode(px, vae, tile_size=120)
+        assert np.isfinite(np.asarray(enc2["samples"])).all()
+        plain_z = vae.encode(
+            jnp.asarray(px) * 2.0 - 1.0
+        )
+        assert enc["samples"].shape == plain_z.shape
+        assert np.isfinite(np.asarray(enc["samples"])).all()
 
     def test_image_invert(self):
         import jax.numpy as jnp
