@@ -67,6 +67,10 @@ class DiffusionModel:
     # pipeline and the router falls back to single-device (parity: no known block
     # list found, 1156-1166).
     pipeline_spec: PipelineSpec | None = None
+    # Model-level sampling preferences set by patch nodes (the host's
+    # model_options analogue): e.g. {"cfg_rescale": 0.7} from RescaleCFG.
+    # Samplers read these as defaults; explicit widget values win.
+    sampler_prefs: dict | None = None
 
     def __call__(self, x, timesteps, context=None, **kwargs):
         """Jit-compiled forward (cached per shape and per ambient sequence_parallel

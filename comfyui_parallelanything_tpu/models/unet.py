@@ -44,6 +44,14 @@ class UNetConfig:
     # carried on the config so samplers/nodes pick it up without a side channel
     # (ComfyUI keeps this in model_sampling the same way).
     prediction: str = "eps"
+    # FreeU patch (Si et al. 2023; the host's FreeU/FreeU_V2 model patches):
+    # (b1, b2, s1, s2, version) applied in the up path — backbone channels
+    # scaled by b, skip connections low-pass-rescaled by s at the two
+    # deepest-channel stages. None = off. Carried on the config (not a
+    # runtime flag) so the patch composes with conversion/parallelize like
+    # any other architecture knob: the patch node rebuilds the module around
+    # the SAME params.
+    freeu: tuple | None = None
     dtype: Any = jnp.bfloat16  # compute dtype; params stay f32
 
 
@@ -87,6 +95,56 @@ def sdxl_refiner_config(**overrides) -> UNetConfig:
         adm_in_channels=2560,
     )
     return dataclasses.replace(base, **overrides)
+
+
+def _fourier_filter(x, threshold: int, scale: float):
+    """FreeU's skip-connection low-frequency rescale: scale the centered
+    ``2·threshold``-wide low-frequency box of the 2-D spectrum by ``scale``.
+    FFT in f32 (TPU FFT is f32); cast back to the input dtype."""
+    dtype = x.dtype
+    xf = jnp.fft.fftshift(
+        jnp.fft.fft2(x.astype(jnp.float32), axes=(1, 2)), axes=(1, 2)
+    )
+    B, H, W, C = x.shape
+    cy, cx = H // 2, W // 2
+    mask = jnp.ones((1, H, W, 1), jnp.float32)
+    mask = mask.at[
+        :, max(cy - threshold, 0):cy + threshold,
+        max(cx - threshold, 0):cx + threshold, :,
+    ].set(float(scale))
+    out = jnp.fft.ifft2(
+        jnp.fft.ifftshift(xf * mask, axes=(1, 2)), axes=(1, 2)
+    ).real
+    return out.astype(dtype)
+
+
+def _apply_freeu(cfg: UNetConfig, h, skip):
+    """FreeU on one up-block junction: when the backbone stream ``h`` sits at
+    one of the two deepest channel widths, scale its first half-channels
+    (constant ``b`` for v1; hidden-mean-modulated for v2 — the FreeU_V2
+    improvement) and low-pass-rescale the skip by ``s``."""
+    b1, b2, s1, s2, version = cfg.freeu
+    C = h.shape[-1]
+    # Stock keys the two stages on literal 4x and 2x the base width (1280/640
+    # for both SD1.5 and SDXL) — NOT the channel_mult tail, which would
+    # collide for SD1.5's (1, 2, 4, 4).
+    stage = {cfg.model_channels * 4: (b1, s1),
+             cfg.model_channels * 2: (b2, s2)}
+    if C not in stage:
+        return h, skip
+    b, s = stage[C]
+    half = C // 2
+    if version >= 2:
+        hidden_mean = jnp.mean(h.astype(jnp.float32), axis=-1, keepdims=True)
+        dims = (1, 2, 3)
+        h_min = jnp.min(hidden_mean, axis=dims, keepdims=True)
+        h_max = jnp.max(hidden_mean, axis=dims, keepdims=True)
+        hidden_mean = (hidden_mean - h_min) / jnp.maximum(h_max - h_min, 1e-8)
+        scale = ((b - 1.0) * hidden_mean + 1.0).astype(h.dtype)
+    else:
+        scale = jnp.asarray(b, h.dtype)
+    h = jnp.concatenate([h[..., :half] * scale, h[..., half:]], axis=-1)
+    return h, _fourier_filter(skip, threshold=1, scale=s)
 
 
 def middle_depth(cfg: UNetConfig) -> int:
@@ -277,6 +335,8 @@ class UNet2D(nn.Module):
                 skip = skips.pop()
                 if ctrl_in:
                     skip = skip + ctrl_in.pop().astype(skip.dtype)
+                if cfg.freeu is not None:
+                    h, skip = _apply_freeu(cfg, h, skip)
                 h = jnp.concatenate([h, skip], axis=-1)
                 h = ResBlock(cfg, out_ch, name=f"out_{level}_{i}_res")(h, emb)
                 if level in cfg.attention_levels and cfg.transformer_depth[level] > 0:

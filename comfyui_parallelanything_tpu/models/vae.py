@@ -273,6 +273,10 @@ def encode_maybe_tiled(vae, x, tile: int = 0) -> jnp.ndarray:
     VAE's spatial-factor alignment (so any factor-aligned tile size works)."""
     if tile:
         f = vae.spatial_factor
+        # Floor BOTH to factor alignment: host widgets/exports carry
+        # arbitrary tile sizes (stock accepts any), and encode_tiled
+        # rejects unaligned values.
+        tile = max(f, tile // f * f)
         overlap = max(f, tile // 4 // f * f)
         return vae.encode_tiled(x, tile=tile, overlap=overlap)
     return vae.encode(x)

@@ -2306,6 +2306,132 @@ class LoadImageMask:
         return (jnp.asarray(arr[..., idx], jnp.float32),)
 
 
+class _FreeUBase:
+    """Shared FreeU patch machinery: rebuild the UNet module around the SAME
+    params with ``cfg.freeu`` set (the patch is an architecture knob here, so
+    it survives conversion/parallelize like any other config field). Applies
+    to SD-family UNET models, before ParallelAnything — stock ordering."""
+
+    RETURN_TYPES = ("MODEL",)
+    RETURN_NAMES = ("model",)
+    FUNCTION = "patch"
+    CATEGORY = CATEGORY
+    _VERSION = 2
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "model": ("MODEL", {}),
+            "b1": ("FLOAT", {"default": 1.3 if cls._VERSION >= 2 else 1.1,
+                             "min": 0.0, "max": 10.0, "step": 0.01}),
+            "b2": ("FLOAT", {"default": 1.4 if cls._VERSION >= 2 else 1.2,
+                             "min": 0.0, "max": 10.0, "step": 0.01}),
+            "s1": ("FLOAT", {"default": 0.9, "min": 0.0, "max": 10.0,
+                             "step": 0.01}),
+            "s2": ("FLOAT", {"default": 0.2, "min": 0.0, "max": 10.0,
+                             "step": 0.01}),
+        }}
+
+    def patch(self, model, b1: float, b2: float, s1: float, s2: float):
+        import dataclasses as dc
+
+        from .models import build_unet
+        from .models.unet import UNetConfig
+
+        cfg = getattr(model, "config", None)
+        if not isinstance(cfg, UNetConfig):
+            raise ValueError(
+                "FreeU patches SD-family UNET models (config "
+                f"{type(cfg).__name__}); apply it between the checkpoint "
+                "loader and ParallelAnything/KSampler"
+            )
+        patched = build_unet(
+            dc.replace(cfg, freeu=(float(b1), float(b2), float(s1),
+                                   float(s2), self._VERSION)),
+            params=model.params, name=f"{model.name}+freeu",
+        )
+        return (dc.replace(patched, sampler_prefs=model.sampler_prefs),)
+
+
+class FreeU(_FreeUBase):
+    DESCRIPTION = "Stock-name FreeU model patch (v1: constant backbone scale)."
+    _VERSION = 1
+
+
+class FreeU_V2(_FreeUBase):
+    DESCRIPTION = "Stock-name FreeU_V2 model patch (hidden-mean-modulated)."
+    _VERSION = 2
+
+
+class RescaleCFG:
+    """Stock RescaleCFG model patch: tags the MODEL with a cfg_rescale
+    default the samplers honor (sampling/cfg.rescale_guidance — Lin et al.
+    2023). An explicit non-zero cfg_rescale widget on a sampler node wins."""
+
+    DESCRIPTION = "Stock-name CFG-rescale model patch."
+    RETURN_TYPES = ("MODEL",)
+    RETURN_NAMES = ("model",)
+    FUNCTION = "patch"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "model": ("MODEL", {}),
+            "multiplier": ("FLOAT", {"default": 0.7, "min": 0.0, "max": 1.0,
+                                     "step": 0.01}),
+        }}
+
+    def patch(self, model, multiplier: float):
+        import copy
+        import dataclasses as dc
+
+        prefs = {**(getattr(model, "sampler_prefs", None) or {}),
+                 "cfg_rescale": float(multiplier)}
+        if dc.is_dataclass(model) and not isinstance(model, type):
+            return (dc.replace(model, sampler_prefs=prefs),)
+        # ParallelModel and friends: shallow-copy the wrapper (placements are
+        # shared; the copy carries no GC finalizer, the original owns
+        # teardown) and tag the copy.
+        m = copy.copy(model)
+        m.sampler_prefs = prefs
+        return (m,)
+
+
+class ConditioningSetMask:
+    """Stock mask-scoped conditioning: the cond's prediction applies with
+    per-pixel weight from a MASK (resized to the latent grid at sampling
+    time). ``set_cond_area`` accepted for export parity — "mask bounds" is
+    stock's compute-crop optimization and produces the same weights as
+    "default" here."""
+
+    DESCRIPTION = "Stock-name mask-scoped conditioning."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "append"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "conditioning": ("CONDITIONING", {}),
+            "mask": ("MASK", {}),
+            "strength": ("FLOAT", {"default": 1.0, "min": 0.0, "max": 10.0,
+                                   "step": 0.01}),
+            "set_cond_area": (["default", "mask bounds"],
+                              {"default": "default"}),
+        }}
+
+    def append(self, conditioning, mask, strength: float = 1.0,
+               set_cond_area: str = "default"):
+        import jax.numpy as jnp
+
+        out = {k: v for k, v in conditioning.items() if k != "area"}
+        out["mask"] = jnp.asarray(mask, jnp.float32)
+        out["strength"] = float(strength)
+        return (out,)
+
+
 class VAEDecodeTiled:
     """Stock tiled decode: bounded activation memory at any resolution.
     ``tile_size`` is in PIXELS like stock (converted to latent cells by the
@@ -2437,6 +2563,10 @@ def stock_node_mappings() -> dict[str, type]:
         "PreviewImage": PreviewImage,
         "ConditioningCombine": ConditioningCombine,
         "ConditioningSetArea": ConditioningSetArea,
+        "ConditioningSetMask": ConditioningSetMask,
+        "FreeU": FreeU,
+        "FreeU_V2": FreeU_V2,
+        "RescaleCFG": RescaleCFG,
         "ConditioningAverage": ConditioningAverage,
         "ConditioningZeroOut": ConditioningZeroOut,
         "ConditioningSetTimestepRange": ConditioningSetTimestepRange,

@@ -271,6 +271,7 @@ class EpsDenoiser:
         cfg_rescale: float = 0.0,
         extra_conds: tuple | list | None = None,
         cond_area: tuple | None = None,
+        cond_mask=None,
         cond_strength: float = 1.0,
         **model_kwargs,
     ):
@@ -296,15 +297,26 @@ class EpsDenoiser:
         # the same way when SetArea was applied to it directly.
         self.extra_conds = tuple(extra_conds or ())
         self.cond_area = cond_area
+        self.cond_mask = cond_mask  # pixel-space MASK (ConditioningSetMask)
         self.cond_strength = cond_strength
         self.kwargs = model_kwargs
         self.sigma_table = model_sigmas(alphas_cumprod)
         self.log_sigmas = jnp.log(self.sigma_table)
 
-    def _area_mask(self, area, strength: float, shape):
-        """Per-pixel weight for one cond: ``strength`` everywhere (area None),
-        or strength inside the (h, w, y, x) latent-unit box. Non-2D latents
-        (video) use the full frame — stock area conditioning is 2D."""
+    def _area_mask(self, area, strength: float, shape, mask=None):
+        """Per-pixel weight for one cond: ``strength`` everywhere (no
+        scoping), strength inside the (h, w, y, x) latent-unit box (SetArea),
+        or a pixel-space MASK resized to the latent grid (SetMask — stock's
+        mask conditioning; "mask bounds" and "default" produce the same
+        weights, the bounds only being stock's compute-crop optimization).
+        Non-2D latents (video) use the full frame — stock scoping is 2D."""
+        if mask is not None and len(shape) == 4:
+            from ..models.vae import normalize_mask
+
+            m = normalize_mask(mask, (shape[1], shape[2]))
+            if m.shape[0] not in (1, shape[0]):
+                m = m[:1]
+            return m * jnp.float32(strength)
         if area is None or len(shape) != 4:
             return jnp.float32(strength)
         h, w, y, x0 = (int(v) for v in area)
@@ -319,7 +331,8 @@ class EpsDenoiser:
         ``timestep_range`` (start, end) contributes only while sampling
         progress is inside the window (the stock ConditioningSetTimestepRange
         + Combine multi-stage pattern)."""
-        m0 = self._area_mask(self.cond_area, self.cond_strength, x_in.shape)
+        m0 = self._area_mask(self.cond_area, self.cond_strength, x_in.shape,
+                             mask=self.cond_mask)
         num = m0 * eps_c
         den = m0 * jnp.ones_like(eps_c[..., :1])
         for e in self.extra_conds:
@@ -330,7 +343,8 @@ class EpsDenoiser:
                 kw["y"] = broadcast_cond_batch(pooled, batch)
             eps_e = self.model(x_in, t_vec, ctx, **kw)
             m = self._area_mask(
-                e.get("area"), float(e.get("strength", 1.0)), x_in.shape
+                e.get("area"), float(e.get("strength", 1.0)), x_in.shape,
+                mask=e.get("mask"),
             )
             rng_ = e.get("timestep_range")
             if rng_ is not None:
@@ -377,13 +391,15 @@ class EpsDenoiser:
                 **kw,
             )
             eps_c, eps_u = jnp.split(eps_both, 2, axis=0)
-            if self.extra_conds or self.cond_area is not None:
+            if (self.extra_conds or self.cond_area is not None
+                    or self.cond_mask is not None):
                 eps_c = self._combine_conds(eps_c, x_in, t_vec, batch)
             eps = eps_u + self.cfg_scale * (eps_c - eps_u)
             eps = rescale_guidance(eps, eps_c, self.cfg_rescale)
         else:
             eps = self.model(x_in, t_vec, self.context, **self.kwargs)
-            if self.extra_conds or self.cond_area is not None:
+            if (self.extra_conds or self.cond_area is not None
+                    or self.cond_mask is not None):
                 eps = self._combine_conds(eps, x_in, t_vec, batch)
         if self.prediction == "v":
             return x / (sigma**2 + 1.0) - eps * sigma * scale

@@ -70,6 +70,7 @@ def run_sampler(
     sigmas: jnp.ndarray | None = None,
     extra_conds=None,
     cond_area=None,
+    cond_mask=None,
     cond_strength: float = 1.0,
     **model_kwargs,
 ) -> jnp.ndarray:
@@ -110,7 +111,13 @@ def run_sampler(
     (timestep-indexed, not sigma-driven) rejects it."""
     use_cfg = cfg_scale != 1.0 and uncond_context is not None
     eff_cfg = cfg_scale if use_cfg else 1.0
-    multi_cond = bool(extra_conds) or cond_area is not None
+    # Model-level sampler preferences (patch nodes, e.g. RescaleCFG): defaults
+    # only — an explicit caller value wins.
+    prefs = getattr(model, "sampler_prefs", None) or {}
+    if cfg_rescale == 0.0:
+        cfg_rescale = float(prefs.get("cfg_rescale", 0.0))
+    multi_cond = (bool(extra_conds) or cond_area is not None
+                  or cond_mask is not None)
     if multi_cond and sampler in ("ddim", "flow_euler"):
         # Multi-cond lives in EpsDenoiser (the k-sampler family — every stock
         # KSampler menu name). ddim/flow_euler are TPU-native extras with
@@ -372,7 +379,7 @@ def run_sampler(
         model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,
         uncond_kwargs=uncond_kwargs, alphas_cumprod=acp, prediction=prediction,
         cfg_rescale=cfg_rescale, extra_conds=extra_conds, cond_area=cond_area,
-        cond_strength=cond_strength, **model_kwargs,
+        cond_mask=cond_mask, cond_strength=cond_strength, **model_kwargs,
     )
     if is_flow:
         # Host CONST-dispatch parity: samplers with an RF renoise form swap in.

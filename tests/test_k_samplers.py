@@ -662,6 +662,41 @@ class TestMultiCond:
         np.testing.assert_allclose(eps[0, 7, 7, 0], 0.0, atol=1e-6)
         np.testing.assert_allclose(eps[0, 0, 6, 0], 0.0, atol=1e-6)
 
+    def test_mask_cond_equals_equivalent_area_box(self):
+        # A pixel-space mask covering exactly the area box must weight
+        # identically to SetArea (the SetMask path resizes pixels → latent
+        # cells; box (4,4,0,0) in an 8×8 latent == top-left 32×32 px of 64²).
+        x = jnp.zeros((1, 8, 8, 4), jnp.float32)
+        ctx0 = jnp.zeros((1, 3, 5), jnp.float32)
+        ctx1 = jnp.ones((1, 7, 5), jnp.float32)
+        mask = jnp.zeros((1, 64, 64)).at[:, :32, :32].set(1.0)
+        d_mask = EpsDenoiser(
+            self._mean_model, ctx0,
+            extra_conds=[{"context": ctx1, "mask": mask, "strength": 1.0}],
+        )
+        d_area = EpsDenoiser(
+            self._mean_model, ctx0,
+            extra_conds=[{"context": ctx1, "area": (4, 4, 0, 0),
+                          "strength": 1.0}],
+        )
+        np.testing.assert_allclose(
+            np.asarray(d_mask(x, jnp.float32(1.0))),
+            np.asarray(d_area(x, jnp.float32(1.0))), atol=1e-6,
+        )
+
+    def test_primary_cond_mask_scopes_primary(self):
+        # SetMask on the PRIMARY positive: outside the mask no cond covers
+        # the pixel → falls back to the primary prediction (the divide-by-
+        # zero guard), inside it's primary-as-usual.
+        x = jnp.zeros((1, 8, 8, 4), jnp.float32)
+        ctx0 = jnp.ones((1, 3, 5), jnp.float32)
+        mask = jnp.zeros((1, 64, 64)).at[:, :32, :].set(1.0)
+        d = EpsDenoiser(self._mean_model, ctx0, cond_mask=mask)
+        out = d(x, jnp.float32(1.0))
+        eps = -np.asarray(out)
+        np.testing.assert_allclose(eps[0, 0, 0, 0], 1.0, atol=1e-6)
+        np.testing.assert_allclose(eps[0, 7, 7, 0], 1.0, atol=1e-6)
+
     def test_full_frame_combine_averages(self):
         x = jnp.zeros((1, 4, 4, 2), jnp.float32)
         d = EpsDenoiser(

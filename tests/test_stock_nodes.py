@@ -1582,14 +1582,100 @@ class TestMaskAndUtilityShims:
         (enc,) = n["VAEEncodeTiled"]().encode(px, vae, tile_size=64,
                                               overlap=32)
         # Factor-unaligned tile sizes floor gracefully through the owner
-        # (encode_maybe_tiled), not a ValueError.
-        (enc2,) = n["VAEEncodeTiled"]().encode(px, vae, tile_size=120)
+        # (encode_maybe_tiled), not a ValueError — 17 is unaligned for any
+        # spatial factor > 1.
+        (enc2,) = n["VAEEncodeTiled"]().encode(px, vae, tile_size=17)
         assert np.isfinite(np.asarray(enc2["samples"])).all()
         plain_z = vae.encode(
             jnp.asarray(px) * 2.0 - 1.0
         )
         assert enc["samples"].shape == plain_z.shape
         assert np.isfinite(np.asarray(enc["samples"])).all()
+
+    def test_freeu_patch(self):
+        import jax
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.models import build_unet, sd15_config
+
+        n = self._nodes()
+        # model_channels*4 / *2 widths must occur in the up path for the
+        # patch to bite: full channel_mult ladder at tiny width.
+        cfg = sd15_config(
+            model_channels=8, channel_mult=(1, 2, 4, 4), num_res_blocks=1,
+            attention_levels=(0,), transformer_depth=(1, 0, 0, 0),
+            num_heads=2, context_dim=16, norm_groups=4, dtype=jnp.float32,
+        )
+        m = build_unet(cfg, jax.random.key(0), sample_shape=(1, 16, 16, 4))
+        x = jax.random.normal(jax.random.key(1), (1, 16, 16, 4))
+        t = jnp.array([300.0])
+        ctx = jax.random.normal(jax.random.key(2), (1, 4, 16))
+        base_out = np.asarray(m(x, t, ctx))
+
+        # Neutral parameters (b=1, s=1) are an identity patch.
+        (neutral,) = n["FreeU_V2"]().patch(m, b1=1.0, b2=1.0, s1=1.0, s2=1.0)
+        np.testing.assert_allclose(np.asarray(neutral(x, t, ctx)), base_out,
+                                   rtol=1e-4, atol=1e-4)
+        # Real parameters change the output; params are shared, not copied.
+        (patched,) = n["FreeU_V2"]().patch(m, b1=1.3, b2=1.4, s1=0.9, s2=0.2)
+        assert patched.params is m.params
+        assert not np.allclose(np.asarray(patched(x, t, ctx)), base_out,
+                               atol=1e-4)
+        (v1,) = n["FreeU"]().patch(m, b1=1.1, b2=1.2, s1=0.9, s2=0.2)
+        out_v1 = np.asarray(v1(x, t, ctx))
+        assert not np.allclose(out_v1, np.asarray(patched(x, t, ctx)),
+                               atol=1e-4)  # v1 != v2 math
+        with pytest.raises(ValueError, match="UNET"):
+            n["FreeU_V2"]().patch(
+                type("M", (), {"config": None, "params": {}})(),
+                1.3, 1.4, 0.9, 0.2,
+            )
+
+    def test_rescale_cfg_patch_honored_by_sampler(self):
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.models.api import DiffusionModel
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        n = self._nodes()
+
+        def apply(p, x, t, context=None, **kw):
+            # Per-SAMPLE context mean (cond/uncond halves differ under the
+            # batched-CFG call) + a spatial gradient so the prediction has a
+            # nonzero std for rescale_guidance to act on.
+            m = jnp.mean(context, axis=(1, 2)).reshape((-1, 1, 1, 1))
+            ramp = jnp.linspace(0.0, 1.0, x.shape[1]).reshape((1, -1, 1, 1))
+            return x * 0.1 + m * (0.5 + ramp)
+
+        m = DiffusionModel(apply=apply, params={}, name="toy")
+        (tagged,) = n["RescaleCFG"]().patch(m, 0.9)
+        assert tagged.sampler_prefs == {"cfg_rescale": 0.9}
+        assert tagged is not m and m.sampler_prefs is None
+
+        noise = jnp.ones((1, 8, 8, 4))
+        ctx = jnp.ones((1, 3, 5))
+        unc = jnp.zeros((1, 3, 5)) - 1.0
+        kw = dict(sampler="euler", steps=3, cfg_scale=7.0,
+                  uncond_context=unc, rng=None)
+        base = run_sampler(m, noise, ctx, **kw)
+        tagged_out = run_sampler(tagged, noise, ctx, **kw)
+        explicit = run_sampler(m, noise, ctx, cfg_rescale=0.9, **kw)
+        # The tag changes the result exactly like the explicit widget value.
+        assert not np.allclose(np.asarray(tagged_out), np.asarray(base),
+                               atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tagged_out),
+                                   np.asarray(explicit), atol=1e-6)
+
+    def test_conditioning_set_mask_node(self):
+        import jax.numpy as jnp
+
+        n = self._nodes()
+        cond = {"context": jnp.ones((1, 3, 5)), "area": (4, 4, 0, 0)}
+        mask = jnp.ones((1, 8, 8))
+        (out,) = n["ConditioningSetMask"]().append(cond, mask, strength=0.5,
+                                                   set_cond_area="default")
+        assert "area" not in out  # mask replaces area scoping
+        assert out["strength"] == 0.5 and out["mask"].shape == (1, 8, 8)
 
     def test_image_invert(self):
         import jax.numpy as jnp
