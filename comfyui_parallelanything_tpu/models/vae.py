@@ -277,8 +277,10 @@ def encode_maybe_tiled(vae, x, tile: int = 0) -> jnp.ndarray:
         # arbitrary tile sizes (stock accepts any), and encode_tiled
         # rejects unaligned values.
         tile = max(f, tile // f * f)
-        overlap = max(f, tile // 4 // f * f)
-        return vae.encode_tiled(x, tile=tile, overlap=overlap)
+        # overlap must stay < tile (encode_tiled's contract): a tile floored
+        # all the way down to one factor cell runs overlap-free.
+        overlap = min(max(f, tile // 4 // f * f), tile - f)
+        return vae.encode_tiled(x, tile=tile, overlap=max(0, overlap))
     return vae.encode(x)
 
 

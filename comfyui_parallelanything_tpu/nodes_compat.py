@@ -2306,6 +2306,94 @@ class LoadImageMask:
         return (jnp.asarray(arr[..., idx], jnp.float32),)
 
 
+class ModelSamplingDiscrete:
+    """Stock prediction-type override: exported workflows fix v-prediction
+    checkpoints (weight-indistinguishable from eps — see the sniffing
+    warning in models/loader.py) with this node; here it rewrites
+    ``config.prediction``, which the samplers read. ``zsnr`` (zero-terminal-
+    SNR sigma rescale) is accepted but not applied — logged divergence, the
+    sampling still runs."""
+
+    DESCRIPTION = "Stock-name prediction-type (eps/v) model patch."
+    RETURN_TYPES = ("MODEL",)
+    RETURN_NAMES = ("model",)
+    FUNCTION = "patch"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "model": ("MODEL", {}),
+            "sampling": (["eps", "v_prediction", "lcm", "x0"],
+                         {"default": "eps"}),
+            "zsnr": ("BOOLEAN", {"default": False}),
+        }}
+
+    def patch(self, model, sampling: str = "eps", zsnr: bool = False):
+        import dataclasses as dc
+
+        from .utils.logging import get_logger
+
+        pred = {"eps": "eps", "v_prediction": "v"}.get(sampling)
+        if pred is None:
+            raise ValueError(
+                f"ModelSamplingDiscrete sampling={sampling!r} is not "
+                "supported (eps / v_prediction are)"
+            )
+        if zsnr:
+            get_logger().warning(
+                "ModelSamplingDiscrete zsnr=True: zero-terminal-SNR sigma "
+                "rescale is not applied (documented divergence) — sampling "
+                "proceeds with the standard schedule"
+            )
+        cfg = getattr(model, "config", None)
+        if (not dc.is_dataclass(model) or cfg is None
+                or not dc.is_dataclass(cfg) or not hasattr(cfg, "prediction")):
+            # A ParallelModel's .config is a ParallelConfig (dataclass, no
+            # prediction field) — the guard must catch it, not fall through
+            # to an opaque dc.replace TypeError.
+            raise ValueError(
+                "ModelSamplingDiscrete needs an unwrapped MODEL whose config "
+                f"carries a prediction field (got {type(model).__name__}); "
+                "apply it before ParallelAnything"
+            )
+        return (dc.replace(model, config=dc.replace(cfg, prediction=pred)),)
+
+
+class EmptyHunyuanLatentVideo:
+    """Stock empty VIDEO latent (the t2v entry of WAN/Hunyuan template
+    exports): 16-channel, 8x spatial, 4x temporal compression —
+    (B, (length-1)//4+1, H/8, W/8, 16) in this repo's NTHWC convention."""
+
+    DESCRIPTION = "Stock-name empty video latent (WAN/Hunyuan t2v)."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "generate"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "width": ("INT", {"default": 848, "min": 16, "max": 8192,
+                              "step": 16}),
+            "height": ("INT", {"default": 480, "min": 16, "max": 8192,
+                               "step": 16}),
+            "length": ("INT", {"default": 25, "min": 1, "max": 1024,
+                               "step": 4}),
+            "batch_size": ("INT", {"default": 1, "min": 1, "max": 16}),
+        }}
+
+    def generate(self, width: int, height: int, length: int,
+                 batch_size: int = 1):
+        from .nodes import TPUEmptyVideoLatent
+
+        # Delegate: the TPU node derives t_lat/spatial factor from
+        # wan_vae_config (single owner of the causal 4k+1 schedule).
+        return TPUEmptyVideoLatent().generate(
+            width=width, height=height, frames=length, batch_size=batch_size
+        )
+
+
 class _FreeUBase:
     """Shared FreeU patch machinery: rebuild the UNet module around the SAME
     params with ``cfg.freeu`` set (the patch is an architecture knob here, so
@@ -2426,9 +2514,15 @@ class ConditioningSetMask:
                set_cond_area: str = "default"):
         import jax.numpy as jnp
 
-        out = {k: v for k, v in conditioning.items() if k != "area"}
-        out["mask"] = jnp.asarray(mask, jnp.float32)
-        out["strength"] = float(strength)
+        # Stock conditioning_set_values maps over EVERY entry — primary and
+        # combined extras alike (the ConditioningSetArea shim's convention).
+        tag = {"mask": jnp.asarray(mask, jnp.float32),
+               "strength": float(strength)}
+        out = {**conditioning, **tag}
+        if conditioning.get("extras"):
+            out["extras"] = tuple(
+                {**e, **tag} for e in conditioning["extras"]
+            )
         return (out,)
 
 
@@ -2567,6 +2661,8 @@ def stock_node_mappings() -> dict[str, type]:
         "FreeU": FreeU,
         "FreeU_V2": FreeU_V2,
         "RescaleCFG": RescaleCFG,
+        "ModelSamplingDiscrete": ModelSamplingDiscrete,
+        "EmptyHunyuanLatentVideo": EmptyHunyuanLatentVideo,
         "ConditioningAverage": ConditioningAverage,
         "ConditioningZeroOut": ConditioningZeroOut,
         "ConditioningSetTimestepRange": ConditioningSetTimestepRange,

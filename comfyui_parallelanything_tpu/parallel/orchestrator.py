@@ -284,6 +284,7 @@ class ParallelModel:
         weights: tuple[float, ...],
         pipeline_spec: Any = None,
         model_config: Any = None,
+        sampler_prefs: dict | None = None,
     ):
         self._apply = apply_fn
         self._host_params = params
@@ -292,6 +293,9 @@ class ParallelModel:
         # The wrapped model's own config (FluxConfig/UNetConfig/...), distinct from
         # the ParallelConfig above — pipelines read patch_size etc. through this.
         self.model_config = model_config
+        # Model-level sampling preferences carried through from the wrapped
+        # model (api.DiffusionModel.sampler_prefs) — samplers read them here.
+        self.sampler_prefs = sampler_prefs
         self._groups = groups
         self.weights = weights
         self._pipeline_spec = pipeline_spec
@@ -792,12 +796,17 @@ def parallelize(
         if pipeline_spec is None:
             pipeline_spec = model._pipeline_spec
         wrapped_config = model.model_config
+        sampler_prefs = getattr(model, "sampler_prefs", None)
         model.cleanup()
     else:
         apply_fn, params = _unwrap_model(model)
         if pipeline_spec is None:
             pipeline_spec = getattr(model, "pipeline_spec", None)
         wrapped_config = getattr(model, "config", None)
+        # Model-level sampling preferences (RescaleCFG and friends) survive
+        # wrapping — the stock ordering is patch -> ParallelAnything ->
+        # KSampler, and samplers read prefs off whatever MODEL they get.
+        sampler_prefs = getattr(model, "sampler_prefs", None)
 
     chain = chain.validated().deduplicated()
     weights = chain.normalized_weights()
@@ -882,4 +891,5 @@ def parallelize(
         weights=final_weights,
         pipeline_spec=pipeline_spec,
         model_config=wrapped_config,
+        sampler_prefs=sampler_prefs,
     )

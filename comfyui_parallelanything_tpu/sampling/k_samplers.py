@@ -310,19 +310,21 @@ class EpsDenoiser:
         mask conditioning; "mask bounds" and "default" produce the same
         weights, the bounds only being stock's compute-crop optimization).
         Non-2D latents (video) use the full frame — stock scoping is 2D."""
+        weight = jnp.float32(strength)
+        if area is not None and len(shape) == 4:
+            h, w, y, x0 = (int(v) for v in area)
+            box = jnp.zeros((1, shape[1], shape[2], 1), jnp.float32)
+            weight = weight * box.at[:, y:y + h, x0:x0 + w, :].set(1.0)
         if mask is not None and len(shape) == 4:
             from ..models.vae import normalize_mask
 
             m = normalize_mask(mask, (shape[1], shape[2]))
             if m.shape[0] not in (1, shape[0]):
                 m = m[:1]
-            return m * jnp.float32(strength)
-        if area is None or len(shape) != 4:
-            return jnp.float32(strength)
-        h, w, y, x0 = (int(v) for v in area)
-        m = jnp.zeros((1, shape[1], shape[2], 1), jnp.float32)
-        m = m.at[:, y:y + h, x0:x0 + w, :].set(1.0)
-        return m * jnp.float32(strength)
+            # Both present (SetMask then SetArea): stock composes — the area
+            # crop times the mask weight inside it (get_area_and_mult).
+            weight = weight * m
+        return weight
 
     def _combine_conds(self, eps_c, x_in, t_vec, batch):
         """Area-weight-normalized blend of the primary cond's prediction with

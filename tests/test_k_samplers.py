@@ -684,6 +684,23 @@ class TestMultiCond:
             np.asarray(d_area(x, jnp.float32(1.0))), atol=1e-6,
         )
 
+    def test_mask_and_area_compose(self):
+        # SetMask then SetArea: the cond carries both — stock composes
+        # (area crop × mask weight), so only the INTERSECTION contributes.
+        x = jnp.zeros((1, 8, 8, 4), jnp.float32)
+        ctx0 = jnp.zeros((1, 3, 5), jnp.float32)
+        ctx1 = jnp.ones((1, 7, 5), jnp.float32)
+        mask = jnp.zeros((1, 64, 64)).at[:, :, :32].set(1.0)  # left half
+        d = EpsDenoiser(
+            self._mean_model, ctx0,
+            extra_conds=[{"context": ctx1, "mask": mask,
+                          "area": (4, 8, 0, 0), "strength": 1.0}],  # top half
+        )
+        eps = -np.asarray(d(x, jnp.float32(1.0)))
+        np.testing.assert_allclose(eps[0, 0, 0, 0], 0.5, atol=1e-6)  # both
+        np.testing.assert_allclose(eps[0, 0, 7, 0], 0.0, atol=1e-6)  # top-right: area only
+        np.testing.assert_allclose(eps[0, 7, 0, 0], 0.0, atol=1e-6)  # bottom-left: mask only
+
     def test_primary_cond_mask_scopes_primary(self):
         # SetMask on the PRIMARY positive: outside the mask no cond covers
         # the pixel → falls back to the primary prediction (the divide-by-

@@ -1666,16 +1666,68 @@ class TestMaskAndUtilityShims:
         np.testing.assert_allclose(np.asarray(tagged_out),
                                    np.asarray(explicit), atol=1e-6)
 
+        # The stock ordering wraps AFTER patching: prefs must survive
+        # parallelize (the ParallelModel carries them through).
+        import comfyui_parallelanything_tpu as pa
+
+        pm = pa.parallelize(tagged, pa.DeviceChain.even(["cpu:0"]))
+        assert pm.sampler_prefs == {"cfg_rescale": 0.9}
+        pm_out = run_sampler(pm, noise, ctx, **kw)
+        np.testing.assert_allclose(np.asarray(pm_out), np.asarray(explicit),
+                                   atol=1e-5)
+        # Guard: the sibling prediction patch must REJECT a wrapped model
+        # with its written guidance, not an opaque TypeError.
+        with pytest.raises(ValueError, match="before ParallelAnything"):
+            n["ModelSamplingDiscrete"]().patch(pm, "v_prediction")
+        pm.cleanup()
+
+    def test_model_sampling_discrete(self):
+        from comfyui_parallelanything_tpu.models import build_unet, sd15_config
+
+        n = self._nodes()
+        import jax
+        import jax.numpy as jnp
+
+        cfg = sd15_config(
+            model_channels=8, channel_mult=(1, 2), num_res_blocks=1,
+            attention_levels=(1,), transformer_depth=(0, 1), num_heads=2,
+            context_dim=16, norm_groups=4, dtype=jnp.float32,
+        )
+        m = build_unet(cfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+        assert m.config.prediction == "eps"
+        (v,) = n["ModelSamplingDiscrete"]().patch(m, "v_prediction",
+                                                  zsnr=False)
+        assert v.config.prediction == "v" and v.params is m.params
+        assert m.config.prediction == "eps"  # original untouched
+        (back,) = n["ModelSamplingDiscrete"]().patch(v, "eps")
+        assert back.config.prediction == "eps"
+        with pytest.raises(ValueError, match="not.*supported"):
+            n["ModelSamplingDiscrete"]().patch(m, "lcm")
+
+    def test_empty_video_latent(self):
+        n = self._nodes()
+        (lat,) = n["EmptyHunyuanLatentVideo"]().generate(
+            width=848, height=480, length=25, batch_size=2
+        )
+        assert lat["samples"].shape == (2, 7, 60, 106, 16)
+        with pytest.raises(ValueError, match="1 mod 4"):
+            n["EmptyHunyuanLatentVideo"]().generate(64, 64, 10)
+
     def test_conditioning_set_mask_node(self):
         import jax.numpy as jnp
 
         n = self._nodes()
-        cond = {"context": jnp.ones((1, 3, 5)), "area": (4, 4, 0, 0)}
+        cond = {"context": jnp.ones((1, 3, 5)), "area": (4, 4, 0, 0),
+                "extras": ({"context": jnp.ones((1, 2, 5))},)}
         mask = jnp.ones((1, 8, 8))
         (out,) = n["ConditioningSetMask"]().append(cond, mask, strength=0.5,
                                                    set_cond_area="default")
-        assert "area" not in out  # mask replaces area scoping
+        # Stock keeps the area (the denoiser composes box × mask) and maps
+        # the tag over combined extras too (conditioning_set_values rule).
+        assert out["area"] == (4, 4, 0, 0)
         assert out["strength"] == 0.5 and out["mask"].shape == (1, 8, 8)
+        assert out["extras"][0]["mask"].shape == (1, 8, 8)
+        assert out["extras"][0]["strength"] == 0.5
 
     def test_image_invert(self):
         import jax.numpy as jnp
