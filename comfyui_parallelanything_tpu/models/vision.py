@@ -226,10 +226,66 @@ def sniff_vision_config(sd) -> CLIPVisionConfig:
     )
 
 
+def openclip_visual_to_hf(sd) -> dict:
+    """OpenCLIP ``visual.*`` layout → HF ``vision_model.*`` key layout.
+
+    The sd21-unclip checkpoints bundle their ViT-H image encoder in OpenCLIP
+    form (``embedder.model.visual.*`` — fused qkv ``in_proj``, ``ln_pre``/
+    ``ln_post``, ``mlp.c_fc``/``c_proj``, a raw ``proj`` matrix); the host's
+    unCLIPCheckpointLoader reads it directly from the checkpoint. Pure key
+    rewrite (+ the qkv third-split and proj transpose) into the HF names
+    ``convert_clip_vision_checkpoint`` consumes. Keys are expected relative
+    to the ``visual.`` root (strip any outer prefix first)."""
+    from .convert import to_numpy
+
+    out: dict = {}
+    for k, v in sd.items():
+        parts = k.split(".")
+        if k == "conv1.weight":
+            out["vision_model.embeddings.patch_embedding.weight"] = v
+        elif k == "class_embedding":
+            out["vision_model.embeddings.class_embedding"] = v
+        elif k == "positional_embedding":
+            out["vision_model.embeddings.position_embedding.weight"] = v
+        elif parts[0] == "ln_pre":
+            out[f"vision_model.pre_layrnorm.{parts[1]}"] = v
+        elif parts[0] == "ln_post":
+            out[f"vision_model.post_layernorm.{parts[1]}"] = v
+        elif k == "proj":
+            out["visual_projection.weight"] = to_numpy(v).T
+        elif parts[0] == "transformer" and parts[1] == "resblocks":
+            n = parts[2]
+            lp = f"vision_model.encoder.layers.{n}."
+            rest = ".".join(parts[3:])
+            if rest in ("attn.in_proj_weight", "attn.in_proj_bias"):
+                arr = to_numpy(v)
+                third = arr.shape[0] // 3
+                kind = "weight" if rest.endswith("weight") else "bias"
+                for i, name in enumerate(("q_proj", "k_proj", "v_proj")):
+                    out[f"{lp}self_attn.{name}.{kind}"] = (
+                        arr[i * third:(i + 1) * third]
+                    )
+            else:
+                sub = {
+                    "ln_1": "layer_norm1", "ln_2": "layer_norm2",
+                    "attn": "self_attn", "mlp": "mlp",
+                    "c_fc": "fc1", "c_proj": "fc2", "out_proj": "out_proj",
+                }
+                mapped = ".".join(sub.get(p, p) for p in parts[3:])
+                out[lp + mapped] = v
+        else:
+            raise KeyError(f"unrecognized OpenCLIP visual key: {k}")
+    return out
+
+
 def convert_clip_vision_checkpoint(sd, cfg: CLIPVisionConfig | None = None):
-    """HF ``vision_model.*`` state dict → ``CLIPVisionModel`` params (+cfg)."""
+    """HF ``vision_model.*`` state dict → ``CLIPVisionModel`` params (+cfg).
+    OpenCLIP ``visual.*``-layout dicts (unclip checkpoints' bundled tower)
+    are detected and remapped first."""
     from .convert import conv_kernel, dense_params, to_numpy, tree_to_jnp
 
+    if "conv1.weight" in sd and "class_embedding" in sd:
+        sd = openclip_visual_to_hf(sd)
     if cfg is None:
         cfg = sniff_vision_config(sd)
     pre = "vision_model."

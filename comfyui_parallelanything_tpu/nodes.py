@@ -1300,6 +1300,7 @@ def _prepare_sampling_inputs(model, positive, negative, latent, rng=None):
         "cond_area": positive.get("area"),
         "cond_mask": positive.get("mask"),
         "cond_strength": float(positive.get("strength", 1.0)),
+        "cond_mask_strength": float(positive.get("mask_strength", 1.0)),
     }
     return model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra
 

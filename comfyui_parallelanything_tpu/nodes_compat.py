@@ -1124,6 +1124,16 @@ class ControlNetApplyAdvanced:
         return tagged, negative
 
 
+def _tag_all_entries(conditioning: dict, tag: dict) -> dict:
+    """Apply ``tag`` to the primary cond AND every combined extra — stock
+    conditioning_set_values maps over every list entry (the one convention
+    all the conditioning shims share)."""
+    out = {**conditioning, **tag}
+    if conditioning.get("extras"):
+        out["extras"] = tuple({**e, **tag} for e in conditioning["extras"])
+    return out
+
+
 def _repeat_to_batch(a, batch: int):
     """Stock repeat_to_batch_size: cycle (tile) then truncate, so any source
     batch composites onto any destination batch (larger, smaller, or
@@ -1472,14 +1482,9 @@ class ConditioningSetTimestepRange:
         }
 
     def set_range(self, conditioning, start: float, end: float):
-        rng_ = (float(start), float(end))
-        out = {**conditioning, "timestep_range": rng_}
-        if conditioning.get("extras"):
-            out["extras"] = tuple(
-                {**e, "timestep_range": rng_}
-                for e in conditioning["extras"]
-            )
-        return (out,)
+        return (_tag_all_entries(
+            conditioning, {"timestep_range": (float(start), float(end))}
+        ),)
 
 
 class ConditioningZeroOut:
@@ -1634,16 +1639,10 @@ class ConditioningSetArea:
                strength: float = 1.0):
         # Stock conditioning_set_values maps over EVERY list entry — primary
         # and combined extras alike get the box.
-        box = {
+        return (_tag_all_entries(conditioning, {
             "area": (height // 8, width // 8, y // 8, x // 8),
             "strength": float(strength),
-        }
-        out = {**conditioning, **box}
-        if conditioning.get("extras"):
-            out["extras"] = tuple(
-                {**e, **box} for e in conditioning["extras"]
-            )
-        return (out,)
+        }),)
 
 
 class ConditioningAverage:
@@ -2306,6 +2305,50 @@ class LoadImageMask:
         return (jnp.asarray(arr[..., idx], jnp.float32),)
 
 
+class unCLIPCheckpointLoader:
+    """Stock unCLIP loader: the sd21-unclip single file bundles a FOURTH
+    component — its ViT-H image encoder (OpenCLIP layout under
+    ``embedder.model.visual.*``) — which feeds CLIPVisionEncode →
+    unCLIPConditioning. Model/CLIP/VAE load exactly like
+    CheckpointLoaderSimple (family sniffed)."""
+
+    DESCRIPTION = "Stock-name unCLIP checkpoint loader (incl. vision tower)."
+    RETURN_TYPES = ("MODEL", "CLIP", "VAE", "CLIP_VISION")
+    RETURN_NAMES = ("model", "clip", "vae", "clip_vision")
+    FUNCTION = "load"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"ckpt_name": ("STRING", {"default": ""})}}
+
+    def load(self, ckpt_name: str):
+        from .models.loader import (
+            load_safetensors_subset,
+            peek_safetensors,
+        )
+        from .models.vision import build_clip_vision, convert_clip_vision_checkpoint
+
+        pfx = "embedder.model.visual."
+        # Header peek BEFORE materializing anything: pointing this node at a
+        # plain multi-GB checkpoint must fail in milliseconds, not after the
+        # whole model/clip/vae convert.
+        path = resolve_model_file(ckpt_name, "checkpoints")
+        if not any(k.startswith(pfx) for k in peek_safetensors(path)):
+            raise ValueError(
+                "checkpoint has no bundled image encoder "
+                f"({pfx}*) — not an unCLIP checkpoint; use "
+                "CheckpointLoaderSimple + CLIPVisionLoader instead"
+            )
+        model, clip, vae = CheckpointLoaderSimple().load(ckpt_name)
+        tower = load_safetensors_subset(path, pfx)
+        params, vcfg = convert_clip_vision_checkpoint(
+            {k[len(pfx):]: v for k, v in tower.items()}
+        )
+        vision = build_clip_vision(vcfg, params=params, name="unclip-vision")
+        return model, clip, vae, {"model": vision}
+
+
 class ModelSamplingDiscrete:
     """Stock prediction-type override: exported workflows fix v-prediction
     checkpoints (weight-indistinguishable from eps — see the sniffing
@@ -2357,7 +2400,12 @@ class ModelSamplingDiscrete:
                 f"carries a prediction field (got {type(model).__name__}); "
                 "apply it before ParallelAnything"
             )
-        return (dc.replace(model, config=dc.replace(cfg, prediction=pred)),)
+        patched = dc.replace(model, config=dc.replace(cfg, prediction=pred))
+        if getattr(model, "source", None) is not None:
+            # dc.replace rebuilds from FIELDS only; the loader's source tag
+            # (object.__setattr__) must survive for downstream LoraLoader.
+            object.__setattr__(patched, "source", model.source)
+        return (patched,)
 
 
 class EmptyHunyuanLatentVideo:
@@ -2387,10 +2435,13 @@ class EmptyHunyuanLatentVideo:
                  batch_size: int = 1):
         from .nodes import TPUEmptyVideoLatent
 
+        # Stock floors off-schedule lengths (((length-1)//4)+1 latent
+        # frames); API submissions bypass widget steps, so accept any length.
+        frames = max(1, (int(length) - 1) // 4 * 4 + 1)
         # Delegate: the TPU node derives t_lat/spatial factor from
         # wan_vae_config (single owner of the causal 4k+1 schedule).
         return TPUEmptyVideoLatent().generate(
-            width=width, height=height, frames=length, batch_size=batch_size
+            width=width, height=height, frames=frames, batch_size=batch_size
         )
 
 
@@ -2514,16 +2565,12 @@ class ConditioningSetMask:
                set_cond_area: str = "default"):
         import jax.numpy as jnp
 
-        # Stock conditioning_set_values maps over EVERY entry — primary and
-        # combined extras alike (the ConditioningSetArea shim's convention).
+        # Own key, NOT "strength": stock keeps area strength and mask
+        # strength separate and MULTIPLIES them (get_area_and_mult) — a
+        # shared key would have SetArea/SetMask clobber each other.
         tag = {"mask": jnp.asarray(mask, jnp.float32),
-               "strength": float(strength)}
-        out = {**conditioning, **tag}
-        if conditioning.get("extras"):
-            out["extras"] = tuple(
-                {**e, **tag} for e in conditioning["extras"]
-            )
-        return (out,)
+               "mask_strength": float(strength)}
+        return (_tag_all_entries(conditioning, tag),)
 
 
 class VAEDecodeTiled:
@@ -2662,6 +2709,7 @@ def stock_node_mappings() -> dict[str, type]:
         "FreeU_V2": FreeU_V2,
         "RescaleCFG": RescaleCFG,
         "ModelSamplingDiscrete": ModelSamplingDiscrete,
+        "unCLIPCheckpointLoader": unCLIPCheckpointLoader,
         "EmptyHunyuanLatentVideo": EmptyHunyuanLatentVideo,
         "ConditioningAverage": ConditioningAverage,
         "ConditioningZeroOut": ConditioningZeroOut,

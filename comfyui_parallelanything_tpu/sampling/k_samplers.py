@@ -273,6 +273,7 @@ class EpsDenoiser:
         cond_area: tuple | None = None,
         cond_mask=None,
         cond_strength: float = 1.0,
+        cond_mask_strength: float = 1.0,
         **model_kwargs,
     ):
         if alphas_cumprod is None:
@@ -299,11 +300,13 @@ class EpsDenoiser:
         self.cond_area = cond_area
         self.cond_mask = cond_mask  # pixel-space MASK (ConditioningSetMask)
         self.cond_strength = cond_strength
+        self.cond_mask_strength = cond_mask_strength
         self.kwargs = model_kwargs
         self.sigma_table = model_sigmas(alphas_cumprod)
         self.log_sigmas = jnp.log(self.sigma_table)
 
-    def _area_mask(self, area, strength: float, shape, mask=None):
+    def _area_mask(self, area, strength: float, shape, mask=None,
+                   mask_strength: float = 1.0):
         """Per-pixel weight for one cond: ``strength`` everywhere (no
         scoping), strength inside the (h, w, y, x) latent-unit box (SetArea),
         or a pixel-space MASK resized to the latent grid (SetMask — stock's
@@ -322,8 +325,10 @@ class EpsDenoiser:
             if m.shape[0] not in (1, shape[0]):
                 m = m[:1]
             # Both present (SetMask then SetArea): stock composes — the area
-            # crop times the mask weight inside it (get_area_and_mult).
-            weight = weight * m
+            # crop times the mask weight inside it (get_area_and_mult), with
+            # the mask's OWN strength multiplier kept separate from the
+            # area's (stock's strength × mask_strength).
+            weight = weight * m * jnp.float32(mask_strength)
         return weight
 
     def _combine_conds(self, eps_c, x_in, t_vec, batch):
@@ -334,7 +339,8 @@ class EpsDenoiser:
         progress is inside the window (the stock ConditioningSetTimestepRange
         + Combine multi-stage pattern)."""
         m0 = self._area_mask(self.cond_area, self.cond_strength, x_in.shape,
-                             mask=self.cond_mask)
+                             mask=self.cond_mask,
+                             mask_strength=self.cond_mask_strength)
         num = m0 * eps_c
         den = m0 * jnp.ones_like(eps_c[..., :1])
         for e in self.extra_conds:
@@ -347,6 +353,7 @@ class EpsDenoiser:
             m = self._area_mask(
                 e.get("area"), float(e.get("strength", 1.0)), x_in.shape,
                 mask=e.get("mask"),
+                mask_strength=float(e.get("mask_strength", 1.0)),
             )
             rng_ = e.get("timestep_range")
             if rng_ is not None:

@@ -72,6 +72,7 @@ def run_sampler(
     cond_area=None,
     cond_mask=None,
     cond_strength: float = 1.0,
+    cond_mask_strength: float = 1.0,
     **model_kwargs,
 ) -> jnp.ndarray:
     """Drive ``model`` from ``noise`` to a clean latent with the named sampler.
@@ -379,7 +380,8 @@ def run_sampler(
         model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,
         uncond_kwargs=uncond_kwargs, alphas_cumprod=acp, prediction=prediction,
         cfg_rescale=cfg_rescale, extra_conds=extra_conds, cond_area=cond_area,
-        cond_mask=cond_mask, cond_strength=cond_strength, **model_kwargs,
+        cond_mask=cond_mask, cond_strength=cond_strength,
+        cond_mask_strength=cond_mask_strength, **model_kwargs,
     )
     if is_flow:
         # Host CONST-dispatch parity: samplers with an RF renoise form swap in.

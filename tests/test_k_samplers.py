@@ -701,6 +701,17 @@ class TestMultiCond:
         np.testing.assert_allclose(eps[0, 0, 7, 0], 0.0, atol=1e-6)  # top-right: area only
         np.testing.assert_allclose(eps[0, 7, 0, 0], 0.0, atol=1e-6)  # bottom-left: mask only
 
+        # Area strength × mask strength MULTIPLY (stock get_area_and_mult):
+        # weight 0.5 × 0.5 = 0.25 against primary weight 1 → 0.25/1.25.
+        d2 = EpsDenoiser(
+            self._mean_model, ctx0,
+            extra_conds=[{"context": ctx1, "mask": mask,
+                          "area": (4, 8, 0, 0), "strength": 0.5,
+                          "mask_strength": 0.5}],
+        )
+        eps2 = -np.asarray(d2(x, jnp.float32(1.0)))
+        np.testing.assert_allclose(eps2[0, 0, 0, 0], 0.25 / 1.25, atol=1e-6)
+
     def test_primary_cond_mask_scopes_primary(self):
         # SetMask on the PRIMARY positive: outside the mask no cond covers
         # the pixel → falls back to the primary prediction (the divide-by-

@@ -1177,6 +1177,116 @@ class TestStockWanI2VWorkflow:
         assert all(os.path.exists(p) for p in out["28"][0])
 
 
+class TestUnclipCheckpointLoader:
+    def test_unclip_single_file_loads_all_four_wires(self, tmp_path,
+                                                     monkeypatch):
+        """A synthetic sd21-unclip single file — v-pred UNet with label_emb +
+        1024-ctx, OpenCLIP-H text tower, VAE, AND the OpenCLIP-layout ViT
+        image encoder under embedder.model.visual.* — loads through
+        unCLIPCheckpointLoader into MODEL/CLIP/VAE/CLIP_VISION, and the
+        vision wire encodes an image into CLIP_VISION_OUTPUT."""
+        import dataclasses
+        import jax
+        import jax.numpy as jnp
+        from safetensors.numpy import save_file
+
+        import comfyui_parallelanything_tpu.models as models_pkg
+        from comfyui_parallelanything_tpu.models import build_unet, build_vae
+        from comfyui_parallelanything_tpu.models.text_encoders import (
+            build_clip_text,
+            open_clip_h_config,
+        )
+        from comfyui_parallelanything_tpu.models.vision import (
+            CLIPVisionConfig,
+            build_clip_vision,
+        )
+        from comfyui_parallelanything_tpu.nodes_compat import (
+            CLIPVisionEncode,
+            unCLIPCheckpointLoader,
+        )
+        from tests.test_convert_unet import _ldm_sd
+        from tests.test_text_encoders import TestOpenCLIPConversion
+        from tests.test_vae import TINY as TINY_VAE, _ldm_layout_sd
+        from tests.test_vision import _openclip_visual_sd
+
+        # Text tower must be 1024-wide: the UNet's ctx width IS the sniff key.
+        h_cfg = open_clip_h_config(
+            vocab_size=100, hidden_size=1024, num_layers=1, num_heads=8,
+            max_len=16, intermediate_size=64, projection_dim=32,
+            dtype=jnp.float32,
+        )
+        monkeypatch.setattr(models_pkg, "open_clip_h_config", lambda: h_cfg)
+        monkeypatch.setattr(models_pkg, "sd_vae_config", lambda: TINY_VAE)
+        real_sd21 = models_pkg.sd21_config
+
+        def tiny_sd21(**kw):
+            kw.pop("prediction", None)
+            return real_sd21(
+                model_channels=32, channel_mult=(1, 2), num_res_blocks=1,
+                attention_levels=(0, 1), transformer_depth=(1, 1),
+                num_heads=4, context_dim=h_cfg.hidden_size, norm_groups=8,
+                prediction="v", dtype=jnp.float32, **kw,
+            )
+
+        monkeypatch.setattr(models_pkg, "sd21_config", tiny_sd21)
+
+        ucfg = tiny_sd21(adm_in_channels=48)
+        unet = build_unet(ucfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+        vae = build_vae(TINY_VAE, jax.random.key(1), sample_hw=16)
+        te = build_clip_text(h_cfg, rng=jax.random.key(2))
+        v_cfg = CLIPVisionConfig(
+            image_size=28, patch_size=7, hidden_size=32, num_layers=2,
+            num_heads=4, intermediate_size=64, act="gelu",
+            projection_dim=24, dtype=jnp.float32,
+        )
+        venc = build_clip_vision(v_cfg, rng=jax.random.key(3))
+
+        sd = {
+            f"model.diffusion_model.{k}": np.ascontiguousarray(v)
+            for k, v in _ldm_sd(ucfg, unet.params).items()
+        }
+        sd.update({
+            f"first_stage_model.{k}": np.ascontiguousarray(v)
+            for k, v in _ldm_layout_sd(TINY_VAE, vae.params).items()
+        })
+        sd.update({
+            f"cond_stage_model.model.{k}": np.ascontiguousarray(v)
+            for k, v in TestOpenCLIPConversion._openclip_layout(
+                h_cfg, te.params
+            ).items()
+        })
+        sd.update({
+            f"embedder.model.visual.{k}": np.ascontiguousarray(v)
+            for k, v in _openclip_visual_sd(v_cfg, venc.params).items()
+        })
+        ckpt = tmp_path / "unclip.safetensors"
+        save_file(sd, str(ckpt))
+        _word_level_tokenizer(tmp_path, monkeypatch)
+
+        model, clip, vae_w, clip_vision = (
+            unCLIPCheckpointLoader().load(str(ckpt))
+        )
+        assert model.source["family"] == "sd21-unclip"
+        assert model.config.prediction == "v"
+        assert model.config.adm_in_channels == 48
+        # The vision wire encodes — sniffed heads differ from the tiny
+        # tower's (the head table keys real widths), so check shape/finite
+        # rather than golden values; real towers sniff exactly.
+        img = np.random.default_rng(0).uniform(size=(1, 28, 28, 3)).astype(
+            np.float32
+        )
+        (cvo,) = CLIPVisionEncode().encode(clip_vision, img, crop="center")
+        assert cvo["image_embeds"].shape == (1, 24)
+        assert np.isfinite(np.asarray(cvo["image_embeds"])).all()
+        # Not-an-unclip file raises with guidance.
+        plain = {k: v for k, v in sd.items()
+                 if not k.startswith("embedder.")}
+        ckpt2 = tmp_path / "plain.safetensors"
+        save_file(plain, str(ckpt2))
+        with pytest.raises(ValueError, match="not an unCLIP"):
+            unCLIPCheckpointLoader().load(str(ckpt2))
+
+
 class TestUnclipReviewFixes:
     def _adm_model(self):
         import jax
@@ -1710,8 +1820,10 @@ class TestMaskAndUtilityShims:
             width=848, height=480, length=25, batch_size=2
         )
         assert lat["samples"].shape == (2, 7, 60, 106, 16)
-        with pytest.raises(ValueError, match="1 mod 4"):
-            n["EmptyHunyuanLatentVideo"]().generate(64, 64, 10)
+        # Off-schedule lengths floor to 4k+1 like stock (API submissions
+        # bypass widget steps): 10 -> 9 pixel frames -> 3 latent frames.
+        (lat2,) = n["EmptyHunyuanLatentVideo"]().generate(64, 64, 10)
+        assert lat2["samples"].shape == (1, 3, 8, 8, 16)
 
     def test_conditioning_set_mask_node(self):
         import jax.numpy as jnp
@@ -1722,12 +1834,15 @@ class TestMaskAndUtilityShims:
         mask = jnp.ones((1, 8, 8))
         (out,) = n["ConditioningSetMask"]().append(cond, mask, strength=0.5,
                                                    set_cond_area="default")
-        # Stock keeps the area (the denoiser composes box × mask) and maps
-        # the tag over combined extras too (conditioning_set_values rule).
+        # Stock keeps the area (the denoiser composes box × mask), stores
+        # the mask strength under its OWN key (area strength and mask
+        # strength multiply — a shared key would clobber), and maps the tag
+        # over combined extras too (conditioning_set_values rule).
         assert out["area"] == (4, 4, 0, 0)
-        assert out["strength"] == 0.5 and out["mask"].shape == (1, 8, 8)
+        assert "strength" not in out  # SetMask never touches area strength
+        assert out["mask_strength"] == 0.5 and out["mask"].shape == (1, 8, 8)
         assert out["extras"][0]["mask"].shape == (1, 8, 8)
-        assert out["extras"][0]["strength"] == 0.5
+        assert out["extras"][0]["mask_strength"] == 0.5
 
     def test_image_invert(self):
         import jax.numpy as jnp

@@ -169,3 +169,78 @@ class TestNodes:
         assert out["image_embeds"].shape == (1, 16)
         assert out["penultimate"].shape[0] == 1
         assert np.isfinite(np.asarray(out["image_embeds"])).all()
+
+
+def _openclip_visual_sd(cfg, params):
+    """Inverse-synthesize an OpenCLIP ``visual.*``-layout dict from our param
+    tree (hand-written inverse of ``openclip_visual_to_hf`` so the remap is
+    checked against an independently-derived mapping)."""
+    sd = {
+        # torch conv (out, in, kh, kw) from flax kernel (kh, kw, in, out)
+        "conv1.weight": np.asarray(params["patch_embed"]["kernel"])
+            .transpose(3, 2, 0, 1),
+        "class_embedding": np.asarray(params["class_embedding"]),
+        "positional_embedding": np.asarray(params["pos_emb"]),
+        "ln_pre.weight": np.asarray(params["pre_ln"]["scale"]),
+        "ln_pre.bias": np.asarray(params["pre_ln"]["bias"]),
+        "ln_post.weight": np.asarray(params["post_ln"]["scale"]),
+        "ln_post.bias": np.asarray(params["post_ln"]["bias"]),
+        "proj": np.asarray(params["visual_proj"]["kernel"]),
+    }
+    for i in range(cfg.num_layers):
+        blk = params[f"layers_{i}"]
+        t = f"transformer.resblocks.{i}"
+        sd[f"{t}.attn.in_proj_weight"] = np.concatenate(
+            [np.asarray(blk[n]["kernel"]).T for n in "qkv"], axis=0
+        )
+        sd[f"{t}.attn.in_proj_bias"] = np.concatenate(
+            [np.asarray(blk[n]["bias"]) for n in "qkv"]
+        )
+        sd[f"{t}.attn.out_proj.weight"] = np.asarray(blk["out"]["kernel"]).T
+        sd[f"{t}.attn.out_proj.bias"] = np.asarray(blk["out"]["bias"])
+        sd[f"{t}.mlp.c_fc.weight"] = np.asarray(blk["fc1"]["kernel"]).T
+        sd[f"{t}.mlp.c_fc.bias"] = np.asarray(blk["fc1"]["bias"])
+        sd[f"{t}.mlp.c_proj.weight"] = np.asarray(blk["fc2"]["kernel"]).T
+        sd[f"{t}.mlp.c_proj.bias"] = np.asarray(blk["fc2"]["bias"])
+        sd[f"{t}.ln_1.weight"] = np.asarray(blk["ln1"]["scale"])
+        sd[f"{t}.ln_1.bias"] = np.asarray(blk["ln1"]["bias"])
+        sd[f"{t}.ln_2.weight"] = np.asarray(blk["ln2"]["scale"])
+        sd[f"{t}.ln_2.bias"] = np.asarray(blk["ln2"]["bias"])
+    return sd
+
+
+class TestOpenCLIPVisual:
+    def test_remap_round_trip_and_forward(self):
+        """The unclip checkpoints' bundled tower layout: OpenCLIP visual.*
+        keys convert through the same path as HF ones (detected + remapped),
+        landing on identical params."""
+        import dataclasses
+
+        import jax
+
+        from comfyui_parallelanything_tpu.models.vision import (
+            build_clip_vision,
+        )
+        from tree_utils import flatten_tree
+
+        cfg = dataclasses.replace(TINY, act="gelu")
+        enc = build_clip_vision(cfg, rng=jax.random.key(3))
+        sd = _openclip_visual_sd(cfg, enc.params)
+        got, got_cfg = convert_clip_vision_checkpoint(sd)
+        # Sniffed config must land on the same tower (act keys off width).
+        assert got_cfg.hidden_size == cfg.hidden_size
+        assert got_cfg.num_layers == cfg.num_layers
+        assert got_cfg.projection_dim == cfg.projection_dim
+        fg, fw = dict(flatten_tree(got)), dict(flatten_tree(enc.params))
+        assert sorted(fg) == sorted(fw)
+        for k in fw:
+            np.testing.assert_array_equal(np.asarray(fg[k]),
+                                          np.asarray(fw[k]), err_msg=str(k))
+
+    def test_unrecognized_key_raises(self):
+        from comfyui_parallelanything_tpu.models.vision import (
+            openclip_visual_to_hf,
+        )
+
+        with pytest.raises(KeyError, match="unrecognized"):
+            openclip_visual_to_hf({"attnpool.weird": np.zeros(1)})
