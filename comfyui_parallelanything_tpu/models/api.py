@@ -71,6 +71,11 @@ class DiffusionModel:
     # model_options analogue): e.g. {"cfg_rescale": 0.7} from RescaleCFG.
     # Samplers read these as defaults; explicit widget values win.
     sampler_prefs: dict | None = None
+    # Loader provenance ({"path", "family"}, set by the checkpoint loaders):
+    # the LoraLoader shims re-bake from the ORIGINAL file, so this must
+    # survive every patch node's dataclasses.replace — hence a field, not an
+    # object.__setattr__ side channel.
+    source: dict | None = None
 
     def __call__(self, x, timesteps, context=None, **kwargs):
         """Jit-compiled forward (cached per shape and per ambient sequence_parallel

@@ -2305,6 +2305,43 @@ class LoadImageMask:
         return (jnp.asarray(arr[..., idx], jnp.float32),)
 
 
+class SamplerCustom:
+    """Stock SamplerCustom — the older one-box custom-sampling driver (MODEL
+    + conds + SAMPLER + SIGMAS in one node, vs SamplerCustomAdvanced's
+    NOISE/GUIDER split). Composes the same wires and delegates."""
+
+    DESCRIPTION = "Stock-name custom-sampling driver (pre-Advanced form)."
+    RETURN_TYPES = ("LATENT", "LATENT")
+    RETURN_NAMES = ("output", "denoised_output")
+    FUNCTION = "sample"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "model": ("MODEL", {}),
+            "add_noise": ("BOOLEAN", {"default": True}),
+            "noise_seed": ("INT", {"default": 0, "min": 0, "max": 2**64 - 1}),
+            "cfg": ("FLOAT", {"default": 8.0, "min": 0.0, "max": 100.0}),
+            "positive": ("CONDITIONING", {}),
+            "negative": ("CONDITIONING", {}),
+            "sampler": ("SAMPLER", {}),
+            "sigmas": ("SIGMAS", {}),
+            "latent_image": ("LATENT", {}),
+        }}
+
+    def sample(self, model, add_noise, noise_seed: int, cfg: float,
+               positive, negative, sampler, sigmas, latent_image):
+        from .nodes import TPUSamplerCustomAdvanced
+
+        noise = {"seed": int(noise_seed) if add_noise else None}
+        guider = {"model": model, "positive": positive,
+                  "negative": negative, "cfg": float(cfg)}
+        return TPUSamplerCustomAdvanced().sample(
+            noise, guider, sampler, sigmas, latent_image
+        )
+
+
 class unCLIPCheckpointLoader:
     """Stock unCLIP loader: the sd21-unclip single file bundles a FOURTH
     component — its ViT-H image encoder (OpenCLIP layout under
@@ -2400,12 +2437,9 @@ class ModelSamplingDiscrete:
                 f"carries a prediction field (got {type(model).__name__}); "
                 "apply it before ParallelAnything"
             )
-        patched = dc.replace(model, config=dc.replace(cfg, prediction=pred))
-        if getattr(model, "source", None) is not None:
-            # dc.replace rebuilds from FIELDS only; the loader's source tag
-            # (object.__setattr__) must survive for downstream LoraLoader.
-            object.__setattr__(patched, "source", model.source)
-        return (patched,)
+        # source/sampler_prefs are DiffusionModel FIELDS, so dc.replace
+        # carries them (downstream LoraLoader depends on source).
+        return (dc.replace(model, config=dc.replace(cfg, prediction=pred)),)
 
 
 class EmptyHunyuanLatentVideo:
@@ -2489,7 +2523,10 @@ class _FreeUBase:
                                    float(s2), self._VERSION)),
             params=model.params, name=f"{model.name}+freeu",
         )
-        return (dc.replace(patched, sampler_prefs=model.sampler_prefs),)
+        # build_unet constructs a FRESH DiffusionModel: carry the loader's
+        # source tag (LoraLoader re-bakes from it) and any sampler prefs.
+        return (dc.replace(patched, sampler_prefs=model.sampler_prefs,
+                           source=getattr(model, "source", None)),)
 
 
 class FreeU(_FreeUBase):
@@ -2710,6 +2747,7 @@ def stock_node_mappings() -> dict[str, type]:
         "RescaleCFG": RescaleCFG,
         "ModelSamplingDiscrete": ModelSamplingDiscrete,
         "unCLIPCheckpointLoader": unCLIPCheckpointLoader,
+        "SamplerCustom": SamplerCustom,
         "EmptyHunyuanLatentVideo": EmptyHunyuanLatentVideo,
         "ConditioningAverage": ConditioningAverage,
         "ConditioningZeroOut": ConditioningZeroOut,

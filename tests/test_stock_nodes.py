@@ -1185,7 +1185,6 @@ class TestUnclipCheckpointLoader:
         image encoder under embedder.model.visual.* — loads through
         unCLIPCheckpointLoader into MODEL/CLIP/VAE/CLIP_VISION, and the
         vision wire encodes an image into CLIP_VISION_OUTPUT."""
-        import dataclasses
         import jax
         import jax.numpy as jnp
         from safetensors.numpy import save_file
@@ -1844,9 +1843,71 @@ class TestMaskAndUtilityShims:
         assert out["extras"][0]["mask"].shape == (1, 8, 8)
         assert out["extras"][0]["mask_strength"] == 0.5
 
+    def test_sampler_custom_matches_advanced(self):
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.models.api import DiffusionModel
+        from comfyui_parallelanything_tpu.nodes import (
+            TPUBasicScheduler,
+            TPUKSamplerSelect,
+            TPURandomNoise,
+            TPUCFGGuider,
+            TPUSamplerCustomAdvanced,
+        )
+
+        n = self._nodes()
+
+        def apply(p, x, t, context=None, **kw):
+            m = jnp.mean(context, axis=(1, 2)).reshape((-1, 1, 1, 1))
+            return x * 0.05 + m
+        model = DiffusionModel(apply=apply, params={},
+                               config=type("C", (), {"prediction": "eps"})())
+        pos = {"context": jnp.ones((1, 3, 5))}
+        neg = {"context": jnp.zeros((1, 3, 5))}
+        lat = {"samples": jnp.zeros((1, 8, 8, 4))}
+        (samp,) = TPUKSamplerSelect().get_sampler("euler")
+        (sig,) = TPUBasicScheduler().get_sigmas(model, "normal", 4, 1.0)
+        (out, den) = n["SamplerCustom"]().sample(
+            model, True, 11, 3.0, pos, neg, samp, sig, lat
+        )
+        (noise,) = TPURandomNoise().get_noise(11)
+        (guider,) = TPUCFGGuider().get_guider(model, pos, neg, 3.0)
+        (out2, _) = TPUSamplerCustomAdvanced().sample(
+            noise, guider, samp, sig, lat
+        )
+        np.testing.assert_allclose(np.asarray(out["samples"]),
+                                   np.asarray(out2["samples"]), atol=1e-6)
+        assert np.isfinite(np.asarray(den["samples"])).all()
+
     def test_image_invert(self):
         import jax.numpy as jnp
 
         n = self._nodes()
         (inv,) = n["ImageInvert"]().invert(jnp.full((1, 2, 2, 3), 0.25))
         assert float(inv[0, 0, 0, 0]) == 0.75
+
+
+class TestPatchSourcePreservation:
+    def test_patches_keep_loader_source_tag(self, tmp_path, monkeypatch):
+        """Every model-patch shim must keep the loader's source tag — the
+        LoraLoader shims re-bake from the original file through it. `source`
+        is a DiffusionModel FIELD precisely so dc.replace carries it."""
+        from comfyui_parallelanything_tpu.nodes import NODE_CLASS_MAPPINGS
+
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        model, _, _ = (
+            NODE_CLASS_MAPPINGS["CheckpointLoaderSimple"]().load(paths["ckpt"])
+        )
+        assert model.source["family"] == "sd15"
+        from comfyui_parallelanything_tpu.nodes_compat import (
+            FreeU_V2,
+            ModelSamplingDiscrete,
+            RescaleCFG,
+        )
+
+        (a,) = FreeU_V2().patch(model, 1.3, 1.4, 0.9, 0.2)
+        (b,) = RescaleCFG().patch(a, 0.7)
+        (c,) = ModelSamplingDiscrete().patch(b, "v_prediction")
+        assert c.source == model.source
+        assert c.sampler_prefs == {"cfg_rescale": 0.7}
+        assert c.config.freeu is not None and c.config.prediction == "v"
