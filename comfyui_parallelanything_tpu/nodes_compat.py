@@ -108,9 +108,9 @@ class CheckpointLoaderSimple:
         family = sniff_model_family(peek_safetensors(path))
         model, vae = TPUCheckpointLoader().load(ckpt_path=path, family=family)
         # Source tag: the LoraLoader shim re-bakes from the original file
-        # (LoRA applies to the checkpoint layout pre-conversion). Same
-        # object.__setattr__ route the frozen dataclass uses for _jit_cache.
-        object.__setattr__(model, "source", {"path": path, "family": family})
+        # (LoRA applies to the checkpoint layout pre-conversion). `source`
+        # is a plain DiffusionModel field (api.py) — ordinary assignment.
+        model.source = {"path": path, "family": family}
         # source_ckpt marks this CLIP wire as rebuildable-from-checkpoint: the
         # LoraLoader shim's strength_clip rebuild must never clobber a wire
         # that came from DualCLIPLoader/TPUCLIPLoader instead.
@@ -490,7 +490,7 @@ class UNETLoader:
         )
         # Same source tag CheckpointLoaderSimple leaves: the LoraLoader shims
         # re-bake from the original file.
-        object.__setattr__(model, "source", {"path": path, "family": family})
+        model.source = {"path": path, "family": family}
         return (model,)
 
 
@@ -596,10 +596,8 @@ class LoraLoader:
             load_vae=False,  # re-bake only needs the diffusion model
         )
         clip_stack = list(source.get("te_loras", ())) + [(lora, strength_clip)]
-        object.__setattr__(
-            patched, "source",
-            {**source, "loras": model_stack, "te_loras": clip_stack},
-        )
+        patched.source = {**source, "loras": model_stack,
+                          "te_loras": clip_stack}
         clip = self._maybe_rebake_clip(clip, source, clip_stack)
         return patched, clip
 
@@ -2305,6 +2303,133 @@ class LoadImageMask:
         return (jnp.asarray(arr[..., idx], jnp.float32),)
 
 
+class KarrasScheduler:
+    """Stock custom-sampling Karras sigma node → SIGMAS wire
+    (sampling/k_samplers.karras_sigmas)."""
+
+    DESCRIPTION = "Stock-name Karras sigma schedule."
+    RETURN_TYPES = ("SIGMAS",)
+    RETURN_NAMES = ("sigmas",)
+    FUNCTION = "get_sigmas"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "steps": ("INT", {"default": 20, "min": 1, "max": 10000}),
+            "sigma_max": ("FLOAT", {"default": 14.614642, "min": 0.0,
+                                    "max": 5000.0, "step": 0.01}),
+            "sigma_min": ("FLOAT", {"default": 0.0291675, "min": 0.0,
+                                    "max": 5000.0, "step": 0.01}),
+            "rho": ("FLOAT", {"default": 7.0, "min": 0.0, "max": 100.0,
+                              "step": 0.01}),
+        }}
+
+    def get_sigmas(self, steps: int, sigma_max: float, sigma_min: float,
+                   rho: float):
+        from .sampling.k_samplers import karras_sigmas
+
+        return (karras_sigmas(int(steps), sigma_min=float(sigma_min),
+                              sigma_max=float(sigma_max), rho=float(rho)),)
+
+
+class ExponentialScheduler:
+    DESCRIPTION = "Stock-name exponential (log-uniform) sigma schedule."
+    RETURN_TYPES = ("SIGMAS",)
+    RETURN_NAMES = ("sigmas",)
+    FUNCTION = "get_sigmas"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "steps": ("INT", {"default": 20, "min": 1, "max": 10000}),
+            "sigma_max": ("FLOAT", {"default": 14.614642, "min": 0.0,
+                                    "max": 5000.0, "step": 0.01}),
+            "sigma_min": ("FLOAT", {"default": 0.0291675, "min": 0.0,
+                                    "max": 5000.0, "step": 0.01}),
+        }}
+
+    def get_sigmas(self, steps: int, sigma_max: float, sigma_min: float):
+        from .sampling.k_samplers import exponential_sigmas
+
+        return (exponential_sigmas(int(steps), sigma_min=float(sigma_min),
+                                   sigma_max=float(sigma_max)),)
+
+
+class SDTurboScheduler:
+    """Stock SD-Turbo schedule: the model's top ``steps`` trained sigmas
+    offset by denoise (turbo models sample in 1-4 steps from raw table
+    entries, not interpolated spacings)."""
+
+    DESCRIPTION = "Stock-name SD-Turbo sigma schedule."
+    RETURN_TYPES = ("SIGMAS",)
+    RETURN_NAMES = ("sigmas",)
+    FUNCTION = "get_sigmas"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "model": ("MODEL", {}),
+            "steps": ("INT", {"default": 1, "min": 1, "max": 10}),
+            "denoise": ("FLOAT", {"default": 1.0, "min": 0.0, "max": 1.0,
+                                  "step": 0.01}),
+        }}
+
+    def get_sigmas(self, model, steps: int, denoise: float = 1.0):
+        import jax.numpy as jnp
+
+        from .sampling.k_samplers import model_sigmas
+        from .sampling.schedules import scaled_linear_schedule
+
+        pred = getattr(getattr(model, "config", None), "prediction", "eps")
+        if pred == "flow":
+            raise ValueError(
+                "SDTurboScheduler reads the SD eps/v trained-sigma ladder — "
+                "flow-family models schedule with BasicScheduler instead"
+            )
+        # Stock: a fixed 10-rung ladder of trained timesteps [999, 899, …,
+        # 99], sliced [start : start+steps] with start = 10 − int(10·denoise)
+        # — slicing TRUNCATES past the end (no clamping: a repeated sigma
+        # would divide-by-zero the multistep samplers).
+        table = model_sigmas(scaled_linear_schedule())
+        ladder = [i * 100 - 1 for i in range(10, 0, -1)]
+        start = 10 - int(10 * float(denoise))
+        idx = ladder[start:start + int(steps)]
+        if not idx:
+            raise ValueError(
+                f"denoise {denoise} leaves no turbo steps (start rung "
+                f"{start} of 10)"
+            )
+        sig = table[jnp.asarray(idx, jnp.int32)]
+        return (jnp.concatenate([sig, jnp.zeros((1,), jnp.float32)]),)
+
+
+def _named_sampler(stock_name: str, sampler_name: str):
+    """A stock named-sampler node (SamplerEulerAncestral, …) → SAMPLER wire.
+    Stock variants carry eta/noise widgets; the TPU samplers run their
+    k-diffusion defaults, so the wires are name-only (divergence documented
+    in the sampler module)."""
+
+    class _Named:
+        DESCRIPTION = f"Stock-name SAMPLER wire for {sampler_name}."
+        RETURN_TYPES = ("SAMPLER",)
+        RETURN_NAMES = ("sampler",)
+        FUNCTION = "get_sampler"
+        CATEGORY = CATEGORY
+
+        @classmethod
+        def INPUT_TYPES(cls):
+            return {"required": {}}
+
+        def get_sampler(self, **_ignored):
+            return ({"sampler": sampler_name},)
+
+    _Named.__name__ = stock_name
+    return _Named
+
+
 class SamplerCustom:
     """Stock SamplerCustom — the older one-box custom-sampling driver (MODEL
     + conds + SAMPLER + SIGMAS in one node, vs SamplerCustomAdvanced's
@@ -2748,6 +2873,17 @@ def stock_node_mappings() -> dict[str, type]:
         "ModelSamplingDiscrete": ModelSamplingDiscrete,
         "unCLIPCheckpointLoader": unCLIPCheckpointLoader,
         "SamplerCustom": SamplerCustom,
+        "KarrasScheduler": KarrasScheduler,
+        "ExponentialScheduler": ExponentialScheduler,
+        "SDTurboScheduler": SDTurboScheduler,
+        "SamplerEulerAncestral": _named_sampler("SamplerEulerAncestral",
+                                                "euler_ancestral"),
+        "SamplerDPMPP_2M_SDE": _named_sampler("SamplerDPMPP_2M_SDE",
+                                              "dpmpp_2m_sde"),
+        "SamplerDPMPP_SDE": _named_sampler("SamplerDPMPP_SDE", "dpmpp_sde"),
+        "SamplerDPMPP_3M_SDE": _named_sampler("SamplerDPMPP_3M_SDE",
+                                              "dpmpp_3m_sde"),
+        "SamplerLMS": _named_sampler("SamplerLMS", "lms"),
         "EmptyHunyuanLatentVideo": EmptyHunyuanLatentVideo,
         "ConditioningAverage": ConditioningAverage,
         "ConditioningZeroOut": ConditioningZeroOut,

@@ -1911,3 +1911,76 @@ class TestPatchSourcePreservation:
         assert c.source == model.source
         assert c.sampler_prefs == {"cfg_rescale": 0.7}
         assert c.config.freeu is not None and c.config.prediction == "v"
+
+
+class TestCustomSamplingSchedulers:
+    def _nodes(self):
+        from comfyui_parallelanything_tpu.nodes_compat import (
+            stock_node_mappings,
+        )
+
+        return stock_node_mappings()
+
+    def test_karras_and_exponential_nodes(self):
+        n = self._nodes()
+        (sig,) = n["KarrasScheduler"]().get_sigmas(
+            steps=10, sigma_max=14.6, sigma_min=0.03, rho=7.0
+        )
+        s = np.asarray(sig)
+        assert len(s) == 11 and s[-1] == 0.0 and np.all(np.diff(s[:-1]) < 0)
+        assert s[0] == pytest.approx(14.6, rel=1e-4)
+        (sig2,) = n["ExponentialScheduler"]().get_sigmas(
+            steps=8, sigma_max=10.0, sigma_min=0.1
+        )
+        s2 = np.asarray(sig2)
+        assert len(s2) == 9 and s2[-1] == 0.0
+        assert s2[0] == pytest.approx(10.0, rel=1e-4)
+
+    def test_sd_turbo_schedule(self):
+        n = self._nodes()
+        (sig,) = n["SDTurboScheduler"]().get_sigmas(None, steps=1,
+                                                    denoise=1.0)
+        s = np.asarray(sig)
+        # One step from the TOP of the trained ladder, then 0.
+        assert len(s) == 2 and s[-1] == 0.0
+        from comfyui_parallelanything_tpu.sampling.k_samplers import (
+            model_sigmas,
+        )
+        from comfyui_parallelanything_tpu.sampling.schedules import (
+            scaled_linear_schedule,
+        )
+
+        table = np.asarray(model_sigmas(scaled_linear_schedule()))
+        assert s[0] == pytest.approx(table[-1], rel=1e-5)
+        # Stock offset rule: start = 10 − int(10·denoise); fractional rungs
+        # floor (denoise=0.75 → start 3 → timestep 699 — the stock value).
+        (sig2,) = n["SDTurboScheduler"]().get_sigmas(None, steps=2,
+                                                     denoise=0.5)
+        s2 = np.asarray(sig2)
+        assert s2[0] == pytest.approx(table[499], rel=1e-5)
+        assert len(s2) == 3 and np.all(np.diff(s2) < 0)
+        (sig3,) = n["SDTurboScheduler"]().get_sigmas(None, steps=1,
+                                                     denoise=0.75)
+        assert np.asarray(sig3)[0] == pytest.approx(table[699], rel=1e-5)
+        # Past-the-ladder slices TRUNCATE (no repeated sigmas — those NaN
+        # the multistep SDE samplers).
+        (sig4,) = n["SDTurboScheduler"]().get_sigmas(None, steps=8,
+                                                     denoise=0.3)
+        s4 = np.asarray(sig4)
+        assert len(s4) == 4 and np.all(np.diff(s4) < 0)  # 3 rungs + 0
+        import types
+        flowish = types.SimpleNamespace(
+            config=types.SimpleNamespace(prediction="flow"))
+        with pytest.raises(ValueError, match="flow"):
+            n["SDTurboScheduler"]().get_sigmas(flowish, steps=1)
+
+    def test_named_sampler_nodes(self):
+        n = self._nodes()
+        for name, want in (("SamplerEulerAncestral", "euler_ancestral"),
+                           ("SamplerDPMPP_2M_SDE", "dpmpp_2m_sde"),
+                           ("SamplerDPMPP_SDE", "dpmpp_sde"),
+                           ("SamplerDPMPP_3M_SDE", "dpmpp_3m_sde"),
+                           ("SamplerLMS", "lms")):
+            # Stock variants carry eta/s_noise widgets — absorbed.
+            (wire,) = n[name]().get_sampler(eta=1.0, s_noise=1.0)
+            assert wire == {"sampler": want}
