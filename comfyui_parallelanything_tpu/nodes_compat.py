@@ -2303,6 +2303,290 @@ class LoadImageMask:
         return (jnp.asarray(arr[..., idx], jnp.float32),)
 
 
+class ImageCrop:
+    DESCRIPTION = "Stock-name image crop."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "crop"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "image": ("IMAGE", {}),
+            "width": ("INT", {"default": 512, "min": 1, "max": 16384}),
+            "height": ("INT", {"default": 512, "min": 1, "max": 16384}),
+            "x": ("INT", {"default": 0, "min": 0, "max": 16384}),
+            "y": ("INT", {"default": 0, "min": 0, "max": 16384}),
+        }}
+
+    def crop(self, image, width: int, height: int, x: int, y: int):
+        import jax.numpy as jnp
+
+        img = jnp.asarray(image)
+        if img.ndim == 3:
+            img = img[None]
+        B, H, W, C = img.shape
+        x = min(int(x), W - 1)
+        y = min(int(y), H - 1)
+        return (img[:, y:min(y + int(height), H), x:min(x + int(width), W)],)
+
+
+def _gaussian_kernel1d(radius: int, sigma: float):
+    import jax.numpy as jnp
+
+    xs = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+    k = jnp.exp(-(xs**2) / (2.0 * float(sigma) ** 2))
+    return k / jnp.sum(k)
+
+
+def _separable_blur(img, radius: int, sigma: float):
+    """Edge-padded separable Gaussian over (B,H,W,C) — the shared primitive
+    of the stock blur/sharpen pair."""
+    import jax
+    import jax.numpy as jnp
+
+    k = _gaussian_kernel1d(radius, sigma)
+    pad = int(radius)
+    # reflect, not edge: stock's Blur/Sharpen pad reflectively — edge
+    # replication over-weights the outermost row and diverges on borders.
+    x = jnp.pad(img, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                mode="reflect")
+    # Two depthwise 1-D convolutions (separable Gaussian).
+    x = jax.lax.conv_general_dilated(
+        x.transpose(0, 3, 1, 2), jnp.broadcast_to(
+            k.reshape(1, 1, -1, 1), (img.shape[-1], 1, 2 * pad + 1, 1)),
+        (1, 1), "VALID", feature_group_count=img.shape[-1],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    x = jax.lax.conv_general_dilated(
+        x, jnp.broadcast_to(
+            k.reshape(1, 1, 1, -1), (img.shape[-1], 1, 1, 2 * pad + 1)),
+        (1, 1), "VALID", feature_group_count=img.shape[-1],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return x.transpose(0, 2, 3, 1)
+
+
+class ImageBlur:
+    DESCRIPTION = "Stock-name Gaussian image blur."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "blur"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "image": ("IMAGE", {}),
+            "blur_radius": ("INT", {"default": 1, "min": 1, "max": 31}),
+            "sigma": ("FLOAT", {"default": 1.0, "min": 0.1, "max": 10.0,
+                                "step": 0.1}),
+        }}
+
+    def blur(self, image, blur_radius: int, sigma: float):
+        import jax.numpy as jnp
+
+        img = jnp.asarray(image)
+        if img.ndim == 3:
+            img = img[None]
+        return (_separable_blur(img, int(blur_radius), float(sigma)),)
+
+
+class ImageSharpen:
+    """Stock unsharp mask: img + alpha·(img − gaussian(img)), clipped."""
+
+    DESCRIPTION = "Stock-name image sharpen (unsharp mask)."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "sharpen"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "image": ("IMAGE", {}),
+            "sharpen_radius": ("INT", {"default": 1, "min": 1, "max": 31}),
+            "sigma": ("FLOAT", {"default": 1.0, "min": 0.1, "max": 10.0,
+                                "step": 0.1}),
+            "alpha": ("FLOAT", {"default": 1.0, "min": 0.0, "max": 5.0,
+                                "step": 0.1}),
+        }}
+
+    def sharpen(self, image, sharpen_radius: int, sigma: float, alpha: float):
+        import jax.numpy as jnp
+
+        img = jnp.asarray(image)
+        if img.ndim == 3:
+            img = img[None]
+        blurred = _separable_blur(img, int(sharpen_radius), float(sigma))
+        return (jnp.clip(img + float(alpha) * (img - blurred), 0.0, 1.0),)
+
+
+class LatentBlend:
+    DESCRIPTION = "Stock-name latent lerp."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "blend"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "samples1": ("LATENT", {}),
+            "samples2": ("LATENT", {}),
+            "blend_factor": ("FLOAT", {"default": 0.5, "min": 0.0,
+                                       "max": 1.0, "step": 0.01}),
+        }}
+
+    def blend(self, samples1, samples2, blend_factor: float):
+        import jax.numpy as jnp
+
+        a = jnp.asarray(samples1["samples"])
+        b = _reshape_latent_to(a, jnp.asarray(samples2["samples"]))
+        f = float(blend_factor)
+        # Stock LatentBlend: samples1·factor + samples2·(1−factor).
+        return ({**samples1, "samples": a * f + b * (1.0 - f)},)
+
+
+def _reshape_latent_to(a, b):
+    """Stock reshape_latent_to: resize ``b``'s SPATIAL grid to ``a``'s and
+    cycle its batch up — the two-latent math nodes all normalize this way.
+    Channel counts must already agree (resizing across channels would
+    fabricate latent data; stock fails loudly there too)."""
+    import jax
+    import jax.numpy as jnp
+
+    if a.shape[-1] != b.shape[-1]:
+        raise ValueError(
+            f"latent channel counts differ ({a.shape[-1]} vs {b.shape[-1]} — "
+            "e.g. an SD1.5 latent mixed with an SD3/FLUX one); latent math "
+            "needs same-family latents"
+        )
+    if a.shape[1:-1] != b.shape[1:-1]:
+        b = jax.image.resize(
+            b, (b.shape[0], *a.shape[1:-1], b.shape[-1]), method="bilinear"
+        )
+    return _repeat_to_batch(b, a.shape[0])
+
+
+def _latent_binop(stock_name: str, fn):
+    class _Op:
+        DESCRIPTION = f"Stock-name latent op {stock_name}."
+        RETURN_TYPES = ("LATENT",)
+        RETURN_NAMES = ("latent",)
+        FUNCTION = "op"
+        CATEGORY = CATEGORY
+
+        @classmethod
+        def INPUT_TYPES(cls):
+            return {"required": {"samples1": ("LATENT", {}),
+                                 "samples2": ("LATENT", {})}}
+
+        def op(self, samples1, samples2):
+            import jax.numpy as jnp
+
+            a = jnp.asarray(samples1["samples"])
+            b = _reshape_latent_to(a, jnp.asarray(samples2["samples"]))
+            return ({**samples1, "samples": fn(a, b)},)
+
+    _Op.__name__ = stock_name
+    return _Op
+
+
+class LatentInterpolate:
+    """Stock norm-preserving latent interpolation: directions lerp after
+    per-pixel channel-norm normalization, magnitudes lerp separately, then
+    recombine (nodes_latent.py LatentInterpolate)."""
+
+    DESCRIPTION = "Stock-name norm-preserving latent interpolate."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "op"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "samples1": ("LATENT", {}),
+            "samples2": ("LATENT", {}),
+            "ratio": ("FLOAT", {"default": 1.0, "min": 0.0, "max": 1.0,
+                                "step": 0.01}),
+        }}
+
+    def op(self, samples1, samples2, ratio: float):
+        import jax.numpy as jnp
+
+        a = jnp.asarray(samples1["samples"])
+        b = _reshape_latent_to(a, jnp.asarray(samples2["samples"]))
+        r = float(ratio)
+        # Channel-axis norms (torch dim=1 on NCHW == our last axis).
+        na = jnp.linalg.norm(a, axis=-1, keepdims=True)
+        nb = jnp.linalg.norm(b, axis=-1, keepdims=True)
+        da = jnp.where(na > 0, a / jnp.maximum(na, 1e-12), 0.0)
+        db = jnp.where(nb > 0, b / jnp.maximum(nb, 1e-12), 0.0)
+        t = da * r + db * (1.0 - r)
+        nt = jnp.linalg.norm(t, axis=-1, keepdims=True)
+        st = jnp.where(nt > 0, t / jnp.maximum(nt, 1e-12), 0.0)
+        return ({**samples1,
+                 "samples": st * (na * r + nb * (1.0 - r))},)
+
+
+class LatentMultiply:
+    """Stock scalar latent multiply (samples × multiplier) — unlike
+    Add/Subtract this one takes a FLOAT, not a second latent."""
+
+    DESCRIPTION = "Stock-name latent scalar multiply."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "op"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "samples": ("LATENT", {}),
+            "multiplier": ("FLOAT", {"default": 1.0, "min": -10.0,
+                                     "max": 10.0, "step": 0.01}),
+        }}
+
+    def op(self, samples, multiplier: float):
+        import jax.numpy as jnp
+
+        return ({**samples,
+                 "samples": jnp.asarray(samples["samples"])
+                 * float(multiplier)},)
+
+
+class LatentBatch:
+    """Stock latent batch join (resizes the second to the first's grid like
+    ImageBatch)."""
+
+    DESCRIPTION = "Stock-name latent batch concat."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "batch"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"samples1": ("LATENT", {}),
+                             "samples2": ("LATENT", {})}}
+
+    def batch(self, samples1, samples2):
+        import jax
+        import jax.numpy as jnp
+
+        a = jnp.asarray(samples1["samples"])
+        b = jnp.asarray(samples2["samples"])
+        if a.shape[1:-1] != b.shape[1:-1]:
+            b = jax.image.resize(
+                b, (b.shape[0], *a.shape[1:-1], b.shape[-1]),
+                method="bilinear",
+            )
+        return ({**samples1, "samples": jnp.concatenate([a, b], axis=0)},)
+
+
 class KarrasScheduler:
     """Stock custom-sampling Karras sigma node → SIGMAS wire
     (sampling/k_samplers.karras_sigmas)."""
@@ -2873,6 +3157,15 @@ def stock_node_mappings() -> dict[str, type]:
         "ModelSamplingDiscrete": ModelSamplingDiscrete,
         "unCLIPCheckpointLoader": unCLIPCheckpointLoader,
         "SamplerCustom": SamplerCustom,
+        "ImageCrop": ImageCrop,
+        "ImageBlur": ImageBlur,
+        "ImageSharpen": ImageSharpen,
+        "LatentBlend": LatentBlend,
+        "LatentBatch": LatentBatch,
+        "LatentAdd": _latent_binop("LatentAdd", lambda a, b: a + b),
+        "LatentSubtract": _latent_binop("LatentSubtract", lambda a, b: a - b),
+        "LatentInterpolate": LatentInterpolate,
+        "LatentMultiply": LatentMultiply,
         "KarrasScheduler": KarrasScheduler,
         "ExponentialScheduler": ExponentialScheduler,
         "SDTurboScheduler": SDTurboScheduler,

@@ -1984,3 +1984,73 @@ class TestCustomSamplingSchedulers:
             # Stock variants carry eta/s_noise widgets — absorbed.
             (wire,) = n[name]().get_sampler(eta=1.0, s_noise=1.0)
             assert wire == {"sampler": want}
+
+
+class TestImageAndLatentOps:
+    def _nodes(self):
+        from comfyui_parallelanything_tpu.nodes_compat import (
+            stock_node_mappings,
+        )
+
+        return stock_node_mappings()
+
+    def test_image_crop_blur_sharpen(self):
+        import jax.numpy as jnp
+
+        n = self._nodes()
+        img = jnp.zeros((1, 16, 16, 3)).at[:, 8, 8, :].set(1.0)
+        (c,) = n["ImageCrop"]().crop(img, width=8, height=4, x=4, y=6)
+        assert c.shape == (1, 4, 8, 3)
+        (b,) = n["ImageBlur"]().blur(img, blur_radius=2, sigma=1.0)
+        assert b.shape == img.shape
+        # Blur spreads the impulse: center drops, neighbor rises.
+        assert float(b[0, 8, 8, 0]) < 1.0 and float(b[0, 8, 9, 0]) > 0.0
+        assert float(jnp.sum(b)) == pytest.approx(float(jnp.sum(img)),
+                                                  rel=1e-3)  # energy kept
+        (s,) = n["ImageSharpen"]().sharpen(img, sharpen_radius=2, sigma=1.0,
+                                           alpha=1.0)
+        assert s.shape == img.shape
+        assert float(s[0, 8, 8, 0]) == 1.0  # clipped at 1 after boost
+
+    def test_latent_math(self):
+        import jax.numpy as jnp
+
+        n = self._nodes()
+        a = {"samples": jnp.ones((2, 4, 4, 4))}
+        b = {"samples": jnp.full((1, 4, 4, 4), 2.0)}  # batch-1 cycles up
+        (add,) = n["LatentAdd"]().op(a, b)
+        assert float(add["samples"][1, 0, 0, 0]) == 3.0
+        (sub,) = n["LatentSubtract"]().op(a, b)
+        assert float(sub["samples"][0, 0, 0, 0]) == -1.0
+        (mul,) = n["LatentMultiply"]().op(a, 0.5)
+        assert float(mul["samples"][0, 0, 0, 0]) == 0.5
+        (bl,) = n["LatentBlend"]().blend(a, b, 0.25)
+        assert float(bl["samples"][0, 0, 0, 0]) == pytest.approx(
+            1.0 * 0.25 + 2.0 * 0.75)
+        (bat,) = n["LatentBatch"]().batch(a, b)
+        assert bat["samples"].shape[0] == 3
+        # Interpolate: ratio=1 returns samples1 exactly (direction and
+        # magnitude both degenerate to a's).
+        (it,) = n["LatentInterpolate"]().op(a, b, 1.0)
+        np.testing.assert_allclose(np.asarray(it["samples"]),
+                                   np.asarray(a["samples"]), atol=1e-6)
+        # Midpoint of parallel latents: magnitudes lerp (1 and 2 -> 1.5).
+        (mid,) = n["LatentInterpolate"]().op(a, b, 0.5)
+        np.testing.assert_allclose(np.asarray(mid["samples"]),
+                                   1.5 * np.ones((2, 4, 4, 4)), atol=1e-6)
+        # Spatial mismatch resizes (stock reshape_latent_to).
+        small = {"samples": jnp.ones((1, 2, 2, 4))}
+        (add2,) = n["LatentAdd"]().op(a, small)
+        assert add2["samples"].shape == (2, 4, 4, 4)
+
+
+def test_latent_math_channel_mismatch_raises():
+    import jax.numpy as jnp
+
+    from comfyui_parallelanything_tpu.nodes_compat import stock_node_mappings
+
+    n = stock_node_mappings()
+    a = {"samples": jnp.ones((1, 4, 4, 4))}
+    b = {"samples": jnp.ones((1, 4, 4, 16))}
+    with pytest.raises(ValueError, match="channel counts differ"):
+        n["LatentAdd"]().op(a, b)
