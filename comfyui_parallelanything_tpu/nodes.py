@@ -1017,7 +1017,7 @@ class TPUEmptyVideoLatent:
 
     def generate(
         self, width: int, height: int, frames: int, batch_size: int,
-        channels: int = 16,
+        channels: int | None = None,
     ):
         import jax.numpy as jnp
 
@@ -1026,6 +1026,10 @@ class TPUEmptyVideoLatent:
         cfg = wan_vae_config()
         t_lat = cfg.latent_frames(frames)  # raises on off-schedule counts
         f = cfg.spatial_factor
+        if channels is None:
+            # Default from the SAME config that owns the schedule/factors
+            # (16 for real WAN) — every caller stays consistent with it.
+            channels = cfg.z_channels
         return (
             {
                 "samples": jnp.zeros(

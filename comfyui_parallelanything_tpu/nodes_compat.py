@@ -2881,8 +2881,8 @@ class EmptyHunyuanLatentVideo:
         # Stock floors off-schedule lengths (((length-1)//4)+1 latent
         # frames); API submissions bypass widget steps, so accept any length.
         frames = max(1, (int(length) - 1) // 4 * 4 + 1)
-        # Delegate: the TPU node derives t_lat/spatial factor from
-        # wan_vae_config (single owner of the causal 4k+1 schedule).
+        # Delegate: the TPU node derives t_lat/spatial factor AND the
+        # default channel count from wan_vae_config (single owner).
         return TPUEmptyVideoLatent().generate(
             width=width, height=height, frames=frames, batch_size=batch_size
         )

@@ -1058,6 +1058,17 @@ def _synthetic_wan_env(tmp_path, monkeypatch):
     base_cfg = dataclasses.replace(wcfg, in_channels=zc, img_dim=None)
     monkeypatch.setattr(models_pkg, "wan_1_3b_config", lambda: base_cfg)
 
+    # -- WAN t2v DiT (bare-latent input, no CLIP branch) --------------------
+    dit_t2v = build_wan(
+        base_cfg, jax.random.key(7), sample_shape=(1, 2, 4, 4, zc), txt_len=6
+    )
+    t2v_path = tmp_path / "wan_t2v_tiny.safetensors"
+    save_file(
+        {k: np.ascontiguousarray(v)
+         for k, v in _official_layout_sd(base_cfg, dit_t2v.params).items()},
+        str(t2v_path),
+    )
+
     # -- video VAE (official torch layout) ----------------------------------
     torch.manual_seed(11)
     tvae = TWanVAE(VCFG).eval()
@@ -1120,7 +1131,8 @@ def _synthetic_wan_env(tmp_path, monkeypatch):
     monkeypatch.setenv("PA_INPUT_DIR", str(tmp_path))
 
     return {
-        "dit": str(dit_path), "vae": str(vae_path), "umt5": str(umt5_path),
+        "dit": str(dit_path), "dit_t2v": str(t2v_path),
+        "vae": str(vae_path), "umt5": str(umt5_path),
         "vision": str(vis_path), "image": "start.png",
     }
 
@@ -1284,6 +1296,47 @@ class TestUnclipCheckpointLoader:
         save_file(plain, str(ckpt2))
         with pytest.raises(ValueError, match="not an unCLIP"):
             unCLIPCheckpointLoader().load(str(ckpt2))
+
+
+class TestStockWanT2VWorkflow:
+    def test_wan_t2v_template_runs_unchanged(self, tmp_path, monkeypatch):
+        """The stock WAN text-to-video API export shape — UNETLoader +
+        CLIPLoader(wan) + VAELoader + EmptyHunyuanLatentVideo (the t2v
+        latent entry) + KSampler + VAEDecode + SaveAnimatedWEBP — runs
+        as-is on the tiny synthetic WAN world."""
+        paths = _synthetic_wan_env(tmp_path, monkeypatch)
+        monkeypatch.setenv("PA_OUTPUT_DIR", str(tmp_path / "out"))
+        wf = {
+            "37": {"class_type": "UNETLoader",
+                   "inputs": {"unet_name": paths["dit_t2v"],
+                              "weight_dtype": "default"}},
+            "38": {"class_type": "CLIPLoader",
+                   "inputs": {"clip_name": paths["umt5"], "type": "wan"}},
+            "39": {"class_type": "VAELoader",
+                   "inputs": {"vae_name": paths["vae"]}},
+            "6": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "a cat walking", "clip": ["38", 0]}},
+            "7": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "blurry", "clip": ["38", 0]}},
+            "40": {"class_type": "EmptyHunyuanLatentVideo",
+                   "inputs": {"width": 16, "height": 16, "length": 5,
+                              "batch_size": 1}},
+            "3": {"class_type": "KSampler",
+                  "inputs": {"seed": 3, "steps": 2, "cfg": 1.0,
+                             "sampler_name": "euler", "scheduler": "normal",
+                             "denoise": 1.0, "model": ["37", 0],
+                             "positive": ["6", 0], "negative": ["7", 0],
+                             "latent_image": ["40", 0]}},
+            "8": {"class_type": "VAEDecode",
+                  "inputs": {"samples": ["3", 0], "vae": ["39", 0]}},
+            "28": {"class_type": "SaveAnimatedWEBP",
+                   "inputs": {"images": ["8", 0], "fps": 8.0,
+                              "filename_prefix": "wan_t2v"}},
+        }
+        out = run_workflow(wf)
+        video = np.asarray(out["8"][0])
+        assert video.shape[-1] == 3 and np.isfinite(video).all()
+        assert all(os.path.exists(p) for p in out["28"][0])
 
 
 class TestUnclipReviewFixes:
