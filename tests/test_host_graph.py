@@ -761,3 +761,24 @@ class TestCustomSamplingWorkflow:
         latent = out["out"][0]["samples"]
         assert latent.shape == (1, 8, 8, 4)
         assert np.isfinite(np.asarray(latent)).all()
+
+
+class TestShippedStockExample:
+    def test_example_stock_txt2img_executes(self, tmp_path, monkeypatch):
+        """The stock-named example (pure ComfyUI builtin class names, the
+        shape a stock export has) runs through the compat shims against the
+        synthetic checkpoint — only user-editable fields rewritten."""
+        import os
+
+        from tests.test_stock_nodes import _synthetic_stock_env
+
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        monkeypatch.setenv("PA_OUTPUT_DIR", str(tmp_path / "out"))
+        wf = json.load(open("examples/workflow_stock_sd15_txt2img.json"))
+        wf["4"]["inputs"]["ckpt_name"] = paths["ckpt"]
+        wf["5"]["inputs"].update(width=32, height=32, batch_size=1)
+        wf["3"]["inputs"]["steps"] = 2
+        out = run_workflow(wf)
+        images = np.asarray(out["8"][0])
+        assert images.shape[0] == 1 and np.isfinite(images).all()
+        assert all(os.path.exists(p) for p in out["9"][0])
