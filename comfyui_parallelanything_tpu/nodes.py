@@ -1267,7 +1267,9 @@ def _prepare_sampling_inputs(model, positive, negative, latent, rng=None):
          "pooled": bcast(e.get("pooled"))}
         for e in positive.get("extras", ())
     ]
-    if negative and (negative.get("extras") or negative.get("area") is not None):
+    if negative and (negative.get("extras") or negative.get("area") is not None
+                     or negative.get("area_pct") is not None
+                     or negative.get("mask") is not None):
         from .utils.logging import get_logger
 
         get_logger().warning(
@@ -1302,6 +1304,7 @@ def _prepare_sampling_inputs(model, positive, negative, latent, rng=None):
     cond_extra = {
         "extra_conds": extras,
         "cond_area": positive.get("area"),
+        "cond_area_pct": positive.get("area_pct"),
         "cond_mask": positive.get("mask"),
         "cond_strength": float(positive.get("strength", 1.0)),
         "cond_mask_strength": float(positive.get("mask_strength", 1.0)),

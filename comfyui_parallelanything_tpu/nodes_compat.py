@@ -1636,9 +1636,11 @@ class ConditioningSetArea:
     def append(self, conditioning, width: int, height: int, x: int, y: int,
                strength: float = 1.0):
         # Stock conditioning_set_values maps over EVERY list entry — primary
-        # and combined extras alike get the box.
+        # and combined extras alike get the box. Clears any fractional box
+        # (stock keeps one "area" key, later node wins).
         return (_tag_all_entries(conditioning, {
             "area": (height // 8, width // 8, y // 8, x // 8),
+            "area_pct": None,
             "strength": float(strength),
         }),)
 
@@ -2301,6 +2303,81 @@ class LoadImageMask:
         arr = np.asarray(px)
         idx = {"red": 0, "green": 1, "blue": 2}[channel]
         return (jnp.asarray(arr[..., idx], jnp.float32),)
+
+
+class CLIPTextEncodeFlux:
+    """Stock FLUX encode: SEPARATE prompts per tower (clip_l → pooled,
+    t5xxl → context stream) + the distilled-guidance tag in one node — the
+    stock FLUX template's text entry."""
+
+    DESCRIPTION = "Stock-name FLUX dual-prompt encode with guidance tag."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "encode"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "clip": ("CLIP", {}),
+            "clip_l": ("STRING", {"default": "", "multiline": True}),
+            "t5xxl": ("STRING", {"default": "", "multiline": True}),
+            "guidance": ("FLOAT", {"default": 3.5, "min": 0.0,
+                                   "max": 100.0}),
+        }}
+
+    def encode(self, clip, clip_l: str, t5xxl: str, guidance: float = 3.5):
+        from .nodes import TPUFluxGuidance, TPUTextEncode
+
+        if clip.get("type") != "flux-dual":
+            raise ValueError(
+                "CLIPTextEncodeFlux needs the dual T5+CLIP-L wire "
+                "(DualCLIPLoader type=flux)"
+            )
+        enc = TPUTextEncode()
+        (ct5,) = enc.encode(clip["t5"], t5xxl, 0)
+        (cl,) = enc.encode(clip["l"], clip_l, 0)
+        cond = {"context": ct5["context"], "penultimate": None,
+                "pooled": cl["pooled"]}
+        (tagged,) = TPUFluxGuidance().append(cond, float(guidance))
+        return (tagged,)
+
+
+class ConditioningSetAreaPercentage:
+    """Stock percentage form of SetArea: the box is fractions of the LATENT
+    frame, resolved per-sample at denoise time — here resolved against the
+    stock 8× latent convention like the pixel form."""
+
+    DESCRIPTION = "Stock-name fractional area conditioning."
+    RETURN_TYPES = ("CONDITIONING",)
+    RETURN_NAMES = ("conditioning",)
+    FUNCTION = "append"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "conditioning": ("CONDITIONING", {}),
+            "width": ("FLOAT", {"default": 1.0, "min": 0.0, "max": 1.0,
+                                "step": 0.01}),
+            "height": ("FLOAT", {"default": 1.0, "min": 0.0, "max": 1.0,
+                                 "step": 0.01}),
+            "x": ("FLOAT", {"default": 0.0, "min": 0.0, "max": 1.0,
+                            "step": 0.01}),
+            "y": ("FLOAT", {"default": 0.0, "min": 0.0, "max": 1.0,
+                            "step": 0.01}),
+            "strength": ("FLOAT", {"default": 1.0, "min": 0.0, "max": 10.0}),
+        }}
+
+    def append(self, conditioning, width: float, height: float, x: float,
+               y: float, strength: float = 1.0):
+        # Stock stores BOTH forms under one "area" key, so the later node
+        # always wins; here the forms are separate keys — clear the sibling.
+        return (_tag_all_entries(conditioning, {
+            "area_pct": (float(height), float(width), float(y), float(x)),
+            "area": None,
+            "strength": float(strength),
+        }),)
 
 
 class ImageCrop:
@@ -3151,6 +3228,8 @@ def stock_node_mappings() -> dict[str, type]:
         "ConditioningCombine": ConditioningCombine,
         "ConditioningSetArea": ConditioningSetArea,
         "ConditioningSetMask": ConditioningSetMask,
+        "ConditioningSetAreaPercentage": ConditioningSetAreaPercentage,
+        "CLIPTextEncodeFlux": CLIPTextEncodeFlux,
         "FreeU": FreeU,
         "FreeU_V2": FreeU_V2,
         "RescaleCFG": RescaleCFG,

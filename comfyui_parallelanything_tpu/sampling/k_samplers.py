@@ -271,6 +271,7 @@ class EpsDenoiser:
         cfg_rescale: float = 0.0,
         extra_conds: tuple | list | None = None,
         cond_area: tuple | None = None,
+        cond_area_pct: tuple | None = None,
         cond_mask=None,
         cond_strength: float = 1.0,
         cond_mask_strength: float = 1.0,
@@ -298,6 +299,7 @@ class EpsDenoiser:
         # the same way when SetArea was applied to it directly.
         self.extra_conds = tuple(extra_conds or ())
         self.cond_area = cond_area
+        self.cond_area_pct = cond_area_pct  # fractional SetAreaPercentage box
         self.cond_mask = cond_mask  # pixel-space MASK (ConditioningSetMask)
         self.cond_strength = cond_strength
         self.cond_mask_strength = cond_mask_strength
@@ -306,7 +308,7 @@ class EpsDenoiser:
         self.log_sigmas = jnp.log(self.sigma_table)
 
     def _area_mask(self, area, strength: float, shape, mask=None,
-                   mask_strength: float = 1.0):
+                   mask_strength: float = 1.0, area_pct=None):
         """Per-pixel weight for one cond: ``strength`` everywhere (no
         scoping), strength inside the (h, w, y, x) latent-unit box (SetArea),
         or a pixel-space MASK resized to the latent grid (SetMask — stock's
@@ -314,6 +316,12 @@ class EpsDenoiser:
         weights, the bounds only being stock's compute-crop optimization).
         Non-2D latents (video) use the full frame — stock scoping is 2D."""
         weight = jnp.float32(strength)
+        if area_pct is not None and area is None and len(shape) == 4:
+            # Fractional box (ConditioningSetAreaPercentage): resolve against
+            # the LATENT frame at weight time, when its shape is known.
+            fh, fw, fy, fx = (float(v) for v in area_pct)
+            area = (max(1, round(fh * shape[1])), max(1, round(fw * shape[2])),
+                    round(fy * shape[1]), round(fx * shape[2]))
         if area is not None and len(shape) == 4:
             h, w, y, x0 = (int(v) for v in area)
             box = jnp.zeros((1, shape[1], shape[2], 1), jnp.float32)
@@ -340,7 +348,8 @@ class EpsDenoiser:
         + Combine multi-stage pattern)."""
         m0 = self._area_mask(self.cond_area, self.cond_strength, x_in.shape,
                              mask=self.cond_mask,
-                             mask_strength=self.cond_mask_strength)
+                             mask_strength=self.cond_mask_strength,
+                             area_pct=self.cond_area_pct)
         num = m0 * eps_c
         den = m0 * jnp.ones_like(eps_c[..., :1])
         for e in self.extra_conds:
@@ -354,6 +363,7 @@ class EpsDenoiser:
                 e.get("area"), float(e.get("strength", 1.0)), x_in.shape,
                 mask=e.get("mask"),
                 mask_strength=float(e.get("mask_strength", 1.0)),
+                area_pct=e.get("area_pct"),
             )
             rng_ = e.get("timestep_range")
             if rng_ is not None:
@@ -401,6 +411,7 @@ class EpsDenoiser:
             )
             eps_c, eps_u = jnp.split(eps_both, 2, axis=0)
             if (self.extra_conds or self.cond_area is not None
+                    or self.cond_area_pct is not None
                     or self.cond_mask is not None):
                 eps_c = self._combine_conds(eps_c, x_in, t_vec, batch)
             eps = eps_u + self.cfg_scale * (eps_c - eps_u)
@@ -408,6 +419,7 @@ class EpsDenoiser:
         else:
             eps = self.model(x_in, t_vec, self.context, **self.kwargs)
             if (self.extra_conds or self.cond_area is not None
+                    or self.cond_area_pct is not None
                     or self.cond_mask is not None):
                 eps = self._combine_conds(eps, x_in, t_vec, batch)
         if self.prediction == "v":

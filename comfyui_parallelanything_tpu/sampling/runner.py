@@ -70,6 +70,7 @@ def run_sampler(
     sigmas: jnp.ndarray | None = None,
     extra_conds=None,
     cond_area=None,
+    cond_area_pct=None,
     cond_mask=None,
     cond_strength: float = 1.0,
     cond_mask_strength: float = 1.0,
@@ -118,7 +119,7 @@ def run_sampler(
     if cfg_rescale == 0.0:
         cfg_rescale = float(prefs.get("cfg_rescale", 0.0))
     multi_cond = (bool(extra_conds) or cond_area is not None
-                  or cond_mask is not None)
+                  or cond_area_pct is not None or cond_mask is not None)
     if multi_cond and sampler in ("ddim", "flow_euler"):
         # Multi-cond lives in EpsDenoiser (the k-sampler family — every stock
         # KSampler menu name). ddim/flow_euler are TPU-native extras with
@@ -380,6 +381,7 @@ def run_sampler(
         model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,
         uncond_kwargs=uncond_kwargs, alphas_cumprod=acp, prediction=prediction,
         cfg_rescale=cfg_rescale, extra_conds=extra_conds, cond_area=cond_area,
+        cond_area_pct=cond_area_pct,
         cond_mask=cond_mask, cond_strength=cond_strength,
         cond_mask_strength=cond_mask_strength, **model_kwargs,
     )

@@ -794,3 +794,38 @@ class TestMultiCond:
         s_lo = float(d.sigma_table[0])
         eps_late = -np.asarray(d(x, d.sigma_table[0])) / s_lo
         np.testing.assert_allclose(eps_late, 0.0, atol=1e-5)
+
+
+class TestAreaPercentage:
+    @staticmethod
+    def _mean_model(x, t_vec, context=None, **kw):
+        m = jnp.mean(context, axis=tuple(range(1, context.ndim)))
+        return jnp.ones_like(x) * m.reshape((-1,) + (1,) * (x.ndim - 1))
+
+    def test_fractional_box_equals_pixel_box(self):
+        # area_pct (0.5, 0.5, 0, 0) on an 8x8 latent == area (4, 4, 0, 0).
+        x = jnp.zeros((1, 8, 8, 4), jnp.float32)
+        ctx0 = jnp.zeros((1, 3, 5), jnp.float32)
+        ctx1 = jnp.ones((1, 7, 5), jnp.float32)
+        d_pct = EpsDenoiser(
+            self._mean_model, ctx0,
+            extra_conds=[{"context": ctx1,
+                          "area_pct": (0.5, 0.5, 0.0, 0.0),
+                          "strength": 1.0}],
+        )
+        d_px = EpsDenoiser(
+            self._mean_model, ctx0,
+            extra_conds=[{"context": ctx1, "area": (4, 4, 0, 0),
+                          "strength": 1.0}],
+        )
+        np.testing.assert_allclose(
+            np.asarray(d_pct(x, jnp.float32(1.0))),
+            np.asarray(d_px(x, jnp.float32(1.0))), atol=1e-6,
+        )
+
+    def test_primary_pct_scopes(self):
+        x = jnp.zeros((1, 8, 8, 4), jnp.float32)
+        d = EpsDenoiser(self._mean_model, jnp.ones((1, 3, 5)),
+                        cond_area_pct=(0.5, 1.0, 0.0, 0.0))
+        out = d(x, jnp.float32(1.0))
+        assert np.isfinite(np.asarray(out)).all()

@@ -2107,3 +2107,37 @@ def test_latent_math_channel_mismatch_raises():
     b = {"samples": jnp.ones((1, 4, 4, 16))}
     with pytest.raises(ValueError, match="channel counts differ"):
         n["LatentAdd"]().op(a, b)
+
+
+def test_conditioning_set_area_percentage_and_flux_encode():
+    import jax.numpy as jnp
+
+    from comfyui_parallelanything_tpu.nodes_compat import stock_node_mappings
+
+    n = stock_node_mappings()
+    cond = {"context": jnp.ones((1, 3, 5)),
+            "extras": ({"context": jnp.ones((1, 2, 5))},)}
+    (out,) = n["ConditioningSetAreaPercentage"]().append(
+        cond, width=0.5, height=0.25, x=0.1, y=0.2, strength=0.8
+    )
+    assert out["area_pct"] == (0.25, 0.5, 0.2, 0.1)
+    assert out["extras"][0]["area_pct"] == (0.25, 0.5, 0.2, 0.1)
+    # CLIPTextEncodeFlux rejects non-flux wires with guidance.
+    with pytest.raises(ValueError, match="flux"):
+        n["CLIPTextEncodeFlux"]().encode({"type": "clip"}, "a", "b", 3.5)
+
+
+def test_area_forms_replace_each_other():
+    import jax.numpy as jnp
+
+    from comfyui_parallelanything_tpu.nodes_compat import stock_node_mappings
+
+    n = stock_node_mappings()
+    cond = {"context": jnp.ones((1, 3, 5))}
+    (px,) = n["ConditioningSetArea"]().append(cond, 512, 512, 0, 0, 1.0)
+    (pct,) = n["ConditioningSetAreaPercentage"]().append(
+        px, width=0.25, height=0.25, x=0.0, y=0.0, strength=1.0
+    )
+    assert pct["area"] is None and pct["area_pct"] is not None
+    (px2,) = n["ConditioningSetArea"]().append(pct, 256, 256, 0, 0, 1.0)
+    assert px2["area_pct"] is None and px2["area"] == (32, 32, 0, 0)
