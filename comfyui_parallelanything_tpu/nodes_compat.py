@@ -2334,9 +2334,12 @@ class CLIPTextEncodeFlux:
                 "CLIPTextEncodeFlux needs the dual T5+CLIP-L wire "
                 "(DualCLIPLoader type=flux)"
             )
+        # Honor a CLIPSetLastLayer tag on the dual wire (it lands on the
+        # OUTER dict) — same convention as CLIPTextEncodeSDXL.
+        clip_skip = int(clip.get("clip_skip", 0))
         enc = TPUTextEncode()
-        (ct5,) = enc.encode(clip["t5"], t5xxl, 0)
-        (cl,) = enc.encode(clip["l"], clip_l, 0)
+        (ct5,) = enc.encode(clip["t5"], t5xxl, clip_skip)
+        (cl,) = enc.encode(clip["l"], clip_l, clip_skip)
         cond = {"context": ct5["context"], "penultimate": None,
                 "pooled": cl["pooled"]}
         (tagged,) = TPUFluxGuidance().append(cond, float(guidance))

@@ -21,6 +21,8 @@ Endpoints (the ComfyUI client-protocol subset that makes scripts work):
                               *running* one at its next sampler-step boundary
                               (cooperative flag, utils/progress.py; a single
                               compiled step cannot be preempted mid-dispatch)
+- ``POST /upload/image``      multipart input upload into $PA_INPUT_DIR
+                              (stock dedupe suffixing; ``overwrite`` honored)
 - ``GET  /object_info[/cls]`` node-registry introspection (INPUT_TYPES etc.)
 - ``GET  /system_stats``      devices from devices.discovery
 - ``GET  /ws``                WebSocket progress events (RFC 6455, stdlib):
@@ -577,7 +579,78 @@ class _Handler(BaseHTTPRequestHandler):
             )
             pid, number = self.q.submit(prompt, preview=preview)
             return self._send(200, {"prompt_id": pid, "number": number})
+        if url.path == "/upload/image":
+            return self._upload_image()
         return self._send(404, {"error": f"no route {url.path}"})
+
+    def _upload_image(self):
+        """Stock ``POST /upload/image``: multipart form with an ``image``
+        file part (+ optional ``overwrite``) saved into the input directory
+        ($PA_INPUT_DIR — the folder LoadImage resolves against), response
+        ``{"name", "subfolder", "type"}`` exactly as API clients expect."""
+        import email
+        import email.policy
+        import os
+        import re
+
+        ctype = self.headers.get("Content-Type", "")
+        if "multipart/form-data" not in ctype:
+            return self._send(400, {"error": "multipart/form-data required"})
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = -1
+        # Stock image uploads are MBs; a tight cap bounds the per-thread
+        # buffering (body + parsed copy) on a host that also serves models.
+        if length <= 0 or length > 64 * 1024 * 1024:
+            return self._send(400, {"error": "bad Content-Length"})
+        body = self.rfile.read(length)
+        msg = email.message_from_bytes(
+            b"Content-Type: " + ctype.encode() + b"\r\n\r\n" + body,
+            policy=email.policy.HTTP,
+        )
+        image_part = None
+        overwrite = False
+        for part in msg.iter_parts():
+            name = part.get_param("name", header="content-disposition")
+            if name == "image":
+                image_part = part
+            elif name == "overwrite":
+                overwrite = (part.get_content() or "").strip().lower() in (
+                    "1", "true", "yes")
+        if image_part is None:
+            return self._send(400, {"error": "no 'image' file part"})
+        filename = image_part.get_filename() or "upload.png"
+        # Flatten any path the client sent; keep a safe basename only, and
+        # never a dot-name/empty result (open("input/..") would explode).
+        filename = re.sub(r"[^A-Za-z0-9._-]", "_", os.path.basename(filename))
+        if filename.strip("._") == "":
+            filename = "upload.png"
+        payload = image_part.get_payload(decode=True)
+        if not payload:
+            return self._send(400, {"error": "empty image payload"})
+        in_dir = os.environ.get("PA_INPUT_DIR", "input")
+        os.makedirs(in_dir, exist_ok=True)
+        stem, ext = os.path.splitext(filename)
+        path = os.path.join(in_dir, filename)
+        if overwrite:
+            with open(path, "wb") as f:
+                f.write(payload)
+        else:
+            # Stock dedupe: suffix (1), (2), …; O_EXCL ("xb") makes the
+            # pick-and-write atomic under the threaded server.
+            i = 0
+            while True:
+                try:
+                    with open(path, "xb") as f:
+                        f.write(payload)
+                    break
+                except FileExistsError:
+                    i += 1
+                    filename = f"{stem} ({i}){ext}"
+                    path = os.path.join(in_dir, filename)
+        return self._send(200, {"name": filename, "subfolder": "",
+                                "type": "input"})
 
 
 def make_server(

@@ -394,3 +394,70 @@ class TestLatentPreviews:
             assert rgb.min() >= 0.0 and rgb.max() <= 1.0
         png = preview_png(np.zeros((1, 4, 4, 4), np.float32))
         assert png[:4] == b"\x89PNG"
+
+
+class TestUploadImage:
+    def _multipart(self, fields):
+        boundary = "----patest123"
+        parts = []
+        for name, (filename, content, ctype) in fields.items():
+            head = f'Content-Disposition: form-data; name="{name}"'
+            if filename:
+                head += f'; filename="{filename}"'
+            parts.append(
+                f"--{boundary}\r\n{head}\r\n"
+                f"Content-Type: {ctype}\r\n\r\n".encode() + content + b"\r\n"
+            )
+        body = b"".join(parts) + f"--{boundary}--\r\n".encode()
+        return body, f"multipart/form-data; boundary={boundary}"
+
+    def _upload(self, base, body, ctype):
+        req = urllib.request.Request(
+            base + "/upload/image", data=body,
+            headers={"Content-Type": ctype}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    def test_upload_roundtrip_and_dedupe(self, server, tmp_path, monkeypatch):
+        import numpy as np
+        from PIL import Image
+        import io
+
+        base, _, _ = server
+        in_dir = tmp_path / "input"
+        monkeypatch.setenv("PA_INPUT_DIR", str(in_dir))
+        buf = io.BytesIO()
+        Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(buf, "PNG")
+        png = buf.getvalue()
+
+        body, ctype = self._multipart(
+            {"image": ("up.png", png, "image/png")})
+        out = self._upload(base, body, ctype)
+        assert out == {"name": "up.png", "subfolder": "", "type": "input"}
+        assert (in_dir / "up.png").read_bytes() == png
+
+        # Re-upload without overwrite: stock dedupe suffix.
+        out2 = self._upload(base, body, ctype)
+        assert out2["name"] == "up (1).png"
+        # overwrite=true clobbers in place.
+        body3, ctype3 = self._multipart({
+            "image": ("up.png", png, "image/png"),
+            "overwrite": ("", b"true", "text/plain"),
+        })
+        out3 = self._upload(base, body3, ctype3)
+        assert out3["name"] == "up.png"
+        # Path components are flattened away.
+        body4, ctype4 = self._multipart(
+            {"image": ("../../evil.png", png, "image/png")})
+        out4 = self._upload(base, body4, ctype4)
+        assert "/" not in out4["name"] and out4["name"].endswith("evil.png")
+        assert (in_dir / out4["name"]).exists()
+
+    def test_upload_rejects_non_multipart(self, server):
+        base, _, _ = server
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/upload/image", {"not": "multipart"})
+        assert ei.value.code == 400
