@@ -575,7 +575,14 @@ class LoraLoader:
         from .nodes import TPUCheckpointLoader
 
         source = getattr(model, "source", None)
-        if source is None:
+        if source is not None and source.get("merged"):
+            raise ValueError(
+                "LoRA-after-merge is not supported: LoRA baking re-converts "
+                "from the source checkpoint file, and a merged model has "
+                "none — apply LoraLoader to each input model BEFORE "
+                "ModelMergeSimple instead"
+            )
+        if source is None or not source.get("path"):
             raise ValueError(
                 "LoraLoader needs a MODEL from CheckpointLoaderSimple (the "
                 "source-checkpoint tag); for TPUCheckpointLoader models pass "
@@ -2383,6 +2390,93 @@ class ConditioningSetAreaPercentage:
         }),)
 
 
+class ImageScaleToTotalPixels:
+    """Stock megapixel-normalize (the FLUX template's input-size step):
+    resize to ``megapixels`` total, aspect preserved."""
+
+    DESCRIPTION = "Stock-name scale-to-megapixels."
+    RETURN_TYPES = ("IMAGE",)
+    RETURN_NAMES = ("image",)
+    FUNCTION = "upscale"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "image": ("IMAGE", {}),
+            "upscale_method": (list(_STOCK_RESIZE), {"default": "bilinear"}),
+            "megapixels": ("FLOAT", {"default": 1.0, "min": 0.01,
+                                     "max": 16.0, "step": 0.01}),
+        }}
+
+    def upscale(self, image, upscale_method: str, megapixels: float):
+        import jax.numpy as jnp
+
+        img = jnp.asarray(image)
+        if img.ndim == 3:
+            img = img[None]
+        _, H, W, _ = img.shape
+        scale = (float(megapixels) * 1024 * 1024 / (H * W)) ** 0.5
+        nh, nw = max(1, round(H * scale)), max(1, round(W * scale))
+        # The shared stock-resize core: method validation + the [0,1] clip
+        # (lanczos/bicubic overshoot) the sibling resize nodes apply.
+        return (_stock_resize(img, nw, nh, upscale_method),)
+
+
+class ModelMergeSimple:
+    """Stock weighted model merge: ``ratio`` of model1 + ``1−ratio`` of
+    model2, leaf-wise over the param pytrees. Both models must share a
+    family/topology (identical tree structure — the stock constraint too)."""
+
+    DESCRIPTION = "Stock-name weighted model merge."
+    RETURN_TYPES = ("MODEL",)
+    RETURN_NAMES = ("model",)
+    FUNCTION = "merge"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "model1": ("MODEL", {}),
+            "model2": ("MODEL", {}),
+            "ratio": ("FLOAT", {"default": 1.0, "min": 0.0, "max": 1.0,
+                                "step": 0.01}),
+        }}
+
+    def merge(self, model1, model2, ratio: float):
+        import dataclasses as dc
+
+        import jax
+
+        if not (dc.is_dataclass(model1) and dc.is_dataclass(model2)):
+            raise ValueError(
+                "ModelMergeSimple needs unwrapped MODELs; apply it before "
+                "ParallelAnything"
+            )
+        r = float(ratio)
+
+        def lerp(a, b):
+            if getattr(a, "shape", None) != getattr(b, "shape", None):
+                # Same tree structure but different widths (e.g. two UNets
+                # built at different model_channels) must fail loudly, not
+                # broadcast into silently corrupted params.
+                raise ValueError(f"leaf shapes differ: {a.shape} vs {b.shape}")
+            return a * r + b * (1.0 - r)
+
+        try:
+            merged = jax.tree.map(lerp, model1.params, model2.params)
+        except (ValueError, TypeError) as e:
+            raise ValueError(
+                "models cannot merge — different families/topologies "
+                f"({e})"
+            ) from None
+        # The merged weights correspond to neither source file, so the
+        # re-bake LoRA path has nothing to re-bake from: a marker source
+        # makes the downstream LoraLoader error name the real cause.
+        return (dc.replace(model1, params=merged, source={"merged": True},
+                           name=f"{model1.name}+merge"),)
+
+
 class ImageCrop:
     DESCRIPTION = "Stock-name image crop."
     RETURN_TYPES = ("IMAGE",)
@@ -3240,6 +3334,8 @@ def stock_node_mappings() -> dict[str, type]:
         "unCLIPCheckpointLoader": unCLIPCheckpointLoader,
         "SamplerCustom": SamplerCustom,
         "ImageCrop": ImageCrop,
+        "ImageScaleToTotalPixels": ImageScaleToTotalPixels,
+        "ModelMergeSimple": ModelMergeSimple,
         "ImageBlur": ImageBlur,
         "ImageSharpen": ImageSharpen,
         "LatentBlend": LatentBlend,

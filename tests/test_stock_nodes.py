@@ -2141,3 +2141,53 @@ def test_area_forms_replace_each_other():
     assert pct["area"] is None and pct["area_pct"] is not None
     (px2,) = n["ConditioningSetArea"]().append(pct, 256, 256, 0, 0, 1.0)
     assert px2["area_pct"] is None and px2["area"] == (32, 32, 0, 0)
+
+
+def test_scale_to_megapixels_and_model_merge():
+    import jax
+    import jax.numpy as jnp
+
+    from comfyui_parallelanything_tpu.models import build_unet, sd15_config
+    from comfyui_parallelanything_tpu.nodes_compat import stock_node_mappings
+
+    n = stock_node_mappings()
+    (img,) = n["ImageScaleToTotalPixels"]().upscale(
+        jnp.zeros((1, 100, 400, 3)), "bilinear", 0.04  # 0.04 MP ≈ 41943 px
+    )
+    B, H, W, C = img.shape
+    assert abs(H * W - 0.04 * 1024 * 1024) / (0.04 * 1024 * 1024) < 0.05
+    assert abs(W / H - 4.0) < 0.2  # aspect preserved
+    with pytest.raises(ValueError, match="upscale_method"):
+        n["ImageScaleToTotalPixels"]().upscale(jnp.zeros((1, 8, 8, 3)),
+                                               "hermite", 1.0)
+
+    cfg = sd15_config(
+        model_channels=8, channel_mult=(1, 2), num_res_blocks=1,
+        attention_levels=(1,), transformer_depth=(0, 1), num_heads=2,
+        context_dim=16, norm_groups=4, dtype=jnp.float32,
+    )
+    m1 = build_unet(cfg, jax.random.key(0), sample_shape=(1, 8, 8, 4))
+    m2 = build_unet(cfg, jax.random.key(1), sample_shape=(1, 8, 8, 4))
+    (merged,) = n["ModelMergeSimple"]().merge(m1, m2, 0.25)
+    leaf1 = jax.tree.leaves(m1.params)[0]
+    leaf2 = jax.tree.leaves(m2.params)[0]
+    got = jax.tree.leaves(merged.params)[0]
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(leaf1) * 0.25
+                               + np.asarray(leaf2) * 0.75, atol=1e-6)
+    assert merged.source == {"merged": True}
+    from comfyui_parallelanything_tpu.nodes_compat import LoraLoader
+    with pytest.raises(ValueError, match="BEFORE"):
+        LoraLoader().load_lora(merged, {"type": "clip"}, "x.safetensors")
+    x = jnp.zeros((1, 8, 8, 4)); t = jnp.array([5.0])
+    ctx = jnp.zeros((1, 3, 16))
+    assert np.isfinite(np.asarray(merged(x, t, ctx))).all()
+    # Cross-topology merge fails loudly.
+    cfg2 = sd15_config(
+        model_channels=8, channel_mult=(1, 2, 2), num_res_blocks=1,
+        attention_levels=(1,), transformer_depth=(0, 1, 0), num_heads=2,
+        context_dim=16, norm_groups=4, dtype=jnp.float32,
+    )
+    m3 = build_unet(cfg2, jax.random.key(2), sample_shape=(1, 8, 8, 4))
+    with pytest.raises(ValueError, match="cannot merge"):
+        n["ModelMergeSimple"]().merge(m1, m3, 0.5)
