@@ -263,3 +263,74 @@ def test_fake_platform_refuses_real_evidence_dir():
     )
     assert proc.returncode != 0
     assert "PA_EVIDENCE_DIR" in proc.stderr
+
+
+def test_chunk_sweep_run_path_banks_winner_and_confirms(tmp_path, monkeypatch):
+    """The sweep's RUN path, rehearsed off-hardware (the round-3 lesson:
+    never let a pipeline's first execution be an unattended live window):
+    measured combos skip on resume, the winner persists with only its own
+    keys, losing combos stay out of BASELINE_measured.json, and exactly one
+    default-env confirmation record banks."""
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    import importlib
+
+    import tpu_watchdog as wd
+    importlib.reload(wd)  # fresh _FAILS/_MB_IDX state
+
+    evidence = tmp_path / "evidence"
+    evidence.mkdir()
+    tuning = tmp_path / "attn_chunk.json"
+    monkeypatch.setenv("PA_EVIDENCE_DIR", str(evidence))
+    monkeypatch.setenv("PA_ATTN_CHUNK_TUNING", str(tuning))
+
+    # Pre-seed ONE measured combo (the default) — the sweep must resume past
+    # it, not re-run it.
+    with open(evidence / "CHUNK_SWEEP.json", "w") as f:
+        f.write(json.dumps({"attn_env": {}, "platform": "tpu",
+                            "value": 2.5, "ts": 1.0}) + "\n")
+
+    calls = []
+    # Keys via the code-under-test's own _combo_key so a key-format drift
+    # cannot silently turn every lookup into a miss. The 2**29+bf16 combo
+    # wins; a lookup miss would yield 99.0 and fail the winner assertions.
+    values = {
+        wd._combo_key({}): 2.5,
+        wd._combo_key({"PA_ATTN_CHUNK_ELEMS": "536870912"}): 2.0,
+        wd._combo_key({"PA_ATTN_CHUNK_ELEMS": "536870912",
+                       "PA_ATTN_BF16_SOFTMAX": "1"}): 1.2,
+        wd._combo_key({"PA_ATTN_CHUNK_ELEMS": "1073741824",
+                       "PA_ATTN_BF16_SOFTMAX": "1"}): 1.5,
+    }
+
+    import measure_tpu
+
+    def fake_run_rung(rung, timeout=0, extra_env=None):
+        assert rung == "sd15_16"
+        combo = {k: v for k, v in (extra_env or {}).items()
+                 if k.startswith("PA_ATTN_")}
+        calls.append(combo)
+        if not combo and calls.count({}) >= 1 and tuning.exists():
+            # The CONFIRMATION run: no PA_ATTN_ env (the persisted table
+            # serves it) — it measures the winner's configuration.
+            return {"rung": rung, "platform": "tpu", "value": 1.2}
+        return {"rung": rung, "platform": "tpu",
+                "value": values.get(wd._combo_key(combo), 99.0)}
+
+    monkeypatch.setattr(measure_tpu, "run_rung", fake_run_rung)
+    monkeypatch.setattr(wd, "_run_script", lambda *a, **k: None)
+
+    wd._run_chunk_sweep()
+
+    # Three live combo runs (default was pre-seeded) + one confirmation.
+    assert len(calls) == 4 and calls[-1] == {}
+    table = json.loads(tuning.read_text())
+    assert table["source"] == "measured"
+    assert table["chunk_elems"] == 2**29 and table["bf16_softmax"] is True
+    assert wd.chunk_sweep_banked() and wd._chunk_confirmed()
+    # Only the confirmation record landed in the rung evidence file — and
+    # it carries the SHIPPING configuration's value, not a losing combo's.
+    recs = _records(os.path.join(str(evidence), "BASELINE_measured.json"))
+    assert len(recs) == 1 and recs[0]["rung"] == "sd15_16"
+    assert recs[0]["value"] == 1.2
+    # A second invocation goes straight to... nothing: banked + confirmed.
+    assert not wd._chunk_sweep_due()
