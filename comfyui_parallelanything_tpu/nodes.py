@@ -679,6 +679,28 @@ class TPUTextEncode:
                 str_l, str_g, cg["pooled"], width=1024, height=1024,
             )
             return ({"context": context, "penultimate": None, "pooled": y},)
+        if ctype == "sd3-triple":
+            # Stock TripleCLIPLoader (or DualCLIPLoader type=sd3, t5=None):
+            # encode every present tower and assemble SD3's (context, y) —
+            # TPUConditioningCombine(mode='sd3') semantics in one encode.
+            # Penultimate streams unconditionally: SD3 trains on layer -2.
+            from .models.text_encoders import sd3_text_conditioning
+
+            (cl,) = self.encode(clip["l"], text, clip_skip)
+            (cg,) = self.encode(clip["g"], text, clip_skip)
+            t5_ctx = None
+            if clip.get("t5") is not None:
+                (ct5,) = self.encode(clip["t5"], text, clip_skip)
+                t5_ctx = ct5["context"]
+            context, y = sd3_text_conditioning(
+                cl["penultimate"], cg["penultimate"],
+                cl["pooled"], cg["pooled"], t5_ctx,
+                # The sequence-concat requires the CLIP joint padded to the
+                # T5 width — 4096 for the real t5xxl, derived so resized
+                # towers compose.
+                context_dim=t5_ctx.shape[-1] if t5_ctx is not None else 4096,
+            )
+            return ({"context": context, "penultimate": None, "pooled": y},)
         if ctype == "flux-dual":
             # Stock DualCLIPLoader(type=flux): T5 context + CLIP-L pooled —
             # TPUConditioningCombine(mode='flux') semantics in one encode.
@@ -1047,6 +1069,22 @@ def _scheduler_menu() -> list[str]:
     return list(SCHEDULER_NAMES)
 
 
+_SHIFT_WIDGET_DEFAULT = 1.15
+
+
+def _shift_from_prefs(model, shift: float) -> float:
+    """Resolve the flow-shift the sampler actually runs with.
+
+    ModelSamplingSD3/ModelSamplingFlux (stock schedule patches) attach a
+    shift default to the MODEL via sampler_prefs; a shift widget left at its
+    default (1.15) yields to it, an explicit non-default value wins — the
+    same precedence RescaleCFG's cfg_rescale uses."""
+    prefs = getattr(model, "sampler_prefs", None) or {}
+    if shift == _SHIFT_WIDGET_DEFAULT and "shift" in prefs:
+        return float(prefs["shift"])
+    return shift
+
+
 def _collect_control(positive) -> tuple:
     """Every control spec reachable from the positive conditioning: the
     top-level ``control`` tuple plus tags riding combined ``extras`` entries
@@ -1404,6 +1442,7 @@ class TPUKSampler:
         rng = seed_key(seed)
         shape = latent["samples"].shape
         noise = jax.random.normal(rng, shape, jnp.float32)
+        shift = _shift_from_prefs(model, shift)
         model_cfg, context, pooled, uncond_context, uncond_kwargs, cond_extra = (
             _prepare_sampling_inputs(model, positive, negative, latent,
                                      rng=rng)
@@ -1495,6 +1534,7 @@ class TPUKSamplerAdvanced:
         from .sampling.runner import run_sampler
 
         latent = latent_image
+        shift = _shift_from_prefs(model, shift)
         (sigmas,) = TPUBasicScheduler().get_sigmas(
             model, scheduler, steps, denoise=1.0, shift=shift
         )
@@ -1831,6 +1871,7 @@ class TPUBasicScheduler:
         from .parallel.orchestrator import model_config_of
         from .sampling.k_samplers import flow_sigma_table, make_sigmas
 
+        shift = _shift_from_prefs(model, shift)
         total = max(steps, int(round(steps / denoise))) if denoise < 1.0 else steps
         if getattr(model_config_of(model), "prediction", "eps") == "flow":
             sigmas = make_sigmas(scheduler, total,

@@ -324,9 +324,25 @@ class DualCLIPLoader:
                     "tokenizer_error": None,
                 },
             )
-        raise ValueError(
-            "DualCLIPLoader type=sd3 needs three towers — wire TPUCLIPLoader "
-            "nodes + TPUConditioningCombine(mode='sd3') instead"
+        # type == "sd3": the two-tower form of the SD3 conditioning (CLIP-L +
+        # OpenCLIP-G, no T5 — sd3_text_conditioning pads L⊕G to 4096 and skips
+        # the T5 stream). Stock positional convention is (clip_l, clip_g); a
+        # "clip_g"-marked file in slot 1 corrects swapped wiring.
+        n1 = os.path.basename(clip_name1).lower()
+        n2 = os.path.basename(clip_name2).lower()
+        swapped = ("clip_g" in n1 or "clipg" in n1) and not (
+            "clip_g" in n2 or "clipg" in n2
+        )
+        l_name = clip_name2 if swapped else clip_name1
+        g_name = clip_name1 if swapped else clip_name2
+        return (
+            {
+                "type": "sd3-triple",
+                "l": clip_wire(l_name, "clip-l"),
+                "g": clip_wire(g_name, "open-clip-g"),
+                "t5": None,
+                "tokenizer_error": None,
+            },
         )
 
 
@@ -412,6 +428,127 @@ class CLIPLoader:
                 kw["merges_path"] = os.environ.get("PA_CLIP_MERGES", "")
         (wire,) = TPUCLIPLoader().load(path, tower, **kw)
         return (wire,)
+
+
+def _classify_text_tower(name: str, path: str | None = None) -> str | None:
+    """Which tower a text-encoder file holds: ``t5`` / ``open-clip-g`` /
+    ``clip-l``. Filename markers first (the stock SD3 template ships
+    clip_l/clip_g/t5xxl); unresolved names fall back to the safetensors key
+    signature (header-only — no tensor reads except one embedding shape)."""
+    n = os.path.basename(name).lower()
+    if "t5" in n:
+        return "t5"
+    if "clip_g" in n or "clipg" in n:
+        return "open-clip-g"
+    if "clip_l" in n or "clipl" in n:
+        return "clip-l"
+    if not path or not os.path.isfile(path):
+        return None
+    try:
+        from safetensors import safe_open
+
+        with safe_open(path, framework="numpy") as f:
+            keys = set(f.keys())
+            if any(k.startswith("encoder.block.") for k in keys) \
+                    or "shared.weight" in keys:
+                return "t5"
+            # open-clip layout: top-level token_embedding + text_projection.
+            if "token_embedding.weight" in keys:
+                return "open-clip-g"
+            for k in keys:
+                if k.endswith("token_embedding.weight"):
+                    width = f.get_slice(k).get_shape()[1]
+                    return "open-clip-g" if width >= 1024 else "clip-l"
+    except Exception:
+        return None
+    return None
+
+
+class TripleCLIPLoader:
+    """Stock triple text-encoder loader (the SD3/SD3.5 templates): clip_l +
+    clip_g + t5xxl files → ONE CLIP wire carrying all three towers. Encoding
+    that wire assembles SD3's (context, y) — L⊕G penultimate streams padded
+    to 4096 and sequence-concatenated with the T5 stream, y = pooled L⊕G
+    (``models.text_encoders.sd3_text_conditioning``). Files are matched to
+    towers by name markers, then by key signature — stock's widget order
+    carries no typed meaning. Host-provided builtin
+    (any_device_parallel.py:1473-1483)."""
+
+    DESCRIPTION = "Stock-name triple text-encoder loader (SD3: L + G + T5)."
+    RETURN_TYPES = ("CLIP",)
+    RETURN_NAMES = ("clip",)
+    FUNCTION = "load"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip_name1": ("STRING", {"default": ""}),
+                "clip_name2": ("STRING", {"default": ""}),
+                "clip_name3": ("STRING", {"default": ""}),
+            }
+        }
+
+    def load(self, clip_name1: str, clip_name2: str, clip_name3: str):
+        from .nodes import TPUCLIPLoader
+
+        names = [clip_name1, clip_name2, clip_name3]
+        paths = [resolve_model_file(n, "clip", "text_encoders") for n in names]
+        towers: dict[str, str] = {}
+        for name, path in zip(names, paths):
+            kind = _classify_text_tower(name, path)
+            if kind is None:
+                raise ValueError(
+                    f"TripleCLIPLoader cannot tell which tower {name!r} holds "
+                    "— name it with a clip_l/clip_g/t5 marker"
+                )
+            if kind in towers:
+                raise ValueError(
+                    f"TripleCLIPLoader got two {kind} files ({towers[kind]!r} "
+                    f"and {name!r}); it needs one each of clip_l/clip_g/t5"
+                )
+            towers[kind] = path
+        missing = {"clip-l", "open-clip-g", "t5"} - set(towers)
+        if missing:
+            raise ValueError(
+                f"TripleCLIPLoader is missing {sorted(missing)} towers "
+                f"(classified: { {k: os.path.basename(v) for k, v in towers.items()} })"
+            )
+
+        loader = TPUCLIPLoader()
+
+        def clip_wire(path: str, encoder_type: str):
+            kw = {}
+            if encoder_type == "t5":
+                tok_json = os.environ.get("PA_T5_TOKENIZER_JSON", "")
+                if not tok_json:
+                    raise ValueError(
+                        "TripleCLIPLoader t5 tower needs PA_T5_TOKENIZER_JSON "
+                        "(no vocab/merges form exists for T5 tokenizers)"
+                    )
+                kw["tokenizer_json"] = tok_json
+                # Stock SD3 tokenizes T5 at 77 tokens to match the CLIP
+                # streams' sequence budget — the default already fits.
+            else:
+                tok_json = os.environ.get("PA_TOKENIZER_JSON", "")
+                if tok_json:
+                    kw["tokenizer_json"] = tok_json
+                else:
+                    kw["vocab_path"] = os.environ.get("PA_CLIP_VOCAB", "")
+                    kw["merges_path"] = os.environ.get("PA_CLIP_MERGES", "")
+            (wire,) = loader.load(path, encoder_type, **kw)
+            return wire
+
+        return (
+            {
+                "type": "sd3-triple",
+                "l": clip_wire(towers["clip-l"], "clip-l"),
+                "g": clip_wire(towers["open-clip-g"], "open-clip-g"),
+                "t5": clip_wire(towers["t5"], "t5"),
+                "tokenizer_error": None,
+            },
+        )
 
 
 class VAELoader:
@@ -2056,6 +2193,195 @@ class LatentFromBatch:
         return (out,)
 
 
+def _latent_spatial_map(samples_dict, fn):
+    """Apply ``fn`` (a spatial-axes transform over channels-last arrays) to
+    the latent samples AND its noise_mask — both share rank and the
+    (..., H, W, C) layout, so the −3/−2 spatial axes line up for image (NHWC)
+    and video (NTHWC) latents alike."""
+    import jax.numpy as jnp
+
+    out = dict(samples_dict)
+    out["samples"] = fn(jnp.asarray(samples_dict["samples"]))
+    if samples_dict.get("noise_mask") is not None:
+        out["noise_mask"] = fn(jnp.asarray(samples_dict["noise_mask"]))
+    return out
+
+
+class LatentFlip:
+    """Stock latent flip: the menu strings name the axis being mirrored
+    ACROSS — "x-axis: vertically" mirrors rows (H), "y-axis: horizontally"
+    mirrors columns (W). The attached noise_mask flips with the samples."""
+
+    DESCRIPTION = "Stock-name latent flip (vertical/horizontal)."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "flip"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "samples": ("LATENT", {}),
+            "flip_method": (["x-axis: vertically", "y-axis: horizontally"],
+                            {"default": "x-axis: vertically"}),
+        }}
+
+    def flip(self, samples, flip_method: str):
+        import jax.numpy as jnp
+
+        axis = -3 if flip_method.startswith("x") else -2
+        return (_latent_spatial_map(samples, lambda a: jnp.flip(a, axis)),)
+
+
+class LatentRotate:
+    """Stock latent rotate: clockwise quarter-turns over the spatial plane
+    (channels-last: H=−3, W=−2; ``jnp.rot90`` with negative k is clockwise).
+    The attached noise_mask rotates with the samples."""
+
+    DESCRIPTION = "Stock-name latent rotation (90° steps, clockwise)."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "rotate"
+    CATEGORY = CATEGORY
+
+    _TURNS = {"none": 0, "90 degrees": 1, "180 degrees": 2, "270 degrees": 3}
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "samples": ("LATENT", {}),
+            "rotation": (list(cls._TURNS), {"default": "none"}),
+        }}
+
+    def rotate(self, samples, rotation: str):
+        import jax.numpy as jnp
+
+        k = self._TURNS.get(rotation)
+        if k is None:
+            raise ValueError(
+                f"rotation {rotation!r} is not one of {list(self._TURNS)}"
+            )
+        if k == 0:
+            return (samples,)
+        return (_latent_spatial_map(
+            samples, lambda a: jnp.rot90(a, k=-k, axes=(-3, -2))
+        ),)
+
+
+class LatentCrop:
+    """Stock latent crop: pixel-space (width, height, x, y) → an 8×-downsampled
+    latent window, clamped so the crop stays inside the latent like stock's
+    boundary adjustment (the window slides back instead of shrinking)."""
+
+    DESCRIPTION = "Stock-name latent crop (pixel coords, /8 latent grid)."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "crop"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "samples": ("LATENT", {}),
+            "width": ("INT", {"default": 512, "min": 64, "max": 16384,
+                              "step": 8}),
+            "height": ("INT", {"default": 512, "min": 64, "max": 16384,
+                               "step": 8}),
+            "x": ("INT", {"default": 0, "min": 0, "max": 16384, "step": 8}),
+            "y": ("INT", {"default": 0, "min": 0, "max": 16384, "step": 8}),
+        }}
+
+    def crop(self, samples, width: int, height: int, x: int, y: int):
+        lat = samples["samples"]
+        H, W = lat.shape[-3], lat.shape[-2]
+        h = max(1, min(int(height) // 8, H))
+        w = max(1, min(int(width) // 8, W))
+        y0 = min(int(y) // 8, H - h)
+        x0 = min(int(x) // 8, W - w)
+
+        def window(a):
+            return a[..., y0:y0 + h, x0:x0 + w, :]
+
+        return (_latent_spatial_map(samples, window),)
+
+
+class SaveLatent:
+    """Stock latent save: a safetensors file holding ``latent_tensor`` plus
+    the ``latent_format_version_0`` marker (stock's un-scaled format signal;
+    LoadLatent applies the legacy 1/0.18215 rescale only when it is absent).
+    Saved under $PA_OUTPUT_DIR via the same counter/prefix rules as
+    SaveImage."""
+
+    DESCRIPTION = "Stock-name latent save (safetensors)."
+    RETURN_TYPES = ()
+    FUNCTION = "save"
+    CATEGORY = CATEGORY
+    OUTPUT_NODE = True
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "samples": ("LATENT", {}),
+            "filename_prefix": ("STRING", {"default": "latents/ComfyUI"}),
+        }}
+
+    def save(self, samples, filename_prefix: str = "latents/ComfyUI"):
+        import numpy as _np
+        from safetensors.numpy import save_file
+
+        from .nodes import resolve_save_target
+
+        target_dir, name, idx = resolve_save_target(
+            filename_prefix, suffix="latent"
+        )
+        path = os.path.join(target_dir, f"{name}_{idx:05}.latent")
+        save_file(
+            {
+                "latent_tensor": _np.asarray(
+                    samples["samples"], dtype=_np.float32
+                ),
+                "latent_format_version_0": _np.zeros((0,), _np.float32),
+            },
+            path,
+        )
+        return {"ui": {"latents": [os.path.basename(path)]}}
+
+
+class LoadLatent:
+    """Stock latent load: reads a SaveLatent file from $PA_INPUT_DIR. Files
+    without the ``latent_format_version_0`` marker are stock's legacy dumps,
+    stored pre-scaled — multiply by 1/0.18215 to recover latent space."""
+
+    DESCRIPTION = "Stock-name latent load (safetensors)."
+    RETURN_TYPES = ("LATENT",)
+    RETURN_NAMES = ("latent",)
+    FUNCTION = "load"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"latent": ("STRING", {"default": ""})}}
+
+    def load(self, latent: str):
+        import jax.numpy as jnp
+        from safetensors.numpy import load_file
+
+        path = latent
+        if not os.path.isabs(path):
+            path = os.path.join(os.environ.get("PA_INPUT_DIR", "."), path)
+        if not os.path.isfile(path):
+            raise ValueError(f"latent file not found: {path}")
+        sd = load_file(path)
+        if "latent_tensor" not in sd:
+            raise ValueError(
+                f"{path} is not a saved latent (no latent_tensor key)"
+            )
+        arr = jnp.asarray(sd["latent_tensor"], jnp.float32)
+        if "latent_format_version_0" not in sd:
+            arr = arr * (1.0 / 0.18215)
+        return ({"samples": arr},)
+
+
 class SolidMask:
     DESCRIPTION = "Stock-name constant mask."
     RETURN_TYPES = ("MASK",)
@@ -3157,6 +3483,83 @@ class RescaleCFG:
         return (m,)
 
 
+def _patch_sampler_prefs(model, **updates):
+    """Merge ``updates`` into the MODEL's sampler_prefs (the RescaleCFG
+    carrier): dataclass models get dc.replace, ParallelModel wrappers a
+    shallow copy (placements shared; the copy carries no GC finalizer)."""
+    import copy
+    import dataclasses as dc
+
+    prefs = {**(getattr(model, "sampler_prefs", None) or {}), **updates}
+    if dc.is_dataclass(model) and not isinstance(model, type):
+        return dc.replace(model, sampler_prefs=prefs)
+    m = copy.copy(model)
+    m.sampler_prefs = prefs
+    return m
+
+
+class ModelSamplingSD3:
+    """Stock SD3 schedule patch: tags the MODEL with the rectified-flow
+    timestep shift (default 3.0 — SD3's trained resolution shift). The
+    samplers and BasicScheduler read it as their shift default; an explicit
+    non-default shift widget wins (same precedence as RescaleCFG's
+    cfg_rescale)."""
+
+    DESCRIPTION = "Stock-name SD3 flow-shift model patch."
+    RETURN_TYPES = ("MODEL",)
+    RETURN_NAMES = ("model",)
+    FUNCTION = "patch"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "model": ("MODEL", {}),
+            "shift": ("FLOAT", {"default": 3.0, "min": 0.0, "max": 100.0,
+                                "step": 0.01}),
+        }}
+
+    def patch(self, model, shift: float = 3.0):
+        return (_patch_sampler_prefs(model, shift=float(shift)),)
+
+
+class ModelSamplingFlux:
+    """Stock FLUX schedule patch: the resolution-dependent flow shift. Stock
+    linearly interpolates the LOG-shift (mu) over the latent token count —
+    base_shift at 256 tokens to max_shift at 4096 — and warps with
+    exp(mu)·t/(1+(exp(mu)−1)·t); at the 1024² defaults the effective shift is
+    exp(1.15) ≈ 3.16. The exp(mu) value lands in sampler_prefs as the
+    samplers' shift default (explicit non-default widget wins)."""
+
+    DESCRIPTION = "Stock-name FLUX resolution-shift model patch."
+    RETURN_TYPES = ("MODEL",)
+    RETURN_NAMES = ("model",)
+    FUNCTION = "patch"
+    CATEGORY = CATEGORY
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {
+            "model": ("MODEL", {}),
+            "max_shift": ("FLOAT", {"default": 1.15, "min": 0.0, "max": 100.0,
+                                    "step": 0.01}),
+            "base_shift": ("FLOAT", {"default": 0.5, "min": 0.0, "max": 100.0,
+                                     "step": 0.01}),
+            "width": ("INT", {"default": 1024, "min": 16, "max": 16384}),
+            "height": ("INT", {"default": 1024, "min": 16, "max": 16384}),
+        }}
+
+    def patch(self, model, max_shift: float = 1.15, base_shift: float = 0.5,
+              width: int = 1024, height: int = 1024):
+        import math
+
+        # Latent tokens: 8x VAE downsample then 2x2 patchify → (w/16)·(h/16).
+        tokens = (width / 16.0) * (height / 16.0)
+        m = (max_shift - base_shift) / (4096.0 - 256.0)
+        mu = tokens * m + (base_shift - m * 256.0)
+        return (_patch_sampler_prefs(model, shift=float(math.exp(mu))),)
+
+
 class ConditioningSetMask:
     """Stock mask-scoped conditioning: the cond's prediction applies with
     per-pixel weight from a MASK (resized to the latent grid at sampling
@@ -3292,6 +3695,7 @@ def stock_node_mappings() -> dict[str, type]:
         "CheckpointLoaderSimple": CheckpointLoaderSimple,
         "DualCLIPLoader": DualCLIPLoader,
         "CLIPLoader": CLIPLoader,
+        "TripleCLIPLoader": TripleCLIPLoader,
         "VAELoader": VAELoader,
         "UNETLoader": UNETLoader,
         "unCLIPConditioning": unCLIPConditioning,
@@ -3331,6 +3735,8 @@ def stock_node_mappings() -> dict[str, type]:
         "FreeU_V2": FreeU_V2,
         "RescaleCFG": RescaleCFG,
         "ModelSamplingDiscrete": ModelSamplingDiscrete,
+        "ModelSamplingSD3": ModelSamplingSD3,
+        "ModelSamplingFlux": ModelSamplingFlux,
         "unCLIPCheckpointLoader": unCLIPCheckpointLoader,
         "SamplerCustom": SamplerCustom,
         "ImageCrop": ImageCrop,
@@ -3366,6 +3772,11 @@ def stock_node_mappings() -> dict[str, type]:
         "ImageBatch": ImageBatch,
         "RepeatLatentBatch": RepeatLatentBatch,
         "LatentFromBatch": LatentFromBatch,
+        "LatentFlip": LatentFlip,
+        "LatentRotate": LatentRotate,
+        "LatentCrop": LatentCrop,
+        "SaveLatent": SaveLatent,
+        "LoadLatent": LoadLatent,
         "SolidMask": SolidMask,
         "InvertMask": InvertMask,
         "ImageToMask": ImageToMask,

@@ -2191,3 +2191,374 @@ def test_scale_to_megapixels_and_model_merge():
     m3 = build_unet(cfg2, jax.random.key(2), sample_shape=(1, 8, 8, 4))
     with pytest.raises(ValueError, match="cannot merge"):
         n["ModelMergeSimple"]().merge(m1, m3, 0.5)
+
+
+# ---------------------------------------------------------------------------
+# SD3 stock surface: TripleCLIPLoader, DualCLIPLoader(type=sd3),
+# ModelSamplingSD3/ModelSamplingFlux, and the stock SD3 template chain.
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_sd3_towers(tmp_path, monkeypatch):
+    """Tiny clip_l / clip_g / t5xxl tower files in the stock SD3 template
+    naming, with tokenizer env vars wired and the tiny configs pinned. The
+    widths are coupled the way the real family's are: T5 d_model (128) is the
+    context width the CLIP L⊕G joint (64+64) pads to; pooled = 64+64."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import torch
+    import transformers
+    from safetensors.numpy import save_file
+
+    import comfyui_parallelanything_tpu.models as models_pkg
+    import comfyui_parallelanything_tpu.models.text_encoders as te_mod
+    from comfyui_parallelanything_tpu.models.text_encoders import (
+        build_clip_text,
+        open_clip_g_config,
+    )
+    from tests.test_text_encoders import (
+        TINY_CLIP,
+        TINY_T5,
+        TestOpenCLIPConversion,
+        _hf_clip,
+    )
+
+    l_cfg = dataclasses.replace(TINY_CLIP, max_len=77)
+    monkeypatch.setattr(te_mod, "clip_l_config", lambda: l_cfg)
+    g_cfg = open_clip_g_config(
+        vocab_size=100, hidden_size=64, num_layers=2, num_heads=4,
+        max_len=77, projection_dim=64, dtype=jnp.float32,
+    )
+    monkeypatch.setattr(models_pkg, "open_clip_g_config", lambda: g_cfg)
+    monkeypatch.setattr(te_mod, "open_clip_g_config", lambda: g_cfg)
+    t5_cfg = dataclasses.replace(TINY_T5, d_model=128)
+    monkeypatch.setattr(te_mod, "t5_xxl_config", lambda: t5_cfg)
+
+    hf_l = _hf_clip(l_cfg, "quick_gelu")
+    l_path = tmp_path / "clip_l.safetensors"
+    save_file(
+        {k: np.ascontiguousarray(v.detach().numpy())
+         for k, v in hf_l.state_dict().items()},
+        str(l_path),
+    )
+
+    g_enc = build_clip_text(g_cfg, rng=jax.random.key(2))
+    g_path = tmp_path / "clip_g.safetensors"
+    save_file(
+        {k: np.ascontiguousarray(v)
+         for k, v in TestOpenCLIPConversion._openclip_layout(
+             g_cfg, g_enc.params
+         ).items()},
+        str(g_path),
+    )
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=t5_cfg.vocab_size, d_model=t5_cfg.d_model,
+        d_kv=t5_cfg.d_kv, d_ff=t5_cfg.d_ff, num_layers=t5_cfg.num_layers,
+        num_heads=t5_cfg.num_heads,
+        relative_attention_num_buckets=t5_cfg.relative_buckets,
+        relative_attention_max_distance=t5_cfg.relative_max_distance,
+        feed_forward_proj="gated-gelu", dropout_rate=0.0,
+    )
+    torch.manual_seed(3)
+    hf_t5 = transformers.T5EncoderModel(hf_cfg).eval()
+    t5_path = tmp_path / "t5xxl_fp16.safetensors"
+    save_file(
+        {k: np.ascontiguousarray(v.detach().numpy())
+         for k, v in hf_t5.state_dict().items()},
+        str(t5_path),
+    )
+
+    _word_level_tokenizer(tmp_path, monkeypatch)  # PA_TOKENIZER_JSON
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {"[UNK]": 0, "</s>": 1, "a": 5, "watercolor": 6, "lighthouse": 7,
+             "at": 8, "dawn": 9, "blurry": 10}
+    t = tokenizers.Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
+    t.pre_tokenizer = Whitespace()
+    t5_tok = tmp_path / "t5_tokenizer.json"
+    t.save(str(t5_tok))
+    monkeypatch.setenv("PA_T5_TOKENIZER_JSON", str(t5_tok))
+
+    return {"l": str(l_path), "g": str(g_path), "t5": str(t5_path)}
+
+
+class TestTripleCLIPLoader:
+    def test_loads_and_encodes_sd3_conditioning(self, tmp_path, monkeypatch):
+        from comfyui_parallelanything_tpu.nodes import TPUTextEncode
+        from comfyui_parallelanything_tpu.nodes_compat import TripleCLIPLoader
+
+        paths = _synthetic_sd3_towers(tmp_path, monkeypatch)
+        # Scrambled widget order: classification is by name/keys, not slot.
+        (clip,) = TripleCLIPLoader().load(paths["t5"], paths["g"], paths["l"])
+        assert clip["type"] == "sd3-triple"
+        assert clip["t5"] is not None
+
+        (cond,) = TPUTextEncode().encode(clip, "a watercolor lighthouse")
+        # context: CLIP joint (77 tokens, padded 64+64→128) ‖ T5 (77, 128)
+        assert cond["context"].shape == (1, 154, 128)
+        assert cond["pooled"].shape == (1, 128)
+        assert np.isfinite(np.asarray(cond["context"])).all()
+        # The T5 half must be the live stream, not padding.
+        assert float(np.abs(np.asarray(cond["context"][:, 77:])).max()) > 0
+
+    def test_key_signature_classification(self, tmp_path, monkeypatch):
+        """Files with no name markers classify off the safetensors keys."""
+        import shutil
+
+        from comfyui_parallelanything_tpu.nodes_compat import (
+            TripleCLIPLoader,
+            _classify_text_tower,
+        )
+
+        paths = _synthetic_sd3_towers(tmp_path, monkeypatch)
+        a = tmp_path / "towerA.safetensors"  # t5 keys
+        b = tmp_path / "towerB.safetensors"  # open-clip keys
+        c = tmp_path / "towerC.safetensors"  # HF CLIP keys, width 64
+        shutil.copy(paths["t5"], a)
+        shutil.copy(paths["g"], b)
+        shutil.copy(paths["l"], c)
+        assert _classify_text_tower(str(a), str(a)) == "t5"
+        assert _classify_text_tower(str(b), str(b)) == "open-clip-g"
+        assert _classify_text_tower(str(c), str(c)) == "clip-l"
+        (clip,) = TripleCLIPLoader().load(str(b), str(c), str(a))
+        assert clip["type"] == "sd3-triple" and clip["t5"] is not None
+
+    def test_duplicate_and_missing_towers_raise(self, tmp_path, monkeypatch):
+        from comfyui_parallelanything_tpu.nodes_compat import TripleCLIPLoader
+
+        paths = _synthetic_sd3_towers(tmp_path, monkeypatch)
+        with pytest.raises(ValueError, match="two t5 files"):
+            TripleCLIPLoader().load(paths["t5"], paths["t5"], paths["l"])
+
+    def test_dual_clip_loader_sd3_two_tower_form(self, tmp_path, monkeypatch):
+        """DualCLIPLoader(type=sd3): CLIP-L + G, no T5 — context is the
+        padded joint alone; a clip_g file in slot 1 corrects swapped wiring."""
+        from comfyui_parallelanything_tpu.nodes import TPUTextEncode
+        from comfyui_parallelanything_tpu.nodes_compat import DualCLIPLoader
+
+        paths = _synthetic_sd3_towers(tmp_path, monkeypatch)
+        (clip,) = DualCLIPLoader().load(paths["g"], paths["l"], type="sd3")
+        assert clip["type"] == "sd3-triple" and clip["t5"] is None
+        (cond,) = TPUTextEncode().encode(clip, "a watercolor lighthouse")
+        # No T5 stream: the joint pads to the real family's 4096.
+        assert cond["context"].shape == (1, 77, 4096)
+        assert cond["pooled"].shape == (1, 128)
+
+
+class TestModelSamplingShiftPatches:
+    def _model(self, prefs=None):
+        from types import SimpleNamespace
+
+        return SimpleNamespace(
+            sampler_prefs=prefs,
+            config=SimpleNamespace(prediction="flow"),
+        )
+
+    def test_sd3_patch_sets_pref_and_resolution_order(self):
+        from comfyui_parallelanything_tpu.nodes import _shift_from_prefs
+        from comfyui_parallelanything_tpu.nodes_compat import ModelSamplingSD3
+
+        (m,) = ModelSamplingSD3().patch(self._model(), shift=3.0)
+        assert m.sampler_prefs["shift"] == 3.0
+        # Widget default yields to the patch; an explicit value wins.
+        assert _shift_from_prefs(m, 1.15) == 3.0
+        assert _shift_from_prefs(m, 2.0) == 2.0
+        assert _shift_from_prefs(self._model(), 1.15) == 1.15
+
+    def test_flux_patch_log_interpolates_over_tokens(self):
+        import math
+
+        from comfyui_parallelanything_tpu.nodes_compat import ModelSamplingFlux
+
+        (m,) = ModelSamplingFlux().patch(self._model())  # 1024² defaults
+        assert m.sampler_prefs["shift"] == pytest.approx(math.exp(1.15))
+        (m2,) = ModelSamplingFlux().patch(self._model(), width=256, height=256)
+        assert m2.sampler_prefs["shift"] == pytest.approx(math.exp(0.5))
+
+    def test_dataclass_model_keeps_type_and_existing_prefs(self):
+        import dataclasses
+
+        from comfyui_parallelanything_tpu.nodes_compat import ModelSamplingSD3
+
+        @dataclasses.dataclass
+        class M:
+            sampler_prefs: dict | None = None
+
+        (m,) = ModelSamplingSD3().patch(
+            M(sampler_prefs={"cfg_rescale": 0.5}), shift=5.0
+        )
+        assert isinstance(m, M)
+        assert m.sampler_prefs == {"cfg_rescale": 0.5, "shift": 5.0}
+
+    def test_basic_scheduler_honors_pref(self):
+        from comfyui_parallelanything_tpu.nodes import TPUBasicScheduler
+
+        (s_pref,) = TPUBasicScheduler().get_sigmas(
+            self._model({"shift": 3.0}), "normal", 8, 1.0
+        )
+        (s_expl,) = TPUBasicScheduler().get_sigmas(
+            self._model(), "normal", 8, 1.0, shift=3.0
+        )
+        np.testing.assert_allclose(np.asarray(s_pref), np.asarray(s_expl))
+        (s_plain,) = TPUBasicScheduler().get_sigmas(
+            self._model(), "normal", 8, 1.0
+        )
+        assert not np.allclose(np.asarray(s_pref), np.asarray(s_plain))
+
+
+class TestStockSD3Template:
+    def test_sd3_template_chain(self, tmp_path, monkeypatch):
+        """The stock SD3 template node chain — UNETLoader (MMDiT file sniffed
+        sd3-medium) + TripleCLIPLoader + CLIPTextEncode ×2 + ModelSamplingSD3
+        + EmptySD3LatentImage + KSampler — runs with stock names/inputs."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+        from safetensors.numpy import save_file
+
+        import comfyui_parallelanything_tpu.models as models_pkg
+        from comfyui_parallelanything_tpu import nodes_compat
+        from comfyui_parallelanything_tpu.models.mmdit import (
+            MMDiTConfig,
+            build_mmdit,
+        )
+        from tests.test_mmdit import _official_layout_sd
+
+        paths = _synthetic_sd3_towers(tmp_path, monkeypatch)
+        mcfg = MMDiTConfig(
+            in_channels=16, depth=2, context_in_dim=128, pooled_dim=128,
+            pos_embed_max=16, qk_norm=True, dtype=jnp.float32,
+        )
+        mm = build_mmdit(
+            mcfg, jax.random.key(0), sample_shape=(1, 8, 8, 16), txt_len=6
+        )
+        mm_path = tmp_path / "sd3_tiny.safetensors"
+        save_file(
+            {k: np.ascontiguousarray(v)
+             for k, v in _official_layout_sd(mcfg, mm.params).items()},
+            str(mm_path),
+        )
+        monkeypatch.setattr(models_pkg, "sd3_medium_config", lambda: mcfg)
+
+        n = nodes_compat.stock_node_mappings()
+        (model,) = n["UNETLoader"]().load_unet(str(mm_path))
+        (clip,) = n["TripleCLIPLoader"]().load(
+            paths["l"], paths["g"], paths["t5"]
+        )
+        (pos,) = n["CLIPTextEncode"]().run(
+            clip=clip, text="a watercolor lighthouse at dawn"
+        )
+        (neg,) = n["CLIPTextEncode"]().run(clip=clip, text="blurry")
+        (model,) = n["ModelSamplingSD3"]().patch(model, shift=3.0)
+        (lat,) = n["EmptySD3LatentImage"]().generate(64, 64, 1)
+        assert lat["samples"].shape == (1, 8, 8, 16)
+        (out,) = n["KSampler"]().run(
+            model=model, positive=pos, negative=neg, latent_image=lat,
+            seed=0, steps=2, cfg=3.0, sampler_name="euler",
+            scheduler="normal",
+        )
+        assert out["samples"].shape == (1, 8, 8, 16)
+        assert np.isfinite(np.asarray(out["samples"])).all()
+
+
+class TestLatentTransforms:
+    def _lat(self, arr, mask=None):
+        d = {"samples": arr}
+        if mask is not None:
+            d["noise_mask"] = mask
+        return d
+
+    def test_flip_axes_and_mask_follow(self):
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.nodes_compat import LatentFlip
+
+        x = jnp.arange(2 * 3 * 4 * 2, dtype=jnp.float32).reshape(2, 3, 4, 2)
+        m = jnp.arange(2 * 3 * 4 * 1, dtype=jnp.float32).reshape(2, 3, 4, 1)
+        (v,) = LatentFlip().flip(self._lat(x, m), "x-axis: vertically")
+        np.testing.assert_array_equal(np.asarray(v["samples"]),
+                                      np.asarray(x)[:, ::-1])
+        np.testing.assert_array_equal(np.asarray(v["noise_mask"]),
+                                      np.asarray(m)[:, ::-1])
+        (h,) = LatentFlip().flip(self._lat(x), "y-axis: horizontally")
+        np.testing.assert_array_equal(np.asarray(h["samples"]),
+                                      np.asarray(x)[:, :, ::-1])
+        # Video latents (NTHWC): the same −3/−2 spatial axes.
+        v5 = jnp.arange(2 * 2 * 3 * 4 * 2, dtype=jnp.float32).reshape(
+            2, 2, 3, 4, 2
+        )
+        (out5,) = LatentFlip().flip(self._lat(v5), "x-axis: vertically")
+        np.testing.assert_array_equal(np.asarray(out5["samples"]),
+                                      np.asarray(v5)[:, :, ::-1])
+
+    def test_rotate_clockwise_quarters_compose(self):
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.nodes_compat import LatentRotate
+
+        x = jnp.arange(1 * 2 * 3 * 1, dtype=jnp.float32).reshape(1, 2, 3, 1)
+        (r90,) = LatentRotate().rotate(self._lat(x), "90 degrees")
+        assert r90["samples"].shape == (1, 3, 2, 1)
+        # Clockwise: the top-left element lands top-right.
+        np.testing.assert_array_equal(
+            np.asarray(r90["samples"])[0, :, :, 0],
+            np.rot90(np.asarray(x)[0, :, :, 0], k=-1),
+        )
+        (r270,) = LatentRotate().rotate(r90, "270 degrees")
+        np.testing.assert_array_equal(np.asarray(r270["samples"]),
+                                      np.asarray(x))
+        (r0,) = LatentRotate().rotate(self._lat(x), "none")
+        np.testing.assert_array_equal(np.asarray(r0["samples"]), np.asarray(x))
+
+    def test_crop_clamps_to_bounds(self):
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.nodes_compat import LatentCrop
+
+        x = jnp.arange(1 * 8 * 8 * 4, dtype=jnp.float32).reshape(1, 8, 8, 4)
+        (c,) = LatentCrop().crop(self._lat(x), width=32, height=16, x=8, y=16)
+        assert c["samples"].shape == (1, 2, 4, 4)
+        np.testing.assert_array_equal(np.asarray(c["samples"]),
+                                      np.asarray(x)[:, 2:4, 1:5])
+        # Out-of-range window slides back inside (stock boundary rule).
+        (c2,) = LatentCrop().crop(self._lat(x), width=32, height=32,
+                                  x=512, y=512)
+        assert c2["samples"].shape == (1, 4, 4, 4)
+        np.testing.assert_array_equal(np.asarray(c2["samples"]),
+                                      np.asarray(x)[:, 4:, 4:])
+
+    def test_save_load_round_trip_and_legacy_rescale(self, tmp_path,
+                                                     monkeypatch):
+        import jax.numpy as jnp
+        from safetensors.numpy import save_file
+
+        from comfyui_parallelanything_tpu.nodes_compat import (
+            LoadLatent,
+            SaveLatent,
+        )
+
+        monkeypatch.setenv("PA_OUTPUT_DIR", str(tmp_path / "out"))
+        monkeypatch.setenv("PA_INPUT_DIR", str(tmp_path / "out"))
+        x = jnp.linspace(-2, 2, 1 * 4 * 4 * 4).reshape(1, 4, 4, 4)
+        ui = SaveLatent().save(self._lat(x), "latents/ComfyUI")
+        fname = ui["ui"]["latents"][0]
+        (lat,) = LoadLatent().load(os.path.join("latents", fname))
+        np.testing.assert_allclose(np.asarray(lat["samples"]), np.asarray(x),
+                                   atol=1e-7)
+        # Legacy (pre-version-marker) dumps are stored scaled by 0.18215.
+        legacy = tmp_path / "out" / "legacy.latent"
+        save_file(
+            {"latent_tensor": np.asarray(x, np.float32) * 0.18215},
+            str(legacy),
+        )
+        (lat2,) = LoadLatent().load("legacy.latent")
+        np.testing.assert_allclose(np.asarray(lat2["samples"]),
+                                   np.asarray(x), atol=1e-5)
+        with pytest.raises(ValueError, match="not found"):
+            LoadLatent().load("ghost.latent")
