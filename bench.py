@@ -25,6 +25,11 @@ platform):
 - ``flux_16_int8`` — FULL 19/38 flux-dev topology with int8-stored weights
   (fits one v5e chip): the measured replacement for flux_16's analytic
   full-depth extrapolation.
+- ``flux_stream`` — FULL 19/38 flux-dev, int8, WEIGHT-STREAMED on one chip
+  (parallel/streaming.py): host-pinned params double-buffered through HBM —
+  the rung for chips whose usable HBM is below even the int8 replica (the
+  round-5 finding that left the flagship blank). PA_STREAM_HBM_BUDGET
+  overrides the carve budget (bytes).
 - ``wan_video``— WAN-class video DiT, 16 frames 480p-latent batch=1 (sequence-
   dominant workload; temporal tokens ≈ video "batch").
 - ``hybrid_sd15`` — SD1.5-class UNet, batch=8, 512², on a heterogeneous
@@ -64,6 +69,20 @@ if (_FAKE_TPU or _TINY) and not os.environ.get("PA_EVIDENCE_DIR"):
         "repo's real evidence artifacts"
     )
 _TPU_PLATFORMS = ("tpu", "axon") + ((_FAKE_TPU,) if _FAKE_TPU else ())
+
+
+def is_banked_tpu_record(rec: dict) -> bool:
+    """The ONE freshness predicate for rung evidence, shared by every consumer
+    (the fallbacks below and scripts/tpu_watchdog.py): a genuine measurement —
+    not marked invalid, not a stale re-emit — from a TPU-class platform. The
+    ``dryrun`` marker is deliberately NOT filtered here: mocked records are
+    confined to their own PA_EVIDENCE_DIR, where the watchdog dry-run
+    legitimately treats them as banked."""
+    return (
+        not rec.get("invalid")
+        and not rec.get("stale")
+        and rec.get("platform") in _TPU_PLATFORMS
+    )
 
 
 def evidence_dir() -> str:
@@ -154,10 +173,18 @@ def _int8_synth_model(jnp, cfg, sample_shape, txt_len, name):
     """Flux-family model with int8-SYNTHESIZED weights (zeros; matmul timing
     is value-independent) built from abstract shapes — no high-precision
     pytree is ever materialized. Dequantize happens inside jit: int8 HBM
-    reads, on-chip widening (models/quantize.py). Shared by the int8 rungs."""
+    reads, on-chip widening (models/quantize.py). Shared by the int8 rungs.
+    Carries the staged pipeline spec (stage closures rebound through the same
+    dequantize wrapper, the models/quantize.quantize_model pattern) so the
+    weight-streaming rung can carve it."""
+    import dataclasses as _dc
+
     from comfyui_parallelanything_tpu.models import flux_abstract_params
     from comfyui_parallelanything_tpu.models.api import DiffusionModel
-    from comfyui_parallelanything_tpu.models.flux import FluxModel
+    from comfyui_parallelanything_tpu.models.flux import (
+        FluxModel,
+        _flux_pipeline_spec,
+    )
     from comfyui_parallelanything_tpu.models.quantize import dequantize_params
 
     sds = flux_abstract_params(cfg, sample_shape=sample_shape, txt_len=txt_len)
@@ -169,7 +196,24 @@ def _int8_synth_model(jnp, cfg, sample_shape, txt_len, name):
             {"params": dequantize_params(p, jnp.bfloat16)}, x, t, context, **kw
         )
 
-    return DiffusionModel(apply=apply, params=params, name=name, config=cfg)
+    def wrap_stage(fn):
+        def wrapped(p, *a, **k):
+            return fn(dequantize_params(p, jnp.bfloat16), *a, **k)
+
+        return wrapped
+
+    spec = _flux_pipeline_spec(module, cfg)
+    spec = _dc.replace(
+        spec,
+        prepare=wrap_stage(spec.prepare),
+        segments=tuple(
+            _dc.replace(seg, fn=wrap_stage(seg.fn)) for seg in spec.segments
+        ),
+        finalize=wrap_stage(spec.finalize),
+    )
+    return DiffusionModel(
+        apply=apply, params=params, name=name, config=cfg, pipeline_spec=spec
+    )
 
 
 def _rung_zimage_21_int8(jnp, rng):
@@ -276,6 +320,39 @@ def _rung_flux_16_int8(jnp, rng):
             4)
 
 
+def _rung_flux_stream(jnp, rng):
+    """FULL 19/38 flux-dev topology, int8 weights, STREAMED through one chip —
+    the north-star shape (batch=16 @1024²) as a measurement instead of a
+    blank: ~12 GiB of int8 weights exceed the chip's usable HBM (<10.8 GiB,
+    round-5 HBM finding), so no resident placement can ever run it
+    single-chip. The weight-streaming executor (parallel/streaming.py) keeps
+    params host-pinned and double-buffers per-stage sub-pytrees through HBM —
+    int8 on the wire (half the bf16 transfer bytes), dequantized on-chip
+    inside each stage program. run_inner routes this rung through
+    ``ParallelConfig(weight_sharding="stream")`` on the lead chip."""
+    from comfyui_parallelanything_tpu.models import flux_dev_config
+
+    batch, latent, ctx_len = 16, 128, 512
+    cfg = flux_dev_config(dtype=jnp.bfloat16)
+    model = _int8_synth_model(
+        jnp, cfg, sample_shape=(1, 32, 32, 16), txt_len=ctx_len,
+        name="flux-dev-int8-stream",
+    )
+    kwargs = {
+        "y": jnp.zeros((batch, cfg.vec_in_dim), jnp.float32),
+        "guidance": jnp.full((batch,), 3.5, jnp.float32),
+    }
+    # 4 sequential microbatches of 4 (the flux_16_int8 activation-peak
+    # lesson); the streamed schedule re-runs per chunk, so transfer overlap
+    # is measured under the same per-iteration image count as the resident
+    # rungs.
+    return (model, batch, (batch, latent, latent, 16), ctx_len,
+            cfg.context_in_dim, kwargs,
+            "FLUX-dev MMDiT FULL depth 19/38, int8 weights STREAMED "
+            "(host-pinned, double-buffered), batch=16 (4x4 microbatch) "
+            "1024x1024 (single chip; weights exceed HBM)", 4)
+
+
 def _rung_wan_video(jnp, rng):
     from comfyui_parallelanything_tpu.models import build_wan, wan_1_3b_config
 
@@ -332,6 +409,7 @@ _RUNGS = {
     "zimage_21_int8": _rung_zimage_21_int8,
     "flux_16": _rung_flux_16,
     "flux_16_int8": _rung_flux_16_int8,
+    "flux_stream": _rung_flux_stream,
     "wan_video": _rung_wan_video,
     "hybrid_sd15": _rung_hybrid_sd15,
     "smoke": _rung_smoke,
@@ -371,21 +449,36 @@ def _flops_per_step(model, x, t, ctx, kwargs):
     model_flops_per_step null → mfu null) and dot/conv FLOP counts are
     backend-independent anyway, so one CPU lowering serves every platform.
     Abstract args only — ShapeDtypeStructs are uncommitted, so default_device
-    controls the lowering target and no TPU buffer is touched."""
+    controls the lowering target and no TPU buffer is touched.
+
+    Falls back to the exact jaxpr walk in scripts/mfu_budget.py when cost
+    analysis yields nothing (VERDICT r5 next-6: zimage_21_int8 banked
+    ``mfu: null`` — the one rung carrying a vs_baseline claim could not be
+    audited), so every rung's MFU wiring is non-null."""
     import jax
 
+    flops = None
     try:
         abstract = jax.tree.map(
             lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
             (model.params, x, t, ctx, kwargs),
         )
         with jax.default_device(jax.devices("cpu")[0]):
-            return _cost_flops(
+            flops = _cost_flops(
                 jax.jit(model.apply).lower(
                     abstract[0], abstract[1], abstract[2], abstract[3],
                     **abstract[4],
                 )
             )
+    except Exception:
+        flops = None
+    if flops:
+        return flops
+    try:
+        sys.path.insert(0, os.path.join(_REPO, "scripts"))
+        from mfu_budget import analytic_flops
+
+        return analytic_flops(model.apply, model.params, x, t, ctx, kwargs)
     except Exception:
         return None
 
@@ -451,7 +544,7 @@ def _default_tpu_rung() -> str:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if not rec.get("invalid") and rec.get("platform") in _TPU_PLATFORMS:
+                if is_banked_tpu_record(rec):
                     banked.add(rec.get("rung"))
     except OSError:
         pass
@@ -459,6 +552,36 @@ def _default_tpu_rung() -> str:
         if rung in banked:
             return rung
     return "sd15_16"
+
+
+def _stale_tpu_record(requested):
+    """The most recent banked VALID TPU record from BASELINE_measured.json
+    (preferring the requested rung's own records), or None when no TPU
+    evidence has ever banked. The wedged-tunnel fallback re-emits it with
+    ``"stale": true`` instead of a meaningless CPU smoke (VERDICT r5 weak-1:
+    three of five round snapshots were smoke while real TPU evidence sat in
+    the measured file)."""
+    best = best_any = None
+    try:
+        with open(os.path.join(evidence_dir(), "BASELINE_measured.json")) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not is_banked_tpu_record(rec) or rec.get("dryrun"):
+                    # dryrun additionally excluded: a mocked record must never
+                    # re-emit as (stale) TPU evidence.
+                    continue
+                ts = rec.get("ts") or 0
+                if best_any is None or ts >= (best_any.get("ts") or 0):
+                    best_any = rec
+                if requested and rec.get("rung") == requested:
+                    if best is None or ts >= (best.get("ts") or 0):
+                        best = rec
+    except OSError:
+        return None
+    return best or best_any
 
 
 def _make_step(pm, batch, n_chunks, t, ctx, kwargs):
@@ -502,7 +625,11 @@ def run_inner() -> None:
     except Exception:
         pass
 
-    from comfyui_parallelanything_tpu import DeviceChain, parallelize
+    from comfyui_parallelanything_tpu import (
+        DeviceChain,
+        ParallelConfig,
+        parallelize,
+    )
 
     platform = jax.devices()[0].platform
     n_dev = len(jax.devices())
@@ -534,16 +661,33 @@ def run_inner() -> None:
             (c for c in range(want, batch + 1) if batch % c == 0), batch
         )
 
-    if config_name == "hybrid_sd15" and is_tpu and platform != "cpu":
+    if config_name == "flux_stream":
+        # Weight-streaming rung: ONE chip, params host-pinned, stages
+        # double-buffered (parallel/streaming.py). The explicit stream mode
+        # pins the rung's meaning (the weights-don't-fit auto-routing would
+        # pick it anyway on a chip whose budget the pytree exceeds);
+        # PA_STREAM_HBM_BUDGET overrides the carve budget — the off-hardware
+        # rehearsal forces multi-stage carving on a tiny model with it.
+        chain = DeviceChain.even([f"{platform}:{jax.devices()[0].id}"])
+        budget = os.environ.get("PA_STREAM_HBM_BUDGET")
+        pm = parallelize(
+            model, chain,
+            ParallelConfig(
+                weight_sharding="stream",
+                hbm_budget_bytes=int(budget) if budget else None,
+            ),
+        )
+    elif config_name == "hybrid_sd15" and is_tpu and platform != "cpu":
         # The heterogeneous rung: lead TPU chip at 70%, host CPU at 30% — a
         # two-platform chain, so parallelize builds two SPMD groups and the
         # weighted host scatter (SURVEY §7 hard part 1) actually runs.
         chain = DeviceChain.from_pairs(
             [(f"{platform}:{jax.devices()[0].id}", 70.0), ("cpu", 30.0)]
         )
+        pm = parallelize(model, chain)
     else:
         chain = DeviceChain.even([f"{platform}:{d.id}" for d in jax.devices()])
-    pm = parallelize(model, chain)
+        pm = parallelize(model, chain)
 
     kx, kc = jax.random.split(jax.random.key(1))
     x = jax.random.normal(kx, x_shape, jnp.float32)
@@ -742,10 +886,31 @@ def _orchestrate() -> None:
                 f"Inner stderr tail:\n{err}\n"
             )
         elif probe_reason:
+            fallback_cause = f"TPU probe failed: {probe_reason[:200]}"
             sys.stderr.write(f"bench: TPU probe failed — {probe_reason}\n")
 
-    # Honest CPU fallback — platform field in the JSON marks it as such. Always
-    # the smoke rung: the real rungs are TPU-sized and would hang a CPU run.
+        # Stale-evidence fallback (VERDICT r5 weak-1/next-4): a wedged tunnel
+        # must not turn the round's official line into a CPU smoke when real
+        # TPU evidence is banked — re-emit the most recent valid banked TPU
+        # record, explicitly marked stale with its capture timestamp. Still
+        # exactly one JSON line.
+        stale = _stale_tpu_record(requested)
+        if stale is not None:
+            out = dict(stale)
+            out["stale"] = True
+            out["stale_reason"] = fallback_cause
+            out["captured_ts"] = out.get("ts")
+            sys.stderr.write(
+                f"bench: emitting stale banked TPU record for rung "
+                f"{out.get('rung')!r} (captured ts {out.get('ts')}) — "
+                f"{fallback_cause}\n"
+            )
+            print(json.dumps(out))
+            return
+
+    # Honest CPU fallback — platform field in the JSON marks it as such
+    # (reached only when NO TPU evidence has ever banked). Always the smoke
+    # rung: the real rungs are TPU-sized and would hang a CPU run.
     if requested not in (None, "smoke"):
         sys.stderr.write(
             f"bench: substituting CPU smoke rung for requested {requested!r} "

@@ -35,6 +35,74 @@ from .unet import UNetConfig, build_unet
 from .wan import WanConfig, build_wan
 
 
+def params_nbytes(params) -> int:
+    """Total stored bytes of a parameter pytree (QuantTensor int8 leaves count
+    at their stored width — the number that competes for HBM)."""
+    import jax
+
+    return sum(
+        int(l.size) * l.dtype.itemsize for l in jax.tree.leaves(params)
+    )
+
+
+def pin_params_host(params, device=None):
+    """Host-resident placement for the weight-streaming executor
+    (parallel/streaming.py): every leaf lands in the device's ``pinned_host``
+    memory space where the backend supports memory kinds (TPU — DMA-able
+    pages, so the per-stage host→HBM prefetch runs at full PCIe/ICI rate
+    without a bounce copy), and falls back to plain host numpy arrays
+    otherwise (CPU backend, older runtimes). Either way the returned pytree
+    holds NO device-memory footprint — stage sub-pytrees are carved from it
+    and streamed per call."""
+    import jax
+
+    from ..parallel.mesh import streamed_tree_put
+
+    dev = device if device is not None else jax.devices()[0]
+    try:
+        sharding = jax.sharding.SingleDeviceSharding(
+            dev, memory_kind="pinned_host"
+        )
+        # Probe with one tiny transfer before committing the whole pytree —
+        # backends without pinned_host raise here, not at tree scale.
+        jax.block_until_ready(jax.device_put(np.zeros((1,)), sharding))
+        return streamed_tree_put(params, lambda _: sharding)
+    except Exception:
+        get_logger().info(
+            "pinned_host memory kind unavailable on %s; keeping weights as "
+            "host numpy arrays", getattr(dev, "platform", dev),
+        )
+        return jax.tree.map(np.asarray, params)
+
+
+def carve_stages(spec, params, max_stage_bytes: int | None = None,
+                 n_stages: int | None = None) -> list[tuple[int, int]]:
+    """Partition a ``PipelineSpec``'s segments into contiguous stage ranges
+    for the streaming executor: each stage's parameter sub-pytree fits
+    ``max_stage_bytes`` (half the double-buffer budget), or — when only a
+    stage COUNT is given — stages are balanced by bytes. Returns
+    ``[(start, end), ...]`` over ``spec.segments``; single-segment stages
+    may exceed the byte cap (a segment is the atomic streaming unit — the
+    cap then simply degrades to one-segment-at-a-time streaming)."""
+    sizes = [
+        params_nbytes({k: params[k] for k in seg.param_keys})
+        for seg in spec.segments
+    ]
+    total = sum(sizes)
+    if max_stage_bytes is None:
+        n = max(1, min(len(sizes), int(n_stages or 4)))
+        max_stage_bytes = max(1, -(-total // n))
+    ranges: list[tuple[int, int]] = []
+    start, acc = 0, 0
+    for i, sz in enumerate(sizes):
+        if i > start and acc + sz > max_stage_bytes:
+            ranges.append((start, i))
+            start, acc = i, 0
+        acc += sz
+    ranges.append((start, len(sizes)))
+    return ranges
+
+
 def load_safetensors(path: str | os.PathLike) -> dict[str, np.ndarray]:
     """Read every tensor of a .safetensors file into float32 numpy.
 

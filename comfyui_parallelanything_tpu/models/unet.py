@@ -259,6 +259,42 @@ class Upsample(nn.Module):
         return nn.Conv(self.channels, (3, 3), padding=1, dtype=self.cfg.dtype)(x)
 
 
+def _has_attn(cfg: UNetConfig, level: int) -> bool:
+    return level in cfg.attention_levels and cfg.transformer_depth[level] > 0
+
+
+def _input_schedule(cfg: UNetConfig) -> list[tuple[int, int]]:
+    """(level, i) of every input (down) block, in execution order."""
+    return [
+        (level, i)
+        for level in range(len(cfg.channel_mult))
+        for i in range(cfg.num_res_blocks)
+    ]
+
+
+def _output_schedule(cfg: UNetConfig) -> list[tuple[int, int]]:
+    """(level, i) of every output (up) block, in execution order."""
+    return [
+        (level, i)
+        for level in reversed(range(len(cfg.channel_mult)))
+        for i in range(cfg.num_res_blocks + 1)
+    ]
+
+
+def _skip_base(cfg: UNetConfig, level: int) -> int:
+    """Index of the first skip pushed by ``level`` (skip_0 = the input conv;
+    each earlier level pushed num_res_blocks skips plus one for its
+    downsample)."""
+    last = len(cfg.channel_mult) - 1
+    return 1 + sum(
+        cfg.num_res_blocks + (1 if m != last else 0) for m in range(level)
+    )
+
+
+def _total_skips(cfg: UNetConfig) -> int:
+    return _skip_base(cfg, len(cfg.channel_mult))
+
+
 class UNet2D(nn.Module):
     """forward(x NHWC, timesteps (B,), context (B,S,D), y=(B,adm) for SDXL).
 
@@ -268,90 +304,169 @@ class UNet2D(nn.Module):
     ``"middle"`` (added to the middle-block output). Composed models build the
     dict inside the same jit program (``apply_control``), so it never crosses
     the kwargs-partitioning boundary as a python value.
+
+    Structured setup-style as a staged forward — prepare → input blocks →
+    middle → output blocks → finalize — so the same module serves the plain
+    jitted apply AND the ``PipelineSpec`` decomposition (batch==1 block
+    placement and the weight-streaming executor, parallel/streaming.py). The
+    carry is a flat dict: ``h``/``emb``/``context`` plus ``skip_{i}`` entries
+    (the skip stack, indexed statically per cfg) and optional ``ctrl_*``
+    residuals; param names are IDENTICAL to the previous inline layout, so
+    checkpoints convert unchanged.
     """
 
     cfg: UNetConfig
 
-    @nn.compact
-    def __call__(self, x, timesteps, context=None, y=None, control=None,
-                 **kwargs):
+    def setup(self):
         cfg = self.cfg
         ch = cfg.model_channels
-        t_emb = timestep_embedding(timesteps, ch).astype(cfg.dtype)
-        emb = nn.Dense(ch * 4, dtype=cfg.dtype, name="time_embed_0")(t_emb)
-        emb = nn.Dense(ch * 4, dtype=cfg.dtype, name="time_embed_2")(nn.silu(emb))
+        self.time_embed_0 = nn.Dense(ch * 4, dtype=cfg.dtype)
+        self.time_embed_2 = nn.Dense(ch * 4, dtype=cfg.dtype)
         if cfg.adm_in_channels is not None:
-            if y is None:
-                raise ValueError("this config requires vector conditioning `y`")
-            y_emb = nn.Dense(ch * 4, dtype=cfg.dtype, name="label_embed_0")(
-                y.astype(cfg.dtype)
-            )
-            emb = emb + nn.Dense(ch * 4, dtype=cfg.dtype, name="label_embed_2")(
-                nn.silu(y_emb)
-            )
-
-        x = x.astype(cfg.dtype)
-        if context is not None:
-            context = context.astype(cfg.dtype)
-
-        h = nn.Conv(ch, (3, 3), padding=1, dtype=cfg.dtype, name="input_conv")(x)
-        skips = [h]
-        # -- input (down) blocks ---------------------------------------------------
+            self.label_embed_0 = nn.Dense(ch * 4, dtype=cfg.dtype)
+            self.label_embed_2 = nn.Dense(ch * 4, dtype=cfg.dtype)
+        self.input_conv = nn.Conv(ch, (3, 3), padding=1, dtype=cfg.dtype)
         for level, mult in enumerate(cfg.channel_mult):
             out_ch = ch * mult
             for i in range(cfg.num_res_blocks):
-                h = ResBlock(cfg, out_ch, name=f"in_{level}_{i}_res")(h, emb)
-                if level in cfg.attention_levels and cfg.transformer_depth[level] > 0:
-                    h = SpatialTransformer(
-                        cfg, out_ch, cfg.transformer_depth[level], name=f"in_{level}_{i}_attn"
-                    )(h, context)
-                skips.append(h)
+                setattr(self, f"in_{level}_{i}_res", ResBlock(cfg, out_ch))
+                if _has_attn(cfg, level):
+                    setattr(
+                        self, f"in_{level}_{i}_attn",
+                        SpatialTransformer(
+                            cfg, out_ch, cfg.transformer_depth[level]
+                        ),
+                    )
             if level != len(cfg.channel_mult) - 1:
-                h = Downsample(cfg, out_ch, name=f"down_{level}")(h)
-                skips.append(h)
-        # -- middle ----------------------------------------------------------------
+                setattr(self, f"down_{level}", Downsample(cfg, out_ch))
         mid_ch = ch * cfg.channel_mult[-1]
-        mid_depth = middle_depth(cfg)
-        h = ResBlock(cfg, mid_ch, name="mid_res1")(h, emb)
-        if mid_depth > 0:
-            h = SpatialTransformer(cfg, mid_ch, mid_depth, name="mid_attn")(h, context)
-        h = ResBlock(cfg, mid_ch, name="mid_res2")(h, emb)
-        ctrl_in: list = []
-        if control is not None:
-            mid_residuals = control.get("middle") or ()
-            if mid_residuals:
-                h = h + mid_residuals[0].astype(h.dtype)
-            ctrl_in = list(control.get("input") or ())
-            if ctrl_in and len(ctrl_in) != len(skips):
-                raise ValueError(
-                    f"control['input'] has {len(ctrl_in)} residuals for "
-                    f"{len(skips)} skip connections — ControlNet/UNet config "
-                    "mismatch"
-                )
-        # -- output (up) blocks ----------------------------------------------------
-        for level in reversed(range(len(cfg.channel_mult))):
+        self.mid_res1 = ResBlock(cfg, mid_ch)
+        if middle_depth(cfg) > 0:
+            self.mid_attn = SpatialTransformer(cfg, mid_ch, middle_depth(cfg))
+        self.mid_res2 = ResBlock(cfg, mid_ch)
+        for level in range(len(cfg.channel_mult)):
             out_ch = ch * cfg.channel_mult[level]
             for i in range(cfg.num_res_blocks + 1):
-                skip = skips.pop()
-                if ctrl_in:
-                    skip = skip + ctrl_in.pop().astype(skip.dtype)
-                if cfg.freeu is not None:
-                    h, skip = _apply_freeu(cfg, h, skip)
-                h = jnp.concatenate([h, skip], axis=-1)
-                h = ResBlock(cfg, out_ch, name=f"out_{level}_{i}_res")(h, emb)
-                if level in cfg.attention_levels and cfg.transformer_depth[level] > 0:
-                    h = SpatialTransformer(
-                        cfg, out_ch, cfg.transformer_depth[level], name=f"out_{level}_{i}_attn"
-                    )(h, context)
+                setattr(self, f"out_{level}_{i}_res", ResBlock(cfg, out_ch))
+                if _has_attn(cfg, level):
+                    setattr(
+                        self, f"out_{level}_{i}_attn",
+                        SpatialTransformer(
+                            cfg, out_ch, cfg.transformer_depth[level]
+                        ),
+                    )
             if level != 0:
-                h = Upsample(cfg, out_ch, name=f"up_{level}")(h)
+                setattr(self, f"up_{level}", Upsample(cfg, out_ch))
+        self.out_norm = nn.GroupNorm(num_groups=cfg.norm_groups, dtype=cfg.dtype)
+        self.out_conv = nn.Conv(
+            cfg.out_channels, (3, 3), padding=1, dtype=jnp.float32
+        )
 
-        h = nn.GroupNorm(num_groups=cfg.norm_groups, dtype=cfg.dtype, name="out_norm")(h)
+    # -- staged forward (the PipelineSpec decomposition) -----------------------
+
+    def prepare(self, x, timesteps, context=None, y=None, control=None,
+                **kwargs):
+        """Embeddings + input conv on the lead device; seeds the carry with
+        skip_0 and flattens any ControlNet residuals into ``ctrl_*`` entries
+        so the carry stays a flat dict of arrays."""
+        cfg = self.cfg
+        ch = cfg.model_channels
+        t_emb = timestep_embedding(timesteps, ch).astype(cfg.dtype)
+        emb = self.time_embed_0(t_emb)
+        emb = self.time_embed_2(nn.silu(emb))
+        if cfg.adm_in_channels is not None:
+            if y is None:
+                raise ValueError("this config requires vector conditioning `y`")
+            y_emb = self.label_embed_0(y.astype(cfg.dtype))
+            emb = emb + self.label_embed_2(nn.silu(y_emb))
+        x = x.astype(cfg.dtype)
+        if context is not None:
+            context = context.astype(cfg.dtype)
+        h = self.input_conv(x)
+        carry = {"h": h, "emb": emb, "context": context, "skip_0": h}
+        if control is not None:
+            for j, res in enumerate(control.get("input") or ()):
+                carry[f"ctrl_in_{j}"] = res
+            mid_residuals = control.get("middle") or ()
+            if mid_residuals:
+                carry["ctrl_mid"] = mid_residuals[0]
+        return carry
+
+    def input_step(self, carry, level: int, i: int):
+        cfg = self.cfg
+        h = getattr(self, f"in_{level}_{i}_res")(carry["h"], carry["emb"])
+        if _has_attn(cfg, level):
+            h = getattr(self, f"in_{level}_{i}_attn")(h, carry["context"])
+        out = dict(carry)
+        idx = _skip_base(cfg, level) + i
+        out[f"skip_{idx}"] = h
+        if i == cfg.num_res_blocks - 1 and level != len(cfg.channel_mult) - 1:
+            h = getattr(self, f"down_{level}")(h)
+            out[f"skip_{idx + 1}"] = h
+        out["h"] = h
+        return out
+
+    def middle_step(self, carry):
+        cfg = self.cfg
+        h = self.mid_res1(carry["h"], carry["emb"])
+        if middle_depth(cfg) > 0:
+            h = self.mid_attn(h, carry["context"])
+        h = self.mid_res2(h, carry["emb"])
+        if "ctrl_mid" in carry:
+            h = h + carry["ctrl_mid"].astype(h.dtype)
+        n_ctrl = sum(1 for k in carry if k.startswith("ctrl_in_"))
+        n_skips = sum(1 for k in carry if k.startswith("skip_"))
+        if n_ctrl and n_ctrl != n_skips:
+            raise ValueError(
+                f"control['input'] has {n_ctrl} residuals for "
+                f"{n_skips} skip connections — ControlNet/UNet config "
+                "mismatch"
+            )
+        return {**carry, "h": h}
+
+    def output_step(self, carry, level: int, i: int):
+        cfg = self.cfg
+        # j-th output block consumes the skip stack LIFO (hs.pop() parity).
+        j = (
+            (len(cfg.channel_mult) - 1 - level) * (cfg.num_res_blocks + 1) + i
+        )
+        idx = _total_skips(cfg) - 1 - j
+        out = dict(carry)
+        skip = out.pop(f"skip_{idx}")
+        ctrl = out.pop(f"ctrl_in_{idx}", None)
+        if ctrl is not None:
+            skip = skip + ctrl.astype(skip.dtype)
+        h = out["h"]
+        if cfg.freeu is not None:
+            h, skip = _apply_freeu(cfg, h, skip)
+        h = jnp.concatenate([h, skip], axis=-1)
+        h = getattr(self, f"out_{level}_{i}_res")(h, out["emb"])
+        if _has_attn(cfg, level):
+            h = getattr(self, f"out_{level}_{i}_attn")(h, out["context"])
+        if i == cfg.num_res_blocks and level != 0:
+            h = getattr(self, f"up_{level}")(h)
+        out["h"] = h
+        return out
+
+    def finalize(self, carry, out_shape: tuple[int, ...]):
+        """Final norm + projection (lead device); ``out_shape`` is the
+        PipelineSpec finalize contract — the UNet's geometry already rides
+        the carry, so it is unused here."""
+        del out_shape
+        h = self.out_norm(carry["h"])
         h = nn.silu(h)
-        h = nn.Conv(
-            cfg.out_channels, (3, 3), padding=1, dtype=jnp.float32, name="out_conv"
-        )(h.astype(jnp.float32))
-        return h
+        return self.out_conv(h.astype(jnp.float32))
+
+    def __call__(self, x, timesteps, context=None, y=None, control=None,
+                 **kwargs):
+        cfg = self.cfg
+        carry = self.prepare(x, timesteps, context, y=y, control=control)
+        for level, i in _input_schedule(cfg):
+            carry = self.input_step(carry, level, i)
+        carry = self.middle_step(carry)
+        for level, i in _output_schedule(cfg):
+            carry = self.output_step(carry, level, i)
+        return self.finalize(carry, x.shape)
 
 
 def apply_inpaint_conditioning(base: "DiffusionModel", mask, masked_latent):
@@ -464,6 +579,87 @@ def unclip_adm(tags, adm_in_channels: int, rng=None,
     return y
 
 
+def _unet_pipeline_spec(module: "UNet2D", cfg: UNetConfig):
+    """Stage decomposition of the UNet forward: embeddings/input conv on the
+    lead device, one segment per input/middle/output block, final
+    norm/projection on the lead. The skip connections ride the carry as
+    statically-indexed ``skip_{i}`` entries, so the carry structure at every
+    segment boundary is fixed per cfg — what both batch==1 block placement
+    (parallel/pipeline.py) and the weight-streaming executor
+    (parallel/streaming.py) need. The reference never pipelines UNets (its
+    block-list walk finds no ['double_blocks', ...] name,
+    any_device_parallel.py:1156-1166); the staged form here is what lets an
+    SD-family model stream when its weights exceed HBM."""
+    from .api import PipelineSegment, PipelineSpec
+
+    def prepare(params, x, t, context=None, **kw):
+        return module.apply(
+            {"params": params}, x, t, context, method=UNet2D.prepare, **kw
+        )
+
+    def make_input(level, i):
+        def fn(params, carry):
+            return module.apply(
+                {"params": params}, carry, level, i, method=UNet2D.input_step
+            )
+
+        return fn
+
+    def middle(params, carry):
+        return module.apply({"params": params}, carry, method=UNet2D.middle_step)
+
+    def make_output(level, i):
+        def fn(params, carry):
+            return module.apply(
+                {"params": params}, carry, level, i, method=UNet2D.output_step
+            )
+
+        return fn
+
+    def finalize(params, carry, out_shape):
+        return module.apply(
+            {"params": params}, carry, out_shape, method=UNet2D.finalize
+        )
+
+    last = len(cfg.channel_mult) - 1
+    segments = []
+    for level, i in _input_schedule(cfg):
+        keys = [f"in_{level}_{i}_res"]
+        if _has_attn(cfg, level):
+            keys.append(f"in_{level}_{i}_attn")
+        if i == cfg.num_res_blocks - 1 and level != last:
+            keys.append(f"down_{level}")
+        segments.append(
+            PipelineSegment(tuple(keys), make_input(level, i),
+                            f"input[{level}.{i}]")
+        )
+    mid_keys = ["mid_res1", "mid_res2"]
+    if middle_depth(cfg) > 0:
+        mid_keys.insert(1, "mid_attn")
+    segments.append(PipelineSegment(tuple(mid_keys), middle, "middle"))
+    for level, i in _output_schedule(cfg):
+        keys = [f"out_{level}_{i}_res"]
+        if _has_attn(cfg, level):
+            keys.append(f"out_{level}_{i}_attn")
+        if i == cfg.num_res_blocks and level != 0:
+            keys.append(f"up_{level}")
+        segments.append(
+            PipelineSegment(tuple(keys), make_output(level, i),
+                            f"output[{level}.{i}]")
+        )
+
+    prepare_keys = ["time_embed_0", "time_embed_2", "input_conv"]
+    if cfg.adm_in_channels is not None:
+        prepare_keys[2:2] = ["label_embed_0", "label_embed_2"]
+    return PipelineSpec(
+        prepare_keys=tuple(prepare_keys),
+        prepare=prepare,
+        segments=tuple(segments),
+        finalize_keys=("out_norm", "out_conv"),
+        finalize=finalize,
+    )
+
+
 def build_unet(
     cfg: UNetConfig,
     rng=None,
@@ -488,5 +684,6 @@ def build_unet(
         return module.apply({"params": params}, x, timesteps, context, **kw)
 
     return DiffusionModel(
-        apply=apply, params=params, name=name, config=cfg, block_lists=None
+        apply=apply, params=params, name=name, config=cfg, block_lists=None,
+        pipeline_spec=_unet_pipeline_spec(module, cfg),
     )

@@ -680,25 +680,60 @@ class TPUTextEncode:
             )
             return ({"context": context, "penultimate": None, "pooled": y},)
         if ctype == "sd3-triple":
-            # Stock TripleCLIPLoader (or DualCLIPLoader type=sd3, t5=None):
-            # encode every present tower and assemble SD3's (context, y) —
-            # TPUConditioningCombine(mode='sd3') semantics in one encode.
-            # Penultimate streams unconditionally: SD3 trains on layer -2.
+            # Stock TripleCLIPLoader (or DualCLIPLoader type=sd3, any one
+            # tower absent): encode every present tower and assemble SD3's
+            # (context, y) — TPUConditioningCombine(mode='sd3') semantics in
+            # one encode. Penultimate streams unconditionally: SD3 trains on
+            # layer -2. A missing CLIP tower zero-fills, the stock SD3
+            # CLIP's convention, and ALIGNMENT matters: the model was
+            # trained with L at joint[0:768] and G at joint[768:2048], so a
+            # missing L must still occupy its slot as zeros (canonical 768,
+            # clamped so resized test towers compose — the same derived-
+            # geometry rule as context_dim below) or G's features shift to
+            # offset 0. A missing G needs only a width-0 stream: its slot is
+            # trailing, and zeros ⊕ pad-to-4096 equals pad-to-4096. Pooled
+            # halves zero-fill at the canonical widths (768/1280) so y keeps
+            # the model's vec_in geometry.
             from .models.text_encoders import sd3_text_conditioning
 
-            (cl,) = self.encode(clip["l"], text, clip_skip)
-            (cg,) = self.encode(clip["g"], text, clip_skip)
+            cl = cg = None
+            if clip.get("l") is not None:
+                (cl,) = self.encode(clip["l"], text, clip_skip)
+            if clip.get("g") is not None:
+                (cg,) = self.encode(clip["g"], text, clip_skip)
+            if cl is None and cg is None:
+                raise ValueError(
+                    "sd3 conditioning needs at least one CLIP tower "
+                    "(clip_l or clip_g); got T5 only"
+                )
             t5_ctx = None
             if clip.get("t5") is not None:
                 (ct5,) = self.encode(clip["t5"], text, clip_skip)
                 t5_ctx = ct5["context"]
+            # The sequence-concat requires the CLIP joint padded to the T5
+            # width — 4096 for the real t5xxl, derived so resized towers
+            # compose.
+            context_dim = t5_ctx.shape[-1] if t5_ctx is not None else 4096
+            present = cl if cl is not None else cg
+            batch, seq = present["penultimate"].shape[:2]
+            if cl is not None:
+                l_pen, l_pooled = cl["penultimate"], cl["pooled"]
+            else:
+                g_width = cg["penultimate"].shape[-1]
+                l_pen = jnp.zeros(
+                    (batch, seq,
+                     min(768, max(0, context_dim - g_width))),
+                    jnp.float32,
+                )
+                l_pooled = jnp.zeros((batch, 768), jnp.float32)
+            if cg is not None:
+                g_pen, g_pooled = cg["penultimate"], cg["pooled"]
+            else:
+                g_pen = jnp.zeros((batch, seq, 0), jnp.float32)
+                g_pooled = jnp.zeros((batch, 1280), jnp.float32)
             context, y = sd3_text_conditioning(
-                cl["penultimate"], cg["penultimate"],
-                cl["pooled"], cg["pooled"], t5_ctx,
-                # The sequence-concat requires the CLIP joint padded to the
-                # T5 width — 4096 for the real t5xxl, derived so resized
-                # towers compose.
-                context_dim=t5_ctx.shape[-1] if t5_ctx is not None else 4096,
+                l_pen, g_pen, l_pooled, g_pooled, t5_ctx,
+                context_dim=context_dim,
             )
             return ({"context": context, "penultimate": None, "pooled": y},)
         if ctype == "flux-dual":

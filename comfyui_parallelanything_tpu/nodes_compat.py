@@ -324,26 +324,39 @@ class DualCLIPLoader:
                     "tokenizer_error": None,
                 },
             )
-        # type == "sd3": the two-tower form of the SD3 conditioning (CLIP-L +
-        # OpenCLIP-G, no T5 — sd3_text_conditioning pads L⊕G to 4096 and skips
-        # the T5 stream). Stock positional convention is (clip_l, clip_g); a
-        # "clip_g"-marked file in slot 1 corrects swapped wiring.
-        n1 = os.path.basename(clip_name1).lower()
-        n2 = os.path.basename(clip_name2).lower()
-        swapped = ("clip_g" in n1 or "clipg" in n1) and not (
-            "clip_g" in n2 or "clipg" in n2
-        )
-        l_name = clip_name2 if swapped else clip_name1
-        g_name = clip_name1 if swapped else clip_name2
-        return (
-            {
-                "type": "sd3-triple",
-                "l": clip_wire(l_name, "clip-l"),
-                "g": clip_wire(g_name, "open-clip-g"),
-                "t5": None,
-                "tokenizer_error": None,
-            },
-        )
+        # type == "sd3": the two-tower form of the SD3 conditioning. Stock
+        # detects which two of {clip_l, clip_g, t5xxl} were supplied from the
+        # state dicts themselves, so the common clip_l+t5xxl / clip_g+t5xxl
+        # pairings load correctly — classify both files (name markers, then
+        # safetensors key signature) and leave the absent tower None; the
+        # encode path zero-fills it like stock's SD3 CLIP. Files that defy
+        # classification fall back to the positional (clip_l, clip_g)
+        # convention, one per free CLIP slot.
+        kinds = []
+        for name in (clip_name1, clip_name2):
+            path = resolve_model_file(name, "clip", "text_encoders")
+            kinds.append(_classify_text_tower(name, path))
+        if kinds[0] is not None and kinds[0] == kinds[1]:
+            raise ValueError(
+                f"DualCLIPLoader type=sd3 got two {kinds[0]} files "
+                f"({clip_name1!r} and {clip_name2!r}); it needs two "
+                "DIFFERENT towers of clip_l/clip_g/t5xxl"
+            )
+        for slot in ("clip-l", "open-clip-g"):
+            if slot not in kinds and None in kinds:
+                kinds[kinds.index(None)] = slot
+        towers = dict(zip(kinds, (clip_name1, clip_name2)))
+        wire_of = {
+            "clip-l": ("l", "clip-l"),
+            "open-clip-g": ("g", "open-clip-g"),
+            "t5": ("t5", "t5"),
+        }
+        out = {"type": "sd3-triple", "l": None, "g": None, "t5": None,
+               "tokenizer_error": None}
+        for kind, name in towers.items():
+            key, encoder_type = wire_of[kind]
+            out[key] = clip_wire(name, encoder_type)
+        return (out,)
 
 
 class CLIPLoader:
@@ -2270,8 +2283,11 @@ class LatentRotate:
 
 class LatentCrop:
     """Stock latent crop: pixel-space (width, height, x, y) → an 8×-downsampled
-    latent window, clamped so the crop stays inside the latent like stock's
-    boundary adjustment (the window slides back instead of shrinking)."""
+    latent window with stock's exact boundary rule: the origin clamps to
+    (dim − 8) in latent units and the slice then truncates at the latent's
+    edge — an oversized or out-of-range window therefore yields a
+    smaller-than-requested latent, exactly as the stock node does (it never
+    slides the window back to preserve the requested size)."""
 
     DESCRIPTION = "Stock-name latent crop (pixel coords, /8 latent grid)."
     RETURN_TYPES = ("LATENT",)
@@ -2294,10 +2310,14 @@ class LatentCrop:
     def crop(self, samples, width: int, height: int, x: int, y: int):
         lat = samples["samples"]
         H, W = lat.shape[-3], lat.shape[-2]
-        h = max(1, min(int(height) // 8, H))
-        w = max(1, min(int(width) // 8, W))
-        y0 = min(int(y) // 8, H - h)
-        x0 = min(int(x) // 8, W - w)
+        # Stock boundary rule: clamp the origin to (dim − 8) latent units,
+        # then let the slice truncate (smaller-than-requested output near the
+        # edge). The extra max(…, 0) keeps sub-64px latents slicing from 0
+        # instead of a negative index.
+        y0 = min(int(y) // 8, max(H - 8, 0))
+        x0 = min(int(x) // 8, max(W - 8, 0))
+        h = max(1, int(height) // 8)
+        w = max(1, int(width) // 8)
 
         def window(a):
             return a[..., y0:y0 + h, x0:x0 + w, :]
@@ -2309,8 +2329,11 @@ class SaveLatent:
     """Stock latent save: a safetensors file holding ``latent_tensor`` plus
     the ``latent_format_version_0`` marker (stock's un-scaled format signal;
     LoadLatent applies the legacy 1/0.18215 rescale only when it is absent).
-    Saved under $PA_OUTPUT_DIR via the same counter/prefix rules as
-    SaveImage."""
+    The file stores the public stock layout — channels-first NCHW (NCTHW for
+    video latents) — so dumps interchange with the stock host; this
+    framework's channels-last axes transpose at the file boundary, the same
+    contract the checkpoint converters keep for single-file layouts. Saved
+    under $PA_OUTPUT_DIR via the same counter/prefix rules as SaveImage."""
 
     DESCRIPTION = "Stock-name latent save (safetensors)."
     RETURN_TYPES = ()
@@ -2335,11 +2358,15 @@ class SaveLatent:
             filename_prefix, suffix="latent"
         )
         path = os.path.join(target_dir, f"{name}_{idx:05}.latent")
+        # Channels-last (..., H, W, C) → the stock file's channels-first
+        # (..., C, H, W): axis -1 moves to position 1 for any latent rank
+        # (NHWC image and NTHWC video alike).
+        arr = _np.moveaxis(
+            _np.asarray(samples["samples"], dtype=_np.float32), -1, 1
+        )
         save_file(
             {
-                "latent_tensor": _np.asarray(
-                    samples["samples"], dtype=_np.float32
-                ),
+                "latent_tensor": arr,
                 "latent_format_version_0": _np.zeros((0,), _np.float32),
             },
             path,
@@ -2348,9 +2375,12 @@ class SaveLatent:
 
 
 class LoadLatent:
-    """Stock latent load: reads a SaveLatent file from $PA_INPUT_DIR. Files
-    without the ``latent_format_version_0`` marker are stock's legacy dumps,
-    stored pre-scaled — multiply by 1/0.18215 to recover latent space."""
+    """Stock latent load: reads a SaveLatent file from $PA_INPUT_DIR. The
+    file holds the stock channels-first layout (NCHW/NCTHW) — axis 1 moves
+    back to -1 on read, the inverse of SaveLatent's boundary transpose.
+    Files without the ``latent_format_version_0`` marker are stock's legacy
+    dumps, stored pre-scaled — multiply by 1/0.18215 to recover latent
+    space."""
 
     DESCRIPTION = "Stock-name latent load (safetensors)."
     RETURN_TYPES = ("LATENT",)
@@ -2376,7 +2406,9 @@ class LoadLatent:
             raise ValueError(
                 f"{path} is not a saved latent (no latent_tensor key)"
             )
-        arr = jnp.asarray(sd["latent_tensor"], jnp.float32)
+        # Stock channels-first file → this framework's channels-last latents;
+        # the legacy 1/0.18215 dumps are stored in the same NCHW layout.
+        arr = jnp.moveaxis(jnp.asarray(sd["latent_tensor"], jnp.float32), 1, -1)
         if "latent_format_version_0" not in sd:
             arr = arr * (1.0 / 0.18215)
         return ({"samples": arr},)

@@ -19,6 +19,7 @@ from .mesh import (
 )
 from .sequence import sequence_parallel_attention
 from .pipeline import PipelineRunner, build_pipeline_runner
+from .streaming import StreamingRunner, build_streaming_runner
 from .multihost import (
     initialize_distributed,
     is_multihost,
@@ -30,6 +31,8 @@ __all__ = [
     "sequence_parallel_attention",
     "PipelineRunner",
     "build_pipeline_runner",
+    "StreamingRunner",
+    "build_streaming_runner",
     "fsdp_spec",
     "place_params",
     "place_params_fsdp",

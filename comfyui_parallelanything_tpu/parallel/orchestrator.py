@@ -164,8 +164,11 @@ class ParallelConfig:
     ``purge_cache`` / ``purge_models`` — cleanup aggressiveness at teardown (901-908)
     ``pad_small_batches``  — see "documented divergences" in the module docstring
     ``weight_sharding``    — "replicate" (reference parity: full model per device,
-        README.md:167) or "fsdp" (shard each weight over the data axis; required
-        when the model doesn't fit one chip — e.g. FLUX-dev bf16 on v5e)
+        README.md:167), "fsdp" (shard each weight over the data axis; required
+        when the model doesn't fit one chip — e.g. FLUX-dev bf16 on v5e), or
+        "stream" (weights stay host-pinned and stream through the lead device
+        double-buffered — parallel/streaming.py; the single-chip answer when
+        even 1/N of the sharded model, or a chip to shard over, is missing)
     ``tensor_parallel``    — size of the ``model`` mesh axis; >1 builds a 2-D
         (data × model) mesh per group and shards weights over ``model`` so XLA
         partitions the matmuls themselves (GSPMD TP). Must divide each group's
@@ -189,6 +192,16 @@ class ParallelConfig:
     # stop permanently serializing a long run). On a failed attempt the counter
     # restarts, giving exponential-free periodic retry.
     reactivate_after: int | None = None
+    # Weight-streaming knobs (weight_sharding="stream", or the automatic
+    # weights-don't-fit routing in parallelize):
+    # ``hbm_budget_bytes`` — device HBM budget the placement decision and the
+    #   stage carve use; None reads devices.memory.usable_hbm_bytes (the
+    #   PA_HBM_BUDGET_BYTES override, else 90% of reported capacity). On
+    #   backends reporting no memory (CPU tests) pass it explicitly.
+    # ``stream_overlap`` — False serializes every transfer/compute (the
+    #   streaming debug mode; parallel/streaming.py module docstring).
+    hbm_budget_bytes: int | None = None
+    stream_overlap: bool = True
     # >1 enables GPipe-style THROUGHPUT pipelining for batch>1 (beyond the
     # reference, whose pipeline mode is batch==1 layer placement only, SURVEY
     # §2e): the batch splits into this many microbatches streamed through the
@@ -285,11 +298,20 @@ class ParallelModel:
         pipeline_spec: Any = None,
         model_config: Any = None,
         sampler_prefs: dict | None = None,
+        streaming: bool = False,
     ):
         self._apply = apply_fn
         self._host_params = params
         self.chain = chain
         self.config = config
+        # Weight-streaming mode (weights-don't-fit routing or an explicit
+        # weight_sharding="stream"): groups hold NO placed params; every call
+        # routes through the double-buffered StreamingRunner on the lead
+        # device (parallel/streaming.py) and the full pytree never exists in
+        # HBM — so neither the lead-copy fallback nor whole-loop compilation
+        # may ever materialize it.
+        self._stream = bool(streaming)
+        self._stream_runner: Any = None
         # The wrapped model's own config (FluxConfig/UNetConfig/...), distinct from
         # the ParallelConfig above — pipelines read patch_size etc. through this.
         self.model_config = model_config
@@ -332,6 +354,12 @@ class ParallelModel:
     def n_devices(self) -> int:
         return sum(len(g.devices) for g in self._groups)
 
+    @property
+    def is_streaming(self) -> bool:
+        """True when this model executes via the weight-streaming runner
+        (weights host-pinned, double-buffered through the lead device)."""
+        return self._stream
+
     # -- compiled-apply cache ------------------------------------------------------
 
     def _jit_for(self, static: Mapping[str, Any]) -> Callable:
@@ -366,6 +394,12 @@ class ParallelModel:
     def __call__(self, x, timesteps, context=None, **kwargs):
         from ..ops.attention import sequence_ctx_key
 
+        if self._stream:
+            # Weight streaming is the ONLY placement that fits — every batch
+            # size, every path (the demote/single fallbacks below would
+            # re-materialize the full pytree on one chip, the thing that
+            # cannot exist).
+            return self._stream_call(x, timesteps, context, kwargs)
         if not self.active:
             ra = self.config.reactivate_after
             if (
@@ -445,6 +479,53 @@ class ParallelModel:
             self._demote()
             return self.single(x, timesteps, context, **kwargs)
 
+    def _get_streaming_runner(self):
+        """Build the weight-streaming runner on first use (placing the
+        resident prepare/finalize params costs device memory, same laziness
+        argument as _get_pipeline_runner)."""
+        if self._stream_runner is None:
+            from ..devices.memory import usable_hbm_bytes
+            from .streaming import build_streaming_runner
+
+            budget = self.config.hbm_budget_bytes
+            if not budget:
+                budget = usable_hbm_bytes(self.lead_device) or None
+            self._stream_runner = build_streaming_runner(
+                self._pipeline_spec, self._host_params, self.lead_device,
+                hbm_budget_bytes=budget, overlap=self.config.stream_overlap,
+            )
+            if self._stream_runner is None:
+                raise ValueError(
+                    "weight streaming requires a model with a PipelineSpec "
+                    "(the staged decomposition the stream is carved from); "
+                    "this model declares none"
+                )
+        return self._stream_runner
+
+    def _stream_call(self, x, timesteps, context, kwargs):
+        """Streamed execution with the stream-mode OOM demotion: a
+        RESOURCE_EXHAUSTED re-carves the schedule at half the stage size and
+        retries (deterministic for a given shape, like every XLA OOM — see
+        the module docstring's demotion note), until stages bottom out at
+        one segment each."""
+        while True:
+            runner = self._get_streaming_runner()
+            try:
+                return runner(x, timesteps, context, **kwargs)
+            except Exception as e:  # noqa: BLE001 — OOM demotion, stream form
+                if not _is_resource_exhausted(e):
+                    raise
+                deeper = runner.recarved()
+                if deeper is None:
+                    raise
+                log_degradation(
+                    "stream-oom",
+                    f"{type(e).__name__}; re-carving weight stream "
+                    f"{runner.n_stages} → {deeper.n_stages} stages",
+                )
+                aggressive_cleanup(clear_compile_cache=False)
+                self._stream_runner = deeper
+
     def _pipeline_microbatch(self, runner, mb, batch, x, timesteps, context, kwargs):
         """GPipe-style throughput pipelining over the stage chain.
 
@@ -488,6 +569,10 @@ class ParallelModel:
     # The reference keeps ``_original_forward`` callable on the lead device
     # (1380-1383); ``single`` is that escape hatch.
     def single(self, x, timesteps, context=None, **kwargs):
+        # Streaming premise: the full pytree does not fit ANY single chip —
+        # the escape hatch is the streamed schedule itself, never a lead copy.
+        if self._stream:
+            return self._stream_call(x, timesteps, context, kwargs)
         # FSDP/TP premise: the full pytree does NOT fit one chip, so the fallback
         # cannot be a lead-device copy. Run over the group mesh with inputs
         # replicated instead — params stay 1/N per chip, XLA gathers per-use.
@@ -594,6 +679,12 @@ class ParallelModel:
         from ..ops.attention import sequence_ctx_key
         from ..sampling.compiled import TraceSpec
 
+        if self._stream:
+            # One XLA program would close over the FULL weight pytree — the
+            # exact allocation streaming exists to avoid. The sampler loop
+            # stays eager and drives the per-stage programs each step
+            # (sampling/runner.py logs the fallback).
+            return None
         if sequence_ctx_key() is not None:
             return None
         if len(self._groups) != 1:
@@ -647,6 +738,11 @@ class ParallelModel:
         placement failure on a later group rolls back the groups placed in
         THIS attempt, so a failed retry never leaves extra replicas pinned
         through the (memory-pressured) demoted period."""
+        if self._stream:
+            # Stream mode never demotes (OOM re-carves the schedule instead)
+            # and a group placement would materialize the full pytree — the
+            # allocation that cannot exist. No-op.
+            return
         self._steps_demoted = 0
         placed_now: list = []
         try:
@@ -727,6 +823,7 @@ class ParallelModel:
             g.params = None
         self._lead_params = None
         self._pipeline_runner = None
+        self._stream_runner = None
         self._jits.clear()
         if self.config.purge_cache:
             aggressive_cleanup(clear_compile_cache=self.config.purge_models)
@@ -841,9 +938,46 @@ def parallelize(
                 )
             )
 
+    # Weights-don't-fit routing rung (VERDICT r5 next-1): a replicate-mode
+    # model whose pytree exceeds the lead device's HBM budget cannot place —
+    # on hardware the loop below would OOM deterministically, burn the
+    # degradation ladder chip by chip, and still fail on the last one. When
+    # the model declares the PipelineSpec staging, route to the
+    # weight-streaming executor instead: params stay host-pinned and stream
+    # double-buffered through the lead device (parallel/streaming.py).
+    stream_mode = config.weight_sharding == "stream"
+    if stream_mode and pipeline_spec is None:
+        raise ValueError(
+            "weight_sharding='stream' requires a model with a PipelineSpec "
+            "(the staged decomposition the stream is carved from)"
+        )
+    if stream_mode and config.tensor_parallel > 1:
+        raise ValueError("weight_sharding='stream' does not compose with "
+                         "tensor_parallel")
+    if (
+        not stream_mode
+        and config.weight_sharding == "replicate"
+        and config.tensor_parallel <= 1
+        and pipeline_spec is not None
+    ):
+        from ..devices.memory import usable_hbm_bytes
+        from ..models.loader import params_nbytes
+
+        budget = config.hbm_budget_bytes or usable_hbm_bytes(devices[0])
+        total = params_nbytes(params)
+        if budget and total > budget:
+            log_degradation(
+                "weights-dont-fit",
+                f"{total / 2**30:.2f} GiB of weights vs {budget / 2**30:.2f} "
+                "GiB HBM budget; routing to the weight-streaming executor",
+            )
+            stream_mode = True
+
     # Place params on each group's mesh, degrading on OOM: drop the last chain device
     # and retry (reference drops the failing device and renormalizes, 1114-1128).
-    while True:
+    # Stream mode skips placement entirely — groups carry no params and the
+    # lazily-built StreamingRunner owns all device residency.
+    while not stream_mode:
         try:
             for g in groups:
                 if g.params is None:
@@ -879,7 +1013,12 @@ def parallelize(
         tuple(DeviceLink(s, w * 100.0) for (s, _), w in zip(surviving, final_weights))
     )
 
-    mode = "spmd" if len(groups) == 1 else "hybrid"
+    if stream_mode:
+        mode = "stream"
+    elif len(groups) == 1:
+        mode = "spmd"
+    else:
+        mode = "hybrid"
     log_setup_summary(chain.devices, final_weights, mode)
 
     return ParallelModel(
@@ -892,4 +1031,5 @@ def parallelize(
         pipeline_spec=pipeline_spec,
         model_config=wrapped_config,
         sampler_prefs=sampler_prefs,
+        streaming=stream_mode,
     )

@@ -77,10 +77,17 @@ _plain_callable_specs: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 def trace_spec_of(model) -> TraceSpec | None:
     """A TraceSpec for ``model``, or None when it cannot run as one program.
 
-    ParallelModel exposes ``.traceable()`` (None for hybrid chains / active
-    sequence-parallel contexts); DiffusionModel / ``(apply, params)`` are pure
-    by construction; a bare callable is *assumed* pure — the documented
-    contract of ``compile_loop=True``."""
+    ParallelModel exposes ``.traceable()`` (None for hybrid chains, active
+    sequence-parallel contexts, and weight-streaming mode — a streamed
+    model's full pytree must never be closed over by one program);
+    DiffusionModel / ``(apply, params)`` are pure by construction; a bare
+    callable is *assumed* pure — the documented contract of
+    ``compile_loop=True``."""
+    if getattr(model, "is_streaming", False):
+        # Belt-and-braces for streaming wrappers that also quack
+        # .apply/.params: the duck-typed branches below would trace the FULL
+        # host pytree into the loop program and materialize it on-device.
+        return None
     traceable = getattr(model, "traceable", None)
     if callable(traceable):
         return traceable()

@@ -36,6 +36,17 @@ def _compiled_spec(model, callback):
             "compile_loop: user callback cannot trace into the loop; eager path"
         )
         return None
+    if getattr(model, "is_streaming", False):
+        # Weight-streaming models can never be one XLA program (the program
+        # would close over the full weight pytree — the allocation streaming
+        # exists to avoid). The eager loop is not a degradation here: each
+        # denoise step drives the double-buffered per-stage programs
+        # (parallel/streaming.py), so streaming survives the full sampler.
+        get_logger().info(
+            "compile_loop: weight-streaming model — per-stage programs run "
+            "inside the eager denoise loop instead"
+        )
+        return None
     spec = trace_spec_of(model)
     if spec is None:
         get_logger().info(

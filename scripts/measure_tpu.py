@@ -27,7 +27,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # Ascending cost so a mid-ladder tunnel flap still banks the cheap rungs.
 LADDER = (
     "smoke", "sd15_16", "sdxl_8", "hybrid_sd15", "zimage_21", "flux_16",
-    "flux_16_int8", "wan_video",
+    "flux_16_int8", "flux_stream", "wan_video",
 )
 
 
@@ -58,7 +58,9 @@ def run_rung(rung: str, timeout: int = 3200, extra_env: dict | None = None) -> d
     if line is not None:
         rec = json.loads(line)
         rec["rung"] = rung
-        if rec.get("platform") not in _TPU_PLATFORMS and rung != "smoke":
+        if (
+            rec.get("platform") not in _TPU_PLATFORMS or rec.get("stale")
+        ) and rung != "smoke":
             # A CPU-fallback line on a TPU-sized rung means the TPU child died
             # (smoke is CPU by definition) — keep its traceback
             # (bench.py forwards the inner stderr tail) or the whole window's
@@ -76,10 +78,16 @@ def run_rung(rung: str, timeout: int = 3200, extra_env: dict | None = None) -> d
 def record_result(rec: dict) -> dict:
     """Stamp and append one rung result to ``BASELINE_measured.json`` — the one
     writer for the evidence file (measure_tpu CLI and tpu_watchdog both go
-    through here so the record format cannot drift)."""
+    through here so the record format cannot drift). Stale lines (bench.py
+    re-emitting ALREADY-banked evidence after a failed fresh attempt) flow
+    back to the caller unwritten: re-appending them would duplicate the
+    original record under a fresh timestamp and corrupt every
+    most-recent-banked query."""
     from bench import evidence_dir
 
     rec["ts"] = time.time()
+    if rec.get("stale"):
+        return rec
     with open(os.path.join(evidence_dir(), "BASELINE_measured.json"), "a") as f:
         f.write(json.dumps(rec) + "\n")
     return rec

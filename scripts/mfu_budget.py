@@ -131,6 +131,29 @@ def walk(jaxpr, acc, seq_lens):
             acc["_by_prim"][name][1] += byts
 
 
+def analytic_flops(apply, params, x, t, ctx, kwargs=None):
+    """Total model FLOPs of ONE forward step from the exact jaxpr walk —
+    bench.py's fallback when XLA HLO cost analysis returns nothing (VERDICT
+    r5 next-6: zimage_21_int8 banked ``mfu: null``; observed on the
+    QuantTensor int8 rungs). Sums every op class; elementwise FLOPs are the
+    output-element count, a rounding error next to the matmuls. Pure tracing —
+    nothing executes, CPU-safe."""
+    import jax as _jax
+
+    kw = dict(kwargs or {})
+    jaxpr = _jax.make_jaxpr(
+        lambda p, x_, t_, c_: apply(p, x_, t_, c_, **kw)
+    )(params, x, t, ctx)
+    acc = {
+        c: {"flops": 0, "flops_padded": 0, "bytes": 0, "count": 0}
+        for c in ("conv", "matmul", "attention", "elementwise")
+    }
+    walk(jaxpr.jaxpr, acc, set())
+    acc.pop("_by_prim", None)
+    total = float(sum(c["flops"] for c in acc.values()))
+    return total if total > 0 else None
+
+
 def main():
     global jax
     import jax

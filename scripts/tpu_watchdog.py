@@ -41,7 +41,11 @@ sys.path.insert(0, _REPO)
 # bench.py owns the platform tuple and evidence-dir override (PA_FAKE_TPU_PLATFORM
 # / PA_EVIDENCE_DIR enable the mocked end-to-end dry-run the round-3 window
 # showed this pipeline needs before it runs unattended on hardware).
-from bench import _TPU_PLATFORMS as _TPU, evidence_dir  # noqa: E402
+from bench import (  # noqa: E402
+    _TPU_PLATFORMS as _TPU,
+    evidence_dir,
+    is_banked_tpu_record as _is_fresh,
+)
 
 # Highest-value first: the README-repro rung carries the vs_baseline headline
 # (reference 26.00 s/it, /root/reference/README.md:54-56). hybrid_sd15 (the
@@ -49,7 +53,7 @@ from bench import _TPU_PLATFORMS as _TPU, evidence_dir  # noqa: E402
 # after the headline trio: cheap enough for a modest window, less valuable
 # than the README repro.
 RUNGS = ("zimage_21", "zimage_21_int8", "sd15_16", "sdxl_8", "hybrid_sd15",
-         "flux_16_int8", "flux_16", "wan_video")
+         "flux_16_int8", "flux_stream", "flux_16", "wan_video")
 
 def _attemptable(rung: str) -> bool:
     # Every rung survives a forced non-pallas run: the "xla" backend family
@@ -77,6 +81,10 @@ _MB_LADDERS: dict[str, tuple[int, ...]] = {
     "zimage_21": (3, 7, 21),
     "zimage_21_int8": (3, 7, 21),
     "flux_16_int8": (4, 8, 16),
+    # flux_stream OOMs re-carve stage size internally (orchestrator
+    # stream-oom demotion) before the microbatch ladder matters; the ladder
+    # is the second lever when activations, not weights, are the peak.
+    "flux_stream": (4, 8, 16),
     "flux_16": (1, 2, 4, 8),
     "sd15_16": (1, 2, 4),
     "sdxl_8": (1, 2, 4),
@@ -246,7 +254,7 @@ def _tpu_records(filename: str):
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if rec.get("platform") in _TPU and not rec.get("invalid"):
+            if _is_fresh(rec):
                 yield rec
 
 
@@ -337,7 +345,7 @@ def _chunk_sweep_state() -> tuple[dict[str, dict], dict[str, int]]:
                 except json.JSONDecodeError:
                     continue
                 key = _combo_key(rec.get("attn_env", {}))
-                if rec.get("platform") in _TPU and not rec.get("invalid"):
+                if _is_fresh(rec):
                     done[key] = rec
                 else:
                     fails[key] = fails.get(key, 0) + 1
@@ -365,7 +373,7 @@ def _run_chunk_sweep() -> None:
             rec["ts"] = time.time()
             with open(sweep_path, "a") as f:
                 f.write(json.dumps(rec) + "\n")
-            if rec.get("platform") in _TPU:
+            if _is_fresh(rec):
                 _log(f"chunk sweep {combo or 'default'}: {rec['value']} s/it")
             else:
                 _log(f"chunk sweep {combo or 'default'} failed "
@@ -407,7 +415,7 @@ def _run_chunk_sweep() -> None:
     # Shipping-config confirmation under the persisted table (also the resume
     # point when a previous window banked the table but lost this run).
     rec = record_result(run_rung(_CHUNK_SWEEP_RUNG, extra_env=mb))
-    if rec.get("platform") in _TPU:
+    if _is_fresh(rec):
         _run_script("render_measured.py", timeout=120)
     else:
         _log("chunk sweep confirmation run failed; retries next window")
@@ -457,7 +465,9 @@ def bank_one() -> bool:
                                                   RUNGS.index(r))):
         _log(f"running rung {rung}")
         rec = record_result(run_rung(rung, extra_env=_rung_env(rung)))
-        ok = rec.get("platform") in _TPU
+        # One shared predicate (bench.is_banked_tpu_record): a stale re-emit
+        # is old banked evidence, never a fresh measurement.
+        ok = _is_fresh(rec)
         if ok:
             _run_script("render_measured.py", timeout=120)
         elif _looks_oom(rec) and _deepen(rung):
@@ -492,7 +502,9 @@ def bank_one() -> bool:
     for rung in stale_after_tuning():
         _log(f"re-running rung {rung} under the measured tuning table")
         rec = record_result(run_rung(rung, extra_env=_rung_env(rung)))
-        ok = rec.get("platform") in _TPU
+        # One shared predicate (bench.is_banked_tpu_record): a stale re-emit
+        # is old banked evidence, never a fresh measurement.
+        ok = _is_fresh(rec)
         if ok:
             _run_script("render_measured.py", timeout=120)
         else:

@@ -87,6 +87,23 @@ class TestMakeStep:
         # models by reading the rung declarations.
         assert 21 % 3 == 0 and 16 % 4 == 0
 
+    def test_flux_stream_rung_registered(self):
+        # The weight-streaming flagship rung (weights exceed usable HBM —
+        # the round-5 finding that left the north-star blank) must be a real
+        # rung the watchdog knows.
+        assert "flux_stream" in bench._RUNGS
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "tpu_watchdog_mod2",
+            os.path.join(os.path.dirname(bench.__file__), "scripts",
+                         "tpu_watchdog.py"),
+        )
+        wd = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(wd)
+        assert "flux_stream" in wd.RUNGS
+
     def test_zimage_int8_fallback_rung_registered(self):
         # The int8-weight headline fallback (bf16 zimage_21 exceeds the
         # tunnel chip's usable HBM even fully sequential — BASELINE_measured
@@ -105,3 +122,123 @@ class TestMakeStep:
         spec.loader.exec_module(wd)
         assert "zimage_21_int8" in wd.RUNGS
         assert wd._MB_LADDERS["zimage_21_int8"][0] == 3
+
+
+def test_flux_stream_rung_rehearsed_off_hardware(tmp_path):
+    """The flux_stream run path end to end in a subprocess — tiny workload,
+    fake evidence dir, small stream budget so the carve produces real stages
+    (the round-3 lesson: never let a code path execute first on an unattended
+    live tunnel). Must emit exactly one JSON line with the streaming rung's
+    label, the microbatched step, and non-null FLOPs wiring."""
+    import json
+    import os
+    import re
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    ).strip()
+    env["PA_BENCH_TINY"] = "1"
+    env["PA_EVIDENCE_DIR"] = str(tmp_path)
+    env["PA_STREAM_HBM_BUDGET"] = "400000"  # tiny → forces a multi-stage carve
+    env["BENCH_CONFIG"] = "flux_stream"
+    repo = os.path.dirname(bench.__file__)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--inner"],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "sec/it denoise step [flux_stream]"
+    assert rec["model_flops_per_step"], "MFU wiring must be non-null"
+    assert rec["microbatch_chunks"] == 2  # tiny rungs declare 2 chunks
+    assert rec["dryrun"] is True
+    # The streaming executor actually served the run (stderr carries the
+    # placement log with the stage count).
+    assert "weight streaming enabled" in proc.stderr
+
+
+class TestStaleRecordFallback:
+    """bench.py's wedged-tunnel fallback (VERDICT r5 weak-1/next-4): when no
+    fresh TPU run is possible, the most recent banked TPU record re-emits
+    with ``"stale": true`` + its capture timestamp instead of a meaningless
+    CPU smoke — still exactly one JSON line."""
+
+    def _seed(self, tmp_path, records):
+        import json
+        import os
+
+        path = os.path.join(str(tmp_path), "BASELINE_measured.json")
+        with open(path, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+        return path
+
+    def test_stale_record_selection(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PA_EVIDENCE_DIR", str(tmp_path))
+        self._seed(tmp_path, [
+            {"rung": "sd15_16", "platform": "tpu", "value": 2.6, "ts": 10.0},
+            {"rung": "sd15_16", "platform": "tpu", "value": 2.5, "ts": 20.0},
+            {"rung": "sdxl_8", "platform": "tpu", "value": 0.6, "ts": 30.0},
+            # Never eligible: invalid, dryrun, already-stale, CPU records.
+            {"rung": "sd15_16", "platform": "tpu", "value": 0.1, "ts": 40.0,
+             "invalid": "timing artifact"},
+            {"rung": "zimage_21", "platform": "tpu", "value": 1.0, "ts": 50.0,
+             "dryrun": True},
+            {"rung": "sd15_16", "platform": "tpu", "value": 9.9, "ts": 60.0,
+             "stale": True},
+            {"rung": "smoke", "platform": "cpu", "value": 5.0, "ts": 70.0},
+        ])
+        # Requested rung wins over globally-newer other-rung records.
+        rec = bench._stale_tpu_record("sd15_16")
+        assert rec["value"] == 2.5 and rec["ts"] == 20.0
+        # No record for the requested rung → most recent valid TPU record.
+        rec = bench._stale_tpu_record("wan_video")
+        assert rec["rung"] == "sdxl_8"
+        # Nothing banked at all → None (the CPU smoke remains the fallback).
+        monkeypatch.setenv("PA_EVIDENCE_DIR", str(tmp_path / "empty"))
+        assert bench._stale_tpu_record("sd15_16") is None
+
+    def test_orchestrate_emits_stale_line_when_probe_fails(self, tmp_path):
+        """Full outer bench.py run in a CPU-only env: the probe reports
+        not-TPU, and the banked record re-emits as ONE stale JSON line —
+        without ever building a model (fast)."""
+        import json
+        import os
+        import re
+        import subprocess
+        import sys
+
+        self._seed(tmp_path, [
+            {"metric": "sec/it denoise step [sd15_16]", "rung": "sd15_16",
+             "platform": "tpu", "value": 2.57, "unit": "s/it", "ts": 123.0},
+        ])
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            env.get("XLA_FLAGS", ""),
+        ).strip()
+        env["PA_EVIDENCE_DIR"] = str(tmp_path)
+        env["BENCH_CONFIG"] = "sd15_16"
+        repo = os.path.dirname(bench.__file__)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py")],
+            env=env, cwd=repo, capture_output=True, text=True, timeout=420,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+        assert len(lines) == 1, f"exactly one JSON line required: {lines}"
+        rec = json.loads(lines[0])
+        assert rec["stale"] is True
+        assert rec["platform"] == "tpu" and rec["value"] == 2.57
+        assert rec["captured_ts"] == 123.0
+        assert "stale_reason" in rec

@@ -2349,6 +2349,54 @@ class TestTripleCLIPLoader:
         assert cond["context"].shape == (1, 77, 4096)
         assert cond["pooled"].shape == (1, 128)
 
+    def test_dual_clip_loader_sd3_clip_plus_t5_pairings(self, tmp_path,
+                                                        monkeypatch):
+        """DualCLIPLoader(type=sd3) with the common clip+t5xxl pairings:
+        stock classifies the two files from their contents, so the T5 file
+        must land on the t5 slot (not mis-load as a CLIP tower) and the
+        missing CLIP tower zero-fills at encode."""
+        from comfyui_parallelanything_tpu.nodes import TPUTextEncode
+        from comfyui_parallelanything_tpu.nodes_compat import DualCLIPLoader
+
+        paths = _synthetic_sd3_towers(tmp_path, monkeypatch)
+        # clip_l + t5xxl (either order): g stays None.
+        (clip,) = DualCLIPLoader().load(paths["t5"], paths["l"], type="sd3")
+        assert clip["type"] == "sd3-triple"
+        assert clip["g"] is None
+        assert clip["l"] is not None and clip["t5"] is not None
+        (cond,) = TPUTextEncode().encode(clip, "a watercolor lighthouse")
+        # CLIP joint (L only, padded to the tiny T5's 128) ‖ T5 stream.
+        assert cond["context"].shape == (1, 154, 128)
+        # Missing G pooled zero-fills at the canonical 1280: 64 + 1280.
+        assert cond["pooled"].shape == (1, 1344)
+        assert float(np.abs(np.asarray(cond["pooled"][:, 64:])).max()) == 0.0
+        # The T5 half must be the live stream, not padding.
+        assert float(np.abs(np.asarray(cond["context"][:, 77:])).max()) > 0
+        # clip_g + t5xxl: l stays None, pooled = zeros(768) ⊕ G's 64.
+        (clip2,) = DualCLIPLoader().load(paths["g"], paths["t5"], type="sd3")
+        assert clip2["l"] is None and clip2["t5"] is not None
+        (cond2,) = TPUTextEncode().encode(clip2, "a watercolor lighthouse")
+        assert cond2["pooled"].shape == (1, 832)
+        assert float(np.abs(np.asarray(cond2["pooled"][:, :768])).max()) == 0.0
+        # ALIGNMENT: the missing L still occupies its LEADING joint slot as
+        # zeros (canonical 768, clamped to the tiny geometry: min(768,
+        # 128−64) = 64), so G's live features keep their trained offset
+        # instead of shifting to column 0.
+        assert cond2["context"].shape == (1, 154, 128)
+        clip_rows = np.asarray(cond2["context"][:, :77])
+        assert float(np.abs(clip_rows[..., :64]).max()) == 0.0
+        assert float(np.abs(clip_rows[..., 64:]).max()) > 0
+
+    def test_dual_clip_loader_sd3_duplicate_towers_raise(self, tmp_path,
+                                                         monkeypatch):
+        import pytest
+
+        from comfyui_parallelanything_tpu.nodes_compat import DualCLIPLoader
+
+        paths = _synthetic_sd3_towers(tmp_path, monkeypatch)
+        with pytest.raises(ValueError, match="two t5 files"):
+            DualCLIPLoader().load(paths["t5"], paths["t5"], type="sd3")
+
 
 class TestModelSamplingShiftPatches:
     def _model(self, prefs=None):
@@ -2521,17 +2569,27 @@ class TestLatentTransforms:
 
         from comfyui_parallelanything_tpu.nodes_compat import LatentCrop
 
-        x = jnp.arange(1 * 8 * 8 * 4, dtype=jnp.float32).reshape(1, 8, 8, 4)
+        x = jnp.arange(1 * 16 * 16 * 4, dtype=jnp.float32).reshape(1, 16, 16, 4)
         (c,) = LatentCrop().crop(self._lat(x), width=32, height=16, x=8, y=16)
         assert c["samples"].shape == (1, 2, 4, 4)
         np.testing.assert_array_equal(np.asarray(c["samples"]),
                                       np.asarray(x)[:, 2:4, 1:5])
-        # Out-of-range window slides back inside (stock boundary rule).
-        (c2,) = LatentCrop().crop(self._lat(x), width=32, height=32,
+        # Stock boundary rule: the origin clamps to (dim − 8) latent units and
+        # the slice truncates — an out-of-range window yields a
+        # smaller-than-requested latent anchored at the clamp, it does NOT
+        # slide back to preserve the requested size.
+        (c2,) = LatentCrop().crop(self._lat(x), width=96, height=96,
                                   x=512, y=512)
-        assert c2["samples"].shape == (1, 4, 4, 4)
+        assert c2["samples"].shape == (1, 8, 8, 4)
         np.testing.assert_array_equal(np.asarray(c2["samples"]),
-                                      np.asarray(x)[:, 4:, 4:])
+                                      np.asarray(x)[:, 8:, 8:])
+        # In-range origin with an oversized window: truncated, not shrunk to
+        # fit beforehand (requested 12 latent cols from col 8 of 16 → 8).
+        (c3,) = LatentCrop().crop(self._lat(x), width=96, height=16,
+                                  x=64, y=0)
+        assert c3["samples"].shape == (1, 2, 8, 4)
+        np.testing.assert_array_equal(np.asarray(c3["samples"]),
+                                      np.asarray(x)[:, 0:2, 8:])
 
     def test_save_load_round_trip_and_legacy_rescale(self, tmp_path,
                                                      monkeypatch):
@@ -2545,16 +2603,30 @@ class TestLatentTransforms:
 
         monkeypatch.setenv("PA_OUTPUT_DIR", str(tmp_path / "out"))
         monkeypatch.setenv("PA_INPUT_DIR", str(tmp_path / "out"))
-        x = jnp.linspace(-2, 2, 1 * 4 * 4 * 4).reshape(1, 4, 4, 4)
+        # Non-square + distinct channel count so a layout mix-up cannot hide.
+        x = jnp.linspace(-2, 2, 1 * 2 * 6 * 4).reshape(1, 2, 6, 4)
         ui = SaveLatent().save(self._lat(x), "latents/ComfyUI")
         fname = ui["ui"]["latents"][0]
+        # The FILE stores the public stock layout: channels-first NCHW.
+        from safetensors.numpy import load_file
+
+        on_disk = load_file(
+            str(tmp_path / "out" / "latents" / fname)
+        )
+        assert on_disk["latent_tensor"].shape == (1, 4, 2, 6)
+        np.testing.assert_allclose(
+            on_disk["latent_tensor"],
+            np.moveaxis(np.asarray(x, np.float32), -1, 1), atol=1e-7,
+        )
         (lat,) = LoadLatent().load(os.path.join("latents", fname))
         np.testing.assert_allclose(np.asarray(lat["samples"]), np.asarray(x),
                                    atol=1e-7)
-        # Legacy (pre-version-marker) dumps are stored scaled by 0.18215.
+        # Legacy (pre-version-marker) dumps are stock files too — NCHW,
+        # stored scaled by 0.18215.
         legacy = tmp_path / "out" / "legacy.latent"
         save_file(
-            {"latent_tensor": np.asarray(x, np.float32) * 0.18215},
+            {"latent_tensor":
+             np.moveaxis(np.asarray(x, np.float32), -1, 1) * 0.18215},
             str(legacy),
         )
         (lat2,) = LoadLatent().load("legacy.latent")
