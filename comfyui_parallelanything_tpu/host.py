@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Any
 
 
@@ -57,11 +58,22 @@ class WorkflowCache:
     teardown firing when ComfyUI replaces a MODEL output
     (any_device_parallel.py:1459, 211-282) — without it, the cache would hold
     every superseded model's device placements alive indefinitely.
+
+    Concurrency (round 7, the multi-worker server): all mutation happens
+    under ``self.lock``, and executions run against a SNAPSHOT of the results
+    dict merged back via ``merge()`` when the run finishes — two prompts
+    executing at once can never observe each other's half-built state. The
+    remaining multi-tenant caveat is inherited from the ComfyUI cache design
+    itself: a prompt that invalidates a node (different checkpoint into the
+    same node id) tears down the incumbent even if a concurrent prompt's
+    snapshot still uses it — serving workloads share models by construction,
+    which is also what makes continuous batching worth having.
     """
 
     def __init__(self) -> None:
         self.results: dict[str, tuple] = {}
         self.signatures: dict[str, str] = {}
+        self.lock = threading.RLock()
 
     def evict(self, nid: str) -> None:
         """Drop one node's cached outputs, tearing down teardownable values
@@ -69,33 +81,69 @@ class WorkflowCache:
         ComfyUI MODEL pass-through)."""
         self.evict_stale({nid})
 
+    @staticmethod
+    def _teardown(value) -> None:
+        cleanup = getattr(value, "cleanup", None)
+        if callable(cleanup):
+            try:
+                cleanup()
+            except Exception:
+                pass
+
     def evict_stale(self, stale) -> None:
         """Drop every cached entry in ``stale``. A value is torn down only when
         NO surviving entry still holds the same object: pass-through nodes
         (e.g. a sampler returning the MODEL it received) share identity with
         their upstream, and tearing down via the stale downstream entry would
         gut the still-valid upstream cache."""
-        stale = set(stale)
-        keep_ids = {
-            id(v)
-            for nid, out in self.results.items()
-            if nid not in stale
-            for v in out
-        }
-        torn: set[int] = set()
-        for nid in stale:
-            out = self.results.pop(nid, None)
-            self.signatures.pop(nid, None)
-            for value in out or ():
-                if id(value) in keep_ids or id(value) in torn:
-                    continue
-                torn.add(id(value))
-                cleanup = getattr(value, "cleanup", None)
-                if callable(cleanup):
-                    try:
-                        cleanup()
-                    except Exception:
-                        pass
+        with self.lock:
+            stale = set(stale)
+            keep_ids = {
+                id(v)
+                for nid, out in self.results.items()
+                if nid not in stale
+                for v in out
+            }
+            torn: set[int] = set()
+            for nid in stale:
+                out = self.results.pop(nid, None)
+                self.signatures.pop(nid, None)
+                for value in out or ():
+                    if id(value) in keep_ids or id(value) in torn:
+                        continue
+                    torn.add(id(value))
+                    self._teardown(value)
+
+    def snapshot(self, sigs: dict[str, str]) -> dict[str, tuple]:
+        """Evict entries stale against this run's signatures and return a
+        consistent copy of the survivors for the run to execute against (one
+        lock hold — no other run's merge can interleave)."""
+        with self.lock:
+            self.evict_stale(
+                nid for nid in self.results
+                if nid not in sigs or self.signatures.get(nid) != sigs[nid]
+            )
+            return dict(self.results)
+
+    def merge(self, results: dict[str, tuple], sigs: dict[str, str]) -> None:
+        """Bank one run's (possibly partial — interrupts keep what completed)
+        outputs. A node another run already banked with the same signature
+        keeps the incumbent; our duplicate (a cold-start race computed the
+        same thing twice) is NOT torn down here — the caller's returned
+        ``results`` still references it, so destroying it would hand the
+        caller dead device buffers. It simply never enters the cache and is
+        reclaimed when the caller drops it (ParallelModel carries a GC
+        finalizer honoring the purge flags). A different-signature incumbent
+        is evicted with full teardown discipline before ours lands."""
+        with self.lock:
+            for nid, out in results.items():
+                prev = self.results.get(nid)
+                if prev is not None and self.signatures.get(nid) == sigs.get(nid):
+                    continue  # incumbent wins; our duplicate stays caller-owned
+                if prev is not None:
+                    self.evict_stale({nid})
+                self.results[nid] = out
+                self.signatures[nid] = sigs[nid]
 
 
 def _is_link(v: Any) -> bool:
@@ -181,9 +229,7 @@ def run_workflow(
     graph = {str(k): v for k, v in workflow.items()}
 
     cache = outputs if isinstance(outputs, WorkflowCache) else None
-    results: dict[str, tuple] = (
-        cache.results if cache is not None else dict(outputs or {})
-    )
+    results: dict[str, tuple] = {} if cache is not None else dict(outputs or {})
 
     def node_class(nid: str) -> tuple[dict, type]:
         spec = graph.get(nid)
@@ -284,11 +330,10 @@ def run_workflow(
 
     if cache is not None:
         sigs = compute_signatures()
-        cache.evict_stale(
-            nid
-            for nid in cache.results
-            if nid not in graph or cache.signatures.get(nid) != sigs[nid]
-        )
+        # Evict-and-copy under one lock hold: this run executes against its
+        # own consistent snapshot; concurrent runs (the multi-worker server)
+        # merge back at completion instead of mutating shared state mid-run.
+        results = cache.snapshot(sigs)
     if on_cached is not None:
         cached = sorted(nid for nid in graph if nid in results)
         if cached:
@@ -338,10 +383,18 @@ def run_workflow(
             out = (out,)
         results[nid] = out
 
-    for nid in graph:
-        postorder(nid, results.__contains__, exec_visit)
-    if cache is not None:
-        cache.signatures.update(sigs)
+    try:
+        for nid in graph:
+            postorder(nid, results.__contains__, exec_visit)
+    finally:
+        if cache is not None:
+            # Merge even on error/interrupt: nodes that DID complete (a slow
+            # checkpoint load before a Cancel) are valid for their signatures
+            # and stay warm — the reference's keep-loaded behavior across a
+            # cancelled prompt.
+            cache.merge(
+                {nid: results[nid] for nid in graph if nid in results}, sigs
+            )
     return results
 
 

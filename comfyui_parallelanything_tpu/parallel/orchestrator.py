@@ -707,6 +707,23 @@ class ParallelModel:
                 return TraceSpec(apply=self._apply, params=g.params)
         return TraceSpec(apply=self._apply, params=self._lead())
 
+    def serving_bucket_width(self, requested: int) -> int:
+        """How many concurrent serving lanes one step dispatch may co-batch
+        for this chain (serving/scheduler.py consults this at admission).
+
+        Stream-mode chains stay width-1: every step already re-streams the
+        full weight pytree under a carved HBM budget, and co-batched lanes
+        would multiply the activation peak that budget was carved against —
+        they keep step-boundary scheduling (cancel, metrics, ragged retire)
+        without co-batching. Hybrid multi-group chains and active
+        sequence-parallel contexts are width-1 for the same reason they are
+        not whole-loop traceable: no single step program exists to widen.
+        Single-group chains take the requested width; the scheduler rounds it
+        to the data-axis width so padded lanes shard evenly over the mesh."""
+        if self._stream or self.traceable() is None:
+            return 1
+        return max(1, int(requested))
+
     # -- degradation (parity 1435-1448, divergence documented above) ---------------
 
     def _demote(self) -> None:

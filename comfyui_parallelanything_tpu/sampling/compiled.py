@@ -56,6 +56,7 @@ __all__ = [
     "compiled_k_sample",
     "compiled_ddim_sample",
     "compiled_flow_sample",
+    "lane_step_program",
 ]
 
 
@@ -978,3 +979,103 @@ def compiled_flow_sample(
         mask, mask_init, mask_noise,
     )
     return _slice_padded(out, batch_orig, padded)
+
+
+# ---------------------------------------------------------------------------
+# per-lane batched step (round 7, serving/): ONE compiled dispatch advances a
+# fixed-width batch of lanes, each carrying its OWN sigma/step state — the
+# step-boundary seam continuous batching joins and leaves at. The Euler math
+# mirrors k_samplers.sample_euler + EpsDenoiser.__call__ op-for-op with the
+# scalar sigma generalized to a per-lane vector; padded/retired lanes are
+# masked with jnp.where (a select, so a junk pad-lane value can never leak
+# into a live lane — per-sample independence of the model does the rest).
+# ---------------------------------------------------------------------------
+
+
+def lane_step_program(
+    spec: TraceSpec, *, prediction: str, use_cfg: bool, cfg_rescale: float,
+    static_kwargs: dict,
+):
+    """The jitted per-step program for one serving bucket.
+
+    Call signature of the returned fn (W = lane width, b = per-request batch):
+
+    ``fn(params, x[W,b,...], sigma[W], sigma_next[W], active[W] f32,
+    cfg_scale[W], context[W,b,L,D]|None, uncond_context|None, kwargs,
+    u_kwargs, log_sigmas|None) -> x'[W,b,...]``
+
+    Per-lane sigmas ride as a vector: the sigma→timestep log-interp, the
+    1/sqrt(sigma²+1) input scaling, the CFG mix (per-lane cfg_scale), and the
+    Euler update all broadcast over the lane axis, so one dispatch advances
+    lanes sitting at DIFFERENT points of DIFFERENT schedules. Inactive lanes
+    get sigma pinned to 1.0 (no divide-by-zero) and their latent passed
+    through unchanged. Cached via the loop-jit cache (bounded, clearable)."""
+    meta = ("serve", prediction, bool(use_cfg), float(cfg_rescale))
+    apply_fn, mesh, axis = spec.apply, spec.mesh, spec.data_axis
+
+    def build(bound_static):
+        def impl(params, x, sigma, sigma_next, active, cfg_scale, context,
+                 uncond_context, kwargs, u_kwargs, log_sigmas):
+            model = _model_fn(apply_fn, params, bound_static)
+            W, b = x.shape[0], x.shape[1]
+            n = W * b
+
+            def flatten(tree):
+                return jax.tree.map(
+                    lambda l: l.reshape((n,) + l.shape[2:]), tree
+                )
+
+            def bcast(v, ndim):
+                return v.reshape(v.shape + (1,) * (ndim - 1))
+
+            lane = lambda v: jnp.repeat(v, b, total_repeat_length=n)  # noqa: E731
+            flat = x.reshape((n,) + x.shape[2:])
+            s = jnp.where(active > 0, sigma, jnp.float32(1.0))
+            s_flat = lane(s)
+            if prediction == "flow":
+                # Flow time IS the sigma (EpsDenoiser flow branch).
+                t_vec = s_flat
+                x_in = flat
+                scale_flat = None
+            else:
+                scale_flat = 1.0 / jnp.sqrt(s_flat**2 + 1.0)
+                t_vec = jnp.interp(
+                    jnp.log(s_flat), log_sigmas,
+                    jnp.arange(log_sigmas.shape[0], dtype=jnp.float32),
+                )
+                x_in = flat * bcast(scale_flat, flat.ndim)
+            ctx = None if context is None else flatten(context)
+            kw = flatten(kwargs) if kwargs else {}
+            if use_cfg:
+                u_kw = flatten(u_kwargs) if u_kwargs else None
+                kw2 = double_kwargs(kw, u_kw, n)
+                uctx = flatten(uncond_context)
+                eps_both = model(
+                    jnp.concatenate([x_in, x_in], axis=0),
+                    jnp.concatenate([t_vec, t_vec], axis=0),
+                    jnp.concatenate([ctx, uctx], axis=0),
+                    **kw2,
+                )
+                eps_c, eps_u = jnp.split(eps_both, 2, axis=0)
+                cfg_flat = bcast(lane(cfg_scale), eps_c.ndim)
+                eps = eps_u + cfg_flat * (eps_c - eps_u)
+                eps = rescale_guidance(eps, eps_c, float(cfg_rescale))
+            else:
+                eps = model(x_in, t_vec, ctx, **kw)
+            if prediction == "v":
+                x0_flat = (
+                    flat / bcast(s_flat**2 + 1.0, flat.ndim)
+                    - eps * bcast(s_flat * scale_flat, flat.ndim)
+                )
+            else:
+                # eps: x0 = x − σ·eps. flow: x0 = x − σ·v — the same expression.
+                x0_flat = flat - bcast(s_flat, flat.ndim) * eps
+            x0 = x0_flat.reshape(x.shape)
+            d = (x - x0) / bcast(s, x.ndim)
+            new = x + d * bcast(sigma_next - sigma, x.ndim)
+            out = jnp.where(bcast(active > 0, x.ndim), new, x)
+            return _constrain(out, mesh, axis)
+
+        return impl
+
+    return _get_loop_jit("serve", spec, static_kwargs, meta, build)

@@ -377,6 +377,27 @@ def run_sampler(
             x = init_latent + x
     if sampler in RNG_SAMPLERS and rng is None:
         rng = jax.random.key(0)
+    # Continuous-batching seam (round 7, serving/): when a scheduler is
+    # installed, route eligible work — history-free sampler, no user callback,
+    # no inpaint mask, no multi-cond — into a shared step-boundary batch with
+    # whatever other requests are in flight. Ineligible or refused work falls
+    # through to the inline paths unchanged; compile_loop callers asked for
+    # the whole-loop program and are never hijacked.
+    if not compile_loop and callback is None and latent_mask is None \
+            and not multi_cond:
+        from ..serving.scheduler import get_scheduler
+
+        _sched = get_scheduler()
+        if _sched is not None and sampler not in RNG_SAMPLERS:
+            ticket = _sched.maybe_submit(
+                model=model, x=x, sigmas=sigmas, context=context,
+                sampler=sampler, cfg_scale=eff_cfg,
+                uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
+                alphas_cumprod=acp, prediction=prediction,
+                cfg_rescale=cfg_rescale, model_kwargs=model_kwargs,
+            )
+            if ticket is not None:
+                return ticket.result()
     if compile_loop:
         spec = _compiled_spec(model, callback)
         if spec is not None:

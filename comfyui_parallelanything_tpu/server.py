@@ -3,24 +3,42 @@
 The reference pack's graphs are driven through ComfyUI's HTTP server (the
 frontend and every scripting client POST API-format JSON to ``/prompt``).
 This module is that surface for the standalone host: stdlib-only
-(``http.server``), one worker thread executing prompts serially (one
-accelerator — serial is the correct schedule), and a persistent
-``host.WorkflowCache`` shared across prompts so a model loaded by one prompt
-stays resident for the next (the reference's keep-loaded behavior, which its
+(``http.server``), a configurable pool of worker threads executing prompts
+(default ONE — the reference's serial schedule; ``workers>1`` or
+``PA_SERVER_WORKERS`` turns on concurrent execution and installs the
+continuous-batching scheduler, serving/, so concurrent prompts' sampler runs
+share compiled step dispatches), and a persistent ``host.WorkflowCache``
+shared across prompts so a model loaded by one prompt stays resident for the
+next (the reference's keep-loaded behavior, which its
 ``cleanup_parallel_model``/finalizer pair defends, any_device_parallel.py
 211-282).
 
 Endpoints (the ComfyUI client-protocol subset that makes scripts work):
 
-- ``POST /prompt``            ``{"prompt": {...graph...}}`` → ``{"prompt_id"}``
+- ``POST /prompt``            ``{"prompt": {...graph...}}`` → ``{"prompt_id"}``;
+                              ``extra_data.priority`` / ``extra_data.deadline_s``
+                              feed the serving policy layer; 429 when the
+                              bounded queue (``max_pending`` /
+                              $PA_MAX_PENDING) is full — explicit
+                              backpressure instead of silent latency
 - ``GET  /history``           all completed prompts
 - ``GET  /history/{id}``      one prompt's status + outputs
 - ``GET  /view?filename=``    serve a saved image (``subfolder=`` honored)
 - ``GET  /queue``             running + pending prompt ids
-- ``POST /interrupt``         drop all *pending* prompts and stop the
+- ``POST /queue``             stock per-prompt cancel:
+                              ``{"delete": [prompt_id, ...]}`` drops queued
+                              prompts and stops running ones at their next
+                              step boundary (per-lane cancel — co-batched
+                              neighbors keep running); ``{"clear": true}``
+                              drops every pending prompt
+- ``GET  /metrics``           Prometheus text: serving per-bucket occupancy,
+                              lane-wait, step-time, dispatch counts
+                              (utils/metrics.py registry) + queue gauges
+- ``POST /interrupt``         drop all *pending* prompts and stop every
                               *running* one at its next sampler-step boundary
-                              (cooperative flag, utils/progress.py; a single
-                              compiled step cannot be preempted mid-dispatch)
+                              (per-prompt cooperative scope,
+                              utils/progress.py; a single compiled step
+                              cannot be preempted mid-dispatch)
 - ``POST /upload/image``      multipart input upload into $PA_INPUT_DIR
                               (stock dedupe suffixing; ``overwrite`` honored)
 - ``GET  /object_info[/cls]`` node-registry introspection (INPUT_TYPES etc.)
@@ -59,13 +77,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from .host import WorkflowCache, run_workflow
-from .utils.progress import (
-    Interrupted,
-    clear_interrupt,
-    request_interrupt,
-    set_preview_hook,
-    set_progress_hook,
-)
+from .utils.progress import Interrupted, progress_scope
 
 _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"  # RFC 6455 §1.3
 
@@ -174,22 +186,55 @@ class _WsListener:
             pass
 
 
-class PromptQueue:
-    """Serial prompt executor with ComfyUI-shaped bookkeeping."""
+class QueueFullError(RuntimeError):
+    """Bounded prompt queue is full — surfaced as HTTP 429 (backpressure)."""
 
-    def __init__(self, class_mappings=None, output_dir: str | None = None):
+
+class PromptQueue:
+    """Prompt executor with ComfyUI-shaped bookkeeping.
+
+    Default is the reference's schedule: ONE worker thread, prompts strictly
+    serial. ``workers > 1`` runs that many prompt workers concurrently and
+    installs a ``serving.ContinuousBatchingScheduler`` so the overlapping
+    sampler runs share compiled step dispatches (per-bucket batching); each
+    prompt executes under its own ``progress_scope`` — per-prompt progress
+    hooks and a per-prompt cooperative Cancel event that doubles as the
+    serving layer's per-lane cancel."""
+
+    def __init__(self, class_mappings=None, output_dir: str | None = None,
+                 workers: int | None = None, max_pending: int | None = None,
+                 serving: bool | None = None):
         self.class_mappings = class_mappings
         self.output_dir = output_dir or os.environ.get("PA_OUTPUT_DIR", "output")
         self.cache = WorkflowCache()
-        self.pending: "queue.Queue[tuple[str, dict] | None]" = queue.Queue()
+        self.pending: "queue.Queue[tuple | None]" = queue.Queue()
         self.pending_ids: list[str] = []
-        self.running: str | None = None
+        # pid → its per-prompt cooperative Cancel event (progress_scope).
+        self.running: dict[str, threading.Event] = {}
         self.history: dict[str, dict] = {}
         self.counter = 0
         self._lock = threading.Lock()
         self._listeners: dict = {}  # socket → _WsListener
-        self._worker = threading.Thread(target=self._run, daemon=True)
-        self._worker.start()
+        self.workers = max(
+            1, int(workers if workers is not None
+                   else os.environ.get("PA_SERVER_WORKERS", "1"))
+        )
+        if max_pending is None:
+            max_pending = int(os.environ.get("PA_MAX_PENDING", "0"))
+        # 0 means unbounded on BOTH spellings (param/CLI and env var).
+        self.max_pending = max_pending or None
+        self.scheduler = None
+        enable_serving = self.workers > 1 if serving is None else serving
+        if enable_serving:
+            from .serving import ContinuousBatchingScheduler
+
+            self.scheduler = ContinuousBatchingScheduler().install()
+        self._workers = [
+            threading.Thread(target=self._run, daemon=True)
+            for _ in range(self.workers)
+        ]
+        for t in self._workers:
+            t.start()
 
     def add_listener(self, sock) -> "_WsListener":
         listener = _WsListener(sock)
@@ -232,26 +277,48 @@ class PromptQueue:
             "data": {"status": {"exec_info": {"queue_remaining": remaining}}},
         })
 
-    def submit(self, prompt: dict, preview: bool = False) -> tuple[str, int]:
+    def submit(self, prompt: dict, preview: bool = False,
+               priority: int = 0, deadline_s: float | None = None
+               ) -> tuple[str, int]:
         pid = uuid.uuid4().hex
         # Bookkeeping AND enqueue under one lock: interrupt() drains under the
         # same lock, so a submit racing an interrupt either lands wholly
         # before (and is dropped with a history entry) or wholly after (and
         # survives) — never half-registered.
         with self._lock:
+            if (self.max_pending is not None
+                    and len(self.pending_ids) - len(self.running)
+                    >= self.max_pending):
+                from .utils.metrics import registry
+
+                registry.counter("pa_server_rejected_total",
+                                 help="prompts refused with 429 (queue full)")
+                raise QueueFullError(
+                    f"queue full ({self.max_pending} pending)"
+                )
             self.counter += 1
             number = self.counter
             self.pending_ids.append(pid)
-            self.pending.put((pid, prompt, bool(preview)))
+            self.pending.put((pid, prompt, bool(preview), int(priority),
+                              deadline_s))
         self._emit_status()
         return pid, number
 
+    def _drop_pending(self, pid: str) -> None:
+        """history + bookkeeping for a prompt cancelled before it ran
+        (caller holds the lock)."""
+        self.pending_ids.remove(pid)
+        self.history[pid] = {
+            "status": {"status_str": "interrupted", "completed": False},
+            "outputs": {},
+        }
+
     def interrupt(self) -> int:
-        """Drop every pending prompt AND ask the running one to stop at its
-        next sampler-step boundary (utils/progress.py cooperative flag — the
-        ComfyUI Cancel semantics; a single compiled step still cannot be
-        preempted mid-dispatch). Anything the worker popped before this drain
-        counts as running."""
+        """Drop every pending prompt AND ask every running one to stop at its
+        next boundary (per-prompt cooperative scope events — the ComfyUI
+        Cancel semantics; a single compiled step still cannot be preempted
+        mid-dispatch). Anything a worker popped before this drain counts as
+        running."""
         dropped = 0
         with self._lock:
             while True:
@@ -262,51 +329,91 @@ class PromptQueue:
                 if item is None:  # preserve the shutdown sentinel
                     self.pending.put(None)
                     break
-                pid = item[0]
-                dropped += 1
-                self.pending_ids.remove(pid)
-                self.history[pid] = {
-                    "status": {"status_str": "interrupted", "completed": False},
-                    "outputs": {},
-                }
+                if item[0] in self.pending_ids:  # not already cancel()ed
+                    dropped += 1
+                    self._drop_pending(item[0])
             # An id still pending but not running is an in-flight pop (the
             # worker took it off the queue but hasn't published running yet):
             # removing it here makes the worker's pending_ids check drop it —
             # the Cancel wins the race instead of losing it.
-            for pid in [p for p in self.pending_ids if p != self.running]:
+            for pid in [p for p in self.pending_ids if p not in self.running]:
                 dropped += 1
-                self.pending_ids.remove(pid)
-                self.history[pid] = {
-                    "status": {"status_str": "interrupted", "completed": False},
-                    "outputs": {},
-                }
-            if self.running is not None:
-                # Set under the SAME lock the worker clears it under when
-                # publishing running: a Cancel can never land in the window
-                # between running=pid and the flag reset.
-                request_interrupt()
+                self._drop_pending(pid)
+            # Each running prompt's own scope event: set under the SAME lock
+            # the worker registers it under, so a Cancel can never land in
+            # the window between pop and registration. Fresh event per prompt
+            # — no stale-flag choreography needed.
+            for evt in self.running.values():
+                evt.set()
+        if self.scheduler is not None:
+            self.scheduler.kick()  # lanes notice the events at this boundary
         if dropped:
             self._emit_status()  # ws clients must see the queue shrink
         return dropped
 
+    def clear_pending(self) -> int:
+        """Drop every PENDING prompt atomically (running ones finish) — the
+        stock ``POST /queue {"clear": true}`` semantics. One lock hold, so a
+        prompt a worker picks up concurrently is never misclassified as
+        pending-then-cancelled-running."""
+        dropped = 0
+        with self._lock:
+            for pid in [p for p in self.pending_ids if p not in self.running]:
+                self._drop_pending(pid)
+                dropped += 1
+        if dropped:
+            self._emit_status()
+        return dropped
+
+    def cancel(self, pids) -> int:
+        """Per-prompt Cancel (stock ``POST /queue {"delete": [...]}``):
+        pending prompts drop with an interrupted history entry; running ones
+        get their scope event set — the cooperative boundary check stops the
+        graph, and the serving scheduler frees the prompt's lane at the next
+        step boundary without perturbing co-batched neighbors."""
+        acted = 0
+        with self._lock:
+            targets = set(str(p) for p in pids)
+            running_hits = [p for p in targets if p in self.running]
+            pending_hits = [
+                p for p in targets
+                if p in self.pending_ids and p not in self.running
+            ]
+            for pid in pending_hits:
+                self._drop_pending(pid)
+                acted += 1
+            for pid in running_hits:
+                self.running[pid].set()
+                acted += 1
+        if running_hits and self.scheduler is not None:
+            self.scheduler.kick()
+        if pending_hits:
+            self._emit_status()
+        return acted
+
     def shutdown(self) -> None:
-        self.pending.put(None)
-        self._worker.join(timeout=30)
+        self.pending.put(None)  # workers cascade the sentinel to siblings
+        for t in self._workers:
+            t.join(timeout=30)
+        if self.scheduler is not None:
+            self.scheduler.uninstall()
+            self.scheduler.shutdown()
 
     def _run(self) -> None:
         while True:
             item = self.pending.get()
             if item is None:
+                self.pending.put(None)  # cascade to sibling workers
                 return
-            pid, prompt, preview = item
+            pid, prompt, preview, priority, deadline_s = item
+            cancel_evt = threading.Event()
             with self._lock:
                 if pid not in self.pending_ids:
                     continue  # interrupted while queued
-                self.running = pid
-                # Reset any stale Cancel under the same lock interrupt() uses:
-                # once running is published, a new interrupt targets THIS
-                # prompt and must survive.
-                clear_interrupt()
+                # Publish under the same lock interrupt()/cancel() set events
+                # under; the event is fresh per prompt, so a stale Cancel
+                # aimed at a previous prompt cannot exist by construction.
+                self.running[pid] = cancel_evt
             self._emit({"type": "execution_start", "data": {"prompt_id": pid}})
             t0 = time.time()
             # Per-node `executing` + per-step `progress` events — the pair a
@@ -350,13 +457,19 @@ class PromptQueue:
                     return
                 self._emit_binary(struct.pack(">II", 1, 2) + png)
 
-            prev_hook = set_progress_hook(hook)
-            prev_preview = set_preview_hook(preview_hook if preview else None)
+            from .serving.scheduler import serving_hints
+
             try:
-                results = run_workflow(
-                    prompt, class_mappings=self.class_mappings,
-                    outputs=self.cache, on_node=on_node, on_cached=on_cached,
-                )
+                with progress_scope(
+                    hook=hook,
+                    preview_hook=preview_hook if preview else None,
+                    interrupt_event=cancel_evt,
+                ), serving_hints(priority=priority, deadline_s=deadline_s):
+                    results = run_workflow(
+                        prompt, class_mappings=self.class_mappings,
+                        outputs=self.cache, on_node=on_node,
+                        on_cached=on_cached,
+                    )
                 entry = {
                     "status": {"status_str": "success", "completed": True,
                                "exec_s": round(time.time() - t0, 3)},
@@ -385,21 +498,15 @@ class PromptQueue:
                                "message": f"{type(e).__name__}: {e}"},
                     "outputs": {},
                 }
-            finally:
-                set_progress_hook(prev_hook)
-                set_preview_hook(prev_preview)
             with self._lock:
                 self.history[pid] = entry
-                self.pending_ids.remove(pid)
-                self.running = None
-                # Consume any leftover Cancel UNDER the same lock interrupt()
-                # sets it under, with running already retired: an interrupt
-                # that landed after the prompt's last cooperative checkpoint
-                # can neither survive this clear nor be re-set afterwards
-                # (interrupt() only sets the flag while running is non-None),
-                # so a stale flag can never leak into the next bare
-                # run_workflow anywhere in the process.
-                clear_interrupt()
+                if pid in self.pending_ids:
+                    self.pending_ids.remove(pid)
+                # The per-prompt Cancel event retires with the prompt: a
+                # Cancel that landed after the last cooperative checkpoint
+                # dies with this entry instead of leaking into the next
+                # prompt (the fresh-event-per-prompt discipline).
+                self.running.pop(pid, None)
             # The canonical completion signal ComfyUI API clients block on.
             self._emit({
                 "type": "executing", "data": {"node": None, "prompt_id": pid},
@@ -462,10 +569,23 @@ class _Handler(BaseHTTPRequestHandler):
             return self._serve_websocket()
         if url.path == "/queue":
             with self.q._lock:
-                running = [self.q.running] if self.q.running else []
-                pend = [p for p in self.q.pending_ids if p != self.q.running]
+                running = list(self.q.running)
+                pend = [p for p in self.q.pending_ids if p not in self.q.running]
             return self._send(
                 200, {"queue_running": running, "queue_pending": pend}
+            )
+        if url.path == "/metrics":
+            from .utils.metrics import registry
+
+            with self.q._lock:
+                registry.gauge("pa_server_queue_pending",
+                               len(self.q.pending_ids) - len(self.q.running),
+                               help="prompts queued, not yet running")
+                registry.gauge("pa_server_running", len(self.q.running),
+                               help="prompts executing right now")
+            return self._send(
+                200, registry.render().encode(),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
             )
         if parts and parts[0] == "history":
             # Snapshot under the queue lock: the worker thread inserts entries
@@ -561,6 +681,27 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         if url.path == "/interrupt":
             return self._send(200, {"dropped": self.q.interrupt()})
+        if url.path == "/queue":
+            # Stock per-prompt cancel: {"delete": [prompt_id, ...]} — routed
+            # through the per-prompt scope event, which the serving layer's
+            # lanes also watch ({"clear": true} drops every pending prompt).
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                return self._send(400, {"error": f"bad JSON: {e}"})
+            deleted = 0
+            if payload.get("clear"):
+                # Stock clear: every PENDING prompt drops; running ones finish.
+                deleted += self.q.clear_pending()
+            targets = payload.get("delete")
+            if targets is not None:
+                if not isinstance(targets, (list, tuple)):
+                    return self._send(
+                        400, {"error": '"delete" must be a list of prompt ids'}
+                    )
+                deleted += self.q.cancel(targets)
+            return self._send(200, {"deleted": deleted})
         if url.path == "/prompt":
             try:
                 length = int(self.headers.get("Content-Length", 0))
@@ -573,11 +714,19 @@ class _Handler(BaseHTTPRequestHandler):
                     )
             except (ValueError, json.JSONDecodeError) as e:
                 return self._send(400, {"error": f"bad JSON: {e}"})
-            preview = bool(
-                (payload.get("extra_data") or {}).get("preview")
-                or payload.get("preview")
-            )
-            pid, number = self.q.submit(prompt, preview=preview)
+            extra = payload.get("extra_data") or {}
+            preview = bool(extra.get("preview") or payload.get("preview"))
+            try:
+                deadline_s = extra.get("deadline_s")
+                pid, number = self.q.submit(
+                    prompt, preview=preview,
+                    priority=int(extra.get("priority") or 0),
+                    deadline_s=None if deadline_s is None else float(deadline_s),
+                )
+            except QueueFullError as e:
+                return self._send(429, {"error": str(e)})
+            except (TypeError, ValueError) as e:
+                return self._send(400, {"error": f"bad extra_data: {e}"})
             return self._send(200, {"prompt_id": pid, "number": number})
         if url.path == "/upload/image":
             return self._upload_image()
@@ -658,11 +807,17 @@ def make_server(
     port: int = 8188,
     class_mappings=None,
     output_dir: str | None = None,
+    workers: int | None = None,
+    max_pending: int | None = None,
+    serving: bool | None = None,
 ) -> tuple[ThreadingHTTPServer, PromptQueue]:
     """Build (but don't start) the HTTP server + its prompt queue. Port 0
     picks an ephemeral port (tests); ``server.server_address`` has the real
-    one."""
-    q = PromptQueue(class_mappings=class_mappings, output_dir=output_dir)
+    one. ``workers > 1`` (or $PA_SERVER_WORKERS) executes prompts
+    concurrently and installs the continuous-batching scheduler;
+    ``max_pending`` (or $PA_MAX_PENDING) bounds the queue (429 beyond it)."""
+    q = PromptQueue(class_mappings=class_mappings, output_dir=output_dir,
+                    workers=workers, max_pending=max_pending, serving=serving)
     handler = type("Handler", (_Handler,), {"q": q})
     srv = ThreadingHTTPServer((host, port), handler)
     return srv, q
@@ -675,8 +830,15 @@ def main() -> None:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8188)
     ap.add_argument("--output-dir", default=None)
+    ap.add_argument("--workers", type=int, default=None,
+                    help="concurrent prompt workers (>1 enables continuous "
+                         "batching; default $PA_SERVER_WORKERS or 1)")
+    ap.add_argument("--max-pending", type=int, default=None,
+                    help="bounded queue depth — 429 beyond it "
+                         "(default $PA_MAX_PENDING or unbounded)")
     args = ap.parse_args()
-    srv, q = make_server(args.host, args.port, output_dir=args.output_dir)
+    srv, q = make_server(args.host, args.port, output_dir=args.output_dir,
+                         workers=args.workers, max_pending=args.max_pending)
     print(f"ParallelAnything workflow server on http://{args.host}:{args.port}")
     try:
         srv.serve_forever()

@@ -1,0 +1,28 @@
+"""Continuous-batching serving subsystem (round 7).
+
+Sits between ``server.PromptQueue`` and ``sampling/runner.py``: concurrent
+prompts' sampler runs that agree on (model, shape, sampler, cfg-mode) share
+ONE compiled step program, joining and leaving the fixed-width batch at step
+boundaries. See serving/scheduler.py for the architecture overview.
+"""
+
+from .bucket import ServeRequest, StepBucket
+from .policy import AdmissionQueue, DeadlineExceeded, ServingRejected
+from .scheduler import (
+    BATCHABLE_SAMPLERS,
+    ContinuousBatchingScheduler,
+    get_scheduler,
+    serving_hints,
+)
+
+__all__ = [
+    "AdmissionQueue",
+    "BATCHABLE_SAMPLERS",
+    "ContinuousBatchingScheduler",
+    "DeadlineExceeded",
+    "ServeRequest",
+    "ServingRejected",
+    "StepBucket",
+    "get_scheduler",
+    "serving_hints",
+]

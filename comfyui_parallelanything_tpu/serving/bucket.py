@@ -1,0 +1,375 @@
+"""Shape-bucketed step batches: fixed-width lanes advancing in lockstep.
+
+One ``StepBucket`` owns everything needed to run ONE compiled step program
+over a fixed-width batch of lanes (padded, masked), where each lane is one
+request at its own position in its own sigma schedule:
+
+- stacked device state ``x[W, b, ...]`` plus per-lane host bookkeeping
+  (schedule, step index, request handle) — the "per-lane step state" the
+  continuous-batching seam needs;
+- step-boundary join/leave: a request enters by ``x.at[lane].set(...)`` at a
+  boundary and retires (its slice extracted, its waiter resolved) the moment
+  its own schedule completes, while other lanes keep running — ragged
+  schedules, lockstep dispatches;
+- masking: retired/empty lanes ride along with ``sigma`` pinned to 1 and the
+  update ``jnp.where``-selected away, so occupancy can never perturb a live
+  lane's values (the model is per-sample independent; the select guarantees
+  even a NaN in a pad lane stays in the pad lane).
+
+Two execution modes share the bookkeeping: a compiled per-lane step program
+(sampling/compiled.py ``lane_step_program`` — single-program models, width N)
+and a width-1 eager mode for models that can never be one XLA program
+(weight-streaming / hybrid chains, parallel/orchestrator.py) — those still
+gain step-boundary scheduling, cancel, and metrics, just not co-batching.
+
+Bitwise discipline: the Euler math here IS k_samplers.sample_euler with the
+scalar sigma generalized per-lane; ``tests/test_serving.py`` pins serial vs
+in-batch equivalence at bf16 tolerances on CPU and the 8-device mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..utils.metrics import registry
+from ..utils.progress import Interrupted
+from .policy import AdmissionQueue, DeadlineExceeded
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One sampler run handed to the scheduler — the (x, sigmas, conditioning)
+    triple run_sampler would otherwise have fed its own eager Euler loop,
+    plus the policy/bookkeeping the serving layer adds."""
+
+    x: Any                      # noised start latent [b, ...]
+    sigmas: np.ndarray          # (n_steps+1,) descending, host-side
+    context: Any
+    uncond_context: Any
+    traced_kwargs: dict
+    static_kwargs: dict
+    u_traced: dict
+    uncond_kwargs: dict | None
+    cfg_scale: float
+    cfg_rescale: float
+    prediction: str
+    acp: Any                    # alphas_cumprod or None (default schedule)
+    priority: int = 0
+    deadline: float | None = None          # time.monotonic() deadline
+    progress_hook: Optional[Callable[[int, int], None]] = None
+    interrupt_event: Optional[threading.Event] = None
+    rid: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
+    submit_ts: float = dataclasses.field(default_factory=time.monotonic)
+
+    def __post_init__(self):
+        self.cancel_event = threading.Event()
+        self._done = threading.Event()
+        self._result: Any = None
+        self._error: BaseException | None = None
+
+    @property
+    def n_steps(self) -> int:
+        return len(self.sigmas) - 1
+
+    def cancelled(self) -> bool:
+        return self.cancel_event.is_set() or (
+            self.interrupt_event is not None and self.interrupt_event.is_set()
+        )
+
+    def resolve(self, result=None, error: BaseException | None = None) -> None:
+        self._result, self._error = result, error
+        self._done.set()
+
+    def result(self, timeout: float | None = None):
+        """Block the submitting thread until its lane retires; re-raises the
+        lane's error (Interrupted propagates exactly as the inline sampler's
+        cooperative check would have raised it)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"serving request {self.rid} still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+@dataclasses.dataclass
+class _Lane:
+    req: ServeRequest
+    idx: int = 0  # next step to run (sigmas[idx] -> sigmas[idx+1])
+    # Width-1 eager mode only: the lane's own latent + denoiser (program mode
+    # keeps lane latents stacked in the bucket's device state instead).
+    x_eager: Any = None
+    denoiser: Any = None
+
+
+class StepBucket:
+    """Fixed-width lockstep batch for one (model, shape, sampler-config) key."""
+
+    def __init__(self, key, label: str, *, width: int, model, spec,
+                 max_waiting: int = 64):
+        import jax.numpy as jnp
+
+        from ..sampling.k_samplers import model_sigmas
+        from ..sampling.schedules import scaled_linear_schedule
+
+        self.key, self.label = key, label
+        self.width = max(1, int(width))
+        self.model, self.spec = model, spec
+        self.queue = AdmissionQueue(max_waiting=max_waiting)
+        self.lanes: list[_Lane | None] = [None] * self.width
+        self.dispatch_count = 0
+        self._program = None
+        self._log_sigmas = None
+        self._acp_default = None
+        # Stacked device state, built from the first admitted request's shapes.
+        self._x = None
+        self._ctx = None
+        self._uctx = None
+        self._kw = None
+        self._ukw = None
+        self._jnp = jnp
+        self._model_sigmas = model_sigmas
+        self._default_schedule = scaled_linear_schedule
+        self._labels = {"bucket": label}
+
+    # -- occupancy ----------------------------------------------------------
+
+    def active_lanes(self) -> list[int]:
+        return [i for i, l in enumerate(self.lanes) if l is not None]
+
+    def idle(self) -> bool:
+        return not self.active_lanes() and len(self.queue) == 0
+
+    def release_state(self) -> None:
+        """Drop the stacked device arrays while idle — an idle serving layer
+        must not pin width×batch latents/contexts in device memory between
+        bursts. Rebuilt by ``_ensure_state`` on the next admission (the
+        compiled step program itself stays in the bounded loop-jit cache)."""
+        self._x = self._ctx = self._uctx = self._kw = self._ukw = None
+
+    def _gauges(self) -> None:
+        registry.gauge("pa_serving_occupancy", len(self.active_lanes()),
+                       labels=self._labels,
+                       help="live lanes in the bucket's step batch")
+        registry.gauge("pa_serving_queue_depth", len(self.queue),
+                       labels=self._labels,
+                       help="requests waiting for a lane")
+
+    # -- state assembly -----------------------------------------------------
+
+    def _zeros_stack(self, template):
+        """[W, *template.shape] zeros matching the template's dtype, lane-axis
+        sharded when the bucket runs over a mesh (composes with the chain's
+        data sharding: the lane axis IS the batch axis the orchestrator
+        shards)."""
+        import jax
+
+        jnp = self._jnp
+
+        def leaf(l):
+            z = jnp.zeros((self.width,) + tuple(l.shape), l.dtype)
+            if self.spec is not None and self.spec.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                z = jax.device_put(
+                    z, NamedSharding(self.spec.mesh, P(self.spec.data_axis))
+                )
+            return z
+
+        return jax.tree.map(leaf, template)
+
+    def _ensure_state(self, req: ServeRequest) -> None:
+        if self.spec is None or self._x is not None:
+            return
+        self._x = self._zeros_stack(req.x)
+        self._ctx = (
+            None if req.context is None else self._zeros_stack(req.context)
+        )
+        self._uctx = (
+            None if req.uncond_context is None
+            else self._zeros_stack(req.uncond_context)
+        )
+        self._kw = self._zeros_stack(req.traced_kwargs) if req.traced_kwargs else None
+        self._ukw = self._zeros_stack(req.u_traced) if req.u_traced else None
+        if req.prediction != "flow":
+            acp = req.acp if req.acp is not None else self._default_schedule()
+            self._log_sigmas = self._jnp.log(self._model_sigmas(acp))
+        from ..sampling.compiled import lane_step_program
+
+        self._program = lane_step_program(
+            self.spec,
+            prediction=req.prediction,
+            use_cfg=req.uncond_context is not None and req.cfg_scale != 1.0,
+            cfg_rescale=req.cfg_rescale,
+            static_kwargs=req.static_kwargs,
+        )
+
+    def _set_lane(self, i: int, req: ServeRequest) -> None:
+        import jax
+
+        self._ensure_state(req)
+        lane = _Lane(req)
+        if self.spec is not None:
+            self._x = self._x.at[i].set(req.x)
+            if self._ctx is not None:
+                self._ctx = self._ctx.at[i].set(req.context)
+            if self._uctx is not None:
+                self._uctx = self._uctx.at[i].set(req.uncond_context)
+            if self._kw is not None:
+                self._kw = jax.tree.map(
+                    lambda stack, v: stack.at[i].set(v),
+                    self._kw, req.traced_kwargs,
+                )
+            if self._ukw is not None:
+                self._ukw = jax.tree.map(
+                    lambda stack, v: stack.at[i].set(v), self._ukw, req.u_traced
+                )
+        else:
+            from ..sampling.k_samplers import EpsDenoiser
+
+            lane.x_eager = req.x
+            lane.denoiser = EpsDenoiser(
+                self.model, req.context, cfg_scale=req.cfg_scale,
+                uncond_context=req.uncond_context,
+                uncond_kwargs=req.uncond_kwargs,
+                alphas_cumprod=req.acp, prediction=req.prediction,
+                cfg_rescale=req.cfg_rescale,
+                **req.traced_kwargs, **req.static_kwargs,
+            )
+        self.lanes[i] = lane
+
+    # -- scheduling ---------------------------------------------------------
+
+    def admit(self, now: float | None = None) -> int:
+        """Fill free lanes from the waiting line (policy order), resolving
+        expired/cancelled entries instead of seating them. Returns how many
+        joined — always at a step boundary (the dispatcher calls this between
+        dispatches, never mid-step)."""
+        now = time.monotonic() if now is None else now
+        for req in self.queue.expired(now):
+            req.resolve(error=DeadlineExceeded(
+                f"deadline passed after {now - req.submit_ts:.3f}s waiting"
+            ))
+            registry.counter("pa_serving_expired_total", labels=self._labels)
+        joined = 0
+        for i in range(self.width):
+            if self.lanes[i] is not None:
+                continue
+            req = self.queue.pop()
+            if req is None:
+                break
+            if req.cancelled():
+                req.resolve(error=Interrupted("cancelled while queued"))
+                registry.counter("pa_serving_cancelled_total", labels=self._labels)
+                continue
+            self._set_lane(i, req)
+            joined += 1
+            registry.observe(
+                "pa_serving_lane_wait_seconds", now - req.submit_ts,
+                labels=self._labels,
+                help="submit-to-lane admission wait",
+            )
+        if joined:
+            self._gauges()
+        return joined
+
+    def _retire(self, i: int, result=None, error=None) -> None:
+        lane = self.lanes[i]
+        self.lanes[i] = None
+        lane.req.resolve(result=result, error=error)
+        registry.counter(
+            "pa_serving_cancelled_total" if error is not None
+            else "pa_serving_completed_total",
+            labels=self._labels,
+        )
+
+    def sweep_cancelled(self) -> int:
+        """Retire lanes whose request was cancelled (client cancel, per-prompt
+        interrupt, deadline) — frees the slot at the boundary WITHOUT touching
+        the stacked state: the lane goes inactive-masked, so neighbors are
+        untouched by construction."""
+        now = time.monotonic()
+        swept = 0
+        for i in self.active_lanes():
+            req = self.lanes[i].req
+            if req.cancelled():
+                self._retire(i, error=Interrupted(
+                    f"cancelled mid-batch at step {self.lanes[i].idx}"
+                ))
+                swept += 1
+            elif req.deadline is not None and now >= req.deadline:
+                self._retire(i, error=DeadlineExceeded(
+                    f"deadline passed at step {self.lanes[i].idx}"
+                ))
+                swept += 1
+        if swept:
+            self._gauges()
+        return swept
+
+    def dispatch(self) -> bool:
+        """Run ONE lockstep step for every active lane (one compiled dispatch
+        in program mode), advance per-lane indices, fire per-lane progress
+        hooks, retire finished lanes. Returns False when there was nothing to
+        run."""
+        active = self.active_lanes()
+        if not active:
+            return False
+        import jax
+
+        jnp = self._jnp
+        t0 = time.perf_counter()
+        if self._program is not None:
+            sig = np.ones((self.width,), np.float32)
+            sig_next = np.ones((self.width,), np.float32)
+            act = np.zeros((self.width,), np.float32)
+            cfg = np.ones((self.width,), np.float32)
+            for i in active:
+                lane = self.lanes[i]
+                sig[i] = lane.req.sigmas[lane.idx]
+                sig_next[i] = lane.req.sigmas[lane.idx + 1]
+                act[i] = 1.0
+                cfg[i] = lane.req.cfg_scale
+            self._x = self._program(
+                self.spec.params, self._x, jnp.asarray(sig),
+                jnp.asarray(sig_next), jnp.asarray(act), jnp.asarray(cfg),
+                self._ctx, self._uctx, self._kw, self._ukw, self._log_sigmas,
+            )
+            jax.block_until_ready(self._x)
+        else:
+            # Width-1 eager mode (streaming/hybrid models): the exact
+            # sample_euler step per lane, one model call each.
+            for i in active:
+                lane = self.lanes[i]
+                s = jnp.float32(lane.req.sigmas[lane.idx])
+                s_next = jnp.float32(lane.req.sigmas[lane.idx + 1])
+                x0 = lane.denoiser(lane.x_eager, s)
+                d = (lane.x_eager - x0) / s
+                lane.x_eager = lane.x_eager + d * (s_next - s)
+            jax.block_until_ready([self.lanes[i].x_eager for i in active])
+        dt = time.perf_counter() - t0
+        self.dispatch_count += 1
+        registry.counter("pa_serving_dispatch_total", labels=self._labels,
+                         help="compiled lockstep step dispatches")
+        registry.observe("pa_serving_step_seconds", dt, labels=self._labels,
+                         help="wall time of one lockstep dispatch")
+        for i in active:
+            lane = self.lanes[i]
+            lane.idx += 1
+            hook = lane.req.progress_hook
+            if hook is not None:
+                try:
+                    hook(lane.idx, lane.req.n_steps)
+                except Exception:  # noqa: BLE001 — a UI hook must not kill lanes
+                    pass
+            if lane.idx >= lane.req.n_steps:
+                result = (
+                    self._x[i] if self._program is not None else lane.x_eager
+                )
+                self._retire(i, result=result)
+        self._gauges()
+        return True

@@ -1,0 +1,384 @@
+"""Continuous-batching scheduler: step-boundary batched scheduling of
+concurrent sampler runs.
+
+The seam: every denoise step is an identical compiled dispatch, so sampler
+runs that agree on (model, latent shape, sampler, cfg-mode) can share ONE
+step program — a request joins the shared batch at the next step boundary,
+runs its own schedule in its own lane, and retires when its own step count
+completes (serving/bucket.py). This module is the glue between the callers
+(sampling/runner.py routes eligible ``run_sampler`` work here when a
+scheduler is installed; server.py installs one when it runs multiple prompt
+workers) and the buckets:
+
+- **shape-bucketed admission**: incoming work keyed by (model id, latent
+  shape/dtype, sampler, prediction, cfg-mode, static/traced kwarg shapes) and
+  routed to the matching bucket, created on first sight with a width the
+  model itself bounds (``ParallelModel.serving_bucket_width`` — stream-mode
+  chains stay width-1, mesh chains round to the data-axis width);
+- **policy**: FIFO-within-priority admission with bounded depth
+  (serving/policy.py), per-request deadline, cancel — wired to the per-thread
+  cooperative interrupt scope (utils/progress.py), so a prompt's Cancel frees
+  its lane at the next boundary without touching its neighbors;
+- **dispatcher**: one thread owns every compiled dispatch (one accelerator —
+  lockstep is the schedule), round-robining buckets; ``auto=False`` exposes
+  the same loop as a manual ``pump()`` for deterministic tests.
+
+Ineligible work (unknown sampler, odd kwarg shapes, full queue) is never
+queued: ``maybe_submit`` returns None and the caller runs inline exactly as
+before — the scheduler can only ever ADD batching, not change results.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..utils.metrics import registry
+from ..utils.progress import (
+    Interrupted,
+    clear_interrupt,
+    current_progress_hook,
+    current_scope,
+    interrupt_requested,
+)
+from .bucket import ServeRequest, StepBucket
+from .policy import ServingRejected
+
+# Samplers whose per-step update the lane program implements. Each entry must
+# have a scan-free, history-free step (per-lane state is (x, idx) only);
+# stochastic samplers are excluded — per-lane rng chains would diverge from
+# the serial chain the equivalence contract is defined against.
+BATCHABLE_SAMPLERS = frozenset({"euler"})
+
+_installed: "ContinuousBatchingScheduler | None" = None
+_install_lock = threading.Lock()
+_hints = threading.local()
+
+
+def get_scheduler() -> "ContinuousBatchingScheduler | None":
+    """The process-wide scheduler run_sampler consults, or None (inline)."""
+    return _installed
+
+
+@contextlib.contextmanager
+def serving_hints(priority: int = 0, deadline_s: float | None = None):
+    """Per-thread policy hints for sampler work submitted inside the block
+    (the server worker sets these from POST /prompt extra_data)."""
+    prev = getattr(_hints, "value", None)
+    _hints.value = {
+        "priority": int(priority),
+        "deadline": (
+            None if deadline_s is None else time.monotonic() + float(deadline_s)
+        ),
+    }
+    try:
+        yield
+    finally:
+        _hints.value = prev
+
+
+def _current_hints() -> dict:
+    return getattr(_hints, "value", None) or {"priority": 0, "deadline": None}
+
+
+def _kwarg_sig(tree: dict, batch: int):
+    """Hashable (name, shape, dtype) signature of a traced-kwargs dict, or
+    None if any leaf lacks the per-request batch dim (ineligible — lanes
+    stack kwargs along a new axis, so every leaf must be per-request)."""
+    sig = []
+    for k in sorted(tree):
+        v = tree[k]
+        if getattr(v, "ndim", 0) < 1 or v.shape[0] != batch:
+            return None
+        sig.append((k, tuple(v.shape), str(v.dtype)))
+    return tuple(sig)
+
+
+class ContinuousBatchingScheduler:
+    """Owns the buckets, the admission policy, and the dispatcher thread."""
+
+    def __init__(self, max_width: int | None = None, max_waiting: int = 64,
+                 samplers=BATCHABLE_SAMPLERS, auto: bool = True):
+        self.max_width = int(
+            max_width if max_width is not None
+            else os.environ.get("PA_SERVING_WIDTH", "4")
+        )
+        self.max_waiting = max_waiting
+        self.samplers = frozenset(samplers)
+        self.buckets: dict[tuple, StepBucket] = {}
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._pump_lock = threading.Lock()
+        self._stop = False
+        self._thread = None
+        if auto:
+            self._thread = threading.Thread(
+                target=self._loop, name="pa-serving-dispatcher", daemon=True
+            )
+            self._thread.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def install(self) -> "ContinuousBatchingScheduler":
+        global _installed
+        with _install_lock:
+            _installed = self
+        return self
+
+    def uninstall(self) -> None:
+        global _installed
+        with _install_lock:
+            if _installed is self:
+                _installed = None
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Stop the dispatcher and resolve every outstanding request with
+        Interrupted — no submitter may be left blocked on a dead scheduler."""
+        self.uninstall()
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        with self._lock:
+            buckets = list(self.buckets.values())
+            self.buckets.clear()
+        for b in buckets:
+            while True:
+                req = b.queue.pop()
+                if req is None:
+                    break
+                req.resolve(error=Interrupted("scheduler shutdown"))
+            for i in b.active_lanes():
+                b.lanes[i].req.resolve(error=Interrupted("scheduler shutdown"))
+                b.lanes[i] = None
+
+    # -- submission ---------------------------------------------------------
+
+    def maybe_submit(
+        self, *, model, x, sigmas, context, sampler, cfg_scale,
+        uncond_context, uncond_kwargs, alphas_cumprod, prediction,
+        cfg_rescale, model_kwargs,
+    ) -> ServeRequest | None:
+        """Admit one sampler run, or return None when it cannot share a step
+        program (caller runs inline). Called from run_sampler with the fully
+        prepared (noised x, schedule, conditioning) — the serving layer never
+        re-derives sampler semantics."""
+        if self._stop or sampler not in self.samplers:
+            return None
+        from ..utils.progress import current_preview_hook
+
+        if current_preview_hook() is not None:
+            # Latent previews are emitted by the inline loops' report_progress
+            # (the only preview call site); a lane has no preview channel, so
+            # a preview-enabled prompt must keep the inline path.
+            return None
+        from ..parallel.split import partition_kwargs, static_kwargs_key
+        from ..sampling.compiled import trace_spec_of
+
+        b = int(x.shape[0])
+        traced, static = partition_kwargs(model_kwargs or {})
+        t_sig = _kwarg_sig(traced, b)
+        if t_sig is None:
+            return None
+        use_cfg = uncond_context is not None and cfg_scale != 1.0
+        u_traced: dict = {}
+        u_sig: tuple = ()
+        if use_cfg:
+            if getattr(uncond_context, "shape", None) != tuple(context.shape):
+                return None
+            u_traced, _ = partition_kwargs(uncond_kwargs or {})
+            u_sig = _kwarg_sig(u_traced, b)
+            if u_sig is None:
+                return None
+        if context is not None and (
+            getattr(context, "ndim", 0) < 1 or context.shape[0] != b
+        ):
+            return None
+        spec = trace_spec_of(model)
+        width = self.max_width
+        bound = getattr(model, "serving_bucket_width", None)
+        if callable(bound):
+            width = bound(width)
+        elif spec is None:
+            width = 1
+        if spec is not None and spec.mesh is not None:
+            n = spec.mesh.shape[spec.data_axis]
+            width = max(n, (width // n) * n)
+        acp = alphas_cumprod
+        if acp is None:
+            acp_fp = None
+        else:
+            # Fingerprint interior samples too, not just the endpoints: two
+            # custom schedules agreeing on length and range must not share a
+            # bucket (the bucket's log-sigma table comes from the FIRST
+            # request's schedule).
+            a = np.asarray(acp, np.float64)
+            stride = max(1, a.shape[0] // 7)
+            acp_fp = (a.shape[0],) + tuple(
+                float(v) for v in a[::stride]
+            ) + (float(a[-1]),)
+        key = (
+            id(model), sampler, prediction, use_cfg, float(cfg_rescale),
+            tuple(x.shape), str(x.dtype),
+            None if context is None
+            else (tuple(context.shape), str(context.dtype)),
+            static_kwargs_key(static), t_sig, u_sig, acp_fp, width,
+        )
+        req = ServeRequest(
+            x=x, sigmas=np.asarray(sigmas, np.float32), context=context,
+            uncond_context=uncond_context if use_cfg else None,
+            traced_kwargs=traced, static_kwargs=static, u_traced=u_traced,
+            uncond_kwargs=uncond_kwargs if use_cfg else None,
+            cfg_scale=float(cfg_scale), cfg_rescale=float(cfg_rescale),
+            prediction=prediction, acp=acp,
+            progress_hook=current_progress_hook(),
+            interrupt_event=(
+                current_scope().interrupt_event
+                if current_scope() is not None else None
+            ),
+            **_current_hints(),
+        )
+        with self._lock:
+            bucket = self.buckets.get(key)
+            if bucket is None:
+                name = getattr(model, "name", None) or type(model).__name__
+                label = (
+                    f"{name}:{sampler}:{prediction}:"
+                    f"{'x'.join(str(d) for d in x.shape)}"
+                )
+                bucket = StepBucket(
+                    key, label, width=width, model=model, spec=spec,
+                    max_waiting=self.max_waiting,
+                )
+                self.buckets[key] = bucket
+            try:
+                bucket.queue.push(req)
+            except ServingRejected:
+                registry.counter("pa_serving_rejected_total",
+                                 labels={"bucket": bucket.label},
+                                 help="admissions refused (queue depth bound)")
+                return None
+            self._cond.notify_all()
+        return req
+
+    def cancel(self, rid: str) -> bool:
+        """Cancel one request by id — queued entries resolve at the next
+        admission sweep, a seated lane frees its slot at the next boundary."""
+        with self._lock:
+            buckets = list(self.buckets.values())
+        for b in buckets:
+            req = b.queue.remove(rid)
+            if req is not None:
+                req.cancel_event.set()
+                req.resolve(error=Interrupted("cancelled while queued"))
+                return True
+            for i in b.active_lanes():
+                if b.lanes[i].req.rid == rid:
+                    b.lanes[i].req.cancel_event.set()
+                    self.kick()
+                    return True
+        return False
+
+    def kick(self) -> None:
+        """Wake the dispatcher (a cancel/interrupt should take effect at the
+        next boundary, not the next poll)."""
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- dispatch -----------------------------------------------------------
+
+    def total_dispatches(self) -> int:
+        with self._lock:
+            return sum(b.dispatch_count for b in self.buckets.values())
+
+    def _has_work(self) -> bool:
+        return any(not b.idle() for b in self.buckets.values())
+
+    def pump(self) -> bool:
+        """One scheduling round: sweep cancels, admit at the boundary, and
+        run ONE lockstep dispatch per non-empty bucket. Returns whether any
+        bucket dispatched. The dispatcher thread calls this in a loop;
+        ``auto=False`` tests call it directly for step-deterministic control."""
+        did = False
+        with self._pump_lock:
+            with self._lock:
+                buckets = list(self.buckets.values())
+            if interrupt_requested() and any(
+                b.active_lanes() or len(b.queue) for b in buckets
+            ):
+                # Process-wide Cancel (POST /interrupt semantics): every lane
+                # and queued request stops at this boundary; the flag is
+                # consumed exactly as the inline loops' check_interrupt would.
+                clear_interrupt()
+                for b in buckets:
+                    while True:
+                        req = b.queue.pop()
+                        if req is None:
+                            break
+                        req.resolve(error=Interrupted("interrupted while queued"))
+                    for i in b.active_lanes():
+                        b.lanes[i].req.cancel_event.set()
+            for b in buckets:
+                b.sweep_cancelled()
+                b.admit()
+            for b in buckets:
+                try:
+                    did = b.dispatch() or did
+                except Exception as e:  # noqa: BLE001 — no waiter may hang
+                    # Resolve EVERY request the dying bucket holds — seated
+                    # lanes AND the waiting line — before dropping it, or
+                    # their submitters block forever in ticket.result().
+                    for i in b.active_lanes():
+                        b.lanes[i].req.resolve(error=e)
+                        b.lanes[i] = None
+                    while True:
+                        req = b.queue.pop()
+                        if req is None:
+                            break
+                        req.resolve(error=e)
+                    with self._lock:
+                        self.buckets.pop(b.key, None)
+            # Drained buckets release their stacked device arrays (lane
+            # state rebuilds from the next admitted request) so an idle
+            # serving layer holds no latents/contexts in device memory
+            # between bursts.
+            for b in buckets:
+                if b.idle():
+                    b.release_state()
+            self._trim_buckets()
+        return did
+
+    def drain(self, timeout: float = 120.0) -> None:
+        """Pump until every bucket is idle (manual mode helper)."""
+        t0 = time.monotonic()
+        while self._has_work():
+            self.pump()
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError("serving drain timed out")
+
+    def _trim_buckets(self, keep: int = 32) -> None:
+        with self._lock:
+            if len(self.buckets) <= keep:
+                return
+            for key in [k for k, b in self.buckets.items() if b.idle()]:
+                if len(self.buckets) <= keep:
+                    break
+                self.buckets.pop(key)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                if not self._has_work():
+                    self._cond.wait(timeout=0.2)
+                    continue
+            try:
+                self.pump()
+            except Exception:  # noqa: BLE001 — the dispatcher must survive
+                time.sleep(0.05)

@@ -8,19 +8,119 @@ the framework itself:
 - ``StepTimer`` — honest per-step wall timing (`block_until_ready` on the step output
   before the clock stops, because XLA dispatch is async), accumulating ``StepStats``
   (images/sec + sec/it) with warmup-step exclusion (first steps include compilation);
-- ``trace`` — context manager around ``jax.profiler.trace`` for Perfetto/XProf dumps.
+- ``trace`` — context manager around ``jax.profiler.trace`` for Perfetto/XProf dumps;
+- ``MetricsRegistry`` — process-wide labeled counters/gauges/summaries with a
+  Prometheus-text renderer (round 7): the serving subsystem's per-bucket
+  occupancy, lane-wait, step-time, and dispatch-count instruments, exposed by
+  the HTTP server's ``GET /metrics``.
 """
 
 from __future__ import annotations
 
 import contextlib
 import dataclasses
+import threading
 import time
 from typing import Any
 
 import jax
 
 from .logging import get_logger
+
+
+class MetricsRegistry:
+    """Thread-safe labeled metrics with Prometheus text exposition.
+
+    Three instrument kinds, created on first touch (no registration step —
+    instrumentation sites must never crash a serving path over bookkeeping):
+    ``counter`` (monotonic), ``gauge`` (set to the latest value), ``summary``
+    (accumulates ``_sum``/``_count`` — enough for rate/mean queries without
+    carrying quantile sketches). Labels are a plain dict, canonicalized to a
+    sorted tuple key."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # name -> {"type": kind, "help": str, "values": {label_key: float|[sum, count]}}
+        self._metrics: dict[str, dict] = {}
+
+    @staticmethod
+    def _label_key(labels: dict | None) -> tuple:
+        return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
+
+    def _slot(self, name: str, kind: str, help_: str) -> dict:
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = {"type": kind, "help": help_, "values": {}}
+        return m
+
+    def counter(self, name: str, inc: float = 1.0, labels: dict | None = None,
+                help: str = "") -> None:
+        with self._lock:
+            vals = self._slot(name, "counter", help)["values"]
+            k = self._label_key(labels)
+            vals[k] = vals.get(k, 0.0) + inc
+
+    def gauge(self, name: str, value: float, labels: dict | None = None,
+              help: str = "") -> None:
+        with self._lock:
+            self._slot(name, "gauge", help)["values"][self._label_key(labels)] = (
+                float(value)
+            )
+
+    def observe(self, name: str, value: float, labels: dict | None = None,
+                help: str = "") -> None:
+        with self._lock:
+            vals = self._slot(name, "summary", help)["values"]
+            k = self._label_key(labels)
+            acc = vals.get(k)
+            if acc is None:
+                acc = vals[k] = [0.0, 0.0]
+            acc[0] += float(value)
+            acc[1] += 1.0
+
+    def get(self, name: str, labels: dict | None = None):
+        """Current value (float for counter/gauge, (sum, count) for summary),
+        or None — the test/introspection read side."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                return None
+            v = m["values"].get(self._label_key(labels))
+            return tuple(v) if isinstance(v, list) else v
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def render(self) -> str:
+        """Prometheus text format 0.0.4 (the GET /metrics body)."""
+
+        def esc(v: str) -> str:
+            return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+        lines: list[str] = []
+        with self._lock:
+            for name in sorted(self._metrics):
+                m = self._metrics[name]
+                if m["help"]:
+                    lines.append(f"# HELP {name} {m['help']}")
+                lines.append(f"# TYPE {name} {m['type']}")
+                for key, v in sorted(m["values"].items()):
+                    lbl = (
+                        "{" + ",".join(f'{k}="{esc(val)}"' for k, val in key) + "}"
+                        if key else ""
+                    )
+                    if m["type"] == "summary":
+                        lines.append(f"{name}_sum{lbl} {v[0]:.9g}")
+                        lines.append(f"{name}_count{lbl} {v[1]:.9g}")
+                    else:
+                        lines.append(f"{name}{lbl} {v:.9g}")
+        return "\n".join(lines) + "\n"
+
+
+# The process-wide registry every instrumentation site writes to (serving/,
+# server.py) and GET /metrics renders. Tests may reset() it.
+registry = MetricsRegistry()
 
 
 @dataclasses.dataclass
@@ -99,19 +199,21 @@ def force_ready(v) -> float:
     return float(np.asarray(jnp.sum(v.astype(jnp.float32))))
 
 
-def chained_time(step, x0, iters: int):
+def chained_time(step, x0, iters: int, warmup: int = 2):
     """Tunnel-proof mean seconds per ``step`` call.
 
     ``step`` must map an array to a like-shaped array (denoise models and
     attention both do). Each iteration feeds its output back as the next
     input, making the timed region one serial dependency chain — no runtime
     can skip, dedupe, or overlap it — and it closes with a ``force_ready``
-    readback. Two warmup calls run first so both the original and the
-    chained dtype signatures are compiled outside the timed region.
+    readback. ``warmup`` calls (>= 2 — both the original and the chained
+    dtype signatures must compile outside the timed region) run first; the
+    count is explicit so bench.py can pin and record the protocol.
 
     Returns ``(sec_per_iter, last_output)``."""
     out = step(x0)
-    out = step(out)
+    for _ in range(max(2, warmup) - 1):
+        out = step(out)
     force_ready(out)
     run = out
     t0 = time.perf_counter()

@@ -11,23 +11,90 @@ and sets the interrupt flag from ``POST /interrupt`` so the *running* prompt
 stops between steps, not just the pending ones.
 
 The hook is a process-wide single slot (one accelerator, one serial prompt
-worker — the server's execution model); ``set_progress_hook`` returns the
-previous hook so scoped installs nest correctly.
+worker — the server's original execution model); ``set_progress_hook`` returns
+the previous hook so scoped installs nest correctly.
+
+Concurrent serving (round 7, serving/) outgrew the single slot: with several
+prompt workers in flight at once, one prompt's Cancel must not kill its
+neighbor, and each prompt's ``progress`` events must carry its own hook. The
+``progress_scope`` context manager installs a PER-THREAD (hook, preview,
+interrupt-event) triple that shadows the process-wide slots for code running
+on that thread; the continuous-batching scheduler captures the submitting
+thread's scope at admission and drives the per-lane hooks/cancel from its
+dispatcher thread. The process-wide flag keeps its original semantics (any
+thread's ``request_interrupt`` stops any running loop at its next boundary)
+so existing single-worker callers are untouched.
 """
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Callable, Optional
 
 _hook: Optional[Callable[[int, int], None]] = None
 _preview_hook: Optional[Callable[[object], None]] = None
 _interrupt = threading.Event()
+_scope_local = threading.local()
 
 
 class Interrupted(RuntimeError):
     """Raised between sampler steps after ``request_interrupt()`` — the
     cooperative analogue of ComfyUI's InterruptProcessingException."""
+
+
+class ProgressScope:
+    """One thread's (hook, preview, interrupt-event) triple — the per-prompt
+    analogue of the process-wide slots. ``interrupt_event`` is a one-shot
+    per-prompt Cancel: fresh per scope, so the stale-flag races the global
+    Event needs clear_interrupt choreography for cannot exist here."""
+
+    __slots__ = ("hook", "preview_hook", "interrupt_event")
+
+    def __init__(self, hook=None, preview_hook=None, interrupt_event=None):
+        self.hook = hook
+        self.preview_hook = preview_hook
+        self.interrupt_event = interrupt_event
+
+
+@contextlib.contextmanager
+def progress_scope(hook=None, preview_hook=None, interrupt_event=None):
+    """Install a per-thread ProgressScope for the duration of the block
+    (shadowing the process-wide slots on THIS thread only); nests — the
+    previous scope is restored on exit."""
+    prev = getattr(_scope_local, "scope", None)
+    scope = ProgressScope(hook, preview_hook, interrupt_event)
+    _scope_local.scope = scope
+    try:
+        yield scope
+    finally:
+        _scope_local.scope = prev
+
+
+def current_scope() -> Optional[ProgressScope]:
+    """The calling thread's active ProgressScope, or None (global-slot mode).
+    The serving scheduler captures this at submit time so its dispatcher
+    thread can drive the submitting prompt's hooks and honor its Cancel."""
+    return getattr(_scope_local, "scope", None)
+
+
+def current_progress_hook() -> Optional[Callable[[int, int], None]]:
+    """The hook ``report_progress`` would fire on this thread right now
+    (scope hook if one is installed, else the process-wide slot)."""
+    scope = current_scope()
+    if scope is not None and scope.hook is not None:
+        return scope.hook
+    return _hook
+
+
+def current_preview_hook() -> Optional[Callable[[object], None]]:
+    """The preview hook active on this thread (scope first, then the
+    process-wide slot) — the serving scheduler keeps preview-enabled work
+    inline, since only the inline loops carry the preview channel."""
+    scope = current_scope()
+    if scope is not None and scope.preview_hook is not None:
+        return scope.preview_hook
+    return _preview_hook
 
 
 def set_progress_hook(fn: Optional[Callable[[int, int], None]]):
@@ -71,6 +138,12 @@ def check_interrupt(where: str = "between nodes") -> None:
     latter so a Cancel landing inside a non-sampler node (VAE decode, a slow
     checkpoint load) still stops the prompt, matching ComfyUI's per-node
     interrupt check."""
+    scope = current_scope()
+    if (scope is not None and scope.interrupt_event is not None
+            and scope.interrupt_event.is_set()):
+        # Per-prompt Cancel (not consumed: the event is one-shot per scope,
+        # and the serving scheduler watches the same event for its lanes).
+        raise Interrupted(f"interrupted {where}")
     if _interrupt.is_set():
         _interrupt.clear()
         raise Interrupted(f"interrupted {where}")
@@ -79,9 +152,16 @@ def check_interrupt(where: str = "between nodes") -> None:
 def report_progress(value: int, max_value: int, latent=None) -> None:
     """One sampler step completed: notify the hook (and the preview hook with
     the current latent, when both are present), then honor a pending
-    interrupt."""
-    if _hook is not None:
-        _hook(value, max_value)
-    if _preview_hook is not None and latent is not None:
-        _preview_hook(latent)
+    interrupt. A per-thread scope shadows the process-wide slots."""
+    scope = current_scope()
+    hook = scope.hook if scope is not None and scope.hook is not None else _hook
+    preview = (
+        scope.preview_hook
+        if scope is not None and scope.preview_hook is not None
+        else _preview_hook
+    )
+    if hook is not None:
+        hook(value, max_value)
+    if preview is not None and latent is not None:
+        preview(latent)
     check_interrupt(f"at step {value}/{max_value}")

@@ -1,0 +1,197 @@
+"""Closed-loop load generator for the workflow server (stdlib-only).
+
+Drives N concurrent clients against a running server: each client POSTs its
+prompt graph, blocks until the prompt completes (polling ``/history/{id}``),
+and immediately submits the next — the closed loop that makes offered load
+equal to in-flight concurrency, which is the regime continuous batching
+(serving/) is built for. Prints ONE JSON summary line: latency percentiles,
+throughput, HTTP 429 rejections, and the serving dispatch/occupancy counters
+scraped from ``GET /metrics`` — so a run shows not just *how fast* but *how
+batched* (BASELINE.md "serving" metric).
+
+Usage:
+    python scripts/loadgen.py --graph workflow.json \
+        [--base http://127.0.0.1:8188] [--clients 4] [--requests 2] \
+        [--timeout 300] [--seed-key 3:inputs:seed]
+
+``--seed-key`` (node:path:to:field) makes every submission unique by writing
+the request counter into that graph field — defeating the workflow cache so
+each prompt actually samples (the default for KSampler graphs: vary the seed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+def _get(base: str, path: str, timeout: float = 30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        body = r.read()
+    ct = r.headers.get("Content-Type", "")
+    return json.loads(body) if "json" in ct else body.decode()
+
+
+def _post(base: str, path: str, payload: dict, timeout: float = 30):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait_done(base: str, pid: str, timeout: float):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        hist = _get(base, f"/history/{pid}")
+        if pid in hist:
+            return hist[pid]
+        time.sleep(0.05)
+    raise TimeoutError(f"prompt {pid} never completed")
+
+
+def _set_path(graph: dict, dotted: str, value):
+    """Write ``value`` at ``node:inputs:field`` (colon-separated path)."""
+    parts = dotted.split(":")
+    node = graph
+    for p in parts[:-1]:
+        node = node[p]
+    node[parts[-1]] = value
+
+
+def _serving_counters(base: str) -> dict:
+    """Scrape the serving counters from the Prometheus text endpoint."""
+    try:
+        text = _get(base, "/metrics")
+    except (urllib.error.URLError, OSError):
+        return {}
+    out: dict[str, float] = {}
+    for name in ("pa_serving_dispatch_total", "pa_serving_completed_total",
+                 "pa_serving_cancelled_total", "pa_serving_rejected_total"):
+        total = 0.0
+        found = False
+        for m in re.finditer(rf"^{name}(?:\{{[^}}]*\}})? ([0-9.eE+-]+)$",
+                             text, re.M):
+            total += float(m.group(1))
+            found = True
+        if found:
+            out[name] = total
+    return out
+
+
+def percentile(samples: list[float], q: float) -> float:
+    """Nearest-rank percentile (no numpy — stdlib-only by contract)."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    k = max(0, min(len(s) - 1, round(q / 100.0 * (len(s) - 1))))
+    return s[k]
+
+
+def run_load(base: str, graph: dict, *, clients: int, requests: int,
+             timeout: float, seed_key: str | None = None,
+             extra_data: dict | None = None) -> dict:
+    """The closed loop; returns the summary dict (importable — the e2e test
+    drives an in-process server through this exact code path)."""
+    latencies: list[float] = []
+    failures: list[str] = []
+    rejected = [0]
+    lock = threading.Lock()
+    counter = [0]
+    before = _serving_counters(base)
+    t_start = time.time()
+
+    def client(ci: int) -> None:
+        for _ in range(requests):
+            g = json.loads(json.dumps(graph))
+            with lock:
+                counter[0] += 1
+                n = counter[0]
+            if seed_key:
+                _set_path(g, seed_key, n)
+            payload = {"prompt": g}
+            if extra_data:
+                payload["extra_data"] = extra_data
+            t0 = time.time()
+            try:
+                pid = _post(base, "/prompt", payload)["prompt_id"]
+            except urllib.error.HTTPError as e:
+                with lock:
+                    if e.code == 429:
+                        rejected[0] += 1
+                    else:
+                        failures.append(f"client {ci}: HTTP {e.code}")
+                continue
+            entry = _wait_done(base, pid, timeout)
+            dt = time.time() - t0
+            with lock:
+                if entry["status"].get("status_str") == "success":
+                    latencies.append(dt)
+                else:
+                    failures.append(
+                        f"client {ci}: {entry['status'].get('status_str')}"
+                    )
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t_start
+    after = _serving_counters(base)
+    return {
+        "clients": clients,
+        "requests": clients * requests,
+        "completed": len(latencies),
+        "failed": len(failures),
+        "rejected_429": rejected[0],
+        "wall_s": round(wall, 3),
+        "throughput_rps": round(len(latencies) / wall, 3) if wall > 0 else None,
+        "latency_p50_s": round(percentile(latencies, 50), 3),
+        "latency_p95_s": round(percentile(latencies, 95), 3),
+        "latency_max_s": round(max(latencies), 3) if latencies else 0.0,
+        "serving_dispatches": (
+            after.get("pa_serving_dispatch_total", 0.0)
+            - before.get("pa_serving_dispatch_total", 0.0)
+        ) if after else None,
+        "errors": failures[:5],
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--base", default="http://127.0.0.1:8188")
+    ap.add_argument("--graph", required=True,
+                    help="workflow JSON file (ComfyUI /prompt API format)")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=2,
+                    help="prompts per client (closed loop)")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--seed-key", default=None,
+                    help="colon path (node:inputs:seed) made unique per prompt")
+    ap.add_argument("--priority", type=int, default=None)
+    ap.add_argument("--deadline-s", type=float, default=None)
+    args = ap.parse_args()
+    with open(args.graph) as f:
+        graph = json.load(f)
+    extra = {}
+    if args.priority is not None:
+        extra["priority"] = args.priority
+    if args.deadline_s is not None:
+        extra["deadline_s"] = args.deadline_s
+    summary = run_load(
+        args.base, graph, clients=args.clients, requests=args.requests,
+        timeout=args.timeout, seed_key=args.seed_key,
+        extra_data=extra or None,
+    )
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,492 @@
+"""Continuous-batching serving subsystem (serving/): equivalence of shared-
+batch sampling vs serial, step-boundary join/leave, per-lane cancel, policy,
+and the dispatch-count batching effect — all off-hardware (CPU + the 8-device
+virtual mesh), with deterministic manual pumping (``auto=False``)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu import DeviceChain, parallelize
+from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+from comfyui_parallelanything_tpu.serving import (
+    AdmissionQueue,
+    ContinuousBatchingScheduler,
+    DeadlineExceeded,
+    ServingRejected,
+    get_scheduler,
+)
+from comfyui_parallelanything_tpu.utils.metrics import registry
+from comfyui_parallelanything_tpu.utils.progress import (
+    Interrupted,
+    progress_scope,
+)
+
+# bf16-scale tolerances (CLAUDE.md: this XLA CPU runs f32 matmuls at bf16).
+TOL = dict(rtol=2e-3, atol=1e-4)
+
+
+def tiny_model(x, t, context=None, **kw):
+    """Per-sample-independent stand-in denoiser: every output element depends
+    only on its own sample's latent/t/context — the property that makes
+    co-batching result-stable, which the equivalence tests then verify."""
+    c = jnp.mean(context, axis=tuple(range(1, context.ndim)))
+    c = c.reshape((-1,) + (1,) * (x.ndim - 1))
+    tt = t.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.tanh(x * 0.9 + c * 0.1) * (0.5 + 0.1 * tt / 1000.0)
+
+
+def mk_inputs(seed, batch=1):
+    r = np.random.default_rng(seed)
+    noise = jnp.asarray(r.normal(size=(batch, 8, 8, 4)).astype(np.float32))
+    ctx = jnp.asarray(r.normal(size=(batch, 6, 16)).astype(np.float32))
+    return noise, ctx
+
+
+@pytest.fixture
+def sched():
+    s = ContinuousBatchingScheduler(max_width=4, auto=False).install()
+    try:
+        yield s
+    finally:
+        s.uninstall()
+        s.shutdown()
+
+
+def _bg(fn, *args):
+    t = threading.Thread(target=fn, args=args, daemon=True)
+    t.start()
+    return t
+
+
+def _wait_enqueued(s, n, timeout=20):
+    """Block until >= n requests are visible to the scheduler (queued or
+    seated) — the deterministic submit/pump handshake for manual mode."""
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        with s._lock:
+            tot = sum(
+                len(b.queue) + len(b.active_lanes())
+                for b in s.buckets.values()
+            )
+        if tot >= n:
+            return
+        time.sleep(0.005)
+    raise TimeoutError(f"never saw {n} enqueued requests")
+
+
+class TestEquivalence:
+    def test_concurrent_ragged_batch_matches_serial(self, sched):
+        """Acceptance: prompts sampled inside a shared batch (unrelated
+        co-resident lanes, ragged schedules) match their serial twins; N
+        concurrent prompts cost ~max(steps) dispatches, not sum(steps)."""
+        plans = [(1, 4), (2, 6), (3, 8)]
+        sched.uninstall()
+        serial = {
+            s: run_sampler(tiny_model, *mk_inputs(s), sampler="euler", steps=n)
+            for s, n in plans
+        }
+        sched.install()
+        results = {}
+
+        def worker(seed, steps):
+            noise, ctx = mk_inputs(seed)
+            results[seed] = run_sampler(
+                tiny_model, noise, ctx, sampler="euler", steps=steps
+            )
+
+        threads = [_bg(worker, s, n) for s, n in plans]
+        _wait_enqueued(sched, len(plans))
+        sched.drain()
+        for t in threads:
+            t.join(20)
+        assert sched.total_dispatches() <= 8 + 2  # max steps + join slack
+        [b] = sched.buckets.values()  # one key → one bucket
+        for s, _ in plans:
+            np.testing.assert_allclose(
+                np.asarray(results[s]), np.asarray(serial[s]), **TOL
+            )
+
+    def test_mid_flight_join_matches_serial(self, sched):
+        """A request entering mid-flight joins at a step boundary with its own
+        per-lane step state and still reproduces its serial result."""
+        sched.uninstall()
+        serial_a = run_sampler(tiny_model, *mk_inputs(10), sampler="euler",
+                               steps=8)
+        serial_b = run_sampler(tiny_model, *mk_inputs(11), sampler="euler",
+                               steps=4)
+        sched.install()
+        results = {}
+
+        def worker(seed, steps):
+            noise, ctx = mk_inputs(seed)
+            results[seed] = run_sampler(
+                tiny_model, noise, ctx, sampler="euler", steps=steps
+            )
+
+        ta = _bg(worker, 10, 8)
+        _wait_enqueued(sched, 1)
+        for _ in range(3):
+            sched.pump()  # A is 3 steps in...
+        tb = _bg(worker, 11, 4)
+        _wait_enqueued(sched, 2)  # ...when B arrives (A seated + B queued)
+        start = sched.total_dispatches()
+        sched.drain()
+        ta.join(20)
+        tb.join(20)
+        # B rode along inside A's remaining 5 dispatches — no extra cost.
+        assert sched.total_dispatches() - start <= 5 + 1
+        np.testing.assert_allclose(np.asarray(results[10]),
+                                   np.asarray(serial_a), **TOL)
+        np.testing.assert_allclose(np.asarray(results[11]),
+                                   np.asarray(serial_b), **TOL)
+
+    def test_cfg_lanes_match_serial(self, sched):
+        """Per-lane cfg_scale: two co-resident CFG requests with DIFFERENT
+        guidance scales each match their serial twin."""
+        plans = [(21, 5, 7.5), (22, 5, 3.0)]
+        sched.uninstall()
+        serial = {}
+        for s, n, cfg in plans:
+            noise, ctx = mk_inputs(s)
+            _, uctx = mk_inputs(s + 100)
+            serial[s] = run_sampler(
+                tiny_model, noise, ctx, sampler="euler", steps=n,
+                cfg_scale=cfg, uncond_context=uctx,
+            )
+        sched.install()
+        results = {}
+
+        def worker(seed, steps, cfg):
+            noise, ctx = mk_inputs(seed)
+            _, uctx = mk_inputs(seed + 100)
+            results[seed] = run_sampler(
+                tiny_model, noise, ctx, sampler="euler", steps=steps,
+                cfg_scale=cfg, uncond_context=uctx,
+            )
+
+        threads = [_bg(worker, *p) for p in plans]
+        _wait_enqueued(sched, 2)
+        sched.drain()
+        for t in threads:
+            t.join(20)
+        for s, _, _ in plans:
+            np.testing.assert_allclose(np.asarray(results[s]),
+                                       np.asarray(serial[s]), **TOL)
+
+    def test_flow_prediction_matches_serial(self, sched):
+        """prediction="flow" lanes (FLUX-family k-sampler path): flow time
+        rides per-lane, guidance kwarg stacks per-lane."""
+        sched.uninstall()
+        noise, ctx = mk_inputs(31)
+        serial = run_sampler(tiny_model, noise, ctx, sampler="euler", steps=5,
+                             prediction="flow", shift=1.15, guidance=3.5)
+        sched.install()
+        results = {}
+
+        def worker():
+            n, c = mk_inputs(31)
+            results[0] = run_sampler(
+                tiny_model, n, c, sampler="euler", steps=5,
+                prediction="flow", shift=1.15, guidance=3.5,
+            )
+
+        t = _bg(worker)
+        _wait_enqueued(sched, 1)
+        sched.drain()
+        t.join(20)
+        np.testing.assert_allclose(np.asarray(results[0]), np.asarray(serial),
+                                   **TOL)
+        assert sched.total_dispatches() == 5
+
+    def test_mesh_batch_matches_serial(self, sched, cpu_devices):
+        """Acceptance: same equivalence on the 8-device virtual mesh — bucket
+        programs compose with the orchestrator's data sharding (lane axis =
+        batch axis, width rounded to the mesh's data width)."""
+        rng = np.random.default_rng(0)
+        params = {
+            "w": jnp.asarray(rng.normal(size=(4, 4)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(4,)), jnp.float32),
+        }
+
+        def toy_apply(p, x, t, context=None, **kw):
+            h = jnp.tanh(x @ p["w"] * 0.1 + p["b"]) * 0.8
+            h = h * jnp.cos(t * 1e-3)[:, None]
+            return h + 0.01 * context.sum(axis=-1, keepdims=True)
+
+        chain = DeviceChain.even([f"cpu:{i}" for i in range(8)])
+        pm = parallelize((toy_apply, params), chain)
+
+        def mk(seed):
+            r = np.random.default_rng(seed)
+            return (jnp.asarray(r.normal(size=(2, 4)), jnp.float32),
+                    jnp.asarray(r.normal(size=(2, 6)), jnp.float32))
+
+        sched.uninstall()
+        serial = {
+            s: run_sampler(pm, *mk(s), sampler="euler", steps=n)
+            for s, n in [(41, 4), (42, 6)]
+        }
+        sched.install()
+        results = {}
+
+        def worker(seed, steps):
+            noise, ctx = mk(seed)
+            results[seed] = run_sampler(pm, noise, ctx, sampler="euler",
+                                        steps=steps)
+
+        threads = [_bg(worker, s, n) for s, n in [(41, 4), (42, 6)]]
+        _wait_enqueued(sched, 2)
+        sched.drain()
+        for t in threads:
+            t.join(20)
+        [bucket] = sched.buckets.values()
+        assert bucket.width == 8  # rounded up to the mesh's data width
+        assert sched.total_dispatches() <= 6 + 1
+        for s in (41, 42):
+            np.testing.assert_allclose(np.asarray(results[s]),
+                                       np.asarray(serial[s]), **TOL)
+
+
+class TestCancelAndPolicy:
+    def test_cancel_frees_lane_without_perturbing_neighbors(self, sched):
+        """Acceptance: cancelling one lane mid-batch frees its slot; the other
+        lane's output is identical to its serial run; the freed slot seats a
+        later request."""
+        sched.uninstall()
+        serial_a = run_sampler(tiny_model, *mk_inputs(51), sampler="euler",
+                               steps=8)
+        sched.install()
+        results, errors = {}, {}
+
+        def worker(seed, steps, evt=None):
+            try:
+                noise, ctx = mk_inputs(seed)
+                if evt is not None:
+                    with progress_scope(interrupt_event=evt):
+                        results[seed] = run_sampler(
+                            tiny_model, noise, ctx, sampler="euler",
+                            steps=steps,
+                        )
+                else:
+                    results[seed] = run_sampler(
+                        tiny_model, noise, ctx, sampler="euler", steps=steps
+                    )
+            except BaseException as e:  # noqa: BLE001 — assertion target
+                errors[seed] = e
+
+        evt = threading.Event()
+        ta = _bg(worker, 51, 8)
+        tb = _bg(worker, 52, 8, evt)
+        _wait_enqueued(sched, 2)
+        for _ in range(3):
+            sched.pump()
+        evt.set()  # per-lane cancel (the per-prompt scope event)
+        sched.pump()
+        [bucket] = sched.buckets.values()
+        assert len(bucket.active_lanes()) == 1  # B's slot freed at boundary
+        tc = _bg(worker, 53, 2)
+        _wait_enqueued(sched, 2)  # A still seated + C queued
+        sched.drain()
+        for t in (ta, tb, tc):
+            t.join(20)
+        assert isinstance(errors.get(52), Interrupted)
+        assert 53 in results  # freed slot was reused
+        np.testing.assert_allclose(np.asarray(results[51]),
+                                   np.asarray(serial_a), **TOL)
+
+    def test_cancel_by_request_id_while_queued(self, sched):
+        done = {}
+
+        def worker():
+            noise, ctx = mk_inputs(61)
+            try:
+                done["out"] = run_sampler(tiny_model, noise, ctx,
+                                          sampler="euler", steps=50)
+            except BaseException as e:  # noqa: BLE001
+                done["err"] = e
+
+        t = _bg(worker)
+        _wait_enqueued(sched, 1)
+        [bucket] = sched.buckets.values()
+        rid = None
+        with bucket.queue._lock:
+            rid = bucket.queue._heap[0][2].rid
+        assert sched.cancel(rid)
+        t.join(20)
+        assert isinstance(done.get("err"), Interrupted)
+
+    def test_deadline_expired_in_queue(self, sched):
+        from comfyui_parallelanything_tpu.serving.scheduler import serving_hints
+
+        done = {}
+
+        def worker():
+            noise, ctx = mk_inputs(71)
+            try:
+                with serving_hints(deadline_s=0.0):
+                    done["out"] = run_sampler(tiny_model, noise, ctx,
+                                              sampler="euler", steps=5)
+            except BaseException as e:  # noqa: BLE001
+                done["err"] = e
+
+        t = _bg(worker)
+        _wait_enqueued(sched, 1)
+        time.sleep(0.01)
+        sched.pump()
+        t.join(20)
+        assert isinstance(done.get("err"), DeadlineExceeded)
+
+    def test_priority_fifo_ordering(self):
+        q = AdmissionQueue(max_waiting=8)
+
+        class R:
+            def __init__(self, rid, priority):
+                self.rid, self.priority = rid, priority
+
+        for rid, pr in [("a", 0), ("b", 5), ("c", 0), ("d", 5)]:
+            q.push(R(rid, pr))
+        assert [q.pop().rid for _ in range(4)] == ["b", "d", "a", "c"]
+        assert q.pop() is None
+
+    def test_bounded_depth_rejects(self):
+        q = AdmissionQueue(max_waiting=2)
+
+        class R:
+            rid, priority = "x", 0
+
+        q.push(R())
+        q.push(R())
+        with pytest.raises(ServingRejected):
+            q.push(R())
+
+    def test_overflow_falls_back_inline(self):
+        """A full admission queue must degrade to inline execution (correct
+        result, no batching), never an error — HTTP backpressure is the
+        server's job, not the sampler's."""
+        s = ContinuousBatchingScheduler(max_width=1, max_waiting=1,
+                                        auto=False).install()
+        try:
+            blocker = _bg(
+                lambda: run_sampler(tiny_model, *mk_inputs(81),
+                                    sampler="euler", steps=3)
+            )
+            _wait_enqueued(s, 1)
+            # Queue now holds the blocker; this submission overflows and runs
+            # inline on the calling thread — no pump needed for it to finish.
+            out = run_sampler(tiny_model, *mk_inputs(82), sampler="euler",
+                              steps=3)
+            assert out.shape == (1, 8, 8, 4)
+            assert (registry.get("pa_serving_rejected_total",
+                                 {"bucket": list(s.buckets.values())[0].label})
+                    or 0) >= 1
+            s.drain()
+            blocker.join(20)
+        finally:
+            s.uninstall()
+            s.shutdown()
+
+
+class TestModesAndMetrics:
+    def test_streaming_model_runs_width_1(self, sched):
+        """A weight-streaming-style model (not single-program traceable) gets
+        step-boundary scheduling at width 1 — eager per-step, serial-exact."""
+
+        class StreamingModel:
+            is_streaming = True
+
+            def __call__(self, x, t, context=None, **kw):
+                return tiny_model(x, t, context)
+
+        model = StreamingModel()
+        sched.uninstall()
+        serial = run_sampler(model, *mk_inputs(91), sampler="euler", steps=4)
+        sched.install()
+        results = {}
+
+        def worker():
+            noise, ctx = mk_inputs(91)
+            results[0] = run_sampler(model, noise, ctx, sampler="euler",
+                                     steps=4)
+
+        t = _bg(worker)
+        _wait_enqueued(sched, 1)
+        sched.drain()
+        t.join(20)
+        [bucket] = sched.buckets.values()
+        assert bucket.width == 1 and bucket.spec is None
+        np.testing.assert_allclose(np.asarray(results[0]), np.asarray(serial),
+                                   **TOL)
+
+    def test_preview_enabled_work_stays_inline(self, sched):
+        """Latent previews only exist on the inline loops (report_progress is
+        the sole preview call site) — a preview-scoped prompt must never lose
+        its frames to a lane."""
+        frames = []
+        noise, ctx = mk_inputs(94)
+        with progress_scope(preview_hook=frames.append):
+            out = run_sampler(tiny_model, noise, ctx, sampler="euler", steps=3)
+        assert out.shape == noise.shape
+        assert len(frames) == 3  # one per step, emitted inline
+        assert not sched.buckets  # nothing was admitted
+
+    def test_rng_and_callback_work_stays_inline(self, sched):
+        """Stochastic samplers and callback runs never enter a bucket."""
+        noise, ctx = mk_inputs(95)
+        out = run_sampler(tiny_model, noise, ctx, sampler="euler_ancestral",
+                          steps=2, rng=jax.random.key(0))
+        assert out.shape == noise.shape
+        out2 = run_sampler(tiny_model, noise, ctx, sampler="euler", steps=2,
+                           callback=lambda i, x: None)
+        assert out2.shape == noise.shape
+        assert not sched.buckets  # nothing was admitted
+
+    def test_uninstalled_scheduler_is_inert(self):
+        assert get_scheduler() is None
+        noise, ctx = mk_inputs(96)
+        out = run_sampler(tiny_model, noise, ctx, sampler="euler", steps=2)
+        assert out.shape == noise.shape
+
+    def test_serving_metrics_populate_and_render(self, sched):
+        def worker():
+            noise, ctx = mk_inputs(97)
+            run_sampler(tiny_model, noise, ctx, sampler="euler", steps=3)
+
+        t = _bg(worker)
+        _wait_enqueued(sched, 1)
+        sched.drain()
+        t.join(20)
+        [bucket] = sched.buckets.values()
+        labels = {"bucket": bucket.label}
+        assert registry.get("pa_serving_dispatch_total", labels) >= 3
+        assert registry.get("pa_serving_completed_total", labels) >= 1
+        assert registry.get("pa_serving_occupancy", labels) == 0  # drained
+        wait_sum, wait_count = registry.get("pa_serving_lane_wait_seconds",
+                                            labels)
+        assert wait_count >= 1 and wait_sum >= 0.0
+        step_sum, step_count = registry.get("pa_serving_step_seconds", labels)
+        assert step_count >= 3 and step_sum > 0.0
+        text = registry.render()
+        assert "# TYPE pa_serving_dispatch_total counter" in text
+        assert "pa_serving_step_seconds_sum" in text
+
+    def test_progress_hooks_fire_per_lane(self, sched):
+        seen = {1: [], 2: []}
+
+        def worker(seed, steps):
+            noise, ctx = mk_inputs(seed + 200)
+            with progress_scope(hook=lambda v, m, _s=seed: seen[_s].append((v, m))):
+                run_sampler(tiny_model, noise, ctx, sampler="euler",
+                            steps=steps)
+
+        t1, t2 = _bg(worker, 1, 3), _bg(worker, 2, 5)
+        _wait_enqueued(sched, 2)
+        sched.drain()
+        t1.join(20)
+        t2.join(20)
+        assert seen[1] == [(1, 3), (2, 3), (3, 3)]
+        assert seen[2] == [(i, 5) for i in range(1, 6)]
