@@ -712,6 +712,21 @@ def run_inner() -> None:
 
     step = _make_step(pm, batch, n_chunks, t, ctx, kwargs)
 
+    # Span tracing (round 8, utils/tracing.py): every benchmarked iteration
+    # runs traced — per-span cost is ~µs against multi-second denoise steps —
+    # so every JSON line carries the trace-derived aggregates
+    # (stream_overlap_efficiency / lane_wait_p95 / host_gap_ms) and
+    # PA_TRACE_OUT (the --trace-out flag) can dump the full Perfetto
+    # timeline without a second run.
+    from comfyui_parallelanything_tpu.utils import tracing
+
+    tracing.enable()
+    inner_step = step
+
+    def step(v):
+        with tracing.span("step", cat="bench", rung=config_name):
+            return inner_step(v)
+
     # Warmup/compile + timed denoise-step iterations, tunnel-proof: the axon
     # plugin's block_until_ready returned in 2.8 ms for a 43-TFLOP step (~80x
     # the chip's peak), so chained_time chains each iteration's output into
@@ -726,6 +741,14 @@ def run_inner() -> None:
     if os.environ.get("PA_BENCH_TINY") == "1":
         iters = 3  # dry-run: control flow under test, not timing fidelity
     sec_it, _ = chained_time(step, x, iters, warmup=BENCH_WARMUP_STEPS)
+
+    trace_events = tracing.export()
+    trace_aggs = tracing.trace_aggregates(trace_events)
+    trace_out = os.environ.get("PA_TRACE_OUT")
+    if trace_out:
+        with open(trace_out, "w") as f:
+            json.dump(trace_events, f)
+        sys.stderr.write(f"bench: trace written to {trace_out}\n")
 
     # MFU: analytic step FLOPs / time / aggregate peak. TPU only (CPU peak is
     # not meaningful for MXU utilization).
@@ -766,6 +789,12 @@ def run_inner() -> None:
         "bench_iters": iters,
         "warmup_steps": BENCH_WARMUP_STEPS,
         "loadavg_1m": _loadavg_1m(),
+        # Trace-derived aggregates (utils/tracing.py): stream compute
+        # occupancy of the streamed-run wall clock (null off the stream
+        # rung), serving lane-wait p95 (null without serving traffic), and
+        # the mean host gap between step spans — where host scheduling
+        # overhead shows up before any device profile is opened.
+        **trace_aggs,
         # Which attention path(s) actually served the run, resolved at trace
         # time ("pallas", "xla", or "pallas+xla" when different shapes picked
         # differently) — so the evidence never hides an XLA fallback behind an
@@ -866,15 +895,36 @@ def _tpu_probe(timeout=120, attempts=2):
 
 def _error_line(error, metric="error"):
     """The one failure-path JSON schema — every error exit goes through here so
-    the driver always sees a consistent field set."""
+    the driver always sees a consistent field set (including the trace-derived
+    aggregate fields every bench line now carries, null here)."""
     return json.dumps({
         "metric": metric, "value": 0, "unit": "", "vs_baseline": None,
         "platform": "none", "n_devices": 0, "error": error[:300],
         "loadavg_1m": _loadavg_1m(),
+        "stream_overlap_efficiency": None, "lane_wait_p95": None,
+        "host_gap_ms": None,
     })
 
 
+def _pop_trace_out_flag() -> None:
+    """Honor ``--trace-out PATH`` (and ``--trace-out=PATH``) by exporting
+    PA_TRACE_OUT for the inner child (both spellings also work set directly
+    in the environment). Parsed by hand: bench.py's only other argv surface
+    is the ``--inner`` sentinel, and argparse would reject it."""
+    argv = sys.argv
+    for i, a in enumerate(list(argv)):
+        if a == "--trace-out" and i + 1 < len(argv):
+            os.environ["PA_TRACE_OUT"] = os.path.abspath(argv[i + 1])
+            del argv[i:i + 2]
+            return
+        if a.startswith("--trace-out="):
+            os.environ["PA_TRACE_OUT"] = os.path.abspath(a.split("=", 1)[1])
+            del argv[i]
+            return
+
+
 def main() -> None:
+    _pop_trace_out_flag()
     if "--inner" in sys.argv:
         run_inner()
         return
@@ -926,6 +976,11 @@ def _orchestrate() -> None:
             out["stale_reason"] = fallback_cause
             out["captured_ts"] = out.get("ts")
             out["loadavg_1m"] = _loadavg_1m()  # load NOW, not at capture
+            # Records banked before round 8 predate the trace-derived
+            # aggregates; the schema stays uniform (nulls, never absent).
+            for field in ("stream_overlap_efficiency", "lane_wait_p95",
+                          "host_gap_ms"):
+                out.setdefault(field, None)
             sys.stderr.write(
                 f"bench: emitting stale banked TPU record for rung "
                 f"{out.get('rung')!r} (captured ts {out.get('ts')}) — "

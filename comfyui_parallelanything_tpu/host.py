@@ -38,6 +38,7 @@ import threading
 from typing import Any
 
 
+from .utils import tracing
 from .utils.progress import Interrupted, check_interrupt
 
 
@@ -372,7 +373,14 @@ def run_workflow(
             on_node(nid)
         fn = getattr(cls(), cls.FUNCTION)
         try:
-            out = fn(**kwargs)
+            # One workflow-node span per executed node (cached nodes never
+            # reach here) — the graph layer of the per-prompt timeline; the
+            # prompt_id correlation rides the thread's progress scope.
+            with tracing.span(
+                "workflow-node", cat="graph", node=nid,
+                class_type=spec.get("class_type"),
+            ):
+                out = fn(**kwargs)
         except (WorkflowError, Interrupted):
             raise
         except Exception as e:

@@ -53,6 +53,7 @@ import jax
 from ..devices.memory import ResidencyTracker
 from ..models.api import PipelineSpec
 from ..models.loader import carve_stages, params_nbytes, pin_params_host
+from ..utils import tracing
 from ..utils.logging import get_logger, log_placement
 from .split import partition_kwargs, static_kwargs_key
 
@@ -268,45 +269,128 @@ class StreamingRunner:
             )
         traced, static = partition_kwargs(kwargs)
         dev = self.device
-        carry = self._prepare_for(static)(
-            self._prepare_params,
-            jax.device_put(x, dev),
-            jax.device_put(timesteps, dev),
-            jax.device_put(context, dev) if context is not None else None,
-            {k: jax.device_put(v, dev) for k, v in traced.items()},
-        )
-        ring: dict[int, Any] = {0: self._place_stage(0)}
-        prev_out = None  # output of stage k-1 — the backpressure handle
-        try:
-            for k, stage in enumerate(self.stages):
-                if prev_out is not None:
-                    # Wait for stage k-1's compute: its weights are provably
-                    # consumed (retire donates them) and at most TWO stages
-                    # are ever in HBM — without this block the async queue
-                    # would admit every remaining prefetch at once.
-                    jax.block_until_ready(prev_out)
-                    self._retire_stage(k - 1, ring)
-                if k + 1 < len(self.stages):
-                    ring[k + 1] = self._place_stage(k + 1)
-                carry = stage.fn(ring[k], carry)
-                if not self.overlap:
-                    jax.block_until_ready(carry)
-                prev_out = carry
-            out = self._finalize_for(tuple(x.shape))(
-                self._finalize_params, carry
+        trace_on = tracing.on()
+        # Span vocabulary (utils/tracing.py): one ``stream-run`` per call;
+        # ``stream-stage-prefetch`` per device_put (async issue under
+        # overlap, blocking in debug mode); ``stream-prefetch-wait`` for the
+        # pre-dispatch block on the CURRENT stage's placed weights — the
+        # EXPOSED transfer time double-buffering failed to hide (~0 when
+        # overlap works; the ISSUE's blocked-on-prefetch wait). Traced runs
+        # only: the compute is data-dependent on the transfer and the host's
+        # next action is this dispatch, so the block shifts no work — but an
+        # untraced run keeps the original sync-free schedule. ``stream-wait``
+        # is the backpressure block on stage k-1's output;
+        # ``stream-stage-compute`` runs from dispatch (weights already
+        # on-device, so transfer stalls are excluded) to the moment the
+        # output is KNOWN done, observed at the next backpressure block.
+        # ``trace_aggregates`` turns these into stream_overlap_efficiency.
+        t_run0 = tracing.now_us() if trace_on else 0.0
+        comp_us = [0.0]  # Σ stage-compute span time → the overlap-eff gauge
+
+        def record_compute(stage_idx: int, ts: float, **attrs) -> None:
+            dur = tracing.now_us() - ts
+            comp_us[0] += dur
+            tracing.record(
+                "stream-stage-compute", ts, dur, cat="stream",
+                stage=stage_idx, nbytes=self.stages[stage_idx].nbytes,
+                **attrs,
             )
-            # The last stage retires by refcount once its compute completes —
-            # deleting here would need a blocking sync on the output instead.
-            last = len(self.stages) - 1
-            if last in ring:
-                ring.pop(last)
-                self.tracker.retire(last)
-            return out
-        finally:
-            # Failure path (OOM mid-schedule): release whatever the ring still
-            # holds so the recarved retry starts from a clean allocator.
-            for idx in list(ring):
-                self._retire_stage(idx, ring)
+        with tracing.span("stream-run", cat="stream", stages=len(self.stages),
+                          device=str(dev), overlap=self.overlap):
+            with tracing.span("stream-prepare", cat="stream"):
+                carry = self._prepare_for(static)(
+                    self._prepare_params,
+                    jax.device_put(x, dev),
+                    jax.device_put(timesteps, dev),
+                    jax.device_put(context, dev) if context is not None else None,
+                    {k: jax.device_put(v, dev) for k, v in traced.items()},
+                )
+            with tracing.span("stream-stage-prefetch", cat="stream", stage=0,
+                              nbytes=self.stages[0].nbytes,
+                              blocking=not self.overlap):
+                ring: dict[int, Any] = {0: self._place_stage(0)}
+            prev_out = None  # output of stage k-1 — the backpressure handle
+            pending = None   # (stage idx, dispatch ts) of the open compute span
+            try:
+                for k, stage in enumerate(self.stages):
+                    if prev_out is not None:
+                        # Wait for stage k-1's compute: its weights are provably
+                        # consumed (retire donates them) and at most TWO stages
+                        # are ever in HBM — without this block the async queue
+                        # would admit every remaining prefetch at once.
+                        with tracing.span("stream-wait", cat="stream",
+                                          stage=k - 1, blocked_on="compute"):
+                            jax.block_until_ready(prev_out)
+                        if pending is not None:
+                            record_compute(pending[0], pending[1])
+                            pending = None
+                        self._retire_stage(k - 1, ring)
+                    if k + 1 < len(self.stages):
+                        with tracing.span(
+                            "stream-stage-prefetch", cat="stream", stage=k + 1,
+                            nbytes=self.stages[k + 1].nbytes,
+                            blocking=not self.overlap,
+                        ):
+                            ring[k + 1] = self._place_stage(k + 1)
+                    if trace_on:
+                        # EXPOSED transfer: how long stage k's own weights
+                        # keep the (otherwise idle) device waiting past this
+                        # point. ~0 when double-buffering hid the transfer;
+                        # the whole point of the overlap-efficiency number is
+                        # that this wait must NOT be booked as compute. The
+                        # block is trace-mode-only and shifts no work: the
+                        # compute below is data-dependent on these very
+                        # buffers, and dispatching it is the host's next act.
+                        with tracing.span("stream-prefetch-wait", cat="stream",
+                                          stage=k, blocked_on="prefetch"):
+                            jax.block_until_ready(ring[k])
+                    t_dispatch = tracing.now_us() if trace_on else 0.0
+                    carry = stage.fn(ring[k], carry)
+                    if not self.overlap:
+                        jax.block_until_ready(carry)
+                        if trace_on:
+                            record_compute(k, t_dispatch)
+                    elif trace_on:
+                        pending = (k, t_dispatch)
+                    prev_out = carry
+                with tracing.span("stream-finalize", cat="stream"):
+                    out = self._finalize_for(tuple(x.shape))(
+                        self._finalize_params, carry
+                    )
+                if pending is not None:
+                    # The last stage's completion is never awaited here (it
+                    # retires by refcount); close its span at finalize
+                    # dispatch, marked as an async tail.
+                    record_compute(pending[0], pending[1], async_tail=True)
+                    pending = None
+                if trace_on:
+                    # The /metrics twin of the trace-derived aggregate:
+                    # fraction of this streamed run spent in stage compute.
+                    from ..utils.metrics import registry
+
+                    run_us = tracing.now_us() - t_run0
+                    if run_us > 0:
+                        registry.gauge(
+                            "pa_stream_overlap_efficiency",
+                            min(1.0, comp_us[0] / run_us),
+                            labels={"device": str(dev)},
+                            help="stage-compute fraction of streamed-run wall "
+                                 "time (1.0 = transfers fully hidden)",
+                        )
+                # The last stage retires by refcount once its compute
+                # completes — deleting here would need a blocking sync on the
+                # output instead.
+                last = len(self.stages) - 1
+                if last in ring:
+                    ring.pop(last)
+                    self.tracker.retire(last)
+                return out
+            finally:
+                # Failure path (OOM mid-schedule): release whatever the ring
+                # still holds so the recarved retry starts from a clean
+                # allocator.
+                for idx in list(ring):
+                    self._retire_stage(idx, ring)
 
 
 def build_streaming_runner(

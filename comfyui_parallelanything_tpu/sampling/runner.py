@@ -6,9 +6,12 @@ here is immediately available to both the Python pipeline API and the node graph
 
 from __future__ import annotations
 
+import functools
 
 import jax
 import jax.numpy as jnp
+
+from ..utils import tracing
 
 from .ddim import ddim_sample
 from .flow import flow_euler_sample, flow_timesteps
@@ -56,6 +59,27 @@ def _compiled_spec(model, callback):
     return spec
 
 
+def _traced_sampler_run(fn):
+    """Wrap the whole dispatch in a ``sampler-run`` span (utils/tracing.py) —
+    the per-prompt timeline node every step/lane-wait span nests under.
+    Disabled tracing costs one flag check; ``sampler``/``steps`` are
+    keyword-only on run_sampler, so the wrapper reads them from kwargs."""
+
+    @functools.wraps(fn)
+    def wrapped(model, noise, context=None, **kwargs):
+        if not tracing.on():
+            return fn(model, noise, context, **kwargs)
+        with tracing.span(
+            "sampler-run", cat="sampling",
+            sampler=kwargs.get("sampler"), steps=kwargs.get("steps"),
+            batch=int(noise.shape[0]) if hasattr(noise, "shape") else None,
+        ):
+            return fn(model, noise, context, **kwargs)
+
+    return wrapped
+
+
+@_traced_sampler_run
 def run_sampler(
     model,
     noise: jnp.ndarray,
@@ -192,10 +216,25 @@ def run_sampler(
         """Per-step progress + cooperative interrupt on the eager loops (the
         ComfyUI protocol's ``progress`` event source; utils/progress.py). The
         compiled path is one XLA program — no step boundaries to report or
-        stop at, which run_sampler's docstring lists among its trade-offs."""
+        stop at, which run_sampler's docstring lists among its trade-offs.
+
+        Tracing: each boundary-to-boundary interval is recorded as a ``step``
+        span — the host-side dispatch window of one denoise step (the eager
+        loops do not sync per step, and tracing must not add a sync; the
+        serving bucket's step spans, which do block, carry the
+        device-inclusive durations)."""
         from ..utils.progress import report_progress
 
+        t_last = [tracing.now_us()] if tracing.on() else None
+
         def cb2(i, x):
+            if t_last is not None and tracing.on():
+                now = tracing.now_us()
+                tracing.record(
+                    "step", t_last[0], now - t_last[0], cat="sampling",
+                    step=i + 1, of=n_steps,
+                )
+                t_last[0] = now
             # Raises Interrupted if requested; x feeds the WS latent-preview
             # hook (utils/progress.set_preview_hook) when one is installed.
             report_progress(i + 1, n_steps, latent=x)

@@ -32,8 +32,16 @@ Endpoints (the ComfyUI client-protocol subset that makes scripts work):
                               neighbors keep running); ``{"clear": true}``
                               drops every pending prompt
 - ``GET  /metrics``           Prometheus text: serving per-bucket occupancy,
-                              lane-wait, step-time, dispatch counts
-                              (utils/metrics.py registry) + queue gauges
+                              lane-wait/step-time histograms (server-side
+                              p50/p95), dispatch counts (utils/metrics.py
+                              registry) + queue gauges
+- ``GET  /trace``             Chrome/Perfetto trace-event JSON of the span
+                              tracer (utils/tracing.py) — per-prompt
+                              timelines from HTTP ingress to device step;
+                              ``?prompt_id=`` filters to one prompt. Enable
+                              with ``--trace`` / $PA_TRACE=1 (off by
+                              default: the tracer's disabled path is a
+                              single flag check)
 - ``POST /interrupt``         drop all *pending* prompts and stop every
                               *running* one at its next sampler-step boundary
                               (per-prompt cooperative scope,
@@ -77,6 +85,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from .host import WorkflowCache, run_workflow
+from .utils import tracing
 from .utils.progress import Interrupted, progress_scope
 
 _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"  # RFC 6455 §1.3
@@ -203,7 +212,11 @@ class PromptQueue:
 
     def __init__(self, class_mappings=None, output_dir: str | None = None,
                  workers: int | None = None, max_pending: int | None = None,
-                 serving: bool | None = None):
+                 serving: bool | None = None, trace: bool | None = None):
+        if trace is None:
+            trace = os.environ.get("PA_TRACE", "") not in ("", "0", "false")
+        if trace:
+            tracing.enable()
         self.class_mappings = class_mappings
         self.output_dir = output_dir or os.environ.get("PA_OUTPUT_DIR", "output")
         self.cache = WorkflowCache()
@@ -460,11 +473,16 @@ class PromptQueue:
             from .serving.scheduler import serving_hints
 
             try:
+                # The prompt span is the root of this prompt's trace
+                # timeline; prompt_id on the scope correlates log records and
+                # spans recorded anywhere on (or on behalf of) this thread.
                 with progress_scope(
                     hook=hook,
                     preview_hook=preview_hook if preview else None,
                     interrupt_event=cancel_evt,
-                ), serving_hints(priority=priority, deadline_s=deadline_s):
+                    prompt_id=pid,
+                ), serving_hints(priority=priority, deadline_s=deadline_s), \
+                        tracing.span("prompt", cat="server", prompt_id=pid):
                     results = run_workflow(
                         prompt, class_mappings=self.class_mappings,
                         outputs=self.cache, on_node=on_node,
@@ -587,6 +605,15 @@ class _Handler(BaseHTTPRequestHandler):
                 200, registry.render().encode(),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
+        if url.path == "/trace":
+            # Chrome/Perfetto trace-event JSON (open at ui.perfetto.dev).
+            # With tracing disabled the export is empty — the body says so
+            # instead of 404ing, so a client can tell "off" from "no spans".
+            qs = parse_qs(url.query)
+            prompt_id = qs.get("prompt_id", [None])[0]
+            trace = tracing.export(prompt_id=prompt_id)
+            trace["enabled"] = tracing.on()
+            return self._send(200, trace)
         if parts and parts[0] == "history":
             # Snapshot under the queue lock: the worker thread inserts entries
             # under it, and json.dumps over a dict mutated mid-iteration raises
@@ -810,14 +837,18 @@ def make_server(
     workers: int | None = None,
     max_pending: int | None = None,
     serving: bool | None = None,
+    trace: bool | None = None,
 ) -> tuple[ThreadingHTTPServer, PromptQueue]:
     """Build (but don't start) the HTTP server + its prompt queue. Port 0
     picks an ephemeral port (tests); ``server.server_address`` has the real
     one. ``workers > 1`` (or $PA_SERVER_WORKERS) executes prompts
     concurrently and installs the continuous-batching scheduler;
-    ``max_pending`` (or $PA_MAX_PENDING) bounds the queue (429 beyond it)."""
+    ``max_pending`` (or $PA_MAX_PENDING) bounds the queue (429 beyond it);
+    ``trace`` (or $PA_TRACE=1) turns the span tracer on so ``GET /trace``
+    serves per-prompt timelines."""
     q = PromptQueue(class_mappings=class_mappings, output_dir=output_dir,
-                    workers=workers, max_pending=max_pending, serving=serving)
+                    workers=workers, max_pending=max_pending, serving=serving,
+                    trace=trace)
     handler = type("Handler", (_Handler,), {"q": q})
     srv = ThreadingHTTPServer((host, port), handler)
     return srv, q
@@ -836,9 +867,13 @@ def main() -> None:
     ap.add_argument("--max-pending", type=int, default=None,
                     help="bounded queue depth — 429 beyond it "
                          "(default $PA_MAX_PENDING or unbounded)")
+    ap.add_argument("--trace", action="store_true", default=None,
+                    help="enable span tracing (GET /trace serves "
+                         "Chrome/Perfetto trace JSON; default $PA_TRACE)")
     args = ap.parse_args()
     srv, q = make_server(args.host, args.port, output_dir=args.output_dir,
-                         workers=args.workers, max_pending=args.max_pending)
+                         workers=args.workers, max_pending=args.max_pending,
+                         trace=args.trace)
     print(f"ParallelAnything workflow server on http://{args.host}:{args.port}")
     try:
         srv.serve_forever()

@@ -37,6 +37,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ..utils import tracing
 from ..utils.metrics import registry
 from ..utils.progress import Interrupted
 from .policy import AdmissionQueue, DeadlineExceeded
@@ -64,6 +65,13 @@ class ServeRequest:
     deadline: float | None = None          # time.monotonic() deadline
     progress_hook: Optional[Callable[[int, int], None]] = None
     interrupt_event: Optional[threading.Event] = None
+    # Trace correlation (utils/tracing.py), captured at submit: the prompt the
+    # request serves, the submitting thread's tid (the request's spans land on
+    # ITS timeline — it is blocked in result() for exactly that interval), and
+    # the submit timestamp on the trace clock (lane-wait span start).
+    prompt_id: Optional[str] = None
+    trace_tid: Optional[int] = None
+    trace_submit_us: Optional[float] = None
     rid: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
     submit_ts: float = dataclasses.field(default_factory=time.monotonic)
 
@@ -105,6 +113,7 @@ class _Lane:
     # keeps lane latents stacked in the bucket's device state instead).
     x_eager: Any = None
     denoiser: Any = None
+    seat_us: float = 0.0  # trace-clock admission time (the lane span start)
 
 
 class StepBucket:
@@ -269,11 +278,23 @@ class StepBucket:
                 continue
             self._set_lane(i, req)
             joined += 1
-            registry.observe(
+            registry.histogram(
                 "pa_serving_lane_wait_seconds", now - req.submit_ts,
                 labels=self._labels,
                 help="submit-to-lane admission wait",
             )
+            if tracing.on():
+                # admission→lane-assign on the submitter's timeline: one
+                # completed span from submit to seat (both trace-clock).
+                self.lanes[i].seat_us = tracing.now_us()
+                if req.trace_submit_us is not None:
+                    tracing.record(
+                        "lane-wait", req.trace_submit_us,
+                        self.lanes[i].seat_us - req.trace_submit_us,
+                        cat="serving", tid=req.trace_tid,
+                        prompt_id=req.prompt_id, bucket=self.label, lane=i,
+                        rid=req.rid, queue_depth=len(self.queue),
+                    )
         if joined:
             self._gauges()
         return joined
@@ -281,6 +302,16 @@ class StepBucket:
     def _retire(self, i: int, result=None, error=None) -> None:
         lane = self.lanes[i]
         self.lanes[i] = None
+        if tracing.on() and lane.seat_us:
+            # lane-assign→retire on the submitter's timeline; the per-step
+            # spans recorded by dispatch() nest inside this interval.
+            tracing.record(
+                "lane", lane.seat_us, tracing.now_us() - lane.seat_us,
+                cat="serving", tid=lane.req.trace_tid,
+                prompt_id=lane.req.prompt_id, bucket=self.label, lane=i,
+                rid=lane.req.rid, steps_run=lane.idx,
+                outcome="error" if error is not None else "completed",
+            )
         lane.req.resolve(result=result, error=error)
         registry.counter(
             "pa_serving_cancelled_total" if error is not None
@@ -322,6 +353,7 @@ class StepBucket:
         import jax
 
         jnp = self._jnp
+        t0_us = tracing.now_us() if tracing.on() else 0.0
         t0 = time.perf_counter()
         if self._program is not None:
             sig = np.ones((self.width,), np.float32)
@@ -355,8 +387,32 @@ class StepBucket:
         self.dispatch_count += 1
         registry.counter("pa_serving_dispatch_total", labels=self._labels,
                          help="compiled lockstep step dispatches")
-        registry.observe("pa_serving_step_seconds", dt, labels=self._labels,
-                         help="wall time of one lockstep dispatch")
+        registry.histogram("pa_serving_step_seconds", dt, labels=self._labels,
+                           help="wall time of one lockstep dispatch")
+        if tracing.on() and t0_us:
+            # (t0_us guards the enable-raced-mid-dispatch case: never emit a
+            # span whose start predates the trace.)
+            dur_us = tracing.now_us() - t0_us
+            # One dispatcher-side span (per-dispatch occupancy + masked-lane
+            # count) ...
+            tracing.record(
+                "serving-dispatch", t0_us, dur_us, cat="serving",
+                bucket=self.label, occupancy=len(active),
+                masked_lanes=self.width - len(active), width=self.width,
+            )
+            # ... and one step span per live lane on its OWN prompt's
+            # timeline (the submitter is blocked in result() for exactly this
+            # interval, so per-tid nesting holds). The dispatch already
+            # blocked on the step output above — the duration is honest, and
+            # tracing added no sync of its own.
+            for i in active:
+                lane = self.lanes[i]
+                tracing.record(
+                    "step", t0_us, dur_us, cat="serving",
+                    tid=lane.req.trace_tid, prompt_id=lane.req.prompt_id,
+                    bucket=self.label, lane=i, step=lane.idx + 1,
+                    of=lane.req.n_steps, occupancy=len(active),
+                )
         for i in active:
             lane = self.lanes[i]
             lane.idx += 1

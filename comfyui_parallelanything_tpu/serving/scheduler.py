@@ -229,6 +229,8 @@ class ContinuousBatchingScheduler:
             else (tuple(context.shape), str(context.dtype)),
             static_kwargs_key(static), t_sig, u_sig, acp_fp, width,
         )
+        from ..utils import tracing
+
         req = ServeRequest(
             x=x, sigmas=np.asarray(sigmas, np.float32), context=context,
             uncond_context=uncond_context if use_cfg else None,
@@ -241,6 +243,13 @@ class ContinuousBatchingScheduler:
                 current_scope().interrupt_event
                 if current_scope() is not None else None
             ),
+            # Trace correlation captured on the SUBMITTING thread: its
+            # prompt, its tid (the dispatcher records this request's
+            # lane-wait/step/lane spans onto that timeline), its submit time
+            # on the trace clock.
+            prompt_id=tracing.current_prompt_id() if tracing.on() else None,
+            trace_tid=threading.get_ident() if tracing.on() else None,
+            trace_submit_us=tracing.now_us() if tracing.on() else None,
             **_current_hints(),
         )
         with self._lock:

@@ -3,6 +3,7 @@ from .cleanup import aggressive_cleanup
 from .compile_cache import enable_compilation_cache
 from .metrics import StepTimer, StepStats, trace
 from .checks import assert_finite, checked
+from . import tracing
 
 __all__ = [
     "enable_compilation_cache",
@@ -14,6 +15,7 @@ __all__ = [
     "StepTimer",
     "StepStats",
     "trace",
+    "tracing",
     "assert_finite",
     "checked",
 ]

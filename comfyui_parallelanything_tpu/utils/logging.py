@@ -5,6 +5,14 @@ summary with device/percentage table (any_device_parallel.py:1029), per-device c
 progress + free-VRAM readings (1088-1094), success/safe-mode/LoRA status (1103-1108),
 OOM/degradation warnings (1116, 1426, 1437). This module keeps that event vocabulary on
 stdlib ``logging`` — levels, structure, and counters instead of prints.
+
+Correlation (round 8): with several prompt workers and a serving dispatcher
+in flight at once, the old flat format left records unattributable. Every
+record now passes through :class:`ContextFilter`, which stamps ``prompt_id``
+and ``span_id`` from the calling thread's active trace/progress context
+(utils/tracing.py span stack, falling back to the utils/progress.py scope),
+so a grep for one prompt's id yields its complete log *and* its ``/trace``
+timeline — the same key correlates both.
 """
 
 from __future__ import annotations
@@ -15,13 +23,38 @@ from collections.abc import Sequence
 _LOGGER_NAME = "parallel_anything_tpu"
 
 
+class ContextFilter(logging.Filter):
+    """Stamp the calling thread's prompt/span context into every record.
+
+    Lazy imports keep this module importable standalone and make the filter
+    unconditionally safe: a tracing/progress hiccup degrades to ``-`` fields,
+    never to a lost log line."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        prompt_id = span_id = None
+        try:
+            from . import tracing
+
+            prompt_id = tracing.current_prompt_id()
+            span_id = tracing.current_span_id()
+        except Exception:
+            pass
+        record.prompt_id = prompt_id if prompt_id is not None else "-"
+        record.span_id = span_id if span_id is not None else "-"
+        return True
+
+
 def get_logger() -> logging.Logger:
     logger = logging.getLogger(_LOGGER_NAME)
     if not logger.handlers:
         handler = logging.StreamHandler()
         handler.setFormatter(
-            logging.Formatter("[ParallelAnything] %(levelname)s %(message)s")
+            logging.Formatter(
+                "[ParallelAnything] %(levelname)s "
+                "prompt=%(prompt_id)s span=%(span_id)s %(message)s"
+            )
         )
+        handler.addFilter(ContextFilter())
         logger.addHandler(handler)
         logger.setLevel(logging.INFO)
         logger.propagate = False

@@ -31,12 +31,23 @@ from .logging import get_logger
 class MetricsRegistry:
     """Thread-safe labeled metrics with Prometheus text exposition.
 
-    Three instrument kinds, created on first touch (no registration step —
+    Four instrument kinds, created on first touch (no registration step —
     instrumentation sites must never crash a serving path over bookkeeping):
     ``counter`` (monotonic), ``gauge`` (set to the latest value), ``summary``
     (accumulates ``_sum``/``_count`` — enough for rate/mean queries without
-    carrying quantile sketches). Labels are a plain dict, canonicalized to a
-    sorted tuple key."""
+    carrying quantile sketches), and ``histogram`` (fixed log-spaced buckets
+    with Prometheus ``_bucket``/``_sum``/``_count`` exposition — the
+    server-side quantile source, so a load generator can read p50/p95 off
+    ``GET /metrics`` instead of only computing them client-side). Labels are
+    a plain dict, canonicalized to a sorted tuple key."""
+
+    # Log-spaced duration buckets, 1 ms … 100 s (~2.5x steps): wide enough
+    # for lane waits under load AND sub-5ms compiled step dispatches; fixed
+    # (not per-metric) so two servers' exposition always merges.
+    HIST_BOUNDS = (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    )
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -78,15 +89,73 @@ class MetricsRegistry:
             acc[0] += float(value)
             acc[1] += 1.0
 
+    def histogram(self, name: str, value: float, labels: dict | None = None,
+                  help: str = "") -> None:
+        """Observe ``value`` (seconds) into the fixed log-spaced buckets."""
+        v = float(value)
+        with self._lock:
+            vals = self._slot(name, "histogram", help)["values"]
+            k = self._label_key(labels)
+            acc = vals.get(k)
+            if acc is None:
+                # [per-bound counts..., +Inf count, sum, count]
+                acc = vals[k] = [0.0] * (len(self.HIST_BOUNDS) + 1) + [0.0, 0.0]
+            for i, bound in enumerate(self.HIST_BOUNDS):
+                if v <= bound:
+                    acc[i] += 1.0
+                    break
+            else:
+                acc[len(self.HIST_BOUNDS)] += 1.0
+            acc[-2] += v
+            acc[-1] += 1.0
+
     def get(self, name: str, labels: dict | None = None):
-        """Current value (float for counter/gauge, (sum, count) for summary),
-        or None — the test/introspection read side."""
+        """Current value (float for counter/gauge, (sum, count) for summary
+        AND histogram), or None — the test/introspection read side."""
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 return None
             v = m["values"].get(self._label_key(labels))
-            return tuple(v) if isinstance(v, list) else v
+            if isinstance(v, list):
+                return (v[-2], v[-1]) if m["type"] == "histogram" else tuple(v)
+            return v
+
+    def quantile(self, name: str, q: float, labels: dict | None = None):
+        """Histogram quantile (0-100) by linear interpolation within the
+        bucket holding the target rank, or None. Merges across all label sets
+        when ``labels`` is None — the read side loadgen's server-side p50/p95
+        comes from (scraped over HTTP there; this is the in-process twin)."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None or m["type"] != "histogram":
+                return None
+            if labels is None:
+                accs = list(m["values"].values())
+            else:
+                acc = m["values"].get(self._label_key(labels))
+                accs = [acc] if acc is not None else []
+            if not accs:
+                return None
+            n = len(self.HIST_BOUNDS)
+            counts = [sum(a[i] for a in accs) for i in range(n + 1)]
+        total = sum(counts)
+        if total <= 0:
+            return None
+        target = q / 100.0 * total
+        cum = 0.0
+        lo = 0.0
+        for i, c in enumerate(counts):
+            if i < n:
+                hi = self.HIST_BOUNDS[i]
+            else:
+                hi = self.HIST_BOUNDS[-1]  # +Inf bucket clamps to last bound
+            if cum + c >= target and c > 0:
+                frac = (target - cum) / c
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+            cum += c
+            lo = hi
+        return lo
 
     def reset(self) -> None:
         with self._lock:
@@ -113,6 +182,24 @@ class MetricsRegistry:
                     if m["type"] == "summary":
                         lines.append(f"{name}_sum{lbl} {v[0]:.9g}")
                         lines.append(f"{name}_count{lbl} {v[1]:.9g}")
+                    elif m["type"] == "histogram":
+                        def le_lbl(le: str) -> str:
+                            pairs = list(key) + [("le", le)]
+                            return "{" + ",".join(
+                                f'{k}="{esc(val)}"' for k, val in pairs
+                            ) + "}"
+
+                        cum = 0.0
+                        for i, bound in enumerate(self.HIST_BOUNDS):
+                            cum += v[i]
+                            lines.append(
+                                f"{name}_bucket{le_lbl(f'{bound:.9g}')} "
+                                f"{cum:.9g}"
+                            )
+                        cum += v[len(self.HIST_BOUNDS)]
+                        lines.append(f"{name}_bucket{le_lbl('+Inf')} {cum:.9g}")
+                        lines.append(f"{name}_sum{lbl} {v[-2]:.9g}")
+                        lines.append(f"{name}_count{lbl} {v[-1]:.9g}")
                     else:
                         lines.append(f"{name}{lbl} {v:.9g}")
         return "\n".join(lines) + "\n"

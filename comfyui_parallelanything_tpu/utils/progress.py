@@ -47,23 +47,31 @@ class ProgressScope:
     """One thread's (hook, preview, interrupt-event) triple — the per-prompt
     analogue of the process-wide slots. ``interrupt_event`` is a one-shot
     per-prompt Cancel: fresh per scope, so the stale-flag races the global
-    Event needs clear_interrupt choreography for cannot exist here."""
+    Event needs clear_interrupt choreography for cannot exist here.
+    ``prompt_id`` names the prompt the scope serves — the correlation key
+    utils/tracing.py spans and utils/logging.py records inherit on this
+    thread (and the serving scheduler captures at admission)."""
 
-    __slots__ = ("hook", "preview_hook", "interrupt_event")
+    __slots__ = ("hook", "preview_hook", "interrupt_event", "prompt_id")
 
-    def __init__(self, hook=None, preview_hook=None, interrupt_event=None):
+    def __init__(self, hook=None, preview_hook=None, interrupt_event=None,
+                 prompt_id=None):
         self.hook = hook
         self.preview_hook = preview_hook
         self.interrupt_event = interrupt_event
+        self.prompt_id = prompt_id
 
 
 @contextlib.contextmanager
-def progress_scope(hook=None, preview_hook=None, interrupt_event=None):
+def progress_scope(hook=None, preview_hook=None, interrupt_event=None,
+                   prompt_id=None):
     """Install a per-thread ProgressScope for the duration of the block
     (shadowing the process-wide slots on THIS thread only); nests — the
     previous scope is restored on exit."""
     prev = getattr(_scope_local, "scope", None)
-    scope = ProgressScope(hook, preview_hook, interrupt_event)
+    if prompt_id is None and prev is not None:
+        prompt_id = prev.prompt_id  # nested scopes stay on the same prompt
+    scope = ProgressScope(hook, preview_hook, interrupt_event, prompt_id)
     _scope_local.scope = scope
     try:
         yield scope

@@ -1,0 +1,441 @@
+"""End-to-end span tracing: per-prompt timelines from HTTP ingress to TPU step.
+
+The reference's observability is ~40 ``[ParallelAnything]`` print sites and
+"read s/it off the progress bar" (SURVEY §5.1, §5.5). This reproduction has
+far more moving parts — weight-streaming prefetch rings, continuous-batching
+lane lifecycles, per-thread progress scopes — and every open ROADMAP item
+("measure flux_stream on hardware", "measure serving latency on hardware")
+is blocked on being able to *see* where time goes. This module is that layer:
+a process-wide :class:`Tracer` producing per-prompt traces of nested spans
+
+    prompt → workflow-node → sampler-run → lane-wait → step
+                                              → stream-stage-{prefetch,compute}
+
+exported in Chrome/Perfetto trace-event JSON (``GET /trace?prompt_id=...`` on
+the server, ``--trace-out`` on bench.py, ``scripts/trace_summary.py`` offline).
+
+Design rules (the near-zero-overhead contract):
+
+- **disabled is a single flag check**: :func:`span` returns one shared
+  ``_NULL`` singleton when tracing is off — no Span object, no clock read, no
+  buffer touch. Instrumentation sites that must *compute* attributes guard on
+  :func:`on` first.
+- **recording is lock-free per thread**: every recording thread owns its own
+  ring buffer (a bounded ``deque`` — old spans fall off instead of growing
+  without bound); the tracer's lock is taken only once per thread, at
+  registration, and at export (which snapshots the per-thread deques).
+- **prompt correlation rides the progress scopes**: a span opened with
+  ``prompt_id=...`` establishes the thread's current prompt; nested spans
+  inherit it, and threads that carry no span context fall back to the
+  per-thread ``utils.progress`` scope (the serving scheduler captures the
+  submitting thread's identity at admission, so lane-wait/step spans recorded
+  from the dispatcher thread land on the *prompt's* timeline).
+- **cross-thread spans carry an explicit tid**: :func:`record` writes a
+  completed span into the *recording* thread's buffer but may stamp it with
+  the submitting thread's tid — per-tid interval nesting is preserved because
+  the submitting thread is blocked in ``ticket.result()`` for exactly that
+  interval.
+- **metrics stay consistent with traces**: every span close feeds its
+  duration into ``MetricsRegistry`` (``pa_trace_span_seconds{name=...}``
+  histogram), so ``/metrics`` aggregates and ``/trace`` timelines are two
+  views of the same measurements.
+
+``block_until_ready`` discipline: instrumentation only ever *reads the clock*
+at boundaries that already synchronize (the serving bucket's post-dispatch
+block, the streaming runner's backpressure block, the eager loops' progress
+callbacks) — tracing never adds a device sync of its own.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+# Per-thread span buffer capacity: at ~150 bytes/span this bounds a thread's
+# trace memory at a few MiB while holding minutes of step-granularity spans.
+DEFAULT_CAPACITY = 16384
+
+_span_ids = itertools.count(1)
+
+
+def now_us() -> float:
+    """Monotonic microseconds — the trace-event clock (Chrome ``ts`` unit)."""
+    return time.perf_counter_ns() / 1e3
+
+
+class _NullSpan:
+    """The disabled-path singleton: a context manager that does nothing and
+    allocates nothing. ``set()`` (attribute attach) is a no-op too, so call
+    sites never need a second enabled-check."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NULL = _NullSpan()
+
+
+class _OpenSpan:
+    """One live span on the opening thread's stack; closing (context exit)
+    records a completed ``X`` event into that thread's ring buffer."""
+
+    __slots__ = ("_tracer", "_local", "name", "cat", "ts", "attrs", "span_id")
+
+    def __init__(self, tracer, local, name, cat, attrs):
+        self._tracer = tracer
+        self._local = local
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+        self.span_id = next(_span_ids)
+        self.ts = 0.0
+
+    def set(self, **attrs):
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        self._local.stack.append(self)
+        self.ts = now_us()
+        return self
+
+    def __exit__(self, *exc):
+        dur = now_us() - self.ts
+        stack = self._local.stack
+        # LIFO by construction (context managers); tolerate a corrupted stack
+        # rather than poisoning the traced code path.
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:
+            stack.remove(self)
+        self._tracer._emit(
+            self._local, self.name, self.ts, dur, self.cat,
+            threading.get_ident(), self.attrs, self.span_id,
+        )
+        return False
+
+
+class _Local(threading.local):
+    """Per-thread recording state: the open-span stack and the ring buffer."""
+
+    def __init__(self):
+        self.stack: list[_OpenSpan] = []
+        self.events: deque | None = None
+
+
+class Tracer:
+    """Process-wide span recorder. ``enabled`` is the hot-path flag; all other
+    state is touched only while tracing is on."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.enabled = False
+        self.capacity = capacity
+        self._local = _Local()
+        self._lock = threading.Lock()
+        # thread ident -> (thread name, events deque) — registration happens
+        # once per recording thread; export snapshots under the lock.
+        self._buffers: dict[int, tuple[str, deque]] = {}
+        self._epoch_us = now_us()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def enable(self, capacity: int | None = None) -> None:
+        """Turn tracing on (clearing any previous trace). ``capacity`` is
+        per-call, not sticky: omitting it restores the default — a tiny
+        capacity chosen for one capture must not silently truncate the
+        next."""
+        with self._lock:
+            self.capacity = DEFAULT_CAPACITY if capacity is None else capacity
+            self._buffers.clear()
+            self._epoch_us = now_us()
+        self._local = _Local()
+        self.enabled = True
+
+    def disable(self) -> None:
+        """Stop recording; the captured trace stays exportable until the next
+        ``enable()``."""
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buffers.clear()
+
+    # -- recording ----------------------------------------------------------
+
+    def _events(self, local) -> deque:
+        ev = local.events
+        if ev is None:
+            ev = local.events = deque(maxlen=self.capacity)
+            t = threading.current_thread()
+            with self._lock:
+                self._buffers[threading.get_ident()] = (t.name, ev)
+        return ev
+
+    def _emit(self, local, name, ts, dur, cat, tid, attrs, span_id) -> None:
+        self._events(local).append((name, ts, dur, cat, tid, attrs, span_id))
+        self._feed_metrics(name, cat, dur)
+
+    @staticmethod
+    def _feed_metrics(name, cat, dur_us) -> None:
+        # Lazy import: tracing must stay importable without jax (metrics.py
+        # imports jax); a metrics hiccup must never break the traced path.
+        try:
+            from .metrics import registry
+
+            registry.histogram(
+                "pa_trace_span_seconds", dur_us / 1e6,
+                labels={"name": name, "cat": cat},
+                help="span durations from utils/tracing.py (trace/metrics "
+                     "consistency: same measurements, two views)",
+            )
+        except Exception:
+            pass
+
+    def span(self, name: str, cat: str = "host",
+             prompt_id: str | None = None, **attrs):
+        """Open a nested span on the calling thread (context manager). When
+        tracing is disabled this is the single flag check returning the
+        shared null singleton."""
+        if not self.enabled:
+            return _NULL
+        local = self._local
+        if prompt_id is None:
+            prompt_id = self._current_prompt_id(local)
+        if prompt_id is not None:
+            attrs["prompt_id"] = prompt_id
+        return _OpenSpan(self, local, name, cat, attrs)
+
+    def record(self, name: str, ts: float, dur: float, cat: str = "host",
+               tid: int | None = None, prompt_id: str | None = None,
+               **attrs) -> None:
+        """Record an already-measured span (explicit interval). ``tid``
+        attributes the span to another thread's timeline (the serving
+        dispatcher recording on behalf of a blocked submitter); the write
+        still goes to the *calling* thread's lock-free buffer."""
+        if not self.enabled:
+            return
+        local = self._local
+        if prompt_id is None:
+            prompt_id = self._current_prompt_id(local)
+        if prompt_id is not None:
+            attrs["prompt_id"] = prompt_id
+        self._emit(
+            local, name, ts, max(0.0, dur), cat,
+            tid if tid is not None else threading.get_ident(),
+            attrs, next(_span_ids),
+        )
+
+    # -- context ------------------------------------------------------------
+
+    def _current_prompt_id(self, local=None) -> Optional[str]:
+        local = local if local is not None else self._local
+        for s in reversed(local.stack):
+            pid = s.attrs.get("prompt_id")
+            if pid is not None:
+                return pid
+        # No span context on this thread: fall back to the per-thread
+        # progress scope (the per-prompt correlation the server installs).
+        try:
+            from .progress import current_scope
+
+            scope = current_scope()
+            return getattr(scope, "prompt_id", None)
+        except Exception:
+            return None
+
+    def current_prompt_id(self) -> Optional[str]:
+        """The prompt the calling thread is working for right now, or None."""
+        return self._current_prompt_id()
+
+    def current_span_id(self) -> Optional[int]:
+        stack = self._local.stack
+        return stack[-1].span_id if stack else None
+
+    # -- export -------------------------------------------------------------
+
+    def export(self, prompt_id: str | None = None) -> dict:
+        """Chrome/Perfetto trace-event JSON (the ``chrome://tracing`` /
+        ui.perfetto.dev format): complete ``X`` events with ``ts``/``dur`` in
+        microseconds, plus thread-name metadata. ``prompt_id`` filters to one
+        prompt's timeline (spans stamped with that prompt_id)."""
+        pid = os.getpid()
+        with self._lock:
+            snap = [(tid, name, list(ev))
+                    for tid, (name, ev) in self._buffers.items()]
+        events: list[dict] = []
+        tids_seen: set[int] = set()
+        for _rec_tid, _tname, recs in snap:
+            for name, ts, dur, cat, tid, attrs, span_id in recs:
+                if prompt_id is not None and attrs.get("prompt_id") != prompt_id:
+                    continue
+                args = dict(attrs)
+                args["span_id"] = span_id
+                events.append({
+                    "ph": "X", "name": name, "cat": cat,
+                    "ts": round(ts - self._epoch_us, 3),
+                    "dur": round(dur, 3),
+                    "pid": pid, "tid": tid, "args": args,
+                })
+                tids_seen.add(tid)
+        events.sort(key=lambda e: (e["tid"], e["ts"], -e["dur"]))
+        thread_names = {tid: tname for tid, tname, _ in snap}
+        meta = [{
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": thread_names.get(tid, f"thread-{tid}")},
+        } for tid in sorted(tids_seen)]
+        meta.insert(0, {
+            "ph": "M", "name": "process_name", "pid": pid,
+            "args": {"name": "parallel_anything_tpu"},
+        })
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+# The process-wide tracer every instrumentation site records into and the
+# server's GET /trace renders. Tests may enable()/disable() it.
+tracer = Tracer()
+
+
+def on() -> bool:
+    """The hot-path enabled check — guard attribute computation with this."""
+    return tracer.enabled
+
+
+def enable(capacity: int | None = None) -> None:
+    tracer.enable(capacity)
+
+
+def disable() -> None:
+    tracer.disable()
+
+
+def span(name: str, cat: str = "host", prompt_id: str | None = None, **attrs):
+    return tracer.span(name, cat=cat, prompt_id=prompt_id, **attrs)
+
+
+def record(name: str, ts: float, dur: float, cat: str = "host",
+           tid: int | None = None, prompt_id: str | None = None, **attrs):
+    tracer.record(name, ts, dur, cat=cat, tid=tid, prompt_id=prompt_id,
+                  **attrs)
+
+
+def export(prompt_id: str | None = None) -> dict:
+    return tracer.export(prompt_id)
+
+
+def current_prompt_id() -> Optional[str]:
+    return tracer.current_prompt_id()
+
+
+def current_span_id() -> Optional[int]:
+    return tracer.current_span_id()
+
+
+@contextlib.contextmanager
+def hardware_trace(log_dir: str = "/tmp/parallelanything-trace"):
+    """Bracket a span subtree with ``jax.profiler.trace`` so the XProf device
+    timeline lines up with the host spans recorded inside the block: open the
+    trace in Perfetto alongside the ``GET /trace`` export and the
+    ``hardware-trace`` host span marks the profiled window."""
+    import jax
+
+    with span("hardware-trace", cat="profiler", log_dir=log_dir):
+        jax.profiler.start_trace(log_dir)
+        try:
+            yield log_dir
+        finally:
+            jax.profiler.stop_trace()
+
+
+# -- trace-derived aggregates ------------------------------------------------
+#
+# Shared by bench.py (every JSON line), __graft_entry__.dryrun_multichip, and
+# scripts/trace_summary.py (which re-implements the same math stdlib-only; a
+# tier-1 test pins the two against each other on the same fixture).
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    """Nearest-rank percentile (scripts/loadgen.py convention)."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    k = max(0, min(len(s) - 1, round(q / 100.0 * (len(s) - 1))))
+    return s[k]
+
+
+def _x_events(events) -> list[dict]:
+    if isinstance(events, dict):
+        events = events.get("traceEvents", [])
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def stream_overlap_efficiency(events) -> float | None:
+    """Fraction of each ``stream-run`` span's wall time occupied by
+    ``stream-stage-compute`` spans, averaged over runs; in (0, 1] by
+    construction (compute spans are non-overlapping and contained in their
+    run). Exposed transfer/backpressure time — the part double-buffering
+    exists to hide — is exactly what pushes this below 1; it is the
+    overlap-efficiency number the flux_stream live-window measurement needs.
+    None when the trace holds no streamed runs."""
+    xs = _x_events(events)
+    runs = [e for e in xs if e["name"] == "stream-run" and e.get("dur", 0) > 0]
+    if not runs:
+        return None
+    comps = [e for e in xs if e["name"] == "stream-stage-compute"]
+    effs = []
+    for r in runs:
+        r0, r1 = r["ts"], r["ts"] + r["dur"]
+        busy = sum(
+            c["dur"] for c in comps
+            if c["tid"] == r["tid"] and c["ts"] >= r0
+            and c["ts"] + c["dur"] <= r1 + 1.0  # float-rounding slack (µs)
+        )
+        effs.append(min(1.0, busy / r["dur"]))
+    return sum(effs) / len(effs)
+
+
+def lane_wait_p95_s(events) -> float | None:
+    """p95 of serving ``lane-wait`` spans (submit → seated), seconds."""
+    waits = [e["dur"] / 1e6 for e in _x_events(events)
+             if e["name"] == "lane-wait"]
+    return _percentile(waits, 95) if waits else None
+
+
+def host_gap_ms(events) -> float | None:
+    """Mean host-side gap between consecutive ``step`` spans on each thread —
+    the per-step scheduling overhead the device cannot see. None with fewer
+    than two steps anywhere."""
+    steps: dict[int, list[dict]] = {}
+    for e in _x_events(events):
+        if e["name"] == "step":
+            steps.setdefault(e["tid"], []).append(e)
+    gaps = []
+    for evs in steps.values():
+        evs.sort(key=lambda e: e["ts"])
+        for a, b in zip(evs, evs[1:]):
+            gaps.append(max(0.0, b["ts"] - (a["ts"] + a["dur"])) / 1e3)
+    return sum(gaps) / len(gaps) if gaps else None
+
+
+def trace_aggregates(events) -> dict:
+    """The trace-derived aggregate fields every bench.py JSON line carries."""
+    eff = stream_overlap_efficiency(events)
+    p95 = lane_wait_p95_s(events)
+    gap = host_gap_ms(events)
+    return {
+        "stream_overlap_efficiency": None if eff is None else round(eff, 4),
+        "lane_wait_p95": None if p95 is None else round(p95, 6),
+        "host_gap_ms": None if gap is None else round(gap, 4),
+    }

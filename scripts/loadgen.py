@@ -5,9 +5,12 @@ prompt graph, blocks until the prompt completes (polling ``/history/{id}``),
 and immediately submits the next — the closed loop that makes offered load
 equal to in-flight concurrency, which is the regime continuous batching
 (serving/) is built for. Prints ONE JSON summary line: latency percentiles,
-throughput, HTTP 429 rejections, and the serving dispatch/occupancy counters
-scraped from ``GET /metrics`` — so a run shows not just *how fast* but *how
-batched* (BASELINE.md "serving" metric).
+throughput, HTTP 429 rejections, the serving dispatch/occupancy counters,
+AND server-side p50/p95 read from the ``GET /metrics`` histograms
+(``server_step_*``/``server_lane_wait_*`` — what the server measured per
+lockstep dispatch / lane admission, vs the client clocks which fold in
+queueing + HTTP + polling) — so a run shows not just *how fast* but *how
+batched* and *where the time went* (BASELINE.md "serving" metric).
 
 Usage:
     python scripts/loadgen.py --graph workflow.json \
@@ -65,6 +68,38 @@ def _set_path(graph: dict, dotted: str, value):
     node[parts[-1]] = value
 
 
+def _histogram_quantile(text: str, name: str, q: float) -> float | None:
+    """Quantile from a Prometheus histogram's ``_bucket`` exposition, merged
+    across label sets (every MetricsRegistry histogram shares one fixed
+    bucket ladder, so cumulative counts add per ``le``). Linear interpolation
+    within the target bucket — the same estimate the server's in-process
+    ``registry.quantile`` computes; this is the scraped twin, so a loadgen
+    run reads *server-side* p50/p95 instead of only its own client clocks."""
+    by_le: dict[str, float] = {}
+    for m in re.finditer(
+        rf'^{name}_bucket\{{[^}}]*le="([^"]+)"[^}}]*\}} ([0-9.eE+-]+)$',
+        text, re.M,
+    ):
+        by_le[m.group(1)] = by_le.get(m.group(1), 0.0) + float(m.group(2))
+    if not by_le:
+        return None
+    finite = sorted(
+        (float(le), c) for le, c in by_le.items() if le != "+Inf"
+    )
+    total = by_le.get("+Inf", finite[-1][1] if finite else 0.0)
+    if total <= 0:
+        return None
+    target = q / 100.0 * total
+    lo = 0.0
+    prev_cum = 0.0
+    for le, cum in finite:
+        if cum >= target and cum > prev_cum:
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return lo + (le - lo) * min(1.0, max(0.0, frac))
+        lo, prev_cum = le, cum
+    return lo  # +Inf bucket: clamp to the last finite bound
+
+
 def _serving_counters(base: str) -> dict:
     """Scrape the serving counters from the Prometheus text endpoint."""
     try:
@@ -72,6 +107,12 @@ def _serving_counters(base: str) -> dict:
     except (urllib.error.URLError, OSError):
         return {}
     out: dict[str, float] = {}
+    for metric, key in (("pa_serving_step_seconds", "step"),
+                        ("pa_serving_lane_wait_seconds", "lane_wait")):
+        for q in (50, 95):
+            v = _histogram_quantile(text, metric, q)
+            if v is not None:
+                out[f"{key}_p{q}_s"] = round(v, 6)
     for name in ("pa_serving_dispatch_total", "pa_serving_completed_total",
                  "pa_serving_cancelled_total", "pa_serving_rejected_total"):
         total = 0.0
@@ -160,6 +201,13 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
             after.get("pa_serving_dispatch_total", 0.0)
             - before.get("pa_serving_dispatch_total", 0.0)
         ) if after else None,
+        # Server-side quantiles from the /metrics histograms (end-state
+        # values — histograms are cumulative): what the SERVER measured per
+        # lockstep dispatch / lane admission, vs the client-clock latencies
+        # above which include queueing + HTTP + polling.
+        "server_step_p50_s": after.get("step_p50_s"),
+        "server_step_p95_s": after.get("step_p95_s"),
+        "server_lane_wait_p95_s": after.get("lane_wait_p95_s"),
         "errors": failures[:5],
     }
 

@@ -1,0 +1,153 @@
+"""Per-layer time breakdown of a captured trace — stdlib-only.
+
+Reads a Chrome/Perfetto trace-event JSON (``bench.py --trace-out``, the
+server's ``GET /trace``, or a ``utils/tracing.py`` export written to disk)
+and prints where the time went: total/mean span time per layer (the ``cat``
+field: server / graph / sampling / serving / stream / bench), the busiest
+span names, and the trace-derived aggregates — stream overlap efficiency,
+lane-wait p95, host gap.
+
+Stdlib-only by contract (it must run on a laptop holding just the trace
+file, no jax): the aggregate math re-implements
+``utils/tracing.trace_aggregates``; ``tests/test_observability.py`` pins the
+two against each other on the same fixture so they cannot drift.
+
+Usage:
+    python scripts/trace_summary.py trace.json [--json] [--prompt-id ID]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from collections import defaultdict
+
+
+def load_events(path: str) -> list[dict]:
+    with open(path) as f:
+        data = json.load(f)
+    events = data.get("traceEvents", data) if isinstance(data, dict) else data
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def percentile(samples: list[float], q: float) -> float:
+    """Nearest-rank percentile (the scripts/loadgen.py convention)."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    k = max(0, min(len(s) - 1, round(q / 100.0 * (len(s) - 1))))
+    return s[k]
+
+
+def stream_overlap_efficiency(events: list[dict]) -> float | None:
+    """Mirror of utils/tracing.stream_overlap_efficiency (drift-pinned by
+    test): Σ stream-stage-compute / stream-run wall time, mean over runs."""
+    runs = [e for e in events
+            if e["name"] == "stream-run" and e.get("dur", 0) > 0]
+    if not runs:
+        return None
+    comps = [e for e in events if e["name"] == "stream-stage-compute"]
+    effs = []
+    for r in runs:
+        r0, r1 = r["ts"], r["ts"] + r["dur"]
+        busy = sum(c["dur"] for c in comps
+                   if c["tid"] == r["tid"] and c["ts"] >= r0
+                   and c["ts"] + c["dur"] <= r1 + 1.0)
+        effs.append(min(1.0, busy / r["dur"]))
+    return sum(effs) / len(effs)
+
+
+def lane_wait_p95_s(events: list[dict]) -> float | None:
+    waits = [e["dur"] / 1e6 for e in events if e["name"] == "lane-wait"]
+    return percentile(waits, 95) if waits else None
+
+
+def host_gap_ms(events: list[dict]) -> float | None:
+    steps: dict[int, list[dict]] = defaultdict(list)
+    for e in events:
+        if e["name"] == "step":
+            steps[e["tid"]].append(e)
+    gaps = []
+    for evs in steps.values():
+        evs.sort(key=lambda e: e["ts"])
+        for a, b in zip(evs, evs[1:]):
+            gaps.append(max(0.0, b["ts"] - (a["ts"] + a["dur"])) / 1e3)
+    return sum(gaps) / len(gaps) if gaps else None
+
+
+def summarize(events: list[dict]) -> dict:
+    by_cat: dict[str, list[float]] = defaultdict(list)
+    by_name: dict[str, list[float]] = defaultdict(list)
+    for e in events:
+        by_cat[e.get("cat", "?")].append(e.get("dur", 0.0))
+        by_name[e["name"]].append(e.get("dur", 0.0))
+    eff = stream_overlap_efficiency(events)
+    p95 = lane_wait_p95_s(events)
+    gap = host_gap_ms(events)
+    return {
+        "spans": len(events),
+        "layers": {
+            cat: {
+                "spans": len(durs),
+                "total_ms": round(sum(durs) / 1e3, 3),
+                "mean_ms": round(sum(durs) / len(durs) / 1e3, 3),
+                "max_ms": round(max(durs) / 1e3, 3),
+            }
+            for cat, durs in sorted(
+                by_cat.items(), key=lambda kv: -sum(kv[1])
+            )
+        },
+        "top_spans": {
+            name: {
+                "count": len(durs),
+                "total_ms": round(sum(durs) / 1e3, 3),
+                "p95_ms": round(percentile(durs, 95) / 1e3, 3),
+            }
+            for name, durs in sorted(
+                by_name.items(), key=lambda kv: -sum(kv[1])
+            )[:12]
+        },
+        "stream_overlap_efficiency": None if eff is None else round(eff, 4),
+        "lane_wait_p95": None if p95 is None else round(p95, 6),
+        "host_gap_ms": None if gap is None else round(gap, 4),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", help="trace-event JSON file")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary (one JSON object)")
+    ap.add_argument("--prompt-id", default=None,
+                    help="restrict to one prompt's spans")
+    args = ap.parse_args()
+    events = load_events(args.trace)
+    if args.prompt_id is not None:
+        events = [e for e in events
+                  if e.get("args", {}).get("prompt_id") == args.prompt_id]
+    s = summarize(events)
+    if args.json:
+        print(json.dumps(s))
+        return
+    print(f"{s['spans']} spans")
+    print(f"{'layer':<10} {'spans':>6} {'total ms':>10} {'mean ms':>9} "
+          f"{'max ms':>9}")
+    for cat, row in s["layers"].items():
+        print(f"{cat:<10} {row['spans']:>6} {row['total_ms']:>10.3f} "
+              f"{row['mean_ms']:>9.3f} {row['max_ms']:>9.3f}")
+    print()
+    print(f"{'span':<24} {'count':>6} {'total ms':>10} {'p95 ms':>9}")
+    for name, row in s["top_spans"].items():
+        print(f"{name:<24} {row['count']:>6} {row['total_ms']:>10.3f} "
+              f"{row['p95_ms']:>9.3f}")
+    print()
+    print(f"stream_overlap_efficiency: {s['stream_overlap_efficiency']}")
+    print(f"lane_wait_p95: {s['lane_wait_p95']}")
+    print(f"host_gap_ms: {s['host_gap_ms']}")
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BrokenPipeError:
+        pass  # `trace_summary.py t.json | head` is a normal way to use this

@@ -1,0 +1,582 @@
+"""End-to-end span tracing + observability satellites (round 8):
+
+- utils/tracing.py: disabled-path no-op contract (no spans allocated, the
+  null singleton, empty buffers), Chrome trace-event export validity
+  (required keys, per-tid nesting), prompt correlation (span kwarg,
+  inheritance, progress-scope fallback);
+- utils/metrics.py histogram kind: Prometheus ``_bucket``/``_sum``/``_count``
+  exposition (golden-text parse, label escaping, bucket monotonicity) and
+  quantile read-side; scripts/loadgen.py's scraped-quantile twin;
+- utils/logging.py ContextFilter: prompt_id/span_id stamped into records;
+- serving + streaming instrumentation: lane-wait/step/lane spans on the
+  submitter's timeline, stream-stage spans with overlap efficiency in (0,1];
+- server GET /trace; scripts/trace_summary.py pinned against
+  utils/tracing.trace_aggregates on the same fixture.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.utils import tracing
+from comfyui_parallelanything_tpu.utils.logging import ContextFilter, get_logger
+from comfyui_parallelanything_tpu.utils.metrics import MetricsRegistry, registry
+from comfyui_parallelanything_tpu.utils.progress import progress_scope
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _tracer_clean():
+    """Tracing is process-global: every test starts and ends disabled with a
+    fresh buffer, so span leakage cannot couple tests."""
+    tracing.disable()
+    tracing.tracer.clear()
+    yield
+    tracing.disable()
+    tracing.tracer.clear()
+
+
+def _x_events(export=None, **kw):
+    export = tracing.export(**kw) if export is None else export
+    return [e for e in export["traceEvents"] if e.get("ph") == "X"]
+
+
+def _assert_nested_per_tid(events):
+    """Chrome X events on one tid must properly nest: sweeping by start time,
+    every span is either contained in or disjoint from the open span above it
+    (1 µs float-rounding slack)."""
+    by_tid: dict[int, list] = {}
+    for e in events:
+        by_tid.setdefault(e["tid"], []).append(e)
+    for tid, evs in by_tid.items():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: list = []
+        for e in evs:
+            while stack and e["ts"] >= stack[-1]["ts"] + stack[-1]["dur"] - 1e-3:
+                stack.pop()
+            if stack:
+                assert e["ts"] + e["dur"] <= (
+                    stack[-1]["ts"] + stack[-1]["dur"] + 1.0
+                ), f"tid {tid}: span {e} escapes parent {stack[-1]}"
+            stack.append(e)
+
+
+class TestTracerCore:
+    def test_disabled_is_noop(self):
+        """The tier-1 disabled-overhead contract: span() returns the shared
+        null singleton (no Span allocated), record() writes nothing, no
+        per-thread buffer is ever registered — the hot path is one flag
+        check."""
+        assert not tracing.on()
+        s = tracing.span("anything", cat="x", foo=1)
+        assert s is tracing._NULL
+        assert tracing.span("other") is s  # the SAME object: nothing allocated
+        with s as inner:
+            assert inner is s
+            inner.set(bar=2)  # attribute attach is a no-op too
+        tracing.record("x", 0.0, 1.0, foo="bar")
+        assert tracing.tracer._buffers == {}  # no buffer was ever touched
+        assert _x_events() == []
+        assert tracing.current_span_id() is None
+
+    def test_disabled_hot_paths_allocate_no_spans(self):
+        """An eager sampler run with tracing off must leave the tracer
+        untouched — the instrumented hot paths (sampler-run wrapper, step
+        callbacks) are all behind the single flag check."""
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        def model(x, t, context=None, **kw):
+            return x * 0.9
+
+        noise = jnp.ones((1, 4, 4, 4))
+        ctx = jnp.ones((1, 3, 8))
+        out = run_sampler(model, noise, ctx, sampler="euler", steps=2)
+        assert out.shape == noise.shape
+        assert tracing.tracer._buffers == {}
+
+    def test_export_shape_and_nesting(self):
+        tracing.enable()
+        with tracing.span("prompt", cat="server", prompt_id="p1"):
+            with tracing.span("workflow-node", cat="graph", node="3"):
+                with tracing.span("sampler-run", cat="sampling"):
+                    pass
+            with tracing.span("workflow-node", cat="graph", node="4"):
+                pass
+        trace = tracing.export()
+        xs = _x_events(trace)
+        assert len(xs) == 4
+        for e in xs:
+            for key in ("ph", "ts", "dur", "pid", "tid", "name"):
+                assert key in e, (key, e)
+            assert e["ph"] == "X" and e["dur"] >= 0
+            # prompt correlation inherited down the whole subtree
+            assert e["args"]["prompt_id"] == "p1"
+        _assert_nested_per_tid(xs)
+        # thread metadata present (Perfetto track naming)
+        metas = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+        assert any(m["name"] == "thread_name" for m in metas)
+        # the whole export is valid JSON for the Chrome trace loader
+        json.loads(json.dumps(trace))
+
+    def test_prompt_filter_and_cross_thread_record(self):
+        tracing.enable()
+        with tracing.span("prompt", prompt_id="keep"):
+            time.sleep(0.001)
+        with tracing.span("prompt", prompt_id="drop"):
+            pass
+        # dispatcher-style record onto another thread's tid
+        done = threading.Event()
+        main_tid = threading.get_ident()
+
+        def dispatcher():
+            t0 = tracing.now_us()
+            tracing.record("step", t0, 5.0, cat="serving", tid=main_tid,
+                           prompt_id="keep", lane=0)
+            done.set()
+
+        threading.Thread(target=dispatcher).start()
+        assert done.wait(5)
+        kept = _x_events(prompt_id="keep")
+        assert {e["name"] for e in kept} == {"prompt", "step"}
+        step = next(e for e in kept if e["name"] == "step")
+        assert step["tid"] == main_tid  # landed on the prompt's timeline
+        assert all(e["args"]["prompt_id"] == "keep" for e in kept)
+        assert not any(
+            e["args"].get("prompt_id") == "drop"
+            for e in _x_events(prompt_id="keep")
+        )
+
+    def test_progress_scope_fallback(self):
+        """A thread with no span context inherits its prompt from the
+        per-thread progress scope — the server's correlation path."""
+        tracing.enable()
+        with progress_scope(prompt_id="scope-p"):
+            assert tracing.current_prompt_id() == "scope-p"
+            with tracing.span("workflow-node", cat="graph"):
+                pass
+            # nested scope without prompt_id stays on the same prompt
+            with progress_scope(hook=lambda v, m: None):
+                assert tracing.current_prompt_id() == "scope-p"
+        [e] = _x_events()
+        assert e["args"]["prompt_id"] == "scope-p"
+
+    def test_ring_buffer_bounded(self):
+        tracing.enable(capacity=16)
+        for i in range(64):
+            tracing.record("tick", float(i), 1.0)
+        assert len(_x_events()) == 16  # old spans fell off, no growth
+
+
+class TestHistogram:
+    def test_exposition_golden_parse(self):
+        """GET /metrics-shaped output must parse: TYPE lines, escaped labels,
+        monotone cumulative buckets ending at +Inf == _count."""
+        r = MetricsRegistry()
+        labels = {"bucket": 'mo"del\nx', "lane": "0"}
+        for v in (0.004, 0.004, 0.3, 7.0, 500.0):
+            r.histogram("pa_t_step_seconds", v, labels=labels, help="t")
+        r.counter("pa_t_total", 2, labels={"bucket": "b"})
+        r.gauge("pa_t_gauge", 1.5)
+        r.observe("pa_t_summary", 0.5)
+        text = r.render()
+        assert "# TYPE pa_t_step_seconds histogram" in text
+        line_re = re.compile(
+            r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+            r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{([a-zA-Z_][a-zA-Z0-9_]*="
+            r'"(\\.|[^"\\])*")(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})? '
+            r"-?[0-9.eE+-]+(e[+-]?[0-9]+)?)$"
+        )
+        for line in text.strip().splitlines():
+            assert line_re.match(line), f"unparseable exposition line: {line!r}"
+        # bucket monotonicity + +Inf == _count
+        buckets = re.findall(
+            r'^pa_t_step_seconds_bucket\{[^}]*le="([^"]+)"[^}]*\} (\S+)$',
+            text, re.M,
+        )
+        counts = [float(c) for _, c in buckets]
+        assert counts == sorted(counts)
+        assert buckets[-1][0] == "+Inf"
+        count = float(re.search(
+            r"^pa_t_step_seconds_count\{[^}]*\} (\S+)$", text, re.M
+        ).group(1))
+        assert counts[-1] == count == 5.0
+        # raw newline/quote must not survive into the text unescaped
+        assert 'mo\\"del\\nx' in text
+
+    def test_get_and_quantile(self):
+        r = MetricsRegistry()
+        for _ in range(99):
+            r.histogram("h", 0.004)
+        r.histogram("h", 40.0)
+        s, c = r.get("h")
+        assert c == 100 and s == pytest.approx(99 * 0.004 + 40.0)
+        p50 = r.quantile("h", 50)
+        assert 0.0025 < p50 <= 0.005  # inside the 0.004 bucket
+        p95 = r.quantile("h", 95)
+        assert p95 <= 0.005
+        assert r.quantile("h", 99.9) > 25.0
+        assert r.quantile("missing", 50) is None
+
+    def test_loadgen_scraped_quantile_matches_registry(self):
+        sys.path.insert(0, str(REPO / "scripts"))
+        try:
+            from loadgen import _histogram_quantile
+        finally:
+            sys.path.pop(0)
+        r = MetricsRegistry()
+        rng = np.random.default_rng(0)
+        for v in rng.uniform(0.001, 2.0, size=200):
+            r.histogram("pa_s_seconds", float(v), labels={"bucket": "b1"})
+        for v in rng.uniform(0.001, 2.0, size=100):
+            r.histogram("pa_s_seconds", float(v), labels={"bucket": "b2"})
+        text = r.render()
+        for q in (50, 95):
+            scraped = _histogram_quantile(text, "pa_s_seconds", q)
+            assert scraped == pytest.approx(r.quantile("pa_s_seconds", q))
+
+
+class TestLoggingCorrelation:
+    def _capture(self):
+        logger = get_logger()
+        records: list[str] = []
+
+        class _Sink(logging.Handler):
+            def emit(self, rec):
+                records.append(self.format(rec))
+
+        sink = _Sink()
+        sink.setFormatter(logging.Formatter(
+            "prompt=%(prompt_id)s span=%(span_id)s %(message)s"
+        ))
+        sink.addFilter(ContextFilter())
+        logger.addHandler(sink)
+        return logger, sink, records
+
+    def test_records_stamped_from_span_context(self):
+        tracing.enable()
+        logger, sink, records = self._capture()
+        try:
+            logger.info("outside")
+            with tracing.span("prompt", prompt_id="pX") as s:
+                logger.info("inside")
+                assert records[-1] == f"prompt=pX span={s.span_id} inside"
+        finally:
+            logger.removeHandler(sink)
+        assert records[0] == "prompt=- span=- outside"
+
+    def test_records_stamped_from_progress_scope(self):
+        logger, sink, records = self._capture()
+        try:
+            with progress_scope(prompt_id="pScope"):
+                logger.info("scoped")
+        finally:
+            logger.removeHandler(sink)
+        assert records[-1] == "prompt=pScope span=- scoped"
+
+    def test_default_handler_format_carries_correlation(self):
+        logger = get_logger()
+        fmt = logger.handlers[0].formatter._fmt
+        assert "%(prompt_id)s" in fmt and "%(span_id)s" in fmt
+
+
+def _tiny_model(x, t, context=None, **kw):
+    c = jnp.mean(context, axis=tuple(range(1, context.ndim)))
+    c = c.reshape((-1,) + (1,) * (x.ndim - 1))
+    tt = t.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.tanh(x * 0.9 + c * 0.1) * (0.5 + 0.1 * tt / 1000.0)
+
+
+class TestServingSpans:
+    def test_lane_wait_step_lane_on_submitter_timeline(self):
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+        from comfyui_parallelanything_tpu.serving import (
+            ContinuousBatchingScheduler,
+        )
+
+        tracing.enable()
+        sched = ContinuousBatchingScheduler(max_width=4, auto=False).install()
+        try:
+            tids = {}
+
+            def worker(seed, steps):
+                with tracing.span("prompt", prompt_id=f"p{seed}"):
+                    tids[seed] = threading.get_ident()
+                    r = np.random.default_rng(seed)
+                    noise = jnp.asarray(
+                        r.normal(size=(1, 8, 8, 4)).astype(np.float32))
+                    ctx = jnp.asarray(
+                        r.normal(size=(1, 6, 16)).astype(np.float32))
+                    run_sampler(_tiny_model, noise, ctx, sampler="euler",
+                                steps=steps)
+
+            threads = [threading.Thread(target=worker, args=a, daemon=True)
+                       for a in [(1, 2), (2, 3)]]
+            for t in threads:
+                t.start()
+            t0 = time.time()
+            while time.time() - t0 < 20:
+                with sched._lock:
+                    n = sum(len(b.queue) + len(b.active_lanes())
+                            for b in sched.buckets.values())
+                if n >= 2:
+                    break
+                time.sleep(0.005)
+            sched.drain()
+            for t in threads:
+                t.join(20)
+        finally:
+            sched.uninstall()
+            sched.shutdown()
+        xs = _x_events()
+        for seed, steps in [(1, 2), (2, 3)]:
+            mine = [e for e in xs if e["args"].get("prompt_id") == f"p{seed}"]
+            names = [e["name"] for e in mine]
+            assert names.count("step") == steps, names
+            assert "lane-wait" in names and "lane" in names
+            # every span of this prompt sits on the submitter's own timeline,
+            # even though the dispatcher thread recorded the serving ones
+            assert {e["tid"] for e in mine} == {tids[seed]}
+            _assert_nested_per_tid(mine)
+        # dispatcher-side occupancy span carries the masked-lane count
+        disp = [e for e in xs if e["name"] == "serving-dispatch"]
+        assert disp and all(
+            e["args"]["occupancy"] + e["args"]["masked_lanes"]
+            == e["args"]["width"] for e in disp
+        )
+        # trace/metrics consistency: the histograms populated too
+        text = registry.render()
+        assert re.search(r"^pa_serving_step_seconds_bucket\{", text, re.M)
+        assert re.search(r"^pa_serving_lane_wait_seconds_bucket\{", text, re.M)
+
+
+class TestStreamingSpans:
+    @pytest.fixture(scope="class")
+    def flux_model(self):
+        from comfyui_parallelanything_tpu.models.flux import (
+            FluxConfig,
+            build_flux,
+        )
+
+        cfg = FluxConfig(
+            in_channels=16, hidden_size=64, num_heads=4, depth=2,
+            depth_single_blocks=4, context_in_dim=32, vec_in_dim=16,
+            axes_dim=(4, 6, 6), guidance_embed=False, dtype=jnp.float32,
+        )
+        return build_flux(
+            cfg, jax.random.key(0), sample_shape=(1, 8, 8, 4), txt_len=16
+        )
+
+    @pytest.mark.parametrize("overlap", [True, False])
+    def test_stream_stage_spans_and_overlap_efficiency(self, flux_model,
+                                                       overlap):
+        from comfyui_parallelanything_tpu.models.loader import params_nbytes
+        from comfyui_parallelanything_tpu.parallel.streaming import (
+            build_streaming_runner,
+        )
+
+        tracing.enable()
+        runner = build_streaming_runner(
+            flux_model.pipeline_spec, flux_model.params,
+            jax.devices("cpu")[0],
+            hbm_budget_bytes=params_nbytes(flux_model.params) // 3,
+            overlap=overlap,
+        )
+        x = jnp.zeros((1, 8, 8, 4))
+        t = jnp.ones((1,))
+        ctx = jnp.zeros((1, 16, 32))
+        y = jnp.zeros((1, 16))
+        out = runner(x, t, ctx, y=y)
+        jax.block_until_ready(out)
+        xs = _x_events()
+        names = {e["name"] for e in xs}
+        assert {"stream-run", "stream-stage-prefetch",
+                "stream-stage-compute"} <= names
+        n_stages = runner.n_stages
+        computes = [e for e in xs if e["name"] == "stream-stage-compute"]
+        prefetches = [e for e in xs if e["name"] == "stream-stage-prefetch"]
+        assert len(computes) == n_stages  # every stage's compute is spanned
+        assert len(prefetches) == n_stages
+        assert {e["args"]["stage"] for e in computes} == set(range(n_stages))
+        assert all(e["args"]["nbytes"] > 0 for e in prefetches)
+        # exposed transfer is booked separately from compute (the semantic
+        # stream_overlap_efficiency depends on): one pre-dispatch wait per
+        # stage, disjoint from every compute span
+        waits = [e for e in xs if e["name"] == "stream-prefetch-wait"]
+        assert {e["args"]["stage"] for e in waits} == set(range(n_stages))
+        for w in waits:
+            for c in computes:
+                assert (w["ts"] + w["dur"] <= c["ts"] + 1.0
+                        or w["ts"] >= c["ts"] + c["dur"] - 1.0), (w, c)
+        eff = tracing.stream_overlap_efficiency(xs)
+        assert eff is not None and 0.0 < eff <= 1.0
+        _assert_nested_per_tid(xs)
+        # the /metrics twin landed
+        got = registry.get(
+            "pa_stream_overlap_efficiency",
+            {"device": str(jax.devices("cpu")[0])},
+        )
+        assert got is not None and 0.0 < got <= 1.0
+
+    def test_no_spans_when_disabled(self, flux_model):
+        from comfyui_parallelanything_tpu.models.loader import params_nbytes
+        from comfyui_parallelanything_tpu.parallel.streaming import (
+            build_streaming_runner,
+        )
+
+        runner = build_streaming_runner(
+            flux_model.pipeline_spec, flux_model.params,
+            jax.devices("cpu")[0],
+            hbm_budget_bytes=params_nbytes(flux_model.params) // 3,
+        )
+        out = runner(jnp.zeros((1, 8, 8, 4)), jnp.ones((1,)),
+                     jnp.zeros((1, 16, 32)), y=jnp.zeros((1, 16)))
+        jax.block_until_ready(out)
+        assert tracing.tracer._buffers == {}
+
+
+class TestTraceSummaryScript:
+    def _fixture_trace(self, tmp_path) -> Path:
+        """A captured-fixture trace exercising every aggregate: one streamed
+        run, serving lane-waits, and sequential steps with host gaps."""
+        tracing.enable()
+        t0 = tracing.now_us()
+        tracing.record("stream-run", t0, 1000.0, cat="stream")
+        tracing.record("stream-stage-prefetch", t0, 60.0, cat="stream",
+                       stage=0, nbytes=100)
+        tracing.record("stream-stage-compute", t0 + 100, 400.0, cat="stream",
+                       stage=0, nbytes=100)
+        tracing.record("stream-stage-compute", t0 + 550, 300.0, cat="stream",
+                       stage=1, nbytes=100)
+        tracing.record("lane-wait", t0, 2_000_000.0, cat="serving")
+        tracing.record("lane-wait", t0, 1_000_000.0, cat="serving")
+        with tracing.span("prompt", prompt_id="pf"):
+            tracing.record("step", t0 + 2000, 100.0, cat="sampling", step=1)
+            tracing.record("step", t0 + 2400, 100.0, cat="sampling", step=2)
+        path = tmp_path / "trace.json"
+        path.write_text(json.dumps(tracing.export()))
+        return path
+
+    def test_summary_matches_tracing_aggregates(self, tmp_path):
+        path = self._fixture_trace(tmp_path)
+        expect = tracing.trace_aggregates(tracing.export())
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "trace_summary.py"),
+             str(path), "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        summary = json.loads(proc.stdout)
+        # the stdlib re-implementation is pinned against the in-package math
+        for key in ("stream_overlap_efficiency", "lane_wait_p95",
+                    "host_gap_ms"):
+            assert summary[key] == pytest.approx(expect[key]), key
+        assert summary["stream_overlap_efficiency"] == pytest.approx(0.7)
+        assert summary["lane_wait_p95"] == pytest.approx(2.0)
+        assert summary["host_gap_ms"] == pytest.approx(0.3)
+        assert summary["layers"]["stream"]["spans"] == 4
+        assert summary["spans"] == len(_x_events())
+
+    def test_human_output_and_prompt_filter(self, tmp_path):
+        path = self._fixture_trace(tmp_path)
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "trace_summary.py"),
+             str(path)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "stream_overlap_efficiency:" in proc.stdout
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "trace_summary.py"),
+             str(path), "--json", "--prompt-id", "pf"],
+            capture_output=True, text=True, timeout=120,
+        )
+        summary = json.loads(proc.stdout)
+        assert summary["spans"] == 3  # prompt span + its 2 steps
+        assert summary["stream_overlap_efficiency"] is None
+
+
+class _EchoNode:
+    """Minimal declarative node for server round-trips without any model."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"x": ("INT", {"default": 0})}}
+
+    RETURN_TYPES = ("INT",)
+    FUNCTION = "run"
+
+    def run(self, x):
+        return (x + 1,)
+
+
+class TestServerTraceEndpoint:
+    @pytest.fixture
+    def server(self, tmp_path):
+        from comfyui_parallelanything_tpu.server import make_server
+
+        srv, q = make_server(
+            port=0, output_dir=str(tmp_path / "out"),
+            class_mappings={"Echo": _EchoNode}, trace=True,
+        )
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        yield base, q
+        srv.shutdown()
+        q.shutdown()
+
+    def test_trace_endpoint_serves_prompt_timeline(self, server):
+        import urllib.request
+
+        base, q = server
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=30) as r:
+                return json.loads(r.read())
+
+        body = json.dumps({"prompt": {
+            "1": {"class_type": "Echo", "inputs": {"x": 1}},
+            "2": {"class_type": "Echo", "inputs": {"x": ["1", 0]}},
+        }}).encode()
+        req = urllib.request.Request(
+            base + "/prompt", data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            pid = json.loads(r.read())["prompt_id"]
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            if pid in get(f"/history/{pid}"):
+                break
+            time.sleep(0.05)
+        trace = get(f"/trace?prompt_id={pid}")
+        assert trace["enabled"] is True
+        xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        names = [e["name"] for e in xs]
+        assert names.count("prompt") == 1
+        assert names.count("workflow-node") == 2  # both Echo nodes spanned
+        prompt = next(e for e in xs if e["name"] == "prompt")
+        for e in xs:
+            assert e["args"]["prompt_id"] == pid
+            assert e["tid"] == prompt["tid"]
+        _assert_nested_per_tid(xs)
+        # unfiltered export includes it too; bogus filter excludes everything
+        assert any(
+            e.get("args", {}).get("prompt_id") == pid
+            for e in get("/trace")["traceEvents"] if e.get("ph") == "X"
+        )
+        assert [e for e in get("/trace?prompt_id=nope")["traceEvents"]
+                if e.get("ph") == "X"] == []
