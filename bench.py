@@ -62,11 +62,15 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # every record it produces lands in PA_EVIDENCE_DIR and carries "dryrun".
 _FAKE_TPU = os.environ.get("PA_FAKE_TPU_PLATFORM")
 _TINY = os.environ.get("PA_BENCH_TINY") == "1"
-if (_FAKE_TPU or _TINY) and not os.environ.get("PA_EVIDENCE_DIR"):
+_FAIL_INJECT = os.environ.get("PA_FAIL_INJECT")
+if (_FAKE_TPU or _TINY or _FAIL_INJECT) and not os.environ.get(
+        "PA_EVIDENCE_DIR"):
     raise RuntimeError(
-        "PA_FAKE_TPU_PLATFORM / PA_BENCH_TINY require PA_EVIDENCE_DIR: a "
-        "faked platform or tiny-workload run must never write into the "
-        "repo's real evidence artifacts"
+        "PA_FAKE_TPU_PLATFORM / PA_BENCH_TINY / PA_FAIL_INJECT require "
+        "PA_EVIDENCE_DIR: a faked platform, tiny-workload, or "
+        "injected-failure run must never write into the repo's real "
+        "evidence artifacts (the perf ledger and postmortem bundles follow "
+        "the evidence dir)"
     )
 _TPU_PLATFORMS = ("tpu", "axon") + ((_FAKE_TPU,) if _FAKE_TPU else ())
 
@@ -91,6 +95,32 @@ def evidence_dir() -> str:
     dry-run points this at a temp dir so a mocked run can never pollute the
     real record."""
     return os.environ.get("PA_EVIDENCE_DIR") or _REPO
+
+
+def _ledger_append(record: dict, kind: str) -> None:
+    """Outer-process perf-ledger append. Stdlib twin of
+    ``comfyui_parallelanything_tpu.utils.telemetry.append_ledger_record`` —
+    the outer process must never import the package (its ``__init__`` pulls
+    jax, which a wedged axon tunnel hangs), so the schema stamp lives in both
+    places on purpose; ``scripts/perf_ledger.py`` validates the shared
+    ``schema`` field either way. Best-effort: a full disk must not cost the
+    driver its one JSON line."""
+    import time
+
+    ledger = os.environ.get("PA_LEDGER_DIR") or os.path.join(
+        evidence_dir(), "ledger"
+    )
+    rec = dict(record)
+    rec["schema"] = "pa-perf-ledger/v1"
+    rec["kind"] = kind
+    rec.setdefault("ts", time.time())
+    rec.setdefault("pid", os.getpid())
+    try:
+        os.makedirs(ledger, exist_ok=True)
+        with open(os.path.join(ledger, "perf_ledger.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
 
 # Peak dense bf16 FLOP/s per chip, by device_kind substring (public spec sheets).
 _PEAK_BF16 = [
@@ -629,11 +659,41 @@ def _make_step(pm, batch, n_chunks, t, ctx, kwargs):
 
 
 def run_inner() -> None:
+    """The measured benchmark, wrapped by the flight recorder: on ANY failure
+    a postmortem bundle (trace rings, metrics, per-device memory, recent
+    logs — utils/telemetry.py) is dumped and its path surfaced on stderr as
+    ``POSTMORTEM_BUNDLE=<path>`` for the outer process / watchdog to attach
+    to the failure record; the exception then propagates so the outer
+    fallback ladder (stale re-emit → CPU smoke) behaves exactly as before."""
+    try:
+        _run_inner()
+    except BaseException as e:
+        if isinstance(e, SystemExit) and not e.code:
+            raise
+        try:
+            from comfyui_parallelanything_tpu.utils import telemetry
+
+            tag = os.environ.get("BENCH_CONFIG", "default")
+            path = telemetry.write_postmortem(f"bench-{tag}", error=e)
+            if path:
+                sys.stderr.write(f"POSTMORTEM_BUNDLE={path}\n")
+        except Exception:
+            pass
+        raise
+
+
+def _run_inner() -> None:
     import jax
     import jax.numpy as jnp
 
     # Persistent XLA compilation cache: repeat driver runs skip the 20-40s
-    # first-compile (cache dir is repo-local; harmless on first run).
+    # first-compile (cache dir is repo-local; harmless on first run). The
+    # enable also installs the compile-event watchers; install them
+    # explicitly too so compile accounting survives a cache-enable failure.
+    from comfyui_parallelanything_tpu.utils import telemetry
+
+    telemetry.watch_compiles()
+    telemetry.watermark.reset()
     try:
         from comfyui_parallelanything_tpu.utils import enable_compilation_cache
 
@@ -722,10 +782,33 @@ def run_inner() -> None:
 
     tracing.enable()
     inner_step = step
+    # PA_FAIL_INJECT (guarded above by the PA_EVIDENCE_DIR requirement): a
+    # deterministic mid-run failure so the postmortem/forensics path is
+    # rehearsed off-hardware — the round-3 lesson applied to the flight
+    # recorder itself. The third step fails, so the bundle holds real warmup
+    # spans/samples.
+    _fail_at = 3 if _FAIL_INJECT else None
+    _step_no = [0]
 
     def step(v):
+        _step_no[0] += 1
+        if _fail_at is not None and _step_no[0] >= _fail_at:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: injected failure "
+                f"(PA_FAIL_INJECT={_FAIL_INJECT})"
+            )
         with tracing.span("step", cat="bench", rung=config_name):
-            return inner_step(v)
+            out = inner_step(v)
+        # HBM watermark sampling during WARMUP steps only: memory_stats() is
+        # a host call (and the fallback walks live arrays), so sampling
+        # inside the timed loop would inflate sec/it against baselines
+        # banked before round 9 — the exact protocol drift the pinned
+        # iteration counts exist to prevent. Warmup runs the identical
+        # program, so the peak it observes is the steady-state peak; one
+        # more sample lands after the timed loop below.
+        if _step_no[0] <= BENCH_WARMUP_STEPS:
+            telemetry.watermark.sample()
+        return out
 
     # Warmup/compile + timed denoise-step iterations, tunnel-proof: the axon
     # plugin's block_until_ready returned in 2.8 ms for a 43-TFLOP step (~80x
@@ -741,6 +824,10 @@ def run_inner() -> None:
     if os.environ.get("PA_BENCH_TINY") == "1":
         iters = 3  # dry-run: control flow under test, not timing fidelity
     sec_it, _ = chained_time(step, x, iters, warmup=BENCH_WARMUP_STEPS)
+    # Post-loop watermark sample (the warmup-phase samples above kept the
+    # host call out of the timed iterations): on real devices memory_stats'
+    # running peak covers the timed steps too.
+    telemetry.watermark.sample()
 
     trace_events = tracing.export()
     trace_aggs = tracing.trace_aggregates(trace_events)
@@ -773,6 +860,7 @@ def run_inner() -> None:
         resolved_backends,
     )
 
+    _comp = telemetry.compile_snapshot()
     record = {
         "metric": f"sec/it denoise step [{config_name}]",
         "value": round(sec_it, 4),
@@ -795,6 +883,16 @@ def run_inner() -> None:
         # the mean host gap between step spans — where host scheduling
         # overhead shows up before any device profile is opened.
         **trace_aggs,
+        # Resource accounting (utils/telemetry.py, round 9): where the
+        # compiles and the bytes went. compile_time_s is total in-process
+        # XLA backend-compile wall time; hits/misses are the persistent
+        # compilation cache's (a warm .jax_cache turns the 20-40s
+        # first-compile into hits); peak_hbm_bytes is the per-iteration
+        # watermark (deterministic pseudo-accounting off-hardware).
+        "compile_time_s": _comp["compile_time_s"],
+        "compile_cache_hits": _comp["cache_hits"],
+        "compile_cache_misses": _comp["cache_misses"],
+        "peak_hbm_bytes": telemetry.watermark.peak_bytes or None,
         # Which attention path(s) actually served the run, resolved at trace
         # time ("pallas", "xla", or "pallas+xla" when different shapes picked
         # differently) — so the evidence never hides an XLA fallback behind an
@@ -814,6 +912,9 @@ def run_inner() -> None:
         if full:
             record["full_model_flops_per_step"] = full
             record["extrapolated_full_depth_s_it"] = round(sec_it * full / flops, 4)
+    # Perf-ledger record (utils/telemetry.py): the regression gate's input —
+    # one schema-versioned line per measured run, rung-stamped.
+    telemetry.append_ledger_record({**record, "rung": config_name}, "bench")
     print(json.dumps(record))
 
 
@@ -825,11 +926,24 @@ def _cpu_env():
     return _sanitized_cpu_env(1)
 
 
+def _postmortem_path(stderr: str) -> str | None:
+    """The inner child's ``POSTMORTEM_BUNDLE=<path>`` marker, if it dumped
+    one before dying (run_inner's flight-recorder wrapper)."""
+    import re
+
+    m = None
+    for m in re.finditer(r"POSTMORTEM_BUNDLE=(\S+)", stderr or ""):
+        pass  # last marker wins (retries can dump more than one)
+    return m.group(1) if m else None
+
+
 def _run_child(env, config, timeout):
     """Run the inner benchmark in a subprocess.
 
-    Returns ``(json_line_or_None, stderr_tail)`` — the stderr tail is preserved
-    so a failed child's traceback survives into the round's artifacts."""
+    Returns ``(json_line_or_None, stderr_tail, postmortem_path_or_None)`` —
+    the stderr tail is preserved so a failed child's traceback survives into
+    the round's artifacts, and the postmortem marker is extracted BEFORE the
+    tail truncation (the traceback printed after it can exceed the tail)."""
     env = dict(env)
     if config is not None:
         env["BENCH_CONFIG"] = config
@@ -846,8 +960,9 @@ def _run_child(env, config, timeout):
         stdout, stderr = _salvage_output(e)
         tail = (f"inner benchmark timed out after {timeout}s; "
                 f"stderr tail:\n{stderr.strip()[-2000:]}")
-        return _last_json_line(stdout), tail
-    return _last_json_line(proc.stdout), proc.stderr.strip()[-2000:]
+        return _last_json_line(stdout), tail, _postmortem_path(stderr)
+    return (_last_json_line(proc.stdout), proc.stderr.strip()[-2000:],
+            _postmortem_path(proc.stderr))
 
 
 def _last_json_line(stdout):
@@ -893,17 +1008,32 @@ def _tpu_probe(timeout=120, attempts=2):
     return False, reason
 
 
-def _error_line(error, metric="error"):
+# Fields added to the line schema after records were first banked: a stale
+# re-emit (or error line) must carry them as nulls, never omit them — the
+# schema stays uniform for every consumer.
+_LATE_SCHEMA_FIELDS = (
+    "stream_overlap_efficiency", "lane_wait_p95", "host_gap_ms",
+    "compile_time_s", "compile_cache_hits", "compile_cache_misses",
+    "peak_hbm_bytes",
+)
+
+
+def _error_line(error, metric="error", postmortem=None):
     """The one failure-path JSON schema — every error exit goes through here so
     the driver always sees a consistent field set (including the trace-derived
-    aggregate fields every bench line now carries, null here)."""
-    return json.dumps({
+    aggregate and resource-accounting fields every bench line now carries,
+    null here). ``postmortem`` is the failure bundle's path when the inner
+    child managed to dump one."""
+    rec = {
         "metric": metric, "value": 0, "unit": "", "vs_baseline": None,
         "platform": "none", "n_devices": 0, "error": error[:300],
         "loadavg_1m": _loadavg_1m(),
-        "stream_overlap_efficiency": None, "lane_wait_p95": None,
-        "host_gap_ms": None,
-    })
+    }
+    for field in _LATE_SCHEMA_FIELDS:
+        rec[field] = None
+    if postmortem:
+        rec["postmortem"] = postmortem
+    return json.dumps(rec)
 
 
 def _pop_trace_out_flag() -> None:
@@ -948,10 +1078,13 @@ def _orchestrate() -> None:
 
     # smoke is by definition the no-TPU rung — skip the (up to 2×120s) probe.
     fallback_cause = "no TPU available"
+    postmortem = None
     if os.environ.get("BENCH_FORCE_CPU") != "1" and requested != "smoke":
         tpu_ok, probe_reason = _tpu_probe()
         if tpu_ok:
-            line, err = _run_child(dict(os.environ), requested, timeout=1800)
+            line, err, postmortem = _run_child(
+                dict(os.environ), requested, timeout=1800
+            )
             if line is not None:
                 print(line)
                 return
@@ -960,6 +1093,13 @@ def _orchestrate() -> None:
                 f"bench: {fallback_cause}; falling back to CPU smoke. "
                 f"Inner stderr tail:\n{err}\n"
             )
+            # The failed attempt is ledger history (kind=error — the
+            # regression gate never compares it) with its forensics pointer.
+            _ledger_append({
+                "rung": requested, "error": fallback_cause,
+                "stderr_tail": err[-500:], "postmortem": postmortem,
+                "loadavg_1m": _loadavg_1m(),
+            }, "error")
         elif probe_reason:
             fallback_cause = f"TPU probe failed: {probe_reason[:200]}"
             sys.stderr.write(f"bench: TPU probe failed — {probe_reason}\n")
@@ -976,11 +1116,16 @@ def _orchestrate() -> None:
             out["stale_reason"] = fallback_cause
             out["captured_ts"] = out.get("ts")
             out["loadavg_1m"] = _loadavg_1m()  # load NOW, not at capture
-            # Records banked before round 8 predate the trace-derived
-            # aggregates; the schema stays uniform (nulls, never absent).
-            for field in ("stream_overlap_efficiency", "lane_wait_p95",
-                          "host_gap_ms"):
+            # Records banked before rounds 8/9 predate the trace-derived
+            # aggregates and the resource-accounting fields; the schema
+            # stays uniform (nulls, never absent).
+            for field in _LATE_SCHEMA_FIELDS:
                 out.setdefault(field, None)
+            if postmortem:
+                # The FAILED fresh attempt's forensics ride the stale line —
+                # the whole point of the bundle is diagnosing why the rung
+                # needed the fallback.
+                out["postmortem"] = postmortem
             sys.stderr.write(
                 f"bench: emitting stale banked TPU record for rung "
                 f"{out.get('rung')!r} (captured ts {out.get('ts')}) — "
@@ -997,16 +1142,36 @@ def _orchestrate() -> None:
             f"bench: substituting CPU smoke rung for requested {requested!r} "
             f"({fallback_cause})\n"
         )
-    line, err = _run_child(_cpu_env(), "smoke", timeout=900)
+    line, err, cpu_postmortem = _run_child(_cpu_env(), "smoke", timeout=900)
     if line is not None:
+        if postmortem:
+            # A TPU attempt failed (and dumped forensics) before this smoke
+            # substitution — its bundle path must ride the line we actually
+            # emit, like the stale and error paths, or the most common
+            # failure shape (TPU OOM → smoke fallback) loses its postmortem.
+            try:
+                out = json.loads(line)
+                out["postmortem"] = postmortem
+                line = json.dumps(out)
+            except json.JSONDecodeError:
+                pass
         print(line)
         return
 
-    # Last resort: still exactly one parseable line, honestly labeled.
+    # Last resort: still exactly one parseable line, honestly labeled, with
+    # the forensics pointer (the most recent bundle any child dumped).
+    postmortem = cpu_postmortem or postmortem
     sys.stderr.write(f"bench: CPU fallback also failed. Inner stderr tail:\n{err}\n")
+    _ledger_append({
+        "rung": requested or "smoke",
+        "error": "both TPU and CPU benchmark subprocesses failed",
+        "stderr_tail": err[-500:], "postmortem": postmortem,
+        "loadavg_1m": _loadavg_1m(),
+    }, "error")
     print(_error_line(
         "both TPU and CPU benchmark subprocesses failed; last stderr: " + err[-200:],
         metric="sec/it denoise step [unavailable]",
+        postmortem=postmortem,
     ))
     sys.exit(1)
 

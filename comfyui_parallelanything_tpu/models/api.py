@@ -88,7 +88,11 @@ class DiffusionModel:
         key = sequence_ctx_key()
         fn = self._jit_cache.get(key)
         if fn is None:
-            fn = self._jit_cache[key] = jax.jit(self.apply)
+            from ..utils.telemetry import instrument_jit
+
+            fn = self._jit_cache[key] = instrument_jit(
+                self.apply, f"model-apply:{self.name}"
+            )
         return fn(self.params, x, timesteps, context, **kwargs)
 
     def n_params(self) -> int:

@@ -377,7 +377,9 @@ class ParallelModel:
             def wrapped(params, x, t, context, traced_kwargs):
                 return apply(params, x, t, context, **traced_kwargs, **bound)
 
-            fn = jax.jit(wrapped)
+            from ..utils.telemetry import instrument_jit
+
+            fn = instrument_jit(wrapped, "parallel-apply")
             self._jits[key] = fn
         return fn
 

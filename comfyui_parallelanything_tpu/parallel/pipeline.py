@@ -38,6 +38,7 @@ import jax
 
 from ..models.api import PipelineSpec
 from ..utils.logging import log_placement
+from ..utils.telemetry import instrument_jit
 from .split import block_ranges, partition_kwargs, static_kwargs_key
 
 
@@ -103,7 +104,7 @@ class PipelineRunner:
                 _Stage(
                     device=dev,
                     params=jax.device_put(subset(keys), dev),
-                    fn=jax.jit(stage_fn),
+                    fn=instrument_jit(stage_fn, f"pipeline-stage[{s}:{e})"),
                     labels=tuple(spec.segments[i].label for i in range(s, e)),
                 )
             )
@@ -127,7 +128,7 @@ class PipelineRunner:
             def wrapped(params, x, t, context, traced):
                 return prepare(params, x, t, context, **traced, **bound)
 
-            fn = jax.jit(wrapped)
+            fn = instrument_jit(wrapped, "pipeline-prepare")
             self._prepare_jits[key] = fn
         return fn
 
@@ -140,7 +141,7 @@ class PipelineRunner:
             def wrapped(params, carry):
                 return finalize(params, carry, out_shape)
 
-            fn = jax.jit(wrapped)
+            fn = instrument_jit(wrapped, "pipeline-finalize")
             self._finalize_jits[out_shape] = fn
         return fn
 

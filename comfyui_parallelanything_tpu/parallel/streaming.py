@@ -55,6 +55,7 @@ from ..models.api import PipelineSpec
 from ..models.loader import carve_stages, params_nbytes, pin_params_host
 from ..utils import tracing
 from ..utils.logging import get_logger, log_placement
+from ..utils.telemetry import instrument_jit, watermark
 from .split import partition_kwargs, static_kwargs_key
 
 
@@ -157,7 +158,7 @@ class StreamingRunner:
             self.stages.append(
                 _Stage(
                     keys=tuple(keys),
-                    fn=jax.jit(stage_fn),
+                    fn=instrument_jit(stage_fn, f"stream-stage[{s}:{e})"),
                     nbytes=params_nbytes(
                         {k: self._host_params[k] for k in keys}
                     ),
@@ -221,7 +222,7 @@ class StreamingRunner:
             def wrapped(params, x, t, context, traced):
                 return prepare(params, x, t, context, **traced, **bound)
 
-            fn = jax.jit(wrapped)
+            fn = instrument_jit(wrapped, "stream-prepare")
             self._prepare_jits[key] = fn
         return fn
 
@@ -233,11 +234,27 @@ class StreamingRunner:
             def wrapped(params, carry):
                 return finalize(params, carry, out_shape)
 
-            fn = jax.jit(wrapped)
+            fn = instrument_jit(wrapped, "stream-finalize")
             self._finalize_jits[out_shape] = fn
         return fn
 
     # -- the double-buffered schedule --------------------------------------
+
+    def _publish_residency(self) -> None:
+        """The pa_hbm_stream_* gauge view of the tracker (utils/metrics.py);
+        refreshed at every placement/retirement so /metrics always shows the
+        live streamed-weight footprint against its 2-stage bound."""
+        try:
+            from ..devices.memory import _device_label
+
+            # Same platform:id label vocabulary as the pa_hbm_bytes_* device
+            # gauges, so residency joins against capacity on the device label.
+            self.tracker.publish_gauges(
+                _device_label(self.device),
+                bound_bytes=2 * self.max_stage_nbytes,
+            )
+        except Exception:
+            pass
 
     def _place_stage(self, idx: int):
         stage = self.stages[idx]
@@ -245,6 +262,7 @@ class StreamingRunner:
             {k: self._host_params[k] for k in stage.keys}, self.device
         )
         self.tracker.place(idx, stage.nbytes)
+        self._publish_residency()
         if not self.overlap:
             jax.block_until_ready(placed)
         return placed
@@ -257,6 +275,7 @@ class StreamingRunner:
             return
         _delete_buffers(placed)
         self.tracker.retire(idx)
+        self._publish_residency()
 
     def __call__(self, x, timesteps, context=None, **kwargs):
         from ..ops.attention import sequence_ctx_key
@@ -325,6 +344,12 @@ class StreamingRunner:
                             record_compute(pending[0], pending[1])
                             pending = None
                         self._retire_stage(k - 1, ring)
+                        if trace_on:
+                            # Per-phase HBM watermark (traced runs only: the
+                            # untraced schedule stays probe-free). This is
+                            # the boundary where residency is at its 2-stage
+                            # peak — the honest sample point.
+                            watermark.sample([self.device])
                     if k + 1 < len(self.stages):
                         with tracing.span(
                             "stream-stage-prefetch", cat="stream", stage=k + 1,
@@ -384,6 +409,7 @@ class StreamingRunner:
                 if last in ring:
                     ring.pop(last)
                     self.tracker.retire(last)
+                    self._publish_residency()
                 return out
             finally:
                 # Failure path (OOM mid-schedule): release whatever the ring

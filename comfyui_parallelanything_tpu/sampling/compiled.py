@@ -705,6 +705,7 @@ def _get_loop_jit(kind: str, spec: TraceSpec, static: dict, meta: tuple, build):
     the cache is bounded and clearable above)."""
     from ..ops.attention import sequence_ctx_key
     from ..parallel.split import static_kwargs_key
+    from ..utils.telemetry import instrument_jit
 
     key = (kind, spec.apply, static_kwargs_key(static), meta, spec.mesh,
            spec.data_axis, sequence_ctx_key())
@@ -714,7 +715,13 @@ def _get_loop_jit(kind: str, spec: TraceSpec, static: dict, meta: tuple, build):
             _loop_jits.pop(next(iter(_loop_jits)))
         impl = build(dict(static))
         donate = (1,) if _donate_for(spec) else ()
-        fn = _loop_jits[key] = jax.jit(impl, donate_argnums=donate)
+        # Compile accounting (utils/telemetry.py): the k-family bakes the
+        # sampler name into the program label; the other kinds are
+        # one-program-per-kind.
+        prog = f"loop:{kind}:{meta[0]}" if kind == "k" else f"loop:{kind}"
+        fn = _loop_jits[key] = instrument_jit(
+            impl, prog, donate_argnums=donate
+        )
     return fn
 
 

@@ -34,7 +34,15 @@ Endpoints (the ComfyUI client-protocol subset that makes scripts work):
 - ``GET  /metrics``           Prometheus text: serving per-bucket occupancy,
                               lane-wait/step-time histograms (server-side
                               p50/p95), dispatch counts (utils/metrics.py
-                              registry) + queue gauges
+                              registry) + queue gauges + per-device
+                              ``pa_hbm_*`` memory gauges (refreshed per
+                              scrape and by the periodic memory monitor)
+- ``GET  /health``            one JSON health document
+                              (utils/telemetry.health_snapshot): devices,
+                              per-device HBM + utilization (deterministic
+                              pseudo-accounting off-hardware), peak
+                              watermark, compile/cache accounting, queue
+                              depth/workers, 1-minute load average
 - ``GET  /trace``             Chrome/Perfetto trace-event JSON of the span
                               tracer (utils/tracing.py) — per-prompt
                               timelines from HTTP ingress to device step;
@@ -242,6 +250,19 @@ class PromptQueue:
             from .serving import ContinuousBatchingScheduler
 
             self.scheduler = ContinuousBatchingScheduler().install()
+        # Periodic HBM sampling (utils/telemetry.py): keeps the pa_hbm_*
+        # gauges and the peak watermark fresh between /metrics scrapes so
+        # GET /health reflects memory state even while a prompt is wedged.
+        self._mem_monitor = None
+        try:
+            from .utils.telemetry import MemoryMonitor, watch_compiles
+
+            watch_compiles()  # /health's compile section needs the listeners
+            self._mem_monitor = MemoryMonitor(
+                float(os.environ.get("PA_MEM_SAMPLE_S", "60"))
+            ).start()
+        except Exception:
+            pass
         self._workers = [
             threading.Thread(target=self._run, daemon=True)
             for _ in range(self.workers)
@@ -408,6 +429,8 @@ class PromptQueue:
         self.pending.put(None)  # workers cascade the sentinel to siblings
         for t in self._workers:
             t.join(timeout=30)
+        if self._mem_monitor is not None:
+            self._mem_monitor.stop()
         if self.scheduler is not None:
             self.scheduler.uninstall()
             self.scheduler.shutdown()
@@ -428,7 +451,7 @@ class PromptQueue:
                 # aimed at a previous prompt cannot exist by construction.
                 self.running[pid] = cancel_evt
             self._emit({"type": "execution_start", "data": {"prompt_id": pid}})
-            t0 = time.time()
+            t0 = time.monotonic()
             # Per-node `executing` + per-step `progress` events — the pair a
             # stock ComfyUI frontend renders its progress bars from. The node
             # id rides a cell so the progress hook can tag its events with
@@ -490,7 +513,7 @@ class PromptQueue:
                     )
                 entry = {
                     "status": {"status_str": "success", "completed": True,
-                               "exec_s": round(time.time() - t0, 3)},
+                               "exec_s": round(time.monotonic() - t0, 3)},
                     "outputs": self._image_outputs(prompt, results),
                 }
                 # Per-output-node `executed` events (what API clients collect
@@ -516,6 +539,23 @@ class PromptQueue:
                                "message": f"{type(e).__name__}: {e}"},
                     "outputs": {},
                 }
+                # Flight recorder: an OOM (or any error under
+                # PA_POSTMORTEM=always) dumps a forensics bundle and hands
+                # the client its path in the history entry — the next
+                # serving-on-hardware failure is diagnosable after the fact.
+                try:
+                    from .utils.telemetry import (
+                        looks_like_oom,
+                        write_postmortem,
+                    )
+
+                    if (looks_like_oom(e)
+                            or os.environ.get("PA_POSTMORTEM") == "always"):
+                        bundle = write_postmortem(f"prompt-{pid}", error=e)
+                        if bundle:
+                            entry["status"]["postmortem"] = bundle
+                except Exception:  # noqa: BLE001 — forensics is best-effort
+                    pass
             with self._lock:
                 self.history[pid] = entry
                 if pid in self.pending_ids:
@@ -601,10 +641,32 @@ class _Handler(BaseHTTPRequestHandler):
                                help="prompts queued, not yet running")
                 registry.gauge("pa_server_running", len(self.q.running),
                                help="prompts executing right now")
+            try:
+                # Scrape-time refresh of the pa_hbm_* device gauges (the
+                # periodic monitor keeps them warm between scrapes; a dead
+                # device backend degrades to the last published values).
+                from .devices.memory import publish_memory_gauges
+
+                publish_memory_gauges()
+            except Exception:
+                pass
             return self._send(
                 200, registry.render().encode(),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
+        if url.path == "/health":
+            from .utils.telemetry import health_snapshot
+
+            with self.q._lock:
+                queue = {
+                    "pending": len(self.q.pending_ids) - len(self.q.running),
+                    "running": len(self.q.running),
+                    "workers": self.q.workers,
+                    "max_pending": self.q.max_pending,
+                    "completed": len(self.q.history),
+                    "serving": self.q.scheduler is not None,
+                }
+            return self._send(200, health_snapshot(queue=queue))
         if url.path == "/trace":
             # Chrome/Perfetto trace-event JSON (open at ui.perfetto.dev).
             # With tracing disabled the export is empty — the body says so

@@ -3,7 +3,7 @@ from .cleanup import aggressive_cleanup
 from .compile_cache import enable_compilation_cache
 from .metrics import StepTimer, StepStats, trace
 from .checks import assert_finite, checked
-from . import tracing
+from . import telemetry, tracing
 
 __all__ = [
     "enable_compilation_cache",
@@ -16,6 +16,7 @@ __all__ = [
     "StepStats",
     "trace",
     "tracing",
+    "telemetry",
     "assert_finite",
     "checked",
 ]

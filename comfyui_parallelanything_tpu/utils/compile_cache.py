@@ -18,8 +18,14 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str:
     """Point JAX's persistent compilation cache at ``cache_dir`` (defaults to
     ``$PA_TPU_COMPILE_CACHE`` or ``~/.cache/comfyui_parallelanything_tpu/xla``)
     and lower the write thresholds so even fast-compiling programs persist.
+    ``$PA_COMPILE_CACHE_MIN_S`` overrides the min-compile-time threshold
+    (cross-process accounting tests pin it to 0 so sub-second programs
+    persist). Also installs the compile-event watchers (utils/telemetry.py),
+    so cache hit/miss accounting is on whenever the cache itself is.
     Idempotent; returns the directory in use."""
     import jax
+
+    from .telemetry import watch_compiles
 
     cache_dir = (
         cache_dir
@@ -28,6 +34,11 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str:
     )
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    min_s = os.environ.get("PA_COMPILE_CACHE_MIN_S")
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(min_s) if min_s else 0.5,
+    )
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    watch_compiles()
     return cache_dir

@@ -17,10 +17,42 @@ timeline — the same key correlates both.
 
 from __future__ import annotations
 
+import collections
 import logging
 from collections.abc import Sequence
 
 _LOGGER_NAME = "parallel_anything_tpu"
+
+# Flight-recorder depth: the "last K log records" a postmortem bundle
+# (utils/telemetry.write_postmortem) captures.
+_RECENT_CAPACITY = 256
+
+
+class _RecentHandler(logging.Handler):
+    """Bounded in-memory ring of formatted records — the log half of the
+    flight recorder. Always installed (a deque append per record is free);
+    read via :func:`recent_log_records` at postmortem time."""
+
+    def __init__(self, capacity: int = _RECENT_CAPACITY):
+        super().__init__()
+        self.records: collections.deque[str] = collections.deque(
+            maxlen=capacity
+        )
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self.records.append(self.format(record))
+        except Exception:  # noqa: BLE001 — the recorder must never raise
+            pass
+
+
+_recent: _RecentHandler | None = None
+
+
+def recent_log_records() -> list[str]:
+    """The last K formatted log records (oldest first) — what
+    ``write_postmortem`` dumps as ``logs.txt``."""
+    return list(_recent.records) if _recent is not None else []
 
 
 class ContextFilter(logging.Filter):
@@ -45,17 +77,23 @@ class ContextFilter(logging.Filter):
 
 
 def get_logger() -> logging.Logger:
+    global _recent
     logger = logging.getLogger(_LOGGER_NAME)
     if not logger.handlers:
-        handler = logging.StreamHandler()
-        handler.setFormatter(
-            logging.Formatter(
-                "[ParallelAnything] %(levelname)s "
-                "prompt=%(prompt_id)s span=%(span_id)s %(message)s"
-            )
+        fmt = logging.Formatter(
+            "[ParallelAnything] %(levelname)s "
+            "prompt=%(prompt_id)s span=%(span_id)s %(message)s"
         )
+        handler = logging.StreamHandler()
+        handler.setFormatter(fmt)
         handler.addFilter(ContextFilter())
         logger.addHandler(handler)
+        _recent = _RecentHandler()
+        _recent.setFormatter(logging.Formatter(
+            "%(asctime)s " + fmt._fmt
+        ))
+        _recent.addFilter(ContextFilter())
+        logger.addHandler(_recent)
         logger.setLevel(logging.INFO)
         logger.propagate = False
     return logger

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Tier-1 CI gate: the ROADMAP.md verify command (virtual-mesh CPU test
+# suite), then the perf-ledger regression check (scripts/perf_ledger.py
+# --check — step-time / peak-HBM drift against the banked evidence). Either
+# failing fails the script, so a green run means both "tests pass" AND
+# "no unexplained performance regression in the ledger".
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+# Per-run log (not a fixed /tmp name: concurrent runs must not clobber each
+# other's DOTS_PASSED count, and another user's stale file must not wedge tee).
+t1log=$(mktemp /tmp/_t1.XXXXXX.log)
+trap 'rm -f "$t1log"' EXIT
+timeout -k 10 870 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$t1log"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1log" | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+    echo "ci_tier1: tier-1 tests FAILED (rc=$rc)" >&2
+    exit "$rc"
+fi
+
+env -u PALLAS_AXON_POOL_IPS python scripts/perf_ledger.py --check

@@ -26,11 +26,29 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
+import sys
 import threading
 import time
 import urllib.error
 import urllib.request
+
+
+def _append_ledger(summary: dict, base: str) -> None:
+    """Perf-ledger append (kind=loadgen) via bench.py's stdlib-only twin of
+    ``utils/telemetry.append_ledger_record`` — loadgen must stay jax-free by
+    contract, so it cannot import the package, but bench's module level is
+    stdlib-only (scripts/perf_ledger.py imports it the same way). One copy
+    of the dir-resolution/schema stamp, not three. Best-effort by that
+    helper's contract: a read-only checkout must not fail the load run it
+    summarizes."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from bench import _ledger_append
+
+    _ledger_append({**summary, "base": base}, "loadgen")
 
 
 def _get(base: str, path: str, timeout: float = 30):
@@ -238,6 +256,7 @@ def main() -> None:
         timeout=args.timeout, seed_key=args.seed_key,
         extra_data=extra or None,
     )
+    _append_ledger(summary, args.base)
     print(json.dumps(summary))
 
 

@@ -43,6 +43,7 @@ sys.path.insert(0, _REPO)
 # showed this pipeline needs before it runs unattended on hardware).
 from bench import (  # noqa: E402
     _TPU_PLATFORMS as _TPU,
+    _postmortem_path,
     evidence_dir,
     is_banked_tpu_record as _is_fresh,
 )
@@ -444,6 +445,64 @@ def _log(msg: str) -> None:
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
+def _health_note(timeout: int = 90) -> dict | None:
+    """Host/device health at failure time, attached to failed-attempt
+    records. Scrapes ``$PA_HEALTH_URL`` (a running server's GET /health)
+    when set; otherwise takes a one-shot ``telemetry.health_snapshot`` in a
+    BOUNDED child — the snapshot imports jax, and a wedged tunnel hangs that
+    import, so it can never run in the watchdog process itself."""
+    url = os.environ.get("PA_HEALTH_URL")
+    if url:
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                return json.loads(r.read())
+        except Exception:
+            return None
+    code = (
+        "import json\n"
+        "from comfyui_parallelanything_tpu.utils.telemetry "
+        "import health_snapshot\n"
+        "print(json.dumps(health_snapshot()))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=dict(os.environ), cwd=_REPO,
+            capture_output=True, text=True, timeout=timeout,
+        )
+        if proc.returncode != 0:
+            return None
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, json.JSONDecodeError, IndexError):
+        return None
+
+
+def _attempt(rung: str) -> tuple[dict, bool]:
+    """One recorded rung attempt. Failed attempts are enriched BEFORE
+    banking: the inner child's postmortem-bundle path (bench.py's
+    ``POSTMORTEM_BUNDLE=`` stderr marker, preserved in fallback_stderr) and
+    a health snapshot — so a dead window's record says what the host and
+    chip looked like, not just that the run died."""
+    from measure_tpu import record_result, run_rung  # noqa: E402
+
+    rec = run_rung(rung, extra_env=_rung_env(rung))
+    ok = _is_fresh(rec)
+    if not ok:
+        # The bench line itself carries the bundle path on its stale / error /
+        # smoke-substitution shapes; the stderr marker is only the fallback
+        # (stderr goes through two tail-truncations, which a fat traceback
+        # printed after the marker can push it out of).
+        if not rec.get("postmortem"):
+            bundle = _postmortem_path(rec.get("fallback_stderr", "") or "")
+            if bundle:
+                rec["postmortem"] = bundle
+        note = _health_note()
+        if note is not None:
+            rec["health"] = note
+    return record_result(rec), ok
+
+
 def _strike(key: str, what: str) -> None:
     """Count a failure observed while a follow-up probe says the tunnel is
     still up — likely the item's own crash, not a flap (see module policy)."""
@@ -457,17 +516,15 @@ def bank_one() -> bool:
 
     Ordering: fewest strikes first, then declared value order — one unlucky
     flap deprioritizes a rung below clean ones but never blocks the ladder."""
-    from measure_tpu import record_result, run_rung  # noqa: E402
-
     done = banked_rungs()
     candidates = [r for r in RUNGS if r not in done and _attemptable(r)]
     for rung in sorted(candidates, key=lambda r: (_FAILS.get(r, 0),
                                                   RUNGS.index(r))):
         _log(f"running rung {rung}")
-        rec = record_result(run_rung(rung, extra_env=_rung_env(rung)))
-        # One shared predicate (bench.is_banked_tpu_record): a stale re-emit
-        # is old banked evidence, never a fresh measurement.
-        ok = _is_fresh(rec)
+        # _attempt applies the one shared predicate (bench.is_banked_tpu_
+        # record — a stale re-emit is old banked evidence, never a fresh
+        # measurement) and enriches failures with health + postmortem notes.
+        rec, ok = _attempt(rung)
         if ok:
             _run_script("render_measured.py", timeout=120)
         elif _looks_oom(rec) and _deepen(rung):
@@ -501,10 +558,7 @@ def bank_one() -> bool:
         return True
     for rung in stale_after_tuning():
         _log(f"re-running rung {rung} under the measured tuning table")
-        rec = record_result(run_rung(rung, extra_env=_rung_env(rung)))
-        # One shared predicate (bench.is_banked_tpu_record): a stale re-emit
-        # is old banked evidence, never a fresh measurement.
-        ok = _is_fresh(rec)
+        rec, ok = _attempt(rung)
         if ok:
             _run_script("render_measured.py", timeout=120)
         else:

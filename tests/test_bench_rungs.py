@@ -145,6 +145,12 @@ def test_flux_stream_rung_rehearsed_off_hardware(tmp_path):
     ).strip()
     env["PA_BENCH_TINY"] = "1"
     env["PA_EVIDENCE_DIR"] = str(tmp_path)
+    # Hermetic compile cache: never touch (or depend on) the machine-global
+    # ~/.cache dir, and pin the min-compile-time write threshold to 0 so the
+    # cold cache records a miss for every tiny program regardless of host
+    # speed — the hit/miss assertion below needs at least one event.
+    env["PA_TPU_COMPILE_CACHE"] = str(tmp_path / "xla-cache")
+    env["PA_COMPILE_CACHE_MIN_S"] = "0"
     env["PA_STREAM_HBM_BUDGET"] = "400000"  # tiny → forces a multi-stage carve
     env["BENCH_CONFIG"] = "flux_stream"
     repo = os.path.dirname(bench.__file__)
@@ -162,6 +168,16 @@ def test_flux_stream_rung_rehearsed_off_hardware(tmp_path):
     # The streaming executor actually served the run (stderr carries the
     # placement log with the stage count).
     assert "weight streaming enabled" in proc.stderr
+    # Resource accounting (round 9, utils/telemetry.py): every fresh line
+    # carries compile + HBM accounting, and the run appended a ledger record.
+    assert rec["compile_time_s"] > 0
+    assert rec["compile_cache_hits"] + rec["compile_cache_misses"] > 0
+    assert rec["peak_hbm_bytes"] > 0
+    ledger = os.path.join(str(tmp_path), "ledger", "perf_ledger.jsonl")
+    assert os.path.exists(ledger)
+    lrec = json.loads(open(ledger).read().strip().splitlines()[-1])
+    assert lrec["kind"] == "bench" and lrec["rung"] == "flux_stream"
+    assert lrec["schema"] == "pa-perf-ledger/v1"
 
 
 class TestStaleRecordFallback:
@@ -242,3 +258,8 @@ class TestStaleRecordFallback:
         assert rec["platform"] == "tpu" and rec["value"] == 2.57
         assert rec["captured_ts"] == 123.0
         assert "stale_reason" in rec
+        # A record banked before round 9 predates the resource-accounting
+        # fields: the stale re-emit carries them as nulls, never absent.
+        for field in ("compile_time_s", "compile_cache_hits",
+                      "compile_cache_misses", "peak_hbm_bytes"):
+            assert field in rec and rec[field] is None
