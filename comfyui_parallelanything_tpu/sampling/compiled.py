@@ -152,13 +152,11 @@ def _constrain(x, mesh, data_axis):
 
 
 def step_keys(rng, n: int) -> jnp.ndarray:
-    """Per-step keys via the same iterative ``rng, sub = split(rng)`` chain the
-    eager stochastic samplers consume, so compiled noise == eager noise."""
-    keys = []
-    for _ in range(n):
-        rng, sub = jax.random.split(rng)
-        keys.append(sub)
-    return jnp.stack(keys)
+    """Per-step keys via the occupancy-independent ``fold_in(rng, i)``
+    discipline (round 10): the key for step i depends only on (rng, i) — not
+    on how many steps ran before or which other work shares a dispatch — so
+    compiled noise == eager noise == serving-lane noise at any occupancy."""
+    return jnp.stack([jax.random.fold_in(rng, i) for i in range(n)])
 
 
 def _mask_blend(x, mask, keep):
@@ -695,7 +693,8 @@ def _donate_for(spec: TraceSpec) -> bool:
     return jax.default_backend() != "cpu"
 
 
-def _get_loop_jit(kind: str, spec: TraceSpec, static: dict, meta: tuple, build):
+def _get_loop_jit(kind: str, spec: TraceSpec, static: dict, meta: tuple, build,
+                  donate: tuple = (1,)):
     """Cache key mirrors the repo's jit-cache discipline: the ambient
     sequence_parallel context is read at trace time inside ops.attention, so it
     must key the cache (ops/attention.py contract; orchestrator._jit_for does
@@ -714,7 +713,7 @@ def _get_loop_jit(kind: str, spec: TraceSpec, static: dict, meta: tuple, build):
         while len(_loop_jits) >= _LOOP_CACHE_MAX:
             _loop_jits.pop(next(iter(_loop_jits)))
         impl = build(dict(static))
-        donate = (1,) if _donate_for(spec) else ()
+        donate = donate if _donate_for(spec) else ()
         # Compile accounting (utils/telemetry.py): the k-family bakes the
         # sampler name into the program label; the other kinds are
         # one-program-per-kind.
@@ -989,13 +988,16 @@ def compiled_flow_sample(
 
 
 # ---------------------------------------------------------------------------
-# per-lane batched step (round 7, serving/): ONE compiled dispatch advances a
-# fixed-width batch of lanes, each carrying its OWN sigma/step state — the
-# step-boundary seam continuous batching joins and leaves at. The Euler math
-# mirrors k_samplers.sample_euler + EpsDenoiser.__call__ op-for-op with the
-# scalar sigma generalized to a per-lane vector; padded/retired lanes are
-# masked with jnp.where (a select, so a junk pad-lane value can never leak
-# into a live lane — per-sample independence of the model does the rest).
+# per-lane batched step (round 7, generalized round 10, serving/): ONE
+# compiled dispatch advances a fixed-width batch of lanes, each carrying its
+# OWN (sigma, state, sampler) — the step-boundary seam continuous batching
+# joins and leaves at. The model eval (the only FLOPs that matter) is shared;
+# each lane's sampler update is the host-precomputed linear combination its
+# LaneStepSpec emitted (sampling/lane_specs.py), so lanes running DIFFERENT
+# samplers — including two-eval and stochastic families — ride one dispatch.
+# Padded/retired lanes are masked with jnp.where (a select, so a junk
+# pad-lane value can never leak into a live lane — per-sample independence of
+# the model does the rest).
 # ---------------------------------------------------------------------------
 
 
@@ -1003,26 +1005,32 @@ def lane_step_program(
     spec: TraceSpec, *, prediction: str, use_cfg: bool, cfg_rescale: float,
     static_kwargs: dict,
 ):
-    """The jitted per-step program for one serving bucket.
+    """The jitted per-step program for one serving bucket (W = lane width,
+    b = per-request batch):
 
-    Call signature of the returned fn (W = lane width, b = per-request batch):
+    ``fn(params, x[W,b,...], xe[W,b,...], h1[W,b,...], h2[W,b,...],
+    sigma_eval[W], active[W] f32, cfg_scale[W], coef[W,4,6] f32,
+    noise_keys[W,2] u32, context[W,b,L,D]|None, uncond_context|None, kwargs,
+    u_kwargs, log_sigmas|None) -> (x', xe', h1', h2')``
 
-    ``fn(params, x[W,b,...], sigma[W], sigma_next[W], active[W] f32,
-    cfg_scale[W], context[W,b,L,D]|None, uncond_context|None, kwargs,
-    u_kwargs, log_sigmas|None) -> x'[W,b,...]``
-
-    Per-lane sigmas ride as a vector: the sigma→timestep log-interp, the
-    1/sqrt(sigma²+1) input scaling, the CFG mix (per-lane cfg_scale), and the
-    Euler update all broadcast over the lane axis, so one dispatch advances
-    lanes sitting at DIFFERENT points of DIFFERENT schedules. Inactive lanes
-    get sigma pinned to 1.0 (no divide-by-zero) and their latent passed
-    through unchanged. Cached via the loop-jit cache (bounded, clearable)."""
+    One batched model eval at per-lane ``(xe, sigma_eval)`` — the σ→timestep
+    log-interp, 1/√(σ²+1) input scaling, and CFG mix (per-lane cfg_scale) all
+    broadcast over the lane axis — produces the denoised estimate ``x0``;
+    then every state slot updates as the ``coef``-weighted combination of
+    ``(x, xe, x0, h1, h2, noise)``. ``noise`` is one per-lane draw from the
+    lane's own key (threefry key data, occupancy-independent by the fold_in
+    discipline), so stochastic lanes are bit-identical alone or co-batched.
+    The sampler never appears in the program: traffic-mix changes can't
+    recompile. Inactive lanes get sigma pinned to 1.0 (no divide-by-zero),
+    identity coefficients, and a where-select pass-through. Cached via the
+    loop-jit cache (bounded, clearable); all four state stacks are donated."""
     meta = ("serve", prediction, bool(use_cfg), float(cfg_rescale))
     apply_fn, mesh, axis = spec.apply, spec.mesh, spec.data_axis
 
     def build(bound_static):
-        def impl(params, x, sigma, sigma_next, active, cfg_scale, context,
-                 uncond_context, kwargs, u_kwargs, log_sigmas):
+        def impl(params, x, xe, h1, h2, sigma_eval, active, cfg_scale, coef,
+                 noise_keys, context, uncond_context, kwargs, u_kwargs,
+                 log_sigmas):
             model = _model_fn(apply_fn, params, bound_static)
             W, b = x.shape[0], x.shape[1]
             n = W * b
@@ -1036,8 +1044,8 @@ def lane_step_program(
                 return v.reshape(v.shape + (1,) * (ndim - 1))
 
             lane = lambda v: jnp.repeat(v, b, total_repeat_length=n)  # noqa: E731
-            flat = x.reshape((n,) + x.shape[2:])
-            s = jnp.where(active > 0, sigma, jnp.float32(1.0))
+            flat = xe.reshape((n,) + xe.shape[2:])
+            s = jnp.where(active > 0, sigma_eval, jnp.float32(1.0))
             s_flat = lane(s)
             if prediction == "flow":
                 # Flow time IS the sigma (EpsDenoiser flow branch).
@@ -1078,11 +1086,29 @@ def lane_step_program(
                 # eps: x0 = x − σ·eps. flow: x0 = x − σ·v — the same expression.
                 x0_flat = flat - bcast(s_flat, flat.ndim) * eps
             x0 = x0_flat.reshape(x.shape)
-            d = (x - x0) / bcast(s, x.ndim)
-            new = x + d * bcast(sigma_next - sigma, x.ndim)
-            out = jnp.where(bcast(active > 0, x.ndim), new, x)
-            return _constrain(out, mesh, axis)
+            # Per-lane noise from per-lane key data: vmapped normal over lane
+            # keys == each lane's solo normal(key, (b, ...)) draw, bitwise.
+            noise = jax.vmap(
+                lambda k: jax.random.normal(
+                    jax.random.wrap_key_data(k), x.shape[1:], x.dtype
+                )
+            )(noise_keys)
+            basis = (x, xe, x0, h1, h2, noise)
+
+            def mix(j):
+                acc = None
+                for k, base in enumerate(basis):
+                    term = bcast(coef[:, j, k], x.ndim) * base
+                    acc = term if acc is None else acc + term
+                return acc.astype(x.dtype)
+
+            live = bcast(active > 0, x.ndim)
+            return tuple(
+                _constrain(jnp.where(live, mix(j), old), mesh, axis)
+                for j, old in enumerate((x, xe, h1, h2))
+            )
 
         return impl
 
-    return _get_loop_jit("serve", spec, static_kwargs, meta, build)
+    return _get_loop_jit("serve", spec, static_kwargs, meta, build,
+                         donate=(1, 2, 3, 4))

@@ -451,7 +451,13 @@ def ancestral_steps(s, s_next, eta: float = 1.0):
 
 
 def sample_euler_ancestral(denoise, x, sigmas, rng, eta: float = 1.0, callback=None):
-    """Euler with ancestral noise injection (stochastic)."""
+    """Euler with ancestral noise injection (stochastic).
+
+    RNG discipline (shared by every stochastic sampler here, their compiled
+    twins, and the serving lanes): the step-``i`` key is ``fold_in(rng, i)``
+    — a pure function of (request rng, step index), never of how many draws
+    preceded it — so output is bit-identical whether the run executes alone,
+    inside a compiled loop, or co-batched in a serving lane (round 10)."""
     for i in range(len(sigmas) - 1):
         s, s_next = sigmas[i], sigmas[i + 1]
         x0 = denoise(x, s)
@@ -459,7 +465,7 @@ def sample_euler_ancestral(denoise, x, sigmas, rng, eta: float = 1.0, callback=N
         d = (x - x0) / s
         x = x + d * (sigma_down - s)
         if float(s_next) > 0:
-            rng, sub = jax.random.split(rng)
+            sub = jax.random.fold_in(rng, i)
             x = x + sigma_up * jax.random.normal(sub, x.shape, x.dtype)
         x = apply_callback(callback, i, x)
     return x
@@ -487,7 +493,7 @@ def sample_euler_ancestral_rf(denoise, x, sigmas, rng, eta: float = 1.0,
             ))
             ratio = sd / s
             x = ratio * x + (1.0 - ratio) * x0
-            rng, sub = jax.random.split(rng)
+            sub = jax.random.fold_in(rng, i)
             x = (alpha_ip1 / alpha_down) * x + renoise * jax.random.normal(
                 sub, x.shape, x.dtype
             )
@@ -527,7 +533,7 @@ def sample_dpmpp_2s_ancestral_rf(denoise, x, sigmas, rng, eta: float = 1.0,
             x0_2 = denoise(u, sigma_mid)
             x = (sd / s) * x + (1.0 - sd / s) * x0_2
         if float(s_next) > 0:
-            rng, sub = jax.random.split(rng)
+            sub = jax.random.fold_in(rng, i)
             x = (alpha_ip1 / alpha_down) * x + renoise * jax.random.normal(
                 sub, x.shape, x.dtype
             )
@@ -543,7 +549,7 @@ def sample_lcm_rf(denoise, x, sigmas, rng, callback=None):
         x0 = denoise(x, sigmas[i])
         x = x0
         if float(sigmas[i + 1]) > 0:
-            rng, sub = jax.random.split(rng)
+            sub = jax.random.fold_in(rng, i)
             t = sigmas[i + 1]
             x = t * jax.random.normal(sub, x.shape, x.dtype) + (1.0 - t) * x0
         x = apply_callback(callback, i, x)
@@ -603,7 +609,7 @@ def sample_dpm_2_ancestral(denoise, x, sigmas, rng, eta: float = 1.0, callback=N
             d_2 = (x_2 - x0_2) / sigma_mid
             x = x + d_2 * (sigma_down - s)
         if float(s_next) > 0:
-            rng, sub = jax.random.split(rng)
+            sub = jax.random.fold_in(rng, i)
             x = x + sigma_up * jax.random.normal(sub, x.shape, x.dtype)
         x = apply_callback(callback, i, x)
     return x
@@ -629,7 +635,7 @@ def sample_dpmpp_2s_ancestral(denoise, x, sigmas, rng, eta: float = 1.0,
             x0_2 = denoise(x_2, sigma_mid)
             x = (sigma_down / s) * x - jnp.expm1(-h) * x0_2
         if float(s_next) > 0:
-            rng, sub = jax.random.split(rng)
+            sub = jax.random.fold_in(rng, i)
             x = x + sigma_up * jax.random.normal(sub, x.shape, x.dtype)
         x = apply_callback(callback, i, x)
     return x
@@ -639,8 +645,9 @@ def sample_dpmpp_sde(denoise, x, sigmas, rng, eta: float = 1.0, callback=None):
     """DPM-Solver++ SDE (k-diffusion ``sample_dpmpp_sde``, r = 1/2): 2nd-order
     single-step with ancestral-style noise injected BOTH at the midpoint model
     call and at the step end — two model calls and two noise draws per step.
-    Per-step rng chain: ``rng, sub = split(rng)`` then ``k_mid, k_end =
-    split(sub)`` (the compiled twin consumes the same chain via step_keys)."""
+    Per-step keys: ``k_mid, k_end = split(fold_in(rng, i))`` — the fold_in
+    discipline (see sample_euler_ancestral), with the two draws split from the
+    step key (the compiled twin and the serving lanes consume the same)."""
     r = 0.5
     for i in range(len(sigmas) - 1):
         s, s_next = sigmas[i], sigmas[i + 1]
@@ -649,7 +656,7 @@ def sample_dpmpp_sde(denoise, x, sigmas, rng, eta: float = 1.0, callback=None):
             d = (x - x0) / s
             x = x + d * (s_next - s)
         else:
-            rng, sub = jax.random.split(rng)
+            sub = jax.random.fold_in(rng, i)
             k_mid, k_end = jax.random.split(sub)
             t, t_next = -jnp.log(s), -jnp.log(s_next)
             h = t_next - t
@@ -715,7 +722,7 @@ def sample_dpmpp_2m_sde(denoise, x, sigmas, rng, eta: float = 1.0, callback=None
                 # midpoint correction
                 x = x + 0.5 * (-jnp.expm1(-h - eta_h)) * (1 / r) * (x0 - old_x0)
             if eta > 0:
-                rng, sub = jax.random.split(rng)
+                sub = jax.random.fold_in(rng, i)
                 x = x + s_next * jnp.sqrt(
                     jnp.maximum(-jnp.expm1(-2 * eta_h), 0.0)
                 ) * jax.random.normal(sub, x.shape, x.dtype)
@@ -761,7 +768,7 @@ def sample_dpmpp_3m_sde(denoise, x, sigmas, rng, eta: float = 1.0, callback=None
                 phi_2 = jnp.expm1(-h_eta) / h_eta + 1.0
                 x = x + phi_2 * d
             if eta > 0:
-                rng, sub = jax.random.split(rng)
+                sub = jax.random.fold_in(rng, i)
                 x = x + s_next * jnp.sqrt(
                     jnp.maximum(-jnp.expm1(-2.0 * eta * h), 0.0)
                 ) * jax.random.normal(sub, x.shape, x.dtype)
@@ -830,7 +837,7 @@ def sample_lcm(denoise, x, sigmas, rng, callback=None):
         x0 = denoise(x, sigmas[i])
         x = x0
         if float(sigmas[i + 1]) > 0:
-            rng, sub = jax.random.split(rng)
+            sub = jax.random.fold_in(rng, i)
             x = x + sigmas[i + 1] * jax.random.normal(sub, x.shape, x.dtype)
         x = apply_callback(callback, i, x)
     return x
@@ -854,7 +861,7 @@ def sample_ddpm(denoise, x, sigmas, rng, callback=None):
             x_a - (1.0 - alpha) * eps / jnp.sqrt(1.0 - acp)
         )
         if float(s_next) > 0:
-            rng, sub = jax.random.split(rng)
+            sub = jax.random.fold_in(rng, i)
             var = (1.0 - alpha) * (1.0 - acp_prev) / (1.0 - acp)
             mu = mu + jnp.sqrt(var) * jax.random.normal(sub, x.shape, x.dtype)
             x = mu * jnp.sqrt(1.0 + s_next**2)  # back to sigma scaling

@@ -416,24 +416,32 @@ def run_sampler(
             x = init_latent + x
     if sampler in RNG_SAMPLERS and rng is None:
         rng = jax.random.key(0)
-    # Continuous-batching seam (round 7, serving/): when a scheduler is
-    # installed, route eligible work — history-free sampler, no user callback,
-    # no inpaint mask, no multi-cond — into a shared step-boundary batch with
-    # whatever other requests are in flight. Ineligible or refused work falls
-    # through to the inline paths unchanged; compile_loop callers asked for
-    # the whole-loop program and are never hijacked.
+    # Continuous-batching seam (round 7, widened round 10, serving/): when a
+    # scheduler is installed, route eligible work — any registered
+    # LaneStepSpec sampler (stateful and stochastic included), no user
+    # callback, no inpaint mask, no multi-cond — into a shared step-boundary
+    # batch with whatever other requests are in flight. Stochastic lanes are
+    # occupancy-deterministic because the per-step noise key is
+    # fold_in(base, i) on BOTH paths (same base as the eager call below).
+    # Ineligible or refused work falls through to the inline paths unchanged;
+    # compile_loop callers asked for the whole-loop program and are never
+    # hijacked.
     if not compile_loop and callback is None and latent_mask is None \
             and not multi_cond:
         from ..serving.scheduler import get_scheduler
 
         _sched = get_scheduler()
-        if _sched is not None and sampler not in RNG_SAMPLERS:
+        if _sched is not None:
             ticket = _sched.maybe_submit(
                 model=model, x=x, sigmas=sigmas, context=context,
                 sampler=sampler, cfg_scale=eff_cfg,
                 uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
                 alphas_cumprod=acp, prediction=prediction,
                 cfg_rescale=cfg_rescale, model_kwargs=model_kwargs,
+                rng=(
+                    jax.random.fold_in(rng, 1)
+                    if sampler in RNG_SAMPLERS else None
+                ),
             )
             if ticket is not None:
                 return ticket.result()

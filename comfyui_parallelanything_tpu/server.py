@@ -655,6 +655,7 @@ class _Handler(BaseHTTPRequestHandler):
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
         if url.path == "/health":
+            from .serving.bucket import batched_fraction
             from .utils.telemetry import health_snapshot
 
             with self.q._lock:
@@ -665,6 +666,9 @@ class _Handler(BaseHTTPRequestHandler):
                     "max_pending": self.q.max_pending,
                     "completed": len(self.q.history),
                     "serving": self.q.scheduler is not None,
+                    # Lane-steps served via shared dispatch / total — how
+                    # much of the step traffic actually co-batched.
+                    "serving_batched_fraction": round(batched_fraction(), 4),
                 }
             return self._send(200, health_snapshot(queue=queue))
         if url.path == "/trace":

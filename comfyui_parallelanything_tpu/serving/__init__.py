@@ -1,12 +1,14 @@
-"""Continuous-batching serving subsystem (round 7).
+"""Continuous-batching serving subsystem (round 7; stateful lanes round 10).
 
 Sits between ``server.PromptQueue`` and ``sampling/runner.py``: concurrent
-prompts' sampler runs that agree on (model, shape, sampler, cfg-mode) share
-ONE compiled step program, joining and leaving the fixed-width batch at step
-boundaries. See serving/scheduler.py for the architecture overview.
+prompts' sampler runs that agree on (model, shape, cfg-mode) — running ANY
+sampler in the LaneStepSpec registry, stochastic families included — share
+ONE compiled dispatch stream, joining and leaving the fixed-width batch at
+step boundaries. See serving/scheduler.py for the architecture overview and
+sampling/lane_specs.py for the per-lane step-program family.
 """
 
-from .bucket import ServeRequest, StepBucket
+from .bucket import ServeRequest, StepBucket, batched_fraction
 from .policy import AdmissionQueue, DeadlineExceeded, ServingRejected
 from .scheduler import (
     BATCHABLE_SAMPLERS,
@@ -23,6 +25,7 @@ __all__ = [
     "ServeRequest",
     "ServingRejected",
     "StepBucket",
+    "batched_fraction",
     "get_scheduler",
     "serving_hints",
 ]

@@ -1,30 +1,42 @@
-"""Shape-bucketed step batches: fixed-width lanes advancing in lockstep.
+"""Shape-bucketed step batches: fixed-width stateful lanes in lockstep.
 
 One ``StepBucket`` owns everything needed to run ONE compiled step program
 over a fixed-width batch of lanes (padded, masked), where each lane is one
-request at its own position in its own sigma schedule:
+request at its own position in its own sigma schedule, running its OWN
+sampler (round 10 — the dispatch unit is one batched model eval, not one
+sampler's step):
 
-- stacked device state ``x[W, b, ...]`` plus per-lane host bookkeeping
-  (schedule, step index, request handle) — the "per-lane step state" the
-  continuous-batching seam needs;
+- stacked device state ``(x, xe, h1, h2)[W, b, ...]`` — latent, next eval
+  input, and two history slots (the lane form of the fused-loop carries,
+  e.g. dpmpp_2m's ``old_x0``) — plus per-lane host bookkeeping (the
+  sampler's eval-ordered ``StepPlan`` list from sampling/lane_specs.py, a
+  plan counter, precomputed per-step noise-key table, request handle);
 - step-boundary join/leave: a request enters by ``x.at[lane].set(...)`` at a
-  boundary and retires (its slice extracted, its waiter resolved) the moment
-  its own schedule completes, while other lanes keep running — ragged
-  schedules, lockstep dispatches;
-- masking: retired/empty lanes ride along with ``sigma`` pinned to 1 and the
-  update ``jnp.where``-selected away, so occupancy can never perturb a live
-  lane's values (the model is per-sample independent; the select guarantees
-  even a NaN in a pad lane stays in the pad lane).
+  boundary (history slots zeroed — the lane state-pytree init) and retires
+  (its slice extracted, its waiter resolved) the moment its own EVAL count
+  completes, while other lanes keep running — ragged schedules, mixed
+  sampler families, lockstep dispatches;
+- masking: retired/empty lanes ride along with ``sigma`` pinned to 1,
+  identity update coefficients, and the ``jnp.where`` select, so occupancy
+  can never perturb a live lane's values (the model is per-sample
+  independent; the select guarantees even a NaN in a pad lane stays there);
+- stochastic lanes: the step-``i`` key is ``fold_in(request rng, i)`` —
+  keys are precomputed per request at seat time, so noise is a pure
+  function of (request, step) and output is bit-identical alone vs
+  co-batched (the occupancy-determinism contract).
 
 Two execution modes share the bookkeeping: a compiled per-lane step program
 (sampling/compiled.py ``lane_step_program`` — single-program models, width N)
 and a width-1 eager mode for models that can never be one XLA program
-(weight-streaming / hybrid chains, parallel/orchestrator.py) — those still
-gain step-boundary scheduling, cancel, and metrics, just not co-batching.
+(weight-streaming / hybrid chains, parallel/orchestrator.py) — those walk
+the SAME StepPlans against their own denoiser, gaining step-boundary
+scheduling, the full sampler family, cancel, and metrics, just not
+co-batching.
 
-Bitwise discipline: the Euler math here IS k_samplers.sample_euler with the
-scalar sigma generalized per-lane; ``tests/test_serving.py`` pins serial vs
-in-batch equivalence at bf16 tolerances on CPU and the 8-device mesh.
+Bitwise discipline: the update math here IS each sampler's ``k_samplers``
+twin with the schedule-derived scalars host-lifted per lane;
+``tests/test_serving.py`` pins the full registry's lane-vs-solo equivalence
+at bf16 tolerances on CPU and the 8-device mesh.
 """
 
 from __future__ import annotations
@@ -37,10 +49,43 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ..sampling.lane_specs import LANE_SPECS, StepPlan, plan_schedule
 from ..utils import tracing
 from ..utils.metrics import registry
 from ..utils.progress import Interrupted
 from .policy import AdmissionQueue, DeadlineExceeded
+
+# Identity update for padded/retired lanes: x'=x, xe'=xe, h1'=h1, h2'=h2 —
+# the host-side twin of the program's active-mask select.
+_IDENTITY_COEF = np.zeros((4, 6), np.float32)
+for _j, _k in ((0, 0), (1, 1), (2, 3), (3, 4)):
+    _IDENTITY_COEF[_j, _k] = 1.0
+del _j, _k
+
+# Process-wide shared-dispatch accounting: lane-steps served in dispatches
+# with occupancy > 1, over all lane-steps — the pa_serving_batched_fraction
+# gauge (ISSUE 5 satellite; surfaced in GET /health and loadgen output).
+_batch_stats = {"total": 0, "shared": 0}
+_batch_lock = threading.Lock()
+
+
+def record_dispatch_occupancy(occupancy: int) -> None:
+    """Account one dispatch's lane-steps and refresh the fraction gauge."""
+    with _batch_lock:
+        _batch_stats["total"] += occupancy
+        if occupancy > 1:
+            _batch_stats["shared"] += occupancy
+        frac = _batch_stats["shared"] / max(1, _batch_stats["total"])
+    registry.gauge(
+        "pa_serving_batched_fraction", frac,
+        help="lane-steps served via shared dispatch / total lane-steps",
+    )
+
+
+def batched_fraction() -> float:
+    """Lane-steps served via shared (occupancy>1) dispatch / total."""
+    with _batch_lock:
+        return _batch_stats["shared"] / max(1, _batch_stats["total"])
 
 
 @dataclasses.dataclass
@@ -61,6 +106,8 @@ class ServeRequest:
     cfg_rescale: float
     prediction: str
     acp: Any                    # alphas_cumprod or None (default schedule)
+    sampler: str = "euler"      # LaneStepSpec registry name
+    rng: Any = None             # stochastic base key (None → deterministic)
     priority: int = 0
     deadline: float | None = None          # time.monotonic() deadline
     progress_hook: Optional[Callable[[int, int], None]] = None
@@ -108,12 +155,56 @@ class ServeRequest:
 @dataclasses.dataclass
 class _Lane:
     req: ServeRequest
-    idx: int = 0  # next step to run (sigmas[idx] -> sigmas[idx+1])
-    # Width-1 eager mode only: the lane's own latent + denoiser (program mode
-    # keeps lane latents stacked in the bucket's device state instead).
+    idx: int = 0   # σ-intervals completed (progress unit)
+    pc: int = 0    # next StepPlan to run (the eval unit — 2/interval for
+                   # second-order samplers)
+    plans: list = dataclasses.field(default_factory=list)
+    keys: Any = None  # [n_steps, 2, key_width] uint32 noise-key table or None
+    # Width-1 eager mode only: the lane's own state pytree + denoiser
+    # (program mode keeps lane state stacked in the bucket's device arrays).
     x_eager: Any = None
+    xe_eager: Any = None
+    h1_eager: Any = None
+    h2_eager: Any = None
     denoiser: Any = None
     seat_us: float = 0.0  # trace-clock admission time (the lane span start)
+
+    def plan(self) -> StepPlan:
+        return self.plans[self.pc]
+
+    def done(self) -> bool:
+        return self.pc >= len(self.plans)
+
+
+def _lane_key_table(rng, n_steps: int, split: bool):
+    """[n_steps, 2, key_width] uint32 per-step key data under the fold_in
+    discipline; columns are the ``split(fold_in(rng, i))`` halves when
+    ``split`` (dpmpp_sde's mid/end draws), else both the per-step key. One
+    tiny vmapped dispatch per admission — the whole table is then host-side
+    numpy, indexed per dispatch with zero device work."""
+    import jax
+    import jax.numpy as jnp
+
+    if rng is None or n_steps <= 0:
+        return None
+    base = rng
+    if not jnp.issubdtype(jnp.asarray(base).dtype, jax.dtypes.prng_key):
+        base = jax.random.wrap_key_data(jnp.asarray(base, jnp.uint32))
+    ks = jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(n_steps))
+    if split:
+        data = jax.random.key_data(jax.vmap(jax.random.split)(ks))
+    else:
+        d = jax.random.key_data(ks)
+        data = jnp.stack([d, d], axis=1)
+    return np.asarray(data)
+
+
+def _noise_key_row(lane: "_Lane", plan: StepPlan):
+    """The lane's key data for this plan's draw, or None when no draw."""
+    if plan.noise is None or lane.keys is None:
+        return None
+    col = 1 if plan.noise == "sde_end" else 0
+    return lane.keys[plan.step, col]
 
 
 class StepBucket:
@@ -135,8 +226,12 @@ class StepBucket:
         self._program = None
         self._log_sigmas = None
         self._acp_default = None
-        # Stacked device state, built from the first admitted request's shapes.
+        # Stacked device state, built from the first admitted request's
+        # shapes: latent, eval input, and the two per-lane history slots.
         self._x = None
+        self._xe = None
+        self._h1 = None
+        self._h2 = None
         self._ctx = None
         self._uctx = None
         self._kw = None
@@ -159,7 +254,8 @@ class StepBucket:
         must not pin width×batch latents/contexts in device memory between
         bursts. Rebuilt by ``_ensure_state`` on the next admission (the
         compiled step program itself stays in the bounded loop-jit cache)."""
-        self._x = self._ctx = self._uctx = self._kw = self._ukw = None
+        self._x = self._xe = self._h1 = self._h2 = None
+        self._ctx = self._uctx = self._kw = self._ukw = None
 
     def _gauges(self) -> None:
         registry.gauge("pa_serving_occupancy", len(self.active_lanes()),
@@ -196,6 +292,9 @@ class StepBucket:
         if self.spec is None or self._x is not None:
             return
         self._x = self._zeros_stack(req.x)
+        self._xe = self._zeros_stack(req.x)
+        self._h1 = self._zeros_stack(req.x)
+        self._h2 = self._zeros_stack(req.x)
         self._ctx = (
             None if req.context is None else self._zeros_stack(req.context)
         )
@@ -223,8 +322,23 @@ class StepBucket:
 
         self._ensure_state(req)
         lane = _Lane(req)
+        # The lane's whole schedule compiles to an eval-ordered plan list at
+        # seat time (host float64 — one pass per request, not per dispatch);
+        # stochastic lanes also bank their fold_in key table here.
+        lane.plans = plan_schedule(req.sampler, req.sigmas, req.prediction)
+        spec_entry = LANE_SPECS[req.sampler]
+        if spec_entry.needs_rng:
+            lane.keys = _lane_key_table(
+                req.rng, req.n_steps, spec_entry.split_keys
+            )
         if self.spec is not None:
+            # State-pytree init: latent and eval input seed from the request,
+            # history slots zero — a reused lane must never see its
+            # predecessor's carries.
             self._x = self._x.at[i].set(req.x)
+            self._xe = self._xe.at[i].set(req.x)
+            self._h1 = self._h1.at[i].set(0.0)
+            self._h2 = self._h2.at[i].set(0.0)
             if self._ctx is not None:
                 self._ctx = self._ctx.at[i].set(req.context)
             if self._uctx is not None:
@@ -241,7 +355,11 @@ class StepBucket:
         else:
             from ..sampling.k_samplers import EpsDenoiser
 
+            jnp = self._jnp
             lane.x_eager = req.x
+            lane.xe_eager = req.x
+            lane.h1_eager = jnp.zeros_like(req.x)
+            lane.h2_eager = jnp.zeros_like(req.x)
             lane.denoiser = EpsDenoiser(
                 self.model, req.context, cfg_scale=req.cfg_scale,
                 uncond_context=req.uncond_context,
@@ -343,10 +461,11 @@ class StepBucket:
         return swept
 
     def dispatch(self) -> bool:
-        """Run ONE lockstep step for every active lane (one compiled dispatch
-        in program mode), advance per-lane indices, fire per-lane progress
-        hooks, retire finished lanes. Returns False when there was nothing to
-        run."""
+        """Run ONE lockstep model eval for every active lane (one compiled
+        dispatch in program mode), apply each lane's own sampler update,
+        advance per-lane plan counters, fire per-lane progress hooks at
+        σ-interval boundaries, retire finished lanes. Returns False when
+        there was nothing to run."""
         active = self.active_lanes()
         if not active:
             return False
@@ -355,38 +474,81 @@ class StepBucket:
         jnp = self._jnp
         t0_us = tracing.now_us() if tracing.on() else 0.0
         t0 = time.perf_counter()
+        plans = {i: self.lanes[i].plan() for i in active}
         if self._program is not None:
             sig = np.ones((self.width,), np.float32)
-            sig_next = np.ones((self.width,), np.float32)
             act = np.zeros((self.width,), np.float32)
             cfg = np.ones((self.width,), np.float32)
+            coef = np.broadcast_to(
+                _IDENTITY_COEF, (self.width, 4, 6)
+            ).copy()
+            key_width = next(
+                (self.lanes[i].keys.shape[-1] for i in active
+                 if self.lanes[i].keys is not None), 2,
+            )
+            keys = np.zeros((self.width, key_width), np.uint32)
             for i in active:
-                lane = self.lanes[i]
-                sig[i] = lane.req.sigmas[lane.idx]
-                sig_next[i] = lane.req.sigmas[lane.idx + 1]
+                lane, plan = self.lanes[i], plans[i]
+                sig[i] = plan.sigma_eval
                 act[i] = 1.0
                 cfg[i] = lane.req.cfg_scale
-            self._x = self._program(
-                self.spec.params, self._x, jnp.asarray(sig),
-                jnp.asarray(sig_next), jnp.asarray(act), jnp.asarray(cfg),
+                coef[i] = plan.coef
+                row = _noise_key_row(lane, plan)
+                if row is not None:
+                    keys[i] = row
+            self._x, self._xe, self._h1, self._h2 = self._program(
+                self.spec.params, self._x, self._xe, self._h1, self._h2,
+                jnp.asarray(sig), jnp.asarray(act), jnp.asarray(cfg),
+                jnp.asarray(coef), jnp.asarray(keys),
                 self._ctx, self._uctx, self._kw, self._ukw, self._log_sigmas,
             )
             jax.block_until_ready(self._x)
         else:
-            # Width-1 eager mode (streaming/hybrid models): the exact
-            # sample_euler step per lane, one model call each.
+            # Width-1 eager mode (streaming/hybrid models): the SAME StepPlan
+            # walk against the lane's own denoiser — full sampler family,
+            # one model call per eval.
             for i in active:
-                lane = self.lanes[i]
-                s = jnp.float32(lane.req.sigmas[lane.idx])
-                s_next = jnp.float32(lane.req.sigmas[lane.idx + 1])
-                x0 = lane.denoiser(lane.x_eager, s)
-                d = (lane.x_eager - x0) / s
-                lane.x_eager = lane.x_eager + d * (s_next - s)
+                lane, plan = self.lanes[i], plans[i]
+                x0e = lane.denoiser(
+                    lane.xe_eager, jnp.float32(plan.sigma_eval)
+                )
+                row = _noise_key_row(lane, plan)
+                noise = None
+                if row is not None:
+                    noise = jax.random.normal(
+                        jax.random.wrap_key_data(jnp.asarray(row)),
+                        lane.x_eager.shape, lane.x_eager.dtype,
+                    )
+                basis = (lane.x_eager, lane.xe_eager, x0e,
+                         lane.h1_eager, lane.h2_eager, noise)
+
+                def _combine(row_c, like):
+                    acc = None
+                    for c, term in zip(row_c, basis):
+                        if float(c) == 0.0 or term is None:
+                            continue
+                        part = float(c) * term
+                        acc = part if acc is None else acc + part
+                    if acc is None:
+                        return jnp.zeros_like(like)
+                    return acc.astype(like.dtype)
+
+                lane.x_eager, lane.xe_eager, lane.h1_eager, lane.h2_eager = (
+                    _combine(plan.coef[0], lane.x_eager),
+                    _combine(plan.coef[1], lane.xe_eager),
+                    _combine(plan.coef[2], lane.h1_eager),
+                    _combine(plan.coef[3], lane.h2_eager),
+                )
             jax.block_until_ready([self.lanes[i].x_eager for i in active])
         dt = time.perf_counter() - t0
         self.dispatch_count += 1
         registry.counter("pa_serving_dispatch_total", labels=self._labels,
                          help="compiled lockstep step dispatches")
+        registry.counter("pa_serving_lane_steps_total", inc=len(active),
+                         labels=self._labels,
+                         help="lane-steps served (occupancy summed over "
+                              "dispatches) — amortization numerator")
+        record_dispatch_occupancy(len(active))
         registry.histogram("pa_serving_step_seconds", dt, labels=self._labels,
                            help="wall time of one lockstep dispatch")
         if tracing.on() and t0_us:
@@ -414,15 +576,19 @@ class StepBucket:
                     of=lane.req.n_steps, occupancy=len(active),
                 )
         for i in active:
-            lane = self.lanes[i]
-            lane.idx += 1
-            hook = lane.req.progress_hook
-            if hook is not None:
-                try:
-                    hook(lane.idx, lane.req.n_steps)
-                except Exception:  # noqa: BLE001 — a UI hook must not kill lanes
-                    pass
-            if lane.idx >= lane.req.n_steps:
+            lane, plan = self.lanes[i], plans[i]
+            lane.pc += 1
+            if plan.completes:
+                # The σ-interval finished (second-order lanes take two evals
+                # to get here) — the progress unit the hooks report.
+                lane.idx += 1
+                hook = lane.req.progress_hook
+                if hook is not None:
+                    try:
+                        hook(lane.idx, lane.req.n_steps)
+                    except Exception:  # noqa: BLE001 — a UI hook must not kill lanes
+                        pass
+            if lane.done():
                 result = (
                     self._x[i] if self._program is not None else lane.x_eager
                 )

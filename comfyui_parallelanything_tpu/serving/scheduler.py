@@ -1,17 +1,19 @@
 """Continuous-batching scheduler: step-boundary batched scheduling of
 concurrent sampler runs.
 
-The seam: every denoise step is an identical compiled dispatch, so sampler
-runs that agree on (model, latent shape, sampler, cfg-mode) can share ONE
-step program — a request joins the shared batch at the next step boundary,
-runs its own schedule in its own lane, and retires when its own step count
-completes (serving/bucket.py). This module is the glue between the callers
-(sampling/runner.py routes eligible ``run_sampler`` work here when a
-scheduler is installed; server.py installs one when it runs multiple prompt
-workers) and the buckets:
+The seam: every model eval is an identical compiled dispatch, so sampler
+runs that agree on (model, latent shape, cfg-mode) — running ANY sampler in
+the LaneStepSpec registry — can share ONE step program: a request joins the
+shared batch at the next step boundary, runs its own schedule (and its own
+per-lane sampler state machine) in its own lane, and retires when its own
+eval count completes (serving/bucket.py). This module is the glue between
+the callers (sampling/runner.py routes eligible ``run_sampler`` work here
+when a scheduler is installed; server.py installs one when it runs multiple
+prompt workers) and the buckets:
 
 - **shape-bucketed admission**: incoming work keyed by (model id, latent
-  shape/dtype, sampler, prediction, cfg-mode, static/traced kwarg shapes) and
+  shape/dtype, prediction, cfg-mode, static/traced kwarg shapes) — NOT the
+  sampler, which rides per-lane (round 10) — and
   routed to the matching bucket, created on first sight with a width the
   model itself bounds (``ParallelModel.serving_bucket_width`` — stream-mode
   chains stay width-1, mesh chains round to the data-axis width);
@@ -48,11 +50,15 @@ from ..utils.progress import (
 from .bucket import ServeRequest, StepBucket
 from .policy import ServingRejected
 
-# Samplers whose per-step update the lane program implements. Each entry must
-# have a scan-free, history-free step (per-lane state is (x, idx) only);
-# stochastic samplers are excluded — per-lane rng chains would diverge from
-# the serial chain the equivalence contract is defined against.
-BATCHABLE_SAMPLERS = frozenset({"euler"})
+# Samplers the stateful-lane program family implements (round 10): every
+# registered LaneStepSpec (sampling/lane_specs.py) — history-carrying,
+# two-eval, and stochastic families included. Stochastic lanes are
+# occupancy-deterministic because the per-step noise key is fold_in(rng, i)
+# on every path; tests/test_serving.py's registry-driven equivalence matrix
+# gates additions (a wired-but-unverified sampler fails the build).
+from ..sampling.lane_specs import LANE_SPECS
+
+BATCHABLE_SAMPLERS = frozenset(LANE_SPECS)
 
 _installed: "ContinuousBatchingScheduler | None" = None
 _install_lock = threading.Lock()
@@ -162,13 +168,22 @@ class ContinuousBatchingScheduler:
     def maybe_submit(
         self, *, model, x, sigmas, context, sampler, cfg_scale,
         uncond_context, uncond_kwargs, alphas_cumprod, prediction,
-        cfg_rescale, model_kwargs,
+        cfg_rescale, model_kwargs, rng=None,
     ) -> ServeRequest | None:
         """Admit one sampler run, or return None when it cannot share a step
         program (caller runs inline). Called from run_sampler with the fully
         prepared (noised x, schedule, conditioning) — the serving layer never
-        re-derives sampler semantics."""
+        re-derives sampler semantics; per-step sampler math comes from the
+        sampler's LaneStepSpec. ``rng`` is the stochastic base key (the same
+        one the eager loop would fold per step)."""
         if self._stop or sampler not in self.samplers:
+            return None
+        spec_entry = LANE_SPECS.get(sampler)
+        if spec_entry is None:
+            return None
+        if prediction == "flow" and not spec_entry.flow_ok:
+            return None
+        if spec_entry.needs_rng and rng is None:
             return None
         from ..utils.progress import current_preview_hook
 
@@ -222,8 +237,11 @@ class ContinuousBatchingScheduler:
             acp_fp = (a.shape[0],) + tuple(
                 float(v) for v in a[::stride]
             ) + (float(a[-1]),)
+        # The sampler is NOT part of the key (round 10): per-lane sampler
+        # state/updates ride the lane axis, so lanes running different
+        # samplers share one bucket — and one compiled dispatch stream.
         key = (
-            id(model), sampler, prediction, use_cfg, float(cfg_rescale),
+            id(model), prediction, use_cfg, float(cfg_rescale),
             tuple(x.shape), str(x.dtype),
             None if context is None
             else (tuple(context.shape), str(context.dtype)),
@@ -233,6 +251,7 @@ class ContinuousBatchingScheduler:
 
         req = ServeRequest(
             x=x, sigmas=np.asarray(sigmas, np.float32), context=context,
+            sampler=sampler, rng=rng,
             uncond_context=uncond_context if use_cfg else None,
             traced_kwargs=traced, static_kwargs=static, u_traced=u_traced,
             uncond_kwargs=uncond_kwargs if use_cfg else None,
@@ -256,8 +275,10 @@ class ContinuousBatchingScheduler:
             bucket = self.buckets.get(key)
             if bucket is None:
                 name = getattr(model, "name", None) or type(model).__name__
+                # No sampler in the label either — a bucket serves the whole
+                # k-sampler family in one dispatch stream.
                 label = (
-                    f"{name}:{sampler}:{prediction}:"
+                    f"{name}:{prediction}:"
                     f"{'x'.join(str(d) for d in x.shape)}"
                 )
                 bucket = StepBucket(

@@ -21,4 +21,15 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
-env -u PALLAS_AXON_POOL_IPS python scripts/perf_ledger.py --check
+env -u PALLAS_AXON_POOL_IPS python scripts/perf_ledger.py --check || exit $?
+
+# Sampler-coverage gate (round 10): one explicit pass over the lane-vs-solo
+# equivalence matrix + the registry coverage check, so a LaneStepSpec wired
+# into sampling/lane_specs.py but unverified (or missing from
+# BATCHABLE_SAMPLERS) fails CI loudly even if someone narrows the main run's
+# -m/-k selection. These tests are also part of the tier-1 run above; this
+# rerun is the contract, not the coverage.
+timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_serving.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly \
+    -k "LaneEquivalenceMatrix or MixedSamplerDispatch or RegistryCoverage"

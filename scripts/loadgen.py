@@ -132,7 +132,8 @@ def _serving_counters(base: str) -> dict:
             if v is not None:
                 out[f"{key}_p{q}_s"] = round(v, 6)
     for name in ("pa_serving_dispatch_total", "pa_serving_completed_total",
-                 "pa_serving_cancelled_total", "pa_serving_rejected_total"):
+                 "pa_serving_cancelled_total", "pa_serving_rejected_total",
+                 "pa_serving_lane_steps_total"):
         total = 0.0
         found = False
         for m in re.finditer(rf"^{name}(?:\{{[^}}]*\}})? ([0-9.eE+-]+)$",
@@ -141,6 +142,9 @@ def _serving_counters(base: str) -> dict:
             found = True
         if found:
             out[name] = total
+    m = re.search(r"^pa_serving_batched_fraction ([0-9.eE+-]+)$", text, re.M)
+    if m:
+        out["pa_serving_batched_fraction"] = float(m.group(1))
     return out
 
 
@@ -155,9 +159,17 @@ def percentile(samples: list[float], q: float) -> float:
 
 def run_load(base: str, graph: dict, *, clients: int, requests: int,
              timeout: float, seed_key: str | None = None,
-             extra_data: dict | None = None) -> dict:
+             extra_data: dict | None = None,
+             samplers: list[str] | None = None,
+             sampler_key: str | None = None) -> dict:
     """The closed loop; returns the summary dict (importable — the e2e test
-    drives an in-process server through this exact code path)."""
+    drives an in-process server through this exact code path).
+
+    ``samplers`` + ``sampler_key`` make the workload MIXED: prompt n runs
+    ``samplers[n % len]`` (round-robin, written into the graph at
+    ``sampler_key``) — the traffic shape the stateful-lane scheduler
+    co-batches into one dispatch stream, whose amortization the summary
+    reports (shared-dispatch counters scraped from /metrics)."""
     latencies: list[float] = []
     failures: list[str] = []
     rejected = [0]
@@ -174,6 +186,8 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
                 n = counter[0]
             if seed_key:
                 _set_path(g, seed_key, n)
+            if samplers and sampler_key:
+                _set_path(g, sampler_key, samplers[n % len(samplers)])
             payload = {"prompt": g}
             if extra_data:
                 payload["extra_data"] = extra_data
@@ -204,9 +218,18 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
         t.join()
     wall = time.time() - t_start
     after = _serving_counters(base)
+    dispatches = (
+        after.get("pa_serving_dispatch_total", 0.0)
+        - before.get("pa_serving_dispatch_total", 0.0)
+    ) if after else None
+    lane_steps = (
+        after.get("pa_serving_lane_steps_total", 0.0)
+        - before.get("pa_serving_lane_steps_total", 0.0)
+    ) if after else None
     return {
         "clients": clients,
         "requests": clients * requests,
+        "samplers": samplers or None,
         "completed": len(latencies),
         "failed": len(failures),
         "rejected_429": rejected[0],
@@ -215,10 +238,18 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
         "latency_p50_s": round(percentile(latencies, 50), 3),
         "latency_p95_s": round(percentile(latencies, 95), 3),
         "latency_max_s": round(max(latencies), 3) if latencies else 0.0,
-        "serving_dispatches": (
-            after.get("pa_serving_dispatch_total", 0.0)
-            - before.get("pa_serving_dispatch_total", 0.0)
-        ) if after else None,
+        "serving_dispatches": dispatches,
+        # Dispatch amortization: lane-steps served per compiled dispatch over
+        # this run (1.0 = no sharing; N = every dispatch carried N lanes) —
+        # the mixed-workload number the ROADMAP serving-on-hardware item banks.
+        "serving_lane_steps": lane_steps,
+        "dispatch_amortization": (
+            round(lane_steps / dispatches, 3)
+            if lane_steps and dispatches else None
+        ),
+        # End-state shared-dispatch fraction (process lifetime, not deltas —
+        # the same gauge GET /health reports).
+        "serving_batched_fraction": after.get("pa_serving_batched_fraction"),
         # Server-side quantiles from the /metrics histograms (end-state
         # values — histograms are cumulative): what the SERVER measured per
         # lockstep dispatch / lane admission, vs the client-clock latencies
@@ -241,9 +272,20 @@ def main() -> None:
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--seed-key", default=None,
                     help="colon path (node:inputs:seed) made unique per prompt")
+    ap.add_argument("--samplers", default=None,
+                    help="comma list (euler,heun,dpmpp_2m,...) assigned "
+                         "round-robin per prompt — the mixed workload the "
+                         "stateful-lane scheduler co-batches; requires "
+                         "--sampler-key")
+    ap.add_argument("--sampler-key", default=None,
+                    help="colon path (node:inputs:sampler_name) the "
+                         "round-robin sampler is written to")
     ap.add_argument("--priority", type=int, default=None)
     ap.add_argument("--deadline-s", type=float, default=None)
     args = ap.parse_args()
+    samplers = [s for s in (args.samplers or "").split(",") if s]
+    if samplers and not args.sampler_key:
+        ap.error("--samplers requires --sampler-key (where to write it)")
     with open(args.graph) as f:
         graph = json.load(f)
     extra = {}
@@ -255,6 +297,7 @@ def main() -> None:
         args.base, graph, clients=args.clients, requests=args.requests,
         timeout=args.timeout, seed_key=args.seed_key,
         extra_data=extra or None,
+        samplers=samplers or None, sampler_key=args.sampler_key,
     )
     _append_ledger(summary, args.base)
     print(json.dumps(summary))

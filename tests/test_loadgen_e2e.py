@@ -37,18 +37,32 @@ def test_loadgen_closed_loop_against_inprocess_server(tmp_path, monkeypatch):
                         seed_key="3:inputs:seed")
         assert warm["completed"] == 1, warm
 
-        summary = run_load(base, graph, clients=3, requests=2, timeout=600,
-                           seed_key="3:inputs:seed")
+        # MIXED workload (round 10): round-robin the sampler family across
+        # prompts — different samplers still share the dispatch stream, and
+        # the amortization fields prove it from the scraped counters alone.
+        summary = run_load(
+            base, graph, clients=3, requests=2, timeout=600,
+            seed_key="3:inputs:seed",
+            samplers=["euler", "heun", "dpmpp_2m", "euler_ancestral"],
+            sampler_key="3:inputs:sampler_name",
+        )
         print(json.dumps(summary))
         assert summary["completed"] == 6, summary
         assert summary["failed"] == 0, summary
         assert summary["latency_p50_s"] > 0
         assert summary["latency_p95_s"] >= summary["latency_p50_s"]
-        # Continuous batching engaged: 6 prompts × 6 steps = 36 serial
-        # dispatches; the closed loop keeps 3 in flight, so shared lockstep
-        # dispatches must come in well under serial.
+        # Continuous batching engaged across sampler families: 6 prompts × 6
+        # steps ≥ 36 serial evals (heun lanes take 11); the closed loop keeps
+        # 3 in flight, so shared lockstep dispatches must come in well under
+        # serial, and the amortization counters must show actual sharing.
         assert summary["serving_dispatches"] is not None
         assert 6 <= summary["serving_dispatches"] < 36, summary
+        assert summary["serving_lane_steps"] >= summary["serving_dispatches"]
+        assert summary["dispatch_amortization"] >= 1.0, summary
+        assert 0.0 < summary["serving_batched_fraction"] <= 1.0, summary
+        assert summary["samplers"] == [
+            "euler", "heun", "dpmpp_2m", "euler_ancestral",
+        ]
     finally:
         srv.shutdown()
         q.shutdown()

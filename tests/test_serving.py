@@ -12,6 +12,11 @@ import numpy as np
 import pytest
 
 from comfyui_parallelanything_tpu import DeviceChain, parallelize
+from comfyui_parallelanything_tpu.sampling.k_samplers import make_sigmas
+from comfyui_parallelanything_tpu.sampling.lane_specs import (
+    LANE_SPECS,
+    lane_eval_count,
+)
 from comfyui_parallelanything_tpu.sampling.runner import run_sampler
 from comfyui_parallelanything_tpu.serving import (
     AdmissionQueue,
@@ -434,11 +439,13 @@ class TestModesAndMetrics:
         assert len(frames) == 3  # one per step, emitted inline
         assert not sched.buckets  # nothing was admitted
 
-    def test_rng_and_callback_work_stays_inline(self, sched):
-        """Stochastic samplers and callback runs never enter a bucket."""
+    def test_callback_and_unbatchable_work_stays_inline(self, sched):
+        """Callback runs and samplers without a LaneStepSpec (lms/uni_pc —
+        order-4 latent history / predictor-corrector structure) never enter a
+        bucket. Stochastic samplers DO batch since round 10 — covered by the
+        equivalence matrix below."""
         noise, ctx = mk_inputs(95)
-        out = run_sampler(tiny_model, noise, ctx, sampler="euler_ancestral",
-                          steps=2, rng=jax.random.key(0))
+        out = run_sampler(tiny_model, noise, ctx, sampler="lms", steps=2)
         assert out.shape == noise.shape
         out2 = run_sampler(tiny_model, noise, ctx, sampler="euler", steps=2,
                            callback=lambda i, x: None)
@@ -474,6 +481,39 @@ class TestModesAndMetrics:
         assert "# TYPE pa_serving_dispatch_total counter" in text
         assert "pa_serving_step_seconds_sum" in text
 
+    def test_streaming_model_runs_stateful_samplers_width_1(self, sched):
+        """The width-1 eager mode walks the SAME StepPlans — a streaming-style
+        model gets the full sampler family (two-eval + stochastic included)
+        through step-boundary scheduling."""
+
+        class StreamingModel:
+            is_streaming = True
+
+            def __call__(self, x, t, context=None, **kw):
+                return tiny_model(x, t, context)
+
+        model = StreamingModel()
+        for sampler, rng in (("dpmpp_2m", None),
+                             ("dpmpp_sde", jax.random.key(4))):
+            kw = dict(sampler=sampler, steps=4)
+            if rng is not None:
+                kw["rng"] = rng
+            sched.uninstall()
+            serial = run_sampler(model, *mk_inputs(92), **kw)
+            sched.install()
+            results = {}
+
+            def worker(_kw=kw):
+                noise, ctx = mk_inputs(92)
+                results[0] = run_sampler(model, noise, ctx, **_kw)
+
+            t = _bg(worker)
+            _wait_enqueued(sched, 1)
+            sched.drain()
+            t.join(20)
+            np.testing.assert_allclose(np.asarray(results[0]),
+                                       np.asarray(serial), **TOL)
+
     def test_progress_hooks_fire_per_lane(self, sched):
         seen = {1: [], 2: []}
 
@@ -490,3 +530,168 @@ class TestModesAndMetrics:
         t2.join(20)
         assert seen[1] == [(1, 3), (2, 3), (3, 3)]
         assert seen[2] == [(i, 5) for i in range(1, 6)]
+
+    def test_progress_reports_intervals_not_evals(self, sched):
+        """A two-eval sampler's hooks fire once per σ-interval (the user-facing
+        step unit), not once per model eval."""
+        seen = []
+
+        def worker():
+            noise, ctx = mk_inputs(210)
+            with progress_scope(hook=lambda v, m: seen.append((v, m))):
+                run_sampler(tiny_model, noise, ctx, sampler="heun", steps=3)
+
+        t = _bg(worker)
+        _wait_enqueued(sched, 1)
+        sched.drain()
+        t.join(20)
+        assert seen == [(1, 3), (2, 3), (3, 3)]
+        # ...even though the lane consumed 2·3−1 = 5 model evals.
+        assert sched.total_dispatches() == 5
+
+
+# ---------------------------------------------------------------------------
+# Round 10: the stateful-lane sampler family. LANE_MATRIX is the explicit
+# lane-vs-solo equivalence matrix — TestRegistryCoverage fails the build if a
+# sampler is wired into LANE_SPECS but missing here (wired-but-unverified).
+# ---------------------------------------------------------------------------
+
+LANE_MATRIX = (
+    "euler", "euler_ancestral", "heun", "dpm_2", "dpm_2_ancestral",
+    "dpmpp_2s_ancestral", "dpmpp_sde", "dpmpp_2m", "dpmpp_2m_sde",
+    "dpmpp_3m_sde", "lcm", "ddpm",
+)
+LANE_MATRIX_FLOW = tuple(s for s in LANE_MATRIX if LANE_SPECS[s].flow_ok)
+
+
+def _solo(kw):
+    kw = dict(kw)
+    noise, ctx = mk_inputs(kw.pop("seed"))
+    return run_sampler(tiny_model, noise, ctx, **kw)
+
+
+def _serve_plans(sched, plans):
+    """Run each plan's run_sampler in a worker thread against the installed
+    scheduler with the deterministic manual-pump handshake; returns results
+    keyed by plan index."""
+    results = {}
+
+    def worker(j, kw):
+        noise, ctx = mk_inputs(kw.pop("seed"))
+        results[j] = run_sampler(tiny_model, noise, ctx, **kw)
+
+    threads = [_bg(worker, j, dict(p)) for j, p in enumerate(plans)]
+    _wait_enqueued(sched, len(plans))
+    sched.drain()
+    for t in threads:
+        t.join(30)
+    assert len(results) == len(plans)
+    return results
+
+
+class TestLaneEquivalenceMatrix:
+    """Acceptance: every newly-batched sampler's lane output matches its solo
+    k_samplers chain within bf16-scale tolerances — co-batched with an
+    unrelated ragged partner so the shared-dispatch path actually runs."""
+
+    @pytest.mark.parametrize("sampler", LANE_MATRIX)
+    def test_eps_lane_matches_solo(self, sched, sampler):
+        kw = dict(sampler=sampler, steps=5,
+                  seed=500 + LANE_MATRIX.index(sampler))
+        if LANE_SPECS[sampler].needs_rng:
+            kw["rng"] = jax.random.key(3)
+        sched.uninstall()
+        solo = _solo(kw)
+        sched.install()
+        res = _serve_plans(
+            sched, [kw, dict(sampler="euler", steps=7, seed=99)]
+        )
+        assert len(sched.buckets) == 1  # sampler-free key: ONE shared bucket
+        np.testing.assert_allclose(np.asarray(res[0]), np.asarray(solo), **TOL)
+
+    @pytest.mark.parametrize("sampler", LANE_MATRIX_FLOW)
+    def test_flow_lane_matches_solo(self, sched, sampler):
+        kw = dict(sampler=sampler, steps=4, prediction="flow", shift=1.15,
+                  seed=600 + LANE_MATRIX.index(sampler))
+        if LANE_SPECS[sampler].needs_rng:
+            kw["rng"] = jax.random.key(5)
+        sched.uninstall()
+        solo = _solo(kw)
+        sched.install()
+        res = _serve_plans(
+            sched,
+            [kw, dict(sampler="euler", steps=5, prediction="flow",
+                      shift=1.15, seed=98)],
+        )
+        np.testing.assert_allclose(np.asarray(res[0]), np.asarray(solo), **TOL)
+
+
+class TestMixedSamplerDispatch:
+    def test_mixed_families_complete_in_max_evals(self, sched):
+        """Acceptance: K concurrent prompts spanning 4 sampler families with
+        ragged schedules complete in a model-eval dispatch count equal to the
+        MAX per-lane eval count, not the sum — and all match their solo runs."""
+        plans = [
+            dict(sampler="euler", steps=4, seed=71),
+            dict(sampler="heun", steps=3, seed=72),
+            dict(sampler="dpmpp_2m", steps=6, seed=73),
+            dict(sampler="euler_ancestral", steps=5, seed=74,
+                 rng=jax.random.key(1)),
+        ]
+        sched.uninstall()
+        solos = [_solo(p) for p in plans]
+        sched.install()
+        res = _serve_plans(sched, plans)
+        [bucket] = sched.buckets.values()  # 4 families, ONE bucket
+        evals = [
+            lane_eval_count(p["sampler"],
+                            np.asarray(make_sigmas("karras", p["steps"])))
+            for p in plans
+        ]
+        assert sched.total_dispatches() == max(evals)  # 5 (heun), not 18
+        assert sum(evals) > max(evals)
+        for j, solo in enumerate(solos):
+            np.testing.assert_allclose(np.asarray(res[j]), np.asarray(solo),
+                                       **TOL)
+        frac = registry.get("pa_serving_batched_fraction")
+        assert 0.0 < frac <= 1.0
+        assert registry.get("pa_serving_lane_steps_total",
+                            {"bucket": bucket.label}) >= sum(evals)
+
+    def test_stochastic_occupancy_deterministic(self, sched):
+        """Acceptance: same prompt+seed yields IDENTICAL output alone vs
+        co-batched — the fold_in(rng, step) key discipline makes noise a pure
+        function of (request, step), independent of occupancy."""
+        kw = dict(sampler="dpmpp_sde", steps=4, seed=81,
+                  rng=jax.random.key(5))
+        alone = _serve_plans(sched, [kw])
+        co = _serve_plans(sched, [
+            kw,
+            dict(sampler="lcm", steps=3, seed=82, rng=jax.random.key(6)),
+            dict(sampler="dpmpp_3m_sde", steps=6, seed=83,
+                 rng=jax.random.key(7)),
+        ])
+        np.testing.assert_array_equal(np.asarray(alone[0]), np.asarray(co[0]))
+
+
+class TestRegistryCoverage:
+    def test_every_wired_sampler_is_batchable_and_verified(self):
+        """Registry-driven coverage gate: a LaneStepSpec wired into the
+        registry but absent from BATCHABLE_SAMPLERS or from the equivalence
+        matrix above fails the build."""
+        from comfyui_parallelanything_tpu.serving.scheduler import (
+            BATCHABLE_SAMPLERS,
+        )
+
+        assert frozenset(LANE_SPECS) == BATCHABLE_SAMPLERS
+        assert set(LANE_MATRIX) == set(LANE_SPECS), (
+            "every registered LaneStepSpec must appear in LANE_MATRIX "
+            "(the lane-vs-solo equivalence matrix)"
+        )
+        assert len(LANE_SPECS) >= 10  # ISSUE 5 target: {euler} → ≥10
+        # Every flow-capable spec is flow-verified; ddpm stays eps-only
+        # (k_samplers.FLOW_REJECT — no rectified-flow form).
+        assert set(LANE_MATRIX_FLOW) == {
+            s for s in LANE_SPECS if LANE_SPECS[s].flow_ok
+        }
+        assert not LANE_SPECS["ddpm"].flow_ok
