@@ -781,13 +781,29 @@ def _run_inner() -> None:
     from comfyui_parallelanything_tpu.utils import tracing
 
     tracing.enable()
+    # Numerics sentinel (round 11, utils/numerics.py): OPT-IN for bench runs
+    # (PA_NUMERICS=1) — with the flag on, the streaming rung's per-stage
+    # finite checks run inside the timed iterations, which would shift
+    # sec/it against pre-sentinel ledger baselines. Default-off keeps the
+    # pinned timing protocol untouched; the fingerprint and final-output
+    # stats below are flag-independent (computed after the loop), so every
+    # line still carries latent_fingerprint/nonfinite_events either way.
+    from comfyui_parallelanything_tpu.utils import numerics
+
+    if os.environ.get("PA_NUMERICS", "") not in ("", "0", "false"):
+        numerics.enable()
+    numerics.sentinel.reset()
     inner_step = step
     # PA_FAIL_INJECT (guarded above by the PA_EVIDENCE_DIR requirement): a
     # deterministic mid-run failure so the postmortem/forensics path is
     # rehearsed off-hardware — the round-3 lesson applied to the flight
     # recorder itself. The third step fails, so the bundle holds real warmup
     # spans/samples.
-    _fail_at = 3 if _FAIL_INJECT else None
+    # ``nan:<lane>`` values target the serving lanes' quarantine rehearsal
+    # (utils/numerics.py), not the bench flight recorder — don't raise here.
+    _fail_at = (
+        3 if _FAIL_INJECT and not _FAIL_INJECT.startswith("nan") else None
+    )
     _step_no = [0]
 
     def step(v):
@@ -823,11 +839,33 @@ def _run_inner() -> None:
     iters = TPU_BENCH_ITERS if is_tpu else SMOKE_BENCH_ITERS
     if os.environ.get("PA_BENCH_TINY") == "1":
         iters = 3  # dry-run: control flow under test, not timing fidelity
-    sec_it, _ = chained_time(step, x, iters, warmup=BENCH_WARMUP_STEPS)
+    sec_it, final_out = chained_time(step, x, iters, warmup=BENCH_WARMUP_STEPS)
     # Post-loop watermark sample (the warmup-phase samples above kept the
     # host call out of the timed iterations): on real devices memory_stats'
     # running peak covers the timed steps too.
     telemetry.watermark.sample()
+
+    # Numerics audit fields (utils/numerics.py), computed post-loop on the
+    # chained final output: the latent fingerprint (bf16-quantized digest —
+    # deterministic per rung, what scripts/numerics_audit.py --check diffs
+    # against its golden bank) and the run's non-finite event count (sentinel
+    # events — e.g. a streamed stage gone bad — plus a poisoned final
+    # output). Best-effort: the one JSON line outranks its audit fields.
+    latent_fingerprint = None
+    try:
+        import numpy as _np
+
+        fstats = numerics.stats_to_dict(
+            _np.asarray(numerics.array_stats(final_out))
+        )
+        if fstats["nonfinite"]:
+            numerics.sentinel.record_event(
+                "bench-final", rung=config_name, **fstats
+            )
+        latent_fingerprint = numerics.latent_fingerprint(final_out)
+    except Exception:
+        pass
+    nonfinite_events = numerics.sentinel.event_count
 
     trace_events = tracing.export()
     trace_aggs = tracing.trace_aggregates(trace_events)
@@ -893,6 +931,11 @@ def _run_inner() -> None:
         "compile_cache_hits": _comp["cache_hits"],
         "compile_cache_misses": _comp["cache_misses"],
         "peak_hbm_bytes": telemetry.watermark.peak_bytes or None,
+        # Numerics audit (utils/numerics.py): the rung's deterministic
+        # latent fingerprint (drift-gated by scripts/numerics_audit.py) and
+        # non-finite events observed this run (0 on a healthy rung).
+        "latent_fingerprint": latent_fingerprint,
+        "nonfinite_events": nonfinite_events,
         # Which attention path(s) actually served the run, resolved at trace
         # time ("pallas", "xla", or "pallas+xla" when different shapes picked
         # differently) — so the evidence never hides an XLA fallback behind an
@@ -1014,7 +1057,7 @@ def _tpu_probe(timeout=120, attempts=2):
 _LATE_SCHEMA_FIELDS = (
     "stream_overlap_efficiency", "lane_wait_p95", "host_gap_ms",
     "compile_time_s", "compile_cache_hits", "compile_cache_misses",
-    "peak_hbm_bytes",
+    "peak_hbm_bytes", "latent_fingerprint", "nonfinite_events",
 )
 
 

@@ -53,7 +53,7 @@ import jax
 from ..devices.memory import ResidencyTracker
 from ..models.api import PipelineSpec
 from ..models.loader import carve_stages, params_nbytes, pin_params_host
-from ..utils import tracing
+from ..utils import numerics, tracing
 from ..utils.logging import get_logger, log_placement
 from ..utils.telemetry import instrument_jit, watermark
 from .split import partition_kwargs, static_kwargs_key
@@ -256,6 +256,22 @@ class StreamingRunner:
         except Exception:
             pass
 
+    def _check_stage(self, idx: int, value, where: str = "stream-stage") -> None:
+        """Numerics sentinel (utils/numerics.py): per-stage output stats so a
+        bad stage is NAMED — called only at boundaries the schedule already
+        synchronizes (the backpressure block / the caller's own sync), so the
+        sentinel adds no sync of its own to the double-buffered schedule."""
+        try:
+            nf = numerics.tree_nonfinite(value)
+        except Exception:  # noqa: BLE001 — observation must never kill the run
+            return
+        if nf:
+            stage = self.stages[idx] if 0 <= idx < len(self.stages) else None
+            numerics.sentinel.record_event(
+                where, stage=idx, device=str(self.device), nonfinite=int(nf),
+                blocks=",".join(stage.labels) if stage is not None else "",
+            )
+
     def _place_stage(self, idx: int):
         stage = self.stages[idx]
         placed = jax.device_put(
@@ -340,6 +356,10 @@ class StreamingRunner:
                         with tracing.span("stream-wait", cat="stream",
                                           stage=k - 1, blocked_on="compute"):
                             jax.block_until_ready(prev_out)
+                        if numerics.on():
+                            # The output is provably ready (the block above),
+                            # so this reduction is pure post-hoc accounting.
+                            self._check_stage(k - 1, prev_out)
                         if pending is not None:
                             record_compute(pending[0], pending[1])
                             pending = None
@@ -410,6 +430,11 @@ class StreamingRunner:
                     ring.pop(last)
                     self.tracker.retire(last)
                     self._publish_residency()
+                if numerics.on():
+                    # Tail check (last stage + finalize — neither is awaited
+                    # by the backpressure loop): the sentinel's pull doubles
+                    # as the sync the caller was about to perform anyway.
+                    self._check_stage(last, out, where="stream-output")
                 return out
             finally:
                 # Failure path (OOM mid-schedule): release whatever the ring

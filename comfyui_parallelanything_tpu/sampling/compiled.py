@@ -41,6 +41,7 @@ from ..parallel.split import (
     pad_leaf as _pad_leaf,
     slice_padded as _slice_padded,
 )
+from ..utils import numerics
 from .cfg import double_kwargs, rescale_guidance
 from .k_samplers import (
     RNG_SAMPLERS,
@@ -746,6 +747,35 @@ def _post_from(mask, keep_at):
     return lambda i, x: _mask_blend(x, mask, keep_at(i))
 
 
+def _emit_numerics(out, emit: bool):
+    """Attach the sentinel's aux outputs (utils/numerics.py) to a loop
+    program's result inside the jitted body: final-latent stats vector +
+    bf16 digest — computed on-device, read by the caller at a boundary that
+    syncs anyway (the loop's own completion)."""
+    if not emit:
+        return out
+    return out, numerics.array_stats(out), numerics.digest(out)
+
+
+def _collect_numerics(out, emit: bool, program: str):
+    """Unpack a loop program's numerics aux outputs and feed the sentinel:
+    a non-finite final latent records an event (counter + last-event + trace
+    span), and the digest lands in the bounded fingerprint ring. No-op (and
+    no host pull) when the sentinel was off at trace time."""
+    if not emit:
+        return out
+    out, stats, dig = out
+    s = np.asarray(stats)
+    if s[0] > 0:
+        numerics.sentinel.record_event(
+            "compiled-loop", program=program, **numerics.stats_to_dict(s)
+        )
+    numerics.sentinel.record_fingerprints(
+        where=program, digests=[int(np.asarray(dig))]
+    )
+    return out
+
+
 # ---------------------------------------------------------------------------
 # entry points (called by sampling.runner when compile_loop=True)
 # ---------------------------------------------------------------------------
@@ -801,7 +831,11 @@ def compiled_k_sample(
         [x, context, uncond_context, traced, u_traced, mask, mask_init, mask_noise],
     )
     x, context, uncond_context, traced, u_traced, mask, mask_init, mask_noise = placed
-    meta = (sampler, float(cfg_scale), float(cfg_rescale), prediction)
+    # The sentinel flag is part of the program signature (stats/digest aux
+    # outputs), so it keys the jit cache via meta — toggling it re-traces
+    # instead of silently returning the wrong tuple shape.
+    emit = numerics.on()
+    meta = (sampler, float(cfg_scale), float(cfg_rescale), prediction, emit)
     apply_fn, mesh, axis = spec.apply, spec.mesh, spec.data_axis
 
     def build(bound_static):
@@ -830,9 +864,11 @@ def compiled_k_sample(
             if meta[3] == "flow":
                 sampler_fn = SCAN_FLOW_VARIANTS.get(meta[0], sampler_fn)
             if meta[0] in _AUX_SAMPLERS:
-                return sampler_fn(denoise, x, sigmas, keys, post, constrain,
-                                  coeffs=aux)
-            return sampler_fn(denoise, x, sigmas, keys, post, constrain)
+                out = sampler_fn(denoise, x, sigmas, keys, post, constrain,
+                                 coeffs=aux)
+            else:
+                out = sampler_fn(denoise, x, sigmas, keys, post, constrain)
+            return _emit_numerics(out, emit)
 
         return impl
 
@@ -841,6 +877,7 @@ def compiled_k_sample(
         spec.params, x, sigmas, keys, aux, context, uncond_context, traced,
         u_traced or None, acp, mask, mask_init, mask_noise,
     )
+    out = _collect_numerics(out, emit, f"loop:k:{sampler}")
     return _slice_padded(out, batch, padded)
 
 
@@ -862,14 +899,15 @@ def compiled_ddim_sample(
         [x, context, uncond_context, traced, u_traced, mask, mask_init, mask_noise],
     )
     x, context, uncond_context, traced, u_traced, mask, mask_init, mask_noise = placed
-    meta = (float(cfg_scale), float(cfg_rescale), prediction)
+    emit = numerics.on()
+    meta = (float(cfg_scale), float(cfg_rescale), prediction, emit)
     apply_fn, mesh, axis = spec.apply, spec.mesh, spec.data_axis
 
     def build(bound_static):
         def impl(params, x, ts, a_t, a_prev, context, uncond_context, kwargs,
                  u_kwargs, mask, mask_init, mask_noise):
             model = _model_fn(apply_fn, params, bound_static)
-            cfg_scale_, cfg_rescale_, prediction_ = meta
+            cfg_scale_, cfg_rescale_, prediction_ = meta[:3]
             batch = x.shape[0]
             use_cfg = cfg_scale_ != 1.0 and uncond_context is not None
             post = _post_from(
@@ -905,7 +943,7 @@ def compiled_ddim_sample(
 
             n = len(ts)
             x, _ = jax.lax.scan(body, x, (jnp.arange(n), ts, a_t, a_prev))
-            return x
+            return _emit_numerics(x, emit)
 
         return impl
 
@@ -914,6 +952,7 @@ def compiled_ddim_sample(
         spec.params, x, ts, a_t, a_prev, context, uncond_context, traced,
         u_traced or None, mask, mask_init, mask_noise,
     )
+    out = _collect_numerics(out, emit, "loop:ddim")
     return _slice_padded(out, batch_orig, padded)
 
 
@@ -933,9 +972,10 @@ def compiled_flow_sample(
         [x, context, uncond_context, traced, u_traced, mask, mask_init, mask_noise],
     )
     x, context, uncond_context, traced, u_traced, mask, mask_init, mask_noise = placed
+    emit = numerics.on()
     meta = (
         float(cfg_scale), float(cfg_rescale),
-        None if guidance is None else float(guidance),
+        None if guidance is None else float(guidance), emit,
     )
     apply_fn, mesh, axis = spec.apply, spec.mesh, spec.data_axis
 
@@ -943,7 +983,7 @@ def compiled_flow_sample(
         def impl(params, x, ts, context, uncond_context, kwargs, u_kwargs,
                  mask, mask_init, mask_noise):
             model = _model_fn(apply_fn, params, bound_static)
-            cfg_scale_, cfg_rescale_, guidance_ = meta
+            cfg_scale_, cfg_rescale_, guidance_ = meta[:3]
             batch = x.shape[0]
             use_cfg = cfg_scale_ != 1.0 and uncond_context is not None
             kw = dict(kwargs)
@@ -975,7 +1015,7 @@ def compiled_flow_sample(
 
             n = len(ts) - 1
             x, _ = jax.lax.scan(body, x, (jnp.arange(n), ts[:-1], ts[1:]))
-            return x
+            return _emit_numerics(x, emit)
 
         return impl
 
@@ -984,6 +1024,7 @@ def compiled_flow_sample(
         spec.params, x, ts, context, uncond_context, traced, u_traced or None,
         mask, mask_init, mask_noise,
     )
+    out = _collect_numerics(out, emit, "loop:flow")
     return _slice_padded(out, batch_orig, padded)
 
 
@@ -1003,7 +1044,7 @@ def compiled_flow_sample(
 
 def lane_step_program(
     spec: TraceSpec, *, prediction: str, use_cfg: bool, cfg_rescale: float,
-    static_kwargs: dict,
+    static_kwargs: dict, emit_stats: bool = False,
 ):
     """The jitted per-step program for one serving bucket (W = lane width,
     b = per-request batch):
@@ -1023,8 +1064,16 @@ def lane_step_program(
     The sampler never appears in the program: traffic-mix changes can't
     recompile. Inactive lanes get sigma pinned to 1.0 (no divide-by-zero),
     identity coefficients, and a where-select pass-through. Cached via the
-    loop-jit cache (bounded, clearable); all four state stacks are donated."""
-    meta = ("serve", prediction, bool(use_cfg), float(cfg_rescale))
+    loop-jit cache (bounded, clearable); all four state stacks are donated.
+
+    ``emit_stats`` (the numerics sentinel, utils/numerics.py) appends two aux
+    outputs — per-lane ``[W, 4]`` stats (non-finite count over x'∪xe', then
+    max|x'|/mean/rms) and per-lane bf16 digests ``[W]`` — computed on-device
+    inside the same dispatch, and keeps ``xe`` UNdonated so the quarantine
+    path can re-run the failing eval input through the model's PipelineSpec
+    stages after the fact."""
+    meta = ("serve", prediction, bool(use_cfg), float(cfg_rescale),
+            bool(emit_stats))
     apply_fn, mesh, axis = spec.apply, spec.mesh, spec.data_axis
 
     def build(bound_static):
@@ -1103,12 +1152,21 @@ def lane_step_program(
                 return acc.astype(x.dtype)
 
             live = bcast(active > 0, x.ndim)
-            return tuple(
+            new = tuple(
                 _constrain(jnp.where(live, mix(j), old), mesh, axis)
                 for j, old in enumerate((x, xe, h1, h2))
+            )
+            if not emit_stats:
+                return new
+            # Per-lane stats (xe' folded into the non-finite count: a NaN a
+            # two-eval sampler parks mid-step is caught at THIS dispatch) and
+            # lane-local digests — tiny reductions riding the same program.
+            return new + (
+                numerics.lane_stats(new[0], extra=new[1]),
+                numerics.lane_digest(new[0]),
             )
 
         return impl
 
     return _get_loop_jit("serve", spec, static_kwargs, meta, build,
-                         donate=(1, 2, 3, 4))
+                         donate=(1, 3, 4) if emit_stats else (1, 2, 3, 4))

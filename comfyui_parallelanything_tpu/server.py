@@ -42,7 +42,11 @@ Endpoints (the ComfyUI client-protocol subset that makes scripts work):
                               per-device HBM + utilization (deterministic
                               pseudo-accounting off-hardware), peak
                               watermark, compile/cache accounting, queue
-                              depth/workers, 1-minute load average
+                              depth/workers, 1-minute load average, and a
+                              ``numerics`` section (utils/numerics.py:
+                              sentinel flag, last non-finite event,
+                              quarantined-lane total, fingerprint-gate
+                              verdict; enable with $PA_NUMERICS=1)
 - ``GET  /trace``             Chrome/Perfetto trace-event JSON of the span
                               tracer (utils/tracing.py) — per-prompt
                               timelines from HTTP ingress to device step;
@@ -225,6 +229,13 @@ class PromptQueue:
             trace = os.environ.get("PA_TRACE", "") not in ("", "0", "false")
         if trace:
             tracing.enable()
+        if os.environ.get("PA_NUMERICS", "") not in ("", "0", "false"):
+            # Numerics sentinel (utils/numerics.py): per-lane non-finite
+            # quarantine + latent fingerprints on the serving path; off by
+            # default (single flag check, zero overhead).
+            from .utils import numerics
+
+            numerics.enable()
         self.class_mappings = class_mappings
         self.output_dir = output_dir or os.environ.get("PA_OUTPUT_DIR", "output")
         self.cache = WorkflowCache()
@@ -648,6 +659,15 @@ class _Handler(BaseHTTPRequestHandler):
                 from .devices.memory import publish_memory_gauges
 
                 publish_memory_gauges()
+            except Exception:
+                pass
+            try:
+                # pa_numerics_* gauges (utils/numerics.py): published at
+                # scrape time so a healthy server exposes explicit zeros,
+                # not absent series.
+                from .utils import numerics
+
+                numerics.sentinel.publish_gauges()
             except Exception:
                 pass
             return self._send(

@@ -23,7 +23,17 @@ sampler's step):
 - stochastic lanes: the step-``i`` key is ``fold_in(request rng, i)`` —
   keys are precomputed per request at seat time, so noise is a pure
   function of (request, step) and output is bit-identical alone vs
-  co-batched (the occupancy-determinism contract).
+  co-batched (the occupancy-determinism contract);
+- numerics quarantine (round 11, utils/numerics.py): with the sentinel on,
+  every dispatch also emits per-lane non-finite counts and bf16 latent
+  digests as on-device aux outputs; a lane whose state goes NaN/Inf is
+  retired at that boundary through the SAME select-mask discipline (its
+  submitter gets :class:`~..utils.numerics.NonFiniteLatent`, survivors are
+  untouched by construction), with a ``write_postmortem`` bundle naming the
+  first offending block (PipelineSpec bisection re-run), step, and σ. The
+  reference's only numeric-failure story is whole-run OOM degradation
+  (any_device_parallel.py:1114-1128, 1435-1448) — here one poisoned lane
+  costs one lane.
 
 Two execution modes share the bookkeeping: a compiled per-lane step program
 (sampling/compiled.py ``lane_step_program`` — single-program models, width N)
@@ -50,7 +60,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ..sampling.lane_specs import LANE_SPECS, StepPlan, plan_schedule
-from ..utils import tracing
+from ..utils import numerics, tracing
 from ..utils.metrics import registry
 from ..utils.progress import Interrupted
 from .policy import AdmissionQueue, DeadlineExceeded
@@ -168,6 +178,10 @@ class _Lane:
     h2_eager: Any = None
     denoiser: Any = None
     seat_us: float = 0.0  # trace-clock admission time (the lane span start)
+    # Numerics sentinel (utils/numerics.py): per-eval bf16 digests of this
+    # lane's latent — the (request, step) fingerprint stack, recorded into
+    # the sentinel's ring at retirement. Empty when the sentinel is off.
+    digests: list = dataclasses.field(default_factory=list)
 
     def plan(self) -> StepPlan:
         return self.plans[self.pc]
@@ -224,6 +238,10 @@ class StepBucket:
         self.lanes: list[_Lane | None] = [None] * self.width
         self.dispatch_count = 0
         self._program = None
+        # Sentinel state captured at program build (the stats/digest aux
+        # outputs are part of the compiled signature); width-1 eager mode
+        # reads numerics.on() live instead.
+        self._emit_stats = False
         self._log_sigmas = None
         self._acp_default = None
         # Stacked device state, built from the first admitted request's
@@ -309,12 +327,14 @@ class StepBucket:
             self._log_sigmas = self._jnp.log(self._model_sigmas(acp))
         from ..sampling.compiled import lane_step_program
 
+        self._emit_stats = numerics.on()
         self._program = lane_step_program(
             self.spec,
             prediction=req.prediction,
             use_cfg=req.uncond_context is not None and req.cfg_scale != 1.0,
             cfg_rescale=req.cfg_rescale,
             static_kwargs=req.static_kwargs,
+            emit_stats=self._emit_stats,
         )
 
     def _set_lane(self, i: int, req: ServeRequest) -> None:
@@ -420,6 +440,14 @@ class StepBucket:
     def _retire(self, i: int, result=None, error=None) -> None:
         lane = self.lanes[i]
         self.lanes[i] = None
+        if lane.digests:
+            # The lane's per-eval fingerprint stack (numerics sentinel):
+            # invariant to occupancy/width/sharding by the digest's
+            # construction, so any drift here IS a numerics change.
+            numerics.sentinel.record_fingerprints(
+                rid=lane.req.rid, sampler=lane.req.sampler, bucket=self.label,
+                steps=lane.idx, digests=list(lane.digests),
+            )
         if tracing.on() and lane.seat_us:
             # lane-assign→retire on the submitter's timeline; the per-step
             # spans recorded by dispatch() nest inside this interval.
@@ -436,6 +464,62 @@ class StepBucket:
             else "pa_serving_completed_total",
             labels=self._labels,
         )
+
+    def _quarantine(self, i: int, plan: StepPlan, stats_vec, xe_lane,
+                    occupancy: int = 0) -> None:
+        """Non-finite quarantine (numerics sentinel): retire lane ``i`` via
+        the existing select-mask discipline — the stacked state is NOT
+        touched, so co-batched neighbors are bit-identical to their solo
+        runs by construction — and dump a ``write_postmortem`` bundle whose
+        extras name the first non-finite block/step/σ. The block comes from
+        :func:`utils.numerics.bisect_nonfinite`: a re-run of the failing
+        eval input through the model's PipelineSpec stages (prepare →
+        per-block segments → finalize); the step/σ come from the lane's own
+        StepPlan — this dispatch IS the first non-finite one, because every
+        emitting dispatch is checked."""
+        lane = self.lanes[i]
+        req = lane.req
+        err = numerics.NonFiniteLatent(
+            f"lane {i} ({req.sampler}) went non-finite at step {plan.step} "
+            f"(σ_eval={plan.sigma_eval:.6g}) in bucket {self.label}; lane "
+            f"quarantined, postmortem bundle written"
+        )
+        forensics = {
+            "bucket": self.label, "lane": i, "rid": req.rid,
+            "sampler": req.sampler, "step": int(plan.step),
+            "sigma": float(plan.sigma_eval), "pc": lane.pc,
+            "occupancy": occupancy, "prompt_id": req.prompt_id,
+            "stats": numerics.stats_to_dict(stats_vec),
+        }
+        log_sig = self._log_sigmas
+        if log_sig is None and lane.denoiser is not None:
+            log_sig = getattr(lane.denoiser, "log_sigmas", None)
+        try:
+            bisect = numerics.bisect_nonfinite(
+                self.model, xe_lane, plan.sigma_eval, req.prediction,
+                log_sig, req.context,
+                {**req.traced_kwargs, **req.static_kwargs},
+            )
+        except Exception as e:  # noqa: BLE001 — forensics never blocks retire
+            bisect = {"block": None, "bisect_error": f"{type(e).__name__}: {e}"}
+        forensics["first_nonfinite"] = {
+            "step": int(plan.step), "sigma": float(plan.sigma_eval), **bisect,
+        }
+        bundle = None
+        try:
+            from ..utils.telemetry import write_postmortem
+
+            bundle = write_postmortem(
+                f"numerics-{self.label}-lane{i}", error=err, extra=forensics
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        numerics.sentinel.record_event(
+            "serving-lane", bucket=self.label, lane=i, step=int(plan.step),
+            sampler=req.sampler,
+        )
+        numerics.sentinel.record_quarantine(**forensics, bundle=bundle)
+        self._retire(i, error=err)
 
     def sweep_cancelled(self) -> int:
         """Retire lanes whose request was cancelled (client cancel, per-prompt
@@ -475,6 +559,10 @@ class StepBucket:
         t0_us = tracing.now_us() if tracing.on() else 0.0
         t0 = time.perf_counter()
         plans = {i: self.lanes[i].plan() for i in active}
+        # Numerics sentinel (utils/numerics.py): (stats, digests, xe-of-lane)
+        # when this dispatch emitted them — read below, AFTER the block the
+        # dispatch already performs, so the sentinel adds no sync of its own.
+        quarantine_src = None
         if self._program is not None:
             sig = np.ones((self.width,), np.float32)
             act = np.zeros((self.width,), np.float32)
@@ -496,19 +584,50 @@ class StepBucket:
                 row = _noise_key_row(lane, plan)
                 if row is not None:
                     keys[i] = row
-            self._x, self._xe, self._h1, self._h2 = self._program(
+            xe_prev = None
+            if self._emit_stats:
+                inj = numerics.take_injection(active)
+                if inj is not None:
+                    # PA_FAIL_INJECT=nan:<lane> rehearsal: poison ONE element
+                    # of the seated lane's next eval input, once — the
+                    # quarantine path below must catch it at this dispatch.
+                    idx = (inj,) + (0,) * (self._xe.ndim - 1)
+                    self._xe = self._xe.at[idx].set(jnp.nan)
+                # emit mode keeps xe UNdonated (lane_step_program) so the
+                # failing eval input survives for the per-block bisection.
+                xe_prev = self._xe
+            outs = self._program(
                 self.spec.params, self._x, self._xe, self._h1, self._h2,
                 jnp.asarray(sig), jnp.asarray(act), jnp.asarray(cfg),
                 jnp.asarray(coef), jnp.asarray(keys),
                 self._ctx, self._uctx, self._kw, self._ukw, self._log_sigmas,
             )
+            if self._emit_stats:
+                (self._x, self._xe, self._h1, self._h2, st_dev, dg_dev) = outs
+            else:
+                self._x, self._xe, self._h1, self._h2 = outs
             jax.block_until_ready(self._x)
+            if self._emit_stats:
+                quarantine_src = (
+                    np.asarray(st_dev), np.asarray(dg_dev),
+                    lambda i, _xe=xe_prev: _xe[i],
+                )
         else:
             # Width-1 eager mode (streaming/hybrid models): the SAME StepPlan
             # walk against the lane's own denoiser — full sampler family,
             # one model call per eval.
+            emit_eager = numerics.on()
+            xe_inputs: dict[int, Any] = {}
+            if emit_eager:
+                inj = numerics.take_injection(active)
+                if inj is not None:
+                    lane0 = self.lanes[inj]
+                    idx = (0,) * lane0.xe_eager.ndim
+                    lane0.xe_eager = lane0.xe_eager.at[idx].set(jnp.nan)
             for i in active:
                 lane, plan = self.lanes[i], plans[i]
+                if emit_eager:
+                    xe_inputs[i] = lane.xe_eager
                 x0e = lane.denoiser(
                     lane.xe_eager, jnp.float32(plan.sigma_eval)
                 )
@@ -540,6 +659,17 @@ class StepBucket:
                     _combine(plan.coef[3], lane.h2_eager),
                 )
             jax.block_until_ready([self.lanes[i].x_eager for i in active])
+            if emit_eager:
+                st_rows, dg_rows = {}, {}
+                for i in active:
+                    lane = self.lanes[i]
+                    st_rows[i] = np.asarray(numerics.lane_stats(
+                        lane.x_eager[None], extra=lane.xe_eager[None]
+                    ))[0]
+                    dg_rows[i] = int(np.asarray(numerics.digest(lane.x_eager)))
+                quarantine_src = (
+                    st_rows, dg_rows, lambda i, _xs=xe_inputs: _xs[i]
+                )
         dt = time.perf_counter() - t0
         self.dispatch_count += 1
         registry.counter("pa_serving_dispatch_total", labels=self._labels,
@@ -575,8 +705,23 @@ class StepBucket:
                     bucket=self.label, lane=i, step=lane.idx + 1,
                     of=lane.req.n_steps, occupancy=len(active),
                 )
+        if quarantine_src is not None:
+            # Sentinel boundary: the per-lane stats/digests this dispatch
+            # emitted (surfaced at the same boundary the progress hooks
+            # fire). A non-finite lane is quarantined BEFORE its plan
+            # counter advances — its slot goes inactive-masked (the select
+            # discipline), so survivors stay bit-identical to solo runs.
+            st, dg, xe_of = quarantine_src
+            for i in active:
+                lane = self.lanes[i]
+                lane.digests.append(int(dg[i]))
+                if float(st[i][0]) > 0:
+                    self._quarantine(i, plans[i], st[i], xe_of(i),
+                                     occupancy=len(active))
         for i in active:
             lane, plan = self.lanes[i], plans[i]
+            if lane is None:
+                continue  # quarantined at this boundary — already retired
             lane.pc += 1
             if plan.completes:
                 # The σ-interval finished (second-order lanes take two evals

@@ -3,9 +3,10 @@ from .cleanup import aggressive_cleanup
 from .compile_cache import enable_compilation_cache
 from .metrics import StepTimer, StepStats, trace
 from .checks import assert_finite, checked
-from . import telemetry, tracing
+from . import numerics, telemetry, tracing
 
 __all__ = [
+    "numerics",
     "enable_compilation_cache",
     "get_logger",
     "log_setup_summary",

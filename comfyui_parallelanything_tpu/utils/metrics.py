@@ -13,6 +13,15 @@ the framework itself:
   Prometheus-text renderer (round 7): the serving subsystem's per-bucket
   occupancy, lane-wait, step-time, and dispatch-count instruments, exposed by
   the HTTP server's ``GET /metrics``.
+
+Instrument families registered against this registry (create-on-first-touch
+— no registration step): ``pa_serving_*`` (serving/), ``pa_compile_*`` /
+``pa_hbm_*`` (utils/telemetry.py, devices/memory.py), ``pa_trace_span_*``
+(utils/tracing.py), and ``pa_numerics_*`` (utils/numerics.py —
+``pa_numerics_nonfinite_total{where=}`` / ``pa_numerics_quarantined_total``
+counters at the event sites, plus the ``pa_numerics_sentinel_enabled`` /
+``pa_numerics_nonfinite_events`` / ``pa_numerics_quarantined_lanes`` gauges
+the server publishes at scrape time so healthy zeros are visible).
 """
 
 from __future__ import annotations

@@ -507,6 +507,15 @@ def health_snapshot(queue: dict | None = None) -> dict:
         out["hbm_utilization_max"] = None
     out["peak_hbm_bytes"] = watermark.peak_bytes or None
     out["compile"] = compile_snapshot()
+    try:
+        # Numerics sentinel (utils/numerics.py): flag state, non-finite
+        # event / quarantined-lane totals, last event, and the fingerprint
+        # gate's last verdict (scripts/numerics_audit.py).
+        from . import numerics
+
+        out["numerics"] = numerics.sentinel.snapshot()
+    except Exception:
+        out["numerics"] = None
     if queue is not None:
         out["queue"] = queue
     return out

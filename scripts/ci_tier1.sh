@@ -23,6 +23,12 @@ fi
 
 env -u PALLAS_AXON_POOL_IPS python scripts/perf_ledger.py --check || exit $?
 
+# Numerics drift gate (round 11): latest banked fingerprint per rung vs
+# the golden bank (scripts/numerics_audit.py) — latent-fingerprint drift
+# or a nonzero nonfinite_events count fails CI exactly like a perf
+# regression; an empty/unfingerprinted ledger is SKIP, never a failure.
+env -u PALLAS_AXON_POOL_IPS python scripts/numerics_audit.py --check || exit $?
+
 # Sampler-coverage gate (round 10): one explicit pass over the lane-vs-solo
 # equivalence matrix + the registry coverage check, so a LaneStepSpec wired
 # into sampling/lane_specs.py but unverified (or missing from

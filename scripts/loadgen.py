@@ -133,7 +133,14 @@ def _serving_counters(base: str) -> dict:
                 out[f"{key}_p{q}_s"] = round(v, 6)
     for name in ("pa_serving_dispatch_total", "pa_serving_completed_total",
                  "pa_serving_cancelled_total", "pa_serving_rejected_total",
-                 "pa_serving_lane_steps_total"):
+                 "pa_serving_lane_steps_total",
+                 # Numerics sentinel (utils/numerics.py): non-finite
+                 # observations and quarantined lanes (summed over labels),
+                 # plus the enabled gauge (published at scrape time) that
+                 # tells a clean 0 apart from an unwatched run.
+                 "pa_numerics_nonfinite_total",
+                 "pa_numerics_quarantined_total",
+                 "pa_numerics_sentinel_enabled"):
         total = 0.0
         found = False
         for m in re.finditer(rf"^{name}(?:\{{[^}}]*\}})? ([0-9.eE+-]+)$",
@@ -250,6 +257,20 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
         # End-state shared-dispatch fraction (process lifetime, not deltas —
         # the same gauge GET /health reports).
         "serving_batched_fraction": after.get("pa_serving_batched_fraction"),
+        # Numerics sentinel deltas over this run (utils/numerics.py): lanes
+        # quarantined by the non-finite watchdog and raw non-finite
+        # observations. The counters only exist once an event fires, so an
+        # absent counter with the sentinel ENABLED means a clean run (0) and
+        # with the sentinel disabled means unwatched (None) — the gauge the
+        # server publishes at scrape time disambiguates the two.
+        "numerics_quarantined": (
+            after.get("pa_numerics_quarantined_total", 0.0)
+            - before.get("pa_numerics_quarantined_total", 0.0)
+        ) if after.get("pa_numerics_sentinel_enabled") else None,
+        "numerics_nonfinite": (
+            after.get("pa_numerics_nonfinite_total", 0.0)
+            - before.get("pa_numerics_nonfinite_total", 0.0)
+        ) if after.get("pa_numerics_sentinel_enabled") else None,
         # Server-side quantiles from the /metrics histograms (end-state
         # values — histograms are cumulative): what the SERVER measured per
         # lockstep dispatch / lane admission, vs the client-clock latencies

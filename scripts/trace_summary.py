@@ -4,8 +4,9 @@ Reads a Chrome/Perfetto trace-event JSON (``bench.py --trace-out``, the
 server's ``GET /trace``, or a ``utils/tracing.py`` export written to disk)
 and prints where the time went: total/mean span time per layer (the ``cat``
 field: server / graph / sampling / serving / stream / bench), the busiest
-span names, and the trace-derived aggregates — stream overlap efficiency,
-lane-wait p95, host gap.
+span names, the trace-derived aggregates — stream overlap efficiency,
+lane-wait p95, host gap — and the numerics sentinel's counters (non-finite
+events by site, quarantines) recorded as instant ``numerics``-cat spans.
 
 Stdlib-only by contract (it must run on a laptop holding just the trace
 file, no jax): the aggregate math re-implements
@@ -75,6 +76,22 @@ def host_gap_ms(events: list[dict]) -> float | None:
     return sum(gaps) / len(gaps) if gaps else None
 
 
+def numerics_counts(events: list[dict]) -> dict:
+    """Numerics sentinel spans (utils/numerics.py records an instant span
+    per non-finite observation / quarantine when tracing is on) — so a
+    captured trace carries its own numeric-health verdict offline."""
+    nonfinite = [e for e in events if e["name"] == "nonfinite-event"]
+    quarantines = [e for e in events if e["name"] == "quarantine"]
+    by_where: dict[str, int] = defaultdict(int)
+    for e in nonfinite:
+        by_where[str(e.get("args", {}).get("where", "?"))] += 1
+    return {
+        "nonfinite_events": len(nonfinite),
+        "quarantines": len(quarantines),
+        "nonfinite_by_where": dict(sorted(by_where.items())),
+    }
+
+
 def summarize(events: list[dict]) -> dict:
     by_cat: dict[str, list[float]] = defaultdict(list)
     by_name: dict[str, list[float]] = defaultdict(list)
@@ -85,6 +102,7 @@ def summarize(events: list[dict]) -> dict:
     p95 = lane_wait_p95_s(events)
     gap = host_gap_ms(events)
     return {
+        "numerics": numerics_counts(events),
         "spans": len(events),
         "layers": {
             cat: {
@@ -144,6 +162,11 @@ def main() -> None:
     print(f"stream_overlap_efficiency: {s['stream_overlap_efficiency']}")
     print(f"lane_wait_p95: {s['lane_wait_p95']}")
     print(f"host_gap_ms: {s['host_gap_ms']}")
+    n = s["numerics"]
+    print(f"numerics: {n['nonfinite_events']} non-finite event(s), "
+          f"{n['quarantines']} quarantine(s)"
+          + (f" — by site {n['nonfinite_by_where']}"
+             if n["nonfinite_by_where"] else ""))
 
 
 if __name__ == "__main__":

@@ -540,6 +540,9 @@ _TIME_TIME_ALLOWLIST = (
     # durations in the package use time.monotonic()/perf_counter().
     ("utils/telemetry.py", 'setdefault("ts"'),
     ("utils/telemetry.py", '"ts": time.time()'),
+    # Numerics sentinel event/quarantine records (round 11): epoch stamps on
+    # forensic records, same pattern as the telemetry ledger stamps.
+    ("utils/numerics.py", '"ts": time.time()'),
 )
 
 
