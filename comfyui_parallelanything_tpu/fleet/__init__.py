@@ -1,0 +1,25 @@
+"""Fleet tier: multi-host front-door routing over N workflow servers.
+
+- fleet/registry.py   — membership: consistent-hash ring + heartbeats
+- fleet/scoreboard.py — per-host health polled from ``GET /health``
+- fleet/router.py     — the front-door process: warm-affinity placement,
+                        health-driven admission, lossless failover
+
+The router owns no model state; backends are plain ``server.py`` processes
+(``--fleet-router`` makes them register elastically). See README "Fleet
+serving".
+"""
+
+from .registry import FleetRegistry, HashRing, HeartbeatClient
+from .router import FleetRouter, make_router, model_key
+from .scoreboard import Scoreboard
+
+__all__ = [
+    "FleetRegistry",
+    "FleetRouter",
+    "HashRing",
+    "HeartbeatClient",
+    "Scoreboard",
+    "make_router",
+    "model_key",
+]

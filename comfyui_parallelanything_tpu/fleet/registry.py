@@ -1,0 +1,229 @@
+"""Fleet membership: the consistent-hash ring and the heartbeat registry.
+
+The reference is single-process — one host owns the prompt queue and every
+loaded model (any_device_parallel.py's module-level parallel-model cache).
+A fleet needs two things that queue never had:
+
+- **membership**: which backend hosts exist right now. Hosts join by POSTing
+  registration heartbeats to the router (``HeartbeatClient`` below is the
+  backend-side thread ``server.py --fleet-router`` starts); a host whose
+  heartbeats stop falls out after ``ttl_s`` (elastic leave — crash or
+  scale-down look identical). Statically configured hosts (the router's
+  ``--backends`` flag) never expire by heartbeat: their liveness is the
+  scoreboard's health polling (fleet/scoreboard.py).
+- **placement order**: a consistent-hash ring over the live hosts
+  (``vnodes`` virtual nodes per host smooth the key distribution). Keys are
+  MODEL identities, not prompt ids: every prompt for one model hashes to the
+  same primary host, so that host's compiled step programs and pinned
+  weights stay warm (the MPMD keep-programs-resident result, PAPERS.md
+  arxiv 2412.14374) — and ring membership changes only move the keys
+  adjacent to the joined/left host, not the whole map.
+
+Pure host-side bookkeeping: nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import threading
+import time
+import urllib.request
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+def stable_hash(key: str) -> int:
+    """Process-independent 64-bit hash (``hash()`` is salted per process —
+    a ring that moves on every restart would defeat warm affinity)."""
+    return int.from_bytes(
+        hashlib.md5(key.encode()).digest()[:8], "big", signed=False
+    )
+
+
+class HashRing:
+    """Consistent-hash ring: ``sequence(key)`` is the deterministic host
+    preference order for a key — the primary first, then each successive
+    distinct host clockwise (the spill/failover order)."""
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = int(vnodes)
+        self._ring: list[tuple[int, str]] = []  # sorted (point, host_id)
+
+    def rebuild(self, host_ids) -> None:
+        ring = []
+        for hid in host_ids:
+            for v in range(self.vnodes):
+                ring.append((stable_hash(f"{hid}#{v}"), hid))
+        ring.sort()
+        self._ring = ring
+
+    def sequence(self, key: str) -> list[str]:
+        """Distinct hosts in ring order starting at the key's point."""
+        if not self._ring:
+            return []
+        point = stable_hash(key)
+        points = [p for p, _ in self._ring]
+        # First vnode clockwise of the key's point (wrapping).
+        import bisect
+
+        start = bisect.bisect_left(points, point) % len(self._ring)
+        seen: list[str] = []
+        for i in range(len(self._ring)):
+            hid = self._ring[(start + i) % len(self._ring)][1]
+            if hid not in seen:
+                seen.append(hid)
+        return seen
+
+
+@dataclasses.dataclass
+class HostInfo:
+    host_id: str
+    base: str                     # http://host:port the router reaches it at
+    static: bool = False          # configured, not heartbeat-registered
+    last_beat: float = 0.0        # time.monotonic() of the last heartbeat
+    joined_monotonic: float = 0.0
+
+
+class FleetRegistry:
+    """Live membership + the ring built over it. Thread-safe: the router's
+    HTTP threads call ``heartbeat``/``remove`` while the monitor thread reads
+    ``hosts``/``sequence``."""
+
+    def __init__(self, ttl_s: float = 10.0, vnodes: int = 64):
+        self.ttl_s = float(ttl_s)
+        self._hosts: dict[str, HostInfo] = {}
+        self._ring = HashRing(vnodes=vnodes)
+        self._lock = threading.Lock()
+
+    def _rebuild(self) -> None:
+        self._ring.rebuild(sorted(self._hosts))
+
+    def add_static(self, host_id: str, base: str) -> None:
+        """Configured backend (router ``--backends``): in the ring until
+        explicitly removed — liveness is the scoreboard's problem."""
+        with self._lock:
+            self._hosts[host_id] = HostInfo(
+                host_id, base.rstrip("/"), static=True,
+                last_beat=time.monotonic(),
+                joined_monotonic=time.monotonic(),
+            )
+            self._rebuild()
+
+    def heartbeat(self, host_id: str, base: str) -> bool:
+        """One registration heartbeat. Returns True when this JOINED a new
+        host (ring changed), False for a refresh."""
+        now = time.monotonic()
+        with self._lock:
+            info = self._hosts.get(host_id)
+            if info is None:
+                self._hosts[host_id] = HostInfo(
+                    host_id, base.rstrip("/"), last_beat=now,
+                    joined_monotonic=now,
+                )
+                self._rebuild()
+                log.info("fleet host joined: %s (%s)", host_id, base)
+                return True
+            info.last_beat = now
+            info.base = base.rstrip("/")
+            return False
+
+    def remove(self, host_id: str) -> bool:
+        with self._lock:
+            if self._hosts.pop(host_id, None) is None:
+                return False
+            self._rebuild()
+        log.info("fleet host left: %s", host_id)
+        return True
+
+    def expire(self) -> list[str]:
+        """Drop heartbeat-registered hosts whose beats stopped; returns the
+        expired host ids (the router fails their in-flight prompts over)."""
+        now = time.monotonic()
+        dropped = []
+        with self._lock:
+            for hid, info in list(self._hosts.items()):
+                if not info.static and now - info.last_beat > self.ttl_s:
+                    del self._hosts[hid]
+                    dropped.append(hid)
+            if dropped:
+                self._rebuild()
+        for hid in dropped:
+            log.warning("fleet host expired (no heartbeat): %s", hid)
+        return dropped
+
+    def hosts(self) -> dict[str, HostInfo]:
+        with self._lock:
+            return dict(self._hosts)
+
+    def base_of(self, host_id: str) -> str | None:
+        with self._lock:
+            info = self._hosts.get(host_id)
+            return info.base if info else None
+
+    def sequence(self, key: str) -> list[str]:
+        """Host preference order for a model key (primary first)."""
+        with self._lock:
+            return self._ring.sequence(key)
+
+    def snapshot(self) -> list[dict]:
+        now = time.monotonic()
+        with self._lock:
+            return [
+                {
+                    "host_id": i.host_id, "base": i.base, "static": i.static,
+                    "heartbeat_age_s": round(now - i.last_beat, 3),
+                }
+                for i in self._hosts.values()
+            ]
+
+
+class HeartbeatClient:
+    """Backend-side registration heartbeats (``server.py --fleet-router``):
+    POST ``{host_id, base}`` to the router's ``/fleet/register`` every
+    ``interval_s`` so the host joins the ring elastically and falls out when
+    it dies. Best-effort by design: a down router must never take the
+    backend with it."""
+
+    def __init__(self, router_base: str, host_id: str, base: str,
+                 interval_s: float = 2.0):
+        self.router_base = router_base.rstrip("/")
+        self.host_id = host_id
+        self.base = base
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def beat_once(self, timeout: float = 5.0) -> bool:
+        req = urllib.request.Request(
+            self.router_base + "/fleet/register",
+            data=json.dumps(
+                {"host_id": self.host_id, "base": self.base}
+            ).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout):
+                return True
+        except OSError:
+            return False
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.beat_once()
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "HeartbeatClient":
+        self._thread = threading.Thread(
+            target=self._loop, name="pa-fleet-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

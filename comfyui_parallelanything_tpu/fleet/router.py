@@ -1,0 +1,809 @@
+"""Fleet front door: route prompts across N backend workflow servers.
+
+The reference (and this repo's server.py) is one process: one prompt queue,
+one set of loaded models, throughput capped at one host and every in-flight
+prompt lost with it on a crash. This router is the fleet tier above that —
+a thin, stdlib-only HTTP process that owns NO model state, only placement,
+admission, and failover bookkeeping:
+
+- **placement is warm-affinity**: consistent hash on the MODEL identity of
+  the prompt graph (fleet/registry.py ring), so every prompt for one model
+  lands on the same primary host and that host's compiled step programs and
+  pinned weights stay resident (the keep-programs-warm economics of
+  PAPERS.md arxiv 2412.14374 — re-staging a model on a cold host costs
+  seconds-to-minutes of compile + weight placement). When the primary is
+  saturated the prompt SPILLS to the next host clockwise on the ring —
+  bounded queueing beats unbounded affinity.
+- **admission is health-driven**: every decision reads the per-host
+  scoreboard (fleet/scoreboard.py) polled from the backends' existing
+  ``GET /health`` documents — queue depth, drain state, HBM watermark,
+  numerics verdict — with staleness-aware backoff; no healthy host means an
+  explicit 503, never a silently growing queue.
+- **failover is lossless**: the router keeps each prompt's submission
+  (graph + extra_data) until its history entry is fetched; when a host dies
+  mid-denoise (heartbeat expiry, health-poll failures, refused proxies) its
+  in-flight prompts are re-submitted to the next ring host. The replay is
+  from step 0 on the sibling, and the round-10 RNG contract (every
+  stochastic step key is ``fold_in(request rng, step)`` — output is a pure
+  function of (request, step), never of occupancy or history) makes the
+  re-run's final latent bitwise-equal to an uninterrupted run, which
+  ``__graft_entry__`` §16 asserts by killing a backend mid-run.
+
+Client protocol is the same ComfyUI subset server.py speaks — ``POST
+/prompt`` returns a router-scoped ``prompt_id`` that stays stable across
+failovers, and ``GET /history/{id}`` serves the completed entry (annotated
+with ``status.fleet``: serving host, attempts, failovers) once the monitor
+has collected it from whichever backend finished the work.
+
+Run:  ``python -m comfyui_parallelanything_tpu.fleet.router \
+          --backends http://h1:8188,http://h2:8188 [--port 8187]``
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+from ..utils import tracing
+from ..utils.logging import get_logger
+from ..utils.metrics import registry
+from .registry import FleetRegistry, stable_hash
+from .scoreboard import Scoreboard
+
+log = get_logger()
+
+FLEET_HEALTH_SCHEMA = "pa-fleet-health/v1"
+
+
+class NoHealthyHost(RuntimeError):
+    """No backend can take the prompt right now — surfaced as HTTP 503."""
+
+
+class FleetSaturated(RuntimeError):
+    """Every healthy backend refused with backpressure — HTTP 429."""
+
+
+class BackendRejected(RuntimeError):
+    """A backend refused the prompt with a non-retryable client error (400
+    bad graph, …): the fault is the REQUEST, not the host — passed through
+    to the client verbatim, never retried on siblings, never counted toward
+    the CI-gated lost budget."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = int(code)
+
+
+def model_key(graph: dict) -> str:
+    """The placement key: the MODEL identity of a prompt graph, not the
+    prompt itself. Loader-class nodes (``class_type`` containing "Loader")
+    name the artifacts a host must have resident — their inputs (checkpoint
+    path, clip pairing, …) are the key; seeds/steps/samplers deliberately
+    are NOT, so every prompt against one model hashes to the same primary
+    host. Graphs with no loader nodes fall back to the sorted class_type
+    multiset (structure, not volatile inputs)."""
+    loaders = []
+    for nid in sorted(graph):
+        spec = graph[nid] if isinstance(graph[nid], dict) else {}
+        ct = str(spec.get("class_type", ""))
+        if "Loader" in ct:
+            loaders.append((ct, json.dumps(
+                spec.get("inputs", {}), sort_keys=True, default=str
+            )))
+    if not loaders:
+        loaders = sorted(
+            str((graph[n] or {}).get("class_type", ""))
+            for n in graph if isinstance(graph[n], dict)
+        )
+    return f"{stable_hash(json.dumps(loaders)):016x}"
+
+
+@dataclasses.dataclass
+class FleetPrompt:
+    """One client prompt's fleet lifecycle: the submission is retained until
+    the entry is collected, so the prompt survives its host."""
+
+    pid: str                    # router-scoped id, stable across failovers
+    graph: dict
+    extra: dict | None
+    key: str                    # model placement key
+    number: int = 0
+    host_id: str | None = None
+    backend_pid: str | None = None
+    attempts: int = 0           # dispatch tries (successful or not)
+    failovers: int = 0          # times moved off a dead/unhealthy host
+    # submitting → inflight → done (or → lost); failover resets to queued.
+    # "submitting" (the initial state) is OWNED by the submit() call —
+    # the monitor's queued-retry sweep must not see a half-submitted
+    # prompt as retryable, or it double-dispatches it.
+    status: str = "submitting"
+    entry: dict | None = None
+    submit_monotonic: float = dataclasses.field(default_factory=time.monotonic)
+    trace_submit_us: float | None = None
+
+
+class FleetRouter:
+    """Placement + admission + failover over a registry and a scoreboard.
+
+    ``auto=True`` runs the monitor thread (health polls, heartbeat expiry,
+    history collection, dead-host failover); ``auto=False`` exposes the same
+    sweep as :meth:`poll_once` for deterministic tests."""
+
+    def __init__(self, fleet_registry: FleetRegistry | None = None,
+                 scoreboard: Scoreboard | None = None, *,
+                 saturation_depth: int = 4, max_attempts: int = 4,
+                 monitor_s: float = 0.2, hbm_watermark: float = 0.95,
+                 http_timeout_s: float = 30.0, max_history: int = 4096,
+                 auto: bool = True):
+        self.registry = fleet_registry or FleetRegistry()
+        self.scoreboard = scoreboard or Scoreboard()
+        self.saturation_depth = int(saturation_depth)
+        self.max_attempts = int(max_attempts)
+        self.monitor_s = float(monitor_s)
+        self.hbm_watermark = hbm_watermark
+        self.http_timeout_s = float(http_timeout_s)
+        # Resolved prompts beyond this budget are evicted oldest-first (the
+        # graph + entry of every prompt ever served must not accumulate for
+        # the router's lifetime); in-flight prompts are never evicted.
+        self.max_history = int(max_history)
+        self.router_id = f"router-{uuid.uuid4().hex[:8]}"
+        self.prompts: dict[str, FleetPrompt] = {}
+        self._inflight: dict[str, int] = {}   # host_id → router-side count
+        # monotonic stamp of the last router-side inflight DECREASE per
+        # host: a health poll older than this carries a provably stale-high
+        # inflight count (see Scoreboard.saturated include_polled).
+        self._last_drop: dict[str, float] = {}
+        self._counter = 0
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if auto:
+            self._thread = threading.Thread(
+                target=self._loop, name="pa-fleet-monitor", daemon=True
+            )
+            self._thread.start()
+
+    # -- backend HTTP -------------------------------------------------------
+
+    def _post(self, base: str, path: str, payload: dict,
+              timeout: float | None = None) -> dict:
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(
+            req, timeout=timeout or self.http_timeout_s
+        ) as r:
+            return json.loads(r.read())
+
+    def _get(self, base: str, path: str, timeout: float | None = None):
+        with urllib.request.urlopen(
+            base + path, timeout=timeout or self.http_timeout_s
+        ) as r:
+            return json.loads(r.read())
+
+    # -- placement ----------------------------------------------------------
+
+    def _router_inflight(self, host_id: str) -> int:
+        with self._lock:
+            return self._inflight.get(host_id, 0)
+
+    def _release(self, host_id: str) -> None:
+        with self._lock:
+            self._inflight[host_id] = max(
+                0, self._inflight.get(host_id, 0) - 1
+            )
+            self._last_drop[host_id] = time.monotonic()
+
+    def _polled_fresh(self, host_id: str) -> bool:
+        """Is the scoreboard's last poll newer than this router's own last
+        completion/rollback for the host? If not, its inflight count is
+        stale-high and must not gate admission."""
+        polled = self.scoreboard.last_ok(host_id)
+        return (polled is not None
+                and polled >= self._last_drop.get(host_id, 0.0))
+
+    def place(self, key: str, exclude=()) -> tuple[str, str, bool]:
+        """(host_id, base, spilled) for a model key: the first accepting
+        host in ring order that is not saturated; if every accepting host is
+        saturated, the least-loaded one (bounded queueing beats a 503 while
+        capacity exists). Raises NoHealthyHost when nothing is accepting."""
+        seq = self.registry.sequence(key)
+        candidates = [
+            h for h in seq
+            if h not in exclude and self.scoreboard.accepting(h)
+        ]
+        if not candidates:
+            raise NoHealthyHost(
+                f"no accepting backend for key {key} "
+                f"(ring: {len(seq)} hosts, excluded: {sorted(exclude)})"
+            )
+        primary = seq[0]
+        for h in candidates:
+            if not self.scoreboard.saturated(
+                h, extra_inflight=self._router_inflight(h),
+                depth=self.saturation_depth,
+                hbm_watermark=self.hbm_watermark,
+                include_polled=self._polled_fresh(h),
+            ):
+                return h, self.registry.base_of(h), h != primary
+        best = min(
+            candidates,
+            key=lambda h: self._router_inflight(h),
+        )
+        return best, self.registry.base_of(best), best != primary
+
+    # -- submission / dispatch ---------------------------------------------
+
+    def submit(self, graph: dict, extra: dict | None = None) -> tuple[str, int]:
+        """Admit one prompt into the fleet; returns (router prompt_id,
+        submission number). Raises NoHealthyHost / FleetSaturated when no
+        backend can take it (explicit backpressure, the 503/429 surface)."""
+        pid = uuid.uuid4().hex
+        with self._lock:
+            self._counter += 1
+            number = self._counter
+        fp = FleetPrompt(
+            pid=pid, graph=graph, extra=extra, key=model_key(graph),
+            number=number,
+            trace_submit_us=tracing.now_us() if tracing.on() else None,
+        )
+        with self._lock:
+            self.prompts[pid] = fp
+        try:
+            self._dispatch(fp)
+        except (NoHealthyHost, FleetSaturated, BackendRejected):
+            with self._lock:
+                self.prompts.pop(pid, None)
+            raise
+        return pid, number
+
+    def _prune_history(self) -> None:
+        """Evict the oldest RESOLVED prompts beyond the history budget
+        (caller holds the lock; dicts iterate in insertion = submit order)."""
+        excess = len(self.prompts) - self.max_history
+        if excess <= 0:
+            return
+        for pid in [p for p, fp in self.prompts.items()
+                    if fp.status in ("done", "lost")][:excess]:
+            del self.prompts[pid]
+
+    def _dispatch(self, fp: FleetPrompt, exclude: set | None = None) -> None:
+        """Place and forward one prompt, walking the ring past refusing or
+        unreachable hosts. On success the prompt is ``inflight``; exhausting
+        every candidate raises (submit path) — failover callers catch and
+        leave the prompt ``queued`` for the next monitor sweep."""
+        exclude = set(exclude or ())
+        saw_backpressure = False
+        while True:
+            if fp.attempts >= self.max_attempts:
+                self._mark_lost(fp)
+                return
+            # Place AND reserve under one lock hold: two simultaneous
+            # submits must not both read a host as free and stack onto it
+            # while a sibling sits idle (the reservation is rolled back if
+            # the POST fails).
+            with self._lock:
+                try:
+                    host, base, spilled = self.place(fp.key, exclude=exclude)
+                except NoHealthyHost:
+                    if saw_backpressure:
+                        # Everything healthy refused with 429/503: the fleet
+                        # is saturated, not dead — the client should back off.
+                        raise FleetSaturated(
+                            "every healthy backend refused with backpressure"
+                        ) from None
+                    raise
+                if base is not None:
+                    self._inflight[host] = self._inflight.get(host, 0) + 1
+            if base is None:
+                exclude.add(host)
+                continue
+            fp.attempts += 1
+            extra = dict(fp.extra or {})
+            # The cross-hop correlation: the backend stamps this origin id
+            # onto its own prompt span, so one Perfetto export holds the
+            # router-side fleet-prompt span AND the backend-side prompt
+            # timeline joined by origin_prompt_id.
+            extra["fleet"] = {"origin": fp.pid, "router": self.router_id}
+            t0_us = tracing.now_us() if tracing.on() else 0.0
+            try:
+                resp = self._post(
+                    base, "/prompt",
+                    {"prompt": fp.graph, "extra_data": extra},
+                )
+            except urllib.error.HTTPError as e:
+                self._release(host)
+                if e.code not in (429, 503):
+                    # Non-retryable client error (400 bad graph, …): the
+                    # REQUEST is at fault, not the host — retrying it on
+                    # siblings would burn the retry budget into the
+                    # CI-gated lost counter for a client mistake.
+                    try:
+                        detail = json.loads(e.read() or b"{}").get("error")
+                    except Exception:  # noqa: BLE001 — body is best-effort
+                        detail = None
+                    raise BackendRejected(
+                        e.code, detail or f"backend refused: HTTP {e.code}"
+                    ) from e
+                # Alive but refusing with backpressure (429 bounded queue,
+                # 503 draining): not a health failure — exclude, walk on.
+                saw_backpressure = True
+                exclude.add(host)
+                continue
+            except OSError as e:
+                self.scoreboard.record_failure(host, base, f"dispatch: {e}")
+                self._release(host)
+                exclude.add(host)
+                continue
+            with self._lock:
+                fp.host_id = host
+                fp.backend_pid = resp.get("prompt_id")
+                fp.status = "inflight"
+            registry.counter("pa_fleet_dispatch_total",
+                             labels={"host": host},
+                             help="prompts forwarded per backend")
+            if spilled:
+                registry.counter(
+                    "pa_fleet_spill_total", labels={"host": host},
+                    help="prompts placed off their warm-affinity primary",
+                )
+            if tracing.on():
+                tracing.record(
+                    "fleet-hop", t0_us, tracing.now_us() - t0_us,
+                    cat="fleet", prompt_id=fp.pid, host=host,
+                    backend_pid=fp.backend_pid, attempt=fp.attempts,
+                    spilled=spilled,
+                )
+            return
+
+    def _mark_lost(self, fp: FleetPrompt) -> None:
+        """Retry budget exhausted — the only way the fleet ever gives up on
+        a prompt, and the counter CI gates on staying zero."""
+        with self._lock:
+            fp.status = "lost"
+            fp.entry = {
+                "status": {
+                    "status_str": "error", "completed": False,
+                    "message": (
+                        f"lost after {fp.attempts} dispatch attempts "
+                        f"({fp.failovers} failovers)"
+                    ),
+                    "fleet": {"host_id": fp.host_id,
+                              "attempts": fp.attempts,
+                              "failovers": fp.failovers, "lost": True},
+                },
+                "outputs": {},
+            }
+        registry.counter("pa_fleet_prompts_lost_total",
+                         help="prompts abandoned after the retry budget — "
+                              "zero on a healthy fleet (CI-gated)")
+        log.error("fleet prompt %s LOST after %d attempts",
+                  fp.pid, fp.attempts)
+
+    # -- completion / failover ---------------------------------------------
+
+    def _complete(self, fp: FleetPrompt, entry: dict) -> None:
+        with self._lock:
+            if fp.status != "inflight":
+                return
+            fp.status = "done"
+            entry = dict(entry)
+            status = dict(entry.get("status") or {})
+            status["fleet"] = {
+                "host_id": fp.host_id, "attempts": fp.attempts,
+                "failovers": fp.failovers,
+            }
+            entry["status"] = status
+            fp.entry = entry
+            if fp.host_id:
+                self._inflight[fp.host_id] = max(
+                    0, self._inflight.get(fp.host_id, 0) - 1
+                )  # inline (holds the lock) — not _release
+                self._last_drop[fp.host_id] = time.monotonic()
+        registry.counter("pa_fleet_completed_total",
+                         help="prompts whose history entry was collected")
+        if tracing.on() and fp.trace_submit_us is not None:
+            tracing.record(
+                "fleet-prompt", fp.trace_submit_us,
+                tracing.now_us() - fp.trace_submit_us, cat="fleet",
+                prompt_id=fp.pid, host=fp.host_id, attempts=fp.attempts,
+                failovers=fp.failovers,
+                outcome=(entry.get("status") or {}).get("status_str"),
+            )
+
+    def failover_host(self, host_id: str, reason: str) -> int:
+        """Move every in-flight prompt off a dead/unhealthy host: re-submit
+        each to the next ring sibling. The replay runs from step 0 there;
+        the fold_in RNG discipline makes its output bitwise-equal to an
+        uninterrupted run, so the client sees only latency, never a
+        different image. Returns how many prompts were moved."""
+        with self._lock:
+            victims = [
+                fp for fp in self.prompts.values()
+                if fp.status == "inflight" and fp.host_id == host_id
+            ]
+            for fp in victims:
+                # Claimed by THIS caller ("submitting") — the monitor's
+                # queued-retry sweep must not concurrently dispatch a prompt
+                # another thread is already re-dispatching. It becomes
+                # "queued" (retryable) only if this dispatch finds no home.
+                fp.status = "submitting"
+                fp.failovers += 1
+                fp.host_id = None
+                fp.backend_pid = None
+            self._inflight[host_id] = 0
+            self._last_drop[host_id] = time.monotonic()
+        if not victims:
+            return 0
+        registry.counter("pa_fleet_failover_total", inc=float(len(victims)),
+                         labels={"host": host_id},
+                         help="in-flight prompts moved off a failed host")
+        log.warning("fleet failover: %d prompt(s) off %s (%s)",
+                    len(victims), host_id, reason)
+        for fp in victims:
+            self._dispatch_or_queue(fp, exclude={host_id})
+        return len(victims)
+
+    def _dispatch_or_queue(self, fp: FleetPrompt, exclude=None) -> None:
+        """Re-dispatch a claimed prompt; park it ``queued`` (monitor retries)
+        when no backend can take it now, and resolve it as an error entry on
+        a non-retryable backend rejection (no client thread is waiting on a
+        failover path, so the rejection lands in its history entry)."""
+        try:
+            self._dispatch(fp, exclude=exclude)
+        except (NoHealthyHost, FleetSaturated):
+            with self._lock:
+                if fp.status == "submitting":
+                    fp.status = "queued"
+        except BackendRejected as e:
+            with self._lock:
+                fp.status = "done"
+                fp.entry = {
+                    "status": {
+                        "status_str": "error", "completed": False,
+                        "message": str(e),
+                        "fleet": {"host_id": fp.host_id,
+                                  "attempts": fp.attempts,
+                                  "failovers": fp.failovers},
+                    },
+                    "outputs": {},
+                }
+
+    # -- the monitor sweep --------------------------------------------------
+
+    def poll_once(self) -> None:
+        """One monitor sweep: expire silent hosts, poll due health, fail
+        over the dead, collect finished histories, retry queued prompts."""
+        for hid in self.registry.expire():
+            self.failover_host(hid, "heartbeat expired")
+        hosts = {hid: info.base for hid, info in self.registry.hosts().items()}
+        self.scoreboard.poll_due(hosts)
+        for hid in hosts:
+            if self.scoreboard.dead(hid):
+                self.failover_host(hid, "health polls failing")
+        self._collect_histories()
+        with self._lock:
+            queued = [fp for fp in self.prompts.values()
+                      if fp.status == "queued"]
+            for fp in queued:
+                fp.status = "submitting"  # claimed by this sweep
+            self._prune_history()
+        for fp in queued:
+            self._dispatch_or_queue(fp)
+
+    def _collect_one(self, fp: FleetPrompt,
+                     timeout: float | None = None) -> None:
+        """Try to fetch one in-flight prompt's entry from its owner. Called
+        from the monitor sweep AND inline from ``GET /history/{pid}`` — a
+        client polling the router must see completion at its own poll
+        cadence, not the monitor's (whose sweep also pays for health polls).
+        Concurrent collectors are safe: ``_complete`` no-ops unless the
+        prompt is still inflight."""
+        if fp.status != "inflight" or fp.backend_pid is None:
+            return
+        base = self.registry.base_of(fp.host_id or "")
+        if base is None:
+            return
+        try:
+            hist = self._get(base, f"/history/{fp.backend_pid}",
+                             timeout=timeout or self.http_timeout_s)
+        except urllib.error.HTTPError:
+            return
+        except OSError as e:
+            self.scoreboard.record_failure(fp.host_id, base, f"history: {e}")
+            return
+        entry = hist.get(fp.backend_pid)
+        if entry:
+            self._complete(fp, entry)
+
+    def _collect_histories(self) -> None:
+        with self._lock:
+            inflight = [fp for fp in self.prompts.values()
+                        if fp.status == "inflight"]
+        for fp in inflight:
+            # Short per-collection timeout, and skip hosts already in
+            # failure backoff: the monitor owns heartbeat expiry and
+            # dead-host failover — one half-dead backend blocking a 30s
+            # socket read per inflight prompt would stall the whole sweep
+            # for minutes. (Clients' inline collects keep their own, longer
+            # timeout.)
+            if self.scoreboard.in_backoff(fp.host_id or ""):
+                continue
+            self._collect_one(fp, timeout=min(5.0, self.http_timeout_s))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — the monitor must survive
+                pass
+            self._stop.wait(self.monitor_s)
+
+    # -- operations ---------------------------------------------------------
+
+    def drain(self, host_id: str) -> dict:
+        """Ask one backend to drain (stop seating, finish lanes) and stop
+        placing there immediately — the host leaves the ring when its
+        heartbeats stop (or via /fleet/leave)."""
+        base = self.registry.base_of(host_id)
+        if base is None:
+            raise KeyError(f"unknown host {host_id!r}")
+        self.scoreboard.mark_draining(host_id)
+        return self._post(base, "/drain", {})
+
+    def leave(self, host_id: str) -> bool:
+        """Explicit ring departure; in-flight prompts fail over."""
+        removed = self.registry.remove(host_id)
+        if removed:
+            self.failover_host(host_id, "left the ring")
+        return removed
+
+    def interrupt(self) -> int:
+        """Broadcast POST /interrupt to every live backend (best-effort) and
+        drop queued prompts."""
+        dropped = 0
+        with self._lock:
+            for fp in self.prompts.values():
+                if fp.status == "queued":
+                    # Operator cancel, not a loss: "done" with an
+                    # interrupted entry, so the CI-gated lost count stays an
+                    # involuntary-failure signal.
+                    fp.status = "done"
+                    fp.entry = {
+                        "status": {"status_str": "interrupted",
+                                   "completed": False},
+                        "outputs": {},
+                    }
+                    dropped += 1
+        for hid, info in self.registry.hosts().items():
+            try:
+                resp = self._post(info.base, "/interrupt", {}, timeout=10)
+                dropped += int(resp.get("dropped", 0))
+            except (OSError, urllib.error.HTTPError):
+                pass
+        return dropped
+
+    def history(self, pid: str | None = None) -> dict:
+        with self._lock:
+            if pid is None:
+                return {p: fp.entry for p, fp in self.prompts.items()
+                        if fp.entry is not None}
+            fp = self.prompts.get(pid)
+        if fp is None:
+            return {}
+        if fp.entry is None:
+            self._collect_one(fp)  # poll-path completion (see _collect_one)
+        with self._lock:
+            return {pid: fp.entry} if fp.entry is not None else {}
+
+    def stats(self) -> dict:
+        with self._lock:
+            by_status: dict[str, int] = {}
+            for fp in self.prompts.values():
+                by_status[fp.status] = by_status.get(fp.status, 0) + 1
+            inflight = dict(self._inflight)
+        return {"prompts": by_status, "router_inflight": inflight,
+                "lost": by_status.get("lost", 0)}
+
+    def publish_gauges(self) -> None:
+        self.scoreboard.publish_gauges()
+        stats = self.stats()
+        registry.gauge("pa_fleet_inflight",
+                       stats["prompts"].get("inflight", 0),
+                       help="prompts dispatched, entry not yet collected")
+        registry.gauge("pa_fleet_queued",
+                       stats["prompts"].get("queued", 0),
+                       help="prompts awaiting a healthy backend")
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    router: FleetRouter  # injected by make_router
+    protocol_version = "HTTP/1.1"
+    # Header write + body write per response: without TCP_NODELAY the body
+    # can stall behind a delayed ACK (see server.py's handler) — the front
+    # door sits on every prompt's path, so it must not add Nagle stalls.
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _send(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        r = self.router
+        if parts and parts[0] == "history":
+            return self._send(
+                200, r.history(parts[1] if len(parts) == 2 else None)
+            )
+        if url.path == "/health":
+            doc = {
+                "schema": FLEET_HEALTH_SCHEMA,
+                "router_id": r.router_id,
+                "hosts": r.scoreboard.snapshot(),
+                "ring": r.registry.snapshot(),
+                **r.stats(),
+            }
+            if tracing.on():
+                doc["fleet_hop_p95_ms"] = tracing.fleet_hop_p95_ms(
+                    tracing.export()
+                )
+            return self._send(200, doc)
+        if url.path == "/metrics":
+            r.publish_gauges()
+            body = registry.render().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            return self.wfile.write(body)
+        if url.path == "/fleet/hosts":
+            return self._send(200, {
+                "ring": r.registry.snapshot(),
+                "scoreboard": r.scoreboard.snapshot(),
+            })
+        return self._send(404, {"error": f"no route {url.path}"})
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        url = urlparse(self.path)
+        r = self.router
+        try:
+            payload = self._body()
+        except (ValueError, json.JSONDecodeError) as e:
+            return self._send(400, {"error": f"bad JSON: {e}"})
+        if url.path == "/prompt":
+            graph = payload.get("prompt")
+            if not isinstance(graph, dict) or not graph:
+                return self._send(
+                    400,
+                    {"error": 'body must carry a non-empty {"prompt": {...}}'}
+                )
+            try:
+                pid, number = r.submit(graph, payload.get("extra_data"))
+            except FleetSaturated as e:
+                return self._send(429, {"error": str(e)})
+            except NoHealthyHost as e:
+                return self._send(503, {"error": str(e)})
+            except BackendRejected as e:
+                # The backend's own client-error verdict, passed through.
+                return self._send(e.code, {"error": str(e)})
+            return self._send(200, {"prompt_id": pid, "number": number})
+        if url.path == "/fleet/register":
+            host_id = payload.get("host_id")
+            base = payload.get("base")
+            if not host_id or not base:
+                return self._send(400, {"error": "host_id and base required"})
+            joined = r.registry.heartbeat(str(host_id), str(base))
+            if joined:
+                # Poll immediately so the joiner is placeable without
+                # waiting out a scoreboard interval.
+                r.scoreboard.poll_host(str(host_id), str(base).rstrip("/"))
+            return self._send(200, {"joined": joined})
+        if url.path == "/fleet/leave":
+            host_id = str(payload.get("host_id") or "")
+            return self._send(200, {"removed": r.leave(host_id)})
+        if url.path == "/fleet/drain":
+            host_id = str(payload.get("host_id") or "")
+            try:
+                resp = r.drain(host_id)
+            except KeyError as e:
+                return self._send(404, {"error": str(e)})
+            except (OSError, urllib.error.HTTPError) as e:
+                return self._send(502, {"error": f"drain proxy failed: {e}"})
+            return self._send(200, resp)
+        if url.path == "/interrupt":
+            return self._send(200, {"dropped": r.interrupt()})
+        return self._send(404, {"error": f"no route {url.path}"})
+
+
+def make_router(
+    host: str = "127.0.0.1", port: int = 8187,
+    backends=None, **router_kwargs,
+) -> tuple[ThreadingHTTPServer, FleetRouter]:
+    """Build (but don't start) the router HTTP server. ``backends`` seeds
+    static ring members: ``(host_id, base)`` tuples or bare base URLs (the
+    host_id then derives from the URL). Port 0 picks an ephemeral port."""
+    router = FleetRouter(**router_kwargs)
+    for b in backends or ():
+        if isinstance(b, (tuple, list)):
+            hid, base = b
+        else:
+            base = str(b)
+            hid = urlparse(base).netloc or base
+        router.registry.add_static(str(hid), str(base))
+    handler = type("Handler", (_RouterHandler,), {"router": router})
+
+    class _RouterHTTPServer(ThreadingHTTPServer):
+        # Default listen backlog (5) drops client poll bursts; the front
+        # door must absorb every client's history polling.
+        request_queue_size = 128
+
+    srv = _RouterHTTPServer((host, port), handler)
+    return srv, router
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8187)
+    ap.add_argument("--backends", default="",
+                    help="comma list of backend base URLs (static ring "
+                         "seeds; elastic hosts join via /fleet/register)")
+    ap.add_argument("--depth", type=int, default=4,
+                    help="per-host admission depth before spilling")
+    ap.add_argument("--poll-s", type=float, default=1.0,
+                    help="health-poll interval per host")
+    ap.add_argument("--ttl-s", type=float, default=10.0,
+                    help="heartbeat TTL before an elastic host expires")
+    ap.add_argument("--max-attempts", type=int, default=4)
+    ap.add_argument("--trace", action="store_true",
+                    help="enable span tracing (fleet-prompt / fleet-hop)")
+    args = ap.parse_args()
+    if args.trace:
+        tracing.enable()
+    srv, router = make_router(
+        args.host, args.port,
+        backends=[b for b in args.backends.split(",") if b],
+        fleet_registry=FleetRegistry(ttl_s=args.ttl_s),
+        scoreboard=Scoreboard(poll_s=args.poll_s),
+        saturation_depth=args.depth, max_attempts=args.max_attempts,
+    )
+    print(f"ParallelAnything fleet router on http://{args.host}:{args.port}")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.shutdown()
+
+
+if __name__ == "__main__":
+    main()

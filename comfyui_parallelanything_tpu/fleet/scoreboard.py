@@ -1,0 +1,304 @@
+"""Per-host health scoreboard: admission state polled from ``GET /health``.
+
+The router never guesses about a backend — every placement decision reads
+this scoreboard, which in turn reads only the backends' existing health
+surface (``pa-health/v2``, utils/telemetry.health_snapshot + the queue/host
+fields server.py adds): queue depth, in-flight prompts, the drain flag, the
+HBM watermark/utilization, compile-cache accounting, and the numerics-gate
+verdict. No side channel, no extra endpoint — if the health document can't
+see a problem, neither can an operator, and fixing THAT is the job.
+
+Staleness-aware backoff: a host that fails a poll is retried on an
+exponential backoff (so a dead host costs one socket timeout per backoff
+interval, not per scheduling decision), and an entry whose last successful
+poll is older than ``stale_after_s`` stops counting as healthy even if the
+last document looked fine — admission decisions are only as good as their
+data's age. ``fail_after`` consecutive failures mark the host DEAD, which is
+the router's failover trigger.
+
+Pure stdlib; nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+
+from ..utils.logging import get_logger
+from ..utils.metrics import registry
+
+log = get_logger()
+
+
+@dataclasses.dataclass
+class HostHealth:
+    """Last known health of one backend, plus the poll bookkeeping."""
+
+    host_id: str
+    base: str
+    # -- from the health document (pa-health/v2) --
+    accepting: bool = True
+    inflight_prompts: int = 0
+    queue_pending: int = 0
+    queue_running: int = 0
+    workers: int = 1
+    hbm_utilization_max: float | None = None
+    peak_hbm_bytes: int | None = None
+    compile_cache: dict | None = None      # {compiles, cache_hits, cache_misses}
+    numerics_ok: bool = True
+    quarantined_lanes: int = 0             # surfaced, not an admission signal
+    schema: str | None = None
+    serving_batched_fraction: float | None = None
+    # -- poll bookkeeping (time.monotonic clocks) --
+    last_ok: float | None = None
+    consecutive_failures: int = 0
+    next_poll: float = 0.0
+    last_error: str | None = None
+
+    def age_s(self, now: float | None = None) -> float | None:
+        if self.last_ok is None:
+            return None
+        return (time.monotonic() if now is None else now) - self.last_ok
+
+
+class Scoreboard:
+    """Polls backend health into per-host entries and answers the router's
+    three questions: is this host healthy, is it accepting, is it saturated.
+
+    Thread-safe; ``poll_due`` is driven by the router's monitor thread, and
+    ``record_failure`` lets the router's own proxy errors (a refused
+    ``POST /prompt``) feed the same failure counter as a failed poll — a
+    host that eats dispatches is as dead as one that fails health checks."""
+
+    def __init__(self, poll_s: float = 1.0, stale_after_s: float = 10.0,
+                 fail_after: int = 3, timeout_s: float = 5.0,
+                 backoff_cap_s: float = 30.0):
+        self.poll_s = float(poll_s)
+        self.stale_after_s = float(stale_after_s)
+        self.fail_after = int(fail_after)
+        self.timeout_s = float(timeout_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self._entries: dict[str, HostHealth] = {}
+        self._lock = threading.Lock()
+
+    # -- polling ------------------------------------------------------------
+
+    def _entry(self, host_id: str, base: str) -> HostHealth:
+        e = self._entries.get(host_id)
+        if e is None or e.base != base:
+            e = self._entries[host_id] = HostHealth(host_id, base)
+        return e
+
+    def poll_due(self, hosts: dict[str, str]) -> list[str]:
+        """Poll every host whose backoff window has elapsed; returns the
+        host ids polled. ``hosts`` is {host_id: base} (the registry's view);
+        entries for departed hosts are dropped."""
+        now = time.monotonic()
+        with self._lock:
+            for hid in list(self._entries):
+                if hid not in hosts:
+                    del self._entries[hid]
+            due = [
+                (hid, base) for hid, base in hosts.items()
+                if self._entry(hid, base).next_poll <= now
+            ]
+        for hid, base in due:
+            self.poll_host(hid, base)
+        return [hid for hid, _ in due]
+
+    def poll_host(self, host_id: str, base: str) -> bool:
+        """One ``GET /health`` poll; True on success. Never raises."""
+        try:
+            with urllib.request.urlopen(
+                base + "/health", timeout=self.timeout_s
+            ) as r:
+                doc = json.loads(r.read())
+        except (OSError, ValueError) as e:
+            self.record_failure(host_id, base, f"{type(e).__name__}: {e}")
+            return False
+        now = time.monotonic()
+        queue = doc.get("queue") or {}
+        numerics = doc.get("numerics") or {}
+        gate = numerics.get("fingerprint_gate") or {}
+        with self._lock:
+            e = self._entry(host_id, base)
+            e.schema = doc.get("schema")
+            e.accepting = bool(doc.get("accepting", True))
+            e.inflight_prompts = int(
+                doc.get("inflight_prompts",
+                        queue.get("pending", 0) + queue.get("running", 0))
+            )
+            e.queue_pending = int(queue.get("pending", 0))
+            e.queue_running = int(queue.get("running", 0))
+            e.workers = int(queue.get("workers", 1))
+            e.serving_batched_fraction = queue.get("serving_batched_fraction")
+            e.hbm_utilization_max = doc.get("hbm_utilization_max")
+            e.peak_hbm_bytes = doc.get("peak_hbm_bytes")
+            comp = doc.get("compile") or {}
+            e.compile_cache = {
+                k: comp.get(k)
+                for k in ("compiles", "cache_hits", "cache_misses")
+            }
+            # The admission signal is the fingerprint GATE's verdict (a host
+            # whose numbers drifted should get no new work) — NOT the
+            # cumulative quarantine counter: a quarantine already failed its
+            # own prompt at the lane, and one bad request in a process's
+            # lifetime must not blacklist the host forever. The counter is
+            # surfaced for operators instead.
+            e.numerics_ok = gate.get("verdict") not in ("drift", "nonfinite")
+            e.quarantined_lanes = int(numerics.get("quarantined_lanes") or 0)
+            e.last_ok = now
+            e.consecutive_failures = 0
+            e.last_error = None
+            e.next_poll = now + self.poll_s
+        return True
+
+    def record_failure(self, host_id: str, base: str | None = None,
+                       error: str = "") -> int:
+        """Register one failed interaction (poll or proxy); returns the new
+        consecutive-failure count. Backoff doubles per failure, capped."""
+        now = time.monotonic()
+        with self._lock:
+            e = self._entry(host_id, base or self._entries.get(
+                host_id, HostHealth(host_id, "")
+            ).base)
+            e.consecutive_failures += 1
+            e.last_error = error or e.last_error
+            e.next_poll = now + min(
+                self.backoff_cap_s,
+                self.poll_s * (2 ** min(e.consecutive_failures, 8)),
+            )
+            n = e.consecutive_failures
+        if n == self.fail_after:
+            log.warning("fleet host %s marked dead after %d failures (%s)",
+                        host_id, n, error)
+        return n
+
+    # -- the router's three questions ---------------------------------------
+
+    def healthy(self, host_id: str, now: float | None = None) -> bool:
+        """Fresh data, under the failure limit, numerics clean."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            e = self._entries.get(host_id)
+            if e is None or e.last_ok is None:
+                return False
+            if e.consecutive_failures >= self.fail_after:
+                return False
+            if now - e.last_ok > self.stale_after_s:
+                return False
+            return e.numerics_ok
+
+    def accepting(self, host_id: str) -> bool:
+        """Healthy AND not draining."""
+        if not self.healthy(host_id):
+            return False
+        with self._lock:
+            return self._entries[host_id].accepting
+
+    def last_ok(self, host_id: str) -> float | None:
+        """time.monotonic() of the host's last successful poll, or None."""
+        with self._lock:
+            e = self._entries.get(host_id)
+            return e.last_ok if e is not None else None
+
+    def saturated(self, host_id: str, extra_inflight: int = 0,
+                  depth: int = 4,
+                  hbm_watermark: float | None = 0.95,
+                  include_polled: bool = True) -> bool:
+        """At or beyond the per-host admission depth. ``extra_inflight`` is
+        the router's own live dispatch count for the host; the polled
+        document lags it (and, once fresh, COUNTS the same prompts), so the
+        two views combine as max, not sum — and the caller passes
+        ``include_polled=False`` when the poll predates its own bookkeeping
+        (a completion the router already collected makes the polled count
+        provably stale-high, which would strand a free host as "saturated"
+        for a poll interval). HBM pressure beyond the watermark counts as
+        saturation too — spilling beats OOMing a warm host."""
+        with self._lock:
+            e = self._entries.get(host_id)
+            if e is None:
+                return True
+            inflight = max(e.inflight_prompts if include_polled else 0,
+                           extra_inflight, 0)
+            if inflight >= depth:
+                return True
+            if (hbm_watermark is not None
+                    and e.hbm_utilization_max is not None
+                    and e.hbm_utilization_max >= hbm_watermark):
+                return True
+            return False
+
+    def dead(self, host_id: str) -> bool:
+        with self._lock:
+            e = self._entries.get(host_id)
+            return (e is not None
+                    and e.consecutive_failures >= self.fail_after)
+
+    def in_backoff(self, host_id: str) -> bool:
+        """True while the host has recorded failures and its backoff window
+        has not elapsed — best-effort traffic (the monitor's history sweeps)
+        should not pay a socket timeout per visit to a struggling host."""
+        with self._lock:
+            e = self._entries.get(host_id)
+            return (e is not None and e.consecutive_failures > 0
+                    and e.next_poll > time.monotonic())
+
+    def mark_draining(self, host_id: str) -> None:
+        """Immediate local effect of a drain request — the next poll will
+        confirm from the host's own document."""
+        with self._lock:
+            e = self._entries.get(host_id)
+            if e is not None:
+                e.accepting = False
+
+    # -- surfaces -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The per-host section of the router's ``GET /health``."""
+        now = time.monotonic()
+        with self._lock:
+            entries = {hid: dataclasses.replace(e)
+                       for hid, e in self._entries.items()}
+        out = {}
+        for hid, e in entries.items():
+            age = e.age_s(now)
+            out[hid] = {
+                "base": e.base,
+                "schema": e.schema,
+                "healthy": self.healthy(hid, now),
+                "accepting": e.accepting,
+                "inflight_prompts": e.inflight_prompts,
+                "queue_pending": e.queue_pending,
+                "queue_running": e.queue_running,
+                "workers": e.workers,
+                "hbm_utilization_max": e.hbm_utilization_max,
+                "compile": e.compile_cache,
+                "numerics_ok": e.numerics_ok,
+                "quarantined_lanes": e.quarantined_lanes,
+                "health_age_s": None if age is None else round(age, 3),
+                "consecutive_failures": e.consecutive_failures,
+                "last_error": e.last_error,
+            }
+        return out
+
+    def publish_gauges(self) -> None:
+        snap = self.snapshot()
+        registry.gauge("pa_fleet_hosts", len(snap),
+                       help="backends on the router's scoreboard")
+        registry.gauge(
+            "pa_fleet_hosts_healthy",
+            sum(1 for s in snap.values() if s["healthy"]),
+            help="backends currently healthy (fresh poll, numerics clean)",
+        )
+        for hid, s in snap.items():
+            registry.gauge("pa_fleet_host_inflight", s["inflight_prompts"],
+                           labels={"host": hid},
+                           help="in-flight prompts per backend (polled)")
+            registry.gauge("pa_fleet_host_accepting",
+                           1.0 if s["accepting"] else 0.0,
+                           labels={"host": hid},
+                           help="drain state per backend (1 = seating)")

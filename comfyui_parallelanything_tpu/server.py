@@ -38,15 +38,26 @@ Endpoints (the ComfyUI client-protocol subset that makes scripts work):
                               ``pa_hbm_*`` memory gauges (refreshed per
                               scrape and by the periodic memory monitor)
 - ``GET  /health``            one JSON health document
-                              (utils/telemetry.health_snapshot): devices,
-                              per-device HBM + utilization (deterministic
-                              pseudo-accounting off-hardware), peak
-                              watermark, compile/cache accounting, queue
-                              depth/workers, 1-minute load average, and a
-                              ``numerics`` section (utils/numerics.py:
-                              sentinel flag, last non-finite event,
-                              quarantined-lane total, fingerprint-gate
-                              verdict; enable with $PA_NUMERICS=1)
+                              (utils/telemetry.health_snapshot,
+                              ``pa-health/v2``): devices, per-device HBM +
+                              utilization (deterministic pseudo-accounting
+                              off-hardware), peak watermark, compile/cache
+                              accounting, queue depth/workers, 1-minute
+                              load average, a ``numerics`` section
+                              (utils/numerics.py: sentinel flag, last
+                              non-finite event, quarantined-lane total,
+                              fingerprint-gate verdict; enable with
+                              $PA_NUMERICS=1), and the fleet identity/
+                              admission fields a router's scoreboard reads
+                              (``host_id``, ``accepting``,
+                              ``inflight_prompts`` — fleet/scoreboard.py
+                              needs no extra endpoint)
+- ``POST /drain``             fleet drain: stop seating new prompts
+                              (``POST /prompt`` → 503 while draining),
+                              finish running lanes; body
+                              ``{"resume": true}`` re-opens admission
+                              (elastic rejoin). A router mirrors the state
+                              from /health's ``accepting``
 - ``GET  /trace``             Chrome/Perfetto trace-event JSON of the span
                               tracer (utils/tracing.py) — per-prompt
                               timelines from HTTP ingress to device step;
@@ -211,6 +222,27 @@ class QueueFullError(RuntimeError):
     """Bounded prompt queue is full — surfaced as HTTP 429 (backpressure)."""
 
 
+class DrainingError(RuntimeError):
+    """Host is draining (POST /drain): no new prompts are seated — surfaced
+    as HTTP 503 so a fleet router places the prompt elsewhere."""
+
+
+def default_host_id() -> str:
+    """Stable-ish per-process host identity for the fleet tier: explicit
+    $PA_HOST_ID wins (operators name their hosts); otherwise hostname+pid —
+    unique across a fleet of processes, including several on one machine."""
+    hid = os.environ.get("PA_HOST_ID")
+    if hid:
+        return hid
+    import socket
+
+    try:
+        name = socket.gethostname()
+    except OSError:
+        name = "host"
+    return f"{name}-{os.getpid()}"
+
+
 class PromptQueue:
     """Prompt executor with ComfyUI-shaped bookkeeping.
 
@@ -224,7 +256,8 @@ class PromptQueue:
 
     def __init__(self, class_mappings=None, output_dir: str | None = None,
                  workers: int | None = None, max_pending: int | None = None,
-                 serving: bool | None = None, trace: bool | None = None):
+                 serving: bool | None = None, trace: bool | None = None,
+                 host_id: str | None = None):
         if trace is None:
             trace = os.environ.get("PA_TRACE", "") not in ("", "0", "false")
         if trace:
@@ -238,6 +271,11 @@ class PromptQueue:
             numerics.enable()
         self.class_mappings = class_mappings
         self.output_dir = output_dir or os.environ.get("PA_OUTPUT_DIR", "output")
+        # Fleet identity + drain state (pa-health/v2): host_id names this
+        # process on a router's scoreboard; accepting=False (POST /drain)
+        # stops seating new prompts while running lanes finish.
+        self.host_id = host_id or default_host_id()
+        self.accepting = True
         self.cache = WorkflowCache()
         self.pending: "queue.Queue[tuple | None]" = queue.Queue()
         self.pending_ids: list[str] = []
@@ -323,14 +361,18 @@ class PromptQueue:
         })
 
     def submit(self, prompt: dict, preview: bool = False,
-               priority: int = 0, deadline_s: float | None = None
-               ) -> tuple[str, int]:
+               priority: int = 0, deadline_s: float | None = None,
+               fleet: dict | None = None) -> tuple[str, int]:
         pid = uuid.uuid4().hex
         # Bookkeeping AND enqueue under one lock: interrupt() drains under the
         # same lock, so a submit racing an interrupt either lands wholly
         # before (and is dropped with a history entry) or wholly after (and
         # survives) — never half-registered.
         with self._lock:
+            if not self.accepting:
+                raise DrainingError(
+                    f"host {self.host_id} is draining (no new prompts)"
+                )
             if (self.max_pending is not None
                     and len(self.pending_ids) - len(self.running)
                     >= self.max_pending):
@@ -345,16 +387,40 @@ class PromptQueue:
             number = self.counter
             self.pending_ids.append(pid)
             self.pending.put((pid, prompt, bool(preview), int(priority),
-                              deadline_s))
+                              deadline_s, fleet))
         self._emit_status()
         return pid, number
+
+    def inflight_prompts(self) -> int:
+        """Queued + running — the pa-health/v2 field a fleet scoreboard
+        reads for saturation decisions (caller need not hold the lock)."""
+        with self._lock:
+            return len(self.pending_ids)
+
+    def drain(self) -> dict:
+        """Stop seating new prompts (POST /prompt → 503); running prompts
+        and their serving lanes finish normally — the fleet drain state a
+        router observes via /health ``accepting``. Returns the drain view."""
+        with self._lock:
+            self.accepting = False
+            state = {"host_id": self.host_id, "accepting": False,
+                     "pending": len(self.pending_ids) - len(self.running),
+                     "running": len(self.running)}
+        return state
+
+    def resume(self) -> dict:
+        """Re-open admission after a drain (elastic rejoin)."""
+        with self._lock:
+            self.accepting = True
+            return {"host_id": self.host_id, "accepting": True}
 
     def _drop_pending(self, pid: str) -> None:
         """history + bookkeeping for a prompt cancelled before it ran
         (caller holds the lock)."""
         self.pending_ids.remove(pid)
         self.history[pid] = {
-            "status": {"status_str": "interrupted", "completed": False},
+            "status": {"status_str": "interrupted", "completed": False,
+                       "host_id": self.host_id},
             "outputs": {},
         }
 
@@ -452,7 +518,7 @@ class PromptQueue:
             if item is None:
                 self.pending.put(None)  # cascade to sibling workers
                 return
-            pid, prompt, preview, priority, deadline_s = item
+            pid, prompt, preview, priority, deadline_s, fleet = item
             cancel_evt = threading.Event()
             with self._lock:
                 if pid not in self.pending_ids:
@@ -516,7 +582,16 @@ class PromptQueue:
                     interrupt_event=cancel_evt,
                     prompt_id=pid,
                 ), serving_hints(priority=priority, deadline_s=deadline_s), \
-                        tracing.span("prompt", cat="server", prompt_id=pid):
+                        tracing.span(
+                            "prompt", cat="server", prompt_id=pid,
+                            # Cross-hop correlation: a fleet router stamps
+                            # its own prompt id into extra_data.fleet, so
+                            # this backend-side timeline joins the router's
+                            # fleet-prompt/fleet-hop spans in one export.
+                            **({"origin_prompt_id": fleet.get("origin"),
+                                "router": fleet.get("router")}
+                               if fleet else {}),
+                        ):
                     results = run_workflow(
                         prompt, class_mappings=self.class_mappings,
                         outputs=self.cache, on_node=on_node,
@@ -567,6 +642,10 @@ class PromptQueue:
                             entry["status"]["postmortem"] = bundle
                 except Exception:  # noqa: BLE001 — forensics is best-effort
                     pass
+            # Every history entry names the host that produced it — the
+            # fleet tier's per-host latency attribution rides this field
+            # (scripts/loadgen.py groups client latencies by it).
+            entry["status"]["host_id"] = self.host_id
             with self._lock:
                 self.history[pid] = entry
                 if pid in self.pending_ids:
@@ -618,6 +697,11 @@ class _Handler(BaseHTTPRequestHandler):
     # strict WS clients reject 'HTTP/1.0 101'. (Every response sets
     # Content-Length, which HTTP/1.1 keep-alive needs.)
     protocol_version = "HTTP/1.1"
+    # Every response is two small writes (buffered headers, then body);
+    # with Nagle on, the body write can stall ~40ms behind the peer's
+    # delayed ACK — tens of ms on every /history poll and /prompt hop,
+    # which the fleet router pays per prompt. TCP_NODELAY it.
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
@@ -690,7 +774,15 @@ class _Handler(BaseHTTPRequestHandler):
                     # much of the step traffic actually co-batched.
                     "serving_batched_fraction": round(batched_fraction(), 4),
                 }
-            return self._send(200, health_snapshot(queue=queue))
+                # pa-health/v2 (fleet tier): identity + admission state a
+                # router's scoreboard reads straight off this document — no
+                # extra endpoint.
+                host = {
+                    "host_id": self.q.host_id,
+                    "accepting": self.q.accepting,
+                    "inflight_prompts": len(self.q.pending_ids),
+                }
+            return self._send(200, health_snapshot(queue=queue, host=host))
         if url.path == "/trace":
             # Chrome/Perfetto trace-event JSON (open at ui.perfetto.dev).
             # With tracing disabled the export is empty — the body says so
@@ -794,6 +886,17 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         if url.path == "/interrupt":
             return self._send(200, {"dropped": self.q.interrupt()})
+        if url.path == "/drain":
+            # Fleet drain: stop seating (POST /prompt → 503), finish running
+            # lanes; {"resume": true} re-opens admission (elastic rejoin).
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                return self._send(400, {"error": f"bad JSON: {e}"})
+            if payload.get("resume"):
+                return self._send(200, self.q.resume())
+            return self._send(200, self.q.drain())
         if url.path == "/queue":
             # Stock per-prompt cancel: {"delete": [prompt_id, ...]} — routed
             # through the per-prompt scope event, which the serving layer's
@@ -831,11 +934,15 @@ class _Handler(BaseHTTPRequestHandler):
             preview = bool(extra.get("preview") or payload.get("preview"))
             try:
                 deadline_s = extra.get("deadline_s")
+                fleet = extra.get("fleet")
                 pid, number = self.q.submit(
                     prompt, preview=preview,
                     priority=int(extra.get("priority") or 0),
                     deadline_s=None if deadline_s is None else float(deadline_s),
+                    fleet=fleet if isinstance(fleet, dict) else None,
                 )
+            except DrainingError as e:
+                return self._send(503, {"error": str(e)})
             except QueueFullError as e:
                 return self._send(429, {"error": str(e)})
             except (TypeError, ValueError) as e:
@@ -915,6 +1022,14 @@ class _Handler(BaseHTTPRequestHandler):
                                 "type": "input"})
 
 
+class _HTTPServer(ThreadingHTTPServer):
+    # http.server's default listen backlog is 5 — a fleet router's poll
+    # traffic (history proxies + health polls + heartbeats, each a fresh
+    # connection) overflows that in bursts and dispatch POSTs get
+    # connection-reset, costing spurious failover retries.
+    request_queue_size = 128
+
+
 def make_server(
     host: str = "127.0.0.1",
     port: int = 8188,
@@ -924,6 +1039,7 @@ def make_server(
     max_pending: int | None = None,
     serving: bool | None = None,
     trace: bool | None = None,
+    host_id: str | None = None,
 ) -> tuple[ThreadingHTTPServer, PromptQueue]:
     """Build (but don't start) the HTTP server + its prompt queue. Port 0
     picks an ephemeral port (tests); ``server.server_address`` has the real
@@ -931,12 +1047,13 @@ def make_server(
     concurrently and installs the continuous-batching scheduler;
     ``max_pending`` (or $PA_MAX_PENDING) bounds the queue (429 beyond it);
     ``trace`` (or $PA_TRACE=1) turns the span tracer on so ``GET /trace``
-    serves per-prompt timelines."""
+    serves per-prompt timelines; ``host_id`` (or $PA_HOST_ID) names this
+    process on a fleet router's scoreboard (pa-health/v2)."""
     q = PromptQueue(class_mappings=class_mappings, output_dir=output_dir,
                     workers=workers, max_pending=max_pending, serving=serving,
-                    trace=trace)
+                    trace=trace, host_id=host_id)
     handler = type("Handler", (_Handler,), {"q": q})
-    srv = ThreadingHTTPServer((host, port), handler)
+    srv = _HTTPServer((host, port), handler)
     return srv, q
 
 
@@ -956,16 +1073,50 @@ def main() -> None:
     ap.add_argument("--trace", action="store_true", default=None,
                     help="enable span tracing (GET /trace serves "
                          "Chrome/Perfetto trace JSON; default $PA_TRACE)")
+    ap.add_argument("--host-id", default=None,
+                    help="fleet identity on a router's scoreboard "
+                         "(default $PA_HOST_ID or hostname-pid)")
+    ap.add_argument("--fleet-router", default=None,
+                    help="router base URL (or $PA_FLEET_ROUTER): register "
+                         "this host via heartbeats so it joins the ring "
+                         "elastically and drops out when it dies")
+    ap.add_argument("--advertise", default=None,
+                    help="base URL the ROUTER should reach this host at "
+                         "(default http://<host>:<port>)")
     args = ap.parse_args()
     srv, q = make_server(args.host, args.port, output_dir=args.output_dir,
                          workers=args.workers, max_pending=args.max_pending,
-                         trace=args.trace)
+                         trace=args.trace, host_id=args.host_id)
+    heartbeat = None
+    router_base = args.fleet_router or os.environ.get("PA_FLEET_ROUTER")
+    if router_base:
+        from .fleet.registry import HeartbeatClient
+
+        # A wildcard bind is not a reachable address — advertise the host's
+        # name instead (or let --advertise override for NAT/containers).
+        reach = args.host
+        if reach in ("0.0.0.0", "::", ""):
+            import socket
+
+            try:
+                reach = socket.gethostname()
+            except OSError:
+                reach = "127.0.0.1"
+        advertise = args.advertise or (
+            f"http://{reach}:{srv.server_address[1]}"
+        )
+        heartbeat = HeartbeatClient(
+            router_base, q.host_id, advertise,
+            interval_s=float(os.environ.get("PA_FLEET_HEARTBEAT_S", "2")),
+        ).start()
     print(f"ParallelAnything workflow server on http://{args.host}:{args.port}")
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        if heartbeat is not None:
+            heartbeat.stop()
         q.shutdown()
 
 

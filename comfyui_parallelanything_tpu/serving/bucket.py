@@ -414,6 +414,19 @@ class StepBucket:
                 req.resolve(error=Interrupted("cancelled while queued"))
                 registry.counter("pa_serving_cancelled_total", labels=self._labels)
                 continue
+            if req.deadline is not None and now >= req.deadline:
+                # Deadline-vs-admission race: a deadline that lapses between
+                # the expired() sweep above and this pop (or was pushed
+                # already-expired) must reject with the deadline error, not
+                # seat for step 0 — seating would spend a dispatch on work
+                # whose client has already given up.
+                req.resolve(error=DeadlineExceeded(
+                    f"deadline passed after {now - req.submit_ts:.3f}s "
+                    "waiting (caught at admission)"
+                ))
+                registry.counter("pa_serving_expired_total",
+                                 labels=self._labels)
+                continue
             self._set_lane(i, req)
             joined += 1
             registry.histogram(

@@ -21,7 +21,14 @@ Instrument families registered against this registry (create-on-first-touch
 ``pa_numerics_nonfinite_total{where=}`` / ``pa_numerics_quarantined_total``
 counters at the event sites, plus the ``pa_numerics_sentinel_enabled`` /
 ``pa_numerics_nonfinite_events`` / ``pa_numerics_quarantined_lanes`` gauges
-the server publishes at scrape time so healthy zeros are visible).
+the server publishes at scrape time so healthy zeros are visible), and
+``pa_fleet_*`` (fleet/ — router-side placement/failover accounting:
+``pa_fleet_dispatch_total{host=}`` / ``pa_fleet_spill_total{host=}`` /
+``pa_fleet_failover_total{host=}`` / ``pa_fleet_completed_total`` counters,
+the CI-gated ``pa_fleet_prompts_lost_total``, and the scoreboard gauges
+``pa_fleet_hosts`` / ``pa_fleet_hosts_healthy`` /
+``pa_fleet_host_inflight{host=}`` / ``pa_fleet_host_accepting{host=}`` /
+``pa_fleet_inflight`` / ``pa_fleet_queued`` published at scrape time).
 """
 
 from __future__ import annotations

@@ -48,7 +48,11 @@ import time
 import traceback as _traceback
 
 LEDGER_SCHEMA = "pa-perf-ledger/v1"
-HEALTH_SCHEMA = "pa-health/v1"
+# v2 (fleet tier): adds top-level host_id / accepting / inflight_prompts —
+# the fields a fleet router's scoreboard needs for placement and drain
+# decisions without any extra endpoint. v1 consumers are unaffected: the
+# additions are top-level keys, every v1 field is unchanged.
+HEALTH_SCHEMA = "pa-health/v2"
 LEDGER_FILENAME = "perf_ledger.jsonl"
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
@@ -478,17 +482,23 @@ def append_ledger_record(record: dict, kind: str) -> str | None:
 # ---------------------------------------------------------------------------
 
 
-def health_snapshot(queue: dict | None = None) -> dict:
+def health_snapshot(queue: dict | None = None,
+                    host: dict | None = None) -> dict:
     """One JSON-able view of the process's resource state: devices, per-device
     HBM (+ utilization), peak watermark, compile/cache accounting, load
     average — the fields the watchdog attaches to failed-attempt notes and
     ``GET /health`` serves. Every section degrades to None independently (a
-    wedged device backend must not blank the host-side sections)."""
+    wedged device backend must not blank the host-side sections). ``host``
+    merges the pa-health/v2 fleet fields (host_id, accepting,
+    inflight_prompts) top-level — the server passes its own identity/drain
+    state; standalone callers (watchdog notes) omit it."""
     out: dict = {
         "schema": HEALTH_SCHEMA,
         "ts": time.time(),
         "loadavg_1m": _loadavg_1m(),
     }
+    if host:
+        out.update(host)
     try:
         from ..devices.discovery import available_devices
 

@@ -148,6 +148,13 @@ class Tracer:
         # thread ident -> (thread name, events deque) — registration happens
         # once per recording thread; export snapshots under the lock.
         self._buffers: dict[int, tuple[str, deque]] = {}
+        # Thread IDENTS ARE REUSED after a thread dies (pthread ids recycle
+        # aggressively under http.server's thread-per-request churn): when a
+        # new thread claims a dead recorder's ident, the dead thread's spans
+        # must survive — they move to this bounded retired ring instead of
+        # being silently replaced. Every event row carries its own tid, so
+        # retired buffers export exactly like live ones.
+        self._retired: deque = deque(maxlen=256)
         self._epoch_us = now_us()
 
     # -- lifecycle ----------------------------------------------------------
@@ -160,6 +167,7 @@ class Tracer:
         with self._lock:
             self.capacity = DEFAULT_CAPACITY if capacity is None else capacity
             self._buffers.clear()
+            self._retired.clear()
             self._epoch_us = now_us()
         self._local = _Local()
         self.enabled = True
@@ -172,6 +180,7 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._buffers.clear()
+            self._retired.clear()
 
     # -- recording ----------------------------------------------------------
 
@@ -181,6 +190,12 @@ class Tracer:
             ev = local.events = deque(maxlen=self.capacity)
             t = threading.current_thread()
             with self._lock:
+                prev = self._buffers.get(threading.get_ident())
+                if prev is not None and prev[1]:
+                    # Recycled ident: retire the dead thread's spans rather
+                    # than dropping them (short-lived HTTP handler threads
+                    # record real spans — fleet dispatch hops among them).
+                    self._retired.append(prev)
                 self._buffers[threading.get_ident()] = (t.name, ev)
         return ev
 
@@ -275,6 +290,11 @@ class Tracer:
         with self._lock:
             snap = [(tid, name, list(ev))
                     for tid, (name, ev) in self._buffers.items()]
+            # Retired buffers (dead threads whose ident was recycled): their
+            # rows carry their own tids, so they render identically.
+            snap.extend(
+                (0, name, list(ev)) for name, ev in self._retired
+            )
         events: list[dict] = []
         tids_seen: set[int] = set()
         for _rec_tid, _tname, recs in snap:
@@ -427,6 +447,19 @@ def host_gap_ms(events) -> float | None:
         for a, b in zip(evs, evs[1:]):
             gaps.append(max(0.0, b["ts"] - (a["ts"] + a["dur"])) / 1e3)
     return sum(gaps) / len(gaps) if gaps else None
+
+
+def fleet_hop_p95_ms(events) -> float | None:
+    """p95 of the router's ``fleet-hop`` spans (place → backend accepted),
+    milliseconds — the fleet tier's own overhead per dispatch, distinct from
+    the backend-side prompt time. The hop span and the backend's prompt span
+    share ``origin_prompt_id``/``prompt_id``, so one Perfetto export shows
+    the prompt's timeline across the hop. None when no fleet routing ran
+    inside the traced window (kept out of :func:`trace_aggregates`, whose
+    key set is pinned against scripts/trace_summary.py)."""
+    hops = [e["dur"] / 1e3 for e in _x_events(events)
+            if e["name"] == "fleet-hop"]
+    return round(_percentile(hops, 95), 4) if hops else None
 
 
 def trace_aggregates(events) -> dict:

@@ -39,3 +39,14 @@ timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m pytest tests/test_serving.py -q -p no:cacheprovider \
     -p no:xdist -p no:randomly \
     -k "LaneEquivalenceMatrix or MixedSamplerDispatch or RegistryCoverage"
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+
+# Fleet smoke (round 12): a 2-backend fleet — router + scripts/loadgen.py
+# fleet mode, ~10 prompts on CPU — gated on prompts_lost == 0 plus full
+# per-host attribution (tests/test_fleet.py::TestFleetSmoke). The fleet
+# tier's one non-negotiable: the front door never loses a prompt. Also part
+# of the tier-1 run above; this rerun is the explicit contract.
+timeout -k 10 300 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_fleet.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly -k "FleetSmoke or Failover"

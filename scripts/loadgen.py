@@ -12,14 +12,32 @@ lockstep dispatch / lane admission, vs the client clocks which fold in
 queueing + HTTP + polling) — so a run shows not just *how fast* but *how
 batched* and *where the time went* (BASELINE.md "serving" metric).
 
+The ONE summary line goes to **stdout** (ledger-appendable, `| jq`-able —
+the same one-JSON-line contract bench.py keeps); the human-readable table
+goes to **stderr**, so piping a fleet run into the ledger never has to strip
+prose.
+
 Usage:
     python scripts/loadgen.py --graph workflow.json \
         [--base http://127.0.0.1:8188] [--clients 4] [--requests 2] \
-        [--timeout 300] [--seed-key 3:inputs:seed]
+        [--timeout 300] [--seed-key 3:inputs:seed] [--seed 7] \
+        [--hosts http://h1:8188,http://h2:8188]
 
 ``--seed-key`` (node:path:to:field) makes every submission unique by writing
 the request counter into that graph field — defeating the workflow cache so
-each prompt actually samples (the default for KSampler graphs: vary the seed).
+each prompt actually samples (the default for KSampler graphs: vary the
+seed). ``--seed N`` makes that schedule REPRODUCIBLE: the written values
+come from a seeded RNG instead of the live counter, so two runs with the
+same seed submit the identical prompt set.
+
+``--hosts`` (comma list of backend base URLs) turns on FLEET mode: ``--base``
+points at a fleet router (fleet/router.py) and the summary adds per-host
+sections — client-side p50/p95 grouped by the serving host (the router
+stamps ``status.fleet.host_id`` on every entry), per-backend dispatch/
+lane-step deltas scraped from each host's /metrics — plus the router's own
+``pa_fleet_*`` deltas (dispatches, spills, failovers) and ``prompts_lost``
+(router-lost + client-timeout), the number the fleet CI smoke gates on
+staying zero.
 """
 
 from __future__ import annotations
@@ -27,6 +45,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import re
 import sys
 import threading
@@ -140,7 +159,12 @@ def _serving_counters(base: str) -> dict:
                  # tells a clean 0 apart from an unwatched run.
                  "pa_numerics_nonfinite_total",
                  "pa_numerics_quarantined_total",
-                 "pa_numerics_sentinel_enabled"):
+                 "pa_numerics_sentinel_enabled",
+                 # Fleet router counters (fleet/router.py) — present when
+                 # --base is a router; summed over their {host=} labels.
+                 "pa_fleet_dispatch_total", "pa_fleet_spill_total",
+                 "pa_fleet_failover_total", "pa_fleet_completed_total",
+                 "pa_fleet_prompts_lost_total"):
         total = 0.0
         found = False
         for m in re.finditer(rf"^{name}(?:\{{[^}}]*\}})? ([0-9.eE+-]+)$",
@@ -164,25 +188,60 @@ def percentile(samples: list[float], q: float) -> float:
     return s[k]
 
 
+def _host_probe(hosts: list[str]) -> dict:
+    """One scrape per backend: its health identity + serving counters —
+    the before/after pair fleet mode diffs for per-host dispatch deltas."""
+    out: dict[str, dict] = {}
+    for h in hosts:
+        h = h.rstrip("/")
+        probe: dict = {"base": h}
+        try:
+            health = _get(h, "/health", timeout=10)
+            probe["host_id"] = health.get("host_id")
+            probe["accepting"] = health.get("accepting")
+            probe["inflight_prompts"] = health.get("inflight_prompts")
+        except (urllib.error.URLError, OSError, ValueError):
+            probe["host_id"] = None
+        probe["counters"] = _serving_counters(h)
+        out[h] = probe
+    return out
+
+
 def run_load(base: str, graph: dict, *, clients: int, requests: int,
              timeout: float, seed_key: str | None = None,
              extra_data: dict | None = None,
              samplers: list[str] | None = None,
-             sampler_key: str | None = None) -> dict:
-    """The closed loop; returns the summary dict (importable — the e2e test
-    drives an in-process server through this exact code path).
+             sampler_key: str | None = None,
+             seed: int | None = None,
+             hosts: list[str] | None = None) -> dict:
+    """The closed loop; returns the summary dict (importable — the e2e and
+    fleet-smoke tests drive in-process servers through this exact code path).
 
     ``samplers`` + ``sampler_key`` make the workload MIXED: prompt n runs
     ``samplers[n % len]`` (round-robin, written into the graph at
     ``sampler_key``) — the traffic shape the stateful-lane scheduler
     co-batches into one dispatch stream, whose amortization the summary
-    reports (shared-dispatch counters scraped from /metrics)."""
+    reports (shared-dispatch counters scraped from /metrics).
+
+    ``seed`` makes the prompt schedule reproducible: the per-prompt value
+    written at ``seed_key`` comes from ``random.Random(seed)`` instead of
+    the live counter. ``hosts`` turns on fleet mode (see module docstring)."""
     latencies: list[float] = []
+    lat_by_host: dict = {}
     failures: list[str] = []
     rejected = [0]
+    timeouts = [0]
     lock = threading.Lock()
     counter = [0]
+    # Reproducible schedule: value n is a pure function of (seed, n), so two
+    # runs with one seed submit the identical prompt set regardless of how
+    # the client threads interleave.
+    schedule = None
+    if seed is not None:
+        rng = random.Random(seed)
+        schedule = [rng.randrange(1 << 31) for _ in range(clients * requests)]
     before = _serving_counters(base)
+    hosts_before = _host_probe(hosts) if hosts else None
     t_start = time.time()
 
     def client(ci: int) -> None:
@@ -192,7 +251,8 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
                 counter[0] += 1
                 n = counter[0]
             if seed_key:
-                _set_path(g, seed_key, n)
+                _set_path(g, seed_key,
+                          schedule[n - 1] if schedule is not None else n)
             if samplers and sampler_key:
                 _set_path(g, sampler_key, samplers[n % len(samplers)])
             payload = {"prompt": g}
@@ -208,14 +268,28 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
                     else:
                         failures.append(f"client {ci}: HTTP {e.code}")
                 continue
-            entry = _wait_done(base, pid, timeout)
+            try:
+                entry = _wait_done(base, pid, timeout)
+            except TimeoutError:
+                # A prompt that never completes is LOST from the client's
+                # view — it must count (the fleet gate), not silently kill
+                # this client thread.
+                with lock:
+                    timeouts[0] += 1
+                    failures.append(f"client {ci}: timeout ({pid})")
+                continue
             dt = time.time() - t0
+            status = entry.get("status") or {}
+            served_by = (status.get("fleet") or {}).get("host_id") \
+                or status.get("host_id")
             with lock:
-                if entry["status"].get("status_str") == "success":
+                if status.get("status_str") == "success":
                     latencies.append(dt)
+                    if served_by:
+                        lat_by_host.setdefault(served_by, []).append(dt)
                 else:
                     failures.append(
-                        f"client {ci}: {entry['status'].get('status_str')}"
+                        f"client {ci}: {status.get('status_str')}"
                     )
 
     threads = [threading.Thread(target=client, args=(i,)) for i in range(clients)]
@@ -233,9 +307,57 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
         after.get("pa_serving_lane_steps_total", 0.0)
         - before.get("pa_serving_lane_steps_total", 0.0)
     ) if after else None
+    fleet = None
+    per_host = None
+    prompts_lost = None
+    if hosts:
+        hosts_after = _host_probe(hosts)
+        per_host = {}
+        for h in hosts:
+            h = h.rstrip("/")
+            b, a = hosts_before.get(h, {}), hosts_after.get(h, {})
+            hid = a.get("host_id") or b.get("host_id") or h
+            cb, ca = b.get("counters") or {}, a.get("counters") or {}
+            lats = lat_by_host.get(hid, [])
+            per_host[hid] = {
+                "base": h,
+                "completed": len(lats),
+                "latency_p50_s": round(percentile(lats, 50), 3),
+                "latency_p95_s": round(percentile(lats, 95), 3),
+                "dispatches": (
+                    ca.get("pa_serving_dispatch_total", 0.0)
+                    - cb.get("pa_serving_dispatch_total", 0.0)
+                ) if ca else None,
+                "lane_steps": (
+                    ca.get("pa_serving_lane_steps_total", 0.0)
+                    - cb.get("pa_serving_lane_steps_total", 0.0)
+                ) if ca else None,
+                "server_step_p50_s": ca.get("step_p50_s"),
+                "server_step_p95_s": ca.get("step_p95_s"),
+                "accepting": a.get("accepting"),
+                "reachable": a.get("host_id") is not None,
+            }
+        # Router-side deltas (--base is the fleet front door). A router-lost
+        # prompt and a client-timeout are the same failure seen from two
+        # ends; the gate number is their sum.
+        def _delta(name):
+            return (after.get(name, 0.0) - before.get(name, 0.0)
+                    if name in after or name in before else None)
+
+        fleet = {
+            "dispatches": _delta("pa_fleet_dispatch_total"),
+            "spills": _delta("pa_fleet_spill_total"),
+            "failovers": _delta("pa_fleet_failover_total"),
+            "completed": _delta("pa_fleet_completed_total"),
+        }
+        lost_router = _delta("pa_fleet_prompts_lost_total")
+        prompts_lost = (lost_router or 0.0) + timeouts[0]
+    elif timeouts[0]:
+        prompts_lost = float(timeouts[0])
     return {
         "clients": clients,
         "requests": clients * requests,
+        "seed": seed,
         "samplers": samplers or None,
         "completed": len(latencies),
         "failed": len(failures),
@@ -278,8 +400,49 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
         "server_step_p50_s": after.get("step_p50_s"),
         "server_step_p95_s": after.get("step_p95_s"),
         "server_lane_wait_p95_s": after.get("lane_wait_p95_s"),
+        # Fleet mode (--hosts): per-host client latencies + dispatch deltas,
+        # router-side placement/failover deltas, and the CI-gated loss count
+        # (router-lost + client-timeout; None outside fleet mode unless a
+        # timeout made the number real).
+        "hosts": per_host,
+        "fleet": fleet,
+        "prompts_lost": prompts_lost,
+        "timeouts": timeouts[0],
         "errors": failures[:5],
     }
+
+
+def print_human_summary(summary: dict, stream=None) -> None:
+    """The operator-facing table — stderr by contract, so stdout stays ONE
+    JSON line (the same ledger-appendable discipline as bench.py)."""
+    stream = stream if stream is not None else sys.stderr
+    w = stream.write
+    w("── loadgen summary ──────────────────────────────\n")
+    w(f"  prompts   {summary['completed']}/{summary['requests']} ok"
+      f"  ({summary['failed']} failed, {summary['rejected_429']} rejected,"
+      f" {summary.get('timeouts', 0)} timed out)\n")
+    w(f"  wall      {summary['wall_s']}s"
+      f"  throughput {summary['throughput_rps']} rps\n")
+    w(f"  latency   p50 {summary['latency_p50_s']}s"
+      f"  p95 {summary['latency_p95_s']}s"
+      f"  max {summary['latency_max_s']}s\n")
+    if summary.get("dispatch_amortization") is not None:
+        w(f"  serving   {summary['serving_dispatches']:.0f} dispatches,"
+          f" {summary['serving_lane_steps']:.0f} lane-steps"
+          f" ({summary['dispatch_amortization']}x amortized)\n")
+    if summary.get("fleet"):
+        f = summary["fleet"]
+        w(f"  fleet     dispatches {f.get('dispatches')}"
+          f"  spills {f.get('spills')}  failovers {f.get('failovers')}"
+          f"  lost {summary.get('prompts_lost')}\n")
+    for hid, h in (summary.get("hosts") or {}).items():
+        w(f"  host {hid:<20} {h['completed']:>3} ok"
+          f"  p50 {h['latency_p50_s']}s  p95 {h['latency_p95_s']}s"
+          f"  dispatches {h['dispatches']}"
+          f"{'' if h.get('reachable') else '  [UNREACHABLE]'}\n")
+    for err in summary.get("errors") or []:
+        w(f"  error     {err}\n")
+    w("─────────────────────────────────────────────────\n")
 
 
 def main() -> None:
@@ -303,10 +466,19 @@ def main() -> None:
                          "round-robin sampler is written to")
     ap.add_argument("--priority", type=int, default=None)
     ap.add_argument("--deadline-s", type=float, default=None)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="seed the prompt schedule (the values written at "
+                         "--seed-key) so a run is reproducible")
+    ap.add_argument("--hosts", default=None,
+                    help="comma list of backend base URLs: fleet mode — "
+                         "--base is the router; summary adds per-host "
+                         "latency/dispatch sections, pa_fleet_* deltas, "
+                         "and the CI-gated prompts_lost count")
     args = ap.parse_args()
     samplers = [s for s in (args.samplers or "").split(",") if s]
     if samplers and not args.sampler_key:
         ap.error("--samplers requires --sampler-key (where to write it)")
+    hosts = [h for h in (args.hosts or "").split(",") if h]
     with open(args.graph) as f:
         graph = json.load(f)
     extra = {}
@@ -319,9 +491,11 @@ def main() -> None:
         timeout=args.timeout, seed_key=args.seed_key,
         extra_data=extra or None,
         samplers=samplers or None, sampler_key=args.sampler_key,
+        seed=args.seed, hosts=hosts or None,
     )
     _append_ledger(summary, args.base)
-    print(json.dumps(summary))
+    print_human_summary(summary)          # operator table → stderr
+    print(json.dumps(summary))            # THE one JSON line → stdout
 
 
 if __name__ == "__main__":

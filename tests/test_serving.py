@@ -346,6 +346,41 @@ class TestCancelAndPolicy:
         t.join(20)
         assert isinstance(done.get("err"), DeadlineExceeded)
 
+    def test_deadline_lapse_racing_admission_rejects_not_seats(self, sched,
+                                                               monkeypatch):
+        """ISSUE 7 satellite: a deadline that lapses while queued but AFTER
+        the periodic expiry sweep ran (the admission race window) must be
+        rejected with the deadline error at seat time — never seated for
+        step 0, which would spend a dispatch on work whose client already
+        gave up."""
+        from comfyui_parallelanything_tpu.serving.scheduler import (
+            serving_hints,
+        )
+
+        done = {}
+
+        def worker():
+            noise, ctx = mk_inputs(72)
+            try:
+                with serving_hints(deadline_s=0.02):
+                    done["out"] = run_sampler(tiny_model, noise, ctx,
+                                              sampler="euler", steps=5)
+            except BaseException as e:  # noqa: BLE001
+                done["err"] = e
+
+        t = _bg(worker)
+        _wait_enqueued(sched, 1)
+        [bucket] = sched.buckets.values()
+        # Simulate the race: the expiry sweep misses the lapse (returns
+        # nothing), so the request reaches the pop-and-seat path expired.
+        monkeypatch.setattr(bucket.queue, "expired", lambda now=None: [])
+        time.sleep(0.05)  # the deadline lapses while still queued
+        sched.pump()
+        t.join(20)
+        assert isinstance(done.get("err"), DeadlineExceeded), done
+        assert "admission" in str(done["err"])
+        assert bucket.dispatch_count == 0  # step 0 never ran for it
+
     def test_priority_fifo_ordering(self):
         q = AdmissionQueue(max_waiting=8)
 

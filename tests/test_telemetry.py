@@ -534,6 +534,7 @@ _PRINT_ALLOWLIST = (
     ("host.py", "usage: python -m"),          # __main__ CLI usage line
     ("host.py", "{nid}:"),                    # __main__ CLI result echo
     ("server.py", "workflow server on"),      # server startup banner
+    ("fleet/router.py", "fleet router on"),   # router startup banner
 )
 _TIME_TIME_ALLOWLIST = (
     # Wall-clock epoch STAMPS (ledger ts, health ts, error ts) — not timing;
