@@ -488,45 +488,25 @@ def _cost_flops(lowered):
     return float(flops) if flops and flops > 0 else None
 
 
-def _flops_per_step(model, x, t, ctx, kwargs):
-    """Analytic model FLOPs for one denoise step via XLA HLO cost analysis of the
-    lowered (uncompiled) forward. Always lowers for CPU: the axon tunnel's PJRT
-    client doesn't implement cost analysis (observed: sd15_16 banked with
-    model_flops_per_step null → mfu null) and dot/conv FLOP counts are
-    backend-independent anyway, so one CPU lowering serves every platform.
-    Abstract args only — ShapeDtypeStructs are uncommitted, so default_device
-    controls the lowering target and no TPU buffer is touched.
-
-    Falls back to the exact jaxpr walk in scripts/mfu_budget.py when cost
-    analysis yields nothing (VERDICT r5 next-6: zimage_21_int8 banked
-    ``mfu: null`` — the one rung carrying a vs_baseline claim could not be
-    audited), so every rung's MFU wiring is non-null."""
-    import jax
-
-    flops = None
+def _step_cost(model, x, t, ctx, kwargs):
+    """Analytic model FLOPs + bytes for one denoise step via the ONE shared
+    accessor (``utils/roofline.step_cost``): XLA HLO cost analysis of a CPU
+    lowering (the axon tunnel's PJRT client implements no cost analysis, and
+    dot/conv counts are backend-independent) with the exact jaxpr walk as
+    fallback and cross-check — the unification that keeps ``mfu`` and
+    ``roofline_ratio`` counting the same step (the record carries
+    ``flops_source`` and the hlo/jaxpr discrepancy ratio when both
+    resolved). Returns the accessor's dict; every field None on failure."""
     try:
-        abstract = jax.tree.map(
-            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
-            (model.params, x, t, ctx, kwargs),
+        from comfyui_parallelanything_tpu.utils import roofline
+
+        return roofline.step_cost(
+            model.apply, model.params, x, t, ctx, kwargs
         )
-        with jax.default_device(jax.devices("cpu")[0]):
-            flops = _cost_flops(
-                jax.jit(model.apply).lower(
-                    abstract[0], abstract[1], abstract[2], abstract[3],
-                    **abstract[4],
-                )
-            )
     except Exception:
-        flops = None
-    if flops:
-        return flops
-    try:
-        sys.path.insert(0, os.path.join(_REPO, "scripts"))
-        from mfu_budget import analytic_flops
-
-        return analytic_flops(model.apply, model.params, x, t, ctx, kwargs)
-    except Exception:
-        return None
+        return {"flops": None, "bytes_accessed": None, "flops_hlo": None,
+                "flops_jaxpr": None, "flops_source": None,
+                "flops_discrepancy_ratio": None}
 
 
 def _full_flux_flops(batch, latent, ctx_len):
@@ -878,10 +858,46 @@ def _run_inner() -> None:
     # MFU: analytic step FLOPs / time / aggregate peak. TPU only (CPU peak is
     # not meaningful for MXU utilization).
     mfu = None
-    flops = _flops_per_step(model, x, t, ctx, kwargs)
+    cost = _step_cost(model, x, t, ctx, kwargs)
+    flops = cost["flops"]
     peak = _peak_bf16(jax.devices()[0].device_kind) if is_tpu else None
     if flops and peak:
         mfu = round(flops / sec_it / (peak * n_dev), 4)
+
+    # Roofline attribution (utils/roofline.py, this round): the calibrated
+    # analytic prediction for this rung's step — max(compute, memory) over
+    # the platform roofline, scaled by the banked (rung, platform,
+    # shape-bucket) calibration when one exists — the predicted_step_s /
+    # roofline_ratio pair every line carries, plus the measured-side bucket
+    # decomposition of the timed window from the trace spans. DP forwards
+    # run collective-free, so the bench prediction carries no comms term;
+    # the per-program registry rows (ledger only) price their own meshes.
+    predicted_step_s = predicted_step_raw_s = roofline_ratio = None
+    attribution = None
+    try:
+        from comfyui_parallelanything_tpu.utils import roofline
+
+        if flops and roofline.enabled():
+            spec = roofline.platform_spec(
+                jax.devices()[0].device_kind, platform
+            )
+            pred = roofline.predict_time_s(
+                flops, cost["bytes_accessed"], spec, n_devices=n_dev
+            )
+            scale = roofline.calibration_scale(
+                roofline.load_calibration(), f"rung:{config_name}",
+                platform, roofline.shape_bucket(flops),
+            )
+            predicted_step_raw_s = round(pred["predicted_s"], 6)
+            predicted_step_s = round(pred["predicted_s"] * scale, 6)
+            if sec_it > 0:
+                roofline_ratio = round(predicted_step_s / sec_it, 4)
+        if roofline.enabled():
+            attribution = roofline.attribution_from_trace(
+                trace_events, wall_s=sec_it * iters, last_steps=iters
+            )
+    except Exception:
+        pass
 
     # vs_baseline only on the README-repro-shaped rungs; anything else would
     # divide the Z_Image baseline by a different workload's s/it. The int8
@@ -945,6 +961,19 @@ def _run_inner() -> None:
         # Which chunked-attention configuration served the run (the sd15_16
         # MFU-budget sweep dimension): threshold elems + softmax dtype.
         "attn_chunk": chunk_config(),
+        # Roofline attribution (utils/roofline.py): the calibrated analytic
+        # step prediction, its ratio against the measured step (sane band
+        # (0, 1.2] — gated by scripts/roofline_report.py --check), the raw
+        # (uncalibrated) prediction the calibration fit reads back, the
+        # measured-side compute/exposed-transfer/host-gap/comms bucket
+        # decomposition of the timed window, and which FLOPs source priced
+        # it (hlo vs jaxpr, + their discrepancy ratio when both resolved).
+        "predicted_step_s": predicted_step_s,
+        "predicted_step_raw_s": predicted_step_raw_s,
+        "roofline_ratio": roofline_ratio,
+        "attribution": attribution,
+        "flops_source": cost["flops_source"],
+        "flops_discrepancy_ratio": cost["flops_discrepancy_ratio"],
     }
     if _FAKE_TPU or _TINY:
         record["dryrun"] = True
@@ -956,8 +985,31 @@ def _run_inner() -> None:
             record["full_model_flops_per_step"] = full
             record["extrapolated_full_depth_s_it"] = round(sec_it * full / flops, 4)
     # Perf-ledger record (utils/telemetry.py): the regression gate's input —
-    # one schema-versioned line per measured run, rung-stamped.
-    telemetry.append_ledger_record({**record, "rung": config_name}, "bench")
+    # one schema-versioned line per measured run, rung-stamped. The ledger
+    # twin additionally carries the per-program roofline rows (predictions
+    # for every instrumented program this run compiled — the calibration
+    # fit's program-level input), which stay off the stdout line to keep
+    # the driver contract lean.
+    ledger_rec = {**record, "rung": config_name}
+    try:
+        from comfyui_parallelanything_tpu.utils import roofline
+
+        prog_rows = roofline.program_rows_for_ledger()
+        if (prog_rows and "parallel-apply" in prog_rows
+                and config_name != "flux_stream"):
+            # Program-level measured_s — what the calibration fit pairs
+            # against predicted_raw_s per program. The resident rungs'
+            # timed step is exactly n_chunks sequential dispatches of the
+            # DP step program, so per-dispatch wall is its honest measured
+            # cost. The streamed rung's step runs the stage programs
+            # instead (stage-index→program joins await the planner item).
+            prog_rows["parallel-apply"]["measured_s"] = round(
+                sec_it / n_chunks, 6
+            )
+        ledger_rec["roofline_programs"] = prog_rows
+    except Exception:
+        pass
+    telemetry.append_ledger_record(ledger_rec, "bench")
     print(json.dumps(record))
 
 
@@ -1058,6 +1110,10 @@ _LATE_SCHEMA_FIELDS = (
     "stream_overlap_efficiency", "lane_wait_p95", "host_gap_ms",
     "compile_time_s", "compile_cache_hits", "compile_cache_misses",
     "peak_hbm_bytes", "latent_fingerprint", "nonfinite_events",
+    # Roofline attribution (round 13): prediction, ratio, measured-side
+    # bucket breakdown, and the FLOPs-source audit fields.
+    "predicted_step_s", "predicted_step_raw_s", "roofline_ratio",
+    "attribution", "flops_source", "flops_discrepancy_ratio",
 )
 
 

@@ -10,7 +10,12 @@ The router owns no model state; backends are plain ``server.py`` processes
 serving".
 """
 
-from .registry import FleetRegistry, HashRing, HeartbeatClient
+from .registry import (
+    FleetRegistry,
+    HashRing,
+    HeartbeatClient,
+    ledger_capacity_weights,
+)
 from .router import FleetRouter, make_router, model_key
 from .scoreboard import Scoreboard
 
@@ -20,6 +25,7 @@ __all__ = [
     "HashRing",
     "HeartbeatClient",
     "Scoreboard",
+    "ledger_capacity_weights",
     "make_router",
     "model_key",
 ]

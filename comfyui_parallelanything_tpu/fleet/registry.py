@@ -19,7 +19,20 @@ A fleet needs two things that queue never had:
   arxiv 2412.14374) — and ring membership changes only move the keys
   adjacent to the joined/left host, not the whole map.
 
-Pure host-side bookkeeping: nothing here imports jax.
+Vnode counts are CAPACITY-WEIGHTED (ROADMAP fleet-hardening item 2): a
+host's share of the ring scales with its banked speed — per-host step-time
+history from the perf ledger (``utils/roofline.host_step_weights``: loadgen
+per-host ``server_step_p50_s`` — the fleet's own same-workload
+measurements; never bench s/it, which is rung-dependent), normalized to
+mean 1.0 — so a v5e-8 takes proportionally more keys than a v5e-4.
+Hosts with no history weigh 1.0 (the pre-calibration equal split); the
+router refreshes weights from the ledger at registry construction. This is
+the first cross-host consumer of the roofline calibration discipline: ring
+share follows measured speed, not the reference's static free-VRAM scoring
+(any_device_parallel.py:724-766).
+
+Pure host-side bookkeeping: nothing here imports jax
+(``utils/roofline``'s module level is stdlib-only by contract).
 """
 
 from __future__ import annotations
@@ -44,6 +57,21 @@ def stable_hash(key: str) -> int:
     )
 
 
+def ledger_capacity_weights(ledger_path: str | None = None) -> dict[str, float]:
+    """Per-host ring weights from the perf ledger's banked step times
+    (``utils/roofline.host_step_weights``); ``{}`` — equal weights — when
+    there is no history or the ledger is unreadable. Best-effort by
+    contract: a corrupt ledger must never keep a router from starting."""
+    try:
+        from ..utils import roofline
+
+        return roofline.host_step_weights(
+            roofline.ledger_records(ledger_path)
+        )
+    except Exception:
+        return {}
+
+
 class HashRing:
     """Consistent-hash ring: ``sequence(key)`` is the deterministic host
     preference order for a key — the primary first, then each successive
@@ -53,10 +81,17 @@ class HashRing:
         self.vnodes = int(vnodes)
         self._ring: list[tuple[int, str]] = []  # sorted (point, host_id)
 
-    def rebuild(self, host_ids) -> None:
+    def rebuild(self, host_ids, weights: dict[str, float] | None = None) -> None:
+        """``weights`` scales each host's vnode count (capacity weighting:
+        2.0 → twice the ring share; min 1 vnode so a slow host still owns
+        keys). Unlisted hosts weigh 1.0 — equal split, the no-history
+        fallback. Vnode hash points depend only on (host, index), so a
+        weight change only adds/removes that host's highest-index vnodes —
+        membership churn stays local, the consistent-hash property."""
         ring = []
         for hid in host_ids:
-            for v in range(self.vnodes):
+            n = max(1, round(self.vnodes * float((weights or {}).get(hid, 1.0))))
+            for v in range(n):
                 ring.append((stable_hash(f"{hid}#{v}"), hid))
         ring.sort()
         self._ring = ring
@@ -93,14 +128,31 @@ class FleetRegistry:
     HTTP threads call ``heartbeat``/``remove`` while the monitor thread reads
     ``hosts``/``sequence``."""
 
-    def __init__(self, ttl_s: float = 10.0, vnodes: int = 64):
+    def __init__(self, ttl_s: float = 10.0, vnodes: int = 64,
+                 capacity_weights: dict[str, float] | None = None,
+                 capacity_from_ledger: bool = True):
         self.ttl_s = float(ttl_s)
         self._hosts: dict[str, HostInfo] = {}
         self._ring = HashRing(vnodes=vnodes)
         self._lock = threading.Lock()
+        self._weights: dict[str, float] = dict(capacity_weights or {})
+        if capacity_from_ledger and not self._weights:
+            self._weights = ledger_capacity_weights()
 
     def _rebuild(self) -> None:
-        self._ring.rebuild(sorted(self._hosts))
+        self._ring.rebuild(sorted(self._hosts), self._weights)
+
+    def set_capacity_weights(self, weights: dict[str, float]) -> None:
+        """Replace the ring's capacity weights and rebuild — the operator /
+        refresh hook (e.g. after a loadgen run banks fresh per-host step
+        times). Ring changes stay local to the hosts whose weight moved."""
+        with self._lock:
+            self._weights = dict(weights or {})
+            self._rebuild()
+
+    def capacity_weights(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._weights)
 
     def add_static(self, host_id: str, base: str) -> None:
         """Configured backend (router ``--backends``): in the ring until

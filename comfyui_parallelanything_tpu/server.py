@@ -754,6 +754,17 @@ class _Handler(BaseHTTPRequestHandler):
                 numerics.sentinel.publish_gauges()
             except Exception:
                 pass
+            try:
+                # pa_roofline_* gauges (utils/roofline.py): per-program
+                # calibrated predictions, plus the live trace window's
+                # attribution fractions (comms / host-gap / compute /
+                # exposed-transfer) when tracing is on — what
+                # scripts/loadgen.py surfaces in its summary.
+                from .utils import roofline
+
+                roofline.publish_gauges()
+            except Exception:
+                pass
             return self._send(
                 200, registry.render().encode(),
                 content_type="text/plain; version=0.0.4; charset=utf-8",

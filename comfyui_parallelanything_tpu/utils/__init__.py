@@ -3,10 +3,11 @@ from .cleanup import aggressive_cleanup
 from .compile_cache import enable_compilation_cache
 from .metrics import StepTimer, StepStats, trace
 from .checks import assert_finite, checked
-from . import numerics, telemetry, tracing
+from . import numerics, roofline, telemetry, tracing
 
 __all__ = [
     "numerics",
+    "roofline",
     "enable_compilation_cache",
     "get_logger",
     "log_setup_summary",

@@ -14,7 +14,10 @@ other two production questions — "where did the bytes and compiles go" and
   inside a program's calls attribute to that program, records a ``compile``
   span (utils/tracing.py) per compile, feeds ``pa_compile_*`` metrics, and —
   on a program's first compile — runs HLO ``cost_analysis()`` on the lowered
-  program so the registry carries FLOPs/bytes-accessed per executable.
+  program so the registry carries FLOPs/bytes-accessed per executable, and
+  feeds the same analysis through ``utils/roofline.observe_program`` so every
+  named program also carries a calibrated analytic time prediction
+  (``pa_roofline_predicted_s``, the ``roofline`` health section).
 - **Device memory telemetry**: :class:`HbmWatermark` (peak
   ``bytes_in_use`` across snapshots — the ``peak_hbm_bytes`` every bench
   line and ledger record carries) and :class:`MemoryMonitor` (the server's
@@ -316,6 +319,21 @@ class _InstrumentedJit:
             compile_registry.record_cost(
                 self.name, cost.get("flops"), cost.get("bytes accessed")
             )
+            # Roofline prediction (utils/roofline.py): the same first-compile
+            # cost analysis priced against the platform's analytic roofline —
+            # mesh width/platform read off the CONCRETE args' shardings. Its
+            # own flag (PA_ROOFLINE) and its own try/except: a broken
+            # prediction must not cost the compile registry its FLOPs row.
+            try:
+                from . import roofline
+
+                roofline.observe_program(
+                    self.name, flops=cost.get("flops"),
+                    bytes_accessed=cost.get("bytes accessed"),
+                    args=(args, kwargs),
+                )
+            except Exception:
+                pass
         except Exception:
             pass  # accounting must never break the program it accounts
 
@@ -517,6 +535,15 @@ def health_snapshot(queue: dict | None = None,
         out["hbm_utilization_max"] = None
     out["peak_hbm_bytes"] = watermark.peak_bytes or None
     out["compile"] = compile_snapshot()
+    try:
+        # Roofline attribution (utils/roofline.py): per-program calibrated
+        # predictions priced from the compile registry's cost analysis —
+        # the cost table the auto-parallel planner reads.
+        from . import roofline
+
+        out["roofline"] = roofline.programs.snapshot()
+    except Exception:
+        out["roofline"] = None
     try:
         # Numerics sentinel (utils/numerics.py): flag state, non-finite
         # event / quarantined-lane totals, last event, and the fingerprint

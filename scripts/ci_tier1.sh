@@ -29,6 +29,13 @@ env -u PALLAS_AXON_POOL_IPS python scripts/perf_ledger.py --check || exit $?
 # regression; an empty/unfingerprinted ledger is SKIP, never a failure.
 env -u PALLAS_AXON_POOL_IPS python scripts/numerics_audit.py --check || exit $?
 
+# Roofline schema gate (round 13): the latest roofline-carrying ledger
+# record per (rung, platform) must keep roofline_ratio in (0, 1.2] and its
+# attribution buckets non-negative, summing to the recorded wall
+# (scripts/roofline_report.py — an empty/unroofed ledger is SKIP, never a
+# failure). Runs after the perf and numerics gates: same ledger, third lens.
+env -u PALLAS_AXON_POOL_IPS python scripts/roofline_report.py --check || exit $?
+
 # Sampler-coverage gate (round 10): one explicit pass over the lane-vs-solo
 # equivalence matrix + the registry coverage check, so a LaneStepSpec wired
 # into sampling/lane_specs.py but unverified (or missing from

@@ -176,6 +176,16 @@ def _serving_counters(base: str) -> dict:
     m = re.search(r"^pa_serving_batched_fraction ([0-9.eE+-]+)$", text, re.M)
     if m:
         out["pa_serving_batched_fraction"] = float(m.group(1))
+    # Roofline attribution fractions (utils/roofline.py, published at scrape
+    # time when the server traces): where the non-compute time goes —
+    # comms (fleet hops) and host-gap alongside compute/exposed-transfer.
+    for name in ("pa_roofline_compute_fraction",
+                 "pa_roofline_exposed_transfer_fraction",
+                 "pa_roofline_comms_fraction",
+                 "pa_roofline_host_gap_fraction"):
+        m = re.search(rf"^{name} ([0-9.eE+-]+)$", text, re.M)
+        if m:
+            out[name] = float(m.group(1))
     return out
 
 
@@ -400,6 +410,14 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
         "server_step_p50_s": after.get("step_p50_s"),
         "server_step_p95_s": after.get("step_p95_s"),
         "server_lane_wait_p95_s": after.get("lane_wait_p95_s"),
+        # Roofline attribution fractions over the server's live trace window
+        # (utils/roofline.py buckets, scraped from /metrics; None when the
+        # server runs untraced): how much of the wall went to cross-host
+        # comms and to host scheduling gaps rather than device compute.
+        "roofline_comms_fraction": after.get("pa_roofline_comms_fraction"),
+        "roofline_host_gap_fraction": after.get(
+            "pa_roofline_host_gap_fraction"
+        ),
         # Fleet mode (--hosts): per-host client latencies + dispatch deltas,
         # router-side placement/failover deltas, and the CI-gated loss count
         # (router-lost + client-timeout; None outside fleet mode unless a
@@ -435,6 +453,11 @@ def print_human_summary(summary: dict, stream=None) -> None:
         w(f"  fleet     dispatches {f.get('dispatches')}"
           f"  spills {f.get('spills')}  failovers {f.get('failovers')}"
           f"  lost {summary.get('prompts_lost')}\n")
+    if summary.get("roofline_comms_fraction") is not None or \
+            summary.get("roofline_host_gap_fraction") is not None:
+        w(f"  roofline  comms {summary.get('roofline_comms_fraction')}"
+          f"  host-gap {summary.get('roofline_host_gap_fraction')}"
+          f"  (fraction of traced wall)\n")
     for hid, h in (summary.get("hosts") or {}).items():
         w(f"  host {hid:<20} {h['completed']:>3} ok"
           f"  p50 {h['latency_p50_s']}s  p95 {h['latency_p95_s']}s"

@@ -76,6 +76,47 @@ def host_gap_ms(events: list[dict]) -> float | None:
     return sum(gaps) / len(gaps) if gaps else None
 
 
+def _load_roofline():
+    """utils/roofline.py loaded standalone by file path — its module level
+    is stdlib-only and free of package-relative imports by contract (the
+    scripts/roofline_report.py loader), so the bucket-decomposition math
+    has ONE implementation instead of a hand-maintained mirror. The
+    trace-aggregate functions above predate that contract and stay mirrored
+    (drift-pinned by tests/test_observability.py)."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "comfyui_parallelanything_tpu", "utils", "roofline.py",
+    )
+    spec = importlib.util.spec_from_file_location("pa_roofline_ts", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_roofline = _load_roofline()
+
+
+def attribution(events: list[dict]) -> dict | None:
+    """utils/roofline.attribution_from_trace over the whole trace window,
+    plus the two headline fractions (comms / host-gap — where the
+    non-compute time went). Streamed windows measure compute directly and
+    leave host-gap residual; async dispatch windows measure the host gaps
+    and leave compute residual — see the roofline module for the bucket
+    contract."""
+    attr = _roofline.attribution_from_trace(events)
+    if attr is None:
+        return None
+    fr = _roofline.attribution_fractions(attr)
+    return {
+        **attr,
+        "comms_fraction": fr["comms_fraction"],
+        "host_gap_fraction": fr["host_gap_fraction"],
+    }
+
+
 def numerics_counts(events: list[dict]) -> dict:
     """Numerics sentinel spans (utils/numerics.py records an instant span
     per non-finite observation / quarantine when tracing is on) — so a
@@ -128,6 +169,9 @@ def summarize(events: list[dict]) -> dict:
         "stream_overlap_efficiency": None if eff is None else round(eff, 4),
         "lane_wait_p95": None if p95 is None else round(p95, 6),
         "host_gap_ms": None if gap is None else round(gap, 4),
+        # Roofline bucket decomposition of the traced window (comms and
+        # host-gap fractions included — where the non-compute time went).
+        "attribution": attribution(events),
     }
 
 
@@ -162,6 +206,13 @@ def main() -> None:
     print(f"stream_overlap_efficiency: {s['stream_overlap_efficiency']}")
     print(f"lane_wait_p95: {s['lane_wait_p95']}")
     print(f"host_gap_ms: {s['host_gap_ms']}")
+    attr = s["attribution"]
+    if attr is not None:
+        print(f"attribution: compute {attr['compute_s']}s, exposed transfer "
+              f"{attr['exposed_transfer_s']}s, comms {attr['comms_s']}s "
+              f"({attr['comms_fraction']:.1%}), host gap "
+              f"{attr['host_gap_s']}s ({attr['host_gap_fraction']:.1%}) "
+              f"of {attr['wall_s']}s wall")
     n = s["numerics"]
     print(f"numerics: {n['nonfinite_events']} non-finite event(s), "
           f"{n['quarantines']} quarantine(s)"

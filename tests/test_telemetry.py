@@ -544,6 +544,9 @@ _TIME_TIME_ALLOWLIST = (
     # Numerics sentinel event/quarantine records (round 11): epoch stamps on
     # forensic records, same pattern as the telemetry ledger stamps.
     ("utils/numerics.py", '"ts": time.time()'),
+    # Roofline calibration bank (round 13): epoch stamp on the persisted
+    # store, same pattern as the ledger/golden banks.
+    ("utils/roofline.py", '"ts": time.time()'),
 )
 
 
