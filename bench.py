@@ -62,15 +62,17 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # every record it produces lands in PA_EVIDENCE_DIR and carries "dryrun".
 _FAKE_TPU = os.environ.get("PA_FAKE_TPU_PLATFORM")
 _TINY = os.environ.get("PA_BENCH_TINY") == "1"
-_FAIL_INJECT = os.environ.get("PA_FAIL_INJECT")
+_FAIL_INJECT = os.environ.get("PA_FAIL_INJECT") or os.environ.get(
+    "PA_FAULT_PLAN")
 if (_FAKE_TPU or _TINY or _FAIL_INJECT) and not os.environ.get(
         "PA_EVIDENCE_DIR"):
     raise RuntimeError(
-        "PA_FAKE_TPU_PLATFORM / PA_BENCH_TINY / PA_FAIL_INJECT require "
-        "PA_EVIDENCE_DIR: a faked platform, tiny-workload, or "
-        "injected-failure run must never write into the repo's real "
-        "evidence artifacts (the perf ledger and postmortem bundles follow "
-        "the evidence dir)"
+        "PA_FAKE_TPU_PLATFORM / PA_BENCH_TINY / PA_FAIL_INJECT / "
+        "PA_FAULT_PLAN require PA_EVIDENCE_DIR: a faked platform, "
+        "tiny-workload, or injected-failure run must never write into the "
+        "repo's real evidence artifacts (the perf ledger and postmortem "
+        "bundles follow the evidence dir; utils/faults.py enforces the same "
+        "arming rule in-process)"
     )
 _TPU_PLATFORMS = ("tpu", "axon") + ((_FAKE_TPU,) if _FAKE_TPU else ())
 
@@ -774,25 +776,26 @@ def _run_inner() -> None:
         numerics.enable()
     numerics.sentinel.reset()
     inner_step = step
-    # PA_FAIL_INJECT (guarded above by the PA_EVIDENCE_DIR requirement): a
-    # deterministic mid-run failure so the postmortem/forensics path is
+    # Fault injection (round 14, utils/faults.py — the unified registry
+    # absorbing this file's old ad-hoc parser): a deterministic mid-run
+    # failure (``mid-step-crash`` site) so the postmortem/forensics path is
     # rehearsed off-hardware — the round-3 lesson applied to the flight
-    # recorder itself. The third step fails, so the bundle holds real warmup
-    # spans/samples.
-    # ``nan:<lane>`` values target the serving lanes' quarantine rehearsal
-    # (utils/numerics.py), not the bench flight recorder — don't raise here.
-    _fail_at = (
-        3 if _FAIL_INJECT and not _FAIL_INJECT.startswith("nan") else None
-    )
+    # recorder itself. The legacy ``PA_FAIL_INJECT=oom`` alias fires from
+    # step 3 on (the historical contract: the bundle holds real warmup
+    # spans/samples); ``PA_FAULT_PLAN`` schedules arbitrary steps.
+    # ``nan:<lane>`` values parse to the ``lane-nan`` site (the serving
+    # quarantine rehearsal) and never fire here. Arming requires the
+    # PA_EVIDENCE_DIR redirect — enforced at module load above AND by the
+    # registry's own rule.
+    from comfyui_parallelanything_tpu.utils import faults
+
     _step_no = [0]
 
     def step(v):
         _step_no[0] += 1
-        if _fail_at is not None and _step_no[0] >= _fail_at:
-            raise RuntimeError(
-                "RESOURCE_EXHAUSTED: injected failure "
-                f"(PA_FAIL_INJECT={_FAIL_INJECT})"
-            )
+        _act = faults.check("mid-step-crash", key=f"{config_name}:{_step_no[0]}")
+        if _act is not None:
+            raise faults.oom_error(_act)
         with tracing.span("step", cat="bench", rung=config_name):
             out = inner_step(v)
         # HBM watermark sampling during WARMUP steps only: memory_stats() is

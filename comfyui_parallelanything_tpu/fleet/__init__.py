@@ -10,6 +10,7 @@ The router owns no model state; backends are plain ``server.py`` processes
 serving".
 """
 
+from .journal import JournalFollower, PromptJournal
 from .registry import (
     FleetRegistry,
     HashRing,
@@ -24,6 +25,8 @@ __all__ = [
     "FleetRouter",
     "HashRing",
     "HeartbeatClient",
+    "JournalFollower",
+    "PromptJournal",
     "Scoreboard",
     "ledger_capacity_weights",
     "make_router",

@@ -1,0 +1,228 @@
+"""Durable prompt journal + lease: the fleet router's crash story.
+
+PR 7 made the router the fleet's front door — and its single point of
+failure (ROADMAP "Fleet tier hardening" item 1): every retained submission,
+placement decision, and collected history entry lived in one process's
+memory. This module makes that state DURABLE and FOLLOWABLE:
+
+- **``pa-fleet-journal/v1``**: an append-only JSONL file the active router
+  writes at the three lifecycle edges — ``submit`` (the full graph +
+  extra_data + placement key: everything needed to re-place the prompt from
+  nothing), ``dispatch`` (which host/backend_pid owns it now), ``resolve``
+  (the final history entry, verbatim — so a router that never saw the
+  prompt live can still serve ``GET /history/{id}``). Appends are
+  line-atomic (single ``write`` of one ``\\n``-terminated line) and flushed
+  per record; ``PA_JOURNAL_FSYNC=1`` adds an fsync per append for real
+  crash-consistency on shared storage.
+- **a lease**: ``<journal>.lease`` rewritten atomically by the active
+  router every monitor sweep (wall-clock epoch stamps — the one clock two
+  processes share; monotonic clocks are process-local). A standby that sees
+  the lease go stale past its TTL declares the primary dead and takes over.
+- **replay**: folding a journal left-to-right reconstructs every prompt's
+  last known state. Unresolved prompts re-enter the standby's normal
+  placement machinery — completed work is re-collected from live backends
+  (the backend still holds the history entry under the recorded
+  backend_pid), genuinely lost work replays from step 0 on a sibling, and
+  the round-10 fold_in RNG contract makes the replayed latents bitwise
+  equal to the uninterrupted run. Router-kill-mid-denoise loses zero
+  prompts, which dryrun §18 and the chaos smoke gate on.
+
+Tailing works over a SHARED PATH (both routers see one file) or over HTTP:
+the active router serves ``GET /journal?offset=N`` (raw bytes from offset)
+and :meth:`JournalFollower.poll` appends whatever is new to the standby's
+local copy — same fold, different transport.
+
+Pure stdlib; nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+JOURNAL_SCHEMA = "pa-fleet-journal/v1"
+
+# Lifecycle edges. "takeover" marks a standby assuming the lease (an audit
+# row — replay treats it as a no-op for prompt state).
+EVENTS = ("submit", "dispatch", "resolve", "takeover")
+
+
+class PromptJournal:
+    """Append side + replay side of one journal file."""
+
+    def __init__(self, path: str, fsync: bool | None = None):
+        self.path = path
+        self.lease_path = path + ".lease"
+        if fsync is None:
+            fsync = os.environ.get("PA_JOURNAL_FSYNC") == "1"
+        self.fsync = bool(fsync)
+        self._lock = threading.Lock()
+        self._f = None
+
+    # -- append side ---------------------------------------------------------
+
+    def _file(self):
+        if self._f is None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "ab")
+        return self._f
+
+    def append(self, ev: str, pid: str, **fields) -> None:
+        """One journal record. Best-effort by contract beyond the flush: a
+        full disk degrades durability, never availability (the in-memory
+        router keeps serving; the log says so)."""
+        assert ev in EVENTS, f"unknown journal event {ev!r}"
+        rec = {"schema": JOURNAL_SCHEMA, "ev": ev, "pid": pid,
+               "ts": time.time(), **fields}
+        line = (json.dumps(rec, default=str) + "\n").encode()
+        try:
+            with self._lock:
+                f = self._file()
+                f.write(line)
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+        except OSError as e:
+            log.error("journal append failed (%s): %s", self.path, e)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+    # -- lease ---------------------------------------------------------------
+
+    def write_lease(self, router_id: str) -> None:
+        """Atomic replace — a reader never sees a half-written lease."""
+        tmp = f"{self.lease_path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(self.lease_path)),
+                        exist_ok=True)
+            with open(tmp, "w") as f:
+                f.write(json.dumps({
+                    "router_id": router_id, "ts": time.time(),
+                    "pid": os.getpid(),
+                }))
+            os.replace(tmp, self.lease_path)
+        except OSError as e:
+            log.error("lease write failed (%s): %s", self.lease_path, e)
+
+    def read_lease(self) -> dict | None:
+        try:
+            with open(self.lease_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def lease_stale(self, ttl_s: float, holder_not: str | None = None) -> bool:
+        """True when no live lease exists: missing/corrupt, older than
+        ``ttl_s``, or (with ``holder_not``) held by that id — a router never
+        treats its OWN lease as a dead primary."""
+        lease = self.read_lease()
+        if lease is None:
+            return True
+        if holder_not is not None and lease.get("router_id") == holder_not:
+            return False
+        try:
+            age = time.time() - float(lease.get("ts", 0))
+        except (TypeError, ValueError):
+            return True
+        return age > ttl_s
+
+    # -- replay side ---------------------------------------------------------
+
+    @staticmethod
+    def iter_records(path: str):
+        """Parsed records in append order; a torn final line (crash mid-
+        write) is skipped, never fatal."""
+        try:
+            with open(path, "rb") as f:
+                for raw in f:
+                    try:
+                        rec = json.loads(raw)
+                    except ValueError:
+                        continue  # torn tail / garbage line
+                    if isinstance(rec, dict) and rec.get("pid"):
+                        yield rec
+        except OSError:
+            return
+
+    @classmethod
+    def fold(cls, records) -> dict[str, dict]:
+        """pid → last known state, folding lifecycle edges left-to-right:
+        ``{"phase": submit|dispatch|resolve, "graph", "extra", "key",
+        "number", "host", "backend_pid", "entry", "status"}``."""
+        table: dict[str, dict] = {}
+        for rec in records:
+            ev = rec.get("ev")
+            pid = rec["pid"]
+            st = table.get(pid)
+            if ev == "submit":
+                table[pid] = {
+                    "phase": "submit", "graph": rec.get("graph"),
+                    "extra": rec.get("extra"), "key": rec.get("key"),
+                    "number": rec.get("number"), "host": None,
+                    "backend_pid": None, "entry": None, "status": None,
+                }
+            elif ev == "dispatch" and st is not None:
+                st["phase"] = "dispatch"
+                st["host"] = rec.get("host")
+                st["backend_pid"] = rec.get("backend_pid")
+            elif ev == "resolve" and st is not None:
+                st["phase"] = "resolve"
+                st["entry"] = rec.get("entry")
+                st["status"] = rec.get("status")
+        return table
+
+    def replay(self) -> dict[str, dict]:
+        return self.fold(self.iter_records(self.path))
+
+
+class JournalFollower:
+    """HTTP tail of an active router's journal (``GET /journal?offset=N``)
+    into a local file a standby's :class:`PromptJournal` then replays — the
+    no-shared-filesystem deployment. ``poll()`` returns how many bytes
+    landed; transport errors return 0 (the primary being down is exactly
+    when the standby must keep deciding on what it already has)."""
+
+    def __init__(self, primary_base: str, local_path: str,
+                 timeout_s: float = 5.0):
+        self.primary_base = primary_base.rstrip("/")
+        self.local_path = local_path
+        self.timeout_s = float(timeout_s)
+        self.offset = 0
+        self.unreachable = False   # the standby's primary-death signal
+        if os.path.exists(local_path):
+            self.offset = os.path.getsize(local_path)
+
+    def poll(self) -> int:
+        try:
+            with urllib.request.urlopen(
+                f"{self.primary_base}/journal?offset={self.offset}",
+                timeout=self.timeout_s,
+            ) as r:
+                chunk = r.read()
+        except (OSError, ValueError):
+            self.unreachable = True
+            return 0
+        self.unreachable = False
+        if not chunk:
+            return 0
+        d = os.path.dirname(os.path.abspath(self.local_path))
+        os.makedirs(d, exist_ok=True)
+        with open(self.local_path, "ab") as f:
+            f.write(chunk)
+        self.offset += len(chunk)
+        return len(chunk)

@@ -44,6 +44,7 @@ import threading
 import time
 import urllib.request
 
+from ..utils import retry
 from ..utils.logging import get_logger
 
 log = get_logger()
@@ -238,18 +239,46 @@ class HeartbeatClient:
     POST ``{host_id, base}`` to the router's ``/fleet/register`` every
     ``interval_s`` so the host joins the ring elastically and falls out when
     it dies. Best-effort by design: a down router must never take the
-    backend with it."""
+    backend with it.
+
+    Round 14: reconnects ride the shared retry policy (utils/retry.py) — an
+    unreachable router used to be re-beat at the fixed cadence forever (a
+    hot loop of socket timeouts when the interval is short); now consecutive
+    failures back off exponentially (deterministic jitter, capped) and the
+    first success snaps back to the normal cadence. ``on_rejoin`` fires when
+    the router reports this beat JOINED the ring anew (we had fallen off —
+    router restart, standby takeover, our beats lost): the server wires it
+    to ``resume_if_auto_drained()`` so a returning host re-opens admission
+    instead of rejoining dark — but NEVER overrides an operator-initiated
+    drain (a router restart mid-maintenance must not resurrect the host). A
+    live host's refresh beats (``joined=False``) never fire it."""
 
     def __init__(self, router_base: str, host_id: str, base: str,
-                 interval_s: float = 2.0):
+                 interval_s: float = 2.0, on_rejoin=None,
+                 retry_policy: "retry.RetryPolicy | None" = None):
         self.router_base = router_base.rstrip("/")
         self.host_id = host_id
         self.base = base
         self.interval_s = float(interval_s)
+        self.on_rejoin = on_rejoin
+        self.retry_policy = retry_policy or dataclasses.replace(
+            retry.HEARTBEAT, base_s=max(0.5, self.interval_s)
+        )
+        self._failures = 0
+        self._ever_joined = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def beat_once(self, timeout: float = 5.0) -> bool:
+        # Fault site (utils/faults.py): a lost heartbeat is silently
+        # swallowed — the router sees this host go dark exactly as if the
+        # network ate the POST (TTL expiry → failover), while the host
+        # itself stays healthy. The chaos rehearsal for asymmetric partitions.
+        from ..utils import faults
+
+        if faults.check("heartbeat-loss", key=self.host_id) is not None:
+            self._failures += 1
+            return False
         req = urllib.request.Request(
             self.router_base + "/fleet/register",
             data=json.dumps(
@@ -258,15 +287,34 @@ class HeartbeatClient:
             headers={"Content-Type": "application/json"}, method="POST",
         )
         try:
-            with urllib.request.urlopen(req, timeout=timeout):
-                return True
-        except OSError:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                resp = json.loads(r.read() or b"{}")
+        except (OSError, ValueError):
+            self._failures += 1
             return False
+        rejoined = bool(resp.get("joined")) and self._ever_joined
+        self._ever_joined = True
+        self._failures = 0
+        if rejoined and self.on_rejoin is not None:
+            try:
+                self.on_rejoin()
+            except Exception:  # noqa: BLE001 — a rejoin hook must not kill beats
+                pass
+        return True
+
+    def next_wait_s(self) -> float:
+        """The loop's sleep before the next beat: the normal cadence while
+        healthy, the policy's backoff window after consecutive failures."""
+        if self._failures == 0:
+            return self.interval_s
+        return max(self.interval_s, self.retry_policy.backoff_s(
+            self._failures - 1, key=self.host_id
+        ))
 
     def _loop(self) -> None:
         while not self._stop.is_set():
             self.beat_once()
-            self._stop.wait(self.interval_s)
+            self._stop.wait(self.next_wait_s())
 
     def start(self) -> "HeartbeatClient":
         self._thread = threading.Thread(

@@ -43,23 +43,32 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import threading
 import time
 import urllib.error
 import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
+from ..utils import retry as retry_mod
 from ..utils import tracing
 from ..utils.logging import get_logger
 from ..utils.metrics import registry
+from .journal import JournalFollower, PromptJournal
 from .registry import FleetRegistry, stable_hash
 from .scoreboard import Scoreboard
 
 log = get_logger()
 
 FLEET_HEALTH_SCHEMA = "pa-fleet-health/v1"
+
+
+class StandbyRouter(RuntimeError):
+    """This router is a standby (the primary holds the lease): submissions
+    are refused with 503 — clients fail over to the primary, or wait for
+    this router's takeover."""
 
 
 class NoHealthyHost(RuntimeError):
@@ -122,11 +131,19 @@ class FleetPrompt:
     # submitting → inflight → done (or → lost); failover resets to queued.
     # "submitting" (the initial state) is OWNED by the submit() call —
     # the monitor's queued-retry sweep must not see a half-submitted
-    # prompt as retryable, or it double-dispatches it.
+    # prompt as retryable, or it double-dispatches it. A standby router
+    # additionally holds journal SHADOWS ("shadow-submit" /
+    # "shadow-inflight") that become live queued/inflight prompts at
+    # takeover.
     status: str = "submitting"
     entry: dict | None = None
     submit_monotonic: float = dataclasses.field(default_factory=time.monotonic)
     trace_submit_us: float | None = None
+    # Queued-retry backoff (utils/retry.py): the monitor re-dispatches a
+    # queued prompt only once its window elapses — no hot-looping the whole
+    # queue against a saturated/empty fleet every 50 ms sweep.
+    retry_at: float = 0.0
+    queue_retries: int = 0
 
 
 class FleetRouter:
@@ -141,6 +158,10 @@ class FleetRouter:
                  saturation_depth: int = 4, max_attempts: int = 4,
                  monitor_s: float = 0.2, hbm_watermark: float = 0.95,
                  http_timeout_s: float = 30.0, max_history: int = 4096,
+                 journal: PromptJournal | None = None,
+                 standby: bool = False, lease_ttl_s: float = 10.0,
+                 follower: JournalFollower | None = None,
+                 retry_policy: retry_mod.RetryPolicy | None = None,
                  auto: bool = True):
         self.registry = fleet_registry or FleetRegistry()
         self.scoreboard = scoreboard or Scoreboard()
@@ -153,6 +174,31 @@ class FleetRouter:
         # graph + entry of every prompt ever served must not accumulate for
         # the router's lifetime); in-flight prompts are never evicted.
         self.max_history = int(max_history)
+        # Router HA (fleet/journal.py): the ACTIVE router journals every
+        # submit/dispatch/resolve and heartbeats the lease; a STANDBY tails
+        # the journal (shared path, or HTTP via ``follower``), serves
+        # /history from the shadows, and takes over — replaying every
+        # unresolved prompt through normal placement — when the primary's
+        # lease goes stale (or, in HTTP mode, its journal feed dies).
+        self.journal = journal
+        self.active = not standby
+        if not self.active and self.journal is None:
+            raise ValueError(
+                "a standby router requires a journal (what would it replay?)"
+            )
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.follower = follower
+        self._follow_failures = 0
+        self._journal_offset = 0
+        # A standby younger than one lease TTL has no basis to judge the
+        # primary dead (it may simply not have observed a lease yet — e.g.
+        # both routers racing up): minimum dwell before any takeover.
+        self._standby_since = time.monotonic()
+        # Queued-retry backoff shape (utils/retry.py).
+        self.retry_policy = retry_policy or retry_mod.RetryPolicy(
+            max_attempts=1_000_000, base_s=max(0.05, self.monitor_s),
+            cap_s=5.0, jitter=0.25,
+        )
         self.router_id = f"router-{uuid.uuid4().hex[:8]}"
         self.prompts: dict[str, FleetPrompt] = {}
         self._inflight: dict[str, int] = {}   # host_id → router-side count
@@ -164,6 +210,8 @@ class FleetRouter:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        if self.active and self.journal is not None:
+            self.journal.write_lease(self.router_id)
         if auto:
             self._thread = threading.Thread(
                 target=self._loop, name="pa-fleet-monitor", daemon=True
@@ -210,11 +258,19 @@ class FleetRouter:
         return (polled is not None
                 and polled >= self._last_drop.get(host_id, 0.0))
 
-    def place(self, key: str, exclude=()) -> tuple[str, str, bool]:
+    def place(self, key: str, exclude=(),
+              prefer_warm: bool = False) -> tuple[str, str, bool]:
         """(host_id, base, spilled) for a model key: the first accepting
         host in ring order that is not saturated; if every accepting host is
         saturated, the least-loaded one (bounded queueing beats a 503 while
-        capacity exists). Raises NoHealthyHost when nothing is accepting."""
+        capacity exists). Raises NoHealthyHost when nothing is accepting.
+
+        ``prefer_warm`` (the failover/replay path): hosts advertising the
+        key in their pa-health/v3 ``warm_keys`` are tried first, ring order
+        within each tier — replaying a dead host's prompt on a warm sibling
+        skips the compile + weight staging a cold primary would pay
+        (ROADMAP fleet item 3). Fresh traffic keeps pure ring order: warm
+        affinity is already where the ring points."""
         seq = self.registry.sequence(key)
         candidates = [
             h for h in seq
@@ -226,6 +282,10 @@ class FleetRouter:
                 f"(ring: {len(seq)} hosts, excluded: {sorted(exclude)})"
             )
         primary = seq[0]
+        if prefer_warm:
+            warm = [h for h in candidates if self.scoreboard.warm(h, key)]
+            if warm:
+                candidates = warm + [h for h in candidates if h not in warm]
         for h in candidates:
             if not self.scoreboard.saturated(
                 h, extra_inflight=self._router_inflight(h),
@@ -242,10 +302,21 @@ class FleetRouter:
 
     # -- submission / dispatch ---------------------------------------------
 
+    def _journal_resolve(self, fp: FleetPrompt, status: str | None = None) -> None:
+        if self.journal is not None:
+            self.journal.append("resolve", fp.pid,
+                                status=status or fp.status, entry=fp.entry)
+
     def submit(self, graph: dict, extra: dict | None = None) -> tuple[str, int]:
         """Admit one prompt into the fleet; returns (router prompt_id,
         submission number). Raises NoHealthyHost / FleetSaturated when no
-        backend can take it (explicit backpressure, the 503/429 surface)."""
+        backend can take it (explicit backpressure, the 503/429 surface),
+        StandbyRouter when this router doesn't hold the lease."""
+        if not self.active:
+            raise StandbyRouter(
+                f"router {self.router_id} is a standby — the primary holds "
+                f"the lease; retry there (or here after takeover)"
+            )
         pid = uuid.uuid4().hex
         with self._lock:
             self._counter += 1
@@ -257,11 +328,22 @@ class FleetRouter:
         )
         with self._lock:
             self.prompts[pid] = fp
+        # Journal BEFORE the dispatch: a router that dies mid-placement must
+        # still leave the submission recoverable (the client has no pid yet
+        # on that path, but the standby resolving an orphan beats losing a
+        # submission whose POST raced the crash).
+        if self.journal is not None:
+            self.journal.append("submit", pid, graph=graph, extra=extra,
+                                key=fp.key, number=number)
         try:
             self._dispatch(fp)
         except (NoHealthyHost, FleetSaturated, BackendRejected):
             with self._lock:
                 self.prompts.pop(pid, None)
+            # The client got an error for this submission — the journal must
+            # say so, or a standby would faithfully replay a prompt its
+            # client believes was refused.
+            self._journal_resolve(fp, status="rejected")
             raise
         return pid, number
 
@@ -275,7 +357,8 @@ class FleetRouter:
                     if fp.status in ("done", "lost")][:excess]:
             del self.prompts[pid]
 
-    def _dispatch(self, fp: FleetPrompt, exclude: set | None = None) -> None:
+    def _dispatch(self, fp: FleetPrompt, exclude: set | None = None,
+                  prefer_warm: bool = False) -> None:
         """Place and forward one prompt, walking the ring past refusing or
         unreachable hosts. On success the prompt is ``inflight``; exhausting
         every candidate raises (submit path) — failover callers catch and
@@ -292,7 +375,9 @@ class FleetRouter:
             # the POST fails).
             with self._lock:
                 try:
-                    host, base, spilled = self.place(fp.key, exclude=exclude)
+                    host, base, spilled = self.place(
+                        fp.key, exclude=exclude, prefer_warm=prefer_warm
+                    )
                 except NoHealthyHost:
                     if saw_backpressure:
                         # Everything healthy refused with 429/503: the fleet
@@ -321,23 +406,37 @@ class FleetRouter:
                 )
             except urllib.error.HTTPError as e:
                 self._release(host)
-                if e.code not in (429, 503):
-                    # Non-retryable client error (400 bad graph, …): the
-                    # REQUEST is at fault, not the host — retrying it on
-                    # siblings would burn the retry budget into the
-                    # CI-gated lost counter for a client mistake.
-                    try:
-                        detail = json.loads(e.read() or b"{}").get("error")
-                    except Exception:  # noqa: BLE001 — body is best-effort
-                        detail = None
-                    raise BackendRejected(
-                        e.code, detail or f"backend refused: HTTP {e.code}"
-                    ) from e
-                # Alive but refusing with backpressure (429 bounded queue,
-                # 503 draining): not a health failure — exclude, walk on.
-                saw_backpressure = True
-                exclude.add(host)
-                continue
+                if e.code in (429, 503):
+                    # Alive but refusing with backpressure (429 bounded
+                    # queue, 503 draining): not a health failure — exclude,
+                    # walk on.
+                    saw_backpressure = True
+                    exclude.add(host)
+                    continue
+                if e.code >= 500:
+                    # Server-side failure (500/502/504 — a half-dead backend
+                    # whose handler errors while its health endpoint still
+                    # answers): the HOST is at fault, exactly like a refused
+                    # socket — feed the scoreboard's failure counter and
+                    # walk the ring. (Chaos finding, round 14: this used to
+                    # be classified as a client error and surfaced to the
+                    # submitter — one injected 5xx cost a prompt.)
+                    self.scoreboard.record_failure(
+                        host, base, f"dispatch: HTTP {e.code}"
+                    )
+                    exclude.add(host)
+                    continue
+                # Non-retryable client error (400 bad graph, …): the
+                # REQUEST is at fault, not the host — retrying it on
+                # siblings would burn the retry budget into the
+                # CI-gated lost counter for a client mistake.
+                try:
+                    detail = json.loads(e.read() or b"{}").get("error")
+                except Exception:  # noqa: BLE001 — body is best-effort
+                    detail = None
+                raise BackendRejected(
+                    e.code, detail or f"backend refused: HTTP {e.code}"
+                ) from e
             except OSError as e:
                 self.scoreboard.record_failure(host, base, f"dispatch: {e}")
                 self._release(host)
@@ -347,6 +446,10 @@ class FleetRouter:
                 fp.host_id = host
                 fp.backend_pid = resp.get("prompt_id")
                 fp.status = "inflight"
+            if self.journal is not None:
+                self.journal.append("dispatch", fp.pid, host=host,
+                                    backend_pid=fp.backend_pid,
+                                    attempt=fp.attempts)
             registry.counter("pa_fleet_dispatch_total",
                              labels={"host": host},
                              help="prompts forwarded per backend")
@@ -382,6 +485,7 @@ class FleetRouter:
                 },
                 "outputs": {},
             }
+        self._journal_resolve(fp)
         registry.counter("pa_fleet_prompts_lost_total",
                          help="prompts abandoned after the retry budget — "
                               "zero on a healthy fleet (CI-gated)")
@@ -408,6 +512,7 @@ class FleetRouter:
                     0, self._inflight.get(fp.host_id, 0) - 1
                 )  # inline (holds the lock) — not _release
                 self._last_drop[fp.host_id] = time.monotonic()
+        self._journal_resolve(fp)
         registry.counter("pa_fleet_completed_total",
                          help="prompts whose history entry was collected")
         if tracing.on() and fp.trace_submit_us is not None:
@@ -449,20 +554,28 @@ class FleetRouter:
         log.warning("fleet failover: %d prompt(s) off %s (%s)",
                     len(victims), host_id, reason)
         for fp in victims:
-            self._dispatch_or_queue(fp, exclude={host_id})
+            self._dispatch_or_queue(fp, exclude={host_id}, prefer_warm=True)
         return len(victims)
 
-    def _dispatch_or_queue(self, fp: FleetPrompt, exclude=None) -> None:
-        """Re-dispatch a claimed prompt; park it ``queued`` (monitor retries)
-        when no backend can take it now, and resolve it as an error entry on
-        a non-retryable backend rejection (no client thread is waiting on a
-        failover path, so the rejection lands in its history entry)."""
+    def _dispatch_or_queue(self, fp: FleetPrompt, exclude=None,
+                           prefer_warm: bool = False) -> None:
+        """Re-dispatch a claimed prompt; park it ``queued`` (monitor retries,
+        on the retry policy's backoff) when no backend can take it now, and
+        resolve it as an error entry on a non-retryable backend rejection
+        (no client thread is waiting on a failover path, so the rejection
+        lands in its history entry). Failover/replay callers pass
+        ``prefer_warm`` — a warm sibling beats a cold primary for a prompt
+        that must restart from step 0 anyway."""
         try:
-            self._dispatch(fp, exclude=exclude)
+            self._dispatch(fp, exclude=exclude, prefer_warm=prefer_warm)
         except (NoHealthyHost, FleetSaturated):
             with self._lock:
                 if fp.status == "submitting":
                     fp.status = "queued"
+                    fp.retry_at = time.monotonic() + self.retry_policy.backoff_s(
+                        fp.queue_retries, key=fp.pid
+                    )
+                    fp.queue_retries += 1
         except BackendRejected as e:
             with self._lock:
                 fp.status = "done"
@@ -476,12 +589,44 @@ class FleetRouter:
                     },
                     "outputs": {},
                 }
+            self._journal_resolve(fp)
 
     # -- the monitor sweep --------------------------------------------------
 
     def poll_once(self) -> None:
-        """One monitor sweep: expire silent hosts, poll due health, fail
-        over the dead, collect finished histories, retry queued prompts."""
+        """One monitor sweep. Active: heartbeat the lease, expire silent
+        hosts, poll due health, fail over the dead, collect finished
+        histories, retry due queued prompts. Standby: tail the journal into
+        shadows and take over when the primary is provably dead."""
+        if not self.active:
+            self._standby_sweep()
+            return
+        if self.journal is not None:
+            # Ownership re-check BEFORE refreshing: if another router holds
+            # a FRESH lease (a standby declared us dead — e.g. one of our
+            # sweeps stalled on a blackholed backend past the TTL), step
+            # down instead of fighting it. A false takeover then costs one
+            # orderly demotion, never a permanent dual-active split brain
+            # (both dispatching the same prompts, both appending the
+            # journal). The demoted router keeps its prompt table and
+            # becomes a live standby for the new primary.
+            lease = self.journal.read_lease()
+            if (lease is not None
+                    and lease.get("router_id") != self.router_id
+                    and not self.journal.lease_stale(self.lease_ttl_s)):
+                self.active = False
+                self._standby_since = time.monotonic()
+                self._journal_offset = 0  # re-fold the journal as shadows
+                registry.counter("pa_fleet_stepdown_total",
+                                 help="active routers that yielded to a "
+                                      "fresher lease holder")
+                log.warning(
+                    "fleet router %s STEPPED DOWN: %s holds a fresh lease",
+                    self.router_id, lease.get("router_id"),
+                )
+                self._standby_sweep()
+                return
+            self.journal.write_lease(self.router_id)
         for hid in self.registry.expire():
             self.failover_host(hid, "heartbeat expired")
         hosts = {hid: info.base for hid, info in self.registry.hosts().items()}
@@ -489,15 +634,147 @@ class FleetRouter:
         for hid in hosts:
             if self.scoreboard.dead(hid):
                 self.failover_host(hid, "health polls failing")
+        # Adopted-after-takeover prompts can reference a host this router
+        # never saw register (it heartbeat only the dead primary): a host
+        # that isn't in the ring can never be collected from — fail its
+        # prompts over to ring members.
+        with self._lock:
+            orphaned = {
+                fp.host_id for fp in self.prompts.values()
+                if fp.status == "inflight" and fp.host_id
+                and fp.host_id not in hosts
+            }
+        for hid in orphaned:
+            self.failover_host(hid, "host not in the ring")
         self._collect_histories()
         with self._lock:
+            now = time.monotonic()
             queued = [fp for fp in self.prompts.values()
-                      if fp.status == "queued"]
+                      if fp.status == "queued" and fp.retry_at <= now]
             for fp in queued:
                 fp.status = "submitting"  # claimed by this sweep
             self._prune_history()
         for fp in queued:
-            self._dispatch_or_queue(fp)
+            # A queued prompt that has already failed over restarts from
+            # step 0 wherever it lands — warm siblings first.
+            self._dispatch_or_queue(fp, prefer_warm=fp.failovers > 0)
+
+    # -- standby / takeover (fleet/journal.py) -------------------------------
+
+    def _tail_shadow(self) -> None:
+        """Fold any new journal records into shadow prompts (standby only).
+        Only complete lines are consumed; a torn tail stays unread until the
+        writer finishes it."""
+        if self.follower is not None:
+            ok = self.follower.poll() or not self.follower.unreachable
+            self._follow_failures = 0 if ok else self._follow_failures + 1
+        path = self.journal.path
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        if size <= self._journal_offset:
+            return
+        with open(path, "rb") as f:
+            f.seek(self._journal_offset)
+            data = f.read(size - self._journal_offset)
+        last_nl = data.rfind(b"\n")
+        if last_nl < 0:
+            return
+        self._journal_offset += last_nl + 1
+        for raw in data[: last_nl + 1].splitlines():
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("pid"):
+                self._apply_shadow(rec)
+
+    def _apply_shadow(self, rec: dict) -> None:
+        ev, pid = rec.get("ev"), rec["pid"]
+        with self._lock:
+            fp = self.prompts.get(pid)
+            if ev == "submit":
+                self.prompts[pid] = FleetPrompt(
+                    pid=pid, graph=rec.get("graph") or {},
+                    extra=rec.get("extra"),
+                    key=rec.get("key") or model_key(rec.get("graph") or {}),
+                    number=int(rec.get("number") or 0),
+                    status="shadow-submit",
+                )
+            elif ev == "dispatch" and fp is not None:
+                fp.status = "shadow-inflight"
+                fp.host_id = rec.get("host")
+                fp.backend_pid = rec.get("backend_pid")
+                fp.attempts = int(rec.get("attempt") or fp.attempts)
+            elif ev == "resolve" and fp is not None:
+                entry = rec.get("entry")
+                if rec.get("status") == "rejected" or entry is None:
+                    # The primary told ITS client no (or resolved without an
+                    # entry): nothing to serve, nothing to replay.
+                    self.prompts.pop(pid, None)
+                else:
+                    fp.status = "lost" if rec.get("status") == "lost" else "done"
+                    fp.entry = entry
+
+    def _primary_dead(self) -> bool:
+        if time.monotonic() - self._standby_since < self.lease_ttl_s:
+            return False  # minimum dwell — see __init__
+        if self.follower is not None:
+            # HTTP mode: the standby cannot read the primary's lease file —
+            # the journal feed dying for fail_after-equivalent polls IS the
+            # death signal.
+            return self._follow_failures >= 3
+        return self.journal.lease_stale(self.lease_ttl_s,
+                                        holder_not=self.router_id)
+
+    def _standby_sweep(self) -> None:
+        self._tail_shadow()
+        with self._lock:
+            # Resolved shadows obey the same history budget as the active
+            # router's table — a standby mirroring a busy primary for weeks
+            # must not hold every prompt's graph + entry forever.
+            self._prune_history()
+        if self._primary_dead():
+            self.takeover()
+
+    def takeover(self) -> int:
+        """Assume the lease: shadows become live prompts — resolved ones
+        serve /history as-is; dispatched ones go back to ``inflight`` (the
+        normal monitor collects them from live backends, or fails them over
+        off dead ones — replay-from-0 on a warm sibling, bitwise-equal by
+        the fold_in contract); submitted-only ones queue for placement.
+        Returns how many unresolved prompts were adopted."""
+        self._tail_shadow()  # drain whatever the primary managed to write
+        with self._lock:
+            if self.active:
+                return 0
+            self.active = True
+            adopted = 0
+            max_number = self._counter
+            for fp in self.prompts.values():
+                if fp.status == "shadow-inflight":
+                    fp.status = "inflight"
+                    if fp.host_id:
+                        self._inflight[fp.host_id] = (
+                            self._inflight.get(fp.host_id, 0) + 1
+                        )
+                    adopted += 1
+                elif fp.status == "shadow-submit":
+                    fp.status = "queued"
+                    adopted += 1
+                max_number = max(max_number, fp.number)
+            # Submission numbers keep ascending across the failover.
+            self._counter = max_number
+        if self.journal is not None:
+            self.journal.write_lease(self.router_id)
+            self.journal.append("takeover", "-", router_id=self.router_id,
+                                adopted=adopted)
+        registry.counter("pa_fleet_takeover_total",
+                         help="standby routers that assumed the lease")
+        log.warning("fleet router %s TOOK OVER (%d unresolved prompt(s) "
+                    "adopted)", self.router_id, adopted)
+        return adopted
 
     def _collect_one(self, fp: FleetPrompt,
                      timeout: float | None = None) -> None:
@@ -570,6 +847,7 @@ class FleetRouter:
         """Broadcast POST /interrupt to every live backend (best-effort) and
         drop queued prompts."""
         dropped = 0
+        interrupted: list[FleetPrompt] = []
         with self._lock:
             for fp in self.prompts.values():
                 if fp.status == "queued":
@@ -582,7 +860,10 @@ class FleetRouter:
                                    "completed": False},
                         "outputs": {},
                     }
+                    interrupted.append(fp)
                     dropped += 1
+        for fp in interrupted:
+            self._journal_resolve(fp)
         for hid, info in self.registry.hosts().items():
             try:
                 resp = self._post(info.base, "/interrupt", {}, timeout=10)
@@ -627,6 +908,8 @@ class FleetRouter:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if self.journal is not None:
+            self.journal.close()
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -660,10 +943,34 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return self._send(
                 200, r.history(parts[1] if len(parts) == 2 else None)
             )
+        if url.path == "/journal":
+            # Raw journal bytes from ``offset`` — the HTTP tail a standby's
+            # JournalFollower drains (fleet/journal.py). 404 when this
+            # router keeps no journal.
+            if r.journal is None:
+                return self._send(404, {"error": "router runs no journal"})
+            qs = parse_qs(url.query)
+            try:
+                offset = int(qs.get("offset", ["0"])[0])
+            except ValueError:
+                return self._send(400, {"error": "offset must be an int"})
+            try:
+                with open(r.journal.path, "rb") as f:
+                    f.seek(max(0, offset))
+                    chunk = f.read()
+            except OSError:
+                chunk = b""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Content-Length", str(len(chunk)))
+            self.end_headers()
+            return self.wfile.write(chunk)
         if url.path == "/health":
             doc = {
                 "schema": FLEET_HEALTH_SCHEMA,
                 "router_id": r.router_id,
+                "role": "active" if r.active else "standby",
+                "journal": r.journal.path if r.journal is not None else None,
                 "hosts": r.scoreboard.snapshot(),
                 "ring": r.registry.snapshot(),
                 **r.stats(),
@@ -706,6 +1013,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 )
             try:
                 pid, number = r.submit(graph, payload.get("extra_data"))
+            except StandbyRouter as e:
+                return self._send(503, {"error": str(e), "role": "standby"})
             except FleetSaturated as e:
                 return self._send(429, {"error": str(e)})
             except NoHealthyHost as e:
@@ -786,17 +1095,45 @@ def main() -> None:
     ap.add_argument("--max-attempts", type=int, default=4)
     ap.add_argument("--trace", action="store_true",
                     help="enable span tracing (fleet-prompt / fleet-hop)")
+    ap.add_argument("--journal", default=None,
+                    help="durable prompt-journal path (pa-fleet-journal/v1 "
+                         "JSONL + <path>.lease): submissions survive this "
+                         "process — a standby tailing the same path (or "
+                         "--follow) replays them after a crash")
+    ap.add_argument("--standby", action="store_true",
+                    help="start as a standby: tail --journal, serve "
+                         "/history from its shadows, refuse /prompt (503), "
+                         "and take over when the primary's lease goes stale")
+    ap.add_argument("--follow", default=None,
+                    help="primary router base URL: tail its journal over "
+                         "HTTP (GET /journal) into --journal instead of "
+                         "reading a shared path (implies --standby)")
+    ap.add_argument("--lease-ttl-s", type=float, default=10.0,
+                    help="lease staleness a standby treats as primary death "
+                         "(keep it ABOVE the scoreboard poll timeout: a "
+                         "sweep stalled on one slow backend must not read "
+                         "as router death)")
     args = ap.parse_args()
     if args.trace:
         tracing.enable()
+    if args.follow and not args.journal:
+        ap.error("--follow requires --journal (the local tail copy)")
+    if args.standby and not args.journal:
+        ap.error("--standby requires --journal (what to replay)")
     srv, router = make_router(
         args.host, args.port,
         backends=[b for b in args.backends.split(",") if b],
         fleet_registry=FleetRegistry(ttl_s=args.ttl_s),
         scoreboard=Scoreboard(poll_s=args.poll_s),
         saturation_depth=args.depth, max_attempts=args.max_attempts,
+        journal=PromptJournal(args.journal) if args.journal else None,
+        standby=bool(args.standby or args.follow),
+        lease_ttl_s=args.lease_ttl_s,
+        follower=(JournalFollower(args.follow, args.journal)
+                  if args.follow else None),
     )
-    print(f"ParallelAnything fleet router on http://{args.host}:{args.port}")
+    role = "standby" if not router.active else "router"
+    print(f"ParallelAnything fleet {role} on http://{args.host}:{args.port}")
     try:
         srv.serve_forever()
     except KeyboardInterrupt:

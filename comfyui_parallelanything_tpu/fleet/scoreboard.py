@@ -2,7 +2,7 @@
 
 The router never guesses about a backend — every placement decision reads
 this scoreboard, which in turn reads only the backends' existing health
-surface (``pa-health/v2``, utils/telemetry.health_snapshot + the queue/host
+surface (``pa-health/v3``, utils/telemetry.health_snapshot + the queue/host
 fields server.py adds): queue depth, in-flight prompts, the drain flag, the
 HBM watermark/utilization, compile-cache accounting, and the numerics-gate
 verdict. No side channel, no extra endpoint — if the health document can't
@@ -27,6 +27,7 @@ import threading
 import time
 import urllib.request
 
+from ..utils import retry as retry_mod
 from ..utils.logging import get_logger
 from ..utils.metrics import registry
 
@@ -39,7 +40,7 @@ class HostHealth:
 
     host_id: str
     base: str
-    # -- from the health document (pa-health/v2) --
+    # -- from the health document (pa-health/v3; v2 fields unchanged) --
     accepting: bool = True
     inflight_prompts: int = 0
     queue_pending: int = 0
@@ -52,6 +53,9 @@ class HostHealth:
     quarantined_lanes: int = 0             # surfaced, not an admission signal
     schema: str | None = None
     serving_batched_fraction: float | None = None
+    # pa-health/v3: model keys the host serves warm (compiled programs /
+    # pinned weights resident) — the residency-aware failover preference.
+    warm_keys: frozenset = frozenset()
     # -- poll bookkeeping (time.monotonic clocks) --
     last_ok: float | None = None
     consecutive_failures: int = 0
@@ -75,12 +79,20 @@ class Scoreboard:
 
     def __init__(self, poll_s: float = 1.0, stale_after_s: float = 10.0,
                  fail_after: int = 3, timeout_s: float = 5.0,
-                 backoff_cap_s: float = 30.0):
+                 backoff_cap_s: float = 30.0,
+                 retry_policy: retry_mod.RetryPolicy | None = None):
         self.poll_s = float(poll_s)
         self.stale_after_s = float(stale_after_s)
         self.fail_after = int(fail_after)
         self.timeout_s = float(timeout_s)
         self.backoff_cap_s = float(backoff_cap_s)
+        # The shared retry shape (utils/retry.py): poll backoff after
+        # failures doubles per failure toward the cap, with deterministic
+        # per-host jitter so N backends' re-polls never synchronize.
+        self.retry_policy = retry_policy or retry_mod.RetryPolicy(
+            max_attempts=1_000_000, base_s=self.poll_s * 2,
+            cap_s=self.backoff_cap_s, jitter=0.25,
+        )
         self._entries: dict[str, HostHealth] = {}
         self._lock = threading.Lock()
 
@@ -150,6 +162,12 @@ class Scoreboard:
             # surfaced for operators instead.
             e.numerics_ok = gate.get("verdict") not in ("drift", "nonfinite")
             e.quarantined_lanes = int(numerics.get("quarantined_lanes") or 0)
+            # pa-health/v3 residency: which model keys the host serves warm
+            # (absent on v2 hosts → empty set — mixed-version fleets degrade
+            # to the old cold-blind placement).
+            e.warm_keys = frozenset(
+                str(k) for k in (doc.get("warm_keys") or ())
+            )
             e.last_ok = now
             e.consecutive_failures = 0
             e.last_error = None
@@ -167,9 +185,11 @@ class Scoreboard:
             ).base)
             e.consecutive_failures += 1
             e.last_error = error or e.last_error
-            e.next_poll = now + min(
-                self.backoff_cap_s,
-                self.poll_s * (2 ** min(e.consecutive_failures, 8)),
+            # Shared backoff shape (utils/retry.py): exponential toward the
+            # cap with deterministic per-host jitter — a fleet of failing
+            # hosts de-synchronizes instead of re-polling in lockstep.
+            e.next_poll = now + self.retry_policy.backoff_s(
+                min(e.consecutive_failures - 1, 8), key=host_id
             )
             n = e.consecutive_failures
         if n == self.fail_after:
@@ -204,6 +224,15 @@ class Scoreboard:
         with self._lock:
             e = self._entries.get(host_id)
             return e.last_ok if e is not None else None
+
+    def warm(self, host_id: str, key: str) -> bool:
+        """Does the host advertise ``key`` in its warm set (pa-health/v3)?
+        The router's failover re-dispatch prefers warm siblings over a cold
+        primary — replaying a dead host's prompt on a host that must first
+        stage the model costs compile + weight placement."""
+        with self._lock:
+            e = self._entries.get(host_id)
+            return e is not None and key in e.warm_keys
 
     def saturated(self, host_id: str, extra_inflight: int = 0,
                   depth: int = 4,
@@ -279,6 +308,7 @@ class Scoreboard:
                 "compile": e.compile_cache,
                 "numerics_ok": e.numerics_ok,
                 "quarantined_lanes": e.quarantined_lanes,
+                "warm_keys": sorted(e.warm_keys),
                 "health_age_s": None if age is None else round(age, 3),
                 "consecutive_failures": e.consecutive_failures,
                 "last_error": e.last_error,

@@ -150,11 +150,42 @@ def _chunk_tuning() -> dict:
         return {}
 
 
+# Degradation-ladder override (utils/degrade.py "attn-chunk-shrink" rung):
+# divides the effective chunk threshold for the REST of the process — a
+# serving dispatch that OOMed at lane width 1 sheds logits memory next. The
+# floor keeps block_q useful (2^20 elements ≈ 4 MB of f32 logits per block).
+_CHUNK_SHRINK = 1
+_CHUNK_FLOOR = 2**20
+
+
 def _chunk_threshold() -> int:
     env = os.environ.get("PA_ATTN_CHUNK_ELEMS")
-    if env:
-        return int(env)
-    return int(_chunk_tuning().get("chunk_elems", _CHUNK_THRESHOLD))
+    base = int(env) if env else int(
+        _chunk_tuning().get("chunk_elems", _CHUNK_THRESHOLD)
+    )
+    # The floor bounds LADDER shrinks only — a configured value (env var /
+    # measured tuning) below the floor is served verbatim: the sweep and
+    # tests deliberately force tiny thresholds.
+    return max(min(base, _CHUNK_FLOOR), base // _CHUNK_SHRINK)
+
+
+def shrink_chunk_threshold() -> int | None:
+    """Halve the effective chunked-attention threshold (the ladder's
+    "attn-chunk-shrink" rung); returns the new threshold, or None when
+    already at the floor (the rung is spent — callers move to the next one).
+    Programs traced before the shrink keep their old blocks — the caller
+    must rebuild (clear_compiled_loops) for the shrink to take effect."""
+    global _CHUNK_SHRINK
+    if _chunk_threshold() <= _CHUNK_FLOOR:
+        return None
+    _CHUNK_SHRINK *= 2
+    return _chunk_threshold()
+
+
+def reset_chunk_shrink() -> None:
+    """Undo ladder shrinks (tests / operator reset after the pressure ends)."""
+    global _CHUNK_SHRINK
+    _CHUNK_SHRINK = 1
 
 
 def _softmax_dtype():
@@ -179,6 +210,10 @@ def chunk_config() -> dict:
     return {
         "chunk_elems": _chunk_threshold(),
         "bf16_softmax": _softmax_dtype() == jnp.bfloat16,
+        # True while the degradation ladder's attn-chunk-shrink rung is in
+        # effect — evidence labeling: a degraded process must not bank its
+        # numbers as the configured chunk setting.
+        "degraded": _CHUNK_SHRINK > 1,
         "sources": {
             "chunk_elems": src("PA_ATTN_CHUNK_ELEMS", "chunk_elems"),
             "bf16_softmax": src("PA_ATTN_BF16_SOFTMAX", "bf16_softmax"),

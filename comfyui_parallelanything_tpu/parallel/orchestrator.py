@@ -519,11 +519,24 @@ class ParallelModel:
                     raise
                 deeper = runner.recarved()
                 if deeper is None:
+                    # Ladder exhausted (one segment per stage already):
+                    # bounded degradation ends in a clean, attributable
+                    # failure — postmortem bundle + the original error.
+                    from ..utils import degrade
+
+                    degrade.ladder_exhausted(
+                        "stream-recarve", e,
+                        detail=f"{runner.n_stages} stages, no finer carve",
+                    )
                     raise
-                log_degradation(
-                    "stream-oom",
+                from ..utils import degrade
+
+                degrade.record_rung(
+                    "stream-recarve",
                     f"{type(e).__name__}; re-carving weight stream "
                     f"{runner.n_stages} → {deeper.n_stages} stages",
+                    stages_before=runner.n_stages,
+                    stages_after=deeper.n_stages,
                 )
                 aggressive_cleanup(clear_compile_cache=False)
                 self._stream_runner = deeper

@@ -53,7 +53,7 @@ import jax
 from ..devices.memory import ResidencyTracker
 from ..models.api import PipelineSpec
 from ..models.loader import carve_stages, params_nbytes, pin_params_host
-from ..utils import numerics, tracing
+from ..utils import faults, numerics, tracing
 from ..utils.logging import get_logger, log_placement
 from ..utils.telemetry import instrument_jit, watermark
 from .split import partition_kwargs, static_kwargs_key
@@ -274,6 +274,13 @@ class StreamingRunner:
 
     def _place_stage(self, idx: int):
         stage = self.stages[idx]
+        # Fault site (utils/faults.py): an injected prefetch OOM raises the
+        # same RESOURCE_EXHAUSTED shape a real allocator failure would, so
+        # the orchestrator's re-carve ladder is rehearsed end to end
+        # (chaos runs gate on the prompt still completing).
+        act = faults.check("stream-prefetch-oom", key=str(idx))
+        if act is not None:
+            raise faults.oom_error(act)
         placed = jax.device_put(
             {k: self._host_params[k] for k in stage.keys}, self.device
         )

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ..utils import tracing
+from ..utils.degrade import DegradedToInline
 
 from .ddim import ddim_sample
 from .flow import flow_euler_sample, flow_timesteps
@@ -26,6 +27,20 @@ from .k_samplers import (
 )
 
 SAMPLER_NAMES = ("ddim", *K_SAMPLERS, "flow_euler")
+
+
+def _compile_eager_rung(e: BaseException, sampler: str) -> None:
+    """Compile-failure ladder (utils/degrade.py): a compile-side error on the
+    whole-loop program falls back to the eager per-step loop — the rung is
+    recorded and the caller's code FALLS THROUGH to the eager path. Runtime
+    errors (incl. OOM, which has its own ladder) re-raise unchanged."""
+    from ..utils.degrade import is_compile_failure, record_rung
+
+    if not is_compile_failure(e):
+        raise e
+    record_rung("compile-eager",
+                f"{sampler}: {type(e).__name__}: {e} — eager loop fallback",
+                sampler=sampler)
 
 
 def _compiled_spec(model, callback):
@@ -266,12 +281,16 @@ def run_sampler(
                     # The loop donates its latent; never donate the CALLER's
                     # noise array (plain txt2img passes it through unchanged).
                     x = jnp.copy(x)
-                return compiled_flow_sample(
-                    spec, x, ts, context, cfg_scale=eff_cfg,
-                    uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
-                    guidance=guidance, cfg_rescale=cfg_rescale,
-                    **compiled_mask_kw, model_kwargs=model_kwargs,
-                )
+                try:
+                    return compiled_flow_sample(
+                        spec, x, ts, context, cfg_scale=eff_cfg,
+                        uncond_context=uncond_context,
+                        uncond_kwargs=uncond_kwargs,
+                        guidance=guidance, cfg_rescale=cfg_rescale,
+                        **compiled_mask_kw, model_kwargs=model_kwargs,
+                    )
+                except Exception as e:  # noqa: BLE001 — classified below
+                    _compile_eager_rung(e, "flow_euler")
         cb = with_progress(masked_callback(
             lambda i: (1.0 - ts[i + 1]) * init_latent + ts[i + 1] * noise
         ), len(ts) - 1)
@@ -314,12 +333,16 @@ def run_sampler(
                     # See the flow branch: the donated latent must not be the
                     # caller's noise array.
                     x = jnp.copy(x)
-                return compiled_ddim_sample(
-                    spec, x, ts, acp, context, cfg_scale=eff_cfg,
-                    uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
-                    prediction=prediction, cfg_rescale=cfg_rescale,
-                    **compiled_mask_kw, model_kwargs=model_kwargs,
-                )
+                try:
+                    return compiled_ddim_sample(
+                        spec, x, ts, acp, context, cfg_scale=eff_cfg,
+                        uncond_context=uncond_context,
+                        uncond_kwargs=uncond_kwargs,
+                        prediction=prediction, cfg_rescale=cfg_rescale,
+                        **compiled_mask_kw, model_kwargs=model_kwargs,
+                    )
+                except Exception as e:  # noqa: BLE001 — classified below
+                    _compile_eager_rung(e, "ddim")
 
         def ddim_keep(i):
             a = acp[ts[i + 1]] if i + 1 < len(ts) else jnp.float32(1.0)
@@ -444,18 +467,30 @@ def run_sampler(
                 ),
             )
             if ticket is not None:
-                return ticket.result()
+                try:
+                    return ticket.result()
+                except DegradedToInline as e:
+                    # The serving layer shed this request (its OOM ladder ran
+                    # out of width/chunk to give): the inline eager path below
+                    # is the final rung — the prompt still completes.
+                    from ..utils.degrade import record_rung
+
+                    record_rung("inline-fallback",
+                                f"{sampler}: {e}", sampler=sampler)
     if compile_loop:
         spec = _compiled_spec(model, callback)
         if spec is not None:
             from .compiled import compiled_k_sample
 
-            return compiled_k_sample(
-                spec, sampler, x, sigmas, context, cfg_scale=eff_cfg,
-                uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
-                acp=acp, prediction=prediction, cfg_rescale=cfg_rescale, rng=rng,
-                **compiled_mask_kw, model_kwargs=model_kwargs,
-            )
+            try:
+                return compiled_k_sample(
+                    spec, sampler, x, sigmas, context, cfg_scale=eff_cfg,
+                    uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
+                    acp=acp, prediction=prediction, cfg_rescale=cfg_rescale,
+                    rng=rng, **compiled_mask_kw, model_kwargs=model_kwargs,
+                )
+            except Exception as e:  # noqa: BLE001 — classified below
+                _compile_eager_rung(e, sampler)
     denoiser = EpsDenoiser(
         model, context, cfg_scale=eff_cfg, uncond_context=uncond_context,
         uncond_kwargs=uncond_kwargs, alphas_cumprod=acp, prediction=prediction,

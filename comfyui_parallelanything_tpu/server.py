@@ -39,7 +39,7 @@ Endpoints (the ComfyUI client-protocol subset that makes scripts work):
                               scrape and by the periodic memory monitor)
 - ``GET  /health``            one JSON health document
                               (utils/telemetry.health_snapshot,
-                              ``pa-health/v2``): devices, per-device HBM +
+                              ``pa-health/v3``): devices, per-device HBM +
                               utilization (deterministic pseudo-accounting
                               off-hardware), peak watermark, compile/cache
                               accounting, queue depth/workers, 1-minute
@@ -108,7 +108,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from .host import WorkflowCache, run_workflow
-from .utils import tracing
+from .utils import faults, tracing
 from .utils.progress import Interrupted, progress_scope
 
 _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"  # RFC 6455 §1.3
@@ -271,11 +271,20 @@ class PromptQueue:
             numerics.enable()
         self.class_mappings = class_mappings
         self.output_dir = output_dir or os.environ.get("PA_OUTPUT_DIR", "output")
-        # Fleet identity + drain state (pa-health/v2): host_id names this
+        # Fleet identity + drain state (pa-health/v3): host_id names this
         # process on a router's scoreboard; accepting=False (POST /drain)
         # stops seating new prompts while running lanes finish.
         self.host_id = host_id or default_host_id()
         self.accepting = True
+        self._drain_source = None
+        # Residency advertisement (pa-health/v3): model keys this host has
+        # served — its warm compiled programs / pinned weights, in the same
+        # fleet/router.model_key space the ring places on. A router replaying
+        # a dead sibling's prompts prefers a host whose warm set covers the
+        # key over a cold primary. LRU-bounded: insertion-ordered dict,
+        # oldest evicted past the cap.
+        self.warm_keys: dict[str, float] = {}
+        self._warm_cap = 64
         self.cache = WorkflowCache()
         self.pending: "queue.Queue[tuple | None]" = queue.Queue()
         self.pending_ids: list[str] = []
@@ -392,17 +401,36 @@ class PromptQueue:
         return pid, number
 
     def inflight_prompts(self) -> int:
-        """Queued + running — the pa-health/v2 field a fleet scoreboard
+        """Queued + running — the pa-health/v3 field a fleet scoreboard
         reads for saturation decisions (caller need not hold the lock)."""
         with self._lock:
             return len(self.pending_ids)
 
-    def drain(self) -> dict:
+    def _mark_warm(self, prompt: dict) -> None:
+        """Record the executed prompt's model key as warm (pa-health/v3).
+        Best-effort: residency advertisement must never fail a prompt."""
+        try:
+            from .fleet.router import model_key
+
+            key = model_key(prompt)
+            with self._lock:
+                self.warm_keys.pop(key, None)
+                self.warm_keys[key] = time.time()
+                while len(self.warm_keys) > self._warm_cap:
+                    self.warm_keys.pop(next(iter(self.warm_keys)))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def drain(self, source: str = "operator") -> dict:
         """Stop seating new prompts (POST /prompt → 503); running prompts
         and their serving lanes finish normally — the fleet drain state a
-        router observes via /health ``accepting``. Returns the drain view."""
+        router observes via /health ``accepting``. ``source`` records WHO
+        drained (operator via POST /drain vs an automatic policy): only
+        non-operator drains may be auto-resumed by the rejoin hook below.
+        Returns the drain view."""
         with self._lock:
             self.accepting = False
+            self._drain_source = source
             state = {"host_id": self.host_id, "accepting": False,
                      "pending": len(self.pending_ids) - len(self.running),
                      "running": len(self.running)}
@@ -412,7 +440,20 @@ class PromptQueue:
         """Re-open admission after a drain (elastic rejoin)."""
         with self._lock:
             self.accepting = True
+            self._drain_source = None
             return {"host_id": self.host_id, "accepting": True}
+
+    def resume_if_auto_drained(self) -> None:
+        """The heartbeat rejoin hook: re-open admission ONLY when the drain
+        was not operator-initiated — a router restart mid-maintenance must
+        not silently cancel the operator's POST /drain (chaos-review
+        finding, round 14). A host that fell off the ring while serving has
+        accepting=True already, so this is a no-op for it."""
+        with self._lock:
+            if self.accepting or getattr(self, "_drain_source", None) == "operator":
+                return
+            self.accepting = True
+            self._drain_source = None
 
     def _drop_pending(self, pid: str) -> None:
         """history + bookkeeping for a prompt cancelled before it ran
@@ -572,6 +613,13 @@ class PromptQueue:
 
             from .serving.scheduler import serving_hints
 
+            # Fault site (utils/faults.py): the straggler rehearsal — an
+            # injected slow-host stalls the prompt worker, not the HTTP
+            # surface, so health polls stay green while latency inflates
+            # (exactly the failure the router's saturation spill must absorb).
+            _slow = faults.check("slow-host", key=pid)
+            if _slow is not None:
+                _slow.sleep()
             try:
                 # The prompt span is the root of this prompt's trace
                 # timeline; prompt_id on the scope correlates log records and
@@ -602,6 +650,9 @@ class PromptQueue:
                                "exec_s": round(time.monotonic() - t0, 3)},
                     "outputs": self._image_outputs(prompt, results),
                 }
+                # This host now holds the prompt's model warm (compiled
+                # programs + pinned weights) — advertise it (pa-health/v3).
+                self._mark_warm(prompt)
                 # Per-output-node `executed` events (what API clients collect
                 # result images from without polling /history).
                 for nid, out in entry["outputs"].items():
@@ -715,7 +766,37 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _http_fault(self) -> bool:
+        """Fault site (utils/faults.py ``backend-http``): per-request
+        drop/delay/5xx keyed on ``METHOD /path``. Returns True when the
+        request was consumed (the caller must not answer it) — the chaos
+        rehearsal for half-dead backends whose sockets misbehave while the
+        process lives. No-op (one flag read) when no plan is armed."""
+        act = faults.check("backend-http", key=f"{self.command} {self.path}")
+        if act is None:
+            return False
+        if act.mode == "delay":
+            act.sleep()
+            return False
+        if act.mode == "drop":
+            # Vanish mid-request: the peer sees a reset/EOF, exactly like a
+            # crashed host — the router's OSError handling must absorb it.
+            import socket as _socket
+
+            self.close_connection = True
+            try:
+                self.connection.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return True
+        act.sleep()  # 5xx (default): alive but failing
+        self._send(500, {"error": f"injected fault (site=backend-http, "
+                                  f"hit={act.hit})"})
+        return True
+
     def do_GET(self):  # noqa: N802 — http.server API
+        if self._http_fault():
+            return
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
         if url.path == "/ws":
@@ -785,13 +866,17 @@ class _Handler(BaseHTTPRequestHandler):
                     # much of the step traffic actually co-batched.
                     "serving_batched_fraction": round(batched_fraction(), 4),
                 }
-                # pa-health/v2 (fleet tier): identity + admission state a
+                # pa-health/v3 (fleet tier): identity + admission state a
                 # router's scoreboard reads straight off this document — no
-                # extra endpoint.
+                # extra endpoint. v3 adds ``warm_keys`` (model residency:
+                # which placement keys this host serves warm — the router's
+                # failover re-dispatch prefers a warm sibling over a cold
+                # primary); every v2 field is unchanged.
                 host = {
                     "host_id": self.q.host_id,
                     "accepting": self.q.accepting,
                     "inflight_prompts": len(self.q.pending_ids),
+                    "warm_keys": list(self.q.warm_keys),
                 }
             return self._send(200, health_snapshot(queue=queue, host=host))
         if url.path == "/trace":
@@ -894,6 +979,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.q.remove_listener(sock)
 
     def do_POST(self):  # noqa: N802 — http.server API
+        if self._http_fault():
+            return
         url = urlparse(self.path)
         if url.path == "/interrupt":
             return self._send(200, {"dropped": self.q.interrupt()})
@@ -1059,7 +1146,7 @@ def make_server(
     ``max_pending`` (or $PA_MAX_PENDING) bounds the queue (429 beyond it);
     ``trace`` (or $PA_TRACE=1) turns the span tracer on so ``GET /trace``
     serves per-prompt timelines; ``host_id`` (or $PA_HOST_ID) names this
-    process on a fleet router's scoreboard (pa-health/v2)."""
+    process on a fleet router's scoreboard (pa-health/v3)."""
     q = PromptQueue(class_mappings=class_mappings, output_dir=output_dir,
                     workers=workers, max_pending=max_pending, serving=serving,
                     trace=trace, host_id=host_id)
@@ -1088,9 +1175,12 @@ def main() -> None:
                     help="fleet identity on a router's scoreboard "
                          "(default $PA_HOST_ID or hostname-pid)")
     ap.add_argument("--fleet-router", default=None,
-                    help="router base URL (or $PA_FLEET_ROUTER): register "
-                         "this host via heartbeats so it joins the ring "
-                         "elastically and drops out when it dies")
+                    help="router base URL(s), comma-separated (or "
+                         "$PA_FLEET_ROUTER): register this host via "
+                         "heartbeats so it joins the ring elastically and "
+                         "drops out when it dies. List EVERY router of an "
+                         "HA pair (primary + standby): a standby that takes "
+                         "over must already know the fleet's membership")
     ap.add_argument("--advertise", default=None,
                     help="base URL the ROUTER should reach this host at "
                          "(default http://<host>:<port>)")
@@ -1098,7 +1188,7 @@ def main() -> None:
     srv, q = make_server(args.host, args.port, output_dir=args.output_dir,
                          workers=args.workers, max_pending=args.max_pending,
                          trace=args.trace, host_id=args.host_id)
-    heartbeat = None
+    heartbeats = []
     router_base = args.fleet_router or os.environ.get("PA_FLEET_ROUTER")
     if router_base:
         from .fleet.registry import HeartbeatClient
@@ -1116,18 +1206,29 @@ def main() -> None:
         advertise = args.advertise or (
             f"http://{reach}:{srv.server_address[1]}"
         )
-        heartbeat = HeartbeatClient(
-            router_base, q.host_id, advertise,
-            interval_s=float(os.environ.get("PA_FLEET_HEARTBEAT_S", "2")),
-        ).start()
+        # One heartbeat client PER router: an HA pair's standby must hold
+        # live membership BEFORE its takeover (round-14 chaos finding: a
+        # promoted standby that only ever heard of backends through the dead
+        # primary has an empty ring and 503s everything).
+        for rb in (b for b in router_base.split(",") if b):
+            heartbeats.append(HeartbeatClient(
+                rb, q.host_id, advertise,
+                interval_s=float(os.environ.get("PA_FLEET_HEARTBEAT_S", "2")),
+                # Rejoin after falling off the ring (router restart /
+                # standby takeover / our own heartbeats lost): re-open
+                # admission so the returning host takes traffic again — a
+                # host that expired off the ring mid-drain would otherwise
+                # rejoin refusing forever.
+                on_rejoin=q.resume_if_auto_drained,
+            ).start())
     print(f"ParallelAnything workflow server on http://{args.host}:{args.port}")
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
-        if heartbeat is not None:
-            heartbeat.stop()
+        for hb in heartbeats:
+            hb.stop()
         q.shutdown()
 
 

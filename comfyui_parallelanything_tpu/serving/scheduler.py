@@ -116,6 +116,13 @@ class ContinuousBatchingScheduler:
         self.max_waiting = max_waiting
         self.samplers = frozenset(samplers)
         self.buckets: dict[tuple, StepBucket] = {}
+        # Degradation-ladder width caps (utils/degrade.py "lane-width-halve"):
+        # bucket-key-prefix (the key minus its width component) → the widest
+        # lane count the ladder still allows after a dispatch OOM. Applied to
+        # every later submission for the same shape, so the shed width stays
+        # shed until the process restarts (an OOM is a property of the shape
+        # on this device, not of one request).
+        self._width_caps: dict[tuple, int] = {}
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._pump_lock = threading.Lock()
@@ -240,13 +247,17 @@ class ContinuousBatchingScheduler:
         # The sampler is NOT part of the key (round 10): per-lane sampler
         # state/updates ride the lane axis, so lanes running different
         # samplers share one bucket — and one compiled dispatch stream.
-        key = (
+        key_prefix = (
             id(model), prediction, use_cfg, float(cfg_rescale),
             tuple(x.shape), str(x.dtype),
             None if context is None
             else (tuple(context.shape), str(context.dtype)),
-            static_kwargs_key(static), t_sig, u_sig, acp_fp, width,
+            static_kwargs_key(static), t_sig, u_sig, acp_fp,
         )
+        cap = self._width_caps.get(key_prefix)
+        if cap is not None:
+            width = min(width, cap)
+        key = key_prefix + (width,)
         from ..utils import tracing
 
         req = ServeRequest(
@@ -360,19 +371,19 @@ class ContinuousBatchingScheduler:
                 try:
                     did = b.dispatch() or did
                 except Exception as e:  # noqa: BLE001 — no waiter may hang
+                    if self._degrade_bucket(b, e):
+                        continue  # ladder absorbed it (requests re-seated
+                        #           or shed to the inline path)
                     # Resolve EVERY request the dying bucket holds — seated
                     # lanes AND the waiting line — before dropping it, or
                     # their submitters block forever in ticket.result().
-                    for i in b.active_lanes():
-                        b.lanes[i].req.resolve(error=e)
-                        b.lanes[i] = None
-                    while True:
-                        req = b.queue.pop()
-                        if req is None:
-                            break
-                        req.resolve(error=e)
+                    # (Pop-then-drain, same ordering discipline as the
+                    # ladder: no new submission can land in the doomed
+                    # bucket after the pop.)
                     with self._lock:
                         self.buckets.pop(b.key, None)
+                    for req in self._drain_bucket(b):
+                        req.resolve(error=e)
             # Drained buckets release their stacked device arrays (lane
             # state rebuilds from the next admitted request) so an idle
             # serving layer holds no latents/contexts in device memory
@@ -382,6 +393,121 @@ class ContinuousBatchingScheduler:
                     b.release_state()
             self._trim_buckets()
         return did
+
+    # -- degradation ladder (utils/degrade.py) -------------------------------
+
+    def _drain_bucket(self, b: StepBucket) -> list:
+        """Every request the bucket holds (seated lanes first, then the
+        waiting line), with the bucket emptied. Seated requests restart from
+        step 0 when re-seated — exactly the fleet-failover replay discipline,
+        bitwise-safe by the fold_in RNG contract."""
+        reqs = []
+        for i in b.active_lanes():
+            reqs.append(b.lanes[i].req)
+            b.lanes[i] = None
+        while True:
+            req = b.queue.pop()
+            if req is None:
+                break
+            reqs.append(req)
+        return reqs
+
+    def _reseat(self, reqs, model, spec, label: str, key_prefix: tuple,
+                width: int) -> None:
+        """Park drained requests in a (new) bucket at ``width``; anything the
+        admission bound refuses is shed to the inline path rather than lost."""
+        from ..utils.degrade import DegradedToInline
+
+        key = key_prefix + (width,)
+        with self._lock:
+            bucket = self.buckets.get(key)
+            if bucket is None:
+                bucket = StepBucket(key, label, width=width, model=model,
+                                    spec=spec, max_waiting=self.max_waiting)
+                self.buckets[key] = bucket
+            for req in reqs:
+                try:
+                    bucket.queue.push(req)
+                except ServingRejected as e:
+                    req.resolve(error=DegradedToInline(
+                        f"re-seat after degradation refused: {e}"
+                    ))
+            self._cond.notify_all()
+
+    def _degrade_bucket(self, b: StepBucket, e: BaseException) -> bool:
+        """The serving OOM/compile ladder: width halve → attn-chunk shrink →
+        inline fallback (OOM), or straight to inline on a compile failure.
+        Returns True when the ladder absorbed the error (every request the
+        bucket held is re-seated or shed — none resolves with ``e``); False
+        hands the error back to the caller's resolve-everything path."""
+        from ..utils.degrade import (
+            DegradedToInline,
+            is_compile_failure,
+            record_rung,
+        )
+        from ..utils.telemetry import looks_like_oom
+
+        oom = looks_like_oom(e)
+        if not oom and not is_compile_failure(e):
+            return False
+        # Pop BEFORE draining, under the submit lock: maybe_submit resolves
+        # the bucket and pushes inside one lock hold, so after this pop no
+        # new request can land in the doomed bucket's queue (a push that
+        # raced in earlier is drained below).
+        with self._lock:
+            self.buckets.pop(b.key, None)
+        reqs = self._drain_bucket(b)
+        key_prefix = b.key[:-1]
+        if not oom:
+            # Compile failure on the lane program: the eager inline loop is
+            # the fallback program — DegradedToInline routes each submitter
+            # there (run_sampler records the compile-eager rung's sibling,
+            # inline-fallback, when it lands).
+            record_rung("compile-eager",
+                        f"bucket {b.label}: lane program compile failed "
+                        f"({type(e).__name__}) — requests shed to inline",
+                        bucket=b.label)
+            for req in reqs:
+                req.resolve(error=DegradedToInline(
+                    f"lane program compile failure in bucket {b.label}: {e}"
+                ))
+            return True
+        min_width = 1
+        if b.spec is not None and b.spec.mesh is not None:
+            min_width = b.spec.mesh.shape[b.spec.data_axis]
+        new_width = max(min_width, b.width // 2)
+        if new_width < b.width:
+            record_rung("lane-width-halve",
+                        f"bucket {b.label}: {type(e).__name__} at width "
+                        f"{b.width} → {new_width}; requests re-seated",
+                        bucket=b.label, width_before=b.width,
+                        width_after=new_width)
+            with self._lock:
+                self._width_caps[key_prefix] = new_width
+            self._reseat(reqs, b.model, b.spec, b.label, key_prefix, new_width)
+            return True
+        from ..ops.attention import shrink_chunk_threshold
+        from ..sampling.compiled import clear_compiled_loops
+
+        new_chunk = shrink_chunk_threshold()
+        if new_chunk is not None:
+            # Smaller attention blocks only help once the cached lane
+            # programs (traced at the old threshold) are rebuilt.
+            clear_compiled_loops()
+            record_rung("attn-chunk-shrink",
+                        f"bucket {b.label}: width already {b.width}; "
+                        f"attention chunk → {new_chunk} elems, programs "
+                        f"rebuilt",
+                        bucket=b.label, chunk_elems=new_chunk)
+            self._reseat(reqs, b.model, b.spec, b.label, key_prefix, b.width)
+            return True
+        # Ladder spent: shed to the inline path (graceful — the prompts
+        # still complete; run_sampler records the inline-fallback rung).
+        for req in reqs:
+            req.resolve(error=DegradedToInline(
+                f"serving OOM ladder exhausted for bucket {b.label}: {e}"
+            ))
+        return True
 
     def drain(self, timeout: float = 120.0) -> None:
         """Pump until every bucket is idle (manual mode helper)."""

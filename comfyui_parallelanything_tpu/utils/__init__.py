@@ -3,10 +3,13 @@ from .cleanup import aggressive_cleanup
 from .compile_cache import enable_compilation_cache
 from .metrics import StepTimer, StepStats, trace
 from .checks import assert_finite, checked
-from . import numerics, roofline, telemetry, tracing
+from . import degrade, faults, numerics, retry, roofline, telemetry, tracing
 
 __all__ = [
+    "degrade",
+    "faults",
     "numerics",
+    "retry",
     "roofline",
     "enable_compilation_cache",
     "get_logger",

@@ -1,0 +1,147 @@
+"""Bounded-retry degradation ladder: graceful, OBSERVABLE on-device fallback.
+
+The reference's whole failure story is two silent demotions — drop a device
+on clone OOM and renormalize (any_device_parallel.py:1114-1128), demote to
+fewer devices on step OOM (1435-1448) — with a print as the only evidence.
+This module is the accounting spine for every rung this repo has grown:
+
+    stream OOM      → re-carve (finer stages)     rung "stream-recarve"
+                    → …until one segment/stage    → exhaustion (clean error)
+    serving OOM     → lane-width halve            rung "lane-width-halve"
+                    → attn-chunk shrink           rung "attn-chunk-shrink"
+                    → inline fallback             rung "inline-fallback"
+    compile failure → eager loop fallback         rung "compile-eager"
+
+Every rung taken is (1) logged through ``log_degradation`` (the reference's
+print-site vocabulary), (2) counted as ``pa_degradation_total{rung=}``, (3)
+recorded as an instant ``degrade``-category span on the tracer, and (4)
+appended to the perf ledger as a ``kind="degradation"`` record — so a fleet
+that is quietly degrading is VISIBLE in /metrics, in traces, and in the
+ledger history, never just slower. Rung exhaustion (nothing left to shed)
+dumps a postmortem bundle and re-raises the original error: graceful
+degradation is bounded by construction, not a retry-forever loop.
+
+The ladder MECHANICS live at the call sites that own the resources
+(parallel/orchestrator.py re-carves, serving/scheduler.py re-buckets,
+sampling/runner.py falls back to eager); this module owns the rung
+vocabulary, the observability contract, and the shared failure
+classification.
+"""
+
+from __future__ import annotations
+
+# Rung vocabulary (the pa_degradation_total{rung=} label set + README table).
+LADDER_RUNGS = {
+    "stream-recarve": "streaming OOM: stage granularity halved "
+                      "(parallel/orchestrator._stream_call)",
+    "lane-width-halve": "serving dispatch OOM: bucket lane width halved, "
+                        "requests re-seated from step 0 "
+                        "(serving/scheduler.py)",
+    "attn-chunk-shrink": "serving dispatch OOM at width 1: chunked-attention "
+                         "threshold halved, programs rebuilt "
+                         "(ops/attention.py)",
+    "inline-fallback": "serving OOM with nothing left to shed: requests "
+                       "resolve DegradedToInline and run_sampler runs the "
+                       "inline eager path",
+    "compile-eager": "compile failure: whole-loop/lane program abandoned for "
+                     "the eager per-step loop (sampling/runner.py)",
+    "exhausted": "a ladder ran out of rungs — clean error + postmortem "
+                 "(labelled with the ladder that exhausted)",
+}
+
+
+class DegradedToInline(RuntimeError):
+    """The serving layer shed this request: the submitter (run_sampler)
+    must run the inline eager path instead. Never escapes run_sampler."""
+
+
+def record_rung(rung: str, detail: str, **attrs) -> None:
+    """One rung taken: log + counter + span + ledger. Never raises — the
+    degradation path is exactly where secondary failures are likeliest."""
+    assert rung in LADDER_RUNGS, f"unknown degradation rung {rung!r}"
+    try:
+        from .logging import log_degradation
+
+        log_degradation(rung, detail)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from .metrics import registry
+
+        registry.counter(
+            "pa_degradation_total", labels={"rung": rung},
+            help="degradation-ladder rungs taken (utils/degrade.py) — a "
+                 "quietly degrading fleet is visible here, never just slower",
+        )
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from . import tracing
+
+        if tracing.on():
+            now = tracing.now_us()
+            tracing.record("degradation", now, 0.0, cat="degrade",
+                           rung=rung, detail=detail, **attrs)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from .telemetry import append_ledger_record
+
+        append_ledger_record(
+            {"metric": "degradation", "rung": rung, "detail": detail, **attrs},
+            "degradation",
+        )
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def ladder_exhausted(ladder: str, error: BaseException,
+                     detail: str = "") -> str | None:
+    """A ladder ran out of rungs: count it, dump a postmortem bundle, and
+    return the bundle path (caller re-raises the original error — bounded
+    degradation ends in a CLEAN, attributable failure, not a spin)."""
+    try:
+        from .logging import log_degradation
+
+        log_degradation("exhausted", f"{ladder}: {detail or error}")
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from .metrics import registry
+
+        registry.counter("pa_degradation_total",
+                         labels={"rung": "exhausted", "ladder": ladder})
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from . import tracing
+
+        if tracing.on():
+            now = tracing.now_us()
+            tracing.record("degradation", now, 0.0, cat="degrade",
+                           rung="exhausted", ladder=ladder)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from .telemetry import write_postmortem
+
+        return write_postmortem(
+            f"degrade-exhausted-{ladder}", error=error,
+            extra={"ladder": ladder, "detail": detail},
+        )
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def is_compile_failure(err: BaseException) -> bool:
+    """Classify an error as compile-side (→ the eager fallback rung applies)
+    vs runtime. OOMs are never compile failures — they have their own
+    ladder. Matches the injected ``compile-fail`` fault and XLA's
+    compilation/lowering error vocabulary."""
+    from .telemetry import looks_like_oom
+
+    if looks_like_oom(err):
+        return False
+    msg = f"{type(err).__name__}: {err}".lower()
+    return any(m in msg for m in
+               ("injected compile failure", "compil", "lowering", "mosaic"))

@@ -1,0 +1,364 @@
+"""Unified fault-injection registry: one syntax, one arming rule, every site.
+
+Before round 14, three ad-hoc ``PA_FAIL_INJECT`` parsers injected faults in
+three places with three grammars (bench.py's raise-at-step-3, the serving
+bucket's ``nan:<lane>`` one-shot via utils/numerics.py, and nothing at all
+for the fleet tier). This module is the chaos tier's single entry point:
+
+- **named sites** (:data:`FAULT_SITES`) across the stack — stream-prefetch
+  OOM, compile failure, backend HTTP drop/delay/5xx, heartbeat loss,
+  slow-host, mid-step crash, per-lane NaN. A call site asks
+  ``faults.check("<site>", key=...)`` at the exact point the real failure
+  would occur; the disabled path is a single flag check (the tracer/sentinel
+  discipline — tier-1-tested no-op).
+- **a deterministic seeded fault plan**: ``PA_FAULT_PLAN`` is JSON —
+  ``{"seed": N, "faults": [{"site": ..., "match": ..., "nth": ...,
+  "count": ..., "delay_s": ..., "mode": ...}]}`` (or a bare list; seed 0).
+  ``match`` substring-filters the call site's ``key`` (a URL path, a stage
+  index, a program name); ``nth`` fires on the nth eligible hit (1-based —
+  omitted, it derives deterministically from the plan seed, so two runs of
+  one seed fire at identical points); ``count`` is how many consecutive
+  hits fire (``null`` = every hit from ``nth`` on); ``delay_s`` rides the
+  action for delay-type faults.
+- **one arming rule**: a plan (or the legacy ``PA_FAIL_INJECT`` alias) arms
+  ONLY under an explicit evidence/ledger redirect (``PA_EVIDENCE_DIR`` /
+  ``PA_LEDGER_DIR``) — an injected failure's postmortems, ledger records,
+  and chaos artifacts must never land in the repo's real evidence (the
+  round-9 rule, now centralized).
+- **attribution**: every fired fault emits an instant ``faults``-category
+  span (``fault-injected``) and a ``pa_fault_injected_total{site=}``
+  counter, so a chaos postmortem PROVES what was injected where — a failure
+  that can't be told apart from a real one is a useless rehearsal.
+
+Legacy aliases (kept so round-9/11 tests and docs don't break):
+``PA_FAIL_INJECT=nan:<lane>`` ≡ a one-shot ``lane-nan`` fault;
+any other value (``oom``) ≡ ``mid-step-crash`` firing from hit 3 onward
+(bench.py's historical raise-at-step-3 contract).
+
+Module level is stdlib-only and free of package-relative imports (the
+``utils/roofline.py`` contract): scripts/chaos.py and tests load it either
+as part of the package or standalone by path; the span/counter emission
+degrades gracefully when the package isn't importable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+
+# Site vocabulary: name → where it injects (the call site owns the failure
+# shape; this table is the operator-facing contract, README "Fault
+# tolerance"). check() accepts only these names so a typo'd plan fails
+# loudly at parse instead of silently never firing.
+FAULT_SITES = {
+    "stream-prefetch-oom": "parallel/streaming.py stage prefetch — raises "
+                           "RESOURCE_EXHAUSTED so the re-carve ladder runs",
+    "compile-fail": "utils/telemetry.instrument_jit first compile — raises "
+                    "so the compile→eager degradation rung runs",
+    "backend-http": "server.py HTTP ingress — mode drop/delay/5xx per "
+                    "request path (key = METHOD /path)",
+    "heartbeat-loss": "fleet HeartbeatClient — the beat is silently skipped "
+                      "(the router sees the host go dark)",
+    "slow-host": "server.py prompt worker — sleeps delay_s before the "
+                 "prompt executes (straggler rehearsal)",
+    "mid-step-crash": "bench.py / chaos denoise step — raises an "
+                      "OOM-shaped RuntimeError mid-run",
+    "lane-nan": "serving lane eval input (via utils/numerics.take_injection) "
+                "— match is the lane index to poison",
+}
+
+
+def _stable_u64(key: str) -> int:
+    return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One parsed plan entry. ``nth`` None → derived from the plan seed."""
+
+    site: str
+    match: str | None = None
+    nth: int | None = None
+    count: int | None = 1          # None = every hit from nth on
+    delay_s: float = 0.0
+    mode: str | None = None
+
+    def resolved_nth(self, seed: int) -> int:
+        if self.nth is not None:
+            return max(1, int(self.nth))
+        # Deterministic in (plan seed, site, match): same seed → same firing
+        # schedule, different sites de-correlate. Band [1, 4] keeps derived
+        # faults inside short CI workloads.
+        return 1 + _stable_u64(f"{seed}:{self.site}:{self.match}") % 4
+
+
+@dataclasses.dataclass
+class FaultAction:
+    """What a call site receives when its fault fires."""
+
+    site: str
+    mode: str | None
+    delay_s: float
+    key: str
+    hit: int            # which eligible hit this was (1-based)
+    spec: FaultSpec
+
+    def sleep(self) -> None:
+        if self.delay_s > 0:
+            time.sleep(self.delay_s)
+
+
+class FaultPlanError(ValueError):
+    """Malformed PA_FAULT_PLAN — raised at parse, never silently ignored."""
+
+
+def parse_plan(raw) -> tuple[int, list[FaultSpec]]:
+    """(seed, specs) from the PA_FAULT_PLAN JSON value (dict or bare list)."""
+    if isinstance(raw, str):
+        try:
+            raw = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise FaultPlanError(f"PA_FAULT_PLAN is not JSON: {e}") from e
+    if isinstance(raw, list):
+        seed, entries = 0, raw
+    elif isinstance(raw, dict):
+        seed = int(raw.get("seed", 0))
+        entries = raw.get("faults", [])
+    else:
+        raise FaultPlanError(f"PA_FAULT_PLAN must be a dict or list, "
+                             f"got {type(raw).__name__}")
+    specs = []
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict) or "site" not in e:
+            raise FaultPlanError(f"fault entry {i} must be an object with "
+                                 f"a 'site': {e!r}")
+        site = str(e["site"])
+        if site not in FAULT_SITES:
+            raise FaultPlanError(
+                f"unknown fault site {site!r} (have: "
+                f"{', '.join(sorted(FAULT_SITES))})"
+            )
+        count = e.get("count", 1)
+        specs.append(FaultSpec(
+            site=site,
+            match=None if e.get("match") is None else str(e["match"]),
+            nth=None if e.get("nth") is None else int(e["nth"]),
+            count=None if count is None else int(count),
+            delay_s=float(e.get("delay_s", 0.0)),
+            mode=None if e.get("mode") is None else str(e["mode"]),
+        ))
+    return seed, specs
+
+
+def _legacy_specs(value: str) -> list[FaultSpec]:
+    """The PA_FAIL_INJECT alias, kept verbatim-compatible with rounds 9/11."""
+    if value.startswith("nan:"):
+        try:
+            lane = int(value.split(":", 1)[1])
+        except ValueError:
+            return []
+        return [FaultSpec(site="lane-nan", match=str(lane), nth=1, count=1)]
+    # bench.py's historical contract: the third step (and every one after,
+    # though the first raise ends the run) fails with an OOM-shaped error.
+    return [FaultSpec(site="mid-step-crash", mode="oom", nth=3, count=None)]
+
+
+class FaultRegistry:
+    """Hit counting + firing decisions for one parsed plan. Thread-safe —
+    sites fire from HTTP handler threads, the serving dispatcher, and the
+    streaming runner concurrently."""
+
+    def __init__(self, seed: int = 0, specs: list[FaultSpec] | None = None,
+                 armed: bool = True):
+        self.seed = int(seed)
+        self.specs = list(specs or ())
+        self.armed = bool(armed) and bool(self.specs)
+        self.env_sig: tuple | None = None   # what from_env parsed, for refresh()
+        self._hits: dict[tuple[int, str], int] = {}   # (spec idx, key-class)
+        self._fired: dict[str, int] = {}              # site → fired count
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "FaultRegistry":
+        plan = env.get("PA_FAULT_PLAN")
+        legacy = env.get("PA_FAIL_INJECT")
+        redirected = bool(env.get("PA_EVIDENCE_DIR") or env.get("PA_LEDGER_DIR"))
+        if plan:
+            seed, specs = parse_plan(plan)
+        elif legacy:
+            seed, specs = 0, _legacy_specs(legacy)
+        else:
+            reg = cls(armed=False)
+            reg.env_sig = _env_sig(env)
+            return reg
+        # The one arming rule: no evidence/ledger redirect → the plan parses
+        # (typos still fail loudly) but never fires.
+        reg = cls(seed=seed, specs=specs, armed=redirected)
+        reg.env_sig = _env_sig(env)
+        return reg
+
+    def check(self, site: str, key: str = "") -> FaultAction | None:
+        """The per-site hook. Counts one eligible hit per matching spec and
+        returns the first spec whose firing window covers it (else None).
+        Fired faults are recorded (span + counter) before returning."""
+        if not self.armed:
+            return None
+        action = None
+        with self._lock:
+            for idx, spec in enumerate(self.specs):
+                if spec.site != site:
+                    continue
+                if spec.match is not None and spec.match not in key:
+                    continue
+                hkey = (idx, "")
+                self._hits[hkey] = hit = self._hits.get(hkey, 0) + 1
+                nth = spec.resolved_nth(self.seed)
+                in_window = hit >= nth and (
+                    spec.count is None or hit < nth + spec.count
+                )
+                if in_window and action is None:
+                    action = FaultAction(site=site, mode=spec.mode,
+                                         delay_s=spec.delay_s, key=key,
+                                         hit=hit, spec=spec)
+            if action is not None:
+                self._fired[site] = self._fired.get(site, 0) + 1
+        if action is not None:
+            self._record_fired(action)
+        return action
+
+    def record_external(self, site: str, key: str = "", mode=None) -> None:
+        """Attribution for a fault the plan armed but a SUBSYSTEM executes
+        (the lane-nan poke lives in utils/numerics.take_injection, which owns
+        the one-shot/seating semantics) — same span + counter as check()."""
+        with self._lock:
+            self._fired[site] = self._fired.get(site, 0) + 1
+        self._record_fired(FaultAction(site=site, mode=mode, delay_s=0.0,
+                                       key=key, hit=0,
+                                       spec=FaultSpec(site=site)))
+
+    @staticmethod
+    def _record_fired(action: FaultAction) -> None:
+        """Span + counter + log — every injected fault is attributable.
+        Package imports are lazy and best-effort: this module stays
+        standalone-loadable, and attribution must never mask the fault."""
+        try:
+            from . import tracing
+
+            if tracing.on():
+                now = tracing.now_us()
+                tracing.record(
+                    "fault-injected", now, 0.0, cat="faults",
+                    site=action.site, mode=action.mode, key=action.key,
+                    hit=action.hit,
+                )
+        except Exception:  # noqa: BLE001 — standalone load / tracing hiccup
+            pass
+        try:
+            from .metrics import registry
+
+            registry.counter(
+                "pa_fault_injected_total", labels={"site": action.site},
+                help="faults fired by the injection registry (utils/faults.py)"
+                     " — chaos runs prove their injections here",
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from .logging import get_logger
+
+            get_logger().warning(
+                "fault injected [%s] mode=%s key=%s hit=%d",
+                action.site, action.mode, action.key, action.hit,
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    def lane_nan_target(self) -> int | None:
+        """The lane index of the first un-exhausted ``lane-nan`` spec, or
+        None. Does NOT consume a hit — utils/numerics.take_injection owns
+        the one-shot/seated semantics; it reports consumption back through
+        :meth:`record_external`."""
+        if not self.armed:
+            return None
+        with self._lock:
+            for spec in self.specs:
+                if spec.site != "lane-nan":
+                    continue
+                try:
+                    return int(spec.match or "0")
+                except ValueError:
+                    continue
+        return None
+
+    def fired(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._fired)
+
+    def reset(self) -> None:
+        """Clear hit/fired counters (re-arm) — tests and the dryrun's
+        repeated injection sections."""
+        with self._lock:
+            self._hits.clear()
+            self._fired.clear()
+
+
+def _env_sig(env=os.environ) -> tuple:
+    return (env.get("PA_FAULT_PLAN"), env.get("PA_FAIL_INJECT"),
+            bool(env.get("PA_EVIDENCE_DIR") or env.get("PA_LEDGER_DIR")))
+
+
+# Process-wide registry, parsed from the env at import (bench/server set the
+# env before the package loads). reload() re-reads unconditionally;
+# refresh() re-reads only when the relevant env vars changed since the parse
+# — the sites that must honor env set mid-process (utils/numerics.py's
+# lane-nan path, guarded by its own sentinel flag) call refresh().
+registry = FaultRegistry.from_env()
+
+
+def active() -> bool:
+    """The hot-path flag — True only when an armed plan exists."""
+    return registry.armed
+
+
+def check(site: str, key: str = "") -> FaultAction | None:
+    """Module-level hook every instrumented site calls. Disabled path is
+    this one attribute read."""
+    if not registry.armed:
+        return None
+    return registry.check(site, key)
+
+
+def fired() -> dict[str, int]:
+    return registry.fired()
+
+
+def reset() -> None:
+    registry.reset()
+
+
+def reload() -> FaultRegistry:
+    global registry
+    registry = FaultRegistry.from_env()
+    return registry
+
+
+def refresh() -> FaultRegistry:
+    """Re-parse the env ONLY when the fault-relevant vars changed — cheap
+    enough for sites whose callers set the env after package import."""
+    if registry.env_sig != _env_sig():
+        return reload()
+    return registry
+
+
+def oom_error(action: FaultAction) -> RuntimeError:
+    """The OOM-shaped injected error (matches utils/telemetry._OOM_MARKERS,
+    so looks_like_oom and the degradation ladders treat it as the real
+    thing)."""
+    return RuntimeError(
+        f"RESOURCE_EXHAUSTED: injected failure "
+        f"(site={action.site}, hit={action.hit})"
+    )

@@ -412,28 +412,26 @@ def gate_status() -> dict | None:
 
 
 def fail_inject_lane() -> int | None:
-    """The lane index to poison, or None. Armed only by
-    ``PA_FAIL_INJECT=nan:<lane>`` AND an explicit evidence/ledger redirect
-    (``PA_LEDGER_DIR``/``PA_EVIDENCE_DIR``) — an injected NaN's postmortem
-    bundle must never land in the repo's real ledger (bench.py applies the
-    same rule to its raise-injection)."""
-    v = os.environ.get("PA_FAIL_INJECT") or ""
-    if not v.startswith("nan:"):
-        return None
-    if not (os.environ.get("PA_LEDGER_DIR")
-            or os.environ.get("PA_EVIDENCE_DIR")):
-        return None
-    try:
-        return int(v.split(":", 1)[1])
-    except ValueError:
-        return None
+    """The lane index to poison, or None. Round 14: parsed by the unified
+    fault registry (utils/faults.py ``lane-nan`` site) — one syntax
+    (``PA_FAULT_PLAN`` or the legacy ``PA_FAIL_INJECT=nan:<lane>`` alias)
+    and ONE arming rule (explicit ``PA_LEDGER_DIR``/``PA_EVIDENCE_DIR``
+    redirect, so an injected NaN's postmortem bundle can never land in the
+    repo's real ledger). ``refresh()`` honors env set after import (tests,
+    the dryrun's §15 re-arm)."""
+    from . import faults
+
+    return faults.refresh().lane_nan_target()
 
 
 def take_injection(active_lanes) -> int | None:
     """One-shot: the armed lane index if it is currently seated, consuming
     the injection; else None (stays armed until the lane exists). The
     serving bucket calls this per dispatch when the sentinel is on; tests
-    and the dryrun re-arm via ``sentinel.reset()``."""
+    and the dryrun re-arm via ``sentinel.reset()``. A consumed injection is
+    reported to the fault registry (``faults``-cat span +
+    ``pa_fault_injected_total{site="lane-nan"}``), so chaos postmortems
+    prove the NaN was injected, not organic."""
     lane = fail_inject_lane()
     if lane is None or lane not in active_lanes:
         return None
@@ -441,6 +439,9 @@ def take_injection(active_lanes) -> int | None:
         if sentinel._inject_done:
             return None
         sentinel._inject_done = True
+    from . import faults
+
+    faults.registry.record_external("lane-nan", key=str(lane), mode="nan")
     return lane
 
 

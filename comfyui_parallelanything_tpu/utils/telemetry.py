@@ -55,7 +55,7 @@ LEDGER_SCHEMA = "pa-perf-ledger/v1"
 # the fields a fleet router's scoreboard needs for placement and drain
 # decisions without any extra endpoint. v1 consumers are unaffected: the
 # additions are top-level keys, every v1 field is unchanged.
-HEALTH_SCHEMA = "pa-health/v2"
+HEALTH_SCHEMA = "pa-health/v3"  # v3 adds host warm_keys; every v2 field intact
 LEDGER_FILENAME = "perf_ledger.jsonl"
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
@@ -283,6 +283,19 @@ class _InstrumentedJit:
     def __call__(self, *args, **kwargs):
         watch_compiles()
         reg = compile_registry
+        if not self._cost_done:
+            # Fault site (utils/faults.py): an injected compile failure fires
+            # before this program's FIRST observed compile, so the
+            # compile→eager degradation rung (utils/degrade.py) is rehearsed
+            # against the same callers a real XLA lowering error would hit.
+            from . import faults
+
+            act = faults.check("compile-fail", key=self.name)
+            if act is not None:
+                raise RuntimeError(
+                    f"injected compile failure (program={self.name}, "
+                    f"hit={act.hit})"
+                )
         n0 = reg.compiles_of(self.name) if not self._cost_done else 0
         reg.push_program(self.name)
         try:
@@ -507,7 +520,7 @@ def health_snapshot(queue: dict | None = None,
     average — the fields the watchdog attaches to failed-attempt notes and
     ``GET /health`` serves. Every section degrades to None independently (a
     wedged device backend must not blank the host-side sections). ``host``
-    merges the pa-health/v2 fleet fields (host_id, accepting,
+    merges the pa-health/v3 fleet fields (host_id, accepting,
     inflight_prompts) top-level — the server passes its own identity/drain
     state; standalone callers (watchdog notes) omit it."""
     out: dict = {

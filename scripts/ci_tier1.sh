@@ -57,3 +57,19 @@ rc=$?
 timeout -k 10 300 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m pytest tests/test_fleet.py -q -p no:cacheprovider \
     -p no:xdist -p no:randomly -k "FleetSmoke or Failover"
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+
+# Chaos smoke (round 14): a seeded fault plan (backend-http 5xx +
+# slow-host, deterministic in the seed) fired against a 2-backend fleet
+# while the PRIMARY ROUTER is killed mid-denoise (standby takeover off the
+# durable prompt journal, fleet/journal.py) and one backend is killed —
+# gated on prompts_lost == 0, every latent bitwise-equal to the fault-free
+# baseline, bounded p95 inflation, and every injected fault attributable
+# (pa_fault_injected_total); plus an injected stream-OOM absorbed by the
+# re-carve degradation rung on a real weight-streamed model
+# (tests/test_chaos.py drives scripts/chaos.py in-process). Also part of
+# the tier-1 run above; this rerun is the explicit contract.
+timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_chaos.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly

@@ -70,7 +70,97 @@ def _append_ledger(summary: dict, base: str) -> None:
     _ledger_append({**summary, "base": base}, "loadgen")
 
 
+def _load_retry():
+    """utils/retry.py loaded standalone by file path — its module level is
+    stdlib-only and free of package-relative imports by contract (the
+    utils/roofline.py loader pattern), so loadgen's poll/reconnect loops ride
+    the SAME policy object the fleet uses, without importing the package."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "comfyui_parallelanything_tpu", "utils", "retry.py",
+    )
+    spec = importlib.util.spec_from_file_location("pa_retry_loadgen", path)
+    mod = importlib.util.module_from_spec(spec)
+    # Registered BEFORE exec: dataclass processing under `from __future__
+    # import annotations` resolves the module through sys.modules.
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_retry = _load_retry()
+# History polling: the SHARED poll shape (retry.POLL — 50 ms cadence backing
+# off toward 500 ms) — a long denoise no longer costs 20 HTTP polls per
+# second per client, the jitter de-synchronizes N clients' polls, and a
+# future tuning of the fleet's poll policy applies here automatically.
+_POLL = _retry.POLL
+
+
+class _Front:
+    """The client's view of the front door: an ordered list of router bases
+    (primary first, standbys after). A connection failure or a standby 503
+    advances to the next base — the router-HA story from the CLIENT side:
+    a router kill mid-run costs a reconnect, never the prompt."""
+
+    def __init__(self, bases):
+        self.bases = [b.rstrip("/") for b in bases]
+        self._i = 0
+        self._lock = threading.Lock()
+
+    @property
+    def base(self) -> str:
+        with self._lock:
+            return self.bases[self._i]
+
+    def _advance(self, frm: str) -> None:
+        with self._lock:
+            if self.bases[self._i] == frm and len(self.bases) > 1:
+                self._i = (self._i + 1) % len(self.bases)
+
+    def request(self, method, path, payload=None, timeout: float = 30):
+        """One HTTP call with base failover: OSError / standby-503 walks the
+        base list (once around); anything else propagates."""
+        last = None
+        for _ in range(max(1, len(self.bases))):
+            base = self.base
+            try:
+                if method == "GET":
+                    with urllib.request.urlopen(
+                        base + path, timeout=timeout
+                    ) as r:
+                        body = r.read()
+                    ct = r.headers.get("Content-Type", "")
+                    return json.loads(body) if "json" in ct else body.decode()
+                req = urllib.request.Request(
+                    base + path, data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    try:
+                        detail = json.loads(e.read() or b"{}")
+                    except ValueError:
+                        detail = {}
+                    if detail.get("role") == "standby":
+                        last = e
+                        self._advance(base)
+                        continue
+                raise
+            except OSError as e:
+                last = e
+                self._advance(base)
+                continue
+        raise last if last is not None else OSError("no base reachable")
+
+
 def _get(base: str, path: str, timeout: float = 30):
+    if isinstance(base, _Front):
+        return base.request("GET", path, timeout=timeout)
     with urllib.request.urlopen(base + path, timeout=timeout) as r:
         body = r.read()
     ct = r.headers.get("Content-Type", "")
@@ -78,6 +168,8 @@ def _get(base: str, path: str, timeout: float = 30):
 
 
 def _post(base: str, path: str, payload: dict, timeout: float = 30):
+    if isinstance(base, _Front):
+        return base.request("POST", path, payload, timeout=timeout)
     req = urllib.request.Request(
         base + path, data=json.dumps(payload).encode(),
         headers={"Content-Type": "application/json"}, method="POST",
@@ -86,13 +178,21 @@ def _post(base: str, path: str, payload: dict, timeout: float = 30):
         return json.loads(r.read())
 
 
-def _wait_done(base: str, pid: str, timeout: float):
+def _wait_done(base, pid: str, timeout: float):
     t0 = time.time()
+    attempt = 0
     while time.time() - t0 < timeout:
-        hist = _get(base, f"/history/{pid}")
+        try:
+            hist = _get(base, f"/history/{pid}")
+        except (urllib.error.URLError, OSError):
+            # The front door may be mid-failover (router kill → standby
+            # takeover): keep polling on the policy's backoff — the prompt
+            # survives in the journal even while no router answers.
+            hist = {}
         if pid in hist:
             return hist[pid]
-        time.sleep(0.05)
+        time.sleep(_POLL.backoff_s(attempt, key=pid))
+        attempt += 1
     raise TimeoutError(f"prompt {pid} never completed")
 
 
@@ -160,6 +260,12 @@ def _serving_counters(base: str) -> dict:
                  "pa_numerics_nonfinite_total",
                  "pa_numerics_quarantined_total",
                  "pa_numerics_sentinel_enabled",
+                 # Chaos tier (round 14): injected-fault and
+                 # degradation-ladder counters (utils/faults.py,
+                 # utils/degrade.py) — a chaos run's summary proves what was
+                 # injected and what gracefully degraded, summed over their
+                 # {site=}/{rung=} labels.
+                 "pa_fault_injected_total", "pa_degradation_total",
                  # Fleet router counters (fleet/router.py) — present when
                  # --base is a router; summed over their {host=} labels.
                  "pa_fleet_dispatch_total", "pa_fleet_spill_total",
@@ -223,7 +329,8 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
              samplers: list[str] | None = None,
              sampler_key: str | None = None,
              seed: int | None = None,
-             hosts: list[str] | None = None) -> dict:
+             hosts: list[str] | None = None,
+             fallback_bases: list[str] | None = None) -> dict:
     """The closed loop; returns the summary dict (importable — the e2e and
     fleet-smoke tests drive in-process servers through this exact code path).
 
@@ -235,7 +342,12 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
 
     ``seed`` makes the prompt schedule reproducible: the per-prompt value
     written at ``seed_key`` comes from ``random.Random(seed)`` instead of
-    the live counter. ``hosts`` turns on fleet mode (see module docstring)."""
+    the live counter. ``hosts`` turns on fleet mode (see module docstring).
+    ``fallback_bases`` (router HA): standby router URLs tried in order when
+    the primary stops answering or replies standby-503 — a router kill
+    mid-run costs the clients a reconnect, never a prompt."""
+    if fallback_bases:
+        base = _Front([base, *fallback_bases])
     latencies: list[float] = []
     lat_by_host: dict = {}
     failures: list[str] = []
@@ -269,14 +381,38 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
             if extra_data:
                 payload["extra_data"] = extra_data
             t0 = time.time()
-            try:
-                pid = _post(base, "/prompt", payload)["prompt_id"]
-            except urllib.error.HTTPError as e:
-                with lock:
-                    if e.code == 429:
-                        rejected[0] += 1
-                    else:
-                        failures.append(f"client {ci}: HTTP {e.code}")
+            # Submit with bounded retry (utils/retry.py shape): a 503 or a
+            # refused connection can be a router mid-failover (standby
+            # takeover costs ~a lease TTL) — retry on backoff until the
+            # window closes, then count the failure. 429 (bounded queue) and
+            # 4xx (request at fault) are never retried.
+            pid = None
+            post_deadline = t0 + min(60.0, timeout)
+            attempt = 0
+            while True:
+                try:
+                    pid = _post(base, "/prompt", payload)["prompt_id"]
+                    break
+                except urllib.error.HTTPError as e:
+                    if e.code == 503 and time.time() < post_deadline:
+                        time.sleep(_POLL.backoff_s(attempt, key=f"s{ci}"))
+                        attempt += 1
+                        continue
+                    with lock:
+                        if e.code == 429:
+                            rejected[0] += 1
+                        else:
+                            failures.append(f"client {ci}: HTTP {e.code}")
+                    break
+                except OSError as e:
+                    if time.time() < post_deadline:
+                        time.sleep(_POLL.backoff_s(attempt, key=f"s{ci}"))
+                        attempt += 1
+                        continue
+                    with lock:
+                        failures.append(f"client {ci}: unreachable ({e})")
+                    break
+            if pid is None:
                 continue
             try:
                 entry = _wait_done(base, pid, timeout)
@@ -403,6 +539,20 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
             after.get("pa_numerics_nonfinite_total", 0.0)
             - before.get("pa_numerics_nonfinite_total", 0.0)
         ) if after.get("pa_numerics_sentinel_enabled") else None,
+        # Chaos tier (round 14): faults fired by the injection registry and
+        # degradation-ladder rungs taken over this run (summed over
+        # site/rung labels; None = the counters never existed — no plan
+        # armed AND nothing degraded).
+        "faults_injected": (
+            after.get("pa_fault_injected_total", 0.0)
+            - before.get("pa_fault_injected_total", 0.0)
+        ) if ("pa_fault_injected_total" in after
+              or "pa_fault_injected_total" in before) else None,
+        "degradations": (
+            after.get("pa_degradation_total", 0.0)
+            - before.get("pa_degradation_total", 0.0)
+        ) if ("pa_degradation_total" in after
+              or "pa_degradation_total" in before) else None,
         # Server-side quantiles from the /metrics histograms (end-state
         # values — histograms are cumulative): what the SERVER measured per
         # lockstep dispatch / lane admission, vs the client-clock latencies
@@ -453,6 +603,10 @@ def print_human_summary(summary: dict, stream=None) -> None:
         w(f"  fleet     dispatches {f.get('dispatches')}"
           f"  spills {f.get('spills')}  failovers {f.get('failovers')}"
           f"  lost {summary.get('prompts_lost')}\n")
+    if summary.get("faults_injected") is not None or \
+            summary.get("degradations") is not None:
+        w(f"  chaos     faults injected {summary.get('faults_injected')}"
+          f"  degradation rungs {summary.get('degradations')}\n")
     if summary.get("roofline_comms_fraction") is not None or \
             summary.get("roofline_host_gap_fraction") is not None:
         w(f"  roofline  comms {summary.get('roofline_comms_fraction')}"
@@ -497,6 +651,10 @@ def main() -> None:
                          "--base is the router; summary adds per-host "
                          "latency/dispatch sections, pa_fleet_* deltas, "
                          "and the CI-gated prompts_lost count")
+    ap.add_argument("--fallback-bases", default=None,
+                    help="comma list of standby router base URLs (router "
+                         "HA): clients fail over to them when --base stops "
+                         "answering or replies standby-503")
     args = ap.parse_args()
     samplers = [s for s in (args.samplers or "").split(",") if s]
     if samplers and not args.sampler_key:
@@ -515,6 +673,8 @@ def main() -> None:
         extra_data=extra or None,
         samplers=samplers or None, sampler_key=args.sampler_key,
         seed=args.seed, hosts=hosts or None,
+        fallback_bases=[b for b in (args.fallback_bases or "").split(",")
+                        if b] or None,
     )
     _append_ledger(summary, args.base)
     print_human_summary(summary)          # operator table → stderr

@@ -117,6 +117,27 @@ def attribution(events: list[dict]) -> dict | None:
     }
 
 
+def chaos_counts(events: list[dict]) -> dict:
+    """Chaos-tier spans (round 14): ``fault-injected`` instants from the
+    injection registry (utils/faults.py) and ``degradation`` instants from
+    the ladder (utils/degrade.py) — a captured trace proves what was
+    injected and what gracefully degraded, by site and by rung."""
+    faults = [e for e in events if e["name"] == "fault-injected"]
+    rungs = [e for e in events if e["name"] == "degradation"]
+    by_site: dict[str, int] = defaultdict(int)
+    for e in faults:
+        by_site[str(e.get("args", {}).get("site", "?"))] += 1
+    by_rung: dict[str, int] = defaultdict(int)
+    for e in rungs:
+        by_rung[str(e.get("args", {}).get("rung", "?"))] += 1
+    return {
+        "faults_injected": len(faults),
+        "faults_by_site": dict(sorted(by_site.items())),
+        "degradations": len(rungs),
+        "degradations_by_rung": dict(sorted(by_rung.items())),
+    }
+
+
 def numerics_counts(events: list[dict]) -> dict:
     """Numerics sentinel spans (utils/numerics.py records an instant span
     per non-finite observation / quarantine when tracing is on) — so a
@@ -144,6 +165,7 @@ def summarize(events: list[dict]) -> dict:
     gap = host_gap_ms(events)
     return {
         "numerics": numerics_counts(events),
+        "chaos": chaos_counts(events),
         "spans": len(events),
         "layers": {
             cat: {
@@ -218,6 +240,12 @@ def main() -> None:
           f"{n['quarantines']} quarantine(s)"
           + (f" — by site {n['nonfinite_by_where']}"
              if n["nonfinite_by_where"] else ""))
+    c = s["chaos"]
+    print(f"chaos: {c['faults_injected']} injected fault(s)"
+          + (f" by site {c['faults_by_site']}" if c["faults_by_site"] else "")
+          + f", {c['degradations']} degradation rung(s)"
+          + (f" by rung {c['degradations_by_rung']}"
+             if c["degradations_by_rung"] else ""))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,81 @@
+"""Chaos smoke (scripts/chaos.py — the ci_tier1.sh gate): a seeded fault
+plan (backend-http 5xx + slow-host) fired against a 2-backend fleet while
+the primary router is killed mid-denoise (standby takeover off the durable
+journal) and one backend is killed — gated on prompts_lost == 0, every
+latent bitwise-equal to the fault-free baseline, bounded p95, and every
+injected fault attributable; plus the stream-OOM phase on a real
+weight-streamed model (the re-carve ladder absorbs it).
+
+Marked slow-adjacent but kept in tier 1 deliberately: the fleet's one
+non-negotiable — the front door never loses a prompt — must break the build
+the moment it breaks."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+
+@pytest.fixture(autouse=True)
+def _evidence_redirect(tmp_path, monkeypatch):
+    """The one arming rule (utils/faults.py): chaos artifacts must never
+    land in the repo's real evidence."""
+    monkeypatch.setenv("PA_EVIDENCE_DIR", str(tmp_path / "evidence"))
+    monkeypatch.delenv("PA_LEDGER_DIR", raising=False)
+    from comfyui_parallelanything_tpu.utils import faults
+
+    faults.reload()
+    yield
+    monkeypatch.delenv("PA_FAULT_PLAN", raising=False)
+    faults.reload()
+
+
+class TestChaosSmoke:
+    def test_fleet_phase_router_and_backend_kill_zero_lost(self, tmp_path):
+        from chaos import run_fleet_chaos
+
+        verdict = run_fleet_chaos(
+            n_backends=2, clients=3, requests=2, seed=7, work_s=0.4,
+            lease_ttl_s=0.75, root=str(tmp_path / "chaos"),
+        )
+        assert verdict["ok"], verdict["failures"]
+        assert verdict["prompts_lost"] == 0
+        assert verdict["completed"] == verdict["total_prompts"]
+        assert verdict["faults_fired"] >= 2  # 5xx + slow-host both fired
+        assert verdict["chaos_p95_s"] <= verdict["p95_bound_s"]
+
+    def test_stream_oom_phase_recarve_absorbs(self):
+        from chaos import run_stream_oom_chaos
+
+        verdict = run_stream_oom_chaos()
+        assert verdict["ok"], verdict["failures"]
+        assert verdict["stages_after"] > verdict["stages_before"]
+        assert verdict["recarve_rungs"] >= 1
+
+    def test_seeded_plan_fires_identically(self):
+        """Fault-plan determinism at the chaos-runner level: the default
+        plan for one seed resolves to one firing schedule."""
+        from chaos import default_plan
+
+        from comfyui_parallelanything_tpu.utils.faults import (
+            FaultRegistry,
+            parse_plan,
+        )
+        import json as _json
+
+        for seed in (7, 8):
+            seed_a, specs_a = parse_plan(_json.dumps(default_plan(seed)))
+            seed_b, specs_b = parse_plan(_json.dumps(default_plan(seed)))
+            ra = FaultRegistry(seed=seed_a, specs=specs_a)
+            rb = FaultRegistry(seed=seed_b, specs=specs_b)
+            for _ in range(8):
+                assert (ra.check("slow-host", key="p") is None) == (
+                    rb.check("slow-host", key="p") is None
+                )
+                assert (
+                    ra.check("backend-http", key="POST /prompt") is None
+                ) == (rb.check("backend-http", key="POST /prompt") is None)

@@ -1,0 +1,417 @@
+"""Degradation ladder (utils/degrade.py + the call sites that own the
+rungs): every rung is counted/ledgered/logged, the serving OOM ladder
+(width halve → attn-chunk shrink → inline fallback) re-seats or sheds
+without losing a request, compile failures fall back to the eager loop, the
+streaming re-carve rung absorbs an injected prefetch OOM on a REAL streamed
+model, and rung exhaustion ends in a clean error + postmortem."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+from comfyui_parallelanything_tpu.serving import ContinuousBatchingScheduler
+from comfyui_parallelanything_tpu.utils import degrade, faults, tracing
+from comfyui_parallelanything_tpu.utils.metrics import registry
+
+TOL = dict(rtol=2e-3, atol=1e-4)
+
+
+@pytest.fixture(autouse=True)
+def _ledger_redirect(tmp_path, monkeypatch):
+    """Degradation rungs LEDGER by design (kind="degradation" records) —
+    a test-provoked rung must land in a temp ledger, never the repo's."""
+    monkeypatch.setenv("PA_LEDGER_DIR", str(tmp_path / "ledger"))
+
+
+def tiny_model(x, t, context=None, **kw):
+    c = jnp.mean(context, axis=tuple(range(1, context.ndim)))
+    c = c.reshape((-1,) + (1,) * (x.ndim - 1))
+    tt = t.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.tanh(x * 0.9 + c * 0.1) * (0.5 + 0.1 * tt / 1000.0)
+
+
+def mk_inputs(seed, batch=1):
+    r = np.random.default_rng(seed)
+    noise = jnp.asarray(r.normal(size=(batch, 8, 8, 4)).astype(np.float32))
+    ctx = jnp.asarray(r.normal(size=(batch, 6, 16)).astype(np.float32))
+    return noise, ctx
+
+
+def _rung_count(rung: str, **extra) -> float:
+    return registry.get("pa_degradation_total",
+                        {"rung": rung, **extra}) or 0.0
+
+
+def _bg(fn, *args):
+    t = threading.Thread(target=fn, args=args, daemon=True)
+    t.start()
+    return t
+
+
+def _wait_enqueued(s, n, timeout=20):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        with s._lock:
+            tot = sum(len(b.queue) + len(b.active_lanes())
+                      for b in s.buckets.values())
+        if tot >= n:
+            return
+        time.sleep(0.005)
+    raise TimeoutError(f"never saw {n} enqueued requests")
+
+
+def _oom_once(bucket):
+    """Wrap a bucket's dispatch to raise an OOM-shaped error exactly once."""
+    real = bucket.dispatch
+    fired = []
+
+    def boom():
+        if not fired:
+            fired.append(1)
+            raise RuntimeError("RESOURCE_EXHAUSTED: synthetic dispatch OOM")
+        return real()
+
+    bucket.dispatch = boom
+    return fired
+
+
+class TestRungAccounting:
+    def test_unknown_rung_asserts(self):
+        with pytest.raises(AssertionError):
+            degrade.record_rung("not-a-rung", "nope")
+
+    def test_record_rung_counts_ledgers_and_traces(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PA_LEDGER_DIR", str(tmp_path))
+        tracing.enable()
+        try:
+            before = _rung_count("stream-recarve")
+            degrade.record_rung("stream-recarve", "unit-test rung",
+                               stages_before=2, stages_after=4)
+            assert _rung_count("stream-recarve") == before + 1
+            events = [e for e in tracing.export()["traceEvents"]
+                      if e.get("ph") == "X" and e["name"] == "degradation"]
+            assert events and events[-1]["args"]["rung"] == "stream-recarve"
+            ledger = tmp_path / "perf_ledger.jsonl"
+            recs = [json.loads(l) for l in
+                    ledger.read_text().strip().splitlines()]
+            mine = [r for r in recs if r.get("kind") == "degradation"]
+            assert mine and mine[-1]["rung"] == "stream-recarve"
+            assert mine[-1]["stages_after"] == 4
+        finally:
+            tracing.disable()
+
+    def test_ladder_exhausted_writes_postmortem(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PA_LEDGER_DIR", str(tmp_path))
+        bundle = degrade.ladder_exhausted(
+            "stream-recarve", RuntimeError("RESOURCE_EXHAUSTED: terminal"),
+            detail="unit",
+        )
+        assert bundle and os.path.isdir(bundle)
+        assert bundle.startswith(str(tmp_path))
+        info = json.load(open(os.path.join(bundle, "error.json")))
+        assert info["extra"]["ladder"] == "stream-recarve"
+
+    def test_compile_failure_classifier(self):
+        assert degrade.is_compile_failure(
+            RuntimeError("injected compile failure (program=loop:k)")
+        )
+        assert degrade.is_compile_failure(
+            RuntimeError("XlaRuntimeError: INTERNAL: during compilation")
+        )
+        # OOM has its own ladder; generic runtime errors re-raise.
+        assert not degrade.is_compile_failure(
+            RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        )
+        assert not degrade.is_compile_failure(ValueError("bad shape"))
+
+
+class TestServingLadder:
+    def _run_pair(self, sched, plans):
+        """Submit the plans through run_sampler worker threads; returns
+        {seed: result} after drain."""
+        results = {}
+
+        def worker(seed, steps):
+            noise, ctx = mk_inputs(seed)
+            results[seed] = run_sampler(
+                tiny_model, noise, ctx, sampler="euler", steps=steps
+            )
+
+        threads = [_bg(worker, s, n) for s, n in plans]
+        _wait_enqueued(sched, len(plans))
+        sched.drain(timeout=120)
+        for t in threads:
+            t.join(60)
+        assert len(results) == len(plans), results
+        return results
+
+    def test_oom_halves_width_and_reseats(self):
+        """Rung 1: a dispatch OOM at width 4 re-buckets every request at
+        width 2 (restart from step 0 — the failover replay discipline) and
+        the results still match serial."""
+        plans = [(11, 4), (12, 5)]
+        serial = {
+            s: run_sampler(tiny_model, *mk_inputs(s), sampler="euler", steps=n)
+            for s, n in plans
+        }
+        sched = ContinuousBatchingScheduler(max_width=4, auto=False).install()
+        try:
+            before = _rung_count("lane-width-halve")
+            results = {}
+
+            def worker(seed, steps):
+                noise, ctx = mk_inputs(seed)
+                results[seed] = run_sampler(
+                    tiny_model, noise, ctx, sampler="euler", steps=steps
+                )
+
+            threads = [_bg(worker, s, n) for s, n in plans]
+            _wait_enqueued(sched, len(plans))
+            [b] = sched.buckets.values()
+            _oom_once(b)
+            sched.drain(timeout=120)
+            for t in threads:
+                t.join(60)
+            assert _rung_count("lane-width-halve") == before + 1
+            # The shed width sticks for this shape: the replacement bucket
+            # (and any future submission) runs at half width.
+            widths = {bk.width for bk in sched.buckets.values()}
+            assert widths == {2}, widths
+            assert sched._width_caps and set(
+                sched._width_caps.values()) == {2}
+            for s, _ in plans:
+                np.testing.assert_allclose(
+                    np.asarray(results[s]), np.asarray(serial[s]), **TOL
+                )
+        finally:
+            sched.uninstall()
+            sched.shutdown()
+
+    def test_oom_at_width_one_shrinks_attn_chunk(self):
+        """Rung 2: width already 1 → the chunked-attention threshold halves,
+        compiled loop programs are rebuilt, the request re-seats."""
+        import importlib
+
+        # ops/__init__ re-exports an `attention` FUNCTION that shadows the
+        # submodule attribute; importlib returns the real module.
+        attention = importlib.import_module(
+            "comfyui_parallelanything_tpu.ops.attention"
+        )
+        attention.reset_chunk_shrink()
+        sched = ContinuousBatchingScheduler(max_width=1, auto=False).install()
+        try:
+            before = _rung_count("attn-chunk-shrink")
+            t0 = attention._chunk_threshold()
+            results = {}
+
+            def worker():
+                noise, ctx = mk_inputs(21)
+                results[21] = run_sampler(
+                    tiny_model, noise, ctx, sampler="euler", steps=3
+                )
+
+            th = _bg(worker)
+            _wait_enqueued(sched, 1)
+            [b] = sched.buckets.values()
+            assert b.width == 1
+            _oom_once(b)
+            sched.drain(timeout=120)
+            th.join(60)
+            assert _rung_count("attn-chunk-shrink") == before + 1
+            assert attention._chunk_threshold() == max(
+                attention._CHUNK_FLOOR, t0 // 2
+            )
+            assert 21 in results
+        finally:
+            attention.reset_chunk_shrink()
+            sched.uninstall()
+            sched.shutdown()
+
+    def test_oom_ladder_exhausted_falls_back_inline(self, monkeypatch):
+        """Rung 3: width 1 AND chunk at the floor → the request is shed to
+        the inline eager path (DegradedToInline caught in run_sampler) —
+        the prompt still completes, the inline-fallback rung is counted."""
+        import importlib
+
+        attention = importlib.import_module(
+            "comfyui_parallelanything_tpu.ops.attention"
+        )
+        monkeypatch.setattr(attention, "_CHUNK_SHRINK", 1 << 30)
+        assert attention.shrink_chunk_threshold() is None  # floor reached
+        serial = run_sampler(tiny_model, *mk_inputs(31), sampler="euler",
+                             steps=3)
+        sched = ContinuousBatchingScheduler(max_width=1, auto=False).install()
+        try:
+            before = _rung_count("inline-fallback")
+            results = {}
+
+            def worker():
+                noise, ctx = mk_inputs(31)
+                results[31] = run_sampler(
+                    tiny_model, noise, ctx, sampler="euler", steps=3
+                )
+
+            th = _bg(worker)
+            _wait_enqueued(sched, 1)
+            [b] = sched.buckets.values()
+            b.dispatch = lambda: (_ for _ in ()).throw(
+                RuntimeError("RESOURCE_EXHAUSTED: terminal OOM")
+            )
+            sched.pump()   # ladder: nothing left → DegradedToInline
+            th.join(60)    # worker finishes on the inline path
+            assert _rung_count("inline-fallback") == before + 1
+            np.testing.assert_allclose(
+                np.asarray(results[31]), np.asarray(serial), **TOL
+            )
+        finally:
+            sched.uninstall()
+            sched.shutdown()
+
+    def test_compile_failure_sheds_to_inline(self):
+        """The compile rung, serving form: a lane-program compile failure
+        resolves every request DegradedToInline (run_sampler runs the eager
+        loop) — never a user-facing crash."""
+        serial = run_sampler(tiny_model, *mk_inputs(41), sampler="euler",
+                             steps=3)
+        sched = ContinuousBatchingScheduler(max_width=4, auto=False).install()
+        try:
+            before = _rung_count("compile-eager")
+            results = {}
+
+            def worker():
+                noise, ctx = mk_inputs(41)
+                results[41] = run_sampler(
+                    tiny_model, noise, ctx, sampler="euler", steps=3
+                )
+
+            th = _bg(worker)
+            _wait_enqueued(sched, 1)
+            [b] = sched.buckets.values()
+            b.dispatch = lambda: (_ for _ in ()).throw(
+                RuntimeError("injected compile failure (program=loop:lane)")
+            )
+            sched.pump()
+            th.join(60)
+            assert _rung_count("compile-eager") == before + 1
+            np.testing.assert_allclose(
+                np.asarray(results[41]), np.asarray(serial), **TOL
+            )
+        finally:
+            sched.uninstall()
+            sched.shutdown()
+
+
+TINY_FLUX_KW = dict(
+    in_channels=16, hidden_size=64, num_heads=4, depth=2,
+    depth_single_blocks=4, context_in_dim=32, vec_in_dim=16,
+    axes_dim=(4, 6, 6), guidance_embed=False,
+)
+
+
+@pytest.fixture(scope="module")
+def flux_model():
+    from comfyui_parallelanything_tpu.models.flux import FluxConfig, build_flux
+
+    cfg = FluxConfig(dtype=jnp.float32, **TINY_FLUX_KW)
+    return build_flux(cfg, jax.random.key(0), sample_shape=(1, 8, 8, 4),
+                      txt_len=16)
+
+
+def _flux_inputs(batch=2):
+    x = jax.random.normal(jax.random.key(1), (batch, 8, 8, 4))
+    t = jnp.linspace(900.0, 1.0, batch)
+    ctx = jax.random.normal(jax.random.key(2),
+                            (batch, 16, TINY_FLUX_KW["context_in_dim"]))
+    y = jax.random.normal(jax.random.key(3),
+                          (batch, TINY_FLUX_KW["vec_in_dim"]))
+    return x, t, ctx, y
+
+
+class TestStreamRecarveRung:
+    def test_injected_prefetch_oom_recarves_and_matches(
+        self, flux_model, monkeypatch, tmp_path
+    ):
+        """The stream ladder end to end, REAL streamed model: an injected
+        prefetch OOM (utils/faults.py site) re-carves the schedule —
+        forward completes, output matches the bare apply, rung counted."""
+        from comfyui_parallelanything_tpu import (
+            DeviceChain,
+            ParallelConfig,
+            parallelize,
+        )
+        from comfyui_parallelanything_tpu.models.loader import params_nbytes
+
+        monkeypatch.setenv("PA_LEDGER_DIR", str(tmp_path))
+        monkeypatch.setenv("PA_FAULT_PLAN", json.dumps({"faults": [
+            {"site": "stream-prefetch-oom", "nth": 2, "count": 1},
+        ]}))
+        faults.reload()
+        try:
+            before = _rung_count("stream-recarve")
+            x, t, ctx, y = _flux_inputs()
+            want = flux_model.apply(flux_model.params, x, t, ctx, y=y)
+            pm = parallelize(
+                flux_model, DeviceChain.even(["cpu:0"]),
+                ParallelConfig(
+                    weight_sharding="stream",
+                    hbm_budget_bytes=params_nbytes(flux_model.params),
+                ),
+            )
+            n0 = pm._get_streaming_runner().n_stages
+            got = pm(x, t, ctx, y=y)
+            assert pm._stream_runner.n_stages > n0
+            assert _rung_count("stream-recarve") == before + 1
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), **TOL
+            )
+            assert faults.fired().get("stream-prefetch-oom") == 1
+        finally:
+            monkeypatch.delenv("PA_FAULT_PLAN")
+            faults.reload()
+
+    def test_exhaustion_is_clean_error_with_postmortem(
+        self, flux_model, monkeypatch, tmp_path
+    ):
+        """Rung exhaustion: a carve already at one segment per stage has no
+        finer rung — the injected OOM surfaces as a clean RESOURCE_EXHAUSTED
+        with a postmortem bundle, never a spin."""
+        from comfyui_parallelanything_tpu import (
+            DeviceChain,
+            ParallelConfig,
+            parallelize,
+        )
+        from comfyui_parallelanything_tpu.models.loader import params_nbytes
+
+        monkeypatch.setenv("PA_LEDGER_DIR", str(tmp_path))
+        monkeypatch.setenv("PA_FAULT_PLAN", json.dumps({"faults": [
+            {"site": "stream-prefetch-oom", "nth": 1, "count": None},
+        ]}))
+        faults.reload()
+        try:
+            pm = parallelize(
+                flux_model, DeviceChain.even(["cpu:0"]),
+                ParallelConfig(
+                    weight_sharding="stream",
+                    # Tiny budget → the carve starts at one segment per
+                    # stage: the ladder has no rung to take.
+                    hbm_budget_bytes=params_nbytes(flux_model.params) // 16,
+                ),
+            )
+            x, t, ctx, y = _flux_inputs()
+            with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+                pm(x, t, ctx, y=y)
+            pms = [d for d in (tmp_path / "postmortem").iterdir()
+                   if "degrade-exhausted-stream-recarve" in d.name]
+            assert pms, list((tmp_path / "postmortem").iterdir())
+        finally:
+            monkeypatch.delenv("PA_FAULT_PLAN")
+            faults.reload()

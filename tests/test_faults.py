@@ -1,0 +1,306 @@
+"""Unified fault-injection registry (utils/faults.py) + shared retry policy
+(utils/retry.py): plan parsing, the one arming rule, deterministic seeded
+firing schedules, the legacy PA_FAIL_INJECT aliases, the tier-1 no-op
+contract for the disabled path, and the backoff/jitter/deadline math every
+fleet loop now rides."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from comfyui_parallelanything_tpu.utils import faults, retry
+from comfyui_parallelanything_tpu.utils.faults import (
+    FAULT_SITES,
+    FaultPlanError,
+    FaultRegistry,
+    FaultSpec,
+    parse_plan,
+)
+
+
+def _schedule(reg: FaultRegistry, site: str, n: int, key: str = "") -> list[bool]:
+    """Fire pattern over n consecutive eligible hits."""
+    return [reg.check(site, key=key) is not None for _ in range(n)]
+
+
+class TestPlanParsing:
+    def test_dict_and_list_forms(self):
+        seed, specs = parse_plan('{"seed": 3, "faults": '
+                                 '[{"site": "slow-host", "nth": 2}]}')
+        assert seed == 3 and len(specs) == 1
+        assert specs[0].site == "slow-host" and specs[0].nth == 2
+        seed2, specs2 = parse_plan('[{"site": "slow-host"}]')
+        assert seed2 == 0 and len(specs2) == 1
+
+    def test_unknown_site_fails_loudly(self):
+        """A typo'd site must fail at parse — a plan that silently never
+        fires is worse than no plan."""
+        with pytest.raises(FaultPlanError, match="unknown fault site"):
+            parse_plan('[{"site": "strem-prefetch-oom"}]')
+
+    def test_bad_json_fails_loudly(self):
+        with pytest.raises(FaultPlanError, match="not JSON"):
+            parse_plan("{nope")
+
+    def test_entry_must_carry_site(self):
+        with pytest.raises(FaultPlanError, match="'site'"):
+            parse_plan('[{"match": "x"}]')
+
+    def test_every_site_documented(self):
+        for site, doc in FAULT_SITES.items():
+            assert doc, site
+
+
+class TestFiringSemantics:
+    def test_nth_and_count_window(self):
+        reg = FaultRegistry(specs=[FaultSpec(site="slow-host", nth=3, count=2)])
+        assert _schedule(reg, "slow-host", 6) == [
+            False, False, True, True, False, False,
+        ]
+
+    def test_count_none_fires_forever_from_nth(self):
+        reg = FaultRegistry(
+            specs=[FaultSpec(site="mid-step-crash", nth=2, count=None)]
+        )
+        assert _schedule(reg, "mid-step-crash", 4) == [
+            False, True, True, True,
+        ]
+
+    def test_match_substring_filters_key(self):
+        reg = FaultRegistry(specs=[
+            FaultSpec(site="backend-http", match="POST /prompt", nth=1),
+        ])
+        assert reg.check("backend-http", key="GET /health") is None
+        act = reg.check("backend-http", key="POST /prompt")
+        assert act is not None and act.hit == 1
+
+    def test_site_mismatch_never_fires(self):
+        reg = FaultRegistry(specs=[FaultSpec(site="slow-host", nth=1)])
+        assert reg.check("backend-http", key="POST /prompt") is None
+
+    def test_seeded_schedule_deterministic(self):
+        """The chaos contract: same plan (same seed) → identical firing
+        schedule; the derived nth is a pure function of (seed, site, match)
+        inside [1, 4]."""
+        plan = {"seed": 11, "faults": [{"site": "slow-host"},
+                                       {"site": "backend-http"}]}
+        seed, specs = parse_plan(json.dumps(plan))
+        r1 = FaultRegistry(seed=seed, specs=specs)
+        r2 = FaultRegistry(seed=seed, specs=parse_plan(json.dumps(plan))[1])
+        for site in ("slow-host", "backend-http"):
+            assert _schedule(r1, site, 8) == _schedule(r2, site, 8)
+        for spec in specs:
+            assert 1 <= spec.resolved_nth(seed) <= 4
+            assert spec.resolved_nth(seed) == spec.resolved_nth(seed)
+
+    def test_fired_counts_and_reset(self):
+        reg = FaultRegistry(specs=[FaultSpec(site="slow-host", nth=1, count=1)])
+        assert _schedule(reg, "slow-host", 3) == [True, False, False]
+        assert reg.fired() == {"slow-host": 1}
+        reg.reset()
+        assert reg.fired() == {}
+        assert reg.check("slow-host") is not None  # re-armed
+
+    def test_fired_fault_counts_metric(self):
+        from comfyui_parallelanything_tpu.utils.metrics import registry
+
+        before = registry.get("pa_fault_injected_total",
+                              {"site": "slow-host"}) or 0.0
+        reg = FaultRegistry(specs=[FaultSpec(site="slow-host", nth=1)])
+        assert reg.check("slow-host") is not None
+        after = registry.get("pa_fault_injected_total", {"site": "slow-host"})
+        assert after == before + 1
+
+    def test_fired_fault_records_span(self):
+        from comfyui_parallelanything_tpu.utils import tracing
+
+        tracing.enable()
+        try:
+            reg = FaultRegistry(specs=[FaultSpec(site="slow-host", nth=1)])
+            assert reg.check("slow-host", key="p1") is not None
+            events = [e for e in tracing.export()["traceEvents"]
+                      if e.get("ph") == "X" and e["name"] == "fault-injected"]
+            assert events and events[-1]["cat"] == "faults"
+            assert events[-1]["args"]["site"] == "slow-host"
+        finally:
+            tracing.disable()
+
+    def test_oom_error_matches_oom_classifier(self):
+        from comfyui_parallelanything_tpu.utils.telemetry import looks_like_oom
+
+        reg = FaultRegistry(specs=[FaultSpec(site="mid-step-crash", nth=1)])
+        act = reg.check("mid-step-crash")
+        assert looks_like_oom(faults.oom_error(act))
+
+
+class TestArmingRule:
+    def test_plan_without_redirect_never_fires(self, monkeypatch):
+        """The one rule: an armed plan requires the evidence/ledger
+        redirect — injected failures must never pollute real evidence."""
+        monkeypatch.delenv("PA_EVIDENCE_DIR", raising=False)
+        monkeypatch.delenv("PA_LEDGER_DIR", raising=False)
+        monkeypatch.setenv("PA_FAULT_PLAN", '[{"site": "slow-host", "nth": 1}]')
+        reg = FaultRegistry.from_env()
+        assert not reg.armed
+        assert reg.check("slow-host") is None
+
+    def test_plan_with_redirect_armed(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PA_EVIDENCE_DIR", str(tmp_path))
+        monkeypatch.setenv("PA_FAULT_PLAN", '[{"site": "slow-host", "nth": 1}]')
+        reg = FaultRegistry.from_env()
+        assert reg.armed and reg.check("slow-host") is not None
+
+    def test_no_env_is_noop(self, monkeypatch):
+        monkeypatch.delenv("PA_FAULT_PLAN", raising=False)
+        monkeypatch.delenv("PA_FAIL_INJECT", raising=False)
+        reg = FaultRegistry.from_env()
+        assert not reg.armed and reg.check("slow-host") is None
+
+    def test_module_disabled_path_is_noop(self, monkeypatch):
+        """The tier-1 contract: with nothing armed, the module-level hook is
+        a flag read returning None and the counter never moves."""
+        from comfyui_parallelanything_tpu.utils.metrics import registry
+
+        monkeypatch.delenv("PA_FAULT_PLAN", raising=False)
+        monkeypatch.delenv("PA_FAIL_INJECT", raising=False)
+        faults.reload()
+        before = registry.get("pa_fault_injected_total") or 0.0
+        for site in FAULT_SITES:
+            assert faults.check(site, key="anything") is None
+        assert not faults.active()
+        assert (registry.get("pa_fault_injected_total") or 0.0) == before
+
+    def test_refresh_tracks_env_changes(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("PA_FAULT_PLAN", raising=False)
+        monkeypatch.delenv("PA_FAIL_INJECT", raising=False)
+        faults.reload()
+        assert not faults.refresh().armed
+        monkeypatch.setenv("PA_LEDGER_DIR", str(tmp_path))
+        monkeypatch.setenv("PA_FAIL_INJECT", "nan:2")
+        assert faults.refresh().armed
+        assert faults.refresh().lane_nan_target() == 2
+        monkeypatch.delenv("PA_FAIL_INJECT", raising=False)
+        assert not faults.refresh().armed
+
+
+class TestLegacyAliases:
+    def test_nan_lane_alias(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PA_LEDGER_DIR", str(tmp_path))
+        monkeypatch.setenv("PA_FAIL_INJECT", "nan:3")
+        monkeypatch.delenv("PA_FAULT_PLAN", raising=False)
+        reg = FaultRegistry.from_env()
+        assert reg.lane_nan_target() == 3
+        # The alias parses to a lane-nan spec ONLY — bench's crash site
+        # must never fire for a nan: value (the round-11 contract).
+        assert reg.check("mid-step-crash") is None
+
+    def test_oom_alias_is_crash_from_step_three(self, monkeypatch, tmp_path):
+        """bench.py's historical contract: PA_FAIL_INJECT=oom fails from the
+        third step on (warmup steps 1–2 survive for the postmortem)."""
+        monkeypatch.setenv("PA_EVIDENCE_DIR", str(tmp_path))
+        monkeypatch.setenv("PA_FAIL_INJECT", "oom")
+        monkeypatch.delenv("PA_FAULT_PLAN", raising=False)
+        reg = FaultRegistry.from_env()
+        assert _schedule(reg, "mid-step-crash", 4) == [
+            False, False, True, True,
+        ]
+        assert reg.lane_nan_target() is None
+
+    def test_plan_wins_over_legacy(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PA_EVIDENCE_DIR", str(tmp_path))
+        monkeypatch.setenv("PA_FAIL_INJECT", "oom")
+        monkeypatch.setenv("PA_FAULT_PLAN", '[{"site": "slow-host", "nth": 1}]')
+        reg = FaultRegistry.from_env()
+        assert reg.check("mid-step-crash") is None
+        assert reg.check("slow-host") is not None
+
+
+class TestRetryPolicy:
+    def test_backoff_growth_and_cap(self):
+        p = retry.RetryPolicy(base_s=0.1, cap_s=1.0, multiplier=2.0,
+                              jitter=0.0)
+        assert p.backoff_s(0) == pytest.approx(0.1)
+        assert p.backoff_s(1) == pytest.approx(0.2)
+        assert p.backoff_s(10) == pytest.approx(1.0)  # capped
+
+    def test_jitter_deterministic_and_downward(self):
+        p = retry.RetryPolicy(base_s=1.0, cap_s=1.0, jitter=0.5)
+        a = p.backoff_s(0, key="host-a")
+        assert a == p.backoff_s(0, key="host-a")  # same (key, attempt)
+        assert 0.5 <= a <= 1.0                    # jitters DOWNWARD only
+        # Distinct keys de-synchronize.
+        vals = {round(p.backoff_s(0, key=f"h{i}"), 9) for i in range(16)}
+        assert len(vals) > 1
+
+    def test_attempts_respects_max(self):
+        p = retry.RetryPolicy(max_attempts=3, base_s=0.0, jitter=0.0)
+        slept = []
+        n = list(p.attempts(sleep=slept.append))
+        assert n == [0, 1, 2]
+        assert len(slept) == 2  # no sleep after the final attempt
+
+    def test_deadline_stops_attempts(self):
+        p = retry.RetryPolicy(max_attempts=100, base_s=10.0, jitter=0.0,
+                              deadline_s=5.0)
+        clock = [0.0]
+        slept = []
+
+        def fake_sleep(s):
+            slept.append(s)
+            clock[0] += s
+
+        n = list(p.attempts(sleep=fake_sleep, now=lambda: clock[0]))
+        assert len(n) == 2          # 0, sleep(min(10, 5)) → deadline spent
+        assert slept == [5.0]       # clamped to the remaining budget
+
+    def test_call_retries_then_raises_last(self):
+        p = retry.RetryPolicy(max_attempts=3, base_s=0.0, jitter=0.0)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise OSError(f"boom {len(calls)}")
+
+        with pytest.raises(OSError, match="boom 3"):
+            p.call(flaky)
+        assert len(calls) == 3
+
+    def test_call_returns_first_success(self):
+        p = retry.RetryPolicy(max_attempts=5, base_s=0.0, jitter=0.0)
+        calls = []
+
+        def second_try():
+            calls.append(1)
+            if len(calls) < 2:
+                raise OSError("once")
+            return "ok"
+
+        assert p.call(second_try) == "ok"
+        assert len(calls) == 2
+
+    def test_call_does_not_retry_foreign_exceptions(self):
+        p = retry.RetryPolicy(max_attempts=5, base_s=0.0)
+        with pytest.raises(ValueError):
+            p.call(lambda: (_ for _ in ()).throw(ValueError("not transient")),
+                   retry_on=(OSError,))
+
+
+class TestHeartbeatBackoff:
+    def test_unreachable_router_backs_off(self):
+        """The satellite fix: consecutive beat failures grow the wait toward
+        the cap instead of hot-looping the fixed cadence; one success snaps
+        back."""
+        from comfyui_parallelanything_tpu.fleet import HeartbeatClient
+
+        hb = HeartbeatClient("http://127.0.0.1:9", "h", "http://x",
+                             interval_s=0.5)
+        assert hb.next_wait_s() == 0.5
+        assert not hb.beat_once(timeout=0.2)
+        w1 = hb.next_wait_s()
+        assert not hb.beat_once(timeout=0.2)
+        w2 = hb.next_wait_s()
+        assert w1 >= 0.5 and w2 > w1
+        hb._failures = 0  # what a successful beat does
+        assert hb.next_wait_s() == 0.5

@@ -182,7 +182,7 @@ class TestHealthV2:
     def test_health_carries_fleet_fields(self, fleet):
         _, _, backends = fleet
         doc = _get(backends[0].base, "/health")
-        assert doc["schema"] == "pa-health/v2"
+        assert doc["schema"] == "pa-health/v3"
         assert doc["host_id"] == "host-0"
         assert doc["accepting"] is True
         assert doc["inflight_prompts"] == 0
@@ -212,7 +212,7 @@ class TestScoreboard:
         for b in backends:
             s = snap[b.host_id]
             assert s["healthy"] and s["accepting"]
-            assert s["schema"] == "pa-health/v2"
+            assert s["schema"] == "pa-health/v3"
             assert s["inflight_prompts"] == 0
             assert s["numerics_ok"] is True
             assert s["health_age_s"] is not None
@@ -391,6 +391,271 @@ class TestFailover:
         pid2 = _post(base, "/prompt", {"prompt": _graph(8)})["prompt_id"]
         entry2 = _wait_entry(base, pid2)
         assert entry2["status"]["fleet"]["host_id"] == survivor.host_id
+
+
+class TestJournal:
+    def test_append_fold_roundtrip(self, tmp_path):
+        from comfyui_parallelanything_tpu.fleet import PromptJournal
+
+        j = PromptJournal(str(tmp_path / "j.jsonl"))
+        j.append("submit", "p1", graph={"1": {}}, extra=None, key="k1",
+                 number=1)
+        j.append("dispatch", "p1", host="h0", backend_pid="b1", attempt=1)
+        j.append("submit", "p2", graph={"2": {}}, extra=None, key="k2",
+                 number=2)
+        j.append("resolve", "p1", status="done",
+                 entry={"status": {"status_str": "success"}})
+        table = j.replay()
+        assert table["p1"]["phase"] == "resolve"
+        assert table["p1"]["entry"]["status"]["status_str"] == "success"
+        assert table["p2"]["phase"] == "submit"
+        assert table["p2"]["graph"] == {"2": {}}
+
+    def test_torn_tail_skipped(self, tmp_path):
+        from comfyui_parallelanything_tpu.fleet import PromptJournal
+
+        j = PromptJournal(str(tmp_path / "j.jsonl"))
+        j.append("submit", "p1", graph={}, key="k", number=1)
+        j.close()
+        with open(j.path, "ab") as f:
+            f.write(b'{"schema": "pa-fleet-journal/v1", "ev": "disp')  # torn
+        table = j.replay()
+        assert list(table) == ["p1"]
+
+    def test_lease_lifecycle(self, tmp_path):
+        from comfyui_parallelanything_tpu.fleet import PromptJournal
+
+        j = PromptJournal(str(tmp_path / "j.jsonl"))
+        assert j.lease_stale(ttl_s=1.0)          # no lease yet
+        j.write_lease("router-a")
+        assert not j.lease_stale(ttl_s=60.0)
+        assert j.read_lease()["router_id"] == "router-a"
+        # A holder never treats its OWN lease as a dead primary.
+        assert not j.lease_stale(ttl_s=0.0, holder_not="router-a")
+        time.sleep(0.05)
+        assert j.lease_stale(ttl_s=0.01)         # aged out
+
+
+class TestRouterHA:
+    def _standby(self, journal_path, backends, lease_ttl=0.5):
+        from comfyui_parallelanything_tpu.fleet import (
+            FleetRegistry,
+            PromptJournal,
+            Scoreboard,
+            make_router,
+        )
+
+        srv, router = make_router(
+            port=0, backends=[(b.host_id, b.base) for b in backends],
+            fleet_registry=FleetRegistry(ttl_s=3.0),
+            scoreboard=Scoreboard(poll_s=0.1, stale_after_s=5.0,
+                                  fail_after=2, timeout_s=2.0),
+            saturation_depth=1, monitor_s=0.05,
+            journal=PromptJournal(journal_path),
+            standby=True, lease_ttl_s=lease_ttl,
+        )
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, router, f"http://127.0.0.1:{srv.server_address[1]}"
+
+    def test_standby_refuses_prompts_503(self, tmp_path, fleet):
+        _, _, backends = fleet
+        srv, router, base = self._standby(
+            str(tmp_path / "j.jsonl"), backends, lease_ttl=3600,
+        )
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(base, "/prompt", {"prompt": _graph(1)})
+            assert err.value.code == 503
+            assert json.loads(err.value.read())["role"] == "standby"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            router.shutdown()
+
+    def test_router_kill_mid_denoise_standby_takeover_zero_lost(
+        self, tmp_path
+    ):
+        """The HA headline: the PRIMARY ROUTER dies mid-denoise; the standby
+        tails the shared journal, sees the lease go stale, takes over,
+        re-collects/replays every unresolved prompt — zero lost, completed
+        entries (including ones resolved before the kill) served by the
+        standby it never saw live."""
+        from comfyui_parallelanything_tpu.fleet import (
+            FleetRegistry,
+            PromptJournal,
+            Scoreboard,
+            make_router,
+        )
+
+        backends = [_Backend(tmp_path, f"ha-host-{i}") for i in range(2)]
+        jpath = str(tmp_path / "journal.jsonl")
+        srv1, primary = make_router(
+            port=0, backends=[(b.host_id, b.base) for b in backends],
+            fleet_registry=FleetRegistry(ttl_s=3.0),
+            scoreboard=Scoreboard(poll_s=0.1, stale_after_s=5.0,
+                                  fail_after=2, timeout_s=2.0),
+            saturation_depth=2, monitor_s=0.05,
+            journal=PromptJournal(jpath), lease_ttl_s=0.5,
+        )
+        threading.Thread(target=srv1.serve_forever, daemon=True).start()
+        base1 = f"http://127.0.0.1:{srv1.server_address[1]}"
+        srv2, standby, base2 = self._standby(jpath, backends, lease_ttl=0.5)
+        try:
+            _wait(lambda: all(primary.scoreboard.healthy(b.host_id)
+                              for b in backends),
+                  what="backends healthy on the primary")
+            # One prompt completes BEFORE the kill (the journal-resolve
+            # record the standby must serve from /history later)...
+            pid_done = _post(base1, "/prompt",
+                             {"prompt": _graph(70)})["prompt_id"]
+            entry_done = _wait_entry(base1, pid_done)
+            assert entry_done["status"]["status_str"] == "success"
+            # ... and two are MID-DENOISE when the router dies.
+            pids = [
+                _post(base1, "/prompt",
+                      {"prompt": _graph(71 + i, work_s=2.0)})["prompt_id"]
+                for i in range(2)
+            ]
+            _wait(lambda: sum(len(b.q.running) for b in backends) >= 1,
+                  what="work running mid-denoise")
+            srv1.shutdown()
+            srv1.server_close()
+            primary.shutdown()   # lease stops refreshing → stale
+            _wait(lambda: standby.active, timeout=15,
+                  what="standby takeover")
+            # The standby serves history it never saw live (journal replay)…
+            got = _get(base2, f"/history/{pid_done}")
+            assert got[pid_done]["status"]["status_str"] == "success"
+            # …and the mid-denoise prompts complete through it: collected
+            # from the live backends (or failed over) — zero lost.
+            for pid in pids:
+                entry = _wait_entry(base2, pid, timeout=60)
+                assert entry["status"]["status_str"] == "success", entry
+            assert standby.stats()["lost"] == 0
+        finally:
+            srv2.shutdown()
+            srv2.server_close()
+            standby.shutdown()
+            for b in backends:
+                b.stop()
+
+    def test_journal_records_full_lifecycle(self, tmp_path):
+        from comfyui_parallelanything_tpu.fleet import (
+            FleetRegistry,
+            PromptJournal,
+            Scoreboard,
+            make_router,
+        )
+
+        backends = [_Backend(tmp_path, "jr-host-0")]
+        jpath = str(tmp_path / "jr.jsonl")
+        srv, router = make_router(
+            port=0, backends=[(b.host_id, b.base) for b in backends],
+            fleet_registry=FleetRegistry(ttl_s=3.0),
+            scoreboard=Scoreboard(poll_s=0.1, fail_after=2, timeout_s=2.0),
+            monitor_s=0.05, journal=PromptJournal(jpath),
+        )
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            _wait(lambda: router.scoreboard.healthy("jr-host-0"),
+                  what="backend healthy")
+            pid = _post(base, "/prompt", {"prompt": _graph(5)})["prompt_id"]
+            _wait_entry(base, pid)
+            evs = [r["ev"] for r in PromptJournal.iter_records(jpath)
+                   if r["pid"] == pid]
+            assert evs[:2] == ["submit", "dispatch"]
+            _wait(lambda: "resolve" in [
+                r["ev"] for r in PromptJournal.iter_records(jpath)
+                if r["pid"] == pid
+            ], what="resolve journaled")
+            table = PromptJournal(jpath).replay()
+            assert table[pid]["phase"] == "resolve"
+            assert table[pid]["entry"]["status"]["status_str"] == "success"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            router.shutdown()
+            for b in backends:
+                b.stop()
+
+
+class TestResidencyAwarePlacement:
+    def test_health_v3_advertises_warm_keys(self, fleet):
+        """A backend that served a model advertises its key (pa-health/v3);
+        the scoreboard parses it into warm()."""
+        base, router, backends = fleet
+        pid = _post(base, "/prompt", {"prompt": _graph(1)})["prompt_id"]
+        entry = _wait_entry(base, pid)
+        hot = entry["status"]["fleet"]["host_id"]
+        key = model_key(_graph(1))
+        hot_base = next(b.base for b in backends if b.host_id == hot)
+        doc = _get(hot_base, "/health")
+        assert key in doc["warm_keys"]
+        _wait(lambda: router.scoreboard.warm(hot, key),
+              what="scoreboard sees the warm key")
+        cold = next(b.host_id for b in backends if b.host_id != hot)
+        assert not router.scoreboard.warm(cold, key)
+
+    def test_failover_prefers_warm_sibling(self, fleet):
+        """place(prefer_warm=True) orders warm hosts first even when ring
+        order says otherwise — the replay path's preference."""
+        base, router, backends = fleet
+        key = model_key(_graph(1))
+        seq = router.registry.sequence(key)
+        primary, sibling = seq[0], seq[1]
+
+        def _fabricate_warmth():
+            # The monitor's background poll rewrites warm_keys from the real
+            # health docs — re-fabricate immediately before each placement.
+            with router.scoreboard._lock:
+                router.scoreboard._entries[sibling].warm_keys = (
+                    frozenset({key})
+                )
+                router.scoreboard._entries[primary].warm_keys = frozenset()
+
+        _fabricate_warmth()
+        cold_first, _, _ = router.place(key)
+        assert cold_first == primary          # fresh traffic: ring order
+        _fabricate_warmth()
+        warm_first, _, _ = router.place(key, prefer_warm=True)
+        assert warm_first == sibling          # replay: warmth wins
+        # Warmth never overrides health: a draining warm host loses.
+        try:
+            router.scoreboard.mark_draining(sibling)
+            _fabricate_warmth()
+            with router.scoreboard._lock:
+                router.scoreboard._entries[sibling].accepting = False
+            again, _, _ = router.place(key, prefer_warm=True)
+            assert again == primary
+        finally:
+            with router.scoreboard._lock:
+                router.scoreboard._entries[sibling].accepting = True
+
+
+class TestHeartbeatRejoin:
+    def test_rejoin_fires_callback_and_resumes(self, tmp_path, fleet):
+        """A host whose registration lapsed (router lost it) re-JOINS on its
+        next beat — the on_rejoin hook fires exactly then (never on refresh
+        beats), restoring admission on the returning backend."""
+        from comfyui_parallelanything_tpu.fleet import HeartbeatClient
+
+        base, router, backends = fleet
+        extra = _Backend(tmp_path, "rejoin-host")
+        rejoins = []
+        hb = HeartbeatClient(base, extra.host_id, extra.base,
+                             interval_s=0.5,
+                             on_rejoin=lambda: rejoins.append(1))
+        try:
+            assert hb.beat_once()            # first join: NOT a rejoin
+            assert rejoins == []
+            assert hb.beat_once()            # refresh: not a rejoin either
+            assert rejoins == []
+            router.registry.remove(extra.host_id)  # expiry stand-in
+            assert hb.beat_once()            # falls back ON → rejoin
+            assert len(rejoins) == 1
+        finally:
+            extra.stop()
 
 
 class TestFleetSmoke:

@@ -159,6 +159,9 @@ class TestChunkedAttention:
         assert att.resolved_backends() == ("xla_chunked",)
         assert att.chunk_config() == {
             "chunk_elems": 64, "bf16_softmax": False,
+            # No degradation-ladder shrink in effect (round 14 evidence
+            # labeling — a degraded process must not bank as configured).
+            "degraded": False,
             # Per-field provenance: only the threshold came from the env.
             "sources": {"chunk_elems": "env", "bf16_softmax": "default"},
         }

@@ -534,7 +534,7 @@ _PRINT_ALLOWLIST = (
     ("host.py", "usage: python -m"),          # __main__ CLI usage line
     ("host.py", "{nid}:"),                    # __main__ CLI result echo
     ("server.py", "workflow server on"),      # server startup banner
-    ("fleet/router.py", "fleet router on"),   # router startup banner
+    ("fleet/router.py", "fleet {role} on"),   # router startup banner
 )
 _TIME_TIME_ALLOWLIST = (
     # Wall-clock epoch STAMPS (ledger ts, health ts, error ts) — not timing;
@@ -547,6 +547,15 @@ _TIME_TIME_ALLOWLIST = (
     # Roofline calibration bank (round 13): epoch stamp on the persisted
     # store, same pattern as the ledger/golden banks.
     ("utils/roofline.py", '"ts": time.time()'),
+    # Prompt journal + lease (round 14): wall-clock is the ONE clock two
+    # router processes share — record stamps and lease-age math must use it
+    # (monotonic clocks are process-local and incomparable across a
+    # failover pair).
+    ("fleet/journal.py", '"ts": time.time()'),
+    ("fleet/journal.py", "age = time.time()"),
+    # Warm-key recency stamps (pa-health/v3): epoch stamps on an advertised
+    # surface, same pattern as the health ts.
+    ("server.py", "warm_keys[key] = time.time()"),
 )
 
 
