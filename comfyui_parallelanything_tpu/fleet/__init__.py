@@ -1,9 +1,13 @@
 """Fleet tier: multi-host front-door routing over N workflow servers.
 
 - fleet/registry.py   — membership: consistent-hash ring + heartbeats
-- fleet/scoreboard.py — per-host health polled from ``GET /health``
+- fleet/scoreboard.py — per-host health polled from ``GET /health`` (+ the
+                        /metrics scrape cache behind ``GET /fleet/metrics``)
 - fleet/router.py     — the front-door process: warm-affinity placement,
                         health-driven admission, lossless failover
+- fleet/journal.py    — the durable prompt journal + lease (router HA)
+- fleet/twin.py       — seeded arrival processes + the discrete-event
+                        traffic twin (stdlib-only, standalone-loadable)
 
 The router owns no model state; backends are plain ``server.py`` processes
 (``--fleet-router`` makes them register elastically). See README "Fleet

@@ -43,6 +43,7 @@ import threading
 import time
 import urllib.request
 
+from ..utils import faults
 from ..utils.logging import get_logger
 
 log = get_logger()
@@ -83,6 +84,21 @@ class PromptJournal:
         rec = {"schema": JOURNAL_SCHEMA, "ev": ev, "pid": pid,
                "ts": time.time(), **fields}
         line = (json.dumps(rec, default=str) + "\n").encode()
+        # Fault site (utils/faults.py): a router crash mid-write leaves a
+        # TORN tail — mode=truncate writes half the line with no newline
+        # (the record is lost; the NEXT append concatenates onto it, so one
+        # more line is unparseable — exactly the disk state a real crash +
+        # restart produces); mode=garble keeps the length and newline but
+        # NULs the middle (unparseable, neighbors intact). Either way the
+        # fold/replay side must skip the damage and the standby's takeover
+        # must still lose zero prompts — the chaos-matrix assertion.
+        action = faults.check("journal-corrupt", key=ev)
+        if action is not None:
+            if action.mode == "garble":
+                mid = max(1, len(line) // 3)
+                line = line[:mid] + b"\x00" * mid + line[2 * mid:]
+            else:  # truncate (default): torn tail, no newline
+                line = line[: max(1, len(line) // 2)]
         try:
             with self._lock:
                 f = self._file()

@@ -53,12 +53,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..utils import retry as retry_mod
-from ..utils import tracing
+from ..utils import slo, tracing
 from ..utils.logging import get_logger
 from ..utils.metrics import registry
 from .journal import JournalFollower, PromptJournal
 from .registry import FleetRegistry, stable_hash
-from .scoreboard import Scoreboard
+from .scoreboard import Scoreboard, merge_metrics
 
 log = get_logger()
 
@@ -162,6 +162,7 @@ class FleetRouter:
                  standby: bool = False, lease_ttl_s: float = 10.0,
                  follower: JournalFollower | None = None,
                  retry_policy: retry_mod.RetryPolicy | None = None,
+                 rebalance_warm_s: float = 30.0,
                  auto: bool = True):
         self.registry = fleet_registry or FleetRegistry()
         self.scoreboard = scoreboard or Scoreboard()
@@ -200,6 +201,15 @@ class FleetRouter:
             cap_s=5.0, jitter=0.25,
         )
         self.router_id = f"router-{uuid.uuid4().hex[:8]}"
+        # Ring-change warm dwell (ROADMAP fleet remainder, round 15): for
+        # ``rebalance_warm_s`` after a join/leave reshuffle, placement runs
+        # prefer_warm — keys whose ring primary just moved to a cold joiner
+        # re-home to warm siblings first instead of paying the compile +
+        # weight staging on the new primary; the dwell ends once the
+        # joiner has had time to warm organically (failover/replay keeps
+        # its own unconditional prefer_warm, as before).
+        self.rebalance_warm_s = float(rebalance_warm_s)
+        self._ring_changed_until = 0.0
         self.prompts: dict[str, FleetPrompt] = {}
         self._inflight: dict[str, int] = {}   # host_id → router-side count
         # monotonic stamp of the last router-side inflight DECREASE per
@@ -249,6 +259,18 @@ class FleetRouter:
                 0, self._inflight.get(host_id, 0) - 1
             )
             self._last_drop[host_id] = time.monotonic()
+
+    def note_ring_change(self) -> None:
+        """A join/leave reshuffled the ring: open the prefer-warm dwell
+        window (see ``rebalance_warm_s``)."""
+        with self._lock:
+            self._ring_changed_until = (
+                time.monotonic() + self.rebalance_warm_s
+            )
+
+    def _ring_recently_changed(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._ring_changed_until
 
     def _polled_fresh(self, host_id: str) -> bool:
         """Is the scoreboard's last poll newer than this router's own last
@@ -364,6 +386,10 @@ class FleetRouter:
         every candidate raises (submit path) — failover callers catch and
         leave the prompt ``queued`` for the next monitor sweep."""
         exclude = set(exclude or ())
+        # Ring-change dwell: fresh traffic ALSO prefers warm siblings while
+        # a join/leave reshuffle is settling — a key re-homed to a cold
+        # joiner goes where its programs are still resident instead.
+        prefer_warm = prefer_warm or self._ring_recently_changed()
         saw_backpressure = False
         while True:
             if fp.attempts >= self.max_attempts:
@@ -627,7 +653,10 @@ class FleetRouter:
                 self._standby_sweep()
                 return
             self.journal.write_lease(self.router_id)
-        for hid in self.registry.expire():
+        expired = self.registry.expire()
+        if expired:
+            self.note_ring_change()  # leave reshuffle: prefer-warm dwell
+        for hid in expired:
             self.failover_host(hid, "heartbeat expired")
         hosts = {hid: info.base for hid, info in self.registry.hosts().items()}
         self.scoreboard.poll_due(hosts)
@@ -840,6 +869,7 @@ class FleetRouter:
         """Explicit ring departure; in-flight prompts fail over."""
         removed = self.registry.remove(host_id)
         if removed:
+            self.note_ring_change()  # leave reshuffle: prefer-warm dwell
             self.failover_host(host_id, "left the ring")
         return removed
 
@@ -893,6 +923,77 @@ class FleetRouter:
             inflight = dict(self._inflight)
         return {"prompts": by_status, "router_inflight": inflight,
                 "lost": by_status.get("lost", 0)}
+
+    def fleet_metrics_view(self) -> tuple[str, dict]:
+        """The fleet-wide merged Prometheus view (``GET /fleet/metrics``):
+        every live backend's ``/metrics`` (scoreboard-cached, backoff-aware
+        — a dead host serves its last scrape with a staleness marker, never
+        a blocking fetch) plus this router's own registry, every series
+        host-labeled. Returns ``(merged_text, stale_by_host)`` — stale
+        means the host's section was never scraped or the host is failing
+        (a backoff-served cache); a healthy host served from the freshness
+        window is NOT stale (its cache is younger than the poll
+        interval). The predicate is computed ONCE here — the
+        ``pa_fleet_scrape_stale`` markers and ``/fleet/slo``'s
+        ``scrape_stale`` field are the same judgment at the same
+        instant."""
+        self.publish_gauges()
+        texts: dict[str, str] = {}
+        ages: dict[str, float | None] = {}
+        stale: dict[str, bool] = {}
+        for hid, info in self.registry.hosts().items():
+            text, age = self.scoreboard.scrape_metrics(hid, info.base)
+            ages[hid] = age
+            stale[hid] = (age is None
+                          or self.scoreboard.in_backoff(hid)
+                          or self.scoreboard.dead(hid))
+            if text is not None:
+                texts[hid] = text
+        texts[self.router_id] = registry.render()
+        merged = merge_metrics(texts)
+        # Staleness markers: the merged view degrades, visibly, instead of
+        # stalling behind a dead backend.
+        extra = [
+            "# TYPE pa_fleet_scrape_stale gauge",
+        ]
+        for hid in sorted(stale):
+            extra.append(
+                f'pa_fleet_scrape_stale{{host="{hid}"}} '
+                f"{1.0 if stale[hid] else 0.0:.9g}"
+            )
+        extra.append("# TYPE pa_fleet_scrape_age_seconds gauge")
+        for hid, age in sorted(ages.items()):
+            if age is not None:
+                extra.append(
+                    f'pa_fleet_scrape_age_seconds{{host="{hid}"}} '
+                    f"{age:.9g}"
+                )
+        return merged + "\n".join(extra) + "\n", stale
+
+    def fleet_slo_view(self) -> dict:
+        """Objective verdicts over the merged fleet view (``GET
+        /fleet/slo``): the declared objectives (PA_SLO_OBJECTIVES or the
+        defaults) judged against the merged ``pa_slo_request_seconds``
+        histograms — fleet-wide and per host. Exposition histograms are
+        lifetime-cumulative; the windowed view rides each host's own
+        ``pa_slo_burn_rate`` gauges inside the merged text."""
+        merged, stale = self.fleet_metrics_view()
+        objectives = slo.objectives_from_env()
+        hosts = {}
+        for hid in self.registry.hosts():
+            per = slo.verdicts_from_text(merged, objectives,
+                                         labels={"host": hid})
+            hosts[hid] = {
+                "objectives": per,
+                "scrape_stale": stale.get(hid, True),
+            }
+        return {
+            "schema": "pa-fleet-slo/v1",
+            "router_id": self.router_id,
+            "enabled": slo.enabled(),
+            "objectives": slo.verdicts_from_text(merged, objectives),
+            "hosts": hosts,
+        }
 
     def publish_gauges(self) -> None:
         self.scoreboard.publish_gauges()
@@ -995,6 +1096,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 "ring": r.registry.snapshot(),
                 "scoreboard": r.scoreboard.snapshot(),
             })
+        if url.path == "/fleet/metrics":
+            # ONE Prometheus view of the whole fleet: every backend's
+            # /metrics merged host-labeled with the router's own, dead
+            # hosts degrading to their cached scrape + a staleness marker.
+            merged, _ = r.fleet_metrics_view()
+            body = merged.encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            return self.wfile.write(body)
+        if url.path == "/fleet/slo":
+            return self._send(200, r.fleet_slo_view())
         return self._send(404, {"error": f"no route {url.path}"})
 
     def do_POST(self):  # noqa: N802 — http.server API
@@ -1031,8 +1147,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
             joined = r.registry.heartbeat(str(host_id), str(base))
             if joined:
                 # Poll immediately so the joiner is placeable without
-                # waiting out a scoreboard interval.
+                # waiting out a scoreboard interval — and open the
+                # prefer-warm dwell: keys the reshuffle re-homed onto this
+                # cold joiner keep going to warm siblings until it warms.
                 r.scoreboard.poll_host(str(host_id), str(base).rstrip("/"))
+                r.note_ring_change()
             return self._send(200, {"joined": joined})
         if url.path == "/fleet/leave":
             host_id = str(payload.get("host_id") or "")

@@ -34,6 +34,85 @@ from ..utils.metrics import registry
 log = get_logger()
 
 
+def _esc_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_host(line: str, host_id: str) -> str:
+    """Inject ``host="<id>"`` into one exposition sample line. A line
+    already carrying a host label keeps it (the router's own
+    ``pa_fleet_host_*`` gauges name backends, not the router)."""
+    head, _, value = line.rpartition(" ")
+    if not head:
+        return line
+    if "{" in head:
+        name, _, labels = head.partition("{")
+        if 'host="' in labels:
+            return line
+        return f'{name}{{host="{_esc_label(host_id)}",{labels} {value}'
+    return f'{head}{{host="{_esc_label(host_id)}"}} {value}'
+
+
+def merge_metrics(texts: dict[str, str]) -> str:
+    """Merge per-host Prometheus expositions into ONE host-labeled view
+    (``GET /fleet/metrics``): every sample line gains a ``host`` label, and
+    samples regroup under one ``# HELP``/``# TYPE`` block per metric family
+    (exposition format requires a family's samples to be contiguous —
+    interleaving N hosts' blocks verbatim would not parse). First host's
+    HELP text wins; histogram ``_bucket``/``_sum``/``_count`` samples
+    follow their family."""
+    families: dict[str, dict] = {}
+    order: list[str] = []
+
+    def fam_slot(name: str) -> dict:
+        f = families.get(name)
+        if f is None:
+            f = families[name] = {"help": None, "type": None, "samples": []}
+            order.append(name)
+        return f
+
+    for hid, text in texts.items():
+        local_types: dict[str, str] = {}
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                parts = line.split(None, 3)
+                if len(parts) == 4:
+                    local_types[parts[2]] = parts[3]
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                parts = line.split(None, 3)
+                if len(parts) < 4:
+                    continue
+                f = fam_slot(parts[2])
+                key = "help" if parts[1] == "HELP" else "type"
+                if f[key] is None:
+                    f[key] = parts[3]
+                continue
+            if line.startswith("#"):
+                continue
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            family = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                base = name[: -len(suffix)] if name.endswith(suffix) else None
+                if base and base in local_types:
+                    family = base
+                    break
+            fam_slot(family)["samples"].append(_label_host(line, hid))
+    lines: list[str] = []
+    for name in order:
+        f = families[name]
+        if not f["samples"]:
+            continue
+        if f["help"] is not None:
+            lines.append(f"# HELP {name} {f['help']}")
+        if f["type"] is not None:
+            lines.append(f"# TYPE {name} {f['type']}")
+        lines.extend(f["samples"])
+    return "\n".join(lines) + "\n"
+
+
 @dataclasses.dataclass
 class HostHealth:
     """Last known health of one backend, plus the poll bookkeeping."""
@@ -61,6 +140,9 @@ class HostHealth:
     consecutive_failures: int = 0
     next_poll: float = 0.0
     last_error: str | None = None
+    # -- /metrics scrape cache (GET /fleet/metrics) --
+    metrics_text: str | None = None
+    metrics_ts: float | None = None
 
     def age_s(self, now: float | None = None) -> float | None:
         if self.last_ok is None:
@@ -196,6 +278,51 @@ class Scoreboard:
             log.warning("fleet host %s marked dead after %d failures (%s)",
                         host_id, n, error)
         return n
+
+    # -- metrics scrape (GET /fleet/metrics) --------------------------------
+
+    def scrape_metrics(self, host_id: str, base: str) -> tuple[str | None, float | None]:
+        """One host's ``GET /metrics`` body for the fleet-wide merged view,
+        riding the health-poll failure bookkeeping: a host in failure
+        backoff (or already dead) is NEVER re-fetched here — its cached
+        text serves with a staleness marker instead, so one dead backend
+        degrades the merged view by exactly its own staleness and never
+        stalls the scrape past the poll timeout. Returns
+        ``(text_or_None, age_s_or_None)``."""
+        now = time.monotonic()
+        with self._lock:
+            e = self._entry(host_id, base)
+            skip = (e.consecutive_failures >= self.fail_after
+                    or (e.consecutive_failures > 0 and e.next_poll > now))
+            cached, cached_ts = e.metrics_text, e.metrics_ts
+        if not skip and cached_ts is not None and now - cached_ts < self.poll_s:
+            # Freshness window: a scrape younger than the poll interval
+            # serves from cache — back-to-back /fleet/metrics + /fleet/slo
+            # (or an eager dashboard) must not double every backend's
+            # /metrics load, and N sequential fetches must not stack
+            # request latency on every view.
+            return cached, now - cached_ts
+        if skip:
+            return cached, (now - cached_ts) if cached_ts is not None else None
+        try:
+            with urllib.request.urlopen(
+                base + "/metrics", timeout=self.timeout_s
+            ) as r:
+                text = r.read().decode("utf-8", "replace")
+        except (OSError, ValueError) as e:
+            # The same failure counter as a failed health poll — a host
+            # that eats metrics scrapes is as suspect as one that eats
+            # health checks, and the shared backoff keeps the NEXT merged
+            # view from paying this timeout again.
+            self.record_failure(host_id, base, f"metrics: {e}")
+            now = time.monotonic()
+            return cached, (now - cached_ts) if cached_ts is not None else None
+        now = time.monotonic()
+        with self._lock:
+            e = self._entry(host_id, base)
+            e.metrics_text = text
+            e.metrics_ts = now
+        return text, 0.0
 
     # -- the router's three questions ---------------------------------------
 

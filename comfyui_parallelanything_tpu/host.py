@@ -35,10 +35,11 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time as _time
 from typing import Any
 
 
-from .utils import tracing
+from .utils import slo, tracing
 from .utils.progress import Interrupted, check_interrupt
 
 
@@ -376,11 +377,27 @@ def run_workflow(
             # One workflow-node span per executed node (cached nodes never
             # reach here) — the graph layer of the per-prompt timeline; the
             # prompt_id correlation rides the thread's progress scope.
+            ct = str(spec.get("class_type") or "")
+            t0_node = _time.monotonic() if slo.enabled() else 0.0
             with tracing.span(
                 "workflow-node", cat="graph", node=nid,
                 class_type=spec.get("class_type"),
             ):
                 out = fn(**kwargs)
+            if slo.enabled():
+                # SLO stage decomposition by node class: sampler nodes are
+                # the EVAL stage (their wall includes the in-lane residency;
+                # lane_wait is observed separately at the serving bucket),
+                # decode nodes the DECODE stage — same boundary the
+                # workflow-node span measures, one clock, two views.
+                if "Decode" in ct:
+                    slo.observe_stage(
+                        "decode", _time.monotonic() - t0_node
+                    )
+                elif "Sampler" in ct:
+                    slo.observe_stage(
+                        "eval", _time.monotonic() - t0_node
+                    )
         except (WorkflowError, Interrupted):
             raise
         except Exception as e:

@@ -108,7 +108,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from .host import WorkflowCache, run_workflow
-from .utils import faults, tracing
+from .utils import faults, slo, tracing
 from .utils.progress import Interrupted, progress_scope
 
 _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"  # RFC 6455 §1.3
@@ -395,8 +395,10 @@ class PromptQueue:
             self.counter += 1
             number = self.counter
             self.pending_ids.append(pid)
+            # The enqueue clock rides the item: the worker's pickup delta is
+            # the ADMISSION stage of the SLO latency decomposition.
             self.pending.put((pid, prompt, bool(preview), int(priority),
-                              deadline_s, fleet))
+                              deadline_s, fleet, time.monotonic()))
         self._emit_status()
         return pid, number
 
@@ -559,7 +561,7 @@ class PromptQueue:
             if item is None:
                 self.pending.put(None)  # cascade to sibling workers
                 return
-            pid, prompt, preview, priority, deadline_s, fleet = item
+            pid, prompt, preview, priority, deadline_s, fleet, enq_ts = item
             cancel_evt = threading.Event()
             with self._lock:
                 if pid not in self.pending_ids:
@@ -570,6 +572,15 @@ class PromptQueue:
                 self.running[pid] = cancel_evt
             self._emit({"type": "execution_start", "data": {"prompt_id": pid}})
             t0 = time.monotonic()
+            # SLO admission stage: ingress → worker pickup — the queue wait
+            # a closed-loop client never inflates and an open-loop one does.
+            admission_s = max(0.0, t0 - enq_ts)
+            slo.observe_stage("admission", admission_s)
+            if tracing.on():
+                now_us = tracing.now_us()
+                tracing.record("admission-wait", now_us - admission_s * 1e6,
+                               admission_s * 1e6, cat="server",
+                               prompt_id=pid)
             # Per-node `executing` + per-step `progress` events — the pair a
             # stock ComfyUI frontend renders its progress bars from. The node
             # id rides a cell so the progress hook can tag its events with
@@ -697,6 +708,10 @@ class PromptQueue:
             # fleet tier's per-host latency attribution rides this field
             # (scripts/loadgen.py groups client latencies by it).
             entry["status"]["host_id"] = self.host_id
+            # SLO request residency: admission wait + execution — the
+            # server-observable part of the client's end-to-end latency
+            # (the client-side remainder is loadgen's "collect" residual).
+            slo.observe_request(admission_s + (time.monotonic() - t0))
             with self._lock:
                 self.history[pid] = entry
                 if pid in self.pending_ids:
@@ -844,6 +859,13 @@ class _Handler(BaseHTTPRequestHandler):
                 from .utils import roofline
 
                 roofline.publish_gauges()
+            except Exception:
+                pass
+            try:
+                # pa_slo_* burn-rate/budget gauges (utils/slo.py): windowed
+                # objective verdicts published at scrape time — the
+                # histograms carry lifetime counts, the gauges the window.
+                slo.registry.publish_gauges()
             except Exception:
                 pass
             return self._send(

@@ -60,7 +60,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ..sampling.lane_specs import LANE_SPECS, StepPlan, plan_schedule
-from ..utils import numerics, tracing
+from ..utils import numerics, slo, tracing
 from ..utils.metrics import registry
 from ..utils.progress import Interrupted
 from .policy import AdmissionQueue, DeadlineExceeded
@@ -434,6 +434,9 @@ class StepBucket:
                 labels=self._labels,
                 help="submit-to-lane admission wait",
             )
+            # SLO lane_wait stage: the same clock, bucket-label-free — the
+            # decomposition view of the per-bucket histogram above.
+            slo.observe_stage("lane_wait", now - req.submit_ts)
             if tracing.on():
                 # admission→lane-assign on the submitter's timeline: one
                 # completed span from submit to seat (both trace-clock).

@@ -69,6 +69,11 @@ FAULT_SITES = {
                       "OOM-shaped RuntimeError mid-run",
     "lane-nan": "serving lane eval input (via utils/numerics.take_injection) "
                 "— match is the lane index to poison",
+    "journal-corrupt": "fleet PromptJournal.append — the record's line is "
+                       "written torn (mode=truncate: half the bytes, no "
+                       "newline) or garbled (mode=garble: NULs mid-line), "
+                       "rehearsing a router crash mid-write; match filters "
+                       "the event name (submit/dispatch/resolve)",
 }
 
 

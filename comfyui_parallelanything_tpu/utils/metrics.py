@@ -51,15 +51,23 @@ class MetricsRegistry:
     instrumentation sites must never crash a serving path over bookkeeping):
     ``counter`` (monotonic), ``gauge`` (set to the latest value), ``summary``
     (accumulates ``_sum``/``_count`` — enough for rate/mean queries without
-    carrying quantile sketches), and ``histogram`` (fixed log-spaced buckets
-    with Prometheus ``_bucket``/``_sum``/``_count`` exposition — the
+    carrying quantile sketches), and ``histogram`` (log-spaced buckets by
+    default, with Prometheus ``_bucket``/``_sum``/``_count`` exposition — the
     server-side quantile source, so a load generator can read p50/p95 off
     ``GET /metrics`` instead of only computing them client-side). Labels are
-    a plain dict, canonicalized to a sorted tuple key."""
+    a plain dict, canonicalized to a sorted tuple key.
+
+    A histogram may declare EXPLICIT bucket bounds at first touch
+    (``histogram(..., bounds=...)``) — the SLO plane aligns
+    ``pa_slo_request_seconds`` edges to the declared latency thresholds so
+    an objective verdict is a bucket read, never an interpolation. Bounds
+    are per-metric and first-touch-wins (all label sets of one metric share
+    one ladder, so exposition always merges across hosts that declared the
+    same objectives)."""
 
     # Log-spaced duration buckets, 1 ms … 100 s (~2.5x steps): wide enough
-    # for lane waits under load AND sub-5ms compiled step dispatches; fixed
-    # (not per-metric) so two servers' exposition always merges.
+    # for lane waits under load AND sub-5ms compiled step dispatches; the
+    # shared default so two servers' exposition always merges.
     HIST_BOUNDS = (
         0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
         1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
@@ -106,22 +114,33 @@ class MetricsRegistry:
             acc[1] += 1.0
 
     def histogram(self, name: str, value: float, labels: dict | None = None,
-                  help: str = "") -> None:
-        """Observe ``value`` (seconds) into the fixed log-spaced buckets."""
+                  help: str = "", bounds=None) -> None:
+        """Observe ``value`` (seconds) into the metric's buckets. ``bounds``
+        (an ascending tuple of upper edges) fixes the ladder at the metric's
+        FIRST touch — omitted, the log-spaced default applies; on later
+        touches it is ignored (first wins: one ladder per metric, so every
+        label set and every host's exposition stays mergeable)."""
         v = float(value)
         with self._lock:
-            vals = self._slot(name, "histogram", help)["values"]
+            m = self._slot(name, "histogram", help)
+            hb = m.get("bounds")
+            if hb is None:
+                hb = m["bounds"] = (
+                    tuple(float(b) for b in bounds)
+                    if bounds else self.HIST_BOUNDS
+                )
+            vals = m["values"]
             k = self._label_key(labels)
             acc = vals.get(k)
             if acc is None:
                 # [per-bound counts..., +Inf count, sum, count]
-                acc = vals[k] = [0.0] * (len(self.HIST_BOUNDS) + 1) + [0.0, 0.0]
-            for i, bound in enumerate(self.HIST_BOUNDS):
+                acc = vals[k] = [0.0] * (len(hb) + 1) + [0.0, 0.0]
+            for i, bound in enumerate(hb):
                 if v <= bound:
                     acc[i] += 1.0
                     break
             else:
-                acc[len(self.HIST_BOUNDS)] += 1.0
+                acc[len(hb)] += 1.0
             acc[-2] += v
             acc[-1] += 1.0
 
@@ -153,7 +172,8 @@ class MetricsRegistry:
                 accs = [acc] if acc is not None else []
             if not accs:
                 return None
-            n = len(self.HIST_BOUNDS)
+            hb = m.get("bounds") or self.HIST_BOUNDS
+            n = len(hb)
             counts = [sum(a[i] for a in accs) for i in range(n + 1)]
         total = sum(counts)
         if total <= 0:
@@ -163,9 +183,9 @@ class MetricsRegistry:
         lo = 0.0
         for i, c in enumerate(counts):
             if i < n:
-                hi = self.HIST_BOUNDS[i]
+                hi = hb[i]
             else:
-                hi = self.HIST_BOUNDS[-1]  # +Inf bucket clamps to last bound
+                hi = hb[-1]  # +Inf bucket clamps to last bound
             if cum + c >= target and c > 0:
                 frac = (target - cum) / c
                 return lo + (hi - lo) * min(1.0, max(0.0, frac))
@@ -205,14 +225,15 @@ class MetricsRegistry:
                                 f'{k}="{esc(val)}"' for k, val in pairs
                             ) + "}"
 
+                        hb = m.get("bounds") or self.HIST_BOUNDS
                         cum = 0.0
-                        for i, bound in enumerate(self.HIST_BOUNDS):
+                        for i, bound in enumerate(hb):
                             cum += v[i]
                             lines.append(
                                 f"{name}_bucket{le_lbl(f'{bound:.9g}')} "
                                 f"{cum:.9g}"
                             )
-                        cum += v[len(self.HIST_BOUNDS)]
+                        cum += v[len(hb)]
                         lines.append(f"{name}_bucket{le_lbl('+Inf')} {cum:.9g}")
                         lines.append(f"{name}_sum{lbl} {v[-2]:.9g}")
                         lines.append(f"{name}_count{lbl} {v[-1]:.9g}")

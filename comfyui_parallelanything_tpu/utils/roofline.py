@@ -925,7 +925,10 @@ def host_step_weights(records: list[dict],
     for rec in records:
         if rec.get("stale") or rec.get("invalid") or rec.get("kind") == "error":
             continue
-        if rec.get("kind") == "loadgen" and isinstance(rec.get("hosts"), dict):
+        # loadgen AND openloop records qualify: both drive every host with
+        # the same prompt mix in the same window (the same-workload rule).
+        if (rec.get("kind") in ("loadgen", "openloop")
+                and isinstance(rec.get("hosts"), dict)):
             for hid, row in rec["hosts"].items():
                 if isinstance(row, dict):
                     feed(step_times, hid, row.get("server_step_p50_s"))

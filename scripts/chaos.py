@@ -201,13 +201,20 @@ class _Fleet:
 
 def default_plan(seed: int) -> dict:
     """The seeded chaos schedule: one 5xx on a prompt dispatch (the router
-    must walk on / retry, never count it lost) and one slow-host stall (the
-    spill/latency rehearsal). nth values derive from the seed inside the
-    registry, so two runs of one seed fire identically."""
+    must walk on / retry, never count it lost), one slow-host stall (the
+    spill/latency rehearsal), and one garbled journal record on a dispatch
+    append (round 15: crash-mid-write rehearsal — the standby's fold must
+    skip the damage and its takeover replay the prompt from its surviving
+    submit record; garble, not truncate, so neighboring records stay
+    parseable and the damage is exactly one record wide). nth values derive
+    from the seed inside the registry, so two runs of one seed fire
+    identically."""
     return {"seed": int(seed), "faults": [
         {"site": "backend-http", "match": "POST /prompt", "mode": "5xx",
          "count": 1},
         {"site": "slow-host", "mode": "stall", "delay_s": 0.5, "count": 1},
+        {"site": "journal-corrupt", "match": "dispatch", "mode": "garble",
+         "count": 1},
     ]}
 
 
@@ -251,6 +258,9 @@ def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
     os.environ["PA_FAULT_PLAN"] = json.dumps(plan or default_plan(seed))
     faults.reload()
     fired_before = _fired_total()
+    from comfyui_parallelanything_tpu.utils.faults import registry as _freg
+
+    by_site_before = dict(_freg.fired())
     chaos_dir = os.path.join(root, "chaos")
     fleet = _Fleet(os.path.join(root, "c"), n_backends, chaos_dir,
                    journal=True, lease_ttl_s=lease_ttl_s)
@@ -274,6 +284,17 @@ def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
         fleet.stop()
         os.environ.pop("PA_FAULT_PLAN", None)
     fired = _fired_total() - fired_before
+    # Per-site DELTAS over this run (not lifetime counts — another phase in
+    # the same process, e.g. the stream-OOM rehearsal, fires too), the same
+    # discipline as `fired` above. reload() swaps the registry object, so
+    # re-import the module-level name rather than holding a stale reference.
+    from comfyui_parallelanything_tpu.utils.faults import registry as _freg2
+
+    fired_by_site = {
+        site: n - by_site_before.get(site, 0)
+        for site, n in _freg2.fired().items()
+        if n - by_site_before.get(site, 0) > 0
+    }
 
     # -- gates ---------------------------------------------------------------
     failures: list[str] = []
@@ -330,6 +351,7 @@ def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
         "prompts_lost": chaos.get("prompts_lost"),
         "completed": chaos["completed"],
         "faults_fired": fired,
+        "faults_by_site": fired_by_site,
         "faults_injected_counter": chaos.get("faults_injected"),
         "baseline_p95_s": baseline["latency_p95_s"],
         "chaos_p95_s": chaos["latency_p95_s"],

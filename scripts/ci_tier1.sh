@@ -36,6 +36,14 @@ env -u PALLAS_AXON_POOL_IPS python scripts/numerics_audit.py --check || exit $?
 # failure). Runs after the perf and numerics gates: same ledger, third lens.
 env -u PALLAS_AXON_POOL_IPS python scripts/roofline_report.py --check || exit $?
 
+# Traffic-twin gate (round 15): the latest kind=openloop ledger record per
+# group must keep |twin p95 - measured p95| / measured within the record's
+# declared error band (scripts/twin_report.py replays the seeded arrival
+# trace through fleet/twin.py against roofline/measured per-host capacity —
+# an openloop-free ledger is SKIP, never a failure). Fourth ledger lens,
+# after the roofline gate whose calibration store it reads.
+env -u PALLAS_AXON_POOL_IPS python scripts/twin_report.py --check || exit $?
+
 # Sampler-coverage gate (round 10): one explicit pass over the lane-vs-solo
 # equivalence matrix + the registry coverage check, so a LaneStepSpec wired
 # into sampling/lane_specs.py but unverified (or missing from

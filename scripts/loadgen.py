@@ -1,10 +1,20 @@
-"""Closed-loop load generator for the workflow server (stdlib-only).
+"""Load generator for the workflow server (stdlib-only): closed- OR open-loop.
 
-Drives N concurrent clients against a running server: each client POSTs its
-prompt graph, blocks until the prompt completes (polling ``/history/{id}``),
-and immediately submits the next — the closed loop that makes offered load
-equal to in-flight concurrency, which is the regime continuous batching
-(serving/) is built for. Prints ONE JSON summary line: latency percentiles,
+CLOSED loop (default): N concurrent clients, each POSTing its prompt graph,
+blocking until the prompt completes (polling ``/history/{id}``), and
+immediately submitting the next — offered load equals in-flight concurrency,
+the regime continuous batching (serving/) is built for.
+
+OPEN loop (``--openloop poisson|onoff|replay``, round 15): requests fire on
+a seeded arrival schedule (fleet/twin.py's generator — the same one the
+traffic twin replays) REGARDLESS of completions — the regime where queues
+actually grow. One rung per ``--rps`` rate; the summary becomes a
+latency-under-load curve (p50/p95/p99 vs offered RPS) plus the SLO stage
+decomposition (admission / lane_wait / eval / decode scraped off
+``pa_slo_stage_seconds``, the client-side ``collect`` residual, burn-rate
+gauges, and — behind a router — ``GET /fleet/slo`` verdicts), appended to
+the ledger as ``kind="openloop"`` — the record ``scripts/twin_report.py``
+checks the twin's prediction against. Prints ONE JSON summary line: latency percentiles,
 throughput, HTTP 429 rejections, the serving dispatch/occupancy counters,
 AND server-side p50/p95 read from the ``GET /metrics`` histograms
 (``server_step_*``/``server_lane_wait_*`` — what the server measured per
@@ -54,34 +64,36 @@ import urllib.error
 import urllib.request
 
 
-def _append_ledger(summary: dict, base: str) -> None:
-    """Perf-ledger append (kind=loadgen) via bench.py's stdlib-only twin of
-    ``utils/telemetry.append_ledger_record`` — loadgen must stay jax-free by
-    contract, so it cannot import the package, but bench's module level is
-    stdlib-only (scripts/perf_ledger.py imports it the same way). One copy
-    of the dir-resolution/schema stamp, not three. Best-effort by that
-    helper's contract: a read-only checkout must not fail the load run it
-    summarizes."""
+def _append_ledger(summary: dict, base: str, kind: str = "loadgen") -> None:
+    """Perf-ledger append (kind=loadgen, or kind=openloop for open-loop
+    runs — the record the traffic twin replays) via bench.py's stdlib-only
+    twin of ``utils/telemetry.append_ledger_record`` — loadgen must stay
+    jax-free by contract, so it cannot import the package, but bench's
+    module level is stdlib-only (scripts/perf_ledger.py imports it the same
+    way). One copy of the dir-resolution/schema stamp, not three.
+    Best-effort by that helper's contract: a read-only checkout must not
+    fail the load run it summarizes."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if repo not in sys.path:
         sys.path.insert(0, repo)
     from bench import _ledger_append
 
-    _ledger_append({**summary, "base": base}, "loadgen")
+    _ledger_append({**summary, "base": base}, kind)
 
 
-def _load_retry():
-    """utils/retry.py loaded standalone by file path — its module level is
+def _load_pkg_file(relpath: str, alias: str):
+    """A package file loaded standalone by path — its module level must be
     stdlib-only and free of package-relative imports by contract (the
-    utils/roofline.py loader pattern), so loadgen's poll/reconnect loops ride
-    the SAME policy object the fleet uses, without importing the package."""
+    utils/roofline.py loader pattern), so loadgen rides the SAME code the
+    fleet/servers run, without importing the package (whose __init__ pulls
+    jax — a wedged axon tunnel hangs it)."""
     import importlib.util
 
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "comfyui_parallelanything_tpu", "utils", "retry.py",
+        "comfyui_parallelanything_tpu", *relpath.split("/"),
     )
-    spec = importlib.util.spec_from_file_location("pa_retry_loadgen", path)
+    spec = importlib.util.spec_from_file_location(alias, path)
     mod = importlib.util.module_from_spec(spec)
     # Registered BEFORE exec: dataclass processing under `from __future__
     # import annotations` resolves the module through sys.modules.
@@ -90,7 +102,14 @@ def _load_retry():
     return mod
 
 
-_retry = _load_retry()
+_retry = _load_pkg_file("utils/retry.py", "pa_retry_loadgen")
+# utils/slo.py: the objective/stage vocabulary + the Prometheus-text readers
+# (stage quantiles, threshold fractions) — the scraped twin of the server's
+# in-process SLO registry. fleet/twin.py: the seeded arrival-process
+# generator the open-loop scheduler fires and the traffic twin replays — ONE
+# generator, so "the same arrival trace" is true by construction.
+_slo = _load_pkg_file("utils/slo.py", "pa_slo_loadgen")
+_twin = _load_pkg_file("fleet/twin.py", "pa_twin_loadgen")
 # History polling: the SHARED poll shape (retry.POLL — 50 ms cadence backing
 # off toward 500 ms) — a long denoise no longer costs 20 HTTP polls per
 # second per client, the jitter de-synchronizes N clients' polls, and a
@@ -205,36 +224,16 @@ def _set_path(graph: dict, dotted: str, value):
     node[parts[-1]] = value
 
 
-def _histogram_quantile(text: str, name: str, q: float) -> float | None:
+def _histogram_quantile(text: str, name: str, q: float,
+                        labels: dict | None = None) -> float | None:
     """Quantile from a Prometheus histogram's ``_bucket`` exposition, merged
-    across label sets (every MetricsRegistry histogram shares one fixed
-    bucket ladder, so cumulative counts add per ``le``). Linear interpolation
-    within the target bucket — the same estimate the server's in-process
-    ``registry.quantile`` computes; this is the scraped twin, so a loadgen
-    run reads *server-side* p50/p95 instead of only its own client clocks."""
-    by_le: dict[str, float] = {}
-    for m in re.finditer(
-        rf'^{name}_bucket\{{[^}}]*le="([^"]+)"[^}}]*\}} ([0-9.eE+-]+)$',
-        text, re.M,
-    ):
-        by_le[m.group(1)] = by_le.get(m.group(1), 0.0) + float(m.group(2))
-    if not by_le:
-        return None
-    finite = sorted(
-        (float(le), c) for le, c in by_le.items() if le != "+Inf"
-    )
-    total = by_le.get("+Inf", finite[-1][1] if finite else 0.0)
-    if total <= 0:
-        return None
-    target = q / 100.0 * total
-    lo = 0.0
-    prev_cum = 0.0
-    for le, cum in finite:
-        if cum >= target and cum > prev_cum:
-            frac = (target - prev_cum) / (cum - prev_cum)
-            return lo + (le - lo) * min(1.0, max(0.0, frac))
-        lo, prev_cum = le, cum
-    return lo  # +Inf bucket: clamp to the last finite bound
+    across (optionally label-filtered) label sets — linear interpolation
+    within the target bucket, the same estimate the server's in-process
+    ``registry.quantile`` computes. The implementation is utils/slo.py's
+    reader (ONE parser for loadgen, the router's /fleet/slo, and
+    twin_report); the wrapper keeps the name tests pin against the
+    registry."""
+    return _slo.histogram_quantile(text, name, q, labels=labels)
 
 
 def _serving_counters(base: str) -> dict:
@@ -316,6 +315,9 @@ def _host_probe(hosts: list[str]) -> dict:
             probe["host_id"] = health.get("host_id")
             probe["accepting"] = health.get("accepting")
             probe["inflight_prompts"] = health.get("inflight_prompts")
+            # Worker-pool width: the twin's per-host concurrency
+            # (fleet/twin.py simulates `workers` servers per host).
+            probe["workers"] = (health.get("queue") or {}).get("workers")
         except (urllib.error.URLError, OSError, ValueError):
             probe["host_id"] = None
         probe["counters"] = _serving_counters(h)
@@ -580,6 +582,407 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
     }
 
 
+def _scrape_slo(base, e2e_p50=None, e2e_p95=None) -> dict | None:
+    """The SLO view of a run, scraped off ``GET /metrics``: per-stage
+    latency decomposition quantiles (``pa_slo_stage_seconds``), server-side
+    request residency, windowed burn-rate gauges, and — fleet mode — the
+    router's merged ``GET /fleet/slo`` verdicts. The CLIENT-side residual,
+    ``collect`` (history polling + HTTP + everything the server cannot
+    see), is e2e minus server residency at matching quantiles — the fifth
+    stage of the decomposition, computable only here.
+
+    The scrape prefers ``GET /fleet/metrics`` (a router's merged
+    host-labeled view — the backends' ``pa_slo_*`` series live THERE in a
+    real multi-process fleet; the router's own registry never carries
+    them) and falls back to ``GET /metrics`` on a plain server (404)."""
+    text = None
+    try:
+        text = _get(base, "/fleet/metrics")
+    except (urllib.error.URLError, OSError, ValueError):
+        pass  # not a router (404) or unreachable — try the plain endpoint
+    if not isinstance(text, str) or "# TYPE" not in text:
+        try:
+            text = _get(base, "/metrics")
+        except (urllib.error.URLError, OSError):
+            return None
+    stages: dict[str, dict] = {}
+    for stage in ("admission", "lane_wait", "eval", "decode"):
+        p50 = _histogram_quantile(text, "pa_slo_stage_seconds", 50,
+                                  labels={"stage": stage})
+        if p50 is None:
+            continue
+        p95 = _histogram_quantile(text, "pa_slo_stage_seconds", 95,
+                                  labels={"stage": stage})
+        stages[stage] = {"p50_s": round(p50, 6),
+                         "p95_s": round(p95, 6) if p95 is not None else None}
+    req50 = _histogram_quantile(text, "pa_slo_request_seconds", 50)
+    req95 = _histogram_quantile(text, "pa_slo_request_seconds", 95)
+    burn: dict[str, float] = {}
+    for m in re.finditer(
+        r'^pa_slo_burn_rate\{[^}]*objective="([^"]+)"[^}]*\} '
+        r"([0-9.eE+-]+)$",
+        text, re.M,
+    ):
+        # Merged fleet views carry one host-labeled gauge per backend: the
+        # fleet's burn rate for an objective is its WORST host's.
+        burn[m.group(1)] = max(burn.get(m.group(1), 0.0),
+                               float(m.group(2)))
+    out: dict = {
+        "stages": stages or None,
+        "request_p50_s": round(req50, 6) if req50 is not None else None,
+        "request_p95_s": round(req95, 6) if req95 is not None else None,
+        "burn_rates": burn or None,
+    }
+    if e2e_p50 is not None and req50 is not None:
+        out["collect_p50_s"] = round(max(0.0, e2e_p50 - req50), 6)
+    if e2e_p95 is not None and req95 is not None:
+        out["collect_p95_s"] = round(max(0.0, e2e_p95 - req95), 6)
+    try:
+        fleet_slo = _get(base, "/fleet/slo", timeout=10)
+        if isinstance(fleet_slo, dict) and fleet_slo.get("objectives"):
+            out["objectives"] = fleet_slo["objectives"]
+    except (urllib.error.URLError, OSError, ValueError):
+        pass  # not a router (plain server 404s) — gauges carry the verdict
+    if not stages and req50 is None and not burn and "objectives" not in out:
+        return None  # PA_SLO=0 everywhere: no SLO section, not zeros
+    return out
+
+
+def run_open_load(base: str, graph: dict, *, kind: str = "poisson",
+                  rps_list=(4.0,), duration_s: float = 3.0,
+                  timeout: float = 300.0, seed: int | None = 0,
+                  seed_key: str | None = None,
+                  extra_data: dict | None = None,
+                  samplers: list[str] | None = None,
+                  sampler_key: str | None = None,
+                  hosts: list[str] | None = None,
+                  fallback_bases: list[str] | None = None,
+                  on_s: float = 1.0, off_s: float = 1.0,
+                  arrivals_doc: dict | None = None,
+                  arrivals_out: str | None = None,
+                  twin_band: float = 0.5) -> dict:
+    """OPEN-loop load: requests fire on a seeded arrival schedule
+    (fleet/twin.py's generator — Poisson, bursty ON-OFF, or trace replay)
+    regardless of completions, which is the regime where queues actually
+    grow (the closed loop's offered load can never exceed its concurrency).
+    One rung per offered rate in ``rps_list``; the summary's
+    ``openloop.curve`` is latency-under-load (p50/p95/p99 vs offered RPS)
+    and its ``slo`` section the stage decomposition + burn rates — together
+    the ``kind="openloop"`` ledger record the traffic twin replays
+    (``scripts/twin_report.py``)."""
+    if fallback_bases:
+        base = _Front([base, *fallback_bases])
+    sched_rng = random.Random(seed if seed is not None else 0)
+    before = _serving_counters(base)
+    hosts_before = _host_probe(hosts) if hosts else None
+    if arrivals_doc is not None:
+        kind = str(arrivals_doc.get("kind") or "replay")
+        rungs_in = [
+            {"rps": r.get("rps"), "duration_s": float(r.get("duration_s") or 0.0),
+             "offsets": [float(t) for t in r.get("offsets") or []],
+             "replay": True}
+            for r in arrivals_doc.get("rungs") or []
+        ]
+    else:
+        rungs_in = [
+            {"rps": float(r), "duration_s": float(duration_s),
+             "offsets": _twin.gen_arrivals(
+                 kind, rps=float(r), duration_s=float(duration_s),
+                 seed=int(seed or 0), on_s=on_s, off_s=off_s,
+             ),
+             "replay": False}
+            for r in rps_list
+        ]
+    all_lat: list[float] = []
+    lat_by_host: dict = {}
+    exec_by_host: dict = {}
+    failures: list[str] = []
+    rejected = [0]
+    timeouts = [0]
+    counter = [0]
+    lock = threading.Lock()
+    curve: list[dict] = []
+    t_start = time.time()
+    for rung in rungs_in:
+        offsets = rung["offsets"]
+        rung_lat: list[float] = []
+        rung_exec: list[float] = []
+        rt0 = time.time()
+
+        def fire(_rung_lat=rung_lat, _rung_exec=rung_exec):
+            # Open-loop discipline: fired at the scheduled instant (the
+            # scheduler thread below owns the clock), never "when the
+            # previous one finished" — and never retry a refusal (a
+            # dropped arrival is data, not an error to paper over).
+            g = json.loads(json.dumps(graph))
+            with lock:
+                counter[0] += 1
+                n = counter[0]
+                val = sched_rng.randrange(1 << 31)
+            if seed_key:
+                _set_path(g, seed_key, val if seed is not None else n)
+            if samplers and sampler_key:
+                _set_path(g, sampler_key, samplers[n % len(samplers)])
+            payload = {"prompt": g}
+            if extra_data:
+                payload["extra_data"] = extra_data
+            t0 = time.time()
+            try:
+                pid = _post(base, "/prompt", payload)["prompt_id"]
+            except urllib.error.HTTPError as e:
+                with lock:
+                    if e.code == 429:
+                        rejected[0] += 1
+                    else:
+                        failures.append(f"openloop: HTTP {e.code}")
+                return
+            except OSError as e:
+                with lock:
+                    failures.append(f"openloop: unreachable ({e})")
+                return
+            try:
+                entry = _wait_done(base, pid, timeout)
+            except TimeoutError:
+                with lock:
+                    timeouts[0] += 1
+                    failures.append(f"openloop: timeout ({pid})")
+                return
+            dt = time.time() - t0
+            status = entry.get("status") or {}
+            served_by = (status.get("fleet") or {}).get("host_id") \
+                or status.get("host_id")
+            with lock:
+                if status.get("status_str") == "success":
+                    _rung_lat.append(dt)
+                    all_lat.append(dt)
+                    ex = status.get("exec_s")
+                    if isinstance(ex, (int, float)):
+                        _rung_exec.append(float(ex))
+                    if served_by:
+                        lat_by_host.setdefault(served_by, []).append(dt)
+                        if isinstance(ex, (int, float)):
+                            exec_by_host.setdefault(
+                                served_by, []
+                            ).append(float(ex))
+                else:
+                    failures.append(
+                        f"openloop: {status.get('status_str')}"
+                    )
+
+        # One scheduler thread owns the arrival clock and spawns a request
+        # thread only AT each arrival's fire time — live threads stay
+        # bounded by in-flight requests, not by the rung's total (a 60 s
+        # 100-rps rung must not park 6000 stacks up front and let their
+        # creation storm distort the very arrival fidelity being measured).
+        threads: list[threading.Thread] = []
+        for off in offsets:
+            delay = rt0 + off - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=fire, daemon=True)
+            threads.append(th)
+            th.start()
+        for th in threads:
+            th.join(timeout + rung["duration_s"] + 60)
+        wall = time.time() - rt0
+        dur = rung["duration_s"] or (max(offsets) if offsets else 0.0) or 1.0
+        entry: dict = {
+            "rps": rung["rps"],
+            "rps_offered": round(len(offsets) / dur, 4),
+            "duration_s": rung["duration_s"],
+            "arrivals": len(offsets),
+            "completed": len(rung_lat),
+            "achieved_rps": round(len(rung_lat) / wall, 4) if wall > 0 else None,
+            "latency_p50_s": round(percentile(rung_lat, 50), 6),
+            "latency_p95_s": round(percentile(rung_lat, 95), 6),
+            "latency_p99_s": round(percentile(rung_lat, 99), 6),
+            # This rung's OWN service p50 — the overhead calibration below
+            # must not subtract a contention-inflated pooled value.
+            "service_p50_s": (
+                round(percentile(rung_exec, 50), 6) if rung_exec else None
+            ),
+        }
+        if kind == "onoff":
+            entry["on_s"], entry["off_s"] = on_s, off_s
+        if rung["replay"]:
+            # Replay rungs carry their offsets verbatim — the twin cannot
+            # regenerate a recorded trace from (kind, seed).
+            entry["offsets"] = offsets
+        curve.append(entry)
+    wall = time.time() - t_start
+    after = _serving_counters(base)
+    if arrivals_out:
+        _twin.save_arrivals(
+            arrivals_out,
+            [{"rps": r["rps"], "duration_s": r["duration_s"],
+              "offsets": r["offsets"]} for r in rungs_in],
+            kind=kind, seed=seed,
+        )
+    e2e_p50 = percentile(all_lat, 50) if all_lat else None
+    e2e_p95 = percentile(all_lat, 95) if all_lat else None
+    slo_view = _scrape_slo(base, e2e_p50=e2e_p50, e2e_p95=e2e_p95)
+    all_exec = [v for vs in exec_by_host.values() for v in vs]
+    # Per-host sections: fleet mode diffs the backend probes (run_load's
+    # shape) + the twin's capacity fields; single-server mode synthesizes
+    # one row per serving host_id from the entries alone.
+    per_host: dict | None = None
+    fleet = None
+    prompts_lost = None
+    if hosts:
+        hosts_after = _host_probe(hosts)
+        # Entries are attributed by the ROUTER's host id
+        # (status.fleet.host_id), which for bare-URL --backends seeds is
+        # URL-derived and differs from the backend's self-declared
+        # /health host_id — join the two through the router's ring
+        # snapshot so per-host service evidence lands either way.
+        ring_map: dict[str, str] = {}
+        try:
+            doc = _get(base, "/fleet/hosts", timeout=10)
+            for row in doc.get("ring") or []:
+                if row.get("base") and row.get("host_id"):
+                    ring_map[str(row["base"]).rstrip("/")] = \
+                        str(row["host_id"])
+        except (urllib.error.URLError, OSError, ValueError):
+            pass
+        per_host = {}
+        for h in hosts:
+            h = h.rstrip("/")
+            b, a = hosts_before.get(h, {}), hosts_after.get(h, {})
+            phid = a.get("host_id") or b.get("host_id")
+            hid = ring_map.get(h) or phid or h
+            cb, ca = b.get("counters") or {}, a.get("counters") or {}
+            lats = lat_by_host.get(hid) \
+                or (lat_by_host.get(phid, []) if phid else [])
+            execs = exec_by_host.get(hid) \
+                or (exec_by_host.get(phid, []) if phid else [])
+            per_host[hid] = {
+                "base": h,
+                "completed": len(lats),
+                "latency_p50_s": round(percentile(lats, 50), 3),
+                "latency_p95_s": round(percentile(lats, 95), 3),
+                "dispatches": (
+                    ca.get("pa_serving_dispatch_total", 0.0)
+                    - cb.get("pa_serving_dispatch_total", 0.0)
+                ) if ca else None,
+                "server_step_p50_s": ca.get("step_p50_s"),
+                "server_step_p95_s": ca.get("step_p95_s"),
+                # The twin's capacity inputs: per-request service p50
+                # (exec_s off the history entries — same workload on every
+                # host by construction) and the worker-pool width.
+                "service_p50_s": (
+                    round(percentile(execs, 50), 6) if execs else None
+                ),
+                "workers": a.get("workers") or b.get("workers"),
+                "accepting": a.get("accepting"),
+                "reachable": a.get("host_id") is not None,
+            }
+
+        def _delta(name):
+            return (after.get(name, 0.0) - before.get(name, 0.0)
+                    if name in after or name in before else None)
+
+        fleet = {
+            "dispatches": _delta("pa_fleet_dispatch_total"),
+            "spills": _delta("pa_fleet_spill_total"),
+            "failovers": _delta("pa_fleet_failover_total"),
+            "completed": _delta("pa_fleet_completed_total"),
+        }
+        lost_router = _delta("pa_fleet_prompts_lost_total")
+        prompts_lost = (lost_router or 0.0) + timeouts[0]
+    elif exec_by_host:
+        workers = None
+        try:
+            health = _get(base, "/health", timeout=10)
+            workers = (health.get("queue") or {}).get("workers")
+        except (urllib.error.URLError, OSError, ValueError):
+            pass
+        per_host = {
+            hid: {
+                "completed": len(lat_by_host.get(hid, [])),
+                "latency_p50_s": round(
+                    percentile(lat_by_host.get(hid, []), 50), 3
+                ),
+                "latency_p95_s": round(
+                    percentile(lat_by_host.get(hid, []), 95), 3
+                ),
+                "service_p50_s": round(percentile(execs, 50), 6),
+                "workers": workers,
+            }
+            for hid, execs in exec_by_host.items()
+        }
+    if prompts_lost is None and timeouts[0]:
+        # Unconditional (not nested under any per-host branch): a run whose
+        # EVERY request timed out has no exec evidence but its losses are
+        # the most real of all — the closed-loop run_load discipline.
+        prompts_lost = float(timeouts[0])
+    total_arrivals = sum(len(r["offsets"]) for r in rungs_in)
+    dispatches = (
+        after.get("pa_serving_dispatch_total", 0.0)
+        - before.get("pa_serving_dispatch_total", 0.0)
+    ) if after else None
+    lane_steps = (
+        after.get("pa_serving_lane_steps_total", 0.0)
+        - before.get("pa_serving_lane_steps_total", 0.0)
+    ) if after else None
+    # The twin's client-side constant: at the LOWEST offered rate queueing
+    # is ~zero, so (client p50 − service p50) is pure transport + history
+    # poll cadence — the per-request overhead the twin adds on top of its
+    # queue + service model (fleet/twin.py simulate(overhead_s=...)). BOTH
+    # sides of the subtraction come from the lightest rung: a pooled
+    # service p50 folds in contention-inflated exec times from saturated
+    # rungs and would clamp the constant toward zero.
+    overall_service = (
+        round(percentile(all_exec, 50), 6) if all_exec else None
+    )
+    client_overhead = None
+    calibration_rungs = [c for c in curve if c["completed"] > 0]
+    if calibration_rungs:
+        lightest = min(calibration_rungs,
+                       key=lambda c: c["rps_offered"] or 0.0)
+        light_service = lightest.get("service_p50_s") or overall_service
+        if light_service is not None:
+            client_overhead = round(
+                max(0.0, lightest["latency_p50_s"] - light_service), 6
+            )
+    return {
+        "mode": "openloop",
+        "openloop": {
+            "kind": kind,
+            "seed": seed,
+            "curve": curve,
+            "client_overhead_s": client_overhead,
+            "twin_band": twin_band,
+        },
+        "twin_band": twin_band,
+        "requests": total_arrivals,
+        "seed": seed,
+        "samplers": samplers or None,
+        "completed": len(all_lat),
+        "failed": len(failures),
+        "rejected_429": rejected[0],
+        "timeouts": timeouts[0],
+        "wall_s": round(wall, 3),
+        "throughput_rps": round(len(all_lat) / wall, 3) if wall > 0 else None,
+        "latency_p50_s": round(percentile(all_lat, 50), 3),
+        "latency_p95_s": round(percentile(all_lat, 95), 3),
+        "latency_p99_s": round(percentile(all_lat, 99), 3),
+        "latency_max_s": round(max(all_lat), 3) if all_lat else 0.0,
+        "serving_dispatches": dispatches,
+        "serving_lane_steps": lane_steps,
+        "dispatch_amortization": (
+            round(lane_steps / dispatches, 3)
+            if lane_steps and dispatches else None
+        ),
+        "serving_batched_fraction": after.get("pa_serving_batched_fraction"),
+        "service_p50_s": overall_service,
+        "slo": slo_view,
+        "hosts": per_host,
+        "fleet": fleet,
+        "prompts_lost": prompts_lost,
+        "errors": failures[:5],
+    }
+
+
 def print_human_summary(summary: dict, stream=None) -> None:
     """The operator-facing table — stderr by contract, so stdout stays ONE
     JSON line (the same ledger-appendable discipline as bench.py)."""
@@ -594,6 +997,22 @@ def print_human_summary(summary: dict, stream=None) -> None:
     w(f"  latency   p50 {summary['latency_p50_s']}s"
       f"  p95 {summary['latency_p95_s']}s"
       f"  max {summary['latency_max_s']}s\n")
+    for rung in (summary.get("openloop") or {}).get("curve") or []:
+        w(f"  openloop  {rung.get('rps_offered')} rps offered"
+          f" ({rung.get('completed')}/{rung.get('arrivals')} ok)"
+          f"  p50 {rung.get('latency_p50_s')}s"
+          f"  p95 {rung.get('latency_p95_s')}s"
+          f"  p99 {rung.get('latency_p99_s')}s\n")
+    slo_view = summary.get("slo") or {}
+    for stage, q in (slo_view.get("stages") or {}).items():
+        w(f"  slo-stage {stage:<10} p50 {q.get('p50_s')}s"
+          f"  p95 {q.get('p95_s')}s\n")
+    if slo_view.get("collect_p50_s") is not None:
+        w(f"  slo-stage collect    p50 {slo_view['collect_p50_s']}s"
+          f"  p95 {slo_view.get('collect_p95_s')}s  (client residual)\n")
+    for name, burn in (slo_view.get("burn_rates") or {}).items():
+        w(f"  slo-burn  {name}: {burn}"
+          f"{'  [BURNING]' if burn > 1.0 else ''}\n")
     if summary.get("dispatch_amortization") is not None:
         w(f"  serving   {summary['serving_dispatches']:.0f} dispatches,"
           f" {summary['serving_lane_steps']:.0f} lane-steps"
@@ -613,10 +1032,12 @@ def print_human_summary(summary: dict, stream=None) -> None:
           f"  host-gap {summary.get('roofline_host_gap_fraction')}"
           f"  (fraction of traced wall)\n")
     for hid, h in (summary.get("hosts") or {}).items():
+        # Single-server open-loop rows carry no probe fields (dispatches /
+        # reachability are fleet-mode diffs) — render what exists.
         w(f"  host {hid:<20} {h['completed']:>3} ok"
           f"  p50 {h['latency_p50_s']}s  p95 {h['latency_p95_s']}s"
-          f"  dispatches {h['dispatches']}"
-          f"{'' if h.get('reachable') else '  [UNREACHABLE]'}\n")
+          f"  dispatches {h.get('dispatches')}"
+          f"{'  [UNREACHABLE]' if h.get('reachable') is False else ''}\n")
     for err in summary.get("errors") or []:
         w(f"  error     {err}\n")
     w("─────────────────────────────────────────────────\n")
@@ -655,6 +1076,34 @@ def main() -> None:
                     help="comma list of standby router base URLs (router "
                          "HA): clients fail over to them when --base stops "
                          "answering or replies standby-503")
+    ap.add_argument("--openloop", default=None,
+                    choices=["poisson", "onoff", "replay"],
+                    help="OPEN-loop mode: requests fire on a seeded arrival "
+                         "schedule regardless of completions — the regime "
+                         "where queues grow. poisson/onoff generate from "
+                         "--rps/--duration/--seed; replay needs "
+                         "--arrivals-in (a saved schedule or a fleet "
+                         "journal). Summary becomes a latency-under-load "
+                         "curve + SLO decomposition; ledger kind=openloop")
+    ap.add_argument("--rps", default="4",
+                    help="comma list of offered request rates — one "
+                         "open-loop rung (curve point) per rate")
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="seconds of arrivals per open-loop rung")
+    ap.add_argument("--on-s", type=float, default=1.0,
+                    help="onoff arrivals: busy-window seconds")
+    ap.add_argument("--off-s", type=float, default=1.0,
+                    help="onoff arrivals: silent-window seconds")
+    ap.add_argument("--arrivals-out", default=None,
+                    help="persist the generated arrival schedule "
+                         "(pa-arrivals/v1 JSON) for replay / the twin")
+    ap.add_argument("--arrivals-in", default=None,
+                    help="replay arrivals from a pa-arrivals/v1 document "
+                         "or a recorded fleet journal (submit timestamps)")
+    ap.add_argument("--twin-band", type=float, default=0.5,
+                    help="declared twin error band: scripts/twin_report.py "
+                         "--check fails when |twin p95 - measured p95| / "
+                         "measured exceeds this fraction")
     args = ap.parse_args()
     samplers = [s for s in (args.samplers or "").split(",") if s]
     if samplers and not args.sampler_key:
@@ -667,16 +1116,35 @@ def main() -> None:
         extra["priority"] = args.priority
     if args.deadline_s is not None:
         extra["deadline_s"] = args.deadline_s
-    summary = run_load(
-        args.base, graph, clients=args.clients, requests=args.requests,
-        timeout=args.timeout, seed_key=args.seed_key,
-        extra_data=extra or None,
-        samplers=samplers or None, sampler_key=args.sampler_key,
-        seed=args.seed, hosts=hosts or None,
-        fallback_bases=[b for b in (args.fallback_bases or "").split(",")
-                        if b] or None,
-    )
-    _append_ledger(summary, args.base)
+    fallback = [b for b in (args.fallback_bases or "").split(",") if b]
+    if args.openloop:
+        if args.openloop == "replay" and not args.arrivals_in:
+            ap.error("--openloop replay requires --arrivals-in")
+        arrivals_doc = (_twin.load_arrivals(args.arrivals_in)
+                        if args.arrivals_in else None)
+        summary = run_open_load(
+            args.base, graph, kind=args.openloop,
+            rps_list=[float(r) for r in args.rps.split(",") if r],
+            duration_s=args.duration, timeout=args.timeout,
+            seed=args.seed if args.seed is not None else 0,
+            seed_key=args.seed_key, extra_data=extra or None,
+            samplers=samplers or None, sampler_key=args.sampler_key,
+            hosts=hosts or None, fallback_bases=fallback or None,
+            on_s=args.on_s, off_s=args.off_s,
+            arrivals_doc=arrivals_doc, arrivals_out=args.arrivals_out,
+            twin_band=args.twin_band,
+        )
+        _append_ledger(summary, args.base, kind="openloop")
+    else:
+        summary = run_load(
+            args.base, graph, clients=args.clients, requests=args.requests,
+            timeout=args.timeout, seed_key=args.seed_key,
+            extra_data=extra or None,
+            samplers=samplers or None, sampler_key=args.sampler_key,
+            seed=args.seed, hosts=hosts or None,
+            fallback_bases=fallback or None,
+        )
+        _append_ledger(summary, args.base)
     print_human_summary(summary)          # operator table → stderr
     print(json.dumps(summary))            # THE one JSON line → stdout
 

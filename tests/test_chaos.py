@@ -12,6 +12,7 @@ the moment it breaks."""
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 
@@ -45,7 +46,11 @@ class TestChaosSmoke:
         assert verdict["ok"], verdict["failures"]
         assert verdict["prompts_lost"] == 0
         assert verdict["completed"] == verdict["total_prompts"]
-        assert verdict["faults_fired"] >= 2  # 5xx + slow-host both fired
+        assert verdict["faults_fired"] >= 3  # 5xx + slow-host + journal
+        # Round 15: the journal-corruption fault is part of the default
+        # matrix — a garbled dispatch record mid-run, with the takeover
+        # still losing zero prompts (asserted above via prompts_lost).
+        assert verdict["faults_by_site"].get("journal-corrupt", 0) >= 1
         assert verdict["chaos_p95_s"] <= verdict["p95_bound_s"]
 
     def test_stream_oom_phase_recarve_absorbs(self):
@@ -55,6 +60,135 @@ class TestChaosSmoke:
         assert verdict["ok"], verdict["failures"]
         assert verdict["stages_after"] > verdict["stages_before"]
         assert verdict["recarve_rungs"] >= 1
+
+    def test_journal_corruption_modes(self, tmp_path, monkeypatch):
+        """The fault's disk shapes (unit view): truncate tears the tail
+        (that record lost; the NEXT append concatenates into one more
+        unparseable line — the real crash+restart disk state); garble
+        damages exactly one record, neighbors intact. Replay/fold skips
+        the damage either way."""
+        from comfyui_parallelanything_tpu.fleet import PromptJournal
+        from comfyui_parallelanything_tpu.utils import faults
+
+        # truncate: the torn dispatch eats itself AND the next line
+        monkeypatch.setenv("PA_FAULT_PLAN", json.dumps({"seed": 0, "faults": [
+            {"site": "journal-corrupt", "match": "dispatch", "nth": 1,
+             "count": 1, "mode": "truncate"},
+        ]}))
+        faults.reload()
+        j = PromptJournal(str(tmp_path / "torn.jsonl"))
+        j.append("submit", "p1", graph={"1": {}}, key="k", number=1)
+        j.append("dispatch", "p1", host="h0", backend_pid="b1", attempt=1)
+        j.append("resolve", "p1", status="done", entry={"status": {}})
+        j.close()
+        assert faults.fired().get("journal-corrupt") == 1
+        table = j.replay()
+        # the resolve concatenated onto the torn dispatch: both lost —
+        # p1 folds back to submit phase, which a takeover REPLAYS (the
+        # zero-lost property: corruption degrades to replay, never loss)
+        assert table["p1"]["phase"] == "submit"
+        assert table["p1"]["graph"] == {"1": {}}
+
+        # garble: one record wide, neighbors parse
+        monkeypatch.setenv("PA_FAULT_PLAN", json.dumps({"seed": 0, "faults": [
+            {"site": "journal-corrupt", "match": "dispatch", "nth": 1,
+             "count": 1, "mode": "garble"},
+        ]}))
+        faults.reload()
+        j2 = PromptJournal(str(tmp_path / "garbled.jsonl"))
+        j2.append("submit", "p1", graph={"1": {}}, key="k", number=1)
+        j2.append("dispatch", "p1", host="h0", backend_pid="b1", attempt=1)
+        j2.append("submit", "p2", graph={"2": {}}, key="k2", number=2)
+        j2.close()
+        table = j2.replay()
+        assert table["p1"]["phase"] == "submit"   # dispatch record garbled
+        assert table["p2"]["phase"] == "submit"   # neighbor intact
+        assert table["p2"]["graph"] == {"2": {}}
+
+    def test_journal_corruption_mid_takeover_zero_lost(self, tmp_path,
+                                                       monkeypatch):
+        """The chaos-matrix satellite, isolated: a dispatch record is
+        garbled in the primary's journal, the primary dies mid-denoise,
+        and the standby's torn-tail fold still takes over with ZERO lost
+        prompts — the corrupted prompt replays from its surviving submit
+        record."""
+        import threading
+
+        from tests.test_fleet import (
+            _Backend,
+            _graph,
+            _post,
+            _wait,
+            _wait_entry,
+        )
+
+        from comfyui_parallelanything_tpu.fleet import (
+            FleetRegistry,
+            PromptJournal,
+            Scoreboard,
+            make_router,
+        )
+        from comfyui_parallelanything_tpu.utils import faults
+
+        monkeypatch.setenv("PA_FAULT_PLAN", json.dumps({"seed": 0, "faults": [
+            {"site": "journal-corrupt", "match": "dispatch", "nth": 2,
+             "count": 1, "mode": "garble"},
+        ]}))
+        faults.reload()
+        backends = [_Backend(tmp_path, f"jc-host-{i}") for i in range(2)]
+        jpath = str(tmp_path / "journal.jsonl")
+        mk = dict(
+            backends=[(b.host_id, b.base) for b in backends],
+            saturation_depth=2, monitor_s=0.05,
+        )
+        srv1, primary = make_router(
+            port=0, fleet_registry=FleetRegistry(ttl_s=3.0),
+            scoreboard=Scoreboard(poll_s=0.1, stale_after_s=5.0,
+                                  fail_after=2, timeout_s=2.0),
+            journal=PromptJournal(jpath), lease_ttl_s=0.5, **mk,
+        )
+        threading.Thread(target=srv1.serve_forever, daemon=True).start()
+        base1 = f"http://127.0.0.1:{srv1.server_address[1]}"
+        srv2, standby = make_router(
+            port=0, fleet_registry=FleetRegistry(ttl_s=3.0),
+            scoreboard=Scoreboard(poll_s=0.1, stale_after_s=5.0,
+                                  fail_after=2, timeout_s=2.0),
+            journal=PromptJournal(jpath), standby=True, lease_ttl_s=0.5,
+            **mk,
+        )
+        threading.Thread(target=srv2.serve_forever, daemon=True).start()
+        base2 = f"http://127.0.0.1:{srv2.server_address[1]}"
+        try:
+            _wait(lambda: all(primary.scoreboard.healthy(b.host_id)
+                              for b in backends),
+                  what="backends healthy on the primary")
+            # Two mid-denoise prompts; the SECOND's dispatch record is
+            # garbled (nth=2) — after takeover it must replay from its
+            # submit record.
+            pids = [
+                _post(base1, "/prompt",
+                      {"prompt": _graph(80 + i, work_s=2.0)})["prompt_id"]
+                for i in range(2)
+            ]
+            _wait(lambda: faults.fired().get("journal-corrupt", 0) >= 1,
+                  what="journal-corrupt fault fired")
+            _wait(lambda: sum(len(b.q.running) for b in backends) >= 1,
+                  what="work running mid-denoise")
+            srv1.shutdown()
+            srv1.server_close()
+            primary.shutdown()
+            _wait(lambda: standby.active, timeout=15,
+                  what="standby takeover over the corrupted journal")
+            for pid in pids:
+                entry = _wait_entry(base2, pid, timeout=60)
+                assert entry["status"]["status_str"] == "success", entry
+            assert standby.stats()["lost"] == 0
+        finally:
+            srv2.shutdown()
+            srv2.server_close()
+            standby.shutdown()
+            for b in backends:
+                b.stop()
 
     def test_seeded_plan_fires_identically(self):
         """Fault-plan determinism at the chaos-runner level: the default

@@ -216,6 +216,39 @@ class TestHistogram:
         # raw newline/quote must not survive into the text unescaped
         assert 'mo\\"del\\nx' in text
 
+    def test_explicit_bounds_first_touch_wins(self):
+        """Round 15: a histogram may declare its bucket ladder at first
+        touch (the SLO plane aligns edges to declared thresholds so a
+        verdict is a bucket read); later bounds are ignored (one ladder per
+        metric — exposition stays mergeable) and the default ladder is
+        untouched for everyone else."""
+        r = MetricsRegistry()
+        r.histogram("pa_b_seconds", 0.2, bounds=(0.1, 0.25, 30.0, 60.0))
+        r.histogram("pa_b_seconds", 31.0, bounds=(1.0, 2.0))  # ignored
+        r.histogram("pa_b_seconds", 0.05, labels={"stage": "x"})
+        text = r.render()
+        # the declared ladder renders (threshold 30 an exact edge), for
+        # EVERY label set of the metric
+        for le in ("0.1", "0.25", "30", "60", "+Inf"):
+            le_re = re.escape(le)
+            assert re.search(
+                rf'^pa_b_seconds_bucket\{{le="{le_re}"\}} ', text, re.M), le
+            assert re.search(
+                rf'^pa_b_seconds_bucket\{{stage="x",le="{le_re}"\}} ',
+                text, re.M), le
+        assert 'le="1"' not in text and 'le="2.5"' not in text
+        # cumulative reads: 0.05 and 0.2 under 0.25; 31 lands in the 60
+        # bucket (not +Inf)
+        m = re.search(r'^pa_b_seconds_bucket\{le="0.25"\} (\S+)$', text, re.M)
+        assert float(m.group(1)) == 1.0  # unlabeled set: only the 0.2
+        # quantile rides the declared ladder
+        assert 0.1 < r.quantile("pa_b_seconds", 40) <= 0.25
+        assert r.quantile("pa_b_seconds", 99) <= 60.0
+        # an untouched metric keeps the default ladder
+        r.histogram("pa_default_seconds", 0.004)
+        assert re.search(r'^pa_default_seconds_bucket\{le="0.001"\} ',
+                         r.render(), re.M)
+
     def test_get_and_quantile(self):
         r = MetricsRegistry()
         for _ in range(99):

@@ -1,0 +1,299 @@
+"""SLO plane (utils/slo.py, round 15): declared objectives, window/burn-rate
+accounting, the pa_slo_* stage decomposition fed from the server/serving/host
+measurement points, the Prometheus-text readers the router and loadgen share,
+and the PA_SLO=0 no-op contract (the tracer/sentinel/roofline discipline)."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from comfyui_parallelanything_tpu.utils import slo
+from comfyui_parallelanything_tpu.utils.metrics import MetricsRegistry, registry
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Process-global state: every test starts with a fresh metrics registry,
+    the default objectives, and PA_SLO unset (enabled)."""
+    monkeypatch.delenv("PA_SLO", raising=False)
+    monkeypatch.delenv("PA_SLO_OBJECTIVES", raising=False)
+    registry.reset()
+    slo.registry.reset()
+    yield
+    registry.reset()
+    slo.registry.reset()
+
+
+class TestObjectives:
+    def test_defaults_and_env_parse(self, monkeypatch):
+        assert [o.name for o in slo.objectives_from_env()] == \
+            [o.name for o in slo.DEFAULT_OBJECTIVES]
+        monkeypatch.setenv("PA_SLO_OBJECTIVES", json.dumps([
+            {"name": "fast", "threshold_s": 0.5, "target": 0.9,
+             "window_s": 60},
+            {"name": "slow", "threshold_s": 5.0},
+        ]))
+        objs = slo.objectives_from_env()
+        assert [o.name for o in objs] == ["fast", "slow"]
+        assert objs[0].threshold_s == 0.5 and objs[0].target == 0.9
+        assert objs[0].window_s == 60
+        assert objs[1].target == 0.95  # default
+
+    def test_malformed_objectives_fail_loudly(self):
+        with pytest.raises(ValueError):
+            slo.parse_objectives("not json{")
+        with pytest.raises(ValueError):
+            slo.parse_objectives(json.dumps({"name": "x"}))  # not a list
+        with pytest.raises(ValueError):
+            slo.parse_objectives(json.dumps([{"name": "x"}]))  # no threshold
+
+    def test_request_bounds_align_thresholds(self):
+        objs = [slo.Objective(name="a", threshold_s=0.123),
+                slo.Objective(name="b", threshold_s=2.5)]
+        bounds = slo.request_bounds(objs)
+        assert 0.123 in bounds and 2.5 in bounds
+        assert list(bounds) == sorted(bounds)
+        # the default ladder survives intact
+        assert set(slo.STAGE_BOUNDS) <= set(bounds)
+
+
+class TestWindowAccounting:
+    def test_burn_rate_math(self):
+        reg = slo.SloRegistry(objectives=[
+            slo.Objective(name="t", threshold_s=0.1, target=0.9,
+                          window_s=3600),
+        ])
+        for _ in range(9):
+            reg.observe_request(0.05)   # good
+        reg.observe_request(1.0)        # bad
+        [v] = reg.verdicts()
+        assert v["requests"] == 10 and v["bad"] == 1
+        assert v["bad_fraction"] == pytest.approx(0.1)
+        # budget = 1 - 0.9 = 0.1; bad fraction 0.1 → burning exactly at
+        # the allowed rate: burn 1.0, budget exhausted, still (just) ok.
+        assert v["burn_rate"] == pytest.approx(1.0)
+        assert v["budget_remaining"] == pytest.approx(0.0)
+        assert v["ok"] is True
+        reg.observe_request(2.0)        # now over budget
+        [v] = reg.verdicts()
+        assert v["burn_rate"] > 1.0 and v["ok"] is False
+        assert reg.burn_rate("t") == v["burn_rate"]
+
+    def test_empty_window_vacuously_ok(self):
+        reg = slo.SloRegistry(objectives=[
+            slo.Objective(name="t", threshold_s=0.1),
+        ])
+        [v] = reg.verdicts()
+        assert v["requests"] == 0 and v["burn_rate"] == 0.0 and v["ok"]
+
+    def test_window_expiry(self):
+        reg = slo.SloRegistry(objectives=[
+            slo.Objective(name="t", threshold_s=0.1, target=0.5,
+                          window_s=0.05),
+        ])
+        reg.observe_request(9.0)  # bad
+        [v] = reg.verdicts()
+        assert v["bad"] == 1
+        time.sleep(0.08)
+        [v] = reg.verdicts()      # the bad event aged out of the window
+        assert v["requests"] == 0 and v["ok"]
+
+    def test_histograms_and_gauges_emitted(self):
+        slo.observe_request(0.01)
+        slo.observe_stage("admission", 0.002)
+        assert registry.get("pa_slo_request_seconds") is not None
+        assert registry.get("pa_slo_stage_seconds",
+                            {"stage": "admission"}) is not None
+        slo.registry.publish_gauges()
+        text = registry.render()
+        assert re.search(r'^pa_slo_burn_rate\{objective="[^"]+"\} ', text,
+                         re.M)
+        assert re.search(r"^pa_slo_budget_remaining\{", text, re.M)
+        # threshold-aligned bucket edge (default objective: 30s)
+        assert re.search(r'^pa_slo_request_seconds_bucket\{le="30"\} ',
+                         text, re.M)
+
+
+class TestDisabledNoOp:
+    def test_pa_slo_0_is_noop(self, monkeypatch):
+        monkeypatch.setenv("PA_SLO", "0")
+        assert not slo.enabled()
+        slo.observe_request(1.0)
+        slo.observe_stage("eval", 1.0)
+        slo.registry.publish_gauges()
+        assert registry.get("pa_slo_request_seconds") is None
+        assert registry.get("pa_slo_stage_seconds", {"stage": "eval"}) is None
+        assert "pa_slo_" not in registry.render()
+
+
+class TestTextReaders:
+    def _render(self, objs=None):
+        r = MetricsRegistry()
+        bounds = slo.request_bounds(objs or [
+            slo.Objective(name="t", threshold_s=0.1, target=0.75),
+        ])
+        for host, vals in (("h0", (0.05, 0.05, 0.09, 2.0)),
+                           ("h1", (0.02, 0.3, 0.4, 0.45))):
+            for v in vals:
+                r.histogram("pa_slo_request_seconds", v,
+                            labels={"host": host}, bounds=bounds)
+        return r.render()
+
+    def test_fraction_under_exact_at_edge(self):
+        text = self._render()
+        # global: 4 of 8 under 0.1 (edge-aligned → exact)
+        fraction, total = slo.fraction_under(
+            text, "pa_slo_request_seconds", 0.1)
+        assert total == 8 and fraction == pytest.approx(0.5)
+        # per-host filter
+        fraction, total = slo.fraction_under(
+            text, "pa_slo_request_seconds", 0.1, labels={"host": "h0"})
+        assert total == 4 and fraction == pytest.approx(0.75)
+
+    def test_fraction_under_mixed_ladders_per_series(self):
+        """Hosts declaring DIFFERENT objectives expose different bucket
+        ladders for one metric; the reader must evaluate each series on its
+        own ladder and aggregate by count — summing cumulative counts
+        across ladders is non-monotone at edges only one host has (a 2-of-2
+        host must not drag a 98-of-98 host down to 2%)."""
+        ra, rb = MetricsRegistry(), MetricsRegistry()
+        bounds_a = slo.request_bounds([
+            slo.Objective(name="t", threshold_s=0.3),
+        ])
+        for v in (0.2, 0.2):
+            ra.histogram("pa_slo_request_seconds", v, labels={"host": "a"},
+                         bounds=bounds_a)
+        for _ in range(98):  # default ladder: no 0.3 edge (0.25, 0.5)
+            rb.histogram("pa_slo_request_seconds", 0.05,
+                         labels={"host": "b"})
+        text = ra.render() + rb.render()
+        fraction, total = slo.fraction_under(
+            text, "pa_slo_request_seconds", 0.3)
+        assert total == 100
+        assert fraction == pytest.approx(1.0)
+
+    def test_verdicts_from_text(self):
+        objs = [slo.Objective(name="t", threshold_s=0.1, target=0.75)]
+        text = self._render(objs)
+        [v] = slo.verdicts_from_text(text, objs)
+        assert v["requests"] == 8
+        assert v["achieved_fraction"] == pytest.approx(0.5)
+        assert v["ok"] is False  # 0.5 < target 0.75
+        [vh] = slo.verdicts_from_text(text, objs, labels={"host": "h0"})
+        assert vh["achieved_fraction"] == pytest.approx(0.75)
+        assert vh["ok"] is True
+        # absent histogram → explicit unknown, not a crash
+        [vn] = slo.verdicts_from_text("", objs)
+        assert vn["achieved_fraction"] is None and vn["ok"] is None
+
+    def test_label_filtered_quantile_matches_registry(self):
+        r = MetricsRegistry()
+        import numpy as np
+
+        rng = np.random.default_rng(3)
+        for v in rng.uniform(0.001, 2.0, size=150):
+            r.histogram("pa_x_seconds", float(v), labels={"stage": "eval"})
+        for v in rng.uniform(5.0, 40.0, size=50):
+            r.histogram("pa_x_seconds", float(v), labels={"stage": "decode"})
+        text = r.render()
+        for stage in ("eval", "decode"):
+            got = slo.histogram_quantile(text, "pa_x_seconds", 95,
+                                         labels={"stage": stage})
+            want = r.quantile("pa_x_seconds", 95, labels={"stage": stage})
+            assert got == pytest.approx(want), stage
+
+
+class _MiniSampler:
+    CATEGORY = "test"
+    RETURN_TYPES = ("INT",)
+    FUNCTION = "run"
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"seed": ("INT", {"default": 0})}}
+
+    def run(self, seed):
+        time.sleep(0.002)
+        return (int(seed),)
+
+
+class _MiniDecode:
+    CATEGORY = "test"
+    RETURN_TYPES = ("INT",)
+    FUNCTION = "run"
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"x": ("INT", {"default": 0})}}
+
+    def run(self, x):
+        time.sleep(0.002)
+        return (int(x),)
+
+
+class TestStageInstrumentation:
+    def test_workflow_nodes_feed_eval_and_decode(self):
+        from comfyui_parallelanything_tpu.host import run_workflow
+
+        graph = {
+            "1": {"class_type": "_MiniSampler", "inputs": {"seed": 1}},
+            "2": {"class_type": "_MiniDecode", "inputs": {"x": ["1", 0]}},
+        }
+        run_workflow(graph, class_mappings={
+            "_MiniSampler": _MiniSampler, "_MiniDecode": _MiniDecode,
+        })
+        ev = registry.get("pa_slo_stage_seconds", {"stage": "eval"})
+        de = registry.get("pa_slo_stage_seconds", {"stage": "decode"})
+        assert ev is not None and ev[1] == 1  # (sum, count)
+        assert de is not None and de[1] == 1
+        assert ev[0] >= 0.002 and de[0] >= 0.002
+
+    def test_server_observes_admission_and_request(self, tmp_path):
+        from comfyui_parallelanything_tpu.server import make_server
+
+        srv, q = make_server(
+            port=0, output_dir=str(tmp_path / "out"),
+            class_mappings={"_MiniSampler": _MiniSampler},
+        )
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            body = json.dumps({"prompt": {
+                "1": {"class_type": "_MiniSampler", "inputs": {"seed": 3}},
+            }}).encode()
+            req = urllib.request.Request(
+                base + "/prompt", data=body,
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                pid = json.loads(r.read())["prompt_id"]
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                with urllib.request.urlopen(
+                    base + f"/history/{pid}", timeout=30
+                ) as r:
+                    if pid in json.loads(r.read()):
+                        break
+                time.sleep(0.02)
+            adm = registry.get("pa_slo_stage_seconds", {"stage": "admission"})
+            assert adm is not None and adm[1] >= 1
+            req_h = registry.get("pa_slo_request_seconds")
+            assert req_h is not None and req_h[1] >= 1
+            # scrape-time burn gauges on GET /metrics
+            with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+                text = r.read().decode()
+            assert re.search(r"^pa_slo_burn_rate\{", text, re.M)
+            assert re.search(
+                r'^pa_slo_stage_seconds_bucket\{.*stage="admission"', text,
+                re.M,
+            )
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            q.shutdown()
