@@ -82,6 +82,8 @@ class PromptJournal:
         router keeps serving; the log says so)."""
         assert ev in EVENTS, f"unknown journal event {ev!r}"
         rec = {"schema": JOURNAL_SCHEMA, "ev": ev, "pid": pid,
+               # palint: allow[observability] wall-clock is the ONE clock a
+               # failover pair shares (monotonic is process-local)
                "ts": time.time(), **fields}
         line = (json.dumps(rec, default=str) + "\n").encode()
         # Fault site (utils/faults.py): a router crash mid-write leaves a
@@ -128,6 +130,8 @@ class PromptJournal:
                         exist_ok=True)
             with open(tmp, "w") as f:
                 f.write(json.dumps({
+                    # palint: allow[observability] lease stamps compare across
+                    # router processes — wall-clock by necessity
                     "router_id": router_id, "ts": time.time(),
                     "pid": os.getpid(),
                 }))
@@ -152,6 +156,8 @@ class PromptJournal:
         if holder_not is not None and lease.get("router_id") == holder_not:
             return False
         try:
+            # palint: allow[observability] lease age vs another process's
+            # wall-clock stamp — the cross-process clock
             age = time.time() - float(lease.get("ts", 0))
         except (TypeError, ValueError):
             return True

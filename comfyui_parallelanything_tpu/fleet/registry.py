@@ -133,10 +133,10 @@ class FleetRegistry:
                  capacity_weights: dict[str, float] | None = None,
                  capacity_from_ledger: bool = True):
         self.ttl_s = float(ttl_s)
-        self._hosts: dict[str, HostInfo] = {}
+        self._hosts: dict[str, HostInfo] = {}  # guarded-by: _lock
         self._ring = HashRing(vnodes=vnodes)
         self._lock = threading.Lock()
-        self._weights: dict[str, float] = dict(capacity_weights or {})
+        self._weights: dict[str, float] = dict(capacity_weights or {})  # guarded-by: _lock
         if capacity_from_ledger and not self._weights:
             self._weights = ledger_capacity_weights()
 

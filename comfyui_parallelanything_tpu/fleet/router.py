@@ -210,12 +210,12 @@ class FleetRouter:
         # its own unconditional prefer_warm, as before).
         self.rebalance_warm_s = float(rebalance_warm_s)
         self._ring_changed_until = 0.0
-        self.prompts: dict[str, FleetPrompt] = {}
-        self._inflight: dict[str, int] = {}   # host_id → router-side count
+        self.prompts: dict[str, FleetPrompt] = {}  # guarded-by: _lock
+        self._inflight: dict[str, int] = {}   # host_id → router-side count — guarded-by: _lock
         # monotonic stamp of the last router-side inflight DECREASE per
         # host: a health poll older than this carries a provably stale-high
         # inflight count (see Scoreboard.saturated include_polled).
-        self._last_drop: dict[str, float] = {}
+        self._last_drop: dict[str, float] = {}  # guarded-by: _lock
         self._counter = 0
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -369,7 +369,7 @@ class FleetRouter:
             raise
         return pid, number
 
-    def _prune_history(self) -> None:
+    def _prune_history(self) -> None:  # palint: holds _lock
         """Evict the oldest RESOLVED prompts beyond the history budget
         (caller holds the lock; dicts iterate in insertion = submit order)."""
         excess = len(self.prompts) - self.max_history
@@ -1252,6 +1252,7 @@ def main() -> None:
                   if args.follow else None),
     )
     role = "standby" if not router.active else "router"
+    # palint: allow[observability] router startup banner (CLI surface)
     print(f"ParallelAnything fleet {role} on http://{args.host}:{args.port}")
     try:
         srv.serve_forever()

@@ -175,12 +175,12 @@ class Scoreboard:
             max_attempts=1_000_000, base_s=self.poll_s * 2,
             cap_s=self.backoff_cap_s, jitter=0.25,
         )
-        self._entries: dict[str, HostHealth] = {}
+        self._entries: dict[str, HostHealth] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # -- polling ------------------------------------------------------------
 
-    def _entry(self, host_id: str, base: str) -> HostHealth:
+    def _entry(self, host_id: str, base: str) -> HostHealth:  # palint: holds _lock
         e = self._entries.get(host_id)
         if e is None or e.base != base:
             e = self._entries[host_id] = HostHealth(host_id, base)

@@ -73,8 +73,8 @@ class WorkflowCache:
     """
 
     def __init__(self) -> None:
-        self.results: dict[str, tuple] = {}
-        self.signatures: dict[str, str] = {}
+        self.results: dict[str, tuple] = {}     # guarded-by: lock
+        self.signatures: dict[str, str] = {}    # guarded-by: lock
         self.lock = threading.RLock()
 
     def evict(self, nid: str) -> None:
@@ -430,11 +430,13 @@ def main(argv: list[str] | None = None) -> None:
 
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) != 1:
+        # palint: allow[observability] __main__ CLI usage line
         print("usage: python -m comfyui_parallelanything_tpu.host <workflow.json>",
               file=sys.stderr)
         raise SystemExit(2)
     results = run_workflow(argv[0])
     for nid, out in results.items():
+        # palint: allow[observability] __main__ CLI result echo
         print(f"{nid}: {tuple(type(o).__name__ for o in out)}")
 
 

@@ -90,6 +90,8 @@ class DiffusionModel:
         if fn is None:
             from ..utils.telemetry import instrument_jit
 
+            # palint: allow[recompile-hazard] one name per LOADED MODEL
+            # (bounded; per-model compile attribution is the point)
             fn = self._jit_cache[key] = instrument_jit(
                 self.apply, f"model-apply:{self.name}"
             )

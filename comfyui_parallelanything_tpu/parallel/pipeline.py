@@ -104,6 +104,8 @@ class PipelineRunner:
                 _Stage(
                     device=dev,
                     params=jax.device_put(subset(keys), dev),
+                    # palint: allow[recompile-hazard] the stage range IS
+                    # program identity, bounded by the pipeline carve
                     fn=instrument_jit(stage_fn, f"pipeline-stage[{s}:{e})"),
                     labels=tuple(spec.segments[i].label for i in range(s, e)),
                 )

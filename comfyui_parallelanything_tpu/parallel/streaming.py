@@ -158,6 +158,9 @@ class StreamingRunner:
             self.stages.append(
                 _Stage(
                     keys=tuple(keys),
+                    # palint: allow[recompile-hazard] the byte-carve range IS
+                    # program identity (a re-carve is a new program), bounded
+                    # by the carve count
                     fn=instrument_jit(stage_fn, f"stream-stage[{s}:{e})"),
                     nbytes=params_nbytes(
                         {k: self._host_params[k] for k in keys}
@@ -362,6 +365,9 @@ class StreamingRunner:
                         # would admit every remaining prefetch at once.
                         with tracing.span("stream-wait", cat="stream",
                                           stage=k - 1, blocked_on="compute"):
+                            # palint: allow[host-sync] the 2-stage HBM
+                            # backpressure block — booked as stream-wait,
+                            # never compute (the bound's load-bearing sync)
                             jax.block_until_ready(prev_out)
                         if numerics.on():
                             # The output is provably ready (the block above),
@@ -395,10 +401,15 @@ class StreamingRunner:
                         # buffers, and dispatching it is the host's next act.
                         with tracing.span("stream-prefetch-wait", cat="stream",
                                           stage=k, blocked_on="prefetch"):
+                            # palint: allow[host-sync] trace-mode-only block
+                            # booking EXPOSED transfer as wait, not compute
+                            # (the PR 3 discipline's defining site)
                             jax.block_until_ready(ring[k])
                     t_dispatch = tracing.now_us() if trace_on else 0.0
                     carry = stage.fn(ring[k], carry)
                     if not self.overlap:
+                        # palint: allow[host-sync] overlap-off DEBUG mode
+                        # serializes by contract (round 6)
                         jax.block_until_ready(carry)
                         if trace_on:
                             record_compute(k, t_dispatch)

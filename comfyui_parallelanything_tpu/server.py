@@ -283,17 +283,17 @@ class PromptQueue:
         # a dead sibling's prompts prefers a host whose warm set covers the
         # key over a cold primary. LRU-bounded: insertion-ordered dict,
         # oldest evicted past the cap.
-        self.warm_keys: dict[str, float] = {}
+        self.warm_keys: dict[str, float] = {}  # guarded-by: _lock
         self._warm_cap = 64
         self.cache = WorkflowCache()
         self.pending: "queue.Queue[tuple | None]" = queue.Queue()
-        self.pending_ids: list[str] = []
+        self.pending_ids: list[str] = []  # guarded-by: _lock
         # pid → its per-prompt cooperative Cancel event (progress_scope).
-        self.running: dict[str, threading.Event] = {}
-        self.history: dict[str, dict] = {}
+        self.running: dict[str, threading.Event] = {}  # guarded-by: _lock
+        self.history: dict[str, dict] = {}  # guarded-by: _lock
         self.counter = 0
         self._lock = threading.Lock()
-        self._listeners: dict = {}  # socket → _WsListener
+        self._listeners: dict = {}  # socket → _WsListener — guarded-by: _lock
         self.workers = max(
             1, int(workers if workers is not None
                    else os.environ.get("PA_SERVER_WORKERS", "1"))
@@ -321,6 +321,8 @@ class PromptQueue:
             ).start()
         except Exception:
             pass
+        # unguarded: written once here before the threads start, only
+        # iterated afterwards (shutdown joins a snapshot-stable list)
         self._workers = [
             threading.Thread(target=self._run, daemon=True)
             for _ in range(self.workers)
@@ -417,6 +419,8 @@ class PromptQueue:
             key = model_key(prompt)
             with self._lock:
                 self.warm_keys.pop(key, None)
+                # palint: allow[observability] epoch STAMP on an advertised
+                # surface (pa-health/v3 warm-key recency), not a duration
                 self.warm_keys[key] = time.time()
                 while len(self.warm_keys) > self._warm_cap:
                     self.warm_keys.pop(next(iter(self.warm_keys)))
@@ -457,7 +461,7 @@ class PromptQueue:
             self.accepting = True
             self._drain_source = None
 
-    def _drop_pending(self, pid: str) -> None:
+    def _drop_pending(self, pid: str) -> None:  # palint: holds _lock
         """history + bookkeeping for a prompt cancelled before it ran
         (caller holds the lock)."""
         self.pending_ids.remove(pid)
@@ -1243,6 +1247,7 @@ def main() -> None:
                 # rejoin refusing forever.
                 on_rejoin=q.resume_if_auto_drained,
             ).start())
+    # palint: allow[observability] server startup banner (CLI surface)
     print(f"ParallelAnything workflow server on http://{args.host}:{args.port}")
     try:
         srv.serve_forever()

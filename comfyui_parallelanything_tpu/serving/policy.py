@@ -37,7 +37,7 @@ class AdmissionQueue:
 
     def __init__(self, max_waiting: int = 64):
         self.max_waiting = max_waiting
-        self._heap: list[tuple[float, int, object]] = []
+        self._heap: list[tuple[float, int, object]] = []  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def __len__(self) -> int:

@@ -115,14 +115,14 @@ class ContinuousBatchingScheduler:
         )
         self.max_waiting = max_waiting
         self.samplers = frozenset(samplers)
-        self.buckets: dict[tuple, StepBucket] = {}
+        self.buckets: dict[tuple, StepBucket] = {}  # guarded-by: _lock
         # Degradation-ladder width caps (utils/degrade.py "lane-width-halve"):
         # bucket-key-prefix (the key minus its width component) → the widest
         # lane count the ladder still allows after a dispatch OOM. Applied to
         # every later submission for the same shape, so the shed width stays
         # shed until the process restarts (an OOM is a property of the shape
         # on this device, not of one request).
-        self._width_caps: dict[tuple, int] = {}
+        self._width_caps: dict[tuple, int] = {}  # guarded-by: _lock
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._pump_lock = threading.Lock()

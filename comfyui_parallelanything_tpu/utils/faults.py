@@ -180,11 +180,13 @@ class FaultRegistry:
     def __init__(self, seed: int = 0, specs: list[FaultSpec] | None = None,
                  armed: bool = True):
         self.seed = int(seed)
+        # unguarded: write-once at construction (refresh() swaps the
+        # whole REGISTRY object, never this list), read-only afterwards
         self.specs = list(specs or ())
         self.armed = bool(armed) and bool(self.specs)
         self.env_sig: tuple | None = None   # what from_env parsed, for refresh()
-        self._hits: dict[tuple[int, str], int] = {}   # (spec idx, key-class)
-        self._fired: dict[str, int] = {}              # site → fired count
+        self._hits: dict[tuple[int, str], int] = {}   # (spec idx, key-class) — guarded-by: _lock
+        self._fired: dict[str, int] = {}              # site → fired count — guarded-by: _lock
         self._lock = threading.Lock()
 
     @classmethod

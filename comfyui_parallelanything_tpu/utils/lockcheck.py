@@ -1,0 +1,349 @@
+"""Runtime lock-acquisition-order graph — the dynamic half of palint's
+lock-discipline pass.
+
+The static half (``scripts/palint/lockorder.py``) proves every write to a
+``# guarded-by:`` attribute holds its declared lock; what it CANNOT prove
+is that the locks themselves are acquired in a consistent global order —
+the fleet/serving tier holds ~20 locks across server handler threads,
+prompt workers, the serving dispatcher, monitor sweeps, and heartbeats,
+and a cycle in the acquisition-order graph is a potential deadlock waiting
+for the right interleaving. This module records that graph live:
+
+- ``PA_LOCKCHECK=1`` + :func:`install` wrap ``threading.Lock`` /
+  ``threading.RLock`` CONSTRUCTION: locks created by repo code (creation
+  frame inside this checkout — jax/stdlib internals are handed the real
+  primitive untouched) become :class:`TrackedLock` proxies.
+- each thread keeps its held-set in acquisition order; acquiring B while
+  holding A records the edge A→B (tagged with both creation sites and the
+  acquiring file:line). RLock re-entry is not an edge.
+- a cycle (A→…→B→A) means two code paths take the same locks in opposite
+  orders — :func:`cycles` returns them, the first detection logs and
+  writes a postmortem bundle (best-effort, the forensics rule), and the
+  tier-1 fleet/serving/chaos tests + the chaos smoke gate on ZERO cycles
+  (tests/conftest.py installs when the env flag is set;
+  ``scripts/chaos.py`` folds ``lock_cycles`` into its verdict).
+
+Edges are ORDER facts, not contention facts: a cycle is reported even if
+the deadlock never fired in this run — that is the point (the interleaving
+that fires it is the one CI never schedules). A false positive (two orders
+serialized by an outer lock) is pragma territory: name the outer lock in
+the test that asserts the cycle away, or restructure — the graph is small.
+
+Known blind spot: nodes are CREATION SITES (lock classes, the lockdep
+model), so two instances born at the same line — the HA router pair's
+``_lock``, two scoreboards — alias to one node and a same-site pair never
+records an edge (a self-edge would read as a spurious one-node cycle).
+An AB-BA inversion BETWEEN two instances of the same class is therefore
+invisible here; instance-level ordering is what the chaos matrix's real
+kill/takeover interleavings exercise.
+
+Module level is stdlib-only and free of package-relative imports (the
+``utils/roofline.py`` standalone contract): tests and scripts load it by
+path before the package (and jax) import, so installation precedes every
+module-level ``threading.Lock()`` in the package.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import _thread
+
+__all__ = [
+    "enabled", "install", "uninstall", "installed", "TrackedLock",
+    "cycles", "edges", "report", "reset",
+]
+
+# The raw primitive for the graph's own bookkeeping — NEVER the (possibly
+# patched) threading.Lock, or every edge insert would record itself.
+_graph_mutex = _thread.allocate_lock()
+# (src site, dst site) -> {"count": n, "at": "file:line" of first observer}
+_edges: dict = {}                      # guarded-by: _graph_mutex
+_cycle_log: list = []                  # guarded-by: _graph_mutex
+_tls = threading.local()               # per-thread held stack
+_installed = [False]
+# Unwrap a prior install (a second execution of this file — e.g. the
+# package import racing a path-loaded boot copy — must not capture the
+# patched factory as "original", or uninstall() would re-install it).
+_orig_lock = getattr(threading.Lock, "_pa_lockcheck_orig", threading.Lock)
+_orig_rlock = getattr(threading.RLock, "_pa_lockcheck_orig", threading.RLock)
+
+# Creation-site scope: track only locks born in this checkout (the package,
+# scripts/, bench.py, tests/) — wrapping jax's or the stdlib's own locks
+# would put third-party ordering in OUR gate.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def enabled() -> bool:
+    return os.environ.get("PA_LOCKCHECK") == "1"
+
+
+def _creation_site() -> str | None:
+    """file:line of the repo frame constructing the lock, or None when the
+    constructor ran from outside the checkout (→ hand back a real lock)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        base = os.path.basename(fn)
+        if base != "lockcheck.py" and "threading" not in base:
+            if fn.startswith(_REPO_ROOT) and "site-packages" not in fn:
+                rel = os.path.relpath(fn, _REPO_ROOT)
+                return f"{rel}:{f.f_lineno}"
+            return None
+        f = f.f_back
+    return None
+
+
+def _held() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _find_path(src: str, dst: str) -> list | None:
+    """DFS over _edges (caller holds _graph_mutex): a site path src→…→dst,
+    or None."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for (a, b) in _edges:
+            if a != node or b in seen:
+                continue
+            if b == dst:
+                return path + [b]
+            seen.add(b)
+            stack.append((b, path + [b]))
+    return None
+
+
+def _acquire_site() -> str:
+    """file:line of the nearest frame OUTSIDE this file performing the
+    acquisition — with-statements route ``__enter__ → acquire →
+    _note_acquire`` and Condition waits route ``_acquire_restore``, so a
+    fixed frame depth would attribute every edge to lockcheck itself."""
+    f = sys._getframe(2)
+    while f is not None:
+        base = os.path.basename(f.f_code.co_filename)
+        if base != "lockcheck.py":
+            return f"{base}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _note_acquire(lock: "TrackedLock") -> None:
+    held = _held()
+    if any(h is lock for h in held):     # RLock re-entry: not an edge
+        held.append(lock)
+        return
+    at = _acquire_site()
+    new_cycle = None
+    with _graph_mutex:
+        for h in held:
+            if h.site == lock.site:
+                continue
+            key = (h.site, lock.site)
+            e = _edges.get(key)
+            if e is None:
+                # New edge: does the reverse direction already exist
+                # (directly or transitively)? Then this acquisition closed
+                # a cycle in the order graph.
+                back = _find_path(lock.site, h.site)
+                _edges[key] = {"count": 1, "at": at}
+                if back is not None:
+                    new_cycle = back + [lock.site]
+                    _cycle_log.append({"cycle": new_cycle, "at": at})
+            else:
+                e["count"] += 1
+    held.append(lock)
+    if new_cycle is not None:
+        _report_cycle(new_cycle, at)
+
+
+def _note_release(lock: "TrackedLock") -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] is lock:
+            del held[i]
+            return
+
+
+def _report_cycle(cycle: list, at: str) -> None:
+    """First-detection forensics: log + best-effort postmortem bundle. Any
+    failure here must not break the locking it observes."""
+    try:
+        from .logging import get_logger
+
+        get_logger().error(
+            "lockcheck: lock-order cycle (potential deadlock) at %s: %s",
+            at, " -> ".join(cycle))
+    except Exception:
+        pass
+    try:
+        from .telemetry import write_postmortem
+
+        write_postmortem("lock-order-cycle", extras={
+            "cycle": cycle, "observed_at": at, "report": report(),
+        })
+    except Exception:
+        pass
+
+
+class TrackedLock:
+    """Proxy over a real Lock/RLock recording acquisition order. Supports
+    the full context-manager/acquire/release protocol plus the private
+    RLock hooks ``threading.Condition`` relies on."""
+
+    __slots__ = ("_real", "site", "kind")
+
+    def __init__(self, real, site: str, kind: str):
+        self._real = real
+        self.site = site
+        self.kind = kind
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._real.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._real.release()
+        _note_release(self)
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # threading.Condition(wrapped_rlock) support: wait() swaps the lock out
+    # and back via these hooks — mirror the held-set so the order graph
+    # stays truthful across a wait.
+    def _release_save(self):
+        state = self._real._release_save() if hasattr(
+            self._real, "_release_save") else self._real.release()
+        _note_release(self)
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        if hasattr(self._real, "_acquire_restore"):
+            self._real._acquire_restore(state)
+        else:
+            self._real.acquire()
+        _note_acquire(self)
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._real, "_is_owned"):
+            return self._real._is_owned()
+        # plain Lock: owned iff locked (the stdlib's own fallback)
+        if self._real.acquire(False):
+            self._real.release()
+            return False
+        return True
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock {self.kind} from {self.site}>"
+
+
+def _make_factory(orig, kind: str):
+    def factory(*args, **kwargs):
+        real = orig(*args, **kwargs)
+        site = _creation_site()
+        if site is None:
+            return real
+        return TrackedLock(real, site, kind)
+    factory._pa_lockcheck_orig = orig
+    return factory
+
+
+_prev = [None, None]  # what install() displaced — restored by uninstall()
+
+
+def install() -> bool:
+    """Patch threading.Lock/RLock construction (idempotent). Returns True
+    when installed. Call BEFORE importing the package so its module-level
+    locks are born tracked — tests/conftest.py does this when
+    PA_LOCKCHECK=1."""
+    if _installed[0]:
+        return True
+    _prev[0], _prev[1] = threading.Lock, threading.RLock
+    threading.Lock = _make_factory(_orig_lock, "Lock")
+    threading.RLock = _make_factory(_orig_rlock, "RLock")
+    _installed[0] = True
+    return True
+
+
+def uninstall() -> None:
+    """Restore whatever install() displaced — a second checker instance
+    (tests path-load their own copy) must not strip the session's."""
+    if not _installed[0]:
+        return
+    threading.Lock = _prev[0] or _orig_lock
+    threading.RLock = _prev[1] or _orig_rlock
+    _installed[0] = False
+
+
+def installed() -> bool:
+    return _installed[0]
+
+
+def edges() -> list[dict]:
+    with _graph_mutex:
+        return [{"from": a, "to": b, **dict(v)}
+                for (a, b), v in sorted(_edges.items())]
+
+
+def cycles() -> list[list[str]]:
+    """Every distinct cycle currently in the order graph (canonicalized so
+    one cycle reports once regardless of entry point)."""
+    with _graph_mutex:
+        keys = list(_edges)
+    adj: dict[str, list[str]] = {}
+    for a, b in keys:
+        adj.setdefault(a, []).append(b)
+    found: dict[tuple, list[str]] = {}
+
+    def dfs(start: str, node: str, path: list[str], seen: set):
+        for nxt in adj.get(node, ()):
+            if nxt == start and len(path) > 1:
+                rot = min(range(len(path)),
+                          key=lambda i: path[i])  # canonical rotation
+                canon = tuple(path[rot:] + path[:rot])
+                found.setdefault(canon, list(canon) + [canon[0]])
+            elif nxt not in seen and nxt > start:
+                # only walk nodes ≥ start: each cycle found exactly once,
+                # from its smallest member
+                dfs(start, nxt, path + [nxt], seen | {nxt})
+
+    for a in sorted(adj):
+        dfs(a, a, [a], {a})
+    return sorted(found.values())
+
+
+def report() -> dict:
+    cyc = cycles()
+    return {
+        "schema": "pa-lockcheck/v1",
+        "enabled": enabled(),
+        "installed": installed(),
+        "edges": edges(),
+        "cycles": cyc,
+        "ok": not cyc,
+    }
+
+
+def reset() -> None:
+    """Clear the graph (tests). Held-sets are per-thread and survive — a
+    reset mid-critical-section only forgets past edges, never present
+    holds."""
+    with _graph_mutex:
+        _edges.clear()
+        _cycle_log.clear()

@@ -29,6 +29,17 @@ the CI-gated ``pa_fleet_prompts_lost_total``, and the scoreboard gauges
 ``pa_fleet_hosts`` / ``pa_fleet_hosts_healthy`` /
 ``pa_fleet_host_inflight{host=}`` / ``pa_fleet_host_accepting{host=}`` /
 ``pa_fleet_inflight`` / ``pa_fleet_queued`` published at scrape time).
+
+Later rounds' families (this map is the OWNING REGISTRY: palint's
+registry-consistency pass fails CI on any ``pa_*`` emission site whose
+family is missing here): ``pa_server_*`` (server.py — queue depth /
+running / rejected), ``pa_stream_overlap_efficiency`` (parallel/streaming
+— stage-compute fraction of streamed-run wall), ``pa_slo_*`` (utils/slo.py
+— burn rate / budget / objective verdicts / threshold-aligned request and
+stage histograms), ``pa_roofline_*`` (utils/roofline.py + fleet/twin.py —
+per-program predicted seconds, twin capacity source), ``pa_fault_injected_total{site=}``
+(utils/faults.py — chaos attribution), and ``pa_degradation_total{rung=}``
+(utils/degrade.py — ladder rungs taken).
 """
 
 from __future__ import annotations
@@ -76,13 +87,13 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         # name -> {"type": kind, "help": str, "values": {label_key: float|[sum, count]}}
-        self._metrics: dict[str, dict] = {}
+        self._metrics: dict[str, dict] = {}  # guarded-by: _lock
 
     @staticmethod
     def _label_key(labels: dict | None) -> tuple:
         return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
 
-    def _slot(self, name: str, kind: str, help_: str) -> dict:
+    def _slot(self, name: str, kind: str, help_: str) -> dict:  # palint: holds _lock
         m = self._metrics.get(name)
         if m is None:
             m = self._metrics[name] = {"type": kind, "help": help_, "values": {}}

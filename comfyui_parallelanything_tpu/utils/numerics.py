@@ -239,7 +239,7 @@ class NumericsSentinel:
         # Per-request fingerprint records: {"rid", "sampler", "bucket",
         # "steps", "digests": [uint32 per eval]} — bounded; the invariance
         # tests and dryrun §15 read these back.
-        self._fingerprints: deque = deque(maxlen=64)
+        self._fingerprints: deque = deque(maxlen=64)  # guarded-by: _lock
         self._inject_done = False
 
     # -- lifecycle ----------------------------------------------------------
@@ -268,6 +268,7 @@ class NumericsSentinel:
         streaming runner records stage events, bench records a poisoned
         final output). Feeds the counter, the last-event slot, and — when
         the tracer is on — an instant ``numerics`` span."""
+        # palint: allow[observability] forensic-record epoch STAMP
         event = {"where": where, "ts": time.time(), **info}
         with self._lock:
             self._events += 1
@@ -298,6 +299,7 @@ class NumericsSentinel:
         """One lane quarantine (serving/bucket.py): the full forensic record
         — bucket/lane/rid/sampler, the first non-finite step/σ/block, and the
         postmortem bundle path."""
+        # palint: allow[observability] forensic-record epoch STAMP
         rec = {"ts": time.time(), **info}
         with self._lock:
             self._quarantined += 1

@@ -217,6 +217,7 @@ def save_calibration(scales: dict, path: str | None = None) -> str | None:
     try:
         os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
         with open(p, "w") as f:
+            # palint: allow[observability] calibration-bank epoch STAMP
             json.dump({"schema": CALIB_SCHEMA, "ts": time.time(),
                        "scales": scales}, f, indent=1, sort_keys=True)
         return p
@@ -360,7 +361,7 @@ class ProgramRegistry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._rows: dict[str, dict] = {}
+        self._rows: dict[str, dict] = {}  # guarded-by: _lock
         self._calib: dict | None = None
 
     def _calibration(self) -> dict:

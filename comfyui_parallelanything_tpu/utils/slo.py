@@ -161,10 +161,11 @@ class SloRegistry:
 
     def __init__(self, objectives: list[Objective] | None = None):
         self._lock = threading.Lock()
+        # guarded-by: _lock (both: replaced/extended wholesale under it)
         self._objectives = list(
             objectives if objectives is not None else objectives_from_env()
         )
-        self._events: dict[str, deque] = {
+        self._events: dict[str, deque] = {  # guarded-by: _lock
             o.name: deque(maxlen=self.MAX_EVENTS) for o in self._objectives
         }
         # The threshold-aligned ladder, computed once per objective set —

@@ -96,8 +96,8 @@ class CompileRegistry:
         self._local = threading.local()
         # program name -> {"compiles", "compile_time_s", "cache_hits",
         #                  "cache_misses", "flops", "bytes_accessed"}
-        self._programs: dict[str, dict] = {}
-        self._totals = {
+        self._programs: dict[str, dict] = {}  # guarded-by: _lock
+        self._totals = {  # guarded-by: _lock
             "compiles": 0, "compile_time_s": 0.0,
             "cache_hits": 0, "cache_misses": 0,
         }
@@ -122,7 +122,7 @@ class CompileRegistry:
         s = self._stack()
         return s[-1] if s else None
 
-    def _prog(self, name: str) -> dict:
+    def _prog(self, name: str) -> dict:  # palint: holds _lock
         p = self._programs.get(name)
         if p is None:
             p = self._programs[name] = {
@@ -492,6 +492,7 @@ def append_ledger_record(record: dict, kind: str) -> str | None:
     rec = dict(record)
     rec["schema"] = LEDGER_SCHEMA
     rec["kind"] = kind
+    # palint: allow[observability] ledger epoch STAMP, not a duration
     rec.setdefault("ts", time.time())
     try:
         rec.setdefault("host", socket.gethostname())
@@ -525,6 +526,7 @@ def health_snapshot(queue: dict | None = None,
     state; standalone callers (watchdog notes) omit it."""
     out: dict = {
         "schema": HEALTH_SCHEMA,
+        # palint: allow[observability] health-document epoch STAMP
         "ts": time.time(),
         "loadavg_1m": _loadavg_1m(),
     }
@@ -620,6 +622,7 @@ def write_postmortem(tag: str, error: BaseException | None = None,
     def error_payload():
         info: dict = {
             "tag": tag,
+            # palint: allow[observability] postmortem epoch STAMP
             "ts": time.time(),
             "loadavg_1m": _loadavg_1m(),
             "compile": compile_snapshot(),

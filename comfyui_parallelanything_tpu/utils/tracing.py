@@ -147,14 +147,14 @@ class Tracer:
         self._lock = threading.Lock()
         # thread ident -> (thread name, events deque) — registration happens
         # once per recording thread; export snapshots under the lock.
-        self._buffers: dict[int, tuple[str, deque]] = {}
+        self._buffers: dict[int, tuple[str, deque]] = {}  # guarded-by: _lock
         # Thread IDENTS ARE REUSED after a thread dies (pthread ids recycle
         # aggressively under http.server's thread-per-request churn): when a
         # new thread claims a dead recorder's ident, the dead thread's spans
         # must survive — they move to this bounded retired ring instead of
         # being silently replaced. Every event row carries its own tid, so
         # retired buffers export exactly like live ones.
-        self._retired: deque = deque(maxlen=256)
+        self._retired: deque = deque(maxlen=256)  # guarded-by: _lock
         self._epoch_us = now_us()
 
     # -- lifecycle ----------------------------------------------------------

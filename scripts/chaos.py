@@ -230,10 +230,24 @@ def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
                     root: str | None = None,
                     plan: dict | None = None) -> dict:
     """The fleet phase (importable — tests/test_chaos.py drives this exact
-    path). Returns the verdict dict; ``ok`` is the gate."""
+    path). Returns the verdict dict; ``ok`` is the gate. Under
+    ``PA_LOCKCHECK=1`` (ci_tier1.sh sets it for the chaos smoke) the
+    lock-acquisition-order graph recorded across the whole
+    router+standby+backends run must stay ACYCLIC — the verdict carries
+    ``lock_cycles`` and a cycle fails the phase (a potential deadlock under
+    fault injection is a chaos failure even if this run never hung)."""
     from loadgen import run_load
 
     from comfyui_parallelanything_tpu.utils import faults
+
+    lockcheck = None
+    if os.environ.get("PA_LOCKCHECK") == "1":
+        from comfyui_parallelanything_tpu.utils import lockcheck
+
+        # Installed here when the harness (tests/conftest.py) hasn't
+        # already: locks created from this point on — every per-instance
+        # router/scoreboard/journal/server lock below — are tracked.
+        lockcheck.install()
 
     root = root or tempfile.mkdtemp(prefix="pa-chaos-")
     total = clients * requests
@@ -343,10 +357,20 @@ def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
         )
     if fired <= 0:
         failures.append("fault plan never fired (injection unproven)")
+    lock_cycles = None
+    if lockcheck is not None:
+        cycles = lockcheck.cycles()
+        lock_cycles = len(cycles)
+        if cycles:
+            failures.append(
+                "lock-order cycle(s) recorded (potential deadlock): "
+                + "; ".join(" -> ".join(c) for c in cycles)
+            )
     return {
         "phase": "fleet",
         "ok": not failures,
         "failures": failures,
+        "lock_cycles": lock_cycles,
         "total_prompts": total,
         "prompts_lost": chaos.get("prompts_lost"),
         "completed": chaos["completed"],

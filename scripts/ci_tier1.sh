@@ -7,6 +7,13 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 
+# Static analysis FIRST (round 16): scripts/palint.py --check is stdlib-only
+# and finishes in ~2s — a standalone-contract drift, an unguarded shared
+# write, an undocumented metric/env/fault-site/span-cat, or a host-sync
+# violation fails the run before the 38-minute suite spends a single dot.
+env -u PALLAS_AXON_POOL_IPS python scripts/palint.py --check || {
+    echo "ci_tier1: palint static-analysis gate FAILED" >&2; exit 1; }
+
 # Per-run log (not a fixed /tmp name: concurrent runs must not clobber each
 # other's DOTS_PASSED count, and another user's stale file must not wedge tee).
 t1log=$(mktemp /tmp/_t1.XXXXXX.log)
@@ -68,6 +75,21 @@ timeout -k 10 300 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
 
+# Lock-order gate (round 16): the FULL fleet + serving suites under
+# PA_LOCKCHECK=1 — utils/lockcheck.py wraps every repo lock construction
+# and conftest's autouse fixture fails the first test whose code paths
+# close a cycle in the acquisition-order graph (a potential deadlock even
+# when CI never schedules the interleaving that fires it). The -k reruns
+# above stay uninstrumented; THIS step is the documented zero-cycle gate
+# over the threaded tier, and the chaos smoke below extends it to the
+# fault-injection paths.
+timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    PA_LOCKCHECK=1 \
+    python -m pytest tests/test_fleet.py tests/test_serving.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+
 # Chaos smoke (round 14): a seeded fault plan (backend-http 5xx +
 # slow-host, deterministic in the seed) fired against a 2-backend fleet
 # while the PRIMARY ROUTER is killed mid-denoise (standby takeover off the
@@ -77,7 +99,13 @@ rc=$?
 # (pa_fault_injected_total); plus an injected stream-OOM absorbed by the
 # re-carve degradation rung on a real weight-streamed model
 # (tests/test_chaos.py drives scripts/chaos.py in-process). Also part of
-# the tier-1 run above; this rerun is the explicit contract.
+# the tier-1 run above; this rerun is the explicit contract. Round 16 runs
+# it under PA_LOCKCHECK=1: utils/lockcheck.py records the lock-acquisition-
+# order graph across the whole router+standby+backends fleet under fault
+# injection, the chaos verdict carries lock_cycles, and conftest fails any
+# test that leaves a cycle — the dynamic half of palint's lock-discipline
+# pass, gated on ZERO potential deadlocks.
 timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    PA_LOCKCHECK=1 \
     python -m pytest tests/test_chaos.py -q -p no:cacheprovider \
     -p no:xdist -p no:randomly

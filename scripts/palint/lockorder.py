@@ -1,0 +1,286 @@
+"""lock-discipline: guarded-by annotations on the threaded tier, enforced.
+
+The fleet/serving/utils tier runs ~20 locks across server threads, prompt
+workers, monitor sweeps, heartbeats, and the serving dispatcher. The
+discipline this pass enforces (the static half — ``utils/lockcheck.py``'s
+runtime acquisition-order graph is the dynamic half, and the two
+cross-check each other):
+
+1. **inventory is explicit**: in any class whose ``__init__`` constructs a
+   ``threading.Lock``/``RLock``, every mutable-container attribute assigned
+   in ``__init__`` must be annotated — ``# guarded-by: <lock>`` when the
+   lock protects it, or ``# unguarded: <reason>`` when it is deliberately
+   free (single-writer, pre-thread-start, atomic by the GIL…). An
+   unannotated shared container is the finding: nobody can review locking
+   they can't see.
+2. **guarded writes hold the lock**: a write to a ``guarded-by: L``
+   attribute outside ``__init__`` must sit lexically inside ``with
+   self.L:`` (or ``with L:`` for module-level locks), or in a method whose
+   ``def`` line carries ``# palint: holds L`` (documents "caller holds
+   it" — the RLock pattern). Writes are assignments, augmented assigns,
+   ``del``, subscript stores, and the mutator calls (``append``/``pop``/
+   ``update``/…). Reads are not checked (the tier reads stale-tolerant
+   snapshots by design).
+
+Module-level locks follow the same shape: ``NAME = threading.Lock()`` plus
+``# guarded-by: NAME`` on the globals it protects.
+
+Scope: the threaded tier only (fleet/, serving/, utils/, server.py,
+host.py) — the model zoo is functional and thread-free by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+NAME = "lock-discipline"
+DOC = "guarded-by annotations present and writes hold the declared lock"
+
+SCOPE_PREFIXES = (
+    "comfyui_parallelanything_tpu/fleet/",
+    "comfyui_parallelanything_tpu/serving/",
+    "comfyui_parallelanything_tpu/utils/",
+    "comfyui_parallelanything_tpu/server.py",
+    "comfyui_parallelanything_tpu/host.py",
+)
+
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "popleft", "appendleft", "remove", "discard", "clear",
+}
+_CONTAINER_CTORS = {"dict", "list", "set", "defaultdict", "deque",
+                    "OrderedDict", "Counter"}
+
+
+def _is_lock_ctor(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("Lock", "RLock")
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in ("threading", "_threading"))
+
+
+def _is_container(node) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        return name in _CONTAINER_CTORS
+    return False
+
+
+def _self_attr(node) -> str | None:
+    """'attr' when node is `self.attr`, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _write_targets(node):
+    """Yield (kind, target-expr) for the writes this statement performs."""
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            yield "assign", t
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        if getattr(node, "value", None) is not None or \
+                isinstance(node, ast.AugAssign):
+            yield "assign", node.target
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            yield "del", t
+
+
+def _with_lock_names(with_node: ast.With) -> set[str]:
+    """Lock names this `with` acquires: `self.X` → 'X', bare `X` → 'X'."""
+    names: set[str] = set()
+    for item in with_node.items:
+        expr = item.context_expr
+        # `with self._lock:` / `with _batch_lock:` / `with lock.acquire…`
+        a = _self_attr(expr)
+        if a:
+            names.add(a)
+        elif isinstance(expr, ast.Name):
+            names.add(expr.id)
+        elif isinstance(expr, ast.Call):
+            a = _self_attr(expr.func)
+            if a:
+                names.add(a)
+            elif isinstance(expr.func, ast.Name):
+                names.add(expr.func.id)
+    return names
+
+
+class _ClassInfo:
+    def __init__(self, node):
+        self.node = node
+        self.locks: set[str] = set()          # lock attr names
+        self.guarded: dict[str, str] = {}     # attr -> lock name
+        self.annotated: set[str] = set()      # attrs with any annotation
+        self.container_attrs: dict[str, int] = {}  # attr -> init line
+        # `self._cond = threading.Condition(self._lock)` — entering the
+        # condition IS holding the lock it wraps: alias name -> lock name.
+        self.aliases: dict[str, str] = {}
+
+
+def _analyze_class(sf, cls) -> _ClassInfo | None:
+    info = _ClassInfo(cls)
+    init = next((n for n in cls.body
+                 if isinstance(n, ast.FunctionDef) and n.name == "__init__"),
+                None)
+    if init is None:
+        return None
+    for node in ast.walk(init):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1) or \
+                (isinstance(node, ast.AnnAssign)
+                 and node.value is not None):
+            target = node.targets[0] if isinstance(node, ast.Assign) \
+                else node.target
+            attr = _self_attr(target)
+            if attr is None:
+                continue
+            if _is_lock_ctor(node.value):
+                info.locks.add(attr)
+                continue
+            if isinstance(node.value, ast.Call) and \
+                    isinstance(node.value.func, ast.Attribute) and \
+                    node.value.func.attr == "Condition" and \
+                    node.value.args:
+                wrapped = _self_attr(node.value.args[0])
+                if wrapped:
+                    info.aliases[attr] = wrapped
+                    continue
+            guard = sf.near(sf.guards, node.lineno)
+            unguard = sf.near(sf.unguarded, node.lineno) is not None
+            if guard:
+                info.guarded[attr] = guard
+                info.annotated.add(attr)
+            elif unguard:
+                info.annotated.add(attr)
+            if _is_container(node.value):
+                info.container_attrs.setdefault(attr, node.lineno)
+    if not info.locks:
+        return None
+    return info
+
+
+def _check_method_writes(sf, info, method, findings, *,
+                         module_guards=None):
+    """Flag writes to guarded attrs outside the declared lock's `with`."""
+    holds = sf.near(sf.holds, method.lineno)
+
+    aliases = info.aliases if info else {}
+
+    def covered(node, lock_name) -> bool:
+        if holds == lock_name:
+            return True
+        for w in with_stack_of.get(id(node), ()):  # lexical With ancestry
+            if lock_name in w:
+                return True
+            if any(aliases.get(n) == lock_name for n in w):
+                return True
+        return False
+
+    # Build the lexical with-ancestry map for this method.
+    with_stack_of: dict[int, tuple] = {}
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            s = stack
+            if isinstance(child, ast.With):
+                s = stack + (_with_lock_names(child),)
+            with_stack_of[id(child)] = s
+            walk(child, s)
+
+    walk(method, ())
+
+    guarded = dict(info.guarded) if info else {}
+    mod_guarded = module_guards or {}
+
+    for node in ast.walk(method):
+        checks = []  # (lock, attr-desc, line)
+        for kind, tgt in _write_targets(node):
+            base = tgt
+            if isinstance(tgt, ast.Subscript):
+                base = tgt.value
+            attr = _self_attr(base)
+            if attr and attr in guarded:
+                checks.append((guarded[attr], f"self.{attr}", node))
+            elif isinstance(base, ast.Name) and base.id in mod_guarded:
+                checks.append((mod_guarded[base.id], base.id, node))
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            base = node.func.value
+            attr = _self_attr(base)
+            if attr and attr in guarded:
+                checks.append((guarded[attr],
+                               f"self.{attr}.{node.func.attr}()", node))
+            elif isinstance(base, ast.Name) and base.id in mod_guarded:
+                checks.append((mod_guarded[base.id],
+                               f"{base.id}.{node.func.attr}()", node))
+        for lock, desc, n in checks:
+            if not covered(n, lock):
+                findings.append({
+                    "path": sf.rel, "line": n.lineno,
+                    "code": "unguarded-write",
+                    "message": f"write to {desc} (guarded-by: {lock}) "
+                               f"outside `with {lock}:` — annotate the "
+                               f"method `# palint: holds {lock}` if the "
+                               f"caller holds it, or take the lock",
+                })
+
+
+def run(ctx) -> list[dict]:
+    findings: list[dict] = []
+    for sf in ctx.files:
+        if sf.tree is None or not any(
+                sf.rel.startswith(p) or sf.rel == p
+                for p in SCOPE_PREFIXES):
+            continue
+        # Module-level locks + guarded globals.
+        module_locks: set[str] = set()
+        module_guards: dict[str, str] = {}
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if _is_lock_ctor(node.value):
+                    module_locks.add(name)
+                else:
+                    guard = sf.near(sf.guards, node.lineno)
+                    if guard:
+                        module_guards[name] = guard
+        # Classes with locks: inventory + write checks.
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = _analyze_class(sf, node)
+            if info is None:
+                continue
+            for attr, line in sorted(info.container_attrs.items()):
+                if attr not in info.annotated and attr not in info.locks:
+                    findings.append({
+                        "path": sf.rel, "line": line,
+                        "code": "unannotated-shared-attr",
+                        "message": f"`self.{attr}` is a mutable container "
+                                   f"in a lock-owning class with no "
+                                   f"`# guarded-by: <lock>` / `# unguarded: "
+                                   f"<reason>` annotation — locking must be "
+                                   f"reviewable",
+                    })
+            for meth in node.body:
+                if isinstance(meth, ast.FunctionDef) and \
+                        meth.name != "__init__":
+                    _check_method_writes(sf, info, meth, findings,
+                                         module_guards=module_guards)
+        # Module-level guarded globals written by module functions.
+        if module_guards:
+            for node in sf.tree.body:
+                if isinstance(node, ast.FunctionDef):
+                    _check_method_writes(sf, None, node, findings,
+                                         module_guards=module_guards)
+    return findings

@@ -1,0 +1,57 @@
+"""observability: no bare print()/time.time() in the package.
+
+Migrated from ``tests/test_telemetry.py::TestObservabilityLint`` (round 9)
+so there is ONE lint engine: the package's output vocabulary is spans,
+logs, and metrics (``utils/{tracing,logging,metrics}.py`` — the PARITY
+print-site mapping), and its duration clocks are monotonic
+(``StepTimer``/``time.monotonic``/``perf_counter``). A bare ``print()`` is
+invisible to every collector; an ad-hoc ``time.time()`` difference breaks
+under clock steps.
+
+The old CENTRAL allowlists (path-suffix + marker tuples in the test file)
+are now per-line pragmas next to the code they excuse —
+``# palint: allow[observability] <why>`` — so the justification lives
+in-line, and the engine's stale-pragma check replaces
+``test_allowlist_entries_still_exist``. Legitimate sites: CLI banners
+(server/router/host ``__main__``), and wall-clock EPOCH STAMPS on
+persisted/advertised records (ledger ts, journal ts — where wall-clock is
+the one clock two processes share).
+
+scripts/, bench.py and tests/ stay exempt (CLI surfaces by design).
+"""
+
+from __future__ import annotations
+
+import re
+
+NAME = "observability"
+DOC = "no bare print()/time.time() in the package (spans/logs/metrics only)"
+
+_PRINT_RE = re.compile(r"^\s*print\(")
+_TIME_RE = re.compile(r"\btime\.time\(")
+
+
+def run(ctx) -> list[dict]:
+    findings: list[dict] = []
+    for f in ctx.package_files():
+        for i, line in enumerate(f.lines, 1):
+            comment = f.comments.get(i)
+            if comment:
+                cut = line.rfind(comment)
+                if cut >= 0:  # match against code only, not the comment
+                    line = line[:cut]
+            if _PRINT_RE.match(line):
+                findings.append({
+                    "path": f.rel, "line": i, "code": "bare-print",
+                    "message": "bare print() in the package — use "
+                               "utils/logging (or justify with a pragma: "
+                               "CLI banners only)",
+                })
+            if _TIME_RE.search(line) and not line.lstrip().startswith("#"):
+                findings.append({
+                    "path": f.rel, "line": i, "code": "ad-hoc-time",
+                    "message": "time.time() in the package — durations use "
+                               "monotonic clocks (StepTimer/tracing); "
+                               "wall-clock epoch STAMPS justify a pragma",
+                })
+    return findings

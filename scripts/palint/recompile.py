@@ -1,0 +1,178 @@
+"""recompile-hazard: jit cache-key stability at the program-cache sites.
+
+Every compiled program in this stack is cached under a stable name
+(``utils/telemetry.instrument_jit``) and a hashable cache key — PR 10
+dropped the sampler from the serving bucket key precisely so traffic mix
+can't recompile, and the roofline/compile registries key per-program
+accounting off those names. Three ways that quietly rots:
+
+- **dynamic program names** at ``instrument_jit`` sites: an f-string /
+  ``%``-format / ``.format()``/concat name mints a new program identity per
+  value — unbounded registry cardinality and per-value compile accounting.
+  The two legitimate sites (stage-carve names — the stage span IS part of
+  program identity, bounded by the carve count) carry justified pragmas.
+- **unhashable static args**: a parameter declared in ``static_argnums`` /
+  ``static_argnames`` whose default (or call-site value, same module) is a
+  list/dict/set raises at trace time — or, for arrays smuggled through
+  ``static_argnames``, recompiles every call.
+- **mutable default kwargs** in the modules that build jit cache keys
+  (sampling/, parallel/, serving/, models/api.py, utils/telemetry.py): a
+  shared default dict flowing into a cache key makes the key aliasable and
+  order-dependent. (The package is currently clean — this keeps it so.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+NAME = "recompile-hazard"
+DOC = "jit cache keys: stable names, hashable statics, no mutable defaults"
+
+# Files whose functions feed jit cache keys (program caches, bucket keys,
+# loop-program keys): mutable defaults are flagged here.
+CACHE_KEY_DIRS = (
+    "comfyui_parallelanything_tpu/sampling/",
+    "comfyui_parallelanything_tpu/parallel/",
+    "comfyui_parallelanything_tpu/serving/",
+    "comfyui_parallelanything_tpu/models/api.py",
+    "comfyui_parallelanything_tpu/utils/telemetry.py",
+)
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray", "defaultdict",
+                  "deque", "OrderedDict", "Counter"}
+
+
+def _is_mutable(node) -> bool:
+    if isinstance(node, _MUTABLE_LITERALS):
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        return name in _MUTABLE_CTORS
+    return False
+
+
+def _is_jit_call(node: ast.Call) -> str | None:
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id == "instrument_jit":
+        return "instrument_jit"
+    if isinstance(fn, ast.Attribute) and fn.attr == "instrument_jit":
+        return "instrument_jit"
+    if isinstance(fn, ast.Attribute) and fn.attr == "jit" and \
+            isinstance(fn.value, ast.Name) and fn.value.id == "jax":
+        return "jax.jit"
+    return None
+
+
+def _dynamic_string(node) -> bool:
+    """True when the expression builds a string at runtime (f-string,
+    %-format, .format(), +-concat of non-constants)."""
+    if isinstance(node, ast.JoinedStr):
+        return any(isinstance(v, ast.FormattedValue) for v in node.values)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Mod, ast.Add)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "format":
+        return True
+    return False
+
+
+def _static_param_names(node: ast.Call, fn_def) -> list[str]:
+    """Parameter names declared static by this jit call, resolvable against
+    ``fn_def`` (the wrapped function's def in the same module) or directly
+    from static_argnames literals."""
+    names: list[str] = []
+    for kw in node.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    names.append(el.value)
+        elif kw.arg == "static_argnums" and fn_def is not None:
+            idxs = [el.value for el in ast.walk(kw.value)
+                    if isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)]
+            params = [a.arg for a in fn_def.args.args]
+            for i in idxs:
+                if 0 <= i < len(params):
+                    names.append(params[i])
+    return names
+
+
+def run(ctx) -> list[dict]:
+    findings: list[dict] = []
+    for f in ctx.files:
+        if f.tree is None:
+            continue
+        in_pkg = f.rel.startswith("comfyui_parallelanything_tpu/") or \
+            f.rel == "bench.py"
+        if not in_pkg:
+            continue
+        # function defs by name (module-wide), for static-arg resolution
+        # and mutable-default checks.
+        all_defs: list = []
+        defs: dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                all_defs.append(node)
+                defs.setdefault(node.name, node)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _is_jit_call(node)
+            if kind is None:
+                continue
+            # (a) dynamic program name: instrument_jit(fn, <name>).
+            if kind == "instrument_jit" and len(node.args) >= 2 and \
+                    _dynamic_string(node.args[1]):
+                findings.append({
+                    "path": f.rel, "line": node.lineno,
+                    "code": "dynamic-program-name",
+                    "message": "program name built at runtime mints a new "
+                               "program identity per value — unbounded "
+                               "compile/roofline registry cardinality; use "
+                               "a stable literal name",
+                })
+            # (b) unhashable statics: resolve the wrapped fn's def and
+            # check declared-static params for mutable defaults.
+            wrapped = None
+            if node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Name):
+                    wrapped = defs.get(a0.id)
+            statics = _static_param_names(node, wrapped)
+            if wrapped is not None and statics:
+                args = wrapped.args
+                pos = args.posonlyargs + args.args
+                defaults = [None] * (len(pos) - len(args.defaults)) + \
+                    list(args.defaults)
+                kw = dict(zip([a.arg for a in args.kwonlyargs],
+                              args.kw_defaults))
+                for p, d in list(zip([a.arg for a in pos], defaults)) + \
+                        list(kw.items()):
+                    if p in statics and d is not None and _is_mutable(d):
+                        findings.append({
+                            "path": f.rel, "line": d.lineno,
+                            "code": "unhashable-static",
+                            "message": f"param `{p}` is declared static but "
+                                       f"defaults to an unhashable mutable "
+                                       f"— trace-time TypeError (or a "
+                                       f"per-call recompile)",
+                        })
+        # (c) mutable default kwargs in cache-key-feeding modules.
+        if any(f.rel.startswith(d) or f.rel == d for d in CACHE_KEY_DIRS):
+            for fn_def in all_defs:
+                args = fn_def.args
+                for d in list(args.defaults) + \
+                        [x for x in args.kw_defaults if x is not None]:
+                    if _is_mutable(d):
+                        findings.append({
+                            "path": f.rel, "line": d.lineno,
+                            "code": "mutable-default",
+                            "message": f"mutable default in `{fn_def.name}` "
+                                       f"— a shared instance flowing into a "
+                                       f"jit cache key aliases across calls",
+                        })
+    return findings

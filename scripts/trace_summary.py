@@ -23,6 +23,27 @@ import argparse
 import json
 from collections import defaultdict
 
+# The span-category vocabulary (the ``cat`` field of every emitted span) —
+# this tuple is the OWNING REGISTRY: palint's registry-consistency pass
+# fails CI on any span site whose category is missing here, and on any
+# entry no span site uses, so the per-layer table above can never grow a
+# silent `?` row. One entry per layer:
+SPAN_CATEGORIES = (
+    "host",       # utils/tracing.py default — uncategorized host work
+    "server",     # server.py prompt / admission-wait spans
+    "graph",      # host.py workflow-node spans
+    "sampling",   # sampling/runner.py sampler-run + eager step spans
+    "serving",    # serving/bucket.py dispatch/lane/step spans
+    "stream",     # parallel/streaming.py run/prefetch/wait/compute spans
+    "bench",      # bench.py timed-iteration step spans
+    "compile",    # utils/telemetry.py instrument_jit compile spans
+    "fleet",      # fleet/router.py fleet-prompt / fleet-hop spans
+    "numerics",   # utils/numerics.py nonfinite-event / quarantine instants
+    "faults",     # utils/faults.py fault-injected instants
+    "degrade",    # utils/degrade.py degradation-rung instants
+    "profiler",   # utils/tracing.hardware_trace jax.profiler bracket
+)
+
 
 def load_events(path: str) -> list[dict]:
     with open(path) as f:

@@ -35,6 +35,30 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# PA_LOCKCHECK=1 (round 16): install the lock-acquisition-order tracker
+# BEFORE jax/the package import so every module-level threading.Lock() in
+# the package is born tracked. Path-loaded (utils/lockcheck.py is
+# standalone by contract) precisely because importing the package here
+# would create its locks un-tracked.
+_lockcheck = None
+if os.environ.get("PA_LOCKCHECK") == "1":
+    import importlib.util as _ilu
+
+    _lc_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "comfyui_parallelanything_tpu", "utils", "lockcheck.py",
+    )
+    _spec = _ilu.spec_from_file_location("pa_lockcheck_boot", _lc_path)
+    _lockcheck = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_lockcheck)
+    _lockcheck.install()
+    # ONE graph per process: later package imports of utils.lockcheck must
+    # resolve to THIS instance (the installed factories close over its
+    # edge dict), not a second execution of the file.
+    import sys as _sys
+
+    _sys.modules["comfyui_parallelanything_tpu.utils.lockcheck"] = _lockcheck
+
 import jax  # noqa: E402
 
 # This XLA CPU backend executes `default`-precision f32 matmuls at bf16 (matching TPU
@@ -50,6 +74,28 @@ def cpu_devices():
     devs = jax.devices("cpu")
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(autouse=True)
+def _no_lock_order_cycles():
+    """Under PA_LOCKCHECK=1 every test ends with the lock-order graph
+    acyclic — the interleaving-independent deadlock gate (a cycle is an
+    ORDER fact: it fails here even when CI never schedules the deadlock).
+    Attribution is per-test: the graph is cumulative (an edge from test A
+    plus the reverse edge from test B is a real cross-path cycle), so the
+    fixture snapshots the cycles already reported and fails only the test
+    that closed a NEW one — the first offender goes red, not every test
+    after it."""
+    if _lockcheck is None:
+        yield
+        return
+    before = {tuple(c) for c in _lockcheck.cycles()}
+    yield
+    new = [c for c in _lockcheck.cycles() if tuple(c) not in before]
+    assert not new, (
+        "lock-order cycle(s) recorded (potential deadlock): "
+        + "; ".join(" -> ".join(c) for c in new)
+    )
 
 
 @pytest.fixture(autouse=True)

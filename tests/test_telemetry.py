@@ -30,7 +30,6 @@ import re
 import subprocess
 import sys
 import threading
-import time
 from pathlib import Path
 
 import jax
@@ -524,85 +523,24 @@ class TestHealthEndpoint:
         assert re.search(r"^pa_hbm_bytes_in_use\{", text, re.M)
 
 
-# The explicit allowlist for the static-analysis guard: (path suffix,
-# required substring). Everything else in the package must route through
-# the span/log/metric vocabulary (utils/{tracing,logging,metrics}.py) —
-# adding a print()/time.time() site means adding a line HERE, which is the
-# review speed bump this guard exists to create. scripts/ and tests/ are
-# exempt (CLI surfaces by design).
-_PRINT_ALLOWLIST = (
-    ("host.py", "usage: python -m"),          # __main__ CLI usage line
-    ("host.py", "{nid}:"),                    # __main__ CLI result echo
-    ("server.py", "workflow server on"),      # server startup banner
-    ("fleet/router.py", "fleet {role} on"),   # router startup banner
-)
-_TIME_TIME_ALLOWLIST = (
-    # Wall-clock epoch STAMPS (ledger ts, health ts, error ts) — not timing;
-    # durations in the package use time.monotonic()/perf_counter().
-    ("utils/telemetry.py", 'setdefault("ts"'),
-    ("utils/telemetry.py", '"ts": time.time()'),
-    # Numerics sentinel event/quarantine records (round 11): epoch stamps on
-    # forensic records, same pattern as the telemetry ledger stamps.
-    ("utils/numerics.py", '"ts": time.time()'),
-    # Roofline calibration bank (round 13): epoch stamp on the persisted
-    # store, same pattern as the ledger/golden banks.
-    ("utils/roofline.py", '"ts": time.time()'),
-    # Prompt journal + lease (round 14): wall-clock is the ONE clock two
-    # router processes share — record stamps and lease-age math must use it
-    # (monotonic clocks are process-local and incomparable across a
-    # failover pair).
-    ("fleet/journal.py", '"ts": time.time()'),
-    ("fleet/journal.py", "age = time.time()"),
-    # Warm-key recency stamps (pa-health/v3): epoch stamps on an advertised
-    # surface, same pattern as the health ts.
-    ("server.py", "warm_keys[key] = time.time()"),
-)
-
-
 class TestObservabilityLint:
-    def _package_files(self):
-        pkg = REPO / "comfyui_parallelanything_tpu"
-        return sorted(p for p in pkg.rglob("*.py")
-                      if "__pycache__" not in p.parts)
+    """Round 16: the static-analysis guard moved into scripts/palint.py
+    (ONE lint engine — six passes, this file's old print/time.time checks
+    among them as the `observability` pass). The central allowlists became
+    per-line `# palint: allow[observability] <why>` pragmas next to the
+    code, with the engine enforcing the staleness discipline the old
+    `test_allowlist_entries_still_exist` carried (a pragma that suppresses
+    nothing, or has no justification, is itself a finding). This test is
+    the thin subprocess gate; tests/test_palint.py covers the passes."""
 
-    def _allowed(self, path, line, allowlist):
-        rel = str(path)
-        return any(rel.endswith(suffix) and marker in line
-                   for suffix, marker in allowlist)
-
-    def test_no_bare_print_outside_allowlist(self):
-        offenders = []
-        for path in self._package_files():
-            for i, line in enumerate(path.read_text().splitlines(), 1):
-                if re.match(r"^\s*print\(", line) and not self._allowed(
-                        path, line, _PRINT_ALLOWLIST):
-                    offenders.append(f"{path}:{i}: {line.strip()}")
-        assert not offenders, (
-            "bare print() in the package — use utils/logging (or add an "
-            "explicit allowlist entry in test_telemetry.py):\n"
-            + "\n".join(offenders)
+    def test_palint_check_green(self, tmp_path):
+        env = dict(os.environ, PA_LEDGER_DIR=str(tmp_path))
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "palint.py"), "--check"],
+            capture_output=True, text=True, env=env, timeout=120,
         )
-
-    def test_no_ad_hoc_time_time_outside_allowlist(self):
-        offenders = []
-        for path in self._package_files():
-            for i, line in enumerate(path.read_text().splitlines(), 1):
-                if "time.time(" in line and not self._allowed(
-                        path, line, _TIME_TIME_ALLOWLIST):
-                    offenders.append(f"{path}:{i}: {line.strip()}")
-        assert not offenders, (
-            "ad-hoc time.time() in the package — durations must use "
-            "monotonic clocks (StepTimer/tracing spans); wall-clock stamps "
-            "need an allowlist entry in test_telemetry.py:\n"
-            + "\n".join(offenders)
+        assert proc.returncode == 0, (
+            "palint --check failed — fix the violation or justify it with "
+            "an in-line pragma:\n" + proc.stdout + proc.stderr
         )
-
-    def test_allowlist_entries_still_exist(self):
-        """A stale allowlist is a lint hole: every entry must still match a
-        real line, or it gets removed with the site it covered."""
-        for suffix, marker in _PRINT_ALLOWLIST + _TIME_TIME_ALLOWLIST:
-            matches = [
-                p for p in self._package_files()
-                if str(p).endswith(suffix) and marker in p.read_text()
-            ]
-            assert matches, f"stale allowlist entry: ({suffix!r}, {marker!r})"
