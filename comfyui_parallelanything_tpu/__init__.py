@@ -35,6 +35,7 @@ from .parallel.split import (
     largest_remainder_split,
     weighted_batch_split,
     blend_memory_weights,
+    blend_speed_weights,
     block_ranges,
     batch_size_of,
     split_tree,
@@ -68,6 +69,7 @@ __all__ = [
     "largest_remainder_split",
     "weighted_batch_split",
     "blend_memory_weights",
+    "blend_speed_weights",
     "block_ranges",
     "batch_size_of",
     "split_tree",

@@ -91,6 +91,18 @@ class WorkflowCache:
                 cleanup()
             except Exception:
                 pass
+        # Cross-request embed cache (models/embed_cache.py): an evicted CLIP
+        # wire releases its cached encoder outputs eagerly — the same
+        # eager-teardown discipline this cache applies to models, extended
+        # to the content-addressed layer underneath it. Identity-safe: the
+        # keep_ids check above this call site already proved no surviving
+        # entry shares the wire, and owner tokens are lifetime-unique.
+        try:
+            from .models.embed_cache import release_wire
+
+            release_wire(value)
+        except Exception:
+            pass
 
     def evict_stale(self, stale) -> None:
         """Drop every cached entry in ``stale``. A value is torn down only when
@@ -397,6 +409,14 @@ def run_workflow(
                 elif "Sampler" in ct:
                     slo.observe_stage(
                         "eval", _time.monotonic() - t0_node
+                    )
+                elif "TextEncode" in ct:
+                    # The ENCODE stage (round 17): text-encode node wall —
+                    # the stage the content-addressed embed cache collapses
+                    # (a hit is a dict lookup; the stage histogram is where
+                    # that collapse becomes visible next to eval).
+                    slo.observe_stage(
+                        "encode", _time.monotonic() - t0_node
                     )
         except (WorkflowError, Interrupted):
             raise

@@ -1,0 +1,276 @@
+"""Content-addressed text-encoder output cache — cross-request compute reuse.
+
+Production diffusion traffic is massively redundant: the same prompts, the
+same negative prompts, and N-seed fanouts of one prompt dominate real queues,
+yet every request pays a full text-encode unless something remembers the
+answer. The reference's only memoization is ComfyUI's node-output cache —
+node-id-scoped, latest-signature-only (``host.WorkflowCache`` mirrors it), so
+alternating prompts A,B,A,B re-encode every time. This cache is the
+cross-request layer underneath it:
+
+- **content-addressed**: entries are keyed by (model key, tower type, token
+  ids, mask) through the same md5 ``stable_hash`` discipline as
+  ``fleet/registry.py`` — process-independent, node-id-independent. The
+  model key is the loader's content stamp (checkpoint path + tower) when one
+  exists, else a per-encoder-object lifetime token (``encoder_token``) —
+  unique for the object's lifetime, so a torn-down encoder's entries can
+  never serve a successor's lookups.
+- **LRU-bounded in bytes** (``PA_EMBED_CACHE_BYTES``, default 256 MiB;
+  ``0`` disables caching entirely — every encode computes): embeds are small
+  (a CLIP context is ~230 KB) but a zipf tail is long; the bound holds under
+  churn with evictions counted.
+- **concurrency-safe per the ``host.WorkflowCache`` snapshot/merge pattern**:
+  lookups and inserts are lock-scoped; when two workers race the same miss,
+  the first ``put`` wins and the loser's duplicate is returned to its caller
+  un-cached (never torn down — the caller still holds it) exactly like
+  ``WorkflowCache.merge``'s incumbent rule.
+- **metered**: ``pa_embed_cache_{hits,misses,bytes,evictions}`` gauges plus
+  the ``pa_encoder_invocations_total`` counter (every *real* encoder program
+  run, cache enabled or not) — the pair ``scripts/loadgen.py`` diffs into
+  ``embed_cache_hit_rate`` / ``encoder_invocations``.
+
+Hits return the cached device arrays THEMSELVES (no copy): cached-vs-fresh
+is bitwise-equal by construction, and downstream consumers see one shared
+cond object — which is exactly what lets the serving tier seat sibling-seed
+lanes against ONE broadcast cond tensor (serving/bucket.py shared-cond mode).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import uuid
+from collections import OrderedDict
+
+import numpy as np
+
+from ..utils.metrics import registry
+
+DEFAULT_BYTES = 256 * 1024 * 1024
+
+
+def cache_budget_bytes() -> int:
+    """The byte bound from ``PA_EMBED_CACHE_BYTES`` (0 disables)."""
+    try:
+        return int(os.environ.get("PA_EMBED_CACHE_BYTES", DEFAULT_BYTES))
+    except ValueError:
+        return DEFAULT_BYTES
+
+
+def lifetime_token(obj, attr: str = "_pa_embed_token") -> str:
+    """A lifetime-unique token for one object. Unlike ``id()``, a token is
+    never reused after the object dies, so keys derived from it can only
+    ever miss, never alias. Works on frozen dataclasses (TextEncoder, VAE)
+    via the same ``object.__setattr__`` side-channel their jit caches use.
+    Shared by this cache's encoder fallback keys and the decode queue's
+    VAE group keys (serving/decode.py)."""
+    tok = getattr(obj, attr, None)
+    if tok is None:
+        tok = uuid.uuid4().hex
+        object.__setattr__(obj, attr, tok)
+    return tok
+
+
+def encoder_token(enc) -> str:
+    """The model-key fallback when no loader content stamp exists."""
+    return lifetime_token(enc, "_pa_embed_token")
+
+
+def file_stamp(path: str) -> tuple:
+    """(path, size, mtime_ns) — the content identity loader stamps fold
+    into model keys, so replacing a checkpoint file IN PLACE changes the
+    key (a path string alone would serve the old file's embeds). Missing
+    or unstattable paths degrade to the bare path (in-memory towers)."""
+    try:
+        st = os.stat(path)
+        return (path, st.st_size, st.st_mtime_ns)
+    except OSError:
+        return (path, None, None)
+
+
+def stable_key(model_key: str, tower: str, ids, mask=None) -> str:
+    """md5 content address over (model key, tower, token ids, mask) — the
+    ``fleet/registry.stable_hash`` discipline (``hash()`` is salted per
+    process; a content address must not be). Keying on the token IDS (not
+    the raw text) folds the tokenizer tables and max_len in for free."""
+    h = hashlib.md5()
+    h.update(str(model_key).encode())
+    h.update(b"\x00" + str(tower).encode() + b"\x00")
+    h.update(np.ascontiguousarray(np.asarray(ids, np.int32)).tobytes())
+    h.update(b"\x00")
+    if mask is not None:
+        h.update(np.ascontiguousarray(np.asarray(mask, np.int32)).tobytes())
+    return h.hexdigest()
+
+
+def _value_bytes(value) -> int:
+    """Total device-array bytes of a cached value (a single array or a tuple
+    of arrays / Nones — the encoder output shapes)."""
+    leaves = value if isinstance(value, (tuple, list)) else (value,)
+    return sum(int(getattr(l, "nbytes", 0) or 0) for l in leaves if l is not None)
+
+
+class EmbedCache:
+    """Byte-bounded LRU of encoder outputs, with per-owner release so a
+    torn-down encoder (WorkflowCache eviction) frees its embeds eagerly
+    instead of waiting for LRU churn."""
+
+    def __init__(self, max_bytes: int | None = None):
+        self._max_bytes = max_bytes
+        self._lock = threading.Lock()
+        # key -> (value, nbytes, owner_token) in LRU order (oldest first).
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()  # guarded-by: _lock
+        self._owners: dict[str, set[str]] = {}  # guarded-by: _lock
+        self._bytes = 0      # guarded-by: _lock
+        self._hits = 0       # guarded-by: _lock
+        self._misses = 0     # guarded-by: _lock
+        self._evictions = 0  # guarded-by: _lock
+
+    def budget(self) -> int:
+        return self._max_bytes if self._max_bytes is not None \
+            else cache_budget_bytes()
+
+    def enabled(self) -> bool:
+        return self.budget() > 0
+
+    def get(self, key: str):
+        """The cached value (moved to MRU) or None; hit/miss accounted."""
+        if not self.enabled():
+            return None
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self._misses += 1
+            else:
+                self._entries.move_to_end(key)
+                self._hits += 1
+        return entry[0] if entry is not None else None
+
+    def put(self, key: str, value, owner: str | None = None):
+        """Insert under the merge discipline: an incumbent wins and is
+        returned (the caller's duplicate stays caller-owned, never cached —
+        a racing double-encode costs the race loser its own compute, not a
+        teardown). Inserting evicts LRU entries until the byte bound holds;
+        a value larger than the whole budget is returned un-cached."""
+        if not self.enabled():
+            return value
+        nbytes = _value_bytes(value)
+        with self._lock:
+            incumbent = self._entries.get(key)
+            if incumbent is not None:
+                self._entries.move_to_end(key)
+                return incumbent[0]
+            if nbytes > self.budget():
+                return value
+            self._entries[key] = (value, nbytes, owner)
+            self._bytes += nbytes
+            if owner is not None:
+                self._owners.setdefault(owner, set()).add(key)
+            while self._bytes > self.budget() and len(self._entries) > 1:
+                self._evict_oldest()
+        return value
+
+    def _evict_oldest(self) -> None:  # palint: holds _lock
+        old_key, (_, old_bytes, old_owner) = self._entries.popitem(last=False)
+        self._bytes -= old_bytes
+        self._evictions += 1
+        if old_owner is not None:
+            keys = self._owners.get(old_owner)
+            if keys is not None:
+                keys.discard(old_key)
+                if not keys:
+                    self._owners.pop(old_owner, None)
+
+    def release_owner(self, owner: str) -> int:
+        """Drop every entry an owner token holds — the WorkflowCache
+        teardown hook (host.py): an evicted CLIP wire's embeds free their
+        bytes NOW, the same eager-teardown discipline the node cache applies
+        to models. Returns how many entries dropped."""
+        with self._lock:
+            keys = self._owners.pop(owner, None)
+            if not keys:
+                return 0
+            n = 0
+            for key in keys:
+                entry = self._entries.pop(key, None)
+                if entry is not None:
+                    self._bytes -= entry[1]
+                    n += 1
+        return n
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._owners.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        """The /health ``reuse.embed_cache`` section (and test read side)."""
+        with self._lock:
+            return {
+                "enabled": self.enabled(),
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "budget_bytes": self.budget(),
+                "hits": self._hits,
+                "misses": self._misses,
+                "evictions": self._evictions,
+            }
+
+    def publish_gauges(self) -> None:
+        """The pa_embed_cache_* gauges (monotonic totals except bytes —
+        loadgen diffs them like counters). Called at /metrics SCRAPE time
+        (server.py), the only moment the gauge values are read — the hot
+        encode path never pays the registry lock per lookup."""
+        with self._lock:
+            hits, misses = self._hits, self._misses
+            nbytes, evictions = self._bytes, self._evictions
+        registry.gauge("pa_embed_cache_hits", hits,
+                       help="embed-cache lookups served without an encode")
+        registry.gauge("pa_embed_cache_misses", misses,
+                       help="embed-cache lookups that paid an encode")
+        registry.gauge("pa_embed_cache_bytes", nbytes,
+                       help="bytes of cached encoder outputs (LRU-bounded "
+                            "by PA_EMBED_CACHE_BYTES)")
+        registry.gauge("pa_embed_cache_evictions", evictions,
+                       help="entries evicted to hold the byte bound")
+
+
+# The process-wide cache every encode site consults. Tests may clear() it.
+cache = EmbedCache()
+
+
+def cached_encode(enc, model_key: str | None, tower: str, ids, mask, compute):
+    """The ONE encode seam: look up (model key, tower, ids, mask); on a miss
+    run ``compute()`` (the real encoder program — counted in
+    ``pa_encoder_invocations_total`` whether or not caching is on) and bank
+    it under the merge discipline. ``model_key`` None falls back to the
+    per-object lifetime token."""
+    owner = encoder_token(enc)
+    key = stable_key(model_key or owner, tower, ids, mask)
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    registry.counter("pa_encoder_invocations_total",
+                     help="real text-encoder program runs (cache misses + "
+                          "uncached encodes)")
+    value = compute()
+    return cache.put(key, value, owner=owner)
+
+
+def release_wire(value) -> None:
+    """Release the embeds of every encoder reachable inside a node-cache
+    value (a CLIP wire dict, possibly nesting l/g/t5 sub-wires) — called by
+    ``host.WorkflowCache`` when it evicts an entry. Best-effort and
+    identity-safe: tokens are lifetime-unique, so releasing can only free
+    memory, never corrupt a lookup."""
+    if not isinstance(value, dict):
+        return
+    enc = value.get("encoder")
+    if enc is not None:
+        tok = getattr(enc, "_pa_embed_token", None)
+        if tok is not None:
+            cache.release_owner(tok)
+    for sub in ("l", "g", "t5"):
+        release_wire(value.get(sub))

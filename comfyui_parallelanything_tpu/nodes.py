@@ -616,7 +616,21 @@ class TPUCLIPLoader:
                     "CLIP loading needs tokenizer_json OR both vocab_path and "
                     "merges_path"
                 )
-        return ({"encoder": enc, "tokenizer": tok, "type": encoder_type},)
+        # Content stamp for the cross-request embed cache: a stable model
+        # key (file identity — path + size + mtime, so an in-place file
+        # replacement changes the key — plus tower config) so two loads of
+        # one checkpoint share cache entries across prompts and restarts
+        # of the wire.
+        import hashlib as _hashlib
+
+        from .models.embed_cache import file_stamp
+
+        model_key = _hashlib.md5(repr(
+            [file_stamp(encoder_path), encoder_type, max_len,
+             vocab_path, merges_path, tokenizer_json],
+        ).encode()).hexdigest()
+        return ({"encoder": enc, "tokenizer": tok, "type": encoder_type,
+                 "model_key": model_key},)
 
 
 class TPUTextEncode:
@@ -751,11 +765,25 @@ class TPUTextEncode:
                 clip.get("tokenizer_error")
                 or "CLIP wire has no encoder/tokenizer"
             )
+        # Cross-request reuse (models/embed_cache.py): encoder outputs are
+        # content-addressed on (model key, tower, token ids) — a hit skips
+        # the encoder program entirely and returns the SAME arrays, so
+        # cached-vs-fresh is bitwise-equal and same-prompt requests share
+        # one cond object (the serving tier's sibling-seed broadcast seam).
+        from .models import embed_cache
+
         ids, mask = tok([text])
         if clip["type"] in ("t5", "umt5"):
-            context = enc(jnp.asarray(ids, jnp.int32), mask=jnp.asarray(mask))
+            context = embed_cache.cached_encode(
+                enc, clip.get("model_key"), clip["type"], ids, mask,
+                lambda: enc(jnp.asarray(ids, jnp.int32),
+                            mask=jnp.asarray(mask)),
+            )
             return ({"context": context, "pooled": None},)
-        last, penultimate, pooled = enc(jnp.asarray(ids, jnp.int32))
+        last, penultimate, pooled = embed_cache.cached_encode(
+            enc, clip.get("model_key"), clip["type"], ids, None,
+            lambda: enc(jnp.asarray(ids, jnp.int32)),
+        )
         if clip_skip == 1:
             context = last
         elif clip_skip == 2:
@@ -1634,7 +1662,18 @@ class TPUVAEDecode:
 
     def decode(self, vae, latent, tile_size: int = 0):
         from .models.vae import decode_maybe_tiled, vae_output_to_images
+        from .serving.decode import get_decode_queue
 
+        # Batched tail decode (serving/decode.py): when the server installed
+        # a decode queue, eligible latents batch into a shared compiled
+        # decode dispatch instead of serializing inline behind the next
+        # prompt's denoise. Ineligible work (tiled, video, odd rank) falls
+        # through to the inline path unchanged.
+        q = get_decode_queue()
+        if q is not None:
+            ticket = q.submit(vae, latent["samples"], tile_size)
+            if ticket is not None:
+                return (vae_output_to_images(ticket.result()),)
         return (vae_output_to_images(decode_maybe_tiled(vae, latent["samples"], tile_size)),)
 
 

@@ -143,6 +143,23 @@ class CheckpointLoaderSimple:
             return {"encoder": None, "tokenizer": None, "type": "error",
                     "tokenizer_error": msg}
 
+        def stamp(ckpt_path, *parts):
+            """Content model key for the cross-request embed cache
+            (models/embed_cache.py): file identity (path+size+mtime — an
+            in-place checkpoint replacement changes the key) + tower tag.
+            LoRA-baked towers carry user deltas a file-derived key cannot
+            see — they fall back to the cache's per-object lifetime token
+            instead (None here)."""
+            if te_loras:
+                return None
+            import hashlib
+
+            from .models.embed_cache import file_stamp
+
+            return hashlib.md5(
+                repr((file_stamp(ckpt_path),) + parts).encode()
+            ).hexdigest()
+
         try:
             if family in ("sd15", "sd21", "sd21-v", "sd21-unclip"):
                 open_clip = family.startswith("sd21")
@@ -172,6 +189,7 @@ class CheckpointLoaderSimple:
                 )
                 return {
                     "encoder": enc, "tokenizer": tok, "type": "clip",
+                    "model_key": stamp(path, family, "cond_stage_model"),
                     "tokenizer_error": None if tok else _TOKENIZER_HELP,
                 }
             if family == "sdxl-refiner":
@@ -199,6 +217,7 @@ class CheckpointLoaderSimple:
                 tok_g = _clip_tokenizer(max_len=enc_g.cfg.max_len, pad_id=0)
                 return {
                     "encoder": enc_g, "tokenizer": tok_g, "type": "clip",
+                    "model_key": stamp(path, family, "conditioner.0"),
                     "tokenizer_error": None if tok_g else _TOKENIZER_HELP,
                 }
             if family == "sdxl":
@@ -238,8 +257,10 @@ class CheckpointLoaderSimple:
                 return {
                     "type": "sdxl-dual",
                     "l": {"encoder": enc_l, "tokenizer": tok_l, "type": "clip",
+                          "model_key": stamp(path, family, "embedders.0"),
                           "tokenizer_error": err},
                     "g": {"encoder": enc_g, "tokenizer": tok_g, "type": "clip",
+                          "model_key": stamp(path, family, "embedders.1"),
                           "tokenizer_error": err},
                     "tokenizer_error": err,
                 }

@@ -4,6 +4,7 @@ from .split import (
     largest_remainder_split,
     weighted_batch_split,
     blend_memory_weights,
+    blend_speed_weights,
     block_ranges,
     batch_size_of,
     split_tree,
@@ -46,6 +47,7 @@ __all__ = [
     "largest_remainder_split",
     "weighted_batch_split",
     "blend_memory_weights",
+    "blend_speed_weights",
     "block_ranges",
     "batch_size_of",
     "split_tree",

@@ -75,6 +75,7 @@ from .split import (
     pad_leaf as _pad_leaf,
     slice_padded as _slice_padded,
     blend_memory_weights,
+    blend_speed_weights,
     largest_remainder_split,
     normalize_weights,
     partition_kwargs,
@@ -134,6 +135,23 @@ def _pad_tree(tree, batch, padded):
     )
 
 
+def _device_step_times(devices) -> list[float]:
+    """Per-device nominal step time from the roofline platform specs
+    (utils/roofline.nominal_step_time_s) — the speed signal
+    ``blend_speed_weights`` folds into heterogeneous-chain splits. Reads
+    only static spec tables: no device work, no measurement, so it is safe
+    at setup time (the reference re-reads VRAM per step; specs don't move)."""
+    from ..utils import roofline
+
+    return [
+        roofline.nominal_step_time_s(
+            getattr(d, "device_kind", "") or "",
+            getattr(d, "platform", "cpu") or "cpu",
+        )
+        for d in devices
+    ]
+
+
 def _split_inputs(batch, sizes, x, timesteps, context, kwargs):
     """Per-chunk (x, timesteps, context, kwargs) under the shared
     split-or-broadcast contract: a value splits on dim0 iff it carries the
@@ -177,6 +195,13 @@ class ParallelConfig:
 
     workload_split: bool = True
     auto_memory_balance: bool = True
+    # Blend per-platform nominal step time (utils/roofline.py platform
+    # specs) into heterogeneous-chain weights the way free memory is
+    # blended above (round 17, ROADMAP "speed-aware hybrid blending"): a
+    # tpu+cpu chain's split must reflect that the CPU is ~40x SLOWER, not
+    # that it has spare RAM. Homogeneous chains are a structural no-op
+    # (equal specs → equal speed shares → user weights unchanged).
+    auto_speed_balance: bool = True
     purge_cache: bool = True
     purge_models: bool = False
     data_axis: str = AXIS_DATA
@@ -819,14 +844,24 @@ class ParallelModel:
             except Exception as e:  # noqa: BLE001
                 if not _is_resource_exhausted(e):
                     raise
-        if not self.config.auto_memory_balance:
+        if not self.config.auto_memory_balance \
+                and not self.config.auto_speed_balance:
             return self.weights
         user = [w for g in self._groups for w in g.user_weights]
         base = normalize_weights(user)
         if base is None:
             return self.weights
-        free = [free_memory_bytes(d) for g in self._groups for d in g.devices]
-        new = blend_memory_weights(base, free)
+        devs = [d for g in self._groups for d in g.devices]
+        new = base
+        if self.config.auto_memory_balance:
+            free = [free_memory_bytes(d) for d in devs]
+            new = blend_memory_weights(new, free)
+        if self.config.auto_speed_balance:
+            # The SPEED half of the re-blend (round 17): same discipline as
+            # memory — re-blended from the ORIGINAL user weights, platform
+            # specs read fresh (they are static, but the env-var fallback
+            # for tunneled device kinds is not).
+            new = blend_speed_weights(new, _device_step_times(devs))
         i = 0
         for g in self._groups:
             for j in range(len(g.device_weights)):
@@ -949,6 +984,8 @@ def parallelize(
     if config.auto_memory_balance:
         free = [free_memory_bytes(d) for d in devices]
         weights = blend_memory_weights(weights, free)
+    if config.auto_speed_balance:
+        weights = blend_speed_weights(weights, _device_step_times(devices))
 
     # Group consecutive-platform links into homogeneous SPMD sub-programs.
     groups: list[_PlatformGroup] = []

@@ -71,6 +71,40 @@ def blend_memory_weights(
     return norm
 
 
+def blend_speed_weights(
+    user_weights: Sequence[float],
+    step_times_s: Sequence[float],
+    alpha: float = 0.7,
+) -> tuple[float, ...]:
+    """Blend user weights with per-device SPEED shares:
+    ``alpha*user + (1-alpha)*inverse-step-time share`` — the memory blend's
+    twin over the roofline platform specs (``utils/roofline.
+    nominal_step_time_s``), closing the ROADMAP "speed-aware hybrid
+    blending" carry-over: the banked hybrid_sd15 (82.6 s/it) showed a
+    VRAM-only split hands a ~40x-slower CPU link work as if it were an
+    equal peer.
+
+    Homogeneous chains are a NO-OP by construction (all step times equal →
+    user weights returned unchanged), so even SPMD sharding and explicit
+    user splits on same-platform meshes are never perturbed — only
+    heterogeneous chains, where unequal speed is the whole point, shift.
+    Zero/negative times (no spec) also fall back to the user weights."""
+    if len(user_weights) != len(step_times_s):
+        raise ValueError("user_weights and step_times_s must have equal length")
+    times = [float(t) for t in step_times_s]
+    if not times or min(times) <= 0.0 or max(times) == min(times):
+        return tuple(float(w) for w in user_weights)
+    inv = [1.0 / t for t in times]
+    total = sum(inv)
+    blended = [
+        alpha * float(w) + (1.0 - alpha) * (s / total)
+        for w, s in zip(user_weights, inv)
+    ]
+    norm = normalize_weights(blended)
+    assert norm is not None  # alpha > 0 and sum(user) == 1
+    return norm
+
+
 # --------------------------------------------------------------------------------------
 # Integer apportionment
 # --------------------------------------------------------------------------------------

@@ -1044,7 +1044,7 @@ def compiled_flow_sample(
 
 def lane_step_program(
     spec: TraceSpec, *, prediction: str, use_cfg: bool, cfg_rescale: float,
-    static_kwargs: dict, emit_stats: bool = False,
+    static_kwargs: dict, emit_stats: bool = False, broadcast_cond: bool = False,
 ):
     """The jitted per-step program for one serving bucket (W = lane width,
     b = per-request batch):
@@ -1071,9 +1071,20 @@ def lane_step_program(
     max|x'|/mean/rms) and per-lane bf16 digests ``[W]`` — computed on-device
     inside the same dispatch, and keeps ``xe`` UNdonated so the quarantine
     path can re-run the failing eval input through the model's PipelineSpec
-    stages after the fact."""
+    stages after the fact.
+
+    ``broadcast_cond`` (round 17, sibling-seed cond sharing): ``context`` /
+    ``uncond_context`` arrive as ONE per-request tensor ``[b, L, D]``
+    referenced by every lane — broadcast over the lane axis inside the
+    program instead of stacked per-lane on the host. An N-seed fanout of one
+    prompt then costs one cond tensor in HBM (not W copies) and zero
+    per-lane cond transfers at seat time. Bit-discipline: the broadcast
+    materializes the IDENTICAL ``[n, L, D]`` values the stacked path
+    reshapes to, so everything downstream of the flatten is the same
+    program graph on the same values (tests pin broadcast-vs-stacked
+    equality bitwise on CPU)."""
     meta = ("serve", prediction, bool(use_cfg), float(cfg_rescale),
-            bool(emit_stats))
+            bool(emit_stats), bool(broadcast_cond))
     apply_fn, mesh, axis = spec.apply, spec.mesh, spec.data_axis
 
     def build(bound_static):
@@ -1093,6 +1104,18 @@ def lane_step_program(
                 return v.reshape(v.shape + (1,) * (ndim - 1))
 
             lane = lambda v: jnp.repeat(v, b, total_repeat_length=n)  # noqa: E731
+            if broadcast_cond:
+                # Shared-cond lanes: one [b, ...] tensor broadcast to the
+                # [W, b, ...] stack the flatten below expects — same values,
+                # same downstream graph as the stacked variant.
+                if context is not None:
+                    context = jnp.broadcast_to(
+                        context[None], (W,) + context.shape
+                    )
+                if uncond_context is not None:
+                    uncond_context = jnp.broadcast_to(
+                        uncond_context[None], (W,) + uncond_context.shape
+                    )
             flat = xe.reshape((n,) + xe.shape[2:])
             s = jnp.where(active > 0, sigma_eval, jnp.float32(1.0))
             s_flat = lane(s)

@@ -303,11 +303,16 @@ class PromptQueue:
         # 0 means unbounded on BOTH spellings (param/CLI and env var).
         self.max_pending = max_pending or None
         self.scheduler = None
+        self.decode_queue = None
         enable_serving = self.workers > 1 if serving is None else serving
         if enable_serving:
-            from .serving import ContinuousBatchingScheduler
+            from .serving import ContinuousBatchingScheduler, DecodeQueue
 
             self.scheduler = ContinuousBatchingScheduler().install()
+            # Batched tail decode (serving/decode.py): concurrent prompts'
+            # VAE decodes batch into shared compiled dispatches instead of
+            # serializing inline behind each other's denoise.
+            self.decode_queue = DecodeQueue().install()
         # Periodic HBM sampling (utils/telemetry.py): keeps the pa_hbm_*
         # gauges and the peak watermark fresh between /metrics scrapes so
         # GET /health reflects memory state even while a prompt is wedged.
@@ -558,6 +563,8 @@ class PromptQueue:
         if self.scheduler is not None:
             self.scheduler.uninstall()
             self.scheduler.shutdown()
+        if self.decode_queue is not None:
+            self.decode_queue.shutdown()
 
     def _run(self) -> None:
         while True:
@@ -870,6 +877,15 @@ class _Handler(BaseHTTPRequestHandler):
                 # objective verdicts published at scrape time — the
                 # histograms carry lifetime counts, the gauges the window.
                 slo.registry.publish_gauges()
+            except Exception:
+                pass
+            try:
+                # pa_embed_cache_* gauges (models/embed_cache.py): published
+                # at scrape time so a fresh server exposes explicit zeros —
+                # loadgen diffs them into embed_cache_hit_rate.
+                from .models.embed_cache import cache as _embed_cache
+
+                _embed_cache.publish_gauges()
             except Exception:
                 pass
             return self._send(

@@ -9,6 +9,7 @@ sampling/lane_specs.py for the per-lane step-program family.
 """
 
 from .bucket import ServeRequest, StepBucket, batched_fraction
+from .decode import DecodeQueue, DecodeTicket, get_decode_queue
 from .policy import AdmissionQueue, DeadlineExceeded, ServingRejected
 from .scheduler import (
     BATCHABLE_SAMPLERS,
@@ -22,10 +23,13 @@ __all__ = [
     "BATCHABLE_SAMPLERS",
     "ContinuousBatchingScheduler",
     "DeadlineExceeded",
+    "DecodeQueue",
+    "DecodeTicket",
     "ServeRequest",
     "ServingRejected",
     "StepBucket",
     "batched_fraction",
+    "get_decode_queue",
     "get_scheduler",
     "serving_hints",
 ]

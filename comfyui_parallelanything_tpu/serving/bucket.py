@@ -24,6 +24,14 @@ sampler's step):
   keys are precomputed per request at seat time, so noise is a pure
   function of (request, step) and output is bit-identical alone vs
   co-batched (the occupancy-determinism contract);
+- sibling-seed cond sharing (round 17): a fresh cond epoch runs SHARED —
+  every lane references ONE cond tensor broadcast on the lane axis inside
+  the program (``lane_step_program(broadcast_cond=True)``) instead of
+  stacked per lane, so an N-seed fanout of one prompt (whose requests
+  alias one cond object via the embed cache) costs one cond in HBM and
+  ceil(N/width) dispatches per eval; the first foreign cond demotes to
+  stacked rows (a mode change, never a value change — siblings' rows
+  refill from the shared ref), and an idle release resets the epoch;
 - numerics quarantine (round 11, utils/numerics.py): with the sentinel on,
   every dispatch also emits per-lane non-finite counts and bf16 latent
   digests as on-device aux outputs; a lane whose state goes NaN/Inf is
@@ -238,6 +246,7 @@ class StepBucket:
         self.lanes: list[_Lane | None] = [None] * self.width
         self.dispatch_count = 0
         self._program = None
+        self._prog_kw = None
         # Sentinel state captured at program build (the stats/digest aux
         # outputs are part of the compiled signature); width-1 eager mode
         # reads numerics.on() live instead.
@@ -254,6 +263,21 @@ class StepBucket:
         self._uctx = None
         self._kw = None
         self._ukw = None
+        # Sibling-seed cond sharing (round 17): a fresh cond epoch starts
+        # in "shared" mode — every lane references ONE cond tensor,
+        # broadcast over the lane axis inside the program
+        # (sampling/compiled.py broadcast_cond) instead of stacked per
+        # lane, so an N-seed fanout of one prompt costs one cond in HBM.
+        # The first seat whose cond is a DIFFERENT object demotes the
+        # bucket to "stacked" (per-lane rows) until the state releases.
+        # Identity is the sharing signal: the embed cache returns one
+        # object per (model, text), so same-prompt requests alias by
+        # construction.
+        self._cond_mode = None        # "shared" | "stacked"
+        self._ctx_ref = None          # identity refs (original objects)
+        self._uctx_ref = None
+        self._ctx_dev = None          # placed shared copies (mesh: replicated)
+        self._uctx_dev = None
         self._jnp = jnp
         self._model_sigmas = model_sigmas
         self._default_schedule = scaled_linear_schedule
@@ -271,9 +295,15 @@ class StepBucket:
         """Drop the stacked device arrays while idle — an idle serving layer
         must not pin width×batch latents/contexts in device memory between
         bursts. Rebuilt by ``_ensure_state`` on the next admission (the
-        compiled step program itself stays in the bounded loop-jit cache)."""
+        compiled step program itself stays in the bounded loop-jit cache).
+        Also resets the cond mode: the next burst re-enters shared-cond
+        from scratch."""
         self._x = self._xe = self._h1 = self._h2 = None
         self._ctx = self._uctx = self._kw = self._ukw = None
+        self._cond_mode = None
+        self._ctx_ref = self._uctx_ref = None
+        self._ctx_dev = self._uctx_dev = None
+        self._program = None
 
     def _gauges(self) -> None:
         registry.gauge("pa_serving_occupancy", len(self.active_lanes()),
@@ -313,29 +343,99 @@ class StepBucket:
         self._xe = self._zeros_stack(req.x)
         self._h1 = self._zeros_stack(req.x)
         self._h2 = self._zeros_stack(req.x)
-        self._ctx = (
-            None if req.context is None else self._zeros_stack(req.context)
-        )
-        self._uctx = (
-            None if req.uncond_context is None
-            else self._zeros_stack(req.uncond_context)
-        )
         self._kw = self._zeros_stack(req.traced_kwargs) if req.traced_kwargs else None
         self._ukw = self._zeros_stack(req.u_traced) if req.u_traced else None
         if req.prediction != "flow":
             acp = req.acp if req.acp is not None else self._default_schedule()
             self._log_sigmas = self._jnp.log(self._model_sigmas(acp))
-        from ..sampling.compiled import lane_step_program
-
+        # Program meta (bucket-key constants) banked once; the program
+        # itself builds lazily per cond mode (_ensure_program) — a
+        # shared→stacked demotion swaps the broadcast_cond variant, and
+        # both live in the bounded loop-jit cache.
         self._emit_stats = numerics.on()
-        self._program = lane_step_program(
-            self.spec,
+        self._prog_kw = dict(
             prediction=req.prediction,
             use_cfg=req.uncond_context is not None and req.cfg_scale != 1.0,
             cfg_rescale=req.cfg_rescale,
             static_kwargs=req.static_kwargs,
-            emit_stats=self._emit_stats,
         )
+
+    def _ensure_program(self) -> None:
+        if self._program is not None or self.spec is None:
+            return
+        from ..sampling.compiled import lane_step_program
+
+        self._program = lane_step_program(
+            self.spec,
+            emit_stats=self._emit_stats,
+            broadcast_cond=self._cond_mode == "shared",
+            **self._prog_kw,
+        )
+
+    def _place_shared(self, arr):
+        """The shared cond tensor as the program input: replicated over the
+        mesh when the bucket runs on one (the lane-axis sharding belongs to
+        the state stacks; the broadcast happens inside the program)."""
+        if arr is None:
+            return None
+        if self.spec is not None and self.spec.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.device_put(arr, NamedSharding(self.spec.mesh, P()))
+        return arr
+
+    def _seat_cond(self, i: int, req: ServeRequest) -> None:
+        """Seat lane ``i``'s conditioning. Fresh epochs (no other live lane)
+        enter SHARED mode: the request's cond objects become the bucket's
+        refs and every sibling whose cond is the SAME object (the embed
+        cache's same-prompt aliasing) rides the broadcast program. The
+        first foreign cond demotes to STACKED per-lane rows — re-filling
+        the seated siblings' rows from the shared refs, so demotion is a
+        mode change, never a value change."""
+        others = [j for j in self.active_lanes() if j != i]
+        if not others:
+            self._cond_mode = "shared"
+            self._ctx_ref = req.context
+            self._uctx_ref = req.uncond_context
+            self._ctx_dev = self._place_shared(req.context)
+            self._uctx_dev = self._place_shared(req.uncond_context)
+            self._ctx = self._uctx = None
+            self._program = None
+            return
+        if self._cond_mode == "shared":
+            if req.context is self._ctx_ref \
+                    and req.uncond_context is self._uctx_ref:
+                registry.counter(
+                    "pa_serving_shared_cond_seats_total",
+                    labels=self._labels,
+                    help="lanes seated against an already-shared cond "
+                         "tensor (sibling-seed reuse)",
+                )
+                return
+            self._cond_mode = "stacked"
+            self._ctx = (
+                None if self._ctx_ref is None
+                else self._zeros_stack(self._ctx_ref)
+            )
+            self._uctx = (
+                None if self._uctx_ref is None
+                else self._zeros_stack(self._uctx_ref)
+            )
+            for j in others:
+                if self._ctx is not None:
+                    self._ctx = self._ctx.at[j].set(self.lanes[j].req.context)
+                if self._uctx is not None:
+                    self._uctx = self._uctx.at[j].set(
+                        self.lanes[j].req.uncond_context
+                    )
+            self._ctx_ref = self._uctx_ref = None
+            self._ctx_dev = self._uctx_dev = None
+            self._program = None
+        if self._ctx is not None:
+            self._ctx = self._ctx.at[i].set(req.context)
+        if self._uctx is not None:
+            self._uctx = self._uctx.at[i].set(req.uncond_context)
 
     def _set_lane(self, i: int, req: ServeRequest) -> None:
         import jax
@@ -359,10 +459,7 @@ class StepBucket:
             self._xe = self._xe.at[i].set(req.x)
             self._h1 = self._h1.at[i].set(0.0)
             self._h2 = self._h2.at[i].set(0.0)
-            if self._ctx is not None:
-                self._ctx = self._ctx.at[i].set(req.context)
-            if self._uctx is not None:
-                self._uctx = self._uctx.at[i].set(req.uncond_context)
+            self._seat_cond(i, req)
             if self._kw is not None:
                 self._kw = jax.tree.map(
                     lambda stack, v: stack.at[i].set(v),
@@ -584,7 +681,8 @@ class StepBucket:
         quarantine_src = None
         stats_dev = None      # program mode: deferred (st, dg, xe_of) refs
         eager_stats = None    # eager mode: deferred xe-inputs map
-        if self._program is not None:
+        if self.spec is not None:
+            self._ensure_program()
             sig = np.ones((self.width,), np.float32)
             act = np.zeros((self.width,), np.float32)
             cfg = np.ones((self.width,), np.float32)
@@ -617,11 +715,20 @@ class StepBucket:
                 # emit mode keeps xe UNdonated (lane_step_program) so the
                 # failing eval input survives for the per-block bisection.
                 xe_prev = self._xe
+            shared = self._cond_mode == "shared"
+            ctx_arg = self._ctx_dev if shared else self._ctx
+            uctx_arg = self._uctx_dev if shared else self._uctx
+            if shared:
+                registry.counter(
+                    "pa_serving_cond_broadcast_total", labels=self._labels,
+                    help="dispatches whose cond rode the lane axis as ONE "
+                         "broadcast tensor (sibling-seed sharing)",
+                )
             outs = self._program(
                 self.spec.params, self._x, self._xe, self._h1, self._h2,
                 jnp.asarray(sig), jnp.asarray(act), jnp.asarray(cfg),
                 jnp.asarray(coef), jnp.asarray(keys),
-                self._ctx, self._uctx, self._kw, self._ukw, self._log_sigmas,
+                ctx_arg, uctx_arg, self._kw, self._ukw, self._log_sigmas,
             )
             if self._emit_stats:
                 (self._x, self._xe, self._h1, self._h2, st_dev, dg_dev) = outs
@@ -772,7 +879,7 @@ class StepBucket:
                         pass
             if lane.done():
                 result = (
-                    self._x[i] if self._program is not None else lane.x_eager
+                    self._x[i] if self.spec is not None else lane.x_eager
                 )
                 self._retire(i, result=result)
         self._gauges()

@@ -16,7 +16,11 @@ prompt workers) and the buckets:
   sampler, which rides per-lane (round 10) — and
   routed to the matching bucket, created on first sight with a width the
   model itself bounds (``ParallelModel.serving_bucket_width`` — stream-mode
-  chains stay width-1, mesh chains round to the data-axis width);
+  chains stay width-1, mesh chains round to the data-axis width); within a
+  bucket, requests aliasing ONE cond object (same-prompt siblings via the
+  embed cache) seat against a shared broadcast cond tensor (round 17,
+  serving/bucket.py shared-cond mode; ``reuse_stats()`` surfaces the
+  per-bucket mode on /health);
 - **policy**: FIFO-within-priority admission with bounded depth
   (serving/policy.py), per-request deadline, cancel — wired to the per-thread
   cooperative interrupt scope (utils/progress.py), so a prompt's Cancel frees
@@ -336,6 +340,20 @@ class ContinuousBatchingScheduler:
     def total_dispatches(self) -> int:
         with self._lock:
             return sum(b.dispatch_count for b in self.buckets.values())
+
+    def reuse_stats(self) -> dict:
+        """Sibling-seed cond sharing view (round 17) — the /health
+        ``reuse.serving`` section: how many occupied buckets currently run
+        the shared-cond broadcast program vs stacked per-lane rows (the
+        seat/dispatch totals live on the labeled
+        ``pa_serving_{shared_cond_seats,cond_broadcast}_total`` counters)."""
+        with self._lock:
+            buckets = list(self.buckets.values())
+        modes = [b._cond_mode for b in buckets if b.active_lanes()]
+        return {
+            "buckets_shared_cond": sum(1 for m in modes if m == "shared"),
+            "buckets_stacked_cond": sum(1 for m in modes if m == "stacked"),
+        }
 
     def _has_work(self) -> bool:
         return any(not b.idle() for b in self.buckets.values())

@@ -40,6 +40,14 @@ stage histograms), ``pa_roofline_*`` (utils/roofline.py + fleet/twin.py —
 per-program predicted seconds, twin capacity source), ``pa_fault_injected_total{site=}``
 (utils/faults.py — chaos attribution), and ``pa_degradation_total{rung=}``
 (utils/degrade.py — ladder rungs taken).
+
+Cross-request compute reuse (round 17): ``pa_embed_cache_*``
+(models/embed_cache.py — content-addressed encoder-output cache hit/miss/
+byte/eviction gauges, published at /metrics scrape), ``pa_encoder_*``
+(the ``pa_encoder_invocations_total`` counter — real encoder program runs,
+the loadgen ``encoder_invocations`` delta), and ``pa_decode_*``
+(serving/decode.py — batched tail decode: dispatch/request counters,
+queue-depth and batched-fraction gauges, wait/step histograms).
 """
 
 from __future__ import annotations

@@ -118,6 +118,28 @@ def platform_spec(device_kind: str = "", platform: str = "cpu") -> dict:
     return {**CPU_SPEC, "platform": platform}
 
 
+# The speed-blend's reference workload (ROADMAP "speed-aware hybrid
+# blending"): roughly one sd15 batch-16 1024² denoise step — the absolute
+# numbers cancel in the share normalization, but the flops:bytes ratio
+# decides which wall (compute vs memory) each platform's nominal time sits
+# against, so it is pinned here rather than left to callers.
+NOMINAL_STEP_FLOPS = 2e12
+NOMINAL_STEP_BYTES = 4e10
+
+
+def nominal_step_time_s(device_kind: str = "", platform: str = "cpu",
+                        flops: float = NOMINAL_STEP_FLOPS,
+                        bytes_accessed: float = NOMINAL_STEP_BYTES) -> float:
+    """Per-platform nominal step time from the roofline spec alone — the
+    SPEED signal ``parallel/split.blend_speed_weights`` blends into
+    heterogeneous-chain workload weights the way free memory is blended
+    today (the banked hybrid_sd15 showed a VRAM-only split makes a tpu+cpu
+    chain a de-optimization: the CPU's share must reflect that it is ~40x
+    slower, not that it has spare RAM)."""
+    spec = platform_spec(device_kind, platform)
+    return max(flops / spec["peak_flops"], bytes_accessed / spec["hbm_bw"])
+
+
 # ---------------------------------------------------------------------------
 # the analytic cost model
 # ---------------------------------------------------------------------------

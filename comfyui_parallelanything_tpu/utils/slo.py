@@ -55,9 +55,13 @@ from collections import deque
 
 SLO_SCHEMA = "pa-slo/v1"
 
-# The five stages of a request's end-to-end latency (ISSUE 11 decomposition).
-# "collect" is client-side residual only — servers never observe it directly.
-STAGES = ("admission", "lane_wait", "eval", "decode", "collect")
+# The stages of a request's end-to-end latency (ISSUE 11 decomposition;
+# round 17 adds "encode" — the text-encode node wall the embed cache
+# collapses — and "decode_wait", the batched-decode queue wait, a sub-stage
+# of the decode node wall). "collect" is client-side residual only — servers
+# never observe it directly.
+STAGES = ("admission", "encode", "lane_wait", "eval", "decode_wait",
+          "decode", "collect")
 
 # Stage histograms keep sub-millisecond resolution at the bottom (a healthy
 # admission wait on an idle host is ~0) and minutes at the top (a saturated
@@ -221,8 +225,8 @@ class SloRegistry:
         """One stage sample of a request's latency decomposition."""
         _histogram("pa_slo_stage_seconds", float(seconds),
                    labels={"stage": str(stage)}, bounds=STAGE_BOUNDS,
-                   help="per-stage latency decomposition "
-                        "(admission/lane_wait/eval/decode)")
+                   help="per-stage latency decomposition (admission/encode/"
+                        "lane_wait/eval/decode_wait/decode)")
 
     # -- window math ---------------------------------------------------------
 

@@ -568,6 +568,24 @@ def health_snapshot(queue: dict | None = None,
         out["numerics"] = numerics.sentinel.snapshot()
     except Exception:
         out["numerics"] = None
+    try:
+        # Cross-request compute reuse (round 17): the content-addressed
+        # embed cache's hit/byte accounting (models/embed_cache.py) and the
+        # batched decode tail's occupancy (serving/decode.py) — the /health
+        # section a capacity planner reads the redundancy win from.
+        from ..models.embed_cache import cache as _embed_cache
+        from ..serving.decode import get_decode_queue as _get_dq
+        from ..serving.scheduler import get_scheduler as _get_sched
+
+        dq = _get_dq()
+        sched = _get_sched()
+        out["reuse"] = {
+            "embed_cache": _embed_cache.stats(),
+            "decode": dq.stats() if dq is not None else None,
+            "serving": sched.reuse_stats() if sched is not None else None,
+        }
+    except Exception:
+        out["reuse"] = None
     if queue is not None:
         out["queue"] = queue
     return out

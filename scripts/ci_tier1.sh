@@ -90,6 +90,21 @@ timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
 
+# Reuse smoke (round 17): the cross-request compute-reuse gate — a
+# zipf(s=1.1) prompt mix through a live 4-worker server must show the
+# embed cache collapsing the encode stage (embed_cache_hit_rate > 0,
+# encoder_invocations <= 0.5x prompts, prompts_lost == 0), an 8-seed
+# fanout must cost exactly ceil(8/width) shared dispatches with latents
+# bitwise-equal to solo (the shared-cond broadcast program), and the
+# batched decode tail must be engaged — all banked as a kind="reuse"
+# ledger record (tests/test_reuse.py::TestReuseSmoke). The unit tier
+# (LRU byte bound, demotion correctness, decode allclose) reruns with it.
+timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_reuse.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+
 # Chaos smoke (round 14): a seeded fault plan (backend-http 5xx +
 # slow-host, deterministic in the seed) fired against a 2-backend fleet
 # while the PRIMARY ROUTER is killed mid-denoise (standby takeover off the

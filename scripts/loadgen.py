@@ -252,6 +252,11 @@ def _serving_counters(base: str) -> dict:
     for name in ("pa_serving_dispatch_total", "pa_serving_completed_total",
                  "pa_serving_cancelled_total", "pa_serving_rejected_total",
                  "pa_serving_lane_steps_total",
+                 # Cross-request reuse (round 17): real encoder program
+                 # runs (the embed-cache miss cost) and batched tail-decode
+                 # dispatch/request counters (serving/decode.py).
+                 "pa_encoder_invocations_total",
+                 "pa_decode_dispatch_total", "pa_decode_requests_total",
                  # Numerics sentinel (utils/numerics.py): non-finite
                  # observations and quarantined lanes (summed over labels),
                  # plus the enabled gauge (published at scrape time) that
@@ -281,6 +286,15 @@ def _serving_counters(base: str) -> dict:
     m = re.search(r"^pa_serving_batched_fraction ([0-9.eE+-]+)$", text, re.M)
     if m:
         out["pa_serving_batched_fraction"] = float(m.group(1))
+    # Reuse gauges (round 17): the embed cache's monotonic hit/miss/eviction
+    # totals (diffed like counters — they only grow) + current bytes, and
+    # the decode tail's lifetime batched fraction.
+    for name in ("pa_embed_cache_hits", "pa_embed_cache_misses",
+                 "pa_embed_cache_evictions", "pa_embed_cache_bytes",
+                 "pa_decode_batched_fraction"):
+        m = re.search(rf"^{name} ([0-9.eE+-]+)$", text, re.M)
+        if m:
+            out[name] = float(m.group(1))
     # Roofline attribution fractions (utils/roofline.py, published at scrape
     # time when the server traces): where the non-compute time goes —
     # comms (fleet hops) and host-gap alongside compute/exposed-transfer.
@@ -292,6 +306,80 @@ def _serving_counters(base: str) -> dict:
         if m:
             out[name] = float(m.group(1))
     return out
+
+
+def parse_prompt_dist(spec: str | None) -> float | None:
+    """``zipf:<s>`` → the exponent s (production prompt popularity is
+    zipf-shaped: a few hot prompts dominate, a long tail follows)."""
+    if not spec:
+        return None
+    kind, _, arg = spec.partition(":")
+    if kind != "zipf":
+        raise ValueError(f"unknown prompt distribution {spec!r} (want zipf:<s>)")
+    return float(arg or "1.1")
+
+
+def prompt_schedule(total: int, *, s: float | None, vocab: list[str],
+                    fanout: int = 1, seed: int | None = 0) -> list[str]:
+    """The per-submission prompt texts: ``ceil(total/fanout)`` GROUPS, each
+    group one zipf-sampled text repeated ``fanout`` times — submissions
+    within a group differ only in their --seed-key value, i.e. they are
+    sibling seeds of one prompt (the serving tier's shared-cond fanout
+    shape). Seeded and threading-independent: value n is a pure function of
+    (seed, n), the run_load schedule discipline."""
+    fanout = max(1, int(fanout))
+    rng = random.Random(seed if seed is not None else 0)
+    groups = (total + fanout - 1) // fanout
+    if s is None:
+        picks = [vocab[g % len(vocab)] for g in range(groups)]
+    else:
+        weights = [1.0 / (k + 1) ** s for k in range(len(vocab))]
+        picks = rng.choices(vocab, weights=weights, k=groups)
+    return [picks[i // fanout] for i in range(total)]
+
+
+def _prompt_texts(total: int, *, prompt_key, prompt_dist, prompt_vocab,
+                  seed_fanout, seed):
+    """The per-submission prompt-text schedule both loops share (closed and
+    open loop MUST bank records under the same schedule for the same
+    flags), or None when no prompt key / no distribution is in play."""
+    if not (prompt_key and (prompt_dist or seed_fanout > 1)):
+        return None
+    return prompt_schedule(
+        total, s=parse_prompt_dist(prompt_dist),
+        vocab=prompt_vocab or [f"prompt {k}" for k in range(32)],
+        fanout=seed_fanout, seed=seed,
+    )
+
+
+def _reuse_summary(before: dict, after: dict) -> dict:
+    """The cross-request-reuse summary fields, diffed from the scraped
+    counters: hit rate over THIS run, real encoder invocations, and the
+    decode tail's batching — the numbers the zipf/fanout CI smoke gates."""
+
+    def delta(name):
+        return (after.get(name, 0.0) - before.get(name, 0.0)
+                if name in after or name in before else None)
+
+    hits, misses = delta("pa_embed_cache_hits"), delta("pa_embed_cache_misses")
+    hit_rate = None
+    if hits is not None and misses is not None and hits + misses > 0:
+        hit_rate = round(hits / (hits + misses), 4)
+    return {
+        # Fraction of encode lookups served from the content-addressed
+        # cache over this run (None: cache absent or no lookups).
+        "embed_cache_hit_rate": hit_rate,
+        "embed_cache_evictions": delta("pa_embed_cache_evictions"),
+        # Real text-encoder program runs over this run — the number the
+        # zipf rung gates at <= 0.5x total prompts.
+        "encoder_invocations": delta("pa_encoder_invocations_total"),
+        # Decode-tail batching: requests served via shared decode dispatch
+        # / total (process lifetime, the same gauge /health reports) plus
+        # this run's dispatch/request deltas.
+        "decode_batched_fraction": after.get("pa_decode_batched_fraction"),
+        "decode_dispatches": delta("pa_decode_dispatch_total"),
+        "decode_requests": delta("pa_decode_requests_total"),
+    }
 
 
 def percentile(samples: list[float], q: float) -> float:
@@ -332,7 +420,11 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
              sampler_key: str | None = None,
              seed: int | None = None,
              hosts: list[str] | None = None,
-             fallback_bases: list[str] | None = None) -> dict:
+             fallback_bases: list[str] | None = None,
+             prompt_dist: str | None = None,
+             prompt_key: str | None = None,
+             prompt_vocab: list[str] | None = None,
+             seed_fanout: int = 1) -> dict:
     """The closed loop; returns the summary dict (importable — the e2e and
     fleet-smoke tests drive in-process servers through this exact code path).
 
@@ -347,7 +439,15 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
     the live counter. ``hosts`` turns on fleet mode (see module docstring).
     ``fallback_bases`` (router HA): standby router URLs tried in order when
     the primary stops answering or replies standby-503 — a router kill
-    mid-run costs the clients a reconnect, never a prompt."""
+    mid-run costs the clients a reconnect, never a prompt.
+
+    Cross-request reuse shape (round 17): ``prompt_dist`` (``zipf:<s>``) +
+    ``prompt_key`` sample each submission's prompt TEXT from
+    ``prompt_vocab`` under a seeded zipf — the redundant production traffic
+    the embed cache collapses; ``seed_fanout`` N groups submissions into
+    N-seed siblings of one sampled prompt (the shared-cond fanout shape).
+    The summary gains ``embed_cache_hit_rate`` / ``encoder_invocations`` /
+    ``decode_batched_fraction`` scraped-delta fields either way."""
     if fallback_bases:
         base = _Front([base, *fallback_bases])
     latencies: list[float] = []
@@ -364,6 +464,10 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
     if seed is not None:
         rng = random.Random(seed)
         schedule = [rng.randrange(1 << 31) for _ in range(clients * requests)]
+    texts = _prompt_texts(
+        clients * requests, prompt_key=prompt_key, prompt_dist=prompt_dist,
+        prompt_vocab=prompt_vocab, seed_fanout=seed_fanout, seed=seed,
+    )
     before = _serving_counters(base)
     hosts_before = _host_probe(hosts) if hosts else None
     t_start = time.time()
@@ -379,6 +483,8 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
                           schedule[n - 1] if schedule is not None else n)
             if samplers and sampler_key:
                 _set_path(g, sampler_key, samplers[n % len(samplers)])
+            if texts is not None:
+                _set_path(g, prompt_key, texts[n - 1])
             payload = {"prompt": g}
             if extra_data:
                 payload["extra_data"] = extra_data
@@ -507,6 +613,12 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
         "requests": clients * requests,
         "seed": seed,
         "samplers": samplers or None,
+        "prompt_dist": prompt_dist if texts is not None else None,
+        "seed_fanout": (
+            seed_fanout if texts is not None and seed_fanout > 1 else None
+        ),
+        "distinct_prompts": len(set(texts)) if texts is not None else None,
+        **_reuse_summary(before, after),
         "completed": len(latencies),
         "failed": len(failures),
         "rejected_429": rejected[0],
@@ -606,7 +718,8 @@ def _scrape_slo(base, e2e_p50=None, e2e_p95=None) -> dict | None:
         except (urllib.error.URLError, OSError):
             return None
     stages: dict[str, dict] = {}
-    for stage in ("admission", "lane_wait", "eval", "decode"):
+    for stage in ("admission", "encode", "lane_wait", "eval",
+                  "decode_wait", "decode"):
         p50 = _histogram_quantile(text, "pa_slo_stage_seconds", 50,
                                   labels={"stage": stage})
         if p50 is None:
@@ -660,7 +773,11 @@ def run_open_load(base: str, graph: dict, *, kind: str = "poisson",
                   on_s: float = 1.0, off_s: float = 1.0,
                   arrivals_doc: dict | None = None,
                   arrivals_out: str | None = None,
-                  twin_band: float = 0.5) -> dict:
+                  twin_band: float = 0.5,
+                  prompt_dist: str | None = None,
+                  prompt_key: str | None = None,
+                  prompt_vocab: list[str] | None = None,
+                  seed_fanout: int = 1) -> dict:
     """OPEN-loop load: requests fire on a seeded arrival schedule
     (fleet/twin.py's generator — Poisson, bursty ON-OFF, or trace replay)
     regardless of completions, which is the regime where queues actually
@@ -693,6 +810,11 @@ def run_open_load(base: str, graph: dict, *, kind: str = "poisson",
              "replay": False}
             for r in rps_list
         ]
+    texts = _prompt_texts(
+        sum(len(r["offsets"]) for r in rungs_in), prompt_key=prompt_key,
+        prompt_dist=prompt_dist, prompt_vocab=prompt_vocab,
+        seed_fanout=seed_fanout, seed=seed,
+    )
     all_lat: list[float] = []
     lat_by_host: dict = {}
     exec_by_host: dict = {}
@@ -723,6 +845,8 @@ def run_open_load(base: str, graph: dict, *, kind: str = "poisson",
                 _set_path(g, seed_key, val if seed is not None else n)
             if samplers and sampler_key:
                 _set_path(g, sampler_key, samplers[n % len(samplers)])
+            if texts is not None and n <= len(texts):
+                _set_path(g, prompt_key, texts[n - 1])
             payload = {"prompt": g}
             if extra_data:
                 payload["extra_data"] = extra_data
@@ -957,6 +1081,12 @@ def run_open_load(base: str, graph: dict, *, kind: str = "poisson",
         "requests": total_arrivals,
         "seed": seed,
         "samplers": samplers or None,
+        "prompt_dist": prompt_dist if texts is not None else None,
+        "seed_fanout": (
+            seed_fanout if texts is not None and seed_fanout > 1 else None
+        ),
+        "distinct_prompts": len(set(texts)) if texts is not None else None,
+        **_reuse_summary(before, after),
         "completed": len(all_lat),
         "failed": len(failures),
         "rejected_429": rejected[0],
@@ -1017,6 +1147,18 @@ def print_human_summary(summary: dict, stream=None) -> None:
         w(f"  serving   {summary['serving_dispatches']:.0f} dispatches,"
           f" {summary['serving_lane_steps']:.0f} lane-steps"
           f" ({summary['dispatch_amortization']}x amortized)\n")
+    if summary.get("embed_cache_hit_rate") is not None or \
+            summary.get("encoder_invocations") is not None:
+        w(f"  reuse     embed-cache hit rate "
+          f"{summary.get('embed_cache_hit_rate')}"
+          f"  encoder invocations {summary.get('encoder_invocations')}"
+          f" / {summary.get('requests')} prompts"
+          f"  (distinct {summary.get('distinct_prompts')})\n")
+    if summary.get("decode_batched_fraction") is not None:
+        w(f"  reuse     decode batched fraction "
+          f"{summary.get('decode_batched_fraction')}"
+          f"  ({summary.get('decode_requests')} decodes in "
+          f"{summary.get('decode_dispatches')} dispatches)\n")
     if summary.get("fleet"):
         f = summary["fleet"]
         w(f"  fleet     dispatches {f.get('dispatches')}"
@@ -1062,6 +1204,22 @@ def main() -> None:
     ap.add_argument("--sampler-key", default=None,
                     help="colon path (node:inputs:sampler_name) the "
                          "round-robin sampler is written to")
+    ap.add_argument("--prompt-dist", default=None,
+                    help="zipf:<s> — sample each submission's prompt TEXT "
+                         "from a seeded zipf over the prompt vocabulary "
+                         "(written at --prompt-key): the redundant "
+                         "production traffic shape the embed cache "
+                         "collapses")
+    ap.add_argument("--prompt-key", default=None,
+                    help="colon path (node:inputs:text) the sampled prompt "
+                         "text is written to")
+    ap.add_argument("--prompt-vocab", default=None,
+                    help="comma list of prompt texts to sample from "
+                         "(default: 32 synthetic 'prompt k' strings)")
+    ap.add_argument("--seed-fanout", type=int, default=1,
+                    help="group submissions into N-seed siblings of one "
+                         "sampled prompt (same text, distinct --seed-key "
+                         "values) — the shared-cond fanout shape")
     ap.add_argument("--priority", type=int, default=None)
     ap.add_argument("--deadline-s", type=float, default=None)
     ap.add_argument("--seed", type=int, default=None,
@@ -1109,6 +1267,14 @@ def main() -> None:
     if samplers and not args.sampler_key:
         ap.error("--samplers requires --sampler-key (where to write it)")
     hosts = [h for h in (args.hosts or "").split(",") if h]
+    prompt_vocab = [p for p in (args.prompt_vocab or "").split(",") if p]
+    if args.prompt_dist and not args.prompt_key:
+        ap.error("--prompt-dist requires --prompt-key (where to write it)")
+    if args.seed_fanout > 1 and not args.prompt_key:
+        # Without a prompt key no fanout schedule is built — recording
+        # seed_fanout on plain traffic would bank a misleading record.
+        ap.error("--seed-fanout requires --prompt-key (where to write it)")
+    parse_prompt_dist(args.prompt_dist)  # fail fast on a typo'd spec
     with open(args.graph) as f:
         graph = json.load(f)
     extra = {}
@@ -1133,6 +1299,9 @@ def main() -> None:
             on_s=args.on_s, off_s=args.off_s,
             arrivals_doc=arrivals_doc, arrivals_out=args.arrivals_out,
             twin_band=args.twin_band,
+            prompt_dist=args.prompt_dist, prompt_key=args.prompt_key,
+            prompt_vocab=prompt_vocab or None,
+            seed_fanout=args.seed_fanout,
         )
         _append_ledger(summary, args.base, kind="openloop")
     else:
@@ -1143,6 +1312,9 @@ def main() -> None:
             samplers=samplers or None, sampler_key=args.sampler_key,
             seed=args.seed, hosts=hosts or None,
             fallback_bases=fallback or None,
+            prompt_dist=args.prompt_dist, prompt_key=args.prompt_key,
+            prompt_vocab=prompt_vocab or None,
+            seed_fanout=args.seed_fanout,
         )
         _append_ledger(summary, args.base)
     print_human_summary(summary)          # operator table → stderr

@@ -38,6 +38,8 @@ DOC = "no host sync/transfer in timed loops or compiled-step hot paths"
 # `step` closure here.
 HOT_PATHS = (
     ("comfyui_parallelanything_tpu/serving/bucket.py", "StepBucket.dispatch"),
+    ("comfyui_parallelanything_tpu/serving/decode.py",
+     "DecodeQueue._dispatch"),
     ("comfyui_parallelanything_tpu/parallel/streaming.py",
      "StreamingRunner.__call__"),
     ("bench.py", "step"),

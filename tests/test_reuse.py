@@ -1,0 +1,635 @@
+"""Cross-request compute reuse (round 17): the content-addressed embed
+cache, sibling-seed shared-cond lanes, and the batched decode tail —
+correctness (bitwise / bf16-tolerance equivalence), the LRU byte bound, and
+the zipf/fanout CI smoke whose gates ride the scraped reuse counters
+(``scripts/ci_tier1.sh`` reruns ``ReuseSmoke or SiblingSeed or EmbedCache
+or BatchedDecode`` as the explicit contract)."""
+
+import json
+import math
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+# bf16-scale tolerances (CLAUDE.md: this XLA CPU runs f32 matmuls at bf16).
+TOL = dict(rtol=2e-3, atol=1e-4)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_embed_cache():
+    """Deterministic hit/miss/byte accounting per test."""
+    from comfyui_parallelanything_tpu.models.embed_cache import cache
+
+    cache.clear()
+    yield
+    cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# embed cache unit behavior (no encoders needed)
+# ---------------------------------------------------------------------------
+
+
+class TestEmbedCache:
+    def _mk(self, max_bytes):
+        from comfyui_parallelanything_tpu.models.embed_cache import EmbedCache
+
+        return EmbedCache(max_bytes=max_bytes)
+
+    def test_byte_bound_holds_under_churn_with_eviction_counts(self):
+        c = self._mk(10 * 1024)  # ten 1 KiB values fit, forty don't
+        val = lambda i: np.full((256,), i, np.float32)  # noqa: E731 — 1 KiB
+        for i in range(40):
+            c.put(f"k{i}", val(i))
+            assert c.stats()["bytes"] <= 10 * 1024  # the bound HOLDS, always
+        st = c.stats()
+        assert st["entries"] == 10
+        assert st["evictions"] == 30
+        # LRU order: the oldest 30 are gone, the newest 10 remain.
+        assert c.get("k0") is None
+        assert c.get("k39") is not None
+        assert c.stats()["hits"] == 1 and c.stats()["misses"] == 1
+
+    def test_lru_recency_protects_hot_entries(self):
+        c = self._mk(3 * 1024)
+        for i in range(3):
+            c.put(f"k{i}", np.zeros((256,), np.float32))
+        assert c.get("k0") is not None   # k0 is now MRU
+        c.put("k3", np.zeros((256,), np.float32))  # evicts k1, not k0
+        assert c.get("k0") is not None
+        assert c.get("k1") is None
+
+    def test_merge_discipline_incumbent_wins(self):
+        # The WorkflowCache.merge rule: a racing double-encode's loser gets
+        # the incumbent back; its duplicate stays caller-owned, un-cached.
+        c = self._mk(1 << 20)
+        first = np.ones((8,), np.float32)
+        second = np.ones((8,), np.float32) * 2
+        assert c.put("k", first) is first
+        assert c.put("k", second) is first
+        assert c.get("k") is first
+
+    def test_release_owner_frees_bytes(self):
+        c = self._mk(1 << 20)
+        c.put("a", np.zeros((256,), np.float32), owner="enc1")
+        c.put("b", np.zeros((256,), np.float32), owner="enc1")
+        c.put("c", np.zeros((256,), np.float32), owner="enc2")
+        assert c.release_owner("enc1") == 2
+        st = c.stats()
+        assert st["entries"] == 1 and st["bytes"] == 1024
+        assert c.get("a") is None and c.get("c") is not None
+
+    def test_disabled_cache_never_stores(self):
+        c = self._mk(0)
+        v = np.zeros((8,), np.float32)
+        assert c.put("k", v) is v
+        assert c.get("k") is None
+        assert c.stats()["enabled"] is False
+
+    def test_oversized_value_returned_uncached(self):
+        c = self._mk(100)
+        v = np.zeros((256,), np.float32)
+        assert c.put("k", v) is v
+        assert c.stats()["entries"] == 0
+
+    def test_stable_key_contract(self):
+        from comfyui_parallelanything_tpu.models.embed_cache import stable_key
+
+        ids = np.array([[1, 2, 3]], np.int32)
+        assert stable_key("m", "clip", ids) == stable_key("m", "clip", ids)
+        assert stable_key("m", "clip", ids) != \
+            stable_key("m2", "clip", ids)
+        assert stable_key("m", "clip", ids) != stable_key("m", "t5", ids)
+        assert stable_key("m", "clip", ids) != \
+            stable_key("m", "clip", np.array([[1, 2, 4]], np.int32))
+        # Mask participates (t5's attention mask changes the output).
+        assert stable_key("m", "t5", ids, np.array([[1, 1, 0]])) != \
+            stable_key("m", "t5", ids, np.array([[1, 1, 1]]))
+
+
+class TestCachedEncode:
+    def _tiny_encoder(self):
+        import jax
+
+        from comfyui_parallelanything_tpu.models.text_encoders import (
+            build_clip_text,
+        )
+        from tests.test_text_encoders import TINY_CLIP
+
+        return build_clip_text(TINY_CLIP, jax.random.key(0))
+
+    def test_cached_vs_fresh_bitwise_equal_and_one_invocation(self):
+        from comfyui_parallelanything_tpu.models import embed_cache
+        from comfyui_parallelanything_tpu.utils.metrics import registry
+
+        enc = self._tiny_encoder()
+        ids = np.array([[5, 6, 7, 99] + [0] * 12], np.int32)
+        calls = [0]
+
+        def compute():
+            import jax.numpy as jnp
+
+            calls[0] += 1
+            return enc(jnp.asarray(ids, jnp.int32))
+
+        inv0 = registry.get("pa_encoder_invocations_total") or 0.0
+        fresh = embed_cache.cached_encode(enc, "mk", "clip", ids, None, compute)
+        cached = embed_cache.cached_encode(enc, "mk", "clip", ids, None, compute)
+        assert calls[0] == 1  # the hit skipped the encoder program entirely
+        assert (registry.get("pa_encoder_invocations_total") or 0.0) - inv0 == 1
+        # Hits return the SAME arrays: cached-vs-fresh is bitwise-equal by
+        # construction (and the shared object is the sibling-seed seam).
+        assert cached[0] is fresh[0]
+        # A recompute after a clear reruns the SAME jitted program on the
+        # same inputs — bitwise-equal output, no recompile of the encoder.
+        embed_cache.cache.clear()
+        fresh2 = embed_cache.cached_encode(enc, "mk", "clip", ids, None, compute)
+        assert calls[0] == 2
+        for a, b in zip(fresh, fresh2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_workflow_cache_eviction_releases_embeds(self):
+        # host.WorkflowCache teardown hook: evicting a CLIP wire releases
+        # its cached embeds eagerly (owner-token release).
+        from comfyui_parallelanything_tpu.host import WorkflowCache
+        from comfyui_parallelanything_tpu.models import embed_cache
+
+        enc = self._tiny_encoder()
+        ids = np.array([[5, 6, 99] + [0] * 13], np.int32)
+        embed_cache.cached_encode(
+            enc, None, "clip", ids, None,
+            lambda: (np.zeros((4,), np.float32),),
+        )
+        assert embed_cache.cache.stats()["entries"] == 1
+        wc = WorkflowCache()
+        wire = {"encoder": enc, "tokenizer": object(), "type": "clip"}
+        wc.results["n1"] = (wire,)
+        wc.signatures["n1"] = "sig"
+        wc.evict("n1")
+        assert embed_cache.cache.stats()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# sibling-seed shared-cond lanes (scheduler harness, manual pump)
+# ---------------------------------------------------------------------------
+
+
+def tiny_model(x, t, context=None, **kw):
+    """Per-sample-independent stand-in denoiser (tests/test_serving.py)."""
+    import jax.numpy as jnp
+
+    c = jnp.mean(context, axis=tuple(range(1, context.ndim)))
+    c = c.reshape((-1,) + (1,) * (x.ndim - 1))
+    tt = t.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.tanh(x * 0.9 + c * 0.1) * (0.5 + 0.1 * tt / 1000.0)
+
+
+def _noise(seed, batch=1):
+    import jax.numpy as jnp
+
+    r = np.random.default_rng(seed)
+    return jnp.asarray(r.normal(size=(batch, 8, 8, 4)).astype(np.float32))
+
+
+def _ctx(seed=1000, batch=1):
+    import jax.numpy as jnp
+
+    r = np.random.default_rng(seed)
+    return jnp.asarray(r.normal(size=(batch, 6, 16)).astype(np.float32))
+
+
+@pytest.fixture
+def sched():
+    from comfyui_parallelanything_tpu.serving import (
+        ContinuousBatchingScheduler,
+    )
+
+    s = ContinuousBatchingScheduler(max_width=4, auto=False).install()
+    try:
+        yield s
+    finally:
+        s.uninstall()
+        s.shutdown()
+
+
+def _serve_fanout(sched, ctx, seeds, steps=1, timeout=30):
+    """Submit one run_sampler per seed — all referencing the SAME ctx object
+    (the embed cache's aliasing) — and drain; returns results by seed."""
+    from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+    results = {}
+
+    def worker(seed):
+        results[seed] = run_sampler(
+            tiny_model, _noise(seed), ctx, sampler="euler", steps=steps
+        )
+
+    threads = [threading.Thread(target=worker, args=(s,), daemon=True)
+               for s in seeds]
+    for t in threads:
+        t.start()
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        with sched._lock:
+            tot = sum(len(b.queue) + len(b.active_lanes())
+                      for b in sched.buckets.values())
+        if tot >= len(seeds):
+            break
+        time.sleep(0.005)
+    sched.drain()
+    for t in threads:
+        t.join(timeout)
+    assert len(results) == len(seeds)
+    return results
+
+
+class TestSiblingSeedFanout:
+    def test_fanout_costs_ceil_n_over_width_dispatches_bitwise(self, sched):
+        """Acceptance: an 8-seed fanout of ONE prompt (one shared cond
+        object) completes in ceil(8/width) shared dispatches per eval, with
+        every latent bitwise-equal to its solo run — the broadcast-cond
+        program at any occupancy is the same program, so the PR 5
+        select-mask contract carries the equality."""
+        from comfyui_parallelanything_tpu.utils.metrics import registry
+
+        ctx = _ctx()
+        seeds = list(range(20, 28))
+        solo = {}
+        for s in seeds:  # solo legs: one at a time through the scheduler
+            solo.update(_serve_fanout(sched, ctx, [s], steps=1))
+        start = sched.total_dispatches()
+        res = _serve_fanout(sched, ctx, seeds, steps=1)
+        n, width = len(seeds), 4
+        assert sched.total_dispatches() - start == math.ceil(n / width)
+        for s in seeds:
+            np.testing.assert_array_equal(
+                np.asarray(res[s]), np.asarray(solo[s])
+            )
+        [bucket] = sched.buckets.values()
+        labels = {"bucket": bucket.label}
+        # The dispatches really rode the broadcast program, and sibling
+        # seats really shared the cond tensor.
+        assert registry.get("pa_serving_cond_broadcast_total", labels) >= 2
+        assert registry.get("pa_serving_shared_cond_seats_total", labels) >= 6
+
+    def test_multi_step_fanout_matches_solo_bitwise(self, sched):
+        ctx = _ctx(7)
+        seeds = [31, 32, 33, 34, 35]
+        solo = {}
+        for s in seeds:
+            solo.update(_serve_fanout(sched, ctx, [s], steps=4))
+        res = _serve_fanout(sched, ctx, seeds, steps=4)
+        for s in seeds:
+            np.testing.assert_array_equal(
+                np.asarray(res[s]), np.asarray(solo[s])
+            )
+
+    def test_foreign_cond_demotes_to_stacked_and_stays_correct(self, sched):
+        """A mid-flight join with a DIFFERENT cond demotes the bucket from
+        shared to stacked; the incumbent's trajectory is unperturbed (its
+        values are re-filled from the shared ref, so demotion is a mode
+        change, never a value change)."""
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        ctx_a, ctx_b = _ctx(1), _ctx(2)
+        solo_a = _serve_fanout(sched, ctx_a, [41], steps=8)[41]
+        solo_b = _serve_fanout(sched, ctx_b, [42], steps=4)[42]
+        results = {}
+
+        def worker(seed, ctx, steps):
+            results[seed] = run_sampler(
+                tiny_model, _noise(seed), ctx, sampler="euler", steps=steps
+            )
+
+        ta = threading.Thread(target=worker, args=(41, ctx_a, 8), daemon=True)
+        ta.start()
+        t0 = time.time()
+        while time.time() - t0 < 30 and not any(
+            b.active_lanes() or len(b.queue)
+            for b in sched.buckets.values()
+        ):
+            time.sleep(0.005)
+        for _ in range(3):
+            sched.pump()  # A is 3 steps in, shared-mode...
+        tb = threading.Thread(target=worker, args=(42, ctx_b, 4), daemon=True)
+        tb.start()
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            with sched._lock:
+                tot = sum(len(b.queue) + len(b.active_lanes())
+                          for b in sched.buckets.values())
+            if tot >= 2:
+                break
+            time.sleep(0.005)
+        sched.drain()  # ...when B's foreign cond joins and demotes
+        ta.join(30)
+        tb.join(30)
+        np.testing.assert_array_equal(np.asarray(results[41]),
+                                      np.asarray(solo_a))
+        np.testing.assert_array_equal(np.asarray(results[42]),
+                                      np.asarray(solo_b))
+
+    def test_shared_mode_reenters_after_bucket_drains(self, sched):
+        # Burst 1 demotes (two conds); burst 2 (single cond) must re-enter
+        # shared mode — release/idle resets the cond epoch.
+        ctx_a, ctx_b = _ctx(3), _ctx(4)
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        results = {}
+
+        def worker(seed, ctx):
+            results[seed] = run_sampler(
+                tiny_model, _noise(seed), ctx, sampler="euler", steps=2
+            )
+
+        ts = [threading.Thread(target=worker, args=(s, c), daemon=True)
+              for s, c in ((51, ctx_a), (52, ctx_b))]
+        for t in ts:
+            t.start()
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            with sched._lock:
+                tot = sum(len(b.queue) + len(b.active_lanes())
+                          for b in sched.buckets.values())
+            if tot >= 2:
+                break
+            time.sleep(0.005)
+        sched.drain()
+        for t in ts:
+            t.join(30)
+        from comfyui_parallelanything_tpu.utils.metrics import registry
+
+        [bucket] = sched.buckets.values()
+        labels = {"bucket": bucket.label}
+        # Burst 1 demoted; idle release resets the epoch (mode None).
+        assert bucket._cond_mode in (None, "stacked")
+        before = registry.get("pa_serving_cond_broadcast_total", labels) or 0
+        res = _serve_fanout(sched, ctx_a, [53, 54], steps=1)
+        after = registry.get("pa_serving_cond_broadcast_total", labels) or 0
+        assert after > before  # burst 2 re-entered shared-cond broadcast
+        assert len(res) == 2
+
+
+# ---------------------------------------------------------------------------
+# batched tail decode
+# ---------------------------------------------------------------------------
+
+
+class TestBatchedDecode:
+    def _vae(self):
+        import jax
+
+        from comfyui_parallelanything_tpu.models import build_vae
+        from tests.test_vae import TINY
+
+        return build_vae(TINY, jax.random.key(1), sample_hw=16)
+
+    def _z(self, seed):
+        import jax.numpy as jnp
+
+        r = np.random.default_rng(seed)
+        return jnp.asarray(r.normal(size=(1, 8, 8, 4)).astype(np.float32))
+
+    def test_batched_decode_allclose_to_solo(self):
+        from comfyui_parallelanything_tpu.serving.decode import DecodeQueue
+        from comfyui_parallelanything_tpu.utils.metrics import registry
+
+        vae = self._vae()
+        q = DecodeQueue(width=4, linger_s=100.0, auto=False)
+        try:
+            zs = [self._z(i) for i in range(4)]
+            solo = [np.asarray(vae.decode(z)) for z in zs]
+            tickets = [q.submit(vae, z) for z in zs[:3]]
+            assert all(t is not None for t in tickets)
+            assert q.pump() is False  # 3 < width, linger far away: not ripe
+            tickets.append(q.submit(vae, zs[3]))
+            d0 = registry.get("pa_decode_dispatch_total") or 0.0
+            assert q.pump() is True   # width reached → ONE shared dispatch
+            assert (registry.get("pa_decode_dispatch_total") or 0.0) - d0 == 1
+            for t, s in zip(tickets, solo):
+                # bf16-scale tolerance: the batch dim changes the XLA
+                # program exactly like any width change (CLAUDE.md).
+                np.testing.assert_allclose(
+                    np.asarray(t.result(timeout=10)), s, **TOL
+                )
+            from comfyui_parallelanything_tpu.serving.decode import (
+                batched_fraction,
+            )
+
+            assert batched_fraction() > 0.0
+        finally:
+            q.shutdown()
+
+    def test_padded_partial_batch_allclose(self):
+        from comfyui_parallelanything_tpu.serving.decode import DecodeQueue
+
+        vae = self._vae()
+        q = DecodeQueue(width=4, linger_s=100.0, auto=False)
+        try:
+            z = self._z(9)
+            solo = np.asarray(vae.decode(z))
+            t = q.submit(vae, z)
+            q.pump(force=True)  # occupancy 1 of width 4: padded rows inert
+            np.testing.assert_allclose(
+                np.asarray(t.result(timeout=10)), solo, **TOL
+            )
+        finally:
+            q.shutdown()
+
+    def test_linger_dispatches_without_full_width(self):
+        from comfyui_parallelanything_tpu.serving.decode import DecodeQueue
+
+        vae = self._vae()
+        q = DecodeQueue(width=4, linger_s=0.0, auto=False)
+        try:
+            t = q.submit(vae, self._z(10))
+            assert q.pump() is True  # linger lapsed → ripe at occupancy 1
+            assert t.result(timeout=10) is not None
+        finally:
+            q.shutdown()
+
+    def test_ineligible_work_returns_none(self):
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.serving.decode import DecodeQueue
+
+        vae = self._vae()
+        q = DecodeQueue(width=4, auto=False)
+        try:
+            assert q.submit(vae, self._z(0), tile=16) is None  # tiled: inline
+            assert q.submit(vae, jnp.zeros((1, 2, 8, 8, 4))) is None  # video
+            assert q.submit(object(), self._z(0)) is None  # no decode/params
+        finally:
+            q.shutdown()
+
+    def test_shutdown_resolves_waiters_with_error(self):
+        from comfyui_parallelanything_tpu.serving.decode import DecodeQueue
+
+        vae = self._vae()
+        q = DecodeQueue(width=4, linger_s=100.0, auto=False)
+        t = q.submit(vae, self._z(11))
+        q.shutdown()
+        with pytest.raises(RuntimeError):
+            t.result(timeout=5)
+
+    def test_mixed_shapes_bucket_separately(self):
+        import jax.numpy as jnp
+
+        from comfyui_parallelanything_tpu.serving.decode import DecodeQueue
+
+        vae = self._vae()
+        q = DecodeQueue(width=2, linger_s=100.0, auto=False)
+        try:
+            a = q.submit(vae, self._z(12))
+            b = q.submit(vae, jnp.asarray(
+                np.random.default_rng(13).normal(
+                    size=(1, 4, 4, 4)
+                ).astype(np.float32)
+            ))
+            q.pump(force=True)
+            assert a.result(timeout=10).shape != b.result(timeout=10).shape
+        finally:
+            q.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the CI smoke: zipf loadgen rung + fanout acceptance + kind="reuse" record
+# ---------------------------------------------------------------------------
+
+
+class TestReuseSmoke:
+    def test_zipf_fanout_reuse_smoke(self, tmp_path, monkeypatch):
+        """The ci_tier1 reuse gate: a zipf(s=1.1) prompt mix over a live
+        multi-worker server shows the encode stage collapsing
+        (``embed_cache_hit_rate > 0``, ``encoder_invocations <= 0.5x``
+        prompts, ``prompts_lost == 0``); an 8-seed fanout costs ~1 encode
+        and exactly ceil(8/width) shared dispatches with bitwise-equal
+        latents; the evidence lands as ONE kind="reuse" ledger record."""
+        from loadgen import run_load
+
+        from comfyui_parallelanything_tpu.server import make_server
+        from comfyui_parallelanything_tpu.utils.metrics import registry
+        from tests.test_server import _stock_graph
+        from tests.test_stock_nodes import _synthetic_stock_env
+
+        out_dir = tmp_path / "out"
+        srv, q = make_server(port=0, output_dir=str(out_dir), workers=4)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        # Distinct-token prompt vocabulary (the synthetic word-level
+        # tokenizer's real words — synthetic 'prompt k' strings would all
+        # tokenize to [UNK] and alias in the cache).
+        vocab = [
+            "a watercolor lighthouse",
+            "a blurry lighthouse",
+            "low quality dawn",
+            "a lighthouse at dawn",
+        ]
+        try:
+            paths = _synthetic_stock_env(tmp_path, monkeypatch)
+            graph = _stock_graph(paths["ckpt"], str(out_dir))
+            graph["3"]["inputs"]["steps"] = 2
+
+            warm = run_load(base, graph, clients=1, requests=1, timeout=600,
+                            seed_key="3:inputs:seed")
+            assert warm["completed"] == 1, warm
+
+            zipf = run_load(
+                base, graph, clients=4, requests=4, timeout=600,
+                seed_key="3:inputs:seed", seed=7,
+                prompt_dist="zipf:1.1", prompt_key="6:inputs:text",
+                prompt_vocab=vocab,
+            )
+            assert zipf["completed"] == 16 and zipf["failed"] == 0, zipf
+            assert not zipf.get("prompts_lost"), zipf
+            # The reuse gates (acceptance): hit rate nonzero; the encode
+            # stage collapsed to at most half the prompt count.
+            assert zipf["embed_cache_hit_rate"] is not None, zipf
+            assert zipf["embed_cache_hit_rate"] > 0, zipf
+            assert zipf["encoder_invocations"] is not None, zipf
+            assert zipf["encoder_invocations"] <= 0.5 * zipf["requests"], zipf
+            assert zipf["distinct_prompts"] <= len(vocab)
+            # Decode tail engaged: every prompt decoded, dispatches counted.
+            assert zipf["decode_requests"] == 16, zipf
+            assert zipf["decode_dispatches"] is not None
+            assert zipf["decode_dispatches"] <= zipf["decode_requests"]
+            assert zipf["decode_batched_fraction"] is not None
+
+            fanout = run_load(
+                base, graph, clients=8, requests=1, timeout=600,
+                seed_key="3:inputs:seed", seed=11,
+                prompt_dist="zipf:1.1", prompt_key="6:inputs:text",
+                prompt_vocab=["a lighthouse at dawn"], seed_fanout=8,
+            )
+            assert fanout["completed"] == 8 and fanout["failed"] == 0, fanout
+            assert not fanout.get("prompts_lost"), fanout
+            assert fanout["distinct_prompts"] == 1
+            # ~1 encode for the whole fanout: the node cache + embed cache
+            # collapse it; concurrent first-sight races bound it by the
+            # worker count, never the fanout size.
+            assert fanout["encoder_invocations"] <= 4, fanout
+        finally:
+            srv.shutdown()
+            q.shutdown()
+
+        # Deterministic fanout acceptance (scheduler harness — the server's
+        # scheduler is uninstalled by shutdown above): 8 sibling seeds, ONE
+        # shared cond object, width 4, 1-step schedules → exactly
+        # ceil(8/4) = 2 dispatches, latents bitwise-equal to solo.
+        from comfyui_parallelanything_tpu.serving import (
+            ContinuousBatchingScheduler,
+        )
+
+        sched = ContinuousBatchingScheduler(max_width=4, auto=False).install()
+        try:
+            ctx = _ctx(99)
+            seeds = list(range(60, 68))
+            solo = {}
+            for s in seeds:
+                solo.update(_serve_fanout(sched, ctx, [s], steps=1))
+            start = sched.total_dispatches()
+            res = _serve_fanout(sched, ctx, seeds, steps=1)
+            fan_dispatches = sched.total_dispatches() - start
+            assert fan_dispatches == math.ceil(8 / 4), fan_dispatches
+            bitwise_ok = True
+            for s in seeds:
+                np.testing.assert_array_equal(
+                    np.asarray(res[s]), np.asarray(solo[s])
+                )
+        finally:
+            sched.uninstall()
+            sched.shutdown()
+
+        # The kind="reuse" ledger record: the zipf rung's collapse + the
+        # fanout arithmetic, appended through bench's stdlib helper (honors
+        # PA_LEDGER_DIR like every other evidence writer).
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from bench import _ledger_append
+
+        _ledger_append({
+            "rung": "reuse_smoke",
+            "platform": "cpu",
+            "prompts": zipf["requests"],
+            "prompt_dist": "zipf:1.1",
+            "distinct_prompts": zipf["distinct_prompts"],
+            "embed_cache_hit_rate": zipf["embed_cache_hit_rate"],
+            "encoder_invocations": zipf["encoder_invocations"],
+            "decode_batched_fraction": zipf["decode_batched_fraction"],
+            "decode_dispatches": zipf["decode_dispatches"],
+            "decode_requests": zipf["decode_requests"],
+            "fanout_n": 8,
+            "fanout_width": 4,
+            "fanout_dispatches": fan_dispatches,
+            "fanout_encoder_invocations": fanout["encoder_invocations"],
+            "fanout_bitwise_equal_to_solo": bitwise_ok,
+            "prompts_lost": 0,
+        }, kind="reuse")

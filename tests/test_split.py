@@ -8,6 +8,7 @@ import pytest
 from comfyui_parallelanything_tpu.parallel.split import (
     batch_size_of,
     blend_memory_weights,
+    blend_speed_weights,
     block_ranges,
     concat_results,
     largest_remainder_split,
@@ -81,6 +82,47 @@ class TestBlendMemoryWeights:
     def test_length_mismatch(self):
         with pytest.raises(ValueError):
             blend_memory_weights([0.5], [1, 2])
+
+
+class TestBlendSpeedWeights:
+    def test_blend_formula(self):
+        # The memory blend's twin: 0.7*user + 0.3*inverse-time share.
+        w = blend_speed_weights([0.5, 0.5], [1.0, 3.0])
+        inv = np.array([1.0, 1.0 / 3.0])
+        expected = 0.7 * np.array([0.5, 0.5]) + 0.3 * inv / inv.sum()
+        expected /= expected.sum()
+        np.testing.assert_allclose(w, expected, rtol=1e-12)
+        assert w[0] > 0.5 > w[1]  # the faster device gains share
+
+    def test_fast_tpu_slow_cpu_spec_pair_shifts_toward_speed(self):
+        # Acceptance (ROADMAP speed-aware hybrid blending): a v6-vs-CPU
+        # platform-spec pair moves a 50/50 user split decisively toward the
+        # TPU — the split reflects SPEED, not VRAM.
+        from comfyui_parallelanything_tpu.utils import roofline
+
+        t_tpu = roofline.nominal_step_time_s("TPU v6 lite", "tpu")
+        t_cpu = roofline.nominal_step_time_s("", "cpu")
+        assert t_tpu < t_cpu / 10  # the specs really are an order apart
+        w = blend_speed_weights([0.5, 0.5], [t_tpu, t_cpu])
+        # alpha=0.7 bounds the shift at 0.7*user + 0.3*1: the TPU lands
+        # near the 0.65 cap, the CPU near the 0.35 floor.
+        assert w[0] > 0.6 > 0.4 > w[1]
+        # VRAM-only blending cannot see this: equal free bytes leave 50/50.
+        assert blend_memory_weights([0.5, 0.5], [100, 100]) == \
+            pytest.approx((0.5, 0.5))
+
+    def test_homogeneous_chain_is_a_no_op(self):
+        # Equal specs → equal times → user weights untouched (even SPMD
+        # sharding and explicit user splits on same-platform meshes are
+        # never perturbed).
+        assert blend_speed_weights([0.6, 0.4], [2.0, 2.0]) == (0.6, 0.4)
+
+    def test_unknown_spec_falls_back_to_user(self):
+        assert blend_speed_weights([0.6, 0.4], [0.0, 1.0]) == (0.6, 0.4)
+
+    def test_length_mismatch(self):
+        with pytest.raises(ValueError):
+            blend_speed_weights([0.5], [1.0, 2.0])
 
 
 class TestBlockRanges:
