@@ -313,20 +313,20 @@ def _synth_int8_params(sds, min_size: int = 2**16):
     leaves bf16 zeros. Matmul timing is value-independent, so zeros measure the
     same compute as real weights — and a 12B high-precision pytree is never
     materialized anywhere."""
-    import numpy as _np
-
     import jax
     import jax.numpy as jnp
 
-    from comfyui_parallelanything_tpu.models.quantize import QuantTensor
+    from comfyui_parallelanything_tpu.models.quantize import (
+        QuantTensor,
+        int8_eligible,
+    )
 
     cpu = jax.devices("cpu")[0]
 
     def synth(leaf):
         shape = tuple(leaf.shape)
-        size = int(_np.prod(shape)) if shape else 1
         with jax.default_device(cpu):
-            if len(shape) >= 2 and size >= min_size:
+            if int8_eligible(shape, min_size):
                 scale_shape = tuple(1 for _ in shape[:-1]) + (shape[-1],)
                 return QuantTensor(
                     q=jnp.zeros(shape, jnp.int8),
@@ -612,6 +612,18 @@ def _stale_tpu_record(requested):
     return best or best_any
 
 
+def _plan_summary(pm):
+    """Compact plan view for the JSON line (None when the planner is off,
+    the chain was ineligible, or the summary layer fails — the one line
+    outranks its plan field)."""
+    try:
+        from comfyui_parallelanything_tpu.parallel import planner
+
+        return planner.plan_summary(getattr(pm, "plan", None))
+    except Exception:
+        return None
+
+
 def _make_step(pm, batch, n_chunks, t, ctx, kwargs):
     """One denoise-step callable mapping latents -> latents (the shape
     ``chained_time`` chains). ``n_chunks > 1`` runs the batch as that many
@@ -719,11 +731,32 @@ def _run_inner() -> None:
             (c for c in range(want, batch + 1) if batch % c == 0), batch
         )
 
+    kx, kc = jax.random.split(jax.random.key(1))
+    x = jax.random.normal(kx, x_shape, jnp.float32)
+    t = jnp.linspace(999.0, 1.0, batch)
+    ctx = jax.random.normal(kc, (batch, ctx_len, ctx_dim), jnp.float32)
+
+    # Analytic step cost BEFORE the wrap (it was always computed for MFU —
+    # now it doubles as the planner's hints): the auto-parallel planner
+    # (parallel/planner.py) scores candidate plans against the rung's real
+    # per-dispatch FLOPs/bytes instead of a weights-derived estimate.
+    cost = _step_cost(model, x, t, ctx, kwargs)
+    plan_hints = {
+        "rung": config_name,
+        "flops": (cost["flops"] / n_chunks) if cost["flops"] else None,
+        "bytes_accessed": (
+            cost["bytes_accessed"] / n_chunks
+            if cost["bytes_accessed"] else None
+        ),
+        "batch": batch // n_chunks,
+    }
+
     if config_name == "flux_stream":
         # Weight-streaming rung: ONE chip, params host-pinned, stages
         # double-buffered (parallel/streaming.py). The explicit stream mode
         # pins the rung's meaning (the weights-don't-fit auto-routing would
-        # pick it anyway on a chip whose budget the pytree exceeds);
+        # pick it anyway on a chip whose budget the pytree exceeds) while
+        # the planner still searches the stage-CARVE axis within it;
         # PA_STREAM_HBM_BUDGET overrides the carve budget — the off-hardware
         # rehearsal forces multi-stage carving on a tiny model with it.
         chain = DeviceChain.even([f"{platform}:{jax.devices()[0].id}"])
@@ -734,6 +767,7 @@ def _run_inner() -> None:
                 weight_sharding="stream",
                 hbm_budget_bytes=int(budget) if budget else None,
             ),
+            plan_hints=plan_hints,
         )
     elif config_name == "hybrid_sd15" and is_tpu and platform != "cpu":
         # The heterogeneous rung: lead TPU chip at 70%, host CPU at 30% — a
@@ -742,15 +776,10 @@ def _run_inner() -> None:
         chain = DeviceChain.from_pairs(
             [(f"{platform}:{jax.devices()[0].id}", 70.0), ("cpu", 30.0)]
         )
-        pm = parallelize(model, chain)
+        pm = parallelize(model, chain, plan_hints=plan_hints)
     else:
         chain = DeviceChain.even([f"{platform}:{d.id}" for d in jax.devices()])
-        pm = parallelize(model, chain)
-
-    kx, kc = jax.random.split(jax.random.key(1))
-    x = jax.random.normal(kx, x_shape, jnp.float32)
-    t = jnp.linspace(999.0, 1.0, batch)
-    ctx = jax.random.normal(kc, (batch, ctx_len, ctx_dim), jnp.float32)
+        pm = parallelize(model, chain, plan_hints=plan_hints)
 
     step = _make_step(pm, batch, n_chunks, t, ctx, kwargs)
 
@@ -859,9 +888,9 @@ def _run_inner() -> None:
         sys.stderr.write(f"bench: trace written to {trace_out}\n")
 
     # MFU: analytic step FLOPs / time / aggregate peak. TPU only (CPU peak is
-    # not meaningful for MXU utilization).
+    # not meaningful for MXU utilization). ``cost`` was computed before the
+    # wrap (it seeded the planner's hints).
     mfu = None
-    cost = _step_cost(model, x, t, ctx, kwargs)
     flops = cost["flops"]
     peak = _peak_bf16(jax.devices()[0].device_kind) if is_tpu else None
     if flops and peak:
@@ -977,6 +1006,11 @@ def _run_inner() -> None:
         "attribution": attribution,
         "flops_source": cost["flops_source"],
         "flops_discrepancy_ratio": cost["flops_discrepancy_ratio"],
+        # Auto-parallel planner (parallel/planner.py): the plan this rung's
+        # wrap routed through — chosen candidate, shadow hand-plan score,
+        # divergence — null with PA_PLANNER=0 or on ineligible chains
+        # (hybrid multi-group).
+        "plan": _plan_summary(pm),
     }
     if _FAKE_TPU or _TINY:
         record["dryrun"] = True
@@ -993,6 +1027,26 @@ def _run_inner() -> None:
     # for every instrumented program this run compiled — the calibration
     # fit's program-level input), which stay off the stdout line to keep
     # the driver contract lean.
+    # kind="plan" ledger record (parallel/planner.py + scripts/plan_report.py
+    # --check): the decision with its measured actual — predicted-vs-actual
+    # error banked per rung, and the raw prediction fit_calibration reads
+    # back so the planner sharpens per platform. Appended BEFORE the bench
+    # record so the ledger's last line stays the bench record (the
+    # rehearsal tests' contract).
+    try:
+        plan_decision = getattr(pm, "plan", None)
+        if plan_decision is not None:
+            from comfyui_parallelanything_tpu.parallel import planner
+
+            plan_ledger = planner.ledger_record(
+                plan_decision, actual_s=sec_it / n_chunks
+            )
+            if _FAKE_TPU or _TINY:
+                plan_ledger["dryrun"] = True
+            telemetry.append_ledger_record(plan_ledger, "plan")
+    except Exception:
+        pass
+
     ledger_rec = {**record, "rung": config_name}
     try:
         from comfyui_parallelanything_tpu.utils import roofline
@@ -1117,6 +1171,8 @@ _LATE_SCHEMA_FIELDS = (
     # bucket breakdown, and the FLOPs-source audit fields.
     "predicted_step_s", "predicted_step_raw_s", "roofline_ratio",
     "attribution", "flops_source", "flops_discrepancy_ratio",
+    # Auto-parallel planner (round 18): the plan the wrap routed through.
+    "plan",
 )
 
 

@@ -75,19 +75,19 @@ def pin_params_host(params, device=None):
         return jax.tree.map(np.asarray, params)
 
 
-def carve_stages(spec, params, max_stage_bytes: int | None = None,
+def carve_ranges(sizes: "list[int] | tuple[int, ...]",
+                 max_stage_bytes: int | None = None,
                  n_stages: int | None = None) -> list[tuple[int, int]]:
-    """Partition a ``PipelineSpec``'s segments into contiguous stage ranges
-    for the streaming executor: each stage's parameter sub-pytree fits
-    ``max_stage_bytes`` (half the double-buffer budget), or — when only a
-    stage COUNT is given — stages are balanced by bytes. Returns
-    ``[(start, end), ...]`` over ``spec.segments``; single-segment stages
-    may exceed the byte cap (a segment is the atomic streaming unit — the
-    cap then simply degrades to one-segment-at-a-time streaming)."""
-    sizes = [
-        params_nbytes({k: params[k] for k in seg.param_keys})
-        for seg in spec.segments
-    ]
+    """The pure carve arithmetic behind :func:`carve_stages`, over segment
+    byte sizes alone (no params pytree, no jax) — shared with the
+    auto-parallel planner (parallel/planner.py), whose stream-carve
+    candidates are exactly this function at different caps/counts. Greedy
+    contiguous packing: each stage's bytes fit ``max_stage_bytes`` (half the
+    double-buffer budget), or — when only a stage COUNT is given — stages
+    are balanced by bytes. Single-segment stages may exceed the byte cap (a
+    segment is the atomic streaming unit — the cap then simply degrades to
+    one-segment-at-a-time streaming)."""
+    sizes = list(sizes)
     total = sum(sizes)
     if max_stage_bytes is None:
         n = max(1, min(len(sizes), int(n_stages or 4)))
@@ -101,6 +101,29 @@ def carve_stages(spec, params, max_stage_bytes: int | None = None,
         acc += sz
     ranges.append((start, len(sizes)))
     return ranges
+
+
+def segment_nbytes(spec, params) -> list[int]:
+    """Per-segment parameter bytes of a ``PipelineSpec`` — the byte profile
+    the carve (and the planner's stage-carve search) operates on."""
+    return [
+        params_nbytes({k: params[k] for k in seg.param_keys})
+        for seg in spec.segments
+    ]
+
+
+def carve_stages(spec, params, max_stage_bytes: int | None = None,
+                 n_stages: int | None = None) -> list[tuple[int, int]]:
+    """Partition a ``PipelineSpec``'s segments into contiguous stage ranges
+    for the streaming executor: each stage's parameter sub-pytree fits
+    ``max_stage_bytes`` (half the double-buffer budget), or — when only a
+    stage COUNT is given — stages are balanced by bytes. Returns
+    ``[(start, end), ...]`` over ``spec.segments``; see :func:`carve_ranges`
+    for the oversized-single-segment caveat."""
+    return carve_ranges(
+        segment_nbytes(spec, params),
+        max_stage_bytes=max_stage_bytes, n_stages=n_stages,
+    )
 
 
 def load_safetensors(path: str | os.PathLike) -> dict[str, np.ndarray]:

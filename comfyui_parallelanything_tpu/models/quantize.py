@@ -65,6 +65,37 @@ def _quantize_leaf(w, channel_axis: int) -> QuantTensor:
     return QuantTensor(q=q, scale=scale)
 
 
+def int8_eligible(shape, min_size: int = 2**16) -> bool:
+    """THE min-size/rank rule deciding which leaves quantize to int8.
+    Shared by ``quantize_params``, bench's ``_synth_int8_params`` synthesis,
+    and the dryrun's abstract flux_stream byte profile (§19b) — one rule,
+    so the synthesized/abstract byte budgets can never drift from what
+    quantization actually stores."""
+    shape = tuple(shape)
+    size = 1
+    for s in shape:
+        size *= int(s)
+    return len(shape) >= 2 and size >= min_size
+
+
+def synth_int8_nbytes(shapes, min_size: int = 2**16) -> int:
+    """Stored bytes of an ABSTRACT pytree (ShapeDtypeStructs / shape stubs)
+    under the int8 synthesis rule: eligible leaves count int8 bytes plus
+    the per-output-channel f32 scale vector, the rest bf16 — sizes a
+    12B-class checkpoint without materializing anything."""
+    total = 0
+    for leaf in jax.tree.leaves(shapes):
+        shape = tuple(getattr(leaf, "shape", ()))
+        size = 1
+        for s in shape:
+            size *= int(s)
+        if int8_eligible(shape, min_size):
+            total += size + int(shape[-1]) * 4  # int8 q + f32 scale row
+        else:
+            total += size * 2  # bf16
+    return total
+
+
 def quantize_params(params, min_size: int = 2**16):
     """Quantize every large ≥2-D weight leaf to per-channel int8.
 
@@ -78,10 +109,7 @@ def quantize_params(params, min_size: int = 2**16):
         if isinstance(w, QuantTensor):
             return w
         shape = tuple(getattr(w, "shape", ()))
-        size = 1
-        for s in shape:
-            size *= int(s)
-        if len(shape) < 2 or size < min_size:
+        if not int8_eligible(shape, min_size):
             return w
         return _quantize_leaf(w, channel_axis=len(shape) - 1)
 

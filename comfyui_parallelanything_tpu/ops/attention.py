@@ -348,6 +348,94 @@ def attention_local(q, k, v, scale: float | None = None) -> jnp.ndarray:
     return _xla_attention(q, k, v, scale)
 
 
+def backend_plan(seq_q: int, seq_k: int | None = None,
+                 head_dim: int | None = None, batch: int = 1,
+                 heads: int = 1) -> dict:
+    """The ``attention_local`` routing ladder as a side-effect-free,
+    inspectable decision — what the auto-parallel planner's attention axis
+    reads (parallel/planner.py): which backend WOULD serve this shape, the
+    chunk configuration it would run under, and the banked measurements
+    (``ops/attn_chunk.json`` threshold sweep + ``ops/pallas/tuning.json``
+    pallas-vs-xla wins) that decided it. Mirrors ``attention_local`` rule
+    for rule so plan and execution agree by construction; a drift test pins
+    the two against each other (tests/test_planner.py)."""
+    from .pallas.tuning import fused_backend, kernel_tuning, pallas_wins
+
+    seq_k = seq_q if seq_k is None else int(seq_k)
+    logit_elems = int(batch) * int(heads) * int(seq_q) * int(seq_k)
+    threshold = _chunk_threshold()
+    candidates: list[dict] = []
+
+    def cand(name, eligible, why, **extra):
+        candidates.append(
+            {"backend": name, "eligible": bool(eligible), "why": why, **extra}
+        )
+
+    tuning = kernel_tuning()
+    nearest = None
+    measured = [e for e in tuning["entries"]
+                if e.get("pallas_ms") is not None
+                or e.get("pallas_jax_ms") is not None]
+    if measured:
+        nearest = min(
+            measured, key=lambda e: abs(int(e.get("seq", 0)) - int(seq_q))
+        )
+    fused_ok = (
+        _pallas_available() and seq_q % 128 == 0 and seq_k % 128 == 0
+        and pallas_wins(seq_q, head_dim)
+    )
+    cand(
+        "pallas", fused_ok and fused_backend(seq_q, head_dim) == "pallas",
+        "fused in-repo kernel (tuning table winner)" if fused_ok
+        else "ineligible: not TPU / non-128-aligned seq / tuning says XLA",
+        measured_ms=(nearest or {}).get("pallas_ms"),
+    )
+    cand(
+        "pallas_jax",
+        fused_ok and fused_backend(seq_q, head_dim) == "pallas_jax",
+        "jax upstream fused kernel (tuning table winner)" if fused_ok
+        else "ineligible: not TPU / non-aligned / tuning says XLA",
+        measured_ms=(nearest or {}).get("pallas_jax_ms"),
+    )
+    cand(
+        "xla", not fused_ok and logit_elems <= threshold,
+        f"materializing logits fit ({logit_elems} <= {threshold} elems)"
+        if logit_elems <= threshold
+        else f"logits would materialize {logit_elems} > {threshold} elems",
+        measured_ms=(nearest or {}).get("xla_ms"),
+    )
+    cand(
+        "xla_chunked", not fused_ok and logit_elems > threshold,
+        "memory-bounded scan over query blocks (logits exceed threshold)",
+        measured_ms=None,
+    )
+    # The exact attention_local resolution order: configured pin first, the
+    # auto ladder only for "auto", then the pallas_jax shape guard and the
+    # xla→chunked size fallback — so a process-pinned backend plans the same
+    # way it executes.
+    backend = _BACKEND
+    if backend == "auto":
+        backend = fused_backend(seq_q, head_dim) if fused_ok else "xla"
+    if backend == "pallas_jax" and (
+        (head_dim is not None and head_dim % 128 != 0)
+        or seq_q % _UPSTREAM_BLOCK != 0 or seq_k % _UPSTREAM_BLOCK != 0
+    ):
+        backend = "xla"
+    if backend == "xla" and logit_elems > threshold:
+        backend = "xla_chunked"
+    cfg = chunk_config()
+    return {
+        "backend": backend,
+        "configured": _BACKEND,
+        "logit_elems": logit_elems,
+        "chunk_elems": cfg["chunk_elems"],
+        "bf16_softmax": cfg["bf16_softmax"],
+        "sources": cfg["sources"],
+        "tuning_source": tuning.get("source", "default"),
+        "candidates": candidates,
+    }
+
+
 def attention(q, k, v, scale: float | None = None) -> jnp.ndarray:
     """Scaled dot-product attention on (B, S, H, D) inputs."""
     seq_cfg = getattr(_SEQ_CTX, "cfg", None)

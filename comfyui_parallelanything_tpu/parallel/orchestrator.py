@@ -227,6 +227,13 @@ class ParallelConfig:
     #   streaming debug mode; parallel/streaming.py module docstring).
     hbm_budget_bytes: int | None = None
     stream_overlap: bool = True
+    # Planner-chosen stream stage COUNT (parallel/planner.py stream-carve
+    # axis): when set, the streaming runner carves byte-balanced into this
+    # many stages instead of the budget-derived byte cap — but only if the
+    # resulting largest stage still fits the 2-buffer budget
+    # (build_streaming_runner falls back to the cap otherwise). None (the
+    # default, and the PA_PLANNER=0 behavior) keeps the hand carve.
+    stream_stages: int | None = None
     # >1 enables GPipe-style THROUGHPUT pipelining for batch>1 (beyond the
     # reference, whose pipeline mode is batch==1 layer placement only, SURVEY
     # §2e): the batch splits into this many microbatches streamed through the
@@ -324,6 +331,7 @@ class ParallelModel:
         model_config: Any = None,
         sampler_prefs: dict | None = None,
         streaming: bool = False,
+        plan: dict | None = None,
     ):
         self._apply = apply_fn
         self._host_params = params
@@ -345,6 +353,12 @@ class ParallelModel:
         self.sampler_prefs = sampler_prefs
         self._groups = groups
         self.weights = weights
+        # The planner decision this wrap routed through (parallel/planner.py)
+        # — None when PA_PLANNER=0, the chain was ineligible (hybrid
+        # multi-group, pinned fsdp/tp), or the planner predates this model.
+        # bench.py reads it onto the JSON line; /health's ``plan`` section
+        # shows the process-wide last decision.
+        self.plan = plan
         self._pipeline_spec = pipeline_spec
         self._pipeline_runner: Any = None  # built lazily on first pipeline-path use
         self._jits: dict[tuple, Callable] = {}
@@ -520,6 +534,7 @@ class ParallelModel:
             self._stream_runner = build_streaming_runner(
                 self._pipeline_spec, self._host_params, self.lead_device,
                 hbm_budget_bytes=budget, overlap=self.config.stream_overlap,
+                n_stages=self.config.stream_stages,
             )
             if self._stream_runner is None:
                 raise ValueError(
@@ -599,8 +614,43 @@ class ParallelModel:
             from .pipeline import build_pipeline_runner
 
             devices = [d for g in self._groups for d in g.devices]
+            # Planner-chosen byte-balanced stage carve (parallel/planner.py
+            # pipeline axis) — only when the decision was ENACTED (mode
+            # "on", never shadow) AND the carve cleared the planner's
+            # hysteresis ("enact"), and only on uniform-weight chains:
+            # explicit uneven user weights (or a rebalance that shifted
+            # them) keep the weight-proportional hand carve, which is what
+            # those weights mean.
+            ranges = None
+            pipe_plan = (self.plan or {}).get("pipeline") \
+                if isinstance(self.plan, dict) else None
+            w = list(self.weights)
+            if (
+                pipe_plan and pipe_plan.get("enact") and w
+                and (self.plan or {}).get("mode_flag") == "on"
+                and max(w) - min(w) < 1e-9
+                and len(pipe_plan.get("ranges") or []) <= len(devices)
+            ):
+                ranges = [tuple(r) for r in pipe_plan["ranges"]]
+                # The carve REALLY applies now — stamp the decision (the
+                # /health and ledger views read the shared dict) and count
+                # it, so observability reflects enacted routing changes,
+                # never mere intent (planner._pipeline_plan docstring).
+                pipe_plan["enacted"] = True
+                try:
+                    from ..utils.metrics import registry as _metrics
+
+                    _metrics.counter(
+                        "pa_planner_pipeline_carve_total",
+                        help="batch==1 pipeline runners built with the "
+                             "planner's byte-balanced stage carve instead "
+                             "of the weight-proportional hand carve",
+                    )
+                except Exception:
+                    pass
             self._pipeline_runner = build_pipeline_runner(
-                self._pipeline_spec, self._host_params, devices, list(self.weights)
+                self._pipeline_spec, self._host_params, devices,
+                list(self.weights), ranges=ranges,
             )
             if self._pipeline_runner is None:
                 self._pipeline_spec = None  # unpipelineable; don't retry every step
@@ -927,18 +977,68 @@ def _unwrap_model(model) -> tuple[Callable[..., Any], Any]:
     )
 
 
+def _plan_inputs(params, pipeline_spec, devices, config: "ParallelConfig",
+                 hints) -> "Any":
+    """Assemble the planner's pure inputs from the wrap's facts (byte
+    profile, budget, device identity) plus the caller's optional hints
+    (bench passes the rung's measured FLOPs/bytes and batch; model wraps
+    without hints plan from the weight bytes alone)."""
+    from ..devices.memory import usable_hbm_bytes
+    from ..models.loader import params_nbytes, segment_nbytes
+    from .planner import PlanInputs
+
+    hints = dict(hints or {})
+    budget = config.hbm_budget_bytes or usable_hbm_bytes(devices[0]) or None
+    seg: tuple = ()
+    if pipeline_spec is not None and getattr(pipeline_spec, "segments", None):
+        try:
+            seg = tuple(segment_nbytes(pipeline_spec, params))
+        except Exception:  # non-dict param containers: plan without the axis
+            seg = ()
+    lead = devices[0]
+    return PlanInputs(
+        n_devices=len(devices),
+        platform=getattr(lead, "platform", "cpu") or "cpu",
+        device_kind=getattr(lead, "device_kind", "") or "",
+        weights_bytes=params_nbytes(params),
+        budget_bytes=int(budget) if budget else None,
+        segment_bytes=seg,
+        flops=hints.get("flops"),
+        bytes_accessed=hints.get("bytes_accessed"),
+        batch=hints.get("batch"),
+        seq_len=hints.get("seq_len"),
+        head_dim=hints.get("head_dim"),
+        heads=hints.get("heads"),
+        rung=str(hints.get("rung") or ""),
+    )
+
+
 def parallelize(
     model,
     chain: DeviceChain | Sequence[tuple[str, float]],
     config: ParallelConfig | None = None,
     *,
     pipeline_spec: Any = None,
+    plan_hints: Mapping[str, Any] | None = None,
 ) -> ParallelModel | Any:
     """Wrap ``model`` for parallel execution over ``chain``.
 
     Returns a ``ParallelModel``; on an unusable chain (empty, or total percentage <= 0)
     returns ``model`` unchanged, exactly like the reference's abort paths
     (1019-1027, 1037-1042).
+
+    Strategy selection (round 18, parallel/planner.py): with ``PA_PLANNER``
+    on (the default) and an open decision — single-platform chain,
+    ``weight_sharding="replicate"``, no explicit tensor_parallel — the
+    roofline-scored planner enumerates (mesh dp×tp × weight mode ×
+    stage-carve × attention) candidates, prunes HBM-infeasible ones against
+    the residency budget, and routes through the best predicted plan; an
+    explicit ``weight_sharding="stream"`` pins the mode but still searches
+    the stage carve. ``plan_hints`` feeds the cost model measured facts
+    (``flops``/``bytes_accessed``/``batch``/``seq_len``/``head_dim``/
+    ``rung`` — bench.py passes its rung's step cost). ``PA_PLANNER=0``
+    restores the hand routing ladder below bitwise; ``PA_PLANNER=shadow``
+    records the decision but enacts the hand plan.
 
     Re-entrant: passing an existing ``ParallelModel`` tears down its placements and
     rebuilds from the retained host params with the new chain/config — the
@@ -1023,8 +1123,69 @@ def parallelize(
     if stream_mode and config.tensor_parallel > 1:
         raise ValueError("weight_sharding='stream' does not compose with "
                          "tensor_parallel")
+
+    # Auto-parallel planner (parallel/planner.py): search the plan space
+    # where the decision is open. Hybrid multi-group chains keep the hand
+    # weighted-scatter rules (one SPMD program per platform is the only
+    # shape that exists there), explicit fsdp/tp configs are the user's
+    # pinned decision, and PA_PLANNER=0 skips this block entirely — the
+    # ladder below then routes bitwise-identically to the pre-planner code.
+    plan_decision = None
+    plan_enacted = False
+    from . import planner as _planner
+
     if (
-        not stream_mode
+        _planner.enabled()
+        and len(groups) == 1
+        and config.pipeline_microbatches == 0
+        and (stream_mode or (config.weight_sharding == "replicate"
+                             and config.tensor_parallel <= 1))
+    ):
+        try:
+            plan_decision = _planner.plan(
+                _plan_inputs(params, pipeline_spec, devices, config,
+                             plan_hints),
+                pinned_mode="stream" if stream_mode else None,
+            )
+        except Exception:  # noqa: BLE001 — planning must never kill a wrap
+            get_logger().warning(
+                "auto-parallel planner failed; falling back to hand rules",
+                exc_info=True,
+            )
+            plan_decision = None
+        if plan_decision is not None and _planner.mode() == "on":
+            chosen = plan_decision["chosen"]
+            if chosen["mode"] == "stream" and pipeline_spec is not None:
+                if not stream_mode and plan_decision["hand"]["mode"] != "stream":
+                    log_degradation(
+                        "plan-stream",
+                        f"planner routed to weight streaming "
+                        f"({chosen.get('n_stages')} stage(s), predicted "
+                        f"{chosen['predicted_s']:.4g}s vs hand "
+                        f"{plan_decision['hand']['predicted_s']:.4g}s)",
+                    )
+                stream_mode = True
+                # A divergent carve enacts its stage count; a hand-equal
+                # decision keeps the budget-cap carve byte-for-byte.
+                if plan_decision["divergent"] and chosen.get("n_stages"):
+                    config = dataclasses.replace(
+                        config, stream_stages=int(chosen["n_stages"])
+                    )
+                plan_enacted = True
+            elif chosen["mode"] == "fsdp":
+                config = dataclasses.replace(config, weight_sharding="fsdp")
+                plan_enacted = True
+            elif chosen["mode"] == "tp" and chosen["tp"] > 1:
+                config = dataclasses.replace(
+                    config, tensor_parallel=int(chosen["tp"])
+                )
+                plan_enacted = True
+            elif chosen["mode"] == "replicate":
+                plan_enacted = True
+
+    if (
+        not plan_enacted
+        and not stream_mode
         and config.weight_sharding == "replicate"
         and config.tensor_parallel <= 1
         and pipeline_spec is not None
@@ -1101,4 +1262,5 @@ def parallelize(
         model_config=wrapped_config,
         sampler_prefs=sampler_prefs,
         streaming=stream_mode,
+        plan=plan_decision,
     )

@@ -60,11 +60,17 @@ class PipelineRunner:
         params: Any,
         devices: Sequence[jax.Device],
         weights: Sequence[float],
+        ranges: Sequence[tuple[int, int]] | None = None,
     ):
         self.lead = devices[0]
         self._spec = spec
         n = len(spec.segments)
-        ranges = block_ranges(n, weights)
+        if ranges is None:
+            # Weight-proportional carve (reference parity, 1168-1178). An
+            # explicit ``ranges`` is the planner's byte-balanced stage
+            # carve (parallel/planner.py pipeline axis) — contiguous,
+            # covering [0, n), at most one range per device.
+            ranges = block_ranges(n, weights)
 
         def subset(keys):
             missing = [k for k in keys if k not in params]
@@ -181,10 +187,13 @@ def build_pipeline_runner(
     params: Any,
     devices: Sequence[jax.Device],
     weights: Sequence[float],
+    ranges: Sequence[tuple[int, int]] | None = None,
 ) -> PipelineRunner | None:
     """Build the batch==1 runner; None when the model declares no pipeline spec — the
     router then falls back to single-device, matching the reference when no known
-    block list is found (1156-1166)."""
+    block list is found (1156-1166). ``ranges`` overrides the
+    weight-proportional carve with an explicit stage partition (the
+    planner's byte-balanced carve)."""
     if spec is None or not spec.segments or len(devices) <= 1:
         return None
-    return PipelineRunner(spec, params, devices, weights)
+    return PipelineRunner(spec, params, devices, weights, ranges=ranges)

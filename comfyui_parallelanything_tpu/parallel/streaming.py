@@ -475,12 +475,24 @@ def build_streaming_runner(
     pipeline spec (nothing to carve — the router must then fail placement the
     ordinary way). ``hbm_budget_bytes`` sizes the stages: two buffers plus
     activation headroom must fit, so each stage is capped at 2/5 of the
-    budget (2 × 2/5 weights + 1/5 activations/temps)."""
+    budget (2 × 2/5 weights + 1/5 activations/temps). An explicit
+    ``n_stages`` (the planner's chosen carve, parallel/planner.py) wins
+    over the byte cap only when its byte-balanced carve still fits the
+    cap — a planned carve must never widen the double-buffer bound."""
     if spec is None or not spec.segments:
         return None
     max_stage_bytes = None
     if hbm_budget_bytes:
         max_stage_bytes = max(1, int(hbm_budget_bytes) * 2 // 5)
+    if n_stages and max_stage_bytes:
+        from ..models.loader import carve_ranges, segment_nbytes
+
+        sizes = segment_nbytes(spec, params)
+        ranges = carve_ranges(sizes, n_stages=int(n_stages))
+        if max(sum(sizes[s:e]) for s, e in ranges) <= max_stage_bytes:
+            max_stage_bytes = None  # the planned carve honors the cap
+        else:
+            n_stages = None  # planned carve would blow the budget; cap rules
     runner = StreamingRunner(
         spec, params, device,
         max_stage_bytes=max_stage_bytes, n_stages=n_stages, overlap=overlap,

@@ -1045,6 +1045,7 @@ def compiled_flow_sample(
 def lane_step_program(
     spec: TraceSpec, *, prediction: str, use_cfg: bool, cfg_rescale: float,
     static_kwargs: dict, emit_stats: bool = False, broadcast_cond: bool = False,
+    broadcast_kwargs: bool = False,
 ):
     """The jitted per-step program for one serving bucket (W = lane width,
     b = per-request batch):
@@ -1082,9 +1083,18 @@ def lane_step_program(
     materializes the IDENTICAL ``[n, L, D]`` values the stacked path
     reshapes to, so everything downstream of the flatten is the same
     program graph on the same values (tests pin broadcast-vs-stacked
-    equality bitwise on CPU)."""
+    equality bitwise on CPU).
+
+    ``broadcast_kwargs`` (PR 12 remainder): the TRACED kwargs trees —
+    ``kwargs`` / ``u_kwargs`` (pooled ``y`` vectors, per-request
+    ``guidance``, the negative-prompt/uncond extras) — arrive as ONE
+    per-request tree referenced by every lane and broadcast over the lane
+    axis inside the program, exactly like ``broadcast_cond`` above. A
+    sibling-seed fanout then stops stacking identical uncond rows too:
+    same values, same downstream graph as the stacked variant (the flatten
+    sees the identical ``[n, ...]`` tree either way)."""
     meta = ("serve", prediction, bool(use_cfg), float(cfg_rescale),
-            bool(emit_stats), bool(broadcast_cond))
+            bool(emit_stats), bool(broadcast_cond), bool(broadcast_kwargs))
     apply_fn, mesh, axis = spec.apply, spec.mesh, spec.data_axis
 
     def build(bound_static):
@@ -1116,6 +1126,15 @@ def lane_step_program(
                     uncond_context = jnp.broadcast_to(
                         uncond_context[None], (W,) + uncond_context.shape
                     )
+            if broadcast_kwargs:
+                # Shared traced kwargs (the PR 12 remainder): one [b, ...]
+                # tree per request, broadcast over the lane axis — the
+                # uncond/negative-prompt extras stop stacking too.
+                bc = lambda l: jnp.broadcast_to(l[None], (W,) + l.shape)  # noqa: E731
+                if kwargs:
+                    kwargs = jax.tree.map(bc, kwargs)
+                if u_kwargs:
+                    u_kwargs = jax.tree.map(bc, u_kwargs)
             flat = xe.reshape((n,) + xe.shape[2:])
             s = jnp.where(active > 0, sigma_eval, jnp.float32(1.0))
             s_flat = lane(s)

@@ -278,6 +278,18 @@ class StepBucket:
         self._uctx_ref = None
         self._ctx_dev = None          # placed shared copies (mesh: replicated)
         self._uctx_dev = None
+        # Traced-kwargs sharing (PR 12 remainder): the SAME state machine
+        # for the traced kwarg trees — pooled ``y`` vectors, ``guidance``,
+        # and the negative-prompt/uncond extras (``u_traced``) — which a
+        # sibling-seed fanout also aliases by object identity. Tracked
+        # independently of the cond mode: siblings that share the prompt
+        # cond but carry per-request kwargs still ride the broadcast-cond
+        # program with stacked kwargs, and vice versa.
+        self._kw_mode = None          # "shared" | "stacked"
+        self._kw_ref = None           # identity refs (original trees)
+        self._ukw_ref = None
+        self._kw_dev = None           # placed shared copies (mesh: replicated)
+        self._ukw_dev = None
         self._jnp = jnp
         self._model_sigmas = model_sigmas
         self._default_schedule = scaled_linear_schedule
@@ -303,6 +315,9 @@ class StepBucket:
         self._cond_mode = None
         self._ctx_ref = self._uctx_ref = None
         self._ctx_dev = self._uctx_dev = None
+        self._kw_mode = None
+        self._kw_ref = self._ukw_ref = None
+        self._kw_dev = self._ukw_dev = None
         self._program = None
 
     def _gauges(self) -> None:
@@ -343,8 +358,9 @@ class StepBucket:
         self._xe = self._zeros_stack(req.x)
         self._h1 = self._zeros_stack(req.x)
         self._h2 = self._zeros_stack(req.x)
-        self._kw = self._zeros_stack(req.traced_kwargs) if req.traced_kwargs else None
-        self._ukw = self._zeros_stack(req.u_traced) if req.u_traced else None
+        # Traced-kwargs stacks build lazily: a fresh epoch enters SHARED
+        # kwargs mode (_seat_kwargs), so the [W, ...] stacks only exist
+        # after a foreign-kwargs demotion.
         if req.prediction != "flow":
             acp = req.acp if req.acp is not None else self._default_schedule()
             self._log_sigmas = self._jnp.log(self._model_sigmas(acp))
@@ -369,6 +385,7 @@ class StepBucket:
             self.spec,
             emit_stats=self._emit_stats,
             broadcast_cond=self._cond_mode == "shared",
+            broadcast_kwargs=self._kw_mode == "shared",
             **self._prog_kw,
         )
 
@@ -437,6 +454,94 @@ class StepBucket:
         if self._uctx is not None:
             self._uctx = self._uctx.at[i].set(req.uncond_context)
 
+    def _place_shared_tree(self, tree):
+        if not tree:
+            return None
+        import jax
+
+        return jax.tree.map(self._place_shared, tree)
+
+    @staticmethod
+    def _same_tree(a, b) -> bool:
+        """Leaf-for-leaf OBJECT identity — the sharing signal (the embed
+        cache / node layer hands siblings the same arrays)."""
+        if a is b:
+            return True
+        if a is None or b is None:
+            return False
+        import jax
+
+        la, ta = jax.tree.flatten(a)
+        lb, tb = jax.tree.flatten(b)
+        return ta == tb and all(x is y for x, y in zip(la, lb))
+
+    def _seat_kwargs(self, i: int, req: ServeRequest) -> None:
+        """Seat lane ``i``'s traced kwargs under the same shared/stacked
+        state machine as ``_seat_cond`` (PR 12 remainder): fresh epochs
+        share the request's kwarg trees — ``traced_kwargs`` AND the
+        negative-prompt/uncond ``u_traced`` — as ONE broadcast program
+        input; the first seat whose trees are not the same objects
+        leaf-for-leaf demotes to stacked per-lane rows, refilled from the
+        seated siblings' own requests (a mode change, never a value
+        change)."""
+        import jax
+
+        kw = req.traced_kwargs or None
+        ukw = req.u_traced or None
+        others = [j for j in self.active_lanes() if j != i]
+        if not others:
+            self._kw_mode = "shared"
+            self._kw_ref = kw
+            self._ukw_ref = ukw
+            self._kw_dev = self._place_shared_tree(kw)
+            self._ukw_dev = self._place_shared_tree(ukw)
+            self._kw = self._ukw = None
+            self._program = None
+            return
+        if self._kw_mode == "shared":
+            if self._same_tree(kw, self._kw_ref) \
+                    and self._same_tree(ukw, self._ukw_ref):
+                registry.counter(
+                    "pa_serving_shared_kwargs_seats_total",
+                    labels=self._labels,
+                    help="lanes seated against already-shared traced "
+                         "kwargs (sibling-seed reuse, uncond included)",
+                )
+                return
+            self._kw_mode = "stacked"
+            self._kw = (
+                None if self._kw_ref is None
+                else self._zeros_stack(self._kw_ref)
+            )
+            self._ukw = (
+                None if self._ukw_ref is None
+                else self._zeros_stack(self._ukw_ref)
+            )
+            for j in others:
+                jr = self.lanes[j].req
+                if self._kw is not None:
+                    self._kw = jax.tree.map(
+                        lambda stack, v, _j=j: stack.at[_j].set(v),
+                        self._kw, jr.traced_kwargs,
+                    )
+                if self._ukw is not None:
+                    self._ukw = jax.tree.map(
+                        lambda stack, v, _j=j: stack.at[_j].set(v),
+                        self._ukw, jr.u_traced,
+                    )
+            self._kw_ref = self._ukw_ref = None
+            self._kw_dev = self._ukw_dev = None
+            self._program = None
+        if self._kw is not None:
+            self._kw = jax.tree.map(
+                lambda stack, v: stack.at[i].set(v),
+                self._kw, req.traced_kwargs,
+            )
+        if self._ukw is not None:
+            self._ukw = jax.tree.map(
+                lambda stack, v: stack.at[i].set(v), self._ukw, req.u_traced
+            )
+
     def _set_lane(self, i: int, req: ServeRequest) -> None:
         import jax
 
@@ -460,15 +565,7 @@ class StepBucket:
             self._h1 = self._h1.at[i].set(0.0)
             self._h2 = self._h2.at[i].set(0.0)
             self._seat_cond(i, req)
-            if self._kw is not None:
-                self._kw = jax.tree.map(
-                    lambda stack, v: stack.at[i].set(v),
-                    self._kw, req.traced_kwargs,
-                )
-            if self._ukw is not None:
-                self._ukw = jax.tree.map(
-                    lambda stack, v: stack.at[i].set(v), self._ukw, req.u_traced
-                )
+            self._seat_kwargs(i, req)
         else:
             from ..sampling.k_samplers import EpsDenoiser
 
@@ -724,11 +821,22 @@ class StepBucket:
                     help="dispatches whose cond rode the lane axis as ONE "
                          "broadcast tensor (sibling-seed sharing)",
                 )
+            kw_shared = self._kw_mode == "shared"
+            kw_arg = self._kw_dev if kw_shared else self._kw
+            ukw_arg = self._ukw_dev if kw_shared else self._ukw
+            if kw_shared and (self._kw_ref is not None
+                              or self._ukw_ref is not None):
+                registry.counter(
+                    "pa_serving_kwargs_broadcast_total", labels=self._labels,
+                    help="dispatches whose traced kwargs (uncond extras "
+                         "included) rode the lane axis as ONE broadcast "
+                         "tree (sibling-seed sharing)",
+                )
             outs = self._program(
                 self.spec.params, self._x, self._xe, self._h1, self._h2,
                 jnp.asarray(sig), jnp.asarray(act), jnp.asarray(cfg),
                 jnp.asarray(coef), jnp.asarray(keys),
-                ctx_arg, uctx_arg, self._kw, self._ukw, self._log_sigmas,
+                ctx_arg, uctx_arg, kw_arg, ukw_arg, self._log_sigmas,
             )
             if self._emit_stats:
                 (self._x, self._xe, self._h1, self._h2, st_dev, dg_dev) = outs

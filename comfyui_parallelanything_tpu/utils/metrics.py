@@ -48,6 +48,12 @@ byte/eviction gauges, published at /metrics scrape), ``pa_encoder_*``
 the loadgen ``encoder_invocations`` delta), and ``pa_decode_*``
 (serving/decode.py — batched tail decode: dispatch/request counters,
 queue-depth and batched-fraction gauges, wait/step histograms).
+
+Auto-parallel planner (round 18): ``pa_planner_*`` (parallel/planner.py —
+``pa_planner_decisions_total`` / ``pa_planner_divergence_total`` counters
+per plan decision, and the ``pa_planner_predicted_s{mode=}`` /
+``pa_planner_hand_predicted_s`` / ``pa_planner_candidates`` gauges carrying
+the last decision's chosen-vs-shadow-hand score).
 """
 
 from __future__ import annotations

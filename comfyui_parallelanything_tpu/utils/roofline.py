@@ -68,23 +68,27 @@ CALIB_SCHEMA = "pa-roofline-calib/v1"
 CALIB_FILENAME = "roofline_calib.json"
 
 # Platform roofline specs by device_kind substring: peak dense bf16 FLOP/s
-# per chip (the bench._PEAK_BF16 table), HBM bytes/s, and the per-chip ICI /
+# per chip (the bench._PEAK_BF16 table), HBM bytes/s, the per-chip ICI /
 # DCN link bandwidths the collective model divides by (public spec sheets;
 # ICI is the aggregate per-chip interconnect, DCN a conservative per-host
-# 100 Gb/s). Matched in order, first substring hit wins.
+# 100 Gb/s), and ``h2d_bw`` — the host→HBM DMA rate the weight-streaming
+# cost model (parallel/planner.py stream candidates) divides weight bytes
+# by (PCIe-class, deliberately conservative: calibration corrects upward,
+# a too-fast guess would make the planner pick stream over placements that
+# actually win). Matched in order, first substring hit wins.
 PLATFORM_SPECS: tuple[tuple[str, dict], ...] = (
     ("v6", {"peak_flops": 918e12, "hbm_bw": 1640e9, "ici_bw": 448e9,
-            "dcn_bw": 12.5e9}),
+            "dcn_bw": 12.5e9, "h2d_bw": 32e9}),
     ("v5p", {"peak_flops": 459e12, "hbm_bw": 2765e9, "ici_bw": 600e9,
-             "dcn_bw": 12.5e9}),
+             "dcn_bw": 12.5e9, "h2d_bw": 32e9}),
     ("v5e", {"peak_flops": 197e12, "hbm_bw": 819e9, "ici_bw": 200e9,
-             "dcn_bw": 12.5e9}),
+             "dcn_bw": 12.5e9, "h2d_bw": 16e9}),
     ("v5 lite", {"peak_flops": 197e12, "hbm_bw": 819e9, "ici_bw": 200e9,
-                 "dcn_bw": 12.5e9}),
+                 "dcn_bw": 12.5e9, "h2d_bw": 16e9}),
     ("v4", {"peak_flops": 275e12, "hbm_bw": 1228e9, "ici_bw": 300e9,
-            "dcn_bw": 12.5e9}),
+            "dcn_bw": 12.5e9, "h2d_bw": 16e9}),
     ("v3", {"peak_flops": 123e12, "hbm_bw": 900e9, "ici_bw": 200e9,
-            "dcn_bw": 12.5e9}),
+            "dcn_bw": 12.5e9, "h2d_bw": 8e9}),
 )
 
 # Deterministic pseudo-spec for CPU / unknown backends — the same
@@ -93,7 +97,7 @@ PLATFORM_SPECS: tuple[tuple[str, dict], ...] = (
 # predictions land well *under* measured time and roofline_ratio stays in
 # its sane (0, 1.2] band until the calibration store learns the host.
 CPU_SPEC = {"peak_flops": 2e12, "hbm_bw": 50e9, "ici_bw": 10e9,
-            "dcn_bw": 1e9, "generation": "cpu-pseudo"}
+            "dcn_bw": 1e9, "h2d_bw": 10e9, "generation": "cpu-pseudo"}
 
 
 def enabled() -> bool:
@@ -218,16 +222,36 @@ def calib_path(ledger_dir: str | None = None) -> str:
     return os.path.join(ledger_dir or _ledger_dir(), CALIB_FILENAME)
 
 
+# (path → (mtime, scales)) memo: the planner prices candidates on every
+# parallelize call, and an uncached open+parse per wrap is avoidable I/O —
+# a changed mtime (re-bank, test write) invalidates naturally.
+_calib_cache: dict = {}
+_calib_cache_lock = threading.Lock()
+
+
 def load_calibration(path: str | None = None) -> dict:
     """The banked scale factors, ``{}`` when nothing is banked yet (fresh
-    checkouts predict uncalibrated — scale 1.0 everywhere)."""
+    checkouts predict uncalibrated — scale 1.0 everywhere). Memoized by
+    file mtime (one stat per call, parse only on change)."""
+    p = path or calib_path()
     try:
-        with open(path or calib_path()) as f:
+        mtime = os.path.getmtime(p)
+    except OSError:
+        return {}
+    with _calib_cache_lock:
+        cached = _calib_cache.get(p)
+        if cached is not None and cached[0] == mtime:
+            return cached[1]
+    try:
+        with open(p) as f:
             data = json.load(f)
         scales = data.get("scales") if isinstance(data, dict) else None
-        return scales if isinstance(scales, dict) else {}
+        scales = scales if isinstance(scales, dict) else {}
     except (OSError, json.JSONDecodeError):
         return {}
+    with _calib_cache_lock:
+        _calib_cache[p] = (mtime, scales)
+    return scales
 
 
 def save_calibration(scales: dict, path: str | None = None) -> str | None:
@@ -295,7 +319,12 @@ def fit_calibration(records: list[dict]) -> dict:
       ``value`` (measured s/it), keyed ``rung:<rung>``;
     - program-level: any record whose ``roofline_programs`` rows carry a
       ``measured_s`` alongside ``predicted_raw_s`` (bench attaches the DP
-      step program's per-dispatch wall).
+      step program's per-dispatch wall);
+    - plan-level: ``kind="plan"`` decisions (parallel/planner.py, appended
+      by bench/dryrun with the measured step) carrying
+      ``plan_predicted_raw_s`` + ``plan_actual_s``, keyed
+      ``plan:<rung>`` — the feedback loop that sharpens the planner's
+      candidate scores per platform as its decisions get measured.
 
     The fitted scale is the conservative :data:`_FIT_QUANTILE` of the
     measured/raw ratios (see above). Each key additionally rolls up into
@@ -318,9 +347,17 @@ def fit_calibration(records: list[dict]) -> dict:
     for rec in records:
         if rec.get("stale") or rec.get("dryrun") or rec.get("invalid"):
             continue
-        if rec.get("kind") not in ("bench", "loadgen"):
+        if rec.get("kind") not in ("bench", "loadgen", "plan"):
             continue  # error records and virtual-mesh dryruns never fit
         platform = rec.get("platform") or "?"
+        if rec.get("kind") == "plan":
+            pred = rec.get("plan_predicted_raw_s")
+            act = rec.get("plan_actual_s")
+            if isinstance(pred, (int, float)) and isinstance(act, (int, float)):
+                feed(f"plan:{rec.get('rung') or '?'}", platform,
+                     shape_bucket(rec.get("plan_flops")),
+                     float(pred), float(act))
+            continue
         pred_raw = rec.get("predicted_step_raw_s")
         value = rec.get("value")
         if (rec.get("kind") == "bench"

@@ -560,6 +560,16 @@ def health_snapshot(queue: dict | None = None,
     except Exception:
         out["roofline"] = None
     try:
+        # Auto-parallel planner (parallel/planner.py, round 18): the
+        # process's last plan decision — chosen vs shadow hand plan,
+        # divergence/win counters — the /health section the acceptance
+        # gate and a capacity planner read routing decisions from.
+        from ..parallel import planner
+
+        out["plan"] = planner.snapshot()
+    except Exception:
+        out["plan"] = None
+    try:
         # Numerics sentinel (utils/numerics.py): flag state, non-finite
         # event / quarantined-lane totals, last event, and the fingerprint
         # gate's last verdict (scripts/numerics_audit.py).

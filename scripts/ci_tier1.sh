@@ -43,6 +43,14 @@ env -u PALLAS_AXON_POOL_IPS python scripts/numerics_audit.py --check || exit $?
 # failure). Runs after the perf and numerics gates: same ledger, third lens.
 env -u PALLAS_AXON_POOL_IPS python scripts/roofline_report.py --check || exit $?
 
+# Plan gate (round 18): the latest kind=plan ledger record per (rung,
+# platform) must match-or-beat the shadow hand-rule plan by predicted
+# score and keep predicted-vs-actual inside the (0, 1.2] calibration band
+# (scripts/plan_report.py reads the planner decisions bench/dryrun banked
+# — a plan-free ledger is SKIP, never a failure). Runs right after the
+# roofline gate: same ledger, the routing lens.
+env -u PALLAS_AXON_POOL_IPS python scripts/plan_report.py --check || exit $?
+
 # Traffic-twin gate (round 15): the latest kind=openloop ledger record per
 # group must keep |twin p95 - measured p95| / measured within the record's
 # declared error band (scripts/twin_report.py replays the seeded arrival

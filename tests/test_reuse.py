@@ -377,6 +377,147 @@ class TestSiblingSeedFanout:
 
 
 # ---------------------------------------------------------------------------
+# shared traced kwargs (PR 12 remainder): the negative-prompt/uncond traced
+# kwargs ride the broadcast lane path too — a sibling-seed fanout stops
+# stacking identical y/guidance/uncond rows.
+# ---------------------------------------------------------------------------
+
+
+def tiny_model_kw(x, t, context=None, y=None):
+    """tiny_model plus a per-sample traced-kwarg contribution, so a wrong
+    y row (or a dropped uncond kwarg) changes the latent."""
+    import jax.numpy as jnp
+
+    out = tiny_model(x, t, context)
+    yy = jnp.mean(y, axis=-1).reshape((-1,) + (1,) * (x.ndim - 1))
+    return out + 0.05 * yy
+
+
+def _kw_inputs(seed=2000, batch=1):
+    import jax.numpy as jnp
+
+    r = np.random.default_rng(seed)
+    return (
+        jnp.asarray(r.normal(size=(batch, 4)).astype(np.float32)),
+        jnp.asarray(r.normal(size=(batch, 4)).astype(np.float32)),
+    )
+
+
+def _serve_kw_fanout(sched, ctx, uctx, y, uy, seeds, steps=1, timeout=30):
+    """One CFG run_sampler per seed, every request referencing the SAME
+    ctx/uctx/y/uncond-y objects (the embed-cache / node-layer aliasing)."""
+    from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+    results = {}
+
+    def worker(seed):
+        results[seed] = run_sampler(
+            tiny_model_kw, _noise(seed), ctx, sampler="euler", steps=steps,
+            cfg_scale=2.0, uncond_context=uctx, uncond_kwargs={"y": uy},
+            y=y,
+        )
+
+    threads = [threading.Thread(target=worker, args=(s,), daemon=True)
+               for s in seeds]
+    for t in threads:
+        t.start()
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        with sched._lock:
+            tot = sum(len(b.queue) + len(b.active_lanes())
+                      for b in sched.buckets.values())
+        if tot >= len(seeds):
+            break
+        time.sleep(0.005)
+    sched.drain()
+    for t in threads:
+        t.join(timeout)
+    assert len(results) == len(seeds)
+    return results
+
+
+class TestSharedKwargsFanout:
+    def test_uncond_kwargs_ride_the_broadcast_path_bitwise(self, sched):
+        """Acceptance (PR 12 remainder): a sibling-seed fanout whose traced
+        kwargs — the pooled y AND the uncond y — alias by object identity
+        rides the broadcast_kwargs program variant (one [b, ...] tree in
+        HBM, not W stacked rows), with every latent bitwise-equal to its
+        solo run."""
+        from comfyui_parallelanything_tpu.utils.metrics import registry
+
+        ctx, uctx = _ctx(100), _ctx(101)
+        y, uy = _kw_inputs(102)
+        seeds = list(range(70, 74))
+        solo = {}
+        for s in seeds:
+            solo.update(_serve_kw_fanout(sched, ctx, uctx, y, uy, [s]))
+        res = _serve_kw_fanout(sched, ctx, uctx, y, uy, seeds)
+        for s in seeds:
+            np.testing.assert_array_equal(
+                np.asarray(res[s]), np.asarray(solo[s]),
+            )
+        [bucket] = sched.buckets.values()
+        labels = {"bucket": bucket.label}
+        assert (registry.get("pa_serving_kwargs_broadcast_total",
+                             labels) or 0) >= 1
+        assert (registry.get("pa_serving_shared_kwargs_seats_total",
+                             labels) or 0) >= 1
+
+    def test_foreign_kwargs_demote_to_stacked_and_stay_correct(self, sched):
+        """A mid-flight join sharing the cond but carrying DIFFERENT traced
+        kwargs demotes only the kwargs axis to stacked rows; both lanes'
+        trajectories stay bitwise-equal to solo (demotion refills rows from
+        the seated requests — a mode change, never a value change)."""
+        from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+
+        ctx, uctx = _ctx(110), _ctx(111)
+        y_a, uy = _kw_inputs(112)
+        y_b, _ = _kw_inputs(113)
+        solo_a = _serve_kw_fanout(sched, ctx, uctx, y_a, uy, [81],
+                                  steps=8)[81]
+        solo_b = _serve_kw_fanout(sched, ctx, uctx, y_b, uy, [82],
+                                  steps=4)[82]
+        results = {}
+
+        def worker(seed, y, steps):
+            results[seed] = run_sampler(
+                tiny_model_kw, _noise(seed), ctx, sampler="euler",
+                steps=steps, cfg_scale=2.0, uncond_context=uctx,
+                uncond_kwargs={"y": uy}, y=y,
+            )
+
+        ta = threading.Thread(target=worker, args=(81, y_a, 8), daemon=True)
+        ta.start()
+        t0 = time.time()
+        while time.time() - t0 < 30 and not any(
+            b.active_lanes() or len(b.queue)
+            for b in sched.buckets.values()
+        ):
+            time.sleep(0.005)
+        for _ in range(3):
+            sched.pump()  # A is steps in, kwargs-shared...
+        tb = threading.Thread(target=worker, args=(82, y_b, 4), daemon=True)
+        tb.start()
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            with sched._lock:
+                tot = sum(len(b.queue) + len(b.active_lanes())
+                          for b in sched.buckets.values())
+            if tot >= 2:
+                break
+            time.sleep(0.005)
+        sched.drain()  # ...when B's foreign y joins and demotes the kwargs
+        ta.join(30)
+        tb.join(30)
+        [bucket] = sched.buckets.values()
+        assert bucket._kw_mode in (None, "stacked")
+        np.testing.assert_array_equal(np.asarray(results[81]),
+                                      np.asarray(solo_a))
+        np.testing.assert_array_equal(np.asarray(results[82]),
+                                      np.asarray(solo_b))
+
+
+# ---------------------------------------------------------------------------
 # batched tail decode
 # ---------------------------------------------------------------------------
 
