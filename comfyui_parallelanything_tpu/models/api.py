@@ -76,6 +76,22 @@ class DiffusionModel:
     # survive every patch node's dataclasses.replace — hence a field, not an
     # object.__setattr__ side channel.
     source: dict | None = None
+    # Serving delegation for ControlNet compositions (models/controlnet.
+    # apply_control): {"base", "ctrl_apply", "ctrl_params", "hint",
+    # "strength", "start", "end"}. The continuous-batching scheduler buckets
+    # such a model on its BASE, carrying the control net as per-lane state —
+    # so ControlNet traffic co-batches with plain txt2img instead of each
+    # composition getting a private bucket. None → serve as an opaque model.
+    control_delegate: dict | None = None
+    # Serving delegation for baked-LoRA models (the LoraLoader shims): the
+    # {"base", "factors"} pair behind this bake — ``base`` is the UNPATCHED
+    # model object (the checkpoint loader's cached output, so identity
+    # matches plain-traffic prompts) and ``factors`` the extracted
+    # {param_path: (a, b)} map with strength pre-folded. Samplers that see
+    # this submit (base, factors) to the serving tier so per-request LoRA
+    # rides as per-lane state (one shared program, any LoRA mix); inline
+    # legs keep using THIS model's baked params. None → bake only.
+    lora_delegate: dict | None = None
 
     def __call__(self, x, timesteps, context=None, **kwargs):
         """Jit-compiled forward (cached per shape and per ambient sequence_parallel

@@ -242,6 +242,24 @@ def apply_control(
         params=merged,
         name=f"{base.name}+control",
         config=base.config,
+        # Serving delegation (round 16): the scheduler buckets this
+        # composition on the BASE model and carries the control net as
+        # per-lane state, so ControlNet lanes co-batch with plain txt2img.
+        # Chained compositions (base is itself merged) stay opaque — the
+        # lane program carries ONE control trunk per bucket epoch.
+        control_delegate=(
+            None
+            if getattr(base, "control_delegate", None) is not None
+            else {
+                "base": base,
+                "ctrl_apply": ctrl_apply,
+                "ctrl_params": control_net.params,
+                "hint": merged["hint"],
+                "strength": strength,
+                "start": start_p,
+                "end": end_p,
+            }
+        ),
     )
 
 

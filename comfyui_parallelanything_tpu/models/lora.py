@@ -1,0 +1,284 @@
+"""Per-lane LoRA as data: low-rank factor maps oriented to a param pytree.
+
+The reference patches LoRA weights into the ONE live model (its host's
+ModelPatcher bakes deltas in place), so two prompts wanting different LoRAs
+serialize on patch/unpatch. The serving tier instead treats LoRA as request
+state: a factor map ``{param_path: (a, b)}`` with ``W_eff = W + b @ a`` rides
+the ServeRequest, the bucket stacks factors on the lane axis (rank-padded,
+zero rows for LoRA-free lanes), and the lane-step program applies the deltas
+inside the shared eval — the Punica/S-LoRA batched-adapter formulation
+(PAPERS.md), so any LoRA mix shares one compiled program.
+
+Orientation contract: for a target leaf ``W`` of shape ``(m, k)``, the factor
+pair is ``a: (r, k)``, ``b: (m, r)`` and the merge is ``W + b @ a`` — strength
+and alpha/rank are pre-folded into ``b``. Checkpoint LoRA pairs (torch
+``up @ down`` on ``[out, in]`` weights) are re-oriented at extraction time, so
+flax ``kernel`` leaves (``[in, out]``, see convert.linear_kernel) get the
+transposed pair. v1 scope: 2-D targets only (attention/MLP matmuls — where
+LoRA rank lives); conv targets fall back to ``bake_lora`` via merge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import get_logger
+
+# kohya flattens dots to underscores and prefixes the module-tree root; the
+# same strip list bake_lora uses (convert.py) so both patch paths agree.
+_PREFIXES = ("lora_unet_", "lora_transformer_", "lora_te1_", "lora_te2_",
+             "lora_te_", "lora_")
+
+
+def flatten_params(params, prefix=""):
+    """Nested dict pytree → {'/'-joined path: leaf}. Dict-only trees (the flax
+    convention every converter in this repo produces)."""
+    out = {}
+    if isinstance(params, dict):
+        for k in params:
+            out.update(flatten_params(params[k], f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1]] = params
+    return out
+
+
+def get_path(params, path):
+    node = params
+    for part in path.split("/"):
+        node = node[part]
+    return node
+
+
+def set_path(params, path, value):
+    """Functional path update: returns a new tree sharing unmodified subtrees."""
+    parts = path.split("/")
+    def rec(node, i):
+        if i == len(parts) - 1:
+            new = dict(node)
+            new[parts[i]] = value
+            return new
+        new = dict(node)
+        new[parts[i]] = rec(node[parts[i]], i + 1)
+        return new
+    return rec(params, 0)
+
+
+def extract_lora_factors(lora_sd, params, strength=1.0, unmatched_out=None):
+    """LoRA state dict → ``{param_path: (a, b)}`` oriented to ``params``.
+
+    Matching mirrors convert.bake_lora (prefix strip, underscore-normalized
+    lookup, unique-suffix fallback) but against '/'-joined pytree paths with
+    the flax ``kernel`` leaf standing in for torch ``.weight``. Non-2-D and
+    unmatched targets are logged and skipped (reference prints-and-continues
+    on patch failures, any_device_parallel.py:1002-1004);
+    ``unmatched_out`` (a list) additionally collects the skipped base keys,
+    so a caller deciding whether the factor map fully covers a bake (the
+    LoraLoader serving delegate) can tell "clean" from "partial".
+    """
+    from .convert import _lora_pairs, to_numpy
+
+    flat = flatten_params(params)
+    by_norm: dict[str, list[str]] = {}
+    for path in flat:
+        norm = path.replace("/", "_").replace(".", "_")
+        for leaf in ("_kernel", "_weight"):
+            if norm.endswith(leaf):
+                norm = norm[: -len(leaf)]
+                break
+        by_norm.setdefault(norm, []).append(path)
+
+    out: dict[str, tuple] = {}
+    unmatched = []
+    for base, (down, up, alpha) in _lora_pairs(lora_sd).items():
+        stripped = base
+        for prefix in _PREFIXES:
+            if stripped.startswith(prefix):
+                stripped = stripped[len(prefix):]
+                break
+        norm = stripped.replace(".", "_")
+        hits = by_norm.get(norm)
+        if not hits:
+            suffix_hits = [v for k, v in by_norm.items()
+                           if k.endswith("_" + norm)]
+            hits = suffix_hits[0] if len(suffix_hits) == 1 else None
+        if not hits or len(hits) != 1:
+            unmatched.append(base)
+            continue
+        path = hits[0]
+        w = flat[path]
+        down_a = np.asarray(to_numpy(down), np.float32)
+        up_a = np.asarray(to_numpy(up), np.float32)
+        rank = down_a.shape[0]
+        scale = float(strength) * ((alpha / rank) if alpha is not None else 1.0)
+        if getattr(w, "ndim", 0) != 2 or down_a.ndim != 2 or up_a.ndim != 2:
+            unmatched.append(base)
+            continue
+        if w.shape == (up_a.shape[0], down_a.shape[1]):
+            # torch orientation [out, in]: delta = (scale·up) @ down
+            a, b = down_a, up_a * scale
+        elif w.shape == (down_a.shape[1], up_a.shape[0]):
+            # flax kernel [in, out]: delta = down.T @ (scale·up).T
+            a, b = (up_a * scale).T, down_a.T
+        else:
+            unmatched.append(base)
+            continue
+        out[path] = (jnp.asarray(a), jnp.asarray(b))
+    if unmatched:
+        get_logger().warning(
+            "extract_lora_factors: %d LoRA key(s) had no batchable 2-D base "
+            "match and were skipped: %s", len(unmatched), unmatched[:5],
+        )
+        if unmatched_out is not None:
+            unmatched_out.extend(unmatched)
+    return out
+
+
+def combine_factors(maps):
+    """N adapter factor maps → one, by rank concatenation (the multi-LoRA
+    request: Σⱼ bⱼ @ aⱼ == concat(b) @ concat(a), so a 2-LoRA lane costs one
+    padded rank slot, not two program variants)."""
+    maps = [m for m in maps if m]
+    if not maps:
+        return {}
+    if len(maps) == 1:
+        return dict(maps[0])
+    out: dict[str, tuple] = {}
+    for m in maps:
+        for path, (a, b) in m.items():
+            if path in out:
+                a0, b0 = out[path]
+                out[path] = (jnp.concatenate([a0, a], axis=0),
+                             jnp.concatenate([b0, b], axis=1))
+            else:
+                out[path] = (a, b)
+    return out
+
+
+def lora_signature(factors, params):
+    """Hashable shape signature ``((path, m, k), ...)`` sorted by path, or
+    None when any factor does not line up with a leaf of ``params`` — the
+    scheduler's batchability check. nd leaves (head-split attention kernels,
+    conv) are addressed through their ``(shape[0], prod(rest))`` flattening;
+    the merge reshapes the delta back."""
+    if not factors:
+        return ()
+    flat = flatten_params(params)
+    sig = []
+    for path in sorted(factors):
+        a, b = factors[path]
+        w = flat.get(path)
+        if w is None or getattr(w, "ndim", 0) < 2:
+            return None
+        m = int(w.shape[0])
+        k = 1
+        for d in w.shape[1:]:
+            k *= int(d)
+        if a.ndim != 2 or b.ndim != 2 or a.shape[1] != k or b.shape[0] != m \
+                or a.shape[0] != b.shape[1]:
+            return None
+        sig.append((path, m, k))
+    return tuple(sig)
+
+
+def pad_rank(a, b, r_max):
+    """Zero-pad a factor pair to rank ``r_max`` (zero rank slots contribute a
+    bitwise-zero delta, so rank masking is structural, not arithmetic)."""
+    r = a.shape[0]
+    if r == r_max:
+        return a, b
+    a = jnp.pad(a, ((0, r_max - r), (0, 0)))
+    b = jnp.pad(b, ((0, 0), (0, r_max - r)))
+    return a, b
+
+
+def merge_lora_params(params, factors):
+    """Eager merge: new pytree with ``W + b @ a`` at each factor path (shares
+    every untouched subtree). The inline-fallback / width-1-lane twin of the
+    batched in-eval delta. nd targets get the delta reshaped from the
+    ``(shape[0], prod(rest))`` flattening the factors address."""
+    out = params
+    for path, (a, b) in factors.items():
+        w = get_path(out, path)
+        out = set_path(out, path,
+                       (w + (b @ a).reshape(w.shape).astype(w.dtype)))
+    return out
+
+
+def factorize_bake(base_params, baked_params, max_rank=64, rtol=1e-5):
+    """Exact low-rank factor recovery from an eager bake: SVD each changed
+    leaf's delta (flattened to ``(shape[0], prod(rest))``) and keep the
+    factors when the truncation reproduces it. Returns ``{path: (a, b)}``,
+    or None when the bake is not representable — mismatched trees, a
+    changed sub-2-D leaf (bias), or a delta that is not low-rank at
+    ``max_rank`` (then the bake stays authoritative; a PARTIAL factor map
+    must never ship, it would diverge from the bake).
+
+    This is how the LoraLoader shims derive a serving delegate for CONVERTED
+    param layouts (head-split attention kernels, renamed paths) that the
+    checkpoint-keyed ``extract_lora_factors`` cannot address: the bake
+    happens at checkpoint layout, conversion reshapes it, and the delta's
+    rank survives both — so the factors come out of the weights themselves.
+    """
+    flat0 = flatten_params(base_params)
+    flat1 = flatten_params(baked_params)
+    if set(flat0) != set(flat1):
+        return None
+    out: dict[str, tuple] = {}
+    for path, w0 in flat0.items():
+        w1 = flat1[path]
+        if tuple(getattr(w0, "shape", ())) != tuple(getattr(w1, "shape", ())):
+            return None
+        d = np.asarray(w1, np.float32) - np.asarray(w0, np.float32)
+        if not d.any():
+            continue
+        if d.ndim < 2:
+            return None  # a changed bias has no (a, b) form
+        d2 = d.reshape(d.shape[0], -1)
+        u, s, vt = np.linalg.svd(d2, full_matrices=False)
+        cut = s[0] * rtol if s.size else 0.0
+        r = int((s > cut).sum())
+        if r == 0 or r > max_rank:
+            return None
+        b = u[:, :r] * s[:r]
+        a = vt[:r]
+        if not np.allclose(b @ a, d2, rtol=1e-4, atol=max(cut, 1e-7)):
+            return None  # not actually low-rank at this cut
+        out[path] = (jnp.asarray(a), jnp.asarray(b))
+    return out or None
+
+
+def lora_model(model, factors):
+    """DiffusionModel with the factors merged — the eager twin used by inline
+    fallback and width-1 eager lanes. A fresh handle (fresh jit cache), the
+    base model object is untouched.
+
+    Parallel chains (no ``.params`` attribute) merge on their traceable spec
+    and rewrap as a plain DiffusionModel — correctness-preserving inline
+    fallback (the merged single program runs unsharded; the serving lane
+    path is where mesh LoRA traffic belongs)."""
+    if not factors:
+        return model
+    if dataclasses.is_dataclass(model) and hasattr(model, "params"):
+        return dataclasses.replace(
+            model,
+            params=merge_lora_params(model.params, factors),
+            name=f"{model.name}+lora",
+        )
+    from ..sampling.compiled import trace_spec_of
+
+    spec = trace_spec_of(model)
+    if spec is None or not isinstance(spec.params, dict):
+        raise TypeError(
+            "per-request LoRA needs a model with an addressable param "
+            f"pytree; {type(model).__name__} exposes none"
+        )
+    from .api import DiffusionModel
+
+    return DiffusionModel(
+        apply=spec.apply,
+        params=merge_lora_params(spec.params, factors),
+        name=f"{getattr(model, 'name', 'model')}+lora",
+    )

@@ -1163,6 +1163,24 @@ def _collect_control(positive) -> tuple:
     return specs
 
 
+def _split_lora_delegate(model, positive):
+    """(model, lora_factors) for the sampler call: a baked-LoRA model whose
+    LoraLoader attached a clean serving delegate samples through the
+    UNPATCHED base + per-request factors, so the continuous-batching
+    scheduler seats it as a LoRA lane of the base model's bucket (any LoRA
+    mix co-batches with plain traffic in one program; run_sampler merges the
+    factors eagerly on inline legs). The bake stays authoritative whenever
+    the request also carries state the factor recompose can't thread —
+    multi-controlnet chains, inpaint, i2v."""
+    delegate = getattr(model, "lora_delegate", None)
+    if (delegate is None or not delegate.get("factors")
+            or positive.get("inpaint") is not None
+            or positive.get("i2v") is not None
+            or len(_collect_control(positive)) > 1):
+        return model, None
+    return delegate["base"], delegate["factors"]
+
+
 def _model_with_control(model, specs, inpaint=None, i2v=None):
     """Compose ControlNet residual injection into the MODEL (the ``control``
     tags Apply nodes leave on the positive conditioning — chained Apply nodes
@@ -1510,6 +1528,7 @@ class TPUKSampler:
             _prepare_sampling_inputs(model, positive, negative, latent,
                                      rng=rng)
         )
+        model, lora = _split_lora_delegate(model, positive)
         model = _model_with_control(
             model, _collect_control(positive), inpaint=positive.get("inpaint"),
             i2v=positive.get("i2v"),
@@ -1531,6 +1550,7 @@ class TPUKSampler:
             ),
             denoise=denoise,
             latent_mask=latent.get("noise_mask"),
+            lora=lora,
             **kwargs,
         )
         return ({"samples": out},)
@@ -1621,6 +1641,7 @@ class TPUKSamplerAdvanced:
             _prepare_sampling_inputs(model, positive, negative, latent,
                                      rng=rng)
         )
+        model, lora = _split_lora_delegate(model, positive)
         model = _model_with_control(
             model, _collect_control(positive), inpaint=positive.get("inpaint"),
             i2v=positive.get("i2v"),
@@ -1636,6 +1657,7 @@ class TPUKSamplerAdvanced:
             init_latent=latent["samples"],
             latent_mask=latent.get("noise_mask"),
             compile_loop=compile_loop,
+            lora=lora,
             **kwargs,
         )
         return ({"samples": out},)

@@ -776,8 +776,31 @@ class LoraLoader:
         clip_stack = list(source.get("te_loras", ())) + [(lora, strength_clip)]
         patched.source = {**source, "loras": model_stack,
                           "te_loras": clip_stack}
+        patched.lora_delegate = self._lane_delegate(model, patched)
         clip = self._maybe_rebake_clip(clip, source, clip_stack)
         return patched, clip
+
+    @staticmethod
+    def _lane_delegate(model, patched):
+        """The serving-tier twin of this bake: ``{"base", "factors"}`` when
+        the whole bake recovers as exact low-rank factors against the
+        unpatched base (models/lora.factorize_bake — SVD of the per-leaf
+        delta, which works on the CONVERTED layout's head-split/renamed
+        leaves where checkpoint-keyed extraction cannot). The continuous-
+        batching scheduler then buckets LoRA prompts on the base model and
+        carries the factors as per-lane state (one shared program for any
+        LoRA mix), while inline legs keep the bake. None (= bake only)
+        whenever any delta is unrepresentable — a partial factor map would
+        make the served result diverge from the bake. Chained links resolve
+        against the base-most model, so a LoRA stack is still ONE delegate."""
+        from .models.lora import factorize_bake
+
+        base = (getattr(model, "lora_delegate", None) or {}).get("base", model)
+        if not isinstance(getattr(base, "params", None), dict) \
+                or not isinstance(getattr(patched, "params", None), dict):
+            return None
+        factors = factorize_bake(base.params, patched.params)
+        return {"base": base, "factors": factors} if factors else None
 
     @staticmethod
     def _maybe_rebake_clip(clip, source: dict, clip_stack: list):

@@ -1045,7 +1045,8 @@ def compiled_flow_sample(
 def lane_step_program(
     spec: TraceSpec, *, prediction: str, use_cfg: bool, cfg_rescale: float,
     static_kwargs: dict, emit_stats: bool = False, broadcast_cond: bool = False,
-    broadcast_kwargs: bool = False,
+    broadcast_kwargs: bool = False, n_extra: int | None = None,
+    mc_has_y: bool = False, control_apply=None, lora_sig: tuple = (),
 ):
     """The jitted per-step program for one serving bucket (W = lane width,
     b = per-request batch):
@@ -1053,7 +1054,9 @@ def lane_step_program(
     ``fn(params, x[W,b,...], xe[W,b,...], h1[W,b,...], h2[W,b,...],
     sigma_eval[W], active[W] f32, cfg_scale[W], coef[W,4,6] f32,
     noise_keys[W,2] u32, context[W,b,L,D]|None, uncond_context|None, kwargs,
-    u_kwargs, log_sigmas|None) -> (x', xe', h1', h2')``
+    u_kwargs, log_sigmas|None, mask[W,b,...], mask_init[W,b,...],
+    mask_noise[W,b,...], mask_mix[W,3], [capability overlays...])
+    -> (x', xe', h1', h2')``
 
     One batched model eval at per-lane ``(xe, sigma_eval)`` — the σ→timestep
     log-interp, 1/√(σ²+1) input scaling, and CFG mix (per-lane cfg_scale) all
@@ -1092,15 +1095,60 @@ def lane_step_program(
     axis inside the program, exactly like ``broadcast_cond`` above. A
     sibling-seed fanout then stops stacking identical uncond rows too:
     same values, same downstream graph as the stacked variant (the flatten
-    sees the identical ``[n, ...]`` tree either way)."""
+    sees the identical ``[n, ...]`` tree either way).
+
+    Capability axes (round 16, universal lane batching). Every feature that
+    used to force inline fallback is per-lane STATE here, so a mixed queue
+    shares the one dispatch:
+
+    - **denoise mask** (img2img/inpaint) — always-on inputs ``mask`` /
+      ``mask_init`` / ``mask_noise`` ``[W, b, ...]`` plus a per-dispatch
+      ``mask_mix[W, 3]`` of ``(gate, keep_a, keep_b)`` host scalars. On
+      σ-interval completion the lane's x'/xe' re-pin the keep region to
+      ``keep_a·init + keep_b·noise`` (the eager masked_callback formula per
+      prediction family); zero-gate lanes are a where-select pass-through, so
+      plain txt2img lanes ride the SAME program — no variant, no recompile,
+      bitwise across any traffic mix.
+    - **multi-cond CFG** (``n_extra`` = the bucket's max extra-cond count K) —
+      K extra eval row-blocks share the model call; per-lane weight maps
+      ``mc_w0``/``mc_w`` (area/mask/strength composed host-side at seat,
+      zero for non-users) and traced per-extra progress windows ``mc_win``
+      reproduce EpsDenoiser._combine_conds op-for-op, with zero-weight lanes
+      falling through to their own eps bitwise (den == 0 → primary).
+    - **ControlNet** (``control_apply``) — the control trunk joins the shared
+      eval over ALL rows with a per-lane hint stack and traced per-lane
+      ``(strength, window)``; residuals scale by the apply_control gate and
+      feed the base model's ``control`` kwarg. Zero-strength lanes get exact
+      zero residual trees (additive no-op on values).
+    - **per-lane LoRA** (``lora_sig`` = ordered ``(path, m, k)`` targets) —
+      A/B factors arrive stacked on the lane axis (rank-padded to the
+      bucket's max; zero factors → bitwise-identity delta) and the eval
+      re-groups rows lane-major and vmaps the model with per-lane merged
+      target leaves ``W + b @ a`` — the Punica/S-LoRA batched-adapter
+      formulation, so any LoRA mix shares one compiled program.
+
+    Each overlay is a cached program VARIANT (same bounded loop-jit cache the
+    PR 12 shared→stacked demotion uses): materializing a capability the
+    bucket epoch hasn't seen compiles once; traffic mix within a capability
+    set never recompiles. Cross-variant legs are allclose-at-bf16, same-
+    program legs stay bitwise (the serving equivalence matrix pins both)."""
+    lora_sig = tuple(tuple(t) for t in lora_sig)
     meta = ("serve", prediction, bool(use_cfg), float(cfg_rescale),
-            bool(emit_stats), bool(broadcast_cond), bool(broadcast_kwargs))
+            bool(emit_stats), bool(broadcast_cond), bool(broadcast_kwargs),
+            None if n_extra is None else int(n_extra), bool(mc_has_y),
+            control_apply, lora_sig)
+    use_mc = n_extra is not None
+    K = int(n_extra or 0)
+    use_control = control_apply is not None
     apply_fn, mesh, axis = spec.apply, spec.mesh, spec.data_axis
 
     def build(bound_static):
         def impl(params, x, xe, h1, h2, sigma_eval, active, cfg_scale, coef,
                  noise_keys, context, uncond_context, kwargs, u_kwargs,
-                 log_sigmas):
+                 log_sigmas, mask, mask_init, mask_noise, mask_mix,
+                 mc_w0=None, mc_ctx=None, mc_w=None, mc_win=None, mc_y=None,
+                 ctrl_params=None, ctrl_hint=None, ctrl_strength=None,
+                 ctrl_win=None, lora_ab=()):
             model = _model_fn(apply_fn, params, bound_static)
             W, b = x.shape[0], x.shape[1]
             n = W * b
@@ -1152,22 +1200,158 @@ def lane_step_program(
                 x_in = flat * bcast(scale_flat, flat.ndim)
             ctx = None if context is None else flatten(context)
             kw = flatten(kwargs) if kwargs else {}
+
+            # --- role blocks: [cond | uncond? | extra_0 .. extra_{K-1}],
+            # each n rows of the ONE shared eval. Inline calls the model once
+            # per extra (token lengths may differ there); bucket eligibility
+            # pins extras to the primary's (L, D), so here they batch.
+            roles_ctx = [ctx]
+            roles_kw = [kw]
             if use_cfg:
-                u_kw = flatten(u_kwargs) if u_kwargs else None
-                kw2 = double_kwargs(kw, u_kw, n)
-                uctx = flatten(uncond_context)
-                eps_both = model(
-                    jnp.concatenate([x_in, x_in], axis=0),
-                    jnp.concatenate([t_vec, t_vec], axis=0),
-                    jnp.concatenate([ctx, uctx], axis=0),
-                    **kw2,
+                u_kw = flatten(u_kwargs) if u_kwargs else {}
+                extra_keys = set(u_kw) - set(kw)
+                if extra_keys:
+                    raise ValueError(
+                        f"uncond kwargs carry keys absent from cond kwargs: "
+                        f"{sorted(extra_keys)}"
+                    )
+                roles_ctx.append(flatten(uncond_context))
+                roles_kw.append({**kw, **u_kw})
+            for k_i in range(K):
+                roles_ctx.append(
+                    mc_ctx[:, k_i].reshape((n,) + mc_ctx.shape[3:])
                 )
-                eps_c, eps_u = jnp.split(eps_both, 2, axis=0)
+                kw_e = dict(kw)
+                if mc_has_y:
+                    kw_e["y"] = mc_y[:, k_i].reshape((n,) + mc_y.shape[3:])
+                roles_kw.append(kw_e)
+            R = len(roles_kw)
+
+            if use_control:
+                hint_flat = ctrl_hint.reshape((n,) + ctrl_hint.shape[2:])
+                # apply_control's gate, per lane: strength × progress window
+                # (ops.basic.progress_window_gate with traced bounds; the
+                # default (0, 1) window is exactly 1.0, matching the inline
+                # no-window fast path bitwise). apply_control keeps the
+                # eps/v linear-in-t approximation for every family.
+                prog_c = 1.0 - t_vec / 999.0
+                on = (prog_c >= lane(ctrl_win[:, 0])) & (
+                    prog_c <= lane(ctrl_win[:, 1])
+                )
+                gain_flat = lane(ctrl_strength) * on.astype(jnp.float32)
+
+            if lora_sig:
+                # Lane-major layout: rows grouped per lane [W, R·b, ...] and
+                # the model vmapped over lanes with per-lane merged LoRA
+                # target leaves (W_eff = W + b @ a; zero-padded factors give
+                # a bitwise-zero delta for LoRA-free lanes / rank slots).
+                from ..models.lora import get_path as _getp, set_path as _setp
+
+                group = lambda r_: r_.reshape((W, b) + r_.shape[1:])  # noqa: E731
+                cat1 = lambda rs: jnp.concatenate(rs, axis=1)  # noqa: E731
+                x_l = cat1([group(x_in)] * R)
+                t_l = cat1([group(t_vec)] * R)
+                ctx_l = (
+                    None if ctx is None
+                    else cat1([group(r_) for r_ in roles_ctx])
+                )
+                kw_l = {
+                    k_: cat1([group(r_[k_]) for r_ in roles_kw])
+                    for k_ in kw
+                }
+                hint_l = (
+                    cat1([group(hint_flat)] * R) if use_control else None
+                )
+                gain_l = (
+                    cat1([group(gain_flat)] * R) if use_control else None
+                )
+
+                def one_lane(ab, xr, tr, cr, kwr, hr, gr):
+                    p = params
+                    for (path, _m, _k), (a_, b_) in zip(lora_sig, ab):
+                        w_ = _getp(p, path)
+                        # nd targets: the factors address the
+                        # (shape[0], prod(rest)) flattening (models/lora.py).
+                        p = _setp(p, path, w_ + (b_ @ a_)
+                                  .reshape(w_.shape).astype(w_.dtype))
+                    call_kw = dict(kwr)
+                    if use_control:
+                        ctrl = control_apply(
+                            ctrl_params, xr, tr, cr, hint=hr,
+                            y=kwr.get("y"),
+                        )
+                        ctrl = jax.tree.map(
+                            lambda r_: r_ * bcast(gr, r_.ndim), ctrl
+                        )
+                        call_kw["control"] = ctrl
+                    return apply_fn(p, xr, tr, cr, **call_kw, **bound_static)
+
+                out_l = jax.vmap(
+                    one_lane,
+                    in_axes=(0, 0, 0, None if ctx_l is None else 0, 0,
+                             None if hint_l is None else 0,
+                             None if gain_l is None else 0),
+                )(lora_ab, x_l, t_l, ctx_l, kw_l, hint_l, gain_l)
+                outs = [
+                    r_.reshape((n,) + r_.shape[2:])
+                    for r_ in jnp.split(out_l, R, axis=1)
+                ]
+            else:
+                x_all = jnp.concatenate([x_in] * R, axis=0)
+                t_all = jnp.concatenate([t_vec] * R, axis=0)
+                ctx_all = (
+                    None if ctx is None
+                    else jnp.concatenate(roles_ctx, axis=0)
+                )
+                kw_all = {
+                    k_: jnp.concatenate([r_[k_] for r_ in roles_kw], axis=0)
+                    for k_ in kw
+                }
+                if use_control:
+                    hint_all = jnp.concatenate([hint_flat] * R, axis=0)
+                    gain_all = jnp.concatenate([gain_flat] * R, axis=0)
+                    ctrl = control_apply(
+                        ctrl_params, x_all, t_all, ctx_all, hint=hint_all,
+                        y=kw_all.get("y"),
+                    )
+                    kw_all["control"] = jax.tree.map(
+                        lambda r_: r_ * bcast(gain_all, r_.ndim), ctrl
+                    )
+                out = model(x_all, t_all, ctx_all, **kw_all)
+                outs = (
+                    jnp.split(out, R, axis=0) if R > 1 else [out]
+                )
+
+            eps_c = outs[0]
+            if use_mc:
+                # EpsDenoiser._combine_conds, lane-batched: per-lane weight
+                # maps (strength/area/mask composed at seat, full [W, b, ...]
+                # per-sample stacks) flatten like the state; zero-map lanes
+                # give den == 0 → the primary eps passes through bitwise.
+                m0_rows = mc_w0.reshape((n,) + mc_w0.shape[2:])
+                num = m0_rows * eps_c
+                den = m0_rows * jnp.ones_like(eps_c[..., :1])
+                flow_t = prediction == "flow"
+                prog_m = 1.0 - (t_vec if flow_t else t_vec / 999.0)
+                for k_i in range(K):
+                    eps_e = outs[1 + (1 if use_cfg else 0) + k_i]
+                    g = (
+                        (prog_m >= lane(mc_win[:, k_i, 0]))
+                        & (prog_m <= lane(mc_win[:, k_i, 1]))
+                    ).astype(jnp.float32)
+                    m_k = mc_w[:, k_i].reshape(
+                        (n,) + mc_w.shape[3:]
+                    ) * g.reshape((-1,) + (1,) * (eps_e.ndim - 1))
+                    num = num + m_k * eps_e
+                    den = den + m_k * jnp.ones_like(eps_e[..., :1])
+                eps_c = jnp.where(den > 0, num / jnp.maximum(den, 1e-8), eps_c)
+            if use_cfg:
+                eps_u = outs[1]
                 cfg_flat = bcast(lane(cfg_scale), eps_c.ndim)
                 eps = eps_u + cfg_flat * (eps_c - eps_u)
                 eps = rescale_guidance(eps, eps_c, float(cfg_rescale))
             else:
-                eps = model(x_in, t_vec, ctx, **kw)
+                eps = eps_c
             if prediction == "v":
                 x0_flat = (
                     flat / bcast(s_flat**2 + 1.0, flat.ndim)
@@ -1197,6 +1381,26 @@ def lane_step_program(
             new = tuple(
                 _constrain(jnp.where(live, mix(j), old), mesh, axis)
                 for j, old in enumerate((x, xe, h1, h2))
+            )
+            # Denoise-mask re-pin (always-on capability axis): on σ-interval
+            # completion a masked lane's x'/xe' keep region re-pins to
+            # keep_a·init + keep_b·noise — the eager masked_callback blend,
+            # gated per lane by the host-computed mask_mix so maskless lanes
+            # are a structural where-pass-through (histories untouched,
+            # matching the inline path where the blend is a post-step
+            # callback that never sees sampler history).
+            m_gate = bcast(mask_mix[:, 0] > 0, x.ndim)
+            keep = (
+                bcast(mask_mix[:, 1], x.ndim) * mask_init
+                + bcast(mask_mix[:, 2], x.ndim) * mask_noise
+            )
+            blend = lambda v: (  # noqa: E731
+                _mask_blend(v, mask, keep)
+            ).astype(x.dtype)
+            new = (
+                _constrain(jnp.where(m_gate, blend(new[0]), new[0]), mesh, axis),
+                _constrain(jnp.where(m_gate, blend(new[1]), new[1]), mesh, axis),
+                new[2], new[3],
             )
             if not emit_stats:
                 return new

@@ -239,6 +239,43 @@ def make_sigmas(
     )
 
 
+def area_weight(area, strength: float, shape, mask=None,
+                mask_strength: float = 1.0, area_pct=None):
+    """Per-pixel weight for one cond: ``strength`` everywhere (no
+    scoping), strength inside the (h, w, y, x) latent-unit box (SetArea),
+    or a pixel-space MASK resized to the latent grid (SetMask — stock's
+    mask conditioning; "mask bounds" and "default" produce the same
+    weights, the bounds only being stock's compute-crop optimization).
+    Non-2D latents (video) use the full frame — stock scoping is 2D.
+
+    Module-level (round 16) so the serving bucket composes the SAME weight
+    maps host-side at seat time for the lane program's per-lane ``mc_w0`` /
+    ``mc_w`` stacks; EpsDenoiser._area_mask delegates here."""
+    weight = jnp.float32(strength)
+    if area_pct is not None and area is None and len(shape) == 4:
+        # Fractional box (ConditioningSetAreaPercentage): resolve against
+        # the LATENT frame at weight time, when its shape is known.
+        fh, fw, fy, fx = (float(v) for v in area_pct)
+        area = (max(1, round(fh * shape[1])), max(1, round(fw * shape[2])),
+                round(fy * shape[1]), round(fx * shape[2]))
+    if area is not None and len(shape) == 4:
+        h, w, y, x0 = (int(v) for v in area)
+        box = jnp.zeros((1, shape[1], shape[2], 1), jnp.float32)
+        weight = weight * box.at[:, y:y + h, x0:x0 + w, :].set(1.0)
+    if mask is not None and len(shape) == 4:
+        from ..models.vae import normalize_mask
+
+        m = normalize_mask(mask, (shape[1], shape[2]))
+        if m.shape[0] not in (1, shape[0]):
+            m = m[:1]
+        # Both present (SetMask then SetArea): stock composes — the area
+        # crop times the mask weight inside it (get_area_and_mult), with
+        # the mask's OWN strength multiplier kept separate from the
+        # area's (stock's strength × mask_strength).
+        weight = weight * m * jnp.float32(mask_strength)
+    return weight
+
+
 class EpsDenoiser:
     """Wraps a model forward into ``denoise(x, sigma) -> x0`` with batched CFG
     (cond ‖ uncond in one call — what feeds the DP path its batch, ddim.py).
@@ -309,35 +346,8 @@ class EpsDenoiser:
 
     def _area_mask(self, area, strength: float, shape, mask=None,
                    mask_strength: float = 1.0, area_pct=None):
-        """Per-pixel weight for one cond: ``strength`` everywhere (no
-        scoping), strength inside the (h, w, y, x) latent-unit box (SetArea),
-        or a pixel-space MASK resized to the latent grid (SetMask — stock's
-        mask conditioning; "mask bounds" and "default" produce the same
-        weights, the bounds only being stock's compute-crop optimization).
-        Non-2D latents (video) use the full frame — stock scoping is 2D."""
-        weight = jnp.float32(strength)
-        if area_pct is not None and area is None and len(shape) == 4:
-            # Fractional box (ConditioningSetAreaPercentage): resolve against
-            # the LATENT frame at weight time, when its shape is known.
-            fh, fw, fy, fx = (float(v) for v in area_pct)
-            area = (max(1, round(fh * shape[1])), max(1, round(fw * shape[2])),
-                    round(fy * shape[1]), round(fx * shape[2]))
-        if area is not None and len(shape) == 4:
-            h, w, y, x0 = (int(v) for v in area)
-            box = jnp.zeros((1, shape[1], shape[2], 1), jnp.float32)
-            weight = weight * box.at[:, y:y + h, x0:x0 + w, :].set(1.0)
-        if mask is not None and len(shape) == 4:
-            from ..models.vae import normalize_mask
-
-            m = normalize_mask(mask, (shape[1], shape[2]))
-            if m.shape[0] not in (1, shape[0]):
-                m = m[:1]
-            # Both present (SetMask then SetArea): stock composes — the area
-            # crop times the mask weight inside it (get_area_and_mult), with
-            # the mask's OWN strength multiplier kept separate from the
-            # area's (stock's strength × mask_strength).
-            weight = weight * m * jnp.float32(mask_strength)
-        return weight
+        return area_weight(area, strength, shape, mask=mask,
+                           mask_strength=mask_strength, area_pct=area_pct)
 
     def _combine_conds(self, eps_c, x_in, t_vec, batch):
         """Area-weight-normalized blend of the primary cond's prediction with

@@ -74,6 +74,28 @@ def _compiled_spec(model, callback):
     return spec
 
 
+def _merge_lora(model, factors):
+    """Eager factor merge for inline legs. A ControlNet composition nests its
+    base params under "base" while the factor paths address the BASE pytree,
+    so recompose around the merged base via the serving delegate instead of
+    patching the merged tree."""
+    from ..models.lora import lora_model
+
+    delegate = getattr(model, "control_delegate", None)
+    if delegate is None:
+        return lora_model(model, factors)
+    from ..models.api import DiffusionModel
+    from ..models.controlnet import apply_control
+
+    return apply_control(
+        lora_model(delegate["base"], factors),
+        DiffusionModel(apply=delegate["ctrl_apply"],
+                       params=delegate["ctrl_params"], name="ctrl"),
+        delegate["hint"], delegate["strength"],
+        delegate["start"], delegate["end"],
+    )
+
+
 def _traced_sampler_run(fn):
     """Wrap the whole dispatch in a ``sampler-run`` span (utils/tracing.py) —
     the per-prompt timeline node every step/lane-wait span nests under.
@@ -124,6 +146,7 @@ def run_sampler(
     cond_mask=None,
     cond_strength: float = 1.0,
     cond_mask_strength: float = 1.0,
+    lora: dict | None = None,
     **model_kwargs,
 ) -> jnp.ndarray:
     """Drive ``model`` from ``noise`` to a clean latent with the named sampler.
@@ -163,6 +186,19 @@ def run_sampler(
     (timestep-indexed, not sigma-driven) rejects it."""
     use_cfg = cfg_scale != 1.0 and uncond_context is not None
     eff_cfg = cfg_scale if use_cfg else 1.0
+    # Per-request LoRA (round 16): ``lora`` maps param paths to low-rank
+    # (a, b) factor pairs (models/lora.py extract_lora_factors). The inline
+    # paths run the eagerly merged model; the serving path submits the BASE
+    # model + factors so LoRA lanes co-batch with plain traffic (the lane
+    # program applies W + b@a per lane). The merge is deferred past the
+    # serving seam — a served request must never pay it.
+    lora_factors = None
+    if lora:
+        lora_factors = dict(lora)
+        if sampler in ("ddim", "flow_euler"):
+            # TPU-native extras: not in the lane registry, always inline.
+            model = _merge_lora(model, lora_factors)
+            lora_factors = None
     # Model-level sampler preferences (patch nodes, e.g. RescaleCFG): defaults
     # only — an explicit caller value wins.
     prefs = getattr(model, "sampler_prefs", None) or {}
@@ -439,24 +475,28 @@ def run_sampler(
             x = init_latent + x
     if sampler in RNG_SAMPLERS and rng is None:
         rng = jax.random.key(0)
-    # Continuous-batching seam (round 7, widened round 10, serving/): when a
-    # scheduler is installed, route eligible work — any registered
+    # Continuous-batching seam (round 7, widened rounds 10 and 16, serving/):
+    # when a scheduler is installed, route eligible work — any registered
     # LaneStepSpec sampler (stateful and stochastic included), no user
-    # callback, no inpaint mask, no multi-cond — into a shared step-boundary
-    # batch with whatever other requests are in flight. Stochastic lanes are
-    # occupancy-deterministic because the per-step noise key is
-    # fold_in(base, i) on BOTH paths (same base as the eager call below).
-    # Ineligible or refused work falls through to the inline paths unchanged;
-    # compile_loop callers asked for the whole-loop program and are never
-    # hijacked.
-    if not compile_loop and callback is None and latent_mask is None \
-            and not multi_cond:
+    # callback — into a shared step-boundary batch with whatever other
+    # requests are in flight. Denoise-masked img2img/inpaint, multi-cond CFG
+    # extras, delegated ControlNet compositions, and per-request LoRA all
+    # ride the lane as per-lane state (round 16) instead of forcing inline.
+    # Stochastic lanes are occupancy-deterministic because the per-step noise
+    # key is fold_in(base, i) on BOTH paths (same base as the eager call
+    # below). Ineligible or refused work falls through to the inline paths
+    # unchanged; compile_loop callers asked for the whole-loop program and
+    # are never hijacked.
+    if not compile_loop and callback is None:
         from ..serving.scheduler import get_scheduler
 
         _sched = get_scheduler()
         if _sched is not None:
+            from ..utils.metrics import registry as _registry
+
             ticket = _sched.maybe_submit(
-                model=model, x=x, sigmas=sigmas, context=context,
+                model=model,  # still the LoRA base — the merge is deferred
+                x=x, sigmas=sigmas, context=context,
                 sampler=sampler, cfg_scale=eff_cfg,
                 uncond_context=uncond_context, uncond_kwargs=uncond_kwargs,
                 alphas_cumprod=acp, prediction=prediction,
@@ -465,6 +505,14 @@ def run_sampler(
                     jax.random.fold_in(rng, 1)
                     if sampler in RNG_SAMPLERS else None
                 ),
+                latent_mask=latent_mask,
+                mask_init=init_latent if latent_mask is not None else None,
+                mask_noise=noise if latent_mask is not None else None,
+                extra_conds=extra_conds, cond_area=cond_area,
+                cond_area_pct=cond_area_pct, cond_mask=cond_mask,
+                cond_strength=cond_strength,
+                cond_mask_strength=cond_mask_strength,
+                lora=lora_factors,
             )
             if ticket is not None:
                 try:
@@ -477,6 +525,45 @@ def run_sampler(
 
                     record_rung("inline-fallback",
                                 f"{sampler}: {e}", sampler=sampler)
+                    _registry.counter(
+                        "pa_serving_inline_fallback_total",
+                        labels={"reason": "degraded", "sampler": sampler},
+                        help="sampler runs that fell back to the inline "
+                             "eager loop with a scheduler installed",
+                    )
+            else:
+                # A scheduler was installed but could not take this request
+                # (capability/shape/queue ineligibility): it runs inline.
+                # Round 16's loadgen mixed-workload summary watches this
+                # counter — eligible mixed traffic must NOT tick it.
+                _registry.counter(
+                    "pa_serving_inline_fallback_total",
+                    labels={"reason": "ineligible", "sampler": sampler},
+                    help="sampler runs that fell back to the inline eager "
+                         "loop with a scheduler installed",
+                )
+    if lora_factors:
+        # Inline (or shed-from-serving) leg: merge the factors eagerly. A
+        # ControlNet composition nests its base params under "base" (the
+        # factor paths address the BASE pytree), so recompose around the
+        # merged base via the delegate instead of patching the merged tree.
+        from ..models.lora import lora_model
+
+        delegate = getattr(model, "control_delegate", None)
+        if delegate is not None:
+            from ..models.api import DiffusionModel
+            from ..models.controlnet import apply_control
+
+            model = apply_control(
+                lora_model(delegate["base"], lora_factors),
+                DiffusionModel(apply=delegate["ctrl_apply"],
+                               params=delegate["ctrl_params"],
+                               name="ctrl"),
+                delegate["hint"], delegate["strength"],
+                delegate["start"], delegate["end"],
+            )
+        else:
+            model = lora_model(model, lora_factors)
     if compile_loop:
         spec = _compiled_spec(model, callback)
         if spec is not None:

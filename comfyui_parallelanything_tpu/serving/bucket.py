@@ -60,6 +60,7 @@ at bf16 tolerances on CPU and the 8-device mesh.
 from __future__ import annotations
 
 import dataclasses
+import math
 import threading
 import time
 import uuid
@@ -126,6 +127,23 @@ class ServeRequest:
     acp: Any                    # alphas_cumprod or None (default schedule)
     sampler: str = "euler"      # LaneStepSpec registry name
     rng: Any = None             # stochastic base key (None → deterministic)
+    # Capability state (round 16, universal lane batching) — everything a
+    # feature-carrying request needs rides the request itself, so a
+    # degradation-ladder re-seat (_drain_bucket → _reseat) reconstructs the
+    # full per-lane state from step 0, not just (x, xe, h1, h2).
+    latent_mask: Any = None     # denoise mask (img2img/inpaint), 1 = denoise
+    mask_init: Any = None       # keep-region init latent reference
+    mask_noise: Any = None      # keep-region unit-noise reference
+    extra_conds: tuple = ()     # multi-cond CFG extras (EpsDenoiser schema)
+    cond_area: Any = None       # primary-cond scoping (SetArea family)
+    cond_area_pct: Any = None
+    cond_mask: Any = None
+    cond_strength: float = 1.0
+    cond_mask_strength: float = 1.0
+    control: dict | None = None  # {"apply", "params", "hint", "strength",
+                                 #  "start", "end"} from model.control_delegate
+    lora: dict | None = None    # {param_path: (a, b)} — W_eff = W + b @ a
+    eager_model: Any = None     # width-1 eager twin (merged control/LoRA)
     priority: int = 0
     deadline: float | None = None          # time.monotonic() deadline
     progress_hook: Optional[Callable[[int, int], None]] = None
@@ -290,6 +308,37 @@ class StepBucket:
         self._ukw_ref = None
         self._kw_dev = None           # placed shared copies (mesh: replicated)
         self._ukw_dev = None
+        # Capability overlays (round 16, universal lane batching). The
+        # denoise-mask axis is ALWAYS-ON — zero stacks built with the state,
+        # no program variant, so any txt2img/img2img mix shares ONE program
+        # bitwise. Multi-cond / ControlNet / LoRA overlays materialize
+        # lazily the first time a carrying request seats: each
+        # materialization swaps the program variant once per bucket epoch
+        # (the PR 12 shared→stacked demotion precedent), after which any
+        # traffic mix rides the variant without recompiling. Every overlay
+        # keeps zero rows structurally inert (zero mask gate / zero weight
+        # map / zero residual gain / zero factors), so non-carrying lanes
+        # pass through bitwise.
+        self._mask = None             # [W, b, ...] f32 denoise masks
+        self._mask_init = None        # [W, b, ...] keep-region init latents
+        self._mask_noise = None       # [W, b, ...] keep-region unit noise
+        self._mask_has = np.zeros(self.width, bool)   # host gate source
+        self._mc_k = None             # None → overlay off; else bucket max K
+        self._mc_has_y = False
+        self._mc_w0 = None            # [W, b, ..., 1] primary weight maps
+        self._mc_ctx = None           # [W, K, b, L, D] extra cond rows
+        self._mc_w = None             # [W, K, b, ..., 1] extra weight maps
+        self._mc_y = None             # [W, K, b, Y] pooled rows (has_y only)
+        self._mc_win = None           # host [W, K, 2] progress windows
+        self._ctrl = None             # {"apply", "params", "params_ref"}
+        self._ctrl_hint = None        # [W, b, H8, W8, C] hint stack
+        self._ctrl_strength = np.zeros(self.width, np.float32)
+        self._ctrl_win = np.tile(
+            np.asarray([0.0, 1.0], np.float32), (self.width, 1)
+        )
+        self._lora_sig = ()           # ordered ((path, m, k), ...)
+        self._lora_rmax = 0
+        self._lora_ab = []            # per path: (a[W,r,k], b[W,m,r]) stacks
         self._jnp = jnp
         self._model_sigmas = model_sigmas
         self._default_schedule = scaled_linear_schedule
@@ -318,6 +367,23 @@ class StepBucket:
         self._kw_mode = None
         self._kw_ref = self._ukw_ref = None
         self._kw_dev = self._ukw_dev = None
+        # Capability overlays drop with the state: the next burst re-enters
+        # the overlay-free (cheapest) program variant from scratch.
+        self._mask = self._mask_init = self._mask_noise = None
+        self._mask_has = np.zeros(self.width, bool)
+        self._mc_k = None
+        self._mc_has_y = False
+        self._mc_w0 = self._mc_ctx = self._mc_w = self._mc_y = None
+        self._mc_win = None
+        self._ctrl = None
+        self._ctrl_hint = None
+        self._ctrl_strength = np.zeros(self.width, np.float32)
+        self._ctrl_win = np.tile(
+            np.asarray([0.0, 1.0], np.float32), (self.width, 1)
+        )
+        self._lora_sig = ()
+        self._lora_rmax = 0
+        self._lora_ab = []
         self._program = None
 
     def _gauges(self) -> None:
@@ -358,6 +424,14 @@ class StepBucket:
         self._xe = self._zeros_stack(req.x)
         self._h1 = self._zeros_stack(req.x)
         self._h2 = self._zeros_stack(req.x)
+        # Denoise-mask stacks are always-on (the mask axis has no program
+        # variant): zero rows + a zero host gate make maskless lanes a
+        # structural where-pass-through inside the program.
+        self._mask = self._zeros_stack(
+            self._jnp.zeros(req.x.shape, self._jnp.float32)
+        )
+        self._mask_init = self._zeros_stack(req.x)
+        self._mask_noise = self._zeros_stack(req.x)
         # Traced-kwargs stacks build lazily: a fresh epoch enters SHARED
         # kwargs mode (_seat_kwargs), so the [W, ...] stacks only exist
         # after a foreign-kwargs demotion.
@@ -386,6 +460,10 @@ class StepBucket:
             emit_stats=self._emit_stats,
             broadcast_cond=self._cond_mode == "shared",
             broadcast_kwargs=self._kw_mode == "shared",
+            n_extra=self._mc_k,
+            mc_has_y=self._mc_has_y,
+            control_apply=None if self._ctrl is None else self._ctrl["apply"],
+            lora_sig=self._lora_sig,
             **self._prog_kw,
         )
 
@@ -542,9 +620,302 @@ class StepBucket:
                 lambda stack, v: stack.at[i].set(v), self._ukw, req.u_traced
             )
 
-    def _set_lane(self, i: int, req: ServeRequest) -> None:
+    # -- capability overlays (round 16) -------------------------------------
+
+    def _mc_map(self, req: ServeRequest, w):
+        """One cond's weight (scalar / [1,H,W,1] / [b,H,W,1] from
+        ``area_weight``) materialized to the bucket's FIXED full per-sample
+        map shape — [b, *spatial, 1] for 4-D latents, [b, 1, ...] otherwise —
+        so scalar-weight and masked lanes share one stack."""
+        jnp = self._jnp
+        b = req.x.shape[0]
+        if req.x.ndim == 4:
+            tgt = (b,) + tuple(req.x.shape[1:-1]) + (1,)
+        else:
+            tgt = (b,) + (1,) * (req.x.ndim - 1)
+        return jnp.broadcast_to(jnp.asarray(w, jnp.float32), tgt)
+
+    def _ensure_mc(self, req: ServeRequest) -> None:
+        """Materialize / grow the multi-cond overlay (bucket-key discipline:
+        the extra count K only grows within an epoch — pad-to-max — and the
+        pooled-y leg switches on at most once; either change swaps the
+        program variant and refills every seated lane's rows from its own
+        request, a mode change never a value change)."""
+        k_req = len(req.extra_conds or ())
+        if not k_req and self._mc_k is None:
+            return
+        need_y = self._mc_has_y or any(
+            e.get("pooled") is not None for e in (req.extra_conds or ())
+        )
+        if self._mc_k is not None and k_req <= self._mc_k \
+                and need_y == self._mc_has_y:
+            return
+        jnp = self._jnp
+        k_new = max(k_req, self._mc_k or 0)
+        map_t = self._mc_map(req, jnp.float32(0.0))
+        self._mc_w0 = self._zeros_stack(map_t)
+        self._mc_w = self._zeros_stack(
+            jnp.zeros((k_new,) + tuple(map_t.shape), jnp.float32)
+        )
+        self._mc_ctx = self._zeros_stack(
+            jnp.zeros((k_new,) + tuple(req.context.shape), req.context.dtype)
+        )
+        self._mc_y = None
+        if need_y:
+            y = req.traced_kwargs["y"]
+            self._mc_y = self._zeros_stack(
+                jnp.zeros((k_new,) + tuple(y.shape), y.dtype)
+            )
+        self._mc_win = np.zeros((self.width, k_new, 2), np.float32)
+        self._mc_win[:, :, 1] = 1.0
+        self._mc_k, self._mc_has_y = k_new, need_y
+        self._program = None
+        for j in self.active_lanes():
+            self._write_mc_row(j, self.lanes[j].req)
+
+    def _write_mc_row(self, i: int, req: ServeRequest) -> None:
+        """Lane ``i``'s multi-cond rows: primary weight map + per-extra
+        (cond rows, weight map, pooled row, progress window), zero rows /
+        identity windows for non-carrying lanes AND for pad slots beyond the
+        lane's own extra count — a reused slot never inherits its
+        predecessor's maps."""
+        if self._mc_k is None:
+            return
+        jnp = self._jnp
+        self._mc_w0 = self._mc_w0.at[i].set(0.0)
+        self._mc_w = self._mc_w.at[i].set(0.0)
+        self._mc_ctx = self._mc_ctx.at[i].set(0.0)
+        if self._mc_y is not None:
+            self._mc_y = self._mc_y.at[i].set(0.0)
+        self._mc_win[i, :, 0] = 0.0
+        self._mc_win[i, :, 1] = 1.0
+        extras = req.extra_conds or ()
+        if not extras:
+            return
+        from ..sampling.k_samplers import area_weight, broadcast_cond_batch
+
+        b = req.x.shape[0]
+        self._mc_w0 = self._mc_w0.at[i].set(self._mc_map(req, area_weight(
+            req.cond_area, req.cond_strength, req.x.shape,
+            mask=req.cond_mask, mask_strength=req.cond_mask_strength,
+            area_pct=req.cond_area_pct,
+        )))
+        y_fill = (req.traced_kwargs or {}).get("y")
+        for k, e in enumerate(extras):
+            self._mc_ctx = self._mc_ctx.at[i, k].set(
+                broadcast_cond_batch(e["context"], b)
+            )
+            self._mc_w = self._mc_w.at[i, k].set(self._mc_map(
+                req, area_weight(
+                    e.get("area"), float(e.get("strength", 1.0)), req.x.shape,
+                    mask=e.get("mask"),
+                    mask_strength=float(e.get("mask_strength", 1.0)),
+                    area_pct=e.get("area_pct"),
+                )
+            ))
+            tr = e.get("timestep_range")
+            if tr is not None:
+                self._mc_win[i, k] = (float(tr[0]), float(tr[1]))
+            if self._mc_y is not None:
+                pooled = e.get("pooled")
+                y_row = y_fill if pooled is None else broadcast_cond_batch(
+                    pooled, b
+                )
+                if y_row is not None:
+                    self._mc_y = self._mc_y.at[i, k].set(
+                        jnp.broadcast_to(
+                            jnp.asarray(y_row), self._mc_y.shape[2:]
+                        )
+                    )
+
+    def _ctrl_hint_norm(self, req: ServeRequest):
+        """apply_control's hint normalization, host-side at seat: rank-4,
+        repeated to the request batch, bilinear-resized to 8× the latent
+        grid (models/controlnet.py apply does the same ops in-graph; the
+        scheduler's eligibility check already rejected per-sample hint
+        batches, mirroring apply_control's guard)."""
         import jax
 
+        jnp = self._jnp
+        hint = jnp.asarray(req.control["hint"], jnp.float32)
+        if hint.ndim == 3:
+            hint = hint[None]
+        b = req.x.shape[0]
+        if hint.shape[0] != b:
+            hint = jnp.repeat(hint[:1], b, axis=0)
+        want = (req.x.shape[1] * 8, req.x.shape[2] * 8)
+        if hint.shape[1:3] != want:
+            hint = jax.image.resize(
+                hint, (b, *want, hint.shape[-1]), method="bilinear"
+            )
+        return hint
+
+    def _ensure_ctrl(self, req: ServeRequest) -> None:
+        """Materialize the ControlNet overlay on the first carrying seat:
+        ONE control-trunk identity per bucket epoch (conflicting nets are
+        bounced to inline at admission, before any state mutates)."""
+        if req.control is None or self._ctrl is not None:
+            return
+        params = req.control["params"]
+        placed = self._place_shared_tree(params)
+        self._ctrl = {
+            "apply": req.control["apply"],
+            "params_ref": params,
+            "params": params if placed is None else placed,
+        }
+        self._ctrl_hint = self._zeros_stack(self._ctrl_hint_norm(req))
+        self._ctrl_strength = np.zeros(self.width, np.float32)
+        self._ctrl_win = np.tile(
+            np.asarray([0.0, 1.0], np.float32), (self.width, 1)
+        )
+        self._program = None
+
+    def _ctrl_conflict(self, req: ServeRequest) -> bool:
+        """True when the request carries a DIFFERENT control trunk than the
+        one this bucket epoch already runs (identity on apply + params)."""
+        return (
+            self.spec is not None
+            and req.control is not None
+            and self._ctrl is not None
+            and (req.control["apply"] is not self._ctrl["apply"]
+                 or req.control["params"] is not self._ctrl["params_ref"])
+        )
+
+    def _ensure_lora(self, req: ServeRequest) -> None:
+        """Materialize / grow the LoRA overlay: the target-path union and
+        rank max only grow within an epoch; a growth rebuilds the factor
+        stacks (zero-padded) and refills every seated lane's rows — rank
+        padding is structural (zero slots give a bitwise-zero delta)."""
+        if not req.lora:
+            return
+        from ..models.lora import get_path
+
+        jnp = self._jnp
+        paths = sorted(set(req.lora) | {p for (p, _, _) in self._lora_sig})
+        r_req = max(int(a.shape[0]) for (a, _b) in req.lora.values())
+        r_new = max(r_req, self._lora_rmax)
+        if tuple(p for (p, _, _) in self._lora_sig) == tuple(paths) \
+                and r_new == self._lora_rmax:
+            return
+        sig = []
+        for p in paths:
+            w = get_path(self.spec.params, p)
+            # nd targets (head-split attention kernels, conv): the factor
+            # pair addresses the (shape[0], prod(rest)) flattening and the
+            # merge reshapes the delta back (models/lora.py contract).
+            sig.append((p, int(w.shape[0]),
+                        int(math.prod(w.shape[1:]))))
+        self._lora_sig = tuple(sig)
+        self._lora_rmax = r_new
+        self._lora_ab = [
+            (self._zeros_stack(jnp.zeros((r_new, k), jnp.float32)),
+             self._zeros_stack(jnp.zeros((m, r_new), jnp.float32)))
+            for (_p, m, k) in sig
+        ]
+        self._program = None
+        for j in self.active_lanes():
+            self._write_lora_row(j, self.lanes[j].req)
+
+    def _write_lora_row(self, i: int, req: ServeRequest) -> None:
+        if not self._lora_sig:
+            return
+        from ..models.lora import pad_rank
+
+        factors = req.lora or {}
+        for idx, (path, _m, _k) in enumerate(self._lora_sig):
+            a_s, b_s = self._lora_ab[idx]
+            pair = factors.get(path)
+            if pair is None:
+                a_s, b_s = a_s.at[i].set(0.0), b_s.at[i].set(0.0)
+            else:
+                a_, b_ = pad_rank(
+                    self._jnp.asarray(pair[0], a_s.dtype),
+                    self._jnp.asarray(pair[1], b_s.dtype),
+                    self._lora_rmax,
+                )
+                a_s, b_s = a_s.at[i].set(a_), b_s.at[i].set(b_)
+            self._lora_ab[idx] = (a_s, b_s)
+
+    def _seat_caps(self, i: int, req: ServeRequest) -> None:
+        """Seat lane ``i``'s capability state. The mask axis is always-on
+        (row writes + a host gate flag); the other overlays materialize on
+        the first carrying seat. A reused slot ALWAYS rewrites its rows in
+        every active overlay, so a lane can never inherit its predecessor's
+        factors/hints/maps."""
+        jnp = self._jnp
+        kinds = []
+        if req.latent_mask is not None:
+            self._mask = self._mask.at[i].set(jnp.broadcast_to(
+                jnp.asarray(req.latent_mask, jnp.float32), req.x.shape
+            ))
+            self._mask_init = self._mask_init.at[i].set(
+                jnp.broadcast_to(jnp.asarray(req.mask_init), req.x.shape)
+                .astype(self._mask_init.dtype)
+            )
+            self._mask_noise = self._mask_noise.at[i].set(
+                jnp.broadcast_to(jnp.asarray(req.mask_noise), req.x.shape)
+                .astype(self._mask_noise.dtype)
+            )
+            self._mask_has[i] = True
+            kinds.append("img2img_mask")
+        else:
+            # Gate off suffices: the program's where-select never reads a
+            # zero-gated lane's mask rows, so no device clear is needed.
+            self._mask_has[i] = False
+        if req.extra_conds:
+            kinds.append("multi_cond")
+        self._ensure_mc(req)
+        self._write_mc_row(i, req)
+        if req.control is not None:
+            self._ensure_ctrl(req)
+            kinds.append("controlnet")
+        if self._ctrl is not None:
+            if req.control is not None:
+                self._ctrl_hint = self._ctrl_hint.at[i].set(
+                    self._ctrl_hint_norm(req)
+                )
+                self._ctrl_strength[i] = float(req.control["strength"])
+                self._ctrl_win[i] = (
+                    float(req.control["start"]), float(req.control["end"])
+                )
+            else:
+                # Zero gain → exact zero residual trees (additive no-op);
+                # a stale hint row only ever feeds the zeroed trunk output.
+                self._ctrl_strength[i] = 0.0
+                self._ctrl_win[i] = (0.0, 1.0)
+        if req.lora:
+            self._ensure_lora(req)
+            kinds.append("lora")
+        self._write_lora_row(i, req)
+        for kind in (kinds or ["txt2img"]):
+            registry.counter(
+                "pa_serving_lane_capability_total",
+                labels={**self._labels, "kind": kind},
+                help="lanes seated, by capability carried (a multi-"
+                     "capability lane counts once per capability; plain "
+                     "lanes count as txt2img)",
+            )
+
+    def _set_lane(self, i: int, req: ServeRequest) -> bool:
+        import jax
+
+        if self._ctrl_conflict(req):
+            # One control trunk per bucket epoch: a different net cannot
+            # join this program — bounce to the inline path (the runner
+            # catches DegradedToInline and falls back) BEFORE any stacked
+            # state mutates.
+            from ..utils.degrade import DegradedToInline
+
+            req.resolve(error=DegradedToInline(
+                f"bucket {self.label} already carries a different "
+                "ControlNet this epoch; re-submit inline"
+            ))
+            registry.counter(
+                "pa_serving_ctrl_conflict_total", labels=self._labels,
+                help="seats bounced to inline: a second ControlNet identity "
+                     "arrived within one bucket epoch",
+            )
+            return False
         self._ensure_state(req)
         lane = _Lane(req)
         # The lane's whole schedule compiles to an eval-ordered plan list at
@@ -566,6 +937,7 @@ class StepBucket:
             self._h2 = self._h2.at[i].set(0.0)
             self._seat_cond(i, req)
             self._seat_kwargs(i, req)
+            self._seat_caps(i, req)
         else:
             from ..sampling.k_samplers import EpsDenoiser
 
@@ -574,15 +946,27 @@ class StepBucket:
             lane.xe_eager = req.x
             lane.h1_eager = jnp.zeros_like(req.x)
             lane.h2_eager = jnp.zeros_like(req.x)
+            # Width-1 eager capability twin: multi-cond rides the denoiser's
+            # own _combine_conds; ControlNet/LoRA ride the pre-merged
+            # ``eager_model``; the denoise mask is a post-completion blend
+            # in dispatch() (the masked_callback formula).
+            model_lane = (
+                req.eager_model if req.eager_model is not None else self.model
+            )
             lane.denoiser = EpsDenoiser(
-                self.model, req.context, cfg_scale=req.cfg_scale,
+                model_lane, req.context, cfg_scale=req.cfg_scale,
                 uncond_context=req.uncond_context,
                 uncond_kwargs=req.uncond_kwargs,
                 alphas_cumprod=req.acp, prediction=req.prediction,
                 cfg_rescale=req.cfg_rescale,
+                extra_conds=req.extra_conds or None,
+                cond_area=req.cond_area, cond_area_pct=req.cond_area_pct,
+                cond_mask=req.cond_mask, cond_strength=req.cond_strength,
+                cond_mask_strength=req.cond_mask_strength,
                 **req.traced_kwargs, **req.static_kwargs,
             )
         self.lanes[i] = lane
+        return True
 
     # -- scheduling ---------------------------------------------------------
 
@@ -621,7 +1005,10 @@ class StepBucket:
                 registry.counter("pa_serving_expired_total",
                                  labels=self._labels)
                 continue
-            self._set_lane(i, req)
+            if not self._set_lane(i, req):
+                # Bounced (capability conflict) — the request resolved with
+                # DegradedToInline; the slot refills on the next sweep.
+                continue
             joined += 1
             registry.histogram(
                 "pa_serving_lane_wait_seconds", now - req.submit_ts,
@@ -791,6 +1178,13 @@ class StepBucket:
                  if self.lanes[i].keys is not None), 2,
             )
             keys = np.zeros((self.width, key_width), np.uint32)
+            # Denoise-mask mix (always-on capability axis): per dispatch,
+            # per lane, (gate, keep_a, keep_b) — gate only on σ-interval
+            # completion of a masked lane; the keep coefficients are the
+            # masked_callback formula per prediction family at the lane's
+            # own σ_next (eps/v: init + σ'·noise; flow: (1−σ')·init +
+            # σ'·noise). All-zero rows make the blend a structural no-op.
+            mask_mix = np.zeros((self.width, 3), np.float32)
             for i in active:
                 lane, plan = self.lanes[i], plans[i]
                 sig[i] = plan.sigma_eval
@@ -800,6 +1194,14 @@ class StepBucket:
                 row = _noise_key_row(lane, plan)
                 if row is not None:
                     keys[i] = row
+                if self._mask_has[i] and plan.completes:
+                    # palint: allow[host-sync] req.sigmas is host-side
+                    # np.ndarray by ServeRequest contract — no device sync
+                    s_next = float(lane.req.sigmas[plan.step + 1])
+                    if lane.req.prediction == "flow":
+                        mask_mix[i] = (1.0, 1.0 - s_next, s_next)
+                    else:
+                        mask_mix[i] = (1.0, 1.0, s_next)
             xe_prev = None
             if self._emit_stats:
                 inj = numerics.take_injection(active)
@@ -832,11 +1234,32 @@ class StepBucket:
                          "included) rode the lane axis as ONE broadcast "
                          "tree (sibling-seed sharing)",
                 )
+            # Capability overlay inputs (only the materialized ones — the
+            # program variant was built with the matching signature).
+            cap_kw = {}
+            if self._mc_k is not None:
+                cap_kw.update(
+                    mc_w0=self._mc_w0, mc_ctx=self._mc_ctx, mc_w=self._mc_w,
+                    mc_win=jnp.asarray(self._mc_win), mc_y=self._mc_y,
+                )
+            if self._ctrl is not None:
+                cap_kw.update(
+                    ctrl_params=self._ctrl["params"],
+                    ctrl_hint=self._ctrl_hint,
+                    ctrl_strength=jnp.asarray(self._ctrl_strength),
+                    ctrl_win=jnp.asarray(self._ctrl_win),
+                )
+            if self._lora_sig:
+                cap_kw["lora_ab"] = tuple(
+                    (a_s, b_s) for (a_s, b_s) in self._lora_ab
+                )
             outs = self._program(
                 self.spec.params, self._x, self._xe, self._h1, self._h2,
                 jnp.asarray(sig), jnp.asarray(act), jnp.asarray(cfg),
                 jnp.asarray(coef), jnp.asarray(keys),
                 ctx_arg, uctx_arg, kw_arg, ukw_arg, self._log_sigmas,
+                self._mask, self._mask_init, self._mask_noise,
+                jnp.asarray(mask_mix), **cap_kw,
             )
             if self._emit_stats:
                 (self._x, self._xe, self._h1, self._h2, st_dev, dg_dev) = outs
@@ -893,6 +1316,29 @@ class StepBucket:
                     _combine(plan.coef[2], lane.h1_eager),
                     _combine(plan.coef[3], lane.h2_eager),
                 )
+                if plan.completes and lane.req.latent_mask is not None:
+                    # Eager twin of the program's mask_mix blend: re-pin the
+                    # keep region on σ-interval completion (histories stay
+                    # untouched, as inline's post-step callback never sees
+                    # sampler history either).
+                    rq = lane.req
+                    # palint: allow[host-sync] rq.sigmas is host-side
+                    # np.ndarray by ServeRequest contract — no device sync
+                    s_next = float(rq.sigmas[plan.step + 1])
+                    if rq.prediction == "flow":
+                        keep = (
+                            (1.0 - s_next) * rq.mask_init
+                            + s_next * rq.mask_noise
+                        )
+                    else:
+                        keep = rq.mask_init + s_next * rq.mask_noise
+                    mk = jnp.asarray(rq.latent_mask, jnp.float32)
+                    lane.x_eager = (
+                        lane.x_eager * mk + keep * (1.0 - mk)
+                    ).astype(lane.x_eager.dtype)
+                    lane.xe_eager = (
+                        lane.xe_eager * mk + keep * (1.0 - mk)
+                    ).astype(lane.xe_eager.dtype)
             # palint: allow[host-sync] the completion boundary: the step
             # histogram must include device time (the StepTimer discipline)
             jax.block_until_ready([self.lanes[i].x_eager for i in active])

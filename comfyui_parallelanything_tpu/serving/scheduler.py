@@ -180,13 +180,22 @@ class ContinuousBatchingScheduler:
         self, *, model, x, sigmas, context, sampler, cfg_scale,
         uncond_context, uncond_kwargs, alphas_cumprod, prediction,
         cfg_rescale, model_kwargs, rng=None,
+        latent_mask=None, mask_init=None, mask_noise=None,
+        extra_conds=(), cond_area=None, cond_area_pct=None, cond_mask=None,
+        cond_strength=1.0, cond_mask_strength=1.0, lora=None,
     ) -> ServeRequest | None:
         """Admit one sampler run, or return None when it cannot share a step
         program (caller runs inline). Called from run_sampler with the fully
         prepared (noised x, schedule, conditioning) — the serving layer never
         re-derives sampler semantics; per-step sampler math comes from the
         sampler's LaneStepSpec. ``rng`` is the stochastic base key (the same
-        one the eager loop would fold per step)."""
+        one the eager loop would fold per step).
+
+        Capability state (round 16) rides the request as per-lane data, NOT
+        the bucket key — a denoise mask, extra conds, a delegated ControlNet,
+        or LoRA factors never fragment buckets, so mixed traffic shares one
+        dispatch stream. Eligibility here only checks what the lane program
+        cannot absorb (shape/(L,D)/pooled-y mismatches → inline)."""
         if self._stop or sampler not in self.samplers:
             return None
         spec_entry = LANE_SPECS.get(sampler)
@@ -225,7 +234,87 @@ class ContinuousBatchingScheduler:
             getattr(context, "ndim", 0) < 1 or context.shape[0] != b
         ):
             return None
+        # -- capability eligibility (round 16) --------------------------------
+        # ControlNet delegation: an apply_control composition buckets on the
+        # BASE model (so control lanes co-batch with plain txt2img of the same
+        # UNet) and the control trunk rides the request. Chained compositions
+        # publish no delegate (models/controlnet.py) and stay opaque.
+        eager_model = None
+        control = None
+        delegate = getattr(model, "control_delegate", None)
+        if delegate is not None and getattr(x, "ndim", 0) == 4:
+            base = delegate["base"]
+            if trace_spec_of(base) is not None:
+                hint = delegate["hint"]
+                hb = 1 if getattr(hint, "ndim", 3) == 3 else int(hint.shape[0])
+                if hb not in (1, b):
+                    # apply_control rejects per-sample hint batches in-graph;
+                    # inline surfaces that same ValueError to the caller.
+                    return None
+                control = {
+                    "apply": delegate["ctrl_apply"],
+                    "params": delegate["ctrl_params"],
+                    "hint": hint,
+                    "strength": delegate["strength"],
+                    "start": delegate["start"],
+                    "end": delegate["end"],
+                }
+                eager_model = model  # width-1 eager twin keeps the merged net
+                model = base
+        # Denoise-mask lanes need both blend references (the runner's inline
+        # loop derives them; a bare mask cannot reconstruct the keep region).
+        if latent_mask is not None:
+            if mask_init is None or mask_noise is None:
+                return None
+            try:
+                for ref in (latent_mask, mask_init, mask_noise):
+                    if np.broadcast_shapes(
+                        tuple(getattr(ref, "shape", ())), tuple(x.shape)
+                    ) != tuple(x.shape):
+                        return None
+            except ValueError:
+                return None
+        # Multi-cond extras must pin to the primary cond's (L, D) — the lane
+        # program stacks every role row in one eval; a different sequence
+        # length cannot share the block. Pooled extras need ``y`` in the
+        # traced kwargs (the bucket key already carries its shape via t_sig).
+        extra_conds = tuple(extra_conds or ())
+        if extra_conds:
+            if context is None or getattr(context, "ndim", 0) != 3:
+                return None
+            for e in extra_conds:
+                ec = e.get("context")
+                if ec is None or getattr(ec, "ndim", 0) != 3:
+                    return None
+                if tuple(ec.shape[1:]) != tuple(context.shape[1:]):
+                    return None
+                if int(ec.shape[0]) not in (1, b):
+                    return None
+                pooled = e.get("pooled")
+                if pooled is not None:
+                    y = traced.get("y")
+                    if (
+                        y is None
+                        or getattr(pooled, "ndim", 0) != 2
+                        or int(pooled.shape[-1]) != int(y.shape[-1])
+                        or int(pooled.shape[0]) not in (1, b)
+                    ):
+                        return None
         spec = trace_spec_of(model)
+        # Per-lane LoRA: factors must address the param tree the lane program
+        # evals (models/lora.py signature check — None means a path/shape
+        # mismatch). Width-1 eager lanes gain nothing over the inline merge.
+        lora_factors = None
+        if lora:
+            if spec is None:
+                return None
+            from ..models.lora import lora_signature
+
+            sig = lora_signature(lora, spec.params)
+            if sig is None:
+                return None
+            if sig:
+                lora_factors = dict(lora)
         width = self.max_width
         bound = getattr(model, "serving_bucket_width", None)
         if callable(bound):
@@ -272,6 +361,12 @@ class ContinuousBatchingScheduler:
             uncond_kwargs=uncond_kwargs if use_cfg else None,
             cfg_scale=float(cfg_scale), cfg_rescale=float(cfg_rescale),
             prediction=prediction, acp=acp,
+            latent_mask=latent_mask, mask_init=mask_init,
+            mask_noise=mask_noise, extra_conds=extra_conds,
+            cond_area=cond_area, cond_area_pct=cond_area_pct,
+            cond_mask=cond_mask, cond_strength=float(cond_strength),
+            cond_mask_strength=float(cond_mask_strength),
+            control=control, lora=lora_factors, eager_model=eager_model,
             progress_hook=current_progress_hook(),
             interrupt_event=(
                 current_scope().interrupt_event

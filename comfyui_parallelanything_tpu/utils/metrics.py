@@ -54,6 +54,18 @@ Auto-parallel planner (round 18): ``pa_planner_*`` (parallel/planner.py —
 per plan decision, and the ``pa_planner_predicted_s{mode=}`` /
 ``pa_planner_hand_predicted_s`` / ``pa_planner_candidates`` gauges carrying
 the last decision's chosen-vs-shadow-hand score).
+
+Universal lane batching (round 19, within the ``pa_serving_*`` family):
+``pa_serving_lane_capability_total{kind=}`` (serving/bucket.py — lanes
+seated by capability carried: ``img2img_mask`` / ``multi_cond`` /
+``controlnet`` / ``lora``, plain lanes as ``txt2img``; a multi-capability
+lane counts once per capability — the loadgen mixed-workload per-kind
+deltas), ``pa_serving_inline_fallback_total{reason=,sampler=}``
+(sampling/runner.py — runs bounced to the inline path, the
+mixed-workload smoke's must-stay-zero gate for eligible shapes), and
+``pa_serving_ctrl_conflict_total{bucket=}`` (serving/bucket.py — lanes
+bounced because the bucket epoch already carries a different control
+trunk).
 """
 
 from __future__ import annotations

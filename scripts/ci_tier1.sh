@@ -113,6 +113,20 @@ timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
 
+# Mixed-workload smoke (round 19): scripts/loadgen.py --workload-mix drives
+# txt2img + img2img(mask) + controlnet + lora traffic through one live
+# 4-worker server — gated on prompts_lost == 0, run-delta shared-dispatch
+# fraction >= 0.8, zero inline fallbacks / control-trunk conflicts for
+# eligible shapes, every capability kind ticking its
+# pa_serving_lane_capability_total delta, and the kind="mixed" ledger
+# record landing (tests/test_loadgen_mix.py — slow-marked, so THIS block is
+# where the universal-lane-batching contract actually runs).
+timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_loadgen_mix.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+
 # Chaos smoke (round 14): a seeded fault plan (backend-http 5xx +
 # slow-host, deterministic in the seed) fired against a 2-backend fleet
 # while the PRIMARY ROUTER is killed mid-denoise (standby takeover off the

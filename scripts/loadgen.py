@@ -270,6 +270,12 @@ def _serving_counters(base: str) -> dict:
                  # injected and what gracefully degraded, summed over their
                  # {site=}/{rung=} labels.
                  "pa_fault_injected_total", "pa_degradation_total",
+                 # Universal lane batching (round 16): capability seats,
+                 # inline-fallback bounces (summed over reason/sampler), and
+                 # control-trunk conflicts — the mixed-workload rung's gates.
+                 "pa_serving_lane_capability_total",
+                 "pa_serving_inline_fallback_total",
+                 "pa_serving_ctrl_conflict_total",
                  # Fleet router counters (fleet/router.py) — present when
                  # --base is a router; summed over their {host=} labels.
                  "pa_fleet_dispatch_total", "pa_fleet_spill_total",
@@ -286,6 +292,16 @@ def _serving_counters(base: str) -> dict:
     m = re.search(r"^pa_serving_batched_fraction ([0-9.eE+-]+)$", text, re.M)
     if m:
         out["pa_serving_batched_fraction"] = float(m.group(1))
+    # Per-kind capability seats (round 16): the {kind=} label breakdown of
+    # lane seats, stored under flat "name:kind" keys so the before/after
+    # diff machinery stays float-valued.
+    for m in re.finditer(
+        r'^pa_serving_lane_capability_total\{[^}]*kind="([^"]+)"[^}]*\} '
+        r"([0-9.eE+-]+)$",
+        text, re.M,
+    ):
+        key = f"pa_serving_lane_capability_total:{m.group(1)}"
+        out[key] = out.get(key, 0.0) + float(m.group(2))
     # Reuse gauges (round 17): the embed cache's monotonic hit/miss/eviction
     # totals (diffed like counters — they only grow) + current bytes, and
     # the decode tail's lifetime batched fraction.
@@ -306,6 +322,95 @@ def _serving_counters(base: str) -> dict:
         if m:
             out[name] = float(m.group(1))
     return out
+
+
+WORKLOAD_KINDS = ("txt2img", "img2img", "controlnet", "lora")
+
+
+def parse_workload_mix(spec: str | None) -> dict | None:
+    """``txt2img,img2img,controlnet,lora:<frac>`` → ``{kind: fraction}``.
+
+    Each comma item is ``kind`` or ``kind:frac``; explicit fractions are
+    taken as-is and the remaining probability mass splits equally over the
+    fraction-less kinds (so ``txt2img,lora:0.1`` is 0.9/0.1). With every
+    fraction explicit the map is normalized. Unknown kinds and infeasible
+    masses fail fast."""
+    if not spec:
+        return None
+    fixed: dict[str, float] = {}
+    free: list[str] = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        kind, _, frac = item.partition(":")
+        if kind not in WORKLOAD_KINDS:
+            raise ValueError(
+                f"unknown workload kind {kind!r} (want one of "
+                f"{', '.join(WORKLOAD_KINDS)})"
+            )
+        if kind in fixed or kind in free:
+            raise ValueError(f"workload kind {kind!r} given twice")
+        if frac:
+            f = float(frac)
+            if not 0.0 <= f <= 1.0:
+                raise ValueError(f"workload fraction {kind}:{f} not in [0, 1]")
+            fixed[kind] = f
+        else:
+            free.append(kind)
+    if not fixed and not free:
+        return None
+    rest = 1.0 - sum(fixed.values())
+    if free:
+        if rest <= 0.0:
+            raise ValueError(
+                "explicit workload fractions sum to >= 1 with "
+                f"fraction-less kinds left over: {spec!r}"
+            )
+        fixed.update({k: rest / len(free) for k in free})
+    total = sum(fixed.values())
+    if total <= 0.0:
+        raise ValueError(f"workload mix has zero total weight: {spec!r}")
+    return {k: v / total for k, v in fixed.items()}
+
+
+def workload_schedule(total: int, mix: dict, seed: int | None = 0) -> list:
+    """The per-submission capability kinds: value n is a pure function of
+    (seed, n) — the run_load schedule discipline, so two runs with one seed
+    sample the identical kind sequence regardless of client interleaving."""
+    rng = random.Random(f"workload:{seed if seed is not None else 0}")
+    kinds = list(mix)
+    weights = [mix[k] for k in kinds]
+    return rng.choices(kinds, weights=weights, k=total)
+
+
+def _capability_summary(before: dict, after: dict) -> dict:
+    """The universal-lane-batching summary fields (round 16), diffed from
+    the scraped counters: how many lane seats each capability kind took,
+    how many sampler runs bounced to the inline eager loop, and control-
+    trunk conflicts. None = the counter never existed on either scrape."""
+
+    def delta(name):
+        return (after.get(name, 0.0) - before.get(name, 0.0)
+                if name in after or name in before else None)
+
+    prefix = "pa_serving_lane_capability_total:"
+    kinds = sorted(
+        k[len(prefix):] for k in set(before) | set(after)
+        if k.startswith(prefix)
+    )
+    return {
+        # Lane seats by capability kind over this run ({kind=} breakdown of
+        # pa_serving_lane_capability_total; None: no capability seating).
+        "lane_capability": {
+            k: delta(prefix + k) for k in kinds
+        } or None,
+        # Sampler runs that fell back to the inline eager loop with a
+        # scheduler installed (reason=degraded|ineligible summed) — the
+        # mixed-workload gate number: eligible traffic must keep this 0.
+        "serving_inline_fallbacks": delta("pa_serving_inline_fallback_total"),
+        "serving_ctrl_conflicts": delta("pa_serving_ctrl_conflict_total"),
+    }
 
 
 def parse_prompt_dist(spec: str | None) -> float | None:
@@ -424,7 +529,9 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
              prompt_dist: str | None = None,
              prompt_key: str | None = None,
              prompt_vocab: list[str] | None = None,
-             seed_fanout: int = 1) -> dict:
+             seed_fanout: int = 1,
+             workload_mix: dict | None = None,
+             workload_graphs: dict | None = None) -> dict:
     """The closed loop; returns the summary dict (importable — the e2e and
     fleet-smoke tests drive in-process servers through this exact code path).
 
@@ -447,7 +554,18 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
     the embed cache collapses; ``seed_fanout`` N groups submissions into
     N-seed siblings of one sampled prompt (the shared-cond fanout shape).
     The summary gains ``embed_cache_hit_rate`` / ``encoder_invocations`` /
-    ``decode_batched_fraction`` scraped-delta fields either way."""
+    ``decode_batched_fraction`` scraped-delta fields either way.
+
+    Mixed capability traffic (round 16): ``workload_mix`` ({kind: fraction}
+    over txt2img/img2img/controlnet/lora, see parse_workload_mix) samples
+    each submission's CAPABILITY kind seeded (value n pure in (seed, n))
+    and submits the matching graph from ``workload_graphs`` ({kind: graph
+    dict}; kinds without an entry — txt2img canonically — use the base
+    ``graph``). Variant graphs must keep the base graph's node ids at
+    ``seed_key``/``sampler_key``/``prompt_key`` so the per-prompt writes
+    land. The summary gains ``workload_mix``/``workload_counts`` plus the
+    ``lane_capability`` per-kind seat deltas and the
+    ``serving_inline_fallbacks`` gate number either way."""
     if fallback_bases:
         base = _Front([base, *fallback_bases])
     latencies: list[float] = []
@@ -468,16 +586,26 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
         clients * requests, prompt_key=prompt_key, prompt_dist=prompt_dist,
         prompt_vocab=prompt_vocab, seed_fanout=seed_fanout, seed=seed,
     )
+    kind_schedule = None
+    kind_counts: dict[str, int] = {}
+    if workload_mix:
+        kind_schedule = workload_schedule(clients * requests, workload_mix,
+                                          seed=seed)
+        for k in kind_schedule:
+            kind_counts[k] = kind_counts.get(k, 0) + 1
     before = _serving_counters(base)
     hosts_before = _host_probe(hosts) if hosts else None
     t_start = time.time()
 
     def client(ci: int) -> None:
         for _ in range(requests):
-            g = json.loads(json.dumps(graph))
             with lock:
                 counter[0] += 1
                 n = counter[0]
+            src = graph
+            if kind_schedule is not None:
+                src = (workload_graphs or {}).get(kind_schedule[n - 1], graph)
+            g = json.loads(json.dumps(src))
             if seed_key:
                 _set_path(g, seed_key,
                           schedule[n - 1] if schedule is not None else n)
@@ -618,6 +746,9 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
             seed_fanout if texts is not None and seed_fanout > 1 else None
         ),
         "distinct_prompts": len(set(texts)) if texts is not None else None,
+        "workload_mix": workload_mix or None,
+        "workload_counts": kind_counts or None,
+        **_capability_summary(before, after),
         **_reuse_summary(before, after),
         "completed": len(latencies),
         "failed": len(failures),
@@ -1147,6 +1278,18 @@ def print_human_summary(summary: dict, stream=None) -> None:
         w(f"  serving   {summary['serving_dispatches']:.0f} dispatches,"
           f" {summary['serving_lane_steps']:.0f} lane-steps"
           f" ({summary['dispatch_amortization']}x amortized)\n")
+    if summary.get("workload_counts"):
+        parts = ", ".join(f"{k}={v}"
+                          for k, v in sorted(summary["workload_counts"].items()))
+        w(f"  workload  {parts}\n")
+    caps = summary.get("lane_capability")
+    if caps or summary.get("serving_inline_fallbacks") is not None:
+        cap_s = ", ".join(f"{k}={v:.0f}" for k, v in sorted(caps.items())) \
+            if caps else "-"
+        w(f"  caps      lane-steps by kind: {cap_s}\n")
+        w(f"  caps      inline fallbacks "
+          f"{summary.get('serving_inline_fallbacks')}"
+          f"  ctrl conflicts {summary.get('serving_ctrl_conflicts')}\n")
     if summary.get("embed_cache_hit_rate") is not None or \
             summary.get("encoder_invocations") is not None:
         w(f"  reuse     embed-cache hit rate "
@@ -1262,7 +1405,32 @@ def main() -> None:
                     help="declared twin error band: scripts/twin_report.py "
                          "--check fails when |twin p95 - measured p95| / "
                          "measured exceeds this fraction")
+    ap.add_argument("--workload-mix", default=None,
+                    help="comma list of capability kinds, optional :frac "
+                         "each (txt2img,img2img,controlnet,lora:0.25) — "
+                         "sample each submission's KIND from the seeded "
+                         "mix and submit that kind's graph (see "
+                         "--workload-graph); summary gains workload counts "
+                         "+ per-kind lane-capability and inline-fallback "
+                         "deltas. Closed-loop only")
+    ap.add_argument("--workload-graph", action="append", default=None,
+                    metavar="KIND=PATH",
+                    help="workflow JSON for one mix kind (repeatable); "
+                         "kinds without a graph fall back to --graph")
     args = ap.parse_args()
+    workload_mix = parse_workload_mix(args.workload_mix)  # fail fast
+    workload_graphs = {}
+    for spec in args.workload_graph or []:
+        kind, sep, path = spec.partition("=")
+        if not sep or kind not in WORKLOAD_KINDS:
+            ap.error(f"--workload-graph wants KIND=PATH with KIND one of "
+                     f"{', '.join(WORKLOAD_KINDS)}; got {spec!r}")
+        with open(path) as f:
+            workload_graphs[kind] = json.load(f)
+    if (workload_mix or workload_graphs) and args.openloop:
+        ap.error("--workload-mix is closed-loop only (no --openloop)")
+    if workload_graphs and not workload_mix:
+        ap.error("--workload-graph requires --workload-mix")
     samplers = [s for s in (args.samplers or "").split(",") if s]
     if samplers and not args.sampler_key:
         ap.error("--samplers requires --sampler-key (where to write it)")
@@ -1315,8 +1483,11 @@ def main() -> None:
             prompt_dist=args.prompt_dist, prompt_key=args.prompt_key,
             prompt_vocab=prompt_vocab or None,
             seed_fanout=args.seed_fanout,
+            workload_mix=workload_mix,
+            workload_graphs=workload_graphs or None,
         )
-        _append_ledger(summary, args.base)
+        _append_ledger(summary, args.base,
+                       kind="mixed" if workload_mix else "loadgen")
     print_human_summary(summary)          # operator table → stderr
     print(json.dumps(summary))            # THE one JSON line → stdout
 

@@ -1,0 +1,415 @@
+"""Universal lane batching (round 16): img2img denoise masks, multi-cond CFG,
+delegated ControlNet, and per-lane LoRA as per-lane state inside the ONE
+compiled lane-step program — co-batched in one bucket, never recompiling on
+traffic mix, occupancy-deterministic, and degradation-safe. All off-hardware
+(CPU + the 8-device virtual mesh) with deterministic manual pumping."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu import DeviceChain, parallelize
+from comfyui_parallelanything_tpu.models.api import DiffusionModel
+from comfyui_parallelanything_tpu.models.controlnet import apply_control
+from comfyui_parallelanything_tpu.models.lora import combine_factors
+from comfyui_parallelanything_tpu.sampling.runner import run_sampler
+from comfyui_parallelanything_tpu.serving import ContinuousBatchingScheduler
+from comfyui_parallelanything_tpu.utils.metrics import registry
+
+# bf16-scale tolerances (CLAUDE.md): cross-program legs (inline vs lane) only.
+# Same-program legs assert bitwise equality instead.
+TOL = dict(rtol=2e-3, atol=1e-4)
+
+
+def mk_base(seed=0):
+    """Per-sample-independent denoiser WITH a params pytree (so LoRA factors
+    have a 2-D leaf to address) and a ``control`` consumption point (so the
+    delegated ControlNet residuals have somewhere to land)."""
+    r = np.random.default_rng(seed)
+    params = {"proj": {"kernel": jnp.asarray(
+        r.normal(size=(4, 4)).astype(np.float32)) * 0.2}}
+
+    def apply(p, x, t, context=None, control=None, **kw):
+        c = jnp.mean(context, axis=(1, 2)).reshape((-1, 1, 1, 1))
+        h = x @ p["proj"]["kernel"]
+        if control is not None:
+            h = h + control["middle"][0]
+        tt = t.reshape((-1, 1, 1, 1))
+        return jnp.tanh(h + 0.1 * c) * (0.5 + 0.1 * tt / 1000.0)
+
+    return DiffusionModel(apply=apply, params=params, name="capbase")
+
+
+def mk_ctrl():
+    """Tiny control trunk: hint mean → one middle residual (per-sample
+    independent, like the base)."""
+    params = {"g": jnp.float32(0.5)}
+
+    def capply(p, x, t, context=None, *, hint, y=None):
+        hm = jnp.mean(hint, axis=(1, 2, 3)).reshape((-1, 1, 1, 1))
+        return {"middle": (p["g"] * hm * jnp.ones_like(x),)}
+
+    return DiffusionModel(apply=capply, params=params, name="capctrl")
+
+
+def mk_inputs(seed, batch=1):
+    r = np.random.default_rng(seed)
+    noise = jnp.asarray(r.normal(size=(batch, 8, 8, 4)).astype(np.float32))
+    ctx = jnp.asarray(r.normal(size=(batch, 6, 16)).astype(np.float32))
+    return noise, ctx
+
+
+def _fixtures(seed=99):
+    """One coherent capability kit: init/mask for img2img, hint + merged
+    control model, a 2-LoRA factor map, an extra cond."""
+    base = mk_base()
+    r = np.random.default_rng(seed)
+    init = jnp.asarray(r.normal(size=(1, 8, 8, 4)).astype(np.float32))
+    mask = jnp.asarray((r.random(size=(1, 8, 8, 1)) > 0.5).astype(np.float32))
+    hint = jnp.asarray(r.random(size=(1, 64, 64, 3)).astype(np.float32))
+    merged = apply_control(base, mk_ctrl(), hint, strength=0.7)
+    f1 = {"proj/kernel": (
+        jnp.asarray(r.normal(size=(2, 4)).astype(np.float32)) * 0.1,
+        jnp.asarray(r.normal(size=(4, 2)).astype(np.float32)) * 0.1)}
+    f2 = {"proj/kernel": (
+        jnp.asarray(r.normal(size=(1, 4)).astype(np.float32)) * 0.1,
+        jnp.asarray(r.normal(size=(4, 1)).astype(np.float32)) * 0.1)}
+    ctx2 = jnp.asarray(r.normal(size=(1, 6, 16)).astype(np.float32))
+    return dict(base=base, init=init, mask=mask, hint=hint, merged=merged,
+                lora1=f1, lora2=combine_factors([f1, f2]), ctx2=ctx2)
+
+
+@pytest.fixture
+def sched():
+    s = ContinuousBatchingScheduler(max_width=4, auto=False).install()
+    try:
+        yield s
+    finally:
+        s.uninstall()
+        s.shutdown()
+
+
+def _bg(fn, *args):
+    t = threading.Thread(target=fn, args=args, daemon=True)
+    t.start()
+    return t
+
+
+def _wait_enqueued(s, n, timeout=20):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        with s._lock:
+            tot = sum(
+                len(b.queue) + len(b.active_lanes())
+                for b in s.buckets.values()
+            )
+        if tot >= n:
+            return
+        time.sleep(0.005)
+    raise TimeoutError(f"never saw {n} enqueued requests")
+
+
+def _run_plans(s, plans):
+    """plans: {name: (model, seed, kwargs)} → {name: result}, all submitted
+    concurrently, seated before the first pump, drained to completion."""
+    results = {}
+
+    def worker(name, model, seed, kw):
+        noise, ctx = mk_inputs(seed)
+        results[name] = run_sampler(model, noise, ctx, **kw)
+
+    threads = [_bg(worker, k, m, seed, kw) for k, (m, seed, kw) in plans.items()]
+    _wait_enqueued(s, len(plans))
+    s.drain(timeout=120)
+    for t in threads:
+        t.join(60)
+    assert len(results) == len(plans), sorted(results)
+    return results
+
+
+def _metric_sum(name, **match):
+    """Sum a labeled counter across label sets matching ``match`` items
+    (bucket labels vary per test model/shape)."""
+    m = registry._metrics.get(name)
+    if not m:
+        return 0
+    want = {(str(k), str(v)) for k, v in match.items()}
+    return sum(v for key, v in m["values"].items() if want <= set(key))
+
+
+def _cap_count(kind):
+    return _metric_sum("pa_serving_lane_capability_total", kind=kind)
+
+
+def _fallback_count():
+    return _metric_sum("pa_serving_inline_fallback_total")
+
+
+class TestUniversalLaneBatching:
+    def test_mixed_capability_bucket_matches_solo(self, sched):
+        """Acceptance: an img2img-masked lane, a ControlNet lane, a 2-LoRA
+        lane, and a plain txt2img lane co-batch in ONE bucket; total dispatch
+        count equals the max per-lane eval count (+ join slack); every latent
+        matches its inline solo twin; no lane fell back inline."""
+        fx = _fixtures()
+        base = fx["base"]
+        plans = {
+            "masked": (base, 1, dict(sampler="euler", steps=4,
+                                     init_latent=fx["init"], denoise=0.8,
+                                     latent_mask=fx["mask"])),
+            "control": (fx["merged"], 2, dict(sampler="euler", steps=6)),
+            "lora2": (base, 3, dict(sampler="euler", steps=8,
+                                    lora=fx["lora2"])),
+            "plain": (base, 4, dict(sampler="euler", steps=5)),
+        }
+        sched.uninstall()
+        inline = {k: run_sampler(m, *mk_inputs(seed), **kw)
+                  for k, (m, seed, kw) in plans.items()}
+        sched.install()
+        caps_before = {k: _cap_count(k) for k in
+                       ("img2img_mask", "controlnet", "lora", "txt2img")}
+        fb_before = _fallback_count()
+        results = _run_plans(sched, plans)
+        assert len(sched.buckets) == 1, (
+            "capability mix must share ONE bucket "
+            f"{[b.label for b in sched.buckets.values()]}"
+        )
+        assert sched.total_dispatches() <= 8 + 2  # max steps + join slack
+        for k in plans:
+            np.testing.assert_allclose(np.asarray(results[k]),
+                                       np.asarray(inline[k]), **TOL,
+                                       err_msg=k)
+        # Seat accounting: each capability ticked its kind; eligible mixed
+        # traffic never fell back inline.
+        for kind in ("img2img_mask", "controlnet", "lora", "txt2img"):
+            assert _cap_count(kind) >= caps_before[kind] + 1, kind
+        assert _fallback_count() == fb_before
+
+    def test_traffic_mix_never_recompiles(self, sched):
+        """Bucket-key discipline: adding a masked lane to a bucket that
+        already ran plain traffic reuses the SAME bucket (the mask axis is
+        always-on, so txt2img↔img2img mixes share one program)."""
+        fx = _fixtures()
+        base = fx["base"]
+        _run_plans(sched, {"p1": (base, 11, dict(sampler="euler", steps=3))})
+        assert len(sched.buckets) == 1
+        _run_plans(sched, {
+            "masked": (base, 12, dict(sampler="euler", steps=3,
+                                      init_latent=fx["init"], denoise=0.8,
+                                      latent_mask=fx["mask"])),
+            "p2": (base, 13, dict(sampler="euler", steps=4)),
+        })
+        assert len(sched.buckets) == 1  # same key — no new bucket, no refit
+
+
+class TestCapabilityEquivalenceMatrix:
+    """Every capability × {eps, flow} × a ragged co-batched partner × CFG —
+    the round-10 equivalence-matrix discipline extended to round 16."""
+
+    CAPS = ("mask", "multi_cond", "control", "lora")
+
+    @pytest.mark.parametrize("prediction", ["eps", "flow"])
+    @pytest.mark.parametrize("cap", CAPS)
+    def test_capability_lane_matches_solo(self, sched, cap, prediction):
+        fx = _fixtures()
+        base = fx["base"]
+        uncond = jnp.asarray(
+            np.random.default_rng(5).normal(size=(1, 6, 16)).astype(np.float32))
+        cfg = dict(cfg_scale=3.0, uncond_context=uncond)
+        model, kw = {
+            "mask": (base, dict(sampler="euler", steps=4,
+                                prediction=prediction, init_latent=fx["init"],
+                                denoise=0.8, latent_mask=fx["mask"], **cfg)),
+            "multi_cond": (base, dict(
+                sampler="euler", steps=5, prediction=prediction,
+                extra_conds=({"context": fx["ctx2"], "strength": 0.7,
+                              "area": (4, 8, 0, 0)},), **cfg)),
+            "control": (fx["merged"], dict(sampler="euler", steps=6,
+                                           prediction=prediction, **cfg)),
+            "lora": (base, dict(sampler="euler", steps=7,
+                                prediction=prediction, lora=fx["lora1"],
+                                **cfg)),
+        }[cap]
+        sched.uninstall()
+        inline = run_sampler(model, *mk_inputs(21), **kw)
+        sched.install()
+        results = _run_plans(sched, {
+            "cap": (model, 21, kw),
+            # Ragged partner: different sampler family, different step count.
+            "partner": (base, 22, dict(sampler="heun", steps=3,
+                                       prediction=prediction, **cfg)),
+        })
+        assert len(sched.buckets) == 1
+        np.testing.assert_allclose(np.asarray(results["cap"]),
+                                   np.asarray(inline), **TOL)
+
+
+class TestOccupancyDeterminism:
+    def test_lora_and_masked_lanes_bitwise_across_occupancy(self, sched):
+        """Same-program legs are BITWISE: a LoRA lane and a masked lane
+        co-batched alone produce bit-identical latents to the same pair
+        co-batched with two extra plain lanes (identity LoRA rows and
+        zero-mask rows are structural no-ops, and the per-step noise key is
+        fold_in(rng, i) regardless of lane index)."""
+        fx = _fixtures()
+        base = fx["base"]
+        rng = jax.random.key(3)
+        pair = {
+            "lora": (base, 31, dict(sampler="euler_ancestral", steps=5,
+                                    rng=rng, lora=fx["lora1"])),
+            "masked": (base, 32, dict(sampler="euler", steps=5,
+                                      init_latent=fx["init"], denoise=0.8,
+                                      latent_mask=fx["mask"])),
+        }
+        first = _run_plans(sched, pair)
+        full = _run_plans(sched, dict(pair, **{
+            "p1": (base, 33, dict(sampler="euler", steps=5)),
+            "p2": (base, 34, dict(sampler="euler", steps=4)),
+        }))
+        for k in pair:
+            np.testing.assert_array_equal(np.asarray(first[k]),
+                                          np.asarray(full[k]), err_msg=k)
+
+
+class TestCapabilityDegradation:
+    def test_oom_on_mixed_bucket_reseats_capabilities_bitwise(self):
+        """Satellite: a dispatch OOM on a mixed-capability bucket width-halves
+        and re-seats; the re-seated lanes reconstruct their capability state
+        from step 0 and finish bit-identical to a clean run at the post-halve
+        width (same program shape → same-program leg)."""
+        fx = _fixtures()
+        base = fx["base"]
+        pair = {
+            "lora": (base, 41, dict(sampler="euler", steps=5,
+                                    lora=fx["lora1"])),
+            "masked": (base, 42, dict(sampler="euler", steps=6,
+                                      init_latent=fx["init"], denoise=0.8,
+                                      latent_mask=fx["mask"])),
+        }
+        clean = ContinuousBatchingScheduler(max_width=2, auto=False).install()
+        try:
+            want = _run_plans(clean, pair)
+        finally:
+            clean.uninstall()
+            clean.shutdown()
+        s = ContinuousBatchingScheduler(max_width=4, auto=False).install()
+        try:
+            results = {}
+
+            def worker(name, model, seed, kw):
+                noise, ctx = mk_inputs(seed)
+                results[name] = run_sampler(model, noise, ctx, **kw)
+
+            threads = [_bg(worker, k, m, seed, kw)
+                       for k, (m, seed, kw) in pair.items()]
+            _wait_enqueued(s, 2)
+            [b] = s.buckets.values()
+            real = b.dispatch
+            state = {"done": False}
+
+            def boom():
+                if not state["done"]:
+                    state["done"] = True
+                    raise RuntimeError("RESOURCE_EXHAUSTED: synthetic OOM")
+                return real()
+
+            b.dispatch = boom
+            s.drain(timeout=120)
+            for t in threads:
+                t.join(60)
+            assert len(results) == 2, sorted(results)
+            widths = {bk.width for bk in s.buckets.values()}
+            assert widths == {2}, widths
+            for k in pair:
+                np.testing.assert_array_equal(np.asarray(results[k]),
+                                              np.asarray(want[k]), err_msg=k)
+        finally:
+            s.uninstall()
+            s.shutdown()
+
+    def test_conflicting_control_trunks_bounce_to_inline(self, sched):
+        """One control-trunk identity per bucket epoch: a SECOND ControlNet
+        (different params) arriving at the same bucket sheds to the inline
+        path — and still completes correctly — instead of perturbing the
+        seated control lane."""
+        fx = _fixtures()
+        base = fx["base"]
+        other = apply_control(base, mk_ctrl(), fx["hint"] * 0.5, strength=0.3)
+        plans = {
+            "c1": (fx["merged"], 51, dict(sampler="euler", steps=5)),
+            "c2": (other, 52, dict(sampler="euler", steps=5)),
+        }
+        sched.uninstall()
+        inline = {k: run_sampler(m, *mk_inputs(seed), **kw)
+                  for k, (m, seed, kw) in plans.items()}
+        sched.install()
+        results = _run_plans(sched, plans)
+        for k in plans:
+            np.testing.assert_allclose(np.asarray(results[k]),
+                                       np.asarray(inline[k]), **TOL,
+                                       err_msg=k)
+        assert _metric_sum("pa_serving_ctrl_conflict_total") >= 1
+
+    def test_ineligible_extras_fall_back_inline_with_counter(self, sched):
+        """An extra cond with a different sequence length cannot share the
+        lane program's role blocks: the run completes inline and ticks
+        pa_serving_inline_fallback_total{reason=ineligible}."""
+        base = mk_base()
+        bad_extra = ({"context": jnp.zeros((1, 9, 16), jnp.float32),
+                      "strength": 0.5},)
+        before = registry.get(
+            "pa_serving_inline_fallback_total",
+            {"reason": "ineligible", "sampler": "euler"}) or 0
+        noise, ctx = mk_inputs(61)
+        got = run_sampler(base, noise, ctx, sampler="euler", steps=3,
+                          extra_conds=bad_extra)
+        sched.uninstall()
+        want = run_sampler(base, noise, ctx, sampler="euler", steps=3,
+                           extra_conds=bad_extra)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert registry.get(
+            "pa_serving_inline_fallback_total",
+            {"reason": "ineligible", "sampler": "euler"}) == before + 1
+
+
+class TestMeshCapabilities:
+    def test_masked_and_lora_lanes_on_virtual_mesh(self, sched, cpu_devices):
+        """The capability axes compose with data sharding on the 8-device
+        virtual mesh (lane axis = batch axis, width rounds to the mesh's
+        data width)."""
+        rng = np.random.default_rng(0)
+        params = {"proj": {"kernel": jnp.asarray(
+            rng.normal(size=(4, 4)), jnp.float32) * 0.2}}
+
+        def apply(p, x, t, context=None, **kw):
+            c = jnp.mean(context, axis=(1, 2)).reshape((-1, 1, 1, 1))
+            h = x @ p["proj"]["kernel"]
+            tt = t.reshape((-1, 1, 1, 1))
+            return jnp.tanh(h + 0.1 * c) * (0.5 + 0.1 * tt / 1000.0)
+
+        chain = DeviceChain.even([f"cpu:{i}" for i in range(8)])
+        pm = parallelize((apply, params), chain)
+        fx = _fixtures()
+        plans = {
+            "masked": (pm, 71, dict(sampler="euler", steps=4,
+                                    init_latent=fx["init"], denoise=0.8,
+                                    latent_mask=fx["mask"])),
+            "lora": (pm, 72, dict(sampler="euler", steps=5,
+                                  lora=fx["lora1"])),
+            "plain": (pm, 73, dict(sampler="euler", steps=6)),
+        }
+        sched.uninstall()
+        inline = {k: run_sampler(m, *mk_inputs(seed), **kw)
+                  for k, (m, seed, kw) in plans.items()}
+        sched.install()
+        results = _run_plans(sched, plans)
+        [bucket] = sched.buckets.values()
+        assert bucket.width == 8  # rounded to the mesh's data width
+        for k in plans:
+            np.testing.assert_allclose(np.asarray(results[k]),
+                                       np.asarray(inline[k]), **TOL,
+                                       err_msg=k)

@@ -656,6 +656,93 @@ class TestStockWorkflow:
         _, clip_ext = node.load_lora(model, external, str(lora_path), 1.0, 1.0)
         assert clip_ext is external
 
+    def test_lora_loader_attaches_serving_delegate(self, tmp_path,
+                                                    monkeypatch):
+        # Round 16 (universal lane batching): a clean 2-D LoRA bake carries a
+        # serving delegate — (unpatched base, extracted factors) — so the
+        # sampler can submit LoRA traffic as per-lane state of the BASE
+        # model's bucket. The delegate's eager merge must reproduce the bake.
+        import jax
+        from safetensors.numpy import save_file
+
+        from comfyui_parallelanything_tpu.models import load_safetensors
+        from comfyui_parallelanything_tpu.models.lora import merge_lora_params
+        from comfyui_parallelanything_tpu.nodes import NODE_CLASS_MAPPINGS
+        from comfyui_parallelanything_tpu.nodes import _split_lora_delegate
+
+        paths = _synthetic_stock_env(tmp_path, monkeypatch)
+        model, clip, _ = (
+            NODE_CLASS_MAPPINGS["CheckpointLoaderSimple"]().load(paths["ckpt"])
+        )
+        sd = load_safetensors(paths["ckpt"])
+        target = next(
+            k for k in sd
+            if k.endswith("attn1.to_q.weight") and "input_blocks" in k
+        ).removeprefix("model.diffusion_model.")
+        out_d, in_d = sd[f"model.diffusion_model.{target}"].shape
+        rng = np.random.default_rng(5)
+        lora_path = tmp_path / "style.safetensors"
+        save_file({
+            f"{target.removesuffix('.weight')}.lora_down.weight":
+                rng.standard_normal((2, in_d)).astype(np.float32),
+            f"{target.removesuffix('.weight')}.lora_up.weight":
+                rng.standard_normal((out_d, 2)).astype(np.float32),
+        }, str(lora_path))
+
+        node = NODE_CLASS_MAPPINGS["LoraLoader"]()
+        patched, _ = node.load_lora(model, clip, str(lora_path), 1.0, 1.0)
+        delegate = patched.lora_delegate
+        assert delegate is not None
+        assert delegate["base"] is model  # bucket identity == plain traffic
+        # Factor merge on the base == the bake (this env's XLA CPU matmuls
+        # run at bf16 scale — CLAUDE.md tolerance discipline).
+        merged = merge_lora_params(model.params, delegate["factors"])
+        for a, b in zip(jax.tree.leaves(merged),
+                        jax.tree.leaves(patched.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=1e-4)
+        # Chained links accumulate into ONE delegate against the same base.
+        stacked, _ = node.load_lora(patched, clip, str(lora_path), 1.0, 1.0)
+        assert stacked.lora_delegate["base"] is model
+        merged2 = merge_lora_params(model.params,
+                                    stacked.lora_delegate["factors"])
+        for a, b in zip(jax.tree.leaves(merged2),
+                        jax.tree.leaves(stacked.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=1e-4)
+
+        # The sampler split: plain positive engages the delegate; inpaint
+        # state (which the factor recompose can't thread) keeps the bake.
+        got_model, got_lora = _split_lora_delegate(patched, {})
+        assert got_model is model and got_lora is delegate["factors"]
+        keep_model, keep_lora = _split_lora_delegate(
+            patched, {"inpaint": {"mask": None, "masked_latent": None}}
+        )
+        assert keep_model is patched and keep_lora is None
+
+        # A pair the bake itself skips (no UNet match) doesn't block the
+        # delegate: factorization works off the WEIGHT DELTA, so whatever
+        # the bake applied is exactly what the factors carry.
+        ghost_path = tmp_path / "ghost.safetensors"
+        save_file({
+            f"{target.removesuffix('.weight')}.lora_down.weight":
+                rng.standard_normal((2, in_d)).astype(np.float32),
+            f"{target.removesuffix('.weight')}.lora_up.weight":
+                rng.standard_normal((out_d, 2)).astype(np.float32),
+            "ghost_block.lora_down.weight":
+                rng.standard_normal((2, 8)).astype(np.float32),
+            "ghost_block.lora_up.weight":
+                rng.standard_normal((8, 2)).astype(np.float32),
+        }, str(ghost_path))
+        ghosted, _ = node.load_lora(model, clip, str(ghost_path), 1.0, 1.0)
+        assert ghosted.lora_delegate is not None
+        merged3 = merge_lora_params(model.params,
+                                    ghosted.lora_delegate["factors"])
+        for a, b in zip(jax.tree.leaves(merged3),
+                        jax.tree.leaves(ghosted.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=1e-4)
+
     def test_save_image_defaults_to_pa_output_dir(self, tmp_path, monkeypatch):
         # Stock exports carry only filename_prefix; images must land in the
         # host-configured root (the one the API server serves /view from).
