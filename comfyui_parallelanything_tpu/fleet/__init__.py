@@ -5,6 +5,9 @@
                         /metrics scrape cache behind ``GET /fleet/metrics``)
 - fleet/router.py     — the front-door process: warm-affinity placement,
                         health-driven admission, lossless failover
+- fleet/roles.py      — disaggregated role pools (encode/denoise/decode):
+                        per-pool rings, the roofline pool-split suggestion,
+                        and the content-addressed stage store
 - fleet/journal.py    — the durable prompt journal + lease (router HA)
 - fleet/twin.py       — seeded arrival processes + the discrete-event
                         traffic twin (stdlib-only, standalone-loadable)
@@ -21,6 +24,7 @@ from .registry import (
     HeartbeatClient,
     ledger_capacity_weights,
 )
+from .roles import ROLES, RolePools, StageStore, normalize_role, suggest_pool_split
 from .router import FleetRouter, make_router, model_key
 from .scoreboard import Scoreboard
 
@@ -31,8 +35,13 @@ __all__ = [
     "HeartbeatClient",
     "JournalFollower",
     "PromptJournal",
+    "ROLES",
+    "RolePools",
     "Scoreboard",
+    "StageStore",
     "ledger_capacity_weights",
     "make_router",
     "model_key",
+    "normalize_role",
+    "suggest_pool_split",
 ]

@@ -51,8 +51,15 @@ log = get_logger()
 JOURNAL_SCHEMA = "pa-fleet-journal/v1"
 
 # Lifecycle edges. "takeover" marks a standby assuming the lease (an audit
-# row — replay treats it as a no-op for prompt state).
-EVENTS = ("submit", "dispatch", "resolve", "takeover")
+# row — replay treats it as a no-op for prompt state). The stage_* pair is
+# the STAGE LINEAGE of a role-pool dispatch (fleet/roles.py): stage_resolve
+# banks a completed stage's content-addressed output handles (embed-cache /
+# latent digests), stage_dispatch records which pool host owns the NEXT
+# stage — so a standby's takeover resumes a prompt from its last completed
+# stage (a dead decode host re-dispatches from the journaled denoise
+# handles; nothing re-denoises, and what does replay is bitwise by fold_in).
+EVENTS = ("submit", "dispatch", "resolve", "takeover",
+          "stage_dispatch", "stage_resolve")
 
 
 class PromptJournal:
@@ -185,7 +192,11 @@ class PromptJournal:
     def fold(cls, records) -> dict[str, dict]:
         """pid → last known state, folding lifecycle edges left-to-right:
         ``{"phase": submit|dispatch|resolve, "graph", "extra", "key",
-        "number", "host", "backend_pid", "entry", "status"}``."""
+        "number", "host", "backend_pid", "entry", "status", "stages",
+        "stage", "stage_idx"}``. ``stages`` is the accumulated stage
+        lineage (one row per completed stage, content-addressed handles
+        included); ``stage``/``stage_idx`` name the stage the CURRENT
+        dispatch owns — None for unstaged prompts."""
         table: dict[str, dict] = {}
         for rec in records:
             ev = rec.get("ev")
@@ -197,11 +208,30 @@ class PromptJournal:
                     "extra": rec.get("extra"), "key": rec.get("key"),
                     "number": rec.get("number"), "host": None,
                     "backend_pid": None, "entry": None, "status": None,
+                    "stages": [], "stage": None, "stage_idx": None,
                 }
             elif ev == "dispatch" and st is not None:
                 st["phase"] = "dispatch"
                 st["host"] = rec.get("host")
                 st["backend_pid"] = rec.get("backend_pid")
+                st["stage"] = rec.get("stage")
+                st["stage_idx"] = rec.get("stage_idx")
+            elif ev == "stage_dispatch" and st is not None:
+                # Ownership moves to the next stage's pool host; replay
+                # re-collects from HERE, with the lineage below feeding the
+                # handles a restarted stage needs.
+                st["phase"] = "dispatch"
+                st["host"] = rec.get("host")
+                st["backend_pid"] = rec.get("backend_pid")
+                st["stage"] = rec.get("stage")
+                st["stage_idx"] = rec.get("stage_idx")
+            elif ev == "stage_resolve" and st is not None:
+                st.setdefault("stages", []).append({
+                    "stage": rec.get("stage"),
+                    "stage_idx": rec.get("stage_idx"),
+                    "host": rec.get("host"),
+                    "handles": rec.get("handles"),
+                })
             elif ev == "resolve" and st is not None:
                 st["phase"] = "resolve"
                 st["entry"] = rec.get("entry")

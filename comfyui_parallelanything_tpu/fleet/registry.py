@@ -122,6 +122,7 @@ class HostInfo:
     static: bool = False          # configured, not heartbeat-registered
     last_beat: float = 0.0        # time.monotonic() of the last heartbeat
     joined_monotonic: float = 0.0
+    role: str = "all"             # role pool (fleet/roles.py); "all" = every pool
 
 
 class FleetRegistry:
@@ -155,7 +156,7 @@ class FleetRegistry:
         with self._lock:
             return dict(self._weights)
 
-    def add_static(self, host_id: str, base: str) -> None:
+    def add_static(self, host_id: str, base: str, role: str = "all") -> None:
         """Configured backend (router ``--backends``): in the ring until
         explicitly removed — liveness is the scoreboard's problem."""
         with self._lock:
@@ -163,25 +164,29 @@ class FleetRegistry:
                 host_id, base.rstrip("/"), static=True,
                 last_beat=time.monotonic(),
                 joined_monotonic=time.monotonic(),
+                role=role or "all",
             )
             self._rebuild()
 
-    def heartbeat(self, host_id: str, base: str) -> bool:
+    def heartbeat(self, host_id: str, base: str, role: str = "all") -> bool:
         """One registration heartbeat. Returns True when this JOINED a new
-        host (ring changed), False for a refresh."""
+        host (ring changed), False for a refresh. ``role`` is the host's
+        declared pool (fleet/roles.py) and follows the beat — a restart
+        under a new ``--role`` re-pools the host without a leave/join."""
         now = time.monotonic()
         with self._lock:
             info = self._hosts.get(host_id)
             if info is None:
                 self._hosts[host_id] = HostInfo(
                     host_id, base.rstrip("/"), last_beat=now,
-                    joined_monotonic=now,
+                    joined_monotonic=now, role=role or "all",
                 )
                 self._rebuild()
                 log.info("fleet host joined: %s (%s)", host_id, base)
                 return True
             info.last_beat = now
             info.base = base.rstrip("/")
+            info.role = role or "all"
             return False
 
     def remove(self, host_id: str) -> bool:
@@ -229,6 +234,7 @@ class FleetRegistry:
                 {
                     "host_id": i.host_id, "base": i.base, "static": i.static,
                     "heartbeat_age_s": round(now - i.last_beat, 3),
+                    "role": i.role,
                 }
                 for i in self._hosts.values()
             ]
@@ -255,10 +261,12 @@ class HeartbeatClient:
 
     def __init__(self, router_base: str, host_id: str, base: str,
                  interval_s: float = 2.0, on_rejoin=None,
-                 retry_policy: "retry.RetryPolicy | None" = None):
+                 retry_policy: "retry.RetryPolicy | None" = None,
+                 role: str = "all"):
         self.router_base = router_base.rstrip("/")
         self.host_id = host_id
         self.base = base
+        self.role = role or "all"
         self.interval_s = float(interval_s)
         self.on_rejoin = on_rejoin
         self.retry_policy = retry_policy or dataclasses.replace(
@@ -279,10 +287,20 @@ class HeartbeatClient:
         if faults.check("heartbeat-loss", key=self.host_id) is not None:
             self._failures += 1
             return False
+        # Fault site (utils/faults.py): the backend→router half of a
+        # NETWORK PARTITION — unlike heartbeat-loss (this host's beats
+        # alone go dark), the chaos matrix fires this together with the
+        # router→backend half on the same host, so BOTH directions are cut
+        # at once: the router fails our in-flight prompts over while we
+        # keep executing into a void. Keyed "{host_id}->router".
+        if faults.check("network-partition", key=f"{self.host_id}->router") is not None:
+            self._failures += 1
+            return False
         req = urllib.request.Request(
             self.router_base + "/fleet/register",
             data=json.dumps(
-                {"host_id": self.host_id, "base": self.base}
+                {"host_id": self.host_id, "base": self.base,
+                 "role": self.role}
             ).encode(),
             headers={"Content-Type": "application/json"}, method="POST",
         )

@@ -1,0 +1,418 @@
+"""Role pools: encode / denoise / decode as separately scaled fleet tiers.
+
+The reference has no notion of serving stages: every worker thread runs the
+WHOLE sampler loop — text encode, denoise steps, and decode all execute on
+whatever device the thread was pinned to (any_device_parallel.py:817-905).
+That shape wastes heavy chips on cheap work: a tail VAE decode or a
+millisecond text-encode occupies the same accelerator a denoise step needs.
+
+This module is the fleet-level answer (ROADMAP "role disaggregation"): hosts
+declare a **role** at registration — ``encode`` (small-chip/CPU hosts
+fronting the content-addressed embed cache), ``denoise`` (the lane-batched
+heavy chips), ``decode`` (width-bucketed batched decodes) — or the default
+``all``, which keeps a host in every pool (a single-pool deployment of
+``all`` hosts is bitwise-identical to the pre-role fleet). The router's
+placement then becomes per-stage: :class:`RolePools` maintains one
+consistent-hash ring per role over the pool's members (same capacity
+weighting and warm-affinity semantics as the global ring,
+fleet/registry.py), and :func:`suggest_pool_split` sizes the pools from
+roofline per-role capacity predictions so "how many decode hosts do I need"
+is a computed answer, not a guess.
+
+Stage hand-offs are content-addressed: :class:`StageStore` holds serialized
+boundary outputs (cond tensors out of encode, latents out of denoise) under
+an md5-of-bytes key — the "latent digest" the journal's stage-lineage
+records carry, so a standby router's takeover can re-dispatch a decode from
+the journaled denoise output handle without re-denoising, and a missing
+handle degrades to local recompute of the upstream stages (bitwise by the
+fold_in contract), never an error.
+
+Pure host-side bookkeeping at module level: nothing here imports jax or
+numpy until a value is actually serialized.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+from collections import OrderedDict
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+# The stage vocabulary. Stage names ARE role names: host.carve_stages ranks
+# workflow nodes into exactly these buckets (host.py's SLO class_type
+# vocabulary — "TextEncode" / "Sampler" / "Decode"), so a stage's dispatch
+# pool is the role of the same name.
+ROLES = ("encode", "denoise", "decode")
+
+
+def normalize_role(raw) -> str:
+    """Canonical role string: one of :data:`ROLES` or ``"all"`` (the
+    default — member of every pool). Unknown strings raise ``ValueError``
+    so a typo'd ``--role dencode`` fails at startup, not at placement."""
+    role = str(raw or "all").strip().lower()
+    if role in ("", "all"):
+        return "all"
+    if role not in ROLES:
+        raise ValueError(
+            f"unknown role {raw!r} (expected one of {('all',) + ROLES})"
+        )
+    return role
+
+
+def _gauge(name, value, labels=None, help="") -> None:
+    try:
+        from ..utils.metrics import registry as _metrics
+    except Exception:
+        return
+    try:
+        _metrics.gauge(name, value, labels=labels, help=help)
+    except Exception:
+        pass
+
+
+class RolePools:
+    """Per-role consistent-hash rings over a :class:`~.registry.FleetRegistry`.
+
+    Role source, in priority order: the role the host registered with
+    (``HostInfo.role`` — the ``--role`` knob on server.py riding the
+    heartbeat), else the role the host's own ``/health`` advertises (the
+    scoreboard's parsed snapshot — covers statically configured
+    ``--backends`` hosts that never heartbeat). A host whose role is
+    ``all`` joins every pool.
+
+    Rings rebuild lazily: every query recomputes a cheap membership
+    signature ``(host_id, role, weight)`` and rebuilds only when it moved —
+    the same keys-stay-put churn property as the global ring."""
+
+    def __init__(self, registry, scoreboard=None, vnodes: int = 64):
+        self.registry = registry
+        self.scoreboard = scoreboard
+        self.vnodes = int(vnodes)
+        self._lock = threading.Lock()
+        self._sig = None                     # guarded-by: _lock
+        self._rings: dict[str, "object"] = {}    # guarded-by: _lock
+        self._members: dict[str, list[str]] = {}  # guarded-by: _lock
+
+    # -- role resolution -----------------------------------------------------
+
+    def role_of(self, host_id: str) -> str:
+        """Effective role of one live host (``"all"`` when undeclared)."""
+        return self.membership().get(host_id, "all")
+
+    def _scoreboard_role(self, host_id: str) -> str | None:
+        sb = self.scoreboard
+        if sb is None:
+            return None
+        role_of = getattr(sb, "role_of", None)
+        if role_of is None:
+            return None
+        try:
+            return role_of(host_id)
+        except Exception:
+            return None
+
+    def membership(self) -> dict[str, str]:
+        """host_id → effective role over the registry's live hosts."""
+        out: dict[str, str] = {}
+        for hid, info in self.registry.hosts().items():
+            role = getattr(info, "role", "all") or "all"
+            if role == "all":
+                role = self._scoreboard_role(hid) or "all"
+            try:
+                out[hid] = normalize_role(role)
+            except ValueError:
+                out[hid] = "all"
+        return out
+
+    def disaggregated(self) -> bool:
+        """True when any live host declared a specific role — the router's
+        gate for stage-carved dispatch. An all-``all`` fleet stays on the
+        single-dispatch path bitwise-unchanged."""
+        return any(r != "all" for r in self.membership().values())
+
+    # -- rings ---------------------------------------------------------------
+
+    def _refresh(self) -> dict[str, list[str]]:
+        from .registry import HashRing
+
+        members_by_role: dict[str, list[str]] = {r: [] for r in ROLES}
+        membership = self.membership()
+        for hid in sorted(membership):
+            role = membership[hid]
+            for r in ROLES:
+                if role in (r, "all"):
+                    members_by_role[r].append(hid)
+        try:
+            weights = self.registry.capacity_weights()
+        except Exception:
+            weights = {}
+        sig = (
+            tuple(sorted(membership.items())),
+            tuple(sorted(weights.items())),
+        )
+        with self._lock:
+            if sig != self._sig:
+                rings = {}
+                for r, hids in members_by_role.items():
+                    ring = HashRing(vnodes=self.vnodes)
+                    ring.rebuild(hids, weights)
+                    rings[r] = ring
+                self._rings = rings
+                self._members = members_by_role
+                self._sig = sig
+            return dict(self._members)
+
+    def pool_sizes(self) -> dict[str, int]:
+        members = self._refresh()
+        return {r: len(members[r]) for r in ROLES}
+
+    def sequence(self, role: str, key: str) -> list[str]:
+        """Host preference order for ``key`` within one role's pool —
+        primary first, ring order after (the spill/failover order). An
+        EMPTY pool falls back to the registry's global ring: a fleet that
+        declared denoise+encode hosts but no decode host still decodes
+        (on whoever the global ring picks), it just doesn't isolate."""
+        self._refresh()
+        with self._lock:
+            ring = self._rings.get(role)
+            seq = ring.sequence(key) if ring is not None else []
+        if seq:
+            return seq
+        return self.registry.sequence(key)
+
+    def publish_gauges(self) -> None:
+        """Live pool sizes (``pa_role_pool_size{role=}``) — scrape-time
+        publication, same pattern as the server's queue gauges."""
+        for role, n in self.pool_sizes().items():
+            _gauge("pa_role_pool_size", n, labels={"role": role},
+                   help="live hosts in each role pool (all-role hosts count in every pool)")
+
+    def snapshot(self) -> dict:
+        """The ``/fleet/hosts`` roles section."""
+        members = self._refresh()
+        return {
+            "disaggregated": self.disaggregated(),
+            "pools": {r: list(members[r]) for r in ROLES},
+            "membership": self.membership(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# pool sizing from roofline per-role capacity
+# ---------------------------------------------------------------------------
+
+# Nominal per-role service-time SHAPE when no measured stage histogram
+# exists yet: denoise dominates (the full step loop), decode is the VAE tail
+# (~1/4 of a step budget at CPU-spec arithmetic intensity), encode is a
+# single text-tower pass. Only the RATIOS matter to apportionment; the
+# roofline's nominal step time scales all three identically.
+_NOMINAL_SHAPE = {"encode": 0.10, "denoise": 1.00, "decode": 0.25}
+
+
+def suggest_pool_split(total_hosts: int,
+                       stage_p50s: dict | None = None,
+                       device_kind: str = "",
+                       platform: str = "cpu") -> dict[str, int]:
+    """Apportion ``total_hosts`` across the role pools proportionally to
+    per-role load — measured stage p50s when the SLO histograms have them
+    (``encode`` / ``eval`` / ``decode`` stage walls; ``denoise`` accepted as
+    an alias for ``eval``), else the roofline-nominal shape scaled by
+    :func:`utils.roofline.nominal_step_time_s` for the platform.
+
+    Largest-remainder apportionment; every pool gets at least one host when
+    ``total_hosts >= 3`` (a pool sized zero would silently fall back to the
+    global ring and un-disaggregate that stage)."""
+    total = max(0, int(total_hosts))
+    if total == 0:
+        return {r: 0 for r in ROLES}
+
+    p = dict(stage_p50s or {})
+    loads = {
+        "encode": p.get("encode"),
+        "denoise": p.get("denoise", p.get("eval")),
+        "decode": p.get("decode"),
+    }
+    if not all(isinstance(v, (int, float)) and v > 0 for v in loads.values()):
+        try:
+            from ..utils import roofline
+
+            t = roofline.nominal_step_time_s(device_kind, platform)
+        except Exception:
+            t = 1.0
+        for r, v in loads.items():
+            if not (isinstance(v, (int, float)) and v > 0):
+                loads[r] = _NOMINAL_SHAPE[r] * t
+
+    weight = sum(loads.values())
+    quotas = {r: total * loads[r] / weight for r in ROLES}
+    split = {r: int(quotas[r]) for r in ROLES}
+    if total >= len(ROLES):
+        for r in ROLES:
+            split[r] = max(1, split[r])
+    # Largest remainder fills (or trims, after the min-1 floor) to total.
+    def _by_remainder(reverse: bool):
+        return sorted(ROLES, key=lambda r: quotas[r] - int(quotas[r]),
+                      reverse=reverse)
+
+    while sum(split.values()) < total:
+        for r in _by_remainder(reverse=True):
+            if sum(split.values()) >= total:
+                break
+            split[r] += 1
+    while sum(split.values()) > total:
+        for r in _by_remainder(reverse=False):
+            if sum(split.values()) <= total:
+                break
+            floor = 1 if total >= len(ROLES) else 0
+            if split[r] > floor:
+                split[r] -= 1
+    return split
+
+
+# ---------------------------------------------------------------------------
+# content-addressed stage hand-off store
+# ---------------------------------------------------------------------------
+
+DEFAULT_STORE_BYTES = 256 * 1024 * 1024
+
+
+def store_budget_bytes() -> int:
+    """``PA_STAGE_STORE_BYTES`` (bytes; 0 disables the store — every stage
+    hand-off then degrades to recompute-locally, still correct)."""
+    raw = os.environ.get("PA_STAGE_STORE_BYTES")
+    if raw is None:
+        return DEFAULT_STORE_BYTES
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return DEFAULT_STORE_BYTES
+
+
+def _to_host_arrays(value):
+    """Device arrays → numpy, recursively, so a stage boundary value
+    serializes without shipping a live device buffer (and deserializes on a
+    host with a different mesh). Containers keep their shape; jnp consumers
+    accept numpy inputs transparently."""
+    if isinstance(value, tuple):
+        return tuple(_to_host_arrays(v) for v in value)
+    if isinstance(value, list):
+        return [_to_host_arrays(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _to_host_arrays(v) for k, v in value.items()}
+    if hasattr(value, "__array__") and not isinstance(value, (str, bytes)):
+        import numpy as np
+
+        return np.asarray(value)
+    return value
+
+
+def serialize_value(value) -> bytes:
+    """One node-output tuple → wire bytes (pickle over numpy-converted
+    leaves). Raises on unpicklable values — callers treat that as "this
+    boundary can't hand off" and skip the handle, not as an error."""
+    return pickle.dumps(_to_host_arrays(value), protocol=4)
+
+
+def deserialize_value(blob: bytes):
+    return pickle.loads(blob)
+
+
+def content_key(blob: bytes) -> str:
+    """The content address: md5 hex of the serialized bytes — the "latent
+    digest" a journal stage record carries for a denoise output, and the
+    cond digest for an encode output."""
+    return hashlib.md5(blob).hexdigest()
+
+
+class StageStore:
+    """Byte-bounded LRU of serialized stage boundary values, keyed by
+    content address. Every backend owns one (module-level :data:`store`):
+    a host PUTs the boundary outputs of the stage it just ran and serves
+    them to the next stage's host over ``GET /stage/{key}``; a missing key
+    is a miss, never an error (the fetching host recomputes locally)."""
+
+    def __init__(self, max_bytes: int | None = None):
+        self.max_bytes = store_budget_bytes() if max_bytes is None else int(max_bytes)
+        self._lock = threading.Lock()
+        self._blobs: "OrderedDict[str, bytes]" = OrderedDict()  # guarded-by: _lock
+        self._bytes = 0       # guarded-by: _lock
+        self.hits = 0         # guarded-by: _lock
+        self.misses = 0       # guarded-by: _lock
+        self.evictions = 0    # guarded-by: _lock
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_bytes > 0
+
+    def put(self, blob: bytes) -> str:
+        """Insert one serialized value; returns its content key. Oversized
+        blobs (> the whole budget) are hashed but not retained."""
+        key = content_key(blob)
+        if not self.enabled or len(blob) > self.max_bytes:
+            return key
+        with self._lock:
+            if key in self._blobs:
+                self._blobs.move_to_end(key)
+                return key
+            self._blobs[key] = blob
+            self._bytes += len(blob)
+            while self._bytes > self.max_bytes and self._blobs:
+                _, old = self._blobs.popitem(last=False)
+                self._bytes -= len(old)
+                self.evictions += 1
+        return key
+
+    def put_value(self, value) -> str | None:
+        """Serialize + insert; ``None`` when the value can't serialize (a
+        model handle at a stage boundary) — the caller simply doesn't
+        advertise a handle for that output."""
+        try:
+            blob = serialize_value(value)
+        except Exception:
+            return None
+        return self.put(blob)
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            blob = self._blobs.get(key)
+            if blob is None:
+                self.misses += 1
+                return None
+            self._blobs.move_to_end(key)
+            self.hits += 1
+            return blob
+
+    def get_value(self, key: str):
+        blob = self.get(key)
+        return None if blob is None else deserialize_value(blob)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._blobs.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled, "entries": len(self._blobs),
+                "bytes": self._bytes, "budget_bytes": self.max_bytes,
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+    def publish_gauges(self) -> None:
+        s = self.stats()
+        _gauge("pa_role_stage_store_bytes", s["bytes"],
+               help="resident bytes in the content-addressed stage hand-off store")
+        _gauge("pa_role_stage_store_entries", s["entries"],
+               help="entries in the content-addressed stage hand-off store")
+
+
+# The process-wide store every server/backends shares (one per process, the
+# same pattern as models/embed_cache.cache).
+store = StageStore()

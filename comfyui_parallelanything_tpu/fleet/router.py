@@ -52,12 +52,15 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from ..utils import faults
 from ..utils import retry as retry_mod
 from ..utils import slo, tracing
 from ..utils.logging import get_logger
 from ..utils.metrics import registry
+from . import roles as roles_mod
 from .journal import JournalFollower, PromptJournal
 from .registry import FleetRegistry, stable_hash
+from .roles import RolePools
 from .scoreboard import Scoreboard, merge_metrics
 
 log = get_logger()
@@ -144,6 +147,18 @@ class FleetPrompt:
     # queue against a saturated/empty fleet every 50 ms sweep.
     retry_at: float = 0.0
     queue_retries: int = 0
+    # Role-pool stage lifecycle (fleet/roles.py): ``plan`` is the carve
+    # (host.carve_stages), set only when the fleet is disaggregated and the
+    # graph carves; ``stage_idx`` names the stage the current dispatch owns;
+    # ``stage_handles`` is the accumulated content-addressed lineage
+    # (node id → stage-store key) and ``stage_hosts`` which hosts banked
+    # those handles (their bases ride the next stage's pa_stage.sources).
+    # A failover re-dispatches ONLY the current stage — completed stages
+    # survive as handles, which is the whole point of the lineage.
+    plan: dict | None = None
+    stage_idx: int = 0
+    stage_handles: dict = dataclasses.field(default_factory=dict)
+    stage_hosts: list = dataclasses.field(default_factory=list)
 
 
 class FleetRouter:
@@ -166,6 +181,11 @@ class FleetRouter:
                  auto: bool = True):
         self.registry = fleet_registry or FleetRegistry()
         self.scoreboard = scoreboard or Scoreboard()
+        # Role pools (fleet/roles.py): per-stage consistent-hash rings over
+        # the hosts advertising each role. With every host at the default
+        # "all" the pools are the whole ring and placement below is
+        # bitwise-identical to the single-pool router.
+        self.roles = RolePools(self.registry, self.scoreboard)
         self.saturation_depth = int(saturation_depth)
         self.max_attempts = int(max_attempts)
         self.monitor_s = float(monitor_s)
@@ -230,8 +250,21 @@ class FleetRouter:
 
     # -- backend HTTP -------------------------------------------------------
 
+    @staticmethod
+    def _partition_check(base: str) -> None:
+        """Fault site (utils/faults.py ``network-partition``): the
+        router→backend half of a partition — every outbound call to the
+        matched base raises the same refused-socket OSError a real severed
+        link produces, while the backend itself stays healthy (its half is
+        the HeartbeatClient's skipped beat). The dispatch/collect paths then
+        exercise their real failure handling: scoreboard failure counts,
+        ring walk-on, dead-host failover."""
+        if faults.check("network-partition", key=f"router->{base}") is not None:
+            raise OSError(f"injected network partition: router->{base}")
+
     def _post(self, base: str, path: str, payload: dict,
               timeout: float | None = None) -> dict:
+        self._partition_check(base)
         req = urllib.request.Request(
             base + path, data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"}, method="POST",
@@ -242,6 +275,7 @@ class FleetRouter:
             return json.loads(r.read())
 
     def _get(self, base: str, path: str, timeout: float | None = None):
+        self._partition_check(base)
         with urllib.request.urlopen(
             base + path, timeout=timeout or self.http_timeout_s
         ) as r:
@@ -281,7 +315,8 @@ class FleetRouter:
                 and polled >= self._last_drop.get(host_id, 0.0))
 
     def place(self, key: str, exclude=(),
-              prefer_warm: bool = False) -> tuple[str, str, bool]:
+              prefer_warm: bool = False,
+              role: str | None = None) -> tuple[str, str, bool]:
         """(host_id, base, spilled) for a model key: the first accepting
         host in ring order that is not saturated; if every accepting host is
         saturated, the least-loaded one (bounded queueing beats a 503 while
@@ -292,12 +327,37 @@ class FleetRouter:
         within each tier — replaying a dead host's prompt on a warm sibling
         skips the compile + weight staging a cold primary would pay
         (ROADMAP fleet item 3). Fresh traffic keeps pure ring order: warm
-        affinity is already where the ring points."""
-        seq = self.registry.sequence(key)
+        affinity is already where the ring points.
+
+        ``role`` (the disaggregated path, fleet/roles.py): ring order comes
+        from that role's POOL ring — the hosts advertising the stage's role
+        (plus ``all`` generalists) — so an encode stage never lands on a
+        heavy denoise chip and per-role scaling is purely membership. With
+        ``role=None`` (every single-pool deployment) this is the registry
+        ring verbatim."""
+        seq = (self.roles.sequence(role, key) if role is not None
+               else self.registry.sequence(key))
         candidates = [
             h for h in seq
             if h not in exclude and self.scoreboard.accepting(h)
         ]
+        if not candidates and role is not None:
+            # The role pool exists but no member is accepting (e.g. the only
+            # decode host died mid-stage). Degrade to the global ring: any
+            # healthy host runs the stage closure bitwise (fold_in replay
+            # contract), so losing a whole tier costs locality, never
+            # prompts.
+            seq = self.registry.sequence(key)
+            candidates = [
+                h for h in seq
+                if h not in exclude and self.scoreboard.accepting(h)
+            ]
+            if candidates:
+                registry.counter(
+                    "pa_role_pool_degraded_total", labels={"role": role},
+                    help="stage placements that fell back to the global "
+                         "ring because the role pool had no accepting host",
+                )
         if not candidates:
             raise NoHealthyHost(
                 f"no accepting backend for key {key} "
@@ -329,6 +389,31 @@ class FleetRouter:
             self.journal.append("resolve", fp.pid,
                                 status=status or fp.status, entry=fp.entry)
 
+    @staticmethod
+    def _carve(graph: dict) -> dict | None:
+        """The stage plan (host.carve_stages) for a graph, or None when it
+        doesn't carve — the single-dispatch fallback. Imported lazily: the
+        router stays a thin stdlib process until a disaggregated fleet
+        actually needs the carve, and any import/carve failure degrades to
+        whole-graph dispatch, never an error (the backend re-derives the
+        same carve from the same graph, so both sides always agree)."""
+        try:
+            from ..host import carve_stages
+            return carve_stages(graph)
+        except Exception:  # noqa: BLE001 — degrade to single dispatch
+            return None
+
+    @staticmethod
+    def _stage_of(fp: FleetPrompt) -> dict | None:
+        """The plan entry the prompt's CURRENT dispatch owns, or None for
+        unstaged prompts."""
+        if fp.plan is None:
+            return None
+        stages = fp.plan.get("stages") or []
+        if 0 <= fp.stage_idx < len(stages):
+            return stages[fp.stage_idx]
+        return None
+
     def submit(self, graph: dict, extra: dict | None = None) -> tuple[str, int]:
         """Admit one prompt into the fleet; returns (router prompt_id,
         submission number). Raises NoHealthyHost / FleetSaturated when no
@@ -348,6 +433,11 @@ class FleetRouter:
             number=number,
             trace_submit_us=tracing.now_us() if tracing.on() else None,
         )
+        # Disaggregated fleets carve the graph into role stages at
+        # admission; a graph that doesn't carve (or a single-pool fleet)
+        # dispatches whole — the bitwise-unchanged default.
+        if self.roles.disaggregated():
+            fp.plan = self._carve(graph)
         with self._lock:
             self.prompts[pid] = fp
         # Journal BEFORE the dispatch: a router that dies mid-placement must
@@ -390,6 +480,13 @@ class FleetRouter:
         # a join/leave reshuffle is settling — a key re-homed to a cold
         # joiner goes where its programs are still resident instead.
         prefer_warm = prefer_warm or self._ring_recently_changed()
+        # Staged prompts (fleet/roles.py) dispatch their CURRENT stage to
+        # that stage's role pool: the full graph travels (the backend
+        # re-derives the same carve — both sides always agree on the
+        # boundary), plus ``pa_stage`` naming the stage, the lineage handles
+        # covering its needs, and the bases holding those handles.
+        stage = self._stage_of(fp)
+        role = str(stage["stage"]) if stage is not None else None
         saw_backpressure = False
         while True:
             if fp.attempts >= self.max_attempts:
@@ -402,7 +499,8 @@ class FleetRouter:
             with self._lock:
                 try:
                     host, base, spilled = self.place(
-                        fp.key, exclude=exclude, prefer_warm=prefer_warm
+                        fp.key, exclude=exclude, prefer_warm=prefer_warm,
+                        role=role,
                     )
                 except NoHealthyHost:
                     if saw_backpressure:
@@ -424,6 +522,22 @@ class FleetRouter:
             # router-side fleet-prompt span AND the backend-side prompt
             # timeline joined by origin_prompt_id.
             extra["fleet"] = {"origin": fp.pid, "router": self.router_id}
+            if stage is not None:
+                with self._lock:
+                    # The FULL accumulated lineage, not just this stage's
+                    # declared needs: a later stage's closure names every
+                    # upstream node, and any resolved boundary inside it
+                    # (the encode output two stages back) short-circuits
+                    # that node's whole prefix on the executing host —
+                    # without it a decode host re-runs the encoder class.
+                    handles = dict(fp.stage_handles)
+                    sources = []
+                    for hid in fp.stage_hosts:
+                        b = self.registry.base_of(hid)
+                        if b and b not in sources:
+                            sources.append(b)
+                extra["pa_stage"] = {"stage": str(stage["stage"]),
+                                     "handles": handles, "sources": sources}
             t0_us = tracing.now_us() if tracing.on() else 0.0
             try:
                 resp = self._post(
@@ -473,12 +587,33 @@ class FleetRouter:
                 fp.backend_pid = resp.get("prompt_id")
                 fp.status = "inflight"
             if self.journal is not None:
-                self.journal.append("dispatch", fp.pid, host=host,
-                                    backend_pid=fp.backend_pid,
-                                    attempt=fp.attempts)
+                if stage is not None and fp.stage_idx > 0:
+                    # Ownership moved to a later stage's pool host: the
+                    # lineage record a standby resumes from (journal.py).
+                    self.journal.append("stage_dispatch", fp.pid, host=host,
+                                        backend_pid=fp.backend_pid,
+                                        attempt=fp.attempts,
+                                        stage=str(stage["stage"]),
+                                        stage_idx=fp.stage_idx)
+                elif stage is not None:
+                    self.journal.append("dispatch", fp.pid, host=host,
+                                        backend_pid=fp.backend_pid,
+                                        attempt=fp.attempts,
+                                        stage=str(stage["stage"]),
+                                        stage_idx=fp.stage_idx)
+                else:
+                    self.journal.append("dispatch", fp.pid, host=host,
+                                        backend_pid=fp.backend_pid,
+                                        attempt=fp.attempts)
             registry.counter("pa_fleet_dispatch_total",
                              labels={"host": host},
                              help="prompts forwarded per backend")
+            if role is not None:
+                registry.counter(
+                    "pa_role_dispatch_total",
+                    labels={"role": role, "host": host},
+                    help="stage dispatches per role pool (fleet/roles.py)",
+                )
             if spilled:
                 registry.counter(
                     "pa_fleet_spill_total", labels={"host": host},
@@ -549,6 +684,95 @@ class FleetRouter:
                 failovers=fp.failovers,
                 outcome=(entry.get("status") or {}).get("status_str"),
             )
+
+    def _stage_or_complete(self, fp: FleetPrompt, entry: dict) -> None:
+        """Route a collected entry: a non-final STAGE result advances the
+        lineage and dispatches the next stage; everything else — an
+        unstaged prompt, the final stage, an errored stage, or an entry
+        WITHOUT ``status.pa_stage`` (the backend fell back to whole-graph
+        execution, so this entry already IS the prompt's result) —
+        completes the prompt."""
+        stage = self._stage_of(fp)
+        if stage is None:
+            return self._complete(fp, entry)
+        status = entry.get("status") if isinstance(entry, dict) else None
+        ps = status.get("pa_stage") if isinstance(status, dict) else None
+        if (isinstance(ps, dict)
+                and str(ps.get("stage")) != str(stage["stage"])):
+            # The entry belongs to an ALREADY-RESOLVED earlier stage: a
+            # takeover adopted this prompt between its stage_resolve and
+            # the next stage_dispatch, so re-collecting the old owner's
+            # history yields the banked stage again. The lineage already
+            # holds those handles — claim the prompt and dispatch the
+            # CURRENT stage instead of advancing past it (or, worse,
+            # completing a decode-stage prompt with a denoise entry).
+            with self._lock:
+                if fp.status != "inflight":
+                    return
+                fp.stage_handles.update({
+                    str(k): str(v)
+                    for k, v in (ps.get("handles") or {}).items()
+                })
+                if fp.host_id:
+                    if fp.host_id not in fp.stage_hosts:
+                        fp.stage_hosts.append(fp.host_id)
+                    self._inflight[fp.host_id] = max(
+                        0, self._inflight.get(fp.host_id, 0) - 1
+                    )  # inline (holds the lock) — not _release
+                    self._last_drop[fp.host_id] = time.monotonic()
+                fp.status = "submitting"
+                fp.host_id = None
+                fp.backend_pid = None
+            self._dispatch_or_queue(fp)
+            return
+        final = fp.stage_idx >= len(fp.plan.get("stages") or ()) - 1
+        if not isinstance(ps, dict) or final:
+            return self._complete(fp, entry)
+        if (status.get("status_str") == "error"
+                or not status.get("completed", True)):
+            # A failed stage fails the prompt — the error entry is the
+            # client's answer; nothing downstream could run anyway.
+            return self._complete(fp, entry)
+        self._advance_stage(fp, entry, ps)
+
+    def _advance_stage(self, fp: FleetPrompt, entry: dict, ps: dict) -> None:
+        """Bank a completed stage's content-addressed handles into the
+        lineage (journal ``stage_resolve``) and dispatch the next stage to
+        its role pool. Concurrent collectors are safe: the first caller
+        flips the prompt off ``inflight`` under the lock; later ones
+        no-op."""
+        with self._lock:
+            if fp.status != "inflight":
+                return
+            stage_name = str(ps.get("stage") or "")
+            done_idx = fp.stage_idx
+            done_host = fp.host_id
+            handles = {str(k): str(v)
+                       for k, v in (ps.get("handles") or {}).items()}
+            fp.stage_handles.update(handles)
+            if done_host and done_host not in fp.stage_hosts:
+                fp.stage_hosts.append(done_host)
+            if done_host:
+                self._inflight[done_host] = max(
+                    0, self._inflight.get(done_host, 0) - 1
+                )  # inline (holds the lock) — not _release
+                self._last_drop[done_host] = time.monotonic()
+            # Claimed by THIS caller for the next hop (same rule as
+            # failover_host: the queued-retry sweep must not double-dispatch
+            # a prompt another thread is already advancing).
+            fp.status = "submitting"
+            fp.stage_idx = done_idx + 1
+            fp.host_id = None
+            fp.backend_pid = None
+        if self.journal is not None:
+            self.journal.append("stage_resolve", fp.pid, stage=stage_name,
+                                stage_idx=done_idx, host=done_host,
+                                handles=handles)
+        registry.counter("pa_role_stage_resolved_total",
+                         labels={"role": stage_name or "?"},
+                         help="stage results banked into the lineage "
+                              "(fleet/roles.py)")
+        self._dispatch_or_queue(fp)
 
     def failover_host(self, host_id: str, reason: str) -> int:
         """Move every in-flight prompt off a dead/unhealthy host: re-submit
@@ -731,11 +955,26 @@ class FleetRouter:
                     number=int(rec.get("number") or 0),
                     status="shadow-submit",
                 )
-            elif ev == "dispatch" and fp is not None:
+            elif ev in ("dispatch", "stage_dispatch") and fp is not None:
                 fp.status = "shadow-inflight"
                 fp.host_id = rec.get("host")
                 fp.backend_pid = rec.get("backend_pid")
                 fp.attempts = int(rec.get("attempt") or fp.attempts)
+                if rec.get("stage_idx") is not None:
+                    fp.stage_idx = int(rec["stage_idx"])
+            elif ev == "stage_resolve" and fp is not None:
+                # The lineage a takeover resumes from: handles for every
+                # completed stage, and which host banked them (its base
+                # rides the next dispatch's pa_stage.sources).
+                fp.stage_handles.update({
+                    str(k): str(v)
+                    for k, v in (rec.get("handles") or {}).items()
+                })
+                host = rec.get("host")
+                if host and host not in fp.stage_hosts:
+                    fp.stage_hosts.append(host)
+                if rec.get("stage_idx") is not None:
+                    fp.stage_idx = int(rec["stage_idx"]) + 1
             elif ev == "resolve" and fp is not None:
                 entry = rec.get("entry")
                 if rec.get("status") == "rejected" or entry is None:
@@ -792,6 +1031,21 @@ class FleetRouter:
                 elif fp.status == "shadow-submit":
                     fp.status = "queued"
                     adopted += 1
+                else:
+                    max_number = max(max_number, fp.number)
+                    continue
+                # A shadow with stage lineage needs its plan back (the
+                # journal carries handles, not the carve — the carve is
+                # deterministic in the graph). _carve returning None
+                # degrades to whole-graph re-dispatch: still bitwise, just
+                # not disaggregated.
+                if (fp.stage_idx or fp.stage_handles) and fp.graph:
+                    fp.plan = self._carve(fp.graph)
+                    if fp.plan is None:
+                        fp.stage_idx = 0
+                        fp.stage_handles = {}
+                elif fp.graph and self.roles.disaggregated():
+                    fp.plan = self._carve(fp.graph)
                 max_number = max(max_number, fp.number)
             # Submission numbers keep ascending across the failover.
             self._counter = max_number
@@ -828,7 +1082,7 @@ class FleetRouter:
             return
         entry = hist.get(fp.backend_pid)
         if entry:
-            self._complete(fp, entry)
+            self._stage_or_complete(fp, entry)
 
     def _collect_histories(self) -> None:
         with self._lock:
@@ -924,6 +1178,40 @@ class FleetRouter:
         return {"prompts": by_status, "router_inflight": inflight,
                 "lost": by_status.get("lost", 0)}
 
+    def roles_view(self) -> dict:
+        """The role-pool picture for ``GET /fleet/hosts``: declared
+        membership + pool sizes, plus the roofline-derived SUGGESTED split
+        for this host count (fleet/roles.py ``suggest_pool_split``) — what
+        an operator compares their knobs against before re-rolling a host's
+        ``--role``."""
+        doc = self.roles.snapshot()
+        total = len(self.registry.hosts())
+        doc["suggested"] = (
+            roles_mod.suggest_pool_split(total) if total else {}
+        )
+        return doc
+
+    def _role_slo(self, objectives) -> dict:
+        """Per-ROLE SLO verdicts: each role's verdicts judged over the
+        merged scrapes of only that pool's hosts (generalist ``all`` hosts
+        count toward every pool, exactly as placement sees them)."""
+        out: dict[str, dict] = {}
+        membership = self.roles.membership()
+        hosts = self.registry.hosts()
+        for role in roles_mod.ROLES:
+            texts: dict[str, str] = {}
+            for hid, info in hosts.items():
+                if membership.get(hid, "all") not in (role, "all"):
+                    continue
+                text, _age = self.scoreboard.scrape_metrics(hid, info.base)
+                if text is not None:
+                    texts[hid] = text
+            if texts:
+                out[role] = slo.verdicts_from_text(
+                    merge_metrics(texts), objectives
+                )
+        return out
+
     def fleet_metrics_view(self) -> tuple[str, dict]:
         """The fleet-wide merged Prometheus view (``GET /fleet/metrics``):
         every live backend's ``/metrics`` (scoreboard-cached, backoff-aware
@@ -987,16 +1275,22 @@ class FleetRouter:
                 "objectives": per,
                 "scrape_stale": stale.get(hid, True),
             }
-        return {
+        doc = {
             "schema": "pa-fleet-slo/v1",
             "router_id": self.router_id,
             "enabled": slo.enabled(),
             "objectives": slo.verdicts_from_text(merged, objectives),
             "hosts": hosts,
         }
+        if self.roles.disaggregated():
+            # Per-role verdicts only when pools actually exist: a
+            # single-pool fleet's /fleet/slo document stays byte-identical.
+            doc["roles"] = self._role_slo(objectives)
+        return doc
 
     def publish_gauges(self) -> None:
         self.scoreboard.publish_gauges()
+        self.roles.publish_gauges()
         stats = self.stats()
         registry.gauge("pa_fleet_inflight",
                        stats["prompts"].get("inflight", 0),
@@ -1095,6 +1389,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return self._send(200, {
                 "ring": r.registry.snapshot(),
                 "scoreboard": r.scoreboard.snapshot(),
+                "roles": r.roles_view(),
             })
         if url.path == "/fleet/metrics":
             # ONE Prometheus view of the whole fleet: every backend's
@@ -1144,7 +1439,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
             base = payload.get("base")
             if not host_id or not base:
                 return self._send(400, {"error": "host_id and base required"})
-            joined = r.registry.heartbeat(str(host_id), str(base))
+            try:
+                role = roles_mod.normalize_role(payload.get("role"))
+            except ValueError as e:
+                return self._send(400, {"error": str(e)})
+            joined = r.registry.heartbeat(str(host_id), str(base), role=role)
             if joined:
                 # Poll immediately so the joiner is placeable without
                 # waiting out a scoreboard interval — and open the

@@ -27,6 +27,7 @@ import threading
 import time
 import urllib.request
 
+from ..utils import faults
 from ..utils import retry as retry_mod
 from ..utils.logging import get_logger
 from ..utils.metrics import registry
@@ -135,6 +136,10 @@ class HostHealth:
     # pa-health/v3: model keys the host serves warm (compiled programs /
     # pinned weights resident) — the residency-aware failover preference.
     warm_keys: frozenset = frozenset()
+    # Role-pool membership the host's own /health advertises
+    # (fleet/roles.py) — how statically configured --backends hosts, which
+    # never heartbeat a role, still land in the right pool.
+    role: str = "all"
     # -- poll bookkeeping (time.monotonic clocks) --
     last_ok: float | None = None
     consecutive_failures: int = 0
@@ -205,6 +210,13 @@ class Scoreboard:
 
     def poll_host(self, host_id: str, base: str) -> bool:
         """One ``GET /health`` poll; True on success. Never raises."""
+        # Fault site (utils/faults.py ``network-partition``): health polls
+        # are router→backend traffic too — a partitioned host must go dark
+        # on the scoreboard exactly as it does on the dispatch path, or the
+        # router would keep placing onto a host it can no longer reach.
+        if faults.check("network-partition", key=f"router->{base}") is not None:
+            self.record_failure(host_id, base, "injected network partition")
+            return False
         try:
             with urllib.request.urlopen(
                 base + "/health", timeout=self.timeout_s
@@ -250,6 +262,7 @@ class Scoreboard:
             e.warm_keys = frozenset(
                 str(k) for k in (doc.get("warm_keys") or ())
             )
+            e.role = str(doc.get("role") or "all")
             e.last_ok = now
             e.consecutive_failures = 0
             e.last_error = None
@@ -352,6 +365,16 @@ class Scoreboard:
             e = self._entries.get(host_id)
             return e.last_ok if e is not None else None
 
+    def role_of(self, host_id: str) -> str | None:
+        """The role the host's own /health advertises, or None before the
+        first successful poll — RolePools (fleet/roles.py) falls back to
+        this when the registry has no heartbeat-declared role."""
+        with self._lock:
+            e = self._entries.get(host_id)
+            if e is None or e.last_ok is None:
+                return None
+            return e.role
+
     def warm(self, host_id: str, key: str) -> bool:
         """Does the host advertise ``key`` in its warm set (pa-health/v3)?
         The router's failover re-dispatch prefers warm siblings over a cold
@@ -436,6 +459,7 @@ class Scoreboard:
                 "numerics_ok": e.numerics_ok,
                 "quarantined_lanes": e.quarantined_lanes,
                 "warm_keys": sorted(e.warm_keys),
+                "role": e.role,
                 "health_age_s": None if age is None else round(age, 3),
                 "consecutive_failures": e.consecutive_failures,
                 "last_error": e.last_error,

@@ -181,6 +181,9 @@ def load_arrivals(path: str) -> dict:
 # ---------------------------------------------------------------------------
 
 
+ROLE_STAGES = ("encode", "denoise", "decode")
+
+
 def simulate(arrivals: list[float], hosts: list[dict],
              percentiles=(50, 95, 99), overhead_s: float = 0.0) -> dict:
     """Replay ``arrivals`` over per-host worker pools.
@@ -192,6 +195,17 @@ def simulate(arrivals: list[float], hosts: list[dict],
     host that can START it earliest (primary affinity while free ≡ earliest
     start; saturation spill ≡ least-loaded) — FIFO per worker, no preemption.
 
+    Host rows may also carry ``"role"`` (fleet/roles.py): when any host
+    declares a role other than ``all``, the simulation becomes the
+    DISAGGREGATED tandem — each request flows encode → denoise → decode,
+    each stage placed earliest-start within that stage's pool (role match
+    plus ``all`` generalists, who share one worker heap across every stage
+    they serve), and a stage's completion time is the next stage's arrival
+    — the hand-off edge. A host's ``service_s`` is its per-STAGE service
+    time there (an encode host's measured p50 is encode work by
+    construction). An all-``all`` fleet takes the single-queue path
+    unchanged, bit-for-bit.
+
     ``overhead_s`` is a constant per-request client-side term (HTTP +
     history-poll cadence — what loadgen's ``collect`` residual measures),
     added to every latency but occupying no server: the twin predicts the
@@ -202,35 +216,52 @@ def simulate(arrivals: list[float], hosts: list[dict],
     one open-loop loadgen rung."""
     pools: dict[str, list[float]] = {}
     service: dict[str, float] = {}
+    role_of: dict[str, str] = {}
     for h in hosts:
         hid = str(h.get("host_id"))
         workers = max(1, int(h.get("workers") or 1))
         pools[hid] = [0.0] * workers  # heap of worker-free times
         service[hid] = max(1e-6, float(h.get("service_s") or 0.0))
+        role_of[hid] = str(h.get("role") or "all")
     if not pools:
         raise ValueError("simulate() needs at least one host")
     for heap in pools.values():
         heapq.heapify(heap)
+    disaggregated = any(r != "all" for r in role_of.values())
+    # Stage hand-off edges: per-stage candidate pools, empty stages elided
+    # (a fleet with no encode specialists and no generalists has no encode
+    # hop to model).
+    stage_pools = [
+        [hid for hid in pools if role_of[hid] in (stage, "all")]
+        for stage in ROLE_STAGES
+    ] if disaggregated else [list(pools)]
+    stage_pools = [p for p in stage_pools if p]
     lat: list[float] = []
     waits: list[float] = []
     served: dict[str, int] = {hid: 0 for hid in pools}
     end = 0.0
     for t in arrivals:
-        # Earliest possible START across hosts; service time breaks ties
-        # (a faster host that starts at the same instant finishes first).
-        best_hid = min(
-            pools,
-            key=lambda hid: (max(pools[hid][0], t), service[hid]),
-        )
-        heap = pools[best_hid]
-        free = heapq.heappop(heap)
-        start = max(free, t)
-        done = start + service[best_hid]
-        heapq.heappush(heap, done)
-        lat.append(done - t + max(0.0, float(overhead_s)))
-        waits.append(start - t)
-        served[best_hid] += 1
-        end = max(end, done)
+        t_stage = t
+        wait = 0.0
+        for pool in stage_pools:
+            # Earliest possible START across the stage's hosts; service
+            # time breaks ties (a faster host that starts at the same
+            # instant finishes first).
+            best_hid = min(
+                pool,
+                key=lambda hid: (max(pools[hid][0], t_stage), service[hid]),
+            )
+            heap = pools[best_hid]
+            free = heapq.heappop(heap)
+            start = max(free, t_stage)
+            done = start + service[best_hid]
+            heapq.heappush(heap, done)
+            wait += start - t_stage
+            served[best_hid] += 1
+            t_stage = done  # the hand-off: next stage arrives at completion
+        lat.append(t_stage - t + max(0.0, float(overhead_s)))
+        waits.append(wait)
+        end = max(end, t_stage)
     out = {
         "requests": len(arrivals),
         "wall_s": round(end, 6),
@@ -310,16 +341,19 @@ def host_service_times(record: dict, calib: dict | None = None) -> list[dict]:
             )
             out.append({"host_id": hid,
                         "service_s": pred["predicted_s"] * scale,
-                        "workers": workers, "source": "roofline"})
+                        "workers": workers, "source": "roofline",
+                        "role": str(row.get("role") or "all")})
             continue
         svc = row.get("service_p50_s")
         if isinstance(svc, (int, float)) and svc > 0:
             out.append({"host_id": hid, "service_s": float(svc),
-                        "workers": workers, "source": "measured"})
+                        "workers": workers, "source": "measured",
+                        "role": str(row.get("role") or "all")})
             continue
         if isinstance(fallback, (int, float)) and fallback > 0:
             out.append({"host_id": hid, "service_s": float(fallback),
-                        "workers": workers, "source": "mean"})
+                        "workers": workers, "source": "mean",
+                        "role": str(row.get("role") or "all")})
     return out
 
 

@@ -203,12 +203,147 @@ def _wire_inputs(cls: type) -> tuple[set[str], set[str], dict[str, str]]:
     return wires, declared, hidden
 
 
+STAGES = ("encode", "denoise", "decode")
+
+
+def _intrinsic_stage(class_type) -> int | None:
+    """Stage rank of a node class, or None for neutral nodes. The SAME
+    class_type substring vocabulary as the SLO stage decomposition in
+    ``exec_visit`` below ("Decode" / "Sampler" / "TextEncode", checked in
+    that order) — one vocabulary, two consumers, so a node's stage rank and
+    its stage histogram always agree."""
+    ct = str(class_type or "")
+    if "Decode" in ct:
+        return 2
+    if "Sampler" in ct:
+        return 1
+    if "TextEncode" in ct:
+        return 0
+    return None
+
+
+def carve_stages(workflow) -> dict | None:
+    """Carve a workflow graph into encode / denoise / decode sub-plans for
+    role-pool dispatch (fleet/roles.py) — the stage-level MPMD placement the
+    reference's whole-sampler-per-thread design has no room for
+    (any_device_parallel.py:817-905).
+
+    Class-AGNOSTIC (the router has no node registry): links are detected by
+    shape plus the referenced id naming a graph node; ranks come from
+    class_type substrings (:func:`_intrinsic_stage`). Neutral nodes inherit
+    the max rank among their ancestors (a LatentUpscale after the sampler is
+    denoise work; a SaveImage after decode is decode work); nodes with no
+    ranked ancestor are FREE (loaders) and replicate into every stage's
+    closure. Each stage's executable ``graph`` is the full upstream closure
+    of its members, so a host holding no hand-off handles simply recomputes
+    the prefix locally — bitwise by the fold_in contract, never an error.
+
+    Returns ``None`` whenever the graph doesn't cleanly split — fewer than
+    two intrinsic stages present, a cycle, a malformed spec, or a
+    non-monotone stage order (highres-fix: a Decode feeding a second
+    Sampler) — and callers fall back to the single-dispatch path, which
+    keeps ``--role all`` fleets bitwise-unchanged. Otherwise::
+
+        {"stages": [{"stage": name, "nodes": [member ids],
+                     "graph": {closure subgraph}, "needs": [handle ids],
+                     "exports": [handle ids]}, ...]}
+
+    ``needs`` are the earlier-stage node ids whose output handles this
+    stage wants preseeded; ``exports`` are this stage's node ids some later
+    stage needs — the boundary values a backend banks content-addressed
+    (roles.StageStore) and the journal's stage lineage records.
+    """
+    if not isinstance(workflow, dict):
+        return None
+    graph = {str(k): v for k, v in workflow.items()}
+    deps: dict[str, list[str]] = {}
+    for nid, spec in graph.items():
+        if not isinstance(spec, dict):
+            return None
+        ds: list[str] = []
+        for v in (spec.get("inputs") or {}).values():
+            if _is_link(v) and str(v[0]) in graph:
+                dep = str(v[0])
+                if dep not in ds:
+                    ds.append(dep)
+        deps[nid] = ds
+
+    # Kahn topological order; leftovers mean a cycle → no carve.
+    indeg = {nid: 0 for nid in graph}
+    rdeps: dict[str, list[str]] = {nid: [] for nid in graph}
+    for nid, ds in deps.items():
+        indeg[nid] = len(ds)
+        for d in ds:
+            rdeps[d].append(nid)
+    ready = sorted(nid for nid, n in indeg.items() if n == 0)
+    topo: list[str] = []
+    while ready:
+        nid = ready.pop(0)
+        topo.append(nid)
+        for child in rdeps[nid]:
+            indeg[child] -= 1
+            if indeg[child] == 0:
+                ready.append(child)
+    if len(topo) != len(graph):
+        return None
+
+    rank: dict[str, int | None] = {}
+    intrinsic_present: set[int] = set()
+    for nid in topo:
+        anc = max(
+            (rank[d] for d in deps[nid] if rank.get(d) is not None),
+            default=None,
+        )
+        r = _intrinsic_stage(graph[nid].get("class_type"))
+        if r is None:
+            rank[nid] = anc
+        else:
+            intrinsic_present.add(r)
+            if anc is not None and anc > r:
+                return None  # stage order not monotone along this edge
+            rank[nid] = r
+    if len(intrinsic_present) < 2:
+        return None
+
+    present = sorted({r for r in rank.values() if r is not None})
+    stages = []
+    for s in present:
+        members = [nid for nid in topo if rank[nid] == s]
+        # Full upstream closure: members plus every transitive dependency.
+        closure: dict[str, Any] = {}
+        stack = list(members)
+        while stack:
+            nid = stack.pop()
+            if nid in closure:
+                continue
+            closure[nid] = graph[nid]
+            stack.extend(deps[nid])
+        needs = sorted({
+            d for m in members for d in deps[m]
+            if rank.get(d) is not None and rank[d] < s
+        })
+        stages.append({
+            "stage": STAGES[s], "nodes": members,
+            "graph": closure, "needs": needs, "exports": [],
+        })
+    by_rank = {st["stage"]: st for st in stages}
+    for st in stages:
+        for d in st["needs"]:
+            owner = by_rank[STAGES[rank[d]]]
+            if d not in owner["exports"]:
+                owner["exports"].append(d)
+    for st in stages:
+        st["exports"].sort()
+    return {"stages": stages}
+
+
 def run_workflow(
     workflow: Any,
     class_mappings: dict[str, type] | None = None,
     outputs: "dict[str, tuple] | WorkflowCache | None" = None,
     on_node=None,
     on_cached=None,
+    preseed: dict[str, tuple] | None = None,
 ) -> dict[str, tuple]:
     """Execute a ComfyUI API-format workflow; returns ``{node_id: outputs}``.
 
@@ -229,6 +364,13 @@ def run_workflow(
     ``utils.progress.Interrupted`` raised inside a node (the cooperative
     sampler interrupt) propagates unwrapped so callers can distinguish
     "interrupted" from "failed".
+
+    ``preseed`` force-seeds node results AFTER cache snapshotting — the
+    stage hand-off hook (``carve_stages``): a downstream stage's host
+    injects the upstream stage's content-addressed boundary outputs so the
+    postorder short-circuits the already-executed prefix. Preseeded values
+    win over cached ones for this run and are banked back under the node's
+    signature like any other result.
     """
     from .nodes import NODE_CLASS_MAPPINGS
 
@@ -348,6 +490,10 @@ def run_workflow(
         # own consistent snapshot; concurrent runs (the multi-worker server)
         # merge back at completion instead of mutating shared state mid-run.
         results = cache.snapshot(sigs)
+    if preseed:
+        results.update(
+            {str(k): tuple(v) for k, v in preseed.items() if str(k) in graph}
+        )
     if on_cached is not None:
         cached = sorted(nid for nid in graph if nid in results)
         if cached:

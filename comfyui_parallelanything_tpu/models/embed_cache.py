@@ -241,15 +241,93 @@ class EmbedCache:
 cache = EmbedCache()
 
 
+# ---------------------------------------------------------------------------
+# remote tier (round 21, the PR 12 remainder): cross-host embed fetch
+# ---------------------------------------------------------------------------
+# In a role-disaggregated fleet (fleet/roles.py) the ENCODE pool fronts this
+# cache: encode hosts serve their entries over ``GET /embed/{key}``
+# (server.py), and a denoise host that misses locally asks the encode hosts
+# listed for the current prompt before paying a local encode. The denoise
+# host's own EmbedCache is the "bounded local LRU" of the tier — a fetched
+# value lands in it under the normal byte bound, so repeat prompts stop
+# crossing the network. Sources are per-prompt, per-thread (the server sets
+# them from the dispatch's stage metadata); with no sources set the seam is
+# bitwise the single-tier cache. A remote miss or transport error falls
+# through to the local encode — NEVER an error.
+
+_remote = threading.local()
+
+
+def remote_sources() -> tuple:
+    return getattr(_remote, "sources", ())
+
+
+def set_remote_sources(bases) -> None:
+    """Install the encode-host bases the CURRENT thread's prompt may fetch
+    conds from (empty/None tears down). server.py brackets each staged
+    execution with this."""
+    _remote.sources = tuple(b.rstrip("/") for b in (bases or ()))
+
+
+def remote_fetch(key: str, timeout_s: float = 5.0):
+    """Try each source's ``GET /embed/{key}``; first 200 wins and is banked
+    in the local cache. Counts ``pa_embed_cache_remote_{hits,misses}``.
+    Returns None (a miss) on any failure — callers encode locally."""
+    sources = remote_sources()
+    if not sources or not cache.enabled():
+        return None
+    import urllib.request
+
+    from ..fleet.roles import deserialize_value
+
+    for base in sources:
+        try:
+            with urllib.request.urlopen(
+                f"{base}/embed/{key}", timeout=timeout_s
+            ) as r:
+                blob = r.read()
+            value = deserialize_value(blob)
+        except Exception:
+            continue
+        registry.counter("pa_embed_cache_remote_hits",
+                         help="embed-cache lookups served by an encode "
+                              "host's remote tier")
+        return cache.put(key, value)
+    registry.counter("pa_embed_cache_remote_misses",
+                     help="remote embed fetches that missed every encode "
+                          "host (fell back to a local encode)")
+    return None
+
+
+def export_blob(key: str):
+    """Serve one cached entry as wire bytes (the ``GET /embed/{key}``
+    response body), or None when absent/unserializable. Serialization is
+    the stage-store walker (fleet/roles.py): device arrays → numpy →
+    pickle, so the fetching host never receives a live device buffer."""
+    value = cache.get(key)
+    if value is None:
+        return None
+    try:
+        from ..fleet.roles import serialize_value
+
+        return serialize_value(value)
+    except Exception:
+        return None
+
+
 def cached_encode(enc, model_key: str | None, tower: str, ids, mask, compute):
     """The ONE encode seam: look up (model key, tower, ids, mask); on a miss
-    run ``compute()`` (the real encoder program — counted in
+    try the remote tier (encode-pool hosts, when the prompt carries
+    sources), then run ``compute()`` (the real encoder program — counted in
     ``pa_encoder_invocations_total`` whether or not caching is on) and bank
     it under the merge discipline. ``model_key`` None falls back to the
     per-object lifetime token."""
     owner = encoder_token(enc)
     key = stable_key(model_key or owner, tower, ids, mask)
     hit = cache.get(key)
+    if hit is not None:
+        return hit
+    hit = remote_fetch(key)
     if hit is not None:
         return hit
     registry.counter("pa_encoder_invocations_total",

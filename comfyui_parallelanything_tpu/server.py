@@ -257,7 +257,7 @@ class PromptQueue:
     def __init__(self, class_mappings=None, output_dir: str | None = None,
                  workers: int | None = None, max_pending: int | None = None,
                  serving: bool | None = None, trace: bool | None = None,
-                 host_id: str | None = None):
+                 host_id: str | None = None, role: str | None = None):
         if trace is None:
             trace = os.environ.get("PA_TRACE", "") not in ("", "0", "false")
         if trace:
@@ -275,6 +275,13 @@ class PromptQueue:
         # process on a router's scoreboard; accepting=False (POST /drain)
         # stops seating new prompts while running lanes finish.
         self.host_id = host_id or default_host_id()
+        # Role-pool membership (fleet/roles.py): which stage tier this host
+        # serves — "all" (the default) keeps the pre-role single-pool
+        # behavior bitwise; a specific role rides the registration
+        # heartbeat and /health so the router pools it.
+        from .fleet.roles import normalize_role
+
+        self.role = normalize_role(role or os.environ.get("PA_ROLE"))
         self.accepting = True
         self._drain_source = None
         # Residency advertisement (pa-health/v3): model keys this host has
@@ -312,6 +319,14 @@ class PromptQueue:
             # Batched tail decode (serving/decode.py): concurrent prompts'
             # VAE decodes batch into shared compiled dispatches instead of
             # serializing inline behind each other's denoise.
+            self.decode_queue = DecodeQueue().install()
+        elif self.role == "decode":
+            # A dedicated DECODE-tier host is the width-bucketed batching
+            # target even single-worker: the router funnels every pool
+            # member's decode stages here, so cross-prompt batching is the
+            # point of the role (serving/decode.py lingers for siblings).
+            from .serving import DecodeQueue
+
             self.decode_queue = DecodeQueue().install()
         # Periodic HBM sampling (utils/telemetry.py): keeps the pa_hbm_*
         # gauges and the peak watermark fresh between /metrics scrapes so
@@ -378,7 +393,8 @@ class PromptQueue:
 
     def submit(self, prompt: dict, preview: bool = False,
                priority: int = 0, deadline_s: float | None = None,
-               fleet: dict | None = None) -> tuple[str, int]:
+               fleet: dict | None = None,
+               stage: dict | None = None) -> tuple[str, int]:
         pid = uuid.uuid4().hex
         # Bookkeeping AND enqueue under one lock: interrupt() drains under the
         # same lock, so a submit racing an interrupt either lands wholly
@@ -405,7 +421,7 @@ class PromptQueue:
             # The enqueue clock rides the item: the worker's pickup delta is
             # the ADMISSION stage of the SLO latency decomposition.
             self.pending.put((pid, prompt, bool(preview), int(priority),
-                              deadline_s, fleet, time.monotonic()))
+                              deadline_s, fleet, stage, time.monotonic()))
         self._emit_status()
         return pid, number
 
@@ -572,7 +588,7 @@ class PromptQueue:
             if item is None:
                 self.pending.put(None)  # cascade to sibling workers
                 return
-            pid, prompt, preview, priority, deadline_s, fleet, enq_ts = item
+            pid, prompt, preview, priority, deadline_s, fleet, stage, enq_ts = item
             cancel_evt = threading.Event()
             with self._lock:
                 if pid not in self.pending_ids:
@@ -642,6 +658,14 @@ class PromptQueue:
             _slow = faults.check("slow-host", key=pid)
             if _slow is not None:
                 _slow.sleep()
+            # Role-pool staged dispatch (fleet/roles.py): a router hop
+            # carrying extra_data.pa_stage executes ONE carved stage — the
+            # stage's upstream-closure subgraph with the previous stage's
+            # content-addressed outputs preseeded. A failed carve or handle
+            # resolution degrades to executing the closure (or the whole
+            # graph) locally — bitwise by the fold_in contract, never an
+            # error.
+            exec_graph, preseed, stage_entry = self._stage_setup(prompt, stage)
             try:
                 # The prompt span is the root of this prompt's trace
                 # timeline; prompt_id on the scope correlates log records and
@@ -662,16 +686,46 @@ class PromptQueue:
                                 "router": fleet.get("router")}
                                if fleet else {}),
                         ):
-                    results = run_workflow(
-                        prompt, class_mappings=self.class_mappings,
-                        outputs=self.cache, on_node=on_node,
-                        on_cached=on_cached,
-                    )
+                    if stage_entry is not None:
+                        # Denoise hosts may pull conds straight off the
+                        # encode tier (models/embed_cache.py remote tier).
+                        from .models.embed_cache import set_remote_sources
+
+                        set_remote_sources(
+                            (stage or {}).get("sources") or ())
+                    try:
+                        results = run_workflow(
+                            exec_graph, class_mappings=self.class_mappings,
+                            outputs=self.cache, on_node=on_node,
+                            on_cached=on_cached, preseed=preseed,
+                        )
+                    finally:
+                        if stage_entry is not None:
+                            from .models.embed_cache import set_remote_sources
+
+                            set_remote_sources(None)
                 entry = {
                     "status": {"status_str": "success", "completed": True,
                                "exec_s": round(time.monotonic() - t0, 3)},
                     "outputs": self._image_outputs(prompt, results),
                 }
+                if stage_entry is not None:
+                    # The stage hand-off: exported boundary outputs banked
+                    # content-addressed; the router journals these handles
+                    # as the prompt's stage lineage and preseeds them into
+                    # the NEXT stage's dispatch.
+                    entry["status"]["pa_stage"] = {
+                        "stage": stage_entry["stage"],
+                        "handles": self._stage_export(stage_entry, results),
+                    }
+                    from .utils.metrics import registry as _metrics
+
+                    _metrics.histogram(
+                        "pa_role_stage_seconds",
+                        time.monotonic() - t0,
+                        labels={"role": stage_entry["stage"]},
+                        help="wall seconds of one carved stage execution "
+                             "on a role-pool host")
                 # This host now holds the prompt's model warm (compiled
                 # programs + pinned weights) — advertise it (pa-health/v3).
                 self._mark_warm(prompt)
@@ -737,6 +791,106 @@ class PromptQueue:
                 "type": "executing", "data": {"node": None, "prompt_id": pid},
             })
             self._emit_status()
+
+    def _stage_setup(self, prompt: dict, stage) -> tuple:
+        """(exec_graph, preseed, stage_entry) for one staged dispatch.
+
+        Re-derives the carve locally (host.carve_stages is deterministic, so
+        router and backend always agree on the cut) and resolves the
+        dispatch's handles: local stage store first, then the peer hosts the
+        router listed. An unresolvable handle is simply not preseeded — the
+        stage's upstream-closure graph recomputes that prefix locally,
+        bitwise by fold_in. Unstaged prompts (or a carve the backend can't
+        reproduce) fall back to the whole graph."""
+        if not isinstance(stage, dict) or not stage.get("stage"):
+            return prompt, None, None
+        try:
+            from .host import carve_stages
+
+            plan = carve_stages(prompt)
+        except Exception:
+            plan = None
+        stage_entry = None
+        for st in (plan or {}).get("stages", ()):
+            if st["stage"] == stage.get("stage"):
+                stage_entry = st
+                break
+        if stage_entry is None:
+            return prompt, None, None
+        from .fleet import roles as fleet_roles
+        from .utils.metrics import registry as _metrics
+
+        handles = {str(k): v for k, v in (stage.get("handles") or {}).items()}
+        sources = [str(b).rstrip("/") for b in (stage.get("sources") or ())]
+        preseed: dict[str, tuple] = {}
+        needs = {str(n) for n in stage_entry["needs"]}
+        # Every carried handle that names a node in this closure preseeds,
+        # not just the declared needs: the closure includes the whole
+        # upstream prefix, and any resolved boundary inside it
+        # short-circuits its subtree (a decode host must not re-run the
+        # encoder class because the closure names the encode node). A miss
+        # only counts for a NEEDS node — those are the ones whose absence
+        # forces a prefix recompute.
+        for nid in sorted(set(handles) | needs):
+            if nid not in stage_entry["graph"]:
+                continue
+            key = handles.get(nid)
+            value = fleet_roles.store.get_value(key) if key else None
+            if value is None and key:
+                value = self._fetch_stage_value(key, sources)
+            if value is None:
+                if nid in needs:
+                    _metrics.counter(
+                        "pa_role_handle_misses",
+                        help="stage hand-off handles that resolved nowhere "
+                             "(prefix recomputed locally)")
+                continue
+            _metrics.counter(
+                "pa_role_handle_hits",
+                help="stage hand-off handles resolved from the local or "
+                     "peer stage store")
+            preseed[nid] = tuple(value)
+        return stage_entry["graph"], preseed, stage_entry
+
+    def _fetch_stage_value(self, key: str, sources):
+        """One handle off a peer's ``GET /stage/{key}``; the blob is banked
+        in the local store too (this host serves it onward — takeover
+        re-dispatches can land anywhere in the pool). None on any failure."""
+        if not sources:
+            return None
+        import urllib.request
+
+        from .fleet import roles as fleet_roles
+
+        for base in sources:
+            try:
+                with urllib.request.urlopen(
+                    f"{base}/stage/{key}", timeout=10
+                ) as r:
+                    blob = r.read()
+                value = fleet_roles.deserialize_value(blob)
+            except Exception:
+                continue
+            fleet_roles.store.put(blob)
+            return value
+        return None
+
+    def _stage_export(self, stage_entry: dict, results: dict) -> dict:
+        """Bank this stage's boundary outputs content-addressed; returns
+        ``{node_id: content_key}`` — the handles the history entry carries
+        and the journal's stage lineage records. Unserializable outputs are
+        skipped (the next stage recomputes them), never an error."""
+        from .fleet import roles as fleet_roles
+
+        handles: dict[str, str] = {}
+        for nid in stage_entry["exports"]:
+            out = results.get(nid)
+            if out is None:
+                continue
+            key = fleet_roles.store.put_value(out)
+            if key:
+                handles[nid] = key
+        return handles
 
     def _image_outputs(self, prompt: dict, results: dict) -> dict:
         """ComfyUI history shape: per save-node ``{"images": [{filename,
@@ -888,6 +1042,14 @@ class _Handler(BaseHTTPRequestHandler):
                 _embed_cache.publish_gauges()
             except Exception:
                 pass
+            try:
+                # pa_role_stage_store_* gauges (fleet/roles.py): the
+                # content-addressed stage hand-off store's residency.
+                from .fleet.roles import store as _stage_store
+
+                _stage_store.publish_gauges()
+            except Exception:
+                pass
             return self._send(
                 200, registry.render().encode(),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
@@ -919,6 +1081,10 @@ class _Handler(BaseHTTPRequestHandler):
                     "accepting": self.q.accepting,
                     "inflight_prompts": len(self.q.pending_ids),
                     "warm_keys": list(self.q.warm_keys),
+                    # Role-pool membership (fleet/roles.py) — the scoreboard
+                    # reads it so statically configured --backends hosts
+                    # pool correctly without ever heartbeating.
+                    "role": self.q.role,
                 }
             return self._send(200, health_snapshot(queue=queue, host=host))
         if url.path == "/trace":
@@ -983,6 +1149,28 @@ class _Handler(BaseHTTPRequestHandler):
             from .devices.discovery import available_devices
 
             return self._send(200, {"devices": available_devices()})
+        if parts and parts[0] == "embed" and len(parts) == 2:
+            # Remote embed tier (models/embed_cache.py): an encode host
+            # serves its content-addressed encoder outputs to denoise-pool
+            # peers. 404 is a MISS, not an error — the peer encodes locally.
+            from .models.embed_cache import export_blob
+
+            blob = export_blob(parts[1])
+            if blob is None:
+                return self._send(404, {"error": "no such embed key"})
+            return self._send(200, blob,
+                              content_type="application/octet-stream")
+        if parts and parts[0] == "stage" and len(parts) == 2:
+            # Stage hand-off store (fleet/roles.py): serve one boundary
+            # value (conds out of encode, latents out of denoise) to the
+            # host running the next stage. 404 = miss = peer recomputes.
+            from .fleet.roles import store as _stage_store
+
+            blob = _stage_store.get(parts[1])
+            if blob is None:
+                return self._send(404, {"error": "no such stage key"})
+            return self._send(200, blob,
+                              content_type="application/octet-stream")
         return self._send(404, {"error": f"no route {url.path}"})
 
     def _serve_websocket(self):
@@ -1075,11 +1263,13 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 deadline_s = extra.get("deadline_s")
                 fleet = extra.get("fleet")
+                stage = extra.get("pa_stage")
                 pid, number = self.q.submit(
                     prompt, preview=preview,
                     priority=int(extra.get("priority") or 0),
                     deadline_s=None if deadline_s is None else float(deadline_s),
                     fleet=fleet if isinstance(fleet, dict) else None,
+                    stage=stage if isinstance(stage, dict) else None,
                 )
             except DrainingError as e:
                 return self._send(503, {"error": str(e)})
@@ -1180,6 +1370,7 @@ def make_server(
     serving: bool | None = None,
     trace: bool | None = None,
     host_id: str | None = None,
+    role: str | None = None,
 ) -> tuple[ThreadingHTTPServer, PromptQueue]:
     """Build (but don't start) the HTTP server + its prompt queue. Port 0
     picks an ephemeral port (tests); ``server.server_address`` has the real
@@ -1191,7 +1382,7 @@ def make_server(
     process on a fleet router's scoreboard (pa-health/v3)."""
     q = PromptQueue(class_mappings=class_mappings, output_dir=output_dir,
                     workers=workers, max_pending=max_pending, serving=serving,
-                    trace=trace, host_id=host_id)
+                    trace=trace, host_id=host_id, role=role)
     handler = type("Handler", (_Handler,), {"q": q})
     srv = _HTTPServer((host, port), handler)
     return srv, q
@@ -1216,6 +1407,12 @@ def main() -> None:
     ap.add_argument("--host-id", default=None,
                     help="fleet identity on a router's scoreboard "
                          "(default $PA_HOST_ID or hostname-pid)")
+    ap.add_argument("--role", default=None,
+                    choices=["all", "encode", "denoise", "decode"],
+                    help="role-pool membership (fleet/roles.py): which "
+                         "stage tier this host serves — rides the "
+                         "registration heartbeat and /health (default "
+                         "$PA_ROLE or 'all', every pool)")
     ap.add_argument("--fleet-router", default=None,
                     help="router base URL(s), comma-separated (or "
                          "$PA_FLEET_ROUTER): register this host via "
@@ -1229,7 +1426,8 @@ def main() -> None:
     args = ap.parse_args()
     srv, q = make_server(args.host, args.port, output_dir=args.output_dir,
                          workers=args.workers, max_pending=args.max_pending,
-                         trace=args.trace, host_id=args.host_id)
+                         trace=args.trace, host_id=args.host_id,
+                         role=args.role)
     heartbeats = []
     router_base = args.fleet_router or os.environ.get("PA_FLEET_ROUTER")
     if router_base:
@@ -1262,6 +1460,7 @@ def main() -> None:
                 # host that expired off the ring mid-drain would otherwise
                 # rejoin refusing forever.
                 on_rejoin=q.resume_if_auto_drained,
+                role=q.role,
             ).start())
     # palint: allow[observability] server startup banner (CLI surface)
     print(f"ParallelAnything workflow server on http://{args.host}:{args.port}")

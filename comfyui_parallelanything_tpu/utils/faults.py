@@ -74,6 +74,14 @@ FAULT_SITES = {
                        "newline) or garbled (mode=garble: NULs mid-line), "
                        "rehearsing a router crash mid-write; match filters "
                        "the event name (submit/dispatch/resolve)",
+    "network-partition": "fleet router↔backend link — BOTH directions of "
+                         "one host's traffic drop while each side stays "
+                         "alive: router _post/_get raises a refused-socket "
+                         "OSError (key = 'router-><base>') and the host's "
+                         "HeartbeatClient silently skips its beat (key = "
+                         "'<host_id>->router'); match filters the key, so "
+                         "one spec partitions one host, two specs cut both "
+                         "directions",
 }
 
 

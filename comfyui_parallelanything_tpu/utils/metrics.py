@@ -66,6 +66,18 @@ mixed-workload smoke's must-stay-zero gate for eligible shapes), and
 ``pa_serving_ctrl_conflict_total{bucket=}`` (serving/bucket.py — lanes
 bounced because the bucket epoch already carries a different control
 trunk).
+
+Disaggregated role pools (round 20): ``pa_role_*`` (fleet/roles.py +
+fleet/router.py + server.py — ``pa_role_pool_size{role=}`` gauges,
+``pa_role_dispatch_total{role=,host=}`` /
+``pa_role_stage_resolved_total{role=}`` /
+``pa_role_handle_hits`` / ``pa_role_handle_misses`` counters, the
+``pa_role_stage_seconds{role=}`` histogram, and the stage-store
+``pa_role_stage_store_bytes`` / ``pa_role_stage_store_entries`` gauges),
+plus ``pa_embed_cache_remote_hits`` / ``pa_embed_cache_remote_misses``
+inside the existing ``pa_embed_cache_*`` family (models/embed_cache.py —
+the cross-host second tier: a denoise host fetching conds from an encode
+host's ``GET /embed/{key}``).
 """
 
 from __future__ import annotations

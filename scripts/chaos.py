@@ -13,6 +13,14 @@ schedule), then the SAME schedule as a CHAOS run while:
 - one BACKEND is killed mid-denoise (ordinary PR 7 failover, now
   warm-preferring).
 
+A separate NETWORK-PARTITION leg (round 20) arms the ``network-partition``
+fault site mid-run against one denoise host in BOTH directions — the
+router's ``_post``/``_get`` and health polls to it raise refused-socket
+errors while the host's own heartbeats silently vanish, each side staying
+alive — and gates the same zero-lost + bitwise contract: the partitioned
+host's in-flight prompts must fail over, and at least one failover plus
+both direction's fault fires must be attributable.
+
 Gates (exit 1 on any failure; one JSON verdict line on stdout, human table
 on stderr — the bench.py/loadgen contract):
 
@@ -224,6 +232,35 @@ def _fired_total() -> float:
     return float(sum(freg.fired().values()))
 
 
+def _bitwise_check(base_dir: str, chaos_dir: str, seed: int,
+                   total: int) -> tuple[int, int]:
+    """(missing, mismatched) latent counts between the two runs: the
+    deterministic latent per seed value must be identical for EVERY
+    submitted seed, and every chaos seed must have produced one at all
+    (at-least-once delivery: every dumped copy must match)."""
+    import random as _random
+
+    import numpy as np
+
+    # ONE sequential RNG — the exact schedule loadgen submitted (a fresh
+    # Random(seed) per element would repeat the first value and the gate
+    # would only ever check prompt 1).
+    _rng = _random.Random(seed)
+    sched = [_rng.randrange(1 << 31) for _ in range(total)]
+    mismatched = missing = 0
+    for s in sched:
+        b_files = sorted(glob.glob(os.path.join(base_dir, f"{s}-*.npy")))
+        c_files = sorted(glob.glob(os.path.join(chaos_dir, f"{s}-*.npy")))
+        if not b_files or not c_files:
+            missing += 1
+            continue
+        b = np.load(b_files[0])
+        for cf in c_files:
+            if not (np.load(cf) == b).all():
+                mismatched += 1
+    return missing, mismatched
+
+
 def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
                     requests: int = 3, seed: int = 7, work_s: float = 0.5,
                     p95_factor: float = 25.0, lease_ttl_s: float = 1.0,
@@ -322,26 +359,7 @@ def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
     # Bitwise survivors: the deterministic latent per seed value must be
     # identical between the baseline and chaos runs, for every submitted
     # seed — and every chaos seed must have produced one at all.
-    import random as _random
-
-    import numpy as np
-
-    # ONE sequential RNG — the exact schedule loadgen submitted (a fresh
-    # Random(seed) per element would repeat the first value and the gate
-    # would only ever check prompt 1).
-    _rng = _random.Random(seed)
-    sched = [_rng.randrange(1 << 31) for _ in range(total)]
-    mismatched = missing = 0
-    for s in sched:
-        b_files = sorted(glob.glob(os.path.join(base_dir, f"{s}-*.npy")))
-        c_files = sorted(glob.glob(os.path.join(chaos_dir, f"{s}-*.npy")))
-        if not b_files or not c_files:
-            missing += 1
-            continue
-        b = np.load(b_files[0])
-        for cf in c_files:   # at-least-once delivery: every copy must match
-            if not (np.load(cf) == b).all():
-                mismatched += 1
+    missing, mismatched = _bitwise_check(base_dir, chaos_dir, seed, total)
     if missing:
         failures.append(f"{missing} seed(s) missing a latent dump")
     if mismatched:
@@ -377,6 +395,138 @@ def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
         "faults_fired": fired,
         "faults_by_site": fired_by_site,
         "faults_injected_counter": chaos.get("faults_injected"),
+        "baseline_p95_s": baseline["latency_p95_s"],
+        "chaos_p95_s": chaos["latency_p95_s"],
+        "p95_bound_s": round(p95_bound, 3),
+        "fleet": chaos.get("fleet"),
+        "root": root,
+    }
+
+
+def run_partition_chaos(*, n_backends: int = 3, clients: int = 3,
+                        requests: int = 3, seed: int = 11,
+                        work_s: float = 0.5, p95_factor: float = 25.0,
+                        root: str | None = None) -> dict:
+    """The network-partition leg (round 20, importable — tests/test_chaos.py
+    drives this exact path): mid-run, BOTH directions of one denoise host's
+    traffic drop while each side stays alive — the ``network-partition``
+    fault site cuts the router's dispatch/collect/health-poll calls to the
+    victim (refused-socket OSError) and swallows the victim's own heartbeats
+    — and the victim's in-flight prompts must fail over with zero lost and
+    bitwise survivors. The victim runs a real ``HeartbeatClient`` beating
+    ``role="denoise"`` into ``/fleet/register``, so the backend→router half
+    exercises the same code path a ``server.py --role denoise`` process
+    runs, and the fleet is DISAGGREGATED for the router (role pools live)."""
+    from loadgen import run_load
+
+    from comfyui_parallelanything_tpu.fleet import HeartbeatClient
+    from comfyui_parallelanything_tpu.utils import faults
+
+    root = root or tempfile.mkdtemp(prefix="pa-partition-")
+    total = clients * requests
+    g = _graph(work_s)
+
+    # -- baseline: same topology, no partition ------------------------------
+    os.environ.pop("PA_FAULT_PLAN", None)
+    faults.reload()
+    base_dir = os.path.join(root, "baseline")
+    fleet = _Fleet(os.path.join(root, "b"), n_backends, base_dir,
+                   journal=False)
+    try:
+        baseline = run_load(
+            fleet.base, g, clients=clients, requests=requests, timeout=120,
+            seed_key="1:inputs:seed", seed=seed,
+            hosts=[b for _, b, _, _ in fleet.backends],
+        )
+    finally:
+        fleet.stop()
+
+    # -- partition: arm BOTH directions against host 0 mid-run --------------
+    chaos_dir = os.path.join(root, "chaos")
+    fleet = _Fleet(os.path.join(root, "c"), n_backends, chaos_dir,
+                   journal=False)
+    victim_id, victim_base = fleet.backends[0][0], fleet.backends[0][1]
+    hb = HeartbeatClient(fleet.base, victim_id, victim_base,
+                         interval_s=0.1, role="denoise").start()
+
+    def arm():
+        # count=None: every hit from the 1st on — a partition persists
+        # until healed, unlike the one-shot faults in the default plan.
+        os.environ["PA_FAULT_PLAN"] = json.dumps({"seed": int(seed), "faults": [
+            {"site": "network-partition", "nth": 1, "count": None,
+             "match": f"router->{victim_base}"},
+            {"site": "network-partition", "nth": 1, "count": None,
+             "match": f"{victim_id}->router"},
+        ]})
+        faults.reload()
+
+    timer = threading.Timer(work_s * 1.5, arm)
+    fired = 0.0
+    try:
+        timer.start()
+        chaos = run_load(
+            fleet.base, g, clients=clients, requests=requests, timeout=240,
+            seed_key="1:inputs:seed", seed=seed,
+            hosts=[b for _, b, _, _ in fleet.backends],
+        )
+    finally:
+        timer.cancel()
+        hb.stop()
+        fleet.stop()
+        # arm()'s reload zeroed the registry, so its lifetime total IS this
+        # leg's count — read it before the disarm reload resets it again.
+        fired = _fired_total()
+        os.environ.pop("PA_FAULT_PLAN", None)
+        faults.reload()
+    beat_drops = hb._failures
+
+    # -- gates ---------------------------------------------------------------
+    failures: list[str] = []
+    if chaos.get("prompts_lost"):
+        failures.append(f"prompts_lost={chaos['prompts_lost']} (must be 0)")
+    if chaos["completed"] != total:
+        failures.append(
+            f"completed {chaos['completed']}/{total} (errors: "
+            f"{chaos.get('errors')})"
+        )
+    missing, mismatched = _bitwise_check(base_dir, chaos_dir, seed, total)
+    if missing:
+        failures.append(f"{missing} seed(s) missing a latent dump")
+    if mismatched:
+        failures.append(f"{mismatched} latent(s) diverged from baseline")
+    # Detection is scoreboard polls (0.1 s cadence, fail_after 2, 2 s
+    # timeout) + one dispatch walking onto the cut link — no lease TTL in
+    # this leg (single router), so the allowance is poll-detection-shaped.
+    allowance = 6.0 + work_s
+    p95_bound = p95_factor * max(baseline["latency_p95_s"], 0.05) + allowance
+    if chaos["latency_p95_s"] > p95_bound:
+        failures.append(
+            f"p95 {chaos['latency_p95_s']}s exceeds bound {p95_bound:.2f}s "
+            f"(baseline {baseline['latency_p95_s']}s)"
+        )
+    if fired <= 0:
+        failures.append("network-partition never fired (injection unproven)")
+    if beat_drops <= 0:
+        failures.append(
+            "backend->router direction never cut (no heartbeat dropped)"
+        )
+    failovers = (chaos.get("fleet") or {}).get("failovers")
+    if not failovers:
+        failures.append(
+            "no failover recorded — the victim's in-flight prompts were "
+            "never failed over (partition landed between waves?)"
+        )
+    return {
+        "phase": "partition",
+        "ok": not failures,
+        "failures": failures,
+        "total_prompts": total,
+        "prompts_lost": chaos.get("prompts_lost"),
+        "completed": chaos["completed"],
+        "victim": victim_id,
+        "faults_fired": fired,
+        "heartbeats_dropped": beat_drops,
+        "failovers": failovers,
         "baseline_p95_s": baseline["latency_p95_s"],
         "chaos_p95_s": chaos["latency_p95_s"],
         "p95_bound_s": round(p95_bound, 3),
@@ -474,6 +624,8 @@ def main() -> int:
     ap.add_argument("--lease-ttl-s", type=float, default=1.0)
     ap.add_argument("--skip-stream", action="store_true",
                     help="skip the stream-OOM phase (no jax model build)")
+    ap.add_argument("--skip-partition", action="store_true",
+                    help="skip the network-partition leg")
     ap.add_argument("--plan", default=None,
                     help="override the fleet phase's PA_FAULT_PLAN JSON")
     args = ap.parse_args()
@@ -487,6 +639,12 @@ def main() -> int:
         p95_factor=args.p95_factor, lease_ttl_s=args.lease_ttl_s,
         plan=json.loads(args.plan) if args.plan else None,
     )]
+    if not args.skip_partition:
+        phases.append(run_partition_chaos(
+            n_backends=max(3, args.backends), clients=args.clients,
+            requests=args.requests, seed=args.seed + 4, work_s=args.work_s,
+            p95_factor=args.p95_factor,
+        ))
     if not args.skip_stream:
         phases.append(run_stream_oom_chaos())
     verdict = {

@@ -127,6 +127,24 @@ timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
 
+# Role-pool smoke (round 20): 1 encode + 2 denoise + 1 decode virtual hosts
+# vs 4 homogeneous backends under the SAME mixed load at the SAME host
+# count (the BASELINE "Role-pool protocol" comparison rule) — gated on
+# prompts_lost == 0, strictly higher disaggregated throughput, the decode
+# stage p95 dropping below the homogeneous baseline, and the kind="roles"
+# ledger record landing; plus the staged-dispatch e2e tier (pool-respecting
+# placement, bitwise vs single-host, mid-denoise role-host kill) and the
+# decode-tier kill with standby takeover re-dispatching from the journaled
+# denoise handle (tests/test_fleet.py::TestStageLineageReplay — the
+# stage-lineage contract). Also part of the tier-1 run above; this rerun is
+# the explicit contract.
+timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_roles.py tests/test_fleet.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    -k "RolePool or StageLineageReplay"
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+
 # Chaos smoke (round 14): a seeded fault plan (backend-http 5xx +
 # slow-host, deterministic in the seed) fired against a 2-backend fleet
 # while the PRIMARY ROUTER is killed mid-denoise (standby takeover off the

@@ -48,6 +48,13 @@ lane-step deltas scraped from each host's /metrics — plus the router's own
 ``pa_fleet_*`` deltas (dispatches, spills, failovers) and ``prompts_lost``
 (router-lost + client-timeout), the number the fleet CI smoke gates on
 staying zero.
+
+Against a DISAGGREGATED fleet (backends launched with ``--role``,
+fleet/roles.py) each per-host row carries its role, the summary adds a
+``roles`` per-pool section (pool membership, served counts, worst p95) plus
+the router's ``pa_role_dispatch_total{role=}`` stage-dispatch deltas, and
+the closed-loop ledger record banks as ``kind="roles"`` — the record the
+role-pool CI smoke gates.
 """
 
 from __future__ import annotations
@@ -280,7 +287,10 @@ def _serving_counters(base: str) -> dict:
                  # --base is a router; summed over their {host=} labels.
                  "pa_fleet_dispatch_total", "pa_fleet_spill_total",
                  "pa_fleet_failover_total", "pa_fleet_completed_total",
-                 "pa_fleet_prompts_lost_total"):
+                 "pa_fleet_prompts_lost_total",
+                 # Role pools (round 20): stage dispatches / resolves per
+                 # role — the disaggregated router's attribution counters.
+                 "pa_role_dispatch_total", "pa_role_stage_resolved_total"):
         total = 0.0
         found = False
         for m in re.finditer(rf"^{name}(?:\{{[^}}]*\}})? ([0-9.eE+-]+)$",
@@ -301,6 +311,16 @@ def _serving_counters(base: str) -> dict:
         text, re.M,
     ):
         key = f"pa_serving_lane_capability_total:{m.group(1)}"
+        out[key] = out.get(key, 0.0) + float(m.group(2))
+    # Per-role stage dispatches (round 20): the {role=} breakdown of the
+    # disaggregated router's dispatch counter, flat "name:role" keys so the
+    # before/after diff machinery stays float-valued.
+    for m in re.finditer(
+        r'^pa_role_dispatch_total\{[^}]*role="([^"]+)"[^}]*\} '
+        r"([0-9.eE+-]+)$",
+        text, re.M,
+    ):
+        key = f"pa_role_dispatch_total:{m.group(1)}"
         out[key] = out.get(key, 0.0) + float(m.group(2))
     # Reuse gauges (round 17): the embed cache's monotonic hit/miss/eviction
     # totals (diffed like counters — they only grow) + current bytes, and
@@ -508,6 +528,10 @@ def _host_probe(hosts: list[str]) -> dict:
             probe["host_id"] = health.get("host_id")
             probe["accepting"] = health.get("accepting")
             probe["inflight_prompts"] = health.get("inflight_prompts")
+            # Role pool (round 20): the backend's declared --role, "all"
+            # when undeclared — threaded into the per-host summary rows so
+            # role sections and the twin's stage pools can form.
+            probe["role"] = health.get("role")
             # Worker-pool width: the twin's per-host concurrency
             # (fleet/twin.py simulates `workers` servers per host).
             probe["workers"] = (health.get("queue") or {}).get("workers")
@@ -516,6 +540,52 @@ def _host_probe(hosts: list[str]) -> dict:
         probe["counters"] = _serving_counters(h)
         out[h] = probe
     return out
+
+
+def _role_sections(per_host: dict | None) -> dict | None:
+    """Per-role pool aggregation of the fleet per-host rows (round 20,
+    fleet/roles.py): which hosts form each pool, how much each pool served,
+    and the pool's worst client p95. None unless some backend declares a
+    role other than ``all`` — homogeneous summaries gain nothing."""
+    if not per_host:
+        return None
+    if not any((h.get("role") or "all") != "all" for h in per_host.values()):
+        return None
+    pools: dict[str, dict] = {}
+    for hid, h in per_host.items():
+        r = str(h.get("role") or "all")
+        p = pools.setdefault(r, {"hosts": [], "completed": 0,
+                                 "dispatches": 0.0, "p95s": []})
+        p["hosts"].append(hid)
+        p["completed"] += int(h.get("completed") or 0)
+        if h.get("dispatches") is not None:
+            p["dispatches"] += float(h["dispatches"])
+        if h.get("completed") and h.get("latency_p95_s") is not None:
+            p["p95s"].append(float(h["latency_p95_s"]))
+    return {
+        r: {
+            "hosts": sorted(p["hosts"]),
+            "completed": p["completed"],
+            "dispatches": p["dispatches"],
+            "latency_p95_s": max(p["p95s"]) if p["p95s"] else None,
+        }
+        for r, p in sorted(pools.items())
+    }
+
+
+def _role_dispatch_deltas(before: dict, after: dict) -> dict | None:
+    """This run's stage dispatches per role, diffed from the router's
+    ``pa_role_dispatch_total{role=}`` breakdown (flat "name:role" scrape
+    keys). None outside a disaggregated fleet — the counter never exists."""
+    prefix = "pa_role_dispatch_total:"
+    roles = sorted(
+        k[len(prefix):] for k in set(before) | set(after)
+        if k.startswith(prefix)
+    )
+    return {
+        r: after.get(prefix + r, 0.0) - before.get(prefix + r, 0.0)
+        for r in roles
+    } or None
 
 
 def run_load(base: str, graph: dict, *, clients: int, requests: int,
@@ -703,6 +773,7 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
             lats = lat_by_host.get(hid, [])
             per_host[hid] = {
                 "base": h,
+                "role": a.get("role") or b.get("role") or "all",
                 "completed": len(lats),
                 "latency_p50_s": round(percentile(lats, 50), 3),
                 "latency_p95_s": round(percentile(lats, 95), 3),
@@ -732,6 +803,9 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
             "failovers": _delta("pa_fleet_failover_total"),
             "completed": _delta("pa_fleet_completed_total"),
         }
+        role_disp = _role_dispatch_deltas(before, after)
+        if role_disp:
+            fleet["role_dispatches"] = role_disp
         lost_router = _delta("pa_fleet_prompts_lost_total")
         prompts_lost = (lost_router or 0.0) + timeouts[0]
     elif timeouts[0]:
@@ -816,8 +890,10 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
         # Fleet mode (--hosts): per-host client latencies + dispatch deltas,
         # router-side placement/failover deltas, and the CI-gated loss count
         # (router-lost + client-timeout; None outside fleet mode unless a
-        # timeout made the number real).
+        # timeout made the number real). "roles" (round 20): the per-role
+        # pool aggregation — None unless some backend declared a role.
         "hosts": per_host,
+        "roles": _role_sections(per_host),
         "fleet": fleet,
         "prompts_lost": prompts_lost,
         "timeouts": timeouts[0],
@@ -1112,6 +1188,7 @@ def run_open_load(base: str, graph: dict, *, kind: str = "poisson",
                 or (exec_by_host.get(phid, []) if phid else [])
             per_host[hid] = {
                 "base": h,
+                "role": a.get("role") or b.get("role") or "all",
                 "completed": len(lats),
                 "latency_p50_s": round(percentile(lats, 50), 3),
                 "latency_p95_s": round(percentile(lats, 95), 3),
@@ -1142,6 +1219,9 @@ def run_open_load(base: str, graph: dict, *, kind: str = "poisson",
             "failovers": _delta("pa_fleet_failover_total"),
             "completed": _delta("pa_fleet_completed_total"),
         }
+        role_disp = _role_dispatch_deltas(before, after)
+        if role_disp:
+            fleet["role_dispatches"] = role_disp
         lost_router = _delta("pa_fleet_prompts_lost_total")
         prompts_lost = (lost_router or 0.0) + timeouts[0]
     elif exec_by_host:
@@ -1238,6 +1318,7 @@ def run_open_load(base: str, graph: dict, *, kind: str = "poisson",
         "service_p50_s": overall_service,
         "slo": slo_view,
         "hosts": per_host,
+        "roles": _role_sections(per_host),
         "fleet": fleet,
         "prompts_lost": prompts_lost,
         "errors": failures[:5],
@@ -1307,6 +1388,11 @@ def print_human_summary(summary: dict, stream=None) -> None:
         w(f"  fleet     dispatches {f.get('dispatches')}"
           f"  spills {f.get('spills')}  failovers {f.get('failovers')}"
           f"  lost {summary.get('prompts_lost')}\n")
+    for role, p in (summary.get("roles") or {}).items():
+        disp = (summary.get("fleet") or {}).get("role_dispatches") or {}
+        w(f"  role {role:<9} {len(p['hosts'])} hosts  {p['completed']:>3} ok"
+          f"  p95 {p.get('latency_p95_s')}s"
+          f"  stage-dispatches {disp.get(role)}\n")
     if summary.get("faults_injected") is not None or \
             summary.get("degradations") is not None:
         w(f"  chaos     faults injected {summary.get('faults_injected')}"
@@ -1319,9 +1405,11 @@ def print_human_summary(summary: dict, stream=None) -> None:
     for hid, h in (summary.get("hosts") or {}).items():
         # Single-server open-loop rows carry no probe fields (dispatches /
         # reachability are fleet-mode diffs) — render what exists.
+        role = h.get("role")
         w(f"  host {hid:<20} {h['completed']:>3} ok"
           f"  p50 {h['latency_p50_s']}s  p95 {h['latency_p95_s']}s"
           f"  dispatches {h.get('dispatches')}"
+          f"{f'  [{role}]' if role and role != 'all' else ''}"
           f"{'  [UNREACHABLE]' if h.get('reachable') is False else ''}\n")
     for err in summary.get("errors") or []:
         w(f"  error     {err}\n")
@@ -1486,8 +1574,12 @@ def main() -> None:
             workload_mix=workload_mix,
             workload_graphs=workload_graphs or None,
         )
+        # A disaggregated fleet (some backend declared a role) banks its
+        # record under kind="roles" — the role-pool CI smoke's gate record;
+        # homogeneous runs keep their historical kinds untouched.
         _append_ledger(summary, args.base,
-                       kind="mixed" if workload_mix else "loadgen")
+                       kind="roles" if summary.get("roles")
+                       else ("mixed" if workload_mix else "loadgen"))
     print_human_summary(summary)          # operator table → stderr
     print(json.dumps(summary))            # THE one JSON line → stdout
 

@@ -53,6 +53,29 @@ class TestChaosSmoke:
         assert verdict["faults_by_site"].get("journal-corrupt", 0) >= 1
         assert verdict["chaos_p95_s"] <= verdict["p95_bound_s"]
 
+    def test_partition_phase_both_directions_zero_lost(self, tmp_path):
+        """Round-20 satellite: a persistent network partition cuts one
+        denoise host off mid-run in BOTH directions (router→backend
+        dispatch/poll and backend→router heartbeat); its in-flight prompts
+        fail over with zero lost and bitwise survivors, and both directions
+        are attributable (fault fires + dropped heartbeats)."""
+        from chaos import run_partition_chaos
+
+        # Defaults (3 backends, 3 clients x 3 requests, 0.5 s work): enough
+        # waves that the mid-run arm always catches the victim with work
+        # in flight — smaller runs can land the partition between waves.
+        verdict = run_partition_chaos(
+            n_backends=3, clients=3, requests=3, seed=11, work_s=0.5,
+            root=str(tmp_path / "chaos"),
+        )
+        assert verdict["ok"], verdict["failures"]
+        assert verdict["prompts_lost"] == 0
+        assert verdict["completed"] == verdict["total_prompts"]
+        assert verdict["faults_fired"] >= 1          # router→backend cut
+        assert verdict["heartbeats_dropped"] >= 1    # backend→router cut
+        assert verdict["failovers"] >= 1
+        assert verdict["chaos_p95_s"] <= verdict["p95_bound_s"]
+
     def test_stream_oom_phase_recarve_absorbs(self):
         from chaos import run_stream_oom_chaos
 

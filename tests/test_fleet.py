@@ -12,6 +12,7 @@ import time
 import urllib.error
 import urllib.request
 
+import numpy as np
 import pytest
 
 from comfyui_parallelanything_tpu.fleet import (
@@ -578,6 +579,113 @@ class TestRouterHA:
             router.shutdown()
             for b in backends:
                 b.stop()
+
+
+class TestStageLineageReplay:
+    """Round-20 satellite: a DECODE-tier host dies mid-decode while the
+    primary router is also gone — the standby's journal takeover must
+    re-dispatch the decode stage from the journaled denoise output handle
+    (stage lineage, fleet/journal.py), never re-denoise, and the survivor
+    stays bitwise. The decode pool has ONE host, so the re-dispatch also
+    exercises place()'s degrade-to-global-ring path."""
+
+    def test_decode_kill_standby_redispatches_from_denoise_handle(
+        self, tmp_path
+    ):
+        from test_roles import _RoleBackend, _sgraph
+        from test_roles import _wait as _rwait
+        from comfyui_parallelanything_tpu.fleet import (
+            FleetRegistry,
+            PromptJournal,
+            Scoreboard,
+            make_router,
+        )
+        from comfyui_parallelanything_tpu.fleet import roles as fleet_roles
+
+        fleet_roles.store.clear()
+        specs = [("sr-enc", "encode"), ("sr-den", "denoise"),
+                 ("sr-dec", "decode")]
+        backends = [_RoleBackend(tmp_path, hid, role) for hid, role in specs]
+        by_id = {b.host_id: b for b in backends}
+        jpath = str(tmp_path / "journal.jsonl")
+
+        def _router(standby):
+            srv, router = make_router(
+                port=0, backends=[(b.host_id, b.base) for b in backends],
+                fleet_registry=FleetRegistry(ttl_s=5.0),
+                scoreboard=Scoreboard(poll_s=0.1, stale_after_s=5.0,
+                                      fail_after=2, timeout_s=2.0),
+                saturation_depth=2, monitor_s=0.05, max_attempts=4,
+                journal=PromptJournal(jpath), lease_ttl_s=0.5,
+                standby=standby,
+            )
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            return srv, router, f"http://127.0.0.1:{srv.server_address[1]}"
+
+        srv1, primary, base1 = _router(standby=False)
+        srv2, standby, base2 = _router(standby=True)
+        try:
+            _wait(lambda: all(primary.scoreboard.healthy(b.host_id)
+                              for b in backends),
+                  what="role backends healthy on the primary")
+            _wait(lambda: primary.roles.disaggregated(),
+                  what="roles visible to the primary")
+            pid = _post(base1, "/prompt",
+                        {"prompt": _sgraph(21, dec_s=4.0)})["prompt_id"]
+            # Decode RUNNING means encode + denoise already resolved and
+            # their stage_resolve lineage (with handles) is journaled.
+            _rwait(lambda: len(by_id["sr-dec"].q.running) > 0,
+                   what="decode stage running")
+            srv1.shutdown()
+            srv1.server_close()
+            primary.shutdown()          # lease stops refreshing
+            by_id["sr-dec"].kill()      # ... then the decode host crashes
+            _wait(lambda: standby.active, timeout=15,
+                  what="standby takeover")
+            entry = _wait_entry(base2, pid, timeout=60)
+            assert entry["status"]["status_str"] == "success"
+            assert standby.stats()["lost"] == 0
+            recs = [r for r in PromptJournal.iter_records(jpath)
+                    if r["pid"] == pid]
+            # Denoise ran EXACTLY once across both routers' lifetimes: the
+            # standby resumed from the journaled denoise handle.
+            den = [r for r in recs if r["ev"] == "stage_dispatch"
+                   and r.get("stage") == "denoise"]
+            assert len(den) == 1, recs
+            resolves = [r for r in recs if r["ev"] == "stage_resolve"]
+            assert [r["stage"] for r in resolves[:2]] == [
+                "encode", "denoise"]
+            den_handle = resolves[1]["handles"]["2"]
+            # The handle survived the decode-host crash (content-addressed
+            # store on the surviving hosts) — the retry consumed it instead
+            # of re-denoising.
+            assert fleet_roles.store.get(den_handle) is not None
+            dec = [r for r in recs if r["ev"] == "stage_dispatch"
+                   and r.get("stage") == "decode"]
+            assert len(dec) >= 2            # original + post-takeover retry
+            assert dec[-1]["host"] != "sr-dec"   # pool empty → global ring
+            # Bitwise: the failed-over decode dumped the same latent a
+            # direct single-host run produces.
+            survivor = by_id[dec[-1]["host"]]
+            staged = np.load(os.path.join(
+                survivor.out_dir, f"21-{survivor.host_id}.npy"))
+            ref = by_id["sr-enc"]
+            pid2 = _post(ref.base, "/prompt",
+                         {"prompt": _sgraph(21)})["prompt_id"]
+            assert (_wait_entry(ref.base, pid2)["status"]["status_str"]
+                    == "success")
+            direct = np.load(os.path.join(ref.out_dir, "21-sr-enc.npy"))
+            assert staged.tobytes() == direct.tobytes()
+        finally:
+            srv2.shutdown()
+            srv2.server_close()
+            standby.shutdown()
+            for b in backends:
+                if b.alive:
+                    b.stop()
+                else:
+                    b.q.shutdown()
+            fleet_roles.store.clear()
 
 
 class TestResidencyAwarePlacement:

@@ -1,0 +1,652 @@
+"""Disaggregated role pools (ROADMAP "role disaggregation"): stage carving
+(host.carve_stages), pool plumbing (fleet/roles.py), the router's stage-aware
+dispatch over a live encode / denoise / decode fleet, and the fixed-host-count
+throughput comparison the role-pool CI smoke gates.
+
+Reference behavior: every worker thread runs the WHOLE sampler — encode,
+denoise, and decode execute on whatever device the thread was pinned to
+(any_device_parallel.py:817-905) — so stages, pools, and hand-off handles are
+all this port's addition and everything here asserts against fleet/roles.py's
+own contracts.
+
+The toy stage nodes model the one physical effect disaggregation exploits: a
+host's HBM holds ONE stage's program + weights at a time (warm-LRU-of-1), so
+running a different node class than the last run pays ``setup_s`` again.
+Homogeneous hosts pay ~3 switches per prompt; role hosts pay one setup ever.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from comfyui_parallelanything_tpu.fleet import (
+    FleetRegistry,
+    PromptJournal,
+    Scoreboard,
+    StageStore,
+    make_router,
+    normalize_role,
+    suggest_pool_split,
+)
+from comfyui_parallelanything_tpu.fleet import roles as fleet_roles
+from comfyui_parallelanything_tpu.host import carve_stages
+from comfyui_parallelanything_tpu.server import make_server
+from comfyui_parallelanything_tpu.utils.metrics import registry
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+
+# ---------------------------------------------------------------------------
+# toy stage nodes
+# ---------------------------------------------------------------------------
+
+
+def _stage_nodes(tag: str, out_dir: str, setup_s: float = 0.0):
+    """Per-backend stage node classes (the per-backend factory pattern
+    scripts/chaos.py uses: tag + out_dir baked into the closure). Class
+    names contain the carve substrings ("TextEncode" / "Sampler" /
+    "Decode", host._intrinsic_stage) so carve_stages ranks them and the
+    SLO stage histograms classify them.
+
+    ``setup_s`` is the class-switch cost: the backend pays it whenever it
+    runs a different node class than its LAST run (a warm-LRU-of-1 of
+    program + weights in HBM) — the cost role pools amortize away."""
+    state = {"warm": None}
+
+    def _charge(name):
+        if setup_s and state["warm"] != name:
+            time.sleep(setup_s)
+        state["warm"] = name
+
+    class ToyTextEncode:
+        CATEGORY = "roles-test"
+        RETURN_TYPES = ("COND",)
+        FUNCTION = "run"
+
+        @classmethod
+        def INPUT_TYPES(cls):
+            return {"required": {"text": ("STRING", {"default": ""}),
+                                 "work_s": ("FLOAT", {"default": 0.0})}}
+
+        def run(self, text, work_s):
+            _charge("encode")
+            time.sleep(float(work_s))
+            digest = hashlib.md5(str(text).encode()).digest()
+            cond = np.frombuffer(digest, np.uint8).astype(np.float32)
+            return (cond,)
+
+    class ToySampler:
+        CATEGORY = "roles-test"
+        RETURN_TYPES = ("LATENT",)
+        FUNCTION = "run"
+
+        @classmethod
+        def INPUT_TYPES(cls):
+            return {"required": {"cond": ("COND",),
+                                 "seed": ("INT", {"default": 0}),
+                                 "work_s": ("FLOAT", {"default": 0.0})}}
+
+        def run(self, cond, seed, work_s):
+            _charge("denoise")
+            time.sleep(float(work_s))
+            rng = np.random.default_rng(int(seed))
+            latent = np.tanh(
+                rng.standard_normal(16).astype(np.float32)
+                + np.asarray(cond, dtype=np.float32) / 255.0
+            )
+            return (latent.astype(np.float32),)
+
+    class ToyDecode:
+        CATEGORY = "roles-test"
+        RETURN_TYPES = ("INT",)
+        FUNCTION = "run"
+
+        @classmethod
+        def INPUT_TYPES(cls):
+            return {"required": {"latent": ("LATENT",),
+                                 "seed": ("INT", {"default": 0}),
+                                 "work_s": ("FLOAT", {"default": 0.0})}}
+
+        def run(self, latent, seed, work_s):
+            _charge("decode")
+            time.sleep(float(work_s))
+            arr = np.asarray(latent, dtype=np.float32)
+            os.makedirs(out_dir, exist_ok=True)
+            np.save(os.path.join(out_dir, f"{int(seed)}-{tag}.npy"), arr)
+            return (int(abs(float(arr.sum())) * 1e6) & 0x7FFFFFFF,)
+
+    return {"ToyTextEncode": ToyTextEncode, "ToySampler": ToySampler,
+            "ToyDecode": ToyDecode}
+
+
+def _sgraph(seed, text="a castle", enc_s=0.0, den_s=0.0, dec_s=0.0):
+    """The canonical 3-stage workflow: TextEncode → Sampler → Decode."""
+    return {
+        "1": {"class_type": "ToyTextEncode",
+              "inputs": {"text": str(text), "work_s": enc_s}},
+        "2": {"class_type": "ToySampler",
+              "inputs": {"cond": ["1", 0], "seed": int(seed),
+                         "work_s": den_s}},
+        "3": {"class_type": "ToyDecode",
+              "inputs": {"latent": ["2", 0], "seed": int(seed),
+                         "work_s": dec_s}},
+    }
+
+
+# ---------------------------------------------------------------------------
+# HTTP helpers (test_fleet.py's, duplicated to keep this module standalone)
+# ---------------------------------------------------------------------------
+
+
+def _get(base, path, timeout=15):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(base, path, payload=None, timeout=15):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload or {}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait(pred, timeout=20, interval=0.02, what="condition"):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(interval)
+    raise TimeoutError(f"never saw: {what}")
+
+
+def _wait_entry(base, pid, timeout=30):
+    out = {}
+
+    def have():
+        hist = _get(base, f"/history/{pid}")
+        if pid in hist:
+            out["entry"] = hist[pid]
+            return True
+        return False
+
+    _wait(have, timeout=timeout, what=f"history entry for {pid}")
+    return out["entry"]
+
+
+class _RoleBackend:
+    """One in-process backend with a declared role and its own latent dump
+    dir (the bitwise witness ToyDecode writes)."""
+
+    def __init__(self, tmp_path, host_id, role="all", setup_s=0.0):
+        self.out_dir = str(tmp_path / f"latents-{host_id}")
+        self.srv, self.q = make_server(
+            port=0, output_dir=str(tmp_path / host_id),
+            class_mappings=_stage_nodes(host_id, self.out_dir, setup_s),
+            host_id=host_id, role=role,
+        )
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+        self.base = f"http://127.0.0.1:{self.srv.server_address[1]}"
+        self.host_id = host_id
+        self.alive = True
+
+    def kill(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+        self.q.interrupt()
+        self.alive = False
+
+    def stop(self):
+        if self.alive:
+            self.srv.shutdown()
+            self.srv.server_close()
+        self.q.shutdown()
+
+
+def _mk_fleet(tmp_path, specs, setup_s=0.0, **router_kw):
+    """(base, srv, router, backends) over ``specs = [(host_id, role), ...]``
+    static seeds; waits for every backend healthy (and for role visibility
+    when any spec declares one — roles ride the scoreboard's health poll
+    for static seeds)."""
+    backends = [_RoleBackend(tmp_path, hid, role, setup_s)
+                for hid, role in specs]
+    kw = dict(
+        fleet_registry=FleetRegistry(ttl_s=5.0),
+        scoreboard=Scoreboard(poll_s=0.1, stale_after_s=5.0, fail_after=2,
+                              timeout_s=2.0),
+        saturation_depth=2, monitor_s=0.05, max_attempts=4,
+    )
+    kw.update(router_kw)
+    srv, router = make_router(
+        port=0, backends=[(b.host_id, b.base) for b in backends], **kw)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    _wait(lambda: all(router.scoreboard.healthy(b.host_id) for b in backends),
+          what="backends healthy")
+    if any(role != "all" for _, role in specs):
+        _wait(lambda: router.roles.disaggregated(),
+              what="declared roles visible to the router")
+    return base, srv, router, backends
+
+
+def _stop_fleet(srv, router, backends):
+    srv.shutdown()
+    srv.server_close()
+    router.shutdown()
+    for b in backends:
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# carve_stages
+# ---------------------------------------------------------------------------
+
+
+class TestCarveStages:
+    def test_three_stage_carve(self):
+        plan = carve_stages(_sgraph(1))
+        assert plan is not None
+        names = [s["stage"] for s in plan["stages"]]
+        assert names == ["encode", "denoise", "decode"]
+        enc, den, dec = plan["stages"]
+        assert enc["nodes"] == ["1"]
+        assert enc["needs"] == [] and enc["exports"] == ["1"]
+        assert den["nodes"] == ["2"]
+        assert den["needs"] == ["1"] and den["exports"] == ["2"]
+        assert dec["nodes"] == ["3"]
+        assert dec["needs"] == ["2"] and dec["exports"] == []
+        # Each stage graph is the FULL upstream closure — a host holding no
+        # handles recomputes the prefix locally, never errors.
+        assert set(enc["graph"]) == {"1"}
+        assert set(den["graph"]) == {"1", "2"}
+        assert set(dec["graph"]) == {"1", "2", "3"}
+
+    def test_neutral_node_inherits_max_ancestor_rank(self):
+        g = _sgraph(2)
+        # A save-ish neutral class after decode is decode work...
+        g["4"] = {"class_type": "ToySave", "inputs": {"x": ["3", 0]}}
+        plan = carve_stages(g)
+        dec = plan["stages"][2]
+        assert set(dec["nodes"]) == {"3", "4"}
+        # ... and decode still only needs the denoise boundary handle.
+        assert dec["needs"] == ["2"]
+
+    def test_free_loader_rides_dependent_closures(self):
+        g = _sgraph(3)
+        # A loader with no ranked ancestor is FREE: it joins the closure of
+        # every stage that (transitively) consumes it, members unchanged.
+        g["0"] = {"class_type": "ToyLoader", "inputs": {}}
+        g["2"]["inputs"]["model"] = ["0", 0]
+        plan = carve_stages(g)
+        enc, den, dec = plan["stages"]
+        assert "0" not in enc["graph"]          # encode never consumes it
+        assert "0" in den["graph"] and "0" in dec["graph"]
+        for st in plan["stages"]:
+            assert "0" not in st["nodes"]       # free, not a member
+            assert "0" not in st["needs"]       # no handle for unranked ids
+
+    def test_fewer_than_two_intrinsic_stages_no_carve(self):
+        assert carve_stages({"1": {"class_type": "SleepWork",
+                                   "inputs": {}}}) is None
+        only_sampler = {"1": {"class_type": "ToySampler",
+                              "inputs": {"seed": 1}}}
+        assert carve_stages(only_sampler) is None
+
+    def test_cycle_no_carve(self):
+        g = {
+            "1": {"class_type": "ToyTextEncode", "inputs": {"text": "x"}},
+            "2": {"class_type": "ToySampler",
+                  "inputs": {"cond": ["1", 0], "latent": ["3", 0]}},
+            "3": {"class_type": "ToyDecode", "inputs": {"latent": ["2", 0]}},
+        }
+        assert carve_stages(g) is None
+
+    def test_non_monotone_highres_fix_no_carve(self):
+        # Decode feeding a SECOND sampler (highres fix): stage order runs
+        # backwards along that edge — fall back to single dispatch.
+        g = _sgraph(4)
+        g["4"] = {"class_type": "ToySampler",
+                  "inputs": {"cond": ["3", 0], "seed": 4, "work_s": 0.0}}
+        assert carve_stages(g) is None
+
+    def test_malformed_graph_no_carve(self):
+        assert carve_stages(None) is None
+        assert carve_stages({"1": "not-a-node"}) is None
+
+
+# ---------------------------------------------------------------------------
+# pool sizing + role normalization
+# ---------------------------------------------------------------------------
+
+
+class TestRolesPlumbing:
+    def test_normalize_role(self):
+        assert normalize_role(None) == "all"
+        assert normalize_role("") == "all"
+        assert normalize_role(" Denoise ") == "denoise"
+        with pytest.raises(ValueError):
+            normalize_role("dencode")
+
+    def test_suggest_pool_split_canonical_four(self):
+        # The shape the e2e fleet below deploys: denoise dominates.
+        assert suggest_pool_split(4) == {
+            "encode": 1, "denoise": 2, "decode": 1,
+        }
+
+    def test_suggest_pool_split_sums_and_floors(self):
+        for n in range(0, 12):
+            split = suggest_pool_split(n)
+            assert sum(split.values()) == n
+            assert all(v >= 0 for v in split.values())
+            if n >= 3:
+                # A zero-sized pool would silently un-disaggregate a stage.
+                assert all(v >= 1 for v in split.values()), (n, split)
+
+    def test_suggest_pool_split_follows_measured_stage_p50s(self):
+        heavy_decode = suggest_pool_split(
+            8, stage_p50s={"encode": 0.01, "eval": 0.05, "decode": 0.60})
+        assert heavy_decode["decode"] > suggest_pool_split(8)["decode"]
+
+
+# ---------------------------------------------------------------------------
+# content-addressed stage store
+# ---------------------------------------------------------------------------
+
+
+class TestStageStore:
+    def test_roundtrip_and_content_address(self):
+        store = StageStore(max_bytes=1 << 20)
+        val = (np.arange(6, dtype=np.float32), "meta", 3)
+        key = store.put_value(val)
+        assert key == fleet_roles.content_key(
+            fleet_roles.serialize_value(val))
+        got = store.get_value(key)
+        assert isinstance(got, tuple)
+        assert (got[0] == val[0]).all() and got[1:] == ("meta", 3)
+        # Content-addressed: the same value re-inserted keeps one entry.
+        assert store.put_value(val) == key
+        assert store.stats()["entries"] == 1
+
+    def test_lru_eviction_is_byte_bounded(self):
+        store = StageStore(max_bytes=250)
+        k1 = store.put(b"a" * 100)
+        k2 = store.put(b"b" * 100)
+        assert store.get(k1) is not None      # touch k1 → k2 becomes LRU
+        k3 = store.put(b"c" * 100)            # 300 > 250: evicts k2
+        assert store.get(k2) is None
+        assert store.get(k1) is not None and store.get(k3) is not None
+        assert store.stats()["bytes"] <= 250
+        assert store.evictions == 1
+
+    def test_oversized_blob_hashed_not_retained(self):
+        store = StageStore(max_bytes=10)
+        blob = b"z" * 100
+        key = store.put(blob)
+        assert key == fleet_roles.content_key(blob)
+        assert store.get(key) is None
+
+    def test_zero_budget_disables_the_store(self):
+        off = StageStore(max_bytes=0)
+        assert not off.enabled
+        assert off.get(off.put(b"ab")) is None
+
+    def test_unpicklable_value_skips_the_handle(self):
+        store = StageStore(max_bytes=1 << 20)
+        assert store.put_value((threading.Lock(),)) is None
+
+
+# ---------------------------------------------------------------------------
+# journal stage lineage (fold-level; the live path is exercised below and in
+# tests/test_fleet.py's decode-kill replay)
+# ---------------------------------------------------------------------------
+
+
+class TestJournalStageLineage:
+    def test_fold_accumulates_stage_lineage(self, tmp_path):
+        j = PromptJournal(str(tmp_path / "j.jsonl"))
+        j.append("submit", "p1", graph=_sgraph(1), key="k", number=1)
+        j.append("dispatch", "p1", host="enc-0", backend_pid="b1",
+                 attempt=1, stage="encode", stage_idx=0)
+        j.append("stage_resolve", "p1", stage="encode", stage_idx=0,
+                 host="enc-0", handles={"1": "c0ffee"})
+        j.append("stage_dispatch", "p1", host="den-0", backend_pid="b2",
+                 attempt=1, stage="denoise", stage_idx=1)
+        st = j.replay()["p1"]
+        assert st["phase"] == "dispatch"
+        assert st["stage"] == "denoise" and st["stage_idx"] == 1
+        assert st["host"] == "den-0" and st["backend_pid"] == "b2"
+        # The lineage a standby resumes from: resolved stages + handles.
+        assert st["stages"] == [{"stage": "encode", "stage_idx": 0,
+                                 "host": "enc-0",
+                                 "handles": {"1": "c0ffee"}}]
+
+
+# ---------------------------------------------------------------------------
+# live role-pool fleet: staged dispatch end to end
+# ---------------------------------------------------------------------------
+
+_SPECS = [("enc-0", "encode"), ("den-0", "denoise"),
+          ("den-1", "denoise"), ("dec-0", "decode")]
+
+
+@pytest.fixture
+def role_fleet(tmp_path):
+    """1 encode + 2 denoise + 1 decode — suggest_pool_split(4)'s shape."""
+    fleet_roles.store.clear()
+    base, srv, router, backends = _mk_fleet(tmp_path, _SPECS)
+    yield base, router, backends
+    _stop_fleet(srv, router, backends)
+    fleet_roles.store.clear()
+
+
+class TestRolePoolDispatch:
+    def test_staged_prompt_walks_the_pools(self, role_fleet):
+        base, router, backends = role_fleet
+        pid = _post(base, "/prompt", {"prompt": _sgraph(5)})["prompt_id"]
+        entry = _wait_entry(base, pid)
+        assert entry["status"]["status_str"] == "success"
+        fp = router.prompts[pid]
+        assert fp.plan is not None and fp.stage_idx == 2
+        # Every hop landed in its stage's pool.
+        assert fp.stage_hosts[0] == "enc-0"
+        assert fp.stage_hosts[1] in ("den-0", "den-1")
+        assert entry["status"]["fleet"]["host_id"] == "dec-0"
+        # Boundary handles banked for both resolved stages.
+        assert set(fp.stage_handles) == {"1", "2"}
+        for key in fp.stage_handles.values():
+            assert fleet_roles.store.get(key) is not None
+        # The WHOLE accumulated lineage preseeds each hop, not just the
+        # declared needs: denoise resolves {"1"}, decode resolves {"1","2"}
+        # (3 hits total) — without the full-lineage dispatch the decode
+        # host re-executes the encode node its closure names, paying that
+        # class's program/weight warm-up per prompt.
+        assert registry.get("pa_role_handle_hits") >= 3
+        assert not registry.get("pa_role_handle_misses")
+
+    def test_staged_result_bitwise_equals_single_host_run(self, role_fleet):
+        base, router, backends = role_fleet
+        pid = _post(base, "/prompt", {"prompt": _sgraph(6)})["prompt_id"]
+        assert _wait_entry(base, pid)["status"]["status_str"] == "success"
+        # The same graph straight at ONE backend (no router → unstaged).
+        ref = backends[1]
+        pid2 = _post(ref.base, "/prompt", {"prompt": _sgraph(6)})["prompt_id"]
+        assert _wait_entry(ref.base, pid2)["status"]["status_str"] == "success"
+        staged = np.load(os.path.join(backends[3].out_dir, "6-dec-0.npy"))
+        direct = np.load(os.path.join(ref.out_dir, f"6-{ref.host_id}.npy"))
+        assert staged.tobytes() == direct.tobytes()   # bitwise, not approx
+
+    def test_role_views_and_metrics(self, role_fleet):
+        base, router, backends = role_fleet
+        pid = _post(base, "/prompt", {"prompt": _sgraph(7)})["prompt_id"]
+        assert _wait_entry(base, pid)["status"]["status_str"] == "success"
+        doc = _get(base, "/fleet/hosts")
+        roles = doc["roles"]
+        assert roles["disaggregated"] is True
+        assert roles["membership"]["enc-0"] == "encode"
+        assert sorted(roles["pools"]["denoise"]) == ["den-0", "den-1"]
+        assert roles["suggested"] == {"encode": 1, "denoise": 2, "decode": 1}
+        # Per-role dispatch counters moved for every stage of the prompt.
+        for role, host in (("encode", "enc-0"), ("decode", "dec-0")):
+            assert (registry.get("pa_role_dispatch_total",
+                                 {"role": role, "host": host}) or 0) >= 1
+        assert (registry.get("pa_role_stage_resolved_total",
+                             {"role": "encode"}) or 0) >= 1
+        slo = _get(base, "/fleet/slo")
+        assert "roles" in slo    # per-role verdicts only when disaggregated
+
+    def test_uncarvable_graph_single_dispatches_on_a_role_fleet(
+        self, role_fleet
+    ):
+        base, router, backends = role_fleet
+        g = {"1": {"class_type": "ToySampler",
+                   "inputs": {"cond": [1.0] * 16, "seed": 8,
+                              "work_s": 0.0}}}
+        pid = _post(base, "/prompt", {"prompt": g})["prompt_id"]
+        entry = _wait_entry(base, pid)
+        assert entry["status"]["status_str"] == "success"
+        fp = router.prompts[pid]
+        assert fp.plan is None and fp.stage_idx == 0
+
+    def test_all_role_fleet_stays_unstaged(self, tmp_path):
+        """--role all everywhere: the pre-role fleet, bitwise-unchanged —
+        one dispatch, no plan, no pa_stage entry, no roles SLO section."""
+        fleet_roles.store.clear()
+        base, srv, router, backends = _mk_fleet(
+            tmp_path, [("all-0", "all"), ("all-1", "all")])
+        try:
+            assert not router.roles.disaggregated()
+            pid = _post(base, "/prompt", {"prompt": _sgraph(9)})["prompt_id"]
+            entry = _wait_entry(base, pid)
+            assert entry["status"]["status_str"] == "success"
+            fp = router.prompts[pid]
+            assert fp.plan is None and fp.stage_idx == 0
+            assert fp.stage_handles == {} and fp.stage_hosts == []
+            assert "pa_stage" not in entry["status"]
+            assert "roles" not in _get(base, "/fleet/slo")
+            host = entry["status"]["fleet"]["host_id"]
+            got = np.load(os.path.join(
+                {b.host_id: b for b in backends}[host].out_dir,
+                "9-{}.npy".format(host)))
+            assert got.shape == (16,)
+        finally:
+            _stop_fleet(srv, router, backends)
+            fleet_roles.store.clear()
+
+    def test_denoise_kill_mid_stage_fails_over_bitwise(self, tmp_path):
+        """Mid-denoise role-host kill: zero lost, survivor bitwise — the
+        fold_in replay contract carried through the staged path."""
+        fleet_roles.store.clear()
+        base, srv, router, backends = _mk_fleet(tmp_path, _SPECS)
+        try:
+            pid = _post(base, "/prompt",
+                        {"prompt": _sgraph(11, den_s=2.5)})["prompt_id"]
+            den = {b.host_id: b for b in backends}
+            _wait(lambda: any(len(den[h].q.running) > 0
+                              for h in ("den-0", "den-1")),
+                  what="denoise stage running")
+            victim = next(h for h in ("den-0", "den-1")
+                          if len(den[h].q.running) > 0)
+            den[victim].kill()
+            entry = _wait_entry(base, pid, timeout=60)
+            assert entry["status"]["status_str"] == "success"
+            assert router.stats()["lost"] == 0
+            fp = router.prompts[pid]
+            assert fp.failovers >= 1
+            # The retry stayed in the denoise pool (the sibling survived).
+            assert fp.stage_hosts[1] != victim
+            assert fp.stage_hosts[1] in ("den-0", "den-1")
+            staged = np.load(os.path.join(
+                backends[3].out_dir, "11-dec-0.npy"))
+            ref = backends[0]      # direct unstaged re-run, any host
+            pid2 = _post(ref.base, "/prompt",
+                         {"prompt": _sgraph(11)})["prompt_id"]
+            assert (_wait_entry(ref.base, pid2)["status"]["status_str"]
+                    == "success")
+            direct = np.load(os.path.join(ref.out_dir, "11-enc-0.npy"))
+            assert staged.tobytes() == direct.tobytes()
+        finally:
+            _stop_fleet(srv, router, [b for b in backends if b.alive])
+            for b in backends:
+                if not b.alive:
+                    b.q.shutdown()
+            fleet_roles.store.clear()
+
+
+# ---------------------------------------------------------------------------
+# the CI smoke: fixed host count, disaggregated vs homogeneous
+# ---------------------------------------------------------------------------
+
+
+class TestRolePoolThroughput:
+    def test_disaggregated_beats_homogeneous_at_fixed_host_count(
+        self, tmp_path, monkeypatch
+    ):
+        """The round's headline gate (BASELINE "Role-pool protocol"): same 4
+        hosts, same mixed load — 1-encode/2-denoise/1-decode sustains
+        strictly higher throughput than 4 homogeneous backends, and the
+        decode stage wall drops (role hosts never pay the class-switch
+        setup a whole-graph host pays ~3× per prompt). scripts/ci_tier1.sh
+        runs exactly this test as the role-pool smoke."""
+        from loadgen import _append_ledger, run_load
+
+        setup_s, clients, requests = 0.4, 4, 3
+        graph = _sgraph(0, den_s=0.02)
+
+        def _run(specs, subdir):
+            fleet_roles.store.clear()
+            registry.reset()
+            base, srv, router, backends = _mk_fleet(
+                tmp_path / subdir, specs, setup_s=setup_s)
+            try:
+                summary = run_load(
+                    base, graph, clients=clients, requests=requests,
+                    timeout=120, seed_key="2:inputs:seed", seed=7,
+                    hosts=[b.base for b in backends],
+                )
+                dec_p95 = registry.quantile(
+                    "pa_slo_stage_seconds", 95, labels={"stage": "decode"})
+            finally:
+                _stop_fleet(srv, router, backends)
+                fleet_roles.store.clear()
+            return summary, dec_p95
+
+        hom, hom_dec_p95 = _run(
+            [(f"hom-{i}", "all") for i in range(4)], "hom")
+        dis, dis_dec_p95 = _run(_SPECS, "dis")
+
+        total = clients * requests
+        for name, s in (("homogeneous", hom), ("disaggregated", dis)):
+            assert s["completed"] == total, (name, s)
+            assert (s["fleet"] or {}).get("prompts_lost") in (0, 0.0, None)
+        # Fixed host count: splitting the fleet into role pools WINS.
+        assert dis["throughput_rps"] > hom["throughput_rps"], (hom, dis)
+        # The decode stage wall collapses once decode hosts stay warm.
+        assert dis_dec_p95 is not None and hom_dec_p95 is not None
+        assert dis_dec_p95 < hom_dec_p95, (hom_dec_p95, dis_dec_p95)
+        # Loadgen's per-role view materialized (kind="roles" ledger shape).
+        assert set(dis["roles"]) == {"encode", "denoise", "decode"}
+        assert dis["roles"]["denoise"]["hosts"] == ["den-0", "den-1"]
+        assert sum(p["completed"] for p in dis["roles"].values()) == total
+        disp = (dis["fleet"] or {}).get("role_dispatches") or {}
+        assert all(disp.get(r, 0) >= total for r in
+                   ("encode", "denoise", "decode")), disp
+        assert hom.get("roles") is None     # homogeneous: no role section
+
+        # The kind="roles" ledger record (hermetic: redirected to tmp — the
+        # CLI path banks the same record when the summary carries roles).
+        ledger_dir = tmp_path / "ledger"
+        monkeypatch.setenv("PA_LEDGER_DIR", str(ledger_dir))
+        _append_ledger(dis, "http://fixed-host-count-comparison",
+                       kind="roles")
+        [line] = open(ledger_dir / "perf_ledger.jsonl").read().splitlines()
+        rec = json.loads(line)
+        assert rec["kind"] == "roles"
+        assert set(rec["roles"]) == {"encode", "denoise", "decode"}

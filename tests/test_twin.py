@@ -105,6 +105,65 @@ class TestSimulation:
         assert off["queue_wait_mean_s"] == base["queue_wait_mean_s"]
 
 
+class TestRoleTandem:
+    """Round 20: host rows carrying ``role`` turn the simulation into the
+    disaggregated encode→denoise→decode tandem (fleet/roles.py's pools with
+    stage hand-off edges); an all-``all`` fleet stays on the single-queue
+    path bit-for-bit."""
+
+    def _role_hosts(self, n_denoise=2):
+        return (
+            [{"host_id": "enc", "service_s": 0.01, "workers": 1,
+              "role": "encode"}]
+            + [{"host_id": f"den{i}", "service_s": 0.10, "workers": 1,
+                "role": "denoise"} for i in range(n_denoise)]
+            + [{"host_id": "dec", "service_s": 0.02, "workers": 1,
+                "role": "decode"}]
+        )
+
+    def test_all_role_rows_match_roleless_rows_bitwise(self):
+        arrivals = twin.gen_arrivals("poisson", rps=10, duration_s=10, seed=6)
+        plain = [{"host_id": f"h{i}", "service_s": 0.05, "workers": 2}
+                 for i in range(3)]
+        tagged = [dict(h, role="all") for h in plain]
+        assert twin.simulate(arrivals, plain) == twin.simulate(
+            arrivals, tagged)
+
+    def test_tandem_latency_is_the_stage_sum_at_low_load(self):
+        arrivals = twin.gen_arrivals("poisson", rps=2, duration_s=20, seed=7)
+        s = twin.simulate(arrivals, self._role_hosts())
+        assert s["requests"] == len(arrivals)
+        # Unqueued request = one visit per stage pool: 0.01 + 0.10 + 0.02.
+        assert s["latency_p50_s"] == pytest.approx(0.13, abs=0.02)
+        # Every stage pool served; each request denoises exactly once.
+        assert s["hosts"]["enc"] == len(arrivals)
+        assert s["hosts"]["dec"] == len(arrivals)
+        assert s["hosts"]["den0"] + s["hosts"]["den1"] == len(arrivals)
+
+    def test_generalist_covers_stages_with_no_dedicated_host(self):
+        arrivals = twin.gen_arrivals("poisson", rps=2, duration_s=10, seed=8)
+        hosts = [
+            {"host_id": "den", "service_s": 0.05, "workers": 1,
+             "role": "denoise"},
+            {"host_id": "gen", "service_s": 0.05, "workers": 1,
+             "role": "all"},
+        ]
+        s = twin.simulate(arrivals, hosts)
+        assert s["requests"] == len(arrivals)
+        # encode + decode have only the generalist — it serves every
+        # request at least twice.
+        assert s["hosts"]["gen"] >= 2 * len(arrivals)
+
+    def test_widening_the_bottleneck_pool_absorbs_the_load(self):
+        """The twin-level readout of suggest_pool_split: denoise saturates
+        first (capacity 10 rps at 0.1 s service) — one more denoise host is
+        the fix, the per-role scaling knob."""
+        arrivals = twin.gen_arrivals("poisson", rps=15, duration_s=20, seed=9)
+        narrow = twin.simulate(arrivals, self._role_hosts(n_denoise=1))
+        wide = twin.simulate(arrivals, self._role_hosts(n_denoise=2))
+        assert wide["latency_p95_s"] < narrow["latency_p95_s"] / 2
+
+
 class TestCapacityTiers:
     def test_measured_and_mean_tiers(self):
         rec = {
