@@ -159,6 +159,11 @@ class FleetPrompt:
     stage_idx: int = 0
     stage_handles: dict = dataclasses.field(default_factory=dict)
     stage_hosts: list = dataclasses.field(default_factory=list)
+    # Every successful dispatch hop, in order — {host, backend_pid, stage,
+    # stage_idx, attempt}. This is the stitch index: GET /fleet/trace walks
+    # it to pull each involved host's span export (failover means one stage
+    # can appear twice, on two hosts — both hops are part of the story).
+    hops: list = dataclasses.field(default_factory=list)
 
 
 class FleetRouter:
@@ -522,6 +527,17 @@ class FleetRouter:
             # router-side fleet-prompt span AND the backend-side prompt
             # timeline joined by origin_prompt_id.
             extra["fleet"] = {"origin": fp.pid, "router": self.router_id}
+            # Distributed-trace propagation (W3C traceparent shape): the
+            # router prompt_id IS the trace_id lineage — every hop of this
+            # prompt (stage hand-offs, failover re-dispatches, post-takeover
+            # replays) carries the SAME trace_id, so the /fleet/trace
+            # stitcher joins all hosts' spans under one id. Injected when
+            # the router traces, or when the client sampled this prompt for
+            # capture (loadgen --trace-sample sets pa_trace_sampled).
+            if tracing.on() or extra.get("pa_trace_sampled"):
+                extra["fleet"]["traceparent"] = tracing.format_traceparent(
+                    fp.pid, sampled=True
+                )
             if stage is not None:
                 with self._lock:
                     # The FULL accumulated lineage, not just this stage's
@@ -586,6 +602,12 @@ class FleetRouter:
                 fp.host_id = host
                 fp.backend_pid = resp.get("prompt_id")
                 fp.status = "inflight"
+                fp.hops.append({
+                    "host": host, "backend_pid": fp.backend_pid,
+                    "stage": role, "stage_idx":
+                        fp.stage_idx if stage is not None else None,
+                    "attempt": fp.attempts,
+                })
             if self.journal is not None:
                 if stage is not None and fp.stage_idx > 0:
                     # Ownership moved to a later stage's pool host: the
@@ -620,12 +642,27 @@ class FleetRouter:
                     help="prompts placed off their warm-affinity primary",
                 )
             if tracing.on():
+                # role/pool attrs (round 21 fix): `role` is the dispatched
+                # stage's tier ("all" for unstaged traffic), `pool` the
+                # serving host's DECLARED membership — they differ when a
+                # generalist host absorbs a stage, which is exactly the
+                # per-tier latency question the labels make filterable.
+                dur_us = tracing.now_us() - t0_us
+                pool = self.roles.role_of(host)
                 tracing.record(
-                    "fleet-hop", t0_us, tracing.now_us() - t0_us,
+                    "fleet-hop", t0_us, dur_us,
                     cat="fleet", prompt_id=fp.pid, host=host,
                     backend_pid=fp.backend_pid, attempt=fp.attempts,
-                    spilled=spilled,
+                    spilled=spilled, role=role or "all", pool=pool,
+                    trace_id=fp.pid,
                 )
+                if stage is not None:
+                    tracing.record(
+                        "stage-dispatch", t0_us, dur_us,
+                        cat="fleet", prompt_id=fp.pid, host=host,
+                        stage=role, stage_idx=fp.stage_idx,
+                        role=role or "all", pool=pool, trace_id=fp.pid,
+                    )
             return
 
     def _mark_lost(self, fp: FleetPrompt) -> None:
@@ -681,9 +718,13 @@ class FleetRouter:
                 "fleet-prompt", fp.trace_submit_us,
                 tracing.now_us() - fp.trace_submit_us, cat="fleet",
                 prompt_id=fp.pid, host=fp.host_id, attempts=fp.attempts,
-                failovers=fp.failovers,
+                failovers=fp.failovers, trace_id=fp.pid,
                 outcome=(entry.get("status") or {}).get("status_str"),
             )
+            # Snapshot the router-side spans into the completed-prompt
+            # retention ring: /fleet/trace must still stitch this prompt
+            # after the live rings wrap under later traffic.
+            tracing.retain_prompt(fp.pid)
 
     def _stage_or_complete(self, fp: FleetPrompt, entry: dict) -> None:
         """Route a collected entry: a non-final STAGE result advances the
@@ -1178,6 +1219,139 @@ class FleetRouter:
         return {"prompts": by_status, "router_inflight": inflight,
                 "lost": by_status.get("lost", 0)}
 
+    def stitch_trace(self, pid: str) -> dict:
+        """ONE Perfetto/Chrome timeline for one prompt across every process
+        it touched (``GET /fleet/trace?prompt_id=``): the router's own spans
+        plus each dispatch hop's host-side ``GET /trace?prompt_id=
+        <backend_pid>`` export, each process on its own host-labeled track
+        (trace-event ``pid``), clock domains aligned on the tracers'
+        wall-clock epoch anchors, and the prompt's journal lineage records
+        (submit / stage_dispatch / stage_resolve / takeover) merged in as
+        instant events on the router track. Every X event is stamped with
+        ``trace_id = <router prompt_id>`` — the single id the whole
+        distributed story nests under. A dead hop (its host left the ring,
+        or its /trace fetch fails) degrades to ``ok: false`` in ``hosts``;
+        the surviving tracks still stitch."""
+        with self._lock:
+            fp = self.prompts.get(pid)
+            hops = [dict(h) for h in fp.hops] if fp is not None else []
+        if fp is None:
+            return {"schema": "pa-fleet-trace/v1", "trace_id": pid,
+                    "error": f"unknown prompt {pid!r}"}
+        docs: list[dict] = [{
+            "host": self.router_id, "role": "router", "backend_pid": pid,
+            "ok": True, "doc": tracing.export(prompt_id=pid),
+        }]
+        seen: set = set()
+        for hop in hops:
+            bpid = hop.get("backend_pid")
+            key = (hop.get("host"), bpid)
+            if not bpid or key in seen:
+                continue
+            seen.add(key)
+            host = str(hop.get("host") or "")
+            entry = {
+                "host": host,
+                "role": hop.get("stage") or self.roles.role_of(host),
+                "backend_pid": bpid, "stage_idx": hop.get("stage_idx"),
+                "ok": False, "doc": None,
+            }
+            base = self.registry.base_of(host)
+            if base is not None:
+                try:
+                    entry["doc"] = self._get(
+                        base, f"/trace?prompt_id={bpid}",
+                        timeout=min(10.0, self.http_timeout_s),
+                    )
+                    entry["ok"] = True
+                except (OSError, ValueError, urllib.error.HTTPError):
+                    pass
+            docs.append(entry)
+        # Clock-domain alignment: each process's trace-event ts is relative
+        # to its OWN monotonic epoch; the wall-clock anchor taken at the
+        # same instant maps them all onto the earliest anchor's timeline
+        # (NTP-level skew is the error bar — ms against multi-ms spans).
+        walls = [d["doc"]["epoch_wall_s"] for d in docs
+                 if d.get("doc")
+                 and isinstance(d["doc"].get("epoch_wall_s"), (int, float))]
+        base_wall = min(walls) if walls else None
+        meta: list[dict] = []
+        events: list[dict] = []
+        hosts_out: list[dict] = []
+        for track, d in enumerate(docs):
+            hosts_out.append({
+                "pid": track, "host": d["host"], "role": d["role"],
+                "backend_pid": d["backend_pid"], "ok": d["ok"],
+                **({"stage_idx": d["stage_idx"]}
+                   if d.get("stage_idx") is not None else {}),
+            })
+            doc = d.get("doc")
+            if not doc:
+                continue
+            wall = doc.get("epoch_wall_s")
+            shift_us = (
+                (wall - base_wall) * 1e6
+                if base_wall is not None and isinstance(wall, (int, float))
+                else 0.0
+            )
+            meta.append({
+                "ph": "M", "name": "process_name", "pid": track,
+                "args": {"name": f"{d['host']} [{d['role']}]"},
+            })
+            for ev in doc.get("traceEvents") or []:
+                ph = ev.get("ph")
+                if ph == "M":
+                    if ev.get("name") == "thread_name":
+                        meta.append({**ev, "pid": track})
+                    continue
+                if ph != "X":
+                    continue
+                args = dict(ev.get("args") or {})
+                args["trace_id"] = pid
+                # Track identity fills in what the recording site didn't
+                # know (setdefault: a fleet-hop span's own `host` attr —
+                # the dispatched backend — must survive).
+                args.setdefault("host", d["host"])
+                args.setdefault("role", d["role"])
+                events.append({
+                    **ev, "pid": track,
+                    "ts": round(ev.get("ts", 0.0) + shift_us, 3),
+                    "args": args,
+                })
+        # Journal lineage as instant events on the router track: the stage
+        # hand-off story (who banked which handles when, takeovers included)
+        # interleaved with the spans it explains.
+        if self.journal is not None and base_wall is not None:
+            try:
+                for rec in PromptJournal.iter_records(self.journal.path):
+                    if rec.get("pid") != pid:
+                        continue
+                    ts = rec.get("ts")
+                    if not isinstance(ts, (int, float)):
+                        continue
+                    events.append({
+                        "ph": "i", "name": f"journal:{rec.get('ev')}",
+                        "cat": "fleet", "s": "p", "pid": 0, "tid": 0,
+                        "ts": round((ts - base_wall) * 1e6, 3),
+                        "args": {
+                            k: v for k, v in rec.items()
+                            if k not in ("graph", "extra") and k != "pid"
+                        } | {"trace_id": pid},
+                    })
+            except OSError:
+                pass
+        events.sort(key=lambda e: (e["pid"], e.get("tid", 0), e["ts"]))
+        return {
+            "schema": "pa-fleet-trace/v1",
+            "trace_id": pid,
+            "router_id": self.router_id,
+            "enabled": tracing.on(),
+            "displayTimeUnit": "ms",
+            "epoch_wall_s": base_wall,
+            "hosts": hosts_out,
+            "traceEvents": meta + events,
+        }
+
     def roles_view(self) -> dict:
         """The role-pool picture for ``GET /fleet/hosts``: declared
         membership + pool sizes, plus the roofline-derived SUGGESTED split
@@ -1406,6 +1580,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return self.wfile.write(body)
         if url.path == "/fleet/slo":
             return self._send(200, r.fleet_slo_view())
+        if url.path == "/fleet/trace":
+            # The stitched cross-host timeline for one prompt (the request-
+            # forensics collector; scripts/explain.py consumes this).
+            qs = parse_qs(url.query)
+            pid = (qs.get("prompt_id") or [None])[0]
+            if not pid:
+                return self._send(400, {"error": "prompt_id required"})
+            doc = r.stitch_trace(pid)
+            return self._send(404 if doc.get("error") else 200, doc)
         return self._send(404, {"error": f"no route {url.path}"})
 
     def do_POST(self):  # noqa: N802 — http.server API

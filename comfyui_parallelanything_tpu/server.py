@@ -666,6 +666,13 @@ class PromptQueue:
             # graph) locally — bitwise by the fold_in contract, never an
             # error.
             exec_graph, preseed, stage_entry = self._stage_setup(prompt, stage)
+            # Inbound distributed-trace context (W3C traceparent shape,
+            # injected by the fleet router into extra_data.fleet): parsed
+            # here so this host's whole span subtree — prompt, node, lane,
+            # step, decode — joins the router's cross-host trace under one
+            # trace_id. Malformed/absent context degrades to local-only.
+            tp = (tracing.parse_traceparent(fleet.get("traceparent"))
+                  if fleet and tracing.on() else None)
             try:
                 # The prompt span is the root of this prompt's trace
                 # timeline; prompt_id on the scope correlates log records and
@@ -676,8 +683,12 @@ class PromptQueue:
                     interrupt_event=cancel_evt,
                     prompt_id=pid,
                 ), serving_hints(priority=priority, deadline_s=deadline_s), \
+                        tracing.trace_context(tp), \
                         tracing.span(
                             "prompt", cat="server", prompt_id=pid,
+                            # Every span names its host + role: the stitched
+                            # fleet timeline's per-tier filter keys.
+                            host_id=self.host_id, role=self.role,
                             # Cross-hop correlation: a fleet router stamps
                             # its own prompt id into extra_data.fleet, so
                             # this backend-side timeline joins the router's
@@ -685,6 +696,11 @@ class PromptQueue:
                             **({"origin_prompt_id": fleet.get("origin"),
                                 "router": fleet.get("router")}
                                if fleet else {}),
+                            **({"trace_id": tp["trace_id"],
+                                "parent_span_id": tp["parent_span_id"]}
+                               if tp else {}),
+                            **({"stage": stage_entry["stage"]}
+                               if stage_entry is not None else {}),
                         ):
                     if stage_entry is not None:
                         # Denoise hosts may pull conds straight off the
@@ -777,6 +793,10 @@ class PromptQueue:
             # server-observable part of the client's end-to-end latency
             # (the client-side remainder is loadgen's "collect" residual).
             slo.observe_request(admission_s + (time.monotonic() - t0))
+            if tracing.on():
+                # Completed-prompt retention: the fleet stitcher may collect
+                # this prompt's spans long after the live rings wrapped.
+                tracing.retain_prompt(pid)
             with self._lock:
                 self.history[pid] = entry
                 if pid in self.pending_ids:
@@ -1095,6 +1115,11 @@ class _Handler(BaseHTTPRequestHandler):
             prompt_id = qs.get("prompt_id", [None])[0]
             trace = tracing.export(prompt_id=prompt_id)
             trace["enabled"] = tracing.on()
+            # Stitch metadata (round 21): who this export belongs to — the
+            # fleet collector labels the track and aligns the clock domain
+            # off these (epoch_wall_s rides tracing.export itself).
+            trace["host_id"] = self.q.host_id
+            trace["role"] = self.q.role
             return self._send(200, trace)
         if parts and parts[0] == "history":
             # Snapshot under the queue lock: the worker thread inserts entries

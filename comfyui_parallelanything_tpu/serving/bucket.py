@@ -155,6 +155,10 @@ class ServeRequest:
     prompt_id: Optional[str] = None
     trace_tid: Optional[int] = None
     trace_submit_us: Optional[float] = None
+    # Distributed trace identity captured on the submitting thread (the
+    # fleet traceparent's trace_id) — the dispatcher stamps it onto this
+    # request's lane-wait/step/lane spans, same rule as trace_tid.
+    trace_id: Optional[str] = None
     rid: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
     submit_ts: float = dataclasses.field(default_factory=time.monotonic)
 
@@ -1029,6 +1033,8 @@ class StepBucket:
                         cat="serving", tid=req.trace_tid,
                         prompt_id=req.prompt_id, bucket=self.label, lane=i,
                         rid=req.rid, queue_depth=len(self.queue),
+                        **({"trace_id": req.trace_id}
+                           if req.trace_id else {}),
                     )
         if joined:
             self._gauges()
@@ -1054,6 +1060,8 @@ class StepBucket:
                 prompt_id=lane.req.prompt_id, bucket=self.label, lane=i,
                 rid=lane.req.rid, steps_run=lane.idx,
                 outcome="error" if error is not None else "completed",
+                **({"trace_id": lane.req.trace_id}
+                   if lane.req.trace_id else {}),
             )
         lane.req.resolve(result=result, error=error)
         registry.counter(
@@ -1400,6 +1408,8 @@ class StepBucket:
                     tid=lane.req.trace_tid, prompt_id=lane.req.prompt_id,
                     bucket=self.label, lane=i, step=lane.idx + 1,
                     of=lane.req.n_steps, occupancy=len(active),
+                    **({"trace_id": lane.req.trace_id}
+                       if lane.req.trace_id else {}),
                 )
         if quarantine_src is not None:
             # Sentinel boundary: the per-lane stats/digests this dispatch

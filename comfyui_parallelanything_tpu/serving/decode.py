@@ -97,6 +97,7 @@ class DecodeTicket:
     submit_ts: float = dataclasses.field(default_factory=time.monotonic)
     prompt_id: Any = None
     trace_tid: Any = None
+    trace_id: Any = None  # distributed trace identity (see ServeRequest)
     rid: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
 
     def __post_init__(self):
@@ -197,6 +198,7 @@ class DecodeQueue:
             vae=vae, z=z,
             prompt_id=tracing.current_prompt_id() if tracing.on() else None,
             trace_tid=threading.get_ident() if tracing.on() else None,
+            trace_id=tracing.current_trace_id() if tracing.on() else None,
         )
         with self._lock:
             if self._stop:
@@ -309,6 +311,7 @@ class DecodeQueue:
                     "decode", t0_us, dur_us, cat="serving",
                     tid=t.trace_tid, prompt_id=t.prompt_id, rid=t.rid,
                     occupancy=k,
+                    **({"trace_id": t.trace_id} if t.trace_id else {}),
                 )
         for i, t in enumerate(tickets):
             t.resolve(result=out[i * b:(i + 1) * b])

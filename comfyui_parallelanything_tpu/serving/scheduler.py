@@ -379,6 +379,7 @@ class ContinuousBatchingScheduler:
             prompt_id=tracing.current_prompt_id() if tracing.on() else None,
             trace_tid=threading.get_ident() if tracing.on() else None,
             trace_submit_us=tracing.now_us() if tracing.on() else None,
+            trace_id=tracing.current_trace_id() if tracing.on() else None,
             **_current_hints(),
         )
         with self._lock:

@@ -78,6 +78,13 @@ plus ``pa_embed_cache_remote_hits`` / ``pa_embed_cache_remote_misses``
 inside the existing ``pa_embed_cache_*`` family (models/embed_cache.py —
 the cross-host second tier: a denoise host fetching conds from an encode
 host's ``GET /embed/{key}``).
+
+Request forensics (round 21): ``pa_trace_dropped_total{reason=}``
+(utils/tracing.py — spans evicted from the tracer's bounded retention
+tiers: ``retired-ring`` for dead-thread buffers pushed off the retired
+ring, ``prompt-retention`` for completed-prompt snapshots LRU-evicted
+past the budget; nonzero warns that a stitched ``GET /fleet/trace``
+timeline may be incomplete).
 """
 
 from __future__ import annotations

@@ -49,18 +49,75 @@ callbacks) — tracing never adds a device sync of its own.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import itertools
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Optional
 
 # Per-thread span buffer capacity: at ~150 bytes/span this bounds a thread's
 # trace memory at a few MiB while holding minutes of step-granularity spans.
 DEFAULT_CAPACITY = 16384
 
+# Explicit budgets for the two secondary retention tiers. Both tiers COUNT
+# their evictions (``pa_trace_dropped_total{reason=}`` + ``Tracer.dropped``)
+# instead of dropping silently — a full ring is an observability failure the
+# operator must be able to see.
+#
+# - retired ring: dead threads whose pthread ident was recycled (one entry
+#   per dead thread's whole buffer).
+# - prompt retention: completed prompts snapshotted by :meth:`retain_prompt`
+#   so a fleet collector can stitch a prompt's timeline after its recording
+#   threads' rings have wrapped (one entry per prompt).
+RETIRED_RING_BUDGET = 256
+PROMPT_RETENTION = 64
+
 _span_ids = itertools.count(1)
+
+_HEX = set("0123456789abcdef")
+
+
+def format_traceparent(trace_id: str, span_id: int | None = None,
+                       sampled: bool = True) -> str:
+    """W3C-traceparent-style context header: ``00-<32hex trace_id>-<16hex
+    span_id>-<01|00>``. The fleet router uses the prompt_id lineage as the
+    trace_id (``uuid4().hex`` is already 32 lowercase hex chars); any other
+    string is md5-hashed into shape so callers never need to care.
+    ``span_id`` defaults to a fresh id from the process-wide counter."""
+    tid = str(trace_id).lower()
+    if len(tid) != 32 or not set(tid) <= _HEX:
+        tid = hashlib.md5(str(trace_id).encode()).hexdigest()
+    if span_id is None:
+        span_id = next(_span_ids)
+    sid = format((int(span_id) & ((1 << 64) - 1)) or 1, "016x")
+    return f"00-{tid}-{sid}-{'01' if sampled else '00'}"
+
+
+def parse_traceparent(header) -> dict | None:
+    """Inverse of :func:`format_traceparent`: ``{"trace_id", "parent_span_id",
+    "sampled"}``, or ``None`` for anything malformed (unknown version,
+    all-zero ids, wrong field widths) — a bad inbound context must degrade to
+    an untraced hop, never to an exception on the serving path."""
+    if not isinstance(header, str):
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    ver, tid, sid, flags = parts
+    if ver != "00" or len(tid) != 32 or len(sid) != 16 or len(flags) != 2:
+        return None
+    if not (set(tid) <= _HEX and set(sid) <= _HEX and set(flags) <= _HEX):
+        return None
+    parent = int(sid, 16)
+    if int(tid, 16) == 0 or parent == 0:
+        return None
+    return {
+        "trace_id": tid,
+        "parent_span_id": parent,
+        "sampled": bool(int(flags, 16) & 1),
+    }
 
 
 def now_us() -> float:
@@ -129,11 +186,13 @@ class _OpenSpan:
 
 
 class _Local(threading.local):
-    """Per-thread recording state: the open-span stack and the ring buffer."""
+    """Per-thread recording state: the open-span stack, the ring buffer, and
+    the active distributed-trace context (parsed traceparent or None)."""
 
     def __init__(self):
         self.stack: list[_OpenSpan] = []
         self.events: deque | None = None
+        self.ctx: dict | None = None
 
 
 class Tracer:
@@ -153,9 +212,25 @@ class Tracer:
         # new thread claims a dead recorder's ident, the dead thread's spans
         # must survive — they move to this bounded retired ring instead of
         # being silently replaced. Every event row carries its own tid, so
-        # retired buffers export exactly like live ones.
-        self._retired: deque = deque(maxlen=256)  # guarded-by: _lock
+        # retired buffers export exactly like live ones. The ring's budget is
+        # explicit and its evictions are COUNTED (``dropped`` below +
+        # ``pa_trace_dropped_total{reason="retired-ring"}``), never silent.
+        self._retired: deque = deque(maxlen=RETIRED_RING_BUDGET)  # guarded-by: _lock
+        # Completed prompts snapshotted by retain_prompt(): prompt_id -> list
+        # of event rows, LRU-bounded at PROMPT_RETENTION prompts so a fleet
+        # trace collector can still stitch a finished prompt after the live
+        # rings wrapped. guarded-by: _lock
+        self._retained: OrderedDict[str, list] = OrderedDict()
+        # Eviction accounting per reason — the local mirror of the
+        # pa_trace_dropped_total counter (readable without a metrics scrape).
+        self.dropped: dict[str, int] = {}  # guarded-by: _lock
         self._epoch_us = now_us()
+        # Wall-clock anchor taken at the SAME moment as the monotonic epoch:
+        # the cross-host stitcher aligns each process's trace-event clock
+        # (perf_counter-based, per-process origin) onto a shared timeline via
+        # these anchors. NTP-level skew (ms) is the accepted error bar.
+        # palint: allow[observability] clock-alignment epoch STAMP
+        self._epoch_wall_s = time.time()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -168,7 +243,11 @@ class Tracer:
             self.capacity = DEFAULT_CAPACITY if capacity is None else capacity
             self._buffers.clear()
             self._retired.clear()
+            self._retained.clear()
+            self.dropped = {}
             self._epoch_us = now_us()
+            # palint: allow[observability] clock-alignment epoch STAMP
+            self._epoch_wall_s = time.time()
         self._local = _Local()
         self.enabled = True
 
@@ -181,6 +260,8 @@ class Tracer:
         with self._lock:
             self._buffers.clear()
             self._retired.clear()
+            self._retained.clear()
+            self.dropped = {}
 
     # -- recording ----------------------------------------------------------
 
@@ -189,15 +270,41 @@ class Tracer:
         if ev is None:
             ev = local.events = deque(maxlen=self.capacity)
             t = threading.current_thread()
+            evicted = 0
             with self._lock:
                 prev = self._buffers.get(threading.get_ident())
                 if prev is not None and prev[1]:
                     # Recycled ident: retire the dead thread's spans rather
                     # than dropping them (short-lived HTTP handler threads
                     # record real spans — fleet dispatch hops among them).
+                    if len(self._retired) == self._retired.maxlen:
+                        evicted = len(self._retired[0][1])
+                        self.dropped["retired-ring"] = (
+                            self.dropped.get("retired-ring", 0) + evicted
+                        )
                     self._retired.append(prev)
                 self._buffers[threading.get_ident()] = (t.name, ev)
+            if evicted:
+                # Counter emitted OUTSIDE the tracer lock (metrics registry
+                # has its own lock; keep the order acyclic).
+                self._count_dropped("retired-ring", evicted)
         return ev
+
+    @staticmethod
+    def _count_dropped(reason: str, n: int) -> None:
+        # Same lazy-import/never-raise contract as _feed_metrics.
+        try:
+            from .metrics import registry
+
+            registry.counter(
+                "pa_trace_dropped_total", float(n),
+                labels={"reason": reason},
+                help="spans evicted from tracer retention tiers "
+                     "(retired-thread ring, completed-prompt retention) — "
+                     "nonzero means the stitched-timeline view is incomplete",
+            )
+        except Exception:
+            pass
 
     def _emit(self, local, name, ts, dur, cat, tid, attrs, span_id) -> None:
         self._events(local).append((name, ts, dur, cat, tid, attrs, span_id))
@@ -231,6 +338,8 @@ class Tracer:
             prompt_id = self._current_prompt_id(local)
         if prompt_id is not None:
             attrs["prompt_id"] = prompt_id
+        if local.ctx is not None:
+            attrs.setdefault("trace_id", local.ctx["trace_id"])
         return _OpenSpan(self, local, name, cat, attrs)
 
     def record(self, name: str, ts: float, dur: float, cat: str = "host",
@@ -247,6 +356,8 @@ class Tracer:
             prompt_id = self._current_prompt_id(local)
         if prompt_id is not None:
             attrs["prompt_id"] = prompt_id
+        if local.ctx is not None:
+            attrs.setdefault("trace_id", local.ctx["trace_id"])
         self._emit(
             local, name, ts, max(0.0, dur), cat,
             tid if tid is not None else threading.get_ident(),
@@ -279,6 +390,76 @@ class Tracer:
         stack = self._local.stack
         return stack[-1].span_id if stack else None
 
+    def current_trace_id(self) -> Optional[str]:
+        """The distributed trace_id active on the calling thread (from
+        :meth:`trace_context`, or inherited off the span stack), or None.
+        The serving scheduler captures this at admission — lane-wait/step
+        spans recorded later from the dispatcher thread carry the
+        SUBMITTER's trace identity, same rule as the captured tid."""
+        ctx = self._local.ctx
+        if ctx is not None:
+            return ctx["trace_id"]
+        for s in reversed(self._local.stack):
+            tid = s.attrs.get("trace_id")
+            if tid:
+                return tid
+        return None
+
+    @contextlib.contextmanager
+    def trace_context(self, traceparent):
+        """Activate a distributed-trace context (a traceparent header string
+        or an already-parsed dict) on the calling thread: every span/record
+        opened inside is stamped with the context's ``trace_id`` attr, so a
+        backend's local spans join the router's cross-host trace. Malformed
+        or absent context degrades to an untraced (but still locally
+        recorded) scope — never an error on the serving path."""
+        ctx = (parse_traceparent(traceparent)
+               if not isinstance(traceparent, dict) else traceparent)
+        if not self.enabled or not ctx:
+            yield None
+            return
+        local = self._local
+        prev = local.ctx
+        local.ctx = ctx
+        try:
+            yield ctx
+        finally:
+            local.ctx = prev
+
+    # -- completed-prompt retention -----------------------------------------
+
+    def retain_prompt(self, prompt_id: str | None) -> int:
+        """Snapshot every event stamped with ``prompt_id`` into the bounded
+        completed-prompt retention ring, so the fleet trace collector can
+        stitch a finished prompt's timeline even after its recording
+        threads' ring buffers have wrapped (high-throughput hosts wrap in
+        seconds). LRU-bounded at :data:`PROMPT_RETENTION` prompts; evictions
+        are counted (reason ``"prompt-retention"``). Returns the number of
+        rows retained."""
+        if not self.enabled or not prompt_id:
+            return 0
+        evicted = 0
+        with self._lock:
+            rows = []
+            for _tid, (_name, ev) in self._buffers.items():
+                rows.extend(r for r in ev if r[5].get("prompt_id") == prompt_id)
+            for _name, ev in self._retired:
+                rows.extend(r for r in ev if r[5].get("prompt_id") == prompt_id)
+            if not rows:
+                return 0
+            self._retained[prompt_id] = rows
+            self._retained.move_to_end(prompt_id)
+            while len(self._retained) > PROMPT_RETENTION:
+                _pid, old = self._retained.popitem(last=False)
+                evicted += len(old)
+            if evicted:
+                self.dropped["prompt-retention"] = (
+                    self.dropped.get("prompt-retention", 0) + evicted
+                )
+        if evicted:
+            self._count_dropped("prompt-retention", evicted)
+        return len(rows)
+
     # -- export -------------------------------------------------------------
 
     def export(self, prompt_id: str | None = None) -> dict:
@@ -295,12 +476,26 @@ class Tracer:
             snap.extend(
                 (0, name, list(ev)) for name, ev in self._retired
             )
+            # Completed-prompt retention: rows may duplicate live-buffer rows
+            # (retention snapshots, it does not move) — deduped by span_id
+            # below, since span ids are process-unique.
+            if prompt_id is not None:
+                retained = list(self._retained.get(prompt_id, ()))
+            else:
+                retained = [r for rows in self._retained.values()
+                            for r in rows]
+            epoch_wall = self._epoch_wall_s
+        snap.append((0, "retained", retained))
         events: list[dict] = []
         tids_seen: set[int] = set()
+        span_ids_seen: set[int] = set()
         for _rec_tid, _tname, recs in snap:
             for name, ts, dur, cat, tid, attrs, span_id in recs:
                 if prompt_id is not None and attrs.get("prompt_id") != prompt_id:
                     continue
+                if span_id in span_ids_seen:
+                    continue
+                span_ids_seen.add(span_id)
                 args = dict(attrs)
                 args["span_id"] = span_id
                 events.append({
@@ -320,7 +515,13 @@ class Tracer:
             "ph": "M", "name": "process_name", "pid": pid,
             "args": {"name": "parallel_anything_tpu"},
         })
-        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            # Wall-clock anchor of ts==0 (taken with the monotonic epoch):
+            # the cross-host stitcher's clock-domain alignment key.
+            "epoch_wall_s": epoch_wall,
+        }
 
 
 # The process-wide tracer every instrumentation site records into and the
@@ -361,6 +562,23 @@ def current_prompt_id() -> Optional[str]:
 
 def current_span_id() -> Optional[int]:
     return tracer.current_span_id()
+
+
+def trace_context(traceparent):
+    return tracer.trace_context(traceparent)
+
+
+def current_trace_id() -> Optional[str]:
+    return tracer.current_trace_id()
+
+
+def retain_prompt(prompt_id: str | None) -> int:
+    return tracer.retain_prompt(prompt_id)
+
+
+def epoch_wall_s() -> float:
+    """Wall-clock instant of the tracer's ts==0 origin (stitcher anchor)."""
+    return tracer._epoch_wall_s
 
 
 @contextlib.contextmanager

@@ -145,6 +145,26 @@ timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
 
+# Forensics gate (round 21): the role-pool failover e2e reruns with
+# PA_FORENSICS_DUMP set, banking its stitched /fleet/trace document + the
+# client-observed wall; scripts/explain.py --check then gates the
+# conservation contract on that prompt — stitched trace fetched (>= 3
+# host-labeled tracks under ONE trace_id across the mid-denoise failover),
+# every critical-path bucket non-negative, buckets summing to the client
+# wall within 10%. The explain step is stdlib-only (standalone-contract:
+# it must hold over a wedged tunnel).
+fdump=$(mktemp /tmp/_forensics.XXXXXX.json)
+trap 'rm -f "$t1log" "$fdump"' EXIT
+timeout -k 10 300 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    PA_FORENSICS_DUMP="$fdump" \
+    python -m pytest tests/test_roles.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly -k "RequestForensics"
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+env -u PALLAS_AXON_POOL_IPS python scripts/explain.py --check \
+    --trace-file "$fdump" --min-hosts 3 || {
+    echo "ci_tier1: request-forensics explain gate FAILED" >&2; exit 1; }
+
 # Chaos smoke (round 14): a seeded fault plan (backend-http 5xx +
 # slow-host, deterministic in the seed) fired against a 2-backend fleet
 # while the PRIMARY ROUTER is killed mid-denoise (standby takeover off the

@@ -60,6 +60,7 @@ role-pool CI smoke gates.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import random
@@ -69,6 +70,23 @@ import threading
 import time
 import urllib.error
 import urllib.request
+
+
+def trace_sampled(n: int, fraction: float, seed: int | None = None) -> bool:
+    """Seeded, PREFIX-STABLE trace-sampling decision for submission ``n``:
+    whether prompt n is sampled depends only on (seed, n) — never on the
+    total request count or thread interleaving — so growing a run keeps
+    every earlier decision, and a re-run with one seed samples the identical
+    prompt set (the reproducible-schedule discipline ``run_load`` already
+    applies to seeds)."""
+    if fraction <= 0:
+        return False
+    if fraction >= 1:
+        return True
+    h = hashlib.md5(
+        f"pa-trace:{0 if seed is None else seed}:{n}".encode()
+    ).hexdigest()
+    return int(h[:8], 16) / float(0xFFFFFFFF) < fraction
 
 
 def _append_ledger(summary: dict, base: str, kind: str = "loadgen") -> None:
@@ -601,7 +619,8 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
              prompt_vocab: list[str] | None = None,
              seed_fanout: int = 1,
              workload_mix: dict | None = None,
-             workload_graphs: dict | None = None) -> dict:
+             workload_graphs: dict | None = None,
+             trace_sample: float = 0.0) -> dict:
     """The closed loop; returns the summary dict (importable — the e2e and
     fleet-smoke tests drive in-process servers through this exact code path).
 
@@ -635,7 +654,15 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
     ``seed_key``/``sampler_key``/``prompt_key`` so the per-prompt writes
     land. The summary gains ``workload_mix``/``workload_counts`` plus the
     ``lane_capability`` per-kind seat deltas and the
-    ``serving_inline_fallbacks`` gate number either way."""
+    ``serving_inline_fallbacks`` gate number either way.
+
+    Request forensics (round 21): ``trace_sample`` tags a seeded,
+    prefix-stable fraction of submissions for full distributed capture
+    (``extra_data.pa_trace_sampled`` — the router injects a traceparent on
+    every hop of a tagged prompt) and, after each tagged prompt completes,
+    fetches its stitched timeline (``GET /fleet/trace`` behind a router,
+    ``GET /trace`` on a plain server). The summary gains ``traced_prompts``
+    + ``trace_fetch_rate`` (stitch fetch success)."""
     if fallback_bases:
         base = _Front([base, *fallback_bases])
     latencies: list[float] = []
@@ -643,6 +670,8 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
     failures: list[str] = []
     rejected = [0]
     timeouts = [0]
+    traced = [0]
+    traced_ok = [0]
     lock = threading.Lock()
     counter = [0]
     # Reproducible schedule: value n is a pure function of (seed, n), so two
@@ -684,8 +713,12 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
             if texts is not None:
                 _set_path(g, prompt_key, texts[n - 1])
             payload = {"prompt": g}
-            if extra_data:
-                payload["extra_data"] = extra_data
+            sampled = trace_sampled(n, trace_sample, seed)
+            ed = dict(extra_data) if extra_data else {}
+            if sampled:
+                ed["pa_trace_sampled"] = True
+            if ed:
+                payload["extra_data"] = ed
             t0 = time.time()
             # Submit with bounded retry (utils/retry.py shape): a 503 or a
             # refused connection can be a router mid-failover (standby
@@ -734,7 +767,26 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
             status = entry.get("status") or {}
             served_by = (status.get("fleet") or {}).get("host_id") \
                 or status.get("host_id")
+            fetched = None
+            if sampled:
+                # The stitched-capture round trip the sampling exists for:
+                # a tagged prompt's distributed timeline must actually be
+                # collectable, and the summary reports the hit rate.
+                fetched = False
+                path = (f"/fleet/trace?prompt_id={pid}" if hosts
+                        else f"/trace?prompt_id={pid}")
+                try:
+                    doc = _get(base, path)
+                    fetched = (not doc.get("error")
+                               and any(e.get("ph") == "X"
+                                       for e in doc.get("traceEvents") or ()))
+                except (OSError, urllib.error.HTTPError, ValueError):
+                    pass
             with lock:
+                if sampled:
+                    traced[0] += 1
+                    if fetched:
+                        traced_ok[0] += 1
                 if status.get("status_str") == "success":
                     latencies.append(dt)
                     if served_by:
@@ -897,6 +949,15 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
         "fleet": fleet,
         "prompts_lost": prompts_lost,
         "timeouts": timeouts[0],
+        # Request forensics (--trace-sample): prompts tagged for distributed
+        # capture, and the fraction whose stitched timeline was actually
+        # fetchable after completion (None = sampling off).
+        "traced_prompts": traced[0] if trace_sample > 0 else None,
+        "trace_fetch_rate": (
+            round(traced_ok[0] / traced[0], 3)
+            if trace_sample > 0 and traced[0] else
+            (0.0 if trace_sample > 0 else None)
+        ),
         "errors": failures[:5],
     }
 
@@ -1505,7 +1566,15 @@ def main() -> None:
                     metavar="KIND=PATH",
                     help="workflow JSON for one mix kind (repeatable); "
                          "kinds without a graph fall back to --graph")
+    ap.add_argument("--trace-sample", type=float, default=0.0,
+                    help="tag a seeded, prefix-stable fraction of prompts "
+                         "(0..1) for full distributed trace capture and "
+                         "fetch each one's stitched timeline after it "
+                         "completes; summary gains traced_prompts + "
+                         "trace_fetch_rate. Closed-loop only")
     args = ap.parse_args()
+    if args.trace_sample and args.openloop:
+        ap.error("--trace-sample is closed-loop only (no --openloop)")
     workload_mix = parse_workload_mix(args.workload_mix)  # fail fast
     workload_graphs = {}
     for spec in args.workload_graph or []:
@@ -1573,6 +1642,7 @@ def main() -> None:
             seed_fanout=args.seed_fanout,
             workload_mix=workload_mix,
             workload_graphs=workload_graphs or None,
+            trace_sample=args.trace_sample,
         )
         # A disaggregated fleet (some backend declared a role) banks its
         # record under kind="roles" — the role-pool CI smoke's gate record;

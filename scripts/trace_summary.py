@@ -175,6 +175,35 @@ def numerics_counts(events: list[dict]) -> dict:
     }
 
 
+def forensics_counts(events: list[dict]) -> dict:
+    """Request-forensics span attrs (round 21): the tracer stamps every
+    span that runs under an inbound traceparent with ``trace_id``, and the
+    router's fleet-hop / stage-dispatch spans carry ``role`` + ``pool``
+    labels.  Reported as NEW keys only — the pinned aggregate keys above
+    (stream overlap, lane-wait p95, host gap) are untouched."""
+    trace_ids = set()
+    by_role: dict[str, int] = defaultdict(int)
+    by_pool: dict[str, int] = defaultdict(int)
+    by_host: dict[str, int] = defaultdict(int)
+    for e in events:
+        args = e.get("args", {})
+        tid = args.get("trace_id")
+        if tid is not None:
+            trace_ids.add(str(tid))
+        if args.get("role") is not None:
+            by_role[str(args["role"])] += 1
+        if args.get("pool") is not None:
+            by_pool[str(args["pool"])] += 1
+        if args.get("host") is not None:
+            by_host[str(args["host"])] += 1
+    return {
+        "trace_ids": len(trace_ids),
+        "spans_by_role": dict(sorted(by_role.items())),
+        "spans_by_pool": dict(sorted(by_pool.items())),
+        "spans_by_host": dict(sorted(by_host.items())),
+    }
+
+
 def summarize(events: list[dict]) -> dict:
     by_cat: dict[str, list[float]] = defaultdict(list)
     by_name: dict[str, list[float]] = defaultdict(list)
@@ -187,6 +216,7 @@ def summarize(events: list[dict]) -> dict:
     return {
         "numerics": numerics_counts(events),
         "chaos": chaos_counts(events),
+        "forensics": forensics_counts(events),
         "spans": len(events),
         "layers": {
             cat: {
@@ -261,6 +291,13 @@ def main() -> None:
           f"{n['quarantines']} quarantine(s)"
           + (f" — by site {n['nonfinite_by_where']}"
              if n["nonfinite_by_where"] else ""))
+    fx = s["forensics"]
+    if fx["trace_ids"] or fx["spans_by_role"]:
+        print(f"forensics: {fx['trace_ids']} trace id(s)"
+              + (f", spans by role {fx['spans_by_role']}"
+                 if fx["spans_by_role"] else "")
+              + (f", by host {fx['spans_by_host']}"
+                 if fx["spans_by_host"] else ""))
     c = s["chaos"]
     print(f"chaos: {c['faults_injected']} injected fault(s)"
           + (f" by site {c['faults_by_site']}" if c["faults_by_site"] else "")

@@ -38,6 +38,7 @@ from comfyui_parallelanything_tpu.fleet import (
 from comfyui_parallelanything_tpu.fleet import roles as fleet_roles
 from comfyui_parallelanything_tpu.host import carve_stages
 from comfyui_parallelanything_tpu.server import make_server
+from comfyui_parallelanything_tpu.utils import tracing
 from comfyui_parallelanything_tpu.utils.metrics import registry
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
@@ -578,6 +579,106 @@ class TestRolePoolDispatch:
                 if not b.alive:
                     b.q.shutdown()
             fleet_roles.store.clear()
+
+
+# ---------------------------------------------------------------------------
+# request forensics: stitched cross-host timeline + explain conservation
+# ---------------------------------------------------------------------------
+
+
+class TestRequestForensics:
+    def test_stitched_timeline_survives_failover_and_conserves_wall(
+        self, tmp_path
+    ):
+        """The round's acceptance gate: ONE staged prompt over the 1+2+1
+        role fleet — with a mid-denoise host kill — yields ONE stitched
+        Perfetto timeline: >= 3 host-labeled tracks under a single
+        trace_id, journal lineage merged as instant events, and
+        scripts/explain.py buckets non-negative and conserving the
+        client-observed wall within 10%. Reference renders per-thread
+        progress prints only (any_device_parallel.py:817-905); the
+        distributed timeline is this port's addition. When
+        PA_FORENSICS_DUMP is set the stitched doc + wall are written there
+        (the scripts/ci_tier1.sh explain-gate input)."""
+        import explain
+
+        fleet_roles.store.clear()
+        tracing.enable()
+        base, srv, router, backends = _mk_fleet(
+            tmp_path, _SPECS,
+            journal=PromptJournal(str(tmp_path / "journal.jsonl")))
+        try:
+            t0 = time.time()
+            pid = _post(base, "/prompt",
+                        {"prompt": _sgraph(21, den_s=2.5)})["prompt_id"]
+            den = {b.host_id: b for b in backends}
+            _wait(lambda: any(len(den[h].q.running) > 0
+                              for h in ("den-0", "den-1")),
+                  what="denoise stage running")
+            victim = next(h for h in ("den-0", "den-1")
+                          if len(den[h].q.running) > 0)
+            den[victim].kill()
+            entry = _wait_entry(base, pid, timeout=60)
+            wall = time.time() - t0
+            assert entry["status"]["status_str"] == "success"
+            assert router.prompts[pid].failovers >= 1
+
+            doc = _get(base, f"/fleet/trace?prompt_id={pid}")
+            assert doc["schema"] == "pa-fleet-trace/v1"
+            assert doc["trace_id"] == pid
+            assert doc["enabled"] is True
+            # >= 3 live host tracks (encode + surviving denoise + decode);
+            # the killed host's hop is a marked-unreachable track, not a
+            # silent gap.
+            ok_hosts = {h["host"] for h in doc["hosts"]
+                        if h["role"] != "router" and h["ok"]}
+            assert len(ok_hosts) >= 3, doc["hosts"]
+            assert any(h["host"] == victim and not h["ok"]
+                       for h in doc["hosts"]), doc["hosts"]
+            # Every stamped span joins the ONE router trace — the failover
+            # re-dispatch did not fork a second trace_id.
+            xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+            assert xs
+            stamped = {e["args"]["trace_id"] for e in xs
+                       if e.get("args", {}).get("trace_id")}
+            assert stamped == {pid}
+            # Journal stage lineage rides along as instant events.
+            inst = {e["name"] for e in doc["traceEvents"]
+                    if e.get("ph") == "i"}
+            assert "journal:submit" in inst
+            assert "journal:stage_dispatch" in inst
+
+            report = explain.explain_doc(doc, wall_s=wall)
+            assert explain.check(report, tolerance=0.10, min_hosts=3) == []
+            assert report["dominant_bucket"] in explain.BUCKETS
+            dump = os.environ.get("PA_FORENSICS_DUMP")
+            if dump:
+                with open(dump, "w") as f:
+                    json.dump({"doc": doc, "wall_s": wall,
+                               "prompt_id": pid}, f)
+        finally:
+            tracing.disable()
+            _stop_fleet(srv, router, [b for b in backends if b.alive])
+            for b in backends:
+                if not b.alive:
+                    b.q.shutdown()
+            fleet_roles.store.clear()
+
+    def test_disabled_fleet_trace_is_a_noop(self, role_fleet):
+        """PA_TRACE off (the default): the serving path records nothing and
+        GET /fleet/trace answers the stitched shape with enabled=false and
+        zero duration events — forensics cost exactly nothing."""
+        base, router, backends = role_fleet
+        tracing.disable()
+        tracing.tracer.clear()
+        assert not tracing.on()
+        pid = _post(base, "/prompt", {"prompt": _sgraph(23)})["prompt_id"]
+        assert _wait_entry(base, pid)["status"]["status_str"] == "success"
+        doc = _get(base, f"/fleet/trace?prompt_id={pid}")
+        assert doc["schema"] == "pa-fleet-trace/v1"
+        assert doc["enabled"] is False
+        assert not [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert tracing.tracer._buffers == {}
 
 
 # ---------------------------------------------------------------------------
