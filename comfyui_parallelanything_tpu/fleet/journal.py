@@ -108,8 +108,15 @@ class PromptJournal:
                 line = line[:mid] + b"\x00" * mid + line[2 * mid:]
             else:  # truncate (default): torn tail, no newline
                 line = line[: max(1, len(line) // 2)]
+        # Slow-disk fault site: the sleep sits INSIDE the timed region so
+        # the injected fsync stall lands in pa_disk_append_seconds — the
+        # exact latency the anomaly sentinel's disk_append_p95 watch reads.
+        slow = faults.check("slow-disk", key=ev)
+        t0 = time.perf_counter()
         try:
             with self._lock:
+                if slow is not None:
+                    slow.sleep()
                 f = self._file()
                 f.write(line)
                 f.flush()
@@ -117,6 +124,14 @@ class PromptJournal:
                     os.fsync(f.fileno())
         except OSError as e:
             log.error("journal append failed (%s): %s", self.path, e)
+        try:
+            from ..utils.metrics import registry
+            registry.histogram("pa_disk_append_seconds",
+                               time.perf_counter() - t0,
+                               labels={"target": "journal"},
+                               help="journal/ledger append wall time")
+        except Exception:  # pragma: no cover - metrics are best-effort
+            pass
 
     def close(self) -> None:
         with self._lock:

@@ -247,6 +247,20 @@ class FleetRouter:
         self._thread: threading.Thread | None = None
         if self.active and self.journal is not None:
             self.journal.write_lease(self.router_id)
+        # Router-side history sampler: the router's own ring is what makes
+        # heartbeat staleness watchable (pa_fleet_host_health_age_s lives
+        # in THIS registry). PA_HISTORY_BYTES=0 keeps this a no-op; the
+        # cadence runs on its own daemon thread, never the dispatch path.
+        self._history_sampler = None
+        if auto:
+            try:
+                from ..utils import timeseries
+                if timeseries.enabled():
+                    self._history_sampler = timeseries.HistorySampler(
+                        host=self.router_id
+                    ).start()
+            except Exception:  # pragma: no cover - best-effort telemetry
+                self._history_sampler = None
         if auto:
             self._thread = threading.Thread(
                 target=self._loop, name="pa-fleet-monitor", daemon=True
@@ -1432,6 +1446,37 @@ class FleetRouter:
                 )
         return merged + "\n".join(extra) + "\n", stale
 
+    def fleet_history_view(self, window_s: float | None = None) -> dict:
+        """The fleet-wide metric history (``GET /fleet/history``): every
+        backend's ``/metrics/history`` window merged host-labeled, riding
+        the scoreboard's scrape cadence with the same staleness discipline
+        as :meth:`fleet_metrics_view` — a dead or failing host serves its
+        cached window marked ``stale``, never a blocking fetch. The
+        router's own ring rides along under ``router_id`` when non-empty
+        (routers sample too — heartbeat staleness is watched here)."""
+        from ..utils import timeseries
+        hosts: dict[str, dict] = {}
+        for hid, info in self.registry.hosts().items():
+            doc, age = self.scoreboard.scrape_history(hid, info.base,
+                                                      window_s=window_s)
+            hosts[hid] = {
+                "window": doc,
+                "age_s": age,
+                "stale": (age is None
+                          or self.scoreboard.in_backoff(hid)
+                          or self.scoreboard.dead(hid)),
+            }
+        out = {
+            "schema": "pa-fleet-history/v1",
+            "router_id": self.router_id,
+            "enabled": timeseries.enabled(),
+            "hosts": hosts,
+        }
+        own = timeseries.ring.window(window_s=window_s)
+        if (own.get("stats") or {}).get("points", 0):
+            out["router"] = own
+        return out
+
     def fleet_slo_view(self) -> dict:
         """Objective verdicts over the merged fleet view (``GET
         /fleet/slo``): the declared objectives (PA_SLO_OBJECTIVES or the
@@ -1477,6 +1522,8 @@ class FleetRouter:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if self._history_sampler is not None:
+            self._history_sampler.stop()
         if self.journal is not None:
             self.journal.close()
 
@@ -1578,6 +1625,20 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             return self.wfile.write(body)
+        if url.path == "/fleet/history":
+            # Merged per-host metric history: each backend's cached
+            # /metrics/history window, dead hosts degrading to their last
+            # scrape with a staleness marker (scripts/console.py consumes
+            # this).
+            qs = parse_qs(url.query)
+            window = None
+            if qs.get("window"):
+                try:
+                    window = float(qs["window"][0])
+                except ValueError:
+                    return self._send(
+                        400, {"error": "window must be seconds"})
+            return self._send(200, r.fleet_history_view(window_s=window))
         if url.path == "/fleet/slo":
             return self._send(200, r.fleet_slo_view())
         if url.path == "/fleet/trace":
@@ -1617,6 +1678,37 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 # The backend's own client-error verdict, passed through.
                 return self._send(e.code, {"error": str(e)})
             return self._send(200, {"prompt_id": pid, "number": number})
+        if url.path == "/history/phase":
+            # Phase boundary stamp (loadgen rung edges): mark the router's
+            # own ring, then fan out best-effort to every live backend so
+            # each host's history window carries the same phase labels —
+            # a dead host just misses the mark, it never blocks the stamp.
+            label = payload.get("label")
+            if not label:
+                return self._send(400, {"error": "label required"})
+            state = payload.get("state", "begin")
+            detail = payload.get("detail")
+            from ..utils import timeseries
+            timeseries.ring.mark_phase(str(label), state=str(state),
+                                       detail=detail)
+            body = json.dumps({"label": str(label), "state": str(state),
+                               "detail": detail}).encode()
+            stamped = [r.router_id]
+            for hid, info in r.registry.hosts().items():
+                if r.scoreboard.dead(hid):
+                    continue
+                try:
+                    req = urllib.request.Request(
+                        info.base.rstrip("/") + "/history/phase",
+                        data=body,
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=2.0):
+                        pass
+                    stamped.append(hid)
+                except (urllib.error.URLError, OSError, ValueError):
+                    continue
+            return self._send(200, {"ok": True, "stamped": stamped})
         if url.path == "/fleet/register":
             host_id = payload.get("host_id")
             base = payload.get("base")

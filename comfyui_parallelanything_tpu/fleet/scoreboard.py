@@ -148,6 +148,9 @@ class HostHealth:
     # -- /metrics scrape cache (GET /fleet/metrics) --
     metrics_text: str | None = None
     metrics_ts: float | None = None
+    # -- /metrics/history scrape cache (GET /fleet/history) --
+    history_doc: dict | None = None
+    history_ts: float | None = None
 
     def age_s(self, now: float | None = None) -> float | None:
         if self.last_ok is None:
@@ -337,6 +340,45 @@ class Scoreboard:
             e.metrics_ts = now
         return text, 0.0
 
+    # -- history scrape (GET /fleet/history) --------------------------------
+
+    def scrape_history(self, host_id: str, base: str,
+                       window_s: float | None = None,
+                       ) -> tuple[dict | None, float | None]:
+        """One host's ``GET /metrics/history`` window (pa-history/v1) for
+        the fleet-merged view, riding the EXACT scrape_metrics discipline:
+        a host in failure backoff or dead serves its cached window (the
+        router's staleness marker tells the reader), a cache younger than
+        the poll interval serves without re-fetching, and a failed fetch
+        feeds the shared failure counter. Returns
+        ``(doc_or_None, age_s_or_None)``."""
+        now = time.monotonic()
+        with self._lock:
+            e = self._entry(host_id, base)
+            skip = (e.consecutive_failures >= self.fail_after
+                    or (e.consecutive_failures > 0 and e.next_poll > now))
+            cached, cached_ts = e.history_doc, e.history_ts
+        if not skip and cached_ts is not None and now - cached_ts < self.poll_s:
+            return cached, now - cached_ts
+        if skip:
+            return cached, (now - cached_ts) if cached_ts is not None else None
+        url = base + "/metrics/history"
+        if window_s is not None:
+            url += f"?window={float(window_s):g}"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+                doc = json.loads(r.read())
+        except (OSError, ValueError) as e:
+            self.record_failure(host_id, base, f"history: {e}")
+            now = time.monotonic()
+            return cached, (now - cached_ts) if cached_ts is not None else None
+        now = time.monotonic()
+        with self._lock:
+            e = self._entry(host_id, base)
+            e.history_doc = doc
+            e.history_ts = now
+        return doc, 0.0
+
     # -- the router's three questions ---------------------------------------
 
     def healthy(self, host_id: str, now: float | None = None) -> bool:
@@ -483,3 +525,11 @@ class Scoreboard:
                            1.0 if s["accepting"] else 0.0,
                            labels={"host": hid},
                            help="drain state per backend (1 = seating)")
+            if s["health_age_s"] is not None:
+                # The anomaly sentinel's heartbeat-staleness signal
+                # (utils/anomaly.py): a host whose last good poll keeps
+                # aging is going dark long before fail_after marks it.
+                registry.gauge("pa_fleet_host_health_age_s",
+                               s["health_age_s"], labels={"host": hid},
+                               help="seconds since the backend's last "
+                                    "successful health poll")

@@ -341,6 +341,21 @@ class PromptQueue:
             ).start()
         except Exception:
             pass
+        # Continuous telemetry (utils/timeseries.py + utils/anomaly.py):
+        # the seeded-cadence history sampler snapshots every pa_* family
+        # into the bounded ring and ticks the anomaly sentinel — a daemon
+        # thread entirely off the hot step path. PA_HISTORY_BYTES=0
+        # disables the whole layer (bitwise no-op).
+        self._history_sampler = None
+        try:
+            from .utils import timeseries
+
+            if timeseries.enabled():
+                self._history_sampler = timeseries.HistorySampler(
+                    host=self.host_id
+                ).start()
+        except Exception:
+            pass
         # unguarded: written once here before the threads start, only
         # iterated afterwards (shutdown joins a snapshot-stable list)
         self._workers = [
@@ -576,6 +591,8 @@ class PromptQueue:
             t.join(timeout=30)
         if self._mem_monitor is not None:
             self._mem_monitor.stop()
+        if self._history_sampler is not None:
+            self._history_sampler.stop()
         if self.scheduler is not None:
             self.scheduler.uninstall()
             self.scheduler.shutdown()
@@ -1070,10 +1087,38 @@ class _Handler(BaseHTTPRequestHandler):
                 _stage_store.publish_gauges()
             except Exception:
                 pass
+            try:
+                # pa_anomaly_* gauges (utils/anomaly.py): explicit zeros
+                # for every quiet watched signal, 1 while firing — the
+                # other families' scrape-time publish discipline.
+                from .utils import anomaly
+
+                anomaly.sentinel.publish_gauges()
+            except Exception:
+                pass
             return self._send(
                 200, registry.render().encode(),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
+        if url.path == "/metrics/history":
+            # The continuous-telemetry window (pa-history/v1): the bounded
+            # ring's per-family points, readable while an incident is
+            # happening — ?window= (seconds) and ?family= (comma name
+            # prefixes) subset it. Disabled (PA_HISTORY_BYTES=0) serves an
+            # empty, explicitly-disabled document rather than 404ing.
+            from .utils import timeseries
+
+            qs = parse_qs(url.query)
+            try:
+                window = qs.get("window", [None])[0]
+                window = None if window in (None, "") else float(window)
+            except ValueError:
+                return self._send(400, {"error": "window must be seconds"})
+            doc = timeseries.ring.window(
+                window_s=window, families=qs.get("family", [None])[0]
+            )
+            doc["host"] = self.q.host_id
+            return self._send(200, doc)
         if url.path == "/health":
             from .serving.bucket import batched_fraction
             from .utils.telemetry import health_snapshot
@@ -1303,6 +1348,25 @@ class _Handler(BaseHTTPRequestHandler):
             except (TypeError, ValueError) as e:
                 return self._send(400, {"error": f"bad extra_data: {e}"})
             return self._send(200, {"prompt_id": pid, "number": number})
+        if url.path == "/history/phase":
+            # Declared load-phase stamp (utils/timeseries.py): loadgen's
+            # open-loop rungs announce themselves so the anomaly sentinel
+            # attributes the rate ramp instead of paging on it.
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                return self._send(400, {"error": f"bad JSON: {e}"})
+            label = payload.get("label")
+            if not label:
+                return self._send(400, {"error": "label required"})
+            from .utils import timeseries
+
+            timeseries.ring.mark_phase(
+                str(label), state=str(payload.get("state") or "begin"),
+                detail=payload.get("detail"),
+            )
+            return self._send(200, {"ok": True})
         if url.path == "/upload/image":
             return self._upload_image()
         return self._send(404, {"error": f"no route {url.path}"})

@@ -74,6 +74,12 @@ FAULT_SITES = {
                        "newline) or garbled (mode=garble: NULs mid-line), "
                        "rehearsing a router crash mid-write; match filters "
                        "the event name (submit/dispatch/resolve)",
+    "slow-disk": "fleet PromptJournal.append + utils/telemetry ledger "
+                 "writes — sleeps delay_s inside the append (the fsync "
+                 "stall rehearsal: journal/ledger latency shows up in "
+                 "pa_disk_append_seconds and the anomaly sentinel's "
+                 "disk_append_p95 watch); match filters the target "
+                 "(journal event name, or 'ledger')",
     "network-partition": "fleet router↔backend link — BOTH directions of "
                          "one host's traffic drop while each side stays "
                          "alive: router _post/_get raises a refused-socket "

@@ -85,6 +85,24 @@ tiers: ``retired-ring`` for dead-thread buffers pushed off the retired
 ring, ``prompt-retention`` for completed-prompt snapshots LRU-evicted
 past the budget; nonzero warns that a stitched ``GET /fleet/trace``
 timeline may be incomplete).
+
+Continuous telemetry (round 22): ``pa_history_*`` (utils/timeseries.py —
+the bounded metric-history ring's occupancy gauges: ``pa_history_bytes``
+/ ``pa_history_points`` / ``pa_history_span_seconds``, published at
+snapshot time so the ring's coverage is itself observable),
+``pa_anomaly_*`` (utils/anomaly.py — the online sentinel:
+``pa_anomaly_active{signal=,host=}`` gauges,
+``pa_anomaly_events_total{signal=}`` /
+``pa_anomaly_unattributed_total{signal=}`` counters — the loadgen
+``anomalies_fired`` / ``anomalies_unattributed`` deltas and the
+scripts/anomaly_report.py attribution gate), and
+``pa_disk_append_seconds{target=}`` (fleet/journal.py + this package's
+utils/telemetry.py — journal/ledger append wall time, the slow-disk
+chaos site's watched latency signal; ``target`` is ``journal`` or
+``ledger``), plus ``pa_fleet_host_health_age_s{host=}`` inside the
+existing ``pa_fleet_*`` family (fleet/scoreboard.py — seconds since each
+backend's last successful health poll, the sentinel's
+heartbeat-staleness signal).
 """
 
 from __future__ import annotations
@@ -248,6 +266,30 @@ class MetricsRegistry:
             cum += c
             lo = hi
         return lo
+
+    def dump(self, prefix: str | None = None) -> dict:
+        """Structured point-in-time copy of every metric (optionally name-
+        prefix filtered): ``{name: {"type", "bounds", "values":
+        {label_str: float | list}}}`` where ``label_str`` is the sorted
+        ``k="v"`` comma join (empty for the unlabeled series) and histogram
+        lists are the raw ``[per-bound counts..., +Inf, sum, count]``
+        accumulator. The history ring's (utils/timeseries.py) snapshot
+        source — one lock hold, values copied out."""
+        out: dict = {}
+        with self._lock:
+            for name, m in self._metrics.items():
+                if prefix is not None and not name.startswith(prefix):
+                    continue
+                values = {}
+                for key, v in m["values"].items():
+                    lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                    values[lbl] = list(v) if isinstance(v, list) else v
+                out[name] = {
+                    "type": m["type"],
+                    "bounds": list(m["bounds"]) if m.get("bounds") else None,
+                    "values": values,
+                }
+        return out
 
     def reset(self) -> None:
         with self._lock:

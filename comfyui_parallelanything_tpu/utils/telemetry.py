@@ -500,13 +500,33 @@ def append_ledger_record(record: dict, kind: str) -> str | None:
         pass
     rec.setdefault("pid", os.getpid())
     path = ledger_path()
+    # Slow-disk fault site (utils/faults.py): the sleep sits inside the
+    # timed region so an injected fsync stall lands in
+    # pa_disk_append_seconds{target=ledger} — the anomaly sentinel's
+    # disk_append_p95 watch reads exactly this histogram.
     try:
+        from . import faults
+        slow = faults.check("slow-disk", key="ledger")
+    except Exception:
+        slow = None
+    t0 = time.perf_counter()
+    try:
+        if slow is not None:
+            slow.sleep()
         os.makedirs(ledger_dir(), exist_ok=True)
         with open(path, "a") as f:
             f.write(json.dumps(rec) + "\n")
-        return path
     except OSError:
-        return None
+        path = None
+    try:
+        from .metrics import registry
+        registry.histogram("pa_disk_append_seconds",
+                           time.perf_counter() - t0,
+                           labels={"target": "ledger"},
+                           help="journal/ledger append wall time")
+    except Exception:
+        pass
+    return path
 
 
 # ---------------------------------------------------------------------------
@@ -596,6 +616,15 @@ def health_snapshot(queue: dict | None = None,
         }
     except Exception:
         out["reuse"] = None
+    try:
+        # Anomaly sentinel (utils/anomaly.py, round 22): active/fired
+        # signal counts, the last event, and the history ring's budget —
+        # the /health section the ops console and chaos verdicts read.
+        from . import anomaly
+
+        out["anomaly"] = anomaly.sentinel.snapshot()
+    except Exception:
+        out["anomaly"] = None
     if queue is not None:
         out["queue"] = queue
     return out

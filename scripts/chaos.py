@@ -216,13 +216,19 @@ def default_plan(seed: int) -> dict:
     submit record; garble, not truncate, so neighboring records stay
     parseable and the damage is exactly one record wide). nth values derive
     from the seed inside the registry, so two runs of one seed fire
-    identically."""
+    identically. Round 22 adds a slow-disk stall on journal dispatch
+    appends (the fsync-stall rehearsal): the injected latency must land in
+    ``pa_disk_append_seconds`` and the anomaly sentinel's
+    ``disk_append_p95`` watch must fire ATTRIBUTED to it — the
+    telemetry-plane leg of the chaos gate."""
     return {"seed": int(seed), "faults": [
         {"site": "backend-http", "match": "POST /prompt", "mode": "5xx",
          "count": 1},
         {"site": "slow-host", "mode": "stall", "delay_s": 0.5, "count": 1},
         {"site": "journal-corrupt", "match": "dispatch", "mode": "garble",
          "count": 1},
+        {"site": "slow-disk", "match": "dispatch", "delay_s": 1.5,
+         "count": 2},
     ]}
 
 
@@ -261,18 +267,38 @@ def _bitwise_check(base_dir: str, chaos_dir: str, seed: int,
     return missing, mismatched
 
 
-def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
-                    requests: int = 3, seed: int = 7, work_s: float = 0.5,
-                    p95_factor: float = 25.0, lease_ttl_s: float = 1.0,
-                    root: str | None = None,
-                    plan: dict | None = None) -> dict:
+def run_fleet_chaos(**kw) -> dict:
     """The fleet phase (importable — tests/test_chaos.py drives this exact
     path). Returns the verdict dict; ``ok`` is the gate. Under
     ``PA_LOCKCHECK=1`` (ci_tier1.sh sets it for the chaos smoke) the
     lock-acquisition-order graph recorded across the whole
     router+standby+backends run must stay ACYCLIC — the verdict carries
     ``lock_cycles`` and a cycle fails the phase (a potential deadlock under
-    fault injection is a chaos failure even if this run never hung)."""
+    fault injection is a chaos failure even if this run never hung).
+
+    Round 22: the telemetry plane rides along. Wall-clock sampler cadence
+    is not assertable in CI, so the phase pins PA_HISTORY_INTERVAL_S high
+    (background samplers never tick mid-run) and drives the history ring +
+    anomaly sentinel with EXPLICIT ticks — the injected slow-disk stall
+    must fire the ``disk_append_p95`` watch ATTRIBUTED to the armed plan,
+    and every firing must be attributed (an unattributed anomaly under a
+    known fault plan is a telemetry failure)."""
+    interval_before = os.environ.get("PA_HISTORY_INTERVAL_S")
+    os.environ["PA_HISTORY_INTERVAL_S"] = "3600"  # manual ticks only
+    try:
+        return _fleet_chaos(**kw)
+    finally:
+        if interval_before is None:
+            os.environ.pop("PA_HISTORY_INTERVAL_S", None)
+        else:
+            os.environ["PA_HISTORY_INTERVAL_S"] = interval_before
+
+
+def _fleet_chaos(*, n_backends: int = 2, clients: int = 3,
+                 requests: int = 3, seed: int = 7, work_s: float = 0.5,
+                 p95_factor: float = 25.0, lease_ttl_s: float = 1.0,
+                 root: str | None = None,
+                 plan: dict | None = None) -> dict:
     from loadgen import run_load
 
     from comfyui_parallelanything_tpu.utils import faults
@@ -312,6 +338,30 @@ def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
     from comfyui_parallelanything_tpu.utils.faults import registry as _freg
 
     by_site_before = dict(_freg.fired())
+
+    # -- telemetry plane: deterministic sentinel warmup ---------------------
+    # Scratch-journal appends between explicit ticks establish the
+    # disk-append baseline the injected stall is judged against (the plan's
+    # slow-disk spec matches "dispatch", so warm "resolve" appends never
+    # fire it, and the scratch path keeps warm records out of the fleet
+    # journal the standby replays).
+    from comfyui_parallelanything_tpu.utils import anomaly, timeseries
+
+    sentinel_on = timeseries.enabled() and anomaly.enabled()
+    anomaly_events: list[dict] = []
+    if sentinel_on:
+        from comfyui_parallelanything_tpu.fleet.journal import PromptJournal
+
+        timeseries.ring.reset()
+        anomaly.sentinel.reset(seed=seed)
+        timeseries.ring.mark_phase("chaos-fleet", state="begin")
+        warm = PromptJournal(os.path.join(root, "warm-journal.jsonl"))
+        for i in range(8):
+            warm.append("resolve", f"warm-{i}")
+            timeseries.ring.snapshot()
+            anomaly_events += anomaly.sentinel.observe(timeseries.ring)
+        warm.close()
+
     chaos_dir = os.path.join(root, "chaos")
     fleet = _Fleet(os.path.join(root, "c"), n_backends, chaos_dir,
                    journal=True, lease_ttl_s=lease_ttl_s)
@@ -347,6 +397,16 @@ def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
         if n - by_site_before.get(site, 0) > 0
     }
 
+    # Post-run sentinel ticks: the stall samples are in the histogram now;
+    # the snapshot's window delta carries both the latency spike and the
+    # pa_fault_injected_total growth the attributor reads. The phase mark
+    # closes AFTER the ticks so phase attribution still sees it open.
+    if sentinel_on:
+        for _ in range(2):
+            timeseries.ring.snapshot()
+            anomaly_events += anomaly.sentinel.observe(timeseries.ring)
+        timeseries.ring.mark_phase("chaos-fleet", state="end")
+
     # -- gates ---------------------------------------------------------------
     failures: list[str] = []
     if chaos.get("prompts_lost"):
@@ -375,6 +435,37 @@ def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
         )
     if fired <= 0:
         failures.append("fault plan never fired (injection unproven)")
+    # Telemetry-plane gates (round 22): the armed slow-disk stall must be
+    # (a) counted at its site, (b) seen by the sentinel as an ATTRIBUTED
+    # anomaly carrying a postmortem — and nothing may fire unattributed
+    # under a known fault plan.
+    planned_sites = {f["site"] for f in (plan or default_plan(seed))["faults"]}
+    if "slow-disk" in planned_sites and \
+            fired_by_site.get("slow-disk", 0) <= 0:
+        failures.append("slow-disk never fired (injection unproven)")
+    anomalies_block = None
+    if sentinel_on:
+        attributed = [e for e in anomaly_events if e.get("attributed")]
+        unattributed = [e for e in anomaly_events
+                        if not e.get("attributed")]
+        if "slow-disk" in planned_sites and not attributed:
+            failures.append(
+                "no attributed anomaly fired (sentinel unproven — the "
+                "slow-disk stall should trip disk_append_p95)"
+            )
+        if unattributed:
+            failures.append(
+                f"{len(unattributed)} unattributed anomaly firing(s): "
+                + ", ".join(e["signal"] for e in unattributed)
+            )
+        anomalies_block = {
+            "fired": len(anomaly_events),
+            "attributed": len(attributed),
+            "unattributed": len(unattributed),
+            "signals": sorted({e["signal"] for e in anomaly_events}),
+            "postmortems": [e["postmortem"] for e in anomaly_events
+                            if e.get("postmortem")],
+        }
     lock_cycles = None
     if lockcheck is not None:
         cycles = lockcheck.cycles()
@@ -395,6 +486,7 @@ def run_fleet_chaos(*, n_backends: int = 2, clients: int = 3,
         "faults_fired": fired,
         "faults_by_site": fired_by_site,
         "faults_injected_counter": chaos.get("faults_injected"),
+        "anomalies": anomalies_block,
         "baseline_p95_s": baseline["latency_p95_s"],
         "chaos_p95_s": chaos["latency_p95_s"],
         "p95_bound_s": round(p95_bound, 3),

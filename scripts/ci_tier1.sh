@@ -59,6 +59,13 @@ env -u PALLAS_AXON_POOL_IPS python scripts/plan_report.py --check || exit $?
 # after the roofline gate whose calibration store it reads.
 env -u PALLAS_AXON_POOL_IPS python scripts/twin_report.py --check || exit $?
 
+# Anomaly-attribution gate (round 22): every kind=anomaly ledger record the
+# online sentinel (utils/anomaly.py) banked must be ATTRIBUTED — explained
+# by a declared fault site or load phase (scripts/anomaly_report.py — an
+# anomaly-free ledger is SKIP, never a failure: a clean run firing zero is
+# the other half of the contract). Fifth ledger lens, after the twin gate.
+env -u PALLAS_AXON_POOL_IPS python scripts/anomaly_report.py --check || exit $?
+
 # Sampler-coverage gate (round 10): one explicit pass over the lane-vs-solo
 # equivalence matrix + the registry coverage check, so a LaneStepSpec wired
 # into sampling/lane_specs.py but unverified (or missing from
@@ -164,6 +171,20 @@ rc=$?
 env -u PALLAS_AXON_POOL_IPS python scripts/explain.py --check \
     --trace-file "$fdump" --min-hosts 3 || {
     echo "ci_tier1: request-forensics explain gate FAILED" >&2; exit 1; }
+
+# Telemetry-plane smoke (round 22): the continuous-telemetry contract —
+# history-ring byte bound + reset-aware readers, deterministic sentinel
+# firing with fault attribution and a postmortem carrying the history
+# window, /metrics/history + /fleet/history with a dead host serving its
+# cached window marked stale, and scripts/console.py --once --json
+# rendering every live host's sparkline data off a real 2-backend fleet
+# (tests/test_telemetry_plane.py). Also part of the tier-1 run above;
+# this rerun is the explicit contract.
+timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_telemetry_plane.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
 
 # Chaos smoke (round 14): a seeded fault plan (backend-http 5xx +
 # slow-host, deterministic in the seed) fired against a 2-backend fleet

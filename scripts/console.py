@@ -1,0 +1,264 @@
+"""Live ops console: the fleet's telemetry plane in one refreshing screen.
+
+Renders ``GET /fleet/history`` (the router's merged per-host metric
+history, round 22) + ``GET /fleet/slo`` + ``GET /fleet/hosts`` as a
+terminal dashboard: one block per host with unicode sparklines of queue
+depth, SLO burn, HBM watermark, per-interval mean step time and disk
+append latency, the host's ACTIVE anomaly signals
+(``pa_anomaly_active``), role occupancy, and the fleet's SLO verdicts.
+A dead host renders its cached window marked STALE — the console
+degrades exactly like the plane it watches, never blanks.
+
+Pointed at a plain ``server.py`` (no router), it falls back to that
+host's own ``GET /metrics/history`` and renders a one-host fleet.
+
+Modes:
+- default          refresh every ``--interval`` seconds until Ctrl-C
+- ``--once``       render one frame and exit (CI smoke)
+- ``--once --json``  print the frame as ONE JSON document instead of a
+                   screen — scriptable, diffable, no ANSI
+
+Stdlib-only and jax-free by construction (the standalone-contract pass
+checks all of ``scripts/``): it must run on a laptop holding nothing but
+a URL to the front door.
+
+Usage:
+    python scripts/console.py --base http://127.0.0.1:8188
+        [--window 600] [--interval 2] [--once] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+
+BLOCKS = "▁▂▃▄▅▆▇█"
+
+# signal → (family, reduction) rendered per host, top to bottom.
+# gauge-sum/max reduce the point's label values; hist-mean is the
+# per-interval mean from consecutive (sum, count) histogram deltas.
+SIGNALS = (
+    ("queue", "pa_server_queue_pending", "gauge-sum"),
+    ("burn", "pa_slo_burn_rate", "gauge-max"),
+    ("hbm", "pa_hbm_utilization", "gauge-max"),
+    ("step_s", "pa_serving_step_seconds", "hist-mean"),
+    ("disk_s", "pa_disk_append_seconds", "hist-mean"),
+)
+
+
+def _get(base: str, path: str, timeout: float = 10):
+    with urllib.request.urlopen(base.rstrip("/") + path,
+                                timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def spark(series: list) -> str:
+    """Min-max scaled unicode sparkline; None samples render as gaps."""
+    vals = [v for v in series if v is not None]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    out = []
+    for v in series:
+        if v is None:
+            out.append(" ")
+            continue
+        frac = 0.0 if hi <= lo else (v - lo) / (hi - lo)
+        out.append(BLOCKS[min(len(BLOCKS) - 1,
+                              int(frac * (len(BLOCKS) - 1) + 0.5))])
+    return "".join(out)
+
+
+def _series(fam: dict, mode: str) -> list:
+    pts = fam.get("points") or []
+    if mode == "hist-mean":
+        out: list = []
+        prev = None
+        for p in pts:
+            tot_sum = tot_cnt = 0.0
+            for v in (p.get("values") or {}).values():
+                if isinstance(v, list) and len(v) >= 2:
+                    tot_sum += v[-2]
+                    tot_cnt += v[-1]
+            if prev is not None:
+                ds, dc = tot_sum - prev[0], tot_cnt - prev[1]
+                out.append(ds / dc if dc > 0 else None)
+            prev = (tot_sum, tot_cnt)
+        return out
+    out = []
+    for p in pts:
+        vals = [v for v in (p.get("values") or {}).values()
+                if isinstance(v, (int, float))]
+        if not vals:
+            out.append(None)
+        elif mode == "gauge-max":
+            out.append(max(vals))
+        else:
+            out.append(sum(vals))
+    return out
+
+
+def _active_anomalies(window: dict) -> list[str]:
+    fam = (window.get("families") or {}).get("pa_anomaly_active") or {}
+    pts = fam.get("points") or []
+    if not pts:
+        return []
+    out = []
+    for lbl, v in (pts[-1].get("values") or {}).items():
+        if isinstance(v, (int, float)) and v >= 1:
+            m = re.search(r'signal="([^"]*)"', lbl)
+            out.append(m.group(1) if m else lbl)
+    return sorted(out)
+
+
+def _host_view(window: dict | None) -> dict:
+    """One host's console block from its pa-history/v1 window."""
+    if not window:
+        return {"signals": {}, "anomalies": [], "points": 0}
+    fams = window.get("families") or {}
+    signals = {}
+    for name, family, mode in SIGNALS:
+        fam = fams.get(family)
+        if not fam:
+            continue
+        series = _series(fam, mode)
+        shown = [None if v is None else round(float(v), 6) for v in series]
+        last = next((v for v in reversed(shown) if v is not None), None)
+        signals[name] = {"family": family, "last": last,
+                         "series": shown, "spark": spark(shown)}
+    return {
+        "signals": signals,
+        "anomalies": _active_anomalies(window),
+        "points": (window.get("stats") or {}).get("points", 0),
+        "phases": [p.get("label") for p in (window.get("phases") or [])
+                   if p.get("state") == "begin"][-3:],
+    }
+
+
+def build_frame(base: str, window_s: float | None) -> dict:
+    """One console frame: fetch + reduce. Raises only when even the
+    single-host fallback is unreachable."""
+    q = f"?window={window_s:g}" if window_s else ""
+    fleet = None
+    try:
+        fleet = _get(base, "/fleet/history" + q)
+    except (urllib.error.URLError, OSError, ValueError):
+        fleet = None
+    if fleet is None or "hosts" not in fleet:
+        # Single-host fallback: a plain server.py front door.
+        own = _get(base, "/metrics/history" + q)
+        fleet = {"schema": "pa-fleet-history/v1",
+                 "router_id": None,
+                 "enabled": own.get("enabled"),
+                 "hosts": {own.get("host") or base: {
+                     "window": own, "stale": False, "age_s": 0.0}}}
+    hosts = {}
+    for hid, h in sorted((fleet.get("hosts") or {}).items()):
+        view = _host_view(h.get("window"))
+        view["stale"] = bool(h.get("stale"))
+        view["age_s"] = h.get("age_s")
+        hosts[hid] = view
+    slo = None
+    try:
+        slo = _get(base, "/fleet/slo")
+    except (urllib.error.URLError, OSError, ValueError):
+        pass
+    roles = None
+    try:
+        doc = _get(base, "/fleet/hosts")
+        roles = (doc.get("roles") or {}) or None
+    except (urllib.error.URLError, OSError, ValueError):
+        pass
+    frame = {
+        "schema": "pa-console/v1",
+        "base": base,
+        "router_id": fleet.get("router_id"),
+        "enabled": fleet.get("enabled"),
+        "hosts": hosts,
+        "roles": roles,
+    }
+    if isinstance(slo, dict):
+        frame["slo"] = {
+            "objectives": [
+                {"name": o.get("name"), "ok": o.get("ok"),
+                 "burn_rate": o.get("burn_rate"),
+                 "achieved_fraction": o.get("achieved_fraction")}
+                for o in slo.get("objectives") or []
+            ],
+        }
+    if "router" in (fleet or {}):
+        frame["router"] = _host_view(fleet["router"])
+    return frame
+
+
+def render(frame: dict, out=sys.stdout) -> None:
+    w = out.write
+    w(f"── pa console ── {frame['base']}"
+      f"{'  (history disabled)' if frame.get('enabled') is False else ''}\n")
+    for o in (frame.get("slo") or {}).get("objectives") or []:
+        mark = {True: "ok", False: "VIOLATED", None: "—"}[o.get("ok")]
+        w(f"  slo {o['name']:<14} {mark:<9}"
+          f" burn {o.get('burn_rate')}"
+          f"  achieved {o.get('achieved_fraction')}\n")
+    for role, p in (frame.get("roles") or {}).items():
+        if isinstance(p, dict):
+            n = len(p.get("hosts") or []) or p.get("n_hosts")
+            w(f"  role {role:<10} {n} host(s)\n")
+    for hid, h in (frame.get("hosts") or {}).items():
+        tag = " [STALE]" if h.get("stale") else ""
+        anom = (" ⚠ " + ",".join(h["anomalies"])) if h.get("anomalies") \
+            else ""
+        w(f"  host {hid}{tag}{anom}  ({h.get('points')} samples"
+          f"{', phases ' + '>'.join(h['phases']) if h.get('phases') else ''}"
+          f")\n")
+        for name, s in (h.get("signals") or {}).items():
+            w(f"    {name:<7} {s['spark']:<24} last {s['last']}\n")
+    w("──\n")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--base", default="http://127.0.0.1:8188",
+                    help="router (or plain server) base URL")
+    ap.add_argument("--window", type=float, default=600.0,
+                    help="history window in seconds")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh cadence (loop mode)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: print the frame as one JSON doc")
+    args = ap.parse_args()
+
+    if args.once:
+        try:
+            frame = build_frame(args.base, args.window)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            sys.stderr.write(f"console: {args.base} unreachable: {e}\n")
+            return 1
+        if args.json:
+            print(json.dumps(frame))
+        else:
+            render(frame)
+        return 0
+    try:
+        while True:
+            try:
+                frame = build_frame(args.base, args.window)
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+                render(frame)
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                sys.stdout.write(f"console: {args.base} unreachable: {e}\n")
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

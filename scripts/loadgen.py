@@ -222,6 +222,19 @@ def _post(base: str, path: str, payload: dict, timeout: float = 30):
         return json.loads(r.read())
 
 
+def _mark_phase(base, label: str, state: str) -> None:
+    """Best-effort phase-boundary stamp into the front door's metric
+    history ring (POST /history/phase, round 22) — the anomaly sentinel
+    attributes firings to the open phase, so each open-loop rung stamps
+    its edges. A pre-round-22 server 404s and a dead front door refuses;
+    either way the rung just runs unstamped."""
+    try:
+        _post(base, "/history/phase", {"label": label, "state": state},
+              timeout=5)
+    except Exception:
+        pass
+
+
 def _wait_done(base, pid: str, timeout: float):
     t0 = time.time()
     attempt = 0
@@ -295,6 +308,10 @@ def _serving_counters(base: str) -> dict:
                  # injected and what gracefully degraded, summed over their
                  # {site=}/{rung=} labels.
                  "pa_fault_injected_total", "pa_degradation_total",
+                 # Anomaly sentinel (round 22, utils/anomaly.py): firings
+                 # and the unattributed subset (summed over {signal=}) — a
+                 # run's summary proves what the telemetry plane flagged.
+                 "pa_anomaly_events_total", "pa_anomaly_unattributed_total",
                  # Universal lane batching (round 16): capability seats,
                  # inline-fallback bounces (summed over reason/sampler), and
                  # control-trunk conflicts — the mixed-workload rung's gates.
@@ -924,6 +941,20 @@ def run_load(base: str, graph: dict, *, clients: int, requests: int,
             - before.get("pa_degradation_total", 0.0)
         ) if ("pa_degradation_total" in after
               or "pa_degradation_total" in before) else None,
+        # Anomaly sentinel deltas over this run (round 22,
+        # utils/anomaly.py): signal firings and the unattributed subset
+        # (None = the counters never existed — sentinel off or nothing
+        # ever fired process-wide).
+        "anomalies_fired": (
+            after.get("pa_anomaly_events_total", 0.0)
+            - before.get("pa_anomaly_events_total", 0.0)
+        ) if ("pa_anomaly_events_total" in after
+              or "pa_anomaly_events_total" in before) else None,
+        "anomalies_unattributed": (
+            after.get("pa_anomaly_unattributed_total", 0.0)
+            - before.get("pa_anomaly_unattributed_total", 0.0)
+        ) if ("pa_anomaly_unattributed_total" in after
+              or "pa_anomaly_unattributed_total" in before) else None,
         # Server-side quantiles from the /metrics histograms (end-state
         # values — histograms are cumulative): what the SERVER measured per
         # lockstep dispatch / lane admission, vs the client-clock latencies
@@ -1093,10 +1124,12 @@ def run_open_load(base: str, graph: dict, *, kind: str = "poisson",
     lock = threading.Lock()
     curve: list[dict] = []
     t_start = time.time()
-    for rung in rungs_in:
+    for rung_idx, rung in enumerate(rungs_in):
         offsets = rung["offsets"]
         rung_lat: list[float] = []
         rung_exec: list[float] = []
+        rung_label = f"openloop-{kind}-r{rung_idx}-{rung['rps']}rps"
+        _mark_phase(base, rung_label, "begin")
         rt0 = time.time()
 
         def fire(_rung_lat=rung_lat, _rung_exec=rung_exec):
@@ -1177,6 +1210,7 @@ def run_open_load(base: str, graph: dict, *, kind: str = "poisson",
         for th in threads:
             th.join(timeout + rung["duration_s"] + 60)
         wall = time.time() - rt0
+        _mark_phase(base, rung_label, "end")
         dur = rung["duration_s"] or (max(offsets) if offsets else 0.0) or 1.0
         entry: dict = {
             "rps": rung["rps"],
@@ -1458,6 +1492,9 @@ def print_human_summary(summary: dict, stream=None) -> None:
             summary.get("degradations") is not None:
         w(f"  chaos     faults injected {summary.get('faults_injected')}"
           f"  degradation rungs {summary.get('degradations')}\n")
+    if summary.get("anomalies_fired") is not None:
+        w(f"  anomaly   fired {summary.get('anomalies_fired')}"
+          f"  unattributed {summary.get('anomalies_unattributed')}\n")
     if summary.get("roofline_comms_fraction") is not None or \
             summary.get("roofline_host_gap_fraction") is not None:
         w(f"  roofline  comms {summary.get('roofline_comms_fraction')}"

@@ -43,6 +43,8 @@ DECLARED = (
     "comfyui_parallelanything_tpu/utils/retry.py",
     "comfyui_parallelanything_tpu/utils/faults.py",
     "comfyui_parallelanything_tpu/utils/lockcheck.py",
+    "comfyui_parallelanything_tpu/utils/timeseries.py",
+    "comfyui_parallelanything_tpu/utils/anomaly.py",
     "comfyui_parallelanything_tpu/fleet/twin.py",
 )
 

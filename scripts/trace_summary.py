@@ -40,6 +40,7 @@ SPAN_CATEGORIES = (
     "fleet",      # fleet/router.py fleet-prompt / fleet-hop spans
     "numerics",   # utils/numerics.py nonfinite-event / quarantine instants
     "faults",     # utils/faults.py fault-injected instants
+    "anomaly",    # utils/anomaly.py sentinel-firing instants
     "degrade",    # utils/degrade.py degradation-rung instants
     "profiler",   # utils/tracing.hardware_trace jax.profiler bracket
 )

@@ -1,0 +1,387 @@
+"""Continuous telemetry plane (round 22): the metric-history ring
+(utils/timeseries.py), the online anomaly sentinel (utils/anomaly.py),
+the server/router history surfaces, and the ops console — byte bounds,
+reset-aware readers, deterministic detectors, dead-host staleness, and
+the PA_HISTORY_BYTES=0 / PA_ANOMALY=0 null paths."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from comfyui_parallelanything_tpu.fleet import (
+    FleetRegistry,
+    Scoreboard,
+    make_router,
+)
+from comfyui_parallelanything_tpu.server import make_server
+from comfyui_parallelanything_tpu.utils import anomaly, timeseries
+from comfyui_parallelanything_tpu.utils.metrics import registry
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+def _get(base, path, timeout=15):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(base, path, payload=None, timeout=15):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload or {}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch, tmp_path):
+    """Every test starts with a fresh ring/sentinel and manual cadence
+    (background samplers pinned to an hour so ticks are explicit); any
+    ledger/postmortem a firing emits lands in the test's tmp dir."""
+    monkeypatch.setenv("PA_HISTORY_INTERVAL_S", "3600")
+    monkeypatch.setenv("PA_EVIDENCE_DIR", str(tmp_path / "evidence"))
+    monkeypatch.delenv("PA_LEDGER_DIR", raising=False)
+    timeseries.ring.reset()
+    anomaly.sentinel.reset(seed=0)
+    yield
+    timeseries.ring.reset()
+    anomaly.sentinel.reset(seed=0)
+
+
+class TestHistoryRing:
+    def test_byte_bound_holds_under_churn(self):
+        r = timeseries.HistoryRing(budget=8 * 1024)
+        for i in range(400):
+            r.record({"pa_churn_total": {
+                "type": "counter", "bounds": None,
+                "values": {f'k="{j}"': float(i + j) for j in range(8)},
+            }}, ts=1000.0 + i)
+        st = r.stats()
+        assert st["bytes"] <= 8 * 1024
+        assert st["downsampled"] > 0
+        # The window SPAN survives downsampling: first/last kept.
+        pts = r._families["pa_churn_total"]["points"]
+        assert pts[0][0] == pytest.approx(1000.0)
+        assert pts[-1][0] == pytest.approx(1399.0)
+
+    def test_timestamps_strictly_monotone(self):
+        r = timeseries.HistoryRing(budget=1 << 20)
+        # A stepped wall clock (same ts, then BACKWARD) never produces an
+        # out-of-order window.
+        for ts in (100.0, 100.0, 50.0, 200.0):
+            r.record({"pa_x_total": {"type": "counter", "bounds": None,
+                                     "values": {"": 1.0}}}, ts=ts)
+        stamps = [ts for ts, _ in r._families["pa_x_total"]["points"]]
+        assert stamps == sorted(stamps)
+        assert len(set(stamps)) == len(stamps)
+
+    def test_counter_reset_aware_delta_and_rate(self):
+        r = timeseries.HistoryRing(budget=1 << 20)
+        # 10 → 14 → restart at 2 → 5: growth is 4 + 2 + 3 = 9, never
+        # negative, never the raw 5 - 10.
+        for i, v in enumerate((10.0, 14.0, 2.0, 5.0)):
+            r.record({"pa_r_total": {"type": "counter", "bounds": None,
+                                     "values": {"": v}}}, ts=100.0 + i)
+        assert r.delta("pa_r_total") == pytest.approx(9.0)
+        assert r.rate("pa_r_total") == pytest.approx(9.0 / 3.0)
+
+    def test_delta_credits_family_born_mid_window(self):
+        r = timeseries.HistoryRing(budget=1 << 20)
+        r.record({"pa_old_total": {"type": "counter", "bounds": None,
+                                   "values": {"": 7.0}}}, ts=100.0)
+        r.record({"pa_old_total": {"type": "counter", "bounds": None,
+                                   "values": {"": 7.0}},
+                  "pa_born_total": {"type": "counter", "bounds": None,
+                                    "values": {'site="x"': 3.0}}}, ts=101.0)
+        # Born mid-window → counted from 0. Present at ring start → its
+        # pre-existing value is NOT growth.
+        assert r.delta("pa_born_total") == pytest.approx(3.0)
+        assert r.delta("pa_old_total") == pytest.approx(0.0)
+
+    def test_windowed_histogram_quantile(self):
+        r = timeseries.HistoryRing(budget=1 << 20)
+        for i in range(6):
+            registry.histogram("pa_tq_seconds", 0.01 if i < 5 else 5.0,
+                               labels={"k": "v"})
+            r.snapshot(ts=1000.0 + i)
+        q = r.quantile_at("pa_tq_seconds", 95, window_s=600)
+        assert q is not None and q > 1.0
+        # A window covering only the quiet prefix reads quiet.
+        assert r.window(window_s=600)["families"]["pa_tq_seconds"]["type"] \
+            == "histogram"
+
+    def test_disabled_budget_is_noop(self, monkeypatch):
+        monkeypatch.setenv("PA_HISTORY_BYTES", "0")
+        assert not timeseries.enabled()
+        r = timeseries.HistoryRing()  # budget read from env
+        assert r.snapshot() == 0
+        r.mark_phase("p")
+        assert r.stats()["points"] == 0 and r._phases == []
+        assert r.window()["enabled"] is False
+
+    def test_window_families_filter_and_phases(self):
+        r = timeseries.HistoryRing(budget=1 << 20)
+        r.mark_phase("rung-1", "begin", ts=999.0)
+        r.record({"pa_a_total": {"type": "counter", "bounds": None,
+                                 "values": {"": 1.0}},
+                  "pa_b_total": {"type": "counter", "bounds": None,
+                                 "values": {"": 1.0}}}, ts=1000.0)
+        doc = r.window(families="pa_a")
+        assert list(doc["families"]) == ["pa_a_total"]
+        assert doc["phases"][0]["label"] == "rung-1"
+        assert doc["stats"]["points"] == 2
+        assert r.phase_at() == "rung-1"
+        r.mark_phase("rung-1", "end", ts=1001.0)
+        r.record({"pa_a_total": {"type": "counter", "bounds": None,
+                                 "values": {"": 2.0}}}, ts=1002.0)
+        assert r.phase_at() is None
+
+
+class TestSentinel:
+    def _feed(self, seed):
+        """One deterministic series: 8 quiet disk-append ticks, then a
+        stall + a fired fault site. Returns the firing sequence."""
+        registry.reset()
+        ring = timeseries.HistoryRing(budget=1 << 20)
+        s = anomaly.AnomalySentinel(seed=seed)
+        sigs = []
+        for i in range(8):
+            registry.histogram("pa_disk_append_seconds", 0.001,
+                               labels={"target": "journal"})
+            ring.snapshot(ts=1000.0 + i)
+            sigs += [e["signal"] for e in s.observe(ring, ts=1000.0 + i)]
+        registry.counter("pa_fault_injected_total",
+                         labels={"site": "slow-disk"})
+        registry.histogram("pa_disk_append_seconds", 1.5,
+                           labels={"target": "journal"})
+        ring.snapshot(ts=1010.0)
+        events = s.observe(ring, ts=1010.0)
+        sigs += [e["signal"] for e in events]
+        return sigs, events
+
+    def test_detector_fires_deterministically_and_attributes(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PA_EVIDENCE_DIR", str(tmp_path))
+        sigs1, events = self._feed(seed=7)
+        sigs2, _ = self._feed(seed=7)
+        assert sigs1 == sigs2 == ["disk_append_p95"]
+        ev = events[0]
+        assert ev["attributed"] is True
+        assert ev["attributed_to"]["faults"] == ["slow-disk"]
+        assert ev["observed"] > ev["baseline"]
+        # Auto-forensics: the bundle carries the history window.
+        pm = ev["postmortem"]
+        err = json.load(open(os.path.join(pm, "error.json")))
+        hist = err["extra"]["history"]
+        assert hist["schema"] == timeseries.HISTORY_SCHEMA
+        assert "pa_disk_append_seconds" in hist["families"]
+        # The firing also left a kind="anomaly" ledger record the
+        # attribution gate (scripts/anomaly_report.py) reads.
+        ledger = os.path.join(str(tmp_path), "ledger", "perf_ledger.jsonl")
+        recs = [json.loads(line) for line in open(ledger)]
+        anoms = [r for r in recs if r.get("kind") == "anomaly"]
+        assert anoms and anoms[-1]["signal"] == "disk_append_p95"
+        assert anoms[-1]["attributed"] is True
+        out = subprocess.run(
+            [sys.executable, os.path.join(SCRIPTS, "anomaly_report.py"),
+             "--check", "--ledger", ledger],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+
+    def test_unattributed_firing_fails_the_gate(self, tmp_path):
+        rec = {"schema": "pa-perf-ledger/v1", "kind": "anomaly",
+               "signal": "burn_rate", "observed": 9.0, "baseline": 0.1,
+               "attributed": False,
+               "attributed_to": {"faults": [], "phase": None}}
+        ledger = tmp_path / "perf_ledger.jsonl"
+        ledger.write_text(json.dumps(rec) + "\n")
+        out = subprocess.run(
+            [sys.executable, os.path.join(SCRIPTS, "anomaly_report.py"),
+             "--check", "--ledger", str(ledger)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 1, out.stdout + out.stderr
+        # Empty ledger is SKIP, never a failure.
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        out = subprocess.run(
+            [sys.executable, os.path.join(SCRIPTS, "anomaly_report.py"),
+             "--check", "--ledger", str(empty)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0 and "SKIP" in out.stdout
+
+    def test_trend_detector_queue_growth(self):
+        ring = timeseries.HistoryRing(budget=1 << 20)
+        s = anomaly.AnomalySentinel(seed=1)
+        fired = []
+        for i, depth in enumerate((0, 1, 2, 0, 2, 5, 9, 14)):
+            ring.record({"pa_server_queue_pending": {
+                "type": "gauge", "bounds": None,
+                "values": {"": float(depth)}}}, ts=1000.0 + i)
+            fired += s.observe(ring, ts=1000.0 + i)
+        assert [e["signal"] for e in fired] == ["queue_depth"]
+        # The dip at i=3 means the monotone run starts at 0 (i=3): the
+        # detector fired only once the rise cleared min_rise over k
+        # all-positive deltas.
+        assert fired[0]["observed"] == 14.0
+
+    def test_pa_anomaly_0_is_noop(self, monkeypatch):
+        monkeypatch.setenv("PA_ANOMALY", "0")
+        assert not anomaly.enabled()
+        registry.reset()
+        ring = timeseries.HistoryRing(budget=1 << 20)
+        assert anomaly.observe(ring) == []
+        anomaly.sentinel.publish_gauges()
+        assert registry.get("pa_anomaly_active",
+                            {"signal": "burn_rate", "host": ""}) is None
+        assert anomaly.sentinel.snapshot()["enabled"] is False
+
+    def test_baseline_frozen_while_firing(self):
+        d = anomaly.BandDetector(z_max=4.0, warmup=2, min_sigma=0.01)
+        for _ in range(5):
+            d.update(1.0)
+        base = d.baseline()
+        assert d.update(100.0) is True
+        assert d.baseline() == base  # anomaly can't teach the detector
+        assert d.update(1.0) is True  # still firing (clear_k=2)
+        assert d.update(1.0) is False
+
+
+class _Work:
+    CATEGORY = "test"
+    RETURN_TYPES = ("INT",)
+    FUNCTION = "run"
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"seed": ("INT", {"default": 0})}}
+
+    def run(self, seed):
+        return (int(seed),)
+
+
+class TestHistoryHTTP:
+    @pytest.fixture
+    def fleet(self, tmp_path):
+        backends = []
+        for i in range(2):
+            srv, q = make_server(
+                port=0, output_dir=str(tmp_path / f"h{i}"),
+                class_mappings={"Work": _Work}, host_id=f"host-{i}",
+            )
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            backends.append(
+                (f"host-{i}", f"http://127.0.0.1:{srv.server_address[1]}",
+                 srv, q))
+        srv, router = make_router(
+            port=0, backends=[(t, b) for t, b, _, _ in backends],
+            fleet_registry=FleetRegistry(ttl_s=3.0),
+            scoreboard=Scoreboard(poll_s=0.1, stale_after_s=5.0,
+                                  fail_after=2, timeout_s=2.0),
+            saturation_depth=1, monitor_s=0.05, max_attempts=4,
+        )
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        t0 = time.monotonic()
+        while not all(router.scoreboard.healthy(t) for t, *_ in backends):
+            assert time.monotonic() - t0 < 30, "backends never healthy"
+            time.sleep(0.02)
+        yield base, router, backends
+        srv.shutdown()
+        srv.server_close()
+        router.shutdown()
+        for _, _, s, q in backends:
+            try:
+                s.shutdown()
+                s.server_close()
+            except OSError:
+                pass
+            q.shutdown()
+
+    def test_server_history_route_and_phase_post(self, fleet):
+        base, router, backends = fleet
+        _, bbase, _, _ = backends[0]
+        _post(bbase, "/history/phase", {"label": "warm", "state": "begin"})
+        registry.gauge("pa_server_queue_pending", 2.0)
+        timeseries.ring.snapshot()
+        doc = _get(bbase, "/metrics/history?window=600")
+        assert doc["schema"] == timeseries.HISTORY_SCHEMA
+        assert doc["host"] == "host-0"
+        assert "pa_server_queue_pending" in doc["families"]
+        assert doc["phases"][0]["label"] == "warm"
+        # family filter narrows the families section
+        doc = _get(bbase, "/metrics/history?family=pa_server")
+        assert all(n.startswith("pa_server") for n in doc["families"])
+        with pytest.raises(urllib.error.HTTPError):
+            _get(bbase, "/metrics/history?window=nope")
+
+    def test_health_carries_anomaly_section(self, fleet):
+        _, _, backends = fleet
+        doc = _get(backends[0][1], "/health")
+        assert doc["anomaly"]["schema"] == anomaly.ANOMALY_SCHEMA
+        assert "disk_append_p95" in doc["anomaly"]["watchlist"]
+
+    def test_fleet_history_merges_and_marks_dead_host_stale(self, fleet):
+        base, router, backends = fleet
+        timeseries.ring.snapshot()
+        doc = _get(base, "/fleet/history?window=600")
+        assert doc["schema"] == "pa-fleet-history/v1"
+        assert set(doc["hosts"]) == {"host-0", "host-1"}
+        for h in doc["hosts"].values():
+            assert h["stale"] is False
+            assert h["window"]["schema"] == timeseries.HISTORY_SCHEMA
+        # Router-side phase fan-out stamps every live host.
+        got = _post(base, "/history/phase", {"label": "rung-0"})
+        assert set(got["stamped"]) >= {"host-0", "host-1"}
+        # Kill one backend: its section degrades to the cached window,
+        # marked stale — never a blocking fetch, never a hole.
+        tag, bbase, srv, q = backends[0]
+        srv.shutdown()
+        srv.server_close()
+        q.interrupt()
+        t0 = time.monotonic()
+        while not router.scoreboard.dead(tag):
+            assert time.monotonic() - t0 < 30, "kill never detected"
+            time.sleep(0.05)
+        doc = _get(base, "/fleet/history")
+        assert doc["hosts"][tag]["stale"] is True
+        assert doc["hosts"][tag]["window"] is not None  # cached, not blank
+        assert doc["hosts"]["host-1"]["stale"] is False
+
+    def test_console_once_json_smoke(self, fleet):
+        base, router, backends = fleet
+        registry.gauge("pa_server_queue_pending", 1.0)
+        timeseries.ring.snapshot()
+        registry.gauge("pa_server_queue_pending", 3.0)
+        timeseries.ring.snapshot()
+        out = subprocess.run(
+            [sys.executable, os.path.join(SCRIPTS, "console.py"),
+             "--base", base, "--once", "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        frame = json.loads(out.stdout)
+        assert frame["schema"] == "pa-console/v1"
+        assert set(frame["hosts"]) >= {"host-0", "host-1"}
+        h = frame["hosts"]["host-0"]
+        assert h["signals"]["queue"]["spark"]
+        assert h["signals"]["queue"]["last"] is not None
+        assert len(h["signals"]["queue"]["series"]) >= 2
+        assert h["stale"] is False
+        # Human mode renders the same frame without ANSI garbage.
+        out = subprocess.run(
+            [sys.executable, os.path.join(SCRIPTS, "console.py"),
+             "--base", base, "--once"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0 and "host-0" in out.stdout
